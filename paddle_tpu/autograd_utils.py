@@ -1,0 +1,176 @@
+"""paddle.grad: gradients of outputs w.r.t. chosen inputs.
+
+Reference analogue: PartialGradEngine
+(/root/reference/paddle/fluid/imperative/partial_grad_engine.cc).
+
+Two modes:
+- create_graph=False: a tape sweep identical to backward() but accumulating
+  into a result list instead of .grad.
+- create_graph=True: the contributing subgraph is replayed as ONE pure
+  function (each node stored its pure fn + original arrays) and the gradient
+  is computed with jax.vjp *inside a taped op*, so the returned grads carry
+  tape history and arbitrary-order differentiation works — jax
+  differentiates through the replayed forward, residuals included.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import enforce as _enforce
+from .framework import Tensor, _unwrap, global_tape, _zero_cotangent
+
+
+def _normalize(outputs, inputs, grad_outputs):
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    else:
+        grad_outputs = list(grad_outputs)
+    return outputs, inputs, grad_outputs
+
+
+def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+                 create_graph=False, allow_unused=False, no_grad_vars=None):
+    outputs, inputs, grad_outputs = _normalize(outputs, inputs, grad_outputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if create_graph:
+        return _replay_grad(outputs, inputs, grad_outputs, allow_unused,
+                            no_grad_vars, retain_graph)
+    return _sweep_grad(outputs, inputs, grad_outputs, allow_unused,
+                       no_grad_vars, retain_graph)
+
+
+def _sweep_grad(outputs, inputs, grad_outputs, allow_unused, no_grad_vars,
+                retain_graph):
+    no_grad_ids = {id(t) for t in (no_grad_vars or [])}
+    tape = global_tape()
+    nodes = tape.nodes
+    cotan = {}
+    max_idx = -1
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    grads = {}  # id(tensor) -> array
+
+    for out, g in zip(outputs, grad_outputs):
+        seed = _unwrap(g) if g is not None else jnp.ones_like(out._data)
+        # identity contribution when an output is itself a requested input
+        if id(out) in input_ids:
+            grads[id(out)] = grads[id(out)] + seed if id(out) in grads \
+                else seed
+        if out._node is None:
+            continue
+        key = (out._node.idx, out._out_idx)
+        cotan[key] = seed if key not in cotan else cotan[key] + seed
+        max_idx = max(max_idx, out._node.idx)
+
+    visited = set()
+    for i in range(max_idx, -1, -1):
+        node = nodes[i]
+        outs = [cotan.pop((i, j), None) for j in range(len(node.out_meta))]
+        if all(o is None for o in outs):
+            continue
+        visited.add(i)
+        cts = tuple(o if o is not None else _zero_cotangent(*node.out_meta[j])
+                    for j, o in enumerate(outs))
+        in_grads = node.vjp_fn(tuple(cts) if node.multi else cts[0])
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for t, creator, g in zip(node.inputs, node.in_creators, in_grads):
+            if t is None or g is None or id(t) in no_grad_ids:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if id(t) in input_ids:
+                grads[id(t)] = grads[id(t)] + g if id(t) in grads else g
+            if t.stop_gradient:
+                continue
+            if creator is not None:
+                key = (creator[0].idx, creator[1])
+                cotan[key] = cotan[key] + g if key in cotan else g
+
+    if not retain_graph:
+        tape.release(visited)
+
+    results = []
+    for t in inputs:
+        if id(t) in grads:
+            results.append(Tensor(grads[id(t)], stop_gradient=True))
+        elif allow_unused:
+            results.append(None)
+        else:
+            results.append(Tensor(jnp.zeros_like(t._data)))
+    return results
+
+
+def _collect_subgraph(outputs):
+    """Contributing tape nodes, forward order."""
+    needed = set()
+    stack = [out._node for out in outputs if out._node is not None]
+    while stack:
+        node = stack.pop()
+        if node.idx in needed:
+            continue
+        needed.add(node.idx)
+        for t, creator in zip(node.inputs, node.in_creators):
+            if t is not None and not t.stop_gradient and creator is not None:
+                stack.append(creator[0])
+    nodes = global_tape().nodes
+    return [nodes[i] for i in sorted(needed)]
+
+
+def _replay_grad(outputs, inputs, grad_outputs, allow_unused, no_grad_vars,
+                 retain_graph):
+    from .ops.registry import run_op
+    no_grad_ids = {id(t) for t in (no_grad_vars or [])}
+    node_list = _collect_subgraph(outputs)
+
+    # connectivity check for allow_unused semantics
+    touched = {id(t) for n in node_list for t in n.inputs if t is not None}
+    touched |= {id(o) for o in outputs}
+
+    k = len(inputs)
+    seeds = [
+        g if g is not None else Tensor(jnp.ones_like(out._data))
+        for out, g in zip(outputs, grad_outputs)
+    ]
+
+    out_ids = [id(o) for o in outputs]
+    orig_out = {id(o): o._data for o in outputs}
+
+    def grad_fn(*arrs):
+        xs, seed_arrs = arrs[:k], arrs[k:]
+
+        def fwd(*xin):
+            env = {id(t): a for t, a in zip(inputs, xin)
+                   if id(t) not in no_grad_ids}
+            for node in node_list:
+                ins = [env.get(id(t), a) if t is not None else a
+                       for t, a in zip(node.inputs, node.in_arrays)]
+                res = node.pure(*ins)
+                res = res if isinstance(res, tuple) else (res,)
+                for r, ref in zip(res, node.out_refs):
+                    t = ref()
+                    if t is not None:
+                        env[id(t)] = r
+            return tuple(env.get(oid, orig_out[oid]) for oid in out_ids)
+
+        _, vjp = jax.vjp(fwd, *xs)
+        return vjp(tuple(seed_arrs))
+
+    flat = run_op("partial_grad_replay", grad_fn, (*inputs, *seeds), {})
+    results = []
+    for t, g in zip(inputs, flat):
+        if id(t) not in touched and id(t) not in {id(o) for o in outputs}:
+            results.append(None if allow_unused else g)
+        else:
+            results.append(g)
+    if not retain_graph:
+        global_tape().release({n.idx for n in node_list})
+    return results

@@ -1,0 +1,11 @@
+from . import dtypes, enforce, flags, generator, place
+from .dtypes import (bool_, uint8, int8, int16, int32, int64, float16,
+                     bfloat16, float32, float64, complex64, complex128,
+                     convert_dtype, set_default_dtype, get_default_dtype)
+from .enforce import (EnforceNotMet, InvalidArgumentError, NotFoundError,
+                      enforce_eq, wrap_op_error)
+from .flags import set_flags, get_flags, define_flag, flag_value
+from .generator import Generator, default_generator, seed, next_key
+from .place import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+                    set_device, get_device, current_place,
+                    is_compiled_with_tpu, device_count)

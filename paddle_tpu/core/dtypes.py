@@ -1,0 +1,74 @@
+"""Dtype system for paddle_tpu.
+
+TPU-first design: the canonical dtype set mirrors what the MXU/VPU support
+natively (bfloat16 is first-class; float16 is supported but bf16 preferred).
+Mirrors the capability of the reference dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:106 VarType.Type)
+without the LoD/encoding baggage — JAX/XLA owns layouts.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtypes (name -> jnp dtype)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16, "float32": float32,
+    "float": float32, "fp32": float32, "float64": float64, "double": float64,
+    "complex64": complex64, "complex128": complex128,
+}
+
+FLOATING = (float16, bfloat16, float32, float64)
+INTEGER = (uint8, int8, int16, int32, int64)
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str / np / jnp) to a numpy dtype obj."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise ValueError(f"Unknown dtype '{dtype}'")
+        return np.dtype(_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (np.dtype(t) for t in FLOATING)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (np.dtype(t) for t in INTEGER)
+
+
+# Default dtype management (mirrors paddle.set_default_dtype)
+_default_dtype = np.dtype(np.float32)
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not is_floating(d):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

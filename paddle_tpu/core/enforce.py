@@ -1,0 +1,77 @@
+"""Error/enforce machinery with op-creation stack attribution.
+
+Capability-parity with the reference's PADDLE_ENFORCE/EnforceNotMet
+(/root/reference/paddle/fluid/platform/enforce.h) and op call-stack
+attachment (/root/reference/paddle/fluid/framework/op_call_stack.cc):
+errors raised during op execution carry the op name and the Python stack
+where the op was invoked, with framework frames filtered out.
+"""
+from __future__ import annotations
+
+import traceback
+
+
+class EnforceNotMet(RuntimeError):
+    """Raised when an enforce check fails; carries op attribution."""
+
+    def __init__(self, message, op_type=None, user_stack=None):
+        self.op_type = op_type
+        self.user_stack = user_stack or []
+        full = message
+        if op_type:
+            full = f"[operator < {op_type} > error] {message}"
+        if self.user_stack:
+            frames = "".join(self.user_stack)
+            full += f"\n\n  [Operator creation stack]:\n{frames}"
+        super().__init__(full)
+
+
+class InvalidArgumentError(EnforceNotMet):
+    pass
+
+
+class NotFoundError(EnforceNotMet):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet):
+    pass
+
+
+def _user_frames(limit=6):
+    """Extract user-code frames (filter out paddle_tpu internals)."""
+    frames = traceback.extract_stack()[:-2]
+    keep = [f for f in frames if "paddle_tpu" not in (f.filename or "")]
+    return traceback.format_list(keep[-limit:])
+
+
+def enforce(cond, message="enforce failed", exc=InvalidArgumentError,
+            op_type=None):
+    if not cond:
+        raise exc(message, op_type=op_type, user_stack=_user_frames())
+
+
+def enforce_eq(a, b, message=None, op_type=None):
+    if a != b:
+        raise InvalidArgumentError(
+            message or f"expected equality, got {a!r} != {b!r}",
+            op_type=op_type, user_stack=_user_frames())
+
+
+def enforce_shape_match(shape_a, shape_b, message=None, op_type=None):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(
+            message or f"shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}",
+            op_type=op_type, user_stack=_user_frames())
+
+
+def wrap_op_error(op_type, exc: Exception) -> EnforceNotMet:
+    """Re-wrap an arbitrary exception raised inside an op kernel so it carries
+    the op type and the user's creation stack (op_call_stack.cc analogue)."""
+    if isinstance(exc, EnforceNotMet) and exc.op_type:
+        return exc
+    return EnforceNotMet(str(exc), op_type=op_type, user_stack=_user_frames())

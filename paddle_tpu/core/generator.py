@@ -1,0 +1,67 @@
+"""Seeded RNG state for eager mode, over JAX's splittable PRNG.
+
+Capability-parity with the reference Generator
+(/root/reference/paddle/fluid/framework/generator.h): a per-device, seedable
+random state visible from Python. TPU-first redesign: instead of a mutable
+Philox state threaded through kernels, we hold a jax PRNG key and split it on
+every draw — functional underneath, stateful at the framework surface (eager
+mode convenience). Compiled/static code paths take explicit keys.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(self._seed)
+            self._offset = 0
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def split(self) -> jax.Array:
+        """Return a fresh subkey; advances internal state."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._offset += 1
+            return sub
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self.manual_seed(state["seed"])
+        key = jax.random.key(self._seed)
+        for _ in range(state["offset"]):
+            key, _ = jax.random.split(key)
+        with self._lock:
+            self._key = key
+            self._offset = state["offset"]
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int):
+    """paddle.seed equivalent: reseed the default eager generator."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def next_key() -> jax.Array:
+    return _default_generator.split()

@@ -1,0 +1,474 @@
+"""Tensor, Parameter, and the eager autograd engine.
+
+Design (TPU-first, not a port):
+
+The reference implements an eager runtime as a C++ tracer + grad-node graph +
+queue-driven engine (/root/reference/paddle/fluid/imperative/tracer.cc:132,
+layer.h:65 VarBase, basic_engine.cc:265 BasicEngine::Execute). On TPU the
+right substrate is JAX: every op is a pure function; eager mode executes it
+immediately and — when gradients are required — records a tape node holding
+the ``jax.vjp`` pullback. ``backward()`` walks the tape in reverse creation
+order (a valid topological order for eagerly-created graphs, playing the role
+of BasicEngine's dependency-counted queue) and accumulates cotangents
+(gradient_accumulator.cc analogue). Eager mode is the debugging/usability
+surface; performance comes from the compiled path (paddle_tpu.jit/static),
+which traces whole step functions into a single XLA program.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dtypes as _dtypes
+from .core import enforce as _enforce
+from .core.place import Place, current_place
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad",
+    "is_grad_enabled", "set_grad_enabled", "in_dygraph_mode",
+]
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# grad-mode state
+# ---------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled()
+
+
+class _GradMode:
+    def __init__(self, mode: bool):
+        self.mode = mode
+
+    def __enter__(self):
+        self.prev = _grad_enabled()
+        _state.grad_enabled = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self.prev
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with _GradMode(self.mode):
+                return fn(*a, **k)
+        return wrapper
+
+
+def no_grad(fn=None):
+    """Context manager / decorator disabling tape recording."""
+    ctx = _GradMode(False)
+    return ctx(fn) if fn is not None else ctx
+
+
+def enable_grad(fn=None):
+    ctx = _GradMode(True)
+    return ctx(fn) if fn is not None else ctx
+
+
+class set_grad_enabled:
+    """Applies immediately AND usable as a context manager (paddle parity)."""
+
+    def __init__(self, mode: bool):
+        self.prev = _grad_enabled()
+        _state.grad_enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self.prev
+
+
+def in_dygraph_mode() -> bool:
+    return True  # eager is the default mode, as in paddle 2.x
+
+
+# ---------------------------------------------------------------------------
+# The tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: holds the vjp pullback and graph edges."""
+
+    __slots__ = ("op_type", "vjp_fn", "inputs", "in_creators", "out_refs",
+                 "out_meta", "multi", "idx", "pure", "in_arrays")
+
+    def __init__(self, op_type, vjp_fn, inputs, outputs, idx, multi=False,
+                 pure=None, in_arrays=None):
+        self.op_type = op_type
+        self.vjp_fn = vjp_fn
+        self.inputs: List["Tensor"] = inputs
+        # snapshot each input's creator NOW: later in-place rebinding of an
+        # input tensor must not redirect this node's upstream edges
+        # (inplace-version-check analogue, reference tensor.h:77)
+        self.in_creators = [
+            (t._node, t._out_idx) if t is not None and t._node is not None
+            else None
+            for t in inputs
+        ]
+        self.out_refs = [weakref.ref(t) for t in outputs]
+        # (shape, dtype) per output so we can build zero cotangents
+        self.out_meta = [(t._data.shape, t._data.dtype) for t in outputs]
+        self.multi = multi  # did the pure fn return a tuple?
+        self.idx = idx
+        # replay support (create_graph / double grad): the pure fn over the
+        # diff-input arrays, and those original arrays
+        self.pure = pure
+        self.in_arrays = in_arrays
+
+
+class Tape:
+    def __init__(self):
+        self.nodes: List[TapeNode] = []
+
+    def record(self, op_type, vjp_fn, inputs, outputs, multi=False,
+               pure=None, in_arrays=None):
+        node = TapeNode(op_type, vjp_fn, inputs, outputs, len(self.nodes),
+                        multi, pure, in_arrays)
+        self.nodes.append(node)
+        for i, t in enumerate(outputs):
+            t._node = node
+            t._out_idx = i
+        return node
+
+    def release(self, visited):
+        """Free the given node indices and compact the tape, so unrelated
+        live graphs keep their autograd state (eager_deletion analogue)."""
+        if not visited:
+            return
+        kept = []
+        for n in self.nodes:
+            if n.idx in visited:
+                for r in n.out_refs:
+                    t = r()
+                    if t is not None and t._node is n:
+                        t._node = None
+                n.vjp_fn = None
+                n.inputs = []
+                n.pure = None
+                n.in_arrays = None
+            else:
+                kept.append(n)
+        for j, n in enumerate(kept):
+            n.idx = j
+        self.nodes = kept
+
+    def clear(self):
+        self.release({n.idx for n in self.nodes})
+
+
+_tape = Tape()
+
+
+def global_tape() -> Tape:
+    return _tape
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # integer/bool primal outputs take float0 cotangents in jax
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def backward_from(root: "Tensor", grad: Optional[Array] = None,
+                  retain_graph: bool = False):
+    """Reverse sweep over the tape starting at ``root``.
+
+    Mirrors BasicEngine (basic_engine.cc:265): instead of refcounted queue
+    dispatch we walk tape nodes newest→oldest, which is a topological order
+    by construction for eager graphs.
+    """
+    if root._node is None:
+        # leaf with no history: grad is just the seed
+        if not root.stop_gradient:
+            seed = grad if grad is not None else jnp.ones_like(root._data)
+            root._accumulate_grad(seed)
+        return
+    if grad is None:
+        _enforce.enforce(
+            root._data.size == 1,
+            "backward() on a non-scalar tensor requires an explicit grad",
+        )
+        grad = jnp.ones_like(root._data)
+
+    # cotangent store keyed by (node idx, out idx); leaf grads go to .grad
+    cotan = {}
+    cotan[(root._node.idx, root._out_idx)] = grad
+
+    nodes = _tape.nodes
+    start = root._node.idx
+    visited = set()
+    for i in range(start, -1, -1):
+        node = nodes[i]
+        outs = [cotan.pop((i, j), None) for j in range(len(node.out_meta))]
+        if all(o is None for o in outs):
+            continue
+        visited.add(i)
+        cts = tuple(
+            o if o is not None else _zero_cotangent(*node.out_meta[j])
+            for j, o in enumerate(outs)
+        )
+        # fire retained-grad on non-leaf outputs
+        for j, o in enumerate(outs):
+            if o is None:
+                continue
+            t = node.out_refs[j]()
+            if t is not None and t._retain_grad:
+                t._accumulate_grad(o)
+        in_grads = node.vjp_fn(tuple(cts) if node.multi else cts[0])
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for t, creator, g in zip(node.inputs, node.in_creators, in_grads):
+            if t is None or t.stop_gradient or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            for hook in t._grad_hooks:
+                new = hook(g)
+                if new is not None:
+                    g = new
+            if creator is None:
+                t._accumulate_grad(g)  # leaf (at record time)
+            else:
+                key = (creator[0].idx, creator[1])
+                cotan[key] = g if key not in cotan else cotan[key] + g
+
+    if not retain_graph:
+        _tape.release(visited)
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+class Tensor:
+    """Eager tensor over jax.Array with paddle-compatible surface.
+
+    Reference analogue: VarBase (/root/reference/paddle/fluid/imperative/
+    layer.h:65) + framework::Tensor (framework/tensor.h:89). Allocation,
+    layout, and device residency are XLA's concern; this class carries
+    autograd state and API surface only.
+    """
+
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_out_idx",
+                 "name", "persistable", "_retain_grad", "_grad_hooks",
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            np_dtype = _dtypes.convert_dtype(dtype) if dtype else None
+            arr = np.asarray(data)
+            if np_dtype is None and arr.dtype == np.float64:
+                np_dtype = _dtypes.get_default_dtype()
+            data = jnp.asarray(arr, dtype=np_dtype)
+        elif dtype is not None:
+            data = data.astype(_dtypes.convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = bool(stop_gradient)
+        self._grad: Optional[Array] = None
+        self._node: Optional[TapeNode] = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._retain_grad = False
+        self._grad_hooks: List[Any] = []
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def place(self) -> Place:
+        return current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad is None:
+            return None
+        return Tensor(self._grad, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else _unwrap(value)
+
+    def _accumulate_grad(self, g: Array):
+        g = g.astype(self._data.dtype) if g.dtype != self._data.dtype else g
+        self._grad = g if self._grad is None else self._grad + g
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _enforce.enforce(
+            is_grad_enabled(), "backward() called inside no_grad")
+        seed = _unwrap(grad_tensor) if grad_tensor is not None else None
+        backward_from(self, seed, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = jnp.zeros_like(self._grad)
+        else:
+            self._grad = None
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def remove(self_inner):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Removable()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from .ops.registry import run_op
+        return run_op("clone", lambda x: x + 0, (self,), {})
+
+    # -- value access -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from .ops.registry import run_op
+        d = _dtypes.convert_dtype(dtype)
+        return run_op("cast", lambda x: x.astype(d), (self,), {})
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def set_value(self, value):
+        """In-place value update (optimizer writes); bypasses the tape."""
+        arr = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        _enforce.enforce_shape_match(arr.shape, self._data.shape,
+                                     "set_value shape mismatch")
+        self._data = arr.astype(self._data.dtype)
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def cpu(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # -- misc ---------------------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        prefix = "Parameter" if isinstance(self, Parameter) else "Tensor"
+        return (f"{prefix}(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n{self._data})")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._data
+
+    # operator overloads are monkey-patched in ops/__init__.py
+    # (math_op_patch.py analogue)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, persistable by default."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor equivalent."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

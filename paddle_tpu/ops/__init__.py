@@ -1,0 +1,155 @@
+"""Op namespace aggregation + Tensor method patching.
+
+The reference patches arithmetic/indexing methods onto its eager tensor in
+python/paddle/fluid/dygraph/math_op_patch.py and monkey-patches the tensor
+namespace in python/paddle/tensor/__init__.py. Same move here: every op in
+this package becomes a Tensor method, and Python operators route through the
+registry (so they are taped for autograd).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from . import creation, linalg, logic, manipulation, math, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .registry import OPS, get_op, op_wrapper, register_op, run_op
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+__all__ = (creation.__all__ + math.__all__ + manipulation.__all__
+           + logic.__all__ + search.__all__ + linalg.__all__ + stat.__all__)
+
+
+# ---------------------------------------------------------------------------
+# operator overloads (math_op_patch.py analogue)
+# ---------------------------------------------------------------------------
+
+def _binary_method(fn, reverse=False):
+    def method(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(other)
+        # python scalars stay scalars either way so jnp weak-type promotion
+        # applies identically to `x - 2` and `2 - x`
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+    return method
+
+
+def _rebind_inplace(target: Tensor, out: Tensor):
+    """Make an op's output *be* `target` on the tape (inplace semantics).
+
+    If the op was taped, the node's output slot is repointed at `target`
+    and target's creator becomes that node, so gradients flow through the
+    inplace write. Inplace on a grad-requiring leaf is rejected, as the
+    write would orphan the leaf's .grad accumulation.
+    """
+    import weakref
+
+    from ..framework import is_grad_enabled
+    if (not target.stop_gradient and target._node is None
+            and is_grad_enabled() and out._node is not None):
+        raise RuntimeError(
+            "in-place operation on a leaf tensor that requires grad is not "
+            "allowed; use set_value() (no tape) or operate out-of-place")
+    target._data = out._data
+    if out._node is not None:
+        target._node = out._node
+        target._out_idx = out._out_idx
+        out._node.out_refs[out._out_idx] = weakref.ref(target)
+        out._node = None
+
+
+def _patch_tensor_methods():
+    T = Tensor
+    T.__add__ = _binary_method(math.add)
+    T.__radd__ = _binary_method(math.add, reverse=True)
+    T.__sub__ = _binary_method(math.subtract)
+    T.__rsub__ = _binary_method(math.subtract, reverse=True)
+    T.__mul__ = _binary_method(math.multiply)
+    T.__rmul__ = _binary_method(math.multiply, reverse=True)
+    T.__truediv__ = _binary_method(math.divide)
+    T.__rtruediv__ = _binary_method(math.divide, reverse=True)
+    T.__floordiv__ = _binary_method(math.floor_divide)
+    T.__mod__ = _binary_method(math.mod)
+    T.__pow__ = _binary_method(math.pow)
+    T.__rpow__ = _binary_method(math.pow, reverse=True)
+    T.__matmul__ = _binary_method(math.matmul)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__invert__ = lambda self: logic.logical_not(self)
+    T.__eq__ = _binary_method(logic.equal)
+    T.__ne__ = _binary_method(logic.not_equal)
+    T.__lt__ = _binary_method(logic.less_than)
+    T.__le__ = _binary_method(logic.less_equal)
+    T.__gt__ = _binary_method(logic.greater_than)
+    T.__ge__ = _binary_method(logic.greater_equal)
+    T.__hash__ = object.__hash__  # __eq__ override would drop hashability
+    T.__and__ = _binary_method(logic.logical_and)
+    T.__or__ = _binary_method(logic.logical_or)
+    T.__xor__ = _binary_method(logic.logical_xor)
+
+    def _getitem(self, item):
+        def unwrap_item(it):
+            if isinstance(it, Tensor):
+                return it._data
+            if isinstance(it, tuple):
+                return tuple(unwrap_item(i) for i in it)
+            return it
+        return run_op("getitem", lambda x: x[unwrap_item(item)], (self,), {})
+
+    def _setitem(self, item, value):
+        def unwrap_item(it):
+            if isinstance(it, Tensor):
+                return it._data
+            if isinstance(it, tuple):
+                return tuple(unwrap_item(i) for i in it)
+            return it
+        idx = unwrap_item(item)
+        out = run_op("setitem",
+                     lambda x, v: x.at[idx].set(
+                         v.astype(x.dtype) if hasattr(v, "astype") else v),
+                     (self, value), {})
+        _rebind_inplace(self, out)
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # method versions of namespace ops
+    _method_table = {}
+    for mod in (creation, math, manipulation, logic, search, linalg, stat):
+        for nm in mod.__all__:
+            _method_table.setdefault(nm, getattr(mod, nm))
+    skip = {"is_tensor", "create_parameter", "meshgrid", "broadcast_tensors"}
+    for nm, fn in _method_table.items():
+        if nm in skip or hasattr(T, nm):
+            continue
+        setattr(T, nm, fn)
+
+    # inplace-suffix conveniences (x.add_(y) etc.) — tape-aware: the output
+    # node is rewired onto self so downstream backward sees the write
+    # (TensorInplaceVersion analogue, reference framework/tensor.h:77)
+    def _make_inplace(fn):
+        def inplace(self, *a, **k):
+            out = fn(self, *a, **k)
+            _rebind_inplace(self, out)
+            return self
+        return inplace
+    for nm in ("add", "subtract", "multiply", "divide", "clip", "scale",
+               "floor", "ceil", "exp", "sqrt", "reciprocal", "round"):
+        setattr(T, nm + "_", _make_inplace(getattr(math, nm)))
+
+    T.mm = math.matmul
+    T.dim = lambda self: self.ndim
+    T.rank = lambda self: Tensor(jnp.asarray(self.ndim))
+    T.numel = lambda self: creation.numel(self)
+
+
+_patch_tensor_methods()

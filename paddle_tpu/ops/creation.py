@@ -1,0 +1,236 @@
+"""Tensor creation ops.
+
+Parity surface: python/paddle/tensor/creation.py in the reference. These do
+not take tensor inputs so they bypass the tape (created tensors are leaves
+with stop_gradient=True, as in paddle).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..core.generator import next_key
+from ..framework import Tensor, _unwrap, to_tensor
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "assign", "clone_", "rand", "randn",
+    "randint", "randperm", "uniform", "normal", "bernoulli", "multinomial",
+    "standard_normal", "tril_indices", "triu_indices", "one_hot",
+    "numel", "create_parameter",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default or _dtypes.get_default_dtype()
+    return _dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_unwrap(s)) if not isinstance(s, int) else s
+                 for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = _unwrap(fill_value)
+    return Tensor(jnp.full(_shape(shape), fill, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(_unwrap(x), dtype=_dt(dtype, np.dtype(
+        _unwrap(x).dtype))))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(_unwrap(x), dtype=_dt(dtype, np.dtype(
+        _unwrap(x).dtype))))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    a = _unwrap(x)
+    return Tensor(jnp.full_like(a, _unwrap(fill_value),
+                                dtype=_dt(dtype, np.dtype(a.dtype))))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = _unwrap(start), _unwrap(end), _unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (start, end, step)
+        dtype = (np.int64 if all(
+            isinstance(v, (int, np.integer)) for v in py) else
+            _dtypes.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=_dtypes.convert_dtype(
+        dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_unwrap(start), _unwrap(stop), int(num),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_unwrap(start), _unwrap(stop), int(num),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    a = _unwrap(x)
+    if a.ndim == 1 and padding_value != 0:
+        d = jnp.diag(a, k=offset)
+        mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+        return Tensor(jnp.where(mask, d, padding_value))
+    return Tensor(jnp.diag(a, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(_unwrap(x), k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    return _tape_unary(x, lambda a: jnp.tril(a, k=diagonal), "tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return _tape_unary(x, lambda a: jnp.triu(a, k=diagonal), "triu")
+
+
+def _tape_unary(x, fn, name):
+    from .registry import run_op
+    return run_op(name, fn, (x,), {})
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [_unwrap(a) for a in (args[0] if len(args) == 1 and
+              isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def assign(x, output=None):
+    a = _unwrap(x)
+    if not isinstance(a, jax.Array):
+        a = jnp.asarray(a)
+    if output is not None:
+        output.set_value(a)
+        return output
+    return Tensor(a)
+
+
+def clone_(x):
+    return Tensor(_unwrap(x))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_unwrap(x).size, dtype=jnp.int64))
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(_unwrap(x), num_classes,
+                                 dtype=_dtypes.get_default_dtype()))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, np.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, np.int64)))
+
+
+# -- random creation --------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=_unwrap(min), maxval=_unwrap(max)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    mean_a, std_a = _unwrap(mean), _unwrap(std)
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean_a), jnp.shape(std_a))
+    n = jax.random.normal(next_key(), _shape(shape),
+                          _dtypes.get_default_dtype())
+    return Tensor(n * std_a + mean_a)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=_dt(dtype, np.int64)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(
+        _dt(dtype, np.int64)))
+
+
+def bernoulli(x, name=None):
+    a = _unwrap(x)
+    return Tensor(jax.random.bernoulli(next_key(), a).astype(a.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = _unwrap(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(*a.shape[:-1], num_samples))
+    else:
+        key = next_key()
+        g = jax.random.gumbel(key, a.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def create_parameter(shape, dtype=None, name=None, default_initializer=None):
+    from ..framework import Parameter
+    if default_initializer is not None:
+        data = default_initializer(_shape(shape), _dt(dtype))
+    else:
+        data = jnp.zeros(_shape(shape), _dt(dtype))
+    return Parameter(data, name=name)

@@ -1,0 +1,229 @@
+"""Linear algebra ops (paddle.tensor.linalg / paddle.linalg parity).
+
+Reference surface: python/paddle/tensor/linalg.py + cholesky/inverse/svd
+ops in /root/reference/paddle/fluid/operators/. On TPU the decompositions
+lower through XLA's linalg custom calls; matmuls hit the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "bmm", "mv", "norm", "vector_norm", "matrix_norm", "cholesky",
+    "cholesky_solve", "inverse", "det", "slogdet", "svd", "qr", "lu", "eig",
+    "eigh", "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
+    "matrix_power", "matrix_rank", "pinv", "cross", "cond", "corrcoef",
+    "cov", "histogram", "histogramdd", "bincount", "multi_dot", "dist",
+]
+
+
+@register_op("bmm")
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@register_op("mv")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@register_op("p_norm")
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or not np.isscalar(axis) else 2
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if p == "inf":
+        p = jnp.inf
+    elif p == "-inf":
+        p = -jnp.inf
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@register_op("matrix_norm")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@register_op("dist")
+def dist(x, y, p=2, name=None):
+    d = x - y
+    if p == 0:
+        return jnp.sum(d != 0).astype(d.dtype)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@register_op("cholesky")
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@register_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    L = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(L, -1, -2), z, lower=False)
+
+
+@register_op("inverse")
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@register_op("determinant")
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdeterminant")
+def _slogdet_impl(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def slogdet(x, name=None):
+    return _slogdet_impl(x)
+
+
+@register_op("svd_op")
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
+@register_op("qr_op")
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@register_op("lu_op")
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+@register_op("eig_op")
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+@register_op("eigh_op")
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@register_op("eigvals_op")
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+@register_op("eigvalsh_op")
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op("solve_op")
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op("triangular_solve_op")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register_op("lstsq_op")
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("matrix_power_op")
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("matrix_rank_op")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op("pinv_op")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register_op("cross_op")
+def cross(x, y, axis=9, name=None):
+    if axis == 9:  # paddle default: first axis of size 3
+        shape = x.shape
+        axis = next((i for i, s in enumerate(shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op("cond_op")
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op("corrcoef_op")
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_op("cov_op")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register_op("histogram_op")
+def histogram(input, bins=100, min=0, max=0, name=None):
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+    if lo is None:
+        lo, hi = jnp.min(input), jnp.max(input)
+    hist, _ = jnp.histogram(input, bins=bins, range=(lo, hi))
+    return hist.astype(jnp.int64)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    a = np.asarray(_unwrap(x))
+    w = np.asarray(_unwrap(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+@register_op("bincount_op")
+def bincount(x, weights=None, minlength=0, name=None):
+    length = max(int(np.asarray(_unwrap(x)).max(initial=0)) + 1, minlength)
+    out = jnp.bincount(jnp.asarray(x), weights=weights, length=length)
+    return out if weights is not None else out.astype(jnp.int64)
+
+
+def multi_dot(x, name=None):
+    arrays = [_unwrap(a) for a in x]
+    from .registry import run_op
+    return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs),
+                  tuple(x), {})

@@ -1,0 +1,84 @@
+"""Comparison / logical ops (paddle.tensor.logic parity).
+
+Reference surface: python/paddle/tensor/logic.py + operators/controlflow
+compare ops in /root/reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+    "bitwise_not", "bitwise_xor", "is_empty", "is_tensor", "isreal",
+    "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _cmp(name, fn):
+    @register_op(name)
+    def op(x, y, name=None):
+        return fn(x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+@register_op("logical_not")
+def logical_not(x, name=None):
+    return jnp.logical_not(x)
+
+
+@register_op("bitwise_not")
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@register_op("isreal")
+def isreal(x, name=None):
+    return jnp.isreal(x)
+
+
+def equal_all(x, y, name=None):
+    a, b = _unwrap(x), _unwrap(y)
+    if a.shape != b.shape:
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(a == b))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_unwrap(x), _unwrap(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+@register_op("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_unwrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,462 @@
+"""Shape / layout / indexing ops (paddle.tensor.manipulation parity).
+
+Reference surface: python/paddle/tensor/manipulation.py and the reshape /
+concat / gather / scatter / slice op families under
+/root/reference/paddle/fluid/operators/. All static-shape on XLA; dynamic
+result shapes (unique, nonzero, masked_select) are eager-only by design —
+inside jit users get the _with_counts/padded variants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "reshape", "transpose", "concat", "split", "chunk", "stack", "unstack",
+    "squeeze", "unsqueeze", "flatten", "gather", "gather_nd", "scatter",
+    "scatter_nd", "scatter_nd_add", "slice", "strided_slice", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "tile", "flip", "roll",
+    "cast", "unique", "unique_consecutive", "masked_select", "index_select",
+    "index_sample", "where", "pad", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "unbind", "moveaxis", "swapaxes", "as_real",
+    "as_complex", "tensordot", "unfold", "view", "view_as", "atleast_1d",
+    "atleast_2d", "atleast_3d", "crop", "tolist", "rot90_", "shard_index",
+    "reverse", "t",
+]
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(_unwrap(s)) if not isinstance(s, (int, np.integer))
+                 else int(s) for s in shape)
+
+
+@register_op("reshape")
+def reshape(x, shape, name=None):
+    shape = _norm_shape(shape)
+    # paddle semantics: 0 means copy the corresponding input dim
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.reshape(x, shape)
+
+
+@register_op("transpose")
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, axes=tuple(perm) if perm is not None else None)
+
+
+@register_op("t")
+def t(x, name=None):
+    return jnp.swapaxes(x, -1, -2) if jnp.ndim(x) >= 2 else x
+
+
+@register_op("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes")
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op("concat_op")
+def _concat_impl(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(_unwrap(axis)) if not isinstance(axis, int) else axis
+    return _concat_impl(*x, axis=axis)
+
+
+@register_op("split_op")
+def _split_impl(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # sections list, possibly with one -1
+    sections = list(sections)
+    if -1 in sections:
+        total = x.shape[axis]
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(_unwrap(axis)) if not isinstance(axis, int) else axis
+    out = _split_impl(x, num_or_sections, axis=axis)
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+@register_op("stack_op")
+def _stack_impl(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack_impl(*x, axis=axis)
+
+
+@register_op("unstack_op")
+def _unstack_impl(x, axis, num):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, num, axis=axis))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    num = num if num is not None else x.shape[axis]
+    return list(_unstack_impl(x, axis=axis, num=num))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+@register_op("squeeze")
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(int(_unwrap(a))
+                                                       for a in axis)
+    return jnp.expand_dims(x, axis=axes)
+
+
+@register_op("flatten_op")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = jnp.ndim(x)
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    shape = x.shape
+    new = shape[:start] + (int(np.prod(shape[start:stop + 1], dtype=np.int64))
+                           if stop >= start else 1,) + shape[stop + 1:]
+    return jnp.reshape(x, new)
+
+
+@register_op("cast")
+def cast(x, dtype):
+    return x.astype(_dtypes.convert_dtype(dtype))
+
+
+@register_op("gather_op")
+def gather(x, index, axis=0, name=None):
+    axis = int(_unwrap(axis)) if not isinstance(axis, int) else axis
+    idx = jnp.asarray(index)
+    if idx.ndim == 0:
+        idx = idx[None]
+    return jnp.take(x, idx, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index, name=None):
+    index = jnp.asarray(index)
+    idx_tuple = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx_tuple]
+
+
+@register_op("scatter_op")
+def scatter(x, index, updates, overwrite=True, name=None):
+    index = jnp.asarray(index).reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle: non-overwrite zeroes target rows then adds
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    index = jnp.asarray(index)
+    idx_tuple = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx_tuple].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype if hasattr(updates, "dtype")
+              else None)
+    return scatter_nd_add(z, index, updates)
+
+
+@register_op("slice_op")
+def slice(input, axes, starts, ends, name=None):
+    out = input
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(_unwrap(st)) if not isinstance(st, int) else st
+        en = int(_unwrap(en)) if not isinstance(en, int) else en
+        dim = input.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+    return out
+
+
+@register_op("strided_slice_op")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    slices = [np.s_[:]] * jnp.ndim(x)
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = np.s_[int(_unwrap(st)):int(_unwrap(en)):int(_unwrap(sd))]
+    return x[tuple(slices)]
+
+
+@register_op("expand_op")
+def expand(x, shape, name=None):
+    shape = _norm_shape(shape)
+    # -1 means keep input dim
+    nd_in = jnp.ndim(x)
+    pad = len(shape) - nd_in
+    full = tuple(
+        x.shape[i - pad] if s == -1 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, full)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, list(_unwrap(y).shape))
+
+
+@register_op("broadcast_to_op")
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, _norm_shape(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = [_unwrap(i) for i in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrays])
+    return [broadcast_to(i, shape) for i in inputs]
+
+
+@register_op("tile_op")
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, _norm_shape(repeat_times))
+
+
+@register_op("flip")
+def flip(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=axes)
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+@register_op("roll_op")
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register_op("where_op")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        raise ValueError("use paddle.nonzero for 1-arg where (eager only)")
+    return jnp.where(condition.astype(bool) if hasattr(condition, "astype")
+                     else condition, x, y)
+
+
+@register_op("pad_op")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = [int(_unwrap(p)) for p in pad] if not isinstance(pad, int) else pad
+    nd = jnp.ndim(x)
+    if isinstance(pad, int):
+        cfg = [(pad, pad)] * nd
+    elif len(pad) == 2 * nd:
+        # paddle layout: (before_0, after_0, before_1, after_1, ...)
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # NCHW/NCL/NCDHW spatial-only pad, given innermost-first pairs
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            spatial_axes = list(range(nd - n_spatial, nd))
+        else:  # NHWC-ish: spatial dims are 1..nd-2
+            spatial_axes = list(range(1, 1 + n_spatial))
+        for i, ax in enumerate(reversed(spatial_axes)):
+            cfg[ax] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode=jmode, constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@register_op("repeat_interleave_op")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    total = None
+    if not isinstance(repeats, int):
+        r = np.asarray(_unwrap(repeats))
+        total = int(r.sum())
+        repeats = jnp.asarray(r)
+    return jnp.repeat(x, repeats, axis=axis, total_repeat_length=total)
+
+
+@register_op("take_along_axis_op")
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return jnp.take_along_axis(arr, jnp.asarray(indices), axis=axis)
+
+
+@register_op("put_along_axis_op")
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = jnp.asarray(indices)
+    vals = jnp.broadcast_to(jnp.asarray(values), idx.shape).astype(arr.dtype)
+    nd = jnp.ndim(arr)
+    ax = axis % nd
+    ix = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    ix[ax] = idx
+    if reduce == "assign":
+        return arr.at[tuple(ix)].set(vals)
+    if reduce == "add":
+        return arr.at[tuple(ix)].add(vals)
+    if reduce == "multiply":
+        return arr.at[tuple(ix)].multiply(vals)
+    raise ValueError(f"unknown reduce '{reduce}'")
+
+
+@register_op("index_select_op")
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, jnp.asarray(index).reshape(-1), axis=axis)
+
+
+@register_op("index_sample_op")
+def index_sample(x, index):
+    idx = jnp.asarray(index)
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+@register_op("tensordot_op")
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@register_op("as_real")
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op("as_complex")
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("unfold_tensor")
+def unfold(x, axis, size, step, name=None):
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    nd = jnp.ndim(x)
+    ax = axis % nd
+    new_shape = x.shape[:ax] + (n, size) + x.shape[ax + 1:]
+    out = jnp.reshape(out, new_shape)
+    # paddle puts the window dim last
+    return jnp.moveaxis(out, ax + 1, -1)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, list(_unwrap(other).shape))
+
+
+def atleast_1d(*inputs):
+    outs = [Tensor(jnp.atleast_1d(_unwrap(i))) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [Tensor(jnp.atleast_2d(_unwrap(i))) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [Tensor(jnp.atleast_3d(_unwrap(i))) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("crop_op")
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _norm_shape(shape)
+    offsets = [0] * len(shape) if offsets is None else [
+        int(_unwrap(o)) for o in offsets]
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op("shard_index_op")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+# -- eager-only dynamic-shape ops -------------------------------------------
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(_unwrap(x))
+    res = np.unique(a, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(_unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    keep = np.ones(a.shape[axis], dtype=bool)
+    sl = [np.s_[:]] * a.ndim
+    vals = np.moveaxis(a, axis, 0)
+    keep[1:] = np.any(
+        vals[1:].reshape(a.shape[axis] - 1, -1)
+        != vals[:-1].reshape(a.shape[axis] - 1, -1), axis=1)
+    out = np.compress(keep, a, axis=axis)
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[axis]))
+        rets.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def masked_select(x, mask, name=None):
+    a, m = np.asarray(_unwrap(x)), np.asarray(_unwrap(mask))
+    return Tensor(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+
+
+def tolist(x):
+    return np.asarray(_unwrap(x)).tolist()
+
+
+def rot90_(x, k, axes):
+    from .math import rot90
+    return rot90(x, k, axes)

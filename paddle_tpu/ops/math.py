@@ -1,0 +1,427 @@
+"""Elementwise / reduction / math ops (paddle.tensor.math parity).
+
+Reference surface: python/paddle/tensor/math.py + operators/elementwise/,
+operators/reduce_ops/ in /root/reference. Every op is a pure jnp function
+registered in the op registry; grads come from jax.vjp (no hand-written grad
+kernels — XLA fuses the backward).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "float_power", "matmul", "abs", "sqrt", "rsqrt",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh",
+    "atanh", "atan2", "floor", "ceil", "round", "trunc", "frac", "sign",
+    "square", "reciprocal", "neg", "clip", "maximum", "minimum", "fmax",
+    "fmin", "sum", "mean", "max", "min", "prod", "nansum", "nanmean",
+    "cumsum", "cumprod", "cummax", "cummin", "logsumexp", "logcumsumexp",
+    "isnan", "isinf", "isfinite", "erf", "erfinv", "lerp", "addmm", "inner",
+    "outer", "dot", "kron", "trace", "diff", "angle", "conj", "real", "imag",
+    "deg2rad", "rad2deg", "gcd", "lcm", "heaviside", "rot90", "amax", "amin",
+    "stanh", "rsub_", "logaddexp", "hypot", "ldexp", "copysign", "nextafter",
+    "signbit", "scale", "increment", "multiply_", "add_n", "count_nonzero",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# -- binary elementwise ------------------------------------------------------
+
+@register_op("elementwise_add")
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+@register_op("elementwise_sub")
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+@register_op("elementwise_mul")
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+@register_op("elementwise_div")
+def divide(x, y, name=None):
+    return jnp.true_divide(x, y)
+
+
+@register_op("elementwise_floordiv")
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+@register_op("elementwise_mod")
+def mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+@register_op("elementwise_pow")
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+float_power = pow
+
+
+@register_op("elementwise_max")
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+@register_op("elementwise_min")
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+@register_op("elementwise_fmax")
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+@register_op("elementwise_fmin")
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+@register_op("atan2")
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+@register_op("logaddexp")
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@register_op("hypot")
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+@register_op("ldexp")
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, jnp.asarray(y, jnp.int32))
+
+
+@register_op("copysign")
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+@register_op("nextafter")
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@register_op("heaviside")
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@register_op("gcd")
+def gcd(x, y, name=None):
+    return jnp.gcd(jnp.asarray(x), jnp.asarray(y))
+
+
+@register_op("lcm")
+def lcm(x, y, name=None):
+    return jnp.lcm(jnp.asarray(x), jnp.asarray(y))
+
+
+# -- matmul family -----------------------------------------------------------
+
+@register_op("matmul_v2")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if jnp.ndim(x) > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if jnp.ndim(y) > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register_op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op("inner")
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@register_op("outer")
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@register_op("dot")
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("kron")
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+# -- unary -------------------------------------------------------------------
+
+def _unary(opname, fn):
+    @register_op(opname)
+    def op(x, name=None):
+        return fn(x)
+    op.__name__ = opname
+    return op
+
+
+abs = _unary("abs", jnp.abs)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sign = _unary("sign", jnp.sign)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+signbit = _unary("signbit", jnp.signbit)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+
+
+@register_op("frac")
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+@register_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("clip")
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op("lerp")
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+@register_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    x.set_value(_unwrap(x) + value)
+    return x
+
+
+def multiply_(x, y, name=None):
+    x.set_value(_unwrap(x) * _unwrap(y))
+    return x
+
+
+def rsub_(x, y):
+    return subtract(y, x)
+
+
+@register_op("add_n")
+def _add_n_impl(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return _add_n_impl(inputs)
+    return _add_n_impl(*inputs)
+
+
+# -- reductions --------------------------------------------------------------
+
+@register_op("reduce_sum")
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("reduce_mean")
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("reduce_max")
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("reduce_min")
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+amax, amin = max, min
+
+
+@register_op("reduce_prod")
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    out = jnp.prod(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("nanmean")
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim).astype(
+        jnp.int64)
+
+
+@register_op("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    out = jnp.cumsum(x, axis=_axis(axis))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("logcumsumexp")
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=_axis(axis))
+
+
+@register_op("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = jnp.cumprod(x, axis=_axis(dim))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("cummax")
+def _cummax_impl(x, axis):
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    a = _unwrap(x)
+    if axis is None:
+        x = x.reshape([-1]) if isinstance(x, Tensor) else Tensor(
+            a.reshape(-1))
+        axis = 0
+    vals = _cummax_impl(x, axis=axis)
+    idx = _running_arg(_unwrap(vals), _unwrap(x), axis)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    a = _unwrap(x)
+    if axis is None:
+        x = Tensor(a.reshape(-1)) if not isinstance(x, Tensor) else \
+            x.reshape([-1])
+        axis = 0
+    vals = _cummin_impl(x, axis=axis)
+    idx = _running_arg(_unwrap(vals), _unwrap(x), axis)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+@register_op("cummin")
+def _cummin_impl(x, axis):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def _running_arg(vals, x, axis):
+    # index where the running extreme was attained
+    eq = vals == x
+    n = x.shape[axis]
+    ar = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                for i in range(x.ndim)])
+    idx = jnp.where(eq, ar, -1)
+    return jax.lax.associative_scan(jnp.maximum, idx, axis=axis)
+
+
+@register_op("trace_op")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))

@@ -1,0 +1,167 @@
+"""Op registry and eager dispatch.
+
+The single op registry that feeds both the eager path and the compiled path —
+capability-parity with the reference's OpRegistry/OperatorWithKernel dispatch
+(/root/reference/paddle/fluid/framework/op_registry.h, operator.cc:1068
+RunImpl, :1207 ChooseKernel) redesigned for XLA: an "op" here is a pure JAX
+function. There is no kernel choice by (place, dtype, layout, library) —
+XLA owns that — so OpInfo reduces to {name, pure_fn, metadata}. Gradients
+come from jax.vjp instead of per-op grad makers; eager autograd records tape
+nodes (see paddle_tpu.framework).
+
+Eager dispatch order (the TraceOp analogue, tracer.cc:132):
+  1. AMP autocast of inputs (amp_auto_cast.cc analogue, via hook)
+  2. unwrap Tensors → jax arrays
+  3. if grad required: jax.vjp(pure_fn)(arrays), record tape node
+     else: pure_fn(arrays)
+  4. NaN/Inf scan if FLAGS_check_nan_inf (nan_inf_utils_detail analogue)
+  5. wrap outputs
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import enforce as _enforce
+from ..core.flags import flag_value
+from ..framework import Tensor, _unwrap, global_tape, is_grad_enabled
+
+__all__ = ["register_op", "run_op", "get_op", "OPS", "op_wrapper"]
+
+
+class OpInfo:
+    __slots__ = ("name", "fn", "ndiff", "tags")
+
+    def __init__(self, name, fn, ndiff=None, tags=()):
+        self.name = name
+        self.fn = fn
+        self.ndiff = ndiff  # number of leading positional args that are
+        # differentiable tensor inputs; None = all Tensor positionals
+        self.tags = set(tags)
+
+
+OPS: Dict[str, OpInfo] = {}
+
+# hook installed by paddle_tpu.amp when an auto_cast context is active;
+# signature: (op_name, args, kwargs) -> (args, kwargs)
+_amp_hook: Optional[Callable] = None
+_amp_lock = threading.Lock()
+
+
+def set_amp_hook(hook):
+    global _amp_hook
+    with _amp_lock:
+        _amp_hook = hook
+
+
+def get_op(name: str) -> OpInfo:
+    if name not in OPS:
+        raise _enforce.NotFoundError(f"op '{name}' is not registered")
+    return OPS[name]
+
+
+def register_op(name: str, tags=()):
+    """Decorator: register a pure jax function as a framework op.
+
+    Convention: positional args that arrive as Tensor/jax.Array are the
+    differentiable inputs; keyword args are attributes (non-differentiable,
+    tensors allowed but treated as constants).
+    """
+    def deco(fn):
+        if name in OPS:
+            raise ValueError(f"op '{name}' already registered")
+        OPS[name] = OpInfo(name, fn, tags=tags)
+
+        @functools.wraps(fn)
+        def eager(*args, **kwargs):
+            return run_op(name, fn, args, kwargs)
+        eager.__op_name__ = name
+        eager.__pure_fn__ = fn
+        return eager
+    return deco
+
+
+def op_wrapper(fn, name=None):
+    """Wrap an unregistered pure function for one-off eager execution."""
+    nm = name or getattr(fn, "__name__", "anonymous")
+
+    @functools.wraps(fn)
+    def eager(*args, **kwargs):
+        return run_op(nm, fn, args, kwargs)
+    eager.__pure_fn__ = fn
+    return eager
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jnp.inexact):
+            if not bool(jnp.isfinite(a).all()):
+                raise _enforce.EnforceNotMet(
+                    f"NaN or Inf found in output of op", op_type=name)
+
+
+def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
+    """Execute one op eagerly, recording a tape node if grads are needed."""
+    if _amp_hook is not None:
+        args, kwargs = _amp_hook(name, args, kwargs)
+
+    # split positional args into diff-tensor slots and pass-through slots
+    tensor_pos = []
+    arrays = []
+    input_tensors = []
+    plain_args = list(args)
+    for i, a in enumerate(plain_args):
+        if isinstance(a, Tensor):
+            tensor_pos.append(i)
+            arrays.append(a._data)
+            input_tensors.append(a)
+        elif isinstance(a, jax.Array):
+            tensor_pos.append(i)
+            arrays.append(a)
+            input_tensors.append(None)
+    kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+
+    requires = (is_grad_enabled()
+                and any(t is not None and not t.stop_gradient
+                        for t in input_tensors))
+
+    def pure(*diff):
+        full = list(plain_args)
+        for pos, val in zip(tensor_pos, diff):
+            full[pos] = val
+        res = fn(*full, **kwargs)
+        # normalize list outputs to tuple so vjp cotangent structure is stable
+        return tuple(res) if isinstance(res, list) else res
+
+    try:
+        if requires:
+            out, vjp_fn = jax.vjp(pure, *arrays)
+        else:
+            out = pure(*arrays)
+    except _enforce.EnforceNotMet:
+        raise
+    except Exception as e:  # attach op attribution (op_call_stack analogue)
+        raise _enforce.wrap_op_error(name, e) from e
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+
+    if flag_value("check_nan_inf"):
+        _check_nan_inf(name, outs)
+
+    out_tensors = [
+        o if isinstance(o, Tensor)
+        else Tensor(o, stop_gradient=not requires)
+        for o in outs
+    ]
+    if requires:
+        global_tape().record(name, vjp_fn, input_tensors, out_tensors,
+                             multi=multi, pure=pure, in_arrays=arrays)
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]

@@ -1,0 +1,161 @@
+"""Search / sort ops (paddle.tensor.search parity).
+
+Reference surface: python/paddle/tensor/search.py + argsort/topk/where ops
+under /root/reference/paddle/fluid/operators/. top_k uses jax.lax.top_k
+(maps to a fast XLA TPU sort); dynamic-shape results (nonzero) are
+eager-only.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "kthvalue",
+    "mode", "median", "nanmedian", "quantile", "nanquantile", "searchsorted",
+    "index_of_max",
+]
+
+
+@register_op("arg_max")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None
+                     else False)
+    return out.astype(jnp.dtype(str(dtype)) if isinstance(dtype, str)
+                      else dtype)
+
+
+@register_op("arg_min")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None
+                     else False)
+    return out.astype(jnp.dtype(str(dtype)) if isinstance(dtype, str)
+                      else dtype)
+
+
+@register_op("argsort")
+def argsort(x, axis=-1, descending=False, name=None):
+    out = jnp.argsort(x, axis=axis, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@register_op("sort_op")
+def sort(x, axis=-1, descending=False, name=None):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+@register_op("top_k_v2")
+def _topk_impl(x, k, axis, largest):
+    if axis != -1 and axis != jnp.ndim(x) - 1:
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        vals = -vals
+    if axis != -1 and axis != jnp.ndim(vals) - 1 + 0:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(_unwrap(k))
+    nd = _unwrap(x).ndim
+    axis = axis % nd if nd else 0
+    vals, idx = _topk_impl(x, k=k, axis=axis if nd else -1,
+                           largest=largest)
+    return vals, idx
+
+
+@register_op("kthvalue_op")
+def _kthvalue_impl(x, k, axis, keepdim):
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    idx = jnp.take(sorted_idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _kthvalue_impl(x, k=int(_unwrap(k)), axis=axis, keepdim=keepdim)
+
+
+@register_op("mode_op")
+def _mode_impl(x, axis, keepdim):
+    x_m = jnp.moveaxis(x, axis, -1)
+    sorted_v = jnp.sort(x_m, axis=-1)
+    n = x_m.shape[-1]
+    # run-length trick: count occurrences of each sorted value
+    eq = sorted_v[..., :, None] == sorted_v[..., None, :]
+    counts = eq.sum(-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(sorted_v, best[..., None], axis=-1)[..., 0]
+    # index of the *last* occurrence in the original array (paddle semantics)
+    match = x_m == vals[..., None]
+    ar = jnp.arange(n)
+    idx = jnp.max(jnp.where(match, ar, -1), axis=-1)
+    if keepdim:
+        vals, idx = vals[..., None], idx[..., None]
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(
+            idx.astype(jnp.int64), -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _mode_impl(x, axis=axis, keepdim=keepdim)
+
+
+@register_op("median_op")
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("nanmedian_op")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("quantile_op")
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+@register_op("nanquantile_op")
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+@register_op("searchsorted_op")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    if jnp.ndim(sorted_sequence) == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(_unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64)))
+                     for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def index_of_max(x, axis=None):
+    return argmax(x, axis=axis)

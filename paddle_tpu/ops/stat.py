@@ -1,0 +1,25 @@
+"""Statistics ops (paddle.tensor.stat parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = ["std", "var", "numel_stat"]
+
+
+@register_op("reduce_std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.std(x, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("reduce_var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.var(x, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def numel_stat(x):
+    from .creation import numel
+    return numel(x)

@@ -1,0 +1,76 @@
+"""paddle.save / paddle.load: pickle-based checkpoint serialization.
+
+Reference analogue: python/paddle/framework/io.py:202 (save) / :292 (load)
+in /root/reference — nested state structures are pickled with Tensors
+converted to numpy. Large-scale sharded checkpoints use
+paddle_tpu.distributed.checkpoint (orbax-backed) instead; this covers the
+single-host paddle.save/paddle.load surface.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import Parameter, Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper marking arrays that were Tensors."""
+
+    __slots__ = ("array", "is_param", "stop_gradient", "name")
+
+    def __init__(self, array, is_param, stop_gradient, name):
+        self.array = array
+        self.is_param = is_param
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        # bfloat16 has no numpy dtype outside ml_dtypes; keep it (ml_dtypes
+        # is always present with jax) — np.asarray handles it natively.
+        return _TensorPayload(arr, isinstance(obj, Parameter),
+                              obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            p = Parameter(jnp.asarray(obj.array), name=obj.name)
+            p.stop_gradient = obj.stop_gradient
+            return p
+        t = Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient,
+                   name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _decode(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **kwargs) -> Any:
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _decode(data, return_numpy=return_numpy)

@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Must run before jax is imported anywhere (hence env mutation at module
+import time). This mirrors the reference's strategy of testing distributed
+code via multi-process on one host (test_dist_base.py) — here we do better:
+XLA's CPU backend gives us 8 virtual devices in one process, so every
+sharding/collective path is exercised in CI without TPU hardware.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,112 @@
+"""Regression tests for autograd engine edge cases found in review:
+tape isolation between graphs, inplace taping, double grad, scalar
+promotion in reverse operators, set_grad_enabled semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_independent_graphs_survive_backward():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    a = p * 2
+    q = paddle.to_tensor([1.0], stop_gradient=False)
+    b = q * 3
+    b.sum().backward()          # must not destroy p's graph
+    a.sum().backward()
+    np.testing.assert_allclose(q.grad.numpy(), [3.0])
+    np.testing.assert_allclose(p.grad.numpy(), [2.0])
+
+
+def test_reverse_op_scalar_promotion():
+    t = paddle.to_tensor([1, 2])  # int32
+    r = 1.5 - t
+    np.testing.assert_allclose(r.numpy(), [0.5, -0.5])
+    r2 = 2.0 / paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(r2.numpy(), [2.0, 1.0])
+
+
+def test_inplace_add_is_taped():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 2
+    y.add_(z)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    np.testing.assert_allclose(z.grad.numpy(), [1.0])
+
+
+def test_inplace_on_grad_leaf_rejected():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(paddle.to_tensor([1.0]))
+
+
+def test_setitem_taped():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    v = paddle.to_tensor([5.0], stop_gradient=False)
+    y = x * 3
+    y[0] = v[0]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 3.0])
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+def test_double_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 12.0)  # 3x^2
+    (ggx,) = paddle.grad(gx, x)
+    np.testing.assert_allclose(ggx.numpy(), 12.0)  # 6x
+
+
+def test_triple_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x ** 4
+    (g1,) = paddle.grad(y, x, create_graph=True)   # 4x^3 = 32
+    (g2,) = paddle.grad(g1, x, create_graph=True)  # 12x^2 = 48
+    (g3,) = paddle.grad(g2, x)                     # 24x = 48
+    np.testing.assert_allclose(g1.numpy(), 32.0)
+    np.testing.assert_allclose(g2.numpy(), 48.0)
+    np.testing.assert_allclose(g3.numpy(), 48.0)
+
+
+def test_grad_of_output_wrt_itself():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    (gy,) = paddle.grad(y, y)
+    np.testing.assert_allclose(gy.numpy(), 1.0)
+
+
+def test_set_grad_enabled_restores():
+    assert paddle.is_grad_enabled()
+    with paddle.set_grad_enabled(False):
+        assert not paddle.is_grad_enabled()
+    assert paddle.is_grad_enabled()
+
+
+def test_save_load_roundtrip(tmp_path):
+    state = {
+        "w": paddle.Parameter(np.ones((2, 2), np.float32)),
+        "step": 7,
+        "nested": {"b": paddle.to_tensor([1.0, 2.0])},
+    }
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    assert isinstance(loaded["w"], paddle.Parameter)
+    assert not loaded["w"].stop_gradient
+    np.testing.assert_allclose(loaded["w"].numpy(), np.ones((2, 2)))
+    assert loaded["step"] == 7
+    np.testing.assert_allclose(loaded["nested"]["b"].numpy(), [1, 2])
+
+
+def test_tape_released_after_partial_grad():
+    from paddle_tpu.framework import global_tape
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    before = len(global_tape().nodes)
+    y = (x * 2).sum()
+    paddle.grad(y, x)
+    assert len(global_tape().nodes) <= before

@@ -1,0 +1,181 @@
+"""Tensor basics + eager autograd engine tests.
+
+Mirrors the reference's imperative tests (test_imperative_basic.py etc.):
+backward correctness vs analytic results, grad accumulation, no_grad,
+hooks, detach, paddle.grad.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    assert t.stop_gradient
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+    s = paddle.to_tensor(3)
+    assert s.item() == 3
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3])
+    assert "int" in str(t.dtype)
+    f = t.astype("float32")
+    assert str(f.dtype) == "float32"
+
+
+def test_arithmetic_and_broadcast():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    y = paddle.to_tensor([10.0, 20.0])
+    z = x * y + 1.0
+    np.testing.assert_allclose(z.numpy(), [[11, 41], [31, 81]])
+    np.testing.assert_allclose((x @ x).numpy(), [[7, 10], [15, 22]])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x      # y = x^2
+    z = y * x + y  # z = x^3 + x^2 → dz/dx = 3x^2 + 2x = 16
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 16.0)
+
+
+def test_grad_accumulation_multiple_uses():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x + x + x
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_backward_twice_accumulates_into_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (x * 2 + d).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x, retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 12.0)
+    assert x.grad is None  # paddle.grad does not populate .grad
+
+
+def test_paddle_grad_unused():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    w = paddle.to_tensor(5.0, stop_gradient=False)
+    y = x * 3
+    gx, gw = paddle.grad(y, [x, w], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), 3.0)
+    assert gw is None
+
+
+def test_backward_non_scalar_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(Exception):
+        y.backward()
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    z = y * 3
+    z.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_set_value_and_inplace():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.set_value(np.array([5.0, 6.0]))
+    np.testing.assert_allclose(x.numpy(), [5, 6])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [6, 7])
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4),
+                         stop_gradient=False)
+    row = x[1]
+    np.testing.assert_allclose(row.numpy(), [4, 5, 6, 7])
+    sub = x[0:2, 1:3]
+    assert sub.shape == [2, 2]
+    sub.sum().backward()
+    expected = np.zeros((3, 4)); expected[0:2, 1:3] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_comparison_and_where():
+    x = paddle.to_tensor([1.0, 5.0, 3.0])
+    m = x > 2.0
+    np.testing.assert_array_equal(m.numpy(), [False, True, True])
+    y = paddle.where(m, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(y.numpy(), [0, 5, 3])
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(Exception):
+            _ = paddle.log(x * 0 - 1)  # log(-1) = nan
+    finally:
+        paddle.set_flags({"check_nan_inf": False})
