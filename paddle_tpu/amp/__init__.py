@@ -1,0 +1,3 @@
+from .auto_cast import (auto_cast, amp_guard, white_list, black_list,
+                        AMP_WHITE_LIST, AMP_BLACK_LIST)  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401

@@ -1,0 +1,121 @@
+"""AMP autocast (reference imperative/amp_auto_cast.cc + fluid/contrib/
+mixed_precision/fp16_lists.py parity).
+
+TPU-first: the low-precision dtype is bfloat16 (MXU native, no loss-scaling
+strictly required — but GradScaler is provided for fp16 parity). The cast
+hook plugs into the op registry's dispatch (registry._amp_hook), exactly
+where the reference tracer casts inputs (tracer.cc:159).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core.flags import flag_value
+from ..framework import Tensor
+from ..ops import registry as _registry
+
+# mirror of fp16_lists.py: ops that are numerically safe in low precision
+AMP_WHITE_LIST: Set[str] = {
+    "matmul_v2", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "linear", "bmm", "mv", "addmm",
+    "flash_attention_op", "scaled_dot_product_attention", "einsum",
+    "lstm_cell", "gru_cell", "simple_rnn_cell", "rnn_scan",
+}
+
+# ops that must stay in fp32 (reductions / norms / losses / exp-family)
+AMP_BLACK_LIST: Set[str] = {
+    "softmax_op", "log_softmax_op", "cross_entropy",
+    "softmax_with_cross_entropy_op", "bce_loss", "bce_with_logits",
+    "layer_norm_op", "batch_norm_train", "batch_norm_infer", "group_norm_op",
+    "instance_norm_op", "sync_batch_norm", "reduce_sum", "reduce_mean",
+    "p_norm", "logsumexp", "exp", "log", "log2", "log10", "log1p", "pow",
+    "elementwise_pow", "square", "sqrt", "rsqrt", "reciprocal", "cumsum",
+    "reduce_prod", "softplus", "mse_loss_op", "l1_loss_op", "kldiv_loss_op",
+    "nll_loss_op", "ctc_loss_op",
+}
+
+white_list = AMP_WHITE_LIST
+black_list = AMP_BLACK_LIST
+
+_state = threading.local()
+
+
+def _amp_level() -> Optional[str]:
+    return getattr(_state, "level", None)
+
+
+def _amp_dtype():
+    return getattr(_state, "dtype", jnp.bfloat16)
+
+
+def _hook(op_name, args, kwargs):
+    level = _amp_level()
+    if level is None:
+        return args, kwargs
+    dtype = _amp_dtype()
+
+    def cast_val(v, to):
+        if isinstance(v, Tensor) and jnp.issubdtype(
+                v._data.dtype, jnp.floating) and v._data.dtype != to:
+            from ..ops.registry import OPS
+            # taped cast so gradients flow through (cast grad = cast back)
+            from ..ops.manipulation import cast as cast_op
+            return cast_op(v, to)
+        return v
+
+    if level == "O2":
+        # pure low precision except black list
+        to = jnp.float32 if op_name in AMP_BLACK_LIST else dtype
+        args = tuple(cast_val(a, to) for a in args)
+        kwargs = {k: cast_val(v, to) for k, v in kwargs.items()}
+        return args, kwargs
+    # O1: cast white-list to low precision, black-list to fp32
+    if op_name in AMP_WHITE_LIST:
+        args = tuple(cast_val(a, dtype) for a in args)
+        kwargs = {k: cast_val(v, dtype) for k, v in kwargs.items()}
+    elif op_name in AMP_BLACK_LIST:
+        args = tuple(cast_val(a, jnp.float32) for a in args)
+        kwargs = {k: cast_val(v, jnp.float32) for k, v in kwargs.items()}
+    return args, kwargs
+
+
+class auto_cast:
+    """with paddle.amp.auto_cast(): ... — O1 (mixed) or O2 (pure bf16)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        if flag_value("use_bf16_compute") and dtype == "float16":
+            # honor the flag: bf16 is the TPU-native low precision
+            dtype = "bfloat16"
+        self.enable = enable
+        self.level = level
+        self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        self.extra_white = set(custom_white_list or ())
+        self.extra_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self.prev = (_amp_level(), getattr(_state, "dtype", None),
+                     _registry._amp_hook)
+        if self.enable:
+            _state.level = self.level
+            _state.dtype = self.dtype
+            if self.extra_white:
+                AMP_WHITE_LIST.update(self.extra_white)
+            if self.extra_black:
+                AMP_BLACK_LIST.update(self.extra_black)
+            _registry.set_amp_hook(_hook)
+        return self
+
+    def __exit__(self, *exc):
+        _state.level = self.prev[0]
+        if self.prev[1] is not None:
+            _state.dtype = self.prev[1]
+        _registry.set_amp_hook(self.prev[2])
+        AMP_WHITE_LIST.difference_update(self.extra_white)
+        AMP_BLACK_LIST.difference_update(self.extra_black)
+
+
+amp_guard = auto_cast

@@ -1,0 +1,134 @@
+"""Dynamic loss scaling (reference fluid/dygraph/amp/loss_scaler.py:27
+AmpScaler + operators/amp/{check_finite_and_unscale,update_loss_scaling}
+in-graph ops — here as pure jnp on the grad arrays)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, no_grad
+
+__all__ = ["AmpScaler", "GradScaler"]
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _unscale_grads(self, optimizer):
+        params = optimizer._param_list()
+        found_inf = False
+        inv = 1.0 / self._scale
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found_inf = True
+            p._grad = g.astype(p._data.dtype)
+        self._found_inf = found_inf
+        return found_inf
+
+    @no_grad()
+    def minimize(self, optimizer, scaled_loss):
+        if not self._enable:
+            optimizer.step()
+            return
+        found_inf = self._unscale_grads(optimizer)
+        if not found_inf:
+            optimizer.step()
+        self._update(found_inf)
+
+    def step(self, optimizer):
+        """torch/paddle-2.x style: scaler.step(opt) after backward."""
+        if not self._enable:
+            optimizer.step()
+            return
+        found_inf = self._unscale_grads(optimizer)
+        if not found_inf:
+            optimizer.step()
+        self._update(found_inf)
+
+    def update(self):
+        pass  # state already updated in step/minimize (paddle parity shim)
+
+    def _update(self, found_inf: bool):
+        if not self._dynamic:
+            return
+        if found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    # -- pure-functional form for compiled steps -----------------------------
+    @staticmethod
+    def functional_update(scale, good, bad, found_inf, incr_ratio=2.0,
+                          decr_ratio=0.5, incr_every_n=1000,
+                          decr_every_n=1):
+        """In-graph loss-scale update (update_loss_scaling op analogue) —
+        all args/results are traced scalars, usable under jit."""
+        good = jnp.where(found_inf, 0, good + 1)
+        bad = jnp.where(found_inf, bad + 1, 0)
+        scale = jnp.where(bad >= decr_every_n,
+                          jnp.maximum(scale * decr_ratio, 1.0), scale)
+        bad = jnp.where(bad >= decr_every_n, 0, bad)
+        scale = jnp.where(good >= incr_every_n, scale * incr_ratio, scale)
+        good = jnp.where(good >= incr_every_n, 0, good)
+        return scale, good, bad
+
+
+class GradScaler(AmpScaler):
+    """paddle.amp.GradScaler (wraps AmpScaler, 2.x surface)."""
+
+    def scale(self, var):
+        return super().scale(var)
+
+    def unscale_(self, optimizer):
+        self._unscale_grads(optimizer)

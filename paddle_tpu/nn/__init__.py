@@ -1,0 +1,11 @@
+"""paddle_tpu.nn — layers, functionals, initializers.
+
+Parity with python/paddle/nn (~90 Layer classes, SURVEY.md §2.6).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import Layer  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from .utils import weight_norm, remove_weight_norm, spectral_norm  # noqa: F401
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,11 @@
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+
+# attention functionals land with the transformer layer module
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention)

@@ -1,0 +1,192 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py parity).
+
+All map to jax.nn / jnp primitives that XLA fuses into surrounding matmuls
+on TPU (reference CUDA impls: activation_op.* — subsumed by the compiler).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+from ...framework import _unwrap
+
+__all__ = [
+    "relu", "relu6", "elu", "selu", "celu", "gelu", "sigmoid", "hardsigmoid",
+    "hardswish", "hardtanh", "hardshrink", "softshrink", "tanhshrink",
+    "leaky_relu", "prelu", "rrelu", "log_sigmoid", "log_softmax", "softmax",
+    "softplus", "softsign", "swish", "silu", "mish", "maxout", "thresholded_relu",
+    "glu", "gumbel_softmax", "tanh_",
+]
+
+
+@register_op("relu")
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+@register_op("relu6")
+def relu6(x, name=None):
+    return jax.nn.relu6(x)
+
+
+@register_op("elu")
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@register_op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("celu")
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+@register_op("gelu")
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@register_op("sigmoid")
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@register_op("hardsigmoid")
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_op("hardswish")
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@register_op("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op("hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op("softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@register_op("tanhshrink")
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@register_op("leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+@register_op("prelu")
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if jnp.ndim(w) == 1 and w.shape[0] != 1 and jnp.ndim(x) > 1:
+        # per-channel: broadcast across spatial dims
+        ch_axis = 1 if data_format[1] == "C" else jnp.ndim(x) - 1
+        shape = [1] * jnp.ndim(x)
+        shape[ch_axis] = w.shape[0]
+        w = jnp.reshape(w, shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@register_op("rrelu")
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@register_op("log_sigmoid")
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("softmax_op")
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = x.astype(dtype) if dtype is not None else x
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax_op")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = x.astype(dtype) if dtype is not None else x
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softplus")
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x,
+                     jnp.logaddexp(scaled, 0.0) / beta)
+
+
+@register_op("softsign")
+def softsign(x, name=None):
+    return jax.nn.soft_sign(x)
+
+
+@register_op("swish")
+def swish(x, name=None):
+    return jax.nn.silu(x)
+
+
+silu = swish
+
+
+@register_op("mish")
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("maxout")
+def maxout(x, groups, axis=1, name=None):
+    nd = jnp.ndim(x)
+    axis = axis % nd
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return jnp.where(x > threshold, x, value)
+
+
+@register_op("glu")
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_op("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None,
+                   name=None):
+    from ...core.generator import next_key
+    k = key if key is not None else next_key()
+    g = jax.random.gumbel(k, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        hard_y = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        y = jax.lax.stop_gradient(hard_y - y) + y  # straight-through
+    return y
+
+
+def tanh_(x):
+    from ...ops.math import tanh
+    out = tanh(x)
+    x._data = out._data
+    return x

@@ -1,0 +1,127 @@
+"""Attention functionals: SDPA + blockwise (flash) attention.
+
+The reference has no fused attention for training (only the inference-side
+multihead_matmul fuse, /root/reference/paddle/fluid/operators/fused/
+multihead_matmul_op.cu) — attention is composed per-op in
+python/paddle/nn/layer/transformer.py. Here attention is first-class:
+
+- scaled_dot_product_attention: jnp composition; XLA fuses the softmax chain
+  into the MXU matmuls on TPU.
+- flash_attention: blockwise online-softmax over KV chunks via lax.scan —
+  O(seq) memory, long-context ready, and the unit the ring-attention
+  context-parallel strategy builds on (paddle_tpu.distributed.ring).
+  A Pallas TPU kernel backs the hot path (paddle_tpu.ops.pallas_kernels)
+  when running on TPU; this file is the portable reference implementation.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention"]
+
+
+def _sdpa_impl(q, k, v, attn_mask, dropout_p, is_causal, scale):
+    # layouts: [batch, seq, heads, head_dim] (paddle convention)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qT = jnp.einsum("bsnh->bnsh", q)
+    kT = jnp.einsum("bsnh->bnsh", k)
+    vT = jnp.einsum("bsnh->bnsh", v)
+    logits = jnp.einsum("bnqh,bnkh->bnqk", qT, kT) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    out = jnp.einsum("bnqk,bnkh->bnqh", probs, vT)
+    return jnp.einsum("bnsh->bsnh", out)
+
+
+@register_op("scaled_dot_product_attention")
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    return _sdpa_impl(query, key, value, attn_mask, dropout_p, is_causal,
+                      scale)
+
+
+def _flash_fwd(q, k, v, is_causal, scale, block_k):
+    """Blockwise attention with online softmax, scanning KV chunks.
+
+    q,k,v: [b, n, s, h] (head-major internally). Returns out, (m, l) stats.
+    """
+    b, n, sq, hd = q.shape
+    sk = k.shape[2]
+    nblocks = (sk + block_k - 1) // block_k
+    pad = nblocks * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, n, nblocks, block_k, hd)
+    vb = v.reshape(b, n, nblocks, block_k, hd)
+
+    q32 = q.astype(jnp.float32) * scale
+    pos_q = jnp.arange(sq)
+
+    def body(carry, blk):
+        acc, m, l = carry
+        kj, vj, jidx = blk
+        logits = jnp.einsum("bnqh,bnkh->bnqk", q32,
+                            kj.astype(jnp.float32))
+        pos_k = jidx * block_k + jnp.arange(block_k)
+        valid = pos_k < sk
+        if is_causal:
+            cm = pos_q[:, None] >= pos_k[None, :]
+            valid = valid[None, :] & cm
+            logits = jnp.where(valid, logits, -jnp.inf)
+        else:
+            logits = jnp.where(valid[None, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # guard fully-masked rows
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bnqk,bnkh->bnqh", p, vj.astype(jnp.float32))
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, n, sq, hd), jnp.float32)
+    m0 = jnp.full((b, n, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, n, sq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0),
+        (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0),
+         jnp.arange(nblocks)))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+@register_op("flash_attention_op")
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, block_size=512, name=None):
+    """paddle.nn.functional.flash_attention-compatible entry.
+
+    Layout: [batch, seq, num_heads, head_dim]. Memory O(seq·block) instead
+    of O(seq²); differentiable via jax.vjp of the scan (XLA rematerializes).
+    """
+    q = jnp.einsum("bsnh->bnsh", query)
+    k = jnp.einsum("bsnh->bnsh", key)
+    v = jnp.einsum("bsnh->bnsh", value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    blk = min(block_size, k.shape[2])
+    out = _flash_fwd(q, k, v, causal, scale, blk)
+    return jnp.einsum("bnsh->bsnh", out)

@@ -1,0 +1,339 @@
+"""Common NN functionals: linear, dropout, embedding, pad, interpolate, etc.
+
+Reference surface: python/paddle/nn/functional/common.py + input.py +
+extension ops. Dropout draws keys from the framework generator (traced-mode
+key threading handled by paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.generator import next_key
+from ...framework import Tensor, _unwrap
+from ...ops.registry import register_op, run_op
+from ...ops.manipulation import pad  # re-export (paddle has F.pad)
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "interpolate", "upsample", "unfold",
+    "fold", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "cosine_similarity", "bilinear", "label_smooth", "class_center_sample",
+    "zeropad2d", "sequence_mask", "temporal_shift", "npair_loss",
+]
+
+
+@register_op("linear")
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: [in_features, out_features]
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("dropout_op")
+def _dropout_impl(x, key, p, mode):
+    if mode == "upscale_in_train":
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    # downscale_in_infer: train multiplies by mask only
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    p = float(_unwrap(p))
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p) if p > 0 else x
+        return x
+    if axis is not None:
+        return _dropout_axis(x, p, axis, mode)
+    return _dropout_impl(x, next_key(), p=p, mode=mode)
+
+
+def _dropout_axis(x, p, axis, mode):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    a = _unwrap(x)
+    shape = tuple(a.shape[i] if i in axes else 1 for i in range(a.ndim))
+
+    def impl(x, key):
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, shape)
+        scaled = x / keep if mode == "upscale_in_train" else x
+        return jnp.where(mask, scaled, 0.0).astype(x.dtype)
+    return run_op("dropout_nd", impl, (x, next_key()), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return _dropout_axis(x, float(p), (0, ch_axis), "upscale_in_train")
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return _dropout_axis(x, float(p), (0, ch_axis), "upscale_in_train")
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(x, key):
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+    return run_op("alpha_dropout", impl, (x, next_key()), {})
+
+
+@register_op("embedding_op")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup (reference lookup_table_v2). On TPU this is a
+    gather that XLA turns into dynamic-slice batches; sparse grads are
+    subsumed by XLA (no SelectedRows needed)."""
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (x != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return out
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+@register_op("interp_op")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format[-1] == "C"
+    nd = x.ndim
+    n_spatial = nd - 2
+    spatial_axes = (list(range(1, 1 + n_spatial)) if channel_last
+                    else list(range(2, 2 + n_spatial)))
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if size is not None:
+        if isinstance(size, (int, np.integer)):
+            out_sizes = [int(size)] * n_spatial
+        else:
+            out_sizes = [int(_unwrap(s)) for s in size]
+    else:
+        sf = (list(scale_factor) if isinstance(scale_factor, (list, tuple))
+              else [scale_factor] * n_spatial)
+        out_sizes = [int(in_sizes[i] * float(_unwrap(sf[i])))
+                     for i in range(n_spatial)]
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    if method == "nearest":
+        out = x
+        for ax, (in_s, out_s) in zip(spatial_axes, zip(in_sizes, out_sizes)):
+            idx = jnp.floor(jnp.arange(out_s) * (in_s / out_s)).astype(
+                jnp.int32)
+            out = jnp.take(out, idx, axis=ax)
+        return out
+    # linear/cubic via jax.image.resize (align_corners=False semantics)
+    new_shape = list(x.shape)
+    for ax, out_s in zip(spatial_axes, out_sizes):
+        new_shape[ax] = out_s
+    if align_corners:
+        out = x
+        for ax, (in_s, out_s) in zip(spatial_axes, zip(in_sizes, out_sizes)):
+            pos = (jnp.arange(out_s) * ((in_s - 1) / (out_s - 1))
+                   if out_s > 1 else jnp.zeros(out_s))
+            lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_s - 1)
+            hi = jnp.clip(lo + 1, 0, in_s - 1)
+            w = (pos - lo).astype(x.dtype)
+            shape = [1] * nd
+            shape[ax] = out_s
+            w = jnp.reshape(w, shape)
+            out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                   + jnp.take(out, hi, axis=ax) * w)
+        return out
+    return jax.image.resize(x, tuple(new_shape), method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+@register_op("unfold_op")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference operators/math/im2col.*): NCHW -> [N, C*kh*kw, L]."""
+    def t2(v):
+        return (int(v), int(v)) if isinstance(v, (int, np.integer)) \
+            else tuple(int(i) for i in v)
+    kh, kw = t2(kernel_sizes)
+    sh, sw = t2(strides)
+    dh, dw = t2(dilations)
+    p = paddings
+    if isinstance(p, (int, np.integer)):
+        ph0 = ph1 = pw0 = pw1 = int(p)
+    elif len(p) == 2:
+        ph0 = ph1 = int(p[0]); pw0 = pw1 = int(p[1])
+    else:
+        ph0, pw0, ph1, pw1 = (int(i) for i in p)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)])
+    out_h = (h + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (w + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), padding=[(0, 0), (0, 0)],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return jnp.reshape(patches, (n, c * kh * kw, out_h * out_w))
+
+
+@register_op("fold_op")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def t2(v):
+        return (int(v), int(v)) if isinstance(v, (int, np.integer)) \
+            else tuple(int(i) for i in v)
+    oh, ow = t2(output_sizes)
+    kh, kw = t2(kernel_sizes)
+    sh, sw = t2(strides)
+    dh, dw = t2(dilations)
+    p = paddings
+    if isinstance(p, (int, np.integer)):
+        ph = pw = int(p)
+    else:
+        ph, pw = int(p[0]), int(p[1])
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    out_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = jnp.reshape(x, (n, c, kh, kw, out_h, out_w))
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + sh * out_h:sh,
+                         wj:wj + sw * out_w:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@register_op("pixel_shuffle_op")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(out, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    out = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(out, (n, h * r, w * r, c // (r * r)))
+
+
+@register_op("pixel_unshuffle_op")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    out = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+    out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+    return jnp.reshape(out, (n, c * r * r, h // r, w // r))
+
+
+@register_op("channel_shuffle_op")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    n, c, h, w = x.shape
+    out = jnp.reshape(x, (n, groups, c // groups, h, w))
+    out = jnp.swapaxes(out, 1, 2)
+    return jnp.reshape(out, (n, c, h, w))
+
+
+@register_op("cosine_similarity_op")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@register_op("bilinear_op")
+def bilinear(x1, x2, weight, bias=None, name=None):
+    # weight: [out_features, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("label_smooth_op")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@register_op("sequence_mask_op")
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    m = maxlen if maxlen is not None else None
+    if m is None:
+        raise ValueError("maxlen must be provided inside jit; eager infers")
+    ar = jnp.arange(m)
+    return (ar[None, :] < x[..., None]).astype(jnp.dtype(str(dtype))
+                                               if isinstance(dtype, str)
+                                               else dtype)
+
+
+@register_op("temporal_shift_op")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = jnp.reshape(x, (n, seg_num, c, h, w))
+    fold_c = int(c * shift_ratio)
+    left = jnp.concatenate([xr[:, 1:, :fold_c],
+                            jnp.zeros_like(xr[:, :1, :fold_c])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold_c:2 * fold_c]),
+                             xr[:, :-1, fold_c:2 * fold_c]], axis=1)
+    rest = xr[:, :, 2 * fold_c:]
+    out = jnp.concatenate([left, right, rest], axis=2)
+    return jnp.reshape(out, (nt, c, h, w))
+
+
+@register_op("npair_loss_op")
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    sim = jnp.matmul(anchor, positive.T)
+    lbl = labels[:, None] == labels[None, :]
+    target = lbl.astype(sim.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), axis=1))) / 2
+    return ce + reg
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample requires dynamic shapes; planned as a "
+        "bucketed variant")

@@ -1,0 +1,181 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference: conv ops in /root/reference/paddle/fluid/operators/conv_op.* and
+conv_transpose_op.* (cuDNN + im2col paths). On TPU a single XLA conv HLO
+covers all of it and lowers to MXU matmuls; layouts are paddle's NCHW/NHWC
+strings mapped to lax dimension_numbers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import register_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(i) for i in v)
+
+
+def _padding(padding, n, stride=None, kernel=None, dilation=None):
+    """paddle padding: int, list of ints, pairs, or SAME/VALID strings."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer))
+                                 for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[a,b],[c,d]] full-layout form
+    return [tuple(int(i) for i in p) for p in padding[-n:]]
+
+
+def _dim_numbers(n, channel_last):
+    spatial = "DHW"[3 - n:]
+    if channel_last:
+        lhs = "N" + spatial + "C"
+    else:
+        lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lhs, rhs, lhs
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          channel_last):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, _dim_numbers(n, channel_last))
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, n),
+        padding=_padding(padding, n),
+        rhs_dilation=_norm_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+@register_op("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 channel_last=data_format in ("NLC",))
+
+
+@register_op("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 channel_last=data_format == "NHWC")
+
+
+@register_op("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 channel_last=data_format == "NDHWC")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, channel_last, output_size=None):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        pad_pairs = None
+    else:
+        pad_pairs = pad
+    # paddle weight layout for transpose: [in_c, out_c//groups, *k]
+    # lax.conv_transpose wants IO spatial; use transpose_kernel=True with
+    # flipped semantics — simplest correct route: gradient-style transpose
+    # via conv_general_dilated with lhs_dilation.
+    k = weight.shape[2:]
+    if pad_pairs is None:
+        if pad == "SAME":
+            pad_pairs = [((ks - 1) // 2, ks // 2) for ks in k]
+        else:
+            pad_pairs = [(0, 0)] * n
+    opad = _norm_tuple(output_padding or 0, n)
+    eff_k = [dilation[i] * (k[i] - 1) + 1 for i in range(n)]
+    trans_pad = [
+        (eff_k[i] - 1 - pad_pairs[i][0],
+         eff_k[i] - 1 - pad_pairs[i][1] + opad[i])
+        for i in range(n)
+    ]
+    # weight [in_c, out_c/g, *k] -> [out_c, in_c/g, *k] flipped
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = jnp.reshape(w, (groups, ic // groups, ocg) + w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = jnp.reshape(w, (groups * ocg, ic // groups) + w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, _dim_numbers(n, channel_last))
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=(1,) * n,
+        padding=trans_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if output_size is not None:
+        target = _norm_tuple(output_size, n)
+        # crop/pad to requested size
+        sl = [np.s_[:]] * out.ndim
+        start = 1 + (0 if not channel_last else 0)
+        spatial_axes = (list(range(2, 2 + n)) if not channel_last
+                        else list(range(1, 1 + n)))
+        for ax, tgt in zip(spatial_axes, target):
+            if out.shape[ax] > tgt:
+                sl[ax] = np.s_[:tgt]
+        out = out[tuple(sl)]
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+@register_op("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC",
+                           output_size)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC",
+                           output_size)
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC",
+                           output_size)

@@ -1,0 +1,153 @@
+"""Normalization functionals.
+
+Reference: batch_norm_op.*, layer_norm_op.*, group_norm_op.*,
+instance_norm_op.* under /root/reference/paddle/fluid/operators/ (cuDNN +
+hand kernels). Here each is a few jnp lines XLA fuses; batch_norm running
+stats are updated functionally and written back by the calling Layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import Tensor, _unwrap
+from ...ops.registry import register_op
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
+           "local_response_norm", "normalize"]
+
+
+def _channel_axis(ndim, data_format):
+    return ndim - 1 if data_format[-1] == "C" else 1
+
+
+@register_op("batch_norm_infer")
+def _bn_infer(x, mean, var, weight, bias, epsilon, ch_axis):
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x - jnp.reshape(mean, shape)) * jnp.reshape(inv, shape)
+    if weight is not None:
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+@register_op("batch_norm_train")
+def _bn_train(x, weight, bias, epsilon, ch_axis):
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x - jnp.reshape(mean, shape)) * jnp.reshape(inv, shape)
+    if weight is not None:
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional batch norm. In training mode the running stats tensors are
+    updated in place (set_value) with the paddle momentum convention:
+    running = momentum*running + (1-momentum)*batch."""
+    ch_axis = _channel_axis(_unwrap(x).ndim, data_format)
+    if use_global_stats is None:
+        use_global_stats = not training
+    if not training or use_global_stats:
+        return _bn_infer(x, running_mean, running_var, weight, bias,
+                         epsilon=epsilon, ch_axis=ch_axis)
+    out, mean, var = _bn_train(x, weight, bias, epsilon=epsilon,
+                               ch_axis=ch_axis)
+    if isinstance(running_mean, Tensor):
+        running_mean.set_value(momentum * running_mean._data
+                               + (1 - momentum) * mean._data)
+        running_var.set_value(momentum * running_var._data
+                              + (1 - momentum) * var._data)
+    return out
+
+
+@register_op("layer_norm_op")
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(normalized_shape) if normalized_shape is not None else 1
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("group_norm_op")
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    ch_axis = _channel_axis(x.ndim, data_format)
+    c = x.shape[ch_axis]
+    xm = jnp.moveaxis(x, ch_axis, 1) if ch_axis != 1 else x
+    n = xm.shape[0]
+    grouped = jnp.reshape(xm, (n, num_groups, c // num_groups) + xm.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    normed = (grouped - mean) * jax.lax.rsqrt(var + epsilon)
+    out = jnp.reshape(normed, xm.shape)
+    if weight is not None:
+        out = out * jnp.reshape(weight, (1, c) + (1,) * (xm.ndim - 2))
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, c) + (1,) * (xm.ndim - 2))
+    return jnp.moveaxis(out, 1, ch_axis) if ch_axis != 1 else out
+
+
+@register_op("instance_norm_op")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    ch_axis = _channel_axis(x.ndim, data_format)
+    axes = tuple(i for i in range(x.ndim) if i not in (0, ch_axis))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        out = out * jnp.reshape(weight, shape) + jnp.reshape(bias, shape)
+    return out
+
+
+@register_op("local_response_norm_op")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    ch_axis = _channel_axis(x.ndim, data_format)
+    sq = jnp.square(x)
+    half = size // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[ch_axis] = (half, size - half - 1)
+    padded = jnp.pad(sq, pad_cfg)
+    window = [1] * x.ndim
+    window[ch_axis] = size
+    summed = jax.lax.reduce_window(
+        padded, 0.0, jax.lax.add, tuple(window), (1,) * x.ndim,
+        [(0, 0)] * x.ndim)
+    div = jnp.power(k + alpha * summed / size, beta)
+    return x / div
+
+
+@register_op("normalize_op")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    if p == 2:
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        denom = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                                  keepdims=True), 1.0 / p)
+    return x / jnp.maximum(denom, epsilon)

@@ -1,0 +1,219 @@
+"""Pooling functionals over lax.reduce_window.
+
+Reference: pool ops in /root/reference/paddle/fluid/operators/pool_op.* —
+one XLA reduce_window covers max/avg over any rank.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import register_op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(i) for i in v)
+
+
+def _pad_pairs(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+
+
+def _window(x, n, ksize, stride, channel_last):
+    ksize = _norm_tuple(ksize, n)
+    stride = _norm_tuple(stride if stride is not None else ksize, n)
+    if channel_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _full_padding(pad, n, channel_last):
+    if isinstance(pad, str):
+        return pad
+    if channel_last:
+        return [(0, 0)] + pad + [(0, 0)]
+    return [(0, 0), (0, 0)] + pad
+
+
+def _max_pool(x, ksize, stride, padding, n, channel_last, ceil_mode=False):
+    dims, strides = _window(x, n, ksize, stride, channel_last)
+    pad = _pad_pairs(padding, n)
+    if not isinstance(pad, str) and ceil_mode:
+        # extend right pads so trailing partial windows are kept
+        spatial = x.shape[1:1 + n] if channel_last else x.shape[2:2 + n]
+        k = _norm_tuple(ksize, n)
+        s = _norm_tuple(stride if stride is not None else ksize, n)
+        pad = [
+            (p[0], p[1] + _ceil_extra(spatial[i], k[i], s[i],
+                                      p[0] + p[1]))
+            for i, p in enumerate(pad)
+        ]
+    neg = (jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.inexact)
+           else jnp.iinfo(x.dtype).min)
+    return jax.lax.reduce_window(
+        x, neg, jax.lax.max, dims, strides, _full_padding(pad, n,
+                                                          channel_last))
+
+
+def _ceil_extra(size, k, s, total_pad):
+    import math
+    out_floor = (size + total_pad - k) // s + 1
+    out_ceil = math.ceil((size + total_pad - k) / s) + 1
+    return (out_ceil - out_floor) * s
+
+
+def _avg_pool(x, ksize, stride, padding, n, channel_last, exclusive=True,
+              ceil_mode=False):
+    dims, strides = _window(x, n, ksize, stride, channel_last)
+    pad = _pad_pairs(padding, n)
+    if not isinstance(pad, str) and ceil_mode:
+        spatial = x.shape[1:1 + n] if channel_last else x.shape[2:2 + n]
+        k = _norm_tuple(ksize, n)
+        s = _norm_tuple(stride if stride is not None else ksize, n)
+        pad = [(p[0], p[1] + _ceil_extra(spatial[i], k[i], s[i],
+                                         p[0] + p[1]))
+               for i, p in enumerate(pad)]
+    fp = _full_padding(pad, n, channel_last)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, fp)
+    if exclusive and not isinstance(fp, str):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides,
+                                       fp)
+        return summed / counts
+    denom = float(np.prod(_norm_tuple(ksize, n)))
+    return summed / denom
+
+
+@register_op("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 1,
+                     data_format == "NLC", ceil_mode)
+
+
+@register_op("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 2,
+                     data_format == "NHWC", ceil_mode)
+
+
+@register_op("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 3,
+                     data_format == "NDHWC", ceil_mode)
+
+
+@register_op("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 1,
+                     data_format == "NLC", exclusive, ceil_mode)
+
+
+@register_op("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    out = _avg_pool(x, kernel_size, stride, padding, 2,
+                    data_format == "NHWC", exclusive, ceil_mode)
+    if divisor_override is not None:
+        k = _norm_tuple(kernel_size, 2)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+@register_op("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    out = _avg_pool(x, kernel_size, stride, padding, 3,
+                    data_format == "NDHWC", exclusive, ceil_mode)
+    if divisor_override is not None:
+        k = _norm_tuple(kernel_size, 3)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+def _adaptive(x, output_size, n, channel_last, op):
+    spatial_axes = (list(range(1, 1 + n)) if channel_last
+                    else list(range(2, 2 + n)))
+    out_size = _norm_tuple(output_size, n)
+    # adaptive pooling where input divides evenly: single reduce_window;
+    # otherwise fall back to per-axis mean/max of split windows
+    result = x
+    for i, ax in enumerate(spatial_axes):
+        in_s, out_s = result.shape[ax], out_size[i]
+        if out_s is None:
+            continue
+        if in_s % out_s == 0:
+            k = in_s // out_s
+            new_shape = (result.shape[:ax] + (out_s, k)
+                         + result.shape[ax + 1:])
+            r = jnp.reshape(result, new_shape)
+            result = (jnp.max(r, axis=ax + 1) if op == "max"
+                      else jnp.mean(r, axis=ax + 1))
+        else:
+            # uneven: gather overlapping windows (paddle formula)
+            starts = (np.arange(out_s) * in_s) // out_s
+            ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+            pieces = []
+            for s_, e_ in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(result, int(s_), int(e_), axis=ax)
+                red = (jnp.max(seg, axis=ax, keepdims=True) if op == "max"
+                       else jnp.mean(seg, axis=ax, keepdims=True))
+                pieces.append(red)
+            result = jnp.concatenate(pieces, axis=ax)
+    return result
+
+
+@register_op("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, False, "avg")
+
+
+@register_op("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format == "NHWC", "avg")
+
+
+@register_op("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format == "NDHWC", "avg")
+
+
+@register_op("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, False, "max")
+
+
+@register_op("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, False, "max")
+
+
+@register_op("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, False, "max")

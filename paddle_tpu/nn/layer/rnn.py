@@ -1,0 +1,333 @@
+"""RNN layers (python/paddle/nn/layer/rnn.py parity).
+
+Reference: rnn_op + cudnn_lstm (/root/reference/paddle/fluid/operators/
+rnn_op.h, cudnn_lstm_op.cu.cc). TPU-first: the time loop is a single
+lax.scan inside one registered op, so the whole sequence compiles to one
+fused XLA while-loop — no per-step dispatch, cuDNN not needed.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import Tensor, _unwrap
+from ...ops.registry import run_op
+from .. import functional as F
+from ..initializer import Uniform, XavierUniform
+from ..param_attr import ParamAttr
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = _unwrap(batch_ref).shape[batch_dim_idx]
+        from ...ops.creation import full
+        return full((batch, self.hidden_size), init_value,
+                    dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), attr=ParamAttr._to_attr(
+                weight_ih_attr), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), attr=ParamAttr._to_attr(
+                weight_hh_attr), default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), attr=ParamAttr._to_attr(bias_ih_attr),
+            default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), attr=ParamAttr._to_attr(bias_hh_attr),
+            default_initializer=init, is_bias=True)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _step(self, x, h, wih, whh, bih, bhh):
+        z = x @ wih.T + bih + h @ whh.T + bhh
+        return jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = run_op("simple_rnn_cell", self._step,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), {})
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (4 * hidden_size, input_size),
+            attr=ParamAttr._to_attr(weight_ih_attr),
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (4 * hidden_size, hidden_size),
+            attr=ParamAttr._to_attr(weight_hh_attr),
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (4 * hidden_size,), attr=ParamAttr._to_attr(bias_ih_attr),
+            default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (4 * hidden_size,), attr=ParamAttr._to_attr(bias_hh_attr),
+            default_initializer=init, is_bias=True)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    @staticmethod
+    def _step(x, h, c, wih, whh, bih, bhh):
+        gates = x @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        h_new, c_new = run_op(
+            "lstm_cell", self._step,
+            (inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), {})
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (3 * hidden_size, input_size),
+            attr=ParamAttr._to_attr(weight_ih_attr),
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (3 * hidden_size, hidden_size),
+            attr=ParamAttr._to_attr(weight_hh_attr),
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (3 * hidden_size,), attr=ParamAttr._to_attr(bias_ih_attr),
+            default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (3 * hidden_size,), attr=ParamAttr._to_attr(bias_hh_attr),
+            default_initializer=init, is_bias=True)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh):
+        gi = x @ wih.T + bih
+        gh = h @ whh.T + bhh
+        ri, zi, ni = jnp.split(gi, 3, axis=-1)
+        rh, zh, nh = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi + zh)
+        n = jnp.tanh(ni + r * nh)
+        return (1 - z) * n + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = run_op("gru_cell", self._step,
+                   (inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh), {})
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (recurrent_op analogue)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        is_lstm = isinstance(cell, LSTMCell)
+        if initial_states is None:
+            ref = inputs if not self.time_major else inputs
+            batch_axis = 1 if self.time_major else 0
+            from ...ops.creation import zeros
+            b = _unwrap(inputs).shape[batch_axis]
+            h0 = zeros((b, cell.hidden_size))
+            initial_states = (h0, zeros((b, cell.hidden_size))) if is_lstm \
+                else h0
+
+        params = (cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh)
+
+        time_major = self.time_major
+        reverse = self.is_reverse
+        step = cell._step
+
+        def impl(x, *args):
+            if is_lstm:
+                h0, c0 = args[0], args[1]
+                wih, whh, bih, bhh = args[2:6]
+            else:
+                h0 = args[0]
+                wih, whh, bih, bhh = args[1:5]
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)
+            if reverse:
+                xs = jnp.flip(xs, 0)
+
+            if is_lstm:
+                def body(carry, xt):
+                    h, c = carry
+                    h2, c2 = step(xt, h, c, wih, whh, bih, bhh)
+                    return (h2, c2), h2
+                (hT, cT), outs = jax.lax.scan(body, (h0, c0), xs)
+                final = (hT, cT)
+            else:
+                def body(h, xt):
+                    h2 = step(xt, h, wih, whh, bih, bhh)
+                    return h2, h2
+                hT, outs = jax.lax.scan(body, h0, xs)
+                final = (hT,)
+            if reverse:
+                outs = jnp.flip(outs, 0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs,) + final
+
+        if is_lstm:
+            h0, c0 = initial_states
+            res = run_op("rnn_scan", impl, (inputs, h0, c0) + params, {})
+            outs, hT, cT = res
+            return outs, (hT, cT)
+        res = run_op("rnn_scan", impl, (inputs, initial_states) + params, {})
+        outs, hT = res
+        return outs, hT
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states = initial_states or (None, None)
+        out_f, st_f = self.rnn_fw(inputs, states[0])
+        out_b, st_b = self.rnn_bw(inputs, states[1])
+        from ...ops.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _RNNBase(Layer):
+    cell_cls = None
+    n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.num_directions = num_dir
+
+        kwargs = {}
+        if activation is not None and self.cell_cls is SimpleRNNCell:
+            kwargs["activation"] = activation
+
+        from .container import LayerList
+        self._cells = LayerList()
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * num_dir
+            for d in range(num_dir):
+                self._cells.append(self.cell_cls(
+                    in_size, hidden_size, weight_ih_attr=weight_ih_attr,
+                    weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                    bias_hh_attr=bias_hh_attr, **kwargs))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat, stack
+        num_dir = self.num_directions
+        batch_axis = 1 if self.time_major else 0
+        b = _unwrap(inputs).shape[batch_axis]
+        x = inputs
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(num_dir):
+                cell = self._cells[layer * num_dir + d]
+                rnn = RNN(cell, is_reverse=(d == 1),
+                          time_major=self.time_major)
+                init = None
+                if initial_states is not None:
+                    idx = layer * num_dir + d
+                    if self.n_states == 2:
+                        h0s, c0s = initial_states
+                        init = (h0s[idx], c0s[idx])
+                    else:
+                        init = initial_states[idx]
+                out, st = rnn(x, init)
+                outs.append(out)
+                if self.n_states == 2:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            x = outs[0] if num_dir == 1 else concat(outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        if self.n_states == 2:
+            return x, (stack(final_h, axis=0), stack(final_c, axis=0))
+        return x, stack(final_h, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    cell_cls = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    cell_cls = LSTMCell
+    n_states = 2
+
+
+class GRU(_RNNBase):
+    cell_cls = GRUCell

@@ -1,0 +1,80 @@
+"""nn.utils: weight_norm / spectral_norm wrappers
+(python/paddle/nn/utils/ parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import Parameter, Tensor
+from .layer.layers import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v/||v|| via a forward-pre-hook."""
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    g = Parameter(_norm_except(w._data, dim).reshape(-1))
+    v = Parameter(w._data)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ..ops.registry import run_op
+        gv, vv = lyr._parameters[name + "_g"], lyr._parameters[name + "_v"]
+
+        def impl(g_, v_):
+            norm = _norm_except(v_, dim)
+            shape = [1] * v_.ndim
+            shape[dim] = -1
+            return v_ / norm * g_.reshape(shape)
+        w_eff = run_op("weight_norm", impl, (gv, vv), {})
+        lyr._buffers[name] = w_eff  # found by __getattr__ during forward
+        return None
+
+    h = layer.register_forward_pre_hook(hook)
+    layer.__dict__["_weight_norm_hook"] = h
+    # materialize once so the attribute exists pre-forward
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    h = layer.__dict__.pop("_weight_norm_hook", None)
+    if h is not None:
+        h.remove()
+    w_eff = layer._buffers.pop(name, None)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    if w_eff is not None:
+        layer._parameters[name] = Parameter(w_eff._data)
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
+                  eps=1e-12, dim=None):
+    from .layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    sn = SpectralNorm(list(w._data.shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = Parameter(w._data)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        w_eff = lyr._sub_layers[name + "_sn"](
+            lyr._parameters[name + "_orig"])
+        lyr._buffers[name] = w_eff
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer

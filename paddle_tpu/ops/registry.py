@@ -110,8 +110,11 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
     if _amp_hook is not None:
         args, kwargs = _amp_hook(name, args, kwargs)
 
-    # split positional args into diff-tensor slots and pass-through slots
+    # split positional args and kwargs into diff-tensor slots and
+    # pass-through slots; Tensor/jax.Array in either position is a
+    # differentiable input
     tensor_pos = []
+    tensor_keys = []
     arrays = []
     input_tensors = []
     plain_args = list(args)
@@ -124,17 +127,31 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
             tensor_pos.append(i)
             arrays.append(a)
             input_tensors.append(None)
-    kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+    plain_kwargs = dict(kwargs)
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor):
+            tensor_keys.append(k)
+            arrays.append(v._data)
+            input_tensors.append(v)
+        elif isinstance(v, jax.Array):
+            tensor_keys.append(k)
+            arrays.append(v)
+            input_tensors.append(None)
 
     requires = (is_grad_enabled()
                 and any(t is not None and not t.stop_gradient
                         for t in input_tensors))
 
+    npos = len(tensor_pos)
+
     def pure(*diff):
         full = list(plain_args)
-        for pos, val in zip(tensor_pos, diff):
+        for pos, val in zip(tensor_pos, diff[:npos]):
             full[pos] = val
-        res = fn(*full, **kwargs)
+        kw = dict(plain_kwargs)
+        for key, val in zip(tensor_keys, diff[npos:]):
+            kw[key] = val
+        res = fn(*full, **kw)
         # normalize list outputs to tuple so vjp cotangent structure is stable
         return tuple(res) if isinstance(res, list) else res
 

@@ -1,0 +1,176 @@
+"""Optimizer base (python/paddle/optimizer/optimizer.py:48 parity).
+
+TPU-first design: every optimizer is defined by two PURE functions —
+``init_state(param) -> state dict`` and
+``update_rule(param, grad, state, lr) -> (new_param, new_state)`` —
+so the same rule drives both the eager ``step()`` (paddle surface) and
+compiled/sharded training steps (paddle_tpu.static.TrainStep applies the
+rule over a param pytree inside jit/pjit; ZeRO sharding shards `state`
+over the dp axis). The reference instead writes one CUDA kernel per
+optimizer (/root/reference/paddle/fluid/operators/optimizers/).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import Parameter, Tensor, no_grad
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    # hyperparameters exposed to the pure update rule
+    _hyper_defaults: Dict[str, Any] = {}
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None \
+            else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:  # L2Decay-like object with a coeff
+            self._weight_decay = float(
+                getattr(weight_decay, "_coeff",
+                        getattr(weight_decay, "coeff", 0.0)))
+        # state: id(param) -> dict name->jax array
+        self._accumulators: Dict[int, Dict[str, Any]] = {}
+        self._step_count = 0
+
+    # -- pure rule (override) ------------------------------------------------
+    def init_state(self, param: jax.Array) -> Dict[str, Any]:
+        return {}
+
+    def update_rule(self, p, g, state, lr):
+        raise NotImplementedError
+
+    # decoupled weight decay? (AdamW) — L2-style adds wd*p to grad
+    _decoupled_wd = False
+
+    # -- LR ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- eager step ----------------------------------------------------------
+    def _param_list(self):
+        if self._parameters is None:
+            raise ValueError(
+                "optimizer constructed without parameters; pass parameters= "
+                "or use the functional API")
+        return self._parameters
+
+    @no_grad()
+    def step(self):
+        params = self._param_list()
+        pg = [(p, p.grad) for p in params
+              if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in pg:
+            if g is None:
+                continue
+            garr = g._data if isinstance(g, Tensor) else g
+            state = self._accumulators.get(id(p))
+            if state is None:
+                state = self.init_state(p._data)
+                self._accumulators[id(p)] = state
+            garr = garr.astype(p._data.dtype)
+            if self._weight_decay and not self._decoupled_wd:
+                garr = garr + self._weight_decay * p._data
+            new_p, new_state = self.update_rule(p._data, garr, state, lr)
+            if self._decoupled_wd and self._weight_decay:
+                new_p = new_p - lr * self._weight_decay * p._data
+            p._data = new_p
+            self._accumulators[id(p)] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._param_list()]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._param_list():
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- functional API for compiled steps ------------------------------------
+    def init_state_tree(self, params_tree):
+        """init_state over a pytree of arrays (for jit'd train steps)."""
+        return jax.tree_util.tree_map(self.init_state, params_tree)
+
+    def apply_gradients_tree(self, params_tree, grads_tree, state_tree,
+                             lr=None):
+        """Pure pytree update: returns (new_params, new_state). Usable under
+        jit/pjit/shard_map; lr may be a traced scalar."""
+        lr = lr if lr is not None else self.get_lr()
+        wd = self._weight_decay
+
+        def upd(p, g, s):
+            g = g.astype(p.dtype)
+            if wd and not self._decoupled_wd:
+                g = g + wd * p
+            new_p, new_s = self.update_rule(p, g, s, lr)
+            if self._decoupled_wd and wd:
+                new_p = new_p - lr * wd * p
+            return new_p, new_s
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = tdef.flatten_up_to(grads_tree)
+        flat_s = tdef.flatten_up_to(state_tree)
+        new = [upd(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        new_p = tdef.unflatten([a for a, _ in new])
+        new_s = tdef.unflatten([b for _, b in new])
+        return new_p, new_s
+
+    # -- state dict ------------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        params = self._parameters or []
+        for i, p in enumerate(params):
+            key = p.name or f"param_{i}"
+            state = self._accumulators.get(id(p))
+            if state:
+                out[key] = {k: Tensor(v) if isinstance(v, jax.Array) else v
+                            for k, v in state.items()}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("_step_count", 0)
+        params = self._parameters or []
+        for i, p in enumerate(params):
+            key = p.name or f"param_{i}"
+            if key in state:
+                self._accumulators[id(p)] = {
+                    k: (v._data if isinstance(v, Tensor) else v)
+                    for k, v in state[key].items()}
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    set_dict = set_state_dict

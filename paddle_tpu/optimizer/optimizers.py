@@ -1,0 +1,243 @@
+"""Concrete optimizers (python/paddle/optimizer/* + reference
+operators/optimizers/ CUDA kernels — here: pure jnp update rules).
+
+SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, Adamax, RMSProp, Lamb,
+Lars — each a pair of pure functions on arrays (see Optimizer docstring).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
+           "Adamax", "RMSProp", "Lamb", "Lars"]
+
+
+class SGD(Optimizer):
+    def update_rule(self, p, g, state, lr):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def update_rule(self, p, g, state, lr):
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_state(self, param):
+        return {
+            "moment1": jnp.zeros_like(param),
+            "moment2": jnp.zeros_like(param),
+            "beta1_pow": jnp.ones((), param.dtype),
+            "beta2_pow": jnp.ones((), param.dtype),
+        }
+
+    def update_rule(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        p_new = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return p_new, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value
+                 =0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, param):
+        return {"moment": jnp.full_like(param, self._init_acc)}
+
+    def update_rule(self, p, g, state, lr):
+        acc = state["moment"] + jnp.square(g)
+        p_new = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return p_new, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def init_state(self, param):
+        return {"avg_squared_grad": jnp.zeros_like(param),
+                "avg_squared_update": jnp.zeros_like(param)}
+
+    def update_rule(self, p, g, state, lr):
+        rho, eps = self._rho, self._epsilon
+        sg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        update = (jnp.sqrt(state["avg_squared_update"] + eps)
+                  / jnp.sqrt(sg + eps)) * g
+        su = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(
+            update)
+        return p - lr * update, {"avg_squared_grad": sg,
+                                 "avg_squared_update": su}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, param):
+        return {"moment": jnp.zeros_like(param),
+                "inf_norm": jnp.zeros_like(param),
+                "beta1_pow": jnp.ones((), param.dtype)}
+
+    def update_rule(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"] * b1
+        p_new = p - (lr / (1 - b1p)) * m / (u + eps)
+        return p_new, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_state(self, param):
+        s = {"mean_square": jnp.zeros_like(param),
+             "momentum": jnp.zeros_like(param)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(param)
+        return s
+
+    def update_rule(self, p, g, state, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return p - mom, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name=name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, param):
+        return {"moment1": jnp.zeros_like(param),
+                "moment2": jnp.zeros_like(param),
+                "beta1_pow": jnp.ones((), param.dtype),
+                "beta2_pow": jnp.ones((), param.dtype)}
+
+    def update_rule(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p_new = p - lr * trust.astype(p.dtype) * r
+        return p_new, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class Lars(Optimizer):
+    """LARS (reference lars_momentum_op)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name=name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+
+    def init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def update_rule(self, p, g, state, lr):
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + self._epsilon), 1.0)
+        v = self._momentum * state["velocity"] + lr * local_lr.astype(
+            p.dtype) * (g + self._lars_wd * p)
+        return p - v, {"velocity": v}

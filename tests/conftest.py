@@ -14,4 +14,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+# exact matmuls for numpy-reference comparisons (CPU default is low-prec).
+# NB: pytest plugins import jax before this conftest, so set the config
+# directly rather than via env.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,298 @@
+"""nn.Layer system + layers tests (mirrors reference test_layers.py /
+test_imperative_* suites, numpy-reference style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward_backward():
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5)
+    y.sum().backward()
+    assert layer.weight.grad is not None
+    np.testing.assert_allclose(layer.bias.grad.numpy(), [2, 2, 2], rtol=1e-6)
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = dict(net.named_parameters())
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    assert len(sd) == 4
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(),
+                               net.fc1.weight.numpy())
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(ll.parameters()) == 6
+
+
+def test_conv2d_matches_reference():
+    paddle.seed(1)
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 8, 8])
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    y2 = nn.Conv2D(3, 8, 3, stride=2)(x)
+    assert y2.shape == [2, 8, 3, 3]
+    y.sum().backward()
+    assert conv.weight.grad is not None
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_transpose_shape():
+    conv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1)
+    x = paddle.randn([2, 4, 5, 5])
+    y = conv(x)
+    assert y.shape == [2, 6, 9, 9]
+
+
+def test_conv_transpose_matches_conv_input_gradient():
+    # conv_transpose(y, w) == d/dx [conv(x, w')·y] with w' the role-swapped
+    # kernel — the defining property of transposed convolution
+    paddle.seed(2)
+    import jax
+    import jax.numpy as jnp
+    y = paddle.randn([1, 2, 5, 5], "float32")   # gradient-side input
+    w = paddle.randn([2, 3, 3, 3], "float32")   # transpose layout [in,out,kh,kw]
+    yt = F.conv2d_transpose(y, w, stride=2)
+    assert yt.shape == [1, 3, 11, 11]
+    # forward conv with kernel [out=2, in=3, kh, kw] maps [1,3,11,11]->[1,2,5,5]
+    w_fwd = jnp.swapaxes(jnp.asarray(w.numpy()), 0, 1)
+
+    def fwd(inp):
+        return jax.lax.conv_general_dilated(
+            inp, jnp.swapaxes(w_fwd, 0, 1), (2, 2), [(0, 0), (0, 0)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                inp.shape, (2, 3, 3, 3), ("NCHW", "OIHW", "NCHW")))
+
+    _, vjp = jax.vjp(fwd, jnp.zeros((1, 3, 11, 11), jnp.float32))
+    (ref,) = vjp(jnp.asarray(y.numpy()))
+    np.testing.assert_allclose(yt.numpy(), np.asarray(ref), atol=1e-4)
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                  [10.5, 12.5]])
+
+
+def test_adaptive_pool():
+    x = paddle.randn([2, 3, 7, 7])
+    y = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y.numpy()[..., 0, 0],
+                               x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_batch_norm_train_and_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    y = bn(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(6)
+    x = paddle.randn([4, 6])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    loss = ln(x).sum()
+    loss.backward()
+    assert ln.weight.grad is not None
+
+
+def test_group_norm_instance_norm():
+    gn = nn.GroupNorm(2, 4)
+    x = paddle.randn([2, 4, 3, 3])
+    assert gn(x).shape == [2, 4, 3, 3]
+    inorm = nn.InstanceNorm2D(4)
+    assert inorm(x).shape == [2, 4, 3, 3]
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    y = do(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    # upscale preserves expectation
+    np.testing.assert_allclose(y.numpy().mean(), 1.0, atol=0.05)
+    do.eval()
+    np.testing.assert_allclose(do(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert np.allclose(g[[1, 2, 3, 4]], 1)
+    assert np.allclose(g[0], 0)
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.to_tensor(
+        np.random.randn(5, 7).astype(np.float32), stop_gradient=False)
+    labels = paddle.to_tensor(np.array([0, 3, 6, 2, 1]))
+    loss = F.cross_entropy(logits, labels)
+    lp = np.log(np.exp(logits.numpy())
+                / np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -lp[np.arange(5), labels.numpy()].mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-4)
+    loss.backward()
+    assert logits.grad is not None
+
+
+def test_cross_entropy_ignore_index_and_weight():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor(np.array([0, 1, -100, 2]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    # only 3 valid entries averaged
+    lp = np.log(np.exp(logits.numpy())
+                / np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -(lp[0, 0] + lp[1, 1] + lp[3, 2]) / 3
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-4)
+
+
+def test_losses_shapes():
+    a, b = paddle.randn([4, 3]), paddle.randn([4, 3])
+    assert F.mse_loss(a, b).shape == []
+    assert F.l1_loss(a, b, reduction="none").shape == [4, 3]
+    p = paddle.nn.functional.sigmoid(a)
+    lbl = paddle.to_tensor((np.random.rand(4, 3) > 0.5).astype(np.float32))
+    assert F.binary_cross_entropy(p, lbl).shape == []
+    assert F.binary_cross_entropy_with_logits(a, lbl).shape == []
+    assert F.kl_div(F.log_softmax(a), F.softmax(b)).shape == []
+
+
+def test_activations_numerics():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(
+        F.sigmoid(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-6)
+    sm = F.softmax(x).numpy()
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(F.hardswish(x).numpy(),
+                               x.numpy() * np.clip(x.numpy() + 3, 0, 6) / 6,
+                               rtol=1e-6)
+
+
+def test_mha_forward():
+    paddle.seed(3)
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 6, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_flash_attention_matches_sdpa():
+    paddle.seed(4)
+    q = paddle.randn([2, 10, 4, 8])
+    k = paddle.randn([2, 10, 4, 8])
+    v = paddle.randn([2, 10, 4, 8])
+    ref = F.scaled_dot_product_attention(q, k, v)
+    out = F.flash_attention(q, k, v, block_size=4)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+    # causal
+    ref_c = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    out_c = F.flash_attention(q, k, v, causal=True, block_size=4)
+    np.testing.assert_allclose(out_c.numpy(), ref_c.numpy(), atol=1e-5)
+
+
+def test_transformer_encoder():
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    x = paddle.randn([2, 5, 16])
+    y = enc(x)
+    assert y.shape == [2, 5, 16]
+    # distinct layers = distinct params
+    assert len(enc.parameters()) == 2 * len(enc_layer.parameters())
+
+
+def test_lstm_gru_rnn():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 6, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 6, 16]
+    assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+    out.sum().backward()
+    assert lstm._cells[0].weight_ih.grad is not None
+
+    gru = nn.GRU(8, 16, direction="bidirect")
+    out2, h2 = gru(x)
+    assert out2.shape == [4, 6, 32]
+    assert h2.shape == [2, 4, 16]
+
+
+def test_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(
+        lambda lyr, inp, out: calls.append(1))
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    w = paddle.Parameter(np.ones((2, 2), np.float32))
+    g = paddle.to_tensor(np.full((2, 2), 10.0, np.float32))
+    clip = ClipGradByGlobalNorm(1.0)
+    [(_, g2)] = clip([(w, g)])
+    np.testing.assert_allclose(
+        np.sqrt((g2.numpy() ** 2).sum()), 1.0, rtol=1e-5)
+
+
+def test_weight_norm():
+    from paddle_tpu.nn import weight_norm, remove_weight_norm
+    layer = nn.Linear(3, 4)
+    w0 = layer.weight.numpy().copy()
+    weight_norm(layer)
+    x = paddle.randn([2, 3])
+    y = layer(x)
+    np.testing.assert_allclose(y.numpy(), x.numpy() @ w0
+                               + layer.bias.numpy(), rtol=1e-5)
+    remove_weight_norm(layer)
+    np.testing.assert_allclose(layer.weight.numpy(), w0, rtol=1e-6)

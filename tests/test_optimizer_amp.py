@@ -1,0 +1,177 @@
+"""Optimizer, LR scheduler, and AMP tests (reference test_adam_op.py /
+test_imperative_optimizer.py / test_amp_* style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import (SGD, Adam, AdamW, Adagrad, Momentum,
+                                  RMSProp, Lamb)
+from paddle_tpu.optimizer import lr as lr_sched
+
+
+def _train_quadratic(opt_cls, steps=120, **kw):
+    paddle.seed(7)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor([1.0, 2.0])) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), opt
+
+
+def test_sgd_converges():
+    w, _ = _train_quadratic(SGD, learning_rate=0.1)
+    np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-3)
+
+
+def test_momentum_converges():
+    w, _ = _train_quadratic(Momentum, learning_rate=0.05, momentum=0.9)
+    np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-2)
+
+
+def test_adam_converges_and_matches_reference_step():
+    w, opt = _train_quadratic(Adam, learning_rate=0.1, steps=300)
+    np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-2)
+    # single-step numeric check against hand formula
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = Adam(learning_rate=0.1, parameters=[p])
+    (p * 3.0).sum().backward()
+    opt.step()
+    # m=0.1*3(>beta1 part)... m=(1-.9)*3=0.3, v=(1-.999)*9=0.009
+    m_hat = 0.3 / (1 - 0.9)
+    v_hat = 0.009 / (1 - 0.999)
+    expected = 1.0 - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), [expected], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+    (p * 0.0).sum().backward()  # zero grad → update only from decay
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5 * 1.0],
+                               rtol=1e-5)
+
+
+def test_rmsprop_adagrad_lamb_run():
+    for cls, kw in [(RMSProp, {"learning_rate": 0.05}),
+                    (Adagrad, {"learning_rate": 0.5}),
+                    (Lamb, {"learning_rate": 0.05})]:
+        w, _ = _train_quadratic(cls, steps=200, **kw)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=0.3)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w, opt = _train_quadratic(Adam, learning_rate=0.1, steps=5)
+    sd = opt.state_dict()
+    p2 = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt2 = Adam(learning_rate=0.1, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == opt._step_count
+
+
+def test_grad_clip_in_optimizer():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=1.0, parameters=[w],
+              grad_clip=ClipGradByGlobalNorm(0.1))
+    (w * 100.0).sum().backward()
+    opt.step()
+    # grad clipped to 0.1 → w = 1 - 0.1
+    np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-5)
+
+
+def test_lr_schedulers():
+    s = lr_sched.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    cos = lr_sched.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos.lr_at(0) - 1.0) < 1e-6
+    assert abs(cos.lr_at(10)) < 1e-6
+
+    warm = lr_sched.LinearWarmup(0.5, warmup_steps=10, start_lr=0.0,
+                                 end_lr=0.5)
+    assert warm.lr_at(5) == pytest.approx(0.25)
+    assert warm.lr_at(20) == pytest.approx(0.5)
+
+    noam = lr_sched.NoamDecay(d_model=512, warmup_steps=100)
+    assert noam.lr_at(50) < noam.lr_at(100)
+
+    plateau = lr_sched.ReduceOnPlateau(0.1, patience=1)
+    for loss in [1.0, 1.0, 1.0, 1.0]:
+        plateau.step(loss)
+    assert plateau() < 0.1
+
+
+def test_scheduler_drives_optimizer():
+    sched = lr_sched.StepDecay(0.5, step_size=1, gamma=0.1)
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=sched, parameters=[w])
+    (w * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.5], rtol=1e-6)  # lr=0.5
+    sched.step()
+    opt.clear_grad()
+    (w * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.45], rtol=1e-5)  # lr=0.05
+
+
+def test_auto_cast_white_list():
+    import jax.numpy as jnp
+    with paddle.amp.auto_cast(level="O1"):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        c = paddle.matmul(a, b)
+        assert c.dtype == jnp.bfloat16
+        # black-list op stays fp32
+        s = F.softmax(c)
+        assert s.dtype == jnp.float32
+    # outside context: fp32 matmul
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == jnp.float32
+
+
+def test_auto_cast_grads_flow():
+    w = paddle.Parameter(np.ones((4, 4), np.float32))
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast():
+        y = paddle.matmul(x, w)
+        loss = y.astype("float32").sum()
+    loss.backward()
+    assert w.grad is not None
+    assert str(w.grad.dtype) == "float32"  # grad cast back to param dtype
+
+
+def test_grad_scaler():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    loss = (w * 2.0).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    # unscaled grad = 2 → w = 1 - 0.2
+    np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)
+    assert scaler.get_loss_scaling() == 1024.0
+
+
+def test_grad_scaler_skips_on_inf():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   decr_every_n_nan_or_inf=1)
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    w._grad = np.array([np.inf], np.float32)
+    import jax.numpy as jnp
+    w._grad = jnp.asarray([jnp.inf], jnp.float32)
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+    assert scaler.get_loss_scaling() == 512.0  # scale halved
