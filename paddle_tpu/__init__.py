@@ -28,6 +28,8 @@ from . import ops as tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
 from .nn.param_attr import ParamAttr  # noqa: F401
 
 

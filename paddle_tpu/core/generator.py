@@ -63,5 +63,45 @@ def seed(s: int):
     return _default_generator
 
 
+class _KeyScope:
+    """Traced-mode key provider: inside jit-traced code, random draws must
+    derive from an explicit (traced) program key instead of host RNG state —
+    otherwise every compiled step would replay the same mask. to_static /
+    TrainStep open a key_scope around the traced body."""
+
+    def __init__(self, key: jax.Array):
+        self.key = key
+        self.counter = 0
+
+    def split(self):
+        k = jax.random.fold_in(self.key, self.counter)
+        self.counter += 1
+        return k
+
+
+_scope_stack = threading.local()
+
+
+def _scopes():
+    if not hasattr(_scope_stack, "stack"):
+        _scope_stack.stack = []
+    return _scope_stack.stack
+
+
+class key_scope:
+    def __init__(self, key: jax.Array):
+        self._scope = _KeyScope(key)
+
+    def __enter__(self):
+        _scopes().append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scopes().pop()
+
+
 def next_key() -> jax.Array:
+    stack = _scopes()
+    if stack:
+        return stack[-1].split()
     return _default_generator.split()

@@ -1,0 +1,2 @@
+from .api import (InputSpec, StaticFunction, functionalize, to_static,
+                  not_to_static, save, load, TranslatedLayer)  # noqa: F401

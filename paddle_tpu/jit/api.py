@@ -1,0 +1,293 @@
+"""paddle.jit: the compiled path.
+
+Reference: @to_static AST transpiler + ProgramTranslator + program cache
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py, function_spec.py) and jit.save/load (jit.py:507,792).
+
+TPU-first redesign: no AST rewriting. Eager ops are already pure jnp
+functions, so "translating to static graph" is just jax tracing:
+``functionalize`` runs paddle-level code (Layers, Tensors, tape disabled)
+under a trace with parameters/buffers lifted to explicit inputs and RNG
+keys threaded from a program key. ``to_static`` wraps that in a
+shape/dtype-keyed executable cache (the CacheKey/ConcreteProgram analogue;
+jax.jit owns compilation + caching). Python control flow is traced through
+(unrolled) exactly like dy2static's fallback; data-dependent control flow
+should use lax.cond/scan via paddle_tpu.ops.control_flow.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..core.generator import key_scope, next_key
+from ..framework import Tensor, no_grad
+from ..nn.layer.layers import Layer
+from ..ops.registry import run_op
+
+__all__ = ["InputSpec", "StaticFunction", "functionalize", "to_static",
+           "not_to_static", "save", "load", "TranslatedLayer"]
+
+
+class InputSpec:
+    """Shape/dtype signature (reference static/input.py:123). A None dim
+    means variable — calls are bucketed per concrete shape by the jit
+    cache (framework-level padding policy lives in paddle_tpu.io)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = _dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _unwrap_tree(obj):
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unwrap_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _unwrap_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _wrap_tree(obj):
+    if isinstance(obj, jax.Array):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_wrap_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _wrap_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def functionalize(fn: Callable, layer: Optional[Layer] = None):
+    """Lower paddle-level code to a pure array function.
+
+    Returns pure(state, key, *array_args) -> (out_tree_of_arrays, new_state)
+    where `state` is the layer's raw state dict (params + buffers). Buffer
+    mutations (BatchNorm running stats) surface in new_state. The tape is
+    disabled inside — compiled gradients come from jax.grad of this pure
+    function, not the eager tape.
+    """
+    target = layer if layer is not None else getattr(fn, "__self__", None)
+
+    def pure(state, key, *args, **kwargs):
+        own = target.state_dict() if target is not None else {}
+        saved = {k: t._data for k, t in own.items()}
+        training_saved = None
+        try:
+            for k, arr in (state or {}).items():
+                if k in own:
+                    own[k]._data = arr
+            with no_grad(), key_scope(key):
+                out = fn(*_wrap_tree(args), **_wrap_tree(kwargs))
+            new_state = {k: own[k]._data for k in own}
+            return _unwrap_tree(out), new_state
+        finally:
+            for k, a in saved.items():
+                own[k]._data = a
+    return pure
+
+
+class StaticFunction:
+    """The to_static callable (ProgramTranslator+StaticFunction analogue).
+
+    Holds a jit cache keyed by input shapes/dtypes + training flag. On call:
+    params/buffers are passed as pytree inputs (so optimizer updates don't
+    retrigger compilation), a fresh program key is threaded for RNG, buffer
+    mutations are written back, and when autograd is active the whole
+    compiled forward is taped as ONE node (partial_program run_program-op
+    analogue).
+    """
+
+    def __init__(self, function, input_spec=None, layer=None,
+                 build_strategy=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer if layer is not None else getattr(
+            function, "__self__", None)
+        self._pure = functionalize(function, self._layer)
+        self._jitted = jax.jit(self._pure, static_argnames=())
+        self._call_count = 0
+        functools.update_wrapper(self, function,
+                                 assigned=("__name__", "__doc__"))
+
+    @property
+    def concrete_program(self):
+        return self
+
+    def _state(self):
+        return self._layer.raw_state() if self._layer is not None else {}
+
+    def __call__(self, *args, **kwargs):
+        arrays = _unwrap_tree(args)
+        kw_arrays = _unwrap_tree(kwargs)
+        state = self._state()
+        key = next_key()
+        self._call_count += 1
+
+        params_requiring = []
+        if self._layer is not None:
+            from ..framework import is_grad_enabled
+            if is_grad_enabled():
+                params_requiring = [
+                    (k, t) for k, t in self._layer.state_dict().items()
+                    if not t.stop_gradient]
+
+        if not params_requiring:
+            out, new_state = self._jitted(state, key, *arrays, **kw_arrays)
+            self._write_back(new_state)
+            return _wrap_tree(out)
+
+        # autograd path: tape the whole compiled program as one op.
+        # trainable params become positional diff inputs.
+        names = [k for k, _ in params_requiring]
+        tensors = [t for _, t in params_requiring]
+        rest = {k: v for k, v in state.items() if k not in set(names)}
+        jitted = self._jitted
+        holder = {}
+
+        def program_op(*trainable_arrays):
+            full_state = dict(rest)
+            for n, a in zip(names, trainable_arrays[:len(names)]):
+                full_state[n] = a
+            in_arrays = trainable_arrays[len(names):]
+            out, new_state = jitted(full_state, key, *in_arrays, **kw_arrays)
+            holder["new_state"] = jax.tree_util.tree_map(
+                jax.lax.stop_gradient, new_state)
+            flat, tdef = jax.tree_util.tree_flatten(out)
+            holder["tdef"] = tdef
+            return tuple(flat) if len(flat) != 1 else flat[0]
+
+        tensor_args = [a for a in _flatten_args(args) if isinstance(
+            a, Tensor)]
+        res = run_op("run_program", program_op,
+                     tuple(tensors) + tuple(tensor_args), {})
+        new_state = holder.get("new_state")
+        if new_state:
+            self._write_back({k: v for k, v in new_state.items()
+                              if k not in set(names)})
+        flat = list(res) if isinstance(res, tuple) else [res]
+        return jax.tree_util.tree_unflatten(
+            holder["tdef"], flat) if "tdef" in holder else res
+
+    def _write_back(self, new_state):
+        if self._layer is None or not new_state:
+            return
+        own = self._layer.state_dict()
+        for k, arr in new_state.items():
+            if k in own and own[k]._data is not arr:
+                # only buffers mutate in forward; params are left alone
+                if own[k].stop_gradient:
+                    own[k]._data = arr
+
+
+def _flatten_args(args):
+    out = []
+    for a in args:
+        if isinstance(a, (list, tuple)):
+            out.extend(_flatten_args(a))
+        else:
+            out.append(a)
+    return out
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static decorator / wrapper."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn.forward, input_spec, layer=fn,
+                                    build_strategy=build_strategy)
+            fn.forward = static
+            return fn
+        return StaticFunction(fn, input_spec,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# save / load (reference jit.py:507 save → inference model; here: weights +
+# AOT-exportable signature. Full StableHLO export via jax.export when specs
+# are given.)
+# ---------------------------------------------------------------------------
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (reference TranslatedLayer)."""
+
+    def __init__(self, state, meta):
+        super().__init__()
+        from ..framework import Parameter
+        self._meta = meta
+        for k, v in state.items():
+            safe = k.replace(".", "__")
+            self.add_parameter(safe, Parameter(jnp.asarray(v)))
+        self._keys = list(state.keys())
+
+    def forward(self, *args):
+        raise RuntimeError(
+            "TranslatedLayer loaded weights only; rebuild the model class "
+            "and use set_state_dict, or load with a known architecture")
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save: persist state + signature (+ StableHLO when specs
+    are concrete)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    if isinstance(layer, Layer):
+        state = {k: np.asarray(v._data)
+                 for k, v in layer.state_dict().items()}
+    meta = {"class": type(layer).__name__,
+            "input_spec": [
+                {"shape": list(s.shape), "dtype": str(np.dtype(s.dtype))}
+                for s in (input_spec or [])]}
+    import pickle
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"state": state, "meta": meta}, f)
+    # AOT export: lower the forward to StableHLO text for serving parity
+    if input_spec and isinstance(layer, Layer):
+        try:
+            pure = functionalize(
+                layer.forward if not isinstance(layer.forward,
+                                                StaticFunction)
+                else layer.forward._function, layer)
+            args = [jax.ShapeDtypeStruct(
+                tuple(d if d is not None else 1 for d in s.shape), s.dtype)
+                for s in input_spec]
+            raw = {k: v._data for k, v in layer.state_dict().items()}
+            lowered = jax.jit(pure).lower(
+                raw, jax.random.key(0), *args)
+            with open(path + ".stablehlo.txt", "w") as f:
+                f.write(lowered.as_text())
+        except Exception:
+            pass  # export is best-effort; weights are the contract
+
+
+def load(path, **config):
+    import pickle
+    with open(path + ".pdiparams", "rb") as f:
+        data = pickle.load(f)
+    return TranslatedLayer(data["state"], data["meta"])

@@ -59,6 +59,16 @@ def set_amp_hook(hook):
         _amp_hook = hook
 
 
+# static-capture tracer installed by paddle_tpu.static.program_guard;
+# signature: (op_type, pure_fn, args, kwargs) -> Var(s)
+_static_tracer: Optional[Callable] = None
+
+
+def set_static_tracer(tracer):
+    global _static_tracer
+    _static_tracer = tracer
+
+
 def get_op(name: str) -> OpInfo:
     if name not in OPS:
         raise _enforce.NotFoundError(f"op '{name}' is not registered")
@@ -106,7 +116,10 @@ def _check_nan_inf(name, arrays):
 
 
 def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
-    """Execute one op eagerly, recording a tape node if grads are needed."""
+    """Execute one op eagerly, recording a tape node if grads are needed.
+    Under a program_guard, append to the captured Program instead."""
+    if _static_tracer is not None:
+        return _static_tracer(name, fn, args, kwargs)
     if _amp_hook is not None:
         args, kwargs = _amp_hook(name, args, kwargs)
 

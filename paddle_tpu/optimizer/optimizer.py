@@ -108,6 +108,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Var, append_backward
+        if isinstance(loss, Var):
+            # static mode: record the optimize stage on the program;
+            # Executor.run compiles fwd+bwd+update into one executable
+            prog = loss.program
+            pairs = append_backward(loss, parameters)
+            prog._optimize = (self, loss)
+            return None, pairs
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._param_list()]

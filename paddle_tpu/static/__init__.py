@@ -1,0 +1,20 @@
+"""paddle_tpu.static: compiled-execution facade.
+
+TrainStep (whole-step compilation) is the workhorse; the Program/Executor
+feed-fetch surface (reference python/paddle/static) is layered on top in
+program.py.
+"""
+from ..jit.api import InputSpec  # noqa: F401
+from .train_step import TrainStep  # noqa: F401
+from .program import (Program, program_guard, default_main_program,
+                      default_startup_program, data, Executor,
+                      append_backward)  # noqa: F401
+
+
+def _enable_static_mode():
+    from . import program
+    program._static_mode = True
+
+
+def nn_placeholder(*a, **k):
+    return data(*a, **k)

@@ -1,0 +1,371 @@
+"""Static Program / Executor (declarative path).
+
+Reference: ProgramDesc + Executor feed/fetch
+(/root/reference/paddle/fluid/framework/framework.proto:202,
+framework/executor.cc:289, python/paddle/fluid/executor.py:475,
+backward.py:1337 append_backward).
+
+TPU-first redesign: a Program is a captured op graph — every op routed
+through the registry while a program_guard is active appends an OpNode
+(pure fn + symbolic vars, shapes inferred with jax.eval_shape — the
+InferShape pass). Executor.run lowers the whole program (plus appended
+backward/optimizer stages) to ONE jitted function keyed by feed shapes —
+the "Program → XLA executable" pipeline replacing the reference's per-op
+interpreter loop. Parameters created inside the guard are captured
+constants whose storage the Executor updates in place after optimizer
+programs run.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..core.enforce import EnforceNotMet, NotFoundError
+from ..core.generator import key_scope, next_key
+from ..framework import Parameter, Tensor
+from ..ops import registry as _registry
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "append_backward"]
+
+_static_mode = False
+
+
+class Var(Tensor):
+    """Symbolic variable: carries aval only (no data). Lives in a Program.
+
+    Subclasses Tensor so every op / layer treats it uniformly; `_data`
+    holds a zero placeholder of the right aval for shape inference."""
+
+    def __init__(self, program, name, shape, dtype, kind="intermediate"):
+        dtype = _dtypes.convert_dtype(dtype)
+        shape = tuple(1 if s is None or s < 0 else int(s) for s in shape)
+        super().__init__(jnp.zeros(shape, dtype), stop_gradient=True)
+        self.program = program
+        self.name = name
+        self.kind = kind  # feed | param | intermediate | fetch
+        self.var_id = program._new_var_id(self)
+
+    def __repr__(self):
+        return (f"Var(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, kind={self.kind})")
+
+
+class OpNode:
+    __slots__ = ("fn", "in_ids", "const_args", "kwargs", "out_ids",
+                 "op_type", "n_outs", "multi")
+
+    def __init__(self, op_type, fn, in_ids, const_args, kwargs, out_ids,
+                 multi):
+        self.op_type = op_type
+        self.fn = fn
+        self.in_ids = in_ids          # positional slots: var_id or None
+        self.const_args = const_args  # positional slots: constants
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+        self.multi = multi
+
+
+class Program:
+    """Captured graph (ProgramDesc analogue)."""
+
+    def __init__(self):
+        self.vars: Dict[int, Var] = {}
+        self.var_names: Dict[str, int] = {}
+        self.ops: List[OpNode] = []
+        self.feeds: List[int] = []
+        self.params: Dict[int, Parameter] = {}  # var_id -> live Parameter
+        self._counter = 0
+        self._optimize = None  # (optimizer, loss_var, grad_map)
+        self.random_seed = None
+
+    def _new_var_id(self, var) -> int:
+        vid = self._counter
+        self._counter += 1
+        self.vars[vid] = var
+        if var.name:
+            self.var_names[var.name] = vid
+        return vid
+
+    def var_by_name(self, name) -> Var:
+        if name not in self.var_names:
+            raise NotFoundError(f"var '{name}' not in program")
+        return self.vars[self.var_names[name]]
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.vars = dict(self.vars)
+        p.var_names = dict(self.var_names)
+        p.ops = list(self.ops)
+        p.feeds = list(self.feeds)
+        p.params = dict(self.params)
+        p._counter = self._counter
+        return p
+
+    # -- capture ------------------------------------------------------------
+    def capture_param(self, t: Tensor) -> Var:
+        """Register a live Parameter/Tensor used by the program."""
+        for vid, p in self.params.items():
+            if p is t:
+                return self.vars[vid]
+        name = t.name or f"param_{len(self.params)}"
+        v = Var(self, name, t._data.shape, t._data.dtype, kind="param")
+        self.params[v.var_id] = t
+        return v
+
+    def add_op(self, op_type, fn, args, kwargs):
+        in_ids, const_args = [], []
+        for a in args:
+            if isinstance(a, Var) and a.program is self:
+                in_ids.append(a.var_id)
+                const_args.append(None)
+            elif isinstance(a, Tensor):
+                pv = self.capture_param(a)
+                in_ids.append(pv.var_id)
+                const_args.append(None)
+            else:
+                in_ids.append(None)
+                const_args.append(a)
+        kw = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Var) and v.program is self:
+                raise EnforceNotMet(
+                    "tensor kwargs not supported in static capture; pass "
+                    "positionally", op_type=op_type)
+            kw[k] = v._data if isinstance(v, Tensor) else v
+
+        # InferShape via eval_shape on the pure fn
+        def shaped(*xs):
+            full = [x if x is not None else c
+                    for x, c in zip(xs, const_args)]
+            res = fn(*full, **kw)
+            return tuple(res) if isinstance(res, (list, tuple)) else res
+
+        in_avals = [
+            jax.ShapeDtypeStruct(self.vars[i]._data.shape,
+                                 self.vars[i]._data.dtype)
+            if i is not None else None
+            for i in in_ids
+        ]
+        out_aval = jax.eval_shape(shaped, *in_avals)
+        multi = isinstance(out_aval, tuple)
+        outs = list(out_aval) if multi else [out_aval]
+        out_vars = [Var(self, f"tmp_{self._counter}", o.shape, o.dtype)
+                    for o in outs]
+        self.ops.append(OpNode(op_type, fn, in_ids, const_args, kw,
+                               [v.var_id for v in out_vars], multi))
+        if multi:
+            return tuple(out_vars)
+        return out_vars[0]
+
+    # -- replay -------------------------------------------------------------
+    def build_callable(self, fetch_ids: Sequence[int],
+                       grad_of: Optional[Sequence[int]] = None):
+        """pure(feed_arrays, param_arrays, key) -> (fetches, grads?)"""
+        feeds = list(self.feeds)
+        param_ids = list(self.params.keys())
+        ops = list(self.ops)
+
+        def replay(env):
+            for node in ops:
+                ins = [env[i] if i is not None else c
+                       for i, c in zip(node.in_ids, node.const_args)]
+                res = node.fn(*ins, **node.kwargs)
+                res = tuple(res) if isinstance(res, (list, tuple)) else \
+                    (res,)
+                for vid, r in zip(node.out_ids, res):
+                    env[vid] = r
+            return env
+
+        def pure(feed_arrays, param_arrays, key):
+            with key_scope(key):
+                env = {}
+                for vid, a in zip(feeds, feed_arrays):
+                    env[vid] = a
+                for vid, a in zip(param_ids, param_arrays):
+                    env[vid] = a
+                if grad_of:
+                    def loss_fn(p_arrays):
+                        e = dict(env)
+                        for vid, a in zip(param_ids, p_arrays):
+                            e[vid] = a
+                        e = replay(e)
+                        return e[grad_of[0]].astype(jnp.float32).sum(), e
+                    (loss, env), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(list(param_arrays))
+                    # expose PARAM@GRAD vars for fetching
+                    pairs = getattr(self, "_grad_pairs", None)
+                    if pairs:
+                        gmap = {pv.var_id: gv.var_id for pv, gv in pairs}
+                        for vid, g in zip(param_ids, grads):
+                            if vid in gmap:
+                                env[gmap[vid]] = g
+                    fetches = [env.get(i) for i in fetch_ids]
+                    return fetches, grads
+                env = replay(env)
+                return [env.get(i) for i in fetch_ids], None
+        return pure, param_ids
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[Tuple[Program, Program]] = []
+
+
+def default_main_program() -> Program:
+    if _guard_stack:
+        return _guard_stack[-1][0]
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    if _guard_stack:
+        return _guard_stack[-1][1]
+    return _default_startup
+
+
+def _static_tracer(op_type, fn, args, kwargs):
+    prog = default_main_program()
+    return prog.add_op(op_type, fn, args, kwargs)
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        self.main = main_program if main_program is not None else Program()
+        self.startup = startup_program if startup_program is not None \
+            else Program()
+
+    def __enter__(self):
+        _guard_stack.append((self.main, self.startup))
+        _registry.set_static_tracer(_static_tracer)
+        return self.main, self.startup
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        if not _guard_stack:
+            _registry.set_static_tracer(None)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (paddle.static.data)."""
+    prog = default_main_program()
+    v = Var(prog, name, shape, dtype, kind="feed")
+    prog.feeds.append(v.var_id)
+    return v
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Mark loss for gradient computation; returns (param, grad_var) pairs.
+
+    Grad vars are materialized at Executor.run time via jax.value_and_grad
+    over the replayed program (backward.py:1337 analogue — the grad-op
+    chain is jax's, not hand-appended)."""
+    prog = loss.program if isinstance(loss, Var) else default_main_program()
+    prog._grad_target = loss.var_id
+    pairs = []
+    for vid, p in prog.params.items():
+        gv = Var(prog, f"{prog.vars[vid].name}@GRAD", p._data.shape,
+                 p._data.dtype, kind="grad")
+        pairs.append((prog.vars[vid], gv))
+    prog._grad_pairs = pairs
+    return pairs
+
+
+class Executor:
+    """Feed/fetch runner (executor.py:475 analogue). Compiles the whole
+    program per feed-shape signature."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        prog = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not prog.ops and not prog.params:
+            return []  # empty program (startup with no ops)
+
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, Var):
+                fetch_ids.append(f.var_id)
+            elif isinstance(f, str):
+                fetch_ids.append(prog.var_by_name(f).var_id)
+            else:
+                raise NotFoundError(f"bad fetch entry {f!r}")
+
+        train = prog._optimize is not None
+        grad_target = getattr(prog, "_grad_target", None)
+        grad_ids = [grad_target] if (train or grad_target is not None) \
+            else None
+        if train:
+            grad_ids = [prog._optimize[1].var_id]
+
+        sig = (id(prog), len(prog.ops), tuple(sorted(feed)), train,
+               tuple(fetch_ids),
+               tuple((k, np.asarray(v).shape) for k, v in sorted(
+                   feed.items())))
+        entry = self._cache.get(sig)
+        if entry is None:
+            pure, param_ids = prog.build_callable(fetch_ids, grad_ids)
+            if train:
+                optimizer = prog._optimize[0]
+
+                def train_fn(feed_arrays, param_arrays, opt_state, lr, key):
+                    fetches, grads = pure(feed_arrays, param_arrays, key)
+                    params_t, opt_t = optimizer.apply_gradients_tree(
+                        list(param_arrays), list(grads), opt_state, lr=lr)
+                    return fetches, params_t, opt_t
+                jitted = jax.jit(train_fn, donate_argnums=(1, 2))
+                opt_state = [prog._optimize[0].init_state(
+                    prog.params[i]._data) for i in param_ids]
+                entry = ("train", jitted, param_ids, opt_state)
+            else:
+                jitted = jax.jit(pure)
+                entry = ("infer", jitted, param_ids, None)
+            self._cache[sig] = entry
+
+        kind, jitted, param_ids, opt_state = entry
+        feed_arrays = []
+        for vid in prog.feeds:
+            nm = prog.vars[vid].name
+            if nm not in feed:
+                raise NotFoundError(f"missing feed '{nm}'")
+            feed_arrays.append(jnp.asarray(np.asarray(feed[nm])))
+        param_arrays = [prog.params[i]._data for i in param_ids]
+        key = next_key()
+        if kind == "train":
+            optimizer = prog._optimize[0]
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            fetches, new_params, new_opt = jitted(
+                feed_arrays, param_arrays, opt_state, lr, key)
+            for vid, arr in zip(param_ids, new_params):
+                prog.params[vid]._data = arr
+            self._cache[sig] = (kind, jitted, param_ids, new_opt)
+        else:
+            fetches, _ = jitted(feed_arrays, param_arrays, key)
+        if return_numpy:
+            return [np.asarray(f) if f is not None else None
+                    for f in fetches]
+        return [Tensor(f) if f is not None else None for f in fetches]
+
+    def close(self):
+        self._cache.clear()
