@@ -1,0 +1,273 @@
+"""Collective communication API (reference collective.py:101-457 +
+operators/collective/c_*.cc parity).
+
+TPU-native: each collective is a registered op lowering to an XLA
+collective (psum/all_gather/ppermute/all_to_all) on a named mesh axis.
+"Rings" (the reference's ring_id/NCCLCommContext) become mesh axes; a
+Group names an axis. Inside shard_map/pjit traces the ops emit ICI
+collectives; in plain single-replica eager mode they are the correct
+world-size-1 identities, so the same model file runs anywhere (the
+reference cannot do this — its collective ops require initialized NCCL).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import Tensor, _unwrap
+from ..ops.registry import run_op
+from .env import axis_context, current_axes, current_axis_name
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+    "all_gather", "broadcast", "reduce", "scatter", "reduce_scatter",
+    "all_to_all", "alltoall", "barrier", "send", "recv", "wait",
+    "split_group_axis",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Names a mesh axis (the ring_id analogue)."""
+
+    def __init__(self, axis: str, ranks=None, gid=0):
+        self.axis = axis
+        self.ranks = ranks
+        self.id = gid
+
+    @property
+    def nranks(self):
+        axes = _live_axis_sizes()
+        return axes.get(self.axis, 1)
+
+    def __repr__(self):
+        return f"Group(axis={self.axis})"
+
+
+_groups = {}
+
+
+def new_group(ranks=None, backend=None, axis: str = None) -> Group:
+    axis = axis or "dp"
+    g = Group(axis, ranks, gid=len(_groups))
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def _live_axis_sizes():
+    """Sizes of axes live in the current trace (inside shard_map)."""
+    sizes = {}
+    for ax in current_axes():
+        try:
+            sizes[ax] = lax.axis_size(ax)
+        except NameError:
+            pass
+    return sizes
+
+
+def _axis_for(group) -> Optional[str]:
+    if isinstance(group, Group):
+        axis = group.axis
+    elif isinstance(group, str):
+        axis = group
+    else:
+        axis = current_axis_name()
+    if axis is None:
+        return None
+    try:
+        lax.axis_size(axis)  # raises NameError when axis not in scope
+        return axis
+    except NameError:
+        return None
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_allreduce_{sum,max,min,prod} (c_allreduce_op.h:111) → lax.p*."""
+    axis = _axis_for(group)
+    if axis is None:
+        return tensor  # world size 1
+
+    def impl(x):
+        if op == ReduceOp.SUM:
+            return lax.psum(x, axis)
+        if op == ReduceOp.MAX:
+            return lax.pmax(x, axis)
+        if op == ReduceOp.MIN:
+            return lax.pmin(x, axis)
+        if op == ReduceOp.AVG:
+            return lax.pmean(x, axis)
+        if op == ReduceOp.PROD:
+            return jnp.exp(lax.psum(jnp.log(x), axis))
+        raise ValueError(op)
+    out = run_op("c_allreduce_" + op, impl, (tensor,), {})
+    if isinstance(tensor, Tensor) and not isinstance(tensor, type(None)):
+        # paddle mutates in place; mirror that surface
+        tensor._data = out._data
+        tensor._node = out._node
+        tensor._out_idx = out._out_idx
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """c_allgather → lax.all_gather. Two call forms:
+    paddle style all_gather(list, tensor) appends per-rank tensors into
+    `tensor_list`; functional style all_gather(x) returns stacked array."""
+    if tensor is None:
+        x = tensor_list
+        ax = _axis_for(group)
+        if ax is None:
+            return x
+        return run_op("c_allgather",
+                      lambda a: lax.all_gather(a, ax, axis=0, tiled=False),
+                      (x,), {})
+    ax = _axis_for(group)
+    if ax is None:
+        tensor_list.append(tensor)
+        return tensor_list
+    gathered = run_op("c_allgather",
+                      lambda a: lax.all_gather(a, ax, axis=0, tiled=False),
+                      (tensor,), {})
+    n = gathered.shape[0]
+    for i in range(n):
+        tensor_list.append(gathered[i])
+    return tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """c_broadcast: every replica takes src's value."""
+    axis = _axis_for(group)
+    if axis is None:
+        return tensor
+
+    def impl(x):
+        full = lax.all_gather(x, axis, axis=0, tiled=False)
+        return full[src]
+    out = run_op("c_broadcast", impl, (tensor,), {})
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data
+        tensor._node = out._node
+        tensor._out_idx = out._out_idx
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_reduce_*: reduced value lands on dst, others keep theirs
+    (SPMD form: select by rank)."""
+    axis = _axis_for(group)
+    if axis is None:
+        return tensor
+
+    def impl(x):
+        red = lax.psum(x, axis) if op == ReduceOp.SUM else (
+            lax.pmax(x, axis) if op == ReduceOp.MAX else
+            lax.pmin(x, axis))
+        idx = lax.axis_index(axis)
+        return jnp.where(idx == dst, red, x)
+    out = run_op("c_reduce_" + op, impl, (tensor,), {})
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data
+        return tensor
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """c_scatter: src's i-th chunk goes to rank i."""
+    axis = _axis_for(group)
+    if axis is None:
+        return tensor
+
+    def impl(x):
+        # x assumed identical on src; take my chunk
+        idx = lax.axis_index(axis)
+        n = lax.axis_size(axis)
+        chunk = x.shape[0] // n
+        return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+    return run_op("c_scatter", impl, (tensor,), {})
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_reducescatter → lax.psum_scatter."""
+    axis = _axis_for(group)
+    if axis is None:
+        return tensor
+    return run_op("c_reducescatter",
+                  lambda x: lax.psum_scatter(x, axis, scatter_dimension=0,
+                                             tiled=True),
+                  (tensor,), {})
+
+
+def all_to_all(out_tensor_or_in, in_tensor=None, group=None, sync_op=True,
+               split_axis=0, concat_axis=0):
+    """alltoall → lax.all_to_all (the Ulysses primitive)."""
+    x = in_tensor if in_tensor is not None else out_tensor_or_in
+    axis = _axis_for(group)
+    if axis is None:
+        return x
+    return run_op(
+        "c_alltoall",
+        lambda a: lax.all_to_all(a, axis, split_axis=split_axis,
+                                 concat_axis=concat_axis, tiled=True),
+        (x,), {})
+
+
+alltoall = all_to_all
+
+
+def barrier(group=None):
+    """barrier op: a psum of a scalar forces synchronization."""
+    axis = _axis_for(group)
+    if axis is None:
+        return
+    run_op("barrier", lambda x: lax.psum(x, axis),
+           (jnp.zeros((), jnp.int32),), {})
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """send_v2/recv_v2 are fused on TPU: p2p = ppermute. send() stages the
+    value; the matching recv() on the destination issues the ppermute.
+    SPMD model: use p2p_shift below for ring patterns instead."""
+    raise NotImplementedError(
+        "raw send/recv is not SPMD-expressible; use "
+        "paddle_tpu.distributed.p2p_shift (ppermute) — pipeline/ring "
+        "schedules are built on it")
+
+
+recv = send
+
+
+def p2p_shift(x, shift=1, group=None):
+    """Ring shift by `shift` positions over the group axis (ppermute) —
+    the TPU-native send_v2/recv_v2 pair for ring/pipeline schedules."""
+    axis = _axis_for(group)
+    if axis is None:
+        return x
+
+    def impl(a):
+        n = lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(a, axis, perm)
+    return run_op("p2p_shift", impl, (x,), {})
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return tensor  # XLA owns stream ordering (c_sync_*_stream analogue)
+
+
+def split_group_axis(axis: str):
+    return axis_context(axis)

@@ -1,0 +1,9 @@
+from .base import (DistributedStrategy, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+                   fleet, init, is_first_worker, worker_index, worker_num,
+                   distributed_optimizer, distributed_model,
+                   DistributedOptimizer)  # noqa: F401
+from .. import recompute as _recompute_mod  # noqa: F401
+
+
+class utils:  # namespace shim: fleet.utils.recompute
+    recompute = staticmethod(_recompute_mod.recompute)

@@ -1,0 +1,289 @@
+"""Fleet facade: init / strategy / distributed_optimizer / distributed_model.
+
+Reference: fleet_base.py:63 (Fleet), base/distributed_strategy.py (1493-line
+proto mirror), base/strategy_compiler.py:171 (meta-optimizer chain), 17
+meta_optimizers/*.py.
+
+TPU-native strategy compilation: instead of rewriting ProgramDescs, the
+chosen strategies compose into (mesh shape, ShardingPlan, TrainStep
+options). The mapping from the reference's meta-optimizer list:
+
+  amp_optimizer            -> TrainStep(amp_level=...)
+  recompute_optimizer      -> paddle_tpu.distributed.recompute on segments
+  sharding_optimizer       -> ShardingPlan(zero_stage=...)
+  pipeline_optimizer       -> 'pp' mesh axis + gpipe_schedule
+  tensor_parallel          -> 'tp' mesh axis + parallel layer specs
+  gradient_merge           -> TrainStep(grad_accum_steps=...)
+  graph_execution (DP)     -> 'dp' mesh axis + batch sharding
+  localsgd/dgc/lars/lamb   -> optimizer choice / wrapper
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...framework import Tensor
+from ...optimizer.optimizer import Optimizer
+from ..env import (DATA_AXIS, PIPE_AXIS, SEQUENCE_AXIS, TENSOR_AXIS,
+                   build_mesh, get_rank, get_world_size, set_mesh)
+from ..sharding import ShardingPlan
+
+__all__ = ["DistributedStrategy", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "fleet", "init", "worker_num",
+           "worker_index", "is_first_worker", "distributed_optimizer",
+           "distributed_model", "DistributedOptimizer"]
+
+
+class DistributedStrategy:
+    """Mirror of distributed_strategy.proto (python surface parity)."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0,
+                            "use_pure_fp16": False,
+                            "custom_white_list": [],
+                            "custom_black_list": []}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "fuse_broadcast_MB": 32.0,
+                                 "hybrid_dp": False}
+        self.pipeline = False
+        self.pipeline_configs = {"micro_batch_size": 1,
+                                 "accumulate_steps": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.localsgd = False
+        self.dgc = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.nccl_comm_num = 1  # parity no-op (no NCCL here)
+        self.fuse_all_reduce_ops = True  # XLA always fuses; parity flag
+        self.execution_strategy = {}
+        self.build_strategy = {}
+
+    def mesh_shape(self, n_devices: int) -> Dict[str, int]:
+        """Derive the named mesh from hybrid/strategy degrees."""
+        h = self.hybrid_configs
+        mp = max(int(h.get("mp_degree", 1)), 1)
+        if self.tensor_parallel:
+            mp = max(mp, int(self.tensor_parallel_configs.get(
+                "tensor_parallel_degree", 1)))
+        pp = max(int(h.get("pp_degree", 1)), 1) if (
+            self.pipeline or h.get("pp_degree", 1) > 1) else 1
+        sp = max(int(h.get("sep_degree", 1)), 1)
+        dp = h.get("dp_degree", -1)
+        if dp in (-1, 0, None):
+            dp = max(n_devices // (mp * pp * sp), 1)
+        shape = {}
+        if dp > 1 or (mp == pp == sp == 1):
+            shape[DATA_AXIS] = dp
+        if mp > 1:
+            shape[TENSOR_AXIS] = mp
+        if pp > 1:
+            shape[PIPE_AXIS] = pp
+        if sp > 1:
+            shape[SEQUENCE_AXIS] = sp
+        return shape
+
+    def __repr__(self):
+        on = [k for k in ("amp", "recompute", "sharding", "pipeline",
+                          "tensor_parallel", "gradient_merge", "lamb",
+                          "lars", "localsgd", "dgc") if getattr(self, k)]
+        return f"DistributedStrategy(enabled={on})"
+
+
+class RoleMakerBase:
+    def worker_num(self):
+        return get_world_size()
+
+    def worker_index(self):
+        return get_rank()
+
+    def is_worker(self):
+        return True
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-driven role maker (reference base/role_maker.py)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, worker_num=1, **kwargs):
+        self._id = current_id
+        self._num = worker_num
+
+    def worker_index(self):
+        return self._id
+
+    def worker_num(self):
+        return self._num
+
+
+class DistributedOptimizer:
+    """Wrapped user optimizer carrying the strategy; the strategy-compiler
+    output. Eager surface: step/minimize work as usual (grads are already
+    globally correct under SPMD). Compiled surface: build_train_step."""
+
+    def __init__(self, optimizer: Optimizer, strategy: DistributedStrategy):
+        self.inner_opt = optimizer
+        self.user_defined_strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+    def step(self):
+        return self.inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        return self.inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program, parameters,
+                                       no_grad_set)
+
+    def build_train_step(self, layer, loss_fn):
+        """Compile the strategy into a sharded TrainStep (the minimize()
+        of the compiled world)."""
+        return fleet.build_train_step(layer, loss_fn, self.inner_opt,
+                                      self.user_defined_strategy)
+
+
+class Fleet:
+    """Singleton facade (reference fleet_base.py:63)."""
+
+    def __init__(self):
+        self._role_maker = None
+        self.strategy: Optional[DistributedStrategy] = None
+        self._is_collective = True
+        self.mesh = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._is_collective = is_collective or isinstance(
+            role_maker, PaddleCloudRoleMaker)
+        self.strategy = strategy or DistributedStrategy()
+        import jax
+        shape = self.strategy.mesh_shape(len(jax.devices()))
+        self.mesh = build_mesh(shape)
+        set_mesh(self.mesh)
+        self._initialized = True
+        return self
+
+    # -- role info ----------------------------------------------------------
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # -- model/optimizer wrapping -------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self.strategy = strategy
+        return DistributedOptimizer(optimizer,
+                                    self.strategy or DistributedStrategy())
+
+    def distributed_model(self, model):
+        from ..parallel import DataParallel
+        return DataParallel(model)
+
+    def build_sharding_plan(self, strategy=None) -> ShardingPlan:
+        strategy = strategy or self.strategy or DistributedStrategy()
+        zero = 0
+        if strategy.sharding:
+            zero = int(strategy.sharding_configs.get("stage", 1))
+        return ShardingPlan(self.mesh, zero_stage=zero)
+
+    def build_train_step(self, layer, loss_fn, optimizer, strategy=None):
+        """The strategy compiler (strategy_compiler.py:171 analogue):
+        compose strategy flags into one sharded compiled TrainStep."""
+        from ...static.train_step import TrainStep
+        strategy = strategy or self.strategy or DistributedStrategy()
+        if not self._initialized:
+            self.init()
+        plan = self.build_sharding_plan(strategy)
+        amp_level = None
+        if strategy.amp:
+            amp_level = "O2" if strategy.amp_configs.get("use_pure_fp16") \
+                else "O1"
+        accum = 1
+        if strategy.gradient_merge:
+            accum = int(strategy.gradient_merge_configs.get("k_steps", 1))
+        if strategy.pipeline:
+            accum = max(accum, int(strategy.pipeline_configs.get(
+                "accumulate_steps", 1)))
+        inner = optimizer.inner_opt if isinstance(
+            optimizer, DistributedOptimizer) else optimizer
+        if strategy.lamb:
+            from ...optimizer import Lamb
+            inner = Lamb(learning_rate=inner.get_lr(),
+                         parameters=inner._parameters)
+        return TrainStep(layer, loss_fn, inner, amp_level=amp_level,
+                         mesh=self.mesh, sharding_plan=plan,
+                         grad_accum_steps=accum)
+
+    def state_dict(self):
+        return {}
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()
+
+
+# module-level conveniences mirroring paddle.distributed.fleet.*
+def init(role_maker=None, is_collective=False, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)

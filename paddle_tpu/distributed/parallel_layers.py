@@ -1,0 +1,195 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: paddle.distributed.split (/root/reference/python/paddle/
+distributed/collective.py:566-713 — _parallel_linear/_parallel_embedding
+with manual c_allreduce/c_concat) and the fleet mp helpers.
+
+TPU-native: parameters carry PartitionSpecs over the 'tp' mesh axis and the
+forward stays a plain matmul — the XLA SPMD partitioner inserts the
+all-reduce/all-gather on ICI exactly where the reference hand-writes NCCL
+ops. Under an explicit shard_map (axis_context('tp')) the layers switch to
+manual psum form, matching the reference's semantics op-for-op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework import Tensor
+from ..nn import functional as F
+from ..nn.initializer import XavierNormal
+from ..nn.layer.common import Linear, Embedding
+from ..nn.layer.layers import Layer
+from ..ops.registry import run_op
+from .env import current_axis_name, TENSOR_AXIS
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "split"]
+
+
+def _tp_axis():
+    return current_axis_name(TENSOR_AXIS)
+
+
+class ColumnParallelLinear(Layer):
+    """Output-dim-sharded linear (reference 'linear' with axis=1,
+    num_partitions → _parallel_linear col path)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.inner = Linear(in_features, out_features, weight_attr,
+                            bias_attr=None if has_bias else False)
+        # annotate: weight [in, out] sharded on out; bias sharded on out
+        self.inner.weight.sharding_spec = P(None, TENSOR_AXIS)
+        if self.inner.bias is not None:
+            self.inner.bias.sharding_spec = P(TENSOR_AXIS)
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    @property
+    def bias(self):
+        return self.inner.bias
+
+    def forward(self, x):
+        axis = _tp_axis()
+        if axis is None:
+            # pjit/spec mode (or single device): plain matmul; constrain
+            # activation sharding so the partitioner splits the out dim
+            out = self.inner(x)
+            from .env import get_mesh
+            mesh = get_mesh()
+            if mesh is not None and TENSOR_AXIS in mesh.axis_names:
+                nd = len(out.shape)
+                spec = P(*([None] * (nd - 1) + [TENSOR_AXIS]))
+                out = run_op(
+                    "sharding_constraint",
+                    lambda a: lax.with_sharding_constraint(
+                        a, jax.sharding.NamedSharding(mesh, spec)),
+                    (out,), {})
+                if self.gather_output:
+                    rep = P(*([None] * nd))
+                    out = run_op(
+                        "sharding_constraint",
+                        lambda a: lax.with_sharding_constraint(
+                            a, jax.sharding.NamedSharding(mesh, rep)),
+                        (out,), {})
+            return out
+        # shard_map mode: weight is already the local shard
+        out = self.inner(x)
+        if self.gather_output:
+            from .collective import all_gather
+            gathered = all_gather(out, group=axis)
+            out = run_op("concat_last",
+                         lambda g: jnp.concatenate(
+                             [g[i] for i in range(g.shape[0])], axis=-1),
+                         (gathered,), {})
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Input-dim-sharded linear (reference axis=0 row path: out =
+    allreduce(x_local @ w_local))."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.inner = Linear(in_features, out_features, weight_attr,
+                            bias_attr=None if has_bias else False)
+        self.inner.weight.sharding_spec = P(TENSOR_AXIS, None)
+        if self.inner.bias is not None:
+            self.inner.bias.sharding_spec = P()
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    @property
+    def bias(self):
+        return self.inner.bias
+
+    def forward(self, x):
+        axis = _tp_axis()
+        if axis is None:
+            out = self.inner(x)
+            from .env import get_mesh
+            mesh = get_mesh()
+            if mesh is not None and TENSOR_AXIS in mesh.axis_names:
+                nd = len(out.shape)
+                rep = P(*([None] * nd))
+                out = run_op(
+                    "sharding_constraint",
+                    lambda a: lax.with_sharding_constraint(
+                        a, jax.sharding.NamedSharding(mesh, rep)),
+                    (out,), {})
+            return out
+        # shard_map mode: local partial matmul then psum
+        w, b = self.inner.weight, self.inner.bias
+        partial = run_op("row_parallel_matmul",
+                         lambda a, wt: jnp.matmul(a, wt), (x, w), {})
+        from .collective import all_reduce
+        out = all_reduce(partial, group=axis)
+        if b is not None:
+            out = out + b
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-sharded embedding (reference _parallel_embedding: pad + shard
+    vocab, mask out-of-shard ids, allreduce partial lookups)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.inner = Embedding(num_embeddings, embedding_dim, weight_attr
+                               =weight_attr)
+        self.inner.weight.sharding_spec = P(TENSOR_AXIS, None)
+
+    def forward(self, x):
+        axis = _tp_axis()
+        if axis is None:
+            return self.inner(x)
+        # shard_map mode: local vocab shard lookup with masking + psum
+        w = self.inner.weight
+
+        def impl(ids, wt):
+            n = lax.axis_size(axis)
+            idx = lax.axis_index(axis)
+            per = self.num_embeddings // n
+            local = ids - idx * per
+            in_range = (local >= 0) & (local < per)
+            safe = jnp.where(in_range, local, 0)
+            emb = jnp.take(wt, safe, axis=0)
+            emb = jnp.where(in_range[..., None], emb, 0.0)
+            return lax.psum(emb, axis)
+        return run_op("vocab_parallel_embedding", impl, (x, w), {})
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split (collective.py:566) — constructs the
+    parallel layer and applies it."""
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        vocab, dim = size
+        layer = VocabParallelEmbedding(vocab, dim, weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown operation '{operation}'")

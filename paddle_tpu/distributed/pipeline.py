@@ -1,0 +1,138 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Reference: program split by device_guard + PipelineTrainer/SectionWorker
+microbatch loop with send_v2/recv_v2 NCCL p2p
+(/root/reference/paddle/fluid/framework/section_worker.cc:34 — F-then-B
+schedule; fluid/optimizer.py:3718 PipelineOptimizer program surgery).
+
+TPU-native: stages are structurally identical blocks whose parameters are
+STACKED along a leading axis sharded over 'pp' (each chip holds its
+stage's weights); the GPipe schedule is a lax.scan whose carry rotates
+activations around the ring with ppermute. The whole pipeline —
+all stages, all microbatches, forward AND backward (via jax AD of the
+scan; ppermute transposes to the reverse shift) — is ONE compiled XLA
+program; no host orchestration per microbatch like SectionWorker.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.registry import run_op
+from .env import PIPE_AXIS, current_axis_name
+
+__all__ = ["PipelineLayer", "gpipe_schedule", "LayerDesc"]
+
+
+class LayerDesc:
+    """Deferred layer construction (fleet.meta_parallel.LayerDesc parity)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+def gpipe_schedule(block_fn: Callable, stage_params, x, num_micro: int,
+                   axis: str = PIPE_AXIS, broadcast_result: bool = True):
+    """Run the GPipe F-then-B schedule inside shard_map over `axis`.
+
+    block_fn(params, x) -> x : one stage's computation (same structure on
+    every stage; params differ per stage — the local shard of the stacked
+    stage parameters).
+    x: [num_micro, micro_batch, ...] — microbatched inputs, materialized on
+    every stage (only stage 0's values matter; later stages overwrite with
+    received activations).
+
+    Returns [num_micro, micro_batch, ...] outputs valid on the LAST stage.
+    The schedule runs T = num_micro + n_stages - 1 ticks; at each tick a
+    stage computes one microbatch (if one has arrived) then passes the
+    activation to the next stage via ppermute — send_v2/recv_v2 made
+    compiler-visible.
+    """
+    n = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    total = num_micro + n - 1
+
+    def tick(carry, t):
+        outputs, in_flight = carry
+        # which microbatch does this stage work on at tick t?
+        mb = t - stage
+        active = (mb >= 0) & (mb < num_micro)
+        # stage 0 reads from x; others read the activation that just
+        # arrived on the ring
+        mb_idx = jnp.clip(mb, 0, num_micro - 1)
+        my_input = jnp.where(stage == 0,
+                             jax.lax.dynamic_index_in_dim(
+                                 x, mb_idx, axis=0, keepdims=False),
+                             in_flight)
+        y = block_fn(stage_params, my_input)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage records its result; others forward it
+        outputs = jnp.where(
+            (stage == n - 1) & active,
+            jax.lax.dynamic_update_index_in_dim(
+                outputs, y, mb_idx, axis=0),
+            outputs)
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        in_flight = lax.ppermute(y, axis, perm)
+        return (outputs, in_flight), None
+
+    y0 = jnp.zeros_like(block_fn(stage_params, x[0]))
+    outputs0 = jnp.zeros((num_micro,) + y0.shape, y0.dtype)
+    (outputs, _), _ = lax.scan(tick, (outputs0, y0),
+                               jnp.arange(total))
+    if broadcast_result:
+        # only the last stage wrote non-zeros; psum = broadcast to all
+        # stages so replicated out_specs read the real result
+        outputs = lax.psum(outputs, axis)
+    return outputs
+
+
+class PipelineLayer(Layer):
+    """fleet.meta_parallel.PipelineLayer parity: takes a list of layer
+    descs, assigns contiguous segments to pp stages.
+
+    TPU execution model: seg_fn consumption happens through
+    paddle_tpu.distributed.fleet.distributed_model / TrainStep with a mesh
+    carrying a 'pp' axis; single-device fallback just runs all layers
+    sequentially (so the same model file works everywhere).
+    """
+
+    def __init__(self, layers: Sequence, num_stages: int = 1,
+                 loss_fn=None, topology=None, seg_method="uniform",
+                 name=None):
+        super().__init__()
+        built = [d.build() if isinstance(d, LayerDesc) else d
+                 for d in layers]
+        from ..nn.layer.container import LayerList
+        self.funcs = LayerList(built)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        # uniform contiguous segmentation (reference seg_method parity)
+        n = len(built)
+        per = (n + num_stages - 1) // num_stages
+        self.stage_bounds = [(i * per, min((i + 1) * per, n))
+                             for i in range(num_stages)]
+
+    def stage_layers(self, stage: int) -> List[Layer]:
+        lo, hi = self.stage_bounds[stage]
+        return list(self.funcs)[lo:hi]
+
+    def forward(self, x):
+        axis = current_axis_name(PIPE_AXIS)
+        if axis is None:
+            for layer in self.funcs:
+                x = layer(x)
+            return x
+        raise RuntimeError(
+            "inside shard_map, drive PipelineLayer via gpipe_schedule "
+            "with stacked stage params (see distributed.fleet)")

@@ -1,0 +1,62 @@
+"""Activation recomputation (reference backward.py:725
+_append_backward_ops_with_checkpoints_ + recompute_optimizer.py).
+
+TPU-native: jax.checkpoint (remat) — the compiler re-emits the forward
+segment in the backward pass, trading FLOPs for HBM. Works in eager mode
+(tape node wraps the remat'd function) and compiled mode alike.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..framework import Tensor
+from ..ops.registry import run_op
+
+__all__ = ["recompute", "recompute_sequential", "RecomputeFunction"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity."""
+    from ..jit.api import _unwrap_tree, _wrap_tree
+    from ..framework import no_grad
+    from ..core.generator import key_scope, next_key
+
+    key = next_key()
+
+    def pure(*arrays):
+        with no_grad(), key_scope(key):
+            out = function(*_wrap_tree(arrays), **kwargs)
+        return _unwrap_tree(out)
+
+    remat = jax.checkpoint(pure)
+    return run_op("recompute", remat, tuple(args), {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segment-wise recompute over a Sequential (paddle incubate parity)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = (n + segments - 1) // segments
+    out = args[0] if len(args) == 1 else args
+
+    for i in range(0, n, per):
+        seg = layers[i:i + per]
+
+        def seg_fn(x, _seg=seg):
+            for l in _seg:
+                x = l(x)
+            return x
+        out = recompute(seg_fn, out)
+    return out
+
+
+class RecomputeFunction:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, *args, **kwargs):
+        return recompute(self.fn, *args, **kwargs)

@@ -1,0 +1,147 @@
+"""Long-context parallelism: ring attention, Ulysses, context parallel.
+
+ABSENT in the reference (SURVEY.md §2.5 last row — no ring attention, no
+sequence parallelism exists there); first-class here because long-context
+is a core TPU workload. Built on the same online-softmax blockwise math as
+nn.functional.flash_attention:
+
+- ring_flash_attention: KV shards rotate around the 'sp' mesh-axis ring via
+  ppermute inside a scan; each step consumes one remote KV block while the
+  next is in flight on ICI (compute/comm overlap is XLA's job once the
+  dependence structure is a ring). O(seq/P) memory per chip.
+- ulysses_attention: all-to-all reshard [b, s/P, h, d] -> [b, s, h/P, d],
+  run full attention per head group, reshard back (DeepSpeed-Ulysses).
+- Differentiable by construction (scan + ppermute transpose cleanly under
+  jax AD) — no hand-written backward.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import Tensor
+from ..ops.registry import run_op
+from .env import SEQUENCE_AXIS, current_axis_name
+
+__all__ = ["ring_flash_attention", "ulysses_attention",
+           "RingAttention"]
+
+
+def _ring_attn_impl(q, k, v, axis, causal, scale):
+    """q,k,v local shards [b, n, s_local, d]; seq dim sharded over `axis`.
+
+    Online-softmax accumulation over ring steps; causal masking uses global
+    positions derived from the ring rank of the KV block's owner.
+    """
+    n_dev = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, h, s_loc, d = q.shape
+    q32 = q.astype(jnp.float32) * scale
+    pos_q = my * s_loc + jnp.arange(s_loc)
+
+    def step(carry, i):
+        acc, m, l, kv_k, kv_v = carry
+        # KV block currently held arrived from rank (my - i) mod n
+        src = (my - i) % n_dev
+        pos_k = src * s_loc + jnp.arange(s_loc)
+        logits = jnp.einsum("bnqh,bnkh->bnqk", q32,
+                            kv_k.astype(jnp.float32))
+        if causal:
+            mask = pos_q[:, None] >= pos_k[None, :]
+            logits = jnp.where(mask, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(logits),
+                      jnp.exp(logits - m_safe[..., None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bnqk,bnkh->bnqh", p, kv_v.astype(jnp.float32))
+        # rotate KV around the ring (send to next rank)
+        perm = [(r, (r + 1) % n_dev) for r in range(n_dev)]
+        kv_k = lax.ppermute(kv_k, axis, perm)
+        kv_v = lax.ppermute(kv_v, axis, perm)
+        return (acc_new, m_new, l_new, kv_k, kv_v), None
+
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    (acc, m, l, _, _), _ = lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n_dev))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_flash_attention(query, key, value, causal=False, group=None,
+                         name=None):
+    """Context-parallel attention. Layout [batch, seq_local, heads, dim];
+    the sequence dim is the local shard of a global sequence distributed
+    over the 'sp' mesh axis. Must run inside shard_map over that axis
+    (paddle_tpu.distributed.sp_shard_map sets this up)."""
+    axis = group if isinstance(group, str) else (
+        group.axis if group is not None else
+        current_axis_name(SEQUENCE_AXIS))
+    if axis is None:
+        from ..nn.functional.attention import flash_attention
+        return flash_attention(query, key, value, causal=causal)
+
+    def impl(q, k, v):
+        qh = jnp.einsum("bsnh->bnsh", q)
+        kh = jnp.einsum("bsnh->bnsh", k)
+        vh = jnp.einsum("bsnh->bnsh", v)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        out = _ring_attn_impl(qh, kh, vh, axis, causal, scale)
+        return jnp.einsum("bnsh->bsnh", out)
+    return run_op("ring_flash_attention", impl, (query, key, value), {})
+
+
+def ulysses_attention(query, key, value, causal=False, group=None,
+                      name=None):
+    """DeepSpeed-Ulysses sequence parallelism: all-to-all so each rank
+    holds ALL tokens for s_heads/P heads, local full attention, then
+    all-to-all back to sequence shards."""
+    axis = group if isinstance(group, str) else (
+        group.axis if group is not None else
+        current_axis_name(SEQUENCE_AXIS))
+    if axis is None:
+        from ..nn.functional.attention import flash_attention
+        return flash_attention(query, key, value, causal=causal)
+
+    def impl(q, k, v):
+        # [b, s/P, n, d] -> all_to_all over heads -> [b, s, n/P, d]
+        def reshard_fwd(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def reshard_bwd(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+        qg, kg, vg = reshard_fwd(q), reshard_fwd(k), reshard_fwd(v)
+        from ..nn.functional.attention import _flash_fwd
+        qh = jnp.einsum("bsnh->bnsh", qg)
+        kh = jnp.einsum("bsnh->bnsh", kg)
+        vh = jnp.einsum("bsnh->bnsh", vg)
+        scale = 1.0 / math.sqrt(qh.shape[-1])
+        blk = min(512, kh.shape[2])
+        out = _flash_fwd(qh, kh, vh, causal, scale, blk)
+        out = jnp.einsum("bnsh->bsnh", out)
+        return reshard_bwd(out)
+    return run_op("ulysses_attention", impl, (query, key, value), {})
+
+
+class RingAttention:
+    """Strategy handle selecting ring vs ulysses (config object parity)."""
+
+    def __init__(self, mode="ring", group=None):
+        assert mode in ("ring", "ulysses")
+        self.mode = mode
+        self.group = group
+
+    def __call__(self, q, k, v, causal=False):
+        if self.mode == "ring":
+            return ring_flash_attention(q, k, v, causal, self.group)
+        return ulysses_attention(q, k, v, causal, self.group)

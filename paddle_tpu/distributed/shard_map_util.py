@@ -1,0 +1,65 @@
+"""shard_map bridge: run paddle-level code SPMD over mesh axes.
+
+The explicit-collectives face of the framework (the reference's world is
+always this mode — every rank runs the program with NCCL calls inside).
+`shard_parallel` wraps a paddle function in jax shard_map with an
+axis_context so collective ops / parallel layers / ring attention find
+their axes.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..framework import Tensor, no_grad
+from ..core.generator import key_scope
+from .env import axis_context, ensure_mesh
+
+__all__ = ["shard_parallel", "sp_shard_map"]
+
+
+def shard_parallel(fn, mesh: Optional[Mesh] = None, in_specs=None,
+                   out_specs=None, axes: Sequence[str] = None,
+                   check_vma=False):
+    """Wrap `fn(paddle tensors) -> paddle tensors` for SPMD execution.
+
+    in_specs/out_specs are PartitionSpecs (pytrees matching args/outputs).
+    Inside, collective ops resolve axis names; the body sees local shards.
+    """
+    mesh = mesh or ensure_mesh()
+    axes = tuple(axes) if axes is not None else tuple(mesh.axis_names)
+
+    def array_fn(*arrays):
+        with axis_context(*axes), no_grad():
+            out = fn(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    smapped = shard_map(array_fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=check_vma)
+
+    def wrapper(*args):
+        arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = smapped(*arrays)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+    wrapper.__wrapped_smap__ = smapped
+    return wrapper
+
+
+def sp_shard_map(fn, mesh=None, seq_dim=1):
+    """Convenience: shard q/k/v over the 'sp' axis on seq_dim and run a
+    context-parallel attention body."""
+    mesh = mesh or ensure_mesh()
+    spec = P(*(None if i != seq_dim else "sp" for i in range(4)))
+    return shard_parallel(fn, mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, axes=("sp",))

@@ -271,7 +271,7 @@ class Tensor:
 
     __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_out_idx",
                  "name", "persistable", "_retain_grad", "_grad_hooks",
-                 "__weakref__")
+                 "sharding_spec", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -294,6 +294,7 @@ class Tensor:
         self.persistable = False
         self._retain_grad = False
         self._grad_hooks: List[Any] = []
+        self.sharding_spec = None  # PartitionSpec annotation (distributed)
 
     # -- basic properties ---------------------------------------------------
     @property

@@ -1,0 +1,7 @@
+from .dataloader import DataLoader  # noqa: F401
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset,
+                      random_split)  # noqa: F401
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, WeightedRandomSampler,
+                      SubsetRandomSampler)  # noqa: F401

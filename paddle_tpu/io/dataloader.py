@@ -1,0 +1,206 @@
+"""DataLoader: batching, multiprocess workers, device prefetch.
+
+Reference: fluid/reader.py:149 DataLoader + dataloader_iter.py:379
+_worker_loop (worker procs + shared-mem tensors + SIGCHLD watchdog) and
+the C++ double-buffering reader (operators/reader/buffered_reader.cc).
+
+TPU-first: host workers produce numpy batches; a prefetch thread stages
+the NEXT batch onto device (jax.device_put, optionally sharded over the
+mesh per a ShardingPlan) while the current step runs — the
+buffered_reader's H2D overlap without custom streams.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..framework import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch])
+                for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, str):
+        return list(batch)
+    return np.asarray(batch)
+
+
+class _WorkerPool:
+    """Thread pool mapping collate over index batches. Threads (not procs)
+    because numpy transforms release the GIL and jax arrays can't cross
+    process boundaries cheaply; the reference's process pool exists to
+    dodge Python-heavy decoding, which belongs in the C++ feeder."""
+
+    def __init__(self, fn, num_workers, prefetch):
+        self.fn = fn
+        self.in_q = queue.Queue()
+        self.out = {}
+        self.cv = threading.Condition()
+        self.workers = []
+        self.closed = False
+        for _ in range(num_workers):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+            self.workers.append(t)
+
+    def _loop(self):
+        while True:
+            item = self.in_q.get()
+            if item is None:
+                return
+            seq, payload = item
+            try:
+                res = (True, self.fn(payload))
+            except Exception as e:  # surfaced on the consumer side
+                res = (False, e)
+            with self.cv:
+                self.out[seq] = res
+                self.cv.notify_all()
+
+    def submit(self, seq, payload):
+        self.in_q.put((seq, payload))
+
+    def get(self, seq):
+        with self.cv:
+            while seq not in self.out:
+                self.cv.wait()
+            ok, val = self.out.pop(seq)
+        if not ok:
+            raise val
+        return val
+
+    def shutdown(self):
+        for _ in self.workers:
+            self.in_q.put(None)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False, sharding_plan=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_buffer_reader = use_buffer_reader
+        self.sharding_plan = sharding_plan
+        self.iterable = not isinstance(dataset, IterableDataset)
+        if self.iterable:
+            if batch_sampler is not None:
+                self.batch_sampler = batch_sampler
+            elif batch_size is None:
+                self.batch_sampler = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("IterableDataset DataLoader has no len()")
+
+    # -- iteration ----------------------------------------------------------
+    def _batches(self):
+        if self.iterable:
+            if self.batch_sampler is None:
+                for i in range(len(self.dataset)):
+                    yield self.dataset[i]
+                return
+            make = lambda idxs: [self.dataset[i] for i in idxs]
+            if self.num_workers > 0:
+                pool = _WorkerPool(
+                    lambda idxs: self.collate_fn(make(idxs)),
+                    self.num_workers, self.prefetch_factor)
+                try:
+                    seqs = []
+                    it = iter(self.batch_sampler)
+                    for seq, idxs in enumerate(it):
+                        pool.submit(seq, idxs)
+                        seqs.append(seq)
+                    for seq in seqs:
+                        yield pool.get(seq)
+                finally:
+                    pool.shutdown()
+            else:
+                for idxs in self.batch_sampler:
+                    yield self.collate_fn(make(idxs))
+        else:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == (self.batch_size or 1):
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not getattr(self, "drop_last", False):
+                yield self.collate_fn(batch)
+
+    def _to_device(self, batch):
+        def put(a):
+            if isinstance(a, np.ndarray):
+                if self.sharding_plan is not None:
+                    return Tensor(self.sharding_plan.place(
+                        a, self.sharding_plan.data_spec(a)))
+                return Tensor(jax.device_put(a))
+            return a
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(put(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: put(v) for k, v in batch.items()}
+        return put(batch)
+
+    def __iter__(self):
+        gen = self._batches()
+        if not self.use_buffer_reader:
+            for b in gen:
+                yield self._to_device(b)
+            return
+        # double-buffer: device-put batch N+1 while N is consumed
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        sentinel = object()
+
+        def producer():
+            try:
+                for b in gen:
+                    q.put(self._to_device(b))
+            except Exception as e:
+                q.put(("__error__", e))
+            q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[0] == "__error__":
+                raise item[1]
+            yield item

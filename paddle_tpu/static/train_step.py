@@ -31,6 +31,14 @@ from ..optimizer.lr import LRScheduler
 __all__ = ["TrainStep"]
 
 
+def _microslice(a, idx, accum):
+    """Slice microbatch idx of `accum` along the batch dim."""
+    if jnp.ndim(a) == 0:
+        return a
+    micro = a.shape[0] // accum
+    return jax.lax.dynamic_slice_in_dim(a, idx * micro, micro, axis=0)
+
+
 class TrainStep:
     """Compiled training step.
 
@@ -62,8 +70,21 @@ class TrainStep:
         self.opt_state = optimizer.init_state_tree(self.params)
         self._accum_grads = None
         self._accum_count = 0
-        self._step_fn = self._build(donate)
+        self._donate = donate
+        self._step_fn = None  # built lazily (data shardings need structure)
         self._grad_fn = None
+        if self.mesh is not None and self.sharding_plan is not None:
+            # place params/opt-state/buffers per the plan up front
+            plan = self.sharding_plan
+            state = layer.state_dict()
+            self.params = {
+                k: plan.place(v, plan.param_spec(k, state.get(k)))
+                for k, v in self.params.items()}
+            self.opt_state = {
+                k: {n: (plan.place(v, plan.state_spec(k, state.get(k)))
+                        if np.ndim(v) > 0 else v)
+                    for n, v in st.items()}
+                for k, st in self.opt_state.items()}
 
     # -- pure step ----------------------------------------------------------
     def _forward_loss(self, params, buffers, key, inputs, labels):
@@ -93,24 +114,59 @@ class TrainStep:
             for k, a in saved.items():
                 state[k]._data = a
 
-    def _build(self, donate):
+    def _build(self, in_arrays, lbl_arrays):
         optimizer = self.optimizer
+        accum = self.grad_accum_steps
 
         def step(params, opt_state, buffers, key, lr, inputs, labels):
-            grad_fn = jax.value_and_grad(
-                lambda p: self._forward_loss(p, buffers, key, inputs,
-                                             labels), has_aux=True)
-            (loss, (new_buffers, _)), grads = grad_fn(params)
+            if accum > 1:
+                # gradient merge (reference gradient_merge_optimizer.py):
+                # split the batch into accum microbatches, scan, average
+                def micro(idx):
+                    sl = jax.tree_util.tree_map(
+                        lambda a: _microslice(a, idx, accum), inputs)
+                    ll = jax.tree_util.tree_map(
+                        lambda a: _microslice(a, idx, accum), labels)
+                    k = jax.random.fold_in(key, idx)
+                    gf = jax.value_and_grad(
+                        lambda p: self._forward_loss(p, buffers, k, sl, ll),
+                        has_aux=True)
+                    return gf
+
+                def body(carry, idx):
+                    g_acc, l_acc = carry
+                    (loss, (nb, _)), grads = micro(idx)(params)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b, g_acc, grads)
+                    return (g_acc, l_acc + loss), nb
+                zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (g_sum, l_sum), nbs = jax.lax.scan(
+                    body, (zero_g, jnp.zeros((), jnp.float32)),
+                    jnp.arange(accum))
+                grads = jax.tree_util.tree_map(lambda a: a / accum, g_sum)
+                loss = l_sum / accum
+                new_buffers = jax.tree_util.tree_map(
+                    lambda a: a[-1], nbs)
+            else:
+                grad_fn = jax.value_and_grad(
+                    lambda p: self._forward_loss(p, buffers, key, inputs,
+                                                 labels), has_aux=True)
+                (loss, (new_buffers, _)), grads = grad_fn(params)
             new_params, new_opt = optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr=lr)
             return new_params, new_opt, new_buffers, loss
 
         jit_kwargs = {}
-        if donate:
+        if self._donate:
             jit_kwargs["donate_argnums"] = (0, 1, 2)
         if self.mesh is not None and self.sharding_plan is not None:
-            in_sh, out_sh = self.sharding_plan.step_shardings(self)
-            jit_kwargs["in_shardings"] = in_sh
+            plan = self.sharding_plan
+            in_sh, out_sh = plan.step_shardings(self)
+            data_in = jax.tree_util.tree_map(
+                lambda a: plan.named(plan.data_spec(a)), in_arrays)
+            lbl_in = jax.tree_util.tree_map(
+                lambda a: plan.named(plan.data_spec(a)), lbl_arrays)
+            jit_kwargs["in_shardings"] = in_sh[:5] + (data_in, lbl_in)
             jit_kwargs["out_shardings"] = out_sh
         return jax.jit(step, **jit_kwargs)
 
@@ -142,6 +198,8 @@ class TrainStep:
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
         in_arrays = _unwrap_tree(tuple(inputs))
         lbl_arrays = _unwrap_tree(tuple(labels))
+        if self._step_fn is None:
+            self._step_fn = self._build(in_arrays, lbl_arrays)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = next_key()
         self.params, self.opt_state, self.buffers, loss = self._step_fn(
