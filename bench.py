@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark harness: ERNIE-base-class pretraining step throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md) so vs_baseline compares
+against the target floor of 0.9x an A100-class step (proxy constant until
+a measured reference exists); value is tokens/sec/chip on the local
+device (real TPU under the driver, CPU mesh elsewhere).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    from paddle_tpu.static import TrainStep
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    # BERT/ERNIE-base-class config; scaled down on CPU so CI finishes
+    if on_tpu:
+        cfg = ErnieConfig(vocab_size=30528, hidden_size=768,
+                          num_hidden_layers=12, num_attention_heads=12,
+                          intermediate_size=3072,
+                          max_position_embeddings=512)
+        batch, seqlen, steps = 32, 512, 12
+    else:
+        cfg = ErnieConfig(vocab_size=8192, hidden_size=256,
+                          num_hidden_layers=4, num_attention_heads=8,
+                          intermediate_size=1024,
+                          max_position_embeddings=128)
+        batch, seqlen, steps = 8, 128, 4
+
+    paddle.seed(0)
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    step = TrainStep(
+        model, lambda out, labels: ErnieForPretraining.pretraining_loss(
+            out, labels), opt, amp_level="O1", amp_dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size,
+                         (batch, seqlen)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(labels)
+
+    # warmup/compile
+    step(x, y)
+    l = step(x, y)
+    float(l.item())  # block
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l = step(x, y)
+    float(l.item())  # block on the last step
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seqlen * steps / dt
+    # target floor: 0.9x of an A100-class BERT-base step ≈ 9000 tok/s/chip
+    # (proxy; reference repo publishes no numbers — BASELINE.md)
+    baseline = 9000.0 if on_tpu else 1.0
+    print(json.dumps({
+        "metric": "ernie_base_pretrain_tokens_per_sec_per_chip"
+        if on_tpu else "ernie_tiny_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

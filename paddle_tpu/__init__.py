@@ -30,6 +30,14 @@ from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import models  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from .nn.param_attr import ParamAttr  # noqa: F401
 
 

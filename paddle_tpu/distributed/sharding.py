@@ -29,7 +29,8 @@ __all__ = ["ShardingPlan", "PartitionSpec", "shard_tensor", "NamedSharding"]
 PartitionSpec = P
 
 
-def _spec_for_param(name: str, tensor, rules, zero_stage, dp_axis):
+def _spec_for_param(name: str, tensor, rules, zero_stage, dp_axis,
+                    axis_size=1):
     # explicit layer annotation wins (TP layers set `.sharding_spec`)
     spec = getattr(tensor, "sharding_spec", None) if tensor is not None \
         else None
@@ -42,11 +43,11 @@ def _spec_for_param(name: str, tensor, rules, zero_stage, dp_axis):
         spec = P()
     if zero_stage >= 3:
         # shard the largest free dim over dp as well
-        spec = _add_axis(spec, tensor, dp_axis)
+        spec = _add_axis(spec, tensor, dp_axis, axis_size)
     return spec
 
 
-def _add_axis(spec: P, tensor, axis: str):
+def _add_axis(spec: P, tensor, axis: str, axis_size: int):
     parts = list(spec) if len(spec) else []
     shape = tensor._data.shape if isinstance(tensor, Tensor) else \
         tensor.shape
@@ -54,10 +55,11 @@ def _add_axis(spec: P, tensor, axis: str):
         parts.append(None)
     if axis in [p for p in parts if p is not None]:
         return P(*parts)
-    # choose the largest dim not already sharded and divisible
+    # choose the largest dim not already sharded and evenly divisible
     order = sorted(range(len(shape)), key=lambda i: -shape[i])
     for i in order:
-        if parts[i] is None and shape[i] > 1:
+        if parts[i] is None and shape[i] > 1 and \
+                shape[i] % max(axis_size, 1) == 0:
             parts[i] = axis
             return P(*parts)
     return P(*parts)
@@ -87,15 +89,20 @@ class ShardingPlan:
     def replicated(self) -> NamedSharding:
         return self.named(P())
 
+    def _dp_size(self) -> int:
+        if self.dp_axis is None:
+            return 1
+        return int(self.mesh.shape[self.dp_axis])
+
     def param_spec(self, name: str, tensor) -> P:
         return _spec_for_param(name, tensor, self.rules, self.zero_stage,
-                               self.dp_axis)
+                               self.dp_axis, self._dp_size())
 
     def state_spec(self, name: str, tensor) -> P:
         """Optimizer-state sharding: ZeRO>=1 shards moments over dp."""
         base = self.param_spec(name, tensor)
         if self.zero_stage >= 1 and self.dp_axis:
-            return _add_axis(base, tensor, self.dp_axis)
+            return _add_axis(base, tensor, self.dp_axis, self._dp_size())
         return base
 
     def data_spec(self, array) -> P:

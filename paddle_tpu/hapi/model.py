@@ -1,0 +1,225 @@
+"""High-level Model API (reference python/paddle/hapi/model.py parity).
+
+Model.prepare/fit/evaluate/predict/save/load. Execution is always the
+compiled TrainStep (there is no slow per-op adapter to fall back to —
+the reference's DynamicGraphAdapter/StaticGraphAdapter split collapses
+into one compiled path on TPU).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import serialization
+from ..framework import Tensor, no_grad
+from ..io import DataLoader
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from ..static.train_step import TrainStep
+from .callbacks import Callback, ProgBarLogger, config_callbacks
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, mesh=None, sharding_plan=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        amp_level = None
+        if isinstance(amp_configs, str):
+            amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            amp_level = amp_configs.get("level")
+        if optimizer is not None and loss is not None:
+            loss_fn = loss if callable(loss) else None
+
+            def apply_loss(out, *lbls):
+                if isinstance(out, (list, tuple)):
+                    return loss_fn(*out, *lbls)
+                return loss_fn(out, *lbls)
+            self._train_step = TrainStep(
+                self.network, apply_loss, optimizer, amp_level=amp_level,
+                mesh=mesh, sharding_plan=sharding_plan)
+        return self
+
+    # -- loops ---------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    @staticmethod
+    def _split_batch(batch, n_labels=1):
+        if isinstance(batch, (list, tuple)):
+            items = list(batch)
+            inputs = items[:-n_labels] if len(items) > n_labels else \
+                items[:1]
+            labels = items[len(inputs):]
+            return tuple(inputs), tuple(labels)
+        return (batch,), ()
+
+    def train_batch(self, inputs, labels=None):
+        loss = self._train_step(tuple(inputs), tuple(labels or ()))
+        return [float(loss.item())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        try:
+            out = self.network(*inputs)
+            metrics = []
+            for m in self._metrics:
+                corr = m.compute(out, *labels)
+                m.update(corr)
+                metrics.append(m.accumulate())
+            loss = None
+            if self._loss is not None and labels:
+                loss = float(self._loss(out, *labels).item())
+            return loss, metrics
+        finally:
+            self.network.train()
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        try:
+            out = self.network(*inputs)
+            return out
+        finally:
+            self.network.train()
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        assert self._train_step is not None, "call prepare() first"
+        loader = self._loader(train_data, batch_size, shuffle)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=len(loader) if hasattr(
+                                    loader, "__len__") else None,
+                                log_freq=log_freq, verbose=verbose,
+                                save_dir=save_dir)
+        cbks.on_begin("train")
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            if hasattr(loader, "batch_sampler") and hasattr(
+                    loader.batch_sampler, "set_epoch"):
+                loader.batch_sampler.set_epoch(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, logs)
+                inputs, labels = self._split_batch(batch)
+                (loss_v,) = self.train_batch(inputs, labels)
+                logs = {"loss": [loss_v], "step": step}
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            # sync compiled params into the Layer for metrics/eval/save
+            self._train_step.sync_to_layer()
+            if isinstance(self._optimizer._lr, object) and hasattr(
+                    self._optimizer._lr, "step"):
+                try:
+                    self._optimizer._lr.step()
+                except TypeError:
+                    pass
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._loader(eval_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            loss, _ = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss)
+        logs = {}
+        if losses:
+            logs["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            name = m.name()
+            res = m.accumulate()
+            if isinstance(name, list):
+                for n, r in zip(name, res):
+                    logs[n] = r
+            else:
+                logs[name] = res
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            out = self.predict_batch(inputs)
+            outputs.append(out.numpy() if isinstance(out, Tensor)
+                           else [o.numpy() for o in out])
+        if stack_outputs and outputs and isinstance(outputs[0], np.ndarray):
+            return [np.concatenate(outputs, 0)]
+        return [outputs]
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        serialization.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            serialization.save(self._optimizer.state_dict(),
+                               path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = serialization.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(
+                serialization.load(path + ".pdopt"))
+        if self._train_step is not None:
+            # refresh compiled-state copies
+            sd = self.network.state_dict()
+            self._train_step.params = {
+                k: sd[k]._data for k in self._train_step._trainable_names}
+            self._train_step.buffers = {
+                k: sd[k]._data for k in self._train_step._buffer_names}
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtype)

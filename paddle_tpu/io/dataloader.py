@@ -186,12 +186,16 @@ class DataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
 
+        class _Error:
+            def __init__(self, exc):
+                self.exc = exc
+
         def producer():
             try:
                 for b in gen:
                     q.put(self._to_device(b))
             except Exception as e:
-                q.put(("__error__", e))
+                q.put(_Error(e))
             q.put(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
@@ -200,7 +204,6 @@ class DataLoader:
             item = q.get()
             if item is sentinel:
                 return
-            if isinstance(item, tuple) and len(item) == 2 and \
-                    item[0] == "__error__":
-                raise item[1]
+            if isinstance(item, _Error):
+                raise item.exc
             yield item

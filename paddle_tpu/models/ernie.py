@@ -1,0 +1,238 @@
+"""ERNIE/BERT-class encoder (the BASELINE config-3/5 flagship).
+
+Capability-parity with the reference's ERNIE workloads (the north star in
+SURVEY.md §0 and BASELINE.md): transformer encoder pretraining with MLM +
+NSP heads. TPU-first construction:
+- fused flash/SDPA attention (single XLA fusion region per block)
+- every parameter carries a tensor-parallel PartitionSpec annotation
+  (qkv/ffn-in column-split, proj/ffn-out row-split, embeddings
+  vocab-split) so ShardingPlan/pjit shards it over 'tp' with zero code
+  changes — the reference needs distinct Column/RowParallelLinear model
+  code (fleet meta_parallel) for this
+- bf16-friendly: LayerNorm/softmax stay fp32 under AMP lists
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..distributed.env import TENSOR_AXIS
+from ..framework import Tensor
+from ..ops import creation, manipulation
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining",
+           "ErnieForSequenceClassification"]
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash_attention = use_flash_attention
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        return cls(hidden_size=1024, num_hidden_layers=24,
+                   num_attention_heads=16, intermediate_size=4096, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """For tests/dryruns."""
+        return cls(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   max_position_embeddings=64, **kw)
+
+
+def _init_linear(layer, std, col_spec=None, row_spec=None):
+    from ..nn.initializer import Normal
+    layer.weight.set_value(Normal(0, std)(tuple(layer.weight.shape),
+                                          layer.weight.dtype))
+    if col_spec is not None:
+        layer.weight.sharding_spec = col_spec
+    return layer
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.use_flash = config.use_flash_attention
+        self.dropout_p = config.attention_probs_dropout_prob
+        std = config.initializer_range
+        self.qkv = _init_linear(nn.Linear(h, 3 * h), std)
+        self.qkv.weight.sharding_spec = P(None, TENSOR_AXIS)
+        self.qkv.bias.sharding_spec = P(TENSOR_AXIS)
+        self.out = _init_linear(nn.Linear(h, h), std)
+        self.out.weight.sharding_spec = P(TENSOR_AXIS, None)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        if attn_mask is None and self.use_flash:
+            ctx = F.flash_attention(q, k, v)
+        else:
+            ctx = F.scaled_dot_product_attention(q, k, v,
+                                                 attn_mask=attn_mask)
+        ctx = ctx.reshape([b, s, h])
+        return self.out(ctx)
+
+
+class ErnieLayer(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        h = config.hidden_size
+        std = config.initializer_range
+        self.attention = ErnieSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.ffn_in = _init_linear(nn.Linear(h, config.intermediate_size),
+                                   std)
+        self.ffn_in.weight.sharding_spec = P(None, TENSOR_AXIS)
+        self.ffn_in.bias.sharding_spec = P(TENSOR_AXIS)
+        self.ffn_out = _init_linear(
+            nn.Linear(config.intermediate_size, h), std)
+        self.ffn_out.weight.sharding_spec = P(TENSOR_AXIS, None)
+        self.ffn_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.act = config.hidden_act
+
+    def forward(self, x, attn_mask=None):
+        attn = self.attention(x, attn_mask)
+        x = self.attn_norm(x + self.dropout(attn))
+        ffn = self.ffn_out(getattr(F, self.act)(self.ffn_in(x)))
+        x = self.ffn_norm(x + self.dropout(ffn))
+        return x
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.word_embeddings.weight.sharding_spec = P(TENSOR_AXIS, None)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = creation.arange(s, dtype="int32")
+            position_ids = manipulation.expand(
+                manipulation.unsqueeze(position_ids, 0), [b, s])
+        if token_type_ids is None:
+            token_type_ids = creation.zeros([b, s], dtype="int32")
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig = None, **kwargs):
+        super().__init__()
+        self.config = config or ErnieConfig(**kwargs)
+        self.embeddings = ErnieEmbeddings(self.config)
+        self.encoder = nn.LayerList(
+            [ErnieLayer(self.config)
+             for _ in range(self.config.num_hidden_layers)])
+        self.pooler = nn.Linear(self.config.hidden_size,
+                                self.config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
+            am = manipulation.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - am.astype("float32")) * -1e9
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + NSP heads (the pretraining objective of BASELINE config 3)."""
+
+    def __init__(self, config: ErnieConfig = None, **kwargs):
+        super().__init__()
+        self.ernie = ErnieModel(config, **kwargs)
+        cfg = self.ernie.config
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.mlm_bias = self.create_parameter(
+            (cfg.vocab_size,), is_bias=True)
+        self.mlm_bias.sharding_spec = P(TENSOR_AXIS)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        # weight-tied decoder: logits = h @ E^T  (vocab-sharded matmul)
+        w = self.ernie.embeddings.word_embeddings.weight
+        logits = F.linear(h, manipulation.t(w)) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return logits, nsp_logits
+
+    @staticmethod
+    def pretraining_loss(outputs, mlm_labels, nsp_labels=None,
+                         ignore_index=-100):
+        logits, nsp_logits = outputs
+        mlm = F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]),
+            mlm_labels.reshape([-1]), ignore_index=ignore_index)
+        if nsp_labels is None:
+            return mlm
+        nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
+        return mlm + nsp
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig = None, num_classes=2, **kwargs):
+        super().__init__()
+        self.ernie = ErnieModel(config, **kwargs)
+        cfg = self.ernie.config
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(pooled))

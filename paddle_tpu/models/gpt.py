@@ -1,0 +1,112 @@
+"""GPT-class decoder LM (causal; the long-context / hybrid-parallel demo).
+
+Uses causal flash attention; same TP annotations as ERNIE; the sp axis can
+shard the sequence (ring attention path) via
+paddle_tpu.distributed.ring for long contexts.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..distributed.env import TENSOR_AXIS
+from ..ops import creation, manipulation
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_seq_len=1024, dropout=0.1,
+                 layer_norm_eps=1e-5, use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash_attention = use_flash_attention
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(vocab_size=512, hidden_size=64, num_layers=2,
+                   num_heads=4, max_seq_len=128, **kw)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.ln1 = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.ln2 = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.num_heads = config.num_heads
+        self.head_dim = h // config.num_heads
+        self.qkv = nn.Linear(h, 3 * h)
+        self.qkv.weight.sharding_spec = P(None, TENSOR_AXIS)
+        self.qkv.bias.sharding_spec = P(TENSOR_AXIS)
+        self.proj = nn.Linear(h, h)
+        self.proj.weight.sharding_spec = P(TENSOR_AXIS, None)
+        self.fc1 = nn.Linear(h, 4 * h)
+        self.fc1.weight.sharding_spec = P(None, TENSOR_AXIS)
+        self.fc1.bias.sharding_spec = P(TENSOR_AXIS)
+        self.fc2 = nn.Linear(4 * h, h)
+        self.fc2.weight.sharding_spec = P(TENSOR_AXIS, None)
+        self.dropout = nn.Dropout(config.dropout)
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x):
+        b, s, h = x.shape
+        xn = self.ln1(x)
+        qkv = self.qkv(xn).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.use_flash:
+            ctx = F.flash_attention(q, k, v, causal=True)
+        else:
+            ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        x = x + self.dropout(self.proj(ctx.reshape([b, s, h])))
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.config = config or GPTConfig(**kwargs)
+        cfg = self.config
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wte.weight.sharding_spec = P(TENSOR_AXIS, None)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = creation.arange(s, dtype="int32")
+        pos = manipulation.expand(manipulation.unsqueeze(pos, 0), [b, s])
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.gpt = GPTModel(config, **kwargs)
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        w = self.gpt.wte.weight
+        return F.linear(h, manipulation.t(w))
+
+    @staticmethod
+    def lm_loss(logits, labels):
+        return F.cross_entropy(
+            logits[:, :-1].reshape([-1, logits.shape[-1]]),
+            labels[:, 1:].reshape([-1]))

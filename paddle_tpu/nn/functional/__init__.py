@@ -9,3 +9,6 @@ from . import activation, common, conv, loss, norm, pooling  # noqa: F401
 # attention functionals land with the transformer layer module
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention)
+
+# math-namespace activations that paddle also exposes under F.*
+from ...ops.math import tanh, abs, square, sqrt  # noqa: F401

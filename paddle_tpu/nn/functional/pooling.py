@@ -69,7 +69,8 @@ def _max_pool(x, ksize, stride, padding, n, channel_last, ceil_mode=False):
                                       p[0] + p[1]))
             for i, p in enumerate(pad)
         ]
-    neg = (jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.inexact)
+    # -inf init is required for jax's reduce_window max AD rule
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.inexact)
            else jnp.iinfo(x.dtype).min)
     return jax.lax.reduce_window(
         x, neg, jax.lax.max, dims, strides, _full_padding(pad, n,

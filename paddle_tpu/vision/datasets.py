@@ -1,0 +1,117 @@
+"""Vision datasets (reference python/paddle/vision/datasets parity).
+
+Zero-egress environment: when real data files are absent, datasets fall
+back to a deterministic synthetic sample set (shape/dtype-faithful) so
+examples, tests, and benchmarks run anywhere. Pass `data_file`/`image_path`
+pointing at real data to use it.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers"]
+
+
+class MNIST(Dataset):
+    """28x28 grayscale digits; synthetic fallback generates class-dependent
+    patterns so a model can actually learn (acc >> chance) without files."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2",
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(
+                    f.read(), dtype=np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), dtype=np.uint8)
+        else:
+            n = synthetic_size or (1024 if mode == "train" else 256)
+            # class patterns shared across splits; noise differs per split
+            base = np.random.RandomState(1234).rand(10, 28, 28)
+            rng = np.random.RandomState(42 if mode == "train" else 7)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            self.images = np.clip(
+                (base[self.labels] * 128 + rng.rand(n, 28, 28) * 64),
+                0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2", synthetic_size=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import pickle
+            import tarfile
+            imgs, labels = [], []
+            with tarfile.open(data_file) as tf:
+                for member in tf.getmembers():
+                    want = ("data_batch" if mode == "train" else
+                            "test_batch") if self.n_classes == 10 else \
+                        ("train" if mode == "train" else "test")
+                    if want in member.name:
+                        d = pickle.load(tf.extractfile(member),
+                                        encoding="bytes")
+                        imgs.append(d[b"data"])
+                        labels.extend(d.get(b"labels",
+                                            d.get(b"fine_labels", [])))
+            self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(labels, np.int64)
+        else:
+            n = synthetic_size or (1024 if mode == "train" else 256)
+            base = np.random.RandomState(99).rand(self.n_classes, 3, 32, 32)
+            rng = np.random.RandomState(13 if mode == "train" else 14)
+            self.labels = rng.randint(0, self.n_classes, n).astype(np.int64)
+            self.images = np.clip(base[self.labels] * 200
+                                  + rng.rand(n, 3, 32, 32) * 55,
+                                  0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    n_classes = 10
+
+
+class Cifar100(_CifarBase):
+    n_classes = 100
+
+
+class Flowers(_CifarBase):
+    n_classes = 102

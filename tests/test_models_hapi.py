@@ -1,0 +1,145 @@
+"""Model zoo + hapi Model + io tests (reference test_vision_models.py /
+test_model.py style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import (LeNet, mobilenet_v2, resnet18)
+from paddle_tpu.models import (ErnieConfig, ErnieForPretraining,
+                               GPTConfig, GPTForCausalLM)
+
+
+def test_lenet_forward():
+    net = LeNet()
+    x = paddle.randn([2, 1, 28, 28])
+    out = net(x)
+    assert out.shape == [2, 10]
+
+
+def test_resnet18_forward():
+    net = resnet18(num_classes=10)
+    x = paddle.randn([2, 3, 32, 32])
+    out = net(x)
+    assert out.shape == [2, 10]
+
+
+def test_mobilenetv2_forward():
+    net = mobilenet_v2(num_classes=7)
+    x = paddle.randn([2, 3, 32, 32])
+    assert net(x).shape == [2, 7]
+
+
+def test_ernie_forward_and_loss():
+    cfg = ErnieConfig.tiny()
+    model = ErnieForPretraining(cfg)
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    labels = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    logits, nsp = model(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    loss = ErnieForPretraining.pretraining_loss((logits, nsp), labels)
+    assert np.isfinite(loss.item())
+    loss.backward()
+    emb = model.ernie.embeddings.word_embeddings.weight
+    assert emb.grad is not None  # tied decoder grads flow
+
+
+def test_gpt_lm_trains():
+    paddle.seed(30)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    from paddle_tpu.static import TrainStep
+    step = TrainStep(model, lambda logits, y: GPTForCausalLM.lm_loss(
+        logits, y), opt)
+    ids = np.random.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    l0 = step(x, x).item()
+    for _ in range(15):
+        l1 = step(x, x).item()
+    assert l1 < l0
+
+
+def test_dataloader_basic():
+    xs = paddle.to_tensor(np.arange(20, dtype=np.float32).reshape(10, 2))
+    ys = paddle.to_tensor(np.arange(10, dtype=np.int64))
+    ds = TensorDataset([xs, ys])
+    loader = DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == [4, 2]
+    # shuffle covers all indices
+    loader2 = DataLoader(ds, batch_size=5, shuffle=True)
+    seen = np.concatenate([b[1].numpy() for b in loader2])
+    assert sorted(seen.tolist()) == list(range(10))
+
+
+def test_dataloader_workers():
+    ds = MNIST(mode="train", synthetic_size=64)
+    loader = DataLoader(ds, batch_size=16, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == [16, 1, 28, 28]
+
+
+def test_hapi_model_fit_mnist():
+    """The first-light config: LeNet on (synthetic) MNIST via Model.fit."""
+    paddle.seed(31)
+    train = MNIST(mode="train", synthetic_size=256)
+    test = MNIST(mode="test", synthetic_size=64)
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    model.prepare(opt, lambda out, y: F.cross_entropy(out, y),
+                  metrics=[Accuracy()])
+    model.fit(train, epochs=8, batch_size=32, verbose=0)
+    logs = model.evaluate(test, batch_size=64, verbose=0)
+    # synthetic classes are learnable: must beat chance comfortably
+    assert logs["acc"] > 0.5, logs
+
+
+def test_hapi_save_load(tmp_path):
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    model.prepare(opt, lambda o, y: F.cross_entropy(o, y))
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    w_before = model.network.features[0].weight.numpy().copy()
+    model.network.features[0].weight.set_value(w_before * 0)
+    model.load(p)
+    np.testing.assert_allclose(model.network.features[0].weight.numpy(),
+                               w_before)
+
+
+def test_metrics():
+    acc = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8],
+                                      [0.6, 0.4]], np.float32))
+    label = paddle.to_tensor(np.array([[0], [1], [1]]))
+    corr = acc.compute(pred, label)
+    acc.update(corr)
+    assert abs(acc.accumulate() - 2 / 3) < 1e-6
+
+    from paddle_tpu.metric import Auc, Precision, Recall
+    prec = Precision()
+    prec.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(prec.accumulate() - 0.5) < 1e-6
+    auc = Auc()
+    auc.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 1, 0, 0]))
+    assert auc.accumulate() > 0.9
+
+
+def test_summary():
+    from paddle_tpu.hapi import summary
+    res = summary(LeNet())
+    assert res["total_params"] > 0
+    assert res["trainable_params"] == res["total_params"]
