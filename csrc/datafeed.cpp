@@ -1,0 +1,291 @@
+// Native data-feed runtime for paddle_tpu.
+//
+// TPU-native equivalent of the reference's C++ feeding stack
+// (/root/reference/paddle/fluid/framework/data_feed.cc MultiSlotDataFeed,
+// framework/blocking_queue.h, framework/data_set.cc in-memory shuffle,
+// operators/reader/buffered_reader.cc): multi-threaded file parsing into
+// fixed-shape slot batches behind a bounded blocking queue, so the Python
+// host loop (and the TPU H2D DMA behind it) never stalls on text parsing.
+//
+// Record format (MultiSlot text): one sample per line; per slot:
+//   <count> <v0> <v1> ... ;
+// slots separated by ';'. Values parsed as float or int64 per slot config.
+// Fixed-size slots are padded/truncated to slot_size (XLA static shapes).
+//
+// C ABI (ctypes-friendly), no exceptions across the boundary.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotConf {
+  int size;       // values per sample (pad/truncate)
+  int is_int64;   // 0 = float32, 1 = int64
+};
+
+struct Batch {
+  // per slot: contiguous [batch, slot_size]
+  std::vector<std::vector<float>> fslots;
+  std::vector<std::vector<int64_t>> islots;
+  int batch_size = 0;
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t cap) : cap_(cap), closed_(false) {}
+
+  bool Push(Batch&& b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return false;
+    q_.push(std::move(b));
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  bool Pop(Batch* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !q_.empty() || closed_; });
+    if (q_.empty()) return false;  // closed and drained
+    *out = std::move(q_.front());
+    q_.pop();
+    cv_push_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    cv_pop_.notify_all();
+    cv_push_.notify_all();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::queue<Batch> q_;
+  size_t cap_;
+  bool closed_;
+};
+
+struct Sample {
+  std::vector<std::vector<float>> fvals;
+  std::vector<std::vector<int64_t>> ivals;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<std::string> files, int batch_size,
+           std::vector<SlotConf> slots, int num_threads, int queue_cap,
+           int shuffle, uint64_t seed)
+      : files_(std::move(files)),
+        batch_size_(batch_size),
+        slots_(std::move(slots)),
+        num_threads_(num_threads < 1 ? 1 : num_threads),
+        queue_(queue_cap < 2 ? 2 : queue_cap),
+        shuffle_(shuffle),
+        seed_(seed) {}
+
+  ~DataFeed() { Stop(); }
+
+  void Start() {
+    next_file_.store(0);
+    done_workers_.store(0);
+    for (int t = 0; t < num_threads_; ++t) {
+      workers_.emplace_back([this, t] { WorkerLoop(t); });
+    }
+  }
+
+  void Stop() {
+    queue_.Close();
+    for (auto& w : workers_) {
+      if (w.joinable()) w.join();
+    }
+    workers_.clear();
+  }
+
+  // Returns batch size (0 = exhausted). Caller provides per-slot buffers
+  // sized batch_size * slot_size.
+  int Next(float** fbufs, int64_t** ibufs) {
+    Batch b;
+    if (!queue_.Pop(&b)) return 0;
+    int fi = 0, ii = 0;
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      if (slots_[s].is_int64) {
+        std::memcpy(ibufs[ii], b.islots[ii].data(),
+                    b.islots[ii].size() * sizeof(int64_t));
+        ++ii;
+      } else {
+        std::memcpy(fbufs[fi], b.fslots[fi].data(),
+                    b.fslots[fi].size() * sizeof(float));
+        ++fi;
+      }
+    }
+    return b.batch_size;
+  }
+
+ private:
+  bool ParseLine(const std::string& line, Sample* sample) {
+    sample->fvals.clear();
+    sample->ivals.clear();
+    std::stringstream ss(line);
+    std::string slot_str;
+    size_t si = 0;
+    while (std::getline(ss, slot_str, ';')) {
+      if (si >= slots_.size()) break;
+      std::stringstream fs(slot_str);
+      long long count = 0;
+      if (!(fs >> count)) return false;
+      const SlotConf& conf = slots_[si];
+      if (conf.is_int64) {
+        std::vector<int64_t> vals;
+        vals.reserve(conf.size);
+        int64_t v;
+        for (long long i = 0; i < count && (fs >> v); ++i) {
+          if ((int)vals.size() < conf.size) vals.push_back(v);
+        }
+        vals.resize(conf.size, 0);
+        sample->ivals.push_back(std::move(vals));
+      } else {
+        std::vector<float> vals;
+        vals.reserve(conf.size);
+        float v;
+        for (long long i = 0; i < count && (fs >> v); ++i) {
+          if ((int)vals.size() < conf.size) vals.push_back(v);
+        }
+        vals.resize(conf.size, 0.0f);
+        sample->fvals.push_back(std::move(vals));
+      }
+      ++si;
+    }
+    return si == slots_.size();
+  }
+
+  void EmitBatch(std::vector<Sample>* buf) {
+    if (buf->empty()) return;
+    Batch b;
+    b.batch_size = (int)buf->size();
+    for (const auto& conf : slots_) {
+      if (conf.is_int64) {
+        b.islots.emplace_back();
+        b.islots.back().reserve((size_t)b.batch_size * conf.size);
+      } else {
+        b.fslots.emplace_back();
+        b.fslots.back().reserve((size_t)b.batch_size * conf.size);
+      }
+    }
+    for (const auto& s : *buf) {
+      int fi = 0, ii = 0;
+      for (const auto& conf : slots_) {
+        if (conf.is_int64) {
+          const auto& v = s.ivals[ii];
+          b.islots[ii].insert(b.islots[ii].end(), v.begin(), v.end());
+          ++ii;
+        } else {
+          const auto& v = s.fvals[fi];
+          b.fslots[fi].insert(b.fslots[fi].end(), v.begin(), v.end());
+          ++fi;
+        }
+      }
+    }
+    buf->clear();
+    queue_.Push(std::move(b));
+  }
+
+  void WorkerLoop(int tid) {
+    std::mt19937_64 rng(seed_ + tid);
+    std::vector<Sample> pending;
+    std::vector<Sample> shuffle_buf;
+    const size_t shuffle_cap = shuffle_ ? 4096 : 0;
+    for (;;) {
+      int idx = next_file_.fetch_add(1);
+      if (idx >= (int)files_.size()) break;
+      std::ifstream in(files_[idx]);
+      if (!in.is_open()) continue;
+      std::string line;
+      while (std::getline(in, line)) {
+        if (line.empty()) continue;
+        Sample s;
+        if (!ParseLine(line, &s)) continue;
+        if (shuffle_cap) {
+          if (shuffle_buf.size() < shuffle_cap) {
+            shuffle_buf.push_back(std::move(s));
+          } else {
+            size_t j = rng() % shuffle_buf.size();
+            pending.push_back(std::move(shuffle_buf[j]));
+            shuffle_buf[j] = std::move(s);
+            if ((int)pending.size() == batch_size_) EmitBatch(&pending);
+          }
+        } else {
+          pending.push_back(std::move(s));
+          if ((int)pending.size() == batch_size_) EmitBatch(&pending);
+        }
+      }
+    }
+    // drain shuffle buffer
+    if (shuffle_cap) {
+      std::shuffle(shuffle_buf.begin(), shuffle_buf.end(), rng);
+      for (auto& s : shuffle_buf) {
+        pending.push_back(std::move(s));
+        if ((int)pending.size() == batch_size_) EmitBatch(&pending);
+      }
+    }
+    EmitBatch(&pending);  // trailing partial batch
+    if (done_workers_.fetch_add(1) + 1 == num_threads_) {
+      queue_.Close();  // last worker out closes the queue
+    }
+  }
+
+  std::vector<std::string> files_;
+  int batch_size_;
+  std::vector<SlotConf> slots_;
+  int num_threads_;
+  BlockingQueue queue_;
+  int shuffle_;
+  uint64_t seed_;
+  std::atomic<int> next_file_{0};
+  std::atomic<int> done_workers_{0};
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* df_create(const char** files, int nfiles, int batch_size,
+                const int* slot_sizes, const int* slot_is_int64,
+                int num_slots, int num_threads, int queue_cap,
+                int shuffle, uint64_t seed) {
+  std::vector<std::string> fs;
+  for (int i = 0; i < nfiles; ++i) fs.emplace_back(files[i]);
+  std::vector<SlotConf> slots;
+  for (int i = 0; i < num_slots; ++i) {
+    slots.push_back({slot_sizes[i], slot_is_int64[i]});
+  }
+  return new DataFeed(std::move(fs), batch_size, std::move(slots),
+                      num_threads, queue_cap, shuffle, seed);
+}
+
+void df_start(void* h) { static_cast<DataFeed*>(h)->Start(); }
+
+int df_next(void* h, float** fbufs, int64_t** ibufs) {
+  return static_cast<DataFeed*>(h)->Next(fbufs, ibufs);
+}
+
+void df_destroy(void* h) { delete static_cast<DataFeed*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+"""Launcher CLI (reference fleet/launch.py:334 `fleetrun` parity).
+
+Usage: python -m paddle_tpu.distributed.launch [--nproc_per_node N]
+       [--ips host1,host2] [--master ip:port] training_script [args...]
+
+On TPU a single process drives all local chips (SPMD), so single-host
+launch is exec-with-env. Multi-host: one process per host, coordinated via
+the JAX coordination service (PADDLE_MASTER → jax.distributed.initialize,
+replacing the reference's PADDLE_TRAINER_ENDPOINTS TCP NCCL-id exchange).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def parse_args(argv):
+    import argparse
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this host (TPU: usually 1 — a single "
+                        "process drives all local chips)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--ips", type=str, default="",
+                   help="comma list of host ips (informational)")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs="...")
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    world = args.nnodes * args.nproc_per_node
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+        })
+        if args.master:
+            host, _, port = args.master.partition(":")
+            env["PADDLE_MASTER"] = host
+            env["MASTER_PORT"] = port or "8476"
+        cmd = [sys.executable, args.script] + list(args.script_args)
+        stdout = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            stdout = open(os.path.join(args.log_dir,
+                                       f"worker.{rank}.log"), "w")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
+                                      stderr=subprocess.STDOUT
+                                      if stdout else None))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -19,12 +19,17 @@ from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
+from .control_flow import (cond, while_loop, case, switch_case, scan,
+                           fori_loop)  # noqa: F401
+from .einsum import einsum  # noqa: F401
 from .registry import OPS, get_op, op_wrapper, register_op, run_op
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 
 __all__ = (creation.__all__ + math.__all__ + manipulation.__all__
-           + logic.__all__ + search.__all__ + linalg.__all__ + stat.__all__)
+           + logic.__all__ + search.__all__ + linalg.__all__ + stat.__all__
+           + ["einsum", "cond", "while_loop", "case", "switch_case",
+              "scan", "fori_loop"])
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,127 @@
+"""Control-flow ops (reference operators/controlflow/conditional_block_op,
+while_op + python layers/control_flow.py cond/while_loop).
+
+TPU-first: paddle.static.nn.cond / paddle.static.nn.while_loop map to
+lax.cond / lax.while_loop so data-dependent control flow stays inside one
+compiled program (the reference interprets sub-blocks on the host). In
+eager mode with concrete tensors they just branch in Python — same
+semantics, zero tracing overhead.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import Tensor, _unwrap
+from .registry import run_op
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "scan",
+           "fori_loop"]
+
+
+def _is_traced(x):
+    import jax.core
+    return isinstance(x, jax.core.Tracer)
+
+
+def cond(pred, true_fn, false_fn=None, operands=(), name=None):
+    """paddle.static.nn.cond. Eager: plain python branch. Traced (inside
+    jit/to_static): lax.cond keeps both branches in-graph."""
+    p = _unwrap(pred)
+    operands = tuple(operands)
+    if not _is_traced(p):
+        if bool(p):
+            return true_fn(*operands)
+        return false_fn(*operands) if false_fn is not None else None
+
+    def wrap(fn):
+        def pure(*arrays):
+            out = fn(*[Tensor(a) for a in arrays])
+            if isinstance(out, (list, tuple)):
+                return tuple(_unwrap(o) for o in out)
+            return _unwrap(out)
+        return pure
+
+    arrays = tuple(_unwrap(o) for o in operands)
+    out = jax.lax.cond(p, wrap(true_fn),
+                       wrap(false_fn) if false_fn is not None
+                       else wrap(lambda *a: a if len(a) != 1 else a[0]),
+                       *arrays)
+    if isinstance(out, tuple):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop → lax.while_loop (structured carry)."""
+    arrays = [_unwrap(v) for v in loop_vars]
+
+    def c(vals):
+        out = cond_fn(*[Tensor(v) for v in vals])
+        return _unwrap(out)
+
+    def b(vals):
+        out = body_fn(*[Tensor(v) for v in vals])
+        out = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(_unwrap(o) for o in out)
+
+    res = jax.lax.while_loop(c, b, tuple(arrays))
+    return [Tensor(r) for r in res]
+
+
+def fori_loop(lower, upper, body_fn, init, name=None):
+    def b(i, val):
+        out = body_fn(Tensor(jnp.asarray(i)), Tensor(val))
+        return _unwrap(out)
+    return Tensor(jax.lax.fori_loop(int(_unwrap(lower)),
+                                    int(_unwrap(upper)), b,
+                                    _unwrap(init)))
+
+
+def scan(f, init, xs, name=None):
+    """lax.scan surface for sequence programs (rnn-style)."""
+    def body(carry, x):
+        c, y = f(Tensor(carry), Tensor(x))
+        return _unwrap(c), _unwrap(y)
+    carry, ys = jax.lax.scan(body, _unwrap(init), _unwrap(xs))
+    return Tensor(carry), Tensor(ys)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case: first true predicate wins (eager)."""
+    for pred, fn in pred_fn_pairs:
+        if bool(_unwrap(pred)):
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no case matched and no default given")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = _unwrap(branch_index)
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        if not _is_traced(idx):
+            i = int(idx)
+            if i in branch_fns:
+                return branch_fns[i]()
+            return default() if default else None
+        # dense dispatch for traced index
+        idx = jnp.searchsorted(jnp.asarray(keys), idx)
+    else:
+        fns = list(branch_fns)
+        if not _is_traced(idx):
+            i = int(idx)
+            if 0 <= i < len(fns):
+                return fns[i]()
+            return default() if default else None
+
+    def wrap(fn):
+        def pure(_):
+            return _unwrap(fn())
+        return pure
+    out = jax.lax.switch(idx, [wrap(f) for f in fns], 0)
+    return Tensor(out)

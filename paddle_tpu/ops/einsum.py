@@ -1,0 +1,18 @@
+"""einsum (paddle.einsum parity) — straight to jnp.einsum, which XLA maps
+onto MXU contractions."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = ["einsum"]
+
+
+@register_op("einsum")
+def _einsum_impl(*operands, equation=""):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum_impl(*operands, equation=equation)

@@ -169,7 +169,14 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
         return tuple(res) if isinstance(res, list) else res
 
     try:
-        if requires:
+        from .. import profiler as _profiler
+        if _profiler._enabled:
+            with _profiler.RecordEvent(name, "Operator"):
+                if requires:
+                    out, vjp_fn = jax.vjp(pure, *arrays)
+                else:
+                    out = pure(*arrays)
+        elif requires:
             out, vjp_fn = jax.vjp(pure, *arrays)
         else:
             out = pure(*arrays)

@@ -1,0 +1,148 @@
+"""Profiler: RecordEvent spans + chrome-trace export + XPlane bridge.
+
+Reference: platform/profiler.{h,cc} (RecordEvent RAII, push/pop per-thread
+event stacks, Enable/DisableProfiler with sorted reports), device_tracer.cc
+(CUPTI timeline) and tools/timeline.py (chrome://tracing export).
+
+TPU-native: host-side spans are recorded here (framework overhead,
+dataloading, dispatch); device-side kernels come from jax.profiler
+(XPlane → TensorBoard / Perfetto). export_chrome_tracing merges host spans
+into the chrome trace format directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+__all__ = ["RecordEvent", "Profiler", "start_profiler", "stop_profiler",
+           "profiler_guard", "export_chrome_tracing", "summary",
+           "start_trace", "stop_trace"]
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[dict] = []
+_tls = threading.local()
+
+
+class RecordEvent:
+    """RAII span (reference profiler.h:127). Usable as context manager or
+    decorator; nesting tracked per thread."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        if not _enabled:
+            return self
+        self._t0 = time.perf_counter_ns()
+        depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        self._depth = depth
+        return self
+
+    def end(self):
+        if not _enabled or self._t0 is None:
+            return
+        t1 = time.perf_counter_ns()
+        _tls.depth = max(getattr(_tls, "depth", 1) - 1, 0)
+        with _lock:
+            _events.append({
+                "name": self.name, "cat": self.event_type,
+                "ts": self._t0 / 1000.0, "dur": (t1 - self._t0) / 1000.0,
+                "ph": "X", "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": {"depth": self._depth},
+            })
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(self.name, self.event_type):
+                return fn(*a, **k)
+        return wrapper
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    """reference profiler.py start_profiler parity."""
+    global _enabled
+    with _lock:
+        _events.clear()
+    _enabled = True
+
+
+def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    global _enabled
+    _enabled = False
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return summary(sorted_key)
+
+
+@contextmanager
+def profiler_guard(state="All", sorted_key="total",
+                   profile_path="/tmp/profile"):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def export_chrome_tracing(path: str):
+    """Write chrome://tracing JSON (tools/timeline.py analogue)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    out = path if path.endswith(".json") else path + ".json"
+    with _lock:
+        data = {"traceEvents": list(_events)}
+    with open(out, "w") as f:
+        json.dump(data, f)
+    return out
+
+
+def summary(sorted_key="total"):
+    """Aggregated per-span stats (DisableProfiler sorted report)."""
+    with _lock:
+        evs = list(_events)
+    agg: Dict[str, dict] = {}
+    for e in evs:
+        s = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
+                                       "max_us": 0.0})
+        s["calls"] += 1
+        s["total_us"] += e["dur"]
+        s["max_us"] = max(s["max_us"], e["dur"])
+    for s in agg.values():
+        s["avg_us"] = s["total_us"] / max(s["calls"], 1)
+    key = {"total": "total_us", "calls": "calls", "max": "max_us",
+           "ave": "avg_us"}.get(sorted_key, "total_us")
+    return dict(sorted(agg.items(), key=lambda kv: -kv[1][key]))
+
+
+# -- device-side (XPlane) bridge --------------------------------------------
+
+def start_trace(log_dir="/tmp/jax-trace"):
+    """Start a jax/XLA device trace (CUPTI/device_tracer analogue —
+    XPlane on TPU, viewable in TensorBoard or Perfetto)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    return log_dir
+
+
+def stop_trace():
+    import jax
+    jax.profiler.stop_trace()

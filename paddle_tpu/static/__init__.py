@@ -9,6 +9,7 @@ from .train_step import TrainStep  # noqa: F401
 from .program import (Program, program_guard, default_main_program,
                       default_startup_program, data, Executor,
                       append_backward)  # noqa: F401
+from . import nn  # noqa: F401
 
 
 def _enable_static_mode():

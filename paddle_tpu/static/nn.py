@@ -1,0 +1,4 @@
+"""paddle.static.nn namespace (control flow + layer functionals)."""
+from ..ops.control_flow import (case, cond, fori_loop, scan, switch_case,
+                                while_loop)  # noqa: F401
+from ..nn.functional import *  # noqa: F401,F403

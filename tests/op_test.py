@@ -1,0 +1,131 @@
+"""OpTest harness (reference unittests/op_test.py:251 parity).
+
+Declarative per-op correctness: subclasses set `op_fn`, `inputs`, `attrs`,
+and a numpy-reference `ref_fn`; `check_output` compares eager vs numpy on
+every available backend path (direct + jitted), `check_grad` compares
+analytic gradients (tape) against numeric finite differences — the same
+contract as the reference's get_numeric_gradient (op_test.py:101), built
+on jax instead of a Scope/Program.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import Tensor
+
+
+class OpTest:
+    op_fn: Callable = None           # the paddle_tpu functional op
+    ref_fn: Callable = None          # numpy reference
+    inputs: Dict[str, np.ndarray] = {}
+    attrs: Dict = {}
+    grad_inputs: Optional[Sequence[str]] = None  # names to grad-check
+
+    rtol = 1e-5
+    atol = 1e-6
+    numeric_delta = 1e-3
+    max_relative_error = 5e-3
+
+    def make_tensors(self, stop_gradient=True):
+        return {k: paddle.to_tensor(v, stop_gradient=stop_gradient)
+                for k, v in self.inputs.items()}
+
+    def _call(self, tensors):
+        return type(self).op_fn(*tensors.values(), **self.attrs)
+
+    def check_output(self, rtol=None, atol=None):
+        rtol = rtol or self.rtol
+        atol = atol or self.atol
+        tensors = self.make_tensors()
+        out = self._call(tensors)
+        ref = type(self).ref_fn(*self.inputs.values(), **self.attrs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        refs = ref if isinstance(ref, (list, tuple)) else (ref,)
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                np.asarray(o._data, dtype=np.float64)
+                if jnp.issubdtype(o.dtype, jnp.inexact)
+                else np.asarray(o._data),
+                r, rtol=rtol, atol=atol,
+                err_msg=f"op {type(self).__name__} output mismatch")
+        # jitted path must agree with eager
+        pure = getattr(type(self).op_fn, "__pure_fn__", None)
+        if pure is not None:
+            jitted = jax.jit(
+                lambda *arrays: pure(*arrays, **self.attrs))
+            jout = jitted(*[t._data for t in tensors.values()])
+            jouts = jout if isinstance(jout, (list, tuple)) else (jout,)
+            for o, j in zip(outs, jouts):
+                np.testing.assert_allclose(
+                    np.asarray(j), np.asarray(o._data), rtol=1e-6,
+                    atol=1e-6,
+                    err_msg=f"op {type(self).__name__} eager≠jit")
+
+    # -- gradient checking ---------------------------------------------------
+    def _numeric_grad(self, wrt: str):
+        """Central finite differences of sum(outputs) w.r.t. inputs[wrt]
+        (get_numeric_gradient analogue)."""
+        base = {k: v.astype(np.float64) for k, v in self.inputs.items()}
+        delta = self.numeric_delta
+
+        def loss_at(x):
+            ins = dict(base)
+            ins[wrt] = x
+            tensors = {k: paddle.to_tensor(v.astype(self.inputs[k].dtype))
+                       for k, v in ins.items()}
+            out = self._call(tensors)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            total = 0.0
+            for o in outs:
+                if jnp.issubdtype(o.dtype, jnp.inexact):
+                    total += float(np.asarray(o._data,
+                                              np.float64).sum())
+            return total
+
+        x0 = base[wrt]
+        grad = np.zeros_like(x0, dtype=np.float64)
+        flat = x0.reshape(-1)
+        gflat = grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            up = loss_at(x0)
+            flat[i] = orig - delta
+            down = loss_at(x0)
+            flat[i] = orig
+            gflat[i] = (up - down) / (2 * delta)
+        return grad
+
+    def check_grad(self, inputs_to_check=None, max_relative_error=None,
+                   user_defined_grads=None):
+        names = (inputs_to_check or self.grad_inputs
+                 or [k for k, v in self.inputs.items()
+                     if np.issubdtype(np.asarray(v).dtype, np.floating)])
+        mre = max_relative_error or self.max_relative_error
+        tensors = self.make_tensors(stop_gradient=True)
+        for k in names:
+            tensors[k].stop_gradient = False
+        out = self._call(tensors)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        loss = None
+        for o in outs:
+            if jnp.issubdtype(o.dtype, jnp.inexact):
+                s = o.sum() if o.ndim else o
+                loss = s if loss is None else loss + s.astype(loss.dtype)
+        loss.backward()
+        for i, k in enumerate(names):
+            analytic = np.asarray(tensors[k].grad._data, np.float64)
+            numeric = (user_defined_grads[i] if user_defined_grads
+                       else self._numeric_grad(k))
+            denom = np.maximum(np.abs(numeric), 1.0)
+            rel = np.abs(analytic - numeric) / denom
+            assert rel.max() <= mre, (
+                f"gradient mismatch for '{k}' in {type(self).__name__}: "
+                f"max rel err {rel.max():.2e} > {mre:.2e}\n"
+                f"analytic={analytic.ravel()[:5]}, "
+                f"numeric={numeric.ravel()[:5]}")
