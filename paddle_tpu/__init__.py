@@ -25,6 +25,7 @@ from .ops import registry as _registry  # noqa: F401
 
 # namespace-style access: paddle_tpu.tensor.xxx mirrors paddle.tensor
 from . import ops as tensor  # noqa: F401
+from . import linalg  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401

@@ -44,15 +44,28 @@ def save_sharded(state: dict, path: str):
     arrays = jax.tree_util.tree_map(
         lambda v: v._data if isinstance(v, Tensor) else v, state)
     if ocp is not None:
+        # write-new-then-swap so a crash mid-save never loses the previous
+        # good checkpoint (the only copy for preemption recovery)
         path = os.path.abspath(path)
-        if os.path.exists(path):
-            shutil.rmtree(path)
+        tmp = path + ".saving"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path, arrays)
+        ckptr.save(tmp, arrays)
         ckptr.wait_until_finished()
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        if os.path.exists(path):
+            os.rename(path, old)
+        os.rename(tmp, path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
     else:
+        tmp = path + ".pkl.tmp"
         serialization.save(
-            jax.tree_util.tree_map(np.asarray, arrays), path + ".pkl")
+            jax.tree_util.tree_map(np.asarray, arrays), tmp)
+        os.replace(tmp, path + ".pkl")
 
 
 def load_sharded(path: str, target: Optional[dict] = None) -> dict:
@@ -107,12 +120,17 @@ class AutoCheckpoint:
         return epoch
 
     def save_epoch(self, epoch: int):
+        # state files written tmp+rename so a preemption mid-write leaves
+        # the files meta.json points at intact
         ckpt = os.path.join(self.dir, "state")
         if self.model is not None:
-            serialization.save(self.model.state_dict(), ckpt + ".pdparams")
+            serialization.save(self.model.state_dict(),
+                               ckpt + ".pdparams.tmp")
+            os.replace(ckpt + ".pdparams.tmp", ckpt + ".pdparams")
         if self.optimizer is not None:
             serialization.save(self.optimizer.state_dict(),
-                               ckpt + ".pdopt")
+                               ckpt + ".pdopt.tmp")
+            os.replace(ckpt + ".pdopt.tmp", ckpt + ".pdopt")
         tmp = self._meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "job_id": self.job_id}, f)

@@ -1,0 +1,22 @@
+"""paddle.linalg namespace.
+
+The reference exposes linear algebra under the tensor namespace
+(/root/reference/python/paddle/tensor/linalg.py) with top-level re-exports;
+later Paddle gathers them under paddle.linalg. This module provides that
+namespace — notably `linalg.cond` (matrix condition number), which cannot
+live at top level because `paddle.cond` is the control-flow op.
+"""
+from .ops.linalg import (  # noqa: F401
+    bmm, mv, norm, vector_norm, matrix_norm, cholesky, cholesky_solve,
+    inverse, det, slogdet, svd, qr, lu, eig, eigh, eigvals, eigvalsh,
+    solve, triangular_solve, lstsq, matrix_power, matrix_rank, pinv,
+    cross, cond, corrcoef, cov, multi_dot, dist,
+)
+
+__all__ = [
+    "bmm", "mv", "norm", "vector_norm", "matrix_norm", "cholesky",
+    "cholesky_solve", "inverse", "det", "slogdet", "svd", "qr", "lu",
+    "eig", "eigh", "eigvals", "eigvalsh", "solve", "triangular_solve",
+    "lstsq", "matrix_power", "matrix_rank", "pinv", "cross", "cond",
+    "corrcoef", "cov", "multi_dot", "dist",
+]

@@ -116,8 +116,14 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     """paddle.nn.functional.flash_attention-compatible entry.
 
     Layout: [batch, seq, num_heads, head_dim]. Memory O(seq·block) instead
-    of O(seq²); differentiable via jax.vjp of the scan (XLA rematerializes).
+    of O(seq²). On TPU the Pallas/Mosaic kernel (ops.pallas_kernels) owns
+    the hot path; elsewhere the lax.scan online-softmax reference runs
+    (differentiable via jax.vjp of the scan; XLA rematerializes).
     """
+    if dropout == 0.0 and not return_softmax:
+        from ...ops import pallas_kernels as _pk
+        if _pk.pallas_available():
+            return _pk.flash_attention_mha(query, key, value, causal=causal)
     q = jnp.einsum("bsnh->bnsh", query)
     k = jnp.einsum("bsnh->bnsh", key)
     v = jnp.einsum("bsnh->bnsh", value)

@@ -44,11 +44,15 @@ def cond(pred, true_fn, false_fn=None, operands=(), name=None):
             return _unwrap(out)
         return pure
 
+    if false_fn is None:
+        # lax.cond requires both branches to return the same structure;
+        # there is no traced equivalent of "do nothing, return None"
+        raise ValueError(
+            "paddle.cond inside jit/to_static requires both true_fn and "
+            "false_fn (branches must return the same structure); got "
+            "false_fn=None")
     arrays = tuple(_unwrap(o) for o in operands)
-    out = jax.lax.cond(p, wrap(true_fn),
-                       wrap(false_fn) if false_fn is not None
-                       else wrap(lambda *a: a if len(a) != 1 else a[0]),
-                       *arrays)
+    out = jax.lax.cond(p, wrap(true_fn), wrap(false_fn), *arrays)
     if isinstance(out, tuple):
         return tuple(Tensor(o) for o in out)
     return Tensor(out)
@@ -109,8 +113,17 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             if i in branch_fns:
                 return branch_fns[i]()
             return default() if default else None
-        # dense dispatch for traced index
-        idx = jnp.searchsorted(jnp.asarray(keys), idx)
+        karr = jnp.asarray(keys)
+        pos = jnp.searchsorted(karr, idx)
+        if default is not None:
+            # unmatched keys route to the default branch (appended last);
+            # mirrors the eager path above
+            matched = (pos < len(keys)) & (karr[jnp.minimum(
+                pos, len(keys) - 1)] == idx)
+            fns = fns + [default]
+            idx = jnp.where(matched, pos, len(keys))
+        else:
+            idx = pos
     else:
         fns = list(branch_fns)
         if not _is_traced(idx):
@@ -118,6 +131,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             if 0 <= i < len(fns):
                 return fns[i]()
             return default() if default else None
+        if default is not None:
+            in_range = (idx >= 0) & (idx < len(fns))
+            fns = fns + [default]
+            idx = jnp.where(in_range, idx, len(fns) - 1)
 
     def wrap(fn):
         def pure(_):

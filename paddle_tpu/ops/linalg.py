@@ -17,9 +17,12 @@ __all__ = [
     "bmm", "mv", "norm", "vector_norm", "matrix_norm", "cholesky",
     "cholesky_solve", "inverse", "det", "slogdet", "svd", "qr", "lu", "eig",
     "eigh", "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
-    "matrix_power", "matrix_rank", "pinv", "cross", "cond", "corrcoef",
+    "matrix_power", "matrix_rank", "pinv", "cross", "corrcoef",
     "cov", "histogram", "histogramdd", "bincount", "multi_dot", "dist",
 ]
+# "cond" (matrix condition number) is deliberately NOT star-exported: the
+# top-level `paddle.cond` is the control-flow op (ops/control_flow.py).
+# The condition number stays reachable as paddle.linalg.cond.
 
 
 @register_op("bmm")

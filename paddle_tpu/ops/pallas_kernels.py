@@ -1,0 +1,351 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference implements its hot paths as hand-written CUDA
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu,
+fused_elemwise_activation, the cuDNN bindings). The TPU-native equivalent is
+a small set of Pallas/Mosaic kernels that own the MXU/VMEM schedule where XLA
+fusion is not enough. This module provides flash attention (forward +
+backward) as blocked online-softmax kernels:
+
+- forward: grid (batch*heads, q_blocks, k_blocks); q/k/v tiles staged in
+  VMEM, accumulator + running (m, l) stats in VMEM scratch that persists
+  across the sequential k-block grid dimension; emits O and the per-row
+  logsumexp needed by the backward.
+- backward: the standard two-kernel split — a dq kernel iterating k-blocks
+  innermost, and a dk/dv kernel iterating q-blocks innermost — each
+  recomputing P = exp(QK^T·scale − lse) on the fly (no O(s²) residuals).
+
+Everything is O(seq·block) memory, causal blocks above the diagonal are
+skipped, and inputs are padded to MXU-friendly (128, 128) tiles. The
+portable lax.scan reference lives in paddle_tpu.nn.functional.attention;
+correctness of this kernel is tested against it (interpret mode on CPU,
+compiled on TPU).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention_mha", "pallas_available"]
+
+# Max block sizes along the q/k sequence dims. Large blocks amortize the
+# per-grid-step overhead (DMA setup + Mosaic loop) — with head_dim 64 a
+# 128x128 block is only ~4 MFLOP, far too little to hide ~1us/step; 512-wide
+# blocks put ~134 MFLOP per step while staying well under VMEM (~1.5 MB).
+_BQ = 512
+_BK = 512
+_NEG = -1e30
+
+
+def pallas_available() -> bool:
+    """True when a TPU backend (incl. the axon plugin) is the default."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, scale, causal, bq, bk, nk, sk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip blocks strictly above the diagonal
+    run = True
+    if causal:
+        run = j * bk <= (i + 1) * bq - 1
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < sk
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (row >= col)
+        s = jnp.where(mask, s, _NEG)
+
+        m_prev = m_ref[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + pv
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_ref[:, 0]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse stored sublane-replicated (8, bq) to satisfy TPU tiling
+        lse = m_ref[:, 0] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def _flash_fwd_pallas(q, k, v, causal, scale, interpret):
+    """q,k,v: [bh, s, h] padded to (128,128) tiles. Returns (o, lse)."""
+    bh, sq, h = q.shape
+    sk = k.shape[1]
+    bq, bk = min(_BQ, _ceil_to(sq, 128)), min(_BK, _ceil_to(sk, 128))
+    sq_p, sk_p, h_p = _ceil_to(sq, bq), _ceil_to(sk, bk), h
+    q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0)))
+    k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
+    v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0)))
+    nq, nk = sq_p // bq, sk_p // bk
+
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        bq=bq, bk=bk, nk=nk, sk=sk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_p, h_p), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, h_p), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o[:, :sq, :h], lse[:, 0, :sq]
+
+
+# --------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, bq, bk, nk, sk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = j * bk <= (i + 1) * bq - 1
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < sk
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (row >= col)
+        s = jnp.where(mask, s, _NEG)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, bq, bk, nq, sk):
+    j = pl.program_id(1)  # k block
+    i = pl.program_id(2)  # q block (innermost)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (i + 1) * bq - 1 >= j * bk
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < sk
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (row >= col)
+        s = jnp.where(mask, s, _NEG)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        p = jnp.where(mask, p, 0.0)
+        # dv += P^T @ dO
+        pt = p.astype(do.dtype)
+        dv_acc[:] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        # dk += dS^T @ Q * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret):
+    bh, sq, h = q.shape
+    sk = k.shape[1]
+    bq, bk = min(_BQ, _ceil_to(sq, 128)), min(_BK, _ceil_to(sk, 128))
+    sq_p, sk_p, h_p = _ceil_to(sq, bq), _ceil_to(sk, bk), h
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qp = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0)))
+    dop = jnp.pad(do, ((0, 0), (0, sq_p - sq), (0, 0)))
+    # padded q rows: lse=0 → p=exp(-1e30)≈0 under mask anyway; keep 0.
+    # lse/delta carried sublane-replicated (bh, 8, sq) for TPU tiling.
+    lsep = jnp.broadcast_to(
+        jnp.pad(lse, ((0, 0), (0, sq_p - sq)))[:, None, :], (bh, 8, sq_p))
+    deltap = jnp.broadcast_to(
+        jnp.pad(delta, ((0, 0), (0, sq_p - sq)))[:, None, :], (bh, 8, sq_p))
+    nq, nk = sq_p // bq, sk_p // bk
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, sk=sk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, h_p), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, h_p), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, sk=sk),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, h_p), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, h_p), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, h_p), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, h_p), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_p, h_p), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_p, h_p), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, h_p), jnp.float32),
+            pltpu.VMEM((bk, h_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :sq, :h], dk[:, :sk, :h], dv[:, :sk, :h]
+
+
+# ------------------------------------------------------------- public API
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_mha(q, k, v, causal, scale, interpret):
+    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, interpret)
+    return o
+
+
+def _flash_mha_fwd(q, k, v, causal, scale, interpret):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_mha_bwd(causal, scale, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret)
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+
+
+def flash_attention_mha(query, key, value, causal=False, scale=None,
+                        interpret=False):
+    """Flash attention over [batch, seq, num_heads, head_dim] inputs.
+
+    Pallas TPU kernel (Mosaic) with custom VJP; O(seq·block) memory.
+    `interpret=True` runs the same kernels under the Pallas interpreter
+    (used by the CPU test suite).
+    """
+    b, sq, n, h = query.shape
+    sk = key.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(h)
+    q = jnp.einsum("bsnh->bnsh", query).reshape(b * n, sq, h)
+    k = jnp.einsum("bsnh->bnsh", key).reshape(b * n, sk, h)
+    v = jnp.einsum("bsnh->bnsh", value).reshape(b * n, sk, h)
+    o = _flash_mha(q, k, v, bool(causal), float(scale), bool(interpret))
+    return jnp.einsum("bnsh->bsnh", o.reshape(b, n, sq, h))

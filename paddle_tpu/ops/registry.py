@@ -19,6 +19,7 @@ Eager dispatch order (the TraceOp analogue, tracer.cc:132):
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 from typing import Any, Callable, Dict, Optional
@@ -170,16 +171,13 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
 
     try:
         from .. import profiler as _profiler
-        if _profiler._enabled:
-            with _profiler.RecordEvent(name, "Operator"):
-                if requires:
-                    out, vjp_fn = jax.vjp(pure, *arrays)
-                else:
-                    out = pure(*arrays)
-        elif requires:
-            out, vjp_fn = jax.vjp(pure, *arrays)
-        else:
-            out = pure(*arrays)
+        span = (_profiler.RecordEvent(name, "Operator")
+                if _profiler._enabled else contextlib.nullcontext())
+        with span:
+            if requires:
+                out, vjp_fn = jax.vjp(pure, *arrays)
+            else:
+                out = pure(*arrays)
     except _enforce.EnforceNotMet:
         raise
     except Exception as e:  # attach op attribution (op_call_stack analogue)
