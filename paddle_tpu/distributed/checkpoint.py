@@ -37,6 +37,13 @@ def _orbax():
         return None
 
 
+def _barrier(name: str):
+    """Cross-host sync around shared-filesystem mutations (no-op 1-proc)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
 def save_sharded(state: dict, path: str):
     """Save a (possibly sharded) pytree of jax arrays. Orbax when
     available (multi-host safe), pickle fallback."""
@@ -48,19 +55,29 @@ def save_sharded(state: dict, path: str):
         # good checkpoint (the only copy for preemption recovery)
         path = os.path.abspath(path)
         tmp = path + ".saving"
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
+        if jax.process_index() == 0:
+            if not os.path.exists(path) and os.path.isdir(tmp):
+                # crash landed between the two swap renames last time: tmp
+                # holds the newest complete checkpoint — promote, don't delete
+                os.rename(tmp, path)
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+        _barrier("ckpt_pre_save")
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(tmp, arrays)
         ckptr.wait_until_finished()
-        old = path + ".old"
-        if os.path.exists(old):
-            shutil.rmtree(old)
-        if os.path.exists(path):
-            os.rename(path, old)
-        os.rename(tmp, path)
-        if os.path.exists(old):
-            shutil.rmtree(old)
+        _barrier("ckpt_post_save")
+        # directory renames touch the shared filesystem once: process 0 only
+        if jax.process_index() == 0:
+            old = path + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            if os.path.exists(path):
+                os.rename(path, old)
+            os.rename(tmp, path)
+            if os.path.exists(old):
+                shutil.rmtree(old)
+        _barrier("ckpt_post_swap")
     else:
         tmp = path + ".pkl.tmp"
         serialization.save(
@@ -72,6 +89,14 @@ def load_sharded(path: str, target: Optional[dict] = None) -> dict:
     """Restore; when `target` (pytree of arrays with shardings) is given,
     arrays are restored onto those shardings (re-sharding on mesh change)."""
     ocp = _orbax()
+    # a crash between the two swap renames in save_sharded leaves the new
+    # checkpoint at .saving (complete — orbax commits before the swap) or
+    # the previous one at .old; fall back rather than fail auto-resume
+    if ocp is not None and not os.path.isdir(path):
+        for suffix in (".saving", ".old"):
+            if os.path.isdir(path + suffix):
+                path = path + suffix
+                break
     if ocp is not None and os.path.isdir(path):
         ckptr = ocp.StandardCheckpointer()
         if target is not None:
@@ -102,39 +127,53 @@ class AutoCheckpoint:
     def _meta_path(self):
         return os.path.join(self.dir, "meta.json")
 
+    @property
+    def _state_path(self):
+        return os.path.join(self.dir, "state.pdckpt")
+
     def restore_epoch(self) -> int:
         """Last completed epoch + 1, restoring state if present."""
+        if not os.path.exists(self._state_path):
+            return self._restore_legacy()
+        # epoch + model + optimizer live in ONE atomically-replaced file,
+        # so a preemption can never produce a mixed-epoch restore
+        bundle = serialization.load(self._state_path)
+        epoch = int(bundle.get("epoch", -1)) + 1
+        if self.model is not None and bundle.get("model") is not None:
+            self.model.set_state_dict(bundle["model"])
+        if self.optimizer is not None and bundle.get("opt") is not None:
+            self.optimizer.set_state_dict(bundle["opt"])
+        return epoch
+
+    def _restore_legacy(self) -> int:
+        """Read the older split-file layout (meta.json + state.pdparams /
+        state.pdopt) so pre-bundle checkpoints still resume."""
         if not os.path.exists(self._meta_path):
             return 0
         with open(self._meta_path) as f:
             meta = json.load(f)
         epoch = int(meta.get("epoch", -1)) + 1
         ckpt = os.path.join(self.dir, "state")
-        if self.model is not None:
-            state = serialization.load(ckpt + ".pdparams")
-            self.model.set_state_dict(state)
-        if self.optimizer is not None and os.path.exists(
-                ckpt + ".pdopt"):
-            self.optimizer.set_state_dict(
-                serialization.load(ckpt + ".pdopt"))
+        if self.model is not None and os.path.exists(ckpt + ".pdparams"):
+            self.model.set_state_dict(serialization.load(ckpt + ".pdparams"))
+        if self.optimizer is not None and os.path.exists(ckpt + ".pdopt"):
+            self.optimizer.set_state_dict(serialization.load(ckpt + ".pdopt"))
         return epoch
 
     def save_epoch(self, epoch: int):
-        # state files written tmp+rename so a preemption mid-write leaves
-        # the files meta.json points at intact
-        ckpt = os.path.join(self.dir, "state")
-        if self.model is not None:
-            serialization.save(self.model.state_dict(),
-                               ckpt + ".pdparams.tmp")
-            os.replace(ckpt + ".pdparams.tmp", ckpt + ".pdparams")
-        if self.optimizer is not None:
-            serialization.save(self.optimizer.state_dict(),
-                               ckpt + ".pdopt.tmp")
-            os.replace(ckpt + ".pdopt.tmp", ckpt + ".pdopt")
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w") as f:
+        bundle = {
+            "epoch": epoch,
+            "job_id": self.job_id,
+            "model": None if self.model is None else self.model.state_dict(),
+            "opt": (None if self.optimizer is None
+                    else self.optimizer.state_dict()),
+        }
+        tmp = self._state_path + ".tmp"
+        serialization.save(bundle, tmp)
+        os.replace(tmp, self._state_path)  # single atomic commit
+        with open(self._meta_path + ".tmp", "w") as f:
             json.dump({"epoch": epoch, "job_id": self.job_id}, f)
-        os.replace(tmp, self._meta_path)  # atomic commit
+        os.replace(self._meta_path + ".tmp", self._meta_path)  # informational
 
 
 def train_epoch_range(max_epoch_num: int, job_id: str = "default_job",

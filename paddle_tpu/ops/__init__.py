@@ -13,8 +13,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import Tensor, _unwrap
-from . import creation, linalg, logic, manipulation, math, search, stat
+from . import (creation, detection, linalg, logic, manipulation, math,
+               search, sequence, stat)
 from .creation import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -28,6 +31,7 @@ from .stat import *  # noqa: F401,F403
 
 __all__ = (creation.__all__ + math.__all__ + manipulation.__all__
            + logic.__all__ + search.__all__ + linalg.__all__ + stat.__all__
+           + detection.__all__ + sequence.__all__
            + ["einsum", "cond", "while_loop", "case", "switch_case",
               "scan", "fori_loop"])
 
@@ -152,6 +156,9 @@ def _patch_tensor_methods():
         setattr(T, nm + "_", _make_inplace(getattr(math, nm)))
 
     T.mm = math.matmul
+    # Tensor.cond is the matrix condition number (the control-flow `cond`
+    # is never a Tensor method), kept even though linalg.__all__ omits it
+    T.cond = linalg.cond
     T.dim = lambda self: self.ndim
     T.rank = lambda self: Tensor(jnp.asarray(self.ndim))
     T.numel = lambda self: creation.numel(self)

@@ -1,0 +1,995 @@
+"""Detection ops (paddle.fluid.layers.detection / operators/detection parity).
+
+Reference surface: /root/reference/paddle/fluid/operators/detection/ (~18K LoC
+CUDA/C++: yolo_box_op.h, box_coder_op.h, prior_box_op.h, multiclass_nms_op.cc,
+matrix_nms_op.cc, roi_align_op.*, generate_proposals_op.cc, ...) and the
+python wrappers in python/paddle/fluid/layers/detection.py.
+
+TPU-first redesign, not a translation:
+
+* Everything is static-shape. The reference's NMS family returns LoD tensors
+  with data-dependent row counts; XLA cannot do that inside jit, so every op
+  here returns fixed-capacity outputs padded with sentinel label -1 / score 0
+  plus an explicit valid-count tensor. This is the bucketing/padding policy
+  SURVEY.md §7 hard-part (b) calls for, applied uniformly.
+* Greedy hard-NMS is an O(K^2) IoU matrix plus a `lax.fori_loop` over the K
+  sorted candidates — the IoU matrix is one fused VPU kernel under XLA, and
+  the loop carries only a K-bit keep mask (no dynamic gather/scatter).
+* matrix_nms is already pure matrix math (upper-triangular max-IoU decay) and
+  maps to TPU almost verbatim from its math definition.
+* roi_align/roi_pool use vectorized bilinear gathers (vmap over ROIs) instead
+  of the reference's per-pixel scalar loops.
+
+All ops are registered in the global registry so they trace into Programs and
+are differentiable where meaningful (roi_align, sigmoid_focal_loss, yolov3
+pieces).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "prior_box",
+    "density_prior_box", "anchor_generator", "yolo_box", "yolov3_loss",
+    "multiclass_nms", "matrix_nms", "nms", "roi_align", "roi_pool",
+    "generate_proposals", "distribute_fpn_proposals", "collect_fpn_proposals",
+    "sigmoid_focal_loss", "bipartite_match", "target_assign",
+    "detection_output", "polygon_box_transform", "mine_hard_examples",
+]
+
+
+# ---------------------------------------------------------------------------
+# box geometry helpers
+# ---------------------------------------------------------------------------
+
+def _box_area(boxes, normalized=True):
+    off = 0.0 if normalized else 1.0
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0] + off, 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1] + off, 0.0)
+    return w * h
+
+
+def _pairwise_iou(a, b, normalized=True):
+    """a: [N,4], b: [M,4] -> [N,M] IoU (xyxy)."""
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(a, normalized)[:, None] + _box_area(b, normalized)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity")
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """IoU between every box in x [N,4] and y [M,4] -> [N,M].
+
+    Ref: operators/detection/iou_similarity_op.{h,cc}.
+    """
+    return _pairwise_iou(x, y, normalized=box_normalized)
+
+
+@register_op("box_coder")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              variance=None, name=None):
+    """Encode/decode boxes against priors (ref box_coder_op.h:41,118).
+
+    encode: target [R,4], prior [C,4] -> [R,C,4] offsets.
+    decode: target [R,C,4] (or [R,4]), prior broadcast on `axis` -> [R,C,4].
+    prior_box_var may be a [C,4] array or `variance` a python list of 4.
+    """
+    norm = 1.0 if box_normalized else 0.0
+    off = 1.0 - norm
+
+    def center_size(b):
+        w = b[..., 2] - b[..., 0] + off
+        h = b[..., 3] - b[..., 1] + off
+        cx = b[..., 0] + w / 2
+        cy = b[..., 1] + h / 2
+        return cx, cy, w, h
+
+    pcx, pcy, pw, ph = center_size(prior_box)
+    if code_type == "encode_center_size":
+        tcx = (target_box[..., 2] + target_box[..., 0]) / 2
+        tcy = (target_box[..., 3] + target_box[..., 1]) / 2
+        tw = target_box[..., 2] - target_box[..., 0] + off
+        th = target_box[..., 3] - target_box[..., 1] + off
+        # broadcast row(target) x col(prior)
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if prior_box_var is not None:
+            out = out / prior_box_var[None, :, :]
+        elif variance:
+            out = out / jnp.asarray(variance, out.dtype)
+        return out
+    elif code_type == "decode_center_size":
+        t = target_box
+        if t.ndim == 2:
+            t = t[:, None, :]
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_ = (v[None, :] for v in (pcx, pcy, pw, ph))
+            pvar = None if prior_box_var is None else prior_box_var[None, :, :]
+        else:
+            pcx_, pcy_, pw_, ph_ = (v[:, None] for v in (pcx, pcy, pw, ph))
+            pvar = None if prior_box_var is None else prior_box_var[:, None, :]
+        if pvar is not None:
+            t = t * pvar
+        elif variance:
+            t = t * jnp.asarray(variance, t.dtype)
+        dcx = t[..., 0] * pw_ + pcx_
+        dcy = t[..., 1] * ph_ + pcy_
+        dw = jnp.exp(t[..., 2]) * pw_
+        dh = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - off, dcy + dh / 2 - off], axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+@register_op("box_clip")
+def box_clip(input, im_info, name=None):
+    """Clip boxes [..., 4] to image bounds. im_info: [H, W, scale] per image
+    (ref box_clip_op.h — clips to im_info/scale - 1)."""
+    im_info = jnp.asarray(im_info)
+    if im_info.ndim == 1:
+        h = im_info[0] / im_info[2] - 1
+        w = im_info[1] / im_info[2] - 1
+    else:
+        h = im_info[:, 0] / im_info[:, 2] - 1
+        w = im_info[:, 1] / im_info[:, 2] - 1
+        shape = (-1,) + (1,) * (input.ndim - 2)
+        h = h.reshape(shape)
+        w = w.reshape(shape)
+    x1 = jnp.clip(input[..., 0], 0, w)
+    y1 = jnp.clip(input[..., 1], 0, h)
+    x2 = jnp.clip(input[..., 2], 0, w)
+    y2 = jnp.clip(input[..., 3], 0, h)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# anchor / prior generation
+# ---------------------------------------------------------------------------
+
+@register_op("prior_box")
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (ref prior_box_op.{h,cc}).
+
+    input: feature map [N,C,H,W]; image: [N,C,IH,IW].
+    Returns (boxes [H,W,P,4], variances [H,W,P,4]), normalized xyxy.
+    """
+    h, w = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] if max_sizes else []
+    # expand aspect ratios like ExpandAspectRatios (flip adds 1/r)
+    ars = [1.0]
+    for r in np.atleast_1d(aspect_ratios):
+        r = float(r)
+        if not any(abs(r - e) < 1e-6 for e in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+    step_w = float(steps[0]) if steps[0] else img_w / w
+    step_h = float(steps[1]) if steps[1] else img_h / h
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            widths.append(ms); heights.append(ms)
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                s = math.sqrt(ms * mx)
+                widths.append(s); heights.append(s)
+            for r in ars:
+                if abs(r - 1.0) < 1e-6:
+                    continue
+                widths.append(ms * math.sqrt(r)); heights.append(ms / math.sqrt(r))
+        else:
+            for r in ars:
+                widths.append(ms * math.sqrt(r)); heights.append(ms / math.sqrt(r))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                s = math.sqrt(ms * mx)
+                widths.append(s); heights.append(s)
+    pw = jnp.asarray(widths, jnp.float32)
+    ph = jnp.asarray(heights, jnp.float32)
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H,W]
+    boxes = jnp.stack([
+        (cxg[..., None] - pw / 2) / img_w,
+        (cyg[..., None] - ph / 2) / img_h,
+        (cxg[..., None] + pw / 2) / img_w,
+        (cyg[..., None] + ph / 2) / img_h,
+    ], axis=-1)  # [H,W,P,4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+@register_op("density_prior_box")
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Densified priors (ref density_prior_box_op.h). Returns (boxes, vars)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = float(steps[0]) if steps[0] else img_w / w
+    step_h = float(steps[1]) if steps[1] else img_h / h
+    centers = []
+    dims = []
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * math.sqrt(ratio)
+            bh = size / math.sqrt(ratio)
+            shift = int(step_w / dens)
+            for di in range(dens):
+                for dj in range(dens):
+                    centers.append((dj * shift + shift / 2.0 - step_w / 2.0,
+                                    di * shift + shift / 2.0 - step_h / 2.0))
+                    dims.append((bw, bh))
+    offs = jnp.asarray(centers, jnp.float32)      # [P,2]
+    whs = jnp.asarray(dims, jnp.float32)          # [P,2]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ccx = cxg[..., None] + offs[:, 0]
+    ccy = cyg[..., None] + offs[:, 1]
+    boxes = jnp.stack([
+        (ccx - whs[:, 0] / 2) / img_w,
+        (ccy - whs[:, 1] / 2) / img_h,
+        (ccx + whs[:, 0] / 2) / img_w,
+        (ccy + whs[:, 1] / 2) / img_h,
+    ], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return boxes, var
+
+
+@register_op("anchor_generator")
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """RPN anchors (ref anchor_generator_op.h). boxes in absolute xyxy."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    dims = []
+    for r in aspect_ratios:
+        for s in anchor_sizes:
+            area = sw * sh
+            area_ratios = area / r
+            base_w = round(math.sqrt(area_ratios))
+            base_h = round(base_w * r)
+            scale_w = s / sw
+            scale_h = s / sh
+            dims.append((scale_w * base_w, scale_h * base_h))
+    whs = jnp.asarray(dims, jnp.float32)  # [A,2]
+    cx = (jnp.arange(w, dtype=jnp.float32) * sw) + offset * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) * sh) + offset * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    anchors = jnp.stack([
+        cxg[..., None] - 0.5 * whs[:, 0],
+        cyg[..., None] - 0.5 * whs[:, 1],
+        cxg[..., None] + 0.5 * whs[:, 0],
+        cyg[..., None] + 0.5 * whs[:, 1],
+    ], axis=-1)  # [H,W,A,4]
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+# ---------------------------------------------------------------------------
+# YOLO
+# ---------------------------------------------------------------------------
+
+@register_op("yolo_box")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None):
+    """Decode YOLOv3 head output (ref yolo_box_op.h:28-82 GetYoloBox et al).
+
+    x: [N, A*(5+C), H, W]; img_size: [N,2] (h,w) int.
+    Returns (boxes [N, A*H*W, 4] xyxy in image coords, scores [N,A*H*W,C]).
+    Candidates with objectness < conf_thresh are zeroed (reference skips
+    writing them; zero-filled output is bit-identical to its memset).
+    """
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    anc = jnp.asarray(anchors, x.dtype).reshape(an, 2)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    in_h = downsample_ratio * h
+    in_w = downsample_ratio * w
+
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    tx, ty, tw, th, tobj = (x[:, :, 0], x[:, :, 1], x[:, :, 2], x[:, :, 3],
+                            x[:, :, 4])
+    cls = x[:, :, 5:]                                  # [N,A,C,H,W]
+    gx = jnp.arange(w, dtype=x.dtype)                  # l (cols)
+    gy = jnp.arange(h, dtype=x.dtype)                  # k (rows)
+    img_h = img_size[:, 0].astype(x.dtype).reshape(n, 1, 1, 1)
+    img_w = img_size[:, 1].astype(x.dtype).reshape(n, 1, 1, 1)
+
+    cx = (gx[None, None, None, :] + jax.nn.sigmoid(tx) * scale + bias) \
+        * img_w / w
+    cy = (gy[None, None, :, None] + jax.nn.sigmoid(ty) * scale + bias) \
+        * img_h / h
+    bw = jnp.exp(tw) * anc[None, :, 0, None, None] * img_w / in_w
+    bh = jnp.exp(th) * anc[None, :, 1, None, None] * img_h / in_h
+    x1, y1 = cx - bw / 2, cy - bh / 2
+    x2, y2 = cx + bw / 2, cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0.0)
+        y1 = jnp.maximum(y1, 0.0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)       # [N,A,H,W,4]
+    conf = jax.nn.sigmoid(tobj)                        # [N,A,H,W]
+    keep = (conf >= conf_thresh).astype(x.dtype)
+    boxes = boxes * keep[..., None]
+    scores = jax.nn.sigmoid(cls) * (conf * keep)[:, :, None]   # [N,A,C,H,W]
+    boxes = boxes.reshape(n, an * h * w, 4)
+    scores = jnp.moveaxis(scores, 2, -1).reshape(n, an * h * w, class_num)
+    return boxes, scores
+
+
+@register_op("yolov3_loss")
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (ref yolov3_loss_op.h semantics, vectorized).
+
+    x: [N, M*(5+C), H, W]; gt_box: [N,B,4] (cx,cy,w,h, normalized to image);
+    gt_label: [N,B] int; returns per-image loss [N].
+    Objectness targets: best-anchor match per gt assigns positives; negatives
+    ignore when best IoU vs any gt > ignore_thresh.
+    """
+    n, _, h, w = x.shape
+    mask = list(anchor_mask)
+    m = len(mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    x = x.reshape(n, m, 5 + class_num, h, w).astype(jnp.float32)
+    px, py, pw, ph, pobj = (x[:, :, 0], x[:, :, 1], x[:, :, 2], x[:, :, 3],
+                            x[:, :, 4])
+    pcls = x[:, :, 5:]                                  # [N,M,C,H,W]
+
+    gtb = gt_box.astype(jnp.float32)                    # [N,B,4] cx cy w h
+    valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)       # [N,B]
+
+    # best anchor (over ALL anchors) per gt by shape-only IoU at origin
+    gw = gtb[..., 2] * in_w
+    gh = gtb[..., 3] * in_h
+    inter = (jnp.minimum(gw[..., None], an_all[:, 0])
+             * jnp.minimum(gh[..., None], an_all[:, 1]))
+    union = gw[..., None] * gh[..., None] + an_all[:, 0] * an_all[:, 1] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+    # position in this level's mask, or -1
+    mask_arr = jnp.asarray(mask)
+    an_pos = jnp.argmax(best_anchor[..., None] == mask_arr, axis=-1)
+    in_level = jnp.any(best_anchor[..., None] == mask_arr, axis=-1) & valid
+
+    gi = jnp.clip((gtb[..., 0] * w).astype(jnp.int32), 0, w - 1)  # [N,B]
+    gj = jnp.clip((gtb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # scatter gt targets onto the grid: obj mask, tx ty tw th, class
+    tgt_shape = (n, m, h, w)
+    obj_mask = jnp.zeros(tgt_shape, jnp.float32)
+    b_idx = jnp.broadcast_to(jnp.arange(n)[:, None], gi.shape)
+    sel = in_level
+    obj_mask = obj_mask.at[b_idx, an_pos, gj, gi].add(
+        jnp.where(sel, 1.0, 0.0))
+    obj_mask = jnp.minimum(obj_mask, 1.0)
+
+    tx = gtb[..., 0] * w - gi
+    ty = gtb[..., 1] * h - gj
+    an_w = an_all[mask_arr][:, 0]
+    an_h = an_all[mask_arr][:, 1]
+    tw_t = jnp.log(jnp.maximum(gw / jnp.maximum(an_w[an_pos], 1e-9), 1e-9))
+    th_t = jnp.log(jnp.maximum(gh / jnp.maximum(an_h[an_pos], 1e-9), 1e-9))
+    box_scale = 2.0 - gtb[..., 2] * gtb[..., 3]
+
+    def gather_pred(p):
+        return p[b_idx, an_pos, gj, gi]                 # [N,B]
+
+    bce = lambda logit, label: (jnp.maximum(logit, 0) - logit * label
+                                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    sel_f = jnp.where(sel, 1.0, 0.0)
+    # coordinate losses (reference uses sigmoid-CE for x,y; L1 for w,h)
+    loss_x = bce(gather_pred(px), tx) * box_scale * sel_f
+    loss_y = bce(gather_pred(py), ty) * box_scale * sel_f
+    loss_w = jnp.abs(gather_pred(pw) - tw_t) * box_scale * sel_f
+    loss_h = jnp.abs(gather_pred(ph) - th_t) * box_scale * sel_f
+
+    # objectness: positives at assigned cells; negatives elsewhere unless
+    # predicted box IoU vs any gt exceeds ignore_thresh
+    pred_boxes = _yolo_pred_boxes(px, py, pw, ph, an_all[mask_arr], w, h,
+                                  in_w, in_h, scale, bias)  # [N,M,H,W,4] cxcywh norm
+    ious = _iou_cxcywh(pred_boxes.reshape(n, -1, 4), gtb, valid)  # [N,MHW,B]
+    best_iou = jnp.max(ious, axis=-1).reshape(n, m, h, w)
+    noobj_mask = ((best_iou <= ignore_thresh).astype(jnp.float32)
+                  * (1.0 - obj_mask))
+    loss_obj = (bce(pobj, jnp.ones_like(pobj)) * obj_mask
+                + bce(pobj, jnp.zeros_like(pobj)) * noobj_mask)
+
+    # classification at positive cells; label smoothing per ref
+    # yolov3_loss_op.h:285-291 (pos = 1-sw, neg = sw, sw = min(1/C, 1/40))
+    sw = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(gt_label, class_num, dtype=jnp.float32)
+    onehot = onehot * (1.0 - 2.0 * sw) + sw
+    pcls_g = pcls[b_idx[..., None], an_pos[..., None],
+                  jnp.arange(class_num)[None, None, :], gj[..., None],
+                  gi[..., None]]                       # [N,B,C]
+    score_w = sel_f if gt_score is None else sel_f * gt_score
+    loss_cls = (bce(pcls_g, onehot).sum(-1) * score_w)
+
+    per_img = (loss_x.sum(1) + loss_y.sum(1) + loss_w.sum(1) + loss_h.sum(1)
+               + loss_obj.sum((1, 2, 3)) + loss_cls.sum(1))
+    return per_img
+
+
+def _yolo_pred_boxes(px, py, pw, ph, anc, w, h, in_w, in_h, scale, bias):
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    cx = (gx[None, None, None, :] + jax.nn.sigmoid(px) * scale + bias) / w
+    cy = (gy[None, None, :, None] + jax.nn.sigmoid(py) * scale + bias) / h
+    bw = jnp.exp(jnp.clip(pw, -10, 10)) * anc[None, :, 0, None, None] / in_w
+    bh = jnp.exp(jnp.clip(ph, -10, 10)) * anc[None, :, 1, None, None] / in_h
+    return jnp.stack([cx, cy, bw, bh], axis=-1)
+
+
+def _iou_cxcywh(pred, gt, valid):
+    """pred [N,P,4], gt [N,B,4] both cx,cy,w,h -> IoU [N,P,B]."""
+    def xyxy(b):
+        return jnp.stack([b[..., 0] - b[..., 2] / 2, b[..., 1] - b[..., 3] / 2,
+                          b[..., 0] + b[..., 2] / 2, b[..., 1] + b[..., 3] / 2],
+                         -1)
+    p = xyxy(pred)
+    g = xyxy(gt)
+    lt = jnp.maximum(p[:, :, None, :2], g[:, None, :, :2])
+    rb = jnp.minimum(p[:, :, None, 2:], g[:, None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    pa = jnp.maximum(p[..., 2] - p[..., 0], 0) * jnp.maximum(p[..., 3] - p[..., 1], 0)
+    ga = jnp.maximum(g[..., 2] - g[..., 0], 0) * jnp.maximum(g[..., 3] - g[..., 1], 0)
+    union = pa[:, :, None] + ga[:, None, :] - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+    return jnp.where(valid[:, None, :], iou, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# NMS family — fixed-capacity, mask-based (TPU static shapes)
+# ---------------------------------------------------------------------------
+
+def _greedy_nms_mask(boxes, scores, iou_threshold, normalized=True):
+    """Greedy hard NMS over pre-sorted (desc) candidates.
+
+    boxes [K,4], scores [K] sorted descending. Returns keep mask [K] bool.
+    One O(K^2) IoU matrix + a fori_loop carrying the keep mask — no dynamic
+    shapes, no gather in the loop body.
+    """
+    k = boxes.shape[0]
+    iou = _pairwise_iou(boxes, boxes, normalized)      # [K,K]
+    sup = iou > iou_threshold
+
+    def body(i, keep):
+        # candidate i survives iff no higher-ranked kept box suppresses it
+        alive = ~jnp.any(sup[:, i] & keep & (jnp.arange(k) < i))
+        return keep.at[i].set(alive & keep[i])
+
+    init = scores > -jnp.inf                            # all candidates
+    return jax.lax.fori_loop(0, k, body, init)
+
+
+@register_op("nms")
+def nms(boxes, scores, iou_threshold=0.3, top_k=-1, name=None):
+    """Single-class hard NMS. Returns (keep_indices [K] sorted by score,
+    keep_mask [K]) where K = top_k or num boxes. Padded entries index -1."""
+    k = boxes.shape[0] if top_k in (-1, None) else min(int(top_k),
+                                                       boxes.shape[0])
+    sc, order = jax.lax.top_k(scores, k)
+    bx = boxes[order]
+    keep = _greedy_nms_mask(bx, sc, iou_threshold)
+    idx = jnp.where(keep, order, -1)
+    return idx, keep
+
+
+@register_op("multiclass_nms")
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   name=None):
+    """Multi-class NMS (ref multiclass_nms_op.cc semantics, static shapes).
+
+    bboxes: [N, M, 4]; scores: [N, C, M].
+    Returns (out [N, keep_top_k, 6] rows = [label, score, x1,y1,x2,y2],
+    valid_counts [N]); padded rows have label -1. The reference returns a
+    LoD tensor with data-dependent rows — fixed capacity + counts is the
+    XLA-native equivalent (callers slice by valid_counts on host).
+    """
+    n, num_boxes, _ = bboxes.shape
+    num_cls = scores.shape[1]
+    k = min(int(nms_top_k), num_boxes) if nms_top_k > 0 else num_boxes
+
+    def per_image(bx, sc):
+        # per class: top-k, threshold, nms
+        def per_class(c_scores):
+            s, order = jax.lax.top_k(c_scores, k)
+            b = bx[order]
+            valid = s > score_threshold
+            keep = _greedy_nms_mask(b, jnp.where(valid, s, -jnp.inf),
+                                    nms_threshold, normalized) & valid
+            return b, jnp.where(keep, s, -1.0), order
+        cb, cs, cidx = jax.vmap(per_class)(sc)          # [C,k,4],[C,k],[C,k]
+        labels = jnp.broadcast_to(jnp.arange(num_cls)[:, None], cs.shape)
+        if background_label >= 0:
+            cs = jnp.where(labels == background_label, -1.0, cs)
+        flat_s = cs.reshape(-1)
+        flat_b = cb.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        flat_i = cidx.reshape(-1)
+        kk = min(int(keep_top_k), flat_s.shape[0]) if keep_top_k > 0 \
+            else flat_s.shape[0]
+        s_top, sel = jax.lax.top_k(flat_s, kk)
+        good = s_top > 0
+        out = jnp.concatenate([
+            jnp.where(good, flat_l[sel], -1).astype(bx.dtype)[:, None],
+            jnp.where(good, s_top, 0.0)[:, None],
+            flat_b[sel] * good[:, None].astype(bx.dtype),
+        ], axis=1)
+        return out, good.sum().astype(jnp.int32), jnp.where(good, flat_i[sel], -1)
+
+    out, counts, index = jax.vmap(per_image)(bboxes, scores)
+    if return_index:
+        return out, counts, index
+    return out, counts
+
+
+@register_op("matrix_nms")
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               name=None):
+    """Matrix NMS (ref matrix_nms_op.cc) — decay by max-IoU with any
+    higher-scored same-class box; pure matrix math, ideal on TPU.
+
+    Returns (out [N, keep_top_k, 6], valid_counts [N])."""
+    n, num_boxes, _ = bboxes.shape
+    num_cls = scores.shape[1]
+    k = min(int(nms_top_k), num_boxes) if nms_top_k > 0 else num_boxes
+
+    def per_image(bx, sc):
+        def per_class(c_scores):
+            s, order = jax.lax.top_k(c_scores, k)
+            b = bx[order]
+            valid = s > score_threshold
+            iou = _pairwise_iou(b, b, normalized)
+            tri = jnp.tril(iou, -1)                     # [k,k] j<i
+            max_iou = jnp.max(tri, axis=1)              # compensate IoU
+            if use_gaussian:
+                decay = jnp.exp(-(tri ** 2 - max_iou[None, :] ** 2)
+                                / gaussian_sigma)
+            else:
+                decay = (1.0 - tri) / jnp.maximum(1.0 - max_iou[None, :], 1e-9)
+            decay = jnp.where(jnp.tril(jnp.ones_like(iou, bool), -1),
+                              decay, jnp.inf)
+            dec = jnp.min(decay, axis=1)
+            dec = jnp.where(jnp.arange(k) == 0, 1.0, dec)
+            s2 = jnp.where(valid, s * dec, -1.0)
+            if post_threshold > 0:
+                s2 = jnp.where(s2 > post_threshold, s2, -1.0)
+            return b, s2
+        cb, cs = jax.vmap(per_class)(sc)
+        labels = jnp.broadcast_to(jnp.arange(num_cls)[:, None], cs.shape)
+        if background_label >= 0:
+            cs = jnp.where(labels == background_label, -1.0, cs)
+        flat_s = cs.reshape(-1)
+        flat_b = cb.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        kk = min(int(keep_top_k), flat_s.shape[0]) if keep_top_k > 0 \
+            else flat_s.shape[0]
+        s_top, sel = jax.lax.top_k(flat_s, kk)
+        good = s_top > 0
+        out = jnp.concatenate([
+            jnp.where(good, flat_l[sel], -1).astype(bx.dtype)[:, None],
+            jnp.where(good, s_top, 0.0)[:, None],
+            flat_b[sel] * good[:, None].astype(bx.dtype),
+        ], axis=1)
+        return out, good.sum().astype(jnp.int32)
+
+    return jax.vmap(per_image)(bboxes, scores)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+@register_op("roi_align")
+def roi_align(input, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              rois_num=None, aligned=True, name=None):
+    """ROIAlign (ref roi_align_op.* bilinear sampling), vmapped over ROIs.
+
+    input: [N,C,H,W]; rois: [R,4] xyxy (image coords) or [R,5] with batch idx
+    in col 0 (when rois_num is None and width 5). Differentiable.
+    """
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    n, c, h, w = input.shape
+    if rois.shape[-1] == 5:
+        batch_idx = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    elif rois_num is not None:
+        rois_num = jnp.asarray(rois_num)
+        batch_idx = jnp.repeat(jnp.arange(n), rois_num,
+                               total_repeat_length=rois.shape[0])
+        boxes = rois
+    else:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(box, b):
+        x1 = box[0] * spatial_scale - offset
+        y1 = box[1] * spatial_scale - offset
+        x2 = box[2] * spatial_scale - offset
+        y2 = box[3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [ph, sr] y coords, [pw, sr] x coords
+        iy = (jnp.arange(ph)[:, None] * bin_h + y1
+              + (jnp.arange(sr) + 0.5) * bin_h / sr)   # [ph,sr]
+        ix = (jnp.arange(pw)[:, None] * bin_w + x1
+              + (jnp.arange(sr) + 0.5) * bin_w / sr)   # [pw,sr]
+        feat = jax.lax.dynamic_index_in_dim(input, b, 0, False)  # [C,H,W]
+
+        def bilinear(y, x):
+            inb = (y >= -1.0) & (y <= h) & (x >= -1.0) & (x <= w)
+            y = jnp.clip(y, 0.0, h - 1)
+            x = jnp.clip(x, 0.0, w - 1)
+            y0 = jnp.floor(y)
+            x0 = jnp.floor(x)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            ly = y - y0
+            lx = x - x0
+            y0i, x0i, y1i, x1i = (y0.astype(jnp.int32), x0.astype(jnp.int32),
+                                  y1_.astype(jnp.int32), x1_.astype(jnp.int32))
+            v = (feat[:, y0i, x0i] * (1 - ly) * (1 - lx)
+                 + feat[:, y0i, x1i] * (1 - ly) * lx
+                 + feat[:, y1i, x0i] * ly * (1 - lx)
+                 + feat[:, y1i, x1i] * ly * lx)
+            return jnp.where(inb, v, 0.0)
+
+        # average over sr*sr samples per bin
+        ys = iy.reshape(ph, sr, 1, 1, 1)                # broadcast vs xs
+        xs = ix.reshape(1, 1, pw, sr, 1)
+        yy = jnp.broadcast_to(ys, (ph, sr, pw, sr, 1))[..., 0]
+        xx = jnp.broadcast_to(xs, (ph, sr, pw, sr, 1))[..., 0]
+        vals = bilinear(yy.reshape(-1), xx.reshape(-1))  # [C, ph*sr*pw*sr]
+        vals = vals.reshape(c, ph, sr, pw, sr)
+        return vals.mean(axis=(2, 4))                    # [C,ph,pw]
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+@register_op("roi_pool")
+def roi_pool(input, rois, output_size, spatial_scale=1.0, rois_num=None,
+             name=None):
+    """ROI max pooling (ref roi_pool_op.*). rois in xyxy image coords."""
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    n, c, h, w = input.shape
+    if rois.shape[-1] == 5:
+        batch_idx = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    elif rois_num is not None:
+        batch_idx = jnp.repeat(jnp.arange(n), jnp.asarray(rois_num),
+                               total_repeat_length=rois.shape[0])
+        boxes = rois
+    else:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+
+    ygrid = jnp.arange(h)
+    xgrid = jnp.arange(w)
+
+    def one_roi(box, b):
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        feat = jax.lax.dynamic_index_in_dim(input, b, 0, False)  # [C,H,W]
+
+        def one_bin(i, j):
+            ys = jnp.clip(jnp.floor(y1 + i * bin_h), 0, h).astype(jnp.int32)
+            ye = jnp.clip(jnp.ceil(y1 + (i + 1) * bin_h), 0, h).astype(jnp.int32)
+            xs = jnp.clip(jnp.floor(x1 + j * bin_w), 0, w).astype(jnp.int32)
+            xe = jnp.clip(jnp.ceil(x1 + (j + 1) * bin_w), 0, w).astype(jnp.int32)
+            m = ((ygrid[:, None] >= ys) & (ygrid[:, None] < ye)
+                 & (xgrid[None, :] >= xs) & (xgrid[None, :] < xe))
+            empty = ~jnp.any(m)
+            v = jnp.where(m[None], feat, -jnp.inf).max(axis=(1, 2))
+            return jnp.where(empty, 0.0, v)
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        vals = jax.vmap(one_bin)(ii.reshape(-1), jj.reshape(-1))  # [ph*pw,C]
+        return vals.T.reshape(c, ph, pw)
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+# ---------------------------------------------------------------------------
+# proposals / FPN
+# ---------------------------------------------------------------------------
+
+@register_op("generate_proposals")
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=True, name=None):
+    """RPN proposal generation (ref generate_proposals_v2 semantics).
+
+    scores [N,A,H,W]; bbox_deltas [N,4A,H,W]; anchors [H,W,A,4] or [HWA,4];
+    im_shape [N,2]. Returns (rois [N, post_nms_top_n, 4], roi_probs
+    [N, post_nms_top_n, 1], rois_num [N]). Fixed-capacity, zero-padded.
+    """
+    n = scores.shape[0]
+    anchors = anchors.reshape(-1, 4)
+    variances = variances.reshape(-1, 4)
+    a = scores.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+
+    def per_image(sc, deltas, im):
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)          # [HWA]
+        d = deltas.reshape(a, 4, *deltas.shape[1:])
+        d = jnp.transpose(d, (2, 3, 0, 1)).reshape(-1, 4)     # [HWA,4]
+        k = min(int(pre_nms_top_n), s.shape[0])
+        s_top, order = jax.lax.top_k(s, k)
+        anc = anchors[order]
+        var = variances[order]
+        dd = d[order]
+        # decode (BoxCoder decode_center_size with per-anchor variances)
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * dd[:, 0] * aw + acx
+        cy = var[:, 1] * dd[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(var[:, 2] * dd[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(var[:, 3] * dd[:, 3], 10.0)) * ah
+        props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        # clip to image
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, im[1] - off),
+            jnp.clip(props[:, 1], 0, im[0] - off),
+            jnp.clip(props[:, 2], 0, im[1] - off),
+            jnp.clip(props[:, 3], 0, im[0] - off)], -1)
+        # filter small
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        ok = (ws >= min_size) & (hs >= min_size)
+        s_f = jnp.where(ok, s_top, -jnp.inf)
+        keep = _greedy_nms_mask(props, s_f, nms_thresh, normalized=not pixel_offset) \
+            & ok
+        s_keep = jnp.where(keep, s_f, -jnp.inf)
+        kk = min(int(post_nms_top_n), k)
+        s_fin, sel = jax.lax.top_k(s_keep, kk)
+        good = jnp.isfinite(s_fin)
+        rois = props[sel] * good[:, None]
+        return rois, jnp.where(good, s_fin, 0.0)[:, None], \
+            good.sum().astype(jnp.int32)
+
+    return jax.vmap(per_image)(scores, bbox_deltas, im_shape)
+
+
+@register_op("distribute_fpn_proposals")
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """Assign each ROI to an FPN level (ref distribute_fpn_proposals_op).
+
+    fpn_rois [R,4]. Returns (level_ids [R] in [0, L), restore_index [R],
+    per-level masks [L,R]). Static-shape variant: callers use the mask to
+    zero out rows instead of materializing ragged per-level lists.
+    """
+    off = 1.0 if pixel_offset else 0.0
+    ws = fpn_rois[:, 2] - fpn_rois[:, 0] + off
+    hs = fpn_rois[:, 3] - fpn_rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    num_l = max_level - min_level + 1
+    ids = lvl - min_level
+    masks = jax.nn.one_hot(ids, num_l, dtype=jnp.bool_).T   # [L,R]
+    order = jnp.argsort(ids, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    return ids, restore, masks
+
+
+@register_op("collect_fpn_proposals")
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n,
+                          rois_num_per_level=None, name=None):
+    """Merge per-level ROIs by score, keep top post_nms_top_n
+    (ref collect_fpn_proposals_op). multi_rois: list of [Ri,4]."""
+    rois = jnp.concatenate(list(multi_rois), axis=0)
+    scores = jnp.concatenate([s.reshape(-1) for s in multi_scores], axis=0)
+    k = min(int(post_nms_top_n), scores.shape[0])
+    s_top, sel = jax.lax.top_k(scores, k)
+    return rois[sel], s_top
+
+
+# ---------------------------------------------------------------------------
+# losses / assignment
+# ---------------------------------------------------------------------------
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    """Focal loss (ref sigmoid_focal_loss_op.h). x: [N,C] logits;
+    label: [N,1] int in [0,C] (0 = background); fg_num: [1] int."""
+    n, c = x.shape
+    label = label.reshape(-1)
+    fg = jnp.maximum(jnp.asarray(fg_num, jnp.float32).reshape(()), 1.0)
+    # per-class binary target: label-1 == class index
+    tgt = jax.nn.one_hot(label - 1, c, dtype=x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce_pos = -jnp.log(jnp.maximum(p, 1e-12))
+    ce_neg = -jnp.log(jnp.maximum(1 - p, 1e-12))
+    loss = (tgt * alpha * ((1 - p) ** gamma) * ce_pos
+            + (1 - tgt) * (1 - alpha) * (p ** gamma) * ce_neg)
+    return loss / fg
+
+
+@register_op("bipartite_match")
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (ref bipartite_match_op.cc BipartiteMatch).
+
+    dist_matrix [N,M] (rows = gt, cols = priors). Returns
+    (match_indices [M] int: row matched to each col or -1,
+     match_dist [M]). match_type='per_prediction' additionally matches
+    unmatched cols to their argmax row when dist > dist_threshold.
+    """
+    n, m = dist_matrix.shape
+
+    def body(_, state):
+        match_idx, match_d, used_r, used_c = state
+        masked = jnp.where(used_r[:, None] | used_c[None, :], -jnp.inf,
+                           dist_matrix)
+        flat = jnp.argmax(masked)
+        r, c2 = flat // m, flat % m
+        best = masked.reshape(-1)[flat]
+        ok = jnp.isfinite(best) & (best > -jnp.inf)
+        match_idx = jnp.where(ok, match_idx.at[c2].set(r), match_idx)
+        match_d = jnp.where(ok, match_d.at[c2].set(
+            jnp.maximum(best, 0.0)), match_d)
+        used_r = jnp.where(ok, used_r.at[r].set(True), used_r)
+        used_c = jnp.where(ok, used_c.at[c2].set(True), used_c)
+        return match_idx, match_d, used_r, used_c
+
+    init = (jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), dist_matrix.dtype),
+            jnp.zeros((n,), bool), jnp.zeros((m,), bool))
+    match_idx, match_d, _, _ = jax.lax.fori_loop(0, min(n, m), body, init)
+    if match_type == "per_prediction":
+        col_best = jnp.argmax(dist_matrix, axis=0)
+        col_dist = jnp.max(dist_matrix, axis=0)
+        extra = (match_idx < 0) & (col_dist > dist_threshold)
+        match_idx = jnp.where(extra, col_best.astype(jnp.int32), match_idx)
+        match_d = jnp.where(extra, col_dist, match_d)
+    return match_idx, match_d
+
+
+@register_op("target_assign")
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Gather rows of `input` [N,K] by matched_indices [M] (−1 → mismatch
+    value, weight 0) → (out [M,K], out_weight [M,1]).
+    Ref target_assign_op.h."""
+    mi = matched_indices.reshape(-1)
+    ok = mi >= 0
+    safe = jnp.maximum(mi, 0)
+    out = jnp.where(ok[:, None], input[safe],
+                    jnp.asarray(mismatch_value, input.dtype))
+    wt = ok.astype(input.dtype)[:, None]
+    return out, wt
+
+
+@register_op("detection_output")
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """SSD head post-processing: decode against priors + multiclass NMS
+    (ref layers/detection.py detection_output). loc [N,M,4], scores [N,M,C]
+    (softmax-ed), priors [M,4]. Returns (out [N,keep_top_k,6], counts [N])."""
+    decoded = jax.vmap(lambda l: _decode_ssd(prior_box, prior_box_var, l))(loc)
+    sc = jnp.transpose(scores, (0, 2, 1))               # [N,C,M]
+    return multiclass_nms.__pure_fn__(
+        decoded, sc, score_threshold=score_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+        background_label=background_label)
+
+
+def _decode_ssd(prior, pvar, loc):
+    pw = prior[:, 2] - prior[:, 0]
+    ph_ = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph_ / 2
+    t = loc * pvar
+    cx = t[:, 0] * pw + pcx
+    cy = t[:, 1] * ph_ + pcy
+    bw = jnp.exp(t[:, 2]) * pw
+    bh = jnp.exp(t[:, 3]) * ph_
+    return jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], -1)
+
+
+@register_op("polygon_box_transform")
+def polygon_box_transform(input, name=None):
+    """OCR quad offsets → absolute coords (ref polygon_box_transform_op.cc):
+    even channels += 4*col_idx, odd channels += 4*row_idx, where input is
+    [N, 8or9, H, W] offset maps (channels are x,y interleaved)."""
+    n, c, h, w = input.shape
+    col = jnp.arange(w, dtype=input.dtype)[None, :] * 4
+    row = jnp.arange(h, dtype=input.dtype)[:, None] * 4
+    is_x = (jnp.arange(c) % 2 == 0)[:, None, None]
+    return jnp.where(is_x, col[None] - input, row[None] - input)
+
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=None, mining_type="max_negative",
+                       name=None):
+    """OHEM negative mining (ref mine_hard_examples_op.cc, max_negative mode).
+
+    cls_loss/loc_loss [N,M]; match_indices [N,M] (−1 = unmatched). Returns
+    neg_mask [N,M] bool marking selected negatives.
+    """
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    is_neg = (match_indices < 0) & (match_dist < neg_dist_threshold)
+    num_pos = jnp.sum(match_indices >= 0, axis=1)
+    num_neg = (num_pos * neg_pos_ratio).astype(jnp.int32)
+    if sample_size is not None:
+        num_neg = jnp.minimum(num_neg, sample_size)
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    return is_neg & (rank < num_neg[:, None])

@@ -54,6 +54,36 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _pick_block(s: int, bmax: int) -> tuple:
+    """Pad s to 128-row tiles and pick the largest block ≤ bmax that divides
+    the padded length — so padding waste is bounded by one 128 tile, never
+    a full 512 block (sq=520 pads to 640 with bq=128, not to 1024)."""
+    s_p = _ceil_to(s, 128)
+    nb = s_p // 128
+    for kt in range(min(bmax // 128, nb), 0, -1):
+        if nb % kt == 0:
+            return s_p, 128 * kt
+    return s_p, 128
+
+
+def _masked_probs(q, k, lse_row, i, j, *, scale, causal, bq, bk, sk):
+    """Shared logits→probabilities block for the backward kernels:
+    P = exp(QK^T·scale − lse) with key-padding and causal masks. The forward
+    kernel computes its own online-softmax variant of the same masking —
+    keep the mask logic here and there in sync."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = col < sk
+    if causal:
+        row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = mask & (row >= col)
+    s = jnp.where(mask, s, _NEG)
+    p = jnp.exp(s - lse_row[:, None])
+    return jnp.where(mask, p, 0.0)
+
+
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -115,8 +145,9 @@ def _flash_fwd_pallas(q, k, v, causal, scale, interpret):
     """q,k,v: [bh, s, h] padded to (128,128) tiles. Returns (o, lse)."""
     bh, sq, h = q.shape
     sk = k.shape[1]
-    bq, bk = min(_BQ, _ceil_to(sq, 128)), min(_BK, _ceil_to(sk, 128))
-    sq_p, sk_p, h_p = _ceil_to(sq, bq), _ceil_to(sk, bk), h
+    sq_p, bq = _pick_block(sq, _BQ)
+    sk_p, bk = _pick_block(sk, _BK)
+    h_p = h
     q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0)))
     k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
     v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0)))
@@ -168,19 +199,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _():
-        q = q_ref[0]
         k = k_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = col < sk
-        if causal:
-            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            mask = mask & (row >= col)
-        s = jnp.where(mask, s, _NEG)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])
-        p = jnp.where(mask, p, 0.0)
+        p = _masked_probs(q_ref[0], k, lse_ref[0, 0], i, j, scale=scale,
+                          causal=causal, bq=bq, bk=bk, sk=sk)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -212,19 +233,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(run)
     def _():
         q = q_ref[0]
-        k = k_ref[0]
         do = do_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = col < sk
-        if causal:
-            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            mask = mask & (row >= col)
-        s = jnp.where(mask, s, _NEG)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])
-        p = jnp.where(mask, p, 0.0)
+        p = _masked_probs(q, k_ref[0], lse_ref[0, 0], i, j, scale=scale,
+                          causal=causal, bq=bq, bk=bk, sk=sk)
         # dv += P^T @ dO
         pt = p.astype(do.dtype)
         dv_acc[:] += jax.lax.dot_general(
@@ -248,8 +259,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret):
     bh, sq, h = q.shape
     sk = k.shape[1]
-    bq, bk = min(_BQ, _ceil_to(sq, 128)), min(_BK, _ceil_to(sk, 128))
-    sq_p, sk_p, h_p = _ceil_to(sq, bq), _ceil_to(sk, bk), h
+    sq_p, bq = _pick_block(sq, _BQ)
+    sk_p, bk = _pick_block(sk, _BK)
+    h_p = h
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     qp = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
@@ -344,8 +356,14 @@ def flash_attention_mha(query, key, value, causal=False, scale=None,
     sk = key.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(h)
+    # pad head_dim up to a full 128-lane tile for Mosaic; zero columns are
+    # exact no-ops for QK^T, PV, and all three gradients, sliced off below
+    h_p = _ceil_to(h, 128)
     q = jnp.einsum("bsnh->bnsh", query).reshape(b * n, sq, h)
     k = jnp.einsum("bsnh->bnsh", key).reshape(b * n, sk, h)
     v = jnp.einsum("bsnh->bnsh", value).reshape(b * n, sk, h)
+    if h_p != h:
+        pad = ((0, 0), (0, 0), (0, h_p - h))
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     o = _flash_mha(q, k, v, bool(causal), float(scale), bool(interpret))
-    return jnp.einsum("bnsh->bsnh", o.reshape(b, n, sq, h))
+    return jnp.einsum("bnsh->bsnh", o.reshape(b, n, sq, h_p)[..., :h])

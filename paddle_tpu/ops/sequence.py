@@ -1,0 +1,245 @@
+"""Sequence ops (paddle.fluid.layers.sequence_lod / operators/sequence_ops
+parity).
+
+The reference represents variable-length batches as LoDTensors — a flat data
+tensor plus nested offset tables (lod_tensor.h:114) — and every sequence op
+walks those offsets with per-sequence scalar loops. That representation is
+hostile to XLA (data-dependent shapes), so this framework uses the padded
+representation as the canonical one: a batch is `[B, T_max, ...]` plus an
+explicit `length [B]` int tensor. This is SURVEY.md §7 hard-part (b)'s
+bucketing/padding policy made first-class — and it is also exactly what
+`sequence_pad`/`sequence_unpad` convert to/from in the reference, so the API
+surface lines up: ops that consumed LoD there take `(x, length)` here.
+
+All masks are built with `sequence_mask`; reductions run over the full padded
+tensor with mask-select, which XLA fuses into single VPU kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_softmax",
+    "sequence_expand", "sequence_expand_as", "sequence_reverse",
+    "sequence_concat", "sequence_slice", "sequence_reshape",
+    "sequence_enumerate",
+]
+
+
+@register_op("sequence_mask")
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[B] lengths -> [B, maxlen] 0/1 mask (ref sequence_mask_op.h)."""
+    x = jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x))  # eager-only when maxlen unspecified
+    rng = jnp.arange(maxlen, dtype=x.dtype)
+    mask = rng[None, :] < x[..., None]
+    return mask.astype(jnp.dtype(str(dtype)) if isinstance(dtype, str)
+                       else dtype)
+
+
+@register_op("sequence_pad")
+def sequence_pad(x, pad_value, length, maxlen=None, name=None):
+    """Flat packed [sum(L), D] + lengths [B] -> ([B, maxlen, D], length).
+
+    Ref sequence_pad_op — LoD input becomes (flat, lengths) here. Inverse of
+    sequence_unpad. Static maxlen required under jit.
+    """
+    length = jnp.asarray(length)
+    b = length.shape[0]
+    if maxlen is None:
+        maxlen = int(jnp.max(length))
+    starts = jnp.concatenate([jnp.zeros((1,), length.dtype),
+                              jnp.cumsum(length)[:-1]])
+    feat = x.shape[1:] if x.ndim > 1 else ()
+    idx = starts[:, None] + jnp.arange(maxlen)
+    idx = jnp.clip(idx, 0, x.shape[0] - 1)
+    out = x[idx]                                       # [B, maxlen, *feat]
+    mask = jnp.arange(maxlen)[None, :] < length[:, None]
+    pad = jnp.asarray(pad_value, x.dtype)
+    mask = mask.reshape(b, maxlen, *([1] * len(feat)))
+    return jnp.where(mask, out, pad), length
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(x, length, name=None):
+    """[B, T, D] + lengths -> packed [sum(L), D]. Dynamic output shape —
+    eager-only (the compiled path keeps data padded; ref sequence_unpad_op)."""
+    length = np.asarray(_unwrap(length) if isinstance(length, Tensor)
+                        else length)
+    xs = []
+    xa = x
+    for i, l in enumerate(length):
+        xs.append(xa[i, :int(l)])
+    return jnp.concatenate(xs, axis=0)
+
+
+@register_op("sequence_pool")
+def sequence_pool(x, pool_type, length=None, is_test=False, pad_value=0.0,
+                  name=None):
+    """Masked pooling over the time axis of [B, T, D] (+lengths).
+
+    pool_type in {sum, average, sqrt, max, min, last, first}. Empty sequences
+    produce pad_value (ref sequence_pool_op.h).
+    """
+    t = x.shape[1]
+    if length is None:
+        length = jnp.full((x.shape[0],), t, jnp.int32)
+    length = jnp.asarray(length)
+    mask = (jnp.arange(t)[None, :] < length[:, None])
+    maskf = mask.astype(x.dtype)[..., None]
+    lf = jnp.maximum(length.astype(x.dtype), 1)[:, None]
+    pt = pool_type.lower()
+    if pt == "sum":
+        out = (x * maskf).sum(1)
+    elif pt == "average":
+        out = (x * maskf).sum(1) / lf
+    elif pt == "sqrt":
+        out = (x * maskf).sum(1) / jnp.sqrt(lf)
+    elif pt == "max":
+        out = jnp.where(maskf > 0, x, -jnp.inf).max(1)
+    elif pt == "min":
+        out = jnp.where(maskf > 0, x, jnp.inf).min(1)
+    elif pt == "last":
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                                  axis=1)[:, 0]
+    elif pt == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    empty = (length == 0).reshape(-1, *([1] * (out.ndim - 1)))
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+@register_op("sequence_first_step")
+def sequence_first_step(x, length=None, name=None):
+    return sequence_pool.__pure_fn__(x, "first", length)
+
+
+@register_op("sequence_last_step")
+def sequence_last_step(x, length=None, name=None):
+    return sequence_pool.__pure_fn__(x, "last", length)
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(x, length=None, name=None):
+    """Per-sequence masked softmax over time axis of [B, T] or [B, T, 1]."""
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    z = x[..., 0] if squeeze else x
+    t = z.shape[1]
+    if length is None:
+        length = jnp.full((z.shape[0],), t, jnp.int32)
+    mask = jnp.arange(t)[None, :] < jnp.asarray(length)[:, None]
+    z = jnp.where(mask, z, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    out = jnp.where(mask, out, 0.0)
+    return out[..., None] if squeeze else out
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(x, length=None, name=None):
+    """Reverse valid prefix of each row of [B, T, ...] in time
+    (ref sequence_reverse_op.h)."""
+    t = x.shape[1]
+    if length is None:
+        return jnp.flip(x, axis=1)
+    length = jnp.asarray(length)
+    pos = jnp.arange(t)[None, :]
+    rev = length[:, None] - 1 - pos
+    idx = jnp.where(pos < length[:, None], rev, pos).astype(jnp.int32)
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+@register_op("sequence_expand")
+def sequence_expand(x, y_length, ref_level=0, name=None):
+    """Repeat each row i of x by y_length[i] and pad: [B, D] + [B] ->
+    [B, max_rep, D] (padded variant of ref sequence_expand_op: the LoD
+    output's ragged repeat becomes an explicit repeat axis + mask)."""
+    y_length = jnp.asarray(y_length)
+    max_rep = int(jnp.max(y_length)) if not isinstance(
+        y_length, jax.core.Tracer) else None
+    if max_rep is None:
+        raise ValueError("sequence_expand needs concrete y_length under jit; "
+                         "pass maxlen-padded inputs instead")
+    out = jnp.repeat(x[:, None], max_rep, axis=1)
+    mask = jnp.arange(max_rep)[None, :] < y_length[:, None]
+    return out * mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(x, y, name=None):
+    """Broadcast each row of x [B, D] across y's time axis [B, T, ...] ->
+    [B, T, D]."""
+    t = y.shape[1]
+    return jnp.repeat(x[:, None], t, axis=1)
+
+
+@register_op("sequence_concat")
+def sequence_concat(xs, lengths=None, name=None):
+    """Concat along time axis, compacting valid prefixes when lengths given:
+    list of [B, Ti, D] (+ lengths [B] each) -> ([B, sum(Ti), D], length)."""
+    if lengths is None:
+        out = jnp.concatenate(list(xs), axis=1)
+        t = out.shape[1]
+        return out, jnp.full((out.shape[0],), t, jnp.int32)
+    xs = list(xs)
+    lengths = [jnp.asarray(l) for l in lengths]
+    b = xs[0].shape[0]
+    t_out = sum(int(x.shape[1]) for x in xs)
+    total = sum(lengths)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((b, t_out) + tuple(feat), xs[0].dtype)
+    # scatter each source's valid prefix at the running per-row offset
+    offset = jnp.zeros((b,), lengths[0].dtype)
+    for x, l in zip(xs, lengths):
+        t = x.shape[1]
+        pos = jnp.arange(t)[None, :]
+        dst = offset[:, None] + pos                    # [B, t]
+        valid = pos < l[:, None]
+        dst = jnp.where(valid, dst, t_out)             # out-of-range drops
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None], dst.shape)
+        out = out.at[bidx, dst].set(x, mode="drop")
+        offset = offset + l
+    return out, total
+
+
+@register_op("sequence_slice")
+def sequence_slice(x, offset, length, name=None):
+    """Per-row slice of the time axis: [B, T, D], offset [B], length [B] ->
+    [B, max(length), D] padded (ref sequence_slice_op.h)."""
+    offset = jnp.asarray(offset).reshape(-1)
+    length = jnp.asarray(length).reshape(-1)
+    max_l = int(jnp.max(length))
+    pos = jnp.arange(max_l)[None, :]
+    idx = jnp.clip(offset[:, None] + pos, 0, x.shape[1] - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = pos < length[:, None]
+    return out * mask.reshape(mask.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(x, new_dim, name=None):
+    """[B, T, D] -> [B, T*D/new_dim, new_dim] (ref sequence_reshape_op)."""
+    b = x.shape[0]
+    return x.reshape(b, -1, new_dim)
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(x, win_size, pad_value=0, name=None):
+    """Sliding windows over time: [B, T] ids -> [B, T, win_size]
+    (ref sequence_enumerate_op.h; positions past the end take pad_value)."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]   # [T,W]
+    valid = pos < t
+    idx = jnp.clip(pos, 0, t - 1)
+    out = x[:, idx]                                     # [B,T,W]
+    return jnp.where(valid[None], out, jnp.asarray(pad_value, x.dtype))

@@ -71,10 +71,13 @@ def test_auto_checkpoint_resume(tmp_path):
     assert seen == [0, 1, 2]
     w_done = net.weight.numpy().copy()
 
-    # simulate restart mid-job: meta says epoch 1 done
-    meta = json.load(open(tmp_path / "job1" / "meta.json"))
-    meta["epoch"] = 1
-    json.dump(meta, open(tmp_path / "job1" / "meta.json", "w"))
+    # simulate restart mid-job: the atomic state bundle says epoch 1 done
+    # (meta.json is informational; epoch+model+opt live in one file so a
+    # preemption can never produce a mixed-epoch restore)
+    from paddle_tpu import serialization
+    bundle = serialization.load(str(tmp_path / "job1" / "state.pdckpt"))
+    bundle["epoch"] = 1
+    serialization.save(bundle, str(tmp_path / "job1" / "state.pdckpt"))
     net2 = nn.Linear(2, 2)
     opt2 = paddle.optimizer.SGD(learning_rate=0.1,
                                 parameters=net2.parameters())

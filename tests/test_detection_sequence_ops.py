@@ -1,0 +1,366 @@
+"""Tests for detection ops and sequence ops vs numpy references.
+
+Mirrors the reference's per-op unit tests (e.g.
+python/paddle/fluid/tests/unittests/test_yolo_box_op.py,
+test_box_coder_op.py, test_multiclass_nms_op.py, test_sequence_pad_op.py)
+but with static-shape/padded semantics where the reference used LoD.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def t(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+
+def np_iou(a, b):
+    n, m = len(a), len(b)
+    out = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            x1 = max(a[i, 0], b[j, 0]); y1 = max(a[i, 1], b[j, 1])
+            x2 = min(a[i, 2], b[j, 2]); y2 = min(a[i, 3], b[j, 3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            ua = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+                  + (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(5, 4).astype(np.float32), axis=-1)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.rand(7, 4).astype(np.float32), axis=-1)[:, [0, 2, 1, 3]]
+    a = np.stack([a[:, 0], a[:, 2], a[:, 1], a[:, 3]], -1)
+    a.sort(axis=-1)  # ensure x1<x2, y1<y2 loosely
+    a = np.stack([a[:, 0], a[:, 1], a[:, 2], a[:, 3]], -1)
+    out = ops.iou_similarity(t(a), t(b)).numpy()
+    np.testing.assert_allclose(out, np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.abs(rng.rand(6, 4)).astype(np.float32)
+    priors[:, 2:] = priors[:, :2] + 0.5 + priors[:, 2:]
+    gt = np.abs(rng.rand(3, 4)).astype(np.float32)
+    gt[:, 2:] = gt[:, :2] + 0.3 + gt[:, 2:]
+    var = np.full((6, 4), 0.5, np.float32)
+    enc = ops.box_coder(t(priors), t(var), t(gt),
+                        code_type="encode_center_size").numpy()
+    assert enc.shape == (3, 6, 4)
+    dec = ops.box_coder(t(priors), t(var), t(enc),
+                        code_type="decode_center_size").numpy()
+    # decoding the encoding of gt against prior j must recover gt
+    for j in range(6):
+        np.testing.assert_allclose(dec[:, j], gt, rtol=1e-4, atol=1e-4)
+
+
+def test_box_clip():
+    boxes = np.array([[[-1.0, -2.0, 50.0, 60.0]]], np.float32)
+    im_info = np.array([[40.0, 40.0, 1.0]], np.float32)
+    out = ops.box_clip(t(boxes), t(im_info)).numpy()
+    np.testing.assert_allclose(out, [[[0, 0, 39, 39]]])
+
+
+def test_prior_box_shapes_and_values():
+    feat = t(np.zeros((1, 8, 4, 4)))
+    img = t(np.zeros((1, 3, 32, 32)))
+    boxes, var = ops.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                               aspect_ratios=[2.0], flip=True, clip=True)
+    b, v = boxes.numpy(), var.numpy()
+    # priors: ar {1, 2, 0.5} for min + 1 sqrt(min*max) = 4
+    assert tuple(b.shape) == (4, 4, 4, 4) and v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # center of cell (0,0) is offset*step = 0.5*8 = 4 → min-size box / 32
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 0.25, 0.25])
+
+
+def test_anchor_generator_shapes():
+    feat = t(np.zeros((1, 8, 3, 5)))
+    anchors, var = ops.anchor_generator(
+        feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+        stride=[16.0, 16.0])
+    assert tuple(anchors.shape) == (3, 5, 4, 4)
+    a = anchors.numpy()
+    # anchors at cell (0,0) centered at offset*stride = 8
+    cx = (a[0, 0, :, 0] + a[0, 0, :, 2]) / 2
+    np.testing.assert_allclose(cx, 8.0, atol=1e-4)
+
+
+def test_yolo_box_matches_naive():
+    rng = np.random.RandomState(2)
+    n, an, c, h, w = 1, 2, 3, 2, 2
+    anchors = [10, 13, 16, 30]
+    x = rng.randn(n, an * (5 + c), h, w).astype(np.float32)
+    img_size = np.array([[64, 64]], np.int32)
+    boxes, scores = ops.yolo_box(t(x), paddle.to_tensor(img_size), anchors,
+                                 c, 0.0, 32, clip_bbox=True)
+    # naive python reference (same math as yolo_box_op.h)
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    xr = x.reshape(n, an, 5 + c, h, w)
+    exp_boxes = np.zeros((n, an * h * w, 4), np.float32)
+    exp_scores = np.zeros((n, an * h * w, c), np.float32)
+    in_hw = 32 * h, 32 * w
+    for j in range(an):
+        for k in range(h):
+            for l in range(w):
+                conf = sig(xr[0, j, 4, k, l])
+                cx = (l + sig(xr[0, j, 0, k, l])) * 64 / w
+                cy = (k + sig(xr[0, j, 1, k, l])) * 64 / h
+                bw = np.exp(xr[0, j, 2, k, l]) * anchors[2 * j] * 64 / in_hw[1]
+                bh = np.exp(xr[0, j, 3, k, l]) * anchors[2 * j + 1] * 64 / in_hw[0]
+                bi = j * h * w + k * w + l
+                exp_boxes[0, bi] = [max(cx - bw / 2, 0), max(cy - bh / 2, 0),
+                                    min(cx + bw / 2, 63), min(cy + bh / 2, 63)]
+                exp_scores[0, bi] = conf * sig(xr[0, j, 5:, k, l])
+    np.testing.assert_allclose(boxes.numpy(), exp_boxes, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(scores.numpy(), exp_scores, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    idx, keep = ops.nms(t(boxes), t(scores), iou_threshold=0.5)
+    assert keep.numpy().tolist() == [True, False, True]
+    assert idx.numpy().tolist() == [0, -1, 2]
+
+
+def test_multiclass_nms_static_shape():
+    rng = np.random.RandomState(3)
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30], [40, 40, 50, 50]]], np.float32)
+    # class 0 = background; class 1 scores
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.8, 0.01]
+    out, counts = ops.multiclass_nms(t(boxes), t(scores),
+                                     score_threshold=0.05, nms_threshold=0.5,
+                                     keep_top_k=3, background_label=0)
+    o = out.numpy()[0]
+    assert o.shape == (3, 6)
+    assert int(counts.numpy()[0]) == 2      # box1 suppressed, box3 below thr
+    assert o[0, 0] == 1.0 and o[0, 1] == pytest.approx(0.9)
+    np.testing.assert_allclose(o[0, 2:], [0, 0, 10, 10], atol=1e-5)
+    assert o[2, 0] == -1                    # padding row
+
+
+def test_matrix_nms_decay():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out, counts = ops.matrix_nms(t(boxes), t(scores), score_threshold=0.05,
+                                 post_threshold=0.0, keep_top_k=3,
+                                 background_label=0)
+    o = out.numpy()[0]
+    # duplicate box decays to ~0 score ((1-iou)/(1-max_iou) with iou=1)
+    assert int(counts.numpy()[0]) >= 2
+    assert o[0, 1] == pytest.approx(0.9, abs=1e-5)
+    dup = o[o[:, 1] > 0][-1]
+    assert dup[1] <= 0.7 + 1e-5
+
+
+def test_roi_align_constant_field():
+    # constant feature map -> every aligned value equals the constant
+    feat = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0.0, 0.0, 7.0, 7.0], [2.0, 2.0, 6.0, 6.0]], np.float32)
+    out = ops.roi_align(t(feat), t(rois), output_size=2, spatial_scale=1.0,
+                        sampling_ratio=2, rois_num=t([2], np.int32),
+                        aligned=False)
+    assert tuple(out.shape) == (2, 2, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    feat = paddle.to_tensor(np.random.RandomState(4).rand(1, 1, 6, 6)
+                            .astype(np.float32), stop_gradient=False)
+    rois = t(np.array([[1.0, 1.0, 4.0, 4.0]], np.float32))
+    out = ops.roi_align(feat, rois, output_size=2, spatial_scale=1.0,
+                        sampling_ratio=2, rois_num=t([1], np.int32))
+    out.sum().backward()
+    g = feat.grad.numpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_roi_pool_max():
+    feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = ops.roi_pool(t(feat), t(rois), output_size=2, spatial_scale=1.0,
+                       rois_num=t([1], np.int32))
+    np.testing.assert_allclose(out.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_generate_proposals_shapes():
+    rng = np.random.RandomState(5)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.rand(n, a, h, w).astype(np.float32)
+    deltas = (rng.randn(n, 4 * a, h, w) * 0.1).astype(np.float32)
+    anchors, var = ops.anchor_generator(
+        t(np.zeros((n, 1, h, w))), anchor_sizes=[16.0],
+        aspect_ratios=[0.5, 1.0, 2.0], stride=[8.0, 8.0])
+    im_shape = np.array([[32.0, 32.0]], np.float32)
+    rois, probs, num = ops.generate_proposals(
+        t(scores), t(deltas), t(im_shape), anchors, var,
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7, min_size=1.0)
+    assert tuple(rois.shape) == (1, 5, 4) and tuple(probs.shape) == (1, 5, 1)
+    k = int(num.numpy()[0])
+    assert 1 <= k <= 5
+    r = rois.numpy()[0, :k]
+    assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+    assert (r >= 0).all() and (r <= 31).all()
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],        # small → low level
+                     [0, 0, 500, 500]],     # large → high level
+                    np.float32)
+    ids, restore, masks = ops.distribute_fpn_proposals(
+        t(rois), min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    ids = ids.numpy()
+    assert ids[0] == 0 and ids[1] == 3      # clipped to [min,max]-min
+    assert masks.numpy().sum() == 2
+
+
+def test_sigmoid_focal_loss_reduces_easy_negatives():
+    x = np.array([[10.0, -10.0]], np.float32)   # confident
+    label = np.array([[1]], np.int64)           # class 0 is positive
+    fg = np.array([1], np.int32)
+    loss = ops.sigmoid_focal_loss(t(x), paddle.to_tensor(label),
+                                  paddle.to_tensor(fg)).numpy()
+    assert tuple(loss.shape) == (1, 2)
+    assert loss[0, 0] < 1e-3 and loss[0, 1] < 1e-3
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.2, 0.8, 0.4]], np.float32)
+    idx, d = ops.bipartite_match(t(dist))
+    assert idx.numpy().tolist() == [0, 1, -1]
+    np.testing.assert_allclose(d.numpy()[:2], [0.9, 0.8])
+    idx2, d2 = ops.bipartite_match(t(dist), match_type="per_prediction",
+                                   dist_threshold=0.35)
+    assert idx2.numpy().tolist() == [0, 1, 1]   # col2 matched to row1 (0.4)
+
+
+def test_target_assign():
+    inp = np.arange(8, dtype=np.float32).reshape(2, 4)
+    mi = np.array([1, -1, 0], np.int32)
+    out, w = ops.target_assign(t(inp), paddle.to_tensor(mi),
+                               mismatch_value=0)
+    np.testing.assert_allclose(out.numpy(),
+                               [[4, 5, 6, 7], [0, 0, 0, 0], [0, 1, 2, 3]])
+    np.testing.assert_allclose(w.numpy().ravel(), [1, 0, 1])
+
+
+def test_yolov3_loss_runs_and_differentiable():
+    rng = np.random.RandomState(6)
+    n, m, c, h, w = 2, 3, 4, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+    mask = [0, 1, 2]
+    x = paddle.to_tensor(rng.randn(n, m * (5 + c), h, w).astype(np.float32),
+                         stop_gradient=False)
+    gt_box = np.zeros((n, 5, 4), np.float32)
+    gt_box[:, 0] = [0.5, 0.5, 0.2, 0.3]
+    gt_label = np.zeros((n, 5), np.int64)
+    loss = ops.yolov3_loss(x, t(gt_box), paddle.to_tensor(gt_label),
+                           anchors, mask, c, ignore_thresh=0.7,
+                           downsample_ratio=8)
+    assert tuple(loss.shape) == (n,)
+    assert np.isfinite(loss.numpy()).all()
+    loss.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert np.abs(x.grad.numpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# sequence ops
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask():
+    out = ops.sequence_mask(paddle.to_tensor(np.array([1, 3, 0])), maxlen=4)
+    np.testing.assert_array_equal(
+        out.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lens = np.array([2, 3], np.int64)
+    padded, l = ops.sequence_pad(t(flat), 0.0, paddle.to_tensor(lens),
+                                 maxlen=4)
+    assert tuple(padded.shape) == (2, 4, 2)
+    np.testing.assert_allclose(padded.numpy()[0, :2], flat[:2])
+    np.testing.assert_allclose(padded.numpy()[0, 2:], 0.0)
+    np.testing.assert_allclose(padded.numpy()[1, :3], flat[2:])
+    unpadded = ops.sequence_unpad(padded, paddle.to_tensor(lens))
+    np.testing.assert_allclose(unpadded.numpy(), flat)
+
+
+def test_sequence_pool_modes():
+    x = np.array([[[1.0], [2.0], [5.0]],
+                  [[3.0], [9.0], [7.0]]], np.float32)
+    lens = paddle.to_tensor(np.array([2, 1]))
+    assert ops.sequence_pool(t(x), "sum", lens).numpy().ravel().tolist() == [3, 3]
+    assert ops.sequence_pool(t(x), "average", lens).numpy().ravel().tolist() == [1.5, 3]
+    assert ops.sequence_pool(t(x), "max", lens).numpy().ravel().tolist() == [2, 3]
+    assert ops.sequence_pool(t(x), "last", lens).numpy().ravel().tolist() == [2, 3]
+    assert ops.sequence_first_step(t(x), lens).numpy().ravel().tolist() == [1, 3]
+    np.testing.assert_allclose(
+        ops.sequence_pool(t(x), "sqrt", lens).numpy().ravel(),
+        [3 / np.sqrt(2), 3.0], rtol=1e-6)
+
+
+def test_sequence_softmax_masked():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    out = ops.sequence_softmax(t(x), paddle.to_tensor(np.array([2])))
+    o = out.numpy()[0]
+    assert o[2] == 0.0
+    np.testing.assert_allclose(o[:2].sum(), 1.0, rtol=1e-6)
+
+
+def test_sequence_reverse_respects_length():
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)[..., None]
+    out = ops.sequence_reverse(t(x), paddle.to_tensor(np.array([3, 4])))
+    np.testing.assert_allclose(out.numpy()[0].ravel(), [2, 1, 0, 3])
+    np.testing.assert_allclose(out.numpy()[1].ravel(), [7, 6, 5, 4])
+
+
+def test_sequence_expand_and_concat_slice():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = ops.sequence_expand(t(x), paddle.to_tensor(np.array([2, 1])))
+    assert tuple(out.shape) == (2, 2, 2)
+    np.testing.assert_allclose(out.numpy()[0], [[1, 2], [1, 2]])
+    np.testing.assert_allclose(out.numpy()[1], [[3, 4], [0, 0]])
+
+    a = np.ones((2, 2, 1), np.float32)
+    b = np.full((2, 3, 1), 2.0, np.float32)
+    la = paddle.to_tensor(np.array([1, 2]))
+    lb = paddle.to_tensor(np.array([3, 1]))
+    cat, total = ops.sequence_concat([t(a), t(b)], [la, lb])
+    assert tuple(cat.shape) == (2, 5, 1)
+    np.testing.assert_allclose(cat.numpy()[0].ravel(), [1, 2, 2, 2, 0])
+    np.testing.assert_allclose(cat.numpy()[1].ravel(), [1, 1, 2, 0, 0])
+    assert total.numpy().tolist() == [4, 3]
+
+    s = ops.sequence_slice(t(np.arange(12, np.float32).reshape(2, 6)
+                             if False else
+                             np.arange(12, dtype=np.float32).reshape(2, 6, 1)),
+                           paddle.to_tensor(np.array([1, 2])),
+                           paddle.to_tensor(np.array([2, 3])))
+    np.testing.assert_allclose(s.numpy()[0].ravel(), [1, 2, 0])
+    np.testing.assert_allclose(s.numpy()[1].ravel(), [8, 9, 10])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3]], np.int64)
+    out = ops.sequence_enumerate(paddle.to_tensor(x), win_size=2, pad_value=0)
+    np.testing.assert_array_equal(out.numpy()[0], [[1, 2], [2, 3], [3, 0]])
