@@ -2,6 +2,9 @@ from .base import (DistributedStrategy, PaddleCloudRoleMaker, UserDefinedRoleMak
                    fleet, init, is_first_worker, worker_index, worker_num,
                    distributed_optimizer, distributed_model,
                    DistributedOptimizer)  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .meta_optimizers import (StrategyCompiler, TrainStepSpec,  # noqa: F401
+                              LocalSGDStep, META_OPTIMIZERS)
 from .. import recompute as _recompute_mod  # noqa: F401
 
 

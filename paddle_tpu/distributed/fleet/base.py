@@ -60,9 +60,18 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1,
+                                          "begin_step": 1}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
         self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.gradient_scale_configs = {"scale_strategy": "avg"}
@@ -227,32 +236,23 @@ class Fleet:
         return ShardingPlan(self.mesh, zero_stage=zero)
 
     def build_train_step(self, layer, loss_fn, optimizer, strategy=None):
-        """The strategy compiler (strategy_compiler.py:171 analogue):
-        compose strategy flags into one sharded compiled TrainStep."""
-        from ...static.train_step import TrainStep
+        """The strategy compiler (strategy_compiler.py:171 analogue): pick
+        the compatible meta-optimizer chain, rewrite the TrainStepSpec,
+        materialize ONE sharded compiled step."""
+        from .meta_optimizers import (StrategyCompiler, TrainStepSpec,
+                                      build_from_spec)
         strategy = strategy or self.strategy or DistributedStrategy()
         if not self._initialized:
             self.init()
-        plan = self.build_sharding_plan(strategy)
-        amp_level = None
-        if strategy.amp:
-            amp_level = "O2" if strategy.amp_configs.get("use_pure_fp16") \
-                else "O1"
-        accum = 1
-        if strategy.gradient_merge:
-            accum = int(strategy.gradient_merge_configs.get("k_steps", 1))
-        if strategy.pipeline:
-            accum = max(accum, int(strategy.pipeline_configs.get(
-                "accumulate_steps", 1)))
         inner = optimizer.inner_opt if isinstance(
             optimizer, DistributedOptimizer) else optimizer
-        if strategy.lamb:
-            from ...optimizer import Lamb
-            inner = Lamb(learning_rate=inner.get_lr(),
-                         parameters=inner._parameters)
-        return TrainStep(layer, loss_fn, inner, amp_level=amp_level,
-                         mesh=self.mesh, sharding_plan=plan,
-                         grad_accum_steps=accum)
+        spec = TrainStepSpec(layer=layer, loss_fn=loss_fn, optimizer=inner)
+        compiler = StrategyCompiler()
+        compiler.compile(spec, strategy, self)
+        self._last_applied = list(spec.applied)
+        # single source of truth for the zero stage: the compiled spec
+        plan = ShardingPlan(self.mesh, zero_stage=spec.zero_stage)
+        return build_from_spec(spec, mesh=self.mesh, sharding_plan=plan)
 
     def state_dict(self):
         return {}

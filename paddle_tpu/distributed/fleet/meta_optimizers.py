@@ -1,0 +1,575 @@
+"""Fleet meta-optimizers + StrategyCompiler.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ (17 transforms:
+amp_optimizer.py, recompute_optimizer.py, sharding_optimizer.py:33,
+pipeline_optimizer.py:136, gradient_merge_optimizer.py, dgc_optimizer.py,
+localsgd_optimizer.py, lamb_optimizer.py, lars_optimizer.py,
+fp16_allreduce_optimizer.py, graph_execution_optimizer.py, ...) and
+base/strategy_compiler.py:171 (StrategyCompiler.generate_optimizer picks a
+compatible meta-optimizer chain via maximum_path_len_algo :89).
+
+TPU-native design: the reference's meta-optimizers are ProgramDesc graph
+rewriters (append c_allreduce ops, split programs, insert cast ops). Here a
+meta-optimizer is a transform over a TrainStepSpec — the declarative recipe
+from which ONE sharded XLA executable is compiled. Graph surgery becomes:
+  - allreduce insertion      -> data sharding over 'dp' (XLA emits psum)
+  - cast-op insertion (AMP)  -> amp_level on the traced forward
+  - program split (pipeline) -> grad-accum microbatching + 'pp' mesh axis
+  - DGC/fp16-allreduce       -> grad_transform between backward and update
+  - LocalSGD                 -> replica-mode step (vmap over 'dp'-sharded
+                                param copies, periodic averaging)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TrainStepSpec", "MetaOptimizerBase", "StrategyCompiler",
+           "META_OPTIMIZERS", "LocalSGDStep", "make_dgc_transform",
+           "make_fp16_allreduce_transform", "chain_grad_transforms"]
+
+
+@dataclasses.dataclass
+class TrainStepSpec:
+    """Declarative train-step recipe the meta-optimizer chain rewrites."""
+    layer: Any
+    loss_fn: Callable
+    optimizer: Any
+    amp_level: Optional[str] = None
+    amp_dtype: str = "bfloat16"
+    grad_accum_steps: int = 1
+    zero_stage: int = 0
+    remat: bool = False
+    remat_policy: Any = None
+    sharding_rules: Optional[Dict[str, Any]] = None
+    # list of (name, init_fn(params)->state, fn(grads, state, params)
+    #          -> (grads, state))
+    grad_transforms: List[Tuple[str, Callable, Callable]] = \
+        dataclasses.field(default_factory=list)
+    localsgd_k_steps: int = 0      # >0 => replica-mode LocalSGD step
+    localsgd_begin_step: int = 1   # sync every step until this step count
+    localsgd_adaptive: bool = False  # adapt k to the loss trajectory
+    applied: List[str] = dataclasses.field(default_factory=list)
+
+
+def chain_grad_transforms(transforms):
+    """Compose [(name, init, fn), ...] into one (init, fn) pair keyed by
+    transform name in the strategy-state dict."""
+    if not transforms:
+        return None, None
+
+    def init(params):
+        return {name: ini(params) for name, ini, _ in transforms}
+
+    def fn(grads, state, params):
+        state = dict(state)
+        for name, _, f in transforms:
+            grads, state[name] = f(grads, state[name], params)
+        return grads, state
+    return init, fn
+
+
+# ---------------------------------------------------------------------------
+# grad transforms (the in-step rewrites)
+# ---------------------------------------------------------------------------
+
+def make_dgc_transform(sparsity=0.999, momentum: float = 0.9,
+                       rampup_begin_step: int = 0, rampup_step: int = 1):
+    """Deep Gradient Compression (reference operators/dgc_op.* +
+    dgc_optimizer.py): momentum correction + error feedback + top-k
+    selection. Before rampup_begin_step grads pass through uncompressed;
+    over the next rampup_step steps the sparsity walks the stages of the
+    `sparsity` list (ref DGCMomentumOptimizer's rampup schedule). On ICI
+    the bandwidth win of sparse exchange is subsumed by XLA's fused
+    collectives, so this keeps DGC's *algorithmic* semantics: only the
+    top-(1-sparsity) fraction of corrected gradient mass flows to the
+    optimizer each step; the rest accumulates locally."""
+    stages = list(sparsity) if isinstance(sparsity, (list, tuple)) \
+        else [float(sparsity)]
+    rampup_step = max(1, int(rampup_step))
+
+    def init(params):
+        zeros = lambda t: jax.tree_util.tree_map(jnp.zeros_like, t)
+        return {"u": zeros(params), "e": zeros(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def one(g, u, e, stage_idx, compress):
+        u = momentum * u + g                    # momentum correction
+        e = e + u                               # error feedback accumulate
+        flat = jnp.abs(e).reshape(-1)
+        # each rampup stage has its own static top-k size (top_k needs a
+        # static k, hence lax.switch over per-stage branches)
+        ks = [max(1, int(round(flat.size * (1.0 - s)))) for s in stages]
+        thr = jax.lax.switch(
+            stage_idx,
+            [(lambda fl, k=k: jax.lax.top_k(fl, k)[0][-1]) for k in ks],
+            flat)
+        mask = (jnp.abs(e) >= thr).astype(g.dtype)
+        # warmup (ref dgc_op rampup_begin_step): pass everything through
+        mask = jnp.where(compress, mask, jnp.ones_like(mask))
+        out = e * mask
+        return out, u * (1.0 - mask), e * (1.0 - mask)
+
+    def fn(grads, state, params):
+        step = state["step"]
+        compress = step >= rampup_begin_step
+        per_stage = max(1, rampup_step // len(stages))
+        stage_idx = jnp.clip((step - rampup_begin_step) // per_stage,
+                             0, len(stages) - 1)
+        outs = {}
+        new_u, new_e = {}, {}
+        for name, g in grads.items():
+            o, nu, ne = one(g, state["u"][name], state["e"][name],
+                            stage_idx, compress)
+            outs[name], new_u[name], new_e[name] = o, nu, ne
+        return outs, {"u": new_u, "e": new_e, "step": step + 1}
+    return init, fn
+
+
+def make_fp16_allreduce_transform(dtype=jnp.bfloat16):
+    """fp16_allreduce_optimizer.py: grads cross the wire in half precision.
+    Under SPMD the sum itself is compiler-placed, so the semantic kept is
+    the precision quantization of the exchanged gradient."""
+
+    def init(params):
+        return {}
+
+    def fn(grads, state, params):
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(dtype).astype(jnp.float32)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+        return grads, state
+    return init, fn
+
+
+# ---------------------------------------------------------------------------
+# meta-optimizers
+# ---------------------------------------------------------------------------
+
+class MetaOptimizerBase:
+    """One strategy transform. `order` fixes chain position (the reference
+    encodes this via meta_optimizers_white_list ordering); `conflicts`
+    mirrors _can_update/_disable_strategy compatibility rules."""
+    name = "base"
+    order = 0
+    conflicts: Tuple[str, ...] = ()
+
+    def can_apply(self, strategy) -> bool:
+        raise NotImplementedError
+
+    def apply(self, spec: TrainStepSpec, strategy, fleet=None) -> None:
+        raise NotImplementedError
+
+    def disable(self, strategy) -> None:
+        if hasattr(strategy, self.name):
+            setattr(strategy, self.name, False)
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    name = "recompute"
+    order = 10
+
+    def can_apply(self, strategy):
+        return strategy.recompute
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.remat = True
+        # offload => save nothing, recompute everything; else keep matmul
+        # outputs (dots) which is the TPU sweet spot
+        if strategy.recompute_configs.get("enable_offload"):
+            spec.remat_policy = jax.checkpoint_policies.nothing_saveable
+        else:
+            spec.remat_policy = jax.checkpoint_policies.checkpoint_dots
+        spec.applied.append(self.name)
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    name = "amp"
+    order = 20
+
+    def can_apply(self, strategy):
+        return strategy.amp
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.amp_level = "O2" if strategy.amp_configs.get(
+            "use_pure_fp16") else "O1"
+        spec.applied.append(self.name)
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    name = "sharding"
+    order = 30
+    conflicts = ("localsgd",)
+
+    def can_apply(self, strategy):
+        return strategy.sharding
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.zero_stage = int(strategy.sharding_configs.get("stage", 1))
+        spec.applied.append(self.name)
+
+
+class TensorParallelOptimizer(MetaOptimizerBase):
+    name = "tensor_parallel"
+    order = 40
+    conflicts = ("localsgd",)
+
+    def can_apply(self, strategy):
+        return strategy.tensor_parallel or \
+            strategy.hybrid_configs.get("mp_degree", 1) > 1
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.applied.append(self.name)  # mesh axis added by mesh_shape()
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    name = "pipeline"
+    order = 50
+    conflicts = ("localsgd",)
+
+    def can_apply(self, strategy):
+        return strategy.pipeline
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.grad_accum_steps = max(
+            spec.grad_accum_steps,
+            int(strategy.pipeline_configs.get("accumulate_steps", 1)))
+        spec.applied.append(self.name)
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    name = "gradient_merge"
+    order = 60
+
+    def can_apply(self, strategy):
+        return strategy.gradient_merge
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.grad_accum_steps = max(
+            spec.grad_accum_steps,
+            int(strategy.gradient_merge_configs.get("k_steps", 1)))
+        spec.applied.append(self.name)
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    name = "dgc"
+    order = 70
+    # reference dgc_optimizer._can_apply: momentum-family only, and DGC is
+    # disabled when AMP is on (no fp16 dgc kernels)
+    conflicts = ("amp", "fp16_allreduce", "localsgd")
+
+    def can_apply(self, strategy):
+        return strategy.dgc
+
+    def apply(self, spec, strategy, fleet=None):
+        cfg = getattr(strategy, "dgc_configs", None) or {}
+        init, fn = make_dgc_transform(
+            sparsity=cfg.get("sparsity", [0.999]),
+            rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+            rampup_step=int(cfg.get("rampup_step", 1)))
+        spec.grad_transforms.append((self.name, init, fn))
+        spec.applied.append(self.name)
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    name = "fp16_allreduce"
+    order = 75
+    conflicts = ("dgc",)
+
+    def can_apply(self, strategy):
+        return strategy.fp16_allreduce
+
+    def apply(self, spec, strategy, fleet=None):
+        init, fn = make_fp16_allreduce_transform()
+        spec.grad_transforms.append((self.name, init, fn))
+        spec.applied.append(self.name)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    name = "localsgd"
+    order = 80
+    # replica-mode step supports amp/remat but not microbatch accumulation
+    # or grad transforms — those strategies are disabled, not dropped
+    conflicts = ("sharding", "pipeline", "dgc", "tensor_parallel",
+                 "gradient_merge", "fp16_allreduce")
+
+    def can_apply(self, strategy):
+        return strategy.localsgd
+
+    def apply(self, spec, strategy, fleet=None):
+        cfg = getattr(strategy, "localsgd_configs", None) or {}
+        spec.localsgd_k_steps = max(1, int(cfg.get("k_steps", 1)))
+        spec.localsgd_begin_step = max(1, int(cfg.get("begin_step", 1)))
+        spec.applied.append(self.name)
+
+
+class AdaptiveLocalSGDOptimizer(MetaOptimizerBase):
+    """adaptive_localsgd (reference localsgd_optimizer.py
+    AdaptiveLocalSGDOptimizer): LocalSGD whose sync period adapts to the
+    loss trajectory — sync often early (loss moving fast), rarely later."""
+    name = "adaptive_localsgd"
+    order = 81
+    conflicts = ("sharding", "pipeline", "dgc", "tensor_parallel",
+                 "gradient_merge", "fp16_allreduce", "localsgd")
+
+    def can_apply(self, strategy):
+        return getattr(strategy, "adaptive_localsgd", False)
+
+    def apply(self, spec, strategy, fleet=None):
+        cfg = getattr(strategy, "adaptive_localsgd_configs", None) or {}
+        spec.localsgd_k_steps = max(1, int(cfg.get("init_k_steps", 1)))
+        spec.localsgd_begin_step = max(1, int(cfg.get("begin_step", 1)))
+        spec.localsgd_adaptive = True
+        spec.applied.append(self.name)
+
+
+class LambOptimizer(MetaOptimizerBase):
+    name = "lamb"
+    order = 90
+    conflicts = ("lars", "dgc")
+
+    def can_apply(self, strategy):
+        return strategy.lamb
+
+    def apply(self, spec, strategy, fleet=None):
+        from ...optimizer import Lamb
+        opt = spec.optimizer
+        cfg = getattr(strategy, "lamb_configs", {})
+        # reference lamb_optimizer swaps Adam-family inner opt for LAMB
+        spec.optimizer = Lamb(
+            learning_rate=opt.get_lr(), parameters=opt._parameters,
+            lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)))
+        spec.applied.append(self.name)
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    name = "lars"
+    order = 91
+    conflicts = ("lamb", "dgc")
+
+    def can_apply(self, strategy):
+        return strategy.lars
+
+    def apply(self, spec, strategy, fleet=None):
+        from ...optimizer import Lars
+        opt = spec.optimizer
+        cfg = getattr(strategy, "lars_configs", {})
+        spec.optimizer = Lars(
+            learning_rate=opt.get_lr(), parameters=opt._parameters,
+            lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+            lars_weight_decay=float(cfg.get("lars_weight_decay", 0.0005)))
+        spec.applied.append(self.name)
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    """Always-on DP terminal optimizer (graph_execution_optimizer.py):
+    in the reference it builds the multi-device NCCL graph; here DP is the
+    'dp' mesh axis + batch data sharding, placed by ShardingPlan."""
+    name = "graph_execution"
+    order = 100
+
+    def can_apply(self, strategy):
+        return True
+
+    def apply(self, spec, strategy, fleet=None):
+        spec.applied.append(self.name)
+
+
+META_OPTIMIZERS: List[MetaOptimizerBase] = [
+    RecomputeOptimizer(), AMPOptimizer(), ShardingOptimizer(),
+    TensorParallelOptimizer(), PipelineOptimizer(),
+    GradientMergeOptimizer(), DGCOptimizer(), FP16AllReduceOptimizer(),
+    LocalSGDOptimizer(), AdaptiveLocalSGDOptimizer(), LambOptimizer(),
+    LarsOptimizer(), GraphExecutionOptimizer(),
+]
+
+
+class StrategyCompiler:
+    """Pick the longest mutually-compatible meta-optimizer chain
+    (strategy_compiler.py:89 maximum_path_len_algo analogue: applicable
+    transforms sorted by chain order; later conflicting ones are dropped
+    and their strategy flag disabled)."""
+
+    def generate_optimizer(self, strategy) -> List[MetaOptimizerBase]:
+        applicable = [m for m in META_OPTIMIZERS if m.can_apply(strategy)]
+        chain: List[MetaOptimizerBase] = []
+        for m in sorted(applicable, key=lambda m: m.order):
+            clash = any(m.name in c.conflicts or c.name in m.conflicts
+                        for c in chain)
+            if clash:
+                m.disable(strategy)
+                continue
+            chain.append(m)
+        return chain
+
+    def compile(self, spec: TrainStepSpec, strategy,
+                fleet=None) -> TrainStepSpec:
+        for m in self.generate_optimizer(strategy):
+            m.apply(spec, strategy, fleet)
+        return spec
+
+
+# ---------------------------------------------------------------------------
+# LocalSGD replica-mode step
+# ---------------------------------------------------------------------------
+
+class LocalSGDStep:
+    """localsgd_optimizer.py, TPU-native: each dp rank keeps its OWN param
+    copy and steps locally; every k steps params are averaged across ranks.
+    The reference rewrites the program to skip grad-allreduce and insert a
+    conditional param-broadcast; here the replicas live as a leading
+    dp-sharded axis and the step is vmapped over it — the periodic average
+    is one psum over 'dp' emitted by XLA."""
+
+    def __init__(self, layer, loss_fn, optimizer, k_steps: int = 4,
+                 mesh=None, dp_axis: str = "dp", begin_step: int = 1,
+                 amp_level=None, amp_dtype="bfloat16", remat=False,
+                 remat_policy=None, adaptive: bool = False,
+                 max_k_steps: int = 16):
+        from ...static.train_step import TrainStep
+        self.inner = TrainStep(layer, loss_fn, optimizer, donate=False,
+                               amp_level=amp_level, amp_dtype=amp_dtype)
+        self._fwd_loss = self.inner._forward_loss
+        if remat:
+            self._fwd_loss = jax.checkpoint(self._fwd_loss,
+                                            policy=remat_policy)
+        self.k_steps = max(1, int(k_steps))
+        self.init_k_steps = self.k_steps
+        self.begin_step = max(1, int(begin_step))
+        self.adaptive = adaptive
+        self.max_k_steps = max_k_steps
+        self._loss0 = None
+        self.mesh = mesh
+        self.optimizer = optimizer
+        if mesh is not None and dp_axis in mesh.axis_names:
+            self.dp = int(mesh.shape[dp_axis])
+        else:
+            self.dp = 1
+        self.dp_axis = dp_axis
+        dp = self.dp
+
+        def rep(a):
+            return jnp.broadcast_to(jnp.asarray(a)[None],
+                                    (dp,) + np.shape(a))
+        self.params = jax.tree_util.tree_map(rep, self.inner.params)
+        self.opt_state = jax.tree_util.tree_map(rep, self.inner.opt_state)
+        self.buffers = jax.tree_util.tree_map(rep, self.inner.buffers)
+        if mesh is not None and self.dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def lead(a):
+                return jax.device_put(
+                    a, NamedSharding(mesh, P(dp_axis)))
+            self.params = jax.tree_util.tree_map(lead, self.params)
+            self.opt_state = jax.tree_util.tree_map(lead, self.opt_state)
+            self.buffers = jax.tree_util.tree_map(lead, self.buffers)
+        self._calls = 0
+        self._step_local = None
+        self._step_avg = None
+
+    def _single(self, params, opt_state, buffers, key, lr, inputs, labels):
+        (loss, (new_buffers, _)), grads = jax.value_and_grad(
+            lambda p: self._fwd_loss(p, buffers, key, inputs,
+                                     labels), has_aux=True)(params)
+        new_params, new_opt = self.optimizer.apply_gradients_tree(
+            params, grads, opt_state, lr=lr)
+        return new_params, new_opt, new_buffers, loss
+
+    def _build(self, average: bool):
+        dp = self.dp
+
+        def step(params, opt_state, buffers, keys, lr, inputs, labels):
+            new_p, new_o, new_b, losses = jax.vmap(
+                self._single, in_axes=(0, 0, 0, 0, None, 0, 0))(
+                params, opt_state, buffers, keys, lr, inputs, labels)
+            if average:
+                new_p = jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(
+                        jnp.mean(a, axis=0, keepdims=True), a.shape),
+                    new_p)
+            return new_p, new_o, new_b, jnp.mean(losses)
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def __call__(self, inputs, labels=()):
+        from ...framework import Tensor
+        from ...jit.api import _unwrap_tree
+        from ...core.generator import next_key
+        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        dp = self.dp
+
+        def split(a):  # [B, ...] -> [dp, B/dp, ...]
+            return a.reshape((dp, a.shape[0] // dp) + a.shape[1:])
+        in_arrays = jax.tree_util.tree_map(split,
+                                           _unwrap_tree(tuple(inputs)))
+        lbl_arrays = jax.tree_util.tree_map(split,
+                                            _unwrap_tree(tuple(labels)))
+        self._calls += 1
+        # before begin_step: sync every step (ref localsgd_optimizer.py
+        # begin_step); after: average on the k-step cadence
+        if self._calls < self.begin_step:
+            average = True
+        else:
+            average = ((self._calls - self.begin_step + 1)
+                       % self.k_steps) == 0
+        if average:
+            if self._step_avg is None:
+                self._step_avg = self._build(True)
+            fn = self._step_avg
+        else:
+            if self._step_local is None:
+                self._step_local = self._build(False)
+            fn = self._step_local
+        keys = jax.random.split(next_key(), dp)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self.params, self.opt_state, self.buffers, loss = fn(
+            self.params, self.opt_state, self.buffers, keys, lr,
+            in_arrays, lbl_arrays)
+        if self.adaptive and average:
+            # ACSGD-style schedule (ref AdaptiveLocalSGDOptimizer): sync
+            # period shrinks as the loss falls — k_t = ceil(k0 *
+            # sqrt(loss_t / loss_0)), clamped to [1, max_k_steps]
+            lt = float(np.asarray(loss))
+            if self._loss0 is None:
+                self._loss0 = max(lt, 1e-12)
+            ratio = max(lt, 0.0) / self._loss0
+            self.k_steps = int(np.clip(
+                np.ceil(self.init_k_steps * np.sqrt(ratio)),
+                1, self.max_k_steps))
+        return Tensor(loss)
+
+
+def build_from_spec(spec: TrainStepSpec, mesh=None, sharding_plan=None):
+    """Materialize the compiled spec into an executable step object."""
+    if spec.localsgd_k_steps > 0:
+        return LocalSGDStep(spec.layer, spec.loss_fn, spec.optimizer,
+                            k_steps=spec.localsgd_k_steps, mesh=mesh,
+                            begin_step=spec.localsgd_begin_step,
+                            amp_level=spec.amp_level,
+                            amp_dtype=spec.amp_dtype,
+                            remat=spec.remat,
+                            remat_policy=spec.remat_policy,
+                            adaptive=spec.localsgd_adaptive)
+    from ...static.train_step import TrainStep
+    init, fn = chain_grad_transforms(spec.grad_transforms)
+    strategy_state = None
+    grad_transform = None
+    if fn is not None:
+        grad_transform = fn
+        # init needs the param arrays; build them the same way TrainStep
+        # will (from the layer's trainable state)
+        state = spec.layer.state_dict()
+        params = {k: t._data for k, t in state.items()
+                  if not t.stop_gradient}
+        strategy_state = init(params)
+    return TrainStep(spec.layer, spec.loss_fn, spec.optimizer,
+                     amp_level=spec.amp_level, amp_dtype=spec.amp_dtype,
+                     mesh=mesh, sharding_plan=sharding_plan,
+                     grad_accum_steps=spec.grad_accum_steps,
+                     grad_transform=grad_transform,
+                     strategy_state=strategy_state,
+                     remat=spec.remat, remat_policy=spec.remat_policy)

@@ -118,17 +118,17 @@ class ShardingPlan:
     # -- TrainStep integration ----------------------------------------------
     def step_shardings(self, train_step):
         """(in_shardings, out_shardings) for TrainStep._build's step fn
-        signature: (params, opt_state, buffers, key, lr, inputs, labels)."""
+        signature:
+            step(params, opt_state, buffers, strat, key, lr, inputs, labels)
+              -> (params, opt_state, buffers, strat, loss)
+        The inputs/labels shardings are appended by TrainStep at first call
+        (structure unknown until then) via data_spec()."""
         params = train_step.params
         state_tensors = train_step.layer.state_dict()
 
         p_shard = {k: self.named(self.param_spec(k, state_tensors.get(k)))
                    for k in params}
         # optimizer state mirrors each param's spec (+zero)
-        def opt_leaf_sharding(path_param_name, leaf):
-            return self.named(self.state_spec(path_param_name,
-                                              state_tensors.get(
-                                                  path_param_name)))
         opt_shard = {}
         for k, st in train_step.opt_state.items():
             opt_shard[k] = {
@@ -136,14 +136,28 @@ class ShardingPlan:
                     if np.ndim(v) > 0 else self.replicated())
                 for n, v in st.items()}
         buf_shard = {k: self.replicated() for k in train_step.buffers}
-        data_sh = jax.tree_util.tree_map(
-            lambda _: None, train_step.params)  # placeholder, built below
 
-        # inputs/labels shardings are resolved per-leaf by TrainStep at
-        # first call (structure unknown until then) via data_spec()
-        in_shardings = (p_shard, opt_shard, buf_shard,
+        # strategy state (DGC momentum/error buffers...): leaves keyed by
+        # a param name shard like that param's optimizer state (so ZeRO's
+        # memory win extends to them); other leaves replicate
+        def strat_shardings(node):
+            if isinstance(node, dict):
+                out = {}
+                for k, v in node.items():
+                    if k in params and not isinstance(v, dict):
+                        out[k] = self.named(self.state_spec(
+                            k, state_tensors.get(k)))
+                    else:
+                        out[k] = strat_shardings(v)
+                return out
+            return self.replicated()
+        strat_sh = strat_shardings(getattr(train_step, "strategy_state",
+                                           {}))
+
+        in_shardings = (p_shard, opt_shard, buf_shard, strat_sh,
                         self.replicated(), self.replicated())
-        out_shardings = (p_shard, opt_shard, buf_shard, self.replicated())
+        out_shardings = (p_shard, opt_shard, buf_shard, strat_sh,
+                         self.replicated())
         return in_shardings, out_shardings
 
     def place(self, array, spec: P):

@@ -485,24 +485,32 @@ def _iou_cxcywh(pred, gt, valid):
 # NMS family — fixed-capacity, mask-based (TPU static shapes)
 # ---------------------------------------------------------------------------
 
-def _greedy_nms_mask(boxes, scores, iou_threshold, normalized=True):
+def _greedy_nms_mask(boxes, scores, iou_threshold, normalized=True,
+                     eta=1.0):
     """Greedy hard NMS over pre-sorted (desc) candidates.
 
     boxes [K,4], scores [K] sorted descending. Returns keep mask [K] bool.
-    One O(K^2) IoU matrix + a fori_loop carrying the keep mask — no dynamic
-    shapes, no gather in the loop body.
+    One O(K^2) IoU matrix + a fori_loop carrying (keep mask, adaptive
+    threshold) — no dynamic shapes, no gather in the loop body.
+
+    eta < 1 enables adaptive NMS (ref multiclass_nms_op.cc NMSFast: after
+    each kept box, while threshold > 0.5 it decays by eta).
     """
     k = boxes.shape[0]
     iou = _pairwise_iou(boxes, boxes, normalized)      # [K,K]
-    sup = iou > iou_threshold
 
-    def body(i, keep):
+    def body(i, carry):
+        keep, thr = carry
         # candidate i survives iff no higher-ranked kept box suppresses it
-        alive = ~jnp.any(sup[:, i] & keep & (jnp.arange(k) < i))
-        return keep.at[i].set(alive & keep[i])
+        alive = ~jnp.any((iou[:, i] > thr) & keep & (jnp.arange(k) < i))
+        kept = alive & keep[i]
+        thr = jnp.where(kept & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return keep.at[i].set(kept), thr
 
     init = scores > -jnp.inf                            # all candidates
-    return jax.lax.fori_loop(0, k, body, init)
+    thr0 = jnp.asarray(iou_threshold, iou.dtype)
+    keep, _ = jax.lax.fori_loop(0, k, body, (init, thr0))
+    return keep
 
 
 @register_op("nms")
@@ -542,7 +550,8 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
             b = bx[order]
             valid = s > score_threshold
             keep = _greedy_nms_mask(b, jnp.where(valid, s, -jnp.inf),
-                                    nms_threshold, normalized) & valid
+                                    nms_threshold, normalized,
+                                    eta=nms_eta) & valid
             return b, jnp.where(keep, s, -1.0), order
         cb, cs, cidx = jax.vmap(per_class)(sc)          # [C,k,4],[C,k],[C,k]
         labels = jnp.broadcast_to(jnp.arange(num_cls)[:, None], cs.shape)
@@ -591,13 +600,16 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
             tri = jnp.tril(iou, -1)                     # [k,k] j<i
             max_iou = jnp.max(tri, axis=1)              # compensate IoU
             if use_gaussian:
-                decay = jnp.exp(-(tri ** 2 - max_iou[None, :] ** 2)
-                                / gaussian_sigma)
+                # ref matrix_nms_op.cc:87 decay_score<T,true>:
+                # exp((max_iou^2 - iou^2) * sigma)
+                decay = jnp.exp((max_iou[None, :] ** 2 - tri ** 2)
+                                * gaussian_sigma)
             else:
                 decay = (1.0 - tri) / jnp.maximum(1.0 - max_iou[None, :], 1e-9)
             decay = jnp.where(jnp.tril(jnp.ones_like(iou, bool), -1),
                               decay, jnp.inf)
-            dec = jnp.min(decay, axis=1)
+            # ref :154 initializes min_decay = 1.0 — decay never amplifies
+            dec = jnp.minimum(jnp.min(decay, axis=1), 1.0)
             dec = jnp.where(jnp.arange(k) == 0, 1.0, dec)
             s2 = jnp.where(valid, s * dec, -1.0)
             if post_threshold > 0:
@@ -808,8 +820,8 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
         hs = props[:, 3] - props[:, 1] + off
         ok = (ws >= min_size) & (hs >= min_size)
         s_f = jnp.where(ok, s_top, -jnp.inf)
-        keep = _greedy_nms_mask(props, s_f, nms_thresh, normalized=not pixel_offset) \
-            & ok
+        keep = _greedy_nms_mask(props, s_f, nms_thresh,
+                                normalized=not pixel_offset, eta=eta) & ok
         s_keep = jnp.where(keep, s_f, -jnp.inf)
         kk = min(int(post_nms_top_n), k)
         s_fin, sel = jax.lax.top_k(s_keep, kk)
@@ -945,7 +957,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return multiclass_nms.__pure_fn__(
         decoded, sc, score_threshold=score_threshold, nms_top_k=nms_top_k,
         keep_top_k=keep_top_k, nms_threshold=nms_threshold,
-        background_label=background_label)
+        nms_eta=nms_eta, background_label=background_label)
 
 
 def _decode_ssd(prior, pvar, loc):
@@ -985,10 +997,20 @@ def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist,
     """
     loss = cls_loss if loc_loss is None else cls_loss + loc_loss
     is_neg = (match_indices < 0) & (match_dist < neg_dist_threshold)
-    num_pos = jnp.sum(match_indices >= 0, axis=1)
-    num_neg = (num_pos * neg_pos_ratio).astype(jnp.int32)
-    if sample_size is not None:
-        num_neg = jnp.minimum(num_neg, sample_size)
+    if mining_type == "hard_example":
+        # ref mine_hard_examples_op.cc: fixed sample_size hardest negatives
+        if sample_size is None:
+            raise ValueError(
+                "mining_type='hard_example' requires sample_size")
+        num_neg = jnp.full((cls_loss.shape[0],), int(sample_size),
+                           jnp.int32)
+    elif mining_type == "max_negative":
+        num_pos = jnp.sum(match_indices >= 0, axis=1)
+        num_neg = (num_pos * neg_pos_ratio).astype(jnp.int32)
+        if sample_size is not None:
+            num_neg = jnp.minimum(num_neg, sample_size)
+    else:
+        raise ValueError(f"unknown mining_type {mining_type!r}")
     neg_loss = jnp.where(is_neg, loss, -jnp.inf)
     order = jnp.argsort(-neg_loss, axis=1)
     rank = jnp.argsort(order, axis=1)

@@ -51,7 +51,10 @@ class TrainStep:
     def __init__(self, layer: Layer, loss_fn: Callable,
                  optimizer: Optimizer, amp_level: Optional[str] = None,
                  amp_dtype="bfloat16", mesh=None, sharding_plan=None,
-                 donate: bool = True, grad_accum_steps: int = 1):
+                 donate: bool = True, grad_accum_steps: int = 1,
+                 grad_transform: Optional[Callable] = None,
+                 strategy_state: Optional[Dict[str, Any]] = None,
+                 remat: bool = False, remat_policy=None):
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -60,6 +63,15 @@ class TrainStep:
         self.mesh = mesh
         self.sharding_plan = sharding_plan
         self.grad_accum_steps = grad_accum_steps
+        # fleet meta-optimizer hooks: grad_transform(grads, strat_state,
+        # params) -> (grads, strat_state) runs between backward and the
+        # optimizer update (DGC / fp16-allreduce analogues); remat wraps
+        # the forward in jax.checkpoint (recompute_optimizer.py analogue).
+        self.grad_transform = grad_transform
+        self.strategy_state = strategy_state if strategy_state is not None \
+            else {}
+        self.remat = remat
+        self.remat_policy = remat_policy
 
         state = layer.state_dict()
         self._trainable_names = [k for k, t in state.items()
@@ -117,8 +129,14 @@ class TrainStep:
     def _build(self, in_arrays, lbl_arrays):
         optimizer = self.optimizer
         accum = self.grad_accum_steps
+        fwd_loss = self._forward_loss
+        if self.remat:
+            fwd_loss = jax.checkpoint(
+                self._forward_loss, policy=self.remat_policy,
+                static_argnums=())
 
-        def step(params, opt_state, buffers, key, lr, inputs, labels):
+        def step(params, opt_state, buffers, strat, key, lr, inputs,
+                 labels):
             if accum > 1:
                 # gradient merge (reference gradient_merge_optimizer.py):
                 # split the batch into accum microbatches, scan, average
@@ -129,7 +147,7 @@ class TrainStep:
                         lambda a: _microslice(a, idx, accum), labels)
                     k = jax.random.fold_in(key, idx)
                     gf = jax.value_and_grad(
-                        lambda p: self._forward_loss(p, buffers, k, sl, ll),
+                        lambda p: fwd_loss(p, buffers, k, sl, ll),
                         has_aux=True)
                     return gf
 
@@ -149,16 +167,18 @@ class TrainStep:
                     lambda a: a[-1], nbs)
             else:
                 grad_fn = jax.value_and_grad(
-                    lambda p: self._forward_loss(p, buffers, key, inputs,
-                                                 labels), has_aux=True)
+                    lambda p: fwd_loss(p, buffers, key, inputs,
+                                       labels), has_aux=True)
                 (loss, (new_buffers, _)), grads = grad_fn(params)
+            if self.grad_transform is not None:
+                grads, strat = self.grad_transform(grads, strat, params)
             new_params, new_opt = optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr=lr)
-            return new_params, new_opt, new_buffers, loss
+            return new_params, new_opt, new_buffers, strat, loss
 
         jit_kwargs = {}
         if self._donate:
-            jit_kwargs["donate_argnums"] = (0, 1, 2)
+            jit_kwargs["donate_argnums"] = (0, 1, 2, 3)
         if self.mesh is not None and self.sharding_plan is not None:
             plan = self.sharding_plan
             in_sh, out_sh = plan.step_shardings(self)
@@ -166,7 +186,7 @@ class TrainStep:
                 lambda a: plan.named(plan.data_spec(a)), in_arrays)
             lbl_in = jax.tree_util.tree_map(
                 lambda a: plan.named(plan.data_spec(a)), lbl_arrays)
-            jit_kwargs["in_shardings"] = in_sh[:5] + (data_in, lbl_in)
+            jit_kwargs["in_shardings"] = in_sh + (data_in, lbl_in)
             jit_kwargs["out_shardings"] = out_sh
         return jax.jit(step, **jit_kwargs)
 
@@ -202,9 +222,10 @@ class TrainStep:
             self._step_fn = self._build(in_arrays, lbl_arrays)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = next_key()
-        self.params, self.opt_state, self.buffers, loss = self._step_fn(
-            self.params, self.opt_state, self.buffers, key, lr, in_arrays,
-            lbl_arrays)
+        (self.params, self.opt_state, self.buffers, self.strategy_state,
+         loss) = self._step_fn(
+            self.params, self.opt_state, self.buffers, self.strategy_state,
+            key, lr, in_arrays, lbl_arrays)
         if isinstance(self.optimizer._lr, LRScheduler):
             pass  # caller steps the scheduler per its own schedule
         return Tensor(loss)
