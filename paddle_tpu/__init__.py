@@ -35,6 +35,9 @@ from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import inference  # noqa: F401
+from . import utils  # noqa: F401
 from . import models  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401

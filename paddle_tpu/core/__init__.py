@@ -1,4 +1,7 @@
-from . import dtypes, enforce, flags, generator, place
+from . import dtypes, enforce, flags, generator, monitor, place
+from .monitor import stat as monitor_stat, get_stats  # noqa: F401
+from .selected_rows import (SelectedRows, embedding_grad_rows,  # noqa: F401
+                            merge_selected_rows, sparse_row_update)
 from .dtypes import (bool_, uint8, int8, int16, int32, int64, float16,
                      bfloat16, float32, float64, complex64, complex128,
                      convert_dtype, set_default_dtype, get_default_dtype)

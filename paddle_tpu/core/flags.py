@@ -102,6 +102,9 @@ define_flag("eager_op_jit", False,
             "Use a per-op jit cache for eager execution (lower dispatch "
             "overhead; compiled path is the real perf story).")
 define_flag("benchmark", False, "Record per-op timing stats in eager mode.")
+define_flag("op_stats", False,
+            "Count per-op eager dispatches in the stat monitor "
+            "(platform/monitor.h analogue).")
 define_flag("seed", 0, "Global RNG seed (0 = nondeterministic).")
 define_flag("allocator_strategy", "xla",
             "Memory strategy. XLA owns device memory on TPU; this flag exists "

@@ -1,0 +1,66 @@
+"""Stat monitor: lock-free-ish named stat registry.
+
+Reference: paddle/fluid/platform/monitor.h:44 (StatValue<T> registry,
+STAT_GPU memory counters, ExportedStatValue dump).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+__all__ = ["StatValue", "stat", "get_stats", "reset_all", "log_stat"]
+
+
+class StatValue:
+    """A named monotonic/gauge counter (StatValue<T> analogue)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v=1):
+        with self._lock:
+            self._value += v
+        return self
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+        return self
+
+    def get(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+
+_registry: Dict[str, StatValue] = {}
+_registry_lock = threading.Lock()
+
+
+def stat(name: str) -> StatValue:
+    """Get-or-create the named stat (STAT_INT registration analogue)."""
+    s = _registry.get(name)
+    if s is None:
+        with _registry_lock:
+            s = _registry.setdefault(name, StatValue(name))
+    return s
+
+
+def log_stat(name: str, value):
+    stat(name).set(value)
+
+
+def get_stats() -> Dict[str, int]:
+    """ExportedStatValue dump."""
+    return {k: v.get() for k, v in sorted(_registry.items())}
+
+
+def reset_all():
+    for v in _registry.values():
+        v.reset()

@@ -1,0 +1,87 @@
+"""SelectedRows: row-sparse gradient representation + row-wise updates.
+
+Reference: paddle/fluid/framework/selected_rows.h:41 (rows + value slab +
+height) and operators/math/selected_rows_functor.* (scatter-add merge,
+sgd/adam sparse updates on rows).
+
+TPU design decision: under jit, XLA already turns embedding backward into
+a scatter-add — dense materialization never happens on-chip, so the
+compiled path needs no SelectedRows. The eager path and host-side update
+utilities keep the row-sparse form for the reference's capability surface
+(huge embedding tables where a dense [vocab, dim] grad is unaffordable):
+grads stay (rows, values) and optimizers update only the touched rows.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows", "embedding_grad_rows", "merge_selected_rows",
+           "sparse_row_update"]
+
+
+class SelectedRows:
+    """Row-sparse slab: `value[i]` is the data for logical row `rows[i]`
+    of a dense [height, ...] tensor. Rows may repeat (unmerged grads)."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.value.dtype)
+        return dense.at[self.rows].add(self.value)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_shape={self.value.shape[1:]})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows (ref scatter::MergeAdd). Static-shape friendly:
+    output keeps the same capacity with unique rows front-packed; padding
+    rows point at row 0 with zero values."""
+    uniq, inv = jnp.unique(sr.rows, return_inverse=True,
+                           size=sr.rows.shape[0], fill_value=-1)
+    merged = jnp.zeros_like(sr.value)
+    merged = merged.at[inv].add(sr.value)
+    valid = uniq >= 0
+    rows = jnp.where(valid, uniq, 0)
+    merged = merged * valid[:, None].astype(merged.dtype)
+    return SelectedRows(rows, merged, sr.height)
+
+
+def embedding_grad_rows(ids, grad_out, height: int) -> SelectedRows:
+    """Build the row-sparse gradient of an embedding lookup: ids [...],
+    grad_out [..., dim] -> SelectedRows over the table's rows (ref
+    lookup_table_v2_grad with is_sparse=True)."""
+    flat_ids = jnp.reshape(ids, (-1,))
+    flat_g = jnp.reshape(grad_out, (flat_ids.shape[0], -1))
+    return merge_selected_rows(
+        SelectedRows(flat_ids, flat_g, height))
+
+
+def sparse_row_update(param, sr: SelectedRows, lr,
+                      velocity: Optional[jax.Array] = None,
+                      momentum: float = 0.0):
+    """SGD/momentum touching only sr's rows (ref
+    selected_rows_functor sgd; momentum optional). Returns
+    (new_param, new_velocity)."""
+    param = jnp.asarray(param)
+    if velocity is not None:
+        velocity = jnp.asarray(velocity)
+    val = sr.value.reshape((sr.rows.shape[0],) + param.shape[1:])
+    if velocity is None:
+        return param.at[sr.rows].add(-lr * val), None
+    v_rows = momentum * velocity[sr.rows] + val
+    new_vel = velocity.at[sr.rows].set(v_rows)
+    return param.at[sr.rows].add(-lr * v_rows), new_vel

@@ -98,9 +98,12 @@ def make_dgc_transform(sparsity=0.999, momentum: float = 0.9,
                 "step": jnp.zeros((), jnp.int32)}
 
     def one(g, u, e, stage_idx, compress):
-        u = momentum * u + g                    # momentum correction
-        e = e + u                               # error feedback accumulate
-        flat = jnp.abs(e).reshape(-1)
+        # momentum correction (the DGC paper's local momentum; the outer
+        # optimizer must be plain SGD — DGCOptimizer swaps it, mirroring
+        # the reference where dgc_momentum_op owns the momentum)
+        u = momentum * u + g
+        e_acc = e + u                           # error feedback accumulate
+        flat = jnp.abs(e_acc).reshape(-1)
         # each rampup stage has its own static top-k size (top_k needs a
         # static k, hence lax.switch over per-stage branches)
         ks = [max(1, int(round(flat.size * (1.0 - s)))) for s in stages]
@@ -108,11 +111,13 @@ def make_dgc_transform(sparsity=0.999, momentum: float = 0.9,
             stage_idx,
             [(lambda fl, k=k: jax.lax.top_k(fl, k)[0][-1]) for k in ks],
             flat)
-        mask = (jnp.abs(e) >= thr).astype(g.dtype)
-        # warmup (ref dgc_op rampup_begin_step): pass everything through
-        mask = jnp.where(compress, mask, jnp.ones_like(mask))
-        out = e * mask
-        return out, u * (1.0 - mask), e * (1.0 - mask)
+        mask = (jnp.abs(e_acc) >= thr).astype(g.dtype)
+        # warmup (ref rampup_begin_step): momentum-corrected grads flow
+        # whole, nothing accumulates in the error buffer
+        out = jnp.where(compress, e_acc * mask, u)
+        new_u = jnp.where(compress, u * (1.0 - mask), u)
+        new_e = jnp.where(compress, e_acc * (1.0 - mask), e)
+        return out, new_u, new_e
 
     def fn(grads, state, params):
         step = state["step"]
@@ -267,8 +272,19 @@ class DGCOptimizer(MetaOptimizerBase):
 
     def apply(self, spec, strategy, fleet=None):
         cfg = getattr(strategy, "dgc_configs", None) or {}
+        # DGC owns the momentum (ref dgc_momentum_op): take it from the
+        # user's Momentum optimizer and swap the update to plain SGD so
+        # momentum isn't applied twice
+        from ...optimizer import SGD, Momentum
+        opt = spec.optimizer
+        momentum = 0.9
+        if isinstance(opt, Momentum):
+            momentum = float(getattr(opt, "_momentum", 0.9))
+            spec.optimizer = SGD(learning_rate=opt.get_lr(),
+                                 parameters=opt._parameters)
         init, fn = make_dgc_transform(
             sparsity=cfg.get("sparsity", [0.999]),
+            momentum=float(cfg.get("momentum", momentum)),
             rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
             rampup_step=int(cfg.get("rampup_step", 1)))
         spec.grad_transforms.append((self.name, init, fn))
@@ -458,6 +474,10 @@ class LocalSGDStep:
         self.params = jax.tree_util.tree_map(rep, self.inner.params)
         self.opt_state = jax.tree_util.tree_map(rep, self.inner.opt_state)
         self.buffers = jax.tree_util.tree_map(rep, self.inner.buffers)
+        # only _forward_loss (layer + amp config) is borrowed from the
+        # inner TrainStep; drop its unreplicated state copies so HBM holds
+        # dp copies, not dp+1
+        self.inner.params = self.inner.opt_state = self.inner.buffers = {}
         if mesh is not None and self.dp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 

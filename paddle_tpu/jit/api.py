@@ -235,26 +235,81 @@ def not_to_static(fn):
 # ---------------------------------------------------------------------------
 
 class TranslatedLayer(Layer):
-    """Loaded inference layer (reference TranslatedLayer)."""
+    """Loaded inference layer (reference TranslatedLayer): weights +
+    (when the artifact carries a serialized exported program) a runnable
+    forward — the AnalysisPredictor "load program + params, run" path,
+    with the program being portable StableHLO instead of a ProgramDesc."""
 
-    def __init__(self, state, meta):
+    def __init__(self, state, meta, exported=None):
         super().__init__()
         from ..framework import Parameter
         self._meta = meta
-        for k, v in state.items():
+        self._exported = exported
+        self._state_arrays = {k: jnp.asarray(v) for k, v in state.items()}
+        for k, v in self._state_arrays.items():
             safe = k.replace(".", "__")
-            self.add_parameter(safe, Parameter(jnp.asarray(v)))
+            self.add_parameter(safe, Parameter(v))
         self._keys = list(state.keys())
 
     def forward(self, *args):
-        raise RuntimeError(
-            "TranslatedLayer loaded weights only; rebuild the model class "
-            "and use set_state_dict, or load with a known architecture")
+        if self._exported is None:
+            raise RuntimeError(
+                "artifact has no serialized program (saved without "
+                "input_spec); rebuild the model class and use "
+                "set_state_dict")
+        arrays = _unwrap_tree(tuple(args))
+        out = self._exported.call(self._state_arrays, *arrays)
+        return _wrap_tree(out)
+
+
+def export_forward(layer, input_spec, platforms=("cpu", "tpu")):
+    """AOT-export a Layer's eval-mode forward as a portable serialized
+    program: fn(state_dict, *inputs) -> outputs via jax.export
+    (the save_inference_model program-serialization analogue,
+    ref inference/api/analysis_predictor.h:82 load path)."""
+    from jax import export as jax_export
+    fn = layer.forward
+    if isinstance(fn, StaticFunction):
+        fn = fn._function
+    pure = functionalize(fn, layer)
+
+    def infer_fn(state, *inputs):
+        out, _ = pure(state, jax.random.key(0), *inputs)
+        return out
+
+    modes = [lyr.training for lyr in layer.sublayers(include_self=True)]
+    layer.eval()
+    try:
+        # None dims stay polymorphic in the artifact (shape-polymorphic
+        # export) so the loaded program runs at any batch size
+        scope = jax_export.SymbolicScope()
+        next_dim = iter(range(1000))
+
+        def dims_of(shape):
+            if all(d is not None for d in shape):
+                return tuple(shape)
+            spec_str = ", ".join(
+                f"b{next(next_dim)}" if d is None else str(d)
+                for d in shape)
+            return jax_export.symbolic_shape(spec_str, scope=scope)
+
+        args = [jax.ShapeDtypeStruct(dims_of(s.shape), np.dtype(s.dtype))
+                for s in input_spec]
+        raw = {k: v._data for k, v in layer.state_dict().items()}
+        state_spec = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for k, v in raw.items()}
+        exported = jax_export.export(
+            jax.jit(infer_fn), platforms=list(platforms))(
+            state_spec, *args)
+        return exported
+    finally:
+        for lyr, m in zip(layer.sublayers(include_self=True), modes):
+            lyr.training = m
 
 
 def save(layer, path, input_spec=None, **config):
-    """paddle.jit.save: persist state + signature (+ StableHLO when specs
-    are concrete)."""
+    """paddle.jit.save: persist state + signature + (with input_spec) the
+    serialized exported program so `load` returns a runnable layer."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {}
     if isinstance(layer, Layer):
@@ -267,27 +322,34 @@ def save(layer, path, input_spec=None, **config):
     import pickle
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({"state": state, "meta": meta}, f)
-    # AOT export: lower the forward to StableHLO text for serving parity
     if input_spec and isinstance(layer, Layer):
         try:
-            pure = functionalize(
-                layer.forward if not isinstance(layer.forward,
-                                                StaticFunction)
-                else layer.forward._function, layer)
-            args = [jax.ShapeDtypeStruct(
-                tuple(d if d is not None else 1 for d in s.shape), s.dtype)
-                for s in input_spec]
-            raw = {k: v._data for k, v in layer.state_dict().items()}
-            lowered = jax.jit(pure).lower(
-                raw, jax.random.key(0), *args)
+            exported = export_forward(layer, input_spec)
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+            # human-inspectable StableHLO text alongside
             with open(path + ".stablehlo.txt", "w") as f:
-                f.write(lowered.as_text())
-        except Exception:
-            pass  # export is best-effort; weights are the contract
+                f.write(str(exported.mlir_module()))
+        except Exception as e:  # export is best-effort for jit.save; the
+            # weights are the contract (untraceable forwards still save).
+            # static.save_inference_model raises instead — there the
+            # program IS the artifact.
+            import warnings
+            for suffix in (".pdmodel", ".stablehlo.txt"):
+                if os.path.exists(path + suffix):
+                    os.remove(path + suffix)
+            warnings.warn(
+                f"jit.save: program export skipped ({type(e).__name__}: "
+                f"{e}); weights saved, load() will be weights-only")
 
 
 def load(path, **config):
     import pickle
     with open(path + ".pdiparams", "rb") as f:
         data = pickle.load(f)
-    return TranslatedLayer(data["state"], data["meta"])
+    exported = None
+    if os.path.exists(path + ".pdmodel"):
+        from jax import export as jax_export
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jax_export.deserialize(f.read())
+    return TranslatedLayer(data["state"], data["meta"], exported)

@@ -188,6 +188,9 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
 
     if flag_value("check_nan_inf"):
         _check_nan_inf(name, outs)
+    if flag_value("op_stats"):
+        from ..core.monitor import stat
+        stat(f"op.{name}.count").add(1)
 
     out_tensors = [
         o if isinstance(o, Tensor)

@@ -10,6 +10,9 @@ from .program import (Program, program_guard, default_main_program,
                       default_startup_program, data, Executor,
                       append_backward)  # noqa: F401
 from . import nn  # noqa: F401
+from . import io  # noqa: F401
+from .io import (save_inference_model, load_inference_model,  # noqa: F401
+                 serialize_program, deserialize_program)
 
 
 def _enable_static_mode():

@@ -1,0 +1,88 @@
+"""static.io: save/load_inference_model (reference python/paddle/static/io.py
+save_inference_model/load_inference_model, fluid/io.py save_persistables).
+
+The saved artifact is {prefix}.pdmodel (serialized jax.export program,
+portable StableHLO compiled for cpu+tpu), {prefix}.pdiparams (weights),
+{prefix}.pdmeta.json (feed/fetch names) — the ProgramDesc+params pair of
+the reference, but compiler-native.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["save_inference_model", "load_inference_model",
+           "serialize_program", "deserialize_program"]
+
+
+def save_inference_model(path_prefix: str, feed_vars=None, fetch_vars=None,
+                         executor=None, layer=None, input_spec=None,
+                         feed_names: Optional[Sequence[str]] = None,
+                         fetch_names: Optional[Sequence[str]] = None,
+                         **kwargs):
+    """Export a Layer's eval forward as a deployable artifact.
+
+    Dygraph-style usage (the TPU-native path):
+        save_inference_model(prefix, layer=model, input_spec=[InputSpec...])
+    The reference's (feed_vars, fetch_vars, executor) static signature is
+    accepted for parity: feed_vars may be the layer and fetch_vars the
+    input_spec list when called positionally from 2.0-style code.
+    """
+    from ..jit.api import InputSpec, export_forward
+    from ..nn.layer.layers import Layer
+
+    # tolerate the 2.0 positional style: (prefix, layer, input_spec)
+    if layer is None and isinstance(feed_vars, Layer):
+        layer = feed_vars
+        if input_spec is None and fetch_vars is not None:
+            input_spec = fetch_vars
+    if layer is None or input_spec is None:
+        raise ValueError(
+            "save_inference_model needs layer= and input_spec= (or the "
+            "positional (path, layer, input_spec) form)")
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    exported = export_forward(layer, input_spec)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
+    meta = {"class": type(layer).__name__,
+            "input_spec": [{"shape": list(s.shape),
+                            "dtype": str(np.dtype(s.dtype))}
+                           for s in input_spec]}
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"state": state, "meta": meta}, f)
+    feed_names = list(feed_names) if feed_names else [
+        getattr(s, "name", None) or f"x{i}"
+        for i, s in enumerate(input_spec)]
+    fetch_names = list(fetch_names) if fetch_names else [
+        f"out{i}" for i in range(len(exported.out_avals))]
+    with open(path_prefix + ".pdmeta.json", "w") as f:
+        json.dump({"feed_names": feed_names, "fetch_names": fetch_names},
+                  f)
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns (predictor, feed_names, fetch_names) — the reference returns
+    (program, feed_names, fetch_targets) to pass to Executor.run; here the
+    program IS executable (an AOT-compiled Predictor), call
+    predictor.run([arrays]) directly."""
+    from ..inference import Config, create_predictor
+    pred = create_predictor(Config(path_prefix))
+    return pred, pred.get_input_names(), pred.get_output_names()
+
+
+def serialize_program(layer, input_spec) -> bytes:
+    """Serialized portable program bytes (ref static/io.py
+    serialize_program)."""
+    from ..jit.api import export_forward
+    return export_forward(layer, input_spec).serialize()
+
+
+def deserialize_program(data: bytes):
+    from jax import export as jax_export
+    return jax_export.deserialize(data)

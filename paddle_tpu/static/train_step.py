@@ -241,4 +241,33 @@ class TrainStep:
         self.sync_to_layer()
         return {"model": self.layer.state_dict(),
                 "opt_state": self.opt_state,
-                "opt": self.optimizer.state_dict()}
+                "opt": self.optimizer.state_dict(),
+                "strategy_state": self.strategy_state}
+
+    def set_state_dict(self, state):
+        """Restore a state_dict() checkpoint (params/buffers into the
+        layer, optimizer + strategy state — DGC error-feedback buffers,
+        rampup counters — into the step). Arrays are COPIED: the compiled
+        step donates its state buffers each call, so sharing them with the
+        checkpoint source would invalidate the source's state."""
+        def copy_arr(v):
+            a = v._data if isinstance(v, Tensor) else v
+            return jnp.array(np.asarray(a))
+        model = state.get("model") or {}
+        own = self.layer.state_dict()
+        for k, v in model.items():
+            arr = copy_arr(v)
+            if k in own:
+                own[k]._data = arr
+            if k in self.params:
+                self.params[k] = arr
+            if k in self.buffers:
+                self.buffers[k] = arr
+        if state.get("opt_state") is not None:
+            self.opt_state = jax.tree_util.tree_map(copy_arr,
+                                                    state["opt_state"])
+        if state.get("opt") is not None:
+            self.optimizer.set_state_dict(state["opt"])
+        if state.get("strategy_state") is not None:
+            self.strategy_state = jax.tree_util.tree_map(
+                copy_arr, state["strategy_state"])

@@ -1,0 +1,6 @@
+"""paddle.utils namespace (reference python/paddle/utils/)."""
+from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+from ..core.flags import set_flags, get_flags  # noqa: F401
+
+__all__ = ["cpp_extension", "unique_name", "set_flags", "get_flags"]

@@ -1,0 +1,122 @@
+"""Custom C++ op extension + SelectedRows + monitor tests."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+CUSTOM_SRC = textwrap.dedent("""
+    #include <cstdint>
+    #include <algorithm>
+    // relu6: the canonical reference custom-op example
+    extern "C" void pd_relu6_forward(const float* x, float* y,
+                                     int64_t n) {
+        for (int64_t i = 0; i < n; ++i)
+            y[i] = std::min(std::max(x[i], 0.0f), 6.0f);
+    }
+    extern "C" void pd_relu6_backward(const float* x, const float* gy,
+                                      float* gx, int64_t n) {
+        for (int64_t i = 0; i < n; ++i)
+            gx[i] = (x[i] > 0.0f && x[i] < 6.0f) ? gy[i] : 0.0f;
+    }
+    // an op without a backward
+    extern "C" void pd_clip1_forward(const float* x, float* y,
+                                     int64_t n) {
+        for (int64_t i = 0; i < n; ++i)
+            y[i] = std::min(std::max(x[i], -1.0f), 1.0f);
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("custom_op")
+    src = os.path.join(str(d), "relu6_op.cc")
+    with open(src, "w") as f:
+        f.write(CUSTOM_SRC)
+    from paddle_tpu.utils.cpp_extension import load
+    return load("relu6_ext", [src], build_directory=str(d), verbose=True)
+
+
+class TestCppExtension:
+    def test_forward_matches_numpy(self, ext):
+        x = np.linspace(-3, 9, 13).astype(np.float32)
+        out = ext.relu6(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.clip(x, 0, 6), rtol=1e-6)
+
+    def test_backward_through_tape(self, ext):
+        x = paddle.to_tensor(
+            np.array([-1.0, 0.5, 3.0, 7.0], np.float32))
+        x.stop_gradient = False
+        y = ext.relu6(x)
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   [0.0, 1.0, 1.0, 0.0])
+
+    def test_works_under_jit(self, ext):
+        import jax
+        f = jax.jit(lambda a: ext.relu6.__pure_fn__(a) * 2)
+        out = f(np.array([1.0, 8.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 12.0])
+
+    def test_no_backward_op(self, ext):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        out = ext.clip1(x)
+        np.testing.assert_allclose(np.asarray(out._data), [1.0])
+
+    def test_missing_op_raises(self, ext):
+        with pytest.raises(AttributeError):
+            ext.nonexistent
+
+
+class TestSelectedRows:
+    def test_to_dense_and_merge(self):
+        from paddle_tpu.core import SelectedRows, merge_selected_rows
+        sr = SelectedRows([1, 3, 1], np.ones((3, 2), np.float32), 5)
+        dense = np.asarray(sr.to_dense())
+        assert dense.shape == (5, 2)
+        np.testing.assert_allclose(dense[1], [2, 2])
+        np.testing.assert_allclose(dense[3], [1, 1])
+        merged = merge_selected_rows(sr)
+        np.testing.assert_allclose(np.asarray(merged.to_dense()), dense)
+
+    def test_embedding_grad_rows_equals_dense(self):
+        from paddle_tpu.core import embedding_grad_rows
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 50, (4, 6))
+        gout = rng.randn(4, 6, 8).astype(np.float32)
+        sr = embedding_grad_rows(ids, gout, height=50)
+        dense = np.zeros((50, 8), np.float32)
+        np.add.at(dense, ids.reshape(-1), gout.reshape(-1, 8))
+        np.testing.assert_allclose(np.asarray(sr.to_dense()), dense,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sparse_row_update_matches_dense_sgd(self):
+        from paddle_tpu.core import SelectedRows, sparse_row_update
+        rng = np.random.RandomState(1)
+        param = rng.randn(10, 4).astype(np.float32)
+        sr = SelectedRows([2, 7], rng.randn(2, 4).astype(np.float32), 10)
+        new_p, _ = sparse_row_update(param, sr, lr=0.1)
+        expect = param - 0.1 * np.asarray(sr.to_dense())
+        np.testing.assert_allclose(np.asarray(new_p), expect, rtol=1e-6)
+
+
+class TestMonitor:
+    def test_stat_registry_and_op_stats_flag(self):
+        from paddle_tpu.core import monitor
+        monitor.reset_all()
+        monitor.stat("test.counter").add(3)
+        monitor.stat("test.counter").add(2)
+        assert monitor.get_stats()["test.counter"] == 5
+        paddle.set_flags({"FLAGS_op_stats": True})
+        try:
+            a = paddle.to_tensor(np.ones((2, 2), np.float32))
+            _ = a + a
+            stats = monitor.get_stats()
+            assert any(k.startswith("op.") for k in stats), stats
+        finally:
+            paddle.set_flags({"FLAGS_op_stats": False})

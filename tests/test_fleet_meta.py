@@ -128,6 +128,48 @@ class TestFleetBuildTrainStep:
         l1 = float(step(x, (y,)).item())
         assert np.isfinite(l0) and np.isfinite(l1)
 
+    def test_dgc_swaps_momentum_for_sgd(self):
+        # DGC owns the momentum (ref dgc_momentum_op): the user's Momentum
+        # optimizer must be replaced by plain SGD to avoid double momentum
+        from paddle_tpu.optimizer import SGD
+        fleet = fleet_mod.fleet
+        s = DistributedStrategy()
+        s.dgc = True
+        fleet.init(is_collective=True, strategy=s)
+        model = _mlp()
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.8,
+                                        parameters=model.parameters())
+        step = fleet.build_train_step(model, _loss, opt)
+        assert isinstance(step.optimizer, SGD)
+
+    def test_train_step_checkpoint_roundtrip_with_strategy_state(self):
+        fleet = fleet_mod.fleet
+        s = DistributedStrategy()
+        s.dgc = True
+        fleet.init(is_collective=True, strategy=s)
+        model = _mlp()
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=model.parameters())
+        step = fleet.build_train_step(model, _loss, opt)
+        x, y = _data()
+        step(x, (y,))
+        step(x, (y,))
+        saved = step.state_dict()
+        assert "strategy_state" in saved
+        step_count = int(np.asarray(saved["strategy_state"]["dgc"]["step"]))
+        assert step_count == 2
+
+        model2 = _mlp()
+        opt2 = paddle.optimizer.Momentum(learning_rate=0.1,
+                                         parameters=model2.parameters())
+        step2 = fleet.build_train_step(model2, _loss, opt2)
+        step2.set_state_dict(saved)
+        assert int(np.asarray(
+            step2.strategy_state["dgc"]["step"])) == 2
+        l_resumed = float(step2(x, (y,)).item())
+        l_orig = float(step(x, (y,)).item())
+        np.testing.assert_allclose(l_resumed, l_orig, rtol=1e-4)
+
     def test_dgc_train_step_converges(self):
         fleet = fleet_mod.fleet
         s = DistributedStrategy()

@@ -1,0 +1,155 @@
+"""paddle.text datasets + inference/deployment path tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+
+
+class TestTextDatasets:
+    def test_imdb_learnable(self):
+        ds = paddle.text.Imdb(mode="train", synthetic_size=64)
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert len(ds) == 64
+        # labels must correlate with token content (sanity of synthesis)
+        pos_hits = [np.mean((d >= 100) & (d < 600)) for d, l in ds
+                    if int(l) == 1]
+        neg_hits = [np.mean((d >= 100) & (d < 600)) for d, l in ds
+                    if int(l) == 0]
+        assert np.mean(pos_hits) > np.mean(neg_hits) + 0.1
+
+    def test_imikolov_ngram_and_seq(self):
+        ng = paddle.text.Imikolov(data_type="NGRAM", window_size=5,
+                                  mode="test", synthetic_size=32)
+        item = ng[0]
+        assert len(item) == 5
+        sq = paddle.text.Imikolov(data_type="SEQ", mode="test",
+                                  synthetic_size=8)
+        assert sq[0].shape == (30,)
+
+    def test_uci_housing_linear(self):
+        tr = paddle.text.UCIHousing(mode="train")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(tr) == 404  # reference split sizes
+
+    def test_wmt_pair_structure(self):
+        for cls in (paddle.text.WMT14, paddle.text.WMT16):
+            ds = cls(mode="test", synthetic_size=16)
+            s, t, tn = ds[0]
+            assert s[0] == 0 and s[-1] == 1          # <s> ... <e>
+            assert len(t) == len(tn)
+            assert tn[-1] == 1
+            d = ds.get_dict("en")
+            assert len(d) == ds.src_dict_size
+
+    def test_conll05_slots(self):
+        ds = paddle.text.Conll05st(mode="test", synthetic_size=4)
+        sample = ds[0]
+        assert len(sample) == 9                       # 9-slot SRL input
+        words, *ctx, pred, mark, labels = sample
+        assert words.shape == mark.shape == labels.shape
+        assert mark.sum() == 1                        # single predicate
+
+    def test_movielens_rating_range(self):
+        ds = paddle.text.Movielens(mode="test", synthetic_size=32)
+        *feats, rating = ds[0]
+        assert 1.0 <= float(rating) <= 5.0
+        assert len(feats) == 7
+
+
+class TestInference:
+    def _save_lenet(self, tmp_path):
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(3)
+        model = LeNet()
+        model.eval()
+        prefix = os.path.join(str(tmp_path), "lenet/inference")
+        spec = [InputSpec([1, 1, 28, 28], "float32")]
+        paddle.static.save_inference_model(prefix, layer=model,
+                                           input_spec=spec)
+        x = np.random.RandomState(0).randn(1, 1, 28, 28).astype(np.float32)
+        with paddle.no_grad():
+            ref = np.asarray(model(paddle.to_tensor(x))._data)
+        return prefix, x, ref
+
+    def test_save_load_inference_model_roundtrip(self, tmp_path):
+        prefix, x, ref = self._save_lenet(tmp_path)
+        assert os.path.exists(prefix + ".pdmodel")
+        pred, feeds, fetches = paddle.static.load_inference_model(prefix)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-5)
+
+    def test_predictor_handle_api(self, tmp_path):
+        prefix, x, ref = self._save_lenet(tmp_path)
+        from paddle_tpu.inference import Config, create_predictor
+        config = Config(prefix)
+        pred = create_predictor(config)
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        np.testing.assert_allclose(out_h.copy_to_cpu(), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_jit_save_load_runnable(self, tmp_path):
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        model.eval()
+        path = os.path.join(str(tmp_path), "mlp/model")
+        paddle.jit.save(model, path, input_spec=[InputSpec([3, 4],
+                                                           "float32")])
+        loaded = paddle.jit.load(path)
+        x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        with paddle.no_grad():
+            ref = np.asarray(model(paddle.to_tensor(x))._data)
+            got = np.asarray(loaded(paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_polymorphic_batch_dim(self, tmp_path):
+        # None dims must stay polymorphic: saved once, runs at any batch
+        paddle.seed(9)
+        model = nn.Linear(4, 2)
+        model.eval()
+        path = os.path.join(str(tmp_path), "poly/model")
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([None, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        for bs in (1, 3, 17):
+            x = np.random.RandomState(bs).randn(bs, 4).astype(np.float32)
+            with paddle.no_grad():
+                ref = np.asarray(model(paddle.to_tensor(x))._data)
+                got = np.asarray(loaded(paddle.to_tensor(x))._data)
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_jit_save_untraceable_forward_keeps_weights(self, tmp_path):
+        class Weird(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                if float(x.sum().item()) > 0:  # traced-value branch
+                    return self.fc(x)
+                return self.fc(x) * 2
+
+        model = Weird()
+        path = os.path.join(str(tmp_path), "weird/model")
+        with pytest.warns(UserWarning, match="export skipped"):
+            paddle.jit.save(model, path,
+                            input_spec=[InputSpec([2, 4], "float32")])
+        assert os.path.exists(path + ".pdiparams")
+        assert not os.path.exists(path + ".pdmodel")
+
+    def test_jit_save_without_spec_loads_weights_only(self, tmp_path):
+        model = nn.Linear(4, 2)
+        path = os.path.join(str(tmp_path), "w/model")
+        paddle.jit.save(model, path)
+        loaded = paddle.jit.load(path)
+        with pytest.raises(RuntimeError):
+            loaded(paddle.to_tensor(np.zeros((1, 4), np.float32)))
