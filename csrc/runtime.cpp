@@ -1,0 +1,547 @@
+// Native host-runtime services for paddle_tpu.
+//
+// TPU-native equivalents of three reference C++ subsystems:
+//
+// 1. Profiler event collector
+//    (/root/reference/paddle/fluid/platform/profiler.cc: RecordEvent RAII
+//    spans pushed onto per-thread stacks, DisableProfiler dump;
+//    profiler.proto timeline -> tools/timeline.py chrome trace).
+//    Here: a mutex-guarded ring buffer of spans, chrome-trace JSON dump.
+//    The hot path (begin/end) is two clock reads + one buffer append —
+//    cheap enough to wrap every eager op dispatch.
+//
+// 2. TCP rendezvous bootstrap
+//    (/root/reference/paddle/fluid/platform/gen_comm_id_helper.cc:
+//    CreateListenSocket :124, SendBroadCastCommID :284,
+//    RecvBroadCastCommID :311 — rank-0 listens and broadcasts the
+//    ncclUniqueId). On TPU the comm fabric needs no id exchange (XLA owns
+//    ICI), but multi-host jobs still need a bootstrap channel for the
+//    coordinator address / cluster topology blob before
+//    jax.distributed.initialize can run. Same rank-0-broadcast shape.
+//
+// 3. Shared-memory blob ring
+//    (/root/reference/paddle/fluid/memory/allocation/mmap_allocator.cc +
+//    fluid/dataloader worker shared-mem tensors): a process-shared
+//    mmap'd ring buffer with a robust pthread mutex/condvar in the
+//    header, so DataLoader worker processes hand fixed-cost batches to
+//    the host loop without pickling through pipes.
+//
+// C ABI throughout (ctypes-friendly); no exceptions cross the boundary.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// 1. profiler
+// ---------------------------------------------------------------------------
+
+namespace prof {
+
+struct Span {
+  char name[64];
+  char cat[16];
+  int64_t t0_ns;
+  int64_t t1_ns;
+  int64_t tid;
+};
+
+static std::mutex g_mu;
+static std::vector<Span> g_spans;
+static std::atomic<int> g_enabled{0};
+static constexpr size_t kMaxSpans = 1 << 20;  // bound memory: ~96MB max
+
+static int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace prof
+
+extern "C" {
+
+void pd_prof_enable(int on) { prof::g_enabled.store(on); }
+int pd_prof_enabled() { return prof::g_enabled.load(); }
+
+int64_t pd_prof_now() { return prof::now_ns(); }
+
+void pd_prof_span(const char* name, const char* cat, int64_t t0_ns,
+                  int64_t t1_ns, int64_t tid) {
+  if (!prof::g_enabled.load()) return;
+  std::lock_guard<std::mutex> lk(prof::g_mu);
+  if (prof::g_spans.size() >= prof::kMaxSpans) return;  // drop, don't grow
+  prof::Span s;
+  snprintf(s.name, sizeof(s.name), "%s", name ? name : "");
+  snprintf(s.cat, sizeof(s.cat), "%s", cat ? cat : "op");
+  s.t0_ns = t0_ns;
+  s.t1_ns = t1_ns;
+  s.tid = tid;
+  prof::g_spans.push_back(s);
+}
+
+int64_t pd_prof_count() {
+  std::lock_guard<std::mutex> lk(prof::g_mu);
+  return (int64_t)prof::g_spans.size();
+}
+
+void pd_prof_clear() {
+  std::lock_guard<std::mutex> lk(prof::g_mu);
+  prof::g_spans.clear();
+}
+
+// chrome://tracing JSON (the tools/timeline.py output format)
+static void json_escape(const char* in, char* out, size_t cap) {
+  size_t j = 0;
+  for (size_t i = 0; in[i] && j + 6 < cap; ++i) {
+    unsigned char c = (unsigned char)in[i];
+    if (c == '"' || c == '\\') {
+      out[j++] = '\\';
+      out[j++] = (char)c;
+    } else if (c < 0x20) {
+      j += (size_t)snprintf(out + j, cap - j, "\\u%04x", c);
+    } else {
+      out[j++] = (char)c;
+    }
+  }
+  out[j] = 0;
+}
+
+int pd_prof_dump(const char* path) {
+  std::lock_guard<std::mutex> lk(prof::g_mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  char name_esc[160], cat_esc[64];
+  fputs("{\"traceEvents\":[\n", f);
+  for (size_t i = 0; i < prof::g_spans.size(); ++i) {
+    const prof::Span& s = prof::g_spans[i];
+    json_escape(s.name, name_esc, sizeof(name_esc));
+    json_escape(s.cat, cat_esc, sizeof(cat_esc));
+    fprintf(f,
+            "{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\",\"pid\":0,"
+            "\"tid\":%lld,\"ts\":%.3f,\"dur\":%.3f}%s\n",
+            name_esc, cat_esc, (long long)s.tid, s.t0_ns / 1e3,
+            (s.t1_ns - s.t0_ns) / 1e3,
+            i + 1 < prof::g_spans.size() ? "," : "");
+  }
+  fputs("]}\n", f);
+  fclose(f);
+  return 0;
+}
+
+// aggregate report rows: writes up to cap entries of
+// (name[64], calls, total_ns, max_ns) into flat buffers; returns count
+int pd_prof_summary(char* names, int64_t* calls, int64_t* total_ns,
+                    int64_t* max_ns, int cap) {
+  std::lock_guard<std::mutex> lk(prof::g_mu);
+  std::vector<std::string> keys;
+  std::vector<int64_t> c, t, m;
+  for (const prof::Span& s : prof::g_spans) {
+    int64_t dur = s.t1_ns - s.t0_ns;
+    size_t j = 0;
+    for (; j < keys.size(); ++j)
+      if (keys[j] == s.name) break;
+    if (j == keys.size()) {
+      if ((int)keys.size() >= cap) continue;
+      keys.push_back(s.name);
+      c.push_back(0);
+      t.push_back(0);
+      m.push_back(0);
+    }
+    c[j] += 1;
+    t[j] += dur;
+    if (dur > m[j]) m[j] = dur;
+  }
+  for (size_t j = 0; j < keys.size(); ++j) {
+    snprintf(names + 64 * j, 64, "%s", keys[j].c_str());
+    calls[j] = c[j];
+    total_ns[j] = t[j];
+    max_ns[j] = m[j];
+  }
+  return (int)keys.size();
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// 2. TCP rendezvous (rank-0 broadcast of a bootstrap blob)
+// ---------------------------------------------------------------------------
+
+namespace rdzv {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread th;
+  std::vector<char> payload;
+  int remaining = 0;
+  std::atomic<int> done{0};
+};
+
+static std::mutex g_mu;
+static std::vector<Server*> g_servers;
+
+}  // namespace rdzv
+
+extern "C" {
+
+// rank 0: serve `payload` to (nranks-1) peers on `port`; returns a handle
+// (>=0) immediately, serving happens on a background thread
+// (gen_comm_id_helper.cc SendBroadCastCommID analogue).
+int pd_rdzv_serve(int port, const char* payload, int len, int npeers) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int opt = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, npeers > 0 ? npeers : 1) != 0) {
+    close(fd);
+    return -1;
+  }
+  auto* srv = new rdzv::Server();
+  srv->listen_fd = fd;
+  srv->payload.assign(payload, payload + len);
+  srv->remaining = npeers;
+  srv->th = std::thread([srv]() {
+    for (int i = 0; i < srv->remaining; ++i) {
+      int conn = accept(srv->listen_fd, nullptr, nullptr);
+      if (conn < 0) break;
+      uint32_t n = (uint32_t)srv->payload.size();
+      uint32_t nn = htonl(n);
+      (void)!write(conn, &nn, 4);
+      size_t off = 0;
+      while (off < srv->payload.size()) {
+        ssize_t w = write(conn, srv->payload.data() + off,
+                          srv->payload.size() - off);
+        if (w <= 0) break;
+        off += (size_t)w;
+      }
+      close(conn);
+    }
+    srv->done.store(1);
+  });
+  std::lock_guard<std::mutex> lk(rdzv::g_mu);
+  rdzv::g_servers.push_back(srv);
+  return (int)rdzv::g_servers.size() - 1;
+}
+
+int pd_rdzv_serve_done(int handle) {
+  std::lock_guard<std::mutex> lk(rdzv::g_mu);
+  if (handle < 0 || handle >= (int)rdzv::g_servers.size()) return -1;
+  rdzv::Server* srv = rdzv::g_servers[handle];
+  if (!srv) return -1;  // closed
+  return srv->done.load();
+}
+
+void pd_rdzv_close(int handle) {
+  rdzv::Server* srv = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(rdzv::g_mu);
+    if (handle < 0 || handle >= (int)rdzv::g_servers.size()) return;
+    srv = rdzv::g_servers[handle];
+    rdzv::g_servers[handle] = nullptr;
+  }
+  if (!srv) return;
+  if (srv->listen_fd >= 0) {
+    shutdown(srv->listen_fd, SHUT_RDWR);
+    close(srv->listen_fd);
+  }
+  if (srv->th.joinable()) srv->th.join();
+  delete srv;
+}
+
+// peers: fetch the blob from rank 0, retrying until timeout
+// (RecvBroadCastCommID analogue). Returns blob length or <0 on error.
+int pd_rdzv_fetch(const char* host, int port, char* buf, int cap,
+                  int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    // bounded reads: a stalled rank 0 must not wedge the peer past the
+    // deadline (the retry loop handles transient failures)
+    timeval tv;
+    tv.tv_sec = 5;
+    tv.tv_usec = 0;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      // hostname endpoint: resolve via getaddrinfo (the Python fallback
+      // resolves names; the native path must too)
+      addrinfo hints;
+      memset(&hints, 0, sizeof(hints));
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+        close(fd);
+        if (res) freeaddrinfo(res);
+        if (std::chrono::steady_clock::now() > deadline) return -2;
+        usleep(100 * 1000);
+        continue;  // DNS may come up later (pods booting)
+      }
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      uint32_t nn = 0;
+      if (read(fd, &nn, 4) == 4) {
+        uint32_t n = ntohl(nn);
+        if ((int)n > cap) {
+          close(fd);
+          return -3;
+        }
+        uint32_t off = 0;
+        while (off < n) {
+          ssize_t r = read(fd, buf + off, n - off);
+          if (r <= 0) break;
+          off += (uint32_t)r;
+        }
+        close(fd);
+        if (off == n) return (int)n;
+      } else {
+        close(fd);
+      }
+    } else {
+      close(fd);
+    }
+    if (std::chrono::steady_clock::now() > deadline) return -4;
+    usleep(100 * 1000);  // retry every 100ms (reference retries likewise)
+  }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// 3. shared-memory blob ring
+// ---------------------------------------------------------------------------
+
+namespace shmring {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;   // data bytes
+  uint64_t head;       // read offset into data region
+  uint64_t tail;       // write offset
+  uint64_t used;       // bytes in use
+  uint64_t count;      // blobs queued
+};
+
+struct Handle {
+  Header* hdr;
+  char* data;
+  uint64_t capacity;
+  std::string name;
+  bool owner;
+};
+
+static std::mutex g_mu;
+static std::vector<Handle*> g_handles;
+
+static void write_bytes(Handle* h, const char* src, uint64_t n) {
+  uint64_t tail = h->hdr->tail;
+  uint64_t first = std::min(n, h->capacity - tail);
+  memcpy(h->data + tail, src, first);
+  if (n > first) memcpy(h->data, src + first, n - first);
+  h->hdr->tail = (tail + n) % h->capacity;
+}
+
+static void read_bytes(Handle* h, char* dst, uint64_t n) {
+  uint64_t head = h->hdr->head;
+  uint64_t first = std::min(n, h->capacity - head);
+  memcpy(dst, h->data + head, first);
+  if (n > first) memcpy(dst + first, h->data, n - first);
+  h->hdr->head = (head + n) % h->capacity;
+}
+
+}  // namespace shmring
+
+extern "C" {
+
+// create (owner=1) or attach (owner=0) a named ring; returns handle >=0.
+// Attachers ignore `capacity` and use the creator's (header is the truth).
+int pd_shm_open(const char* name, uint64_t capacity, int owner) {
+  using namespace shmring;
+  int fd;
+  if (owner) {
+    shm_unlink(name);  // stale ring from a crashed run
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return -1;
+    if (ftruncate(fd, (off_t)(sizeof(Header) + capacity)) != 0) {
+      close(fd);
+      return -2;
+    }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return -1;
+    // map the header first to learn the creator's capacity — a caller-
+    // passed size could over-map (SIGBUS) or mis-wrap the ring
+    void* hm = mmap(nullptr, sizeof(Header), PROT_READ, MAP_SHARED, fd,
+                    0);
+    if (hm == MAP_FAILED) {
+      close(fd);
+      return -3;
+    }
+    capacity = ((Header*)hm)->capacity;
+    munmap(hm, sizeof(Header));
+  }
+  uint64_t total = sizeof(Header) + capacity;
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                   0);
+  close(fd);
+  if (mem == MAP_FAILED) return -3;
+  auto* h = new Handle();
+  h->hdr = (Header*)mem;
+  h->data = (char*)mem + sizeof(Header);
+  h->capacity = capacity;
+  h->name = name;
+  h->owner = owner != 0;
+  if (owner) {
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->hdr->mu, &ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_cond_init(&h->hdr->not_empty, &ca);
+    pthread_cond_init(&h->hdr->not_full, &ca);
+    h->hdr->capacity = capacity;
+    h->hdr->head = h->hdr->tail = h->hdr->used = h->hdr->count = 0;
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_handles.push_back(h);
+  return (int)g_handles.size() - 1;
+}
+
+static shmring::Handle* get_handle(int handle) {
+  std::lock_guard<std::mutex> lk(shmring::g_mu);
+  if (handle < 0 || handle >= (int)shmring::g_handles.size())
+    return nullptr;
+  return shmring::g_handles[handle];
+}
+
+static int lock_robust(shmring::Header* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&hdr->mu);
+  else if (rc != 0) return rc;
+  return 0;
+}
+
+// push one blob; blocks while the ring is full. Returns 0 on success.
+int pd_shm_push(int handle, const char* data, uint64_t len) {
+  using namespace shmring;
+  Handle* h = get_handle(handle);
+  if (!h) return -1;
+  uint64_t need = len + 8;
+  if (need > h->capacity) return -2;
+  if (lock_robust(h->hdr) != 0) return -3;
+  while (h->hdr->capacity - h->hdr->used < need)
+    pthread_cond_wait(&h->hdr->not_full, &h->hdr->mu);
+  write_bytes(h, (const char*)&len, 8);
+  write_bytes(h, data, len);
+  h->hdr->used += need;
+  h->hdr->count += 1;
+  pthread_cond_signal(&h->hdr->not_empty);
+  pthread_mutex_unlock(&h->hdr->mu);
+  return 0;
+}
+
+// pop one blob into buf (cap bytes); blocks up to timeout_ms.
+// Returns blob length, -4 on timeout, <0 on error.
+int64_t pd_shm_pop(int handle, char* buf, uint64_t cap, int timeout_ms) {
+  using namespace shmring;
+  Handle* h = get_handle(handle);
+  if (!h) return -1;
+  if (lock_robust(h->hdr) != 0) return -3;
+  if (h->hdr->count == 0 && timeout_ms >= 0) {
+    timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    while (h->hdr->count == 0) {
+      int rc = pthread_cond_timedwait(&h->hdr->not_empty, &h->hdr->mu,
+                                      &ts);
+      if (rc == ETIMEDOUT) {
+        pthread_mutex_unlock(&h->hdr->mu);
+        return -4;
+      }
+    }
+  } else {
+    while (h->hdr->count == 0)
+      pthread_cond_wait(&h->hdr->not_empty, &h->hdr->mu);
+  }
+  uint64_t len = 0;
+  read_bytes(h, (char*)&len, 8);
+  if (len > cap) {  // caller's buffer too small: un-read the header
+    h->hdr->head =
+        (h->hdr->head + h->capacity - 8) % h->capacity;
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -(int64_t)len;  // negative length signals required size
+  }
+  read_bytes(h, buf, len);
+  h->hdr->used -= len + 8;
+  h->hdr->count -= 1;
+  pthread_cond_signal(&h->hdr->not_full);
+  pthread_mutex_unlock(&h->hdr->mu);
+  return (int64_t)len;
+}
+
+uint64_t pd_shm_count(int handle) {
+  using namespace shmring;
+  Handle* h = get_handle(handle);
+  if (!h) return 0;
+  if (lock_robust(h->hdr) != 0) return 0;
+  uint64_t c = h->hdr->count;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return c;
+}
+
+void pd_shm_close(int handle) {
+  using namespace shmring;
+  Handle* h;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (handle < 0 || handle >= (int)g_handles.size()) return;
+    h = g_handles[handle];
+    g_handles[handle] = nullptr;
+  }
+  if (!h) return;
+  munmap((void*)h->hdr, sizeof(Header) + h->capacity);
+  if (h->owner) shm_unlink(h->name.c_str());
+  delete h;
+}
+
+}  // extern "C"

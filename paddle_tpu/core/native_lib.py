@@ -1,0 +1,91 @@
+"""Loader for the native host-runtime library (csrc/runtime.cpp).
+
+Builds on demand (like io/native_feed.py) and exposes the C ABI via
+ctypes. Every consumer must tolerate `runtime_lib() is None` (no
+toolchain) with a pure-Python fallback — native is the fast path, not a
+hard dependency.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["runtime_lib"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libpaddletpu_runtime.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_CSRC, "runtime.cpp")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return _SO
+    # the Makefile is the single source of truth for build flags
+    res = subprocess.run(
+        ["make", "-C", _CSRC, "libpaddletpu_runtime.so"],
+        capture_output=True, text=True)
+    if res.returncode != 0 or not os.path.exists(_SO):
+        return None
+    return _SO
+
+
+def _bind(lib):
+    i64, i32, cp = ctypes.c_int64, ctypes.c_int, ctypes.c_char_p
+    u64 = ctypes.c_uint64
+    lib.pd_prof_enable.argtypes = [i32]
+    lib.pd_prof_now.restype = i64
+    lib.pd_prof_span.argtypes = [cp, cp, i64, i64, i64]
+    lib.pd_prof_count.restype = i64
+    lib.pd_prof_dump.argtypes = [cp]
+    lib.pd_prof_dump.restype = i32
+    lib.pd_prof_summary.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(i64),
+                                    ctypes.POINTER(i64),
+                                    ctypes.POINTER(i64), i32]
+    lib.pd_prof_summary.restype = i32
+    lib.pd_rdzv_serve.argtypes = [i32, cp, i32, i32]
+    lib.pd_rdzv_serve.restype = i32
+    lib.pd_rdzv_serve_done.argtypes = [i32]
+    lib.pd_rdzv_serve_done.restype = i32
+    lib.pd_rdzv_close.argtypes = [i32]
+    lib.pd_rdzv_fetch.argtypes = [cp, i32, ctypes.c_char_p, i32, i32]
+    lib.pd_rdzv_fetch.restype = i32
+    lib.pd_shm_open.argtypes = [cp, u64, i32]
+    lib.pd_shm_open.restype = i32
+    lib.pd_shm_push.argtypes = [i32, ctypes.c_char_p, u64]
+    lib.pd_shm_push.restype = i32
+    lib.pd_shm_pop.argtypes = [i32, ctypes.c_char_p, u64, i32]
+    lib.pd_shm_pop.restype = i64
+    lib.pd_shm_count.argtypes = [i32]
+    lib.pd_shm_count.restype = u64
+    lib.pd_shm_close.argtypes = [i32]
+    return lib
+
+
+def runtime_lib():
+    """The loaded native runtime, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(so))
+        except OSError:
+            _lib = None
+    return _lib

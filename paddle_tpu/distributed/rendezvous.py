@@ -1,0 +1,120 @@
+"""TCP rendezvous bootstrap: rank-0 broadcasts a blob to all peers.
+
+Reference: platform/gen_comm_id_helper.cc (CreateListenSocket :124,
+SendBroadCastCommID :284, RecvBroadCastCommID :311 — the raw-socket
+exchange of the ncclUniqueId before any collective can run).
+
+TPU-native role: XLA owns the ICI fabric, so there is no comm id — what
+multi-host jobs still need is a pre-`jax.distributed.initialize` channel
+for the coordinator address / cluster topology / experiment config. Same
+rank-0-broadcast shape, native C++ sockets (csrc/runtime.cpp pd_rdzv_*)
+with a pure-Python fallback.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..core.native_lib import runtime_lib
+
+__all__ = ["broadcast_bootstrap", "Rendezvous"]
+
+
+class Rendezvous:
+    """One rank-0-broadcast exchange on (host, port)."""
+
+    def __init__(self, endpoint: str, rank: int, nranks: int):
+        host, port = endpoint.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.rank, self.nranks = rank, nranks
+        self._handle = None
+        self._py_thread = None
+
+    # -- rank 0 --------------------------------------------------------------
+    def serve(self, payload: bytes):
+        if self.nranks <= 1:
+            return
+        lib = runtime_lib()
+        if lib is not None:
+            h = lib.pd_rdzv_serve(self.port, payload, len(payload),
+                                  self.nranks - 1)
+            if h < 0:
+                raise OSError(f"rendezvous: cannot listen on {self.port}")
+            self._handle = h
+            return
+        # python fallback
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host if self.host != "" else "0.0.0.0", self.port))
+        srv.listen(self.nranks - 1)
+
+        def run():
+            for _ in range(self.nranks - 1):
+                conn, _ = srv.accept()
+                conn.sendall(struct.pack("!I", len(payload)) + payload)
+                conn.close()
+            srv.close()
+        self._py_thread = threading.Thread(target=run, daemon=True)
+        self._py_thread.start()
+
+    # -- peers ---------------------------------------------------------------
+    def fetch(self, timeout: float = 120.0, max_len: int = 1 << 20) -> bytes:
+        lib = runtime_lib()
+        if lib is not None:
+            import ctypes
+            buf = ctypes.create_string_buffer(max_len)
+            n = lib.pd_rdzv_fetch(self.host.encode(), self.port, buf,
+                                  max_len, int(timeout * 1000))
+            if n < 0:
+                raise TimeoutError(
+                    f"rendezvous fetch from {self.host}:{self.port} "
+                    f"failed ({n})")
+            return buf.raw[:n]
+        deadline = time.time() + timeout
+        while True:
+            try:
+                with socket.create_connection((self.host, self.port),
+                                              timeout=2.0) as conn:
+                    hdr = conn.recv(4, socket.MSG_WAITALL)
+                    if len(hdr) < 4:  # server closed early: retry
+                        raise ConnectionError("short header")
+                    (n,) = struct.unpack("!I", hdr)
+                    data = b""
+                    while len(data) < n:
+                        chunk = conn.recv(n - len(data))
+                        if not chunk:
+                            break
+                        data += chunk
+                    if len(data) == n:
+                        return data
+            except OSError:
+                pass
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous fetch from {self.host}:{self.port} "
+                    f"timed out")
+            time.sleep(0.1)
+
+    def close(self):
+        lib = runtime_lib()
+        if self._handle is not None and lib is not None:
+            lib.pd_rdzv_close(self._handle)
+            self._handle = None
+        if self._py_thread is not None:
+            self._py_thread.join(timeout=1.0)
+            self._py_thread = None
+
+
+def broadcast_bootstrap(payload: Optional[bytes], endpoint: str, rank: int,
+                        nranks: int, timeout: float = 120.0) -> bytes:
+    """Rank 0 passes its payload; every rank returns the payload
+    (gen_comm_id one-shot convenience)."""
+    rv = Rendezvous(endpoint, rank, nranks)
+    if rank == 0:
+        assert payload is not None
+        rv.serve(payload)
+        return payload
+    return rv.fetch(timeout=timeout)

@@ -1,0 +1,107 @@
+"""Shared-memory blob ring for DataLoader worker processes.
+
+Reference: memory/allocation/mmap_allocator.cc (shared-mem tensor buffers
+for loader workers) + fluid/dataloader/dataloader_iter.py worker loop
+(workers push batches to the main process).
+
+csrc/runtime.cpp pd_shm_*: a named POSIX shm segment holding a ring of
+length-prefixed blobs guarded by a process-shared robust mutex — workers
+push pickled/packed batches, the host loop pops them without a pipe
+round-trip. Falls back to a multiprocessing.Queue-equivalent in-process
+deque when the native lib is unavailable (single-process mode only).
+"""
+from __future__ import annotations
+
+import collections
+import ctypes
+import os
+import pickle
+import threading
+from typing import Any, Optional
+
+from ..core.native_lib import runtime_lib
+
+__all__ = ["ShmRing"]
+
+
+class ShmRing:
+    """Fixed-capacity cross-process blob queue."""
+
+    def __init__(self, name: Optional[str] = None,
+                 capacity: int = 64 << 20, create: bool = True):
+        """capacity only matters for the creator; attachers
+        (create=False) always adopt the creator's capacity from the shm
+        header."""
+        self.name = name or f"/pd_ring_{os.getpid()}"
+        if not self.name.startswith("/"):
+            self.name = "/" + self.name
+        self.capacity = int(capacity)
+        self._lib = runtime_lib()
+        self._handle = None
+        self._fallback = None
+        if self._lib is not None:
+            h = self._lib.pd_shm_open(self.name.encode(), self.capacity,
+                                      1 if create else 0)
+            if h < 0:
+                raise OSError(
+                    f"shm ring open failed ({h}) for {self.name}")
+            self._handle = h
+        else:  # in-process fallback (no cross-process support)
+            self._fallback = collections.deque()
+            self._cv = threading.Condition()
+
+    # -- raw bytes -----------------------------------------------------------
+    def push_bytes(self, data: bytes):
+        if self._handle is not None:
+            rc = self._lib.pd_shm_push(self._handle, data, len(data))
+            if rc != 0:
+                raise OSError(f"shm push failed ({rc})")
+            return
+        with self._cv:
+            self._fallback.append(bytes(data))
+            self._cv.notify()
+
+    def pop_bytes(self, timeout: Optional[float] = None) -> bytes:
+        if self._handle is not None:
+            cap = 1 << 20
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                t_ms = -1 if timeout is None else int(timeout * 1000)
+                n = self._lib.pd_shm_pop(self._handle, buf, cap, t_ms)
+                if n >= 0:
+                    return buf.raw[:int(n)]
+                if n == -4:
+                    raise TimeoutError("shm ring pop timed out")
+                if n in (-1, -2, -3):
+                    raise OSError(f"shm pop failed ({n})")
+                # buffer too small: -n is the required size
+                cap = -int(n)
+        with self._cv:
+            if not self._fallback:
+                if not self._cv.wait_for(lambda: bool(self._fallback),
+                                         timeout):
+                    raise TimeoutError("ring pop timed out")
+            return self._fallback.popleft()
+
+    # -- python objects (batches) -------------------------------------------
+    def put(self, obj: Any):
+        self.push_bytes(pickle.dumps(obj, protocol=4))
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return pickle.loads(self.pop_bytes(timeout))
+
+    def qsize(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.pd_shm_count(self._handle))
+        return len(self._fallback)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.pd_shm_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -27,6 +27,24 @@ _enabled = False
 _events: List[dict] = []
 _tls = threading.local()
 
+# native span collector (csrc/runtime.cpp pd_prof_*): the eager op
+# dispatch wraps every op in a RecordEvent, so span recording must be
+# cheap — the C++ path is two clock reads + one buffer append with no
+# Python dict building. Loaded lazily on the first start_profiler() so
+# `import paddle_tpu` never pays the one-time C++ build; falls back to
+# the pure-Python list when the toolchain is unavailable.
+_native = None
+_native_resolved = False
+
+
+def _get_native():
+    global _native, _native_resolved
+    if not _native_resolved:
+        from ..core.native_lib import runtime_lib
+        _native = runtime_lib()
+        _native_resolved = True
+    return _native
+
 
 class RecordEvent:
     """RAII span (reference profiler.h:127). Usable as context manager or
@@ -40,6 +58,9 @@ class RecordEvent:
     def begin(self):
         if not _enabled:
             return self
+        if _native is not None:
+            self._t0 = _native.pd_prof_now()
+            return self
         self._t0 = time.perf_counter_ns()
         depth = getattr(_tls, "depth", 0)
         _tls.depth = depth + 1
@@ -48,6 +69,12 @@ class RecordEvent:
 
     def end(self):
         if not _enabled or self._t0 is None:
+            return
+        if _native is not None:
+            _native.pd_prof_span(self.name.encode(),
+                                 self.event_type.encode(), self._t0,
+                                 _native.pd_prof_now(),
+                                 threading.get_ident() % (1 << 31))
             return
         t1 = time.perf_counter_ns()
         _tls.depth = max(getattr(_tls, "depth", 1) - 1, 0)
@@ -81,12 +108,18 @@ def start_profiler(state="All", tracer_option="Default"):
     global _enabled
     with _lock:
         _events.clear()
+    native = _get_native()
+    if native is not None:
+        native.pd_prof_clear()
+        native.pd_prof_enable(1)
     _enabled = True
 
 
 def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
     global _enabled
     _enabled = False
+    if _native is not None:
+        _native.pd_prof_enable(0)  # resolved by start_profiler
     if profile_path:
         export_chrome_tracing(profile_path)
     return summary(sorted_key)
@@ -108,6 +141,10 @@ def export_chrome_tracing(path: str):
     if d:
         os.makedirs(d, exist_ok=True)
     out = path if path.endswith(".json") else path + ".json"
+    if _native is not None:
+        if _native.pd_prof_dump(out.encode()) != 0:
+            raise OSError(f"cannot write trace to {out}")
+        return out
     with _lock:
         data = {"traceEvents": list(_events)}
     with open(out, "w") as f:
@@ -117,15 +154,29 @@ def export_chrome_tracing(path: str):
 
 def summary(sorted_key="total"):
     """Aggregated per-span stats (DisableProfiler sorted report)."""
-    with _lock:
-        evs = list(_events)
     agg: Dict[str, dict] = {}
-    for e in evs:
-        s = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
-                                       "max_us": 0.0})
-        s["calls"] += 1
-        s["total_us"] += e["dur"]
-        s["max_us"] = max(s["max_us"], e["dur"])
+    if _native is not None:
+        import ctypes
+        cap = 512
+        names = ctypes.create_string_buffer(64 * cap)
+        calls = (ctypes.c_int64 * cap)()
+        total = (ctypes.c_int64 * cap)()
+        mx = (ctypes.c_int64 * cap)()
+        n = _native.pd_prof_summary(names, calls, total, mx, cap)
+        for i in range(n):
+            nm = names.raw[64 * i:64 * (i + 1)].split(b"\0")[0].decode()
+            agg[nm] = {"calls": int(calls[i]),
+                       "total_us": total[i] / 1e3,
+                       "max_us": mx[i] / 1e3}
+    else:
+        with _lock:
+            evs = list(_events)
+        for e in evs:
+            s = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
+                                           "max_us": 0.0})
+            s["calls"] += 1
+            s["total_us"] += e["dur"]
+            s["max_us"] = max(s["max_us"], e["dur"])
     for s in agg.values():
         s["avg_us"] = s["total_us"] / max(s["calls"], 1)
     key = {"total": "total_us", "calls": "calls", "max": "max_us",

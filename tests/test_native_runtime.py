@@ -1,0 +1,151 @@
+"""Native host-runtime tests: profiler collector, TCP rendezvous,
+shared-memory blob ring (csrc/runtime.cpp)."""
+import multiprocessing as mp
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.native_lib import runtime_lib
+
+native = runtime_lib()
+needs_native = pytest.mark.skipif(native is None,
+                                  reason="native runtime unavailable")
+
+
+@needs_native
+class TestNativeProfiler:
+    def test_spans_collected_and_dumped(self, tmp_path):
+        import paddle_tpu.profiler as prof
+        prof.start_profiler()
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        for _ in range(3):
+            y = (x @ x).sum()
+        rep = prof.stop_profiler(
+            profile_path=str(tmp_path / "trace"))
+        assert any("matmul" in k for k in rep), list(rep)[:5]
+        row = next(v for k, v in rep.items() if "matmul" in k)
+        assert row["calls"] >= 3
+        out = str(tmp_path / "trace.json")
+        assert os.path.exists(out)
+        import json
+        data = json.load(open(out))
+        assert len(data["traceEvents"]) > 0
+
+    def test_span_names_json_escaped(self, tmp_path):
+        import json
+        import paddle_tpu.profiler as prof
+        prof.start_profiler()
+        with prof.RecordEvent('load "train" shard\\0'):
+            pass
+        prof.stop_profiler(profile_path=str(tmp_path / "esc"))
+        data = json.load(open(str(tmp_path / "esc.json")))
+        assert any('load "train"' in e["name"]
+                   for e in data["traceEvents"])
+
+    def test_low_overhead_when_disabled(self):
+        from paddle_tpu.profiler import RecordEvent
+        t0 = time.perf_counter()
+        for _ in range(20000):
+            with RecordEvent("noop"):
+                pass
+        assert time.perf_counter() - t0 < 1.0
+
+
+class TestRendezvous:
+    def _free_port(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def test_broadcast_bootstrap_threads(self):
+        from paddle_tpu.distributed.rendezvous import Rendezvous
+        port = self._free_port()
+        payload = b"coordinator=10.0.0.1:8476;topo=v4-32"
+        rv0 = Rendezvous(f"127.0.0.1:{port}", rank=0, nranks=3)
+        rv0.serve(payload)
+        results = []
+
+        def peer():
+            rv = Rendezvous(f"127.0.0.1:{port}", rank=1, nranks=3)
+            results.append(rv.fetch(timeout=10))
+        ts = [threading.Thread(target=peer) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        rv0.close()
+        assert results == [payload, payload]
+
+    def test_fetch_timeout(self):
+        from paddle_tpu.distributed.rendezvous import Rendezvous
+        rv = Rendezvous(f"127.0.0.1:{self._free_port()}", rank=1, nranks=2)
+        with pytest.raises((TimeoutError, OSError)):
+            rv.fetch(timeout=0.5)
+
+
+def _worker_push(ring_name, capacity):
+    from paddle_tpu.io.shm_ring import ShmRing
+    ring = ShmRing(ring_name, capacity=capacity, create=False)
+    for i in range(5):
+        ring.put({"idx": i, "x": np.full((16, 16), i, np.float32)})
+
+
+@needs_native
+class TestShmRing:
+    def test_cross_process_batches(self):
+        from paddle_tpu.io.shm_ring import ShmRing
+        name = f"/pd_test_ring_{os.getpid()}"
+        ring = ShmRing(name, capacity=8 << 20, create=True)
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_worker_push, args=(name, 8 << 20))
+        p.start()
+        got = [ring.get(timeout=30) for _ in range(5)]
+        p.join(timeout=10)
+        ring.close()
+        assert [g["idx"] for g in got] == list(range(5))
+        np.testing.assert_allclose(got[3]["x"][0, 0], 3.0)
+
+    def test_attach_adopts_creator_capacity(self):
+        # attacher passes a wrong capacity; the header's must win
+        from paddle_tpu.io.shm_ring import ShmRing
+        name = f"/pd_test_cap_{os.getpid()}"
+        creator = ShmRing(name, capacity=1 << 20, create=True)
+        attacher = ShmRing(name, capacity=64 << 20, create=False)
+        payload = b"z" * (700 << 10)  # fits 1MB ring, not a mis-wrapped one
+        attacher.push_bytes(payload)
+        assert creator.pop_bytes(timeout=5) == payload
+        attacher.close()
+        creator.close()
+
+    def test_blocking_pop_timeout(self):
+        from paddle_tpu.io.shm_ring import ShmRing
+        ring = ShmRing(f"/pd_test_empty_{os.getpid()}", capacity=1 << 20)
+        with pytest.raises(TimeoutError):
+            ring.get(timeout=0.3)
+        ring.close()
+
+    def test_large_blob_regrow(self):
+        from paddle_tpu.io.shm_ring import ShmRing
+        ring = ShmRing(f"/pd_test_big_{os.getpid()}", capacity=8 << 20)
+        big = np.random.RandomState(0).bytes(3 << 20)  # > 1MB initial cap
+        ring.push_bytes(big)
+        assert ring.pop_bytes(timeout=5) == big
+        ring.close()
+
+    def test_ring_wraparound(self):
+        from paddle_tpu.io.shm_ring import ShmRing
+        ring = ShmRing(f"/pd_test_wrap_{os.getpid()}", capacity=4096)
+        for round_ in range(10):
+            for i in range(3):
+                ring.push_bytes(bytes([round_ * 3 + i]) * 800)
+            for i in range(3):
+                data = ring.pop_bytes(timeout=5)
+                assert data == bytes([round_ * 3 + i]) * 800
+        ring.close()
