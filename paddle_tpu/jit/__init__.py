@@ -1,2 +1,5 @@
 from .api import (InputSpec, StaticFunction, functionalize, to_static,
                   not_to_static, save, load, TranslatedLayer)  # noqa: F401
+from . import dy2static  # noqa: F401
+from .dy2static import (convert_function, set_max_while_iters,  # noqa: F401
+                        max_while_iters_guard)

@@ -114,12 +114,28 @@ class StaticFunction:
     """
 
     def __init__(self, function, input_spec=None, layer=None,
-                 build_strategy=None):
+                 build_strategy=None, enable_ast=True):
         self._function = function
         self._input_spec = input_spec
         self._layer = layer if layer is not None else getattr(
             function, "__self__", None)
-        self._pure = functionalize(function, self._layer)
+        traced_fn = function
+        if enable_ast and not getattr(function, "_not_to_static", False):
+            # AST conversion (ProgramTranslator transformer stack): tensor
+            # if/while/for become lax-backed ops; plain python otherwise
+            import inspect as _inspect
+            from .dy2static import convert_function
+            if _inspect.ismethod(function):
+                conv = convert_function(function.__func__)
+                if conv is not function.__func__:
+                    self_obj = function.__self__
+
+                    @functools.wraps(function)
+                    def traced_fn(*a, **k):
+                        return conv(self_obj, *a, **k)
+            else:
+                traced_fn = convert_function(function)
+        self._pure = functionalize(traced_fn, self._layer)
         self._jitted = jax.jit(self._pure, static_argnames=())
         self._call_count = 0
         functools.update_wrapper(self, function,

@@ -1,0 +1,599 @@
+"""dy2static: AST-level conversion of Python control flow to traceable ops.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — the
+ProgramTranslator's AST transformer stack (ifelse_transformer.py,
+loop_transformer.py, logical_transformer.py, convert_call_func.py...)
+rewrites user code so `if`/`while`/`for` over Tensors become
+conditional_block/while ops.
+
+TPU-native: the rewrite targets lax-backed ops (ops/control_flow.py
+cond/while_loop/fori_loop) with RUNTIME dispatch — the generated helpers
+check whether the predicate/bounds are traced; concrete values keep plain
+Python semantics (zero overhead eagerly, and static `for range(3)` loops
+stay unrolled under jit, which keeps them reverse-differentiable).
+
+Supported rewrites:
+  - `if`/`elif`/`else` over tensor predicates (assignment merging; both-
+    branch returns)
+  - `while` over tensor conditions
+  - `for i in range(...)` with tensor bounds; `for x in <Tensor>` row
+    iteration
+  - `and`/`or`/`not` inside converted predicates (lazy logical helpers)
+Restrictions (clear errors, mirroring the reference's documented limits):
+  - vars assigned under tensor control flow should exist beforehand when
+    the predicate is traced (single-branch assignment of new names)
+  - no break/continue/early-return inside tensor-dependent loops
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import Any, Callable, Dict, List, Set, Tuple
+
+import jax
+
+from ..framework import Tensor
+from ..ops import control_flow as _cf
+
+__all__ = ["convert_function", "ConversionError", "jst"]
+
+
+class ConversionError(Exception):
+    pass
+
+
+class _Undef:
+    """Sentinel for a name unbound before tensor control flow. Any actual
+    USE raises, mirroring Python's UnboundLocalError instead of letting
+    the sentinel leak into downstream computation."""
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<pd-undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "variable assigned only inside a conditional branch was used "
+            "before assignment (dy2static)")
+
+    __getattr__ = __bool__ = __add__ = __radd__ = __sub__ = __rsub__ = \
+        __mul__ = __rmul__ = __truediv__ = __rtruediv__ = __lt__ = \
+        __gt__ = __le__ = __ge__ = __call__ = __getitem__ = __iter__ = \
+        __neg__ = _raise
+
+
+UNDEF = _Undef()
+
+# when set, converted tensor-dependent `while` loops compile to a
+# reverse-differentiable masked scan of this length instead of
+# lax.while_loop (which has no transpose rule). Mirrors the reference
+# while_op's differentiability — with the XLA-imposed static bound made
+# explicit.
+_max_while_iters = None
+
+
+def set_max_while_iters(n):
+    """Enable differentiable converted `while` loops, bounded at n
+    iterations (iterations past the dynamic exit are masked out; loops
+    that would genuinely run longer than n are truncated). Pass None to
+    restore unbounded forward-only lax.while_loop."""
+    global _max_while_iters
+    _max_while_iters = None if n is None else int(n)
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def max_while_iters_guard(n):
+    global _max_while_iters
+    old = _max_while_iters
+    _max_while_iters = None if n is None else int(n)
+    try:
+        yield
+    finally:
+        _max_while_iters = old
+
+
+def _is_traced(x):
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (the convert_operators.py analogue) — generated code
+# calls these through the `_jst` module alias injected into globals
+# ---------------------------------------------------------------------------
+
+class jst:
+    UNDEF = UNDEF
+
+    @staticmethod
+    def _check_defined(vals, names, what):
+        for v, n in zip(vals, names):
+            if v is UNDEF:
+                raise ConversionError(
+                    f"variable '{n}' is assigned inside a tensor-"
+                    f"dependent {what} but not defined before it; "
+                    "initialize it first (dy2static restriction)")
+
+    @staticmethod
+    def ifelse(pred, true_fn, false_fn, init_vals, names):
+        if not _is_traced(pred):
+            p = bool(pred.item() if isinstance(pred, Tensor) else pred)
+            return tuple(true_fn(*init_vals) if p
+                         else false_fn(*init_vals))
+        # traced: UNDEF slots may not cross lax.cond; both branches must
+        # assign them (checked by the original code's own semantics)
+        defined_idx = [i for i, v in enumerate(init_vals)
+                       if v is not UNDEF]
+
+        def wrap(fn):
+            def pure(*defined):
+                full = list(init_vals)
+                for i, v in zip(defined_idx, defined):
+                    full[i] = v
+                out = fn(*full)
+                jst._check_defined(out, names, "if")
+                return tuple(out)
+            return pure
+        operands = tuple(init_vals[i] for i in defined_idx)
+        out = _cf.cond(pred, wrap(true_fn), wrap(false_fn),
+                       operands=operands)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    @staticmethod
+    def while_(cond_fn, body_fn, init_vals, names):
+        probe = cond_fn(*init_vals)
+        if not _is_traced(probe):
+            vals = tuple(init_vals)
+            cur = probe
+            while bool(cur.item() if isinstance(cur, Tensor) else cur):
+                vals = tuple(body_fn(*vals))
+                cur = cond_fn(*vals)
+            return vals
+        jst._check_defined(init_vals, names, "while")
+        if _max_while_iters is not None:
+            # differentiable bounded form (masked scan) — needed whenever
+            # the converted loop sits under backward(); see
+            # set_max_while_iters
+            out = _cf.bounded_while_loop(
+                cond_fn, lambda *vs: tuple(body_fn(*vs)),
+                list(init_vals), _max_while_iters)
+            return tuple(out)
+        out = _cf.while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)),
+                             list(init_vals))
+        return tuple(out)
+
+    @staticmethod
+    def for_range(start, stop, step, body_fn, init_vals, names):
+        traced = any(_is_traced(v) for v in (start, stop, step))
+        if not traced:
+            vals = tuple(init_vals)
+            s = int(start.item() if isinstance(start, Tensor) else start)
+            e = int(stop.item() if isinstance(stop, Tensor) else stop)
+            st = int(step.item() if isinstance(step, Tensor) else step)
+            for i in range(s, e, st):
+                vals = tuple(body_fn(i, *vals))
+            return vals
+        jst._check_defined(init_vals, names, "for")
+        # tensor bounds: normalized while over the index
+        i0 = start if isinstance(start, Tensor) else Tensor(
+            jax.numpy.asarray(start))
+
+        def cond_fn(i, *vs):
+            # direction depends on the (possibly traced) step sign
+            import jax.numpy as jnp
+            from ..framework import _unwrap
+            s = _unwrap(step)
+            lt = _unwrap(i < stop)
+            gt = _unwrap(i > stop)
+            return Tensor(jnp.where(s > 0, lt, gt))
+
+        def body(i, *vs):
+            out = body_fn(i, *vs)
+            return (i + step,) + tuple(out)
+        out = _cf.while_loop(cond_fn, body, [i0] + list(init_vals))
+        return tuple(out[1:])
+
+    @staticmethod
+    def for_iter(seq, body_fn, init_vals, names):
+        if isinstance(seq, Tensor) and seq.ndim > 0:
+            n = seq.shape[0]
+            vals = tuple(init_vals)
+            for i in range(int(n)):   # static length: unrolled trace
+                vals = tuple(body_fn(seq[i], *vals))
+            return vals
+        vals = tuple(init_vals)
+        for item in seq:
+            vals = tuple(body_fn(item, *vals))
+        return vals
+
+    @staticmethod
+    def and_(lhs, rhs_fn):
+        if _is_traced(lhs) or isinstance(lhs, Tensor):
+            from .. import ops
+            return ops.logical_and(lhs, rhs_fn())
+        return lhs and rhs_fn()
+
+    @staticmethod
+    def or_(lhs, rhs_fn):
+        if _is_traced(lhs) or isinstance(lhs, Tensor):
+            from .. import ops
+            return ops.logical_or(lhs, rhs_fn())
+        return lhs or rhs_fn()
+
+    @staticmethod
+    def not_(x):
+        if _is_traced(x) or isinstance(x, Tensor):
+            from .. import ops
+            return ops.logical_not(x)
+        return not x
+
+
+# ---------------------------------------------------------------------------
+# AST analysis helpers
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
+    """Names bound by simple assignments/aug-assigns/for-targets within
+    the statement list (not descending into nested defs)."""
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # don't descend
+            names.add(node.name)
+
+        def visit_Lambda(self, node):  # lambda params aren't assignments
+            pass
+
+        # comprehension targets are scoped to the comprehension in py3 —
+        # they are NOT branch-assigned variables
+        def visit_ListComp(self, node):
+            pass
+
+        visit_SetComp = visit_DictComp = visit_GeneratorExp = \
+            visit_ListComp
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store,)):
+                names.add(node.id)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _contains(stmts, types) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def generic_visit(self, node):
+            if isinstance(node, types):
+                self.found = True
+            super().generic_visit(node)
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _names_tuple(names):
+    return ast.Tuple(
+        elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+        ctx=ast.Store())
+
+
+def _names_load_tuple(names):
+    return ast.Tuple(
+        elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+        ctx=ast.Load())
+
+
+def _jst_attr(name):
+    return ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                         attr=name, ctx=ast.Load())
+
+
+def _init_stmts(names, uid):
+    """try/except preamble capturing possibly-unbound initial values."""
+    out = []
+    for k, n in enumerate(names):
+        out.append(ast.Try(
+            body=[ast.Assign(
+                targets=[ast.Name(id=f"__pd_i{uid}_{k}",
+                                  ctx=ast.Store())],
+                value=ast.Name(id=n, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[
+                    ast.Name(id="NameError", ctx=ast.Load()),
+                    ast.Name(id="UnboundLocalError", ctx=ast.Load())],
+                    ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=f"__pd_i{uid}_{k}",
+                                      ctx=ast.Store())],
+                    value=_jst_attr("UNDEF"))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _init_load_tuple(names, uid):
+    return ast.Tuple(
+        elts=[ast.Name(id=f"__pd_i{uid}_{k}", ctx=ast.Load())
+              for k in range(len(names))], ctx=ast.Load())
+
+
+class _BoolOpInPred(ast.NodeTransformer):
+    """Rewrite and/or/not inside a (potentially tensor) predicate into
+    lazy _jst helpers (logical_transformer.py analogue)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "and_" if isinstance(node.op, ast.And) else "or_"
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = ast.Call(
+                func=_jst_attr(fn),
+                args=[out, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       vararg=None, kwonlyargs=[],
+                                       kw_defaults=[], kwarg=None,
+                                       defaults=[]),
+                    body=rhs)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("not_"), args=[node.operand],
+                            keywords=[])
+        return node
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.uid = 0
+
+    def _next(self):
+        self.uid += 1
+        return self.uid
+
+    # -- if/else -------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        uid = self._next()
+        test = _BoolOpInPred().visit(node.test)
+
+        body_ret = _contains(node.body, ast.Return)
+        else_ret = _contains(node.orelse, ast.Return)
+        if body_ret or else_ret:
+            # supported shape: both branches are single `return expr`
+            if (body_ret and else_ret
+                    and len(node.body) == 1 and len(node.orelse) == 1
+                    and isinstance(node.body[0], ast.Return)
+                    and isinstance(node.orelse[0], ast.Return)):
+                # ifelse expects branch fns returning the merged-vars
+                # tuple; here that tuple is just (return-value,)
+                def one_tuple_lambda(expr):
+                    return ast.Lambda(args=_no_args(), body=ast.Tuple(
+                        elts=[expr], ctx=ast.Load()))
+                call = ast.Call(
+                    func=_jst_attr("ifelse"),
+                    args=[test,
+                          one_tuple_lambda(node.body[0].value),
+                          one_tuple_lambda(node.orelse[0].value),
+                          ast.Tuple(elts=[], ctx=ast.Load()),
+                          ast.Tuple(elts=[], ctx=ast.Load())],
+                    keywords=[])
+                ret = ast.Return(value=ast.Subscript(
+                    value=call,
+                    slice=ast.Constant(value=0), ctx=ast.Load()))
+                return ast.copy_location(ret, node)
+            return node  # leave python `if` (eager ok; traced will error)
+
+        stores = sorted(_assigned_names(node.body)
+                        | _assigned_names(node.orelse))
+        if not stores:
+            # side-effect-only branch (e.g. list.append): keep python
+            return node
+        args = _fn_args(stores)
+        t_name, f_name = f"__pd_true_{uid}", f"__pd_false_{uid}"
+        true_def = ast.FunctionDef(
+            name=t_name, args=args,
+            body=list(node.body) + [ast.Return(
+                value=_names_load_tuple(stores))],
+            decorator_list=[], returns=None)
+        false_def = ast.FunctionDef(
+            name=f_name, args=_fn_args(stores),
+            body=(list(node.orelse) or [ast.Pass()]) + [ast.Return(
+                value=_names_load_tuple(stores))],
+            decorator_list=[], returns=None)
+        call = ast.Call(
+            func=_jst_attr("ifelse"),
+            args=[test,
+                  ast.Name(id=t_name, ctx=ast.Load()),
+                  ast.Name(id=f_name, ctx=ast.Load()),
+                  _init_load_tuple(stores, uid),
+                  ast.Constant(value=tuple(stores))],
+            keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(stores)], value=call)
+        out = _init_stmts(stores, uid) + [true_def, false_def, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        if _contains(node.body, (ast.Break, ast.Continue, ast.Return)):
+            return node  # python semantics (eager fine; traced errors)
+        uid = self._next()
+        test = _BoolOpInPred().visit(node.test)
+        stores = sorted(_assigned_names(node.body))
+        if not stores:
+            return node
+        c_name, b_name = f"__pd_cond_{uid}", f"__pd_body_{uid}"
+        cond_def = ast.FunctionDef(
+            name=c_name, args=_fn_args(stores),
+            body=[ast.Return(value=test)], decorator_list=[],
+            returns=None)
+        body_def = ast.FunctionDef(
+            name=b_name, args=_fn_args(stores),
+            body=list(node.body) + [ast.Return(
+                value=_names_load_tuple(stores))],
+            decorator_list=[], returns=None)
+        call = ast.Call(
+            func=_jst_attr("while_"),
+            args=[ast.Name(id=c_name, ctx=ast.Load()),
+                  ast.Name(id=b_name, ctx=ast.Load()),
+                  _init_load_tuple(stores, uid),
+                  ast.Constant(value=tuple(stores))],
+            keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(stores)], value=call)
+        out = _init_stmts(stores, uid) + [cond_def, body_def, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- for -----------------------------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        if _contains(node.body, (ast.Break, ast.Continue, ast.Return)):
+            return node
+        uid = self._next()
+        stores = sorted(_assigned_names(node.body) - {node.target.id})
+        if not stores:
+            return node
+        b_name = f"__pd_forbody_{uid}"
+        body_def = ast.FunctionDef(
+            name=b_name,
+            args=_fn_args([node.target.id] + stores),
+            body=list(node.body) + [ast.Return(
+                value=_names_load_tuple(stores))],
+            decorator_list=[], returns=None)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range")
+        if is_range:
+            r = node.iter.args
+            start = r[0] if len(r) >= 2 else ast.Constant(value=0)
+            stop = r[1] if len(r) >= 2 else r[0]
+            step = r[2] if len(r) == 3 else ast.Constant(value=1)
+            call = ast.Call(
+                func=_jst_attr("for_range"),
+                args=[start, stop, step,
+                      ast.Name(id=b_name, ctx=ast.Load()),
+                      _init_load_tuple(stores, uid),
+                      ast.Constant(value=tuple(stores))],
+                keywords=[])
+        else:
+            call = ast.Call(
+                func=_jst_attr("for_iter"),
+                args=[node.iter,
+                      ast.Name(id=b_name, ctx=ast.Load()),
+                      _init_load_tuple(stores, uid),
+                      ast.Constant(value=tuple(stores))],
+                keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(stores)], value=call)
+        out = _init_stmts(stores, uid) + [body_def, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _fn_args(names):
+    return ast.arguments(
+        posonlyargs=[],
+        args=[ast.arg(arg=n, annotation=None) for n in names],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+_cache: Dict[Any, Callable] = {}
+
+
+def convert_function(fn: Callable) -> Callable:
+    """AST-convert `fn`'s tensor control flow. Returns the converted
+    function (or `fn` itself when conversion is impossible — e.g. no
+    source available)."""
+    key = getattr(fn, "__wrapped__", fn)
+    if key in _cache:
+        return _cache[key]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            raise ConversionError("not a function def")
+        fdef.decorator_list = []  # strip @to_static etc.
+        _Transformer().visit(fdef)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+        glb = dict(fn.__globals__)
+        glb["_jst"] = jst
+        # rebind the original closure by turning freevars into defaults?
+        # simpler: exec and wrap with original closure cells when present
+        if fn.__closure__:
+            # re-close over the original cells: build a wrapper that
+            # injects the free variables into globals at call time
+            freevars = fn.__code__.co_freevars
+            cells = {n: c for n, c in zip(freevars, fn.__closure__)}
+
+            def make(glb=glb):
+                loc: Dict[str, Any] = {}
+                exec(code, glb, loc)
+                return loc[fdef.name]
+
+            inner = None
+
+            @functools.wraps(fn)
+            def converted(*args, **kwargs):
+                nonlocal inner
+                for n, c in cells.items():
+                    glb[n] = c.cell_contents
+                if inner is None:
+                    inner = make()
+                return inner(*args, **kwargs)
+            _cache[key] = converted
+            return converted
+        loc: Dict[str, Any] = {}
+        exec(code, glb, loc)
+        out = functools.wraps(fn)(loc[fdef.name])
+        _cache[key] = out
+        return out
+    except (OSError, TypeError, SyntaxError, ConversionError) as e:
+        # no source (REPL, builtins, lambdas) is routine — trace as-is
+        # silently; only a real conversion failure is worth a warning
+        if isinstance(e, ConversionError):
+            warnings.warn(f"dy2static: could not convert {fn!r} "
+                          f"({type(e).__name__}: {e}); tracing it as-is")
+        _cache[key] = fn
+        return fn

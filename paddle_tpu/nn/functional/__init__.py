@@ -12,3 +12,7 @@ from .attention import (  # noqa: F401
 
 # math-namespace activations that paddle also exposes under F.*
 from ...ops.math import tanh, abs, square, sqrt  # noqa: F401
+
+# vision sampling + unpool live with the op batch (ops/extras.py)
+from ...ops.extras import (affine_grid, grid_sample,  # noqa: F401
+                           max_unpool2d)

@@ -302,17 +302,27 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 @register_op("temporal_shift_op")
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                    name=None):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    elif data_format != "NCHW":
+        raise ValueError(f"unsupported data_format {data_format!r}")
     nt, c, h, w = x.shape
     n = nt // seg_num
     xr = jnp.reshape(x, (n, seg_num, c, h, w))
-    fold_c = int(c * shift_ratio)
-    left = jnp.concatenate([xr[:, 1:, :fold_c],
-                            jnp.zeros_like(xr[:, :1, :fold_c])], axis=1)
-    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold_c:2 * fold_c]),
-                             xr[:, :-1, fold_c:2 * fold_c]], axis=1)
-    rest = xr[:, :, 2 * fold_c:]
+    # ref temporal_shift_op.h:43: c1 = c*ratio, c2 = c*2*ratio (NOT
+    # 2*int(c*ratio) — they differ when c*ratio truncates)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    left = jnp.concatenate([xr[:, 1:, :c1],
+                            jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, c1:c2]),
+                             xr[:, :-1, c1:c2]], axis=1)
+    rest = xr[:, :, c2:]
     out = jnp.concatenate([left, right, rest], axis=2)
-    return jnp.reshape(out, (nt, c, h, w))
+    out = jnp.reshape(out, (nt, c, h, w))
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
 
 
 @register_op("npair_loss_op")

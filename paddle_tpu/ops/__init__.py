@@ -13,17 +13,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import Tensor, _unwrap
-from . import (creation, detection, linalg, logic, manipulation, math,
-               search, sequence, stat)
+from . import (creation, detection, extras, linalg, logic, manipulation,
+               math, search, sequence, stat)
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
-from .control_flow import (cond, while_loop, case, switch_case, scan,
-                           fori_loop)  # noqa: F401
+from .control_flow import (cond, while_loop, bounded_while_loop, case,
+                           switch_case, scan, fori_loop)  # noqa: F401
 from .einsum import einsum  # noqa: F401
 from .registry import OPS, get_op, op_wrapper, register_op, run_op
 from .search import *  # noqa: F401,F403
@@ -31,9 +32,9 @@ from .stat import *  # noqa: F401,F403
 
 __all__ = (creation.__all__ + math.__all__ + manipulation.__all__
            + logic.__all__ + search.__all__ + linalg.__all__ + stat.__all__
-           + detection.__all__ + sequence.__all__
-           + ["einsum", "cond", "while_loop", "case", "switch_case",
-              "scan", "fori_loop"])
+           + detection.__all__ + sequence.__all__ + extras.__all__
+           + ["einsum", "cond", "while_loop", "bounded_while_loop",
+              "case", "switch_case", "scan", "fori_loop"])
 
 
 # ---------------------------------------------------------------------------

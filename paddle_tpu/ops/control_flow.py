@@ -17,8 +17,8 @@ import jax.numpy as jnp
 from ..framework import Tensor, _unwrap
 from .registry import run_op
 
-__all__ = ["cond", "while_loop", "case", "switch_case", "scan",
-           "fori_loop"]
+__all__ = ["cond", "while_loop", "bounded_while_loop", "case",
+           "switch_case", "scan", "fori_loop"]
 
 
 def _is_traced(x):
@@ -72,6 +72,33 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         return tuple(_unwrap(o) for o in out)
 
     res = jax.lax.while_loop(c, b, tuple(arrays))
+    return [Tensor(r) for r in res]
+
+
+def bounded_while_loop(cond_fn, body_fn, loop_vars, max_iters: int,
+                       name=None):
+    """Differentiable while: a lax.scan over `max_iters` steps where
+    iterations past the (dynamic) exit condition pass the carry through
+    unchanged. Reverse-mode differentiable — the TPU answer to the
+    reference while_op's backward (backward.py builds grad blocks for
+    while; lax.while_loop has no transpose, masked scan does).
+
+    Semantics match while_loop as long as the true iteration count never
+    exceeds max_iters (excess iterations are silently truncated — choose
+    the bound accordingly)."""
+    arrays = [_unwrap(v) for v in loop_vars]
+
+    def step(carry, _):
+        vals = carry
+        pred = _unwrap(cond_fn(*[Tensor(v) for v in vals]))
+        out = body_fn(*[Tensor(v) for v in vals])
+        out = out if isinstance(out, (list, tuple)) else (out,)
+        new_vals = tuple(
+            jnp.where(pred, _unwrap(o).astype(jnp.asarray(v).dtype), v)
+            for o, v in zip(out, vals))
+        return new_vals, None
+
+    res, _ = jax.lax.scan(step, tuple(arrays), None, length=int(max_iters))
     return [Tensor(r) for r in res]
 
 

@@ -1,0 +1,508 @@
+"""Op-surface batch 2: vision sampling, CRF/decoding, segment pools,
+special math — reference ops that had no equivalent yet.
+
+Reference citations per op in docstrings (paths under
+/root/reference/paddle/fluid/operators/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+__all__ = ["affine_grid", "grid_sample", "max_unpool2d", "multiplex",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "linear_chain_crf", "viterbi_decode", "gather_tree",
+           "beam_search_step", "diagonal", "diag_embed", "bucketize",
+           "renorm", "poisson", "lgamma", "digamma", "polygamma", "logit",
+           "frexp", "trapezoid", "cumulative_trapezoid", "vander", "cdist",
+           "block_diag", "householder_product", "affine_channel",
+           "py_func"]
+
+
+# ---------------------------------------------------------------------------
+# vision sampling
+# ---------------------------------------------------------------------------
+
+@register_op("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Affine sampling grid (ref affine_grid_op.cc): theta [N,2,3],
+    out_shape (N,C,H,W) -> grid [N,H,W,2] of (x,y) in [-1,1] source
+    coords."""
+    n, _, h, w = [int(s) for s in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")      # [H,W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], -1).reshape(-1, 3)   # [HW,3]
+    out = jnp.einsum("nij,pj->npi", jnp.asarray(theta), base)
+    return out.reshape(theta.shape[0], h, w, 2)
+
+
+@register_op("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at grid [N,Ho,Wo,2] (x,y in [-1,1]) — ref
+    grid_sampler_op.cc. Gather-based: XLA lowers to dynamic-slices."""
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def in_bounds(ix, iy):
+        return ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, lo, hi):
+            rng = hi - lo
+            v = jnp.abs((v - lo) % (2 * rng) - rng)
+            return v + lo
+        if align_corners:
+            fx = reflect(fx, 0.0, w - 1.0)
+            fy = reflect(fy, 0.0, h - 1.0)
+        else:
+            fx = jnp.clip(reflect(fx + 0.5, 0.0, float(w)) - 0.5,
+                          0, w - 1)
+            fy = jnp.clip(reflect(fy + 0.5, 0.0, float(h)) - 0.5,
+                          0, h - 1)
+
+    if mode == "nearest":
+        ix = jnp.round(fx).astype(jnp.int32)
+        iy = jnp.round(fy).astype(jnp.int32)
+        mask = in_bounds(ix, iy) if padding_mode == "zeros" else \
+            jnp.ones_like(ix, bool)
+        v = jax.vmap(
+            lambda img, jx, jy, m: img[:, jnp.clip(jy, 0, h - 1),
+                                       jnp.clip(jx, 0, w - 1)]
+            * m.astype(img.dtype))(x, ix, iy, mask)
+        return v  # [N,C,Ho,Wo]
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = (x1 - fx) * (y1 - fy)
+    wb = (fx - x0) * (y1 - fy)
+    wc = (x1 - fx) * (fy - y0)
+    wd = (fx - x0) * (fy - y0)
+
+    def corner(ix, iy, wgt):
+        if padding_mode == "zeros":
+            m = in_bounds(ix, iy)
+            wgt = wgt * m.astype(wgt.dtype)
+        jx = jnp.clip(ix, 0, w - 1)
+        jy = jnp.clip(iy, 0, h - 1)
+        v = jax.vmap(lambda img, ax, ay: img[:, ay, ax])(x, jx, jy)
+        return v * wgt[:, None]
+
+    out = (corner(x0, y0, wa) + corner(x1, y0, wb)
+           + corner(x0, y1, wc) + corner(x1, y1, wd))
+    return out
+
+
+@register_op("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d with indices (ref unpool_op.cc): scatter
+    pooled values back to their argmax positions."""
+    if data_format != "NCHW":
+        raise ValueError(
+            f"max_unpool2d supports NCHW only, got {data_format!r}")
+    n, c, h, w = x.shape
+    ks = kernel_size if isinstance(kernel_size, (tuple, list)) else \
+        (kernel_size, kernel_size)
+    st = stride or ks
+    st = st if isinstance(st, (tuple, list)) else (st, st)
+    if output_size is None:
+        out_h = (h - 1) * st[0] + ks[0] - 2 * (
+            padding if isinstance(padding, int) else padding[0])
+        out_w = (w - 1) * st[1] + ks[1] - 2 * (
+            padding if isinstance(padding, int) else padding[1])
+    else:
+        out_h, out_w = [int(s) for s in output_size[-2:]]
+    flat_idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    flat_val = x.reshape(n, c, -1)
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, flat_idx, flat_val)
+    return out.reshape(n, c, out_h, out_w)
+
+
+@register_op("affine_channel")
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """Per-channel scale+bias (ref affine_channel_op.cc — folded-BN form
+    used by detection models)."""
+    if data_format == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# manipulation / segment pools
+# ---------------------------------------------------------------------------
+
+@register_op("multiplex")
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (ref multiplex_op.cc):
+    out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(list(inputs), axis=0)          # [K,N,...]
+    idx = jnp.reshape(jnp.asarray(index), (-1,)).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def _segment(op_name, reduce_fn, fill):
+    def fn(data, segment_ids, name=None):
+        num = int(jnp.max(segment_ids)) + 1 if not isinstance(
+            segment_ids, jax.core.Tracer) else None
+        if num is None:
+            raise ValueError(
+                f"{op_name}: segment_ids must be concrete (static segment "
+                "count); inside jit pass num_segments via jax.ops")
+        return reduce_fn(data, jnp.asarray(segment_ids), num)
+    fn.__name__ = op_name
+    return register_op(op_name)(fn)
+
+
+segment_sum = _segment(
+    "segment_sum",
+    lambda d, s, n: jax.ops.segment_sum(d, s, num_segments=n), 0)
+segment_mean = _segment(
+    "segment_mean",
+    lambda d, s, n: jax.ops.segment_sum(d, s, num_segments=n)
+    / jnp.maximum(jax.ops.segment_sum(jnp.ones_like(d), s,
+                                      num_segments=n), 1), 0)
+segment_max = _segment(
+    "segment_max",
+    lambda d, s, n: jax.ops.segment_max(d, s, num_segments=n), -jnp.inf)
+segment_min = _segment(
+    "segment_min",
+    lambda d, s, n: jax.ops.segment_min(d, s, num_segments=n), jnp.inf)
+
+
+@register_op("block_diag")
+def block_diag(inputs, name=None):
+    """Assemble a block-diagonal matrix (ref paddle.block_diag)."""
+    mats = [jnp.atleast_2d(jnp.asarray(m)) for m in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype),
+                                           (r, c))
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+@register_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """ref diagonal op (paddle.diagonal)."""
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched vectors -> batched diagonal matrices (ref diag_embed_op)."""
+    x = jnp.asarray(x)
+    m = x.shape[-1] + abs(offset)
+    eye = jnp.eye(m, k=offset, dtype=x.dtype)
+    rows = jnp.arange(x.shape[-1]) + max(-offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    diag = x[..., :, None] * eye[rows]                  # [..., L, m]
+    out = out.at[..., rows, :].add(diag)
+    if dim1 != -2 or dim2 != -1:
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CRF / sequence decoding
+# ---------------------------------------------------------------------------
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(emission, transition, label, length=None, name=None):
+    """Linear-chain CRF negative log-likelihood (ref
+    linear_chain_crf_op.cc). emission [B,T,N]; transition [N+2,N]
+    (row 0 = start scores, row 1 = stop scores, rows 2.. = pairwise);
+    label [B,T] int; length [B] valid lengths. Returns nll [B]."""
+    b, t, n = emission.shape
+    start = transition[0]
+    stop = transition[1]
+    trans = transition[2:]
+    if length is None:
+        length = jnp.full((b,), t, jnp.int32)
+    mask = jnp.arange(t)[None, :] < length[:, None]      # [B,T]
+
+    # log partition via forward recursion
+    def step(alpha, inp):
+        emit, m = inp                                    # [B,N], [B]
+        new = emit[:, None, :] + trans[None] + alpha[:, :, None]
+        new = jax.scipy.special.logsumexp(new, axis=1)
+        return jnp.where(m[:, None], new, alpha), None
+    alpha0 = start[None] + emission[:, 0]
+    alpha, _ = jax.lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(emission, 1, 0)[1:], jnp.moveaxis(mask, 1, 0)[1:]))
+    logz = jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+
+    # gold path score
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    emit_score = jnp.sum(
+        jnp.take_along_axis(emission, lbl[..., None], -1)[..., 0] * mask,
+        axis=1)
+    pair = trans[lbl[:, :-1], lbl[:, 1:]]                # [B,T-1]
+    pair_score = jnp.sum(pair * mask[:, 1:], axis=1)
+    last_idx = jnp.maximum(length - 1, 0)
+    last_lbl = jnp.take_along_axis(lbl, last_idx[:, None], 1)[:, 0]
+    gold = (start[lbl[:, 0]] + emit_score + pair_score + stop[last_lbl])
+    return logz - gold
+
+
+@register_op("viterbi_decode")
+def viterbi_decode(emission, transition, length=None,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi best path (ref viterbi_decode_op / crf_decoding_op.cc).
+    Returns (scores [B], paths [B,T])."""
+    b, t, n = emission.shape
+    if include_bos_eos_tag:
+        start, stop, trans = (transition[0], transition[1], transition[2:])
+    else:
+        start = jnp.zeros((n,), emission.dtype)
+        stop = jnp.zeros((n,), emission.dtype)
+        trans = transition
+    if length is None:
+        length = jnp.full((b,), t, jnp.int32)
+    mask = jnp.arange(t)[None, :] < length[:, None]
+
+    def step(carry, inp):
+        alpha = carry
+        emit, m = inp
+        cand = alpha[:, :, None] + trans[None]           # [B,N,N]
+        best_prev = jnp.argmax(cand, axis=1)             # [B,N]
+        new = jnp.max(cand, axis=1) + emit
+        new = jnp.where(m[:, None], new, alpha)
+        return new, best_prev
+    alpha0 = start[None] + emission[:, 0]
+    alpha, back = jax.lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(emission, 1, 0)[1:], jnp.moveaxis(mask, 1, 0)[1:]))
+    final = alpha + stop[None]
+    scores = jnp.max(final, axis=1)
+    last = jnp.argmax(final, axis=1)                     # [B]
+
+    def walk(carry, bp_m):
+        cur = carry
+        bp, m = bp_m                                     # [B,N], [B]
+        prev = jnp.take_along_axis(bp, cur[:, None], 1)[:, 0]
+        cur = jnp.where(m, prev, cur)
+        return cur, cur
+    mask_rev = jnp.moveaxis(mask, 1, 0)[1:][::-1]
+    _, path_rev = jax.lax.scan(walk, last, (back[::-1], mask_rev))
+    paths = jnp.concatenate([path_rev[::-1], last[None]], axis=0)
+    return scores, jnp.moveaxis(paths, 0, 1).astype(jnp.int64)
+
+
+@register_op("gather_tree")
+def gather_tree(ids, parents, name=None):
+    """Back-trace beam-search parent pointers into full sequences (ref
+    gather_tree_op.cc). ids/parents [T,B,W]. Returns [T,B,W]."""
+    t = ids.shape[0]
+
+    def step(carry, inp):
+        beam = carry                                    # [B,W] beam index
+        step_ids, step_parents = inp
+        tok = jnp.take_along_axis(step_ids, beam, axis=1)
+        beam = jnp.take_along_axis(step_parents, beam, axis=1)
+        return beam, tok
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None],
+                            ids.shape[1:]).astype(ids.dtype)
+    _, toks = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return toks[::-1]
+
+
+@register_op("beam_search_step")
+def beam_search_step(log_probs, scores, beam_size=4, end_token=None,
+                     name=None):
+    """One beam-search expansion (ref beam_search_op.cc semantics,
+    static-shape): log_probs [B,W,V] next-token scores, scores [B,W]
+    running beam scores. Returns (new_scores [B,W], token_ids [B,W],
+    parent_ids [B,W])."""
+    b, w, v = log_probs.shape
+    total = scores[:, :, None] + log_probs               # [B,W,V]
+    flat = total.reshape(b, w * v)
+    new_scores, idx = jax.lax.top_k(flat, beam_size)
+    parents = (idx // v).astype(jnp.int32)
+    tokens = (idx % v).astype(jnp.int32)
+    return new_scores, tokens, parents
+
+
+# ---------------------------------------------------------------------------
+# special math
+# ---------------------------------------------------------------------------
+
+@register_op("lgamma")
+def lgamma(x, name=None):
+    """ref lgamma_op."""
+    return jax.lax.lgamma(jnp.asarray(x).astype(jnp.float32)
+                          if jnp.issubdtype(jnp.asarray(x).dtype,
+                                            jnp.integer) else x)
+
+
+@register_op("digamma")
+def digamma(x, name=None):
+    """ref digamma_op."""
+    return jax.lax.digamma(x)
+
+
+@register_op("polygamma")
+def polygamma(x, n, name=None):
+    """ref polygamma op (paddle.polygamma)."""
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register_op("poisson")
+def poisson(x, name=None):
+    """Sample Poisson(lambda=x) elementwise (ref poisson_op)."""
+    from ..core.generator import next_key
+    return jax.random.poisson(next_key(), x, shape=jnp.shape(x))
+
+
+@register_op("logit")
+def logit(x, eps=None, name=None):
+    """ref logit_op: log(x/(1-x)) with optional clipping."""
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def frexp(x, name=None):
+    """ref paddle.frexp: mantissa/exponent decomposition."""
+    from ..framework import Tensor
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    m, e = jnp.frexp(arr)
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+@register_op("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """ref bucketize (searchsorted over a 1-D boundary set)."""
+    side = "right" if right else "left"
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    """ref renorm_op: scale slices along `axis` whose p-norm exceeds
+    max_norm down to max_norm."""
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+@register_op("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref paddle.trapezoid."""
+    if x is not None:
+        return jax.scipy.integrate.trapezoid(y, x=jnp.asarray(x),
+                                             axis=axis)
+    return jax.scipy.integrate.trapezoid(y, dx=dx or 1.0, axis=axis)
+
+
+@register_op("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref paddle.cumulative_trapezoid."""
+    y = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        d = jnp.diff(jnp.moveaxis(jnp.asarray(x), axis, -1)
+                     if jnp.ndim(x) > 1 else jnp.asarray(x), axis=-1)
+    else:
+        d = dx or 1.0
+    avg = (y[..., 1:] + y[..., :-1]) / 2.0
+    out = jnp.cumsum(avg * d, axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+@register_op("vander")
+def vander(x, n=None, increasing=False, name=None):
+    """ref paddle.vander."""
+    m = n if n is not None else x.shape[0]
+    powers = jnp.arange(m) if increasing else jnp.arange(m - 1, -1, -1)
+    return x[:, None] ** powers[None, :].astype(x.dtype)
+
+
+@register_op("cdist")
+def cdist(x, y, p=2.0, compute_mode=None, name=None):
+    """Pairwise p-distance between row sets (ref paddle.cdist):
+    x [..,M,D], y [..,N,D] -> [..,M,N]."""
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), -1)
+    return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+
+@register_op("householder_product")
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (ref paddle.linalg
+    .householder_product / LAPACK orgqr). x [M,N] reflectors in columns,
+    tau [N]."""
+    m, n = x.shape[-2], x.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(q, x.shape[:-2] + (m, m))
+    for i in range(n - 1, -1, -1):
+        v = x[..., :, i]
+        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = v.at[..., i].set(1.0)
+        t = tau[..., i]
+        vq = jnp.einsum("...k,...kj->...j", v, q)       # v^T q
+        q = q - t[..., None, None] * jnp.einsum(
+            "...i,...j->...ij", v, vq)                  # q -= tau v (v^T q)
+    return q
+
+
+# ---------------------------------------------------------------------------
+# py_func: arbitrary python as an op (ref py_func_op.cc)
+# ---------------------------------------------------------------------------
+
+def py_func(func, x, out_shape=None, out_dtype="float32",
+            backward_func=None, name=None):
+    """Run a numpy-level python function as a framework op (ref
+    operators/py_func_op.cc + static.nn.py_func). Works eagerly and under
+    jit (via pure_callback when out_shape is given)."""
+    from ..framework import Tensor
+    from .registry import run_op
+
+    xs = x if isinstance(x, (list, tuple)) else (x,)
+
+    def pure(*arrays):
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            if out_shape is None:
+                raise ValueError(
+                    "py_func under jit needs out_shape/out_dtype")
+            out_sds = jax.ShapeDtypeStruct(tuple(out_shape),
+                                           np.dtype(out_dtype))
+            return jax.pure_callback(
+                lambda *a: np.asarray(func(*a)), out_sds, *arrays,
+                vmap_method="sequential")
+        return jnp.asarray(func(*[np.asarray(a) for a in arrays]))
+
+    return run_op("py_func", pure, tuple(xs), {})

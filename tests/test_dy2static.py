@@ -1,0 +1,273 @@
+"""dy2static AST conversion tests (reference
+unittests/dygraph_to_static/ pattern: dygraph output == converted static
+output on the same inputs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.dy2static import convert_function, jst
+
+
+def tensor_if(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+
+
+def tensor_if_return(x):
+    if x.mean() > 0:
+        return x * 2
+    else:
+        return x - 1
+
+
+def tensor_while(x):
+    i = paddle.to_tensor(np.float32(0))
+    s = paddle.to_tensor(np.float32(0))
+    while i < x.sum():
+        s = s + i
+        i = i + 1.0
+    return s
+
+
+def tensor_for_range(x, n):
+    acc = paddle.zeros(list(x.shape))
+    for i in range(n):
+        acc = acc + x
+    return acc
+
+
+def for_over_tensor(xs):
+    s = paddle.zeros([2])
+    for row in xs:
+        s = s + row
+    return s
+
+
+def nested_control(x, n):
+    s = paddle.zeros([1])
+    i = 0
+    while i < n:
+        if x.sum() > 0:
+            s = s + x.sum()
+        else:
+            s = s - 1.0
+        i = i + 1
+    return s
+
+
+def boolop_pred(x):
+    if (x.sum() > 0) and (x.mean() < 10):
+        return x + 1
+    else:
+        return x - 1
+
+
+class TestConvertEager:
+    """Converted functions keep python semantics on concrete tensors."""
+
+    def test_if(self):
+        f = convert_function(tensor_if)
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        np.testing.assert_allclose(np.asarray(f(x)._data), [2, 2, 2])
+        np.testing.assert_allclose(np.asarray(f(-x)._data), [-2, -2, -2])
+
+    def test_while_matches_python(self):
+        f = convert_function(tensor_while)
+        x = paddle.to_tensor(np.full(3, 2.0, np.float32))
+        assert float(f(x).item()) == float(tensor_while(x).item()) == 15.0
+
+    def test_nested(self):
+        f = convert_function(nested_control)
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        assert float(f(x, 3).item()) == 9.0
+        assert float(f(-x, 3).item()) == -3.0
+
+
+class TestConvertTraced:
+    """Same functions compile under jit with tensor-dependent branches."""
+
+    def _jit(self, f, *args):
+        import jax
+        conv = convert_function(f)
+
+        def pure(*arrays):
+            wrapped = [paddle.Tensor(a) if isinstance(
+                a, (np.ndarray, jax.Array)) else a for a in arrays]
+            out = conv(*wrapped)
+            return out._data
+        return jax.jit(pure)
+
+    def test_if_traced_both_branches(self):
+        g = self._jit(tensor_if)
+        np.testing.assert_allclose(
+            np.asarray(g(np.ones(3, np.float32))), [2, 2, 2])
+        np.testing.assert_allclose(
+            np.asarray(g(-np.ones(3, np.float32))), [-2, -2, -2])
+
+    def test_if_return_traced(self):
+        g = self._jit(tensor_if_return)
+        np.testing.assert_allclose(
+            np.asarray(g(np.ones(3, np.float32))), [2, 2, 2])
+
+    def test_while_traced(self):
+        g = self._jit(tensor_while)
+        assert float(np.asarray(g(np.full(3, 2.0, np.float32)))) == 15.0
+
+    def test_boolop_traced(self):
+        g = self._jit(boolop_pred)
+        np.testing.assert_allclose(
+            np.asarray(g(np.ones(3, np.float32))), [2, 2, 2])
+        np.testing.assert_allclose(
+            np.asarray(g(-np.ones(3, np.float32))), [-2, -2, -2])
+
+    def test_static_range_loop_stays_differentiable(self):
+        import jax
+        conv = convert_function(tensor_for_range)
+
+        def loss(xa):
+            return conv(paddle.Tensor(xa), 3).sum()._data
+        g = jax.grad(loss)(np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(g), [3, 3])
+
+
+class TestToStaticIntegration:
+    def test_layer_with_tensor_branch(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.sum() > 0:
+                    out = h * 2
+                else:
+                    out = -h
+                return out
+
+        paddle.seed(11)
+        model = Gate()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        with paddle.no_grad():
+            eager = np.asarray(model(x)._data)
+        static_model = paddle.jit.to_static(Gate())
+        static_model.set_state_dict(model.state_dict())
+        with paddle.no_grad():
+            out = np.asarray(static_model(x)._data)
+        np.testing.assert_allclose(out, eager, rtol=1e-5)
+
+    def test_backward_through_converted_layer(self):
+        class LoopNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+
+            def forward(self, x):
+                y = self.fc(x)
+                for i in range(2):
+                    y = y + x
+                return y
+
+        paddle.seed(12)
+        model = paddle.jit.to_static(LoopNet())
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        loss = model(x).sum()
+        loss.backward()
+        grads = [p.grad for p in model.parameters()]
+        assert all(g is not None for g in grads)
+
+    def test_differentiable_bounded_while(self):
+        # tensor-dependent while under backward(): needs the bounded
+        # masked-scan form (lax.while_loop has no transpose)
+        class CounterNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                i = paddle.to_tensor(np.float32(0))
+                while i < 3.0:
+                    h = h * 2.0
+                    i = i + 1.0
+                return h
+
+        paddle.seed(13)
+        with paddle.jit.max_while_iters_guard(8):
+            model = paddle.jit.to_static(CounterNet())
+            x = paddle.to_tensor(np.ones((2, 3), np.float32))
+            out = model(x)
+            out.sum().backward()
+        grads = [p.grad for p in model.parameters()]
+        assert all(g is not None for g in grads)
+        # h scaled by 2^3: grad wrt bias of fc = 8 per output element
+        bias_grad = np.asarray(
+            [g for p, g in zip(model.parameters(), grads)
+             if tuple(p.shape) == (3,)][0]._data)
+        np.testing.assert_allclose(bias_grad, [16, 16, 16])  # 2 rows * 8
+
+    def test_for_over_tensor_rows(self):
+        f = convert_function(for_over_tensor)
+        xs = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        np.testing.assert_allclose(np.asarray(f(xs)._data), [6, 9])
+
+    def test_descending_range_with_traced_step(self):
+        import jax
+        from paddle_tpu.jit.dy2static import jst
+
+        def body(i, acc):
+            return (acc + i,)
+
+        def run(start, stop, step):
+            (out,) = jst.for_range(start, stop, step, body,
+                                   (paddle.to_tensor(np.float32(0)),),
+                                   ("acc",))
+            return out._data
+        # traced descending range: 3+2+1 = 6
+        got = jax.jit(lambda s: jst.for_range(
+            paddle.Tensor(s), 0, -1, body,
+            (paddle.to_tensor(np.float32(0)),), ("acc",))[0]._data)(
+            np.int32(3))
+        assert float(np.asarray(got)) == 6.0
+
+    def test_comprehension_in_branch_ok(self):
+        def f(x):
+            if x.sum() > 0:
+                y = sum([i for i in range(3)]) + x
+            else:
+                y = x
+            return y
+        import jax
+        conv = convert_function(f)
+        out = jax.jit(lambda a: conv(paddle.Tensor(a))._data)(
+            np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(out), [4, 4])
+
+    def test_undef_use_raises_unbound(self):
+        def f(x, flag):
+            if flag:
+                y = x + 1
+            return y
+        conv = convert_function(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(conv(x, True)._data),
+                                   [2, 2])
+        with pytest.raises(UnboundLocalError):
+            conv(x, False) + 1
+
+    def test_unconvertible_warns_and_falls_back(self):
+        def with_break(x, n):
+            s = x
+            for i in range(n):
+                if i == 2:
+                    break
+                s = s + x
+            return s
+        f = convert_function(with_break)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(f(x, 5)._data), [3, 3])

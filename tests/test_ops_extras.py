@@ -1,0 +1,275 @@
+"""Op batch 2 correctness: vision sampling (vs torch reference), CRF,
+segment pools, special math, py_func."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("padding_mode", ["zeros", "border"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_vs_torch(self, mode, padding_mode, align):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 10).astype(np.float32)
+        grid = rng.uniform(-1.3, 1.3, (2, 5, 7, 2)).astype(np.float32)
+        ours = _np(F.grid_sample(paddle.to_tensor(x),
+                                 paddle.to_tensor(grid), mode=mode,
+                                 padding_mode=padding_mode,
+                                 align_corners=align))
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=padding_mode, align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_affine_grid_vs_torch(self):
+        rng = np.random.RandomState(1)
+        theta = rng.randn(2, 2, 3).astype(np.float32)
+        for align in (True, False):
+            ours = _np(F.affine_grid(paddle.to_tensor(theta),
+                                     (2, 3, 6, 9), align_corners=align))
+            ref = torch.nn.functional.affine_grid(
+                torch.tensor(theta), (2, 3, 6, 9),
+                align_corners=align).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_grid_sample_grad(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+        x.stop_gradient = False
+        grid = paddle.to_tensor(
+            rng.uniform(-0.9, 0.9, (1, 4, 4, 2)).astype(np.float32))
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(_np(x.grad)).all()
+
+
+class TestUnpool:
+    def test_unpool_roundtrip_vs_torch(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        tx = torch.tensor(x)
+        pooled, idx = torch.nn.functional.max_pool2d(
+            tx, 2, stride=2, return_indices=True)
+        ref = torch.nn.functional.max_unpool2d(pooled, idx, 2,
+                                               stride=2).numpy()
+        ours = _np(F.max_unpool2d(paddle.to_tensor(pooled.numpy()),
+                                  paddle.to_tensor(idx.numpy()), 2,
+                                  stride=2))
+        np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+class TestCRF:
+    def _brute_logz(self, emission, transition):
+        t, n = emission.shape
+        start, stop, trans = (transition[0], transition[1], transition[2:])
+        import itertools
+        scores = []
+        for path in itertools.product(range(n), repeat=t):
+            s = start[path[0]] + emission[0, path[0]]
+            for i in range(1, t):
+                s += trans[path[i - 1], path[i]] + emission[i, path[i]]
+            s += stop[path[-1]]
+            scores.append(s)
+        m = max(scores)
+        return m + np.log(sum(np.exp(s - m) for s in scores))
+
+    def test_nll_vs_bruteforce(self):
+        rng = np.random.RandomState(4)
+        t, n = 4, 3
+        em = rng.randn(1, t, n).astype(np.float32)
+        tr = rng.randn(n + 2, n).astype(np.float32)
+        lbl = rng.randint(0, n, (1, t))
+        nll = _np(paddle.linear_chain_crf(
+            paddle.to_tensor(em), paddle.to_tensor(tr),
+            paddle.to_tensor(lbl)))
+        logz = self._brute_logz(em[0], tr)
+        start, stop, trans = tr[0], tr[1], tr[2:]
+        gold = start[lbl[0, 0]] + em[0, 0, lbl[0, 0]]
+        for i in range(1, t):
+            gold += trans[lbl[0, i - 1], lbl[0, i]] + em[0, i, lbl[0, i]]
+        gold += stop[lbl[0, -1]]
+        np.testing.assert_allclose(nll[0], logz - gold, rtol=1e-4)
+
+    def test_viterbi_is_argmax_path(self):
+        rng = np.random.RandomState(5)
+        t, n = 4, 3
+        em = rng.randn(1, t, n).astype(np.float32)
+        tr = rng.randn(n + 2, n).astype(np.float32)
+        scores, path = paddle.viterbi_decode(
+            paddle.to_tensor(em), paddle.to_tensor(tr))
+        import itertools
+        start, stop, trans = tr[0], tr[1], tr[2:]
+        best, best_p = -1e30, None
+        for p in itertools.product(range(n), repeat=t):
+            s = start[p[0]] + em[0, 0, p[0]]
+            for i in range(1, t):
+                s += trans[p[i - 1], p[i]] + em[0, i, p[i]]
+            s += stop[p[-1]]
+            if s > best:
+                best, best_p = s, p
+        np.testing.assert_allclose(_np(scores)[0], best, rtol=1e-4)
+        assert tuple(_np(path)[0]) == best_p
+
+    def test_crf_training_improves_decode(self):
+        # train transition+emission projections on synthetic SRL-style data
+        rng = np.random.RandomState(6)
+        b, t, n, d = 16, 8, 5, 12
+        feats = rng.randn(b, t, d).astype(np.float32)
+        w_true = rng.randn(d, n).astype(np.float32)
+        labels = np.argmax(feats @ w_true, -1)
+        w = paddle.to_tensor(np.zeros((d, n), np.float32))
+        trans = paddle.to_tensor(np.zeros((n + 2, n), np.float32))
+        w.stop_gradient = False
+        trans.stop_gradient = False
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w, trans])
+        xf = paddle.to_tensor(feats)
+        yl = paddle.to_tensor(labels)
+        first = last = None
+        for i in range(30):
+            em = xf @ w
+            nll = paddle.linear_chain_crf(em, trans, yl).mean()
+            nll.backward()
+            opt.step()
+            opt.clear_grad()
+            if i == 0:
+                first = float(nll.item())
+        last = float(nll.item())
+        assert last < first * 0.5
+        _, decoded = paddle.viterbi_decode(xf @ w, trans)
+        acc = (np.asarray(decoded._data) == labels).mean()
+        assert acc > 0.9
+
+
+class TestBeamDecode:
+    def test_gather_tree_vs_manual(self):
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int32)
+        out = _np(paddle.gather_tree(paddle.to_tensor(ids),
+                                     paddle.to_tensor(parents)))
+        # TF/paddle gather_tree semantics: out[T-1,k]=ids[T-1,k]; walk
+        # parents backward. beam 0: t2 tok 5, parents[2,0,0]=0 -> t1 tok
+        # ids[1,0,0]=3, parents[1,0,0]=1 -> t0 tok ids[0,0,1]=2
+        assert list(out[:, 0, 0]) == [2, 3, 5]
+        # beam 1: t2 tok 6, parents[2,0,1]=1 -> t1 tok ids[1,0,1]=4,
+        # parents[1,0,1]=0 -> t0 tok ids[0,0,0]=1
+        assert list(out[:, 0, 1]) == [1, 4, 6]
+
+    def test_beam_search_step(self):
+        lp = np.log(np.array([[[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]],
+                             np.float32))
+        scores = np.zeros((1, 2), np.float32)
+        ns, tok, par = paddle.beam_search_step(
+            paddle.to_tensor(lp), paddle.to_tensor(scores), beam_size=2)
+        assert _np(tok)[0, 0] == 1 and _np(par)[0, 0] == 1  # p=0.8 wins
+        assert _np(tok)[0, 1] == 0 and _np(par)[0, 1] == 0  # p=0.7 next
+
+
+class TestSegmentAndMisc:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        seg = np.array([0, 0, 1])
+        np.testing.assert_allclose(_np(paddle.segment_sum(data, seg)),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(_np(paddle.segment_mean(data, seg)),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(_np(paddle.segment_max(data, seg)),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(_np(paddle.segment_min(data, seg)),
+                                   [[1, 2], [5, 6]])
+
+    def test_multiplex(self):
+        a = paddle.to_tensor(np.array([[1., 1.], [2., 2.]], np.float32))
+        b = paddle.to_tensor(np.array([[3., 3.], [4., 4.]], np.float32))
+        out = _np(paddle.multiplex([a, b], np.array([1, 0])))
+        np.testing.assert_allclose(out, [[3, 3], [2, 2]])
+
+    def test_diag_embed_vs_torch(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        for off in (-1, 0, 2):
+            ours = _np(paddle.diag_embed(paddle.to_tensor(x), offset=off))
+            ref = torch.diag_embed(torch.tensor(x), offset=off).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+    def test_special_math_vs_torch(self):
+        rng = np.random.RandomState(8)
+        x = np.abs(rng.randn(16).astype(np.float32)) + 0.1
+        np.testing.assert_allclose(
+            _np(paddle.lgamma(paddle.to_tensor(x))),
+            torch.lgamma(torch.tensor(x)).numpy(), rtol=1e-4)
+        np.testing.assert_allclose(
+            _np(paddle.digamma(paddle.to_tensor(x))),
+            torch.digamma(torch.tensor(x)).numpy(), rtol=1e-3, atol=1e-4)
+        p = rng.uniform(0.05, 0.95, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(paddle.logit(paddle.to_tensor(p))),
+            torch.logit(torch.tensor(p)).numpy(), rtol=1e-4)
+        y = rng.randn(2, 5, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(paddle.cdist(paddle.to_tensor(y), paddle.to_tensor(y))),
+            torch.cdist(torch.tensor(y), torch.tensor(y)).numpy(),
+            rtol=1e-3, atol=1e-4)
+
+    def test_renorm_vs_torch(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        ours = _np(paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0,
+                                 max_norm=1.5))
+        ref = torch.renorm(torch.tensor(x), p=2, dim=0,
+                           maxnorm=1.5).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_block_diag_bucketize_vander_trapezoid(self):
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((1, 3), np.float32)
+        out = _np(paddle.block_diag([paddle.to_tensor(a),
+                                     paddle.to_tensor(b)]))
+        assert out.shape == (3, 5)
+        assert out[2, 2] == 2 and out[0, 0] == 1 and out[0, 2] == 0
+        bounds = np.array([1., 3., 5.], np.float32)
+        out = _np(paddle.bucketize(
+            paddle.to_tensor(np.array([0., 2., 5.5], np.float32)), bounds))
+        np.testing.assert_array_equal(out, [0, 1, 3])
+        v = _np(paddle.vander(paddle.to_tensor(
+            np.array([1., 2., 3.], np.float32)), n=3))
+        np.testing.assert_allclose(v[1], [4, 2, 1])
+        y = np.array([1., 2., 3.], np.float32)
+        np.testing.assert_allclose(
+            _np(paddle.trapezoid(paddle.to_tensor(y), dx=1.0)), 4.0)
+
+    def test_householder_product_vs_qr(self):
+        rng = np.random.RandomState(10)
+        a = rng.randn(5, 3).astype(np.float32)
+        h, tau = np.linalg.qr(a, mode="raw")
+        q = _np(paddle.householder_product(
+            paddle.to_tensor(np.asarray(h).T.copy()),
+            paddle.to_tensor(np.asarray(tau))))
+        ref_q = np.linalg.qr(a, mode="reduced")[0]
+        np.testing.assert_allclose(np.abs(q[:, :3]), np.abs(ref_q),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_py_func_eager_and_grad_free(self):
+        def np_impl(a):
+            return a * 2 + 1
+        x = paddle.to_tensor(np.array([1., 2.], np.float32))
+        out = paddle.py_func(np_impl, x)
+        np.testing.assert_allclose(_np(out), [3, 5])
+
+    def test_temporal_shift_shape_and_content(self):
+        x = np.arange(2 * 2 * 4 * 1 * 1, dtype=np.float32).reshape(
+            4, 4, 1, 1)
+        out = _np(F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                   shift_ratio=0.25))
+        assert out.shape == (4, 4, 1, 1)
+        # first quarter channels shifted backward: frame0 gets frame1's
+        np.testing.assert_allclose(out[0, 0], x[1, 0])
