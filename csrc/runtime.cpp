@@ -224,20 +224,30 @@ int pd_rdzv_serve(int port, const char* payload, int len, int npeers) {
   srv->payload.assign(payload, payload + len);
   srv->remaining = npeers;
   srv->th = std::thread([srv]() {
-    for (int i = 0; i < srv->remaining; ++i) {
+    // count a peer as served only after the FULL payload went out — a
+    // dropped connection gets to retry (pd_rdzv_fetch retries until its
+    // timeout), so done=1 really means every peer has the blob
+    int served = 0;
+    while (served < srv->remaining) {
       int conn = accept(srv->listen_fd, nullptr, nullptr);
-      if (conn < 0) break;
+      if (conn < 0) return;  // listener closed (pd_rdzv_close)
       uint32_t n = (uint32_t)srv->payload.size();
       uint32_t nn = htonl(n);
-      (void)!write(conn, &nn, 4);
+      // MSG_NOSIGNAL: a peer resetting mid-send must fail the write,
+      // not SIGPIPE the process
+      bool ok = send(conn, &nn, 4, MSG_NOSIGNAL) == 4;
       size_t off = 0;
-      while (off < srv->payload.size()) {
-        ssize_t w = write(conn, srv->payload.data() + off,
-                          srv->payload.size() - off);
-        if (w <= 0) break;
+      while (ok && off < srv->payload.size()) {
+        ssize_t w = send(conn, srv->payload.data() + off,
+                         srv->payload.size() - off, MSG_NOSIGNAL);
+        if (w <= 0) {
+          ok = false;
+          break;
+        }
         off += (size_t)w;
       }
       close(conn);
+      if (ok) ++served;
     }
     srv->done.store(1);
   });
@@ -343,7 +353,10 @@ int pd_rdzv_fetch(const char* host, int port, char* buf, int cap,
 
 namespace shmring {
 
+constexpr uint64_t kRingMagic = 0x50445249474e4731ULL;  // "PDRIGN1"
+
 struct Header {
+  uint64_t magic;      // kRingMagic once the creator finished init
   pthread_mutex_t mu;
   pthread_cond_t not_empty;
   pthread_cond_t not_full;
@@ -385,38 +398,78 @@ static void read_bytes(Handle* h, char* dst, uint64_t n) {
 
 extern "C" {
 
-// create (owner=1) or attach (owner=0) a named ring; returns handle >=0.
-// Attachers ignore `capacity` and use the creator's (header is the truth).
-int pd_shm_open(const char* name, uint64_t capacity, int owner) {
+// mode 0 = attach, 1 = create (fail with -5 if the name exists —
+// refusing to sever a live ring), 2 = force-create (unlink any existing
+// segment first; for recovering from a crashed run).
+// Attachers ignore `capacity` and use the creator's (header is the truth);
+// they spin on hdr->magic until the creator has finished initializing the
+// process-shared mutex/conds, so a racing attach never sees capacity=0 or
+// an uninitialized mutex.
+int pd_shm_open(const char* name, uint64_t capacity, int mode) {
   using namespace shmring;
   int fd;
+  int owner = mode != 0;
   if (owner) {
-    shm_unlink(name);  // stale ring from a crashed run
+    if (mode == 2) shm_unlink(name);  // explicit force only
     fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
-    if (fd < 0) return -1;
+    if (fd < 0) return errno == EEXIST ? -5 : -1;
     if (ftruncate(fd, (off_t)(sizeof(Header) + capacity)) != 0) {
       close(fd);
+      shm_unlink(name);
       return -2;
     }
   } else {
     fd = shm_open(name, O_RDWR, 0600);
     if (fd < 0) return -1;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(10);
+    // the creator's shm_open(O_CREAT) makes the name visible before
+    // ftruncate sizes it — reading a zero-length mapping would SIGBUS,
+    // so wait for the file to cover the header first
+    for (;;) {
+      struct stat st;
+      if (fstat(fd, &st) != 0) {
+        close(fd);
+        return -3;
+      }
+      if ((uint64_t)st.st_size >= sizeof(Header)) break;
+      if (std::chrono::steady_clock::now() > deadline) {
+        close(fd);
+        return -6;
+      }
+      usleep(1000);
+    }
     // map the header first to learn the creator's capacity — a caller-
-    // passed size could over-map (SIGBUS) or mis-wrap the ring
+    // passed size could over-map (SIGBUS) or mis-wrap the ring. Wait for
+    // the creator's ready flag before trusting any header field.
     void* hm = mmap(nullptr, sizeof(Header), PROT_READ, MAP_SHARED, fd,
                     0);
     if (hm == MAP_FAILED) {
       close(fd);
       return -3;
     }
-    capacity = ((Header*)hm)->capacity;
+    auto* hp = (Header*)hm;
+    while (__atomic_load_n(&hp->magic, __ATOMIC_ACQUIRE) != kRingMagic) {
+      if (std::chrono::steady_clock::now() > deadline) {
+        munmap(hm, sizeof(Header));
+        close(fd);
+        return -6;  // creator never finished init
+      }
+      usleep(1000);
+    }
+    capacity = hp->capacity;
     munmap(hm, sizeof(Header));
   }
   uint64_t total = sizeof(Header) + capacity;
   void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
                    0);
   close(fd);
-  if (mem == MAP_FAILED) return -3;
+  if (mem == MAP_FAILED) {
+    // a creator must not leave a linked-but-never-published segment
+    // behind: it would permanently -5 every future create of this name
+    if (owner) shm_unlink(name);
+    return -3;
+  }
   auto* h = new Handle();
   h->hdr = (Header*)mem;
   h->data = (char*)mem + sizeof(Header);
@@ -436,6 +489,8 @@ int pd_shm_open(const char* name, uint64_t capacity, int owner) {
     pthread_cond_init(&h->hdr->not_full, &ca);
     h->hdr->capacity = capacity;
     h->hdr->head = h->hdr->tail = h->hdr->used = h->hdr->count = 0;
+    // publish only after every field above is initialized
+    __atomic_store_n(&h->hdr->magic, kRingMagic, __ATOMIC_RELEASE);
   }
   std::lock_guard<std::mutex> lk(g_mu);
   g_handles.push_back(h);

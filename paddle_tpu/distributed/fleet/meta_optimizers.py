@@ -277,6 +277,15 @@ class DGCOptimizer(MetaOptimizerBase):
         # momentum isn't applied twice
         from ...optimizer import SGD, Momentum
         opt = spec.optimizer
+        # ref dgc_optimizer._can_apply: DGC only composes with the
+        # momentum family — with e.g. Adam, DGC's own momentum correction
+        # would stack on Adam's moment estimates (double momentum)
+        if not isinstance(opt, (SGD, Momentum)):
+            import warnings
+            warnings.warn(
+                f"DGC requires a Momentum/SGD inner optimizer, got "
+                f"{type(opt).__name__}; disabling dgc")
+            return
         momentum = 0.9
         if isinstance(opt, Momentum):
             momentum = float(getattr(opt, "_momentum", 0.9))

@@ -32,6 +32,7 @@ class Rendezvous:
         self.rank, self.nranks = rank, nranks
         self._handle = None
         self._py_thread = None
+        self._py_done = threading.Event()
 
     # -- rank 0 --------------------------------------------------------------
     def serve(self, payload: bytes):
@@ -50,15 +51,47 @@ class Rendezvous:
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((self.host if self.host != "" else "0.0.0.0", self.port))
         srv.listen(self.nranks - 1)
+        self._py_srv = srv
 
         def run():
-            for _ in range(self.nranks - 1):
-                conn, _ = srv.accept()
-                conn.sendall(struct.pack("!I", len(payload)) + payload)
-                conn.close()
+            served = 0
+            while served < self.nranks - 1:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return  # listen socket closed under us (close())
+                # one flaky peer must not abort the broadcast: it will
+                # reconnect and retry (fetch retries until its timeout)
+                try:
+                    conn.sendall(struct.pack("!I", len(payload)) + payload)
+                    served += 1
+                except OSError:
+                    pass
+                finally:
+                    conn.close()
             srv.close()
+            self._py_done.set()
         self._py_thread = threading.Thread(target=run, daemon=True)
         self._py_thread.start()
+
+    def wait_served(self, timeout: float = 120.0) -> bool:
+        """Block until all (nranks-1) peers have fetched (rank 0 only).
+        The reference's SendBroadCastCommID completes every send before
+        returning; this is the explicit-wait equivalent for the
+        background-thread server."""
+        if self.nranks <= 1:
+            return True
+        if self._handle is not None:
+            lib = runtime_lib()
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if lib.pd_rdzv_serve_done(self._handle) > 0:
+                    return True
+                time.sleep(0.05)
+            return False
+        if self._py_thread is not None:
+            return self._py_done.wait(timeout)
+        return True
 
     # -- peers ---------------------------------------------------------------
     def fetch(self, timeout: float = 120.0, max_len: int = 1 << 20) -> bytes:
@@ -104,6 +137,12 @@ class Rendezvous:
             lib.pd_rdzv_close(self._handle)
             self._handle = None
         if self._py_thread is not None:
+            srv = getattr(self, "_py_srv", None)
+            if srv is not None:
+                try:
+                    srv.close()  # interrupts a blocked accept()
+                except OSError:
+                    pass
             self._py_thread.join(timeout=1.0)
             self._py_thread = None
 
@@ -116,5 +155,14 @@ def broadcast_bootstrap(payload: Optional[bytes], endpoint: str, rank: int,
     if rank == 0:
         assert payload is not None
         rv.serve(payload)
+        # complete all sends before returning (SendBroadCastCommID
+        # semantics), then release the listening socket so the port is
+        # reusable in-process
+        ok = rv.wait_served(timeout)
+        rv.close()
+        if not ok:
+            raise TimeoutError(
+                f"rendezvous: not all {nranks - 1} peers fetched from "
+                f"{endpoint} within {timeout}s")
         return payload
     return rv.fetch(timeout=timeout)

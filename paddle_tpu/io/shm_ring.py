@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import collections
 import ctypes
+import itertools
 import os
 import pickle
 import threading
@@ -27,12 +28,23 @@ __all__ = ["ShmRing"]
 class ShmRing:
     """Fixed-capacity cross-process blob queue."""
 
+    _seq = itertools.count(1)
+    _fb_registry: dict = {}
+    _fb_lock = threading.Lock()
+
     def __init__(self, name: Optional[str] = None,
-                 capacity: int = 64 << 20, create: bool = True):
+                 capacity: int = 64 << 20, create: bool = True,
+                 force: bool = False):
         """capacity only matters for the creator; attachers
         (create=False) always adopt the creator's capacity from the shm
-        header."""
-        self.name = name or f"/pd_ring_{os.getpid()}"
+        header. Creating over an existing segment fails unless
+        force=True (which severs/unlinks the old ring)."""
+        if name is None:
+            # pid alone would collide across ShmRing instances in one
+            # process — add a per-process sequence number (itertools
+            # .count: atomic under the GIL, unlike `+= 1`)
+            name = f"/pd_ring_{os.getpid()}_{next(ShmRing._seq)}"
+        self.name = name
         if not self.name.startswith("/"):
             self.name = "/" + self.name
         self.capacity = int(capacity)
@@ -40,15 +52,37 @@ class ShmRing:
         self._handle = None
         self._fallback = None
         if self._lib is not None:
+            mode = 0 if not create else (2 if force else 1)
             h = self._lib.pd_shm_open(self.name.encode(), self.capacity,
-                                      1 if create else 0)
+                                      mode)
+            if h == -5:
+                raise FileExistsError(
+                    f"shm ring {self.name} already exists; pass "
+                    "force=True to replace it")
             if h < 0:
                 raise OSError(
                     f"shm ring open failed ({h}) for {self.name}")
             self._handle = h
-        else:  # in-process fallback (no cross-process support)
-            self._fallback = collections.deque()
-            self._cv = threading.Condition()
+        else:  # in-process fallback (no cross-process support); a
+            # process-level registry keeps the create/attach/exclusive
+            # contract identical to the native path
+            with ShmRing._fb_lock:
+                existing = ShmRing._fb_registry.get(self.name)
+                if create:
+                    if existing is not None and not force:
+                        raise FileExistsError(
+                            f"shm ring {self.name} already exists; pass "
+                            "force=True to replace it")
+                    entry = (collections.deque(), threading.Condition())
+                    ShmRing._fb_registry[self.name] = entry
+                    self._fb_owner = True
+                else:
+                    if existing is None:
+                        raise OSError(
+                            f"shm ring open failed (-1) for {self.name}")
+                    entry = existing
+                    self._fb_owner = False
+            self._fallback, self._cv = entry
 
     # -- raw bytes -----------------------------------------------------------
     def push_bytes(self, data: bytes):
@@ -99,6 +133,12 @@ class ShmRing:
         if self._handle is not None:
             self._lib.pd_shm_close(self._handle)
             self._handle = None
+        if self._fallback is not None and getattr(self, "_fb_owner", False):
+            with ShmRing._fb_lock:
+                if ShmRing._fb_registry.get(self.name) is not None and \
+                        ShmRing._fb_registry[self.name][0] is self._fallback:
+                    del ShmRing._fb_registry[self.name]
+            self._fb_owner = False
 
     def __del__(self):
         try:

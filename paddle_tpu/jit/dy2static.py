@@ -245,8 +245,15 @@ def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
     names: Set[str] = set()
 
     class V(ast.NodeVisitor):
-        def visit_FunctionDef(self, node):  # don't descend
-            names.add(node.name)
+        def visit_FunctionDef(self, node):
+            # don't descend. Helper defs generated by this transformer
+            # (__pd_true_*, __pd_body_*...) are re-created inside each
+            # branch/body where they're used, so they must never become
+            # lax.cond/while operands; user-level conditional `def`s
+            # remain merged stores (eager path rebinds them; traced path
+            # errors as before — functions can't cross cond boundaries)
+            if not node.name.startswith("__pd_"):
+                names.add(node.name)
 
         def visit_Lambda(self, node):  # lambda params aren't assignments
             pass
@@ -260,7 +267,11 @@ def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
             visit_ListComp
 
         def visit_Name(self, node):
-            if isinstance(node.ctx, (ast.Store,)):
+            # __pd_* names are bound by this transformer itself (init
+            # captures, helper defs of already-converted inner control
+            # flow); they never need to cross an outer cond/while
+            if isinstance(node.ctx, (ast.Store,)) and \
+                    not node.id.startswith("__pd_"):
                 names.add(node.id)
 
     v = V()

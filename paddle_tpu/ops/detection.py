@@ -995,23 +995,32 @@ def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist,
     cls_loss/loc_loss [N,M]; match_indices [N,M] (−1 = unmatched). Returns
     neg_mask [N,M] bool marking selected negatives.
     """
-    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
-    is_neg = (match_indices < 0) & (match_dist < neg_dist_threshold)
     if mining_type == "hard_example":
-        # ref mine_hard_examples_op.cc: fixed sample_size hardest negatives
+        # ref mine_hard_examples_op.cc: kHardExample ranks cls+loc loss
+        # over EVERY prior (IsEligibleMining is all-true), caps the
+        # selection at sample_size, but only originally-unmatched
+        # selected priors become negatives (matched ones stay positives)
         if sample_size is None:
             raise ValueError(
                 "mining_type='hard_example' requires sample_size")
-        num_neg = jnp.full((cls_loss.shape[0],), int(sample_size),
+        loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+        eligible = jnp.ones_like(match_indices, dtype=bool)
+        num_sel = jnp.full((cls_loss.shape[0],), int(sample_size),
                            jnp.int32)
+        neg_only = match_indices < 0
     elif mining_type == "max_negative":
+        # kMaxNegative ranks by cls_loss alone (loc_loss is only folded
+        # in under kHardExample, mine_hard_examples_op.cc)
+        loss = cls_loss
+        eligible = (match_indices < 0) & (match_dist < neg_dist_threshold)
         num_pos = jnp.sum(match_indices >= 0, axis=1)
-        num_neg = (num_pos * neg_pos_ratio).astype(jnp.int32)
+        num_sel = (num_pos * neg_pos_ratio).astype(jnp.int32)
         if sample_size is not None:
-            num_neg = jnp.minimum(num_neg, sample_size)
+            num_sel = jnp.minimum(num_sel, sample_size)
+        neg_only = True
     else:
         raise ValueError(f"unknown mining_type {mining_type!r}")
-    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
-    order = jnp.argsort(-neg_loss, axis=1)
+    sel_loss = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-sel_loss, axis=1)
     rank = jnp.argsort(order, axis=1)
-    return is_neg & (rank < num_neg[:, None])
+    return eligible & (rank < num_sel[:, None]) & neg_only

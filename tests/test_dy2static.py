@@ -59,6 +59,39 @@ def nested_control(x, n):
     return s
 
 
+def nested_if_in_if(x):
+    if x.sum() > 0:
+        if x.mean() > 1:
+            y = x * 2
+        else:
+            y = x * 3
+    else:
+        y = x - 1
+    return y
+
+
+def if_in_static_for(x):
+    y = x
+    for i in range(2):
+        if x.sum() > 0:
+            y = y + 1
+        else:
+            y = y - 1
+    return y
+
+
+def if_in_while(x, n):
+    s = paddle.zeros([1])
+    i = paddle.to_tensor(np.float32(0))
+    while i < n:
+        if x.sum() > 0:
+            s = s + 1.0
+        else:
+            s = s - 1.0
+        i = i + 1.0
+    return s
+
+
 def boolop_pred(x):
     if (x.sum() > 0) and (x.mean() < 10):
         return x + 1
@@ -123,6 +156,36 @@ class TestConvertTraced:
             np.asarray(g(np.ones(3, np.float32))), [2, 2, 2])
         np.testing.assert_allclose(
             np.asarray(g(-np.ones(3, np.float32))), [-2, -2, -2])
+
+    def test_nested_if_in_if_traced(self):
+        # regression: transformer helper names (__pd_true_*, __pd_i*)
+        # must not become lax.cond operands
+        g = self._jit(nested_if_in_if)
+        np.testing.assert_allclose(
+            np.asarray(g(np.full(3, 2.0, np.float32))), [4, 4, 4])
+        np.testing.assert_allclose(
+            np.asarray(g(np.full(3, 0.5, np.float32))), [1.5, 1.5, 1.5])
+        np.testing.assert_allclose(
+            np.asarray(g(-np.ones(3, np.float32))), [-2, -2, -2])
+
+    def test_if_in_static_for_traced(self):
+        g = self._jit(if_in_static_for)
+        np.testing.assert_allclose(
+            np.asarray(g(np.ones(3, np.float32))), [3, 3, 3])
+        np.testing.assert_allclose(
+            np.asarray(g(-np.ones(3, np.float32))), [-3, -3, -3])
+
+    def test_if_in_tensor_while_traced(self):
+        import jax
+        conv = convert_function(if_in_while)
+
+        def pure(xa, n):
+            return conv(paddle.Tensor(xa), paddle.Tensor(n))._data
+        g = jax.jit(pure)
+        assert float(np.asarray(
+            g(np.ones(3, np.float32), np.float32(3)))) == 3.0
+        assert float(np.asarray(
+            g(-np.ones(3, np.float32), np.float32(3)))) == -3.0
 
     def test_static_range_loop_stays_differentiable(self):
         import jax

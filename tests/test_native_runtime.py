@@ -89,6 +89,33 @@ class TestRendezvous:
         with pytest.raises((TimeoutError, OSError)):
             rv.fetch(timeout=0.5)
 
+    def test_broadcast_bootstrap_waits_and_frees_port(self):
+        # rank 0 must complete all sends before returning
+        # (SendBroadCastCommID semantics) and release the listening
+        # socket, so the same port is immediately reusable in-process
+        from paddle_tpu.distributed.rendezvous import broadcast_bootstrap
+        port = self._free_port()
+        ep = f"127.0.0.1:{port}"
+        for round_ in range(2):  # port reuse across rounds
+            payload = b"round-%d" % round_
+            got = []
+            peers = [threading.Thread(
+                target=lambda: got.append(
+                    broadcast_bootstrap(None, ep, 1, 2, timeout=10)))]
+            for t in peers:
+                t.start()
+            out = broadcast_bootstrap(payload, ep, 0, 2, timeout=10)
+            for t in peers:
+                t.join(timeout=10)
+            assert out == payload and got == [payload]
+
+    def test_broadcast_bootstrap_rank0_timeout_when_no_peers(self):
+        from paddle_tpu.distributed.rendezvous import broadcast_bootstrap
+        port = self._free_port()
+        with pytest.raises(TimeoutError):
+            broadcast_bootstrap(b"x", f"127.0.0.1:{port}", 0, 2,
+                                timeout=0.6)
+
 
 def _worker_push(ring_name, capacity):
     from paddle_tpu.io.shm_ring import ShmRing
@@ -138,6 +165,28 @@ class TestShmRing:
         ring.push_bytes(big)
         assert ring.pop_bytes(timeout=5) == big
         ring.close()
+
+    def test_exclusive_create_and_force(self):
+        # creating over a live ring must fail (not silently sever it)
+        # unless force=True is passed explicitly
+        from paddle_tpu.io.shm_ring import ShmRing
+        name = f"/pd_test_excl_{os.getpid()}"
+        ring = ShmRing(name, capacity=1 << 20, create=True)
+        with pytest.raises(FileExistsError):
+            ShmRing(name, capacity=1 << 20, create=True)
+        forced = ShmRing(name, capacity=1 << 20, create=True, force=True)
+        forced.push_bytes(b"ok")
+        assert forced.pop_bytes(timeout=5) == b"ok"
+        forced.close()
+        ring.close()
+
+    def test_default_names_unique_in_process(self):
+        from paddle_tpu.io.shm_ring import ShmRing
+        a = ShmRing(capacity=1 << 20)
+        b = ShmRing(capacity=1 << 20)
+        assert a.name != b.name
+        a.close()
+        b.close()
 
     def test_ring_wraparound(self):
         from paddle_tpu.io.shm_ring import ShmRing
