@@ -55,6 +55,10 @@ def _hook(op_name, args, kwargs):
     level = _amp_level()
     if level is None:
         return args, kwargs
+    if op_name == "cast":
+        # never rewrite explicit casts — including the ones this hook
+        # itself emits (rewriting them recurses forever under O2)
+        return args, kwargs
     dtype = _amp_dtype()
 
     def cast_val(v, to):

@@ -1,0 +1,55 @@
+"""In-graph AMP primitives (pure, jit-safe).
+
+Reference: operators/amp/check_finite_and_unscale_op.cc and
+operators/amp/update_loss_scaling_op.cc — the reference implements loss
+scaling as graph ops so the whole fp16 step stays on-device. Here the
+same two primitives are pure jnp functions over grad pytrees, composed
+into the compiled TrainStep (static/train_step.py) with the scale state
+carried in strategy_state — zero host round-trips per step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["check_finite_and_unscale_tree", "update_loss_scaling_state"]
+
+
+def check_finite_and_unscale_tree(grads, scale):
+    """(grads / scale, found_inf) over a pytree of grad arrays.
+
+    found_inf is a traced bool scalar: True if ANY leaf holds a
+    non-finite value (check_finite_and_unscale_op.cc semantics). Leaves
+    are unscaled in fp32 and cast back to their own dtype.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.asarray(True)
+    for g in leaves:
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact):
+            finite = finite & jnp.all(jnp.isfinite(g))
+    found_inf = jnp.logical_not(finite)
+    inv = 1.0 / scale.astype(jnp.float32)
+
+    def unscale(g):
+        return (g.astype(jnp.float32) * inv).astype(g.dtype)
+
+    return jax.tree_util.tree_map(unscale, grads), found_inf
+
+
+def update_loss_scaling_state(scale, good, bad, found_inf, incr_ratio=2.0,
+                              decr_ratio=0.5, incr_every_n=1000,
+                              decr_every_n=1):
+    """update_loss_scaling op: dynamic scale adjustment, all traced.
+
+    Returns (scale, good_steps, bad_steps). On overflow the scale
+    decays (floored at 1.0); after incr_every_n clean steps it grows.
+    """
+    good = jnp.where(found_inf, 0, good + 1)
+    bad = jnp.where(found_inf, bad + 1, 0)
+    hit_bad = bad >= decr_every_n
+    scale = jnp.where(hit_bad, jnp.maximum(scale * decr_ratio, 1.0), scale)
+    bad = jnp.where(hit_bad, 0, bad)
+    hit_good = good >= incr_every_n
+    scale = jnp.where(hit_good, scale * incr_ratio, scale)
+    good = jnp.where(hit_good, 0, good)
+    return scale, good, bad

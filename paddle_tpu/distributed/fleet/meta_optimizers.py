@@ -41,6 +41,7 @@ class TrainStepSpec:
     optimizer: Any
     amp_level: Optional[str] = None
     amp_dtype: str = "bfloat16"
+    scaler: Any = None  # amp.GradScaler -> in-graph loss scaling
     grad_accum_steps: int = 1
     zero_stage: int = 0
     remat: bool = False
@@ -200,8 +201,30 @@ class AMPOptimizer(MetaOptimizerBase):
         return strategy.amp
 
     def apply(self, spec, strategy, fleet=None):
-        spec.amp_level = "O2" if strategy.amp_configs.get(
-            "use_pure_fp16") else "O1"
+        cfg = strategy.amp_configs
+        pure = cfg.get("use_pure_fp16")
+        spec.amp_level = "O2" if pure else "O1"
+        if pure:
+            spec.amp_dtype = "float16"
+        if pure:
+            # loss scaling is an fp16 mechanism; the bf16 O1 default
+            # neither needs the isfinite reduction per step nor wants
+            # divergence masked by silent step-skipping
+            # in-graph dynamic loss scaling (amp_optimizer.py wires the
+            # check_finite/update_loss_scaling ops; here a GradScaler
+            # config compiled into the TrainStep)
+            from ...amp import GradScaler
+            spec.scaler = GradScaler(
+                init_loss_scaling=float(
+                    cfg.get("init_loss_scaling", 32768.0)),
+                incr_every_n_steps=int(
+                    cfg.get("incr_every_n_steps", 1000)),
+                decr_every_n_nan_or_inf=int(
+                    cfg.get("decr_every_n_nan_or_inf", 2)),
+                incr_ratio=float(cfg.get("incr_ratio", 2.0)),
+                decr_ratio=float(cfg.get("decr_ratio", 0.5)),
+                use_dynamic_loss_scaling=bool(
+                    cfg.get("use_dynamic_loss_scaling", True)))
         spec.applied.append(self.name)
 
 
@@ -601,4 +624,5 @@ def build_from_spec(spec: TrainStepSpec, mesh=None, sharding_plan=None):
                      grad_accum_steps=spec.grad_accum_steps,
                      grad_transform=grad_transform,
                      strategy_state=strategy_state,
-                     remat=spec.remat, remat_policy=spec.remat_policy)
+                     remat=spec.remat, remat_policy=spec.remat_policy,
+                     scaler=spec.scaler)

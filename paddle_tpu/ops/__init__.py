@@ -13,8 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import Tensor, _unwrap
-from . import (creation, detection, extras, linalg, logic, manipulation,
-               math, search, sequence, stat)
+from . import (amp_ops, creation, detection, extras, linalg, logic,
+               manipulation, math, search, sequence, stat)
+from .amp_ops import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
@@ -33,6 +34,7 @@ from .stat import *  # noqa: F401,F403
 __all__ = (creation.__all__ + math.__all__ + manipulation.__all__
            + logic.__all__ + search.__all__ + linalg.__all__ + stat.__all__
            + detection.__all__ + sequence.__all__ + extras.__all__
+           + amp_ops.__all__
            + ["einsum", "cond", "while_loop", "bounded_while_loop",
               "case", "switch_case", "scan", "fori_loop"])
 

@@ -1,0 +1,59 @@
+"""AMP graph ops: check_finite_and_unscale / update_loss_scaling.
+
+Reference: operators/amp/check_finite_and_unscale_op.cc (inputs X...,
+Scale -> Out..., FoundInfinite) and update_loss_scaling_op.cc
+(FoundInfinite + counters -> new LossScaling/counters). The reference
+implements these as graph ops so fp16 loss scaling never syncs to the
+host; here the same contract is a registered op over varargs tensors,
+and static/train_step.py composes the pytree forms
+(amp/functional.py) directly into the compiled step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..amp.functional import (check_finite_and_unscale_tree,
+                              update_loss_scaling_state)
+from .registry import register_op
+
+__all__ = ["check_finite_and_unscale", "update_loss_scaling"]
+
+
+@register_op("check_finite_and_unscale")
+def check_finite_and_unscale(*xs, scale=None):
+    """Unscale xs by 1/scale; last output is the found_infinite flag.
+
+    Returns (x0/scale, ..., xn/scale, found_inf). Unlike the reference
+    (which leaves Out undefined when FoundInfinite), outputs are always
+    the unscaled values — callers gate the optimizer update on the flag
+    (the TrainStep does this with jnp.where).
+    """
+    if scale is None:
+        raise ValueError("check_finite_and_unscale requires scale=")
+    out, found_inf = check_finite_and_unscale_tree(
+        list(xs), jnp.asarray(scale))
+    return tuple(out) + (found_inf,)
+
+
+@register_op("update_loss_scaling")
+def update_loss_scaling(found_inf, prev_loss_scaling, in_good_steps,
+                        in_bad_steps, incr_ratio=2.0, decr_ratio=0.5,
+                        incr_every_n_steps=1000,
+                        decr_every_n_nan_or_inf=1, stop_update=False):
+    """Dynamic loss-scale update (update_loss_scaling_op.cc contract).
+
+    Returns (loss_scaling, good_steps, bad_steps).
+    """
+    scale, good, bad = update_loss_scaling_state(
+        jnp.asarray(prev_loss_scaling, jnp.float32),
+        jnp.asarray(in_good_steps, jnp.int32),
+        jnp.asarray(in_bad_steps, jnp.int32),
+        jnp.asarray(found_inf, bool),
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+        incr_every_n=incr_every_n_steps,
+        decr_every_n=decr_every_n_nan_or_inf)
+    if stop_update:
+        return (jnp.asarray(prev_loss_scaling, jnp.float32),
+                jnp.asarray(in_good_steps, jnp.int32),
+                jnp.asarray(in_bad_steps, jnp.int32))
+    return scale, good, bad

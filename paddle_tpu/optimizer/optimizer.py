@@ -53,6 +53,48 @@ class Optimizer:
     def update_rule(self, p, g, state, lr):
         raise NotImplementedError
 
+    # -- master weights (multi_precision) -------------------------------------
+    # Reference: python/paddle/optimizer/adam.py:30 multi_precision — low-
+    # precision params keep an fp32 master copy in optimizer state; the
+    # update runs in fp32 and the param is the cast-down of the master.
+    _LOW_PRECISION = (jnp.float16, jnp.bfloat16)
+
+    def _uses_master(self, param) -> bool:
+        return bool(self._multi_precision) and \
+            param.dtype in self._LOW_PRECISION
+
+    def _init_param_state(self, param):
+        if self._uses_master(param):
+            master = param.astype(jnp.float32)
+            st = self.init_state(master)  # fp32 moments
+            st["master_weight"] = master
+            return st
+        return self.init_state(param)
+
+    def _apply_one(self, p, g, state, lr):
+        """One param update honoring weight decay + master weights.
+        Pure: usable eagerly and under jit."""
+        wd = self._weight_decay
+        master = state.get("master_weight") if isinstance(state, dict) \
+            else None
+        if master is not None:
+            inner = {k: v for k, v in state.items() if k != "master_weight"}
+            g32 = g.astype(jnp.float32)
+            if wd and not self._decoupled_wd:
+                g32 = g32 + wd * master
+            new_master, new_state = self.update_rule(master, g32, inner, lr)
+            if self._decoupled_wd and wd:
+                new_master = new_master - lr * wd * master
+            new_state["master_weight"] = new_master
+            return new_master.astype(p.dtype), new_state
+        g = g.astype(p.dtype)
+        if wd and not self._decoupled_wd:
+            g = g + wd * p
+        new_p, new_state = self.update_rule(p, g, state, lr)
+        if self._decoupled_wd and wd:
+            new_p = new_p - lr * wd * p
+        return new_p, new_state
+
     # decoupled weight decay? (AdamW) — L2-style adds wd*p to grad
     _decoupled_wd = False
 
@@ -95,14 +137,9 @@ class Optimizer:
             garr = g._data if isinstance(g, Tensor) else g
             state = self._accumulators.get(id(p))
             if state is None:
-                state = self.init_state(p._data)
+                state = self._init_param_state(p._data)
                 self._accumulators[id(p)] = state
-            garr = garr.astype(p._data.dtype)
-            if self._weight_decay and not self._decoupled_wd:
-                garr = garr + self._weight_decay * p._data
-            new_p, new_state = self.update_rule(p._data, garr, state, lr)
-            if self._decoupled_wd and self._weight_decay:
-                new_p = new_p - lr * self._weight_decay * p._data
+            new_p, new_state = self._apply_one(p._data, garr, state, lr)
             p._data = new_p
             self._accumulators[id(p)] = new_state
 
@@ -128,29 +165,21 @@ class Optimizer:
 
     # -- functional API for compiled steps ------------------------------------
     def init_state_tree(self, params_tree):
-        """init_state over a pytree of arrays (for jit'd train steps)."""
-        return jax.tree_util.tree_map(self.init_state, params_tree)
+        """init_state over a pytree of arrays (for jit'd train steps).
+        Adds fp32 master_weight entries for low-precision params when
+        multi_precision is on."""
+        return jax.tree_util.tree_map(self._init_param_state, params_tree)
 
     def apply_gradients_tree(self, params_tree, grads_tree, state_tree,
                              lr=None):
         """Pure pytree update: returns (new_params, new_state). Usable under
         jit/pjit/shard_map; lr may be a traced scalar."""
         lr = lr if lr is not None else self.get_lr()
-        wd = self._weight_decay
-
-        def upd(p, g, s):
-            g = g.astype(p.dtype)
-            if wd and not self._decoupled_wd:
-                g = g + wd * p
-            new_p, new_s = self.update_rule(p, g, s, lr)
-            if self._decoupled_wd and wd:
-                new_p = new_p - lr * wd * p
-            return new_p, new_s
-
         flat_p, tdef = jax.tree_util.tree_flatten(params_tree)
         flat_g = tdef.flatten_up_to(grads_tree)
         flat_s = tdef.flatten_up_to(state_tree)
-        new = [upd(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        new = [self._apply_one(p, g, s, lr)
+               for p, g, s in zip(flat_p, flat_g, flat_s)]
         new_p = tdef.unflatten([a for a, _ in new])
         new_s = tdef.unflatten([b for _, b in new])
         return new_p, new_s

@@ -54,12 +54,28 @@ class TrainStep:
                  donate: bool = True, grad_accum_steps: int = 1,
                  grad_transform: Optional[Callable] = None,
                  strategy_state: Optional[Dict[str, Any]] = None,
-                 remat: bool = False, remat_policy=None):
+                 remat: bool = False, remat_policy=None, scaler=None):
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
+        # In-graph dynamic loss scaling (reference
+        # operators/amp/{check_finite_and_unscale,update_loss_scaling}
+        # ops): pass an amp.GradScaler/AmpScaler and its state lives in
+        # strategy_state as traced scalars — scale/unscale, the finite
+        # check, the skip-step select, and the scale update all compile
+        # into the step; no host sync (unlike GradScaler.step eager-side).
+        self._scaler_cfg = None
+        if scaler is not None and getattr(scaler, "_enable", True):
+            self._scaler_cfg = {
+                "init_scale": float(scaler._scale),
+                "incr_ratio": float(scaler._incr_ratio),
+                "decr_ratio": float(scaler._decr_ratio),
+                "incr_every_n": int(scaler._incr_every_n),
+                "decr_every_n": int(scaler._decr_every_n),
+                "dynamic": bool(scaler._dynamic),
+            }
         self.mesh = mesh
         self.sharding_plan = sharding_plan
         self.grad_accum_steps = grad_accum_steps
@@ -79,7 +95,36 @@ class TrainStep:
         self._buffer_names = [k for k, t in state.items() if t.stop_gradient]
         self.params = {k: state[k]._data for k in self._trainable_names}
         self.buffers = {k: state[k]._data for k in self._buffer_names}
-        self.opt_state = optimizer.init_state_tree(self.params)
+        if amp_level == "O2":
+            # pure-low-precision mode (reference amp O2 / pure_fp16):
+            # params themselves are cast down; the optimizer keeps fp32
+            # masters (multi_precision is mandatory for fp16 training)
+            dt = jnp.dtype(amp_dtype)
+            orig = dict(self.params)
+            self.params = {
+                k: v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating)
+                else v
+                for k, v in self.params.items()}
+            if not optimizer._multi_precision:
+                optimizer._multi_precision = True
+            self.opt_state = optimizer.init_state_tree(self.params)
+            # masters must come from the ORIGINAL fp32 values, not the
+            # cast-down params (adam.py multi_precision keeps full
+            # precision; round-tripping through fp16 would quantize
+            # every weight at init)
+            for k, st in self.opt_state.items():
+                if isinstance(st, dict) and "master_weight" in st:
+                    st["master_weight"] = orig[k].astype(jnp.float32)
+        else:
+            self.opt_state = optimizer.init_state_tree(self.params)
+        if self._scaler_cfg is not None:
+            cfg = self._scaler_cfg
+            self.strategy_state.setdefault(
+                "amp_scale", jnp.asarray(cfg["init_scale"], jnp.float32))
+            self.strategy_state.setdefault("amp_good",
+                                           jnp.asarray(0, jnp.int32))
+            self.strategy_state.setdefault("amp_bad",
+                                           jnp.asarray(0, jnp.int32))
         self._accum_grads = None
         self._accum_count = 0
         self._donate = donate
@@ -135,8 +180,18 @@ class TrainStep:
                 self._forward_loss, policy=self.remat_policy,
                 static_argnums=())
 
+        scaler_cfg = self._scaler_cfg
+
         def step(params, opt_state, buffers, strat, key, lr, inputs,
                  labels):
+            scale = strat["amp_scale"] if scaler_cfg is not None else None
+
+            def scaled_loss(p, b, k, i, l):
+                loss, aux = fwd_loss(p, b, k, i, l)
+                if scale is not None:
+                    loss = loss * scale
+                return loss, aux
+
             if accum > 1:
                 # gradient merge (reference gradient_merge_optimizer.py):
                 # split the batch into accum microbatches, scan, average
@@ -147,7 +202,7 @@ class TrainStep:
                         lambda a: _microslice(a, idx, accum), labels)
                     k = jax.random.fold_in(key, idx)
                     gf = jax.value_and_grad(
-                        lambda p: fwd_loss(p, buffers, k, sl, ll),
+                        lambda p: scaled_loss(p, buffers, k, sl, ll),
                         has_aux=True)
                     return gf
 
@@ -167,13 +222,37 @@ class TrainStep:
                     lambda a: a[-1], nbs)
             else:
                 grad_fn = jax.value_and_grad(
-                    lambda p: fwd_loss(p, buffers, key, inputs,
-                                       labels), has_aux=True)
+                    lambda p: scaled_loss(p, buffers, key, inputs,
+                                          labels), has_aux=True)
                 (loss, (new_buffers, _)), grads = grad_fn(params)
+            found_inf = None
+            if scale is not None:
+                from ..amp.functional import (check_finite_and_unscale_tree,
+                                              update_loss_scaling_state)
+                grads, found_inf = check_finite_and_unscale_tree(grads,
+                                                                 scale)
+                loss = loss / scale
             if self.grad_transform is not None:
                 grads, strat = self.grad_transform(grads, strat, params)
             new_params, new_opt = optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr=lr)
+            if found_inf is not None:
+                # skipped-step semantics: on overflow keep params and
+                # optimizer state exactly as they were
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(found_inf, o, n), new, old)
+                new_params = keep(new_params, params)
+                new_opt = keep(new_opt, opt_state)
+                strat = dict(strat)
+                if scaler_cfg["dynamic"]:
+                    ns, ng, nb = update_loss_scaling_state(
+                        scale, strat["amp_good"], strat["amp_bad"],
+                        found_inf,
+                        incr_ratio=scaler_cfg["incr_ratio"],
+                        decr_ratio=scaler_cfg["decr_ratio"],
+                        incr_every_n=scaler_cfg["incr_every_n"],
+                        decr_every_n=scaler_cfg["decr_every_n"])
+                    strat.update(amp_scale=ns, amp_good=ng, amp_bad=nb)
             return new_params, new_opt, new_buffers, strat, loss
 
         jit_kwargs = {}

@@ -175,3 +175,163 @@ def test_grad_scaler_skips_on_inf():
     scaler.step(opt)
     np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
     assert scaler.get_loss_scaling() == 512.0  # scale halved
+
+
+# -- in-graph AMP: master weights + compiled loss scaling --------------------
+# (reference operators/amp/check_finite_and_unscale_op.cc,
+#  update_loss_scaling_op.cc, python/paddle/optimizer/adam.py multi_precision)
+
+class TestMasterWeights:
+    def test_fp16_adam_keeps_fp32_master(self):
+        import jax.numpy as jnp
+        p = paddle.create_parameter([4], "float16")
+        p._data = jnp.ones(4, jnp.float16)
+        opt = paddle.optimizer.Adam(learning_rate=1e-4, parameters=[p],
+                                    multi_precision=True)
+        # 100 updates of ~1e-4: pure-fp16 accumulation would stall
+        # (1.0 + 1e-4 rounds back to 1.0 in fp16); master fp32 must not
+        for _ in range(100):
+            p._grad = jnp.ones(4, jnp.float16)
+            opt.step()
+        st = opt._accumulators[id(p)]
+        assert st["master_weight"].dtype == jnp.float32
+        assert st["moment1"].dtype == jnp.float32
+        # param tracks cast-down master; master itself moved ~100*1e-4
+        assert float(st["master_weight"][0]) < 1.0 - 5e-3
+        assert p._data.dtype == jnp.float16
+        np.testing.assert_allclose(
+            np.asarray(p._data), np.asarray(
+                st["master_weight"].astype(jnp.float16)))
+
+    def test_fp16_without_master_stalls(self):
+        # control: the failure mode master weights exist to fix
+        import jax.numpy as jnp
+        p = paddle.create_parameter([4], "float16")
+        p._data = jnp.ones(4, jnp.float16)
+        opt = paddle.optimizer.SGD(learning_rate=1e-4, parameters=[p])
+        for _ in range(10):
+            p._grad = jnp.ones(4, jnp.float16)
+            opt.step()
+        np.testing.assert_array_equal(np.asarray(p._data),
+                                      np.ones(4, np.float16))
+
+    def test_momentum_multi_precision_tree_api(self):
+        import jax.numpy as jnp
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        multi_precision=True)
+        params = {"w": jnp.ones(3, jnp.float16)}
+        st = opt.init_state_tree(params)
+        assert st["w"]["master_weight"].dtype == jnp.float32
+        grads = {"w": jnp.full(3, 0.5, jnp.float16)}
+        new_p, new_st = opt.apply_gradients_tree(params, grads, st)
+        assert new_p["w"].dtype == jnp.float16
+        np.testing.assert_allclose(
+            np.asarray(new_st["w"]["master_weight"]),
+            1.0 - 0.1 * 0.5, rtol=1e-6)
+
+
+class TestInGraphLossScaling:
+    def _make_step(self, scaler, amp_level="O2", amp_dtype="float16"):
+        from paddle_tpu.static.train_step import TrainStep
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    multi_precision=True)
+        return TrainStep(net, lambda o, y: F.mse_loss(o, y), opt,
+                         amp_level=amp_level, amp_dtype=amp_dtype,
+                         scaler=scaler)
+
+    def test_o2_fp16_trains_and_scale_state_in_graph(self):
+        import jax.numpy as jnp
+        from paddle_tpu.amp import GradScaler
+        scaler = GradScaler(init_loss_scaling=2.0 ** 8,
+                            incr_every_n_steps=4)
+        step = self._make_step(scaler)
+        # params were cast down; optimizer holds fp32 masters
+        assert all(v.dtype == jnp.float16 for v in step.params.values())
+        assert all(st["master_weight"].dtype == jnp.float32
+                   for st in step.opt_state.values())
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 4).astype(np.float32)
+        losses = [float(step(paddle.to_tensor(x),
+                             paddle.to_tensor(y)).item())
+                  for _ in range(12)]
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+        # clean steps: scale grew (incr_every_n=4, 12 clean steps)
+        assert float(step.strategy_state["amp_scale"]) > 2.0 ** 8
+
+    def test_overflow_skips_update_and_decays_scale(self):
+        import jax.numpy as jnp
+        from paddle_tpu.amp import GradScaler
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+        step = self._make_step(scaler)
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randn(8, 4).astype(np.float32)
+        step(paddle.to_tensor(x), paddle.to_tensor(y))  # warmup/compile
+        before = {k: np.asarray(v) for k, v in step.params.items()}
+        scale_before = float(step.strategy_state["amp_scale"])
+        bad = x.copy()
+        bad[0, 0] = np.inf
+        loss = step(paddle.to_tensor(bad), paddle.to_tensor(y))
+        # skipped-step semantics: params and opt state unchanged
+        for k, v in step.params.items():
+            np.testing.assert_array_equal(before[k], np.asarray(v))
+        assert float(step.strategy_state["amp_scale"]) == \
+            scale_before * 0.5
+        # recovery: clean step still trains afterwards
+        l2 = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert np.isfinite(float(l2.item()))
+
+    def test_amp_ops_under_jit(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.amp.functional import (
+            check_finite_and_unscale_tree, update_loss_scaling_state)
+
+        @jax.jit
+        def f(tree, scale):
+            g, inf = check_finite_and_unscale_tree(tree, scale)
+            s, good, bad = update_loss_scaling_state(
+                scale, jnp.asarray(3, jnp.int32),
+                jnp.asarray(0, jnp.int32), inf)
+            return g, inf, s
+        tree = {"a": jnp.ones(3) * 8.0, "b": jnp.ones(2)}
+        g, inf, s = f(tree, jnp.asarray(4.0, jnp.float32))
+        assert not bool(inf)
+        np.testing.assert_allclose(np.asarray(g["a"]), 2.0)
+        tree["b"] = jnp.array([1.0, np.nan])
+        g, inf, s = f(tree, jnp.asarray(4.0, jnp.float32))
+        assert bool(inf) and float(s) == 2.0
+
+
+def test_ernie_tiny_fp16_o2_trains():
+    """fp16 O2 end-to-end (VERDICT item 5 done-criterion): ERNIE-tiny
+    under TrainStep with in-graph dynamic loss scaling + master weights
+    trains; an injected overflow batch is skipped without corrupting
+    state."""
+    import jax.numpy as jnp
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    from paddle_tpu.static.train_step import TrainStep
+    from paddle_tpu.amp import GradScaler
+    paddle.seed(42)
+    cfg = ErnieConfig.tiny()
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                 multi_precision=True)
+    scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+    step = TrainStep(
+        model,
+        lambda out, y: ErnieForPretraining.pretraining_loss(out, y),
+        opt, amp_level="O2", amp_dtype="float16", scaler=scaler)
+    assert any(v.dtype == jnp.float16 for v in step.params.values())
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    losses = [float(step(paddle.to_tensor(ids),
+                         paddle.to_tensor(labels)).item())
+              for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # memorizes the fixed batch
