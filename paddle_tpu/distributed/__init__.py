@@ -17,6 +17,8 @@ from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
 from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                               VocabParallelEmbedding, split)  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, gpipe_schedule  # noqa: F401
+from .pipeline_engine import (PipelineParallel, build_1f1b_schedule,  # noqa: F401
+                              stage_submeshes)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .ring import (RingAttention, ring_flash_attention,
                    ulysses_attention)  # noqa: F401

@@ -3,5 +3,7 @@ from ..vision.models import (LeNet, MobileNetV1, MobileNetV2, ResNet, VGG,
                              resnet50, resnet101, resnet152, vgg11, vgg13,
                              vgg16, vgg19)  # noqa: F401
 from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
+                    ErnieStageFirst, ErnieStageMiddle, ErnieStageLast,
+                    ernie_pipeline_stages,
                     ErnieForSequenceClassification)  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401

@@ -25,7 +25,8 @@ from ..framework import Tensor
 from ..ops import creation, manipulation
 
 __all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining",
-           "ErnieForSequenceClassification"]
+           "ErnieForSequenceClassification", "ErnieStageFirst",
+           "ErnieStageMiddle", "ErnieStageLast", "ernie_pipeline_stages"]
 
 
 class ErnieConfig:
@@ -236,3 +237,110 @@ class ErnieForSequenceClassification(nn.Layer):
         _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                attention_mask)
         return self.classifier(self.dropout(pooled))
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel stage decomposition
+# ---------------------------------------------------------------------------
+# Reference: PipelineOptimizer splits the ERNIE program by device_guard
+# (fluid/optimizer.py:3718) — embedding on the first device, lm head on
+# the last. Here the split is explicit heterogeneous stage Layers driven
+# by distributed.pipeline_engine.PipelineParallel. The MLM decoder weight
+# is UNTIED from the word embedding across a pipeline split (tying would
+# need a per-step tied-grad allreduce between first and last stage —
+# Megatron's _allreduce_word_embedding_grads; the throughput cost on ICI
+# buys nothing at pretraining loss parity, so we keep stages independent
+# and document the decision here).
+
+class ErnieStageFirst(nn.Layer):
+    """Embeddings + leading encoder blocks -> hidden states.
+
+    With an attention_mask, the additive [b,1,1,s] form is built here
+    once and threaded to later stages as part of the activation tuple
+    (the same mask plumbing ErnieModel.forward does in one program)."""
+
+    def __init__(self, config: ErnieConfig, num_blocks: int):
+        super().__init__()
+        self.embeddings = ErnieEmbeddings(config)
+        self.blocks = nn.LayerList(
+            [ErnieLayer(config) for _ in range(num_blocks)])
+
+    def forward(self, input_ids, attention_mask=None):
+        x = self.embeddings(input_ids)
+        if attention_mask is not None:
+            am = manipulation.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - am.astype("float32")) * -1e9
+        for b in self.blocks:
+            x = b(x, attention_mask)
+        if attention_mask is not None:
+            return x, attention_mask
+        return x
+
+
+class ErnieStageMiddle(nn.Layer):
+    """A run of encoder blocks (hidden -> hidden)."""
+
+    def __init__(self, config: ErnieConfig, num_blocks: int):
+        super().__init__()
+        self.blocks = nn.LayerList(
+            [ErnieLayer(config) for _ in range(num_blocks)])
+
+    def forward(self, x, attention_mask=None):
+        for b in self.blocks:
+            x = b(x, attention_mask)
+        if attention_mask is not None:
+            return x, attention_mask
+        return x
+
+
+class ErnieStageLast(nn.Layer):
+    """Trailing blocks + pooler + MLM/NSP heads (hidden -> logits)."""
+
+    def __init__(self, config: ErnieConfig, num_blocks: int):
+        super().__init__()
+        self.blocks = nn.LayerList(
+            [ErnieLayer(config) for _ in range(num_blocks)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_transform = nn.Linear(config.hidden_size,
+                                       config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        self.decoder.weight.sharding_spec = P(None, TENSOR_AXIS)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, x, attention_mask=None):
+        for b in self.blocks:
+            x = b(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        h = self.mlm_norm(F.gelu(self.mlm_transform(x)))
+        logits = self.decoder(h)
+        return logits, self.nsp(pooled)
+
+
+def ernie_pipeline_stages(config: ErnieConfig, num_stages: int):
+    """Split an ERNIE pretraining model into heterogeneous pp stages.
+
+    Blocks are distributed as evenly as possible; stage 0 additionally
+    carries the embeddings, the last stage the pooler + heads (the
+    device_guard placement of the reference's pipeline ERNIE).
+    """
+    assert num_stages >= 1
+    L = config.num_hidden_layers
+    base, extra = divmod(L, num_stages)
+    counts = [base + (1 if i < extra else 0) for i in range(num_stages)]
+    if num_stages == 1:
+        class _Solo(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.first = ErnieStageFirst(config, 0)
+                self.last = ErnieStageLast(config, L)
+
+            def forward(self, input_ids):
+                return self.last(self.first(input_ids))
+        return [_Solo()]
+    stages = [ErnieStageFirst(config, counts[0])]
+    for i in range(1, num_stages - 1):
+        stages.append(ErnieStageMiddle(config, counts[i]))
+    stages.append(ErnieStageLast(config, counts[-1]))
+    return stages

@@ -1,0 +1,259 @@
+"""Heterogeneous pipeline parallelism tests (reference
+section_worker.cc F-then-B loop / PipelineOptimizer split semantics;
+pipeline_engine.py is the TPU redesign)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import (PipelineParallel, build_1f1b_schedule,
+                                    stage_submeshes)
+
+
+class TestSchedule:
+    def _check(self, sched, S, M):
+        assert len(sched) == 2 * S * M
+        done = set()
+        for op, s, m in sched:
+            if op == "F":
+                if s > 0:
+                    assert ("F", s - 1, m) in done, (op, s, m)
+            else:
+                assert ("F", s, m) in done
+                if s < S - 1:
+                    assert ("B", s + 1, m) in done
+            done.add((op, s, m))
+
+    @pytest.mark.parametrize("S,M", [(2, 4), (4, 8), (3, 3), (1, 2),
+                                     (4, 2)])
+    def test_1f1b_dependencies(self, S, M):
+        self._check(build_1f1b_schedule(S, M, "1f1b"), S, M)
+
+    @pytest.mark.parametrize("S,M", [(2, 4), (4, 8)])
+    def test_fthenb_dependencies(self, S, M):
+        self._check(build_1f1b_schedule(S, M, "fthenb"), S, M)
+
+    def test_1f1b_bounds_in_flight_activations(self):
+        # PipeDream-flush property: stage s never holds more than
+        # min(M, S - s) outstanding forward activations
+        S, M = 4, 16
+        sched = build_1f1b_schedule(S, M, "1f1b")
+        live = [0] * S
+        peak = [0] * S
+        for op, s, m in sched:
+            if op == "F":
+                live[s] += 1
+                peak[s] = max(peak[s], live[s])
+            else:
+                live[s] -= 1
+        for s in range(S):
+            assert peak[s] <= min(M, S - s), (s, peak[s])
+        # ...while fthenb (GPipe) holds all M on every stage
+        live = [0] * S
+        gpeak = [0] * S
+        for op, s, m in build_1f1b_schedule(S, M, "fthenb"):
+            if op == "F":
+                live[s] += 1
+                gpeak[s] = max(gpeak[s], live[s])
+            else:
+                live[s] -= 1
+        assert gpeak[0] == M
+
+
+def _mlp_stages(din=8, dh=16, dout=4):
+    paddle.seed(5)
+    s0 = nn.Sequential(nn.Linear(din, dh), nn.ReLU())
+    s1 = nn.Sequential(nn.Linear(dh, dh), nn.ReLU())
+    s2 = nn.Sequential(nn.Linear(dh, dout))
+    return [s0, s1, s2]
+
+
+class _Chain(nn.Layer):
+    def __init__(self, stages):
+        super().__init__()
+        self.stages = nn.LayerList(stages)
+
+    def forward(self, x):
+        for s in self.stages:
+            x = s(x)
+        return x
+
+
+def _copy_state(src_layers, dst_layers):
+    for a, b in zip(src_layers, dst_layers):
+        sd = {k: paddle.to_tensor(np.asarray(v._data))
+              for k, v in a.state_dict().items()}
+        b.set_state_dict(sd)
+
+
+class TestPipelineTraining:
+    def test_mlp_3stage_matches_single_device(self):
+        stages = _mlp_stages()
+        ref_stages = _mlp_stages()
+        _copy_state(stages, ref_stages)
+        ref = _Chain(ref_stages)
+
+        opt_pp = paddle.optimizer.Adam(learning_rate=1e-2)
+        pp = PipelineParallel(stages, lambda o, y: F.mse_loss(o, y),
+                              opt_pp, num_micro=4)
+        opt_ref = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=ref.parameters())
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randn(8, 4).astype(np.float32)
+        for step in range(5):
+            lp = pp.train_batch(paddle.to_tensor(x), paddle.to_tensor(y))
+            out = ref(paddle.to_tensor(x))
+            lr = F.mse_loss(out, paddle.to_tensor(y))
+            lr.backward()
+            opt_ref.step()
+            opt_ref.clear_grad()
+            np.testing.assert_allclose(float(lp.item()), float(lr.item()),
+                                       rtol=1e-5, atol=1e-6)
+        # trained params match too
+        pp.sync_to_layers()
+        for a, b in zip(stages, ref_stages):
+            for (k, va), (_, vb) in zip(a.state_dict().items(),
+                                        ref.state_dict().items()):
+                pass  # ref keys differ (wrapped); compare via stages
+        for a, b in zip(stages, ref_stages):
+            for k, va in a.state_dict().items():
+                vb = b.state_dict()[k]
+                np.testing.assert_allclose(np.asarray(va._data),
+                                           np.asarray(vb._data),
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_ernie_2stage_trains_and_matches(self):
+        """VERDICT item 2 done-criterion: ERNIE split across 2 pp stages
+        (embedding in stage 0, lm head in stage 1) trains and its loss
+        matches the same model run unsplit, to 1e-5."""
+        from paddle_tpu.models import ErnieConfig, ernie_pipeline_stages
+        cfg = ErnieConfig.tiny(hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0)
+        paddle.seed(11)
+        stages = ernie_pipeline_stages(cfg, 2)
+        paddle.seed(11)
+        ref_stages = ernie_pipeline_stages(cfg, 2)
+        _copy_state(stages, ref_stages)
+        ref = _Chain(ref_stages)
+
+        def loss_fn(out, labels):
+            logits, _ = out
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1]))
+
+        opt_pp = paddle.optimizer.AdamW(learning_rate=5e-4)
+        pp = PipelineParallel(stages, loss_fn, opt_pp, num_micro=2)
+        opt_ref = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                         parameters=ref.parameters())
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, cfg.vocab_size, (4, 12)).astype(np.int32)
+        labels = rng.randint(0, cfg.vocab_size, (4, 12)).astype(np.int32)
+        pp_losses, ref_losses = [], []
+        for step in range(4):
+            lp = pp.train_batch(paddle.to_tensor(ids),
+                                paddle.to_tensor(labels))
+            out = ref(paddle.to_tensor(ids))
+            lr = loss_fn(out, paddle.to_tensor(labels))
+            lr.backward()
+            opt_ref.step()
+            opt_ref.clear_grad()
+            pp_losses.append(float(lp.item()))
+            ref_losses.append(float(lr.item()))
+        np.testing.assert_allclose(pp_losses, ref_losses, rtol=1e-5)
+        assert pp_losses[-1] < pp_losses[0]  # actually training
+
+    def test_pipeline_over_pp_mesh_with_dp(self):
+        """pp×dp composition on the 8-device CPU mesh: 2 pp stages, each
+        on a 4-device dp submesh."""
+        import jax
+        import paddle_tpu.distributed as dist
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        mesh = dist.build_mesh({"pp": 2, "dp": 4},
+                               devices=jax.devices()[:8])
+        subs = stage_submeshes(mesh, 2, "pp")
+        assert all(s is not None and s.devices.size == 4 for s in subs)
+        assert set(subs[0].axis_names) == {"dp"}
+
+        stages = _mlp_stages()[:2]  # 2 stages
+        opt = paddle.optimizer.SGD(learning_rate=1e-2)
+        pp = PipelineParallel(stages,
+                              lambda o, y: F.mse_loss(o, y), opt,
+                              num_micro=2, mesh=mesh)
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        l0 = float(pp.train_batch(paddle.to_tensor(x),
+                                  paddle.to_tensor(y)).item())
+        l1 = float(pp.train_batch(paddle.to_tensor(x),
+                                  paddle.to_tensor(y)).item())
+        assert np.isfinite([l0, l1]).all() and l1 < l0
+
+    def test_eval_batch(self):
+        stages = _mlp_stages()
+        opt = paddle.optimizer.SGD(learning_rate=1e-2)
+        pp = PipelineParallel(stages, lambda o, y: F.mse_loss(o, y),
+                              opt, num_micro=2)
+        x = np.random.RandomState(3).randn(4, 8).astype(np.float32)
+        out = pp.eval_batch(paddle.to_tensor(x))
+        ref = _Chain(stages)(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), rtol=1e-5)
+
+
+class TestPipelineAmp:
+    def test_scaler_skips_overflow_batch(self):
+        from paddle_tpu.amp import GradScaler
+        stages = _mlp_stages()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2)
+        pp = PipelineParallel(stages, lambda o, y: F.mse_loss(o, y),
+                              opt, num_micro=2)
+        scaler = GradScaler(init_loss_scaling=2.0 ** 8)
+        rng = np.random.RandomState(4)
+        x = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(4, 4).astype(np.float32)
+        pp.train_batch(paddle.to_tensor(x), paddle.to_tensor(y),
+                       scaler=scaler)
+        before = {id(s): jax.tree_util.tree_map(np.asarray, s.params)
+                  for s in pp.stages}
+        bad = x.copy()
+        bad[0, 0] = np.inf
+        pp.train_batch(paddle.to_tensor(bad), paddle.to_tensor(y),
+                       scaler=scaler)
+        assert scaler.get_loss_scaling() == 2.0 ** 7  # decayed
+        for s in pp.stages:  # untouched params
+            for k, v in s.params.items():
+                np.testing.assert_array_equal(before[id(s)][k],
+                                              np.asarray(v))
+        # clean batch still trains
+        l = pp.train_batch(paddle.to_tensor(x), paddle.to_tensor(y),
+                           scaler=scaler)
+        assert np.isfinite(float(l.item()))
+
+
+class TestErnieStagesMask:
+    def test_attention_mask_threads_through_stages(self):
+        from paddle_tpu.models import (ErnieConfig, ErnieModel,
+                                       ernie_pipeline_stages)
+        cfg = ErnieConfig.tiny(hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0)
+        paddle.seed(21)
+        stages = ernie_pipeline_stages(cfg, 2)
+        ids = paddle.to_tensor(
+            np.random.RandomState(5).randint(
+                0, cfg.vocab_size, (2, 8)).astype(np.int32))
+        mask_np = np.ones((2, 8), np.float32)
+        mask_np[:, 5:] = 0.0  # pad tail
+        mask = paddle.to_tensor(mask_np)
+        with paddle.no_grad():
+            h = stages[0](ids, mask)
+            assert isinstance(h, tuple) and len(h) == 2
+            out_masked = stages[1](*h)
+            out_plain = stages[1](stages[0](ids))
+        # masking pads must change the logits at unmasked positions
+        assert not np.allclose(np.asarray(out_masked[0]._data[:, 0]),
+                               np.asarray(out_plain[0]._data[:, 0]))
