@@ -1,11 +1,20 @@
 #!/usr/bin/env python
-"""Benchmark harness: ERNIE-base-class pretraining step throughput.
+"""Benchmark harness. Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "extras": {...}}
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no numbers (BASELINE.md) so vs_baseline compares
-against the target floor of 0.9x an A100-class step (proxy constant until
-a measured reference exists); value is tokens/sec/chip on the local
-device (real TPU under the driver, CPU mesh elsewhere).
+Primary metric: ERNIE/BERT-base pretraining tokens/sec/chip with MFU
+computed from first principles (model FLOPs per token / measured
+throughput / chip peak) — no self-chosen floor. vs_baseline compares
+against a published-hardware-derived figure: an A100 sustains roughly
+25k tokens/s on BERT-base-class pretraining (NVIDIA DeepLearningExamples
+BERT-base LAMB phase-1 order of magnitude); the reference repo itself
+publishes no numbers (BASELINE.md).
+
+extras carries the BASELINE.md configs 2 and 4 plus the eager-dispatch
+microbench: ResNet-50 images/sec/chip (synthetic data), a dynamic-shape
+detection-style train loop proving the bucketing policy causes no
+recompile storm (compile count == bucket count), and per-op eager
+overhead in µs (op_tester.cc analogue).
 """
 import json
 import os
@@ -16,22 +25,40 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+# bf16 peak FLOP/s per chip by TPU generation (public cloud specs);
+# override with PD_PEAK_FLOPS for unlisted hardware.
+_PEAK_BY_KIND = {
+    "TPU v2": 45e12, "TPU v3": 123e12, "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
 
-def main():
-    import jax
+
+def _chip_peak_flops(dev) -> float:
+    if os.environ.get("PD_PEAK_FLOPS"):
+        return float(os.environ["PD_PEAK_FLOPS"])
+    kind = getattr(dev, "device_kind", "") or ""
+    for k, v in _PEAK_BY_KIND.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return 275e12  # assume v4-class when unidentifiable
+
+
+def _param_count(params) -> int:
+    return int(sum(np.prod(v.shape) for v in params.values()))
+
+
+def bench_ernie(on_tpu):
     import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
     from paddle_tpu.models import ErnieConfig, ErnieForPretraining
     from paddle_tpu.static import TrainStep
 
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
-    # BERT/ERNIE-base-class config; scaled down on CPU so CI finishes
     if on_tpu:
         cfg = ErnieConfig(vocab_size=30528, hidden_size=768,
                           num_hidden_layers=12, num_attention_heads=12,
                           intermediate_size=3072,
                           max_position_embeddings=512)
-        batch, seqlen, steps = 32, 512, 12
+        batch, seqlen, steps = 48, 512, 24
     else:
         cfg = ErnieConfig(vocab_size=8192, hidden_size=256,
                           num_hidden_layers=4, num_attention_heads=8,
@@ -55,27 +82,165 @@ def main():
     x = paddle.to_tensor(ids)
     y = paddle.to_tensor(labels)
 
-    # warmup/compile
-    step(x, y)
-    l = step(x, y)
-    float(l.item())  # block
+    step(x, y)                      # compile
+    float(step(x, y).item())        # settle
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        l = step(x, y)
-    float(l.item())  # block on the last step
+        loss = step(x, y)
+    float(loss.item())
     dt = time.perf_counter() - t0
-
     tokens_per_sec = batch * seqlen * steps / dt
-    # target floor: 0.9x of an A100-class BERT-base step ≈ 9000 tok/s/chip
-    # (proxy; reference repo publishes no numbers — BASELINE.md)
-    baseline = 9000.0 if on_tpu else 1.0
+
+    # MFU from first principles. Train FLOPs/token ~= 6*N + 12*L*h*s
+    # (fwd 2N + attention 4*L*h*s for scores+values; x3 for fwd+bwd).
+    n_params = _param_count(step.params)
+    L, h, s = cfg.num_hidden_layers, cfg.hidden_size, seqlen
+    flops_per_token = 6.0 * n_params + 12.0 * L * h * s
+    import jax
+    peak = _chip_peak_flops(jax.devices()[0])
+    mfu = tokens_per_sec * flops_per_token / peak
+    return tokens_per_sec, mfu, n_params, flops_per_token
+
+
+def bench_resnet(on_tpu):
+    """BASELINE config 2: ResNet-50 images/sec/chip, synthetic data."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50, resnet18
+    from paddle_tpu.static import TrainStep
+
+    paddle.seed(0)
+    if on_tpu:
+        model, batch, size, steps = resnet50(num_classes=1000), 64, 224, 12
+    else:
+        model, batch, size, steps = resnet18(num_classes=10), 4, 32, 2
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=1e-4)
+    step = TrainStep(model,
+                     lambda out, y: F.cross_entropy(out, y), opt,
+                     amp_level="O1", amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randn(batch, 3, size, size).astype(np.float32))
+    y = paddle.to_tensor(
+        rng.randint(0, 10, (batch,)).astype(np.int32))
+    step(x, y)
+    float(step(x, y).item())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss.item())
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def bench_dynamic_shapes(on_tpu):
+    """BASELINE config 4: PP-YOLOv2-style variable input sizes through
+    the bucketing/padding policy — counts XLA compilations to prove no
+    recompile storm (done-criterion: compiles == number of buckets)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    buckets = (128, 192, 256) if on_tpu else (32, 48)
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, stride=2, padding=1), nn.ReLU(),
+        nn.Conv2D(8, 8, 3, stride=2, padding=1), nn.ReLU(),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(8, 4))
+    from paddle_tpu.jit.api import functionalize
+    pure = functionalize(net.forward, net)
+    state = {k: t._data for k, t in net.state_dict().items()}
+    key = jax.random.key(0)
+
+    def train(state, x, y):
+        def loss_fn(st):
+            out, _ = pure(st, key, x)
+            return F.cross_entropy(
+                paddle.Tensor(out), paddle.Tensor(y))._data
+        g = jax.grad(loss_fn)(state)
+        return jax.tree_util.tree_map(lambda p, gg: p - 0.01 * gg,
+                                      state, g)
+
+    jit_train = jax.jit(train)
+    rng = np.random.RandomState(0)
+    n_imgs = 24
+
+    def pad_to_bucket(img):
+        hh, ww = img.shape[1:]
+        b = next(b for b in buckets if b >= max(hh, ww))
+        out = np.zeros((3, b, b), np.float32)
+        out[:, :hh, :ww] = img
+        return out
+
+    t0 = time.perf_counter()
+    for i in range(n_imgs):
+        hw = rng.randint(buckets[0] // 2, buckets[-1], size=2)
+        img = rng.randn(3, hw[0], hw[1]).astype(np.float32)
+        x = jnp.asarray(pad_to_bucket(img)[None])
+        y = jnp.asarray([i % 4], jnp.int32)
+        state = jit_train(state, x, y)
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    dt = time.perf_counter() - t0
+    compiles = jit_train._cache_size()
+    return n_imgs / dt, int(compiles), len(buckets)
+
+
+def bench_eager_dispatch():
+    """op_tester.cc analogue: per-op eager overhead (dispatch + tape)."""
+    import paddle_tpu as paddle
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    b = paddle.to_tensor(np.ones((4, 4), np.float32))
+    (a + b)._data.block_until_ready()
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c = a + b
+    c._data.block_until_ready()
+    add_us = (time.perf_counter() - t0) / n * 1e6
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c = a @ b
+    c._data.block_until_ready()
+    mm_us = (time.perf_counter() - t0) / n * 1e6
+    return add_us, mm_us
+
+
+def main():
+    import jax
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+    tokens_per_sec, mfu, n_params, fpt = bench_ernie(on_tpu)
+    images_per_sec = bench_resnet(on_tpu)
+    dyn_ips, compiles, n_buckets = bench_dynamic_shapes(on_tpu)
+    add_us, mm_us = bench_eager_dispatch()
+
+    # A100 BERT-base-class pretraining sustains ~25k tokens/s/chip
+    # (derived from published A100 BERT results; see module docstring)
+    baseline = 25000.0 if on_tpu else 1.0
     print(json.dumps({
         "metric": "ernie_base_pretrain_tokens_per_sec_per_chip"
         if on_tpu else "ernie_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / baseline, 3),
+        "extras": {
+            "mfu": round(mfu, 4),
+            "model_params": n_params,
+            "flops_per_token": fpt,
+            "chip_peak_flops": _chip_peak_flops(jax.devices()[0]),
+            "resnet50_images_per_sec": round(images_per_sec, 2),
+            "dynamic_shape_images_per_sec": round(dyn_ips, 2),
+            "dynamic_shape_compiles": compiles,
+            "dynamic_shape_buckets": n_buckets,
+            "recompile_storm": compiles > n_buckets,
+            "eager_add_overhead_us": round(add_us, 1),
+            "eager_matmul_overhead_us": round(mm_us, 1),
+        },
     }))
 
 

@@ -29,7 +29,7 @@ AMP_WHITE_LIST: Set[str] = {
 AMP_BLACK_LIST: Set[str] = {
     "softmax_op", "log_softmax_op", "cross_entropy",
     "softmax_with_cross_entropy_op", "bce_loss", "bce_with_logits",
-    "layer_norm_op", "batch_norm_train", "batch_norm_infer", "group_norm_op",
+    "layer_norm_op", "batch_norm_op", "group_norm_op",
     "instance_norm_op", "sync_batch_norm", "reduce_sum", "reduce_mean",
     "p_norm", "logsumexp", "exp", "log", "log2", "log10", "log1p", "pow",
     "elementwise_pow", "square", "sqrt", "rsqrt", "reciprocal", "cumsum",

@@ -306,6 +306,11 @@ class PipelineParallel:
         lbl_arrays = _unwrap_tree(tuple(labels))
         M = self.num_micro
         S = len(self.stages)
+        for a in jax.tree_util.tree_leaves((in_arrays, lbl_arrays)):
+            if np.ndim(a) > 0 and a.shape[0] % M != 0:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} not divisible by "
+                    f"num_micro={M} (remainder rows would be dropped)")
         key = next_key()
 
         def micro(tree, m):
@@ -397,6 +402,11 @@ class PipelineParallel:
         from ..core.generator import next_key
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         x = _unwrap_tree(tuple(inputs))
+        for a in jax.tree_util.tree_leaves(x):
+            if np.ndim(a) > 0 and a.shape[0] % self.num_micro != 0:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} not divisible by "
+                    f"num_micro={self.num_micro}")
         key = next_key()
         outs = []
         for m in range(self.num_micro):

@@ -44,6 +44,15 @@ def _dropout_impl(x, key, p, mode):
     return jnp.where(mask, x, 0.0).astype(x.dtype)
 
 
+@register_op("dropout_eval")
+def _dropout_eval(x, p=0.5, mode="upscale_in_train"):
+    """Eval-mode dropout (what Program.clone(for_test=True) rewrites
+    dropout_op nodes into): identity, or downscale_in_infer scaling."""
+    if mode == "downscale_in_infer":
+        return x * (1.0 - p)
+    return x
+
+
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     p = float(_unwrap(p))
@@ -56,17 +65,21 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     return _dropout_impl(x, next_key(), p=p, mode=mode)
 
 
+@register_op("dropout_nd")
+def _dropout_nd(x, key, p=0.5, axes=(), mode="upscale_in_train"):
+    """Axis-structured dropout (one mask per the listed dims, broadcast
+    over the rest) — dropout_nd_op.cc analogue; registered so captured
+    programs serialize and clone(for_test) can flip it."""
+    shape = tuple(x.shape[i] if i in axes else 1 for i in range(x.ndim))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape)
+    scaled = x / keep if mode == "upscale_in_train" else x
+    return jnp.where(mask, scaled, 0.0).astype(x.dtype)
+
+
 def _dropout_axis(x, p, axis, mode):
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
-    a = _unwrap(x)
-    shape = tuple(a.shape[i] if i in axes else 1 for i in range(a.ndim))
-
-    def impl(x, key):
-        keep = 1.0 - p
-        mask = jax.random.bernoulli(key, keep, shape)
-        scaled = x / keep if mode == "upscale_in_train" else x
-        return jnp.where(mask, scaled, 0.0).astype(x.dtype)
-    return run_op("dropout_nd", impl, (x, next_key()), {})
+    return _dropout_nd(x, next_key(), p=p, axes=axes, mode=mode)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -83,20 +96,22 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return _dropout_axis(x, float(p), (0, ch_axis), "upscale_in_train")
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
-    if not training or p == 0.0:
-        return x
+@register_op("alpha_dropout")
+def _alpha_dropout_op(x, key, p=0.5):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
 
-    def impl(x, key):
-        keep = 1.0 - p
-        mask = jax.random.bernoulli(key, keep, x.shape)
-        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
-        b = -a * alpha_p * (1 - keep)
-        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
-    return run_op("alpha_dropout", impl, (x, next_key()), {})
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout_op(x, next_key(), p=float(p))
 
 
 @register_op("embedding_op")

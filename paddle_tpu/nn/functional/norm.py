@@ -21,21 +21,24 @@ def _channel_axis(ndim, data_format):
     return ndim - 1 if data_format[-1] == "C" else 1
 
 
-@register_op("batch_norm_infer")
-def _bn_infer(x, mean, var, weight, bias, epsilon, ch_axis):
-    shape = [1] * x.ndim
-    shape[ch_axis] = x.shape[ch_axis]
-    inv = jax.lax.rsqrt(var + epsilon)
-    out = (x - jnp.reshape(mean, shape)) * jnp.reshape(inv, shape)
-    if weight is not None:
-        out = out * jnp.reshape(weight, shape)
-    if bias is not None:
-        out = out + jnp.reshape(bias, shape)
-    return out
-
-
-@register_op("batch_norm_train")
-def _bn_train(x, weight, bias, epsilon, ch_axis):
+@register_op("batch_norm_op")
+def _bn_full(x, running_mean, running_var, weight, bias, training=False,
+             momentum=0.9, epsilon=1e-05, ch_axis=1):
+    """Single-node batch norm (reference batch_norm_op.cc contract):
+    returns (out, new_running_mean, new_running_var). `training` is a
+    static attribute, so Program.clone(for_test=True) flips it and the
+    cloned graph really normalizes with the running stats."""
+    if not training:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        inv = jax.lax.rsqrt(running_var + epsilon)
+        out = (x - jnp.reshape(running_mean, shape)) * jnp.reshape(inv,
+                                                                   shape)
+        if weight is not None:
+            out = out * jnp.reshape(weight, shape)
+        if bias is not None:
+            out = out + jnp.reshape(bias, shape)
+        return out, running_mean, running_var
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     mean = jnp.mean(x, axis=axes)
     var = jnp.var(x, axis=axes)
@@ -47,7 +50,9 @@ def _bn_train(x, weight, bias, epsilon, ch_axis):
         out = out * jnp.reshape(weight, shape)
     if bias is not None:
         out = out + jnp.reshape(bias, shape)
-    return out, mean, var
+    new_mean = momentum * running_mean + (1 - momentum) * mean
+    new_var = momentum * running_var + (1 - momentum) * var
+    return out, new_mean, new_var
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
@@ -59,16 +64,26 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     ch_axis = _channel_axis(_unwrap(x).ndim, data_format)
     if use_global_stats is None:
         use_global_stats = not training
-    if not training or use_global_stats:
-        return _bn_infer(x, running_mean, running_var, weight, bias,
-                         epsilon=epsilon, ch_axis=ch_axis)
-    out, mean, var = _bn_train(x, weight, bias, epsilon=epsilon,
-                               ch_axis=ch_axis)
-    if isinstance(running_mean, Tensor):
-        running_mean.set_value(momentum * running_mean._data
-                               + (1 - momentum) * mean._data)
-        running_var.set_value(momentum * running_var._data
-                              + (1 - momentum) * var._data)
+    train_mode = training and not use_global_stats
+    out, new_mean, new_var = _bn_full(
+        x, running_mean, running_var, weight, bias, training=train_mode,
+        momentum=momentum, epsilon=epsilon, ch_axis=ch_axis)
+    if train_mode and isinstance(running_mean, Tensor):
+        from ...static.program import Var as _StaticVar
+        if not isinstance(new_mean, _StaticVar):
+            # eager: write back in place
+            running_mean.set_value(new_mean)
+            running_var.set_value(new_var)
+        elif not isinstance(running_mean, _StaticVar):
+            # static capture over live buffers: register a post-run
+            # writeback so Executor keeps the running stats advancing
+            prog = new_mean.program
+            prog._buffer_writes.append(
+                (prog.capture_param(running_mean).var_id,
+                 new_mean.var_id))
+            prog._buffer_writes.append(
+                (prog.capture_param(running_var).var_id,
+                 new_var.var_id))
     return out
 
 

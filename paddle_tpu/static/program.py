@@ -31,7 +31,8 @@ from ..framework import Parameter, Tensor
 from ..ops import registry as _registry
 
 __all__ = ["Program", "program_guard", "default_main_program",
-           "default_startup_program", "data", "Executor", "append_backward"]
+           "default_startup_program", "data", "Executor",
+           "append_backward", "gradients"]
 
 _static_mode = False
 
@@ -80,6 +81,10 @@ class Program:
         self.ops: List[OpNode] = []
         self.feeds: List[int] = []
         self.params: Dict[int, Parameter] = {}  # var_id -> live Parameter
+        self.buffer_ids: set = set()  # captured stop_gradient tensors
+        # (buffer_var_id, value_var_id): after a run, write the computed
+        # value back into the live buffer (BN running stats)
+        self._buffer_writes: List[Tuple[int, int]] = []
         self._counter = 0
         self._optimize = None  # (optimizer, loss_var, grad_map)
         self.random_seed = None
@@ -107,25 +112,178 @@ class Program:
         return list(self.vars.values())
 
     def clone(self, for_test=False):
-        import copy
         p = Program()
         p.vars = dict(self.vars)
         p.var_names = dict(self.var_names)
-        p.ops = list(self.ops)
+        p.ops = [OpNode(n.op_type, n.fn, list(n.in_ids),
+                        list(n.const_args), dict(n.kwargs),
+                        list(n.out_ids), n.multi) for n in self.ops]
         p.feeds = list(self.feeds)
         p.params = dict(self.params)
+        p.buffer_ids = set(self.buffer_ids)
+        p._buffer_writes = list(self._buffer_writes)
         p._counter = self._counter
+        p.random_seed = self.random_seed
+        if for_test:
+            # flip train-mode ops (reference clone prunes/rewires the
+            # test program: dropout becomes identity/downscale,
+            # batch_norm switches to running-stat normalization)
+            for node in p.ops:
+                if node.op_type in ("dropout_op", "dropout_nd",
+                                    "alpha_dropout"):
+                    # drop the rng-key positional slot (x, key) -> (x,);
+                    # alpha_dropout's eval form is identity (p/mode
+                    # kwargs absent -> dropout_eval passes through)
+                    node.op_type = "dropout_eval"
+                    node.fn = _registry.get_op("dropout_eval").fn
+                    node.in_ids = node.in_ids[:1]
+                    node.const_args = node.const_args[:1]
+                    node.kwargs = {k: node.kwargs[k]
+                                   for k in ("p", "mode")
+                                   if k in node.kwargs}
+                elif node.op_type == "batch_norm_op":
+                    node.kwargs = dict(node.kwargs, training=False)
         return p
+
+    def prune(self, targets) -> "Program":
+        """Backward-slice the graph to the ops needed for `targets`
+        (framework/prune.cc analogue)."""
+        target_ids = set()
+        for t in targets if isinstance(targets, (list, tuple)) \
+                else [targets]:
+            target_ids.add(t.var_id if isinstance(t, Var)
+                           else self.var_by_name(t).var_id)
+        needed = set(target_ids)
+        kept: List[OpNode] = []
+        for node in reversed(self.ops):
+            if any(o in needed for o in node.out_ids):
+                kept.append(node)
+                needed.update(i for i in node.in_ids if i is not None)
+        kept.reverse()
+        p = Program()
+        p.ops = kept
+        live = set(needed) | {o for n in kept for o in n.out_ids}
+        p.vars = {vid: v for vid, v in self.vars.items() if vid in live}
+        p.var_names = {nm: vid for nm, vid in self.var_names.items()
+                       if vid in live}
+        p.feeds = [f for f in self.feeds if f in needed]
+        p.params = {vid: t for vid, t in self.params.items()
+                    if vid in needed}
+        p.buffer_ids = {b for b in self.buffer_ids if b in needed}
+        p._buffer_writes = [(b, v) for b, v in self._buffer_writes
+                            if b in needed and v in live]
+        p._counter = self._counter
+        p.random_seed = self.random_seed
+        return p
+
+    # -- serialization (framework.proto ProgramDesc analogue) ----------------
+    def to_bytes(self, include_params: bool = True) -> bytes:
+        """Serialize: ops as registry names + attrs, vars as metadata,
+        params (optionally) as values. Round-trips through from_bytes."""
+        import pickle
+
+        def enc(v):
+            if isinstance(v, jax.Array):
+                if jnp.issubdtype(v.dtype, jax.dtypes.prng_key):
+                    # rng-key consts (dropout keys): store the raw bits
+                    return ("__key__", np.asarray(jax.random.key_data(v)))
+                return ("__arr__", np.asarray(v))
+            return v
+        ops = []
+        for n in self.ops:
+            if n.op_type not in _registry.OPS or \
+                    _registry.OPS[n.op_type].fn is not n.fn:
+                raise EnforceNotMet(
+                    f"op '{n.op_type}' is not a registered op; programs "
+                    "with ad-hoc functions cannot be serialized",
+                    op_type=n.op_type)
+            ops.append((n.op_type, list(n.in_ids),
+                        [enc(c) for c in n.const_args],
+                        {k: enc(v) for k, v in n.kwargs.items()},
+                        list(n.out_ids), n.multi))
+        vars_meta = {
+            vid: (v.name, tuple(v._data.shape), str(v._data.dtype),
+                  v.kind)
+            for vid, v in self.vars.items()}
+        params = {
+            vid: (t.name, np.asarray(t._data) if include_params else None,
+                  str(t._data.dtype))
+            for vid, t in self.params.items()}
+        return pickle.dumps({
+            "version": 1, "vars": vars_meta, "ops": ops,
+            "feeds": list(self.feeds), "params": params,
+            "buffer_ids": sorted(self.buffer_ids),
+            "buffer_writes": list(self._buffer_writes),
+            "counter": self._counter, "random_seed": self.random_seed,
+        }, protocol=4)
+
+    @staticmethod
+    def from_bytes(blob: bytes) -> "Program":
+        import pickle
+        d = pickle.loads(blob)
+
+        def dec(v):
+            if isinstance(v, tuple) and len(v) == 2:
+                if v[0] == "__arr__":
+                    return jnp.asarray(v[1])
+                if v[0] == "__key__":
+                    return jax.random.wrap_key_data(jnp.asarray(v[1]))
+            return v
+        p = Program()
+        for vid, (name, shape, dtype, kind) in sorted(d["vars"].items()):
+            v = Var.__new__(Var)
+            Tensor.__init__(v, jnp.zeros(shape, dtype), stop_gradient=True)
+            v.program = p
+            v.name = name
+            v.kind = kind
+            v.var_id = vid
+            p.vars[vid] = v
+            if name:
+                p.var_names[name] = vid
+        for op_type, in_ids, const_args, kwargs, out_ids, multi in \
+                d["ops"]:
+            fn = _registry.get_op(op_type).fn
+            p.ops.append(OpNode(op_type, fn, in_ids,
+                                [dec(c) for c in const_args],
+                                {k: dec(v) for k, v in kwargs.items()},
+                                out_ids, multi))
+        p.feeds = list(d["feeds"])
+        p.buffer_ids = set(d.get("buffer_ids", ()))
+        p._buffer_writes = [tuple(x) for x in d.get("buffer_writes", ())]
+        for vid, (name, value, dtype) in d["params"].items():
+            arr = jnp.asarray(value) if value is not None else \
+                jnp.zeros(p.vars[vid]._data.shape, dtype)
+            t = Parameter(arr)
+            t.name = name
+            t.stop_gradient = vid in p.buffer_ids
+            p.params[vid] = t
+        p._counter = d["counter"]
+        p.random_seed = d.get("random_seed")
+        return p
+
+    def save(self, path: str, include_params: bool = True):
+        with open(path, "wb") as f:
+            f.write(self.to_bytes(include_params))
+
+    @staticmethod
+    def load(path: str) -> "Program":
+        with open(path, "rb") as f:
+            return Program.from_bytes(f.read())
 
     # -- capture ------------------------------------------------------------
     def capture_param(self, t: Tensor) -> Var:
-        """Register a live Parameter/Tensor used by the program."""
+        """Register a live Parameter/Tensor used by the program.
+        stop_gradient captures (BN running stats and other buffers) are
+        tracked in buffer_ids: no grads, no optimizer updates."""
         for vid, p in self.params.items():
             if p is t:
                 return self.vars[vid]
         name = t.name or f"param_{len(self.params)}"
-        v = Var(self, name, t._data.shape, t._data.dtype, kind="param")
+        kind = "buffer" if t.stop_gradient else "param"
+        v = Var(self, name, t._data.shape, t._data.dtype, kind=kind)
         self.params[v.var_id] = t
+        if t.stop_gradient:
+            self.buffer_ids.add(v.var_id)
         return v
 
     def add_op(self, op_type, fn, args, kwargs):
@@ -179,9 +337,18 @@ class Program:
         """pure(feed_arrays, param_arrays, key) -> (fetches, grads?)"""
         feeds = list(self.feeds)
         param_ids = list(self.params.keys())
+        # grads/updates apply only to trainable captures, never buffers
+        train_pos = [k for k, vid in enumerate(param_ids)
+                     if vid not in self.buffer_ids]
         ops = list(self.ops)
+        fetch_set = set(fetch_ids)
+        # lazily compute var grads: only specs whose @GRAD vars are
+        # actually fetched cost a differentiated replay
+        var_grad_specs = [
+            s for s in getattr(self, "_var_grads", [])
+            if any(g in fetch_set for g in s["grad_vars"])]
 
-        def replay(env):
+        def replay(env, override=None):
             for node in ops:
                 ins = [env[i] if i is not None else c
                        for i, c in zip(node.in_ids, node.const_args)]
@@ -189,7 +356,38 @@ class Program:
                 res = tuple(res) if isinstance(res, (list, tuple)) else \
                     (res,)
                 for vid, r in zip(node.out_ids, res):
-                    env[vid] = r
+                    # `override` cuts the graph at chosen vars (static
+                    # gradients() wrt intermediates)
+                    env[vid] = override[vid] if override and \
+                        vid in override else r
+            return env
+
+        def apply_var_grads(env, feed_arrays, param_arrays):
+            for spec in var_grad_specs:
+                in_ids_ = spec["inputs"]
+                xs = [env[i] for i in in_ids_]
+
+                def h(xvals):
+                    e = {}
+                    for vid, a in zip(feeds, feed_arrays):
+                        e[vid] = a
+                    for vid, a in zip(param_ids, param_arrays):
+                        e[vid] = a
+                    ov = dict(zip(in_ids_, xvals))
+                    e.update(ov)
+                    e = replay(e, override=ov)
+                    total = jnp.zeros((), jnp.float32)
+                    for tid, tg in zip(spec["targets"], spec["tgrads"]):
+                        tval = e[tid].astype(jnp.float32)
+                        if tg is None:
+                            total = total + tval.sum()
+                        else:
+                            total = total + (tval
+                                             * jnp.asarray(tg)).sum()
+                    return total
+                gs = jax.grad(h)(xs)
+                for gid, g in zip(spec["grad_vars"], gs):
+                    env[gid] = g
             return env
 
         def pure(feed_arrays, param_arrays, key):
@@ -200,26 +398,30 @@ class Program:
                 for vid, a in zip(param_ids, param_arrays):
                     env[vid] = a
                 if grad_of:
-                    def loss_fn(p_arrays):
+                    def loss_fn(t_arrays):
                         e = dict(env)
-                        for vid, a in zip(param_ids, p_arrays):
-                            e[vid] = a
+                        for pos, a in zip(train_pos, t_arrays):
+                            e[param_ids[pos]] = a
                         e = replay(e)
                         return e[grad_of[0]].astype(jnp.float32).sum(), e
                     (loss, env), grads = jax.value_and_grad(
-                        loss_fn, has_aux=True)(list(param_arrays))
+                        loss_fn, has_aux=True)(
+                        [param_arrays[k] for k in train_pos])
                     # expose PARAM@GRAD vars for fetching
                     pairs = getattr(self, "_grad_pairs", None)
                     if pairs:
                         gmap = {pv.var_id: gv.var_id for pv, gv in pairs}
-                        for vid, g in zip(param_ids, grads):
+                        for pos, g in zip(train_pos, grads):
+                            vid = param_ids[pos]
                             if vid in gmap:
                                 env[gmap[vid]] = g
+                    env = apply_var_grads(env, feed_arrays, param_arrays)
                     fetches = [env.get(i) for i in fetch_ids]
                     return fetches, grads
                 env = replay(env)
+                env = apply_var_grads(env, feed_arrays, param_arrays)
                 return [env.get(i) for i in fetch_ids], None
-        return pure, param_ids
+        return pure, param_ids, train_pos
 
 
 _default_main = Program()
@@ -277,14 +479,78 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     over the replayed program (backward.py:1337 analogue — the grad-op
     chain is jax's, not hand-appended)."""
     prog = loss.program if isinstance(loss, Var) else default_main_program()
+    if int(np.prod(loss._data.shape)) != 1:
+        raise EnforceNotMet(
+            f"append_backward loss must be a scalar, got shape "
+            f"{tuple(loss._data.shape)} (reference backward.py enforces "
+            "loss.shape == [1])", op_type="append_backward")
     prog._grad_target = loss.var_id
+
+    def resolve_name(item):
+        if isinstance(item, str):
+            return item
+        nm = getattr(item, "name", None)
+        if nm:
+            return nm
+        for vid, p in prog.params.items():  # unnamed Parameter: identity
+            if p is item:
+                return prog.vars[vid].name
+        return None
+    skip = {resolve_name(i) for i in (no_grad_set or ())} - {None}
+    keep_names = None
+    if parameter_list is not None:
+        keep_names = {resolve_name(p) for p in parameter_list} - {None}
     pairs = []
     for vid, p in prog.params.items():
-        gv = Var(prog, f"{prog.vars[vid].name}@GRAD", p._data.shape,
+        if vid in prog.buffer_ids:
+            continue
+        name = prog.vars[vid].name
+        if name in skip or (keep_names is not None
+                            and name not in keep_names):
+            continue
+        gv = Var(prog, f"{name}@GRAD", p._data.shape,
                  p._data.dtype, kind="grad")
         pairs.append((prog.vars[vid], gv))
     prog._grad_pairs = pairs
     return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static d(targets)/d(inputs) for ARBITRARY program vars
+    (backward.py:1932 `paddle.static.gradients` analogue).
+
+    Returns grad Vars (name `<input>@GRAD`) fetchable through
+    Executor.run. inputs may be feeds, params, or intermediates — for an
+    intermediate the graph is cut at that var (its upstream is treated
+    as constant), matching the reference's grad semantics.
+    """
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = targets[0].program
+    skip = {item if isinstance(item, str) else item.name
+            for item in (no_grad_set or ())}
+    inputs = [v for v in inputs if v.name not in skip]
+    if target_gradients is not None:
+        tg = target_gradients if isinstance(target_gradients,
+                                            (list, tuple)) \
+            else [target_gradients]
+    else:
+        tg = [None] * len(targets)
+    grad_vars = []
+    for v in inputs:
+        gv = Var(prog, f"{v.name}@GRAD", v._data.shape, v._data.dtype,
+                 kind="grad")
+        grad_vars.append(gv)
+    spec = {
+        "targets": [t.var_id for t in targets],
+        "inputs": [v.var_id for v in inputs],
+        "grad_vars": [g.var_id for g in grad_vars],
+        "tgrads": [None if g is None else np.asarray(
+            g._data if isinstance(g, Tensor) else g) for g in tg],
+    }
+    prog._var_grads = getattr(prog, "_var_grads", [])
+    prog._var_grads.append(spec)
+    return grad_vars
 
 
 class Executor:
@@ -319,24 +585,35 @@ class Executor:
         if train:
             grad_ids = [prog._optimize[1].var_id]
 
+        # BN running stats etc.: fetch the updated values and write them
+        # back into the live buffers after the run
+        buffer_writes = list(getattr(prog, "_buffer_writes", ()))
+        fetch_ids_full = list(fetch_ids) + [v for _, v in buffer_writes]
+
         sig = (id(prog), len(prog.ops), tuple(sorted(feed)), train,
-               tuple(fetch_ids),
+               tuple(fetch_ids_full),
                tuple((k, np.asarray(v).shape) for k, v in sorted(
                    feed.items())))
         entry = self._cache.get(sig)
         if entry is None:
-            pure, param_ids = prog.build_callable(fetch_ids, grad_ids)
+            pure, param_ids, train_pos = prog.build_callable(
+                fetch_ids_full, grad_ids)
             if train:
                 optimizer = prog._optimize[0]
 
-                def train_fn(feed_arrays, param_arrays, opt_state, lr, key):
+                def train_fn(feed_arrays, param_arrays, opt_state, lr,
+                             key):
                     fetches, grads = pure(feed_arrays, param_arrays, key)
-                    params_t, opt_t = optimizer.apply_gradients_tree(
-                        list(param_arrays), list(grads), opt_state, lr=lr)
-                    return fetches, params_t, opt_t
+                    t_arrays = [param_arrays[k] for k in train_pos]
+                    new_t, opt_t = optimizer.apply_gradients_tree(
+                        t_arrays, list(grads), opt_state, lr=lr)
+                    new_params = list(param_arrays)
+                    for k, a in zip(train_pos, new_t):
+                        new_params[k] = a
+                    return fetches, new_params, opt_t
                 jitted = jax.jit(train_fn, donate_argnums=(1, 2))
-                opt_state = [prog._optimize[0].init_state(
-                    prog.params[i]._data) for i in param_ids]
+                opt_state = prog._optimize[0].init_state_tree(
+                    [prog.params[param_ids[k]]._data for k in train_pos])
                 entry = ("train", jitted, param_ids, opt_state)
             else:
                 jitted = jax.jit(pure)
@@ -362,6 +639,11 @@ class Executor:
             self._cache[sig] = (kind, jitted, param_ids, new_opt)
         else:
             fetches, _ = jitted(feed_arrays, param_arrays, key)
+        n_user = len(fetch_ids)
+        for (bvid, _), val in zip(buffer_writes, fetches[n_user:]):
+            if val is not None:
+                prog.params[bvid]._data = jnp.asarray(val)
+        fetches = fetches[:n_user]
         if return_numpy:
             return [np.asarray(f) if f is not None else None
                     for f in fetches]

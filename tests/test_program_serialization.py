@@ -1,0 +1,192 @@
+"""Program serialization / prune / clone(for_test) / gradients() tests
+(reference framework.proto ProgramDesc round-trip, framework/prune.cc,
+backward.py:1932 paddle.static.gradients)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.static import (Executor, Program, append_backward, data,
+                               gradients, program_guard)
+
+
+def _build_mlp_program():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = data("x", [None, 4], "float32")
+        paddle.seed(3)
+        fc1 = nn.Linear(4, 8)
+        fc2 = nn.Linear(8, 2)
+        h = F.relu(fc1(x))
+        out = fc2(h)
+        loss = out.mean()
+    return main, x, h, out, loss, (fc1, fc2)
+
+
+class TestSerialization:
+    def test_save_load_run_equivalence(self, tmp_path):
+        main, x, h, out, loss, _ = _build_mlp_program()
+        exe = Executor()
+        feed = {"x": np.random.RandomState(0).randn(3, 4).astype(
+            np.float32)}
+        ref = exe.run(main, feed=feed, fetch_list=[out])[0]
+
+        path = str(tmp_path / "prog.pdmodel")
+        main.save(path)
+        loaded = Program.load(path)
+        got = Executor().run(loaded, feed=feed,
+                             fetch_list=[loaded.var_by_name(out.name)])[0]
+        np.testing.assert_allclose(ref, got, rtol=1e-6)
+
+    def test_roundtrip_without_params(self):
+        main, x, h, out, loss, (fc1, fc2) = _build_mlp_program()
+        blob = main.to_bytes(include_params=False)
+        loaded = Program.from_bytes(blob)
+        # params are zero-initialized placeholders awaiting a load
+        for t in loaded.params.values():
+            assert float(np.abs(np.asarray(t._data)).sum()) == 0.0
+        assert len(loaded.ops) == len(main.ops)
+
+    def test_unregistered_op_rejected(self):
+        from paddle_tpu.core.enforce import EnforceNotMet
+        from paddle_tpu.ops.registry import op_wrapper
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [2], "float32")
+            f = op_wrapper(lambda a: a * 2, name="adhoc_double")
+            y = f(x)
+        with pytest.raises(EnforceNotMet):
+            main.to_bytes()
+
+
+class TestPrune:
+    def test_prune_drops_dead_branch(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [4], "float32")
+            kept = x * 2.0
+            dead = (x + 1.0).sum()  # not needed for `kept`
+        n_all = len(main.ops)
+        pruned = main.prune(kept)
+        assert len(pruned.ops) < n_all
+        feed = {"x": np.arange(4, dtype=np.float32)}
+        got = Executor().run(pruned, feed=feed, fetch_list=[
+            pruned.vars[kept.var_id]])[0]
+        np.testing.assert_allclose(got, np.arange(4) * 2.0)
+
+
+class TestCloneForTest:
+    def test_dropout_flips_to_identity(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [32, 16], "float32")
+            y = F.dropout(x, p=0.5, training=True)
+        test_prog = main.clone(for_test=True)
+        feed = {"x": np.ones((32, 16), np.float32)}
+        train_out = Executor().run(main, feed=feed, fetch_list=[
+            main.vars[y.var_id]])[0]
+        eval_out = Executor().run(test_prog, feed=feed, fetch_list=[
+            test_prog.vars[y.var_id]])[0]
+        assert (train_out == 0).any()          # train: dropped entries
+        np.testing.assert_allclose(eval_out, 1.0)  # eval: identity
+
+    def test_batchnorm_uses_running_stats_in_eval_clone(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [8, 4], "float32")
+            paddle.seed(0)
+            bn = nn.BatchNorm1D(4)
+            # make running stats distinctive
+            bn._mean.set_value(np.full(4, 5.0, np.float32))
+            bn._variance.set_value(np.full(4, 4.0, np.float32))
+            y = bn(x)
+        test_prog = main.clone(for_test=True)
+        feed = {"x": np.random.RandomState(1).randn(8, 4).astype(
+            np.float32) * 10 + 5}
+        # eval BEFORE any train run: stats still (5, 4)
+        eval_out = Executor().run(test_prog, feed=feed, fetch_list=[
+            test_prog.vars[y.var_id]])[0]
+        expected = (feed["x"] - 5.0) / np.sqrt(4.0 + 1e-5)
+        np.testing.assert_allclose(eval_out, expected, rtol=1e-4,
+                                   atol=1e-4)
+        train_out = Executor().run(main, feed=feed, fetch_list=[
+            main.vars[y.var_id]])[0]
+        # train normalizes with batch stats (≈0 mean), differing from
+        # the running-stat eval output
+        assert abs(train_out.mean()) < 0.1
+        assert not np.allclose(train_out, eval_out, atol=0.1)
+        # ...and the train run advanced the shared running stats
+        # (momentum writeback through the Executor)
+        mean_after = np.asarray(bn._mean._data)
+        assert not np.allclose(mean_after, 5.0), mean_after
+        eval2 = Executor().run(test_prog, feed=feed, fetch_list=[
+            test_prog.vars[y.var_id]])[0]
+        assert not np.allclose(eval2, eval_out, atol=1e-3)
+
+
+class TestGradients:
+    def test_gradients_wrt_feed(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [3], "float32")
+            y = (x * x).sum()
+            (gx,) = gradients(y, x)
+        feed = {"x": np.array([1.0, 2.0, 3.0], np.float32)}
+        got = Executor().run(main, feed=feed, fetch_list=[gx])[0]
+        np.testing.assert_allclose(got, [2.0, 4.0, 6.0])
+
+    def test_gradients_wrt_intermediate_cuts_graph(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [3], "float32")
+            h = x * 3.0          # intermediate
+            y = (h * h).sum()
+            (gh,) = gradients(y, h)
+        feed = {"x": np.array([1.0, 2.0, 3.0], np.float32)}
+        got = Executor().run(main, feed=feed, fetch_list=[gh])[0]
+        # d(h^2)/dh = 2h = 6x — NOT d/dx (which would be 18x)
+        np.testing.assert_allclose(got, [6.0, 12.0, 18.0])
+
+    def test_gradients_with_target_gradients(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [2], "float32")
+            y = x * 2.0
+            (gx,) = gradients(y, x, target_gradients=np.array(
+                [10.0, 1.0], np.float32))
+        feed = {"x": np.zeros(2, np.float32)}
+        got = Executor().run(main, feed=feed, fetch_list=[gx])[0]
+        np.testing.assert_allclose(got, [20.0, 2.0])
+
+    def test_gradients_no_grad_set(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [2], "float32")
+            z = data("z", [2], "float32")
+            y = (x * z).sum()
+            gs = gradients(y, [x, z], no_grad_set=["z"])
+        assert len(gs) == 1 and gs[0].name == "x@GRAD"
+
+    def test_append_backward_rejects_nonscalar(self):
+        from paddle_tpu.core.enforce import EnforceNotMet
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [3], "float32")
+            y = x * 2.0
+            with pytest.raises(EnforceNotMet):
+                append_backward(y)
+
+    def test_append_backward_no_grad_set(self):
+        main, x, h, out, loss, (fc1, fc2) = _build_mlp_program()
+        with program_guard(main, Program()):
+            pairs = append_backward(loss, no_grad_set=[fc1.bias])
+        # the bias param's captured var must be excluded
+        bias_var = next(main.vars[vid].name
+                        for vid, p in main.params.items()
+                        if p is fc1.bias)
+        names = [p.name for p, g in pairs]
+        assert bias_var not in names
+        assert len(names) == 3  # 4 params minus the bias
