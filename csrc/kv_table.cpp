@@ -1,0 +1,269 @@
+// Host-side sparse embedding KV table for paddle_tpu.
+//
+// TPU-native equivalent of the reference's parameter-server embedding
+// storage (/root/reference/paddle/fluid/framework/fleet/heter_ps/
+// hashtable.h GPU hashtable, paddle/fluid/distributed/table/ dense/sparse
+// tables, operators/distributed/large_scale_kv.h): a sharded, lock-striped
+// hashtable keyed by int64 feature id holding one embedding row plus
+// per-row optimizer state. The TPU chip never sees the full [vocab, dim]
+// table — the train step pulls only the rows touched by a batch (dense
+// minibatch block), and pushes their gradients back; the optimizer update
+// for sparse rows runs here on the host (reference CommonAccessor
+// sgd/adagrad on the PS server), keeping HBM free for the dense model.
+//
+// Rows are lazily initialized on first pull with a per-key deterministic
+// uniform(-init_range, init_range) (splitmix64 of key ^ seed), so every
+// process that pulls the same key sees the same init without coordination.
+//
+// C ABI (ctypes-friendly), no exceptions across the boundary.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumShards = 64;
+
+struct Row {
+  std::vector<float> w;      // [dim]
+  std::vector<float> accum;  // adagrad state, lazily sized
+};
+
+struct Table {
+  int dim = 0;
+  int optimizer = 0;  // 0 = sgd, 1 = adagrad
+  float lr = 0.01f;
+  float init_range = 0.01f;
+  uint64_t seed = 0;
+  std::unordered_map<int64_t, Row> shards[kNumShards];
+  std::mutex locks[kNumShards];
+};
+
+std::mutex g_tables_mu;
+std::vector<Table*> g_tables;
+
+inline int shard_of(int64_t key) {
+  return static_cast<int>((static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ULL)
+                          >> 58) & (kNumShards - 1);
+}
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+void init_row(const Table* t, int64_t key, std::vector<float>* w) {
+  w->resize(t->dim);
+  uint64_t s = splitmix64(static_cast<uint64_t>(key) ^ t->seed);
+  for (int i = 0; i < t->dim; ++i) {
+    s = splitmix64(s);
+    // 24-bit mantissa uniform in [0,1)
+    float u = static_cast<float>((s >> 40) & 0xFFFFFF) / 16777216.0f;
+    (*w)[i] = (2.0f * u - 1.0f) * t->init_range;
+  }
+}
+
+Table* get_table(int h) {
+  std::lock_guard<std::mutex> g(g_tables_mu);
+  if (h < 0 || h >= static_cast<int>(g_tables.size())) return nullptr;
+  return g_tables[h];
+}
+
+}  // namespace
+
+extern "C" {
+
+// optimizer: 0=sgd, 1=adagrad. Returns handle >= 0 or -1.
+int pd_kv_open(int dim, int optimizer, float lr, float init_range,
+               uint64_t seed) {
+  if (dim <= 0) return -1;
+  Table* t = new Table();
+  t->dim = dim;
+  t->optimizer = optimizer;
+  t->lr = lr;
+  t->init_range = init_range;
+  t->seed = seed;
+  std::lock_guard<std::mutex> g(g_tables_mu);
+  g_tables.push_back(t);
+  return static_cast<int>(g_tables.size()) - 1;
+}
+
+// Pull n rows into out [n*dim]; missing keys are deterministically
+// initialized (and inserted). Returns 0 on success.
+int pd_kv_pull(int h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = ids[i];
+    int s = shard_of(key);
+    std::lock_guard<std::mutex> g(t->locks[s]);
+    Row& r = t->shards[s][key];
+    if (r.w.empty()) init_row(t, key, &r.w);
+    std::memcpy(out + i * t->dim, r.w.data(), t->dim * sizeof(float));
+  }
+  return 0;
+}
+
+// Push n gradient rows [n*dim]; applies the table's optimizer per row.
+// Duplicate ids in one push are applied sequentially (scatter-add
+// semantics for sgd). Returns 0 on success.
+int pd_kv_push(int h, const int64_t* ids, int64_t n, const float* grads) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  const float eps = 1e-6f;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = ids[i];
+    int s = shard_of(key);
+    std::lock_guard<std::mutex> g(t->locks[s]);
+    Row& r = t->shards[s][key];
+    if (r.w.empty()) init_row(t, key, &r.w);
+    const float* gr = grads + i * t->dim;
+    if (t->optimizer == 1) {
+      if (r.accum.empty()) r.accum.assign(t->dim, 0.0f);
+      for (int d = 0; d < t->dim; ++d) {
+        r.accum[d] += gr[d] * gr[d];
+        r.w[d] -= t->lr * gr[d] / (std::sqrt(r.accum[d]) + eps);
+      }
+    } else {
+      for (int d = 0; d < t->dim; ++d) r.w[d] -= t->lr * gr[d];
+    }
+  }
+  return 0;
+}
+
+int64_t pd_kv_size(int h) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  int64_t total = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> g(t->locks[s]);
+    total += static_cast<int64_t>(t->shards[s].size());
+  }
+  return total;
+}
+
+// Binary snapshot: [dim:i32][opt:i32][lr:f32][range:f32][seed:u64]
+// then per row: [key:i64][w:dim*f32][has_accum:i32][accum?:dim*f32].
+int pd_kv_save(int h, const char* path) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -2;
+  std::fwrite(&t->dim, 4, 1, f);
+  std::fwrite(&t->optimizer, 4, 1, f);
+  std::fwrite(&t->lr, 4, 1, f);
+  std::fwrite(&t->init_range, 4, 1, f);
+  std::fwrite(&t->seed, 8, 1, f);
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> g(t->locks[s]);
+    for (auto& kv : t->shards[s]) {
+      std::fwrite(&kv.first, 8, 1, f);
+      std::fwrite(kv.second.w.data(), 4, t->dim, f);
+      int has = kv.second.accum.empty() ? 0 : 1;
+      std::fwrite(&has, 4, 1, f);
+      if (has) std::fwrite(kv.second.accum.data(), 4, t->dim, f);
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// Parses the whole snapshot into a staging buffer first; the table is
+// only mutated after a fully consistent parse (a truncated/corrupt file
+// returns an error and leaves the table untouched).
+int pd_kv_load(int h, const char* path) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -2;
+  int dim = 0, optimizer = 0;
+  float lr = 0, init_range = 0;
+  uint64_t seed = 0;
+  if (std::fread(&dim, 4, 1, f) != 1 || dim != t->dim ||
+      std::fread(&optimizer, 4, 1, f) != 1 ||
+      std::fread(&lr, 4, 1, f) != 1 ||
+      std::fread(&init_range, 4, 1, f) != 1 ||
+      std::fread(&seed, 8, 1, f) != 1) {
+    std::fclose(f);
+    return -3;  // bad/truncated header: table untouched
+  }
+  std::vector<std::pair<int64_t, Row>> staged;
+  int64_t key;
+  bool truncated = false;
+  for (;;) {
+    size_t got = std::fread(&key, 8, 1, f);
+    if (got == 0) break;  // clean EOF at a record boundary
+    Row r;
+    r.w.resize(dim);
+    if (std::fread(r.w.data(), 4, dim, f) != static_cast<size_t>(dim)) {
+      truncated = true;
+      break;
+    }
+    int has = 0;
+    if (std::fread(&has, 4, 1, f) != 1) {
+      truncated = true;
+      break;
+    }
+    if (has) {
+      r.accum.resize(dim);
+      if (std::fread(r.accum.data(), 4, dim, f) !=
+          static_cast<size_t>(dim)) {
+        truncated = true;
+        break;
+      }
+    }
+    staged.emplace_back(key, std::move(r));
+  }
+  std::fclose(f);
+  if (truncated) return -4;  // partial record: table untouched
+  t->optimizer = optimizer;
+  t->lr = lr;
+  t->init_range = init_range;
+  t->seed = seed;
+  for (auto& kv : staged) {
+    int s = shard_of(kv.first);
+    std::lock_guard<std::mutex> g(t->locks[s]);
+    t->shards[s][kv.first] = std::move(kv.second);
+  }
+  return 0;
+}
+
+// Drop rows whose max |w| is below threshold (reference table shrink).
+int64_t pd_kv_shrink(int h, float threshold) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  int64_t dropped = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> g(t->locks[s]);
+    for (auto it = t->shards[s].begin(); it != t->shards[s].end();) {
+      float mx = 0.0f;
+      for (float v : it->second.w) mx = std::fmax(mx, std::fabs(v));
+      if (mx < threshold) {
+        it = t->shards[s].erase(it);
+        ++dropped;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return dropped;
+}
+
+int pd_kv_close(int h) {
+  std::lock_guard<std::mutex> g(g_tables_mu);
+  if (h < 0 || h >= static_cast<int>(g_tables.size()) || !g_tables[h])
+    return -1;
+  delete g_tables[h];
+  g_tables[h] = nullptr;
+  return 0;
+}
+
+}  // extern "C"

@@ -17,6 +17,9 @@ from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
 from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                               VocabParallelEmbedding, split)  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, gpipe_schedule  # noqa: F401
+from .embedding_kv import (EmbeddingKV, SparseEmbedding,  # noqa: F401
+                           distributed_lookup_table, pull_sparse,
+                           push_sparse)
 from .pipeline_engine import (PipelineParallel, build_1f1b_schedule,  # noqa: F401
                               stage_submeshes)
 from .recompute import recompute, recompute_sequential  # noqa: F401

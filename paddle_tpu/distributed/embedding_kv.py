@@ -1,0 +1,353 @@
+"""Host-side embedding KV: the parameter-server capability, TPU-style.
+
+Reference capability being covered (SURVEY §2.5 PS rows):
+  - paddle/fluid/distributed/table/ (BRPC PS dense/sparse tables)
+  - framework/fleet/heter_ps/hashtable.h (GPU-PS HBM hashtable)
+  - operators/distributed/large_scale_kv.h, distributed_lookup_table_op,
+    pull_sparse / push_sparse ops (pscore).
+
+TPU design: there is no RPC parameter server. Huge embedding tables live
+in *host* memory in a sharded C++ hashtable (csrc/kv_table.cpp); each
+train step pulls only the rows a batch touches (a dense [n_unique, dim]
+block fed to the compiled TPU step), and pushes their gradients back —
+the sparse optimizer update (sgd/adagrad) runs host-side like the
+reference's CommonAccessor on the PS server. Multi-host: each process
+owns the keys it feeds (data-parallel input sharding ⇒ disjoint-enough
+key sets); for shared keys the reference's async-PS semantics (last
+writer wins within a step) apply.
+
+The pure-Python dict fallback keeps identical semantics (and the same
+deterministic per-key init) when the C++ toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..framework import Tensor
+
+__all__ = ["EmbeddingKV", "SparseEmbedding", "pull_sparse", "push_sparse",
+           "distributed_lookup_table"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libpaddletpu_kv.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _kv_lib():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(_CSRC, "kv_table.cpp")
+        if os.path.exists(src) and (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(src)):
+            subprocess.run(["make", "-C", _CSRC, "libpaddletpu_kv.so"],
+                           capture_output=True, text=True)
+        if not os.path.exists(_SO):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        i32, i64, f32 = ctypes.c_int, ctypes.c_int64, ctypes.c_float
+        u64, cp = ctypes.c_uint64, ctypes.c_char_p
+        pi64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        pf32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.pd_kv_open.argtypes = [i32, i32, f32, f32, u64]
+        lib.pd_kv_open.restype = i32
+        lib.pd_kv_pull.argtypes = [i32, pi64, i64, pf32]
+        lib.pd_kv_pull.restype = i32
+        lib.pd_kv_push.argtypes = [i32, pi64, i64, pf32]
+        lib.pd_kv_push.restype = i32
+        lib.pd_kv_size.argtypes = [i32]
+        lib.pd_kv_size.restype = i64
+        lib.pd_kv_save.argtypes = [i32, cp]
+        lib.pd_kv_save.restype = i32
+        lib.pd_kv_load.argtypes = [i32, cp]
+        lib.pd_kv_load.restype = i32
+        lib.pd_kv_shrink.argtypes = [i32, f32]
+        lib.pd_kv_shrink.restype = i64
+        lib.pd_kv_close.argtypes = [i32]
+        _lib = lib
+    return _lib
+
+
+def _splitmix64(x):
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+class _PyTable:
+    """Pure-Python fallback with semantics identical to kv_table.cpp."""
+
+    def __init__(self, dim, optimizer, lr, init_range, seed):
+        self.dim, self.optimizer = dim, optimizer
+        self.lr, self.init_range, self.seed = lr, init_range, seed
+        self.rows = {}
+        self.accum = {}
+
+    def _init_row(self, key):
+        s = _splitmix64((key ^ self.seed) & 0xFFFFFFFFFFFFFFFF)
+        out = np.empty(self.dim, np.float32)
+        for i in range(self.dim):
+            s = _splitmix64(s)
+            u = ((s >> 40) & 0xFFFFFF) / 16777216.0
+            out[i] = (2.0 * u - 1.0) * self.init_range
+        return out
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, k in enumerate(ids):
+            k = int(k)
+            if k not in self.rows:
+                self.rows[k] = self._init_row(k)
+            out[i] = self.rows[k]
+        return out
+
+    def push(self, ids, grads):
+        eps = 1e-6
+        for i, k in enumerate(ids):
+            k = int(k)
+            if k not in self.rows:
+                self.rows[k] = self._init_row(k)
+            g = grads[i]
+            if self.optimizer == 1:
+                a = self.accum.setdefault(k, np.zeros(self.dim, np.float32))
+                a += g * g
+                self.rows[k] -= self.lr * g / (np.sqrt(a) + eps)
+            else:
+                self.rows[k] -= self.lr * g
+
+
+_OPTIMIZERS = {"sgd": 0, "adagrad": 1}
+
+
+class EmbeddingKV:
+    """Sharded host-memory embedding table with sparse pull/push.
+
+    The dense TPU step never materializes [vocab, dim]; it sees only the
+    pulled [n_unique, dim] block per batch. SelectedRows (the row-sparse
+    grad form, core/selected_rows.py) is the push currency.
+    """
+
+    def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.01,
+                 init_range: float = 0.01, seed: int = 0):
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        lib = _kv_lib()
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.pd_kv_open(self.dim, _OPTIMIZERS[optimizer],
+                                     float(lr), float(init_range),
+                                     int(seed))
+            self._py = None
+        else:
+            self._h = -1
+            self._py = _PyTable(self.dim, _OPTIMIZERS[optimizer], lr,
+                                init_range, seed)
+
+    @property
+    def native(self) -> bool:
+        return self._py is None
+
+    def pull(self, ids) -> np.ndarray:
+        """ids [n] int64 -> rows [n, dim] float32 (missing keys get the
+        deterministic per-key init)."""
+        ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
+        if self._py is not None:
+            return self._py.pull(ids)
+        out = np.empty((ids.shape[0], self.dim), np.float32)
+        rc = self._lib.pd_kv_pull(self._h, ids, ids.shape[0], out)
+        if rc != 0:
+            raise RuntimeError(f"pd_kv_pull failed: {rc}")
+        return out
+
+    def push(self, ids, grads) -> None:
+        """Apply sparse optimizer update. `grads` may be an ndarray
+        [n, dim], a Tensor, or a SelectedRows."""
+        from ..core.selected_rows import SelectedRows
+        if isinstance(grads, SelectedRows):
+            ids, grads = np.asarray(grads.rows), np.asarray(grads.value)
+        if isinstance(grads, Tensor):
+            grads = np.asarray(grads._data)
+        ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim))
+        if self._py is not None:
+            self._py.push(ids, grads)
+            return
+        rc = self._lib.pd_kv_push(self._h, ids, ids.shape[0], grads)
+        if rc != 0:
+            raise RuntimeError(f"pd_kv_push failed: {rc}")
+
+    def __len__(self):
+        if self._py is not None:
+            return len(self._py.rows)
+        return int(self._lib.pd_kv_size(self._h))
+
+    def close(self) -> None:
+        """Free the native table (pd_kv_close). Safe to call twice."""
+        if self._py is None and self._h >= 0 and self._lib is not None:
+            self._lib.pd_kv_close(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # save/load use one binary format for native and fallback tables
+    # (kv_table.cpp snapshot layout), so checkpoints move between
+    # machines with and without the C++ toolchain.
+    def save(self, path: str) -> None:
+        if self._py is not None:
+            import struct
+            with open(path, "wb") as f:
+                f.write(struct.pack("<iiffQ", self.dim,
+                                    self._py.optimizer, self._py.lr,
+                                    self._py.init_range,
+                                    self._py.seed & (2**64 - 1)))
+                for k, w in self._py.rows.items():
+                    f.write(struct.pack("<q", k))
+                    f.write(np.asarray(w, np.float32).tobytes())
+                    acc = self._py.accum.get(k)
+                    f.write(struct.pack("<i", 0 if acc is None else 1))
+                    if acc is not None:
+                        f.write(np.asarray(acc, np.float32).tobytes())
+            return
+        rc = self._lib.pd_kv_save(self._h, path.encode())
+        if rc != 0:
+            raise RuntimeError(f"pd_kv_save failed: {rc}")
+
+    def load(self, path: str) -> None:
+        if self._py is not None:
+            import struct
+            with open(path, "rb") as f:
+                hdr = f.read(24)
+                if len(hdr) < 24:
+                    raise RuntimeError(f"kv load: truncated header "
+                                       f"in {path}")
+                dim, opt, lr, rng, seed = struct.unpack("<iiffQ", hdr)
+                if dim != self.dim:
+                    raise RuntimeError(
+                        f"kv load: dim mismatch ({dim} != {self.dim})")
+                self._py.optimizer = opt
+                self._py.lr = lr
+                self._py.init_range = rng
+                self._py.seed = seed
+                row_bytes = 4 * dim
+                while True:
+                    kb = f.read(8)
+                    if not kb:
+                        break
+                    if len(kb) < 8:
+                        raise RuntimeError("kv load: truncated record")
+                    (k,) = struct.unpack("<q", kb)
+                    wb = f.read(row_bytes)
+                    hb = f.read(4)
+                    if len(wb) < row_bytes or len(hb) < 4:
+                        raise RuntimeError("kv load: truncated record")
+                    self._py.rows[k] = np.frombuffer(
+                        wb, np.float32).copy()
+                    (has,) = struct.unpack("<i", hb)
+                    if has:
+                        ab = f.read(row_bytes)
+                        if len(ab) < row_bytes:
+                            raise RuntimeError(
+                                "kv load: truncated record")
+                        self._py.accum[k] = np.frombuffer(
+                            ab, np.float32).copy()
+            return
+        rc = self._lib.pd_kv_load(self._h, path.encode())
+        if rc != 0:
+            raise RuntimeError(f"pd_kv_load failed: {rc}")
+
+    def shrink(self, threshold: float = 0.0) -> int:
+        """Drop near-zero rows (reference table shrink). Returns count."""
+        if self._py is not None:
+            drop = [k for k, v in self._py.rows.items()
+                    if np.abs(v).max() < threshold]
+            for k in drop:
+                self._py.rows.pop(k, None)
+                self._py.accum.pop(k, None)
+            return len(drop)
+        return int(self._lib.pd_kv_shrink(self._h, float(threshold)))
+
+
+def pull_sparse(kv: EmbeddingKV, ids):
+    """ref pull_sparse / distributed_lookup_table op: host pull of the
+    rows `ids` touch, compacted to unique keys. Returns
+    (block Tensor [n_unique, dim] with grads enabled, inverse index
+    [ids.size] mapping each id to its block row)."""
+    flat = np.asarray(ids._data if isinstance(ids, Tensor) else ids
+                      ).ravel().astype(np.int64)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    block = Tensor(np.asarray(kv.pull(uniq)), stop_gradient=False)
+    return block, uniq, inverse
+
+
+def push_sparse(kv: EmbeddingKV, uniq, block_grad):
+    """ref push_sparse op: push the pulled block's gradient back."""
+    kv.push(uniq, block_grad)
+
+
+def distributed_lookup_table(kv: EmbeddingKV, ids):
+    """ref distributed_lookup_table_op: full lookup (pull + expand to the
+    ids' shape). Gradients flow to the pulled block; call
+    SparseEmbedding.apply_gradients (or push_sparse) after backward."""
+    block, uniq, inverse = pull_sparse(kv, ids)
+    from ..ops.registry import run_op
+
+    shape = tuple(np.asarray(
+        ids._data if isinstance(ids, Tensor) else ids).shape)
+
+    def gather(b):
+        import jax.numpy as jnp
+        return jnp.take(b, inverse, axis=0).reshape(
+            shape + (kv.dim,))
+
+    out = run_op("distributed_lookup_table", gather, (block,), {})
+    return out, block, uniq
+
+
+class SparseEmbedding:
+    """Layer-like facade over EmbeddingKV (the reference's
+    paddle.static.nn.sparse_embedding / fleet large-scale embedding).
+
+    forward() pulls rows and returns a differentiable Tensor;
+    apply_gradients() pushes accumulated grads — call it after
+    loss.backward(), in place of an optimizer step for these params.
+    """
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, init_range=0.01,
+                 seed=0):
+        self.kv = EmbeddingKV(dim, optimizer=optimizer, lr=lr,
+                              init_range=init_range, seed=seed)
+        self._pending = []
+
+    def __call__(self, ids):
+        out, block, uniq = distributed_lookup_table(self.kv, ids)
+        self._pending.append((block, uniq))
+        return out
+
+    def apply_gradients(self):
+        for block, uniq in self._pending:
+            if block.grad is not None:
+                self.kv.push(uniq, np.asarray(block.grad._data))
+        self._pending.clear()

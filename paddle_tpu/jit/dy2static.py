@@ -19,10 +19,20 @@ Supported rewrites:
   - `for i in range(...)` with tensor bounds; `for x in <Tensor>` row
     iteration
   - `and`/`or`/`not` inside converted predicates (lazy logical helpers)
+  - `break` / `continue` / early `return` via the guard-flag technique
+    (reference break_continue_transformer.py / return_transformer.py):
+    a pre-pass rewrites them into boolean flags, guards the trailing
+    statements, folds the flags into loop conditions, and appends one
+    final `return` — the flag-form loops then convert like any other.
 Restrictions (clear errors, mirroring the reference's documented limits):
   - vars assigned under tensor control flow should exist beforehand when
     the predicate is traced (single-branch assignment of new names)
-  - no break/continue/early-return inside tensor-dependent loops
+  - a traced early `return` must be matched (both if-branches return, or
+    the fall-through path also returns) so the merged return value has a
+    consistent structure; one-sided returns under a traced predicate
+    raise the _check_defined error
+  - `break` inside `for x in <iterable>` (non-range) keeps Python
+    semantics (eager only)
 """
 from __future__ import annotations
 
@@ -148,14 +158,17 @@ class jst:
 
     @staticmethod
     def while_(cond_fn, body_fn, init_vals, names):
-        probe = cond_fn(*init_vals)
-        if not _is_traced(probe):
-            vals = tuple(init_vals)
-            cur = probe
-            while bool(cur.item() if isinstance(cur, Tensor) else cur):
-                vals = tuple(body_fn(*vals))
-                cur = cond_fn(*vals)
-            return vals
+        vals = tuple(init_vals)
+        cur = cond_fn(*vals)
+        while not _is_traced(cur):
+            if not bool(cur.item() if isinstance(cur, Tensor) else cur):
+                return vals
+            vals = tuple(body_fn(*vals))
+            cur = cond_fn(*vals)
+        # the condition is (or became — e.g. a traced break-flag merged
+        # into the carry mid-unroll) tensor-dependent: hand the remainder
+        # to lax from the current values
+        init_vals = vals
         jst._check_defined(init_vals, names, "while")
         if _max_while_iters is not None:
             # differentiable bounded form (masked scan) — needed whenever
@@ -212,6 +225,19 @@ class jst:
         for item in seq:
             vals = tuple(body_fn(item, *vals))
         return vals
+
+    @staticmethod
+    def final_ret(rf, rv):
+        """Function epilogue for flag-form returns: falls through to
+        Python's implicit None when no return fired (eager); traced, the
+        merged return value is authoritative (a traced function that may
+        not return has no consistent output structure anyway)."""
+        if _is_traced(rf):
+            return rv
+        fired = bool(rf.item() if isinstance(rf, Tensor) else rf)
+        if not fired:
+            return None
+        return rv
 
     @staticmethod
     def and_(lhs, rhs_fn):
@@ -341,6 +367,279 @@ def _init_load_tuple(names, uid):
     return ast.Tuple(
         elts=[ast.Name(id=f"__pd_i{uid}_{k}", ctx=ast.Load())
               for k in range(len(names))], ctx=ast.Load())
+
+
+RET_F = "_pde_rf"
+RET_V = "_pde_rv"
+
+
+def _exit_kinds_at_level(stmts) -> Set[str]:
+    """Which of {break, continue} occur at THIS loop level (not inside
+    nested loops/functions) and whether any `return` occurs anywhere
+    below (returns propagate through nested loops)."""
+    found: Set[str] = set()
+
+    class R(ast.NodeVisitor):
+        def visit_FunctionDef(self, n):
+            pass
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+        def visit_Return(self, n):
+            found.add("return")
+
+    class V(R):
+        def visit_While(self, n):
+            r = R()
+            for s in n.body + n.orelse:
+                r.visit(s)
+        visit_For = visit_While
+
+        def visit_Break(self, n):
+            found.add("break")
+
+        def visit_Continue(self, n):
+            found.add("continue")
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return found
+
+
+def _always_returns(stmts) -> bool:
+    """Every control path through `stmts` ends in `return`."""
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, ast.If) and s.orelse \
+                and _always_returns(s.body) and _always_returns(s.orelse):
+            return True
+    return False
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _assign_expr(name, expr):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=expr)
+
+
+def _not_or(flags: List[str]):
+    """`not (f1 or f2 or ...)` — converted lazily by _BoolOpInPred when
+    it lands in a tensor-if predicate."""
+    if len(flags) == 1:
+        inner = ast.Name(id=flags[0], ctx=ast.Load())
+    else:
+        inner = ast.BoolOp(op=ast.Or(), values=[
+            ast.Name(id=f, ctx=ast.Load()) for f in flags])
+    return ast.UnaryOp(op=ast.Not(), operand=inner)
+
+
+class _EarlyExit:
+    """Pre-pass: rewrite break/continue/early-return into guard flags
+    (reference break_continue_transformer.py / return_transformer.py),
+    producing flag-form loops/ifs the main _Transformer converts."""
+
+    def __init__(self):
+        self.uid = 0
+        self.flagify_returns = False
+
+    def run(self, fdef):
+        kinds_all = _exit_kinds_at_level(fdef.body)
+        nested_ret = self._has_nested_return(fdef.body)
+        loops_exit = self._any_loop_needs_flags(fdef.body)
+        if not nested_ret and not loops_exit:
+            return
+        self.flagify_returns = nested_ret
+        body = self.block(fdef.body, None, None)
+        if nested_ret:
+            epilogue = ast.Return(value=ast.Call(
+                func=_jst_attr("final_ret"),
+                args=[ast.Name(id=RET_F, ctx=ast.Load()),
+                      ast.Name(id=RET_V, ctx=ast.Load())],
+                keywords=[]))
+            body = ([_assign_const(RET_F, False),
+                     _assign_expr(RET_V, _jst_attr("UNDEF"))]
+                    + body + [epilogue])
+        for s in body:
+            # synthesized statements get the function's first line; the
+            # user's own statements keep their real locations
+            if getattr(s, "lineno", None) is None:
+                ast.copy_location(s, fdef.body[0])
+            ast.fix_missing_locations(s)
+        fdef.body = body
+        _ = kinds_all
+
+    # -- analysis ------------------------------------------------------------
+    @staticmethod
+    def _has_nested_return(body) -> bool:
+        for s in body:
+            if isinstance(s, ast.Return):
+                continue                      # top-level return is fine…
+            if "return" in _exit_kinds_at_level([s]):
+                return True                   # …nested ones need flags
+        return False
+
+    @staticmethod
+    def _any_loop_needs_flags(stmts) -> bool:
+        class V(ast.NodeVisitor):
+            found = False
+
+            def visit_FunctionDef(self, n):
+                pass
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_While(self, n):
+                kinds = _exit_kinds_at_level(n.body)
+                if kinds & {"break", "continue"}:
+                    self.found = True
+                self.generic_visit(n)
+            visit_For = visit_While
+        v = V()
+        for s in stmts:
+            v.visit(s)
+        return v.found
+
+    def _flags_set_by(self, stmt, brk, cont) -> List[str]:
+        kinds = _exit_kinds_at_level([stmt])
+        flags = []
+        if brk and "break" in kinds:
+            flags.append(brk)
+        if cont and "continue" in kinds:
+            flags.append(cont)
+        if self.flagify_returns and "return" in kinds:
+            flags.append(RET_F)
+        return flags
+
+    # -- rewriting -----------------------------------------------------------
+    def block(self, stmts, brk, cont) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break) and brk:
+                out.append(_assign_const(brk, True))
+                return out                      # rest is unreachable
+            if isinstance(s, ast.Continue) and cont:
+                out.append(_assign_const(cont, True))
+                return out
+            if isinstance(s, ast.Return) and self.flagify_returns:
+                out.append(_assign_expr(
+                    RET_V, s.value if s.value is not None
+                    else ast.Constant(value=None)))
+                out.append(_assign_const(RET_F, True))
+                return out
+            if isinstance(s, ast.If):
+                # `if p: …return…` followed by more code where the body
+                # always returns ≡ `if p: … else: <rest>` — the else-form
+                # assigns the return flag/value on BOTH sides, so traced
+                # predicates merge a consistent structure (the reference's
+                # return_transformer does the same hoisting)
+                if (self.flagify_returns and not s.orelse
+                        and _always_returns(s.body) and i + 1 < len(stmts)):
+                    folded = ast.If(test=s.test, body=s.body,
+                                    orelse=list(stmts[i + 1:]))
+                    ast.copy_location(folded, s)
+                    out.extend(self.block([folded], brk, cont))
+                    return out
+                ns = ast.If(
+                    test=s.test,
+                    body=self.block(s.body, brk, cont) or [ast.Pass()],
+                    orelse=self.block(s.orelse, brk, cont))
+                ast.copy_location(ns, s)
+                out.append(ns)
+                flags = self._flags_set_by(s, brk, cont)
+                if flags:
+                    rest = self.block(stmts[i + 1:], brk, cont)
+                    if rest:
+                        guard = ast.If(test=_not_or(flags), body=rest,
+                                       orelse=[])
+                        ast.copy_location(guard, s)
+                        out.append(guard)
+                    return out
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                out.extend(self.loop(s))
+                if self.flagify_returns and \
+                        "return" in _exit_kinds_at_level([s]):
+                    rest = self.block(stmts[i + 1:], brk, cont)
+                    if rest:
+                        guard = ast.If(test=_not_or([RET_F]), body=rest,
+                                       orelse=[])
+                        ast.copy_location(guard, s)
+                        out.append(guard)
+                    return out
+                continue
+            out.append(s)
+        return out
+
+    def loop(self, node) -> List[ast.stmt]:
+        if node.orelse:
+            return [node]                       # loop-else: keep python
+        kinds = _exit_kinds_at_level(node.body)
+        has_b = "break" in kinds
+        has_c = "continue" in kinds
+        has_r = self.flagify_returns and "return" in kinds
+        if not (has_b or has_c or has_r):
+            new_body = self.block(node.body, None, None)
+            repl = type(node)(**{**{f: getattr(node, f)
+                                    for f in node._fields},
+                                 "body": new_body})
+            ast.copy_location(repl, node)
+            return [repl]
+
+        self.uid += 1
+        uid = self.uid
+        bf = f"_pde_b{uid}" if has_b else None
+        cf = f"_pde_c{uid}" if has_c else None
+        cond_flags = ([bf] if has_b else []) + ([RET_F] if has_r else [])
+
+        if isinstance(node, ast.While):
+            new_test = (ast.BoolOp(op=ast.And(),
+                                   values=[_not_or(cond_flags), node.test])
+                        if cond_flags else node.test)
+            new_body = (([_assign_const(cf, False)] if has_c else [])
+                        + self.block(node.body, bf, cf))
+            repl = ast.While(test=new_test, body=new_body, orelse=[])
+            ast.copy_location(repl, node)
+            # flags are loop-carried stores: initialize both before the
+            # loop so the converted while's captures are defined
+            return ([_assign_const(bf, False)] if has_b else []) \
+                + ([_assign_const(cf, False)] if has_c else []) + [repl]
+
+        # for-loop over non-range iterables: the guard-flag form would
+        # drain the whole iterator (wrong cost, non-termination on
+        # infinite generators) — keep CPython semantics; a real `return`
+        # inside exits the function directly, which composes with the
+        # flag epilogue (flags simply never fire)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and isinstance(node.target, ast.Name))
+        if not is_range:
+            return [node]
+
+        # range-for: keep the `for` shape (stays unrolled under trace —
+        # reverse-differentiable) and guard the whole body with the
+        # break/return flags; iterations after the exit are no-ops.
+        # Deviation from CPython: the loop variable keeps iterating to
+        # the end of the range after a `break` (its post-loop value
+        # differs) — all *guarded* state matches exactly.
+        guard_flags = ([bf] if has_b else []) + ([RET_F] if has_r else [])
+        inner = (([_assign_const(cf, False)] if has_c else [])
+                 + self.block(node.body, bf, cf))
+        new_body = ([ast.If(test=_not_or(guard_flags),
+                            body=inner or [ast.Pass()], orelse=[])]
+                    if guard_flags else inner)
+        repl = ast.For(target=node.target, iter=node.iter,
+                       body=new_body, orelse=[])
+        ast.copy_location(repl, node)
+        return ([_assign_const(bf, False)] if has_b else []) \
+            + ([_assign_const(cf, False)] if has_c else []) + [repl]
 
 
 class _BoolOpInPred(ast.NodeTransformer):
@@ -564,6 +863,7 @@ def convert_function(fn: Callable) -> Callable:
         if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
             raise ConversionError("not a function def")
         fdef.decorator_list = []  # strip @to_static etc.
+        _EarlyExit().run(fdef)
         _Transformer().visit(fdef)
         ast.fix_missing_locations(tree)
         code = compile(tree, filename=f"<dy2static {fn.__name__}>",

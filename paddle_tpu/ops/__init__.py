@@ -18,6 +18,12 @@ from . import (amp_ops, creation, detection, extras, linalg, logic,
 from .amp_ops import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from .loss_extra import *  # noqa: F401,F403
+from .misc_ops import *  # noqa: F401,F403
+from .quant_ops import *  # noqa: F401,F403
+from .rnn_ops import *  # noqa: F401,F403
+from .tensor_array import *  # noqa: F401,F403
+from .vision_extra import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403

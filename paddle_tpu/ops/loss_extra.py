@@ -1,0 +1,258 @@
+"""Loss / ranking / ads-model ops from the reference's long tail.
+
+Reference specs (semantics; implementations are jnp-first):
+  hinge_loss_op.h (l = max(0, 1 - x*(2y-1))),
+  huber_loss_op.h (0.5*d^2 inside delta, delta*(|d|-0.5*delta) outside),
+  modified_huber_loss_op.h (-4v if v<-1, (1-v)^2 if v<1, 0 else; v=x*(2y-1)),
+  rank_loss_op.h (log(1+exp(l-r)) - label*(l-r)),
+  bpr_loss_op.h (Bayesian personalized ranking over classes),
+  center_loss_op.h (0.5*||x - centers[label]||^2 + center EMA update),
+  teacher_student_sigmoid_loss_op.h (click + teacher-score double sigmoid),
+  fsp_op.h (flow-of-solution-procedure matrix for distillation),
+  cvm_op.h (show/click log transforms), data_norm_op.cc
+  (means=sum/size, scales=sqrt(size/square_sum)),
+  nce_op.h (noise-contrastive estimation with log-uniform sampling),
+  sample_logits_op.h (sampled-softmax gather),
+  hierarchical_sigmoid_op.h + math/matrix_bit_code.h (SimpleCode paths),
+  match_matrix_tensor_op.cc (x W_c y^T text-match tensors).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "hinge_loss", "huber_loss", "modified_huber_loss", "rank_loss",
+    "bpr_loss", "center_loss", "teacher_student_sigmoid_loss", "fsp",
+    "cvm", "data_norm", "nce", "sample_logits", "hierarchical_sigmoid",
+    "match_matrix_tensor",
+]
+
+
+@register_op("hinge_loss")
+def hinge_loss(logits, labels, name=None):
+    """l = max(0, 1 - logits*(2*labels - 1)); labels in {0,1}."""
+    return jnp.maximum(0.0, 1.0 - logits * (2.0 * labels - 1.0))
+
+
+@register_op("huber_loss")
+def huber_loss(input, label, delta=1.0, name=None):
+    """Returns (residual, loss) like the reference (residual kept for the
+    grad path there; here for output parity)."""
+    r = label - input
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return r, loss
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(logits, labels, name=None):
+    v = logits * (2.0 * labels - 1.0)
+    return jnp.where(v < -1.0, -4.0 * v,
+                     jnp.where(v < 1.0, jnp.square(1.0 - v), 0.0))
+
+
+@register_op("rank_loss")
+def rank_loss(label, left, right, name=None):
+    d = left - right
+    return jnp.log(1.0 + jnp.exp(d)) - label * d
+
+
+@register_op("bpr_loss")
+def bpr_loss(logits, label, name=None):
+    """-mean_{j != label} log(sigmoid(x_label - x_j)) per row."""
+    b, c = logits.shape
+    pos = jnp.take_along_axis(
+        logits, label.reshape(b, 1).astype(jnp.int32), axis=1)
+    diff = pos - logits                                  # [B, C]
+    # log(sigmoid(d)) = -log(1 + exp(-d)); clip like TolerableValue
+    logsig = -jnp.log1p(jnp.clip(jnp.exp(-diff), 0.0, 1e20))
+    mask = jnp.ones((b, c), logits.dtype) - jax.nn.one_hot(
+        label.reshape(b).astype(jnp.int32), c, dtype=logits.dtype)
+    return (-(logsig * mask).sum(axis=1, keepdims=True)
+            / (c - 1)).astype(logits.dtype)
+
+
+@register_op("center_loss")
+def center_loss(x, label, centers, alpha=0.05, need_update=True, name=None):
+    """Returns (loss [B,1], sample_center_diff [B,D], centers_out).
+    Center update: c -= alpha * sum(diff_c) / (1 + count_c)."""
+    lbl = label.reshape(-1).astype(jnp.int32)
+    diff = x - centers[lbl]
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if need_update:
+        k = centers.shape[0]
+        sums = jnp.zeros_like(centers).at[lbl].add(diff)
+        counts = jnp.zeros((k,), x.dtype).at[lbl].add(1.0)
+        centers_out = centers + alpha * sums / (1.0 + counts)[:, None]
+    else:
+        centers_out = centers
+    return loss, diff, centers_out
+
+
+@register_op("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    """Double sigmoid CE keyed on the label coding scheme in
+    teacher_student_sigmoid_loss_op.h (label<-1: no-click no-teacher;
+    -1<=label<0: click no-teacher; else click bit + teacher score)."""
+    sp = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    no_click = sp                       # z=0, no teacher
+    click = sp - x                      # z=1, no teacher
+    z2 = jnp.where(label < 1.0, label, label - 1.0)
+    clk = jnp.where(label < 1.0, 0.0, 1.0)
+    with_teacher = sp - clk * x + sp - z2 * x
+    return jnp.where(label < -1.0, no_click,
+                     jnp.where(label < 0.0, click, with_teacher))
+
+
+@register_op("fsp")
+def fsp(x, y, name=None):
+    """FSP distillation matrix (fsp_op.h): out[b,i,j] =
+    mean_hw x[b,i,h,w] * y[b,j,h,w]."""
+    h, w = x.shape[2], x.shape[3]
+    return jnp.einsum("bihw,bjhw->bij", x, y) / (h * w)
+
+
+@register_op("cvm")
+def cvm(x, cvm_in=None, use_cvm=True, name=None):
+    """Show/click feature transform (cvm_op.h): col0=log(show+1),
+    col1=log(click+1)-col0 when use_cvm, else drop the two cvm cols."""
+    if use_cvm:
+        c0 = jnp.log(x[:, 0:1] + 1.0)
+        c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+        return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+@register_op("data_norm")
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4,
+              name=None):
+    """Global-statistics normalization (data_norm_op.cc): means =
+    batch_sum/batch_size, scales = sqrt(batch_size/batch_square_sum);
+    returns (y, means, scales)."""
+    means = batch_sum / batch_size
+    scales = jnp.sqrt(batch_size / batch_square_sum)
+    return (x - means[None, :]) * scales[None, :], means, scales
+
+
+# ---------------------------------------------------------------------------
+# sampled-class ops (nce / sample_logits) with the reference's log-uniform
+# sampler: P(x) = ln(1 + 1/(x+1)) / ln(range+1)  (math/sampler.h)
+# ---------------------------------------------------------------------------
+
+def _log_uniform_sample(key, shape, range_):
+    u = jax.random.uniform(key, shape)
+    s = jnp.exp(u * np.log(range_ + 1.0)) - 1.0
+    return jnp.clip(s.astype(jnp.int32), 0, range_ - 1)
+
+
+def _log_uniform_prob(x, range_):
+    return jnp.log1p(1.0 / (x.astype(jnp.float32) + 1.0)) / np.log(
+        range_ + 1.0)
+
+
+@register_op("nce")
+def nce(x, label, weight, bias=None, num_total_classes=None,
+        num_neg_samples=10, seed=0, sampler="log_uniform", name=None):
+    """Noise-contrastive estimation (nce_op.h). Returns (cost [B,1],
+    sample_logits, sample_labels). o = sigmoid(x.W[c] + b[c]);
+    cost = -log(o/(o+kq)) for true c, -log(kq/(o+kq)) for sampled."""
+    n = int(num_total_classes or weight.shape[0])
+    b = x.shape[0]
+    k = int(num_neg_samples)
+    key = jax.random.key(int(seed))
+    if sampler == "uniform":
+        neg = jax.random.randint(key, (b, k), 0, n)
+        q = jnp.full((b, k), 1.0 / n)
+    else:
+        neg = _log_uniform_sample(key, (b, k), n)
+        q = _log_uniform_prob(neg, n)
+    pos = label.reshape(b, 1).astype(jnp.int32)
+    samples = jnp.concatenate([pos, neg], axis=1)        # [B, 1+K]
+    w = weight[samples]                                  # [B,1+K,D]
+    logits = jnp.einsum("bd,bkd->bk", x, w)
+    if bias is not None:
+        logits = logits + bias[samples]
+    o = jax.nn.sigmoid(logits)
+    qpos = (jnp.full((b, 1), 1.0 / n) if sampler == "uniform"
+            else _log_uniform_prob(pos, n))
+    kq = k * jnp.concatenate([qpos, q], axis=1)
+    eps = 1e-12
+    cost_true = -jnp.log(o[:, :1] / (o[:, :1] + kq[:, :1]) + eps)
+    cost_neg = -jnp.log(kq[:, 1:] / (o[:, 1:] + kq[:, 1:]) + eps)
+    cost = cost_true.sum(1, keepdims=True) + cost_neg.sum(1, keepdims=True)
+    return cost, logits, samples
+
+
+@register_op("sample_logits")
+def sample_logits(logits, label, num_samples=10, seed=0, uniq=True,
+                  remove_accidental_hits=True, use_customized_samples=False,
+                  customized_samples=None, customized_probabilities=None,
+                  name=None):
+    """Sampled-softmax gather (sample_logits_op.h): returns (samples,
+    probabilities, sampled_logits, sampled_label). Sampled logits are
+    logits[samples] - log(q) (subtract-log-q trick); accidental hits of
+    the true class among negatives get -1e20."""
+    b, n = logits.shape
+    nt = label.shape[1] if label.ndim > 1 else 1
+    pos = label.reshape(b, nt).astype(jnp.int32)
+    if use_customized_samples:
+        neg = customized_samples.astype(jnp.int32)
+        q_neg = customized_probabilities
+    else:
+        neg = _log_uniform_sample(jax.random.key(int(seed)),
+                                  (b, int(num_samples)), n)
+        q_neg = _log_uniform_prob(neg, n)
+    samples = jnp.concatenate([pos, neg], axis=1)
+    q_pos = _log_uniform_prob(pos, n)
+    probs = jnp.concatenate([q_pos, q_neg], axis=1)
+    gathered = jnp.take_along_axis(logits, samples, axis=1)
+    sampled = gathered - jnp.log(probs + 1e-12)
+    if remove_accidental_hits:
+        hit = (neg[:, None, :] == pos[:, :, None]).any(axis=1)
+        sampled = sampled.at[:, nt:].add(
+            jnp.where(hit, -1e20, 0.0).astype(sampled.dtype))
+    sampled_label = jnp.broadcast_to(
+        jnp.arange(nt, dtype=jnp.int32)[None, :], (b, nt))
+    return samples, probs, sampled, sampled_label
+
+
+@register_op("hierarchical_sigmoid")
+def hierarchical_sigmoid(x, label, w, bias=None, num_classes=2, name=None):
+    """Default-tree hsigmoid (hierarchical_sigmoid_op.h + SimpleCode in
+    math/matrix_bit_code.h: c = label + num_classes, node index at bit b
+    is (c>>(b+1))-1, target bit is c&(1<<b), path length =
+    highest_set_bit(c)-1). Returns (cost [B,1], pre_out)."""
+    n = int(num_classes)
+    b = x.shape[0]
+    max_len = int(np.floor(np.log2(2 * n - 1)))
+    c = label.reshape(b).astype(jnp.int32) + n
+    bits = jnp.arange(max_len, dtype=jnp.int32)          # [L]
+    length = (jnp.floor(jnp.log2(c.astype(jnp.float32)))
+              ).astype(jnp.int32)                        # FindLastSet-1
+    valid = bits[None, :] < length[:, None]              # [B, L]
+    idx = jnp.clip((c[:, None] >> (bits[None, :] + 1)) - 1, 0,
+                   w.shape[0] - 1)                       # [B, L]
+    bit = ((c[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+    pre = jnp.einsum("bd,bld->bl", x, w[idx])
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    # BCE-with-logits against the path bits, masked to real path length
+    sp = jnp.maximum(pre, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+    loss = (sp - bit * pre) * valid.astype(x.dtype)
+    return loss.sum(axis=1, keepdims=True), pre
+
+
+@register_op("match_matrix_tensor")
+def match_matrix_tensor(x, y, w, dim_t=None, name=None):
+    """Text-match tensors (match_matrix_tensor_op.cc): x [B,T1,D1],
+    y [B,T2,D2], w [D1,C,D2] -> out [B,C,T1,T2]; returns (out, tmp) where
+    tmp = x·w ([B,T1,C,D2])."""
+    tmp = jnp.einsum("bsd,dce->bsce", x, w)
+    out = jnp.einsum("bsce,bte->bcst", tmp, y)
+    return out, tmp

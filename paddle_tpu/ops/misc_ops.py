@@ -1,0 +1,339 @@
+"""Long-tail reference ops: partial/slab utilities, positional encoding,
+time-axis convs, PS id sharding, SPP, sequence conv/scatter, debug print.
+
+Reference specs (semantics only; all implementations are jnp/lax-first):
+  partial_concat_op.cc, partial_sum_op.cc, pad_constant_like_op.cc,
+  space_to_depth_op.cc, conv_shift_op.cc, row_conv_op.cc,
+  add_position_encoding_op.cc, shuffle_batch_op.cc, filter_by_instag_op.cc,
+  merge_ids_op.cc / split_ids_op.cc, split_selected_rows_op.cc,
+  get_tensor_from_selected_rows_op.cc, spp_op.cc, sequence_conv_op.cc,
+  sequence_scatter_op.cc, sequence_topk_avg_pooling_op.cc, print_op.cc,
+  select_input_op.cc / select_output_op.cc, l1_norm_op.cc,
+  squared_l2_norm_op.cc, squared_l2_distance_op.cc (all under
+  /root/reference/paddle/fluid/operators/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "print_op", "select_input", "select_output", "partial_concat",
+    "partial_sum", "pad_constant_like", "space_to_depth", "conv_shift",
+    "row_conv", "add_position_encoding", "shuffle_batch",
+    "filter_by_instag", "merge_ids", "split_ids", "split_selected_rows",
+    "get_tensor_from_selected_rows", "spp", "sequence_conv",
+    "sequence_scatter", "sequence_topk_avg_pooling", "l1_norm",
+    "squared_l2_norm", "squared_l2_distance",
+]
+
+
+@register_op("print")
+def print_op(x, message="", first_n=-1, summarize=20, print_phase="both",
+             name=None):
+    """Identity that prints its input (ref print_op.cc). Works under jit
+    via jax.debug.print; `first_n`/`summarize` are host-side conveniences
+    honoured eagerly."""
+    if isinstance(x, jax.core.Tracer):
+        jax.debug.print("{msg}{val}", msg=message, val=x)
+        return x
+    flat = np.asarray(x).ravel()
+    shown = flat if summarize < 0 else flat[:summarize]
+    print(f"{message}shape={tuple(np.shape(x))} values={shown.tolist()}")
+    return x
+
+
+@register_op("select_input")
+def _select_input_impl(*args):
+    """out = inputs[mask] (ref select_input_op.cc). Last positional is the
+    scalar branch index; under jit this is lax.switch, so all inputs must
+    share shape/dtype (same restriction the reference's fused branches
+    have after conditional_block lowering)."""
+    xs, mask = args[:-1], args[-1]
+    idx = jnp.clip(jnp.asarray(mask, jnp.int32).reshape(()), 0,
+                   len(xs) - 1)
+    return jax.lax.switch(idx, [lambda i=i: xs[i] for i in range(len(xs))])
+
+
+def select_input(inputs, mask):
+    return _select_input_impl(*inputs, mask)
+
+
+@register_op("select_output")
+def _select_output_impl(x, mask, n_out=2):
+    """Route x to output[mask]; other outputs are zeros of x's shape (ref
+    select_output_op.cc writes only the selected branch var; zero-filled
+    twins keep XLA shapes static)."""
+    idx = jnp.asarray(mask, jnp.int32).reshape(())
+    return tuple(jnp.where(jnp.equal(idx, i), x, jnp.zeros_like(x))
+                 for i in range(int(n_out)))
+
+
+def select_output(x, mask, n_out=2):
+    return _select_output_impl(x, mask, n_out=int(n_out))
+
+
+@register_op("partial_concat")
+def _partial_concat_impl(*xs, start_index=0, length=-1):
+    """Concat columns [start, start+length) of each [B, M] input
+    (ref partial_concat_op.cc)."""
+    m = xs[0].shape[1]
+    s = start_index if start_index >= 0 else m + start_index
+    e = m if length < 0 else s + length
+    return jnp.concatenate([x[:, s:e] for x in xs], axis=1)
+
+
+def partial_concat(x, start_index=0, length=-1, name=None):
+    return _partial_concat_impl(*x, start_index=int(start_index),
+                                length=int(length))
+
+
+@register_op("partial_sum")
+def _partial_sum_impl(*xs, start_index=0, length=-1):
+    """Sum of column slices [start, start+length) over inputs
+    (ref partial_sum_op.cc)."""
+    m = xs[0].shape[1]
+    s = start_index if start_index >= 0 else m + start_index
+    e = m if length < 0 else s + length
+    out = xs[0][:, s:e]
+    for x in xs[1:]:
+        out = out + x[:, s:e]
+    return out
+
+
+def partial_sum(x, start_index=0, length=-1, name=None):
+    return _partial_sum_impl(*x, start_index=int(start_index),
+                             length=int(length))
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y at the end of every dim up to x's shape (ref
+    pad_constant_like_op.cc: output shape = X.shape, data = Y padded)."""
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, blocksize, name=None):
+    """NCHW [N,C,H,W] -> [N, C*b*b, H/b, W/b] (ref space_to_depth_op.cc;
+    inverse of pixel_shuffle)."""
+    b = int(blocksize)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("conv_shift")
+def conv_shift(x, y, name=None):
+    """Circular convolution (ref conv_shift_op.cc): X [B,M], Y [B,N] with
+    odd N << M; out[b,i] = sum_j x[b, (i + j - N//2) mod M] * y[b, j]."""
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    windows = x[:, idx]                       # [B, M, N]
+    return jnp.einsum("bmn,bn->bm", windows, y)
+
+
+@register_op("row_conv")
+def row_conv(x, filt, name=None):
+    """Lookahead row convolution (ref row_conv_op.cc): x [B,T,D],
+    filter [k,D]; out[b,t,d] = sum_{j<k, t+j<T} x[b,t+j,d]*filter[j,d]."""
+    k = filt.shape[0]
+    t = x.shape[1]
+    padded = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        out = out + padded[:, j:j + t, :] * filt[j][None, None, :]
+    return out
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """out = alpha*x + beta*PE with the reference's half-split sinusoid
+    (add_position_encoding_op.h: first half sin, second half cos)."""
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    div = jnp.power(jnp.asarray(10000.0, x.dtype),
+                    jnp.arange(half, dtype=x.dtype) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    if pe.shape[1] < d:
+        pe = jnp.pad(pe, ((0, 0), (0, d - pe.shape[1])))
+    return alpha * x + beta * pe[None, :, :]
+
+
+@register_op("shuffle_batch")
+def shuffle_batch(x, seed=0, name=None):
+    """Random permutation of rows (ref shuffle_batch_op.cc). Returns
+    (out, shuffle_idx) so the order can be undone/reused."""
+    perm = jax.random.permutation(jax.random.key(int(seed)), x.shape[0])
+    return x[perm], perm.astype(jnp.int32)
+
+
+def filter_by_instag(ins, ins_tag_lengths, ins_tags, filter_tags,
+                     out_val_if_empty=0):
+    """Keep rows whose tag set intersects filter_tags (ref
+    filter_by_instag_op.cc). Eager-only (dynamic output rows), like the
+    reference's LoD output. ins: [B, D]; ins_tags: flat int tags;
+    ins_tag_lengths: [B] tags per row. Returns (filtered, index,
+    loss_weight)."""
+    ins = np.asarray(_unwrap(ins))
+    tags = np.asarray(_unwrap(ins_tags)).ravel()
+    lens = np.asarray(_unwrap(ins_tag_lengths)).ravel()
+    fset = set(int(t) for t in np.asarray(_unwrap(filter_tags)).ravel())
+    keep, off = [], 0
+    for i, l in enumerate(lens):
+        if fset.intersection(int(t) for t in tags[off:off + int(l)]):
+            keep.append(i)
+        off += int(l)
+    if not keep:
+        out = np.full((1,) + ins.shape[1:], out_val_if_empty, ins.dtype)
+        return (Tensor(jnp.asarray(out)),
+                Tensor(jnp.asarray([0], jnp.int64)),
+                Tensor(jnp.asarray([0.0], jnp.float32)))
+    idx = np.asarray(keep, np.int64)
+    return (Tensor(jnp.asarray(ins[idx])), Tensor(jnp.asarray(idx)),
+            Tensor(jnp.ones((len(keep),), jnp.float32)))
+
+
+def split_ids(ids, shard_num):
+    """Shard ids by `id % shard_num` (ref split_ids_op.cc). Eager-only
+    (dynamic shapes), returns a python list of id arrays."""
+    ids = np.asarray(_unwrap(ids)).ravel()
+    return [Tensor(jnp.asarray(ids[ids % shard_num == s]))
+            for s in range(int(shard_num))]
+
+
+def merge_ids(ids, rows, values):
+    """Inverse of split_ids for looked-up rows (ref merge_ids_op.cc):
+    reassemble per-shard embedding rows into the original id order."""
+    ids = np.asarray(_unwrap(ids)).ravel()
+    dim = np.asarray(_unwrap(values[0])).shape[-1]
+    out = np.zeros((ids.shape[0], dim),
+                   np.asarray(_unwrap(values[0])).dtype)
+    for shard_rows, shard_vals in zip(rows, values):
+        r = np.asarray(_unwrap(shard_rows)).ravel()
+        v = np.asarray(_unwrap(shard_vals))
+        pos = {int(idv): i for i, idv in enumerate(r)}
+        for i, idv in enumerate(ids):
+            if int(idv) in pos:
+                out[i] = v[pos[int(idv)]]
+    return Tensor(jnp.asarray(out))
+
+
+def split_selected_rows(sr, height_sections):
+    """Split a SelectedRows by contiguous height sections (ref
+    split_selected_rows_op.cc) — the PS shard scatter."""
+    from ..core.selected_rows import SelectedRows
+    rows = np.asarray(sr.rows)
+    vals = np.asarray(sr.value)
+    outs, start = [], 0
+    for h in height_sections:
+        m = (rows >= start) & (rows < start + h)
+        outs.append(SelectedRows(jnp.asarray(rows[m] - start),
+                                 jnp.asarray(vals[m]), int(h)))
+        start += h
+    return outs
+
+
+def get_tensor_from_selected_rows(sr):
+    """SelectedRows value slab as a dense tensor (ref
+    get_tensor_from_selected_rows_op.cc)."""
+    return Tensor(sr.value)
+
+
+@register_op("spp")
+def spp(x, pyramid_height=3, pooling_type="max", name=None):
+    """Spatial pyramid pooling (ref spp_op.cc): concat of adaptive pools
+    at 1x1, 2x2, ... 2^(h-1) bins, flattened: [N, C*sum(4^l)]."""
+    from ..nn.functional.pooling import _adaptive
+    n, c = x.shape[0], x.shape[1]
+    outs = []
+    for l in range(int(pyramid_height)):
+        bins = 2 ** l
+        p = _adaptive(x, (bins, bins), 2, False,
+                      "max" if pooling_type == "max" else "avg")
+        outs.append(p.reshape(n, c * bins * bins))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("sequence_conv")
+def sequence_conv(x, filt, length=None, context_length=3, context_start=None,
+                  name=None):
+    """Per-timestep context-window linear map (ref sequence_conv_op.cc):
+    x [B,T,D], filter [context_length*D, M]; window rows outside [0,T) or
+    beyond `length` are zero — LoD replaced by the (padded, lengths)
+    convention of ops/sequence.py."""
+    cl = int(context_length)
+    start = -((cl - 1) // 2) if context_start is None else int(context_start)
+    b, t, d = x.shape
+    cols = []
+    for j in range(cl):
+        off = start + j
+        shifted = jnp.roll(x, -off, axis=1)
+        pos = jnp.arange(t) + off
+        valid = (pos >= 0) & (pos < t)
+        if length is not None:
+            valid = valid[None, :] & (pos[None, :] <
+                                      jnp.asarray(length)[:, None])
+            shifted = shifted * valid[:, :, None].astype(x.dtype)
+        else:
+            shifted = shifted * valid[None, :, None].astype(x.dtype)
+        cols.append(shifted)
+    im2col = jnp.concatenate(cols, axis=-1)          # [B,T,cl*D]
+    return im2col @ filt                             # [B,T,M]
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(x, index, updates, length=None, name=None):
+    """Scatter-add per-sequence updates into x (ref
+    sequence_scatter_op.cc): x [B,D], index [B,K] column ids, updates
+    [B,K]; positions past `length[b]` are ignored."""
+    upd = updates
+    if length is not None:
+        mask = (jnp.arange(index.shape[1])[None, :]
+                < jnp.asarray(length)[:, None])
+        upd = upd * mask.astype(updates.dtype)
+    rows = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], index.shape)
+    return x.at[rows, index].add(upd)
+
+
+@register_op("sequence_topk_avg_pooling")
+def sequence_topk_avg_pooling(x, topks=(1,), name=None):
+    """Top-k average pooling over the last axis per channel (ref
+    sequence_topk_avg_pooling_op.cc, text-matching pyramid): x [B,C,N] ->
+    [B, C*len(topks)] where each slot is mean(top-k)."""
+    ks = tuple(int(k) for k in topks)
+    kmax = max(ks)
+    top = jax.lax.top_k(x, kmax)[0]                  # [B,C,kmax] sorted
+    csum = jnp.cumsum(top, axis=-1)
+    outs = [csum[..., k - 1] / k for k in ks]
+    return jnp.concatenate(outs, axis=-1)
+
+
+@register_op("l1_norm")
+def l1_norm(x, name=None):
+    """sum(|x|) (ref l1_norm_op.cc)."""
+    return jnp.sum(jnp.abs(x))
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x, name=None):
+    """sum(x^2) (ref squared_l2_norm_op.cc) — the grad-clip workhorse."""
+    return jnp.sum(jnp.square(x))
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(x, y, name=None):
+    """Row-wise ||x - y||^2 (ref squared_l2_distance_op.cc). Returns
+    (sub_result, out) like the reference (sub kept for the grad path;
+    here for API parity)."""
+    sub = x - (y if y.shape[0] == x.shape[0]
+               else jnp.broadcast_to(y, x.shape))
+    return sub, jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)))

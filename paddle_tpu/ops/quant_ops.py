@@ -1,0 +1,181 @@
+"""Quantization-aware-training ops (reference fake_quantize_op.cc family).
+
+Reference specs: operators/fake_quantize_op.{cc,h} —
+  fake_quantize_abs_max, fake_quantize_range_abs_max,
+  fake_quantize_moving_average_abs_max, fake_quantize_dequantize_abs_max,
+  fake_quantize_dequantize_moving_average_abs_max,
+  fake_channel_wise_quantize_abs_max,
+  fake_channel_wise_quantize_dequantize_abs_max,
+  moving_average_abs_max_scale
+and operators/fake_dequantize_op.cc (fake_dequantize_max_abs).
+
+TPU design: the *_dequantize ops are differentiable with the
+straight-through estimator (the reference registers FakeQuantDequantGradOp
+passing dY through; here it is one jax.custom_vjp shared by the family).
+Stateful scale tracking (range / moving-average) is functional: state
+tensors go in, updated state comes out — fits the compiled TrainStep where
+state lives in strategy_state, no mutable op attributes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_quantize_dequantize_abs_max",
+    "fake_quantize_range_abs_max", "fake_quantize_moving_average_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "moving_average_abs_max_scale", "fake_dequantize_max_abs",
+]
+
+
+def _qmax(bit_length):
+    return float((1 << (int(bit_length) - 1)) - 1)
+
+
+@jax.custom_vjp
+def _quant_dequant_ste(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * qmax) * s / qmax
+
+
+def _qd_fwd(x, scale, qmax):
+    return _quant_dequant_ste(x, scale, qmax), None
+
+
+def _qd_bwd(_, g):
+    return g, None, None          # straight-through: dX = dY
+
+
+_quant_dequant_ste.defvjp(_qd_fwd, _qd_bwd)
+
+
+@register_op("fake_quantize_abs_max")
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """out = round(x / max|x| * qmax) (integers stored as float), returns
+    (out, scale) — ref FakeQuantizeAbsMaxKernel."""
+    scale = jnp.max(jnp.abs(x))
+    s = jnp.maximum(scale, 1e-8)
+    q = _qmax(bit_length)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * q), scale
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    """QAT quant-dequant with STE gradient; returns (out, scale)."""
+    scale = jnp.max(jnp.abs(x))
+    return _quant_dequant_ste(x, scale, _qmax(bit_length)), scale
+
+
+@register_op("fake_quantize_range_abs_max")
+def fake_quantize_range_abs_max(x, in_scale, scales_window, iter_idx,
+                                window_size=10000, bit_length=8,
+                                is_test=False, name=None):
+    """Windowed max-abs scale tracking (ref FakeQuantizeRangeAbsMaxKernel):
+    train mode records max|x| into a circular window and takes the window
+    max as scale. Returns (out, out_scale, scales_window, iter_idx+1)."""
+    q = _qmax(bit_length)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        s = jnp.maximum(in_scale.reshape(()), 1e-8)
+        return (jnp.round(jnp.clip(x / s, -1.0, 1.0) * q), in_scale,
+                scales_window, iter_idx)
+    slot = jnp.mod(iter_idx.astype(jnp.int32), window_size)
+    window = scales_window.at[slot].set(cur)
+    n_seen = jnp.minimum(iter_idx.astype(jnp.int32) + 1, window_size)
+    mask = jnp.arange(window.shape[0]) < n_seen
+    scale = jnp.max(jnp.where(mask, window, 0.0))
+    s = jnp.maximum(scale, 1e-8)
+    return (jnp.round(jnp.clip(x / s, -1.0, 1.0) * q), scale, window,
+            iter_idx + 1)
+
+
+def _moving_average_scale(accum, state, cur, rate):
+    state2 = rate * state + 1.0
+    accum2 = rate * accum + cur
+    return accum2, state2, accum2 / state2
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def fake_quantize_moving_average_abs_max(x, in_accum, in_state,
+                                         moving_rate=0.9, bit_length=8,
+                                         is_test=False, name=None):
+    """EMA max-abs scale (ref FakeQuantizeMovingAverageAbsMaxKernel).
+    Returns (out, scale, accum, state)."""
+    q = _qmax(bit_length)
+    if is_test:
+        scale = in_accum / jnp.maximum(in_state, 1e-8)
+        accum, state = in_accum, in_state
+    else:
+        cur = jnp.max(jnp.abs(x))
+        accum, state, scale = _moving_average_scale(
+            in_accum, in_state, cur, moving_rate)
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * q), scale, accum, state
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_accum, in_state, moving_rate=0.9, bit_length=8,
+        is_test=False, name=None):
+    """QAT quant-dequant with EMA scale and STE grad. Returns
+    (out, scale, accum, state)."""
+    if is_test:
+        scale = in_accum / jnp.maximum(in_state, 1e-8)
+        accum, state = in_accum, in_state
+    else:
+        cur = jnp.max(jnp.abs(x))
+        accum, state, scale = _moving_average_scale(
+            in_accum, in_state, cur, moving_rate)
+    out = _quant_dequant_ste(x, scale, _qmax(bit_length))
+    return out, scale, accum, state
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0,
+                                       name=None):
+    """Per-channel quantize (ref FakeChannelWiseQuantizeAbsMaxKernel);
+    returns (out, scales[C])."""
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scales = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    s = jnp.maximum(scales.reshape(shape), 1e-8)
+    q = _qmax(bit_length)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * q), scales
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0, name=None):
+    """Per-channel QAT quant-dequant with STE grad; returns (out, scales)."""
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scales = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    out = _quant_dequant_ste(x, scales.reshape(shape), _qmax(bit_length))
+    return out, scales
+
+
+@register_op("moving_average_abs_max_scale")
+def moving_average_abs_max_scale(x, in_accum, in_state, moving_rate=0.9,
+                                 is_test=False, name=None):
+    """Scale observer only — out = x (ref MovingAverageAbsMaxScaleKernel).
+    Returns (out, scale, accum, state)."""
+    if is_test:
+        return x, in_accum / jnp.maximum(in_state, 1e-8), in_accum, in_state
+    cur = jnp.max(jnp.abs(x))
+    accum, state, scale = _moving_average_scale(in_accum, in_state, cur,
+                                                moving_rate)
+    return x, scale, accum, state
+
+
+@register_op("fake_dequantize_max_abs")
+def fake_dequantize_max_abs(x, scale, max_range, name=None):
+    """out = x * scale / max_range (ref fake_dequantize_op.cc)."""
+    return x * scale / max_range

@@ -1,0 +1,105 @@
+"""TensorArray + array ops (reference LoDTensorArray capability).
+
+Reference: framework/lod_tensor_array.h, operators/tensor_array_to_tensor_op.cc,
+operators/array_operator.h (write_to_array / read_from_array),
+operators/controlflow/ array ops and lod_array_length_op.cc.
+
+TPU design: the reference's TensorArray is the mutable spine of its
+while-loop RNNs. Here eager code gets a functional python-list TensorArray
+(writes return a new array — fits the tape), while *compiled* loops use
+lax.scan's native stacking instead; tensor_array_to_tensor is a registered
+op so the concat/stack step itself is jit-able.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from .registry import register_op
+
+__all__ = ["TensorArray", "write_to_array", "read_from_array",
+           "array_length", "tensor_array_to_tensor", "create_array"]
+
+
+class TensorArray:
+    """Functional tensor array: write returns a new TensorArray sharing
+    unwritten slots (structural sharing via list copy)."""
+
+    def __init__(self, items=None):
+        self._items = list(items or [])
+
+    def write(self, i, x):
+        i = int(_unwrap(i))
+        items = list(self._items)
+        if i == len(items):
+            items.append(x)
+        elif i < len(items):
+            items[i] = x
+        else:
+            items.extend([None] * (i - len(items)))
+            items.append(x)
+        return TensorArray(items)
+
+    def append(self, x):
+        return self.write(len(self._items), x)
+
+    def read(self, i):
+        return self._items[int(_unwrap(i))]
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def stack(self, axis=0):
+        from .manipulation import stack
+        return stack(list(self._items), axis=axis)
+
+    def concat(self, axis=0):
+        from .manipulation import concat
+        return concat(list(self._items), axis=axis)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """paddle.tensor.create_array parity."""
+    return TensorArray(initialized_list)
+
+
+def write_to_array(array, i, x):
+    """ref write_to_array op: array[i] = x (functional — returns the new
+    array)."""
+    if array is None:
+        array = TensorArray()
+    return array.write(i, x)
+
+
+def read_from_array(array, i):
+    """ref read_from_array op."""
+    return array.read(i)
+
+
+def array_length(array):
+    """ref lod_array_length op."""
+    return len(array)
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor_impl(*xs, axis=0, use_stack=False):
+    if use_stack:
+        out = jnp.stack(xs, axis=axis)
+        index = jnp.full((len(xs),), 1, jnp.int32)
+    else:
+        out = jnp.concatenate(xs, axis=axis)
+        index = jnp.asarray([x.shape[axis] for x in xs], jnp.int32)
+    return out, index
+
+
+def tensor_array_to_tensor(array, axis=0, use_stack=False, name=None):
+    """ref tensor_array_to_tensor_op.cc: returns (tensor, out_index) where
+    out_index records each element's extent along `axis`."""
+    items = list(array) if isinstance(array, TensorArray) else list(array)
+    return _tensor_array_to_tensor_impl(*items, axis=axis,
+                                        use_stack=use_stack)

@@ -1,0 +1,258 @@
+"""dy2static break/continue/early-return (reference
+unittests/dygraph_to_static/test_break_continue.py /
+test_return.py patterns): converted output must equal plain-python
+eager output, and tensor-dependent cases must trace under jit."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_function, max_while_iters_guard
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+def _counter():
+    """Infinite generator — converted break must still terminate it."""
+    i = 0
+    while True:
+        yield i
+        i += 1
+
+
+# -- reference test patterns -------------------------------------------------
+
+def while_break(x):                       # test_while_loop_class_var-ish
+    i = paddle.to_tensor(np.float32(0))
+    s = paddle.to_tensor(np.float32(0))
+    while i < 10:
+        if i > x.sum():
+            break
+        s = s + i
+        i = i + 1
+    return s
+
+
+def while_continue(x):
+    i = paddle.to_tensor(np.float32(0))
+    s = paddle.to_tensor(np.float32(0))
+    while i < 6:
+        i = i + 1
+        if i.sum() % 2 == 0:
+            continue
+        s = s + i
+    return s
+
+
+def for_break(x):                         # test_break_in_for_loop
+    s = paddle.to_tensor(np.float32(0))
+    for i in range(8):
+        if s > x.sum():
+            break
+        s = s + 1.0
+    return s
+
+
+def for_continue(x):                      # test_continue_in_for
+    s = paddle.to_tensor(np.float32(0))
+    for i in range(6):
+        if i == 2:
+            continue
+        s = s + float(i)
+    return s
+
+
+def for_break_continue_mixed(x):
+    s = paddle.to_tensor(np.float32(0))
+    for i in range(10):
+        if i == 1:
+            continue
+        if s > x.sum() + 4.0:
+            break
+        s = s + 1.0
+    return s
+
+
+def nested_for_break(x):                  # break binds the inner loop
+    s = paddle.to_tensor(np.float32(0))
+    for i in range(3):
+        for j in range(5):
+            if j == 2:
+                break
+            s = s + 1.0
+    return s
+
+
+def early_return_in_if(x):                # test_return patterns
+    if x.sum() > 0:
+        return x * 2.0
+    return x - 1.0
+
+
+def return_in_for(x):                     # return inside loop
+    s = paddle.to_tensor(np.float32(0))
+    for i in range(10):
+        s = s + x.sum()
+        if s > 5.0:
+            return s * 10.0
+    return s
+
+
+def return_in_while(x):
+    i = paddle.to_tensor(np.float32(0))
+    while i < 10:
+        i = i + x.sum() * x.sum() + 0.5   # always makes progress
+        if i > 7.0:
+            return i + 0.5
+    return i
+
+
+def return_no_value(x):
+    if x.sum() > 100.0:
+        return
+    return x + 1.0
+
+
+def break_after_stmts(x):                 # statements after break-if run
+    s = paddle.to_tensor(np.float32(0))
+    t = paddle.to_tensor(np.float32(0))
+    for i in range(5):
+        if i == 3:
+            break
+        s = s + 1.0
+        t = t + s
+    return s + t
+
+
+def continue_skips_tail(x):
+    s = paddle.to_tensor(np.float32(0))
+    t = paddle.to_tensor(np.float32(0))
+    for i in range(6):
+        if i % 2 == 0:
+            continue
+        s = s + 1.0
+        t = t + 10.0
+    return s + t
+
+
+def for_range_step_break(x):
+    s = paddle.to_tensor(np.float32(0))
+    for i in range(8, 0, -2):
+        if i == 2:
+            break
+        s = s + float(i)
+    return s
+
+
+ALL_FNS = [while_break, while_continue, for_break, for_continue,
+           for_break_continue_mixed, nested_for_break, early_return_in_if,
+           return_in_for, return_in_while, return_no_value,
+           break_after_stmts, continue_skips_tail, for_range_step_break]
+
+
+class TestEagerEquivalence:
+    """Converted function == original python on concrete tensors."""
+
+    @pytest.mark.parametrize("fn", ALL_FNS, ids=lambda f: f.__name__)
+    @pytest.mark.parametrize("val", [-2.0, 0.5, 3.0])
+    def test_matches_python(self, fn, val):
+        x = paddle.to_tensor(np.float32([val]))
+        expect = fn(x)
+        got = convert_function(fn)(x)
+        if expect is None:
+            assert got is None
+        else:
+            np.testing.assert_allclose(_np(got), _np(expect), rtol=1e-6)
+
+
+class TestTracedBreakContinue:
+    """The flag-form loops must compile: whole function under jax.jit."""
+
+    def _jit_check(self, fn, val, max_while=None):
+        conv = convert_function(fn)
+        expect = fn(paddle.to_tensor(np.float32([val])))
+
+        def pure(arr):
+            out = conv(paddle.Tensor(arr))
+            return out._data
+
+        ctx = max_while_iters_guard(max_while) if max_while else None
+        if ctx:
+            with ctx:
+                got = jax.jit(pure)(np.float32([val]))
+        else:
+            got = jax.jit(pure)(np.float32([val]))
+        np.testing.assert_allclose(np.asarray(got), _np(expect),
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("val", [-2.0, 0.5, 3.0])
+    def test_while_break_traced(self, val):
+        self._jit_check(while_break, val)
+
+    def test_while_continue_traced(self):
+        self._jit_check(while_continue, 1.0)
+
+    @pytest.mark.parametrize("val", [-2.0, 3.0])
+    def test_for_break_traced(self, val):
+        self._jit_check(for_break, val)
+
+    def test_mixed_traced(self):
+        self._jit_check(for_break_continue_mixed, 0.5)
+
+    def test_return_in_while_traced_raises_clear_error(self):
+        # a traced return-in-while is one-sided: the merged return value
+        # has no pre-loop structure — restriction documented in the
+        # module docstring, surfaced as ConversionError
+        from paddle_tpu.jit.dy2static import ConversionError
+        conv = convert_function(return_in_while)
+
+        def pure(arr):
+            return conv(paddle.Tensor(arr))._data
+
+        with pytest.raises(ConversionError, match="not defined before"):
+            jax.jit(pure)(np.float32([0.3]))
+
+    def test_early_return_matched_traced(self):
+        # both paths return -> mergeable under trace
+        conv = convert_function(early_return_in_if)
+
+        def pure(arr):
+            return conv(paddle.Tensor(arr))._data
+
+        for v in (-1.0, 2.0):
+            got = jax.jit(pure)(np.float32([v]))
+            np.testing.assert_allclose(
+                np.asarray(got),
+                _np(early_return_in_if(paddle.to_tensor(np.float32([v])))),
+                rtol=1e-6)
+
+    def test_nonrange_iterable_break_keeps_python_semantics(self):
+        # break in a for over an arbitrary iterable must NOT be
+        # flag-rewritten (that would drain the iterator / hang on
+        # infinite generators)
+        def gen_break(x):
+            s = paddle.to_tensor(np.float32(0))
+            seen = []
+            for v in _counter():
+                if v == 3:
+                    break
+                seen.append(v)
+                s = s + 1.0
+            return s, len(seen)
+
+        conv = convert_function(gen_break)
+        s, n = conv(paddle.to_tensor(np.float32([1.0])))
+        assert n == 3
+        np.testing.assert_allclose(_np(s), 3.0)
+
+    def test_grad_through_break_loop(self):
+        # differentiability: unrolled range-for with tensor-if break
+        conv = convert_function(for_break)
+
+        def loss(arr):
+            return conv(paddle.Tensor(arr))._data.sum()
+
+        g = jax.grad(loss)(np.float32([2.0]))
+        assert np.isfinite(np.asarray(g)).all()

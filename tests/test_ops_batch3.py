@@ -1,0 +1,755 @@
+"""Op batch 3: long-tail misc ops, extra losses, op-level RNN family.
+
+OpTest receipts (numpy ref + numeric grad) for the ops added to close the
+reference op-surface gap; RNN ops are cross-checked against torch's
+reference implementations (same gate order/layout by construction).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+
+from op_test import OpTest
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# misc manipulation ops
+# ---------------------------------------------------------------------------
+
+class TestPartialConcat(OpTest):
+    op_fn = staticmethod(ops.partial_concat.__wrapped__
+                         if hasattr(ops.partial_concat, "__wrapped__")
+                         else ops.partial_concat)
+    inputs = {"x": [rng.randn(3, 8).astype(np.float32),
+                    rng.randn(3, 8).astype(np.float32)]}
+    attrs = {"start_index": 2, "length": 4}
+
+    def test(self):
+        xs = [paddle.to_tensor(v) for v in self.inputs["x"]]
+        out = ops.partial_concat(xs, **self.attrs)
+        ref = np.concatenate([v[:, 2:6] for v in self.inputs["x"]], axis=1)
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-6)
+
+    def test_grad(self):
+        xs = [paddle.to_tensor(v) for v in self.inputs["x"]]
+        for x in xs:
+            x.stop_gradient = False
+        ops.partial_concat(xs, **self.attrs).sum().backward()
+        g = np.zeros((3, 8), np.float32)
+        g[:, 2:6] = 1.0
+        for x in xs:
+            np.testing.assert_allclose(_np(x.grad), g)
+
+
+class TestPartialSum(OpTest):
+    def test(self):
+        a = rng.randn(3, 8).astype(np.float32)
+        b = rng.randn(3, 8).astype(np.float32)
+        out = ops.partial_sum([paddle.to_tensor(a), paddle.to_tensor(b)],
+                              start_index=1, length=5)
+        np.testing.assert_allclose(_np(out), a[:, 1:6] + b[:, 1:6],
+                                   rtol=1e-6)
+
+
+class TestPadConstantLike(OpTest):
+    op_fn = staticmethod(ops.pad_constant_like)
+    ref_fn = staticmethod(
+        lambda x, y, pad_value=0.0: np.pad(
+            y, [(0, a - b) for a, b in zip(x.shape, y.shape)],
+            constant_values=pad_value))
+    inputs = {"x": rng.randn(4, 6).astype(np.float32),
+              "y": rng.randn(2, 5).astype(np.float32)}
+    attrs = {"pad_value": 1.5}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["y"])
+
+
+class TestSpaceToDepth(OpTest):
+    op_fn = staticmethod(ops.space_to_depth)
+    inputs = {"x": rng.randn(2, 3, 4, 4).astype(np.float32)}
+    attrs = {"blocksize": 2}
+
+    @staticmethod
+    def ref_fn(x, blocksize):
+        n, c, h, w = x.shape
+        b = blocksize
+        y = x.reshape(n, c, h // b, b, w // b, b)
+        return y.transpose(0, 3, 5, 1, 2, 4).reshape(
+            n, c * b * b, h // b, w // b)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+    def test_pixel_unshuffle_inverse(self):
+        # space_to_depth must invert pixel_shuffle's layout claim
+        x = paddle.to_tensor(self.inputs["x"])
+        down = ops.space_to_depth(x, 2)
+        assert tuple(down.shape) == (2, 12, 2, 2)
+
+
+class TestConvShift(OpTest):
+    op_fn = staticmethod(ops.conv_shift)
+    inputs = {"x": rng.randn(3, 10).astype(np.float32),
+              "y": rng.randn(3, 3).astype(np.float32)}
+
+    @staticmethod
+    def ref_fn(x, y):
+        b, m = x.shape
+        n = y.shape[1]
+        out = np.zeros_like(x)
+        for bi in range(b):
+            for i in range(m):
+                for j in range(n):
+                    out[bi, i] += x[bi, (i + j - n // 2) % m] * y[bi, j]
+        return out
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestRowConv(OpTest):
+    op_fn = staticmethod(ops.row_conv)
+    inputs = {"x": rng.randn(2, 6, 4).astype(np.float32),
+              "filt": rng.randn(3, 4).astype(np.float32)}
+
+    @staticmethod
+    def ref_fn(x, filt):
+        b, t, d = x.shape
+        k = filt.shape[0]
+        out = np.zeros_like(x)
+        for j in range(k):
+            for ti in range(t):
+                if ti + j < t:
+                    out[:, ti] += x[:, ti + j] * filt[j]
+        return out
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "filt"])
+
+
+class TestAddPositionEncoding(OpTest):
+    def test(self):
+        x = rng.randn(2, 5, 8).astype(np.float32)
+        out = _np(ops.add_position_encoding(paddle.to_tensor(x),
+                                            alpha=0.7, beta=1.3))
+        pos = np.arange(5)[:, None]
+        div = 10000.0 ** (np.arange(4) / 4.0)
+        pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], axis=1)
+        ref = 0.7 * x + 1.3 * pe[None]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestSpp(OpTest):
+    def test(self):
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        out = _np(ops.spp(paddle.to_tensor(x), 2, "avg"))
+        l0 = x.mean(axis=(2, 3)).reshape(2, 3)
+        l1 = x.reshape(2, 3, 2, 4, 2, 4).mean(axis=(3, 5)).reshape(2, 12)
+        np.testing.assert_allclose(out, np.concatenate([l0, l1], 1),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSequenceConv(OpTest):
+    def test_vs_manual(self):
+        x = rng.randn(2, 5, 3).astype(np.float32)
+        filt = rng.randn(9, 4).astype(np.float32)
+        lens = np.array([3, 5])
+        out = _np(ops.sequence_conv(
+            paddle.to_tensor(x), paddle.to_tensor(filt),
+            length=paddle.to_tensor(lens), context_length=3))
+        # manual: context window [-1, 0, 1], zero outside [0, len)
+        ref = np.zeros((2, 5, 4), np.float32)
+        for b in range(2):
+            for t in range(5):
+                win = []
+                for off in (-1, 0, 1):
+                    p = t + off
+                    win.append(x[b, p] if 0 <= p < lens[b]
+                               else np.zeros(3, np.float32))
+                ref[b, t] = np.concatenate(win) @ filt
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestSequenceScatter(OpTest):
+    def test(self):
+        x = np.zeros((2, 6), np.float32)
+        idx = np.array([[0, 2, 2], [1, 3, 5]])
+        upd = rng.randn(2, 3).astype(np.float32)
+        out = _np(ops.sequence_scatter(
+            paddle.to_tensor(x), paddle.to_tensor(idx),
+            paddle.to_tensor(upd), length=paddle.to_tensor(
+                np.array([2, 3]))))
+        ref = x.copy()
+        ref[0, 0] += upd[0, 0]
+        ref[0, 2] += upd[0, 1]          # 3rd masked (len 2)
+        ref[1, 1] += upd[1, 0]
+        ref[1, 3] += upd[1, 1]
+        ref[1, 5] += upd[1, 2]
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestSequenceTopkAvgPooling(OpTest):
+    def test(self):
+        x = rng.randn(2, 3, 7).astype(np.float32)
+        out = _np(ops.sequence_topk_avg_pooling(paddle.to_tensor(x),
+                                                topks=(1, 3)))
+        srt = np.sort(x, axis=-1)[..., ::-1]
+        ref = np.concatenate([srt[..., :1].mean(-1), srt[..., :3].mean(-1)],
+                             axis=-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestNormOps(OpTest):
+    def test_l1_squared_l2(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            float(_np(ops.l1_norm(paddle.to_tensor(x)))),
+            np.abs(x).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_np(ops.squared_l2_norm(paddle.to_tensor(x)))),
+            (x ** 2).sum(), rtol=1e-5)
+
+    def test_squared_l2_distance(self):
+        x = rng.randn(4, 3).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        sub, out = ops.squared_l2_distance(paddle.to_tensor(x),
+                                           paddle.to_tensor(y))
+        np.testing.assert_allclose(_np(out), ((x - y) ** 2).sum(1),
+                                   rtol=1e-5)
+
+
+class TestSelectInputOutput(OpTest):
+    def test_select_input(self):
+        a = paddle.to_tensor(np.full((2, 2), 1.0, np.float32))
+        b = paddle.to_tensor(np.full((2, 2), 2.0, np.float32))
+        m = paddle.to_tensor(np.array(1, np.int32))
+        out = ops.select_input([a, b], m)
+        np.testing.assert_allclose(_np(out), 2.0)
+
+    def test_select_output(self):
+        x = paddle.to_tensor(np.full((2,), 3.0, np.float32))
+        outs = ops.select_output(x, paddle.to_tensor(
+            np.array(0, np.int32)), n_out=2)
+        np.testing.assert_allclose(_np(outs[0]), 3.0)
+        np.testing.assert_allclose(_np(outs[1]), 0.0)
+
+
+class TestShuffleSplitMerge(OpTest):
+    def test_shuffle_batch(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        out, idx = ops.shuffle_batch(paddle.to_tensor(x), seed=3)
+        np.testing.assert_allclose(np.sort(_np(out), axis=0),
+                                   np.sort(x, axis=0))
+        np.testing.assert_allclose(_np(out), x[_np(idx)])
+
+    def test_split_merge_ids(self):
+        ids = np.array([7, 2, 9, 4, 2], np.int64)
+        shards = ops.split_ids(paddle.to_tensor(ids), 3)
+        assert sum(s.shape[0] for s in shards) == 5
+        for s, arr in enumerate(shards):
+            assert all(int(v) % 3 == s for v in _np(arr))
+        # merge: lookup rows per shard then reassemble
+        table = rng.randn(10, 4).astype(np.float32)
+        rows, vals = [], []
+        for s in shards:
+            r = np.unique(_np(s))
+            rows.append(paddle.to_tensor(r))
+            vals.append(paddle.to_tensor(table[r]))
+        merged = ops.merge_ids(paddle.to_tensor(ids), rows, vals)
+        np.testing.assert_allclose(_np(merged), table[ids], rtol=1e-6)
+
+    def test_filter_by_instag(self):
+        ins = np.arange(8, dtype=np.float32).reshape(4, 2)
+        tags = np.array([1, 2, 3, 1, 5], np.int64)   # lens 2,1,1,1
+        lens = np.array([2, 1, 1, 1], np.int64)
+        out, idx, w = ops.filter_by_instag(
+            paddle.to_tensor(ins), paddle.to_tensor(lens),
+            paddle.to_tensor(tags), paddle.to_tensor(
+                np.array([1], np.int64)))
+        np.testing.assert_allclose(_np(idx), [0, 2])
+        np.testing.assert_allclose(_np(out), ins[[0, 2]])
+
+    def test_selected_rows_utils(self):
+        from paddle_tpu.core.selected_rows import SelectedRows
+        sr = SelectedRows(np.array([1, 5, 8]), rng.randn(3, 4), 10)
+        parts = ops.split_selected_rows(sr, [5, 5])
+        assert _np(parts[0].rows).tolist() == [1]
+        assert _np(parts[1].rows).tolist() == [0, 3]
+        dense = ops.get_tensor_from_selected_rows(sr)
+        assert tuple(dense.shape) == (3, 4)
+
+    def test_print_op_identity(self, capsys):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = ops.print_op(x, message="dbg: ")
+        np.testing.assert_allclose(_np(y), 1.0)
+        assert "dbg" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+class TestHingeLoss(OpTest):
+    op_fn = staticmethod(ops.hinge_loss)
+    ref_fn = staticmethod(
+        lambda x, y: np.maximum(0.0, 1 - x * (2 * y - 1)))
+    inputs = {"logits": rng.randn(6, 1).astype(np.float32),
+              "labels": rng.randint(0, 2, (6, 1)).astype(np.float32)}
+    grad_inputs = ["logits"]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["logits"])
+
+
+class TestHuberLoss(OpTest):
+    def test(self):
+        x = rng.randn(8).astype(np.float32)
+        y = rng.randn(8).astype(np.float32)
+        r, loss = ops.huber_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 delta=0.8)
+        d = y - x
+        ref = np.where(np.abs(d) <= 0.8, 0.5 * d * d,
+                       0.8 * (np.abs(d) - 0.4))
+        np.testing.assert_allclose(_np(loss), ref, rtol=1e-5, atol=1e-6)
+
+    def test_grad(self):
+        x = paddle.to_tensor(rng.randn(8).astype(np.float32))
+        x.stop_gradient = False
+        ops.huber_loss(x, paddle.to_tensor(
+            rng.randn(8).astype(np.float32)))[1].sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+
+class TestModifiedHuber(OpTest):
+    op_fn = staticmethod(ops.modified_huber_loss)
+    inputs = {"logits": rng.uniform(-2.5, 2.5, (10,)).astype(np.float32),
+              "labels": rng.randint(0, 2, (10,)).astype(np.float32)}
+
+    @staticmethod
+    def ref_fn(x, y):
+        v = x * (2 * y - 1)
+        return np.where(v < -1, -4 * v, np.where(v < 1, (1 - v) ** 2, 0.0))
+
+    def test(self):
+        self.check_output()
+
+
+class TestRankLoss(OpTest):
+    op_fn = staticmethod(ops.rank_loss)
+    ref_fn = staticmethod(
+        lambda lab, l, r: np.log(1 + np.exp(l - r)) - lab * (l - r))
+    inputs = {"label": rng.randint(0, 2, (5, 1)).astype(np.float32),
+              "left": rng.randn(5, 1).astype(np.float32),
+              "right": rng.randn(5, 1).astype(np.float32)}
+    grad_inputs = ["left", "right"]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["left", "right"])
+
+
+class TestBprLoss(OpTest):
+    def test(self):
+        x = rng.randn(4, 5).astype(np.float32)
+        lbl = rng.randint(0, 5, (4,)).astype(np.int64)
+        out = _np(ops.bpr_loss(paddle.to_tensor(x), paddle.to_tensor(lbl)))
+        ref = np.zeros((4, 1), np.float32)
+        for i in range(4):
+            s = 0.0
+            for j in range(5):
+                if j == lbl[i]:
+                    continue
+                s += -np.log(1.0 + np.exp(x[i, j] - x[i, lbl[i]]))
+            ref[i, 0] = -s / 4
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestCenterLoss(OpTest):
+    def test(self):
+        x = rng.randn(5, 3).astype(np.float32)
+        lbl = np.array([0, 1, 0, 2, 1], np.int64)
+        centers = rng.randn(3, 3).astype(np.float32)
+        loss, diff, cout = ops.center_loss(
+            paddle.to_tensor(x), paddle.to_tensor(lbl),
+            paddle.to_tensor(centers), alpha=0.1)
+        ref_diff = x - centers[lbl]
+        np.testing.assert_allclose(
+            _np(loss), 0.5 * (ref_diff ** 2).sum(1, keepdims=True),
+            rtol=1e-5)
+        ref_c = centers.copy()
+        for c in range(3):
+            m = lbl == c
+            ref_c[c] += 0.1 * ref_diff[m].sum(0) / (1.0 + m.sum())
+        np.testing.assert_allclose(_np(cout), ref_c, rtol=1e-4, atol=1e-5)
+
+
+class TestTeacherStudent(OpTest):
+    def test(self):
+        x = rng.randn(6).astype(np.float32)
+        lbl = np.array([-2.0, -0.5, 0.3, 1.7, -2.0, 0.9], np.float32)
+        out = _np(ops.teacher_student_sigmoid_loss(
+            paddle.to_tensor(x), paddle.to_tensor(lbl)))
+        sp = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+        ref = np.where(
+            lbl < -1.0, sp,
+            np.where(lbl < 0.0, sp - x,
+                     np.where(lbl < 1.0, sp + sp - lbl * x,
+                              sp - x + sp - (lbl - 1.0) * x)))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestFsp(OpTest):
+    op_fn = staticmethod(ops.fsp)
+    ref_fn = staticmethod(
+        lambda x, y: np.einsum("bihw,bjhw->bij", x, y) / (
+            x.shape[2] * x.shape[3]))
+    inputs = {"x": rng.randn(2, 3, 4, 5).astype(np.float32),
+              "y": rng.randn(2, 6, 4, 5).astype(np.float32)}
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["x", "y"])
+
+
+class TestCvmDataNorm(OpTest):
+    def test_cvm(self):
+        x = np.abs(rng.randn(3, 6)).astype(np.float32)
+        out = _np(ops.cvm(paddle.to_tensor(x), use_cvm=True))
+        c0 = np.log(x[:, 0] + 1)
+        c1 = np.log(x[:, 1] + 1) - c0
+        np.testing.assert_allclose(out[:, 0], c0, rtol=1e-5)
+        np.testing.assert_allclose(out[:, 1], c1, rtol=1e-5)
+        np.testing.assert_allclose(out[:, 2:], x[:, 2:])
+        out2 = _np(ops.cvm(paddle.to_tensor(x), use_cvm=False))
+        np.testing.assert_allclose(out2, x[:, 2:])
+
+    def test_data_norm(self):
+        x = rng.randn(5, 3).astype(np.float32)
+        bsize = np.full((3,), 10.0, np.float32)
+        bsum = rng.randn(3).astype(np.float32) * 10
+        bsq = np.abs(rng.randn(3)).astype(np.float32) * 10 + 5
+        y, means, scales = ops.data_norm(
+            paddle.to_tensor(x), paddle.to_tensor(bsize),
+            paddle.to_tensor(bsum), paddle.to_tensor(bsq))
+        np.testing.assert_allclose(_np(means), bsum / bsize, rtol=1e-5)
+        np.testing.assert_allclose(_np(scales), np.sqrt(bsize / bsq),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(y), (x - bsum / bsize) * np.sqrt(bsize / bsq), rtol=1e-5)
+
+
+class TestHierarchicalSigmoid(OpTest):
+    def test_vs_manual_bitcode(self):
+        n = 6
+        x = rng.randn(4, 5).astype(np.float32)
+        lbl = np.array([0, 3, 5, 2], np.int64)
+        w = rng.randn(n - 1 + n, 5).astype(np.float32) * 0.3
+        b = rng.randn(n - 1 + n).astype(np.float32) * 0.1
+        cost, pre = ops.hierarchical_sigmoid(
+            paddle.to_tensor(x), paddle.to_tensor(lbl),
+            paddle.to_tensor(w), paddle.to_tensor(b), num_classes=n)
+        ref = np.zeros((4, 1), np.float32)
+        for i in range(4):
+            c = int(lbl[i]) + n
+            length = int(np.floor(np.log2(c)))
+            for bit in range(length):
+                idx = (c >> (bit + 1)) - 1
+                tgt = float((c >> bit) & 1)
+                z = x[i] @ w[idx] + b[idx]
+                ref[i, 0] += (max(z, 0) + np.log1p(np.exp(-abs(z)))
+                              - tgt * z)
+        np.testing.assert_allclose(_np(cost), ref, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(9, 4).astype(np.float32))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        cost, _ = ops.hierarchical_sigmoid(
+            x, paddle.to_tensor(np.array([1, 4, 2], np.int64)), w,
+            num_classes=5)
+        cost.sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+        assert np.isfinite(_np(w.grad)).all()
+
+
+class TestNceSampleLogits(OpTest):
+    def test_nce_structure(self):
+        x = paddle.to_tensor(rng.randn(4, 6).astype(np.float32))
+        lbl = paddle.to_tensor(np.array([1, 0, 3, 2], np.int64))
+        w = paddle.to_tensor(rng.randn(8, 6).astype(np.float32))
+        b = paddle.to_tensor(np.zeros(8, np.float32))
+        x.stop_gradient = False
+        cost, logits, samples = ops.nce(x, lbl, w, b,
+                                        num_total_classes=8,
+                                        num_neg_samples=4, seed=0)
+        assert tuple(cost.shape) == (4, 1)
+        assert (_np(cost) > 0).all()
+        assert tuple(samples.shape) == (4, 5)
+        np.testing.assert_allclose(_np(samples)[:, 0], [1, 0, 3, 2])
+        cost.sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_sample_logits(self):
+        logits = rng.randn(3, 12).astype(np.float32)
+        lbl = np.array([[2], [5], [7]], np.int64)
+        s, p, sl, slab = ops.sample_logits(
+            paddle.to_tensor(logits), paddle.to_tensor(lbl),
+            num_samples=6, seed=1)
+        s_, p_, sl_ = _np(s), _np(p), _np(sl)
+        np.testing.assert_allclose(s_[:, 0].ravel(), lbl.ravel())
+        # sampled logits = gathered - log q
+        for i in range(3):
+            np.testing.assert_allclose(
+                sl_[i, 0], logits[i, lbl[i, 0]] - np.log(p_[i, 0] + 1e-12),
+                rtol=1e-4)
+        # accidental hits of the true class masked to -inf-ish
+        for i in range(3):
+            for j in range(1, 7):
+                if s_[i, j] == lbl[i, 0]:
+                    assert sl_[i, j] < -1e19
+
+
+class TestMatchMatrixTensor(OpTest):
+    def test(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        y = rng.randn(2, 5, 6).astype(np.float32)
+        w = rng.randn(4, 2, 6).astype(np.float32)
+        out, tmp = ops.match_matrix_tensor(
+            paddle.to_tensor(x), paddle.to_tensor(y), paddle.to_tensor(w))
+        ref = np.einsum("bsd,dce,bte->bcst", x, w, y)
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# op-level RNN family vs torch
+# ---------------------------------------------------------------------------
+
+def _torch_weights(mod, layer, direction, num_dir):
+    sfx = "_reverse" if direction == 1 else ""
+    return [getattr(mod, f"{n}_l{layer}{sfx}").detach().numpy()
+            for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")]
+
+
+class TestRnnOpVsTorch(OpTest):
+    @pytest.mark.parametrize("mode,bidir,layers", [
+        ("LSTM", False, 1), ("LSTM", True, 2), ("GRU", False, 2),
+        ("RNN_TANH", True, 1)])
+    def test_modes(self, mode, bidir, layers):
+        b_, t_, d_, h_ = 3, 6, 4, 5
+        x = rng.randn(b_, t_, d_).astype(np.float32)
+        cls = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+               "RNN_TANH": torch.nn.RNN}[mode]
+        tm = cls(d_, h_, num_layers=layers, batch_first=True,
+                 bidirectional=bidir)
+        num_dir = 2 if bidir else 1
+        weights = []
+        for layer in range(layers):
+            for d in range(num_dir):
+                weights += _torch_weights(tm, layer, d, num_dir)
+        ours = ops.rnn(paddle.to_tensor(x),
+                       *[paddle.to_tensor(w) for w in weights],
+                       mode=mode, num_layers=layers, is_bidirec=bidir)
+        with torch.no_grad():
+            tout, tstate = tm(torch.tensor(x))
+        np.testing.assert_allclose(_np(ours[0]), tout.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        th = (tstate[0] if mode == "LSTM" else tstate).numpy()
+        np.testing.assert_allclose(_np(ours[1]), th, rtol=1e-4, atol=1e-5)
+
+    def test_sequence_length_masking(self):
+        b_, t_, d_, h_ = 2, 5, 3, 4
+        x = rng.randn(b_, t_, d_).astype(np.float32)
+        lens = np.array([3, 5])
+        tm = torch.nn.LSTM(d_, h_, batch_first=True)
+        weights = _torch_weights(tm, 0, 0, 1)
+        out, hT, cT = ops.rnn(paddle.to_tensor(x),
+                              *[paddle.to_tensor(w) for w in weights],
+                              mode="LSTM",
+                              sequence_length=paddle.to_tensor(lens))
+        packed = torch.nn.utils.rnn.pack_padded_sequence(
+            torch.tensor(x), torch.tensor(lens), batch_first=True,
+            enforce_sorted=False)
+        with torch.no_grad():
+            pout, (ph, pc) = tm(packed)
+        unpacked, _ = torch.nn.utils.rnn.pad_packed_sequence(
+            pout, batch_first=True)
+        np.testing.assert_allclose(_np(out), unpacked.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(hT)[0], ph[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(cT)[0], pc[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_ragged_vs_torch(self):
+        # reverse direction must reverse within each valid prefix, not
+        # flip padding into the sequence
+        b_, t_, d_, h_ = 3, 6, 4, 5
+        x = rng.randn(b_, t_, d_).astype(np.float32)
+        lens = np.array([4, 6, 2])
+        x[0, 4:] = 1000.0     # poison the padding: must not leak
+        x[2, 2:] = -1000.0
+        tm = torch.nn.LSTM(d_, h_, batch_first=True, bidirectional=True)
+        weights = (_torch_weights(tm, 0, 0, 2)
+                   + _torch_weights(tm, 0, 1, 2))
+        out, hT, cT = ops.rnn(paddle.to_tensor(x),
+                              *[paddle.to_tensor(w) for w in weights],
+                              mode="LSTM", is_bidirec=True,
+                              sequence_length=paddle.to_tensor(lens))
+        packed = torch.nn.utils.rnn.pack_padded_sequence(
+            torch.tensor(x), torch.tensor(lens), batch_first=True,
+            enforce_sorted=False)
+        with torch.no_grad():
+            pout, (ph, pc) = tm(packed)
+        unpacked, _ = torch.nn.utils.rnn.pad_packed_sequence(
+            pout, batch_first=True)
+        for i in range(b_):
+            np.testing.assert_allclose(
+                _np(out)[i, :lens[i]], unpacked.numpy()[i, :lens[i]],
+                rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(hT), ph.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rnn_grad_flows(self):
+        b_, t_, d_, h_ = 2, 4, 3, 4
+        x = paddle.to_tensor(rng.randn(b_, t_, d_).astype(np.float32))
+        ws = [paddle.to_tensor(
+            (rng.randn(*s) * 0.2).astype(np.float32)) for s in
+            [(4 * h_, d_), (4 * h_, h_), (4 * h_,), (4 * h_,)]]
+        x.stop_gradient = False
+        for w in ws:
+            w.stop_gradient = False
+        out, hT, cT = ops.rnn(x, *ws, mode="LSTM")
+        out.sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+        assert all(np.isfinite(_np(w.grad)).all() for w in ws)
+
+
+class TestLstmGruUnits(OpTest):
+    def test_lstm_unit(self):
+        x = rng.randn(3, 8).astype(np.float32)
+        c0 = rng.randn(3, 2).astype(np.float32)
+        c, h = ops.lstm_unit(paddle.to_tensor(x), paddle.to_tensor(c0),
+                             forget_bias=0.5)
+        i, f, g, o = np.split(x, 4, axis=1)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        cref = sig(f + 0.5) * c0 + sig(i) * np.tanh(g)
+        np.testing.assert_allclose(_np(c), cref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(h), sig(o) * np.tanh(cref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_unit_origin_mode(self):
+        h_ = 3
+        x = rng.randn(2, 3 * h_).astype(np.float32)
+        hp = rng.randn(2, h_).astype(np.float32)
+        w = rng.randn(h_, 3 * h_).astype(np.float32) * 0.3
+        hid, rhp, gate = ops.gru_unit(
+            paddle.to_tensor(x), paddle.to_tensor(hp),
+            paddle.to_tensor(w), origin_mode=True)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        ur = x[:, :2 * h_] + hp @ w[:, :2 * h_]
+        u, r = np.split(sig(ur), 2, axis=1)
+        c = np.tanh(x[:, 2 * h_:] + (r * hp) @ w[:, 2 * h_:])
+        np.testing.assert_allclose(_np(hid), u * hp + (1 - u) * c,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestFusionOps(OpTest):
+    def test_fusion_lstm_matches_lstm(self):
+        b_, t_, d_, h_ = 2, 4, 3, 5
+        x = rng.randn(b_, t_, d_).astype(np.float32)
+        ws = [(rng.randn(*s) * 0.2).astype(np.float32) for s in
+              [(4 * h_, d_), (4 * h_, h_), (4 * h_,), (4 * h_,)]]
+        a = ops.lstm(paddle.to_tensor(x), *map(paddle.to_tensor, ws))
+        b = ops.fusion_lstm(paddle.to_tensor(x), *map(paddle.to_tensor, ws))
+        np.testing.assert_allclose(_np(a[0]), _np(b[0]), rtol=1e-6)
+
+    def test_fusion_gru_vs_torch(self):
+        b_, t_, d_, h_ = 2, 5, 3, 4
+        x = rng.randn(b_, t_, d_).astype(np.float32)
+        tm = torch.nn.GRU(d_, h_, batch_first=True)
+        ws = _torch_weights(tm, 0, 0, 1)
+        out, hT = ops.fusion_gru(paddle.to_tensor(x),
+                                 *map(paddle.to_tensor, ws))
+        with torch.no_grad():
+            tout, th = tm(torch.tensor(x))
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fusion_repeated_fc_relu(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        w1 = rng.randn(4, 5).astype(np.float32)
+        b1 = rng.randn(5).astype(np.float32)
+        w2 = rng.randn(5, 2).astype(np.float32)
+        b2 = rng.randn(2).astype(np.float32)
+        out = ops.fusion_repeated_fc_relu(
+            paddle.to_tensor(x),
+            [paddle.to_tensor(w1), paddle.to_tensor(w2)],
+            [paddle.to_tensor(b1), paddle.to_tensor(b2)])
+        ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fusion_seqpool_concat(self):
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 5, 4).astype(np.float32)
+        out = ops.fusion_seqpool_concat(
+            [paddle.to_tensor(a), paddle.to_tensor(b)], pooltype="SUM")
+        ref = np.concatenate([a.sum(1), b.sum(1)], axis=1)
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-5, atol=1e-5)
+
+    def test_fusion_seqexpand_concat_fc(self):
+        ref_in = rng.randn(2, 4, 3).astype(np.float32)
+        v = rng.randn(2, 2).astype(np.float32)
+        w = rng.randn(5, 6).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        out = ops.fusion_seqexpand_concat_fc(
+            paddle.to_tensor(ref_in), [paddle.to_tensor(v)],
+            paddle.to_tensor(w), paddle.to_tensor(b))
+        cat = np.concatenate(
+            [ref_in, np.broadcast_to(v[:, None, :], (2, 4, 2))], axis=-1)
+        np.testing.assert_allclose(_np(out), np.maximum(cat @ w + b, 0),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fusion_squared_mat_sub(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        out = ops.fusion_squared_mat_sub(paddle.to_tensor(x),
+                                         paddle.to_tensor(y), scalar=0.5)
+        ref = 0.5 * ((x @ y) ** 2 - (x ** 2) @ (y ** 2))
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-4)
+
+    def test_batch_fc_rank_attention(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        w = rng.randn(2, 4, 5).astype(np.float32)
+        bias = rng.randn(2, 1, 5).astype(np.float32)
+        out = ops.batch_fc(paddle.to_tensor(x), paddle.to_tensor(w),
+                           paddle.to_tensor(bias))
+        ref = np.maximum(np.einsum("snd,sdm->snm", x, w) + bias, 0)
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+        xr = rng.randn(4, 3).astype(np.float32)
+        rank = np.array([0, 2, 1, 0], np.int64)
+        par = rng.randn(3, 3, 2).astype(np.float32)
+        out2 = ops.rank_attention(paddle.to_tensor(xr),
+                                  paddle.to_tensor(rank),
+                                  paddle.to_tensor(par))
+        ref2 = np.stack([xr[i] @ par[rank[i]] for i in range(4)])
+        np.testing.assert_allclose(_np(out2), ref2, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,380 @@
+"""Op batch 4: QAT fake-quantization, vision long-tail (deformable conv,
+PS/precise ROI pooling, perspective transform, correlation, tree/var
+conv), cross-replica sync_batch_norm, TensorArray."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+rng = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+class TestFakeQuantize:
+    def test_abs_max(self):
+        x = rng.randn(4, 5).astype(np.float32)
+        out, scale = ops.fake_quantize_abs_max(paddle.to_tensor(x),
+                                               bit_length=8)
+        s = np.abs(x).max()
+        np.testing.assert_allclose(float(_np(scale)), s, rtol=1e-6)
+        np.testing.assert_allclose(_np(out), np.round(x / s * 127),
+                                   atol=0.51)
+        assert np.all(np.abs(_np(out)) <= 127)
+
+    def test_quant_dequant_ste_grad(self):
+        x = paddle.to_tensor(rng.randn(6).astype(np.float32))
+        x.stop_gradient = False
+        out, scale = ops.fake_quantize_dequantize_abs_max(x, bit_length=8)
+        # quant error bounded by scale/qmax/2
+        err = np.abs(_np(out) - _np(x))
+        assert err.max() <= float(_np(scale)) / 127 / 2 + 1e-6
+        out.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), np.ones(6), rtol=1e-6)
+
+    def test_channel_wise(self):
+        x = rng.randn(3, 4, 2).astype(np.float32)
+        out, scales = ops.fake_channel_wise_quantize_abs_max(
+            paddle.to_tensor(x), bit_length=8, quant_axis=0)
+        np.testing.assert_allclose(_np(scales),
+                                   np.abs(x).max(axis=(1, 2)), rtol=1e-6)
+        for c in range(3):
+            np.testing.assert_allclose(
+                _np(out)[c], np.round(x[c] / np.abs(x[c]).max() * 127),
+                atol=0.51)
+
+    def test_moving_average(self):
+        x = rng.randn(5).astype(np.float32)
+        accum = np.array(2.0, np.float32)
+        state = np.array(3.0, np.float32)
+        out, scale, a2, s2 = ops.fake_quantize_moving_average_abs_max(
+            paddle.to_tensor(x), paddle.to_tensor(accum),
+            paddle.to_tensor(state), moving_rate=0.9)
+        cur = np.abs(x).max()
+        np.testing.assert_allclose(float(_np(a2)), 0.9 * 2.0 + cur,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(_np(s2)), 0.9 * 3.0 + 1, rtol=1e-5)
+        np.testing.assert_allclose(float(_np(scale)),
+                                   (0.9 * 2.0 + cur) / (0.9 * 3.0 + 1),
+                                   rtol=1e-5)
+
+    def test_range_abs_max_window(self):
+        x1 = (rng.randn(4) * 2).astype(np.float32)
+        window = np.zeros(4, np.float32)
+        it = np.array(0, np.int64)
+        out, scale, window, it = ops.fake_quantize_range_abs_max(
+            paddle.to_tensor(x1), paddle.to_tensor(np.array(1.0)),
+            paddle.to_tensor(window), paddle.to_tensor(it), window_size=4)
+        np.testing.assert_allclose(float(_np(scale)), np.abs(x1).max(),
+                                   rtol=1e-5)
+        # second step with smaller max keeps window max
+        x2 = (x1 * 0.1).astype(np.float32)
+        out2, scale2, _, _ = ops.fake_quantize_range_abs_max(
+            paddle.to_tensor(x2), scale, window, it, window_size=4)
+        np.testing.assert_allclose(float(_np(scale2)), np.abs(x1).max(),
+                                   rtol=1e-5)
+
+    def test_observer_and_dequant(self):
+        x = rng.randn(4).astype(np.float32)
+        y, scale, a, s = ops.moving_average_abs_max_scale(
+            paddle.to_tensor(x), paddle.to_tensor(np.array(0.0)),
+            paddle.to_tensor(np.array(0.0)))
+        np.testing.assert_allclose(_np(y), x)
+        deq = ops.fake_dequantize_max_abs(
+            paddle.to_tensor(np.array([127.0, -64.0])),
+            paddle.to_tensor(np.array(0.5)), 127.0)
+        np.testing.assert_allclose(_np(deq), [0.5, -0.251968], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv family
+# ---------------------------------------------------------------------------
+
+def _ref_conv(x, w, stride=1, pad=1):
+    return np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (stride, stride),
+        [(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+
+
+class TestDeformableConv:
+    def test_zero_offset_equals_conv(self):
+        x = rng.randn(2, 4, 6, 6).astype(np.float32)
+        w = rng.randn(5, 4, 3, 3).astype(np.float32)
+        offset = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        mask = np.ones((2, 9, 6, 6), np.float32)
+        out = ops.deformable_conv(paddle.to_tensor(x),
+                                  paddle.to_tensor(offset),
+                                  paddle.to_tensor(mask),
+                                  paddle.to_tensor(w), stride=1, padding=1)
+        np.testing.assert_allclose(_np(out), _ref_conv(x, w), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_v1_integer_shift(self):
+        # constant offset (dy=1, dx=0) == conv over shifted input
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        offset = np.zeros((1, 2 * 9, 8, 8), np.float32)
+        offset[:, 0::2] = 1.0            # all dy = 1
+        out = ops.deformable_conv_v1(paddle.to_tensor(x),
+                                     paddle.to_tensor(offset),
+                                     paddle.to_tensor(w), padding=1)
+        xs = np.zeros_like(x)
+        xs[:, :, :-1] = x[:, :, 1:]      # shift up (sample at y+1)
+        ref = _ref_conv(xs, w)
+        # interior rows only (border rows differ: zero-pad vs shift)
+        np.testing.assert_allclose(_np(out)[:, :, 1:-2], ref[:, :, 1:-2],
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_mask_scales(self):
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        w = rng.randn(2, 2, 3, 3).astype(np.float32)
+        offset = np.zeros((1, 18, 5, 5), np.float32)
+        half = np.full((1, 9, 5, 5), 0.5, np.float32)
+        out_half = ops.deformable_conv(
+            paddle.to_tensor(x), paddle.to_tensor(offset),
+            paddle.to_tensor(half), paddle.to_tensor(w), padding=1)
+        np.testing.assert_allclose(_np(out_half), 0.5 * _ref_conv(x, w),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_groups_and_grad(self):
+        x = paddle.to_tensor(rng.randn(1, 4, 5, 5).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(6, 2, 3, 3).astype(np.float32))
+        offset = paddle.to_tensor(
+            (rng.randn(1, 18, 5, 5) * 0.3).astype(np.float32))
+        mask = paddle.to_tensor(
+            np.abs(rng.randn(1, 9, 5, 5)).astype(np.float32))
+        for t in (x, w, offset, mask):
+            t.stop_gradient = False
+        out = ops.deformable_conv(x, offset, mask, w, padding=1, groups=2)
+        assert tuple(out.shape) == (1, 6, 5, 5)
+        out.sum().backward()
+        for t in (x, w, offset, mask):
+            assert np.isfinite(_np(t.grad)).all()
+
+
+class TestPsRoiPools:
+    def test_psroi_pool_manual(self):
+        # 2x2 grid, 2 output channels => C = 2*2*2 = 8
+        x = rng.randn(1, 8, 8, 8).astype(np.float32)
+        rois = np.array([[0, 0, 0, 8, 8]], np.float32)   # whole image
+        out = ops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                             output_channels=2, pooled_height=2,
+                             pooled_width=2, spatial_scale=1.0)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+        # bin (i,j) of channel c averages x[c*4 + i*2 + j] over its quarter
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    region = x[0, c * 4 + i * 2 + j,
+                               i * 4:(i + 1) * 4, j * 4:(j + 1) * 4]
+                    np.testing.assert_allclose(
+                        _np(out)[0, c, i, j], region.mean(), rtol=1e-4)
+
+    def test_prroi_pool_constant_and_grad(self):
+        x = paddle.to_tensor(np.full((1, 3, 6, 6), 2.5, np.float32))
+        rois = paddle.to_tensor(np.array([[0, 1, 1, 5, 5]], np.float32))
+        out = ops.prroi_pool(x, rois, pooled_height=2, pooled_width=2)
+        np.testing.assert_allclose(_np(out), 2.5, rtol=1e-5)
+        x.stop_gradient = False
+        ops.prroi_pool(x, rois, 2, 2).sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+        assert np.abs(_np(x.grad)).sum() > 0
+
+    def test_deformable_psroi_zero_trans(self):
+        x = rng.randn(1, 8, 8, 8).astype(np.float32)
+        rois = np.array([[0, 0, 0, 8, 8]], np.float32)
+        trans = np.zeros((1, 2, 2, 2), np.float32)
+        a = ops.deformable_psroi_pooling(
+            paddle.to_tensor(x), paddle.to_tensor(rois),
+            paddle.to_tensor(trans), output_channels=2, pooled_height=2,
+            pooled_width=2)
+        b = ops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                           output_channels=2, pooled_height=2,
+                           pooled_width=2)
+        np.testing.assert_allclose(_np(a), _np(b), rtol=1e-5)
+
+
+class TestRoiPerspective:
+    def test_identity_quad(self):
+        h = w = 6
+        x = rng.randn(1, 2, h, w).astype(np.float32)
+        quad = np.array([[0, 0, w - 1, 0, w - 1, h - 1, 0, h - 1]],
+                        np.float32)
+        out = ops.roi_perspective_transform(
+            paddle.to_tensor(x), paddle.to_tensor(quad),
+            transformed_height=h, transformed_width=w)
+        np.testing.assert_allclose(_np(out)[0], x[0], rtol=1e-3, atol=1e-3)
+
+    def test_batch_index_routing(self):
+        # each ROI must sample from its own image
+        h = w = 4
+        x = np.stack([np.zeros((1, h, w), np.float32),
+                      np.ones((1, h, w), np.float32)])
+        quad = np.array([0, 0, w - 1, 0, w - 1, h - 1, 0, h - 1],
+                        np.float32)
+        rois = np.stack([np.concatenate([[0], quad]),
+                         np.concatenate([[1], quad])]).astype(np.float32)
+        out = ops.roi_perspective_transform(
+            paddle.to_tensor(x), paddle.to_tensor(rois),
+            transformed_height=h, transformed_width=w)
+        np.testing.assert_allclose(_np(out)[0], 0.0, atol=1e-5)
+        np.testing.assert_allclose(_np(out)[1], 1.0, rtol=1e-5)
+
+    def test_subregion(self):
+        x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+        # axis-aligned quad covering columns 1..4, rows 2..5
+        quad = np.array([[1, 2, 4, 2, 4, 5, 1, 5]], np.float32)
+        out = ops.roi_perspective_transform(
+            paddle.to_tensor(x), paddle.to_tensor(quad),
+            transformed_height=4, transformed_width=4)
+        np.testing.assert_allclose(_np(out)[0, 0], x[0, 0, 2:6, 1:5],
+                                   rtol=1e-3, atol=1e-3)
+
+
+class TestCorrelation:
+    def test_manual(self):
+        x1 = rng.randn(1, 3, 5, 5).astype(np.float32)
+        x2 = rng.randn(1, 3, 5, 5).astype(np.float32)
+        out = ops.correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                              max_displacement=1)
+        assert tuple(out.shape) == (1, 9, 5, 5)
+        # displacement (0,0) is channel 4
+        np.testing.assert_allclose(_np(out)[0, 4], (x1 * x2).mean(1)[0],
+                                   rtol=1e-4, atol=1e-5)
+        # displacement (dy=1, dx=0) is channel 7: x2 sampled at h+1
+        ref = np.zeros((5, 5), np.float32)
+        ref[:4] = (x1[0, :, :4] * x2[0, :, 1:]).mean(0)
+        np.testing.assert_allclose(_np(out)[0, 7], ref, rtol=1e-4,
+                                   atol=1e-5)
+
+
+    def test_kernel_size_and_stride(self):
+        # constant images: patch correlation == pointwise correlation in
+        # the interior; stride1 subsamples output positions
+        x1 = np.full((1, 2, 6, 6), 2.0, np.float32)
+        x2 = np.full((1, 2, 6, 6), 3.0, np.float32)
+        out = ops.correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                              max_displacement=0, kernel_size=3)
+        assert tuple(out.shape) == (1, 1, 6, 6)
+        np.testing.assert_allclose(_np(out)[0, 0, 2, 2], 6.0, rtol=1e-5)
+        # border taps are zero-padded -> smaller average
+        assert _np(out)[0, 0, 0, 0] < 6.0
+        strided = ops.correlation(paddle.to_tensor(x1),
+                                  paddle.to_tensor(x2),
+                                  max_displacement=1, stride1=2)
+        assert tuple(strided.shape) == (1, 9, 3, 3)
+
+
+class TestTreeVarConv:
+    def test_tree_conv_star(self):
+        # one root (0) with children 1, 2; feature dim 3
+        nodes = rng.randn(1, 3, 3).astype(np.float32)
+        edges = np.array([[[0, 1], [0, 2]]], np.int64)
+        filt = rng.randn(3, 3, 4, 1).astype(np.float32)
+        out = ops.tree_conv(paddle.to_tensor(nodes),
+                            paddle.to_tensor(edges),
+                            paddle.to_tensor(filt))
+        wt, wl, wr = filt[:, 0, :, 0], filt[:, 1, :, 0], filt[:, 2, :, 0]
+        # node 0: self + child1 (eta_l=1, eta_r=0) + child2 (eta_l=0, eta_r=1)
+        ref0 = (nodes[0, 0] @ wt + nodes[0, 1] @ wl + nodes[0, 2] @ wr)
+        np.testing.assert_allclose(_np(out)[0, 0, :, 0],
+                                   np.maximum(ref0, 0), rtol=1e-4,
+                                   atol=1e-5)
+        # leaves: only self term
+        for leaf in (1, 2):
+            np.testing.assert_allclose(
+                _np(out)[0, leaf, :, 0],
+                np.maximum(nodes[0, leaf] @ wt, 0), rtol=1e-4, atol=1e-5)
+
+    def test_var_conv_2d_masks(self):
+        x = rng.randn(2, 1, 6, 6).astype(np.float32)
+        w = rng.randn(3, 1, 3, 3).astype(np.float32)
+        out = ops.var_conv_2d(paddle.to_tensor(x),
+                              paddle.to_tensor(np.array([4, 6])),
+                              paddle.to_tensor(np.array([3, 6])),
+                              paddle.to_tensor(w), output_channels=3)
+        full = _ref_conv(x, w)
+        np.testing.assert_allclose(_np(out)[0, :, :4, :3],
+                                   full[0, :, :4, :3], rtol=1e-4,
+                                   atol=1e-4)
+        assert np.abs(_np(out)[0, :, 4:, :]).max() == 0
+        assert np.abs(_np(out)[0, :, :, 3:]).max() == 0
+        np.testing.assert_allclose(_np(out)[1], full[1], rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestSyncBatchNorm:
+    def test_matches_global_bn(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, ("dp",))
+        x = rng.randn(8, 3, 4, 4).astype(np.float32)
+        wt = np.ones(3, np.float32)
+        bs = np.zeros(3, np.float32)
+        rm = np.zeros(3, np.float32)
+        rv = np.ones(3, np.float32)
+        fn = ops.sync_batch_norm.__pure_fn__
+
+        def local(xs, w, b, m, v):
+            return fn(xs, w, b, m, v, training=True, axis_name="dp")
+
+        smapped = shard_map(local, mesh=mesh,
+                            in_specs=(P("dp"), P(), P(), P(), P()),
+                            out_specs=(P("dp"), P(), P(), P(), P()))
+        y, m_out, v_out, sm, sv = smapped(jnp.asarray(x), jnp.asarray(wt),
+                                          jnp.asarray(bs), jnp.asarray(rm),
+                                          jnp.asarray(rv))
+        gm = x.mean(axis=(0, 2, 3))
+        gv = (x ** 2).mean(axis=(0, 2, 3)) - gm ** 2
+        ref = (x - gm[None, :, None, None]) / np.sqrt(
+            gv[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(sm), gm, rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestTensorArray:
+    def test_write_read_stack(self):
+        ta = ops.create_array()
+        for i in range(3):
+            ta = ops.write_to_array(
+                ta, i, paddle.to_tensor(np.full((2,), float(i),
+                                                np.float32)))
+        assert ops.array_length(ta) == 3
+        np.testing.assert_allclose(_np(ops.read_from_array(ta, 1)), 1.0)
+        stacked = ta.stack()
+        assert tuple(stacked.shape) == (3, 2)
+
+    def test_to_tensor(self):
+        items = [paddle.to_tensor(rng.randn(2, 3).astype(np.float32)),
+                 paddle.to_tensor(rng.randn(4, 3).astype(np.float32))]
+        ta = ops.create_array(initialized_list=items)
+        out, index = ops.tensor_array_to_tensor(ta, axis=0)
+        assert tuple(out.shape) == (6, 3)
+        np.testing.assert_allclose(_np(index), [2, 4])
+        out2, idx2 = ops.tensor_array_to_tensor(
+            [items[0], items[0]], axis=0, use_stack=True)
+        assert tuple(out2.shape) == (2, 2, 3)
+
+    def test_grad_through_array(self):
+        x = paddle.to_tensor(rng.randn(2, 2).astype(np.float32))
+        x.stop_gradient = False
+        ta = ops.create_array()
+        ta = ops.write_to_array(ta, 0, x * 2.0)
+        ta = ops.write_to_array(ta, 1, x * 3.0)
+        out, _ = ops.tensor_array_to_tensor(ta, axis=0)
+        out.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), 5.0)
