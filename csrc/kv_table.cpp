@@ -195,12 +195,22 @@ int pd_kv_load(int h, const char* path) {
     std::fclose(f);
     return -3;  // bad/truncated header: table untouched
   }
+  long file_size = 0;
+  if (std::fseek(f, 0, SEEK_END) == 0) file_size = std::ftell(f);
+  std::fseek(f, 24, SEEK_SET);  // past the header
   std::vector<std::pair<int64_t, Row>> staged;
   int64_t key;
   bool truncated = false;
   for (;;) {
+    long pos = std::ftell(f);
     size_t got = std::fread(&key, 8, 1, f);
-    if (got == 0) break;  // clean EOF at a record boundary
+    if (got == 0) {
+      // fread reports 0 items both at clean EOF and when 1-7 trailing
+      // bytes remain (snapshot cut mid-key; glibc consumes the partial
+      // bytes) — only an exact end-of-file position is clean
+      truncated = (pos != file_size);
+      break;
+    }
     Row r;
     r.w.resize(dim);
     if (std::fread(r.w.data(), 4, dim, f) != static_cast<size_t>(dim)) {

@@ -50,17 +50,27 @@ class Generator:
             self._offset = state["offset"]
 
 
-_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+# created on first use — constructing a PRNG key materializes a device
+# array, and importing the package must NEVER initialize the XLA backend
+# (it breaks jax.distributed.initialize ordering and hangs imports when
+# the device is unreachable)
+_default_generator = None
+_default_lock = threading.Lock()
 
 
 def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        with _default_lock:
+            if _default_generator is None:
+                _default_generator = Generator(
+                    np.random.randint(0, 2**31 - 1))
     return _default_generator
 
 
 def seed(s: int):
     """paddle.seed equivalent: reseed the default eager generator."""
-    _default_generator.manual_seed(s)
-    return _default_generator
+    return default_generator().manual_seed(s)
 
 
 class _KeyScope:
@@ -104,4 +114,4 @@ def next_key() -> jax.Array:
     stack = _scopes()
     if stack:
         return stack[-1].split()
-    return _default_generator.split()
+    return default_generator().split()

@@ -341,9 +341,23 @@ class SparseEmbedding:
                               init_range=init_range, seed=seed)
         self._pending = []
 
+    # pulled blocks kept for the backward push; bounded so grad-enabled
+    # eval loops that never call apply_gradients don't leak one block
+    # per forward (prefer paddle.no_grad() for eval — then nothing is
+    # retained at all)
+    _MAX_PENDING = 16
+
     def __call__(self, ids):
         out, block, uniq = distributed_lookup_table(self.kv, ids)
-        self._pending.append((block, uniq))
+        from ..framework import is_grad_enabled
+        if is_grad_enabled():
+            if len(self._pending) >= self._MAX_PENDING:
+                # oldest gradless entries are stale forwards, not an
+                # in-progress accumulation window
+                self._pending = [
+                    (b, u) for b, u in self._pending
+                    if b.grad is not None][-self._MAX_PENDING + 1:]
+            self._pending.append((block, uniq))
         return out
 
     def apply_gradients(self):

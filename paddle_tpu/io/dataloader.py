@@ -100,10 +100,23 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, sharding_plan=None):
+                 persistent_workers=False, sharding_plan=None,
+                 worker_mode="thread"):
+        """worker_mode: "thread" (default — numpy transforms release the
+        GIL, zero serialization) or "process" (forkserver workers for
+        Python-heavy decode/tokenize, shared-memory return path + death
+        watchdog — the reference dataloader_iter.py:379 architecture).
+        Process mode requires a picklable dataset/collate_fn; datasets
+        defined in a script's __main__ need the standard
+        `if __name__ == "__main__":` guard (as with torch/paddle
+        multiprocess loaders)."""
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_mode = worker_mode
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self.prefetch_factor = max(prefetch_factor, 2)
         self.use_buffer_reader = use_buffer_reader
         self.sharding_plan = sharding_plan
@@ -136,17 +149,35 @@ class DataLoader:
                 return
             make = lambda idxs: [self.dataset[i] for i in idxs]
             if self.num_workers > 0:
-                pool = _WorkerPool(
-                    lambda idxs: self.collate_fn(make(idxs)),
-                    self.num_workers, self.prefetch_factor)
+                if self.worker_mode == "process":
+                    from .process_pool import ProcessPool
+                    pool = ProcessPool(
+                        self.dataset, self.collate_fn, self.num_workers,
+                        use_shared_memory=self.use_shared_memory,
+                        worker_init_fn=self.worker_init_fn,
+                        timeout=self.timeout)
+                else:
+                    pool = _WorkerPool(
+                        lambda idxs: self.collate_fn(make(idxs)),
+                        self.num_workers, self.prefetch_factor)
                 try:
-                    seqs = []
-                    it = iter(self.batch_sampler)
-                    for seq, idxs in enumerate(it):
+                    # windowed submission: at most workers*prefetch
+                    # batches in flight, so a slow consumer doesn't pile
+                    # a whole epoch of results into parent RAM
+                    window = self.num_workers * self.prefetch_factor
+                    it = enumerate(iter(self.batch_sampler))
+                    in_flight = []
+                    for seq, idxs in itertools.islice(it, window):
                         pool.submit(seq, idxs)
-                        seqs.append(seq)
-                    for seq in seqs:
-                        yield pool.get(seq)
+                        in_flight.append(seq)
+                    next_take = 0
+                    while next_take < len(in_flight):
+                        out = pool.get(in_flight[next_take])
+                        next_take += 1
+                        for seq, idxs in itertools.islice(it, 1):
+                            pool.submit(seq, idxs)
+                            in_flight.append(seq)
+                        yield out
                 finally:
                     pool.shutdown()
             else:

@@ -1,0 +1,248 @@
+"""Multiprocess DataLoader workers with a death watchdog.
+
+Reference: python/paddle/fluid/dataloader/dataloader_iter.py:379
+(_worker_loop: index batches in, collated samples out through
+shared-memory tensors) and imperative/data_loader.cc (SIGCHLD watchdog
+killing the job when a worker dies instead of hanging the queue).
+
+TPU-first shape: spawned workers own the Python-heavy work (decode,
+tokenize, augment) that the thread pool can't parallelize under the GIL;
+batches return through the native shared-memory ring (csrc/runtime.cpp
+pd_shm_*, one ring per worker — no pickling large arrays through pipes)
+with an mp.Queue fallback when the native lib is unavailable. Worker
+death is detected by a monitor thread polling exitcodes (the portable
+equivalent of the reference's SIGCHLD handler — signal handlers only fire
+on the main thread, a poller works everywhere) and surfaces as a
+RuntimeError on the consumer instead of a hang.
+"""
+from __future__ import annotations
+
+import io
+import itertools
+import multiprocessing as mp
+import os
+import pickle
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["ProcessPool"]
+
+_POOL_SEQ = itertools.count(1)
+
+
+def _pack(seq, ok, payload):
+    """(seq, ok, batch-of-ndarrays-or-exception) -> bytes."""
+    return pickle.dumps((seq, ok, payload), protocol=4)
+
+
+def _worker_loop(worker_id, dataset, collate_fn, index_q, ring_name,
+                 result_q, init_fn, seed):
+    if init_fn is not None:
+        init_fn(worker_id)
+    np.random.seed((seed + worker_id) % (2**32))
+    ring = None
+    if ring_name is not None:
+        try:
+            from .shm_ring import ShmRing
+            ring = ShmRing(name=ring_name, create=False)
+        except Exception:
+            ring = None
+
+    def emit(blob):
+        if ring is not None:
+            ring.push_bytes(blob)
+        else:
+            result_q.put(blob)
+
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        seq, idxs = item
+        try:
+            batch = collate_fn([dataset[i] for i in idxs])
+            emit(_pack(seq, True, batch))
+        except Exception as e:  # surfaced on the consumer side
+            try:
+                emit(_pack(seq, False, e))
+            except Exception:
+                emit(_pack(seq, False,
+                           RuntimeError(f"worker {worker_id}: "
+                                        f"{type(e).__name__}: {e}")))
+
+
+class ProcessPool:
+    """Order-preserving map of collate over index batches in fork()ed
+    worker processes. API mirrors the in-module thread pool (submit/get/
+    shutdown) so DataLoader switches on num_workers + mode only."""
+
+    def __init__(self, dataset, collate_fn, num_workers,
+                 use_shared_memory=True, worker_init_fn=None,
+                 ring_capacity=32 << 20, timeout=0):
+        # forkserver, not fork or spawn: the parent runs JAX's thread
+        # pools, and fork()ing a multithreaded process corrupts them
+        # (the reference forks because its parent is thread-light; ours
+        # is not), while plain spawn re-executes the user's __main__
+        # script for every worker (breaking guard-less scripts). The
+        # forkserver daemon starts clean (no jax) and forks workers from
+        # there. Dataset/collate_fn must be picklable — the same
+        # contract as the reference's multiprocess DataLoader.
+        ctx = mp.get_context("forkserver")
+        self._timeout = timeout or None
+        self.index_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        self.rings = []
+        self.procs = []
+        self.out = {}
+        self.cv = threading.Condition()
+        self.dead: Optional[str] = None
+        self._closed = False
+
+        ring_names = []
+        from ..core.native_lib import runtime_lib
+        if use_shared_memory and runtime_lib() is None:
+            # ShmRing's pure-python fallback is in-process only — a
+            # fork()ed child would push into its own copy; use the
+            # mp.Queue path instead
+            use_shared_memory = False
+        if use_shared_memory:
+            try:
+                from .shm_ring import ShmRing
+                pool_id = next(_POOL_SEQ)   # names unique across pools
+                for w in range(num_workers):
+                    r = ShmRing(name=f"/pd_dl_{os.getpid()}_{pool_id}_{w}",
+                                capacity=ring_capacity, create=True)
+                    self.rings.append(r)
+                    ring_names.append(r.name)
+            except Exception:
+                self.rings = []
+                ring_names = []
+        if not ring_names:
+            ring_names = [None] * num_workers
+
+        seed = int.from_bytes(os.urandom(4), "little")
+        for w in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(w, dataset, collate_fn, self.index_q, ring_names[w],
+                      self.result_q, worker_init_fn, seed),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+
+        # result drainers: one per ring (pop_bytes blocks per-ring) or a
+        # single one for the mp.Queue path
+        self._drainers = []
+        if self.rings:
+            for r in self.rings:
+                t = threading.Thread(target=self._drain_ring, args=(r,),
+                                     daemon=True)
+                t.start()
+                self._drainers.append(t)
+        else:
+            t = threading.Thread(target=self._drain_queue, daemon=True)
+            t.start()
+            self._drainers.append(t)
+
+        # watchdog: dead worker -> error out instead of hanging
+        self._watchdog = threading.Thread(target=self._watch, daemon=True)
+        self._watchdog.start()
+
+    # -- internals -----------------------------------------------------------
+    def _store(self, blob):
+        seq, ok, payload = pickle.loads(blob)
+        with self.cv:
+            self.out[seq] = (ok, payload)
+            self.cv.notify_all()
+
+    def _drain_ring(self, ring):
+        while not self._closed:
+            try:
+                blob = ring.pop_bytes(timeout=0.2)
+            except Exception:
+                if self._closed:
+                    return
+                continue
+            if blob:
+                self._store(blob)
+
+    def _drain_queue(self):
+        while not self._closed:
+            try:
+                blob = self.result_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            except (EOFError, OSError):
+                return
+            self._store(blob)
+
+    def _watch(self):
+        while not self._closed:
+            for p in self.procs:
+                # ANY exit before shutdown() is unexpected — a clean
+                # sys.exit() from a dataset mid-epoch must not hang the
+                # consumer either (normal exits only happen after the
+                # shutdown sentinel, when _closed is already set)
+                if p.exitcode is not None and not self._closed:
+                    with self.cv:
+                        self.dead = (f"DataLoader worker (pid {p.pid}) "
+                                     f"exited unexpectedly with code "
+                                     f"{p.exitcode}")
+                        self.cv.notify_all()
+                    return
+            time.sleep(0.1)
+
+    # -- API -----------------------------------------------------------------
+    def submit(self, seq, idxs):
+        self.index_q.put((seq, list(idxs)))
+
+    def get(self, seq):
+        deadline = (time.time() + self._timeout) if self._timeout else None
+        with self.cv:
+            while seq not in self.out:
+                if self.dead:
+                    raise RuntimeError(self.dead)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"DataLoader batch {seq} timed out")
+                self.cv.wait(timeout=remaining if remaining else 0.5)
+            ok, val = self.out.pop(seq)
+        if not ok:
+            raise val
+        return val
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self.procs:
+            try:
+                self.index_q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.terminate()
+        # drainers must be out of pop_bytes before the rings unmap —
+        # closing a segment under a blocked reader is a use-after-unmap
+        for t in self._drainers:
+            t.join(timeout=2.0)
+        for r in self.rings:
+            try:
+                r.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

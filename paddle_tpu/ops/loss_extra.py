@@ -158,14 +158,20 @@ def _log_uniform_prob(x, range_):
 
 @register_op("nce")
 def nce(x, label, weight, bias=None, num_total_classes=None,
-        num_neg_samples=10, seed=0, sampler="log_uniform", name=None):
+        num_neg_samples=10, seed=None, sampler="log_uniform", name=None):
     """Noise-contrastive estimation (nce_op.h). Returns (cost [B,1],
     sample_logits, sample_labels). o = sigmoid(x.W[c] + b[c]);
-    cost = -log(o/(o+kq)) for true c, -log(kq/(o+kq)) for sampled."""
+    cost = -log(o/(o+kq)) for true c, -log(kq/(o+kq)) for sampled.
+    seed=None (default) draws a fresh key per call from the framework
+    generator — fixed seeds are for reproducible tests only."""
     n = int(num_total_classes or weight.shape[0])
     b = x.shape[0]
     k = int(num_neg_samples)
-    key = jax.random.key(int(seed))
+    if seed is None:
+        from ..core.generator import next_key
+        key = next_key()
+    else:
+        key = jax.random.key(int(seed))
     if sampler == "uniform":
         neg = jax.random.randint(key, (b, k), 0, n)
         q = jnp.full((b, k), 1.0 / n)
@@ -190,7 +196,7 @@ def nce(x, label, weight, bias=None, num_total_classes=None,
 
 
 @register_op("sample_logits")
-def sample_logits(logits, label, num_samples=10, seed=0, uniq=True,
+def sample_logits(logits, label, num_samples=10, seed=None, uniq=True,
                   remove_accidental_hits=True, use_customized_samples=False,
                   customized_samples=None, customized_probabilities=None,
                   name=None):
@@ -205,8 +211,12 @@ def sample_logits(logits, label, num_samples=10, seed=0, uniq=True,
         neg = customized_samples.astype(jnp.int32)
         q_neg = customized_probabilities
     else:
-        neg = _log_uniform_sample(jax.random.key(int(seed)),
-                                  (b, int(num_samples)), n)
+        if seed is None:
+            from ..core.generator import next_key
+            key = next_key()
+        else:
+            key = jax.random.key(int(seed))
+        neg = _log_uniform_sample(key, (b, int(num_samples)), n)
         q_neg = _log_uniform_prob(neg, n)
     samples = jnp.concatenate([pos, neg], axis=1)
     q_pos = _log_uniform_prob(pos, n)

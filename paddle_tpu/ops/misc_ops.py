@@ -169,10 +169,16 @@ def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
 
 
 @register_op("shuffle_batch")
-def shuffle_batch(x, seed=0, name=None):
+def shuffle_batch(x, seed=None, name=None):
     """Random permutation of rows (ref shuffle_batch_op.cc). Returns
-    (out, shuffle_idx) so the order can be undone/reused."""
-    perm = jax.random.permutation(jax.random.key(int(seed)), x.shape[0])
+    (out, shuffle_idx) so the order can be undone/reused. seed=None
+    draws a fresh key per call from the framework generator."""
+    if seed is None:
+        from ..core.generator import next_key
+        key = next_key()
+    else:
+        key = jax.random.key(int(seed))
+    perm = jax.random.permutation(key, x.shape[0])
     return x[perm], perm.astype(jnp.int32)
 
 

@@ -30,20 +30,12 @@ def main():
     coord_port = os.environ["PD_TEST_COORD_PORT"]
     out_dir = os.environ["PD_TEST_OUT"]
 
-    # phase 1: bootstrap blob broadcast over raw TCP. The rendezvous
-    # module is loaded standalone (importing the paddle_tpu package would
-    # initialize the XLA backend, which must not happen before
-    # jax.distributed.initialize below — same ordering rule the
-    # reference has for gen_comm_id before NCCL comm init).
-    import importlib
-    import types
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for pkg in ("paddle_tpu", "paddle_tpu.core", "paddle_tpu.distributed"):
-        stub = types.ModuleType(pkg)
-        stub.__path__ = [os.path.join(repo, *pkg.split("."))]
-        sys.modules[pkg] = stub          # parent __init__ never runs
-    broadcast_bootstrap = importlib.import_module(
-        "paddle_tpu.distributed.rendezvous").broadcast_bootstrap
+    # phase 1: bootstrap blob broadcast over raw TCP. Importing
+    # paddle_tpu must NOT initialize the XLA backend (that would break
+    # jax.distributed.initialize below — the same ordering rule the
+    # reference has for gen_comm_id before NCCL comm init); this import
+    # doubles as the regression test for that lazy-init property.
+    from paddle_tpu.distributed.rendezvous import broadcast_bootstrap
     payload = b"cluster-topology-v1" if rank == 0 else None
     blob = broadcast_bootstrap(payload, f"127.0.0.1:{rdzv_port}", rank,
                                world, timeout=60.0)

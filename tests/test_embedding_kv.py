@@ -107,11 +107,19 @@ class TestEmbeddingKV:
         p = str(tmp_path / "t.bin")
         kv.save(p)
         data = open(p, "rb").read()
-        open(p, "wb").write(data[:len(data) - 5])   # chop a record
+        open(p, "wb").write(data[:len(data) - 5])   # chop mid-row
         kv2 = EmbeddingKV(dim=3)
         with pytest.raises(RuntimeError):
             kv2.load(p)
         assert len(kv2) == 0                        # table untouched
+        # chop mid-key (1-7 trailing bytes) — fread sees 0 items there
+        # just like clean EOF; must still be rejected
+        row_bytes = 8 + 3 * 4 + 4
+        open(p, "wb").write(data[:24 + row_bytes + 3])
+        kv3 = EmbeddingKV(dim=3)
+        with pytest.raises(RuntimeError):
+            kv3.load(p)
+        assert len(kv3) == 0
 
     def test_close_idempotent(self):
         kv = EmbeddingKV(dim=2)

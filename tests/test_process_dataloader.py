@@ -1,0 +1,85 @@
+"""Multiprocess DataLoader workers (reference dataloader_iter.py:379
+_worker_loop + SIGCHLD watchdog capability): order preservation,
+exception propagation, worker-death detection, shm-ring return path."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataset import Dataset
+
+
+class RangeSquares(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        # python-heavy on purpose (the reason process workers exist)
+        acc = 0
+        for k in range(200):
+            acc += (i * k) % 7
+        return np.asarray([i * i + 0 * acc], np.float32)
+
+
+class Exploding(RangeSquares):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return super().__getitem__(i)
+
+
+class Dying(RangeSquares):
+    def __getitem__(self, i):
+        if i == 7:
+            os._exit(3)          # simulates a segfaulting worker
+        return super().__getitem__(i)
+
+
+class TestProcessWorkers:
+    def test_matches_serial_and_order(self):
+        ds = RangeSquares(32)
+        serial = [b for b in DataLoader(ds, batch_size=4, shuffle=False,
+                                        use_buffer_reader=False)]
+        procs = [b for b in DataLoader(ds, batch_size=4, shuffle=False,
+                                       num_workers=2,
+                                       worker_mode="process",
+                                       use_buffer_reader=False)]
+        assert len(serial) == len(procs) == 8
+        for a, b in zip(serial, procs):
+            np.testing.assert_allclose(np.asarray(a[0]._data),
+                                       np.asarray(b[0]._data))
+
+    def test_exception_propagates(self):
+        dl = DataLoader(Exploding(16), batch_size=4, num_workers=2,
+                        worker_mode="process", use_buffer_reader=False)
+        with pytest.raises(ValueError, match="boom at 5"):
+            list(dl)
+
+    def test_worker_death_detected(self):
+        dl = DataLoader(Dying(16), batch_size=4, num_workers=2,
+                        worker_mode="process", use_buffer_reader=False,
+                        timeout=60)
+        with pytest.raises((RuntimeError, TimeoutError),
+                           match="exited unexpectedly|timed out"):
+            list(dl)
+
+    def test_shm_ring_path_when_native(self):
+        from paddle_tpu.core.native_lib import runtime_lib
+        if runtime_lib() is None:
+            pytest.skip("no native runtime")
+        from paddle_tpu.io.process_pool import ProcessPool
+        from paddle_tpu.io.dataloader import default_collate_fn
+        pool = ProcessPool(RangeSquares(8), default_collate_fn, 2,
+                           use_shared_memory=True)
+        try:
+            assert pool.rings, "shm rings should back the return path"
+            pool.submit(0, [0, 1])
+            pool.submit(1, [2, 3])
+            np.testing.assert_allclose(pool.get(0).ravel(), [0.0, 1.0])
+            np.testing.assert_allclose(pool.get(1).ravel(), [4.0, 9.0])
+        finally:
+            pool.shutdown()

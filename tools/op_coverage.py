@@ -46,7 +46,6 @@ ALIASES = {
     "depthwise_conv2d_transpose": "conv2d_transpose",
     "dropout": "dropout_op",
     "expand": "expand_op", "expand_v2": "expand_op",
-    "expand_as": "expand_as_v2",
     "flatten": "flatten_op", "flatten2": "flatten_op",
     "frobenius_norm": "matrix_norm",
     "gather": "gather_op",
@@ -54,7 +53,7 @@ ALIASES = {
     "group_norm": "group_norm_op",
     "gru": "rnn",  # rnn op, mode="GRU" (reference gru_op.cc fused scan)
     "cudnn_lstm": "rnn", "lstm": "rnn", "lstmp": "rnn",
-    "im2sequence": "unfold_op",  # + transpose; see ops/manipulation.py
+    "im2sequence": "unfold_op",  # + transpose over time
     "index_sample": "index_sample_op",
     "index_select": "index_select_op",
     "instance_norm": "instance_norm_op",
@@ -131,6 +130,12 @@ ALIASES = {
     "read_from_array": "ops/tensor_array.py:read_from_array",
     "lod_array_length": "ops/tensor_array.py:array_length",
     "fake_quantize_dequantize": "fake_quantize_dequantize_abs_max",
+    "distributed_lookup_table":
+        "distributed/embedding_kv.py:distributed_lookup_table",
+    "pull_sparse": "distributed/embedding_kv.py:pull_sparse",
+    "pull_sparse_v2": "distributed/embedding_kv.py:pull_sparse",
+    "push_sparse": "distributed/embedding_kv.py:push_sparse",
+    "push_sparse_v2": "distributed/embedding_kv.py:push_sparse",
     # -- implemented as module-level callables (not in the op registry)
     "py_func": "ops/extras.py:py_func",
     "run_program": "jit/api.py:functionalize",  # partial-program analogue
@@ -186,7 +191,6 @@ DESIGN = {
                         "distributed/parallel.py"),
     "array_to_lod_tensor": _LOD, "lod_tensor_to_array": _LOD,
     "lod_reset": _LOD, "merge_lod_tensor": _LOD, "split_lod_tensor": _LOD,
-    "im2sequence": _LOD,
     "ascend_trigger": "Ascend NPU backend; out of scope for a TPU framework",
     "tensorrt_engine": _OUT_OF_SCOPE, "lite_engine": _OUT_OF_SCOPE,
     "fusion_group": ("runtime codegen fusion is XLA's job; no generated "
@@ -194,16 +198,11 @@ DESIGN = {
     "listen_and_serv": _PS, "heter_listen_and_serv": _PS,
     "send_and_recv": _PS, "recv_save": _PS, "send": _PS, "recv": _PS,
     "fetch_barrier": _PS, "send_barrier": _PS,
-    "distributed_lookup_table": _PS,
-    "pull_sparse": _PS, "pull_sparse_v2": _PS,
-    "push_sparse": _PS, "push_sparse_v2": _PS,
     "pull_box_sparse": _PS, "push_box_sparse": _PS,
     "push_box_extended_sparse": _PS, "pull_box_extended_sparse": _PS,
     "lookup_sparse_table_merge": _PS, "sparse_tensor_load": _PS,
     "split_byref": "by-ref aliasing has no meaning on immutable jax arrays",
     "shrink_rnn_memory": _LOD,
-    "attention_lstm": ("inference-only fused CPU op in the reference; the "
-                       "rnn op + attention layers compose and XLA fuses"),
     "fused_embedding_fc_lstm": "composition: embedding_op + rnn (XLA fuses)",
     "multi_gru": "composition: stacked rnn(mode=GRU) layers (XLA fuses)",
     "pyramid_hash": ("ads-specific hashed-ngram embedding; covered by "
@@ -214,8 +213,6 @@ DESIGN = {
     "dequantize": "see quantize", "requantize": "see quantize",
     "bilateral_slice": ("HDRNet-specific CUDA op, no Python API exposes it "
                         "in the reference snapshot; out of model-zoo scope"),
-    "correlation": ("FlowNet cost-volume op registered in "
-                    "ops/vision_extra.py"),
     "save": "serialization.py:save + static/io.py (save/load as host IO)",
     "load": "see save", "save_combine": "see save",
     "load_combine": "see save",
