@@ -41,6 +41,7 @@ from . import utils  # noqa: F401
 from . import models  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
+from . import onnx  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .nn.param_attr import ParamAttr  # noqa: F401

@@ -341,22 +341,30 @@ class SparseEmbedding:
                               init_range=init_range, seed=seed)
         self._pending = []
 
-    # pulled blocks kept for the backward push; bounded so grad-enabled
-    # eval loops that never call apply_gradients don't leak one block
-    # per forward (prefer paddle.no_grad() for eval — then nothing is
-    # retained at all)
-    _MAX_PENDING = 16
+    # pulled blocks kept for the backward push. Entries accumulate until
+    # apply_gradients() clears them, so a grad-enabled eval loop that
+    # never calls it would leak one block per forward — past the
+    # threshold we warn loudly and shed the oldest *grad-less* entries
+    # only (anything holding a gradient, or still awaiting backward
+    # within the window, is real pending work and is never dropped).
+    _PENDING_WARN = 1024
 
     def __call__(self, ids):
         out, block, uniq = distributed_lookup_table(self.kv, ids)
         from ..framework import is_grad_enabled
         if is_grad_enabled():
-            if len(self._pending) >= self._MAX_PENDING:
-                # oldest gradless entries are stale forwards, not an
-                # in-progress accumulation window
-                self._pending = [
-                    (b, u) for b, u in self._pending
-                    if b.grad is not None][-self._MAX_PENDING + 1:]
+            if len(self._pending) >= self._PENDING_WARN:
+                import warnings
+                warnings.warn(
+                    f"SparseEmbedding holds {len(self._pending)} pulled "
+                    "blocks awaiting apply_gradients(); call it after "
+                    "backward(), or run evaluation under "
+                    "paddle.no_grad(). Shedding the oldest gradient-"
+                    "less half to bound memory.")
+                keep_from = self._PENDING_WARN // 2
+                head = [(b, u) for b, u in self._pending[:keep_from]
+                        if b.grad is not None]
+                self._pending = head + self._pending[keep_from:]
             self._pending.append((block, uniq))
         return out
 

@@ -5,3 +5,5 @@ from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, WeightedRandomSampler,
                       SubsetRandomSampler)  # noqa: F401
+from .fleet_dataset import (DatasetBase, DatasetFactory,  # noqa: F401
+                            InMemoryDataset, QueueDataset)

@@ -1,0 +1,174 @@
+"""Dataset-driven training: the Trainer/DeviceWorker capability.
+
+Reference: framework/trainer.h:53 (TrainerBase -> MultiTrainer),
+device_worker.h:149 (HogwildWorker), framework/data_set.h:43
+(Dataset/DatasetImpl with in-memory global shuffle + channels),
+fluid.DatasetFactory ("QueueDataset" / "InMemoryDataset") and
+Executor.train_from_dataset / infer_from_dataset (fluid/executor.py).
+
+TPU-first redesign: the reference spins one hogwild thread per core,
+each racing lock-free updates into shared parameters. On TPU the chip
+IS the parallelism — one process feeds one compiled step whose batch
+dimension does the work of the thread pool, so "num threads" configures
+the C++ *feeder* (csrc/datafeed.cpp parse/shuffle threads), not racing
+updaters, and the update is exact instead of hogwild-approximate. The
+file format, slot config, shuffle semantics and the
+train_from_dataset driver loop keep the reference's shape.
+"""
+from __future__ import annotations
+
+import glob as _glob
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DatasetBase", "QueueDataset", "InMemoryDataset",
+           "DatasetFactory"]
+
+
+class DatasetBase:
+    """Slot-configured file dataset (reference DatasetImpl).
+
+    Slots are (name, size, dtype) with dtype float32|int64; files use the
+    MultiSlot text format of csrc/datafeed.cpp ("size v1 .. vn" per slot,
+    ';'-separated). `set_use_var` derives slots from static feed Vars.
+    """
+
+    def __init__(self):
+        self.filelist: List[str] = []
+        self.batch_size = 1
+        self.thread_num = 2
+        self.slots: List[Tuple[str, int, str]] = []
+        self.queue_capacity = 8
+        self._shuffle = False
+        self._seed = 0
+
+    # -- reference config surface -------------------------------------------
+    def set_filelist(self, files):
+        out = []
+        for f in files:
+            hits = sorted(_glob.glob(f))
+            out.extend(hits if hits else [f])
+        self.filelist = out
+
+    def set_batch_size(self, bs):
+        self.batch_size = int(bs)
+
+    def set_thread(self, n):
+        self.thread_num = int(n)
+
+    def set_queue_num(self, n):
+        self.queue_capacity = int(n)
+
+    def set_shuffle(self, shuffle: bool):
+        """Streaming shuffle inside the C++ feeder (QueueDataset path);
+        InMemoryDataset prefers load_into_memory + local_shuffle."""
+        self._shuffle = bool(shuffle)
+
+    def set_seed(self, seed: int):
+        """Seed for the feeder's streaming shuffle and the default
+        local_shuffle/global_shuffle permutation."""
+        self._seed = int(seed)
+
+    def set_slots(self, slots):
+        self.slots = [(str(n), int(s), str(d)) for n, s, d in slots]
+
+    def set_use_var(self, var_list):
+        """Derive slot config from feed Vars (paddle.static.data): name,
+        flattened per-sample size, dtype family."""
+        slots = []
+        for v in var_list:
+            shape = getattr(v, "orig_shape", None) or tuple(v.shape)
+            per_sample = 1
+            for s in shape[1:]:
+                per_sample *= int(s if s else 1)
+            dt = str(getattr(v, "dtype", "float32"))
+            kind = "int64" if ("int" in dt) else "float32"
+            slots.append((v.name, per_sample, kind))
+        self.slots = slots
+
+    def _feed(self, shuffle=None):
+        from .native_feed import NativeMultiSlotFeed
+        return NativeMultiSlotFeed(
+            self.filelist, self.batch_size,
+            [(s, d) for _, s, d in self.slots],
+            num_threads=self.thread_num,
+            queue_capacity=self.queue_capacity,
+            shuffle=self._shuffle if shuffle is None else shuffle,
+            seed=self._seed)
+
+    def slot_names(self):
+        return [n for n, _, _ in self.slots]
+
+    def __iter__(self):
+        """Yield feed dicts {slot_name: np.ndarray [bs, size]}."""
+        names = self.slot_names()
+        for batch in self._feed():
+            yield dict(zip(names, batch))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference QueueDataset): files are parsed by
+    the C++ feeder's thread pool and consumed batch-by-batch; nothing is
+    held in memory."""
+
+
+class InMemoryDataset(DatasetBase):
+    """Out-of-core load + in-memory shuffle (reference InMemoryDataset:
+    load_into_memory -> local_shuffle/global_shuffle -> train)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: Optional[List[Tuple[np.ndarray, ...]]] = None
+
+    def load_into_memory(self):
+        samples = []
+        for batch in self._feed(shuffle=False):
+            for i in range(batch[0].shape[0]):
+                samples.append(tuple(a[i] for a in batch))
+        self._samples = samples
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        assert self._samples is not None, "call load_into_memory() first"
+        rng = np.random.RandomState(self._seed if seed is None else seed)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Reference global_shuffle reshards samples over trainers by
+        hash; with data-parallel input sharding each rank owns its own
+        files, so the global pass reduces to a seed-synchronized local
+        shuffle (every rank permutes with the same seed)."""
+        assert self._samples is not None, "call load_into_memory() first"
+        seed = self._seed
+        if fleet is not None:
+            seed = getattr(fleet, "global_shuffle_seed", self._seed)
+        np.random.RandomState(seed).shuffle(self._samples)
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self, fleet=None):
+        return 0 if self._samples is None else len(self._samples)
+
+    def __iter__(self):
+        if self._samples is None:
+            yield from super().__iter__()
+            return
+        names = self.slot_names()
+        bs = self.batch_size
+        for start in range(0, len(self._samples), bs):
+            chunk = self._samples[start:start + bs]
+            arrays = [np.stack([s[j] for s in chunk])
+                      for j in range(len(self.slots))]
+            yield dict(zip(names, arrays))
+
+
+class DatasetFactory:
+    """fluid.DatasetFactory parity."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

@@ -134,19 +134,18 @@ class ProcessPool:
             p.start()
             self.procs.append(p)
 
-        # result drainers: one per ring (pop_bytes blocks per-ring) or a
-        # single one for the mp.Queue path
+        # result drainers: one per ring (pop_bytes blocks per-ring), plus
+        # ALWAYS the mp.Queue drainer — a worker whose ring attach fails
+        # falls back to the queue, and its batches must still arrive
         self._drainers = []
-        if self.rings:
-            for r in self.rings:
-                t = threading.Thread(target=self._drain_ring, args=(r,),
-                                     daemon=True)
-                t.start()
-                self._drainers.append(t)
-        else:
-            t = threading.Thread(target=self._drain_queue, daemon=True)
+        for r in self.rings:
+            t = threading.Thread(target=self._drain_ring, args=(r,),
+                                 daemon=True)
             t.start()
             self._drainers.append(t)
+        t = threading.Thread(target=self._drain_queue, daemon=True)
+        t.start()
+        self._drainers.append(t)
 
         # watchdog: dead worker -> error out instead of hanging
         self._watchdog = threading.Thread(target=self._watch, daemon=True)

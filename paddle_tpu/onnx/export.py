@@ -1,0 +1,30 @@
+"""paddle.onnx.export facade (reference python/paddle/onnx/export.py:21).
+
+The reference delegates wholesale to the external `paddle2onnx` package
+and raises when it isn't installed. Mirror of that contract: ONNX
+protobuf emission needs an external StableHLO->ONNX converter, which no
+bundled package provides — so export always (a) saves the portable
+deployment artifact this framework natively serves from (the jax.export
+bundle written by save_inference_model: `path + '.pdmodel'` +
+`path + '.pdiparams'`, loadable with paddle_tpu.inference.Predictor),
+then (b) raises the reference-style ImportError for the `.onnx` file
+itself. See DESIGN.md "Inference & deployment frontends".
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Save the StableHLO serving artifact at `path` and raise
+    ImportError for .onnx emission (no converter is bundled — the same
+    failure mode as the reference without paddle2onnx)."""
+    from ..static.io import save_inference_model
+
+    save_inference_model(path, layer=layer, input_spec=input_spec)
+    raise ImportError(
+        "paddle_tpu bundles no StableHLO->ONNX converter (the reference "
+        "needs the external 'paddle2onnx' package the same way). The "
+        f"portable serving artifact was saved via save_inference_model("
+        f"'{path}') — '{path}.pdmodel' loads with "
+        "paddle_tpu.inference.Predictor.")

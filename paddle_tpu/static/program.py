@@ -37,15 +37,32 @@ __all__ = ["Program", "program_guard", "default_main_program",
 _static_mode = False
 
 
+# placeholder extent for None/-1 dims during capture-time shape
+# inference. NOT 1: batch=1 placeholders silently specialize
+# broadcasting/squeeze semantics at capture while the Executor re-jits
+# per real shape — a mismatch class the reference doesn't have (its
+# InferShape propagates -1 symbolically). A distinctive prime makes the
+# placeholder inert for broadcasting and lets capture warn when the
+# value leaks into op attributes (a python-side `x.shape[0]` read).
+SYMBOLIC_DIM = 509
+
+
 class Var(Tensor):
     """Symbolic variable: carries aval only (no data). Lives in a Program.
 
     Subclasses Tensor so every op / layer treats it uniformly; `_data`
-    holds a zero placeholder of the right aval for shape inference."""
+    holds a zero placeholder of the right aval for shape inference.
+    `orig_shape` preserves the declared shape (None/-1 dims intact);
+    `symbolic_dims` indexes them."""
 
     def __init__(self, program, name, shape, dtype, kind="intermediate"):
         dtype = _dtypes.convert_dtype(dtype)
-        shape = tuple(1 if s is None or s < 0 else int(s) for s in shape)
+        self.orig_shape = tuple(None if (s is None or s < 0) else int(s)
+                                for s in shape)
+        self.symbolic_dims = {i for i, s in enumerate(self.orig_shape)
+                              if s is None}
+        shape = tuple(SYMBOLIC_DIM if s is None else s
+                      for s in self.orig_shape)
         super().__init__(jnp.zeros(shape, dtype), stop_gradient=True)
         self.program = program
         self.name = name
@@ -203,7 +220,7 @@ class Program:
                         list(n.out_ids), n.multi))
         vars_meta = {
             vid: (v.name, tuple(v._data.shape), str(v._data.dtype),
-                  v.kind)
+                  v.kind, getattr(v, "orig_shape", None))
             for vid, v in self.vars.items()}
         params = {
             vid: (t.name, np.asarray(t._data) if include_params else None,
@@ -230,12 +247,17 @@ class Program:
                     return jax.random.wrap_key_data(jnp.asarray(v[1]))
             return v
         p = Program()
-        for vid, (name, shape, dtype, kind) in sorted(d["vars"].items()):
+        for vid, meta in sorted(d["vars"].items()):
+            name, shape, dtype, kind = meta[:4]
+            orig = meta[4] if len(meta) > 4 else None
             v = Var.__new__(Var)
             Tensor.__init__(v, jnp.zeros(shape, dtype), stop_gradient=True)
             v.program = p
             v.name = name
             v.kind = kind
+            v.orig_shape = orig if orig is not None else tuple(shape)
+            v.symbolic_dims = {i for i, s in enumerate(v.orig_shape)
+                               if s is None}
             v.var_id = vid
             p.vars[vid] = v
             if name:
@@ -306,6 +328,26 @@ class Program:
                     "tensor kwargs not supported in static capture; pass "
                     "positionally", op_type=op_type)
             kw[k] = v._data if isinstance(v, Tensor) else v
+
+        # a SYMBOLIC_DIM-valued attribute almost certainly came from
+        # reading a placeholder dim (user code did `x.shape[0]` while
+        # building the program) — it would bake the placeholder into the
+        # graph where the real batch size belongs
+        def _leaks(v):
+            if isinstance(v, (int, np.integer)):
+                return int(v) == SYMBOLIC_DIM
+            if isinstance(v, (list, tuple)):
+                return any(_leaks(x) for x in v)
+            return False
+        if any(_leaks(c) for c in const_args) or \
+                any(_leaks(v) for v in kw.values()):
+            import warnings
+            warnings.warn(
+                f"static capture of op '{op_type}': an attribute equals "
+                f"the symbolic-dim placeholder ({SYMBOLIC_DIM}); if this "
+                "came from reading a data() placeholder's shape, derive "
+                "it inside the op from the input instead (paddle.shape)",
+                stacklevel=3)
 
         # InferShape via eval_shape on the pure fn
         def shaped(*xs):
@@ -560,6 +602,71 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Any, Any] = {}
+
+    # -- Dataset-driven loops (trainer.h:53 / executor.py
+    #    train_from_dataset capability; see io/fleet_dataset.py) --------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive the program over a slot Dataset (QueueDataset /
+        InMemoryDataset). One compiled step per feed shape; the C++
+        feeder's threads replace the reference's hogwild workers (the
+        update is exact, not racy — see io/fleet_dataset.py)."""
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      fetch_info, print_period,
+                                      train=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Forward-only sweep over a Dataset (reference
+        infer_from_dataset); pass an eval program
+        (program.clone(for_test=True) with no optimizer attached)."""
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      fetch_info, print_period,
+                                      train=False)
+
+    def _run_from_dataset(self, program, dataset, fetch_list, fetch_info,
+                          print_period, train):
+        if dataset is None:
+            raise EnforceNotMet("dataset must be provided",
+                                op_type="train_from_dataset")
+        prog = program if program is not None else default_main_program()
+        if not train and prog._optimize is not None:
+            raise EnforceNotMet(
+                "infer_from_dataset got a program with an optimizer "
+                "attached; pass program.clone(for_test=True)",
+                op_type="infer_from_dataset")
+        if train and prog._optimize is None:
+            raise EnforceNotMet(
+                "train_from_dataset needs a program with an optimizer "
+                "(call optimizer.minimize(loss) inside the "
+                "program_guard) — otherwise the sweep would be forward-"
+                "only", op_type="train_from_dataset")
+        feed_names = {prog.vars[i].name for i in prog.feeds}
+        fetch_list = fetch_list or []
+        names = (fetch_info or
+                 [getattr(f, "name", str(f)) for f in fetch_list])
+        step = 0
+        last_fetch = None
+        for batch in dataset:
+            feed = {k: v for k, v in batch.items() if k in feed_names}
+            missing = feed_names - set(feed)
+            if missing:
+                raise EnforceNotMet(
+                    f"dataset slots {sorted(set(feed))} do not cover "
+                    f"program feeds {sorted(missing)} (set_use_var with "
+                    "the program's data() vars)",
+                    op_type="train_from_dataset")
+            last_fetch = self.run(prog, feed=feed, fetch_list=fetch_list)
+            step += 1
+            if fetch_list and print_period and step % print_period == 0:
+                vals = ", ".join(
+                    f"{n}={np.asarray(v).ravel()[:4]}"
+                    for n, v in zip(names, last_fetch))
+                print(f"[{'train' if train else 'infer'}_from_dataset] "
+                      f"step {step}: {vals}")
+        return last_fetch
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):

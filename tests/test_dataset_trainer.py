@@ -1,0 +1,143 @@
+"""Dataset-driven training loops (reference trainer.h:53 +
+executor.train_from_dataset + DatasetFactory/InMemoryDataset): the
+QueueDataset streams through the C++ feeder into a static program;
+InMemoryDataset shuffles; infer_from_dataset sweeps an eval clone."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.io import DatasetFactory
+
+
+def _write_files(tmp_path, n_files=2, rows=24):
+    """Linear-regression MultiSlot data: x slot (3 floats), y slot (1
+    float) with y = x @ [1, 2, 3] + 0.5."""
+    w = np.array([1.0, 2.0, 3.0])
+    files = []
+    rng = np.random.RandomState(0)
+    for fi in range(n_files):
+        p = str(tmp_path / f"part-{fi}.txt")
+        with open(p, "w") as f:
+            for _ in range(rows):
+                x = rng.randn(3)
+                y = float(x @ w + 0.5)
+                xs = " ".join(f"{v:.6f}" for v in x)
+                f.write(f"3 {xs};1 {y:.6f}\n")
+        files.append(p)
+    return files
+
+
+def _build_program():
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [None, 3], "float32")
+        y = static.data("y", [None, 1], "float32")
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(3, 1)
+        pred = lin(x)
+        loss = ((pred - y) * (pred - y)).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    return prog, startup, loss
+
+
+class TestTrainFromDataset:
+    def test_queue_dataset_trains(self, tmp_path):
+        files = _write_files(tmp_path)
+        prog, startup, loss = _build_program()
+        exe = static.Executor()
+        exe.run(startup)
+
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(8)
+        ds.set_thread(1)
+        ds.set_filelist(files)
+        ds.set_slots([("x", 3, "float32"), ("y", 1, "float32")])
+
+        first = float(np.asarray(
+            exe.train_from_dataset(prog, ds, fetch_list=[loss])[0]))
+        for _ in range(20):
+            out = exe.train_from_dataset(prog, ds, fetch_list=[loss])
+        last = float(np.asarray(out[0]))
+        assert last < first * 0.2, (first, last)
+
+    def test_inmemory_shuffle_and_infer(self, tmp_path):
+        files = _write_files(tmp_path, n_files=1, rows=16)
+        ds = DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_thread(1)
+        ds.set_filelist(files)
+        ds.set_slots([("x", 3, "float32"), ("y", 1, "float32")])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 16
+        before = [b["x"].copy() for b in ds]
+        ds.local_shuffle(seed=3)
+        after = [b["x"].copy() for b in ds]
+        assert not all(np.array_equal(a, b)
+                       for a, b in zip(before, after))
+        # same multiset of rows
+        np.testing.assert_allclose(
+            np.sort(np.concatenate(before).ravel()),
+            np.sort(np.concatenate(after).ravel()), rtol=1e-6)
+
+        # infer over an eval clone (no optimizer)
+        prog, startup, loss = _build_program()
+        exe = static.Executor()
+        exe.run(startup)
+        infer_prog = prog.clone(for_test=True)
+        out = exe.infer_from_dataset(infer_prog, ds, fetch_list=[
+            infer_prog.var_by_name(loss.name)])
+        assert np.isfinite(float(np.asarray(out[0])))
+
+    def test_infer_rejects_train_program(self, tmp_path):
+        files = _write_files(tmp_path, n_files=1, rows=8)
+        prog, startup, loss = _build_program()
+        exe = static.Executor()
+        exe.run(startup)
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(4)
+        ds.set_filelist(files)
+        ds.set_slots([("x", 3, "float32"), ("y", 1, "float32")])
+        with pytest.raises(Exception, match="clone"):
+            exe.infer_from_dataset(prog, ds)
+
+    def test_set_use_var_derives_slots(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 3], "float32")
+            y = static.data("lbl", [None, 1], "int64")
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_use_var([x, y])
+        assert ds.slots == [("x", 3, "float32"), ("lbl", 1, "int64")]
+
+    def test_train_rejects_optimizerless_program(self, tmp_path):
+        files = _write_files(tmp_path, n_files=1, rows=8)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 3], "float32")
+            y = static.data("y", [None, 1], "float32")
+            loss = ((x.sum(axis=1, keepdim=True) - y) ** 2).mean()
+        exe = static.Executor()
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(4)
+        ds.set_filelist(files)
+        ds.set_slots([("x", 3, "float32"), ("y", 1, "float32")])
+        with pytest.raises(Exception, match="optimizer"):
+            exe.train_from_dataset(prog, ds)
+
+    def test_streaming_shuffle_setter(self, tmp_path):
+        files = _write_files(tmp_path, n_files=1, rows=32)
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(32)
+        ds.set_thread(1)
+        ds.set_filelist(files)
+        ds.set_slots([("x", 3, "float32"), ("y", 1, "float32")])
+        plain = next(iter(ds))["x"]
+        ds.set_shuffle(True)
+        ds.set_seed(5)
+        shuffled = next(iter(ds))["x"]
+        assert not np.array_equal(plain, shuffled)
+        np.testing.assert_allclose(np.sort(plain.ravel()),
+                                   np.sort(shuffled.ravel()), rtol=1e-6)

@@ -153,3 +153,22 @@ class TestInference:
         loaded = paddle.jit.load(path)
         with pytest.raises(RuntimeError):
             loaded(paddle.to_tensor(np.zeros((1, 4), np.float32)))
+
+
+def test_onnx_export_facade(tmp_path):
+    """paddle.onnx.export parity: saves the StableHLO serving artifact,
+    raises the reference-style ImportError for .onnx emission when no
+    converter package exists."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.api import InputSpec
+
+    import pytest
+
+    net = nn.Linear(4, 2)
+    prefix = str(tmp_path / "m")
+    with pytest.raises(ImportError, match="save_inference_model"):
+        paddle.onnx.export(net, prefix,
+                           input_spec=[InputSpec([1, 4], "float32")])
+    import os
+    assert os.path.exists(prefix + ".pdmodel")   # artifact always saved
