@@ -98,7 +98,7 @@ def flag_value(name: str):
 # ---------------------------------------------------------------------------
 define_flag("check_nan_inf", False,
             "Scan op outputs for NaN/Inf after each eager op (debug).")
-define_flag("eager_op_jit", False,
+define_flag("eager_op_jit", True,
             "Use a per-op jit cache for eager execution (lower dispatch "
             "overhead; compiled path is the real perf story).")
 define_flag("benchmark", False, "Record per-op timing stats in eager mode.")

@@ -342,29 +342,29 @@ class SparseEmbedding:
         self._pending = []
 
     # pulled blocks kept for the backward push. Entries accumulate until
-    # apply_gradients() clears them, so a grad-enabled eval loop that
-    # never calls it would leak one block per forward — past the
-    # threshold we warn loudly and shed the oldest *grad-less* entries
-    # only (anything holding a gradient, or still awaiting backward
-    # within the window, is real pending work and is never dropped).
-    _PENDING_WARN = 1024
+    # apply_gradients() clears them; a loop that never calls it (eval
+    # under grad mode, or a training loop missing the call) would leak
+    # one block per forward. Past the threshold the oldest half is shed
+    # unconditionally — entries that old are stale by definition; any
+    # gradients they carried are lost, which the one-time warning says
+    # how to fix (call apply_gradients / use paddle.no_grad).
+    _PENDING_MAX = 1024
 
     def __call__(self, ids):
         out, block, uniq = distributed_lookup_table(self.kv, ids)
         from ..framework import is_grad_enabled
         if is_grad_enabled():
-            if len(self._pending) >= self._PENDING_WARN:
-                import warnings
-                warnings.warn(
-                    f"SparseEmbedding holds {len(self._pending)} pulled "
-                    "blocks awaiting apply_gradients(); call it after "
-                    "backward(), or run evaluation under "
-                    "paddle.no_grad(). Shedding the oldest gradient-"
-                    "less half to bound memory.")
-                keep_from = self._PENDING_WARN // 2
-                head = [(b, u) for b, u in self._pending[:keep_from]
-                        if b.grad is not None]
-                self._pending = head + self._pending[keep_from:]
+            if len(self._pending) >= self._PENDING_MAX:
+                if not getattr(self, "_shed_warned", False):
+                    self._shed_warned = True
+                    import warnings
+                    warnings.warn(
+                        "SparseEmbedding exceeded its pending pulled-"
+                        "block window; shedding oldest entries (their "
+                        "sparse gradients, if any, are dropped). Call "
+                        "apply_gradients() after backward(), or run "
+                        "evaluation under paddle.no_grad().")
+                self._pending = self._pending[self._PENDING_MAX // 2:]
             self._pending.append((block, uniq))
         return out
 

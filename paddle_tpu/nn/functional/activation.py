@@ -171,7 +171,7 @@ def glu(x, axis=-1, name=None):
     return a * jax.nn.sigmoid(b)
 
 
-@register_op("gumbel_softmax")
+@register_op("gumbel_softmax", tags=("rng",))
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None,
                    name=None):
     from ...core.generator import next_key

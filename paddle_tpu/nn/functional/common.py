@@ -44,7 +44,7 @@ def _dropout_impl(x, key, p, mode):
     return jnp.where(mask, x, 0.0).astype(x.dtype)
 
 
-@register_op("dropout_eval")
+@register_op("dropout_eval", tags=("rng",))
 def _dropout_eval(x, p=0.5, mode="upscale_in_train"):
     """Eval-mode dropout (what Program.clone(for_test=True) rewrites
     dropout_op nodes into): identity, or downscale_in_infer scaling."""
@@ -65,7 +65,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     return _dropout_impl(x, next_key(), p=p, mode=mode)
 
 
-@register_op("dropout_nd")
+@register_op("dropout_nd", tags=("rng",))
 def _dropout_nd(x, key, p=0.5, axes=(), mode="upscale_in_train"):
     """Axis-structured dropout (one mask per the listed dims, broadcast
     over the rest) — dropout_nd_op.cc analogue; registered so captured
@@ -96,7 +96,7 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return _dropout_axis(x, float(p), (0, ch_axis), "upscale_in_train")
 
 
-@register_op("alpha_dropout")
+@register_op("alpha_dropout", tags=("rng",))
 def _alpha_dropout_op(x, key, p=0.5):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805

@@ -376,7 +376,7 @@ def polygamma(x, n, name=None):
     return jax.scipy.special.polygamma(n, x)
 
 
-@register_op("poisson")
+@register_op("poisson", tags=("rng",))
 def poisson(x, name=None):
     """Sample Poisson(lambda=x) elementwise (ref poisson_op)."""
     from ..core.generator import next_key

@@ -156,7 +156,7 @@ def _log_uniform_prob(x, range_):
         range_ + 1.0)
 
 
-@register_op("nce")
+@register_op("nce", tags=("rng",))
 def nce(x, label, weight, bias=None, num_total_classes=None,
         num_neg_samples=10, seed=None, sampler="log_uniform", name=None):
     """Noise-contrastive estimation (nce_op.h). Returns (cost [B,1],
@@ -195,7 +195,7 @@ def nce(x, label, weight, bias=None, num_total_classes=None,
     return cost, logits, samples
 
 
-@register_op("sample_logits")
+@register_op("sample_logits", tags=("rng",))
 def sample_logits(logits, label, num_samples=10, seed=None, uniq=True,
                   remove_accidental_hits=True, use_customized_samples=False,
                   customized_samples=None, customized_probabilities=None,

@@ -168,7 +168,7 @@ def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
     return alpha * x + beta * pe[None, :, :]
 
 
-@register_op("shuffle_batch")
+@register_op("shuffle_batch", tags=("rng",))
 def shuffle_batch(x, seed=None, name=None):
     """Random permutation of rows (ref shuffle_batch_op.cc). Returns
     (out, shuffle_idx) so the order can be undone/reused. seed=None

@@ -63,11 +63,26 @@ class Var(Tensor):
                               if s is None}
         shape = tuple(SYMBOLIC_DIM if s is None else s
                       for s in self.orig_shape)
-        super().__init__(jnp.zeros(shape, dtype), stop_gradient=True)
+        self._init_symbolic(shape, dtype)
         self.program = program
         self.name = name
         self.kind = kind  # feed | param | intermediate | fetch
         self.var_id = program._new_var_id(self)
+
+    def _init_symbolic(self, shape, dtype):
+        """Aval-only placeholder: capture never executes ops, so _data is
+        a ShapeDtypeStruct (shape/dtype carrier) — no SYMBOLIC_DIM-extent
+        buffer is ever materialized (a [None,1024,4096] activation would
+        otherwise allocate a 509-batch zeros array per Var)."""
+        self._data = jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+        self.stop_gradient = True
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.persistable = False
+        self._retain_grad = False
+        self._grad_hooks = []
+        self.sharding_spec = None
 
     def __repr__(self):
         return (f"Var(name={self.name}, shape={self.shape}, "
@@ -251,7 +266,7 @@ class Program:
             name, shape, dtype, kind = meta[:4]
             orig = meta[4] if len(meta) > 4 else None
             v = Var.__new__(Var)
-            Tensor.__init__(v, jnp.zeros(shape, dtype), stop_gradient=True)
+            v._init_symbolic(tuple(shape), dtype)
             v.program = p
             v.name = name
             v.kind = kind
@@ -654,7 +669,7 @@ class Executor:
             missing = feed_names - set(feed)
             if missing:
                 raise EnforceNotMet(
-                    f"dataset slots {sorted(set(feed))} do not cover "
+                    f"dataset slots {sorted(batch)} do not cover "
                     f"program feeds {sorted(missing)} (set_use_var with "
                     "the program's data() vars)",
                     op_type="train_from_dataset")
