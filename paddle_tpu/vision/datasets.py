@@ -15,7 +15,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -115,3 +116,145 @@ class Cifar100(_CifarBase):
 
 class Flowers(_CifarBase):
     n_classes = 102
+
+
+_DEFAULT_IMG_EXTS = (".npy", ".npz", ".png", ".jpg", ".jpeg", ".bmp")
+
+
+def _file_filter(extensions, is_valid_file):
+    """One predicate per torchvision/reference semantics: extensions and
+    is_valid_file are mutually exclusive."""
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError(
+            "pass either extensions or is_valid_file, not both")
+    if is_valid_file is not None:
+        return is_valid_file, "<is_valid_file>"
+    exts = tuple(e.lower() for e in (extensions or _DEFAULT_IMG_EXTS))
+    return (lambda path: path.lower().endswith(exts)), exts
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image dataset (reference
+    vision/datasets/folder.py:62): root/<class>/<file>. Files load via a
+    pluggable `loader`; the default handles numpy formats (.npy/.npz)
+    directly and other image formats through PIL when available (store
+    arrays as .npy/.npz or pass loader= on PIL-less stacks)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        valid, exts = _file_filter(extensions, is_valid_file)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fn)
+                if valid(path):
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no samples matching {exts} under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        low = path.lower()
+        if low.endswith(".npy"):
+            return np.load(path)
+        if low.endswith(".npz"):
+            return next(iter(np.load(path).values()))
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise ImportError(
+                f"loading {path} needs PIL; store arrays as .npy/.npz "
+                "or pass a custom loader=") from e
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive unlabeled image folder (reference folder.py:219):
+    yields (image,) per sample for inference sweeps."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        valid, _ = _file_filter(extensions, is_valid_file)
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                if valid(path):
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"no images under {root}")
+
+    def __getitem__(self, index):
+        img = self.loader(self.samples[index])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Segmentation pairs (reference vision/datasets/voc2012.py:40):
+    (image [H,W,3] uint8, label mask [H,W] uint8 with 21 classes).
+    Synthetic fallback: deterministic blob masks + class-colored images
+    so segmentation models train without files."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic_size=None,
+                 image_size=64):
+        self.mode = mode
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            blob = np.load(data_file)
+            self.images, self.masks = blob["images"], blob["masks"]
+        else:
+            n = synthetic_size or (128 if mode == "train" else 32)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            h = w = image_size
+            yy, xx = np.mgrid[0:h, 0:w]
+            images = np.zeros((n, h, w, 3), np.uint8)
+            masks = np.zeros((n, h, w), np.uint8)
+            for i in range(n):
+                cls = rng.randint(1, self.NUM_CLASSES)
+                cy, cx = rng.randint(h // 4, 3 * h // 4, size=2)
+                r = rng.randint(h // 8, h // 4)
+                blob = ((yy - cy) ** 2 + (xx - cx) ** 2) < r * r
+                masks[i][blob] = cls
+                images[i] = rng.randint(0, 40, (h, w, 3))
+                images[i][blob] = (cls * 11 % 255, cls * 37 % 255,
+                                   cls * 73 % 255)
+            self.images, self.masks = images, masks
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)

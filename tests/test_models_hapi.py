@@ -143,3 +143,36 @@ def test_summary():
     res = summary(LeNet())
     assert res["total_params"] > 0
     assert res["trainable_params"] == res["total_params"]
+
+
+def test_dataset_folder_and_voc(tmp_path):
+    """DatasetFolder/ImageFolder directory scanning + VOC2012 synthetic
+    segmentation pairs (reference vision/datasets/folder.py, voc2012.py)."""
+    import numpy as np
+    from paddle_tpu.vision.datasets import (DatasetFolder, ImageFolder,
+                                            VOC2012)
+
+    root = tmp_path / "data"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            np.save(root / cls / f"{i}.npy",
+                    np.full((4, 4, 3), i, np.uint8))
+    ds = DatasetFolder(str(root))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (4, 4, 3) and label == 0
+    assert ds[5][1] == 1
+
+    flat = ImageFolder(str(root))
+    assert len(flat) == 6
+    (sample,) = flat[0]
+    assert sample.shape == (4, 4, 3)
+
+    voc = VOC2012(mode="train", synthetic_size=8, image_size=32)
+    img, mask = voc[0]
+    assert img.shape == (32, 32, 3) and mask.shape == (32, 32)
+    assert mask.max() >= 1 and mask.max() < VOC2012.NUM_CLASSES
+    # masks non-trivial and images correlated with masks
+    assert (mask > 0).sum() > 10
