@@ -2,3 +2,5 @@ from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
                          Lars, Momentum, RMSProp)  # noqa: F401
+from .extras import (ExponentialMovingAverage, LookaheadOptimizer,  # noqa: F401
+                     ModelAverage)

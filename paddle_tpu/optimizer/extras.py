@@ -1,0 +1,217 @@
+"""Weight-averaging training utilities: EMA, ModelAverage, Lookahead.
+
+Reference: python/paddle/fluid/optimizer.py — ExponentialMovingAverage
+(:3466, shadow vars updated as s = decay*s + (1-decay)*p with optional
+thres_steps-ramped decay and bias correction), ModelAverage (:3157,
+sliding-window parameter sums with apply/restore scopes) and
+LookaheadOptimizer (:5238, slow/fast weights: every k steps
+slow += alpha*(fast-slow), fast = slow).
+
+TPU-first: all three are pure array transforms over the live parameter
+list — shadow state is a dict of jax arrays, apply()/restore() swap
+param buffers in place (no Program rewriting), and every update is a
+handful of fused elementwise ops XLA executes in one kernel. Usable
+from eager loops and from hapi callbacks alike.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import no_grad
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage",
+           "LookaheadOptimizer"]
+
+
+def _swap_scope(obj, params, new_value_of, need_restore):
+    """Back up live params, swap in new values, return a context manager
+    that restores on exit. Nested apply() without restore() would clobber
+    the backup with already-swapped weights — refuse instead."""
+    if obj._backup is not None:
+        raise RuntimeError(
+            f"{type(obj).__name__}.apply() is already active; call "
+            "restore() (or leave the `with` scope) before applying again")
+    obj._backup = {id(p): p._data for p in params}
+    for p in params:
+        p._data = new_value_of(p).astype(p._data.dtype)
+
+    @contextlib.contextmanager
+    def scope():
+        try:
+            yield
+        finally:
+            if need_restore:
+                obj.restore()
+    return scope()
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (fluid/optimizer.py:3466 parity).
+
+    update() after each optimizer step; apply() swaps EMA weights in
+    (optionally as a context manager), restore() swaps back.
+    With thres_steps/bias correction: decay_t = min(decay,
+    (1+t)/(10+t)) like the reference's ramped schedule.
+    """
+
+    def __init__(self, parameters, decay: float = 0.999,
+                 thres_steps: bool = False, name: Optional[str] = None):
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self.decay = float(decay)
+        self.thres_steps = bool(thres_steps)
+        self._step = 0
+        self._shadow: Dict[int, jnp.ndarray] = {
+            id(p): jnp.asarray(p._data) for p in self._params}
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    def _decay_t(self) -> float:
+        if not self.thres_steps:
+            return self.decay
+        t = self._step
+        return min(self.decay, (1.0 + t) / (10.0 + t))
+
+    @no_grad()
+    def update(self):
+        self._step += 1
+        d = self._decay_t()
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p._data
+
+    @no_grad()
+    def apply(self, need_restore: bool = True):
+        """Swap EMA weights into the live params. Returns a context
+        manager when used with `with ema.apply(): ...`; without `with`,
+        call restore() manually."""
+        return _swap_scope(self, self._params,
+                           lambda p: self._shadow[id(p)], need_restore)
+
+    @no_grad()
+    def restore(self):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def state_dict(self):
+        return {"step": self._step,
+                "shadow": {i: np.asarray(s) for i, (k, s) in
+                           enumerate(self._shadow.items())}}
+
+    def set_state_dict(self, state):
+        self._step = int(state["step"])
+        for i, p in enumerate(self._params):
+            self._shadow[id(p)] = jnp.asarray(state["shadow"][i])
+
+
+class ModelAverage:
+    """Sliding-window parameter averaging (fluid/optimizer.py:3157
+    parity): accumulates parameter sums each step; apply() swaps in the
+    window average for evaluation, restore() swaps back.
+
+    The window holds at most max_average_window steps and at least
+    min_average_window (the reference's average_window_rate bounds the
+    window relative to total steps; here the rate caps growth the same
+    way: window <= average_window_rate * num_updates).
+    """
+
+    def __init__(self, average_window_rate: float = 0.15, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        self._params = [p for p in (parameters or [])
+                        if not p.stop_gradient]
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._num_updates = 0
+        self._window = 0
+        self._sum: Dict[int, jnp.ndarray] = {
+            id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    @no_grad()
+    def step(self):
+        """Accumulate the current parameters into the window (call after
+        each optimizer step)."""
+        self._num_updates += 1
+        self._window += 1
+        limit = max(self.min_window,
+                    min(self.max_window,
+                        int(self.rate * self._num_updates) or 1))
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        if self._window > limit:
+            # restart the window from the running mean (the reference
+            # rotates previous-sum blocks; a mean-seeded restart keeps
+            # the same bounded-window semantics with O(1) state)
+            for p in self._params:
+                mean = self._sum[id(p)] / self._window
+                self._sum[id(p)] = mean
+            self._window = 1
+
+    @no_grad()
+    def apply(self, executor=None, need_restore: bool = True):
+        if self._window == 0:
+            raise RuntimeError(
+                "ModelAverage.apply() before any step(): the window is "
+                "empty (the reference errors on zero accumulates too)")
+        w = self._window
+        return _swap_scope(self, self._params,
+                           lambda p: self._sum[id(p)] / w, need_restore)
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (fluid/optimizer.py:5238 parity): the inner
+    optimizer updates fast weights every step; every k steps the slow
+    weights move slow += alpha*(fast-slow) and fast resets to slow."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        assert inner_optimizer is not None
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow: Optional[Dict[int, jnp.ndarray]] = None
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._param_list()
+                if not p.stop_gradient]
+
+    @no_grad()
+    def step(self):
+        if self._slow is None:
+            self._slow = {id(p): jnp.asarray(p._data)
+                          for p in self._params()}
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            a = self.alpha
+            for p in self._params():
+                slow = self._slow[id(p)]
+                slow = slow + a * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, None

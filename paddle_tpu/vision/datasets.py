@@ -155,10 +155,13 @@ class DatasetFolder(Dataset):
         self.samples = []
         for c in classes:
             cdir = os.path.join(root, c)
-            for fn in sorted(os.listdir(cdir)):
-                path = os.path.join(cdir, fn)
-                if valid(path):
-                    self.samples.append((path, self.class_to_idx[c]))
+            # recurse like the reference's make_dataset (folder.py):
+            # class dirs may nest sessions/shards of files
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    if valid(path):
+                        self.samples.append((path, self.class_to_idx[c]))
         if not self.samples:
             raise ValueError(f"no samples matching {exts} under {root}")
 

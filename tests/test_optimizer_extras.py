@@ -1,0 +1,178 @@
+"""EMA / ModelAverage / Lookahead (reference fluid/optimizer.py:3466,
+:3157, :5238): shadow math, apply/restore scopes, slow-weight sync."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import (ExponentialMovingAverage,
+                                  LookaheadOptimizer, ModelAverage)
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+def _tiny_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    lin = nn.Linear(4, 1)
+    xs = rng.randn(16, 4).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    x = paddle.to_tensor(xs)
+    y = paddle.to_tensor(xs @ w)        # realizable: loss -> 0
+    return lin, x, y
+
+
+class TestEMA:
+    def test_shadow_math(self):
+        lin, x, y = _tiny_problem()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        ema = ExponentialMovingAverage(lin.parameters(), decay=0.9)
+        p = [q for q in lin.parameters() if not q.stop_gradient][0]
+        shadow0 = _np(p).copy()
+        loss = F.mse_loss(lin(x), y)
+        opt.clear_grad(); loss.backward(); opt.step()
+        ema.update()
+        expect = 0.9 * shadow0 + 0.1 * _np(p)
+        np.testing.assert_allclose(_np(ema._shadow[id(p)]), expect,
+                                   rtol=1e-5)
+
+    def test_apply_restore_scope(self):
+        lin, x, y = _tiny_problem()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        ema = ExponentialMovingAverage(lin.parameters(), decay=0.5)
+        for _ in range(3):
+            loss = F.mse_loss(lin(x), y)
+            opt.clear_grad(); loss.backward(); opt.step()
+            ema.update()
+        p = [q for q in lin.parameters() if not q.stop_gradient][0]
+        live = _np(p).copy()
+        with ema.apply():
+            applied = _np(p).copy()
+            np.testing.assert_allclose(applied,
+                                       _np(ema._shadow[id(p)]), rtol=1e-6)
+            assert not np.allclose(applied, live)
+        np.testing.assert_allclose(_np(p), live)   # restored
+
+    def test_thres_steps_ramp(self):
+        lin, _, _ = _tiny_problem()
+        ema = ExponentialMovingAverage(lin.parameters(), decay=0.999,
+                                       thres_steps=True)
+        assert ema._decay_t() == pytest.approx(0.1)   # t=0: 1/10
+        ema._step = 90
+        assert ema._decay_t() == pytest.approx(91 / 100)
+
+    def test_state_roundtrip(self):
+        lin, x, y = _tiny_problem()
+        ema = ExponentialMovingAverage(lin.parameters(), decay=0.9)
+        ema.update()
+        st = ema.state_dict()
+        ema2 = ExponentialMovingAverage(lin.parameters(), decay=0.9)
+        ema2.set_state_dict(st)
+        for p in ema._params:
+            np.testing.assert_allclose(_np(ema2._shadow[id(p)]),
+                                       _np(ema._shadow[id(p)]))
+
+
+class TestModelAverage:
+    def test_window_average(self):
+        lin, x, y = _tiny_problem()
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=lin.parameters())
+        ma = ModelAverage(parameters=lin.parameters(),
+                          min_average_window=10, max_average_window=100)
+        p = [q for q in lin.parameters() if not q.stop_gradient][0]
+        snaps = []
+        for _ in range(4):
+            loss = F.mse_loss(lin(x), y)
+            opt.clear_grad(); loss.backward(); opt.step()
+            ma.step()
+            snaps.append(_np(p).copy())
+        live = _np(p).copy()
+        with ma.apply():
+            np.testing.assert_allclose(_np(p), np.mean(snaps, axis=0),
+                                       rtol=1e-5)
+        np.testing.assert_allclose(_np(p), live)
+
+    def test_averaged_weights_evaluate_smoother(self):
+        lin, x, y = _tiny_problem()
+        opt = paddle.optimizer.SGD(learning_rate=0.9,  # noisy/overshooting
+                                   parameters=lin.parameters())
+        ma = ModelAverage(parameters=lin.parameters(),
+                          min_average_window=4, max_average_window=50)
+        for _ in range(30):
+            loss = F.mse_loss(lin(x), y)
+            opt.clear_grad(); loss.backward(); opt.step()
+            ma.step()
+        raw = float(_np(F.mse_loss(lin(x), y)))
+        with ma.apply():
+            avg = float(_np(F.mse_loss(lin(x), y)))
+        assert np.isfinite(avg)
+        assert avg <= raw * 1.5   # averaging must not blow up the loss
+
+
+class TestLookahead:
+    def test_slow_weight_sync(self):
+        lin, x, y = _tiny_problem()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        look = LookaheadOptimizer(inner, alpha=0.5, k=2)
+        p = [q for q in lin.parameters() if not q.stop_gradient][0]
+        slow0 = _np(p).copy()
+        # step 1: fast only
+        loss = F.mse_loss(lin(x), y)
+        look.clear_grad(); loss.backward(); look.step()
+        fast1 = _np(p).copy()
+        assert not np.allclose(fast1, slow0)
+        # step 2: sync -> p = slow0 + 0.5*(fast2 - slow0)
+        loss = F.mse_loss(lin(x), y)
+        look.clear_grad(); loss.backward()
+        g = _np(p.grad)
+        fast2 = fast1 - 0.1 * g
+        look.step()
+        np.testing.assert_allclose(_np(p), slow0 + 0.5 * (fast2 - slow0),
+                                   rtol=1e-5)
+
+    def test_converges(self):
+        lin, x, y = _tiny_problem()
+        inner = paddle.optimizer.SGD(learning_rate=0.2,
+                                     parameters=lin.parameters())
+        look = LookaheadOptimizer(inner, alpha=0.8, k=3)
+        first = last = None
+        for i in range(60):
+            loss = F.mse_loss(lin(x), y)
+            look.clear_grad(); loss.backward(); look.step()
+            if i == 0: first = float(_np(loss))
+            last = float(_np(loss))
+        assert last < first * 0.1, (first, last)
+
+
+class TestApplyGuards:
+    def test_double_apply_refused(self):
+        lin, x, y = _tiny_problem()
+        ema = ExponentialMovingAverage(lin.parameters(), decay=0.9)
+        ema.update()
+        ema.apply()
+        with pytest.raises(RuntimeError, match="already active"):
+            ema.apply()
+        ema.restore()
+
+    def test_model_average_empty_window_refused(self):
+        lin, x, y = _tiny_problem()
+        ma = ModelAverage(parameters=lin.parameters())
+        with pytest.raises(RuntimeError, match="window is\s+empty"):
+            ma.apply()
+
+    def test_dataset_folder_recurses(self, tmp_path):
+        import numpy as np
+        from paddle_tpu.vision.datasets import DatasetFolder
+        nested = tmp_path / "cls_a" / "session1"
+        nested.mkdir(parents=True)
+        np.save(nested / "0.npy", np.zeros((2, 2), np.uint8))
+        (tmp_path / "cls_b").mkdir()
+        np.save(tmp_path / "cls_b" / "0.npy", np.ones((2, 2), np.uint8))
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 2       # the nested sample is found
