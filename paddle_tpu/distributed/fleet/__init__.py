@@ -5,8 +5,5 @@ from .base import (DistributedStrategy, PaddleCloudRoleMaker, UserDefinedRoleMak
 from . import meta_optimizers  # noqa: F401
 from .meta_optimizers import (StrategyCompiler, TrainStepSpec,  # noqa: F401
                               LocalSGDStep, META_OPTIMIZERS)
-from .. import recompute as _recompute_mod  # noqa: F401
-
-
-class utils:  # namespace shim: fleet.utils.recompute
-    recompute = staticmethod(_recompute_mod.recompute)
+from . import metrics  # noqa: F401
+from . import utils  # noqa: F401  # fleet.utils.{recompute,fs,http_server}

@@ -1,0 +1,80 @@
+"""Fleet global metrics (reference
+python/paddle/distributed/fleet/metrics/metric.py: sum/max/min/auc/mae/
+rmse/acc computed across all trainers via fleet allreduce).
+
+Each helper reduces per-rank statistics over the collective group
+(distributed/collective.py — lax collectives inside a mesh context,
+identity at world size 1) and returns a python float/np array, matching
+the reference's "scalar metric over the whole fleet" contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....framework import Tensor
+from ... import collective as _c
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
+
+_pysum, _pymax, _pymin = sum, max, min
+
+
+def _reduce(value, op):
+    arr = np.asarray(value._data if isinstance(value, Tensor) else value,
+                     np.float64)
+    t = Tensor(np.asarray(arr, np.float32))
+    out = _c.all_reduce(t, op=op)
+    return np.asarray(out._data if isinstance(out, Tensor) else out)
+
+
+def sum(input):  # noqa: A001 — reference name
+    """Global sum of a per-rank stat."""
+    return _reduce(input, _c.ReduceOp.SUM)
+
+
+def max(input):  # noqa: A001
+    return _reduce(input, _c.ReduceOp.MAX)
+
+
+def min(input):  # noqa: A001
+    return _reduce(input, _c.ReduceOp.MIN)
+
+
+def acc(correct, total):
+    """Global accuracy: sum(correct) / sum(total)."""
+    c = float(sum(correct).sum())
+    t = float(sum(total).sum())
+    return c / t if t else 0.0
+
+
+def mae(abserr, total_ins_num):
+    """Global mean absolute error from per-rank (sum|err|, count)."""
+    e = float(sum(abserr).sum())
+    n = float(sum(total_ins_num).sum())
+    return e / n if n else 0.0
+
+
+def rmse(sqrerr, total_ins_num):
+    """Global root-mean-square error from per-rank (sum err^2, count)."""
+    e = float(sum(sqrerr).sum())
+    n = float(sum(total_ins_num).sum())
+    return float(np.sqrt(e / n)) if n else 0.0
+
+
+def auc(stat_pos, stat_neg):
+    """Global AUC from per-rank positive/negative score histograms
+    (reference auc: allreduce the [num_buckets] pos/neg counts, then the
+    trapezoidal sweep over buckets — fleet metric.py:healthy)."""
+    pos = sum(stat_pos).astype(np.float64).ravel()
+    neg = sum(stat_neg).astype(np.float64).ravel()
+    # sweep from the highest score bucket down
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

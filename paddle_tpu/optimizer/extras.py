@@ -38,6 +38,11 @@ def _swap_scope(obj, params, new_value_of, need_restore):
     obj._backup = {id(p): p._data for p in params}
     for p in params:
         p._data = new_value_of(p).astype(p._data.dtype)
+    if not need_restore:
+        # the swap is permanent: discard the backup so later apply()
+        # calls aren't refused and a stray restore() can't roll params
+        # back to this stale snapshot
+        obj._backup = None
 
     @contextlib.contextmanager
     def scope():
@@ -211,7 +216,8 @@ class LookaheadOptimizer:
     def get_lr(self):
         return self.inner_optimizer.get_lr()
 
-    def minimize(self, loss, **kwargs):
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
         loss.backward()
         self.step()
-        return None, None
+        return None, [(p, p.grad) for p in self._params()]

@@ -1,0 +1,103 @@
+"""Fleet utils (reference fleet/utils/fs.py + http_server.py) and fleet
+global metrics (fleet/metrics/metric.py): LocalFS surface, HTTP KV
+rendezvous store, cross-"rank" metric reduction (world size 1 identity +
+8-device mesh check)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import (KVClient, KVServer,
+                                                LocalFS)
+from paddle_tpu.distributed.fleet.metrics import metric as M
+
+
+class TestLocalFS:
+    def test_surface(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "a" / "b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert dirs == ["b"] and files == []
+        dirs, files = fs.ls_dir(d)
+        assert files == ["x.txt"]
+        fs.mv(f, os.path.join(d, "y.txt"))
+        assert not fs.is_exist(f)
+        fs.upload(os.path.join(d, "y.txt"), str(tmp_path / "up.txt"))
+        assert fs.is_file(str(tmp_path / "up.txt"))
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_requires_binary(self):
+        import shutil
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        if shutil.which("hadoop"):
+            pytest.skip("hadoop present")
+        with pytest.raises(RuntimeError, match="hadoop"):
+            HDFSClient()
+
+
+class TestKVServer:
+    def test_put_get_delete(self):
+        with KVServer(0, host="127.0.0.1") as srv:
+            cli = KVClient(f"127.0.0.1:{srv.port}")
+            assert cli.get("missing") is None
+            cli.put("job/rank0", b"ep0:1234")
+            cli.put("job/rank1", "ep1:1235")
+            assert cli.get("job/rank0") == b"ep0:1234"
+            assert cli.get("job/rank1") == b"ep1:1235"
+            cli.delete("job/rank0")
+            assert cli.get("job/rank0") is None
+
+    def test_barrier_pattern(self):
+        # the role_maker Gloo-HTTP pattern: every rank writes its key,
+        # then polls until all are present
+        with KVServer(0, host="127.0.0.1") as srv:
+            cli = KVClient(f"127.0.0.1:{srv.port}")
+            for r in range(4):
+                cli.put(f"barrier/{r}", b"1")
+            present = [cli.get(f"barrier/{r}") for r in range(4)]
+            assert all(v == b"1" for v in present)
+
+
+class TestFleetMetrics:
+    def test_world1_identity(self):
+        assert float(M.sum(np.array([3.0, 4.0])).sum()) == 7.0
+        assert M.acc(np.array(30.0), np.array(40.0)) == pytest.approx(0.75)
+        assert M.mae(np.array(5.0), np.array(10.0)) == pytest.approx(0.5)
+        assert M.rmse(np.array(16.0), np.array(4.0)) == pytest.approx(2.0)
+
+    def test_auc_separable(self):
+        # scores bucketized 0..9; positives high, negatives low -> auc ~1
+        pos = np.zeros(10); pos[8:] = 50
+        neg = np.zeros(10); neg[:2] = 50
+        assert M.auc(pos, neg) == pytest.approx(1.0)
+        # identical distributions -> 0.5
+        flat = np.ones(10)
+        assert M.auc(flat, flat) == pytest.approx(0.5)
+
+    def test_across_mesh_ranks(self):
+        # inside an 8-device shard_map, per-rank stats reduce globally
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+        from paddle_tpu.distributed import collective as C
+
+        devs = np.array(jax.devices()[:8])
+        mesh = Mesh(devs, ("dp",))
+        per_rank = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+
+        def g(x):
+            from paddle_tpu.framework import Tensor
+            out = C.all_reduce(Tensor(x.reshape(())), group="dp")
+            return out._data.reshape(1)
+
+        with mesh:
+            total = shard_map(g, mesh=mesh, in_specs=P("dp"),
+                              out_specs=P("dp"))(per_rank)
+        np.testing.assert_allclose(np.asarray(total), 28.0)

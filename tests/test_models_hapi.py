@@ -176,3 +176,17 @@ def test_dataset_folder_and_voc(tmp_path):
     assert mask.max() >= 1 and mask.max() < VOC2012.NUM_CLASSES
     # masks non-trivial and images correlated with masks
     assert (mask > 0).sum() > 10
+
+
+def test_dataset_folder_recurses(tmp_path):
+    """DatasetFolder recurses into nested class subdirs (reference
+    folder.py make_dataset semantics)."""
+    import numpy as np
+    from paddle_tpu.vision.datasets import DatasetFolder
+    nested = tmp_path / "cls_a" / "session1"
+    nested.mkdir(parents=True)
+    np.save(nested / "0.npy", np.zeros((2, 2), np.uint8))
+    (tmp_path / "cls_b").mkdir()
+    np.save(tmp_path / "cls_b" / "0.npy", np.ones((2, 2), np.uint8))
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 2

@@ -163,16 +163,16 @@ class TestApplyGuards:
     def test_model_average_empty_window_refused(self):
         lin, x, y = _tiny_problem()
         ma = ModelAverage(parameters=lin.parameters())
-        with pytest.raises(RuntimeError, match="window is\s+empty"):
+        with pytest.raises(RuntimeError, match=r"window is\s+empty"):
             ma.apply()
 
-    def test_dataset_folder_recurses(self, tmp_path):
-        import numpy as np
-        from paddle_tpu.vision.datasets import DatasetFolder
-        nested = tmp_path / "cls_a" / "session1"
-        nested.mkdir(parents=True)
-        np.save(nested / "0.npy", np.zeros((2, 2), np.uint8))
-        (tmp_path / "cls_b").mkdir()
-        np.save(tmp_path / "cls_b" / "0.npy", np.ones((2, 2), np.uint8))
-        ds = DatasetFolder(str(tmp_path))
-        assert len(ds) == 2       # the nested sample is found
+
+    def test_apply_no_restore_is_permanent(self):
+        lin, x, y = _tiny_problem()
+        ema = ExponentialMovingAverage(lin.parameters(), decay=0.9)
+        ema.update()
+        ema.apply(need_restore=False)       # keep averaged weights
+        assert ema._backup is None          # no stale snapshot retained
+        ema.update()
+        with ema.apply():                   # later applies still work
+            pass

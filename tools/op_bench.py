@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Per-op micro-benchmark harness (reference
+paddle/fluid/operators/benchmark/op_tester.cc — time one registered op
+over a shape/dtype config, report per-call latency).
+
+Usage:
+  python tools/op_bench.py --op matmul_v2 --shapes 512x512,512x512 -n 200
+  python tools/op_bench.py --suite            # common-op default suite
+  python tools/op_bench.py --op softmax_op --shapes 128x1024 --grad
+
+Prints one JSON line per benchmark:
+  {"op", "shapes", "dtype", "mode", "mean_us", "p50_us", "min_us",
+   "iters"}
+Modes: eager (framework dispatch incl. tape when --grad) and jit
+(pure fn under jax.jit — the compiled-path cost). The eager-vs-jit gap
+is the dispatch overhead the eager fast path (FLAGS_eager_op_jit)
+minimizes.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _parse_shapes(spec):
+    return [tuple(int(d) for d in s.split("x")) for s in spec.split(",")]
+
+
+def _time(fn, iters, sync):
+    fn()  # warmup / compile
+    sync()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn()
+        sync_out(out)
+        samples.append((time.perf_counter() - t0) * 1e6)
+    arr = np.asarray(samples)
+    return {"mean_us": round(float(arr.mean()), 2),
+            "p50_us": round(float(np.percentile(arr, 50)), 2),
+            "min_us": round(float(arr.min()), 2)}
+
+
+def sync_out(out):
+    import jax
+    leaves = jax.tree_util.tree_leaves(
+        out if not hasattr(out, "_data") else out._data)
+    for leaf in leaves:
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def bench_op(op_name, shapes, dtype="float32", iters=100, grad=False,
+             attrs=None):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.registry import OPS
+
+    if op_name not in OPS:
+        raise SystemExit(f"op '{op_name}' not registered "
+                         f"({len(OPS)} ops; see OP_COVERAGE.md)")
+    info = OPS[op_name]
+    rng = np.random.RandomState(0)
+    arrays = [rng.randn(*s).astype(dtype) if "float" in dtype
+              else rng.randint(0, 10, s).astype(dtype) for s in shapes]
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    if grad:
+        for t in tensors:
+            t.stop_gradient = False
+    kw = dict(attrs or {})
+
+    from paddle_tpu.ops.registry import run_op
+
+    def eager():
+        return run_op(op_name, info.fn, tuple(tensors), dict(kw))
+
+    jitted = jax.jit(lambda *xs: info.fn(*xs, **kw))
+    jarrays = [t._data for t in tensors]
+
+    def compiled():
+        return jitted(*jarrays)
+
+    out = []
+    for mode, fn in (("eager", eager), ("jit", compiled)):
+        stats = _time(fn, iters, lambda: None)
+        out.append({"op": op_name,
+                    "shapes": [list(s) for s in shapes],
+                    "dtype": dtype, "mode": mode,
+                    "grad": bool(grad and mode == "eager"),
+                    "iters": iters, **stats})
+    return out
+
+
+_SUITE = [
+    ("elementwise_add", "64x64,64x64", {}),
+    ("matmul_v2", "256x256,256x256", {}),
+    ("softmax_op", "128x1024", {}),
+    ("gelu", "128x1024", {}),
+    ("reduce_sum", "256x1024", {}),
+    ("transpose", "256x1024", {"perm": [1, 0]}),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser("op_bench")
+    ap.add_argument("--op")
+    ap.add_argument("--shapes", help="comma list, dims x-separated")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("-n", "--iters", type=int, default=100)
+    ap.add_argument("--grad", action="store_true",
+                    help="eager mode with tape recording")
+    ap.add_argument("--attrs", default=None,
+                    help="JSON dict of op attributes")
+    ap.add_argument("--suite", action="store_true",
+                    help="run the default common-op suite")
+    args = ap.parse_args()
+
+    runs = []
+    if args.suite:
+        for op, shapes, attrs in _SUITE:
+            runs.append((op, _parse_shapes(shapes), attrs))
+    else:
+        if not args.op or not args.shapes:
+            ap.error("--op and --shapes required (or --suite)")
+        runs.append((args.op, _parse_shapes(args.shapes),
+                     json.loads(args.attrs) if args.attrs else {}))
+
+    for op, shapes, attrs in runs:
+        try:
+            for row in bench_op(op, shapes, args.dtype, args.iters,
+                                args.grad, attrs):
+                print(json.dumps(row))
+        except Exception as e:
+            print(json.dumps({"op": op, "error": f"{type(e).__name__}: "
+                                                 f"{e}"}))
+
+
+if __name__ == "__main__":
+    main()
