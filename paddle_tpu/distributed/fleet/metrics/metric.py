@@ -19,54 +19,66 @@ __all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
 _pysum, _pymax, _pymin = sum, max, min
 
 
-def _reduce(value, op):
-    arr = np.asarray(value._data if isinstance(value, Tensor) else value,
-                     np.float64)
-    t = Tensor(np.asarray(arr, np.float32))
-    out = _c.all_reduce(t, op=op)
-    return np.asarray(out._data if isinstance(out, Tensor) else out)
+def _reduce(value, op, group=None):
+    import jax
+    import jax.numpy as jnp
+    arr = value._data if isinstance(value, Tensor) else value
+    if isinstance(arr, jax.Array) or isinstance(arr, jax.core.Tracer):
+        # traced / device value (inside a mesh program): reduce with lax
+        # collectives, keeping the caller's dtype untouched
+        out = _c.all_reduce(Tensor(arr), op=op, group=group)
+        return out._data if isinstance(out, Tensor) else out
+    # concrete host statistic: stay in float64 the whole way (counts past
+    # 2^24 must not round); world size 1 makes all_reduce the identity,
+    # so skip the float32 device round-trip entirely
+    arr64 = np.asarray(arr, np.float64)
+    if _c._axis_for(group) is None:
+        return arr64
+    out = _c.all_reduce(Tensor(jnp.asarray(arr)), op=op, group=group)
+    return np.asarray(out._data if isinstance(out, Tensor) else out,
+                      np.float64)
 
 
-def sum(input):  # noqa: A001 — reference name
-    """Global sum of a per-rank stat."""
-    return _reduce(input, _c.ReduceOp.SUM)
+def sum(input, group=None):  # noqa: A001 — reference name
+    """Global sum of a per-rank stat (group: mesh axis name/Group)."""
+    return _reduce(input, _c.ReduceOp.SUM, group)
 
 
-def max(input):  # noqa: A001
-    return _reduce(input, _c.ReduceOp.MAX)
+def max(input, group=None):  # noqa: A001
+    return _reduce(input, _c.ReduceOp.MAX, group)
 
 
-def min(input):  # noqa: A001
-    return _reduce(input, _c.ReduceOp.MIN)
+def min(input, group=None):  # noqa: A001
+    return _reduce(input, _c.ReduceOp.MIN, group)
 
 
-def acc(correct, total):
+def acc(correct, total, group=None):
     """Global accuracy: sum(correct) / sum(total)."""
-    c = float(sum(correct).sum())
-    t = float(sum(total).sum())
+    c = float(sum(correct, group).sum())
+    t = float(sum(total, group).sum())
     return c / t if t else 0.0
 
 
-def mae(abserr, total_ins_num):
+def mae(abserr, total_ins_num, group=None):
     """Global mean absolute error from per-rank (sum|err|, count)."""
-    e = float(sum(abserr).sum())
-    n = float(sum(total_ins_num).sum())
+    e = float(sum(abserr, group).sum())
+    n = float(sum(total_ins_num, group).sum())
     return e / n if n else 0.0
 
 
-def rmse(sqrerr, total_ins_num):
+def rmse(sqrerr, total_ins_num, group=None):
     """Global root-mean-square error from per-rank (sum err^2, count)."""
-    e = float(sum(sqrerr).sum())
-    n = float(sum(total_ins_num).sum())
+    e = float(sum(sqrerr, group).sum())
+    n = float(sum(total_ins_num, group).sum())
     return float(np.sqrt(e / n)) if n else 0.0
 
 
-def auc(stat_pos, stat_neg):
+def auc(stat_pos, stat_neg, group=None):
     """Global AUC from per-rank positive/negative score histograms
     (reference auc: allreduce the [num_buckets] pos/neg counts, then the
     trapezoidal sweep over buckets — fleet metric.py:healthy)."""
-    pos = sum(stat_pos).astype(np.float64).ravel()
-    neg = sum(stat_neg).astype(np.float64).ravel()
+    pos = sum(stat_pos, group).astype(np.float64).ravel()
+    neg = sum(stat_neg, group).astype(np.float64).ravel()
     # sweep from the highest score bucket down
     tot_pos = tot_neg = 0.0
     area = 0.0

@@ -130,20 +130,20 @@ class HDFSClient(FS):
                 "(it is a CLI wrapper, like the reference); use LocalFS "
                 "for local checkpoints")
 
-    def _run(self, *args) -> str:
+    def _run(self, *args, check=True) -> Tuple[int, str]:
         cmd = [self.hadoop, "fs"]
         for k, v in self.configs.items():
             cmd += ["-D", f"{k}={v}"]
         cmd += list(args)
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=self.time_out)
-        if res.returncode != 0:
+        if check and res.returncode != 0:
             raise RuntimeError(
                 f"hadoop fs {' '.join(args)} failed: {res.stderr}")
-        return res.stdout
+        return res.returncode, res.stdout
 
     def ls_dir(self, path):
-        out = self._run("-ls", path)
+        _, out = self._run("-ls", path)
         dirs, files = [], []
         for line in out.splitlines():
             parts = line.split()
@@ -154,12 +154,14 @@ class HDFSClient(FS):
         return sorted(dirs), sorted(files)
 
     def is_exist(self, path):
-        return subprocess.run(
-            [self.hadoop, "fs", "-test", "-e", path]).returncode == 0
+        # same -D configs/timeout/capture as every other call; -test uses
+        # its exit code as the answer, so no raise on nonzero
+        rc, _ = self._run("-test", "-e", path, check=False)
+        return rc == 0
 
     def is_dir(self, path):
-        return subprocess.run(
-            [self.hadoop, "fs", "-test", "-d", path]).returncode == 0
+        rc, _ = self._run("-test", "-d", path, check=False)
+        return rc == 0
 
     def is_file(self, path):
         return self.is_exist(path) and not self.is_dir(path)
@@ -173,9 +175,18 @@ class HDFSClient(FS):
     def rename(self, src, dst):
         self._run("-mv", src, dst)
 
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self.rename(src, dst)
+
     def touch(self, path, exist_ok=True):
-        if not exist_ok and self.is_exist(path):
-            raise FileExistsError(path)
+        if self.is_exist(path):
+            # -touchz errors on non-empty files; the reference's touch is
+            # a no-op for existing paths unless exist_ok is False
+            if not exist_ok:
+                raise FileExistsError(path)
+            return
         self._run("-touchz", path)
 
     def upload(self, local_path, fs_path):

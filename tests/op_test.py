@@ -47,11 +47,14 @@ class OpTest:
         outs = out if isinstance(out, (list, tuple)) else (out,)
         refs = ref if isinstance(ref, (list, tuple)) else (ref,)
         for o, r in zip(outs, refs):
+            if jnp.issubdtype(o.dtype, jnp.complexfloating):
+                got = np.asarray(o._data, dtype=np.complex128)
+            elif jnp.issubdtype(o.dtype, jnp.inexact):
+                got = np.asarray(o._data, dtype=np.float64)
+            else:
+                got = np.asarray(o._data)
             np.testing.assert_allclose(
-                np.asarray(o._data, dtype=np.float64)
-                if jnp.issubdtype(o.dtype, jnp.inexact)
-                else np.asarray(o._data),
-                r, rtol=rtol, atol=atol,
+                got, r, rtol=rtol, atol=atol,
                 err_msg=f"op {type(self).__name__} output mismatch")
         # jitted path must agree with eager
         pure = getattr(type(self).op_fn, "__pure_fn__", None)

@@ -101,3 +101,34 @@ class TestFleetMetrics:
             total = shard_map(g, mesh=mesh, in_specs=P("dp"),
                               out_specs=P("dp"))(per_rank)
         np.testing.assert_allclose(np.asarray(total), 28.0)
+
+    def test_metric_helpers_traced_in_mesh(self):
+        # the metric helpers themselves (not raw collectives) must work
+        # on traced per-rank values inside a shard_map program
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+        import paddle_tpu.distributed.fleet.metrics.metric as M
+
+        devs = np.array(jax.devices()[:8])
+        mesh = Mesh(devs, ("dp",))
+        per_rank = (jnp.arange(8, dtype=jnp.float32) + 1).reshape(8, 1)
+
+        def g(x):
+            s = M.sum(x.reshape(()), group="dp")
+            mx = M.max(x.reshape(()), group="dp")
+            return jnp.stack([s, mx]).reshape(1, 2)
+
+        with mesh:
+            out = shard_map(g, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"))(per_rank)
+        got = np.asarray(out)
+        np.testing.assert_allclose(got[:, 0], 36.0)  # 1+..+8 everywhere
+        np.testing.assert_allclose(got[:, 1], 8.0)
+
+    def test_metric_counts_exact_past_2e24(self):
+        # integer counts above 2^24 must not round through float32
+        import paddle_tpu.distributed.fleet.metrics.metric as M
+        n = 16777217  # 2^24 + 1, not representable in float32
+        assert int(M.sum(np.asarray([n], np.int64))[0]) == n

@@ -31,9 +31,8 @@ def _parse_shapes(spec):
     return [tuple(int(d) for d in s.split("x")) for s in spec.split(",")]
 
 
-def _time(fn, iters, sync):
-    fn()  # warmup / compile
-    sync()
+def _time(fn, iters):
+    sync_out(fn())  # warmup / compile, synchronized
     samples = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -87,7 +86,7 @@ def bench_op(op_name, shapes, dtype="float32", iters=100, grad=False,
 
     out = []
     for mode, fn in (("eager", eager), ("jit", compiled)):
-        stats = _time(fn, iters, lambda: None)
+        stats = _time(fn, iters)
         out.append({"op": op_name,
                     "shapes": [list(s) for s in shapes],
                     "dtype": dtype, "mode": mode,

@@ -36,7 +36,7 @@ ALIASES = {
     "trilinear_interp": "interp_op", "trilinear_interp_v2": "interp_op",
     "bilinear_tensor_product": "bilinear_op",
     "concat": "concat_op",
-    "conditional_block": "cond_op",
+    "conditional_block": "ops/control_flow.py:cond",
     "cos_sim": "cosine_similarity_op",
     "crop": "crop_op", "crop_tensor": "crop_op",
     "cross": "cross_op",
