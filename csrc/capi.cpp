@@ -1,0 +1,443 @@
+// C API implementation: embeds CPython and drives the public paddle_tpu
+// API (see paddle_tpu_capi.h for the design rationale; reference
+// capability: inference/capi/c_api.cc + fluid/train/demo/demo_trainer.cc).
+//
+// All Python-facing logic lives in one embedded helper module
+// (_PD_HELPERS below); the C functions marshal flat buffers in and out.
+// Buffers cross the boundary as PyBytes (one copy each way) — simple,
+// ABI-stable, and no dependency on the numpy C API.
+#include "paddle_tpu_capi.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// The Python half of the C API. Only public paddle_tpu surface is used.
+const char* const _PD_HELPERS = R"PY(
+import os as _os
+
+# PD_CAPI_PLATFORM=cpu forces the XLA backend (some accelerator plugins
+# override the JAX_PLATFORMS env var, so this must go through jax.config
+# before the first device use)
+if _os.environ.get("PD_CAPI_PLATFORM"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["PD_CAPI_PLATFORM"])
+    if _os.environ.get("PD_CAPI_CPU_DEVICES"):
+        _jax.config.update("jax_num_cpu_devices",
+                           int(_os.environ["PD_CAPI_CPU_DEVICES"]))
+
+import numpy as _np
+
+
+def _as_array(data_bytes, dtype, shape):
+    return _np.frombuffer(data_bytes, dtype=dtype).reshape(shape).copy()
+
+
+def new_predictor(prefix):
+    import paddle_tpu.inference as inf
+    cfg = inf.Config(prefix)
+    return inf.create_predictor(cfg)
+
+
+def predictor_input_names(p):
+    return list(p.get_input_names())
+
+
+def predictor_output_num(p):
+    return len(p.get_output_names())
+
+
+def predictor_set_input(p, name, data_bytes, dtype, shape):
+    p.get_input_handle(name).copy_from_cpu(_as_array(data_bytes, dtype,
+                                                     shape))
+
+
+def predictor_run(p):
+    p.run()
+
+
+def predictor_output_shape(p, i):
+    name = p.get_output_names()[i]
+    return list(p.get_output_handle(name).copy_to_cpu().shape)
+
+
+def predictor_output_bytes(p, i):
+    name = p.get_output_names()[i]
+    arr = _np.ascontiguousarray(
+        p.get_output_handle(name).copy_to_cpu()).astype(_np.float32)
+    return arr.tobytes()
+
+
+_OPTIMIZERS = {"sgd": "SGD", "momentum": "Momentum", "adam": "Adam",
+               "adamw": "AdamW"}
+
+
+def new_train_session(program_path, loss_name, optimizer, lr):
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    prog = static.Program.load(program_path)
+    loss = prog.var_by_name(loss_name)
+    cls = getattr(paddle.optimizer, _OPTIMIZERS[optimizer.lower()])
+    with static.program_guard(prog, static.Program()):
+        cls(learning_rate=lr).minimize(loss)
+    return {"prog": prog, "loss": loss, "exe": static.Executor(),
+            "feeds": {}}
+
+
+def train_set_feed(sess, name, data_bytes, dtype, shape):
+    sess["feeds"][name] = _as_array(data_bytes, dtype, shape)
+
+
+def train_run_step(sess):
+    (lv,) = sess["exe"].run(sess["prog"], feed=dict(sess["feeds"]),
+                            fetch_list=[sess["loss"]])
+    return float(_np.asarray(lv).reshape(-1)[0])
+
+
+def train_save(sess, path):
+    sess["prog"].save(path)
+)PY";
+
+PyObject* g_helpers = nullptr;  // module dict holding the helper fns
+
+bool ensure_init() {
+  if (g_helpers == nullptr) {
+    g_last_error = "PD_Init was not called (or failed)";
+    return false;
+  }
+  return true;
+}
+
+// Call helper `fn` with args tuple (steals nothing); returns new ref or
+// nullptr with g_last_error set.
+PyObject* call_helper(const char* fn, PyObject* args) {
+  PyObject* f = PyDict_GetItemString(g_helpers, fn);  // borrowed
+  if (f == nullptr) {
+    g_last_error = std::string("missing helper ") + fn;
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+PyObject* shape_tuple(const int64_t* shape, int ndim) {
+  PyObject* t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(shape[i]));
+  return t;
+}
+
+int64_t numel(const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+int64_t dtype_size(const char* dtype) {
+  if (std::strcmp(dtype, "float32") == 0) return 4;
+  if (std::strcmp(dtype, "int32") == 0) return 4;
+  if (std::strcmp(dtype, "int64") == 0) return 8;
+  if (std::strcmp(dtype, "bool") == 0) return 1;
+  return -1;
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() : st(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+struct PD_AnalysisConfig {
+  std::string prefix;
+};
+struct PD_Predictor {
+  PyObject* obj;                 // Python Predictor
+  PyObject* input_names;         // list[str] (cached, owns refs)
+};
+struct PD_TrainSession {
+  PyObject* obj;                 // helper session dict
+};
+
+extern "C" {
+
+int PD_Init(const char* repo_root) {
+  if (g_helpers != nullptr) return 0;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  int rc = -1;
+  {
+    GIL gil;
+    rc = [&]() -> int {
+  if (repo_root != nullptr && repo_root[0] != '\0') {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(repo_root);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  } else if (const char* home = std::getenv("PADDLE_TPU_HOME")) {
+    PyObject* sys_path = PySys_GetObject("path");
+    PyObject* p = PyUnicode_FromString(home);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  PyObject* mod = PyImport_AddModule("__paddle_tpu_capi__");  // borrowed
+  if (mod == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* dict = PyModule_GetDict(mod);  // borrowed
+  PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res = PyRun_String(_PD_HELPERS, Py_file_input, dict, dict);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+      Py_DECREF(res);
+      g_helpers = dict;
+      Py_INCREF(mod);  // keep the module (and its dict) alive forever
+      return 0;
+    }();
+  }
+  if (we_initialized) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // PD_* calls from other threads can PyGILState_Ensure without
+    // deadlocking (the saved thread state is intentionally leaked — the
+    // embedded interpreter lives for the process lifetime).
+    (void)PyEval_SaveThread();
+  }
+  return rc;
+}
+
+void PD_Finalize(void) {
+  // The embedded interpreter stays up for the process lifetime (XLA
+  // runtimes do not survive re-initialization); this only clears the
+  // handle so PD_Init can validate ordering.
+}
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+PD_AnalysisConfig* PD_NewAnalysisConfig(void) {
+  return new PD_AnalysisConfig();
+}
+
+void PD_DeleteAnalysisConfig(PD_AnalysisConfig* cfg) { delete cfg; }
+
+void PD_SetModel(PD_AnalysisConfig* cfg, const char* model_prefix,
+                 const char* params_path) {
+  (void)params_path;  // derived from the prefix, kept for API parity
+  cfg->prefix = model_prefix != nullptr ? model_prefix : "";
+}
+
+PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* cfg) {
+  if (!ensure_init()) return nullptr;
+  GIL gil;
+  PyObject* args = Py_BuildValue("(s)", cfg->prefix.c_str());
+  PyObject* obj = call_helper("new_predictor", args);
+  Py_DECREF(args);
+  if (obj == nullptr) return nullptr;
+  args = Py_BuildValue("(O)", obj);
+  PyObject* names = call_helper("predictor_input_names", args);
+  Py_DECREF(args);
+  if (names == nullptr) {
+    Py_DECREF(obj);
+    return nullptr;
+  }
+  return new PD_Predictor{obj, names};
+}
+
+void PD_DeletePredictor(PD_Predictor* pred) {
+  if (pred == nullptr) return;
+  GIL gil;
+  Py_XDECREF(pred->obj);
+  Py_XDECREF(pred->input_names);
+  delete pred;
+}
+
+int PD_GetInputNum(const PD_Predictor* pred) {
+  GIL gil;
+  return static_cast<int>(PyList_Size(pred->input_names));
+}
+
+int PD_GetOutputNum(const PD_Predictor* pred) {
+  GIL gil;
+  PyObject* args = Py_BuildValue("(O)", pred->obj);
+  PyObject* n = call_helper("predictor_output_num", args);
+  Py_DECREF(args);
+  if (n == nullptr) return -1;
+  int out = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return out;
+}
+
+const char* PD_GetInputName(const PD_Predictor* pred, int i) {
+  GIL gil;
+  if (i < 0 || i >= PyList_Size(pred->input_names)) return nullptr;
+  return PyUnicode_AsUTF8(PyList_GetItem(pred->input_names, i));
+}
+
+static int set_named_buffer(const char* helper, PyObject* target,
+                            const char* name, const void* data,
+                            const char* dtype, const int64_t* shape,
+                            int ndim) {
+  int64_t esz = dtype_size(dtype);
+  if (esz < 0) {
+    g_last_error = std::string("unsupported dtype ") + dtype;
+    return -1;
+  }
+  GIL gil;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), numel(shape, ndim) * esz);
+  PyObject* shp = shape_tuple(shape, ndim);
+  PyObject* args = Py_BuildValue("(OsOsO)", target, name, bytes, dtype,
+                                 shp);
+  PyObject* res = call_helper(helper, args);
+  Py_DECREF(args);
+  Py_DECREF(bytes);
+  Py_DECREF(shp);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int PD_PredictorSetInput(PD_Predictor* pred, const char* name,
+                         const void* data, const char* dtype,
+                         const int64_t* shape, int ndim) {
+  if (!ensure_init()) return -1;
+  return set_named_buffer("predictor_set_input", pred->obj, name, data,
+                          dtype, shape, ndim);
+}
+
+int PD_PredictorRun(PD_Predictor* pred) {
+  if (!ensure_init()) return -1;
+  GIL gil;
+  PyObject* args = Py_BuildValue("(O)", pred->obj);
+  PyObject* res = call_helper("predictor_run", args);
+  Py_DECREF(args);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int PD_GetOutputNdim(PD_Predictor* pred, int i) {
+  GIL gil;
+  PyObject* args = Py_BuildValue("(Oi)", pred->obj, i);
+  PyObject* shp = call_helper("predictor_output_shape", args);
+  Py_DECREF(args);
+  if (shp == nullptr) return -1;
+  int nd = static_cast<int>(PyList_Size(shp));
+  Py_DECREF(shp);
+  return nd;
+}
+
+int PD_GetOutputShape(PD_Predictor* pred, int i, int64_t* shape_out) {
+  GIL gil;
+  PyObject* args = Py_BuildValue("(Oi)", pred->obj, i);
+  PyObject* shp = call_helper("predictor_output_shape", args);
+  Py_DECREF(args);
+  if (shp == nullptr) return -1;
+  int nd = static_cast<int>(PyList_Size(shp));
+  for (int d = 0; d < nd; ++d)
+    shape_out[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
+  Py_DECREF(shp);
+  return nd;
+}
+
+int64_t PD_CopyOutputFloat(PD_Predictor* pred, int i, float* dst,
+                           int64_t capacity) {
+  GIL gil;
+  PyObject* args = Py_BuildValue("(Oi)", pred->obj, i);
+  PyObject* bytes = call_helper("predictor_output_bytes", args);
+  Py_DECREF(args);
+  if (bytes == nullptr) return -1;
+  int64_t n = static_cast<int64_t>(PyBytes_Size(bytes)) / 4;
+  if (n > capacity) {
+    Py_DECREF(bytes);
+    g_last_error = "output larger than destination capacity";
+    return -1;
+  }
+  std::memcpy(dst, PyBytes_AsString(bytes), n * 4);
+  Py_DECREF(bytes);
+  return n;
+}
+
+PD_TrainSession* PD_NewTrainSession(const char* program_path,
+                                    const char* loss_name,
+                                    const char* optimizer,
+                                    float learning_rate) {
+  if (!ensure_init()) return nullptr;
+  GIL gil;
+  PyObject* args = Py_BuildValue("(sssf)", program_path, loss_name,
+                                 optimizer, learning_rate);
+  PyObject* obj = call_helper("new_train_session", args);
+  Py_DECREF(args);
+  if (obj == nullptr) return nullptr;
+  return new PD_TrainSession{obj};
+}
+
+void PD_DeleteTrainSession(PD_TrainSession* sess) {
+  if (sess == nullptr) return;
+  GIL gil;
+  Py_XDECREF(sess->obj);
+  delete sess;
+}
+
+int PD_TrainSessionSetFeed(PD_TrainSession* sess, const char* name,
+                           const void* data, const char* dtype,
+                           const int64_t* shape, int ndim) {
+  if (!ensure_init()) return -1;
+  return set_named_buffer("train_set_feed", sess->obj, name, data, dtype,
+                          shape, ndim);
+}
+
+int PD_TrainSessionRunStep(PD_TrainSession* sess, float* loss_out) {
+  if (!ensure_init()) return -1;
+  GIL gil;
+  PyObject* args = Py_BuildValue("(O)", sess->obj);
+  PyObject* res = call_helper("train_run_step", args);
+  Py_DECREF(args);
+  if (res == nullptr) return -1;
+  *loss_out = static_cast<float>(PyFloat_AsDouble(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int PD_TrainSessionSave(PD_TrainSession* sess, const char* path) {
+  if (!ensure_init()) return -1;
+  GIL gil;
+  PyObject* args = Py_BuildValue("(Os)", sess->obj, path);
+  PyObject* res = call_helper("train_save", args);
+  Py_DECREF(args);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+/* C API for paddle_tpu inference + training (reference capability:
+ * /root/reference/paddle/fluid/inference/capi/paddle_c_api.h and the C++
+ * train demo /root/reference/paddle/fluid/train/demo/).
+ *
+ * TPU-native design: the XLA runtime lives in-process with Python, so
+ * this library embeds the CPython interpreter (one per process) and
+ * drives the same public paddle_tpu API a Python user calls — the C ABI
+ * is a deployment surface, not a second implementation. Link with
+ * -lpaddletpu_capi; call PD_Init(repo_root) once before anything else.
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PD_CAPI_EXPORT __attribute__((visibility("default")))
+
+/* ---- lifecycle ---- */
+/* repo_root: directory containing the paddle_tpu package (may be NULL
+ * when PADDLE_TPU_HOME is set or the package is importable already).
+ * Returns 0 on success. */
+PD_CAPI_EXPORT int PD_Init(const char* repo_root);
+PD_CAPI_EXPORT void PD_Finalize(void);
+/* Last error message of the calling thread ("" when none). */
+PD_CAPI_EXPORT const char* PD_GetLastError(void);
+
+/* ---- inference (AnalysisConfig / Predictor analogues) ---- */
+typedef struct PD_AnalysisConfig PD_AnalysisConfig;
+typedef struct PD_Predictor PD_Predictor;
+
+PD_CAPI_EXPORT PD_AnalysisConfig* PD_NewAnalysisConfig(void);
+PD_CAPI_EXPORT void PD_DeleteAnalysisConfig(PD_AnalysisConfig* cfg);
+/* model_prefix: path prefix of the exported artifact
+ * (<prefix>.pdmodel / <prefix>.pdiparams — static/io.py
+ * save_inference_model). params_path is accepted for reference-API
+ * parity and may be NULL. */
+PD_CAPI_EXPORT void PD_SetModel(PD_AnalysisConfig* cfg,
+                                const char* model_prefix,
+                                const char* params_path);
+
+PD_CAPI_EXPORT PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* cfg);
+PD_CAPI_EXPORT void PD_DeletePredictor(PD_Predictor* pred);
+
+PD_CAPI_EXPORT int PD_GetInputNum(const PD_Predictor* pred);
+PD_CAPI_EXPORT int PD_GetOutputNum(const PD_Predictor* pred);
+/* Returned pointer is owned by the predictor; valid until it is
+ * deleted. NULL on bad index. */
+PD_CAPI_EXPORT const char* PD_GetInputName(const PD_Predictor* pred,
+                                           int i);
+
+/* dtype strings: "float32", "int32", "int64", "bool".
+ * Returns 0 on success. */
+PD_CAPI_EXPORT int PD_PredictorSetInput(PD_Predictor* pred,
+                                        const char* name,
+                                        const void* data,
+                                        const char* dtype,
+                                        const int64_t* shape, int ndim);
+PD_CAPI_EXPORT int PD_PredictorRun(PD_Predictor* pred);
+/* Output i metadata after Run: ndim, then shape into shape_out
+ * (caller-sized via PD_GetOutputNdim). Element count returned, -1 on
+ * error. Output data is converted to float32. */
+PD_CAPI_EXPORT int PD_GetOutputNdim(PD_Predictor* pred, int i);
+PD_CAPI_EXPORT int PD_GetOutputShape(PD_Predictor* pred, int i,
+                                     int64_t* shape_out);
+PD_CAPI_EXPORT int64_t PD_CopyOutputFloat(PD_Predictor* pred, int i,
+                                          float* dst, int64_t capacity);
+
+/* ---- training (C++ train-demo capability) ---- */
+/* Loads a serialized static Program (static/program.py Program.save),
+ * attaches optimizer ("sgd" | "momentum" | "adam" | "adamw") on the var
+ * named loss_name, compiles the whole step with the Executor. */
+typedef struct PD_TrainSession PD_TrainSession;
+
+PD_CAPI_EXPORT PD_TrainSession* PD_NewTrainSession(
+    const char* program_path, const char* loss_name,
+    const char* optimizer, float learning_rate);
+PD_CAPI_EXPORT void PD_DeleteTrainSession(PD_TrainSession* sess);
+PD_CAPI_EXPORT int PD_TrainSessionSetFeed(PD_TrainSession* sess,
+                                          const char* name,
+                                          const void* data,
+                                          const char* dtype,
+                                          const int64_t* shape, int ndim);
+/* One optimizer step over the current feeds; loss written to loss_out.
+ * Returns 0 on success. */
+PD_CAPI_EXPORT int PD_TrainSessionRunStep(PD_TrainSession* sess,
+                                          float* loss_out);
+/* Save all trainable parameters back into the program file at `path`
+ * (round-trips through Program.save). Returns 0 on success. */
+PD_CAPI_EXPORT int PD_TrainSessionSave(PD_TrainSession* sess,
+                                       const char* path);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,77 @@
+// C++ training demo over the C API (reference capability:
+// /root/reference/paddle/fluid/train/demo/demo_trainer.cc — load a
+// saved program in C++, feed numpy-less buffers, run optimizer steps).
+//
+// Usage: train_demo <program.pdprog> <loss_var_name> [repo_root]
+// Trains y = x @ w (4->1 linear regression) on synthetic data and exits
+// 0 iff the loss fell by >20x; prints the first/last losses.
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <program.pdprog> <loss_name> [repo_root]\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* repo = argc > 3 ? argv[3] : nullptr;
+  if (PD_Init(repo) != 0) {
+    std::fprintf(stderr, "PD_Init failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  PD_TrainSession* sess =
+      PD_NewTrainSession(argv[1], argv[2], "sgd", 0.1f);
+  if (sess == nullptr) {
+    std::fprintf(stderr, "session failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  // synthetic regression batch: y = x @ [1, 2, -1, 0.5]
+  const int B = 32, D = 4;
+  std::vector<float> xs(B * D), ys(B);
+  unsigned s = 123u;
+  auto rnd = [&s]() {
+    s = s * 1664525u + 1013904223u;
+    return static_cast<float>((s >> 8) & 0xFFFF) / 65536.0f;
+  };
+  const float w[D] = {1.0f, 2.0f, -1.0f, 0.5f};
+  for (int b = 0; b < B; ++b) {
+    float acc = 0.0f;
+    for (int d = 0; d < D; ++d) {
+      xs[b * D + d] = rnd();
+      acc += xs[b * D + d] * w[d];
+    }
+    ys[b] = acc;
+  }
+  const int64_t xshape[2] = {B, D};
+  const int64_t yshape[2] = {B, 1};
+  if (PD_TrainSessionSetFeed(sess, "x", xs.data(), "float32", xshape,
+                             2) != 0 ||
+      PD_TrainSessionSetFeed(sess, "y", ys.data(), "float32", yshape,
+                             2) != 0) {
+    std::fprintf(stderr, "feed failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  float first = 0.0f, loss = 0.0f;
+  for (int step = 0; step < 200; ++step) {
+    if (PD_TrainSessionRunStep(sess, &loss) != 0) {
+      std::fprintf(stderr, "step failed: %s\n", PD_GetLastError());
+      return 1;
+    }
+    if (step == 0) first = loss;
+  }
+  std::printf("first_loss=%g last_loss=%g\n", first, loss);
+  PD_DeleteTrainSession(sess);
+  if (!(std::isfinite(loss) && loss < first / 20.0f)) {
+    std::fprintf(stderr, "loss did not converge\n");
+    return 1;
+  }
+  return 0;
+}

@@ -25,9 +25,14 @@ AMP_WHITE_LIST: Set[str] = {
     "lstm_cell", "gru_cell", "simple_rnn_cell", "rnn_scan",
 }
 
-# ops that must stay in fp32 (reductions / norms / losses / exp-family)
+# ops that must stay in fp32 (reductions / norms / losses / exp-family).
+# cross_entropy is deliberately NOT listed: its hard-label path is a
+# fused kernel that accumulates in f32 internally while keeping the
+# [N, vocab] logits in their storage dtype (nn/functional/loss.py
+# _softmax_ce_fused) — black-listing it would materialize a full f32
+# copy of the largest tensor in an LM train step.
 AMP_BLACK_LIST: Set[str] = {
-    "softmax_op", "log_softmax_op", "cross_entropy",
+    "softmax_op", "log_softmax_op",
     "softmax_with_cross_entropy_op", "bce_loss", "bce_with_logits",
     "layer_norm_op", "batch_norm_op", "group_norm_op",
     "instance_norm_op", "sync_batch_norm", "reduce_sum", "reduce_mean",

@@ -34,9 +34,24 @@ def _reduce(value, op, group=None):
     arr64 = np.asarray(arr, np.float64)
     if _c._axis_for(group) is None:
         return arr64
-    out = _c.all_reduce(Tensor(jnp.asarray(arr)), op=op, group=group)
-    return np.asarray(out._data if isinstance(out, Tensor) else out,
-                      np.float64)
+    # a concrete value with a live axis only happens while TRACING (the
+    # axis resolves via lax.axis_size inside shard_map/pjit), so the
+    # collective output below is a tracer and must be returned as such.
+    # f64 is unavailable on device (x64 off); integral counts go through
+    # an int32 psum, which is exact up to 2^31 (the f32 path would round
+    # past 2^24 — the failure the reference's int64 stats avoid).
+    integral = (np.issubdtype(np.asarray(arr).dtype, np.integer)
+                or np.all(arr64 == np.floor(arr64)))
+    if op == _c.ReduceOp.SUM and integral and \
+            np.all(np.abs(arr64) < 2 ** 30):
+        dev = jnp.asarray(arr64.astype(np.int32))
+    else:
+        dev = jnp.asarray(arr64, jnp.float32)
+    out = _c.all_reduce(Tensor(dev), op=op, group=group)
+    res = out._data if isinstance(out, Tensor) else out
+    if isinstance(res, jax.core.Tracer):
+        return res
+    return np.asarray(res, np.float64)
 
 
 def sum(input, group=None):  # noqa: A001 — reference name

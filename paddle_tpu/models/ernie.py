@@ -205,9 +205,16 @@ class ErnieForPretraining(nn.Layer):
         seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                  attention_mask)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
-        # weight-tied decoder: logits = h @ E^T  (vocab-sharded matmul)
+        # weight-tied decoder: logits = h @ E^T  (vocab-sharded matmul).
+        # Done in 2D [b*s, hidden] — a 3D dot here gives the [b, s, V]
+        # logits a batch-major layout that XLA then has to transpose-copy
+        # (a multi-GB move at vocab scale); the flat matmul keeps the
+        # natural row-major layout and reshape back is a free bitcast.
+        b, s = h.shape[0], h.shape[1]
         w = self.ernie.embeddings.word_embeddings.weight
-        logits = F.linear(h, manipulation.t(w)) + self.mlm_bias
+        h2 = h.reshape([-1, h.shape[-1]])
+        logits = (F.linear(h2, manipulation.t(w))
+                  + self.mlm_bias).reshape([b, s, -1])
         nsp_logits = self.nsp(pooled)
         return logits, nsp_logits
 

@@ -103,7 +103,11 @@ class GPTForCausalLM(nn.Layer):
     def forward(self, input_ids):
         h = self.gpt(input_ids)
         w = self.gpt.wte.weight
-        return F.linear(h, manipulation.t(w))
+        # 2D head matmul: keeps the [b*s, vocab] logits row-major so XLA
+        # never transpose-copies the largest tensor (see ernie.py)
+        b, s = h.shape[0], h.shape[1]
+        h2 = h.reshape([-1, h.shape[-1]])
+        return F.linear(h2, manipulation.t(w)).reshape([b, s, -1])
 
     @staticmethod
     def lm_loss(logits, labels):

@@ -32,6 +32,61 @@ def _reduce(loss, reduction):
     return loss
 
 
+# -- fused hard-label softmax-CE -------------------------------------------
+# The MLM/LM head dominates HBM traffic at scale: logits are
+# [batch*seq, vocab] (1.5 GB in bf16 for BERT-base at 48x512). The naive
+# log_softmax path under AMP upcasts them to a second full f32 buffer
+# (+3 GB), materializes f32 log-probs (+3 GB) and f32 dlogits in the
+# backward — measured at >50% of the ERNIE train step on TPU v5e. This
+# custom-vjp kernel keeps the logits in their storage dtype end to end:
+# every f32 conversion feeds straight into an XLA reduce/elementwise
+# fusion (no f32 copy of the [N, C] tensor is ever written to HBM) and
+# the backward emits dlogits directly in the logits dtype, fused with
+# the (softmax - onehot) * g computation.
+
+@jax.custom_vjp
+def _softmax_ce_fused(logits, labels, valid):
+    """logits [N, C] float; labels int32 [N] (pre-clamped to range);
+    valid bool [N]. Returns per-row f32 loss (0 where invalid)."""
+    loss, _ = _softmax_ce_fused_fwd_impl(logits, labels, valid)
+    return loss
+
+
+def _softmax_ce_fused_fwd_impl(logits, labels, valid):
+    m = jnp.max(logits, axis=-1).astype(jnp.float32)
+    s = jnp.sum(jnp.exp(logits.astype(jnp.float32) - m[:, None]),
+                axis=-1)
+    lse = m + jnp.log(s)
+    picked = jnp.take_along_axis(
+        logits, labels[:, None], axis=-1)[:, 0].astype(jnp.float32)
+    loss = jnp.where(valid, lse - picked, 0.0)
+    return loss, lse
+
+
+def _softmax_ce_fused_fwd(logits, labels, valid):
+    loss, lse = _softmax_ce_fused_fwd_impl(logits, labels, valid)
+    return loss, (logits, labels, valid, lse)
+
+
+def _softmax_ce_fused_bwd(res, g):
+    logits, labels, valid, lse = res
+    gm = jnp.where(valid, g, 0.0).astype(jnp.float32)
+    p = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+    # (softmax - onehot) in f32 BEFORE the storage-dtype cast: at the
+    # label column p≈1 and the true grad is (p-1)·g ≈ 0 — subtracting
+    # after a bf16 round would leave bf16-eps·|g| of noise. The one-hot
+    # is an inline iota compare so the whole expression stays one XLA
+    # fusion (no scatter, no materialized f32 [N, C] buffer).
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+              == labels[:, None])
+    d = ((p - onehot.astype(jnp.float32)) * gm[:, None]).astype(
+        logits.dtype)
+    return d, None, None
+
+
+_softmax_ce_fused.defvjp(_softmax_ce_fused_fwd, _softmax_ce_fused_bwd)
+
+
 @register_op("softmax_with_cross_entropy_op")
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, axis=-1,
@@ -61,12 +116,37 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 
     def impl(logits, lbl, weight=None):
         axis_ = axis % logits.ndim
+        is_soft = soft_label or (hasattr(lbl, "dtype")
+                                 and jnp.issubdtype(lbl.dtype, jnp.inexact)
+                                 and lbl.shape == logits.shape)
+        # fused low-precision-safe path for the common hard-label form
+        # (cross_entropy is NOT on the AMP black list: this kernel does
+        # its accumulations in f32 internally, so bf16 logits stay bf16)
+        if (use_softmax and not is_soft and weight is None
+                and label_smoothing == 0 and axis_ == logits.ndim - 1):
+            lbl_i = lbl
+            if lbl_i.ndim == logits.ndim and lbl_i.shape[axis_] == 1:
+                lbl_i = jnp.squeeze(lbl_i, axis=axis_)
+            valid = (lbl_i != ignore_index).reshape(-1)
+            safe = jnp.where(valid.reshape(lbl_i.shape), lbl_i,
+                             0).astype(jnp.int32).reshape(-1)
+            flat = logits.reshape(-1, logits.shape[-1])
+            loss = _softmax_ce_fused(flat, safe, valid).reshape(
+                lbl_i.shape)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)),
+                                    1.0)
+                return jnp.sum(loss) / denom
+            return loss
+        # general paths compute in f32 (the pre-fused behavior, where
+        # the AMP black list upcast the inputs before dispatch)
+        if jnp.issubdtype(logits.dtype, jnp.floating) and \
+                logits.dtype != jnp.float32:
+            logits = logits.astype(jnp.float32)
         logp = (jax.nn.log_softmax(logits, axis=axis_) if use_softmax
                 else jnp.log(jnp.maximum(logits, 1e-30)))
         n_classes = logits.shape[axis_]
-        if soft_label or (hasattr(lbl, "dtype")
-                          and jnp.issubdtype(lbl.dtype, jnp.inexact)
-                          and lbl.shape == logits.shape):
+        if is_soft:
             soft = lbl
             if label_smoothing > 0:
                 soft = soft * (1 - label_smoothing) \

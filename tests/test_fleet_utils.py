@@ -132,3 +132,27 @@ class TestFleetMetrics:
         import paddle_tpu.distributed.fleet.metrics.metric as M
         n = 16777217  # 2^24 + 1, not representable in float32
         assert int(M.sum(np.asarray([n], np.int64))[0]) == n
+
+    def test_metric_counts_exact_across_mesh(self):
+        # the same count summed over 8 ranks through the device
+        # collective: int32 psum keeps it exact (f32 would round)
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+        import paddle_tpu.distributed.fleet.metrics.metric as M
+
+        n = 16777217
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+        def g(x):
+            # concrete host count captured inside the traced program
+            s = M.sum(np.asarray([n], np.int64), group="dp")
+            return (jnp.asarray(s).reshape(1, 1)
+                    + 0 * x.astype(jnp.int32))
+
+        with mesh:
+            out = shard_map(g, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"))(
+                jnp.zeros((8, 1), jnp.float32))
+        assert int(np.asarray(out)[0, 0]) == 8 * n
