@@ -144,6 +144,21 @@ PyObject* call_helper(const char* fn, PyObject* args) {
   return out;
 }
 
+// Null-safe variant that OWNS `args`: tolerates a failed Py_BuildValue
+// (args == nullptr -> error return instead of a Py_DECREF(nullptr)
+// crash) and drops the args reference either way.
+PyObject* call_args(const char* fn, PyObject* args) {
+  if (args == nullptr) {
+    set_error_from_python();
+    if (g_last_error.empty() || g_last_error == "python error")
+      g_last_error = std::string("argument marshalling failed for ") + fn;
+    return nullptr;
+  }
+  PyObject* out = call_helper(fn, args);
+  Py_DECREF(args);
+  return out;
+}
+
 PyObject* shape_tuple(const int64_t* shape, int ndim) {
   PyObject* t = PyTuple_New(ndim);
   for (int i = 0; i < ndim; ++i)
@@ -238,8 +253,10 @@ int PD_Init(const char* repo_root) {
 
 void PD_Finalize(void) {
   // The embedded interpreter stays up for the process lifetime (XLA
-  // runtimes do not survive re-initialization); this only clears the
-  // handle so PD_Init can validate ordering.
+  // runtimes do not survive re-initialization); clearing the handle
+  // makes post-Finalize PD_* calls fail cleanly and lets a subsequent
+  // PD_Init re-bind the helper module.
+  g_helpers = nullptr;
 }
 
 const char* PD_GetLastError(void) { return g_last_error.c_str(); }
@@ -259,13 +276,11 @@ void PD_SetModel(PD_AnalysisConfig* cfg, const char* model_prefix,
 PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* cfg) {
   if (!ensure_init()) return nullptr;
   GIL gil;
-  PyObject* args = Py_BuildValue("(s)", cfg->prefix.c_str());
-  PyObject* obj = call_helper("new_predictor", args);
-  Py_DECREF(args);
+  PyObject* obj = call_args("new_predictor",
+                            Py_BuildValue("(s)", cfg->prefix.c_str()));
   if (obj == nullptr) return nullptr;
-  args = Py_BuildValue("(O)", obj);
-  PyObject* names = call_helper("predictor_input_names", args);
-  Py_DECREF(args);
+  PyObject* names = call_args("predictor_input_names",
+                              Py_BuildValue("(O)", obj));
   if (names == nullptr) {
     Py_DECREF(obj);
     return nullptr;
@@ -288,9 +303,8 @@ int PD_GetInputNum(const PD_Predictor* pred) {
 
 int PD_GetOutputNum(const PD_Predictor* pred) {
   GIL gil;
-  PyObject* args = Py_BuildValue("(O)", pred->obj);
-  PyObject* n = call_helper("predictor_output_num", args);
-  Py_DECREF(args);
+  PyObject* n = call_args("predictor_output_num",
+                          Py_BuildValue("(O)", pred->obj));
   if (n == nullptr) return -1;
   int out = static_cast<int>(PyLong_AsLong(n));
   Py_DECREF(n);
@@ -312,16 +326,22 @@ static int set_named_buffer(const char* helper, PyObject* target,
     g_last_error = std::string("unsupported dtype ") + dtype;
     return -1;
   }
+  int64_t n = numel(shape, ndim);
+  if (ndim < 0 || n < 0) {
+    g_last_error = "invalid shape (negative dim or ndim)";
+    return -1;
+  }
   GIL gil;
   PyObject* bytes = PyBytes_FromStringAndSize(
-      static_cast<const char*>(data), numel(shape, ndim) * esz);
+      static_cast<const char*>(data), n * esz);
   PyObject* shp = shape_tuple(shape, ndim);
-  PyObject* args = Py_BuildValue("(OsOsO)", target, name, bytes, dtype,
-                                 shp);
-  PyObject* res = call_helper(helper, args);
-  Py_DECREF(args);
-  Py_DECREF(bytes);
-  Py_DECREF(shp);
+  PyObject* res = (bytes != nullptr && shp != nullptr)
+                      ? call_args(helper,
+                                  Py_BuildValue("(OsOsO)", target, name,
+                                                bytes, dtype, shp))
+                      : (set_error_from_python(), nullptr);
+  Py_XDECREF(bytes);
+  Py_XDECREF(shp);
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;
@@ -338,9 +358,8 @@ int PD_PredictorSetInput(PD_Predictor* pred, const char* name,
 int PD_PredictorRun(PD_Predictor* pred) {
   if (!ensure_init()) return -1;
   GIL gil;
-  PyObject* args = Py_BuildValue("(O)", pred->obj);
-  PyObject* res = call_helper("predictor_run", args);
-  Py_DECREF(args);
+  PyObject* res = call_args("predictor_run",
+                            Py_BuildValue("(O)", pred->obj));
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;
@@ -348,9 +367,8 @@ int PD_PredictorRun(PD_Predictor* pred) {
 
 int PD_GetOutputNdim(PD_Predictor* pred, int i) {
   GIL gil;
-  PyObject* args = Py_BuildValue("(Oi)", pred->obj, i);
-  PyObject* shp = call_helper("predictor_output_shape", args);
-  Py_DECREF(args);
+  PyObject* shp = call_args("predictor_output_shape",
+                            Py_BuildValue("(Oi)", pred->obj, i));
   if (shp == nullptr) return -1;
   int nd = static_cast<int>(PyList_Size(shp));
   Py_DECREF(shp);
@@ -359,9 +377,8 @@ int PD_GetOutputNdim(PD_Predictor* pred, int i) {
 
 int PD_GetOutputShape(PD_Predictor* pred, int i, int64_t* shape_out) {
   GIL gil;
-  PyObject* args = Py_BuildValue("(Oi)", pred->obj, i);
-  PyObject* shp = call_helper("predictor_output_shape", args);
-  Py_DECREF(args);
+  PyObject* shp = call_args("predictor_output_shape",
+                            Py_BuildValue("(Oi)", pred->obj, i));
   if (shp == nullptr) return -1;
   int nd = static_cast<int>(PyList_Size(shp));
   for (int d = 0; d < nd; ++d)
@@ -373,9 +390,8 @@ int PD_GetOutputShape(PD_Predictor* pred, int i, int64_t* shape_out) {
 int64_t PD_CopyOutputFloat(PD_Predictor* pred, int i, float* dst,
                            int64_t capacity) {
   GIL gil;
-  PyObject* args = Py_BuildValue("(Oi)", pred->obj, i);
-  PyObject* bytes = call_helper("predictor_output_bytes", args);
-  Py_DECREF(args);
+  PyObject* bytes = call_args("predictor_output_bytes",
+                              Py_BuildValue("(Oi)", pred->obj, i));
   if (bytes == nullptr) return -1;
   int64_t n = static_cast<int64_t>(PyBytes_Size(bytes)) / 4;
   if (n > capacity) {
@@ -394,10 +410,10 @@ PD_TrainSession* PD_NewTrainSession(const char* program_path,
                                     float learning_rate) {
   if (!ensure_init()) return nullptr;
   GIL gil;
-  PyObject* args = Py_BuildValue("(sssf)", program_path, loss_name,
-                                 optimizer, learning_rate);
-  PyObject* obj = call_helper("new_train_session", args);
-  Py_DECREF(args);
+  PyObject* obj = call_args(
+      "new_train_session", Py_BuildValue("(sssf)", program_path,
+                                         loss_name, optimizer,
+                                         learning_rate));
   if (obj == nullptr) return nullptr;
   return new PD_TrainSession{obj};
 }
@@ -420,9 +436,8 @@ int PD_TrainSessionSetFeed(PD_TrainSession* sess, const char* name,
 int PD_TrainSessionRunStep(PD_TrainSession* sess, float* loss_out) {
   if (!ensure_init()) return -1;
   GIL gil;
-  PyObject* args = Py_BuildValue("(O)", sess->obj);
-  PyObject* res = call_helper("train_run_step", args);
-  Py_DECREF(args);
+  PyObject* res = call_args("train_run_step",
+                            Py_BuildValue("(O)", sess->obj));
   if (res == nullptr) return -1;
   *loss_out = static_cast<float>(PyFloat_AsDouble(res));
   Py_DECREF(res);
@@ -432,9 +447,8 @@ int PD_TrainSessionRunStep(PD_TrainSession* sess, float* loss_out) {
 int PD_TrainSessionSave(PD_TrainSession* sess, const char* path) {
   if (!ensure_init()) return -1;
   GIL gil;
-  PyObject* args = Py_BuildValue("(Os)", sess->obj, path);
-  PyObject* res = call_helper("train_save", args);
-  Py_DECREF(args);
+  PyObject* res = call_args("train_save",
+                            Py_BuildValue("(Os)", sess->obj, path));
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;

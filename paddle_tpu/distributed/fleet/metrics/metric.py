@@ -40,10 +40,16 @@ def _reduce(value, op, group=None):
     # f64 is unavailable on device (x64 off); integral counts go through
     # an int32 psum, which is exact up to 2^31 (the f32 path would round
     # past 2^24 — the failure the reference's int64 stats avoid).
-    integral = (np.issubdtype(np.asarray(arr).dtype, np.integer)
-                or np.all(arr64 == np.floor(arr64)))
-    if op == _c.ReduceOp.SUM and integral and \
-            np.all(np.abs(arr64) < 2 ** 30):
+    # the collective dtype must be chosen from METADATA that is
+    # identical on every rank (multi-host ranks trace independently; a
+    # value-dependent branch would emit mismatched collectives and hang
+    # the fleet). Integer-dtyped stats ride an int32 psum — exact while
+    # the cross-rank total stays below 2^31 (the reference carries
+    # these as int64; int64 needs x64, unavailable on device, so the
+    # 2^31 aggregate bound is this helper's documented contract) —
+    # float stats ride f32.
+    if op == _c.ReduceOp.SUM and \
+            np.issubdtype(np.asarray(arr).dtype, np.integer):
         dev = jnp.asarray(arr64.astype(np.int32))
     else:
         dev = jnp.asarray(arr64, jnp.float32)

@@ -2,7 +2,8 @@ from .dataloader import DataLoader  # noqa: F401
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset,
                       random_split)  # noqa: F401
-from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+from .sampler import (BatchSampler, BucketBatchSampler, bucket_collate,
+                      DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, WeightedRandomSampler,
                       SubsetRandomSampler)  # noqa: F401
 from .fleet_dataset import (DatasetBase, DatasetFactory,  # noqa: F401

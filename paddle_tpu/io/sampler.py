@@ -8,7 +8,8 @@ import numpy as np
 
 __all__ = ["Sampler", "SequenceSampler", "RandomSampler",
            "WeightedRandomSampler", "SubsetRandomSampler", "BatchSampler",
-           "DistributedBatchSampler"]
+           "DistributedBatchSampler", "BucketBatchSampler",
+           "bucket_collate"]
 
 
 class Sampler:
@@ -150,3 +151,118 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+
+class BucketBatchSampler(BatchSampler):
+    """Length-bucketed batching: the framework-level variable-length
+    policy (DESIGN.md "LoD" section).
+
+    The reference threads LoD metadata through kernels so every batch
+    can be ragged (`lod_tensor.h:114`); XLA needs static shapes, so
+    ragged batches become `(padded, lengths)` pairs drawn from a SMALL
+    FIXED SET of padded shapes: samples are grouped by which bucket
+    boundary their length fits under, and every emitted batch is padded
+    to its bucket's boundary by `bucket_collate` — one XLA compilation
+    per bucket, never one per shape (bench.py's dynamic-shape config
+    proves compiles == buckets). With drop_last=False, per-bucket
+    remainder batches are smaller along the batch dim and add one
+    compilation each; pass drop_last=True when a strict
+    one-compile-per-bucket guarantee matters.
+
+    lengths: per-sample lengths (ints), or None to call len() on each
+    sample of `dataset`. boundaries: ascending bucket upper bounds;
+    samples longer than the last boundary go to a final overflow bucket
+    sized by the max observed length (rounded up to `multiple`).
+    """
+
+    def __init__(self, dataset=None, lengths=None, boundaries=(64, 128,
+                 256, 512), batch_size=1, shuffle=False, drop_last=False,
+                 multiple=8):
+        if lengths is None:
+            lengths = [len(dataset[i]) for i in range(len(dataset))]
+        if dataset is None:
+            dataset = range(len(lengths))  # lengths determine the stream
+        super().__init__(dataset=dataset, shuffle=shuffle,
+                         batch_size=batch_size, drop_last=drop_last)
+        self.lengths = np.asarray(lengths, np.int64)
+        bounds = sorted(int(b) for b in boundaries)
+        mx = int(self.lengths.max()) if len(self.lengths) else 1
+        if mx > bounds[-1]:
+            bounds.append(-(-mx // multiple) * multiple)
+        self.boundaries = bounds
+
+    def collate(self, pad_value=0):
+        """The matching collate_fn: built over self.boundaries, which
+        already includes the overflow bucket's rounded bound — always
+        use this (or bucket_collate(sampler)) so collate and sampler
+        agree on the padded-shape set."""
+        return bucket_collate(self, pad_value=pad_value)
+
+    def bucket_of(self, length: int) -> int:
+        for i, b in enumerate(self.boundaries):
+            if length <= b:
+                return i
+        return len(self.boundaries) - 1
+
+    def __iter__(self):
+        pending: dict = {}
+        for idx in self.sampler:
+            b = self.bucket_of(int(self.lengths[idx]))
+            pending.setdefault(b, []).append(idx)
+            if len(pending[b]) == self.batch_size:
+                yield pending.pop(b)
+        for b in sorted(pending):
+            if not self.drop_last:
+                yield pending[b]
+
+    def __len__(self):
+        # exact: lengths and boundaries are fixed at construction, so
+        # per-bucket batch counts are computable (consumers like LR
+        # schedulers and progress bars rely on len() being right)
+        counts: dict = {}
+        for ln in self.lengths:
+            b = self.bucket_of(int(ln))
+            counts[b] = counts.get(b, 0) + 1
+        total = 0
+        for c in counts.values():
+            total += c // self.batch_size
+            if not self.drop_last and c % self.batch_size:
+                total += 1
+        return total
+
+
+def bucket_collate(boundaries, pad_value=0):
+    """collate_fn companion to BucketBatchSampler: stacks variable-length
+    1D+ samples into (padded [B, T, ...], lengths [B]) with T = the
+    smallest bucket boundary fitting the batch — the LoD-replacement
+    convention consumed by ops/sequence.py and the RNN ops'
+    sequence_length arguments.
+
+    Pass the BucketBatchSampler itself (preferred) so the collate uses
+    the sampler's boundary list INCLUDING the overflow bucket's rounded
+    bound — building from a raw boundary tuple while the sampler added
+    an overflow bucket would give overflow batches per-batch shapes."""
+    if isinstance(boundaries, BucketBatchSampler):
+        bounds = list(boundaries.boundaries)
+    else:
+        bounds = sorted(int(b) for b in boundaries)
+
+    def collate(samples):
+        arrs = [np.asarray(s) for s in samples]
+        lens = np.asarray([a.shape[0] for a in arrs], np.int64)
+        mx = int(lens.max())
+        t = next((b for b in bounds if b >= mx), bounds[-1])
+        if t < mx:
+            raise ValueError(
+                f"sample length {mx} exceeds the largest bucket bound "
+                f"{bounds[-1]}; build the collate from the sampler "
+                "(bucket_collate(sampler)) so the overflow bucket is "
+                "included")
+        tail = arrs[0].shape[1:]
+        out = np.full((len(arrs), t) + tail, pad_value,
+                      arrs[0].dtype)
+        for i, a in enumerate(arrs):
+            out[i, :a.shape[0]] = a
+        return out, lens
+
+    return collate

@@ -321,7 +321,11 @@ class ErnieStageLast(nn.Layer):
             x = b(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         h = self.mlm_norm(F.gelu(self.mlm_transform(x)))
-        logits = self.decoder(h)
+        # 2D decoder matmul for the same layout reason as
+        # ErnieForPretraining.forward (vocab-sized logits stay row-major)
+        b0, s0 = h.shape[0], h.shape[1]
+        logits = self.decoder(h.reshape([-1, h.shape[-1]])).reshape(
+            [b0, s0, -1])
         return logits, self.nsp(pooled)
 
 

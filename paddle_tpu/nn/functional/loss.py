@@ -91,6 +91,19 @@ _softmax_ce_fused.defvjp(_softmax_ce_fused_fwd, _softmax_ce_fused_bwd)
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, axis=-1,
                                return_softmax=False, name=None):
+    # hard-label last-axis form rides the fused low-precision-safe
+    # kernel (see _softmax_ce_fused); other forms stay on log_softmax
+    if (not soft_label and not return_softmax
+            and axis % logits.ndim == logits.ndim - 1):
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=-1)
+        valid = (lbl != ignore_index).reshape(-1)
+        safe = jnp.where(lbl == ignore_index, 0,
+                         lbl).astype(jnp.int32).reshape(-1)
+        flat = logits.reshape(-1, logits.shape[-1])
+        loss = _softmax_ce_fused(flat, safe, valid)
+        return loss.reshape(lbl.shape + (1,))
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)

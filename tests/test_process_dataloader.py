@@ -83,3 +83,111 @@ class TestProcessWorkers:
             np.testing.assert_allclose(pool.get(1).ravel(), [4.0, 9.0])
         finally:
             pool.shutdown()
+
+
+class TestBucketBatching:
+    """Framework-level variable-length policy (DESIGN.md LoD section):
+    bucketed padding keeps the set of padded shapes small and fixed, so
+    a jitted consumer compiles once per bucket — the XLA-native answer
+    to the reference's ragged LoDTensor batches (lod_tensor.h:114)."""
+
+    def _dataset(self):
+        rng = np.random.RandomState(0)
+        return [rng.randn(int(n), 3).astype(np.float32)
+                for n in rng.randint(5, 100, size=64)]
+
+    def test_batches_land_on_bucket_shapes(self):
+        from paddle_tpu.io import BucketBatchSampler, bucket_collate
+        data = self._dataset()
+        bounds = (16, 32, 64, 128)
+        bs = BucketBatchSampler(data, lengths=[len(a) for a in data],
+                                boundaries=bounds, batch_size=4)
+        collate = bucket_collate(bounds)
+        seen_shapes = set()
+        total = 0
+        for batch_idx in bs:
+            padded, lens = collate([data[i] for i in batch_idx])
+            assert padded.shape[1] in bounds
+            # every row's true prefix survives, padding is zeros
+            for r, i in enumerate(batch_idx):
+                np.testing.assert_array_equal(
+                    padded[r, :len(data[i])], data[i])
+                assert (padded[r, len(data[i]):] == 0).all()
+                assert lens[r] == len(data[i])
+            seen_shapes.add(padded.shape[1:])
+            total += len(batch_idx)
+        assert total == len(data)          # nothing dropped
+        assert len(seen_shapes) <= len(bounds)
+
+    def test_jit_compiles_once_per_bucket(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import BucketBatchSampler, bucket_collate
+        data = self._dataset()
+        bounds = (32, 64, 128)
+        bs = BucketBatchSampler(data, lengths=[len(a) for a in data],
+                                boundaries=bounds, batch_size=4,
+                                drop_last=True)
+        collate = bucket_collate(bounds)
+
+        @jax.jit
+        def step(padded, lens):
+            mask = (jnp.arange(padded.shape[1])[None, :]
+                    < lens[:, None]).astype(padded.dtype)
+            return (padded * mask[:, :, None]).sum()
+
+        buckets_used = set()
+        for batch_idx in bs:
+            padded, lens = collate([data[i] for i in batch_idx])
+            buckets_used.add(padded.shape[1])
+            step(jnp.asarray(padded), jnp.asarray(lens))
+        assert step._cache_size() == len(buckets_used)
+
+    def test_dataloader_integration(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import (BucketBatchSampler, DataLoader,
+                                   bucket_collate)
+        data = self._dataset()
+        bounds = (16, 32, 64, 128)
+        bs = BucketBatchSampler(data, lengths=[len(a) for a in data],
+                                boundaries=bounds, batch_size=4)
+        dl = DataLoader(data, batch_sampler=bs,
+                        collate_fn=bucket_collate(bounds),
+                        num_workers=0)
+        n = 0
+        for padded, lens in dl:
+            arr = padded.numpy() if hasattr(padded, "numpy") else \
+                np.asarray(padded)
+            assert arr.shape[1] in bounds
+            n += arr.shape[0]
+        assert n == len(data)
+
+    def test_overflow_bucket_consistent_with_collate(self):
+        from paddle_tpu.io import BucketBatchSampler, bucket_collate
+        rng = np.random.RandomState(2)
+        # lengths beyond the last boundary -> overflow bucket
+        data = [rng.randn(int(n), 2).astype(np.float32)
+                for n in list(rng.randint(5, 60, 12)) + [130, 200, 487]]
+        bs = BucketBatchSampler(data, lengths=[len(a) for a in data],
+                                boundaries=(16, 64), batch_size=3,
+                                multiple=8)
+        assert bs.boundaries[-1] == 488  # ceil(487/8)*8
+        collate = bs.collate()  # shares the overflow bound
+        shapes = set()
+        for idx in bs:
+            padded, _ = collate([data[i] for i in idx])
+            assert padded.shape[1] in bs.boundaries
+            shapes.add(padded.shape[1])
+        assert 488 in shapes
+        # a collate built from the RAW boundaries must refuse overflow
+        import pytest as _pytest
+        bad = bucket_collate((16, 64))
+        with _pytest.raises(ValueError, match="exceeds the largest"):
+            bad([data[-1]])
+
+    def test_lengths_only_construction(self):
+        from paddle_tpu.io import BucketBatchSampler
+        bs = BucketBatchSampler(lengths=[5, 70, 12, 30], batch_size=2,
+                                boundaries=(16, 128))
+        batches = list(bs)
+        assert sorted(i for b in batches for i in b) == [0, 1, 2, 3]
