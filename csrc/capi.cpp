@@ -297,11 +297,13 @@ void PD_DeletePredictor(PD_Predictor* pred) {
 }
 
 int PD_GetInputNum(const PD_Predictor* pred) {
+  if (!ensure_init()) return -1;
   GIL gil;
   return static_cast<int>(PyList_Size(pred->input_names));
 }
 
 int PD_GetOutputNum(const PD_Predictor* pred) {
+  if (!ensure_init()) return -1;
   GIL gil;
   PyObject* n = call_args("predictor_output_num",
                           Py_BuildValue("(O)", pred->obj));
@@ -312,6 +314,7 @@ int PD_GetOutputNum(const PD_Predictor* pred) {
 }
 
 const char* PD_GetInputName(const PD_Predictor* pred, int i) {
+  if (!ensure_init()) return nullptr;
   GIL gil;
   if (i < 0 || i >= PyList_Size(pred->input_names)) return nullptr;
   return PyUnicode_AsUTF8(PyList_GetItem(pred->input_names, i));
@@ -366,6 +369,7 @@ int PD_PredictorRun(PD_Predictor* pred) {
 }
 
 int PD_GetOutputNdim(PD_Predictor* pred, int i) {
+  if (!ensure_init()) return -1;
   GIL gil;
   PyObject* shp = call_args("predictor_output_shape",
                             Py_BuildValue("(Oi)", pred->obj, i));
@@ -376,6 +380,7 @@ int PD_GetOutputNdim(PD_Predictor* pred, int i) {
 }
 
 int PD_GetOutputShape(PD_Predictor* pred, int i, int64_t* shape_out) {
+  if (!ensure_init()) return -1;
   GIL gil;
   PyObject* shp = call_args("predictor_output_shape",
                             Py_BuildValue("(Oi)", pred->obj, i));
@@ -389,6 +394,7 @@ int PD_GetOutputShape(PD_Predictor* pred, int i, int64_t* shape_out) {
 
 int64_t PD_CopyOutputFloat(PD_Predictor* pred, int i, float* dst,
                            int64_t capacity) {
+  if (!ensure_init()) return -1;
   GIL gil;
   PyObject* bytes = call_args("predictor_output_bytes",
                               Py_BuildValue("(Oi)", pred->obj, i));

@@ -190,6 +190,7 @@ class BucketBatchSampler(BatchSampler):
         if mx > bounds[-1]:
             bounds.append(-(-mx // multiple) * multiple)
         self.boundaries = bounds
+        self._num_batches = None  # lazily computed, then cached
 
     def collate(self, pad_value=0):
         """The matching collate_fn: built over self.boundaries, which
@@ -216,19 +217,21 @@ class BucketBatchSampler(BatchSampler):
                 yield pending[b]
 
     def __len__(self):
-        # exact: lengths and boundaries are fixed at construction, so
-        # per-bucket batch counts are computable (consumers like LR
-        # schedulers and progress bars rely on len() being right)
-        counts: dict = {}
-        for ln in self.lengths:
-            b = self.bucket_of(int(ln))
-            counts[b] = counts.get(b, 0) + 1
-        total = 0
-        for c in counts.values():
-            total += c // self.batch_size
-            if not self.drop_last and c % self.batch_size:
-                total += 1
-        return total
+        # exact and precomputed: lengths and boundaries are fixed at
+        # construction (consumers like LR schedulers and progress bars
+        # call len() repeatedly)
+        if self._num_batches is None:
+            counts: dict = {}
+            for ln in self.lengths:
+                b = self.bucket_of(int(ln))
+                counts[b] = counts.get(b, 0) + 1
+            total = 0
+            for c in counts.values():
+                total += c // self.batch_size
+                if not self.drop_last and c % self.batch_size:
+                    total += 1
+            self._num_batches = total
+        return self._num_batches
 
 
 def bucket_collate(boundaries, pad_value=0):

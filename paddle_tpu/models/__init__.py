@@ -7,3 +7,4 @@ from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
                     ernie_pipeline_stages,
                     ErnieForSequenceClassification)  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .generation import generate_gpt  # noqa: F401

@@ -114,3 +114,13 @@ class GPTForCausalLM(nn.Layer):
         return F.cross_entropy(
             logits[:, :-1].reshape([-1, logits.shape[-1]]),
             labels[:, 1:].reshape([-1]))
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=None, eos_token_id=None, pad_token_id=0, seed=0):
+        """KV-cache autoregressive decode compiled as one XLA program
+        (models/generation.py); temperature=0 is greedy."""
+        from .generation import generate_gpt
+        return generate_gpt(self, input_ids, max_new_tokens=max_new_tokens,
+                            temperature=temperature, top_k=top_k,
+                            eos_token_id=eos_token_id,
+                            pad_token_id=pad_token_id, seed=seed)

@@ -87,6 +87,22 @@ def _softmax_ce_fused_bwd(res, g):
 _softmax_ce_fused.defvjp(_softmax_ce_fused_fwd, _softmax_ce_fused_bwd)
 
 
+def _fused_hard_label_ce(logits, lbl, ignore_index):
+    """Shared dispatch into the fused kernel for last-axis hard labels:
+    squeeze a trailing label dim, build valid/safe index streams,
+    flatten, call, reshape back. Returns (per-elem loss, valid mask)
+    shaped like the squeezed labels."""
+    lbl_i = lbl
+    if lbl_i.ndim == logits.ndim and lbl_i.shape[-1] == 1:
+        lbl_i = jnp.squeeze(lbl_i, axis=-1)
+    valid = (lbl_i != ignore_index).reshape(-1)
+    safe = jnp.where(valid.reshape(lbl_i.shape), lbl_i,
+                     0).astype(jnp.int32).reshape(-1)
+    flat = logits.reshape(-1, logits.shape[-1])
+    loss = _softmax_ce_fused(flat, safe, valid).reshape(lbl_i.shape)
+    return loss, valid.reshape(lbl_i.shape)
+
+
 @register_op("softmax_with_cross_entropy_op")
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, axis=-1,
@@ -95,15 +111,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     # kernel (see _softmax_ce_fused); other forms stay on log_softmax
     if (not soft_label and not return_softmax
             and axis % logits.ndim == logits.ndim - 1):
-        lbl = label
-        if lbl.ndim == logits.ndim:
-            lbl = jnp.squeeze(lbl, axis=-1)
-        valid = (lbl != ignore_index).reshape(-1)
-        safe = jnp.where(lbl == ignore_index, 0,
-                         lbl).astype(jnp.int32).reshape(-1)
-        flat = logits.reshape(-1, logits.shape[-1])
-        loss = _softmax_ce_fused(flat, safe, valid)
-        return loss.reshape(lbl.shape + (1,))
+        loss, _ = _fused_hard_label_ce(logits, label, ignore_index)
+        return loss[..., None]
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
@@ -137,15 +146,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         # its accumulations in f32 internally, so bf16 logits stay bf16)
         if (use_softmax and not is_soft and weight is None
                 and label_smoothing == 0 and axis_ == logits.ndim - 1):
-            lbl_i = lbl
-            if lbl_i.ndim == logits.ndim and lbl_i.shape[axis_] == 1:
-                lbl_i = jnp.squeeze(lbl_i, axis=axis_)
-            valid = (lbl_i != ignore_index).reshape(-1)
-            safe = jnp.where(valid.reshape(lbl_i.shape), lbl_i,
-                             0).astype(jnp.int32).reshape(-1)
-            flat = logits.reshape(-1, logits.shape[-1])
-            loss = _softmax_ce_fused(flat, safe, valid).reshape(
-                lbl_i.shape)
+            loss, valid = _fused_hard_label_ce(logits, lbl, ignore_index)
             if reduction == "mean":
                 denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)),
                                     1.0)
