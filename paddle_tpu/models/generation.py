@@ -67,11 +67,12 @@ def _attend(q, kc, vc, n_valid, scale):
     return jnp.einsum("bnqk,bnkh->bnqh", p, vc)
 
 
-def _step_hidden(params, eps, n_heads, x, caches, pos, prefill_len):
+def _step_hidden(params, eps, n_heads, x, caches, pos):
     """One token's hidden state through all blocks, updating caches.
 
     x: [B, 1, H]; caches: list of (k [B,N,T,hd], v [B,N,T,hd]);
-    pos: scalar index where this token's K/V land."""
+    pos: scalar index where this token's K/V land (attention covers
+    cache[:pos+1])."""
     new_caches = []
     hd = x.shape[-1] // n_heads
     scale = 1.0 / math.sqrt(hd)
@@ -137,7 +138,8 @@ def _pick(logits, key, temperature, top_k):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        k = min(int(top_k), logits.shape[-1])  # HF-style clamp
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits >= kth, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
@@ -164,7 +166,7 @@ def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
             x = (params["wte"][tok]
                  + params["wpe"][pos][None])[:, None, :]
             x, caches = _step_hidden(params, eps, n_heads, x, caches,
-                                     pos, prompt)
+                                     pos)
             h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
             logits = h[:, 0] @ params["wte"].T
             return (caches, logits, pos + 1, done), tok
@@ -178,11 +180,83 @@ def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=64)
+def _build_beam_run(eps, n_heads, num_beams, eos_token_id, pad_token_id,
+                    max_new_tokens, prompt, total):
+    """Beam-search decode sharing the KV-cache machinery: beams live as
+    batch rows [B*W], each step expands with the beam_search_step op's
+    semantics (ops/extras.py, ref beam_search_op.cc), reorders the
+    caches by parent beam, and the token/parent trail is walked back
+    with gather_tree (ref gather_tree_op.cc)."""
+    from ..ops.extras import beam_search_step, gather_tree
+    bs_step = beam_search_step.__pure_fn__
+    tree = gather_tree.__pure_fn__
+    w = num_beams
+
+    def run(params, ids, key):
+        del key
+        b = ids.shape[0]
+        # prefill ONCE over the B prompts, then repeat the caches and
+        # final logits across beams (duplicate rows would recompute the
+        # identical prompt forward W times)
+        x, caches = _prefill(params, eps, n_heads, ids, total)
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, w, axis=0), caches)
+        h_last = _ln(x[:, -1:], params["lnf_w"], params["lnf_b"], eps)
+        logits = jnp.repeat(h_last[:, 0] @ params["wte"].T, w,
+                            axis=0)                         # [B*W, V]
+        scores0 = jnp.tile(
+            jnp.asarray([0.0] + [-1e30] * (w - 1), jnp.float32), (b, 1))
+        done0 = jnp.zeros((b, w), bool)
+
+        def body(carry, _):
+            caches, logits, pos, scores, done = carry
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1).reshape(b, w, -1)
+            if eos_token_id is not None:
+                # finished beams only extend with pad at zero cost
+                v = logp.shape[-1]
+                frozen = jnp.full((v,), -1e30).at[pad_token_id].set(0.0)
+                logp = jnp.where(done[:, :, None], frozen[None, None],
+                                 logp)
+            scores, toks, parents = bs_step(logp, scores, beam_size=w)
+            if eos_token_id is not None:
+                done = jnp.take_along_axis(done, parents, axis=1)
+                done = done | (toks == eos_token_id)
+            # reorder beam rows (KV caches + emitted state) by parent
+            gidx = (jnp.arange(b)[:, None] * w + parents).reshape(-1)
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, gidx, axis=0), caches)
+            flat_toks = toks.reshape(-1)
+            x = (params["wte"][flat_toks]
+                 + params["wpe"][pos][None])[:, None, :]
+            x, caches = _step_hidden(params, eps, n_heads, x, caches,
+                                     pos)
+            h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+            logits = h[:, 0] @ params["wte"].T
+            return (caches, logits, pos + 1, scores, done), (toks,
+                                                             parents)
+
+        (_, _, _, scores, _), (toks, parents) = jax.lax.scan(
+            body, (caches, logits, jnp.int32(prompt), scores0, done0),
+            jnp.arange(max_new_tokens))
+        seqs = tree(toks, parents)                         # [T, B, W]
+        best = jnp.argmax(scores, axis=1)                  # [B]
+        best_toks = jnp.take_along_axis(
+            seqs, best[None, :, None], axis=2)[:, :, 0]    # [T, B]
+        return (jnp.concatenate([ids, best_toks.T.astype(jnp.int32)],
+                                axis=1),
+                jnp.take_along_axis(scores, best[:, None], 1)[:, 0])
+
+    return jax.jit(run)
+
+
 def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k: Optional[int] = None,
                  eos_token_id: Optional[int] = None, pad_token_id=0,
-                 seed=0):
-    """KV-cache decode for GPTForCausalLM. temperature=0 -> greedy.
+                 num_beams=1, seed=0):
+    """KV-cache decode for GPTForCausalLM. temperature=0 -> greedy;
+    num_beams>1 -> beam search (temperature/top_k ignored).
 
     Returns int32 [B, prompt_len + max_new_tokens]; rows that hit
     eos_token_id keep emitting pad_token_id afterwards.
@@ -197,6 +271,14 @@ def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
         raise ValueError(
             f"prompt+max_new_tokens={total} exceeds max_seq_len="
             f"{cfg.max_seq_len}")
+    if num_beams > 1:
+        run = _build_beam_run(
+            float(cfg.layer_norm_eps), int(cfg.num_heads),
+            int(num_beams),
+            None if eos_token_id is None else int(eos_token_id),
+            int(pad_token_id), int(max_new_tokens), prompt, total)
+        out, _scores = run(params, ids, jax.random.key(seed))
+        return Tensor(out)
     run = _build_run(
         float(cfg.layer_norm_eps), int(cfg.num_heads),
         float(temperature), None if top_k is None else int(top_k),

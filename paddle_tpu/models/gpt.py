@@ -116,11 +116,14 @@ class GPTForCausalLM(nn.Layer):
             labels[:, 1:].reshape([-1]))
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=None, eos_token_id=None, pad_token_id=0, seed=0):
+                 top_k=None, eos_token_id=None, pad_token_id=0,
+                 num_beams=1, seed=0):
         """KV-cache autoregressive decode compiled as one XLA program
-        (models/generation.py); temperature=0 is greedy."""
+        (models/generation.py); temperature=0 is greedy, num_beams>1
+        is beam search over the same cache machinery."""
         from .generation import generate_gpt
         return generate_gpt(self, input_ids, max_new_tokens=max_new_tokens,
                             temperature=temperature, top_k=top_k,
                             eos_token_id=eos_token_id,
-                            pad_token_id=pad_token_id, seed=seed)
+                            pad_token_id=pad_token_id,
+                            num_beams=num_beams, seed=seed)

@@ -86,3 +86,55 @@ class TestKVCacheDecode:
         model.generate(paddle.to_tensor(ids), max_new_tokens=4)
         model.generate(paddle.to_tensor(ids + 1), max_new_tokens=4)
         assert run._cache_size() == before  # no retrace, no recompile
+
+
+class TestBeamSearch:
+    def _logprob_of(self, model, seq, prompt_len):
+        """Total log-prob of seq's generated suffix under the model."""
+        lg = model(paddle.to_tensor(seq[None].astype(np.int32)))
+        lp = np.asarray(lg._data, np.float64)[0]
+        lp = lp - np.log(np.exp(lp - lp.max(-1, keepdims=True)).sum(
+            -1, keepdims=True)) - lp.max(-1, keepdims=True)
+        total = 0.0
+        for t in range(prompt_len, len(seq)):
+            total += lp[t - 1, seq[t]]
+        return total
+
+    def test_beam1_equals_greedy(self, model):
+        rng = np.random.RandomState(6)
+        ids = rng.randint(0, 97, (2, 5)).astype(np.int32)
+        g = np.asarray(model.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=6)._data)
+        b = np.asarray(model.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=6,
+                                      num_beams=1)._data)
+        np.testing.assert_array_equal(g, b)
+
+    def test_beam_not_worse_than_greedy(self, model):
+        rng = np.random.RandomState(7)
+        ids = rng.randint(0, 97, (1, 5)).astype(np.int32)
+        g = np.asarray(model.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=7)._data)[0]
+        b = np.asarray(model.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=7,
+                                      num_beams=4)._data)[0]
+        lp_g = self._logprob_of(model, g, 5)
+        lp_b = self._logprob_of(model, b, 5)
+        assert lp_b >= lp_g - 1e-4, (lp_b, lp_g)
+
+    def test_beam_eos_freezes(self, model):
+        rng = np.random.RandomState(8)
+        ids = rng.randint(0, 97, (1, 4)).astype(np.int32)
+        # eos := the step-1 top-1 token. The beam that emits it freezes
+        # at that (maximal) step-1 score while every other beam only
+        # accumulates negative log-probs, so the frozen beam is
+        # GUARANTEED to win: the best sequence must be [eos, pad...].
+        first = np.asarray(model.generate(
+            paddle.to_tensor(ids), max_new_tokens=1,
+            num_beams=4)._data)[0, -1]
+        out = np.asarray(model.generate(
+            paddle.to_tensor(ids), max_new_tokens=5, num_beams=4,
+            eos_token_id=int(first), pad_token_id=96)._data)[0]
+        gen = out[4:]
+        assert gen[0] == first
+        assert (gen[1:] == 96).all()
