@@ -99,10 +99,12 @@ class ErnieSelfAttention(nn.Layer):
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
         if attn_mask is None and self.use_flash:
-            ctx = F.flash_attention(q, k, v)
+            ctx = F.flash_attention(q, k, v, dropout=self.dropout_p,
+                                    training=self.training)
         else:
-            ctx = F.scaled_dot_product_attention(q, k, v,
-                                                 attn_mask=attn_mask)
+            ctx = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
+                training=self.training)
         ctx = ctx.reshape([b, s, h])
         return self.out(ctx)
 

@@ -62,10 +62,14 @@ class GPTBlock(nn.Layer):
         xn = self.ln1(x)
         qkv = self.qkv(xn).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        p = self.dropout.p
         if self.use_flash:
-            ctx = F.flash_attention(q, k, v, causal=True)
+            ctx = F.flash_attention(q, k, v, causal=True, dropout=p,
+                                    training=self.training)
         else:
-            ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            ctx = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=p,
+                training=self.training)
         x = x + self.dropout(self.proj(ctx.reshape([b, s, h])))
         x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)))))
         return x

@@ -26,7 +26,8 @@ from ...ops.registry import register_op
 __all__ = ["scaled_dot_product_attention", "flash_attention"]
 
 
-def _sdpa_impl(q, k, v, attn_mask, dropout_p, is_causal, scale):
+def _sdpa_impl(q, k, v, attn_mask, dropout_p, is_causal, scale,
+               drop_key=None):
     # layouts: [batch, seq, heads, head_dim] (paddle convention)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -45,16 +46,49 @@ def _sdpa_impl(q, k, v, attn_mask, dropout_p, is_causal, scale):
             logits = logits + attn_mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
         q.dtype)
+    if drop_key is not None and dropout_p > 0.0:
+        # dropout on the NORMALIZED attention probs — the reference
+        # composes softmax -> dropout_op -> matmul in its transformer
+        # (python/paddle/nn/layer/transformer.py), so the fused form
+        # must drop the same tensor
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          0.0).astype(probs.dtype)
     out = jnp.einsum("bnqk,bnkh->bnqh", probs, vT)
     return jnp.einsum("bnsh->bsnh", out)
 
 
+# NB: the "rng" tag keeps these off the eager jit fast path, matching
+# every explicit-key rng op (dropout_nd etc.); the compiled TrainStep
+# path is unaffected — dispatch cost there is zero by construction.
+@register_op("sdpa_dropout", tags=("rng",))
+def _sdpa_dropout(query, key, value, drop_key, attn_mask=None,
+                  dropout_p=0.0, is_causal=False, scale=None):
+    return _sdpa_impl(query, key, value, attn_mask, dropout_p, is_causal,
+                      scale, drop_key=drop_key)
+
+
 @register_op("scaled_dot_product_attention")
+def _sdpa_op(query, key, value, attn_mask=None, dropout_p=0.0,
+             is_causal=False, scale=None):
+    return _sdpa_impl(query, key, value, attn_mask, dropout_p, is_causal,
+                      scale)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None, name=None):
-    return _sdpa_impl(query, key, value, attn_mask, dropout_p, is_causal,
-                      scale)
+    """Plain-python dispatcher (ops must stay pure): training-mode
+    dropout routes to the rng-tagged op with an explicit key."""
+    if dropout_p and training:
+        from ...core.generator import next_key
+        return _sdpa_dropout(query, key, value, next_key(),
+                             attn_mask=attn_mask, dropout_p=dropout_p,
+                             is_causal=is_causal, scale=scale)
+    return _sdpa_op(query, key, value, attn_mask=attn_mask,
+                    dropout_p=dropout_p, is_causal=is_causal,
+                    scale=scale)
 
 
 def _flash_fwd(q, k, v, is_causal, scale, block_k):
@@ -111,19 +145,12 @@ def _flash_fwd(q, k, v, is_causal, scale, block_k):
 
 
 @register_op("flash_attention_op")
-def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, block_size=512, name=None):
-    """paddle.nn.functional.flash_attention-compatible entry.
-
-    Layout: [batch, seq, num_heads, head_dim]. Memory O(seq·block) instead
-    of O(seq²). On TPU the Pallas/Mosaic kernel (ops.pallas_kernels) owns
-    the hot path; elsewhere the lax.scan online-softmax reference runs
-    (differentiable via jax.vjp of the scan; XLA rematerializes).
-    """
-    if dropout == 0.0 and not return_softmax:
-        from ...ops import pallas_kernels as _pk
-        if _pk.pallas_available():
-            return _pk.flash_attention_mha(query, key, value, causal=causal)
+def _flash_attention_op(query, key, value, causal=False, block_size=512):
+    """No-dropout flash attention: Pallas kernel on TPU, lax.scan
+    online-softmax elsewhere."""
+    from ...ops import pallas_kernels as _pk
+    if _pk.pallas_available():
+        return _pk.flash_attention_mha(query, key, value, causal=causal)
     q = jnp.einsum("bsnh->bnsh", query)
     k = jnp.einsum("bsnh->bnsh", key)
     v = jnp.einsum("bsnh->bnsh", value)
@@ -131,3 +158,48 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     blk = min(block_size, k.shape[2])
     out = _flash_fwd(q, k, v, causal, scale, blk)
     return jnp.einsum("bnsh->bsnh", out)
+
+
+@register_op("flash_attention_dropout", tags=("rng",))
+def _flash_attention_dropout_op(query, key, value, seed, causal=False,
+                                dropout_p=0.0):
+    """Training-mode flash attention with in-kernel attention-probs
+    dropout (ops/pallas_kernels.py — the backward regenerates each
+    block's keep mask from the seed; O(seq·block) memory stands). The
+    non-TPU path falls back to SDPA-with-dropout: exact reference
+    semantics, O(seq²) memory (test sizes only)."""
+    from ...ops import pallas_kernels as _pk
+    if _pk.kernel_dropout_available():
+        return _pk.flash_attention_mha(query, key, value, causal=causal,
+                                       dropout_p=dropout_p, seed=seed)
+    key_arr = jax.random.wrap_key_data(
+        jnp.asarray(seed, jnp.uint32).reshape(1).repeat(2))
+    return _sdpa_impl(query, key, value, None, dropout_p, causal, None,
+                      drop_key=key_arr)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, block_size=512, training=True,
+                    name=None):
+    """paddle.nn.functional.flash_attention-compatible entry.
+
+    Layout: [batch, seq, num_heads, head_dim]. Memory O(seq·block)
+    instead of O(seq²). Training-mode attention dropout runs INSIDE the
+    Pallas kernel on TPU (block-seeded mask, regenerated in the
+    backward); eval or dropout=0 takes the deterministic kernel.
+    """
+    if dropout and training and not return_softmax:
+        from ...core.generator import next_key
+        seed = jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+        return _flash_attention_dropout_op(query, key, value, seed,
+                                           causal=causal,
+                                           dropout_p=float(dropout))
+    if not return_softmax:
+        return _flash_attention_op(query, key, value, causal=causal,
+                                   block_size=block_size)
+    # return_softmax form: the blockwise reference path (pure jnp),
+    # sharing the registered op's implementation
+    return _flash_attention_op.__pure_fn__(query, key, value,
+                                           causal=causal,
+                                           block_size=block_size)

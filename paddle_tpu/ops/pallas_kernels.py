@@ -50,6 +50,36 @@ def pallas_available() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=1)
+def kernel_dropout_available() -> bool:
+    """Self-check of the in-kernel dropout path on the current backend.
+
+    The Pallas TPU interpreter stubs prng_random_bits to zeros (every
+    link dropped), so the dropout kernel must only be trusted where a
+    tiny probe shows real RNG behavior: deterministic per seed,
+    seed-sensitive, and not degenerate. Cached per process; callers
+    fall back to SDPA-with-dropout when this fails."""
+    if not pallas_available():
+        return False
+    try:
+        import numpy as np
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 128, 1, 64), jnp.float32)
+        base = np.asarray(flash_attention_mha(q, q, q))
+        a = np.asarray(flash_attention_mha(q, q, q, dropout_p=0.5,
+                                           seed=3))
+        a2 = np.asarray(flash_attention_mha(q, q, q, dropout_p=0.5,
+                                            seed=3))
+        b = np.asarray(flash_attention_mha(q, q, q, dropout_p=0.5,
+                                           seed=4))
+        return (np.allclose(a, a2)
+                and np.abs(a - b).max() > 1e-6
+                and np.abs(a).max() > 1e-6
+                and np.abs(a - base).max() > 1e-6)
+    except Exception:  # pragma: no cover — kernel/backend quirk
+        return False
+
+
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -86,9 +116,21 @@ def _masked_probs(q, k, lse_row, i, j, *, scale, causal, bq, bk, sk):
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p):
+    """Deterministic per-(batch·head, q-block, k-block) keep mask: the
+    backward kernels REGENERATE the forward's mask from the same seed
+    tuple instead of storing an O(s²) mask (the flash-dropout trick)."""
+    pltpu.prng_seed(seed_ref[0], bh, i, j)
+    bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.uint32)
+    threshold = jnp.uint32(min(int(dropout_p * 4294967296.0),
+                               4294967295))
+    return bits >= threshold  # keep with prob 1 - p
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
-                *, scale, causal, bq, bk, nk, sk):
+                *, scale, causal, bq, bk, nk, sk, dropout_p):
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -123,7 +165,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
+        # the softmax denominator sums over ALL links (dropout zeroes
+        # entries of the NORMALIZED probs), so l uses the unmasked p
         l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        if dropout_p > 0.0:
+            keep = _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -141,7 +188,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-def _flash_fwd_pallas(q, k, v, causal, scale, interpret):
+def _flash_fwd_pallas(q, k, v, causal, scale, interpret, dropout_p=0.0,
+                      seed=None):
     """q,k,v: [bh, s, h] padded to (128,128) tiles. Returns (o, lse)."""
     bh, sq, h = q.shape
     sk = k.shape[1]
@@ -152,14 +200,17 @@ def _flash_fwd_pallas(q, k, v, causal, scale, interpret):
     k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
     v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0)))
     nq, nk = sq_p // bq, sk_p // bk
+    seed_arr = jnp.asarray(
+        [0 if seed is None else seed], jnp.int32).reshape(1)
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        bq=bq, bk=bk, nk=nk, sk=sk)
+        bq=bq, bk=bk, nk=nk, sk=sk, dropout_p=float(dropout_p))
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
@@ -178,14 +229,16 @@ def _flash_fwd_pallas(q, k, v, causal, scale, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(seed_arr, q, k, v)
     return o[:, :sq, :h], lse[:, 0, :sq]
 
 
 # --------------------------------------------------------------- backward
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, scale, causal, bq, bk, nk, sk):
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_ref, *, scale, causal, bq, bk, nk, sk,
+               dropout_p):
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -205,6 +258,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta_ref[0, 0][:, None])
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -215,9 +271,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, bq, bk, nq, sk):
+                *, scale, causal, bq, bk, nq, sk, dropout_p):
+    bh = pl.program_id(0)
     j = pl.program_id(1)  # k block
     i = pl.program_id(2)  # q block (innermost)
 
@@ -236,14 +293,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         p = _masked_probs(q, k_ref[0], lse_ref[0, 0], i, j, scale=scale,
                           causal=causal, bq=bq, bk=bk, sk=sk)
-        # dv += P^T @ dO
-        pt = p.astype(do.dtype)
+        if dropout_p > 0.0:
+            # same seed tuple (bh, q-block i, k-block j) as the forward
+            keep = _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p)
+            pd = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        else:
+            pd = p
+        # dv += (dropout(P))^T @ dO
+        pt = pd.astype(do.dtype)
         dv_acc[:] += jax.lax.dot_general(
             pt, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta_ref[0, 0][:, None])
         # dk += dS^T @ Q * scale
         dk_acc[:] += jax.lax.dot_general(
@@ -256,7 +321,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret):
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret,
+                      dropout_p=0.0, seed=None):
     bh, sq, h = q.shape
     sk = k.shape[1]
     sq_p, bq = _pick_block(sq, _BQ)
@@ -274,12 +340,16 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret):
     deltap = jnp.broadcast_to(
         jnp.pad(delta, ((0, 0), (0, sq_p - sq)))[:, None, :], (bh, 8, sq_p))
     nq, nk = sq_p // bq, sk_p // bk
+    seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
+                else jnp.asarray(seed, jnp.int32).reshape(1))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, sk=sk),
+                          bq=bq, bk=bk, nk=nk, sk=sk,
+                          dropout_p=float(dropout_p)),
         grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, h_p), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, h_p), lambda b, i, j: (b, j, 0)),
@@ -291,13 +361,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret):
         out_shape=jax.ShapeDtypeStruct((bh, sq_p, h_p), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, h_p), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(seed_arr, qp, kp, vp, dop, lsep, deltap)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, sk=sk),
+                          bq=bq, bk=bk, nq=nq, sk=sk,
+                          dropout_p=float(dropout_p)),
         grid=(bh, nk, nq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, h_p), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bk, h_p), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, h_p), lambda b, j, i: (b, j, 0)),
@@ -318,39 +390,49 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret):
             pltpu.VMEM((bk, h_p), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(seed_arr, qp, kp, vp, dop, lsep, deltap)
 
     return dq[:, :sq, :h], dk[:, :sk, :h], dv[:, :sk, :h]
 
 
 # ------------------------------------------------------------- public API
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_mha(q, k, v, causal, scale, interpret):
-    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_mha(q, k, v, seed, causal, scale, interpret, dropout_p):
+    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, interpret,
+                             dropout_p=dropout_p, seed=seed)
     return o
 
 
-def _flash_mha_fwd(q, k, v, causal, scale, interpret):
-    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_mha_fwd(q, k, v, seed, causal, scale, interpret, dropout_p):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, interpret,
+                               dropout_p=dropout_p, seed=seed)
+    return o, (q, k, v, seed, o, lse)
 
 
-def _flash_mha_bwd(causal, scale, interpret, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret)
+def _flash_mha_bwd(causal, scale, interpret, dropout_p, res, do):
+    q, k, v, seed, o, lse = res
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
+                                   interpret, dropout_p=dropout_p,
+                                   seed=seed)
+    import numpy as np
+    dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 
 def flash_attention_mha(query, key, value, causal=False, scale=None,
-                        interpret=False):
+                        interpret=False, dropout_p=0.0, seed=None):
     """Flash attention over [batch, seq, num_heads, head_dim] inputs.
 
     Pallas TPU kernel (Mosaic) with custom VJP; O(seq·block) memory.
-    `interpret=True` runs the same kernels under the Pallas interpreter
-    (used by the CPU test suite).
+    dropout_p applies attention-probs dropout INSIDE the kernel (the
+    backward regenerates each block's keep-mask from (seed, block)
+    instead of storing it); `seed` is a traced int32 scalar — vary it
+    per training step. `interpret=True` runs the same kernels under the
+    Pallas interpreter (used by the CPU test suite).
     """
     b, sq, n, h = query.shape
     sk = key.shape[1]
@@ -365,5 +447,8 @@ def flash_attention_mha(query, key, value, causal=False, scale=None,
     if h_p != h:
         pad = ((0, 0), (0, 0), (0, h_p - h))
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    o = _flash_mha(q, k, v, bool(causal), float(scale), bool(interpret))
+    seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
+                else jnp.asarray(seed, jnp.int32).reshape(1))
+    o = _flash_mha(q, k, v, seed_arr, bool(causal), float(scale),
+                   bool(interpret), float(dropout_p))
     return jnp.einsum("bnsh->bsnh", o.reshape(b, n, sq, h_p)[..., :h])

@@ -101,14 +101,20 @@ class TestBeamSearch:
         return total
 
     def test_beam1_equals_greedy(self, model):
+        # exercise the BEAM builder itself at W=1 (generate() dispatches
+        # num_beams=1 to the greedy builder, which would be vacuous)
+        from paddle_tpu.models.generation import (_build_beam_run,
+                                                  _gpt_params)
+        import jax
         rng = np.random.RandomState(6)
         ids = rng.randint(0, 97, (2, 5)).astype(np.int32)
         g = np.asarray(model.generate(paddle.to_tensor(ids),
                                       max_new_tokens=6)._data)
-        b = np.asarray(model.generate(paddle.to_tensor(ids),
-                                      max_new_tokens=6,
-                                      num_beams=1)._data)
-        np.testing.assert_array_equal(g, b)
+        cfg = model.gpt.config
+        run = _build_beam_run(float(cfg.layer_norm_eps),
+                              int(cfg.num_heads), 1, None, 0, 6, 5, 11)
+        b, _ = run(_gpt_params(model), ids, jax.random.key(0))
+        np.testing.assert_array_equal(g, np.asarray(b))
 
     def test_beam_not_worse_than_greedy(self, model):
         rng = np.random.RandomState(7)
