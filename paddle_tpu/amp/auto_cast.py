@@ -21,7 +21,8 @@ from ..ops import registry as _registry
 AMP_WHITE_LIST: Set[str] = {
     "matmul_v2", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
     "conv2d_transpose", "conv3d_transpose", "linear", "bmm", "mv", "addmm",
-    "flash_attention_op", "scaled_dot_product_attention", "einsum",
+    "flash_attention_op", "scaled_dot_product_attention",
+    "sdpa_dropout", "flash_attention_dropout", "einsum",
     "lstm_cell", "gru_cell", "simple_rnn_cell", "rnn_scan",
 }
 

@@ -188,7 +188,9 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     Pallas kernel on TPU (block-seeded mask, regenerated in the
     backward); eval or dropout=0 takes the deterministic kernel.
     """
-    if dropout and training and not return_softmax:
+    if dropout and training:
+        # return_softmax is an API-parity flag (no path here has ever
+        # returned the probs); training-mode dropout must still apply
         from ...core.generator import next_key
         seed = jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)

@@ -66,16 +66,19 @@ class MultiHeadAttention(Layer):
                 k = concat([cache.k, k], axis=1)
                 v = concat([cache.v, v], axis=1)
                 cache = self.Cache(k, v)
+        # reference MultiHeadAttention applies dropout to the attention
+        # WEIGHTS (python/paddle/nn/layer/transformer.py: weights =
+        # F.dropout(softmax(product))), not to the projected output
         if attn_mask is None and self.use_flash and not self.need_weights:
-            out = F.flash_attention(q, k, v)
+            out = F.flash_attention(q, k, v, dropout=self.dropout,
+                                    training=self.training)
         else:
-            out = F.scaled_dot_product_attention(q, k, v,
-                                                 attn_mask=attn_mask)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+                training=self.training)
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.embed_dim])
         out = self.out_proj(out)
-        if self.training and self.dropout > 0:
-            out = F.dropout(out, self.dropout, training=True)
         if isinstance(cache, self.Cache):
             return out, cache
         return out

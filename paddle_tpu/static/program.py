@@ -173,6 +173,22 @@ class Program:
                     node.kwargs = {k: node.kwargs[k]
                                    for k in ("p", "mode")
                                    if k in node.kwargs}
+                elif node.op_type == "sdpa_dropout":
+                    # (q, k, v, key) -> deterministic SDPA over (q, k, v)
+                    node.op_type = "scaled_dot_product_attention"
+                    node.fn = _registry.get_op(
+                        "scaled_dot_product_attention").fn
+                    node.in_ids = node.in_ids[:3]
+                    node.const_args = node.const_args[:3]
+                    node.kwargs = {k: v for k, v in node.kwargs.items()
+                                   if k != "dropout_p"}
+                elif node.op_type == "flash_attention_dropout":
+                    node.op_type = "flash_attention_op"
+                    node.fn = _registry.get_op("flash_attention_op").fn
+                    node.in_ids = node.in_ids[:3]
+                    node.const_args = node.const_args[:3]
+                    node.kwargs = {k: v for k, v in node.kwargs.items()
+                                   if k == "causal"}
                 elif node.op_type == "batch_norm_op":
                     node.kwargs = dict(node.kwargs, training=False)
         return p

@@ -93,6 +93,38 @@ class TestCloneForTest:
         assert (train_out == 0).any()          # train: dropped entries
         np.testing.assert_allclose(eval_out, 1.0)  # eval: identity
 
+    def test_attention_dropout_flips_in_eval_clone(self):
+        # sdpa_dropout / flash_attention_dropout nodes must become the
+        # deterministic attention ops (reference clone prunes dropout)
+        main = Program()
+        with program_guard(main, Program()):
+            q = data("q", [2, 8, 2, 8], "float32")
+            y = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                               training=True)
+            z = F.flash_attention(q, q, q, dropout=0.5, training=True)
+        test_prog = main.clone(for_test=True)
+        assert not any(n.op_type in ("sdpa_dropout",
+                                     "flash_attention_dropout")
+                       for n in test_prog.ops)
+        rng = np.random.RandomState(0)
+        feed = {"q": rng.randn(2, 8, 2, 8).astype(np.float32)}
+        for var in (y, z):
+            a = Executor().run(test_prog, feed=feed, fetch_list=[
+                test_prog.vars[var.var_id]])[0]
+            b = Executor().run(test_prog, feed=feed, fetch_list=[
+                test_prog.vars[var.var_id]])[0]
+            np.testing.assert_allclose(a, b)   # deterministic
+        # and equal to the no-dropout computation
+        ref = Program()
+        with program_guard(ref, Program()):
+            q2 = data("q", [2, 8, 2, 8], "float32")
+            y2 = F.scaled_dot_product_attention(q2, q2, q2)
+        want = Executor().run(ref, feed=feed, fetch_list=[
+            ref.vars[y2.var_id]])[0]
+        got = Executor().run(test_prog, feed=feed, fetch_list=[
+            test_prog.vars[y.var_id]])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
     def test_batchnorm_uses_running_stats_in_eval_clone(self):
         main = Program()
         with program_guard(main, Program()):
