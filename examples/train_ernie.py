@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""ERNIE/BERT pretraining on synthetic data (BASELINE config 3).
+
+One compiled train step (fwd + loss + bwd + AdamW + AMP O1) per batch;
+on a TPU chip this is the bench.py flagship path. Run small anywhere:
+
+    python examples/train_ernie.py --tiny --steps 30
+    python examples/train_ernie.py                  # base config (TPU)
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny config + CPU-friendly shapes")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seqlen", type=int, default=None)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the XLA CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu or args.tiny:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    from paddle_tpu.static import TrainStep
+
+    if args.tiny:
+        cfg = ErnieConfig.tiny()
+        batch, seqlen = args.batch or 8, args.seqlen or 64
+    else:
+        cfg = ErnieConfig(vocab_size=30528, max_position_embeddings=512)
+        batch, seqlen = args.batch or 48, args.seqlen or 512
+
+    paddle.seed(0)
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    step = TrainStep(
+        model,
+        lambda out, labels: ErnieForPretraining.pretraining_loss(out,
+                                                                 labels),
+        opt, amp_level="O1", amp_dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    y = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+
+    print("compiling...", flush=True)
+    loss0 = float(step(x, y).item())
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = step(x, y)
+    last = float(loss.item())
+    dt = time.perf_counter() - t0
+    toks = batch * seqlen * args.steps / dt
+    print(f"loss {loss0:.4f} -> {last:.4f} | "
+          f"{dt / args.steps * 1e3:.1f} ms/step | {toks:,.0f} tokens/s")
+
+
+if __name__ == "__main__":
+    main()
