@@ -161,21 +161,23 @@ def _flash_attention_op(query, key, value, causal=False, block_size=512):
 
 
 @register_op("flash_attention_dropout", tags=("rng",))
-def _flash_attention_dropout_op(query, key, value, seed, causal=False,
-                                dropout_p=0.0):
+def _flash_attention_dropout_op(query, key, value, drop_key,
+                                causal=False, dropout_p=0.0):
     """Training-mode flash attention with in-kernel attention-probs
     dropout (ops/pallas_kernels.py — the backward regenerates each
-    block's keep mask from the seed; O(seq·block) memory stands). The
-    non-TPU path falls back to SDPA-with-dropout: exact reference
-    semantics, O(seq²) memory (test sizes only)."""
+    block's keep mask from a seed derived from drop_key; O(seq·block)
+    memory stands). drop_key is a real PRNG key so static replay can
+    refresh it per run like every other rng op. The non-TPU path falls
+    back to SDPA-with-dropout: exact reference semantics, O(seq²)
+    memory (test sizes only)."""
     from ...ops import pallas_kernels as _pk
     if _pk.kernel_dropout_available():
+        seed = jax.random.randint(drop_key, (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
         return _pk.flash_attention_mha(query, key, value, causal=causal,
                                        dropout_p=dropout_p, seed=seed)
-    key_arr = jax.random.wrap_key_data(
-        jnp.asarray(seed, jnp.uint32).reshape(1).repeat(2))
     return _sdpa_impl(query, key, value, None, dropout_p, causal, None,
-                      drop_key=key_arr)
+                      drop_key=drop_key)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -192,9 +194,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         # return_softmax is an API-parity flag (no path here has ever
         # returned the probs); training-mode dropout must still apply
         from ...core.generator import next_key
-        seed = jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1,
-                                  dtype=jnp.int32)
-        return _flash_attention_dropout_op(query, key, value, seed,
+        return _flash_attention_dropout_op(query, key, value, next_key(),
                                            causal=causal,
                                            dropout_p=float(dropout))
     if not return_softmax:

@@ -421,9 +421,21 @@ class Program:
             s for s in getattr(self, "_var_grads", [])
             if any(g in fetch_set for g in s["grad_vars"])]
 
+        def _is_prng_key(c):
+            try:
+                return hasattr(c, "dtype") and jax.dtypes.issubdtype(
+                    c.dtype, jax.dtypes.prng_key)
+            except Exception:
+                return False
+
         def replay(env, override=None):
             for node in ops:
-                ins = [env[i] if i is not None else c
+                # rng ops capture a trace-time key in const_args; replay
+                # must NOT bake it (every Executor.run would reuse the
+                # same dropout mask) — draw a fresh key from the per-run
+                # key_scope instead (deterministic given the run key)
+                ins = [env[i] if i is not None
+                       else (next_key() if _is_prng_key(c) else c)
                        for i, c in zip(node.in_ids, node.const_args)]
                 res = node.fn(*ins, **node.kwargs)
                 res = tuple(res) if isinstance(res, (list, tuple)) else \

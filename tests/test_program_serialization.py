@@ -93,6 +93,28 @@ class TestCloneForTest:
         assert (train_out == 0).any()          # train: dropped entries
         np.testing.assert_allclose(eval_out, 1.0)  # eval: identity
 
+    def test_static_dropout_mask_varies_per_run(self):
+        # rng keys captured as consts must be refreshed from the per-run
+        # key_scope at replay — a baked key would repeat the SAME mask
+        # on every Executor.run (frozen sparsification, not dropout)
+        main = Program()
+        with program_guard(main, Program()):
+            x = data("x", [16, 16], "float32")
+            y = F.dropout(x, p=0.5, training=True)
+            q = data("q", [2, 8, 2, 8], "float32")
+            z = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                               training=True)
+        rng = np.random.RandomState(3)
+        feed = {"x": np.ones((16, 16), np.float32),
+                "q": rng.randn(2, 8, 2, 8).astype(np.float32)}
+        exe = Executor()
+        outs = [exe.run(main, feed=feed,
+                        fetch_list=[main.vars[y.var_id],
+                                    main.vars[z.var_id]])
+                for _ in range(2)]
+        assert np.abs(outs[0][0] - outs[1][0]).max() > 1e-6
+        assert np.abs(outs[0][1] - outs[1][1]).max() > 1e-6
+
     def test_attention_dropout_flips_in_eval_clone(self):
         # sdpa_dropout / flash_attention_dropout nodes must become the
         # deterministic attention ops (reference clone prunes dropout)
