@@ -40,3 +40,9 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     mesh initialized — multi-host launch goes through paddle_tpu.launch."""
     init_parallel_env()
     return func(*args)
+
+# reference paddle.distributed re-exports: fleet datasets + sparse-table
+# entry policies (python/paddle/distributed/__init__.py)
+from ..io.fleet_dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+from .embedding_kv import (CountFilterEntry,  # noqa: F401,E402
+                           ProbabilityEntry)

@@ -32,7 +32,8 @@ import numpy as np
 from ..framework import Tensor
 
 __all__ = ["EmbeddingKV", "SparseEmbedding", "pull_sparse", "push_sparse",
-           "distributed_lookup_table"]
+           "distributed_lookup_table", "CountFilterEntry",
+           "ProbabilityEntry"]
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc")
@@ -137,6 +138,40 @@ class _PyTable:
 _OPTIMIZERS = {"sgd": 0, "adagrad": 1}
 
 
+class CountFilterEntry:
+    """Reference distributed.CountFilterEntry (sparse-table accessor
+    config): a key is only ADMITTED into the table after it has been
+    seen `count_filter` times — cold long-tail ids serve the zero
+    vector and take no updates until they prove frequent."""
+
+    needs_count = True
+
+    def __init__(self, count_filter: int = 10):
+        if count_filter < 1:
+            raise ValueError("count_filter must be >= 1")
+        self.count_filter = int(count_filter)
+
+    def admits(self, key: int, seen_count: int) -> bool:
+        return seen_count >= self.count_filter
+
+
+class ProbabilityEntry:
+    """Reference distributed.ProbabilityEntry: a key is admitted with
+    fixed probability on first sight (deterministic per key here — a
+    splitmix64 hash coin, so every worker makes the same decision)."""
+
+    needs_count = False  # pure hash coin: no per-key bookkeeping
+
+    def __init__(self, probability: float = 0.1):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = float(probability)
+
+    def admits(self, key: int, seen_count: int) -> bool:
+        h = _splitmix64(key & 0xFFFFFFFFFFFFFFFF)
+        return (h >> 11) / float(1 << 53) < self.probability
+
+
 class EmbeddingKV:
     """Sharded host-memory embedding table with sparse pull/push.
 
@@ -146,10 +181,16 @@ class EmbeddingKV:
     """
 
     def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.01,
-                 init_range: float = 0.01, seed: int = 0):
+                 init_range: float = 0.01, seed: int = 0, entry=None):
         self.dim = int(dim)
         self.optimizer = optimizer
-        lib = _kv_lib()
+        # entry (CountFilterEntry/ProbabilityEntry) gates key admission;
+        # the admission bookkeeping lives host-side in python, so entry
+        # tables use the python table (the C++ table stays the fast path
+        # for unconditional admission)
+        self.entry = entry
+        self._seen: dict = {}
+        lib = _kv_lib() if entry is None else None
         self._lib = lib
         if lib is not None:
             self._h = lib.pd_kv_open(self.dim, _OPTIMIZERS[optimizer],
@@ -167,8 +208,22 @@ class EmbeddingKV:
 
     def pull(self, ids) -> np.ndarray:
         """ids [n] int64 -> rows [n, dim] float32 (missing keys get the
-        deterministic per-key init)."""
+        deterministic per-key init; with an entry policy, unadmitted
+        keys serve zeros)."""
         ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
+        if self.entry is not None:
+            count = getattr(self.entry, "needs_count", True)
+            out = np.zeros((ids.shape[0], self.dim), np.float32)
+            for i, k in enumerate(ids):
+                k = int(k)
+                if count:
+                    seen = self._seen.get(k, 0) + 1
+                    self._seen[k] = seen
+                else:
+                    seen = 1  # policy ignores it; keep _seen empty
+                if k in self._py.rows or self.entry.admits(k, seen):
+                    out[i] = self._py.pull(np.asarray([k], np.int64))[0]
+            return out
         if self._py is not None:
             return self._py.pull(ids)
         out = np.empty((ids.shape[0], self.dim), np.float32)
@@ -188,6 +243,12 @@ class EmbeddingKV:
         ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
         grads = np.ascontiguousarray(
             np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim))
+        if self.entry is not None:
+            keep = [i for i, k in enumerate(ids)
+                    if int(k) in self._py.rows]
+            if keep:
+                self._py.push(ids[keep], grads[keep])
+            return
         if self._py is not None:
             self._py.push(ids, grads)
             return

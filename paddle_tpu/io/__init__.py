@@ -1,4 +1,5 @@
-from .dataloader import DataLoader  # noqa: F401
+from .dataloader import (DataLoader, WorkerInfo,  # noqa: F401
+                         get_worker_info)
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset,
                       random_split)  # noqa: F401

@@ -51,19 +51,23 @@ class _WorkerPool:
     process boundaries cheaply; the reference's process pool exists to
     dodge Python-heavy decoding, which belongs in the C++ feeder."""
 
-    def __init__(self, fn, num_workers, prefetch):
+    def __init__(self, fn, num_workers, prefetch, dataset=None):
         self.fn = fn
+        self.num_workers = num_workers
+        self.dataset = dataset
         self.in_q = queue.Queue()
         self.out = {}
         self.cv = threading.Condition()
         self.workers = []
         self.closed = False
-        for _ in range(num_workers):
-            t = threading.Thread(target=self._loop, daemon=True)
+        for wid in range(num_workers):
+            t = threading.Thread(target=self._loop, args=(wid,),
+                                 daemon=True)
             t.start()
             self.workers.append(t)
 
-    def _loop(self):
+    def _loop(self, wid=0):
+        _set_worker_info(WorkerInfo(wid, self.num_workers, self.dataset))
         while True:
             item = self.in_q.get()
             if item is None:
@@ -159,7 +163,8 @@ class DataLoader:
                 else:
                     pool = _WorkerPool(
                         lambda idxs: self.collate_fn(make(idxs)),
-                        self.num_workers, self.prefetch_factor)
+                        self.num_workers, self.prefetch_factor,
+                        dataset=self.dataset)
                 try:
                     # windowed submission: at most workers*prefetch
                     # batches in flight, so a slow consumer doesn't pile
@@ -238,3 +243,27 @@ class DataLoader:
             if isinstance(item, _Error):
                 raise item.exc
             yield item
+
+
+# -- worker introspection (reference io.get_worker_info) -------------------
+
+class WorkerInfo:
+    """Reference paddle.io.get_worker_info payload: inside a DataLoader
+    worker returns (id, num_workers, dataset); in the main process
+    returns None."""
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = threading.local()
+
+
+def _set_worker_info(info):
+    _worker_info.value = info
+
+
+def get_worker_info():
+    return getattr(_worker_info, "value", None)

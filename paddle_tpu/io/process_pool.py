@@ -40,7 +40,10 @@ def _pack(seq, ok, payload):
 
 
 def _worker_loop(worker_id, dataset, collate_fn, index_q, ring_name,
-                 result_q, init_fn, seed):
+                 result_q, init_fn, seed, num_workers=0):
+    # reference dataloader_iter._worker_loop exposes get_worker_info()
+    from .dataloader import WorkerInfo, _set_worker_info
+    _set_worker_info(WorkerInfo(worker_id, num_workers, dataset))
     if init_fn is not None:
         init_fn(worker_id)
     np.random.seed((seed + worker_id) % (2**32))
@@ -129,7 +132,7 @@ class ProcessPool:
             p = ctx.Process(
                 target=_worker_loop,
                 args=(w, dataset, collate_fn, self.index_q, ring_names[w],
-                      self.result_q, worker_init_fn, seed),
+                      self.result_q, worker_init_fn, seed, num_workers),
                 daemon=True)
             p.start()
             self.procs.append(p)

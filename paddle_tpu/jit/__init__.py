@@ -3,3 +3,5 @@ from .api import (InputSpec, StaticFunction, functionalize, to_static,
 from . import dy2static  # noqa: F401
 from .dy2static import (convert_function, set_max_while_iters,  # noqa: F401
                         max_while_iters_guard)
+from .compat import (TracedLayer, ProgramTranslator,  # noqa: F401
+                     set_code_level, set_verbosity)

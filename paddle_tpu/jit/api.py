@@ -149,6 +149,10 @@ class StaticFunction:
         return self._layer.raw_state() if self._layer is not None else {}
 
     def __call__(self, *args, **kwargs):
+        from .compat import ProgramTranslator
+        if not ProgramTranslator.enabled():
+            # reference ProgramTranslator().enable(False): run eagerly
+            return self._function(*args, **kwargs)
         arrays = _unwrap_tree(args)
         kw_arrays = _unwrap_tree(kwargs)
         state = self._state()

@@ -1,0 +1,94 @@
+"""jit-facade compatibility (reference python/paddle/jit/__init__.py):
+TracedLayer, the ProgramTranslator singleton, and the dy2static logging
+knobs — thin, real layers over StaticFunction/functionalize."""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["TracedLayer", "ProgramTranslator", "set_code_level",
+           "set_verbosity"]
+
+_state = threading.local()
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Reference jit.set_verbosity: dy2static log level (0 silences)."""
+    _state.verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Reference jit.set_code_level: at level>0 the AST-transformed
+    source of each converted function is printed once (the reference
+    logs the transformed code of the first `level` transformers)."""
+    _state.code_level = int(level)
+
+
+def _code_level() -> int:
+    return getattr(_state, "code_level", 0)
+
+
+class ProgramTranslator:
+    """Reference ProgramTranslator singleton: the global on/off switch
+    for @to_static conversion. enable(False) makes every StaticFunction
+    call fall through to the original eager function."""
+
+    _instance: Optional["ProgramTranslator"] = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls) -> "ProgramTranslator":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        type(self)._enabled = bool(enable_to_static)
+
+    @classmethod
+    def enabled(cls) -> bool:
+        return cls._enabled
+
+
+class TracedLayer:
+    """Reference jit.TracedLayer (jit.py:1052): trace a dygraph Layer
+    into a static callable once, replay it, and export it as an
+    inference artifact. Here the trace IS a StaticFunction jit cache;
+    save_inference_model reuses static/io.py's jax.export path."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (outputs, TracedLayer) like the reference."""
+        from .api import StaticFunction
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        fn = StaticFunction(layer.forward, layer=layer)
+        out = fn(*ins)
+        return out, TracedLayer(layer, fn, ins)
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None,
+                             **kwargs):
+        from ..static.io import save_inference_model
+        from .api import InputSpec
+        spec: List[InputSpec] = []
+        for i, t in enumerate(self._example_inputs):
+            arr = np.asarray(t._data if hasattr(t, "_data") else t)
+            spec.append(InputSpec(list(arr.shape), str(arr.dtype),
+                                  f"x{i}"))
+        was_training = self._layer.training
+        try:
+            self._layer.eval()
+            save_inference_model(path, layer=self._layer,
+                                 input_spec=spec)
+        finally:
+            if was_training:
+                self._layer.train()

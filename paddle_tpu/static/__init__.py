@@ -13,6 +13,13 @@ from . import nn  # noqa: F401
 from . import io  # noqa: F401
 from .io import (save_inference_model, load_inference_model,  # noqa: F401
                  serialize_program, deserialize_program)
+from .compat import (global_scope, scope_guard, Scope,  # noqa: F401
+                     BuildStrategy, ExecutionStrategy, CompiledProgram,
+                     ParallelExecutor, Print, py_func, name_scope,
+                     WeightNormParamAttr, save, load, save_vars,
+                     load_vars, load_program_state, set_program_state,
+                     cpu_places, cuda_places, xpu_places, Variable,
+                     accuracy, auc)
 
 
 def _enable_static_mode():

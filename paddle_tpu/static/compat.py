@@ -1,0 +1,475 @@
+"""Static-facade compatibility surface (reference python/paddle/static).
+
+The rows here are the reference's executor/scope-era API
+(`static/__init__.py` re-exports) that the TPU design subsumes with the
+compiled Program/Executor: each entry is either a thin, REAL
+implementation over the existing machinery (save/load state, py_func
+via jax.pure_callback, Print via jax.debug.print, accuracy/auc
+compositions, places) or a documented config shim whose job XLA owns
+(BuildStrategy/ExecutionStrategy knobs, ParallelExecutor).
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import place as _place
+from ..core.enforce import EnforceNotMet
+from ..ops.registry import register_op
+from .program import (Executor, Program, Var, default_main_program,
+                      program_guard)
+
+__all__ = [
+    "global_scope", "scope_guard", "Scope", "BuildStrategy",
+    "ExecutionStrategy", "CompiledProgram", "ParallelExecutor", "Print",
+    "py_func", "name_scope", "WeightNormParamAttr", "save", "load",
+    "save_vars", "load_vars", "load_program_state", "set_program_state",
+    "cpu_places", "cuda_places", "xpu_places", "Variable", "accuracy",
+    "auc",
+]
+
+Variable = Var  # reference fluid.framework.Variable alias
+
+
+# ---------------------------------------------------------------- places
+
+def cpu_places(device_count=None):
+    """static.cpu_places parity: CPUPlace list (device_count or 1)."""
+    n = device_count or 1
+    return [_place.CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Reference cuda_places → the accelerator places of this host (on
+    TPU every CUDAPlace request maps to the chip backend)."""
+    ids = device_ids if device_ids is not None else range(
+        max(1, len([d for d in jax.devices()
+                    if d.platform != "cpu"]) or 1))
+    return [_place.CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    ids = device_ids if device_ids is not None else [0]
+    return [_place.XPUPlace(i) for i in ids]
+
+
+# ----------------------------------------------------------------- scope
+
+class Scope:
+    """Name → persistable view (reference Scope:52). The TPU design has
+    no scope hierarchy — programs are pure functions over explicit
+    environments (DESIGN.md) — so a Scope resolves names across the
+    Programs registered with it (every Program an Executor runs is
+    attached to the global scope). find_var covers persistables
+    (parameters/buffers), the dominant reference use (checkpoint IO)."""
+
+    class _VarView:
+        def __init__(self, tensor):
+            self._t = tensor
+
+        def get_tensor(self):
+            return np.asarray(self._t._data)
+
+        def set(self, value, place=None):
+            self._t._data = jnp.asarray(np.asarray(value))
+
+    def __init__(self):
+        import weakref
+        # weak refs: attaching a Program to the scope must not extend
+        # its lifetime (a long-lived process building per-eval programs
+        # would otherwise leak every parameter array forever)
+        self._programs = weakref.WeakValueDictionary()
+        self._order = 0
+
+    def _attach(self, program: Program):
+        for p in self._programs.values():
+            if p is program:
+                return
+        self._programs[self._order] = program
+        self._order += 1
+
+    def find_var(self, name: str):
+        for k in sorted(self._programs.keys(), reverse=True):
+            prog = self._programs.get(k)
+            if prog is None:
+                continue
+            for vid, t in prog.params.items():
+                if prog.vars[vid].name == name or t.name == name:
+                    return Scope._VarView(t)
+        return None
+
+    def var(self, name: str):
+        return self.find_var(name)
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+_orig_exe_run = Executor.run
+
+
+def _run_and_register(self, program=None, *args, **kwargs):
+    prog = program if program is not None else default_main_program()
+    if isinstance(prog, CompiledProgram):
+        prog = prog._program
+        program = prog
+    if isinstance(prog, Program):
+        global_scope()._attach(prog)
+    return _orig_exe_run(self, program, *args, **kwargs)
+
+
+Executor.run = _run_and_register
+
+
+# ------------------------------------------------- executor-config shims
+
+class BuildStrategy:
+    """Reference BuildStrategy (details/build_strategy.h): every field is
+    a graph-pass toggle (fusion, memory reuse, reduce strategy). Under
+    XLA those passes are the compiler; the knobs are accepted and
+    recorded so strategy-driven code runs unchanged, and have no effect
+    by design."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_all_reduce_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.build_cse = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    """Reference ExecutionStrategy: thread counts / drop-scope cadence
+    for the SSA executors. One jitted program has no op threads or
+    local scopes; accepted for parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """Reference CompiledProgram/with_data_parallel: multi-device SSA
+    graphs. Here compilation IS Executor.run's jit cache, and data
+    parallelism is a ShardingPlan over a mesh — this wrapper carries the
+    strategy objects and unwraps at run."""
+
+    def __init__(self, program, build_strategy: Optional[BuildStrategy]
+                 = None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._places = None
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._places = places
+        return self
+
+
+class ParallelExecutor:
+    """Legacy ParallelExecutor facade (reference
+    framework/parallel_executor.cc): delegates to the Executor; the
+    multi-device SSA replication it existed for is the SPMD
+    partitioner's job (DESIGN.md Parallelism)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+        self._loss_name = loss_name
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        prog = self._program
+        resolved = []
+        for f in fetch_list:
+            if isinstance(f, str):
+                resolved.append(prog.vars[prog.var_names[f]])
+            else:
+                resolved.append(f)
+        return self._exe.run(prog, feed=feed, fetch_list=resolved,
+                             return_numpy=return_numpy)
+
+
+# --------------------------------------------------------- runtime ops
+
+@register_op("print_op")
+def _print_impl(x, message="", first_n=-1, summarize=20):
+    """Print op (reference controlflow/print_op): identity that prints
+    the tensor at RUN time via jax.debug.print (works inside jit)."""
+    jax.debug.print(message + " {}", x)
+    return x
+
+
+def Print(input, first_n=-1, message="", summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    return _print_impl(input, message=message or "print:",
+                       first_n=first_n, summarize=summarize)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static.py_func: run arbitrary python inside the graph.
+
+    TPU-native form: jax.pure_callback — the callback executes host-side
+    at run time, inside jit. `out` declares result shape/dtype (a Var
+    template from create_var, a (shape, dtype) tuple, or a list of
+    either). backward_func, when given, defines the VJP the same way.
+    """
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def _is_pair(o):
+        # a single-output declaration: (shape, dtype)
+        if not (isinstance(o, tuple) and len(o) == 2):
+            return False
+        try:
+            np.dtype(o[1])
+            return True
+        except TypeError:
+            return False
+
+    # multi-output: a list, or a tuple of Vars/pairs — a bare
+    # (shape, dtype) pair declares ONE output
+    multi_out = isinstance(out, list) or (
+        isinstance(out, tuple) and not _is_pair(out)
+        and len(out) > 0 and isinstance(out[0], (Var, tuple, list)))
+    outs = list(out) if multi_out else [out]
+
+    def spec_of(o):
+        if isinstance(o, Var):
+            return jax.ShapeDtypeStruct(tuple(o._data.shape),
+                                        o._data.dtype)
+        shape, dtype = o
+        return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+    specs = [spec_of(o) for o in outs]
+    single = not multi_out
+
+    def call(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r, s.dtype).reshape(s.shape)
+                for r, s in zip(res, specs)]
+
+    name = getattr(func, "__name__", "py_func")
+
+    @register_op_once(f"py_func_{name}_{id(func)}")
+    def impl(*arrays):
+        res = jax.pure_callback(
+            call, specs if not single else specs[:1], *arrays,
+            vmap_method="sequential")
+        return res[0] if single else tuple(res)
+
+    if backward_func is not None:
+        fwd_plain = impl.__pure_fn__
+
+        @jax.custom_vjp
+        def with_grad(*arrays):
+            return fwd_plain(*arrays)
+
+        def fwd(*arrays):
+            return fwd_plain(*arrays), arrays
+
+        def bwd(res_args, g):
+            gs = g if isinstance(g, (list, tuple)) else [g]
+            in_specs = [jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+                        for a in res_args]
+
+            def bcall(*vals):
+                n = len(res_args)
+                r = backward_func(*[np.asarray(v) for v in vals])
+                r = r if isinstance(r, (list, tuple)) else [r]
+                return [np.asarray(v, s.dtype).reshape(s.shape)
+                        for v, s in zip(r, in_specs)]
+
+            outs_b = jax.pure_callback(bcall, in_specs,
+                                       *(list(res_args) + list(gs)),
+                                       vmap_method="sequential")
+            return tuple(outs_b)
+
+        with_grad.defvjp(fwd, bwd)
+        from ..ops.registry import op_wrapper
+        return op_wrapper(with_grad, name=f"py_func_{name}")(*xs)
+    return impl(*xs)
+
+
+_op_once_registry: Dict[str, object] = {}
+
+
+def register_op_once(name):
+    """register_op that tolerates re-registration (py_func is typically
+    rebuilt per call site)."""
+    def deco(fn):
+        from ..ops import registry as _r
+        if name in _r.OPS:
+            del _r.OPS[name]
+        return register_op(name)(fn)
+    return deco
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    """Reference static.name_scope: prefixes generated op/var names (a
+    debugging aid). Var names here come from the unique-name generator;
+    the prefix is pushed onto it for the scope's duration."""
+    from ..utils import unique_name as _un
+    if hasattr(_un, "guard_prefix"):
+        with _un.guard_prefix(prefix):
+            yield
+    else:  # no generator hook: parity no-op
+        yield
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr (fluid/param_attr.py): requests the
+    weight-norm reparameterization for a parameter. The eager-world
+    equivalent here is nn.utils.weight_norm(layer, dim=dim) applied
+    after construction; this class carries the config so reference code
+    parses, and Layers that accept a ParamAttr treat it as a plain
+    attr. dim/name/initializer are preserved."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+# ---------------------------------------------------- state save / load
+
+def load_program_state(path_prefix: str):
+    """name -> ndarray dict from static.save output."""
+    path = path_prefix + ".pdparams" if not path_prefix.endswith(
+        ".pdparams") else path_prefix
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program: Program, state: Dict[str, np.ndarray]):
+    for vid, t in program.params.items():
+        nm = program.vars[vid].name or t.name
+        if nm in state:
+            t._data = jnp.asarray(np.asarray(state[nm]))
+
+
+def save(program: Program, path_prefix: str):
+    """static.save parity: persistables -> <prefix>.pdparams (pickled
+    name->ndarray dict)."""
+    state = {}
+    for vid, t in program.params.items():
+        nm = program.vars[vid].name or t.name or f"param_{vid}"
+        state[nm] = np.asarray(t._data)
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+def load(program: Program, path_prefix: str, executor=None,
+         var_list=None):
+    set_program_state(program, load_program_state(path_prefix))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    prog = main_program or default_main_program()
+    import os
+    os.makedirs(dirname, exist_ok=True)
+    save(prog, os.path.join(dirname, filename or "params"))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import os
+    prog = main_program or default_main_program()
+    load(prog, os.path.join(dirname, filename or "params"))
+
+
+# ------------------------------------------------------- static metrics
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Reference metrics/accuracy_op: top-k accuracy of `input`
+    (probabilities/logits [N, C]) against integer `label` [N] or
+    [N, 1]. Returns a scalar Var in-program."""
+    from ..ops import registry as _r
+
+    @register_op_once("accuracy_static")
+    def _acc(x, lbl, k=1):
+        lb = lbl.reshape(lbl.shape[0])
+        topk = jax.lax.top_k(x, k)[1]
+        hit = (topk == lb[:, None].astype(topk.dtype)).any(axis=1)
+        return hit.astype(jnp.float32).mean()
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Reference metrics/auc_op, stateless batch form: histogram the
+    positive-class scores into num_thresholds buckets and run the
+    trapezoidal sweep (the fleet metric helper applies the same formula
+    across workers)."""
+
+    @register_op_once("auc_static")
+    def _auc(x, lbl, num_thresholds=4095):
+        scores = x[:, 1] if x.ndim == 2 and x.shape[1] >= 2 else \
+            x.reshape(-1)
+        lb = lbl.reshape(-1).astype(jnp.float32)
+        idx = jnp.clip((scores * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+        pos = jnp.zeros(num_thresholds + 1).at[idx].add(lb)
+        neg = jnp.zeros(num_thresholds + 1).at[idx].add(1.0 - lb)
+        tot_pos = jnp.cumsum(pos[::-1])
+        tot_neg = jnp.cumsum(neg[::-1])
+        new_neg = tot_neg
+        prev_neg = jnp.concatenate([jnp.zeros(1), tot_neg[:-1]])
+        prev_pos = jnp.concatenate([jnp.zeros(1), tot_pos[:-1]])
+        area = jnp.sum((new_neg - prev_neg) * (prev_pos + tot_pos) / 2.0)
+        denom = jnp.maximum(tot_pos[-1] * tot_neg[-1], 1e-12)
+        return area / denom
+
+    return _auc(input, label, num_thresholds=num_thresholds)

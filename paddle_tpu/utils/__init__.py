@@ -2,5 +2,12 @@
 from . import cpp_extension  # noqa: F401
 from . import unique_name  # noqa: F401
 from ..core.flags import set_flags, get_flags  # noqa: F401
+from .misc import (deprecated, run_check, require_version,  # noqa: F401
+                   dump_config, load_op_library,
+                   get_weights_path_from_url)
+from . import misc as download  # noqa: F401 — download.* helpers live
+# in misc (get_weights_path_from_url); the reference exposes a module
 
-__all__ = ["cpp_extension", "unique_name", "set_flags", "get_flags"]
+__all__ = ["cpp_extension", "unique_name", "set_flags", "get_flags",
+           "deprecated", "run_check", "require_version", "dump_config",
+           "load_op_library", "download"]

@@ -1,0 +1,118 @@
+"""Small paddle.utils helpers (reference python/paddle/utils/__init__.py
+rows: deprecated, run_check, require_version, dump_config,
+load_op_library, download)."""
+from __future__ import annotations
+
+import functools
+import os
+import re
+import warnings
+
+__all__ = ["deprecated", "run_check", "require_version", "dump_config",
+           "load_op_library", "get_weights_path_from_url"]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """Reference utils.deprecated: decorator that warns on use."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Reference paddle.utils.run_check: prove the install can compute.
+
+    Runs a jitted matmul on the default backend and, when several
+    devices are visible, a psum over a 1-D mesh — printing what the
+    reference prints ("PaddlePaddle is installed successfully!"-style)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    a = jnp.ones((128, 128), jnp.float32)
+    out = jax.jit(lambda x: (x @ x).sum())(a)
+    assert float(out) == 128.0 * 128.0 * 128.0
+    print(f"paddle_tpu works on 1 device ({devs[0].platform}).")
+    if len(devs) > 1:
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(devs), ("d",))
+        x = jnp.arange(len(devs), dtype=jnp.float32).reshape(-1, 1)
+        tot = jax.shard_map(
+            lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+            in_specs=P("d"), out_specs=P("d"))(x)
+        assert float(np.asarray(tot)[0, 0]) == sum(range(len(devs)))
+        print(f"paddle_tpu works across {len(devs)} devices "
+              f"(psum verified).")
+    print("paddle_tpu is installed successfully!")
+
+
+def require_version(min_version: str, max_version: str = None):
+    """Reference utils.require_version: assert the installed framework
+    version is within [min_version, max_version]."""
+    from .. import __version__ as ver
+
+    def parse(v):
+        return [int(x) for x in re.findall(r"\d+", v)[:4]] or [0]
+
+    cur = parse(ver)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {ver} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {ver} > allowed {max_version}")
+    return True
+
+
+def dump_config(path=None):
+    """Reference utils dump of the runtime config: the typed flag
+    registry + env bridge (core/flags.py) as a dict (optionally written
+    to `path`)."""
+    from ..core import flags as _flags
+    snap = dict(sorted(_flags.get_flags().items()))
+    if path:
+        import json
+        with open(path, "w") as f:
+            json.dump({k: repr(v) for k, v in snap.items()}, f,
+                      indent=2, sort_keys=True)
+    return snap
+
+
+def load_op_library(lib_path: str):
+    """Reference utils.load_op_library (dlopen a custom-op .so): custom
+    ops here are built/loaded through utils.cpp_extension.load; a
+    prebuilt shared library is attached via ctypes and its registration
+    entry point (pd_register_ops) invoked when present."""
+    import ctypes
+    lib = ctypes.CDLL(os.path.abspath(lib_path))
+    if hasattr(lib, "pd_register_ops"):
+        lib.pd_register_ops()
+    return lib
+
+
+def get_weights_path_from_url(url: str, md5sum=None):
+    """Reference utils.download.get_weights_path_from_url. This image
+    has no network egress: the file must already exist in the cache dir
+    (~/.cache/paddle_tpu/weights or PD_WEIGHTS_HOME); otherwise a clear
+    error explains how to place it."""
+    cache = os.environ.get(
+        "PD_WEIGHTS_HOME",
+        os.path.expanduser("~/.cache/paddle_tpu/weights"))
+    fname = os.path.join(cache, url.split("/")[-1])
+    if os.path.exists(fname):
+        return fname
+    raise RuntimeError(
+        f"no network egress: place the file for {url} at {fname} "
+        "(or set PD_WEIGHTS_HOME)")

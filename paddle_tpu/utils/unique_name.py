@@ -5,7 +5,7 @@ from __future__ import annotations
 import contextlib
 from collections import defaultdict
 
-__all__ = ["generate", "switch", "guard"]
+__all__ = ["generate", "switch", "guard", "guard_prefix"]
 
 
 class _Generator:
@@ -22,7 +22,10 @@ _generator = _Generator()
 
 
 def generate(key: str) -> str:
-    return _generator(key)
+    name = _generator(key)
+    if _prefix_stack:  # static.name_scope prefixes
+        return "/".join(_prefix_stack) + "/" + name
+    return name
 
 
 def switch(new_generator=None):
@@ -40,3 +43,17 @@ def guard(new_generator=None):
     finally:
         global _generator
         _generator = old
+
+
+_prefix_stack: list = []
+
+
+@contextlib.contextmanager
+def guard_prefix(prefix: str):
+    """static.name_scope support: names generated inside get
+    '<prefix>/' prepended (nestable)."""
+    _prefix_stack.append(prefix)
+    try:
+        yield
+    finally:
+        _prefix_stack.pop()

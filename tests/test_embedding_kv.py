@@ -194,3 +194,48 @@ class TestSparseEmbeddingTraining:
         assert block.shape[0] == 2      # unique rows only cross the host
         np.testing.assert_allclose(uniq, [5, 9])
         np.testing.assert_allclose(_np(out)[0], _np(out)[1])
+
+
+class TestEntryPolicies:
+    """CountFilterEntry / ProbabilityEntry (reference sparse-table
+    accessor configs): admission gating on the host KV."""
+
+    def test_count_filter_admits_after_n(self):
+        from paddle_tpu.distributed import CountFilterEntry
+        from paddle_tpu.distributed.embedding_kv import EmbeddingKV
+        kv = EmbeddingKV(dim=4, lr=0.5, init_range=0.0,
+                         entry=CountFilterEntry(count_filter=3))
+        ids = np.asarray([7], np.int64)
+        # first two sights: zeros served, no row created, push ignored
+        for _ in range(2):
+            np.testing.assert_allclose(kv.pull(ids), 0.0)
+            kv.push(ids, np.ones((1, 4), np.float32))
+        assert len(kv) == 0
+        # third sight admits; row now learns
+        r3 = kv.pull(ids)
+        np.testing.assert_allclose(r3, 0.0)  # init_range=0 -> zero init
+        assert len(kv) == 1
+        kv.push(ids, np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(kv.pull(ids)[0], -0.5)
+
+    def test_probability_entry_deterministic(self):
+        from paddle_tpu.distributed import ProbabilityEntry
+        e = ProbabilityEntry(probability=0.5)
+        picks = [e.admits(k, 1) for k in range(2000)]
+        assert picks == [e.admits(k, 1) for k in range(2000)]
+        frac = sum(picks) / len(picks)
+        assert 0.4 < frac < 0.6
+        from paddle_tpu.distributed.embedding_kv import EmbeddingKV
+        kv = EmbeddingKV(dim=2, entry=ProbabilityEntry(0.5))
+        ids = np.arange(100, dtype=np.int64)
+        kv.pull(ids)
+        assert 20 < len(kv) < 80  # only admitted keys materialized
+
+    def test_rejects_bad_config(self):
+        from paddle_tpu.distributed import (CountFilterEntry,
+                                            ProbabilityEntry)
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            CountFilterEntry(0)
+        with _pytest.raises(ValueError):
+            ProbabilityEntry(0.0)

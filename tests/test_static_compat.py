@@ -1,0 +1,249 @@
+"""Static/jit/io compatibility surface (reference python/paddle/static
+__all__, jit __all__, io.get_worker_info) — every row either a real thin
+implementation or a documented config shim, each exercised here."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+from paddle_tpu.static import (CompiledProgram, Executor,
+                               ParallelExecutor, Program, Scope,
+                               accuracy, auc, global_scope, name_scope,
+                               program_guard, py_func, scope_guard)
+
+
+class TestScope:
+    def test_find_var_after_run(self, tmp_path):
+        paddle.seed(0)
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", [4, 3])
+            lin = nn.Linear(3, 2)
+            y = lin(x)
+        exe = Executor()
+        exe.run(main, feed={"x": np.ones((4, 3), np.float32)},
+                fetch_list=[y])
+        v = global_scope().find_var(lin.weight.name)
+        assert v is not None
+        np.testing.assert_allclose(v.get_tensor(),
+                                   np.asarray(lin.weight._data))
+        # set() writes back into the live parameter
+        v.set(np.zeros((3, 2), np.float32))
+        np.testing.assert_allclose(np.asarray(lin.weight._data), 0.0)
+
+    def test_scope_guard_isolates(self):
+        s = Scope()
+        with scope_guard(s):
+            assert global_scope() is s
+        assert global_scope() is not s
+
+
+class TestStateIO:
+    def test_save_load_program_state(self, tmp_path):
+        paddle.seed(1)
+        main = Program()
+        with program_guard(main, Program()):
+            x = static.data("x", [2, 3])
+            lin = nn.Linear(3, 2)
+            lin(x)
+        prefix = str(tmp_path / "ckpt")
+        static.save(main, prefix)
+        before = np.asarray(lin.weight._data).copy()
+        lin.weight._data = lin.weight._data * 0.0
+        static.load(main, prefix)
+        np.testing.assert_allclose(np.asarray(lin.weight._data), before)
+        # explicit state dict forms
+        state = static.load_program_state(prefix)
+        assert lin.weight.name in state or any(
+            k.endswith("weight") or "param" in k for k in state)
+        static.set_program_state(main, state)
+
+
+class TestExecutorsAndConfigs:
+    def test_compiled_program_runs(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = static.data("x", [2, 2])
+            y = x * 2.0
+        cp = CompiledProgram(main,
+                             build_strategy=static.BuildStrategy())
+        cp = cp.with_data_parallel(
+            loss_name=None, exec_strategy=static.ExecutionStrategy())
+        out = Executor().run(cp, feed={"x": np.ones((2, 2), np.float32)},
+                             fetch_list=[y])[0]
+        np.testing.assert_allclose(out, 2.0)
+
+    def test_parallel_executor_facade(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = static.data("x", [2, 2])
+            y = (x + 1.0).sum()
+        pe = ParallelExecutor(use_cuda=False, main_program=main)
+        out = pe.run([y], feed={"x": np.zeros((2, 2), np.float32)})[0]
+        np.testing.assert_allclose(out, 4.0)
+
+    def test_places(self):
+        assert len(static.cpu_places(3)) == 3
+        assert static.cuda_places([0])[0] is not None
+        assert static.xpu_places() is not None
+        assert static.Variable is not None
+
+
+class TestPyFuncAndPrint:
+    def test_py_func_forward(self):
+        main = Program()
+        with program_guard(main, Program()):
+            x = static.data("x", [3], "float32")
+            out = py_func(lambda a: a * 3.0 + 1.0, x,
+                          ((3,), "float32"))
+        got = Executor().run(
+            main, feed={"x": np.arange(3, dtype=np.float32)},
+            fetch_list=[out])[0]
+        np.testing.assert_allclose(got, [1.0, 4.0, 7.0])
+
+    def test_py_func_backward_eager(self):
+        x = paddle.to_tensor(np.arange(3, dtype=np.float32),
+                             stop_gradient=False)
+        out = py_func(lambda a: a ** 2, x, ((3,), "float32"),
+                      backward_func=lambda a, g: 2.0 * a * g)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   [0.0, 2.0, 4.0])
+
+    def test_py_func_multi_output(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        a, b = py_func(lambda v: (v + 1.0, v * 2.0), x,
+                       [((4,), "float32"), ((4,), "float32")])
+        np.testing.assert_allclose(np.asarray(a._data),
+                                   [1.0, 2.0, 3.0, 4.0])
+        np.testing.assert_allclose(np.asarray(b._data),
+                                   [0.0, 2.0, 4.0, 6.0])
+
+    def test_print_identity(self, capfd):
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        y = static.Print(x, message="dbg")
+        np.testing.assert_allclose(np.asarray(y._data), 1.0)
+
+
+class TestStaticMetrics:
+    def test_accuracy(self):
+        probs = paddle.to_tensor(np.asarray(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+        lbl = paddle.to_tensor(np.asarray([[1], [0], [0]], np.int32))
+        acc = accuracy(probs, lbl, k=1)
+        np.testing.assert_allclose(float(acc.item()), 2.0 / 3.0,
+                                   rtol=1e-6)
+
+    def test_auc_separable(self):
+        scores = np.concatenate([np.random.RandomState(0).rand(50) * .4,
+                                 .6 + np.random.RandomState(1).rand(50)
+                                 * .4])
+        probs = np.stack([1 - scores, scores], 1).astype(np.float32)
+        lbl = np.concatenate([np.zeros(50), np.ones(50)]).astype(
+            np.int32)[:, None]
+        a = auc(paddle.to_tensor(probs), paddle.to_tensor(lbl))
+        assert float(a.item()) > 0.99
+
+
+class TestNameScope:
+    def test_prefix_applied(self):
+        from paddle_tpu.utils import unique_name
+        with name_scope("blockA"):
+            n = unique_name.generate("fc")
+        assert n.startswith("blockA/")
+        assert not unique_name.generate("fc").startswith("blockA/")
+
+
+class TestJitCompat:
+    def test_program_translator_toggle(self):
+        import paddle_tpu.jit as jit
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)
+            return x + 1.0
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        jit.ProgramTranslator().enable(False)
+        try:
+            out = f(x)
+            np.testing.assert_allclose(np.asarray(out._data), 2.0)
+        finally:
+            jit.ProgramTranslator().enable(True)
+        out2 = f(x)
+        np.testing.assert_allclose(np.asarray(out2._data), 2.0)
+        jit.set_verbosity(1)
+        jit.set_code_level(1)
+
+    def test_traced_layer_roundtrip(self, tmp_path):
+        import paddle_tpu.jit as jit
+        paddle.seed(2)
+        layer = nn.Sequential(nn.Linear(4, 3), nn.ReLU())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        out, traced = jit.TracedLayer.trace(layer, [x])
+        again = traced(x)
+        np.testing.assert_allclose(np.asarray(again._data),
+                                   np.asarray(out._data), rtol=1e-6)
+        prefix = str(tmp_path / "traced")
+        traced.save_inference_model(prefix)
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config(prefix))
+        pred.get_input_handle(pred.get_input_names()[0]).copy_from_cpu(
+            np.asarray(x._data))
+        pred.run()
+        got = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, np.asarray(out._data),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestWorkerInfo:
+    def test_thread_workers_see_info(self):
+        from paddle_tpu.io import DataLoader, get_worker_info
+        data = [np.float32(i) for i in range(16)]
+        seen = []
+
+        def collate(batch):
+            info = get_worker_info()
+            seen.append(None if info is None
+                        else (info.id, info.num_workers))
+            return np.asarray(batch)
+
+        dl = DataLoader(data, batch_size=4, num_workers=2,
+                        collate_fn=collate)
+        n = sum(1 for _ in dl)
+        assert n == 4
+        assert all(s is not None for s in seen)
+        assert {s[1] for s in seen} == {2}
+        assert get_worker_info() is None  # main thread
+
+
+class TestUtilsMisc:
+    def test_run_check_and_version(self, capsys):
+        import paddle_tpu.utils as U
+        U.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
+        assert U.require_version("0.0.1")
+        import pytest as _pytest
+        with _pytest.raises(Exception, match="< required"):
+            U.require_version("999.0")
+
+    def test_deprecated_and_dump(self):
+        import warnings
+        import paddle_tpu.utils as U
+
+        @U.deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_fn() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+        snap = U.dump_config()
+        assert "check_nan_inf" in snap

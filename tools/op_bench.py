@@ -102,6 +102,11 @@ _SUITE = [
     ("gelu", "128x1024", {}),
     ("reduce_sum", "256x1024", {}),
     ("transpose", "256x1024", {"perm": [1, 0]}),
+    # attention: the Pallas kernel vs the composed SDPA at BERT-base
+    # block shape [batch, seq, heads, head_dim]
+    ("flash_attention_op", "2x512x8x64,2x512x8x64,2x512x8x64", {}),
+    ("scaled_dot_product_attention",
+     "2x512x8x64,2x512x8x64,2x512x8x64", {}),
 ]
 
 
