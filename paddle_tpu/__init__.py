@@ -99,3 +99,23 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
     return 0
+
+# reference top-level re-exports: hapi callbacks namespace + platform
+# introspection shims (python/paddle/__init__.py)
+from .hapi import callbacks  # noqa: F401,E402
+
+
+def get_cudnn_version():
+    """Reference paddle.get_cudnn_version: None — no cuDNN on TPU/XLA
+    (the reference returns None when CUDA is absent too)."""
+    return None
+
+
+def monkey_patch_math_varbase():
+    """Reference internal: Tensor operator overloads. Applied at import
+    here (framework.py patches Tensor); kept as an explicit no-op."""
+
+
+def monkey_patch_variable():
+    """Reference internal: static Variable operator overloads. Applied
+    at import (static/program.py Var); kept as an explicit no-op."""

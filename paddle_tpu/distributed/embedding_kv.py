@@ -213,16 +213,25 @@ class EmbeddingKV:
         ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
         if self.entry is not None:
             count = getattr(self.entry, "needs_count", True)
-            out = np.zeros((ids.shape[0], self.dim), np.float32)
+            rows = self._py.rows
+            admitted = np.zeros(ids.shape[0], bool)
             for i, k in enumerate(ids):
                 k = int(k)
+                if k in rows:
+                    admitted[i] = True  # already materialized: no
+                    continue            # further count bookkeeping
                 if count:
                     seen = self._seen.get(k, 0) + 1
                     self._seen[k] = seen
                 else:
-                    seen = 1  # policy ignores it; keep _seen empty
-                if k in self._py.rows or self.entry.admits(k, seen):
-                    out[i] = self._py.pull(np.asarray([k], np.int64))[0]
+                    seen = 1
+                if self.entry.admits(k, seen):
+                    admitted[i] = True
+                    if count:
+                        self._seen.pop(k, None)  # row exists from now on
+            out = np.zeros((ids.shape[0], self.dim), np.float32)
+            if admitted.any():
+                out[admitted] = self._py.pull(ids[admitted])
             return out
         if self._py is not None:
             return self._py.pull(ids)

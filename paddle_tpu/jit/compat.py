@@ -3,7 +3,6 @@ TracedLayer, the ProgramTranslator singleton, and the dy2static logging
 knobs — thin, real layers over StaticFunction/functionalize."""
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
 import numpy as np
@@ -11,23 +10,27 @@ import numpy as np
 __all__ = ["TracedLayer", "ProgramTranslator", "set_code_level",
            "set_verbosity"]
 
-_state = threading.local()
+# module-level (not thread-local): conversion may happen on any thread
+_verbosity = 0
+_code_level_value = 0
 
 
 def set_verbosity(level: int = 0, also_to_stdout: bool = False):
     """Reference jit.set_verbosity: dy2static log level (0 silences)."""
-    _state.verbosity = int(level)
+    global _verbosity
+    _verbosity = int(level)
 
 
 def set_code_level(level: int = 100, also_to_stdout: bool = False):
     """Reference jit.set_code_level: at level>0 the AST-transformed
-    source of each converted function is printed once (the reference
-    logs the transformed code of the first `level` transformers)."""
-    _state.code_level = int(level)
+    source of each converted function is printed when it is converted
+    (dy2static.convert_function consults this)."""
+    global _code_level_value
+    _code_level_value = int(level)
 
 
 def _code_level() -> int:
-    return getattr(_state, "code_level", 0)
+    return _code_level_value
 
 
 class ProgramTranslator:

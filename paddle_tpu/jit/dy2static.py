@@ -866,6 +866,13 @@ def convert_function(fn: Callable) -> Callable:
         _EarlyExit().run(fdef)
         _Transformer().visit(fdef)
         ast.fix_missing_locations(tree)
+        try:  # jit.set_code_level(>0): show the transformed source
+            from .compat import _code_level
+            if _code_level() > 0:
+                print(f"[dy2static] transformed source of "
+                      f"{fn.__name__}:\n{ast.unparse(tree)}")
+        except Exception:
+            pass
         code = compile(tree, filename=f"<dy2static {fn.__name__}>",
                        mode="exec")
         glb = dict(fn.__globals__)

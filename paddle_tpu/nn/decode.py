@@ -1,0 +1,150 @@
+"""nn.BeamSearchDecoder + nn.dynamic_decode (reference
+python/paddle/nn/decode.py -> fluid/layers/rnn.py BeamSearchDecoder /
+dynamic_decode over beam_search ops).
+
+Steps run as a host loop with early exit once every beam finishes
+(the reference's while_op is the same step-driven shape); each cell
+step rides the cached jitted eager path, beam expansion is the
+beam_search_step op, finished beams freeze at zero cost, and
+gather_tree back-traces parent pointers at the end. The fully-compiled
+single-program decode (prefill + lax.scan + KV cache) lives in
+models/generation.py for transformer LMs."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import Tensor, _unwrap
+from ..ops.extras import beam_search_step, gather_tree
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode-step contract (reference Decoder): initialize() →
+    (initial_inputs, initial_states, initial_finished); step() →
+    (outputs, next_states, next_inputs, finished)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference BeamSearchDecoder).
+
+    cell: an RNNCellBase (SimpleRNNCell/GRUCell/LSTMCell) — called as
+    cell(inputs, states) -> (output, new_states).
+    embedding_fn: token ids -> cell inputs (e.g. an nn.Embedding).
+    output_fn: cell output -> vocab logits (e.g. an nn.Linear); identity
+    when the cell output already is the logits.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # beams live flattened as batch rows [B*W, ...]
+    def _merge(self, x):
+        return x.reshape((-1,) + tuple(x.shape[2:]))
+
+    def _split(self, x, b):
+        return x.reshape((b, self.beam_size) + tuple(x.shape[1:]))
+
+    def _map_state(self, states, fn):
+        return jax.tree_util.tree_map(fn, states)
+
+    def initialize(self, initial_cell_states, batch_size=None):
+        w = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda s: _unwrap(s), initial_cell_states)
+        b = batch_size or jax.tree_util.tree_leaves(states)[0].shape[0]
+        # tile each state row across beams: [B, ...] -> [B*W, ...]
+        states = self._map_state(
+            states, lambda s: jnp.repeat(s, w, axis=0))
+        tokens = jnp.full((b, w), self.start_token, jnp.int32)
+        scores = jnp.tile(jnp.asarray([0.0] + [-1e30] * (w - 1),
+                                      jnp.float32), (b, 1))
+        finished = jnp.zeros((b, w), bool)
+        return tokens, states, scores, finished
+
+    def step(self, time, tokens, states, scores, finished):
+        b, w = tokens.shape
+        flat_tok = tokens.reshape(-1)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(Tensor(flat_tok))
+            inputs = _unwrap(inputs)
+        else:
+            inputs = flat_tok
+        out, new_states = self.cell(Tensor(inputs),
+                                    self._wrap_states(states))
+        new_states = jax.tree_util.tree_map(_unwrap, new_states)
+        logits = _unwrap(self.output_fn(out)
+                         if self.output_fn is not None else out)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1).reshape(b, w, -1)
+        v = logp.shape[-1]
+        frozen = jnp.full((v,), -1e30).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+        scores, toks, parents = beam_search_step.__pure_fn__(
+            logp, scores, beam_size=w)
+        finished = jnp.take_along_axis(finished, parents, axis=1)
+        finished = finished | (toks == self.end_token)
+        gidx = (jnp.arange(b)[:, None] * w + parents).reshape(-1)
+        new_states = self._map_state(new_states,
+                                     lambda s: jnp.take(s, gidx, axis=0))
+        return toks, parents, new_states, scores, finished
+
+    def _wrap_states(self, states):
+        return jax.tree_util.tree_map(
+            lambda s: Tensor(s) if not isinstance(s, Tensor) else s,
+            states)
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 32, batch_size=None,
+                   output_time_major: bool = False, **kwargs):
+    """Run the decoder to max_step_num (reference dynamic_decode).
+
+    Returns (ids [B, T, W] int64 (or [T, B, W] when time-major),
+    final_scores [B, W]); beams come in beam_search_step order
+    (descending scores, best beam at W index 0), matching the
+    reference's outputs.
+    """
+    import inspect
+    init_kw = {}
+    if batch_size is not None and "batch_size" in             inspect.signature(decoder.initialize).parameters:
+        init_kw["batch_size"] = batch_size
+    tokens, states, scores, finished = decoder.initialize(inits,
+                                                          **init_kw)
+
+    toks_steps = []
+    parents_steps = []
+    for t in range(int(max_step_num)):
+        toks, parents, states, scores, finished = decoder.step(
+            t, tokens, states, scores, finished)
+        tokens = toks
+        toks_steps.append(toks)
+        parents_steps.append(parents)
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(toks_steps)          # [T, B, W]
+    parents = jnp.stack(parents_steps)
+    seqs = gather_tree.__pure_fn__(ids, parents)
+    if not output_time_major:
+        seqs = jnp.moveaxis(seqs, 0, 1)  # [B, T, W]
+    return Tensor(seqs.astype(jnp.int64)), Tensor(scores)

@@ -16,3 +16,6 @@ from ...ops.math import tanh, abs, square, sqrt  # noqa: F401
 # vision sampling + unpool live with the op batch (ops/extras.py)
 from ...ops.extras import (affine_grid, grid_sample,  # noqa: F401
                            max_unpool2d)
+
+from . import extension  # noqa: F401,E402
+from .extension import diag_embed, gather_tree  # noqa: F401,E402

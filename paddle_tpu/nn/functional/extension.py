@@ -1,0 +1,6 @@
+"""nn.functional.extension (reference
+python/paddle/nn/functional/extension.py: diag_embed and friends)."""
+from ...ops.extras import diag_embed, gather_tree  # noqa: F401
+from ...ops.sequence import sequence_mask  # noqa: F401
+
+__all__ = ["diag_embed", "gather_tree", "sequence_mask"]

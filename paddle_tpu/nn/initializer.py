@@ -171,3 +171,19 @@ class Dirac(Initializer):
     def __call__(self, shape, dtype):
         return jax.nn.initializers.delta_orthogonal()(
             next_key(), tuple(shape), dtype)
+
+
+# reference nn/initializer is a package of per-initializer modules
+# (assign/constant/kaiming/normal/uniform/xavier); expose matching
+# namespaces over the classes above for import parity
+from types import SimpleNamespace as _NS  # noqa: E402
+
+assign = _NS(Assign=Assign, NumpyArrayInitializer=Assign)
+constant = _NS(Constant=Constant, ConstantInitializer=Constant)
+kaiming = _NS(KaimingNormal=KaimingNormal, KaimingUniform=KaimingUniform,
+              MSRAInitializer=KaimingNormal)
+normal = _NS(Normal=Normal, TruncatedNormal=TruncatedNormal,
+             NormalInitializer=Normal)
+uniform = _NS(Uniform=Uniform, UniformInitializer=Uniform)
+xavier = _NS(XavierNormal=XavierNormal, XavierUniform=XavierUniform,
+             XavierInitializer=XavierNormal)

@@ -1,0 +1,5 @@
+"""nn.weight_norm_hook (reference python/paddle/nn/weight_norm_hook.py):
+the weight-norm reparameterization hooks live in nn/utils.py here."""
+from .utils import weight_norm, remove_weight_norm  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm"]

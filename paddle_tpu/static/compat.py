@@ -234,20 +234,16 @@ class ParallelExecutor:
 
 # --------------------------------------------------------- runtime ops
 
-@register_op("print_op")
-def _print_impl(x, message="", first_n=-1, summarize=20):
-    """Print op (reference controlflow/print_op): identity that prints
-    the tensor at RUN time via jax.debug.print (works inside jit)."""
-    jax.debug.print(message + " {}", x)
-    return x
-
-
 def Print(input, first_n=-1, message="", summarize=20,
           print_tensor_name=True, print_tensor_type=True,
           print_tensor_shape=True, print_tensor_lod=False,
           print_phase="both"):
-    return _print_impl(input, message=message or "print:",
-                       first_n=first_n, summarize=summarize)
+    """Reference controlflow/print_op facade over the already-registered
+    'print' op (ops/misc_ops.py — brace-safe jax.debug.print with
+    first_n/summarize handling)."""
+    from ..ops.misc_ops import print_op
+    return print_op(input, message=message or "print:",
+                    first_n=first_n, summarize=summarize)
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
@@ -295,12 +291,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
     name = getattr(func, "__name__", "py_func")
 
-    @register_op_once(f"py_func_{name}_{id(func)}")
-    def impl(*arrays):
+    # op_wrapper: one-off eager/captured op, NOT added to the global
+    # registry (a per-call registration would leak one entry per
+    # py_func call site for the process lifetime)
+    from ..ops.registry import op_wrapper
+
+    def _impl(*arrays):
         res = jax.pure_callback(
             call, specs if not single else specs[:1], *arrays,
             vmap_method="sequential")
         return res[0] if single else tuple(res)
+
+    impl = op_wrapper(_impl, name=f"py_func_{name}")
 
     if backward_func is not None:
         fwd_plain = impl.__pure_fn__
@@ -335,12 +337,9 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     return impl(*xs)
 
 
-_op_once_registry: Dict[str, object] = {}
-
-
 def register_op_once(name):
-    """register_op that tolerates re-registration (py_func is typically
-    rebuilt per call site)."""
+    """register_op that tolerates re-registration (used by the static
+    metric helpers below, which register a FIXED set of names)."""
     def deco(fn):
         from ..ops import registry as _r
         if name in _r.OPS:
@@ -432,19 +431,12 @@ def load_vars(executor, dirname, main_program=None, vars=None,
 # ------------------------------------------------------- static metrics
 
 def accuracy(input, label, k=1, correct=None, total=None):
-    """Reference metrics/accuracy_op: top-k accuracy of `input`
-    (probabilities/logits [N, C]) against integer `label` [N] or
-    [N, 1]. Returns a scalar Var in-program."""
-    from ..ops import registry as _r
-
-    @register_op_once("accuracy_static")
-    def _acc(x, lbl, k=1):
-        lb = lbl.reshape(lbl.shape[0])
-        topk = jax.lax.top_k(x, k)[1]
-        hit = (topk == lb[:, None].astype(topk.dtype)).any(axis=1)
-        return hit.astype(jnp.float32).mean()
-
-    return _acc(input, label, k=k)
+    """Reference metrics/accuracy_op: top-k accuracy of `input` against
+    integer `label` [N] or [N, 1] — delegates to the existing
+    paddle_tpu.metric.accuracy functional (one implementation)."""
+    from ..metric import accuracy as _metric_accuracy
+    return _metric_accuracy(input, label, k=k, correct=correct,
+                            total=total)
 
 
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
