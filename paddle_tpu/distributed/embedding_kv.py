@@ -227,6 +227,9 @@ class EmbeddingKV:
                     seen = 1
                 if self.entry.admits(k, seen):
                     admitted[i] = True
+                    # materialize NOW so duplicates of k later in this
+                    # same batch hit the `k in rows` fast path
+                    self._py.pull(np.asarray([k], np.int64))
                     if count:
                         self._seen.pop(k, None)  # row exists from now on
             out = np.zeros((ids.shape[0], self.dim), np.float32)

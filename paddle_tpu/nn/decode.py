@@ -58,13 +58,6 @@ class BeamSearchDecoder(Decoder):
         self.embedding_fn = embedding_fn
         self.output_fn = output_fn
 
-    # beams live flattened as batch rows [B*W, ...]
-    def _merge(self, x):
-        return x.reshape((-1,) + tuple(x.shape[2:]))
-
-    def _split(self, x, b):
-        return x.reshape((b, self.beam_size) + tuple(x.shape[1:]))
-
     def _map_state(self, states, fn):
         return jax.tree_util.tree_map(fn, states)
 

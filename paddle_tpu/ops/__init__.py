@@ -42,7 +42,9 @@ __all__ = (creation.__all__ + math.__all__ + manipulation.__all__
            + detection.__all__ + sequence.__all__ + extras.__all__
            + amp_ops.__all__
            + ["einsum", "cond", "while_loop", "bounded_while_loop",
-              "case", "switch_case", "scan", "fori_loop"])
+              "case", "switch_case", "scan", "fori_loop",
+              "reshape_", "squeeze_", "unsqueeze_", "scatter_",
+              "tanh_"])
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +147,7 @@ def _patch_tensor_methods():
     for mod in (creation, math, manipulation, logic, search, linalg, stat):
         for nm in mod.__all__:
             _method_table.setdefault(nm, getattr(mod, nm))
-    skip = {"is_tensor", "create_parameter", "meshgrid", "broadcast_tensors"}
+    skip = {"create_parameter", "broadcast_tensors"}
     for nm, fn in _method_table.items():
         if nm in skip or hasattr(T, nm):
             continue
@@ -161,8 +163,18 @@ def _patch_tensor_methods():
             return self
         return inplace
     for nm in ("add", "subtract", "multiply", "divide", "clip", "scale",
-               "floor", "ceil", "exp", "sqrt", "reciprocal", "round"):
+               "floor", "ceil", "exp", "sqrt", "reciprocal", "round",
+               "tanh"):
         setattr(T, nm + "_", _make_inplace(getattr(math, nm)))
+    for nm in ("reshape", "squeeze", "unsqueeze"):
+        setattr(T, nm + "_", _make_inplace(getattr(manipulation, nm)))
+    setattr(T, "scatter_", _make_inplace(getattr(manipulation, "scatter")))
+    # method forms of ops living outside the namespace-table modules
+    from . import extras as _extras
+    if not hasattr(T, "multiplex"):
+        T.multiplex = _extras.multiplex
+    if not hasattr(T, "to_tensor"):
+        T.to_tensor = lambda self, *a, **k: self
 
     T.mm = math.matmul
     # Tensor.cond is the matrix condition number (the control-flow `cond`
@@ -174,3 +186,27 @@ def _patch_tensor_methods():
 
 
 _patch_tensor_methods()
+
+
+def _functional_inplace(fn):
+    """paddle.reshape_(x, ...)-style module-level inplace form sharing
+    the ONE tape-correct rebind implementation (_rebind_inplace):
+    leaf-with-grad writes are rejected, node out_refs are rewired."""
+    import functools as _ft
+
+    @_ft.wraps(fn)
+    def f(x, *a, **k):
+        out = fn(x, *a, **k)
+        if isinstance(x, Tensor) and isinstance(out, Tensor):
+            _rebind_inplace(x, out)
+            return x
+        return out
+    f.__name__ = fn.__name__ + "_"
+    return f
+
+
+reshape_ = _functional_inplace(manipulation.reshape)
+squeeze_ = _functional_inplace(manipulation.squeeze)
+unsqueeze_ = _functional_inplace(manipulation.unsqueeze)
+scatter_ = _functional_inplace(manipulation.scatter)
+tanh_ = _functional_inplace(math.tanh)

@@ -15,6 +15,7 @@ from ..core.generator import next_key
 from ..framework import Tensor, _unwrap, to_tensor
 
 __all__ = [
+    "set_printoptions",
     "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
     "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
     "tril", "triu", "meshgrid", "assign", "clone_", "rand", "randn",
@@ -234,3 +235,23 @@ def create_parameter(shape, dtype=None, name=None, default_initializer=None):
     else:
         data = jnp.zeros(_shape(shape), _dt(dtype))
     return Parameter(data, name=name)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions (ref tensor printing config): Tensor
+    __repr__ renders through numpy, so this forwards to
+    numpy.set_printoptions (sci_mode -> suppress)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)

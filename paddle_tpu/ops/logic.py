@@ -12,6 +12,7 @@ from ..framework import Tensor, _unwrap
 from .registry import register_op
 
 __all__ = [
+    "all", "any",
     "equal", "not_equal", "greater_than", "greater_equal", "less_than",
     "less_equal", "equal_all", "allclose", "isclose", "logical_and",
     "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
@@ -82,3 +83,15 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+@register_op("reduce_all")
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    """paddle.all (ref reduce_all_op)."""
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("reduce_any")
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    """paddle.any (ref reduce_any_op)."""
+    return jnp.any(x, axis=axis, keepdims=keepdim)

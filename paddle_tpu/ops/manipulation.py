@@ -17,6 +17,7 @@ from ..framework import Tensor, _unwrap
 from .registry import register_op
 
 __all__ = [
+    "broadcast_shape", "rank", "shape",
     "reshape", "transpose", "concat", "split", "chunk", "stack", "unstack",
     "squeeze", "unsqueeze", "flatten", "gather", "gather_nd", "scatter",
     "scatter_nd", "scatter_nd_add", "slice", "strided_slice", "expand",
@@ -460,3 +461,23 @@ def tolist(x):
 def rot90_(x, k, axes):
     from .math import rot90
     return rot90(x, k, axes)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """paddle.broadcast_shape: the numpy-broadcast result shape."""
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@register_op("shape_op")
+def shape(input, name=None):
+    """paddle.shape as a Tensor (ref shape_op: runtime shape). Static
+    under XLA, so this is the traced constant shape."""
+    return jnp.asarray(input.shape, jnp.int32)
+
+
+def rank(input, name=None):
+    """paddle.rank: ndim as a 0-D Tensor (ref rank_op)."""
+    from ..framework import Tensor
+    arr = input._data if isinstance(input, Tensor) else input
+    return Tensor(jnp.asarray(jnp.ndim(arr), jnp.int32))

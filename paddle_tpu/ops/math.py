@@ -15,6 +15,7 @@ from ..framework import Tensor, _unwrap
 from .registry import register_op
 
 __all__ = [
+    "floor_mod", "mm",
     "add", "subtract", "multiply", "divide", "floor_divide", "mod",
     "remainder", "pow", "float_power", "matmul", "abs", "sqrt", "rsqrt",
     "exp", "expm1", "log", "log2", "log10", "log1p", "sin", "cos", "tan",
@@ -425,3 +426,8 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 @register_op("rot90")
 def rot90(x, k=1, axes=(0, 1), name=None):
     return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+# reference aliases (python/paddle/__init__.py DEFINE_ALIAS rows)
+floor_mod = mod
+mm = matmul

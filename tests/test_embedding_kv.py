@@ -231,6 +231,22 @@ class TestEntryPolicies:
         kv.pull(ids)
         assert 20 < len(kv) < 80  # only admitted keys materialized
 
+    def test_duplicates_cross_threshold_within_batch(self):
+        # occurrence 2 admits id 5; occurrence 3 IN THE SAME BATCH must
+        # see the materialized row (regression: deferred materialization
+        # re-counted it and served zeros)
+        from paddle_tpu.distributed import CountFilterEntry
+        from paddle_tpu.distributed.embedding_kv import EmbeddingKV
+        kv = EmbeddingKV(dim=3, lr=1.0, init_range=0.0,
+                         entry=CountFilterEntry(count_filter=2))
+        ids = np.asarray([5, 5, 5], np.int64)
+        out = kv.pull(ids)
+        assert len(kv) == 1
+        assert kv._seen == {}          # admitted keys are not re-counted
+        kv.push(np.asarray([5], np.int64), np.ones((1, 3), np.float32))
+        out2 = kv.pull(np.asarray([5], np.int64))
+        np.testing.assert_allclose(out2[0], -1.0)
+
     def test_rejects_bad_config(self):
         from paddle_tpu.distributed import (CountFilterEntry,
                                             ProbabilityEntry)

@@ -658,3 +658,35 @@ def test_embedding_kv_pull_push_receipt():
     block2, _, _ = pull_sparse(kv, ids)
     after = np.asarray(block2._data)
     np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+
+
+def test_tensor_alias_surface():
+    """paddle.<fn> aliases + Tensor-method parity rows added in the
+    namespace audit (reference python/paddle/__init__.py DEFINE_ALIAS
+    list): all/any reductions, floor_mod/mm, shape/rank/
+    broadcast_shape, inplace variants, set_printoptions."""
+    t = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    assert bool(paddle.all(paddle.to_tensor(np.asarray([True, True]))).item())
+    assert not bool(paddle.any(paddle.to_tensor(np.asarray([False]))).item())
+    np.testing.assert_allclose(
+        np.asarray(paddle.floor_mod(t, paddle.to_tensor(
+            np.full((2, 2), 3.0, np.float32)))._data),
+        np.asarray([[1.0, 2.0], [0.0, 1.0]]))
+    np.testing.assert_allclose(np.asarray(paddle.mm(t, t)._data),
+                               np.asarray(t._data) @ np.asarray(t._data))
+    assert list(np.asarray(paddle.shape(t)._data)) == [2, 2]
+    assert int(paddle.rank(t).item()) == 2
+    assert paddle.broadcast_shape([2, 1], [4]) == [2, 4]
+    x = paddle.to_tensor(np.zeros(4, np.float32))
+    paddle.reshape_(x, [2, 2])
+    assert tuple(x.shape) == (2, 2)
+    y = paddle.to_tensor(np.ones(3, np.float32))
+    y.tanh_()
+    np.testing.assert_allclose(np.asarray(y._data),
+                               np.tanh(np.ones(3)), rtol=1e-6)
+    # module-level inplace forms share the tape-correct rebind: a
+    # grad-requiring LEAF is rejected just like the method form
+    leaf = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with pytest.raises(RuntimeError, match="in-place"):
+        paddle.tanh_(leaf)
+    paddle.set_printoptions(precision=4)
