@@ -12,6 +12,7 @@ from ...ops.registry import register_op
 from ...framework import _unwrap
 
 __all__ = [
+    "relu_", "elu_", "softmax_",
     "relu", "relu6", "elu", "selu", "celu", "gelu", "sigmoid", "hardsigmoid",
     "hardswish", "hardtanh", "hardshrink", "softshrink", "tanhshrink",
     "leaky_relu", "prelu", "rrelu", "log_sigmoid", "log_softmax", "softmax",
@@ -185,8 +186,21 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None,
     return y
 
 
-def tanh_(x):
-    from ...ops.math import tanh
-    out = tanh(x)
-    x._data = out._data
-    return x
+# inplace functional variants (reference F.tanh_/relu_/elu_/softmax_):
+# one wrapper each, built once at import over the single tape-correct
+# rebind implementation (ops/__init__._functional_inplace — leaf-with-
+# grad writes rejected, node out_refs rewired)
+def _act_inplace(fn):
+    from ...ops import _functional_inplace
+    return _functional_inplace(fn)
+
+
+def _tanh_base(x):
+    from ...ops.math import tanh as _t
+    return _t(x)
+
+
+tanh_ = _act_inplace(_tanh_base)
+relu_ = _act_inplace(relu)
+elu_ = _act_inplace(elu)
+softmax_ = _act_inplace(softmax)

@@ -13,6 +13,7 @@ from ...framework import Tensor, _unwrap
 from ...ops.registry import register_op
 
 __all__ = [
+    "hsigmoid_loss",
     "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
@@ -490,3 +491,21 @@ def softmax_with_cross_entropy_label_smooth(logits, label, epsilon=0.1):
     oh = one_hot(label, _unwrap(logits).shape[-1])
     smooth = label_smooth(oh, epsilon=epsilon)
     return cross_entropy(logits, smooth, soft_label=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """paddle.nn.functional.hsigmoid_loss (reference
+    hierarchical_sigmoid_op): default complete-binary-tree form over the
+    registered hierarchical_sigmoid op; custom-tree path tables are not
+    supported (the default SimpleCode tree covers the reference's
+    non-custom path)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not "
+            "supported; use the default complete binary tree")
+    from ...ops.loss_extra import hierarchical_sigmoid
+    cost, _ = hierarchical_sigmoid(input, label, weight, bias,
+                                   num_classes=num_classes)
+    return cost

@@ -11,6 +11,7 @@ from ..param_attr import ParamAttr
 from .layers import Layer
 
 __all__ = [
+    "PairwiseDistance",
     "Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
     "Embedding", "Flatten", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
     "Upsample", "UpsamplingNearest2D", "UpsamplingBilinear2D",
@@ -271,3 +272,28 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
 
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference nn.PairwiseDistance
+    over p_norm_op on x - y)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = float(p)
+        self.epsilon = float(epsilon)
+        self.keepdim = bool(keepdim)
+
+    def forward(self, x, y):
+        from ...ops.registry import run_op
+        import jax.numpy as jnp
+        import math as _math
+
+        def impl(a, b, p=self.p, eps=self.epsilon, kd=self.keepdim):
+            # reference adds epsilon to the DIFFERENCE (perturbs the
+            # vector, not the summed powers) and supports p=inf
+            d = a - b + eps
+            if _math.isinf(p):
+                return jnp.abs(d).max(axis=-1, keepdims=kd)
+            return (jnp.abs(d) ** p).sum(
+                axis=-1, keepdims=kd) ** (1.0 / p)
+        return run_op("pairwise_distance", impl, (x, y), {})

@@ -5,6 +5,7 @@ from .. import functional as F
 from .layers import Layer
 
 __all__ = [
+    "HSigmoidLoss",
     "CrossEntropyLoss", "BCELoss", "BCEWithLogitsLoss", "NLLLoss", "MSELoss",
     "L1Loss", "SmoothL1Loss", "KLDivLoss", "MarginRankingLoss",
     "HingeEmbeddingLoss", "CosineEmbeddingLoss", "CTCLoss",
@@ -227,3 +228,31 @@ class GaussianNLLLoss(Layer):
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, self.full,
                                    self.epsilon, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn.HSigmoidLoss over
+    hierarchical_sigmoid_op): holds the [num_classes-1, feature] tree
+    weights; forward returns the per-sample path cost [B, 1]."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree HSigmoidLoss is not supported; the default "
+                "complete binary tree covers the reference's "
+                "non-custom path")
+        self.num_classes = int(num_classes)
+        self.weight = self.create_parameter(
+            (self.num_classes - 1, int(feature_size)))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter((self.num_classes - 1,),
+                                              is_bias=True)
+
+    def forward(self, input, label):
+        from .. import functional as F
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias)

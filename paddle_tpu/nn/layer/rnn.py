@@ -20,7 +20,7 @@ from ..initializer import Uniform, XavierUniform
 from ..param_attr import ParamAttr
 from .layers import Layer
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
            "SimpleRNN", "LSTM", "GRU"]
 
 

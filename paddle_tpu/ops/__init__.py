@@ -147,7 +147,8 @@ def _patch_tensor_methods():
     for mod in (creation, math, manipulation, logic, search, linalg, stat):
         for nm in mod.__all__:
             _method_table.setdefault(nm, getattr(mod, nm))
-    skip = {"create_parameter", "broadcast_tensors"}
+    skip = {"create_parameter", "broadcast_tensors",
+            "set_printoptions", "broadcast_shape"}
     for nm, fn in _method_table.items():
         if nm in skip or hasattr(T, nm):
             continue
@@ -174,7 +175,17 @@ def _patch_tensor_methods():
     if not hasattr(T, "multiplex"):
         T.multiplex = _extras.multiplex
     if not hasattr(T, "to_tensor"):
-        T.to_tensor = lambda self, *a, **k: self
+        def _to_tensor_method(self, dtype=None, stop_gradient=None,
+                              place=None, **k):
+            out = self
+            if dtype is not None:
+                out = out.astype(dtype)
+            if stop_gradient is not None and out is self:
+                out = self.clone() if not self.stop_gradient else                     Tensor(self._data)
+            if stop_gradient is not None:
+                out.stop_gradient = bool(stop_gradient)
+            return out
+        T.to_tensor = _to_tensor_method
 
     T.mm = math.matmul
     # Tensor.cond is the matrix condition number (the control-flow `cond`

@@ -296,3 +296,74 @@ def test_weight_norm():
                                + layer.bias.numpy(), rtol=1e-5)
     remove_weight_norm(layer)
     np.testing.assert_allclose(layer.weight.numpy(), w0, rtol=1e-6)
+
+
+class TestHSigmoidAndDistance:
+    """nn.HSigmoidLoss / F.hsigmoid_loss / nn.PairwiseDistance
+    (reference hierarchical_sigmoid_op + PairwiseDistance)."""
+
+    def test_hsigmoid_matches_manual_tree(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        C, D, B = 6, 5, 3
+        rng = np.random.RandomState(0)
+        x = rng.randn(B, D).astype(np.float32)
+        w = rng.randn(C - 1, D).astype(np.float32)
+        b = rng.randn(C - 1).astype(np.float32)
+        lbl = np.asarray([0, 3, 5], np.int32)
+        import paddle_tpu.nn.functional as F
+        got = np.asarray(F.hsigmoid_loss(
+            paddle.to_tensor(x), paddle.to_tensor(lbl), C,
+            paddle.to_tensor(w), paddle.to_tensor(b))._data).ravel()
+        # manual SimpleCode tree (matrix_bit_code.h): c = label + C;
+        # node at bit k is (c >> (k+1)) - 1, bit is (c >> k) & 1,
+        # path length = floor(log2(c))
+        want = []
+        for i in range(B):
+            c = int(lbl[i]) + C
+            L = int(np.floor(np.log2(c)))
+            total = 0.0
+            for k in range(L):
+                node = (c >> (k + 1)) - 1
+                bit = (c >> k) & 1
+                z = float(x[i] @ w[node] + b[node])
+                total += np.log1p(np.exp(-abs(z))) + max(z, 0) - bit * z
+            want.append(total)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_hsigmoid_layer_trains(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(1)
+        h = nn.HSigmoidLoss(4, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(6, 4).astype(np.float32))
+        lbl = paddle.to_tensor(np.arange(6, dtype=np.int32))
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=h.parameters())
+        first = last = None
+        for _ in range(30):
+            loss = h(x, lbl).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.item())
+            last = float(loss.item())
+        assert last < first * 0.7
+
+    def test_pairwise_distance(self):
+        import paddle_tpu.nn as nn
+        rng = np.random.RandomState(2)
+        a = rng.randn(4, 7).astype(np.float32)
+        b = rng.randn(4, 7).astype(np.float32)
+        for p in (1.0, 2.0, 3.0, float("inf")):
+            d = nn.PairwiseDistance(p=p, epsilon=0.0)
+            got = np.asarray(d(paddle.to_tensor(a),
+                               paddle.to_tensor(b))._data)
+            want = np.linalg.norm(a - b, ord=p, axis=-1)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # epsilon perturbs the DIFFERENCE (x==y -> eps*sqrt(n), not
+        # sqrt(n*eps)): reference semantics
+        d = nn.PairwiseDistance(p=2.0, epsilon=1e-6)
+        z = np.asarray(d(paddle.to_tensor(a),
+                         paddle.to_tensor(a))._data)
+        np.testing.assert_allclose(z, 1e-6 * np.sqrt(7), rtol=1e-3)
