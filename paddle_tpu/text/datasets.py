@@ -245,3 +245,22 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+import sys as _sys  # noqa: E402
+
+
+def _submodule(name, **attrs):
+    mod = type(_sys)(__name__ + "." + name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    _sys.modules[__name__ + "." + name] = mod
+    return mod
+
+
+conll05 = _submodule("conll05", Conll05st=Conll05st)
+imdb = _submodule("imdb", Imdb=Imdb)
+imikolov = _submodule("imikolov", Imikolov=Imikolov)
+movielens = _submodule("movielens", Movielens=Movielens)
+uci_housing = _submodule("uci_housing", UCIHousing=UCIHousing)
+wmt14 = _submodule("wmt14", WMT14=WMT14)
+wmt16 = _submodule("wmt16", WMT16=WMT16)

@@ -261,3 +261,22 @@ class VOC2012(Dataset):
 
     def __len__(self):
         return len(self.images)
+# reference exposes per-dataset submodules (from . import cifar ...);
+# register REAL modules in sys.modules so reference-style imports work
+import sys as _sys  # noqa: E402
+
+
+def _submodule(name, **attrs):
+    mod = type(_sys)(__name__ + "." + name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    _sys.modules[__name__ + "." + name] = mod
+    return mod
+
+
+cifar = _submodule("cifar", Cifar10=Cifar10, Cifar100=Cifar100)
+mnist = _submodule("mnist", MNIST=MNIST, FashionMNIST=FashionMNIST)
+flowers = _submodule("flowers", Flowers=Flowers)
+voc2012 = _submodule("voc2012", VOC2012=VOC2012)
+folder = _submodule("folder", DatasetFolder=DatasetFolder,
+                    ImageFolder=ImageFolder)

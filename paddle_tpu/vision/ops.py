@@ -1,0 +1,66 @@
+"""paddle.vision.ops (reference python/paddle/vision/ops.py:
+yolo_loss, yolo_box, deform_conv2d + the DeformConv2D layer) — thin
+namespace over the registered detection/vision ops."""
+from __future__ import annotations
+
+from ..ops.detection import yolo_box, yolov3_loss  # noqa: F401
+from ..ops.vision_extra import deformable_conv
+
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """paddle.vision.ops.yolo_loss → yolov3_loss op."""
+    return yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask,
+                       class_num, ignore_thresh, downsample_ratio,
+                       gt_score=gt_score,
+                       use_label_smooth=use_label_smooth,
+                       scale_x_y=scale_x_y)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """paddle.vision.ops.deform_conv2d (v1 when mask is None, v2
+    otherwise) → deformable_conv ops."""
+    return deformable_conv(x, offset, mask, weight, bias=bias,
+                           stride=stride, padding=padding,
+                           dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+
+
+from ..nn.layer.layers import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Layer form (reference vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + tuple(ks))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter((out_channels,),
+                                              is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias,
+            stride=self._stride, padding=self._padding,
+            dilation=self._dilation,
+            deformable_groups=self._deformable_groups,
+            groups=self._groups, mask=mask)

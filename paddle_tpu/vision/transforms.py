@@ -190,3 +190,90 @@ class Pad:
             p = (p, p, p, p)
         pad = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
         return np.pad(arr, pad, constant_values=self.fill)
+
+# reference package layout: vision.transforms.transforms (self) and
+# vision.transforms.functional (the lowercase per-image functions the
+# class transforms are built from — paddle/vision/transforms/functional.py)
+import sys as _sys  # noqa: E402
+transforms = _sys.modules[__name__]
+
+
+class _Functional:
+    """paddle.vision.transforms.functional over numpy images."""
+
+    @staticmethod
+    def to_tensor(pic, data_format="CHW"):
+        return ToTensor(data_format)(pic)
+
+    @staticmethod
+    def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+        return Normalize(mean, std, data_format)(img)
+
+    @staticmethod
+    def resize(img, size, interpolation="bilinear"):
+        return _resize_np(np.asarray(img), size)
+
+    @staticmethod
+    def crop(img, top, left, height, width):
+        return np.asarray(img)[top:top + height, left:left + width]
+
+    @staticmethod
+    def center_crop(img, output_size):
+        return CenterCrop(output_size)(img)
+
+    @staticmethod
+    def hflip(img):
+        return np.asarray(img)[:, ::-1]
+
+    @staticmethod
+    def vflip(img):
+        return np.asarray(img)[::-1]
+
+    @staticmethod
+    def pad(img, padding, fill=0, padding_mode="constant"):
+        return Pad(padding, fill)(img)
+
+    @staticmethod
+    def adjust_brightness(img, brightness_factor):
+        arr = np.asarray(img)
+        return np.clip(np.asarray(arr, np.float32) * brightness_factor,
+                       0, 255).astype(arr.dtype)
+
+    @staticmethod
+    def adjust_contrast(img, contrast_factor):
+        arr = np.asarray(img, np.float32)
+        mean = arr.mean()
+        out = (arr - mean) * contrast_factor + mean
+        return np.clip(out, 0, 255).astype(np.asarray(img).dtype)
+
+    @staticmethod
+    def to_grayscale(img, num_output_channels=1):
+        arr = np.asarray(img, np.float32)
+        gray = (arr[..., :3] @ np.asarray(
+            [0.299, 0.587, 0.114], np.float32))[..., None]
+        return np.repeat(gray, num_output_channels,
+                         axis=-1).astype(np.asarray(img).dtype)
+
+    @staticmethod
+    def rotate(img, angle, interpolation="nearest", expand=False,
+               center=None, fill=0):
+        k = int(round(angle / 90.0)) % 4
+        if abs(angle - 90.0 * round(angle / 90.0)) > 1e-6:
+            raise NotImplementedError(
+                "rotate supports multiples of 90 degrees (no PIL in "
+                "this image)")
+        return np.rot90(np.asarray(img), k=k).copy()
+
+
+functional = _Functional()
+
+# register as a REAL submodule so reference-style imports work
+# (`import paddle_tpu.vision.transforms.functional`, `from
+# paddle_tpu.vision.transforms import functional`)
+_fmod = type(_sys)("paddle_tpu.vision.transforms.functional")
+for _n in dir(_Functional):
+    if not _n.startswith("_"):
+        setattr(_fmod, _n, getattr(_Functional, _n))
+_fmod.__doc__ = _Functional.__doc__
+_sys.modules["paddle_tpu.vision.transforms.functional"] = _fmod
+functional = _fmod

@@ -190,3 +190,40 @@ def test_dataset_folder_recurses(tmp_path):
     np.save(tmp_path / "cls_b" / "0.npy", np.ones((2, 2), np.uint8))
     ds = DatasetFolder(str(tmp_path))
     assert len(ds) == 2
+
+
+class TestVisionOpsNamespace:
+    """paddle.vision.ops (reference vision/ops.py: yolo_loss/yolo_box/
+    deform_conv2d/DeformConv2D) + package-layout aliases."""
+
+    def test_deform_conv2d_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        got = deform_conv2d(x, off, w, padding=1)
+        want = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(want._data), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_namespace_aliases(self):
+        import paddle_tpu.vision as V
+        import paddle_tpu.vision.datasets as D
+        import paddle_tpu.vision.transforms as T
+        import paddle_tpu.text.datasets as TD
+        # reference-style REAL submodule imports must work
+        import paddle_tpu.vision.transforms.functional as TF
+        from paddle_tpu.vision.datasets import cifar as _cifar
+        from paddle_tpu.text.datasets import imdb as _imdb
+        assert V.ops.yolo_loss is not None and V.ops.yolo_box is not None
+        assert D.cifar.Cifar10 is D.Cifar10 is _cifar.Cifar10
+        assert T.transforms.Compose is T.Compose
+        assert callable(TF.normalize) and callable(TF.to_tensor)
+        img = (np.random.RandomState(0).rand(4, 4, 3) * 255).astype(
+            np.uint8)
+        assert TF.pad(img, 1).shape[:2] == (6, 6)
+        assert TF.hflip(img).shape == img.shape
+        assert TD.imdb.Imdb is TD.Imdb is _imdb.Imdb
