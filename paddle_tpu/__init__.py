@@ -11,7 +11,8 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from . import core
-from .core import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,
+from .core import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,
+                   XPUPlace, get_device,
                    set_device, is_compiled_with_tpu, seed, set_flags,
                    get_flags, set_default_dtype, get_default_dtype)
 from .core.dtypes import (bool_ as bool8, bfloat16, complex128, complex64,

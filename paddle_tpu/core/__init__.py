@@ -9,6 +9,6 @@ from .enforce import (EnforceNotMet, InvalidArgumentError, NotFoundError,
                       enforce_eq, wrap_op_error)
 from .flags import set_flags, get_flags, define_flag, flag_value
 from .generator import Generator, default_generator, seed, next_key
-from .place import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+from .place import (Place, CPUPlace, CUDAPinnedPlace, TPUPlace, CUDAPlace, XPUPlace,
                     set_device, get_device, current_place,
                     is_compiled_with_tpu, device_count)

@@ -56,6 +56,15 @@ class CUDAPlace(Place):  # capability alias: JAX gpu backend
     kind = "gpu"
 
 
+class CUDAPinnedPlace(Place):
+    """Pinned-host place (reference CUDAPinnedPlace): host staging
+    memory; on TPU all host arrays are staged by the runtime, so this
+    is CPU-kind for placement purposes."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
 class XPUPlace(Place):
     kind = "xpu"
 

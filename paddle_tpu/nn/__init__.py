@@ -5,6 +5,7 @@ Parity with python/paddle/nn (~90 Layer classes, SURVEY.md §2.6).
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import extension  # noqa: F401
+from . import vision  # noqa: F401
 from . import weight_norm_hook  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer import *  # noqa: F401,F403

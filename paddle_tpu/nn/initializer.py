@@ -15,6 +15,7 @@ from ..core.generator import next_key
 from ..framework import Tensor, _unwrap
 
 __all__ = [
+    "Bilinear", "set_global_initializer",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain",
@@ -187,3 +188,53 @@ normal = _NS(Normal=Normal, TruncatedNormal=TruncatedNormal,
 uniform = _NS(Uniform=Uniform, UniformInitializer=Uniform)
 xavier = _NS(XavierNormal=XavierNormal, XavierUniform=XavierUniform,
              XavierInitializer=XavierNormal)
+
+
+def _dt(dtype):
+    import jax.numpy as jnp
+    from ..core import dtypes as _dtypes
+    return _dtypes.convert_dtype(dtype) if isinstance(dtype, str) \
+        else dtype
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init (reference
+    initializer.BilinearInitializer: the classic transposed-conv
+    upsample weights)."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as _np
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a conv kernel shape "
+                             "[c_out, c_in, kh, kw]")
+        c_out, c_in, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        cw = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = _np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f_h - ch))
+                * (1 - abs(og[1] / f_w - cw))).astype(_np.float32)
+        w = _np.zeros(shape, _np.float32)
+        for i in range(min(c_out, c_in)):
+            w[i, i] = filt
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype=_dt(dtype))
+
+
+BilinearInitializer = Bilinear
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference initializer.set_global_initializer: the defaults
+    Layer.create_parameter falls back to when no ParamAttr/initializer
+    is given. Pass None to restore the built-in defaults."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_default(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init

@@ -163,6 +163,16 @@ class Layer:
             trainable = attr.trainable
         elif attr is False and is_bias:
             return None
+        # precedence (reference set_global_initializer): an explicit
+        # ParamAttr initializer wins; otherwise a global default (when
+        # set) overrides the layer's built-in default_initializer
+        attr_init = isinstance(attr, ParamAttr) and \
+            attr.initializer is not None
+        if not attr_init:
+            from .. import initializer as _init_mod
+            g = _init_mod._global_default(is_bias)
+            if g is not None:
+                init = g
         if init is None:
             from ..initializer import Constant
             init = Constant(0.0) if is_bias else XavierNormal()

@@ -306,3 +306,53 @@ class TestBeamSearchDecoder:
             done = done | (nxt == 12)
             cur = paddle.to_tensor(nxt.astype(np.int32))
         np.testing.assert_array_equal(got, np.stack(want, 1))
+
+
+def test_api_audit_clean():
+    """The maintained audit tool (tools/api_audit.py) must report ZERO
+    missing reference names — the machine-checkable form of the
+    'complete public API surface' claim."""
+    import subprocess, sys as _sys, os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ref = os.environ.get("PD_REFERENCE",
+                         "/root/reference/python/paddle")
+    if not os.path.isdir(ref):
+        pytest.skip("reference tree not mounted")
+    res = subprocess.run(
+        [_sys.executable, os.path.join(root, "tools", "api_audit.py"),
+         "--fail"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "TOTAL missing: 0" in res.stdout
+
+
+class TestInitializerGlobals:
+    def test_set_global_initializer_precedence(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.initializer import (Bilinear, Constant,
+                                               set_global_initializer)
+        from paddle_tpu.nn.param_attr import ParamAttr
+        set_global_initializer(Constant(2.0), Constant(3.0))
+        try:
+            lin = nn.Linear(3, 2)
+            assert np.all(np.asarray(lin.weight._data) == 2.0)
+            assert np.all(np.asarray(lin.bias._data) == 3.0)
+            lin2 = nn.Linear(3, 2, weight_attr=ParamAttr(
+                initializer=Constant(7.0)))
+            assert np.all(np.asarray(lin2.weight._data) == 7.0)
+        finally:
+            set_global_initializer(None)
+        lin3 = nn.Linear(3, 2)
+        assert float(np.asarray(lin3.weight._data).std()) > 0
+
+    def test_bilinear_kernel_upsamples_constant(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.nn.initializer import Bilinear
+        w = paddle.to_tensor(np.asarray(Bilinear()((1, 1, 4, 4))))
+        x = paddle.to_tensor(np.ones((1, 1, 3, 3), np.float32))
+        out = F.conv2d_transpose(x, w, stride=2, padding=1)
+        arr = np.asarray(out._data)
+        # interior of a constant upsample stays constant
+        np.testing.assert_allclose(arr[0, 0, 2:-2, 2:-2], 1.0,
+                                   rtol=1e-5)
