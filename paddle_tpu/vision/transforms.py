@@ -265,8 +265,6 @@ class _Functional:
         return np.rot90(np.asarray(img), k=k).copy()
 
 
-functional = _Functional()
-
 # register as a REAL submodule so reference-style imports work
 # (`import paddle_tpu.vision.transforms.functional`, `from
 # paddle_tpu.vision.transforms import functional`)
