@@ -234,6 +234,18 @@ def main():
         add_us = mm_us = -1.0
         errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
 
+    # record which attention path the ERNIE step actually used (the
+    # dropout kernel self-check can fall back to SDPA-with-dropout)
+    try:
+        from paddle_tpu.ops.pallas_kernels import kernel_dropout_available
+        # the ERNIE step trains with attention dropout, so its attention
+        # either runs the Pallas kernel WITH in-kernel dropout or the
+        # SDPA-with-dropout fallback — there is no no-dropout tier here
+        attn_path = ("pallas+kernel_dropout" if kernel_dropout_available()
+                     else "sdpa_dropout_fallback")
+    except Exception as e:  # pragma: no cover
+        attn_path = f"unknown: {type(e).__name__}"
+
     # A100 BERT-base-class pretraining sustains ~25k tokens/s/chip
     # (derived from published A100 BERT results; see module docstring)
     baseline = 25000.0 if on_tpu else 1.0
@@ -255,6 +267,7 @@ def main():
             "recompile_storm": compiles > n_buckets,
             "eager_add_overhead_us": round(add_us, 1),
             "eager_matmul_overhead_us": round(mm_us, 1),
+            "attention_path": attn_path,
             **({"errors": errors} if errors else {}),
         },
     }))
