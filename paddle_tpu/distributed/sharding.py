@@ -29,8 +29,7 @@ __all__ = ["ShardingPlan", "PartitionSpec", "shard_tensor", "NamedSharding"]
 PartitionSpec = P
 
 
-def _spec_for_param(name: str, tensor, rules, zero_stage, dp_axis,
-                    axis_size=1):
+def _spec_for_param(name: str, tensor, rules):
     # explicit layer annotation wins (TP layers set `.sharding_spec`)
     spec = getattr(tensor, "sharding_spec", None) if tensor is not None \
         else None
@@ -39,12 +38,7 @@ def _spec_for_param(name: str, tensor, rules, zero_stage, dp_axis,
             if re.search(pattern, name):
                 spec = P(*s) if not isinstance(s, P) else s
                 break
-    if spec is None:
-        spec = P()
-    if zero_stage >= 3:
-        # shard the largest free dim over dp as well
-        spec = _add_axis(spec, tensor, dp_axis, axis_size)
-    return spec
+    return spec if spec is not None else P()
 
 
 def _add_axis(spec: P, tensor, axis: str, axis_size: int):
@@ -94,9 +88,27 @@ class ShardingPlan:
             return 1
         return int(self.mesh.shape[self.dp_axis])
 
+    def _sanitize(self, spec: P) -> P:
+        """Drop spec axes absent from this plan's mesh, so a model
+        annotated for (say) tp degrades to replicated on a dp-only mesh."""
+        names = set(self.mesh.axis_names)
+
+        def keep(p):
+            if p is None:
+                return None
+            if isinstance(p, (tuple, list)):
+                kept = tuple(a for a in p if a in names)
+                return kept if kept else None
+            return p if p in names else None
+        return P(*[keep(p) for p in spec])
+
     def param_spec(self, name: str, tensor) -> P:
-        return _spec_for_param(name, tensor, self.rules, self.zero_stage,
-                               self.dp_axis, self._dp_size())
+        # sanitize BEFORE the ZeRO-3 axis addition: a stale 'tp' label on
+        # a dp-only mesh must not block _add_axis from dp-sharding the dim
+        spec = self._sanitize(_spec_for_param(name, tensor, self.rules))
+        if self.zero_stage >= 3 and self.dp_axis:
+            spec = _add_axis(spec, tensor, self.dp_axis, self._dp_size())
+        return spec
 
     def state_spec(self, name: str, tensor) -> P:
         """Optimizer-state sharding: ZeRO>=1 shards moments over dp."""
