@@ -184,7 +184,8 @@ def bench_dynamic_shapes(on_tpu):
         x = jnp.asarray(pad_to_bucket(img)[None])
         y = jnp.asarray([i % 4], jnp.int32)
         state = jit_train(state, x, y)
-    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    # host value read, not block_until_ready (no-op under the tunnel)
+    np.asarray(jax.tree_util.tree_leaves(state)[0]).ravel()[:1]
     dt = time.perf_counter() - t0
     compiles = jit_train._cache_size()
     return n_imgs / dt, int(compiles), len(buckets)
@@ -195,29 +196,86 @@ def bench_eager_dispatch():
     import paddle_tpu as paddle
     a = paddle.to_tensor(np.ones((4, 4), np.float32))
     b = paddle.to_tensor(np.ones((4, 4), np.float32))
-    (a + b)._data.block_until_ready()
+    # sync via a host value read: block_until_ready is a no-op under the
+    # axon tunnel, so timing must end on an actual device->host fetch
+    np.asarray((a + b)._data)
+    np.asarray((a @ b)._data)  # warm the matmul compile too
     n = 2000
     t0 = time.perf_counter()
     for _ in range(n):
         c = a + b
-    c._data.block_until_ready()
+    np.asarray(c._data)
     add_us = (time.perf_counter() - t0) / n * 1e6
     t0 = time.perf_counter()
     for _ in range(n):
         c = a @ b
-    c._data.block_until_ready()
+    np.asarray(c._data)
     mm_us = (time.perf_counter() - t0) / n * 1e6
     return add_us, mm_us
 
 
-def main():
-    import jax
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+def _probe_tpu(timeout_s=None):
+    """Liveness-check the TPU backend in a THROWAWAY subprocess.
 
-    tokens_per_sec, mfu, n_params, fpt = bench_ernie(on_tpu)
+    A wedged tunnel hangs jax backend init forever, and an in-process
+    hang is unrecoverable (round-2: bench rc=1, dryrun rc=124) — so the
+    first jax call of this process must never be the gamble. The probe
+    also executes + host-reads a matmul because block_until_ready is a
+    no-op under the tunnel and init can succeed while execution wedges.
+    Returns (on_tpu, platform_or_error)."""
+    import subprocess
+    timeout_s = timeout_s or float(os.environ.get("PD_TPU_PROBE_TIMEOUT",
+                                                  180))
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((128, 128)) @ jnp.ones((128, 128)); "
+            "assert float(x[0, 0]) == 128.0; "
+            "print('PLATFORM', d[0].platform, flush=True)")
+    # SIGTERM first with a grace period: a hard SIGKILL mid-TPU-execution
+    # can wedge a merely-slow tunnel permanently (round-2 postmortem)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return False, (f"backend init/exec timed out after {timeout_s:.0f}s"
+                       " (wedged TPU tunnel)")
+    if proc.returncode != 0:
+        tail = (stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return False, f"backend init failed rc={proc.returncode}: {tail[0]}"
+    out = (stdout or "").strip().split()
+    plat = out[-1] if out else "?"
+    if plat in ("tpu", "axon"):
+        return True, plat
+    return False, plat  # healthy non-TPU host (plat == "cpu"): not an error
+
+
+def main():
+    errors = {}
+    on_tpu, probe_info = _probe_tpu()
+    if not on_tpu:
+        if probe_info != "cpu":
+            errors["tpu_backend"] = probe_info
+        # force CPU BEFORE any jax call: with axon wedged, letting the
+        # plugin initialize would hang this process too
+        from __graft_entry__ import _force_cpu_devices
+        _force_cpu_devices(1)
+    import jax
+
+    try:
+        tokens_per_sec, mfu, n_params, fpt = bench_ernie(on_tpu)
+    except Exception as e:  # pragma: no cover - JSON line must survive
+        tokens_per_sec = mfu = fpt = -1.0
+        n_params = -1
+        errors["ernie"] = f"{type(e).__name__}: {e}"
     # secondary benches never sink the primary metric; failures are
     # reported in extras["errors"]
-    errors = {}
     try:
         images_per_sec = bench_resnet(on_tpu)
     except Exception as e:  # pragma: no cover
@@ -256,6 +314,7 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / baseline, 3),
         "extras": {
+            "platform": jax.devices()[0].platform,
             "mfu": round(mfu, 4),
             "model_params": n_params,
             "flops_per_token": fpt,
