@@ -1,0 +1,147 @@
+"""paddle.distribution numeric checks vs closed-form / numpy references
+(reference contract: /root/reference/python/paddle/distribution.py)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Distribution, Normal, Uniform
+
+
+def test_uniform_scalar_args():
+    u = Uniform(1.0, 3.0)
+    s = u.sample([1000], seed=7)
+    a = np.asarray(s._data)
+    assert a.shape == (1000,)
+    assert a.min() >= 1.0 and a.max() < 3.0
+    assert abs(a.mean() - 2.0) < 0.1
+    lp = np.asarray(u.log_prob(paddle.to_tensor([2.0]))._data)
+    np.testing.assert_allclose(lp, [math.log(0.5)], rtol=1e-6)
+    # outside the support: probability 0 / log prob -inf
+    assert np.asarray(u.probs(paddle.to_tensor([5.0]))._data)[0] == 0.0
+    assert np.isneginf(np.asarray(u.log_prob(paddle.to_tensor([5.0]))._data))
+    np.testing.assert_allclose(np.asarray(u.entropy()._data),
+                               math.log(2.0), rtol=1e-6)
+
+
+def test_uniform_batched():
+    low = np.array([0.0, 1.0], np.float32)
+    high = np.array([2.0, 5.0], np.float32)
+    u = Uniform(low, high)
+    s = np.asarray(u.sample([64], seed=3)._data)
+    assert s.shape == (64, 2)
+    assert (s >= low).all() and (s < high).all()
+    ent = np.asarray(u.entropy()._data)
+    np.testing.assert_allclose(ent, np.log(high - low), rtol=1e-6)
+    p = np.asarray(u.probs(paddle.to_tensor(
+        np.array([1.0, 2.0], np.float32)))._data)
+    np.testing.assert_allclose(p, [0.5, 0.25], rtol=1e-6)
+
+
+def test_normal_log_prob_entropy_kl():
+    loc = np.array([0.0, 1.0], np.float32)
+    scale = np.array([1.0, 2.0], np.float32)
+    n = Normal(loc, scale)
+    v = np.array([0.5, -1.0], np.float32)
+    lp = np.asarray(n.log_prob(paddle.to_tensor(v))._data)
+    want = -((v - loc) ** 2) / (2 * scale ** 2) - np.log(scale) \
+        - 0.5 * math.log(2 * math.pi)
+    np.testing.assert_allclose(lp, want, rtol=1e-5)
+    ent = np.asarray(n.entropy()._data)
+    np.testing.assert_allclose(
+        ent, 0.5 + 0.5 * math.log(2 * math.pi) + np.log(scale), rtol=1e-5)
+    probs = np.asarray(n.probs(paddle.to_tensor(v))._data)
+    np.testing.assert_allclose(probs, np.exp(want), rtol=1e-5)
+
+    m = Normal(np.array([0.5, 0.0], np.float32),
+               np.array([1.5, 1.0], np.float32))
+    kl = np.asarray(n.kl_divergence(m)._data)
+    ratio2 = (scale / np.array([1.5, 1.0])) ** 2
+    t1 = ((loc - np.array([0.5, 0.0])) / np.array([1.5, 1.0])) ** 2
+    np.testing.assert_allclose(kl, 0.5 * (ratio2 + t1 - 1 - np.log(ratio2)),
+                               rtol=1e-5)
+    # KL(p || p) == 0
+    np.testing.assert_allclose(np.asarray(n.kl_divergence(n)._data),
+                               np.zeros(2), atol=1e-6)
+
+
+def test_normal_sample_moments():
+    n = Normal(2.0, 3.0)
+    s = np.asarray(n.sample([20000], seed=11)._data)
+    assert s.shape == (20000,)
+    assert abs(s.mean() - 2.0) < 0.1
+    assert abs(s.std() - 3.0) < 0.1
+
+
+def test_categorical_entropy_kl_softmax_semantics():
+    x = np.array([0.2, 0.4, 0.8, 1.6], np.float32)
+    y = np.array([0.5, 0.5, 0.5, 0.5], np.float32)
+    c, d = Categorical(x), Categorical(y)
+    # entropy/kl treat the arg in log space (softmax) — reference :827
+    p = np.exp(x - x.max()) / np.exp(x - x.max()).sum()
+    want_ent = -(p * np.log(p)).sum()
+    np.testing.assert_allclose(np.asarray(c.entropy()._data).ravel(),
+                               [want_ent], rtol=1e-5)
+    q = np.ones(4) / 4
+    want_kl = (p * (np.log(p) - np.log(q))).sum()
+    np.testing.assert_allclose(np.asarray(c.kl_divergence(d)._data).ravel(),
+                               [want_kl], rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(c.kl_divergence(c)._data).ravel(), [0.0], atol=1e-6)
+
+
+def test_categorical_probs_normalizes_by_sum():
+    # reference :892: probs() normalizes the raw arg by its sum
+    x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    c = Categorical(x)
+    p = np.asarray(c.probs(paddle.to_tensor(
+        np.array([2, 1, 3], np.int64)))._data)
+    np.testing.assert_allclose(p, [0.3, 0.2, 0.4], rtol=1e-6)
+    lp = np.asarray(c.log_prob(paddle.to_tensor(
+        np.array([2], np.int64)))._data)
+    np.testing.assert_allclose(lp, [math.log(0.3)], rtol=1e-5)
+
+
+def test_categorical_batched_probs_and_sample():
+    x = np.array([[1.0, 1.0, 2.0], [3.0, 1.0, 1.0]], np.float32)
+    c = Categorical(x)
+    p = np.asarray(c.probs(paddle.to_tensor(
+        np.array([[0, 2], [0, 1]], np.int64)))._data)
+    np.testing.assert_allclose(p, [[0.25, 0.5], [0.6, 0.2]], rtol=1e-6)
+    s = np.asarray(c.sample([5, 2], seed=5)._data)
+    assert s.shape == (5, 2, 2)
+    assert s.min() >= 0 and s.max() < 3
+
+
+def test_categorical_sample_frequencies():
+    x = np.array([1.0, 3.0], np.float32)
+    c = Categorical(x)
+    s = np.asarray(c.sample([8000], seed=13)._data)
+    frac1 = (s == 1).mean()
+    assert abs(frac1 - 0.75) < 0.03
+
+
+def test_sample_traceable_under_jit():
+    """Distribution methods must compose with jit via the key scope."""
+    import jax
+    from paddle_tpu.core.generator import key_scope
+
+    def f(key):
+        with key_scope(key):
+            n = Normal(0.0, 1.0)
+            return n.sample([4])._data
+
+    out1 = jax.jit(f)(jax.random.key(0))
+    out2 = jax.jit(f)(jax.random.key(0))
+    np.testing.assert_allclose(out1, out2)
+    out3 = jax.jit(f)(jax.random.key(1))
+    assert not np.allclose(out1, out3)
+
+
+def test_base_class_raises():
+    d = Distribution()
+    for m in ("sample", "entropy", "log_prob", "probs"):
+        with pytest.raises(NotImplementedError):
+            getattr(d, m)(*([0] if m in ("sample", "log_prob", "probs")
+                            else []))
