@@ -40,6 +40,13 @@ from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from . import models  # noqa: F401
+from . import distribution  # noqa: F401
+from . import compat  # noqa: F401
+from . import device  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import incubate  # noqa: F401
+from .batch import batch  # noqa: F401  (paddle.batch is the function)
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
 from . import onnx  # noqa: F401

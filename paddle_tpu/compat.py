@@ -1,0 +1,92 @@
+"""paddle.compat — py2/py3 string & arithmetic helpers
+(reference python/paddle/compat.py:19). Python-3-only here; the py2
+branches of the reference collapse to identities."""
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round",
+           "floor_division", "get_exception_message"]
+
+int_type = int
+long_type = int
+
+
+def _to_text(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, (str, bool, float)):
+        return obj
+    return str(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert bytes/str (or containers of them) to str."""
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_text(i, encoding) for i in obj]
+            return obj
+        return [_to_text(i, encoding) for i in obj]
+    if isinstance(obj, set):
+        if inplace:
+            new = {_to_text(i, encoding) for i in obj}
+            obj.clear()
+            obj.update(new)
+            return obj
+        return {_to_text(i, encoding) for i in obj}
+    if isinstance(obj, dict):
+        new = {_to_text(k, encoding): _to_text(v, encoding)
+               for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return _to_text(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, bytes):
+        return obj
+    return str(obj).encode(encoding)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str/bytes (or containers of them) to bytes."""
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_bytes(i, encoding) for i in obj]
+            return obj
+        return [_to_bytes(i, encoding) for i in obj]
+    if isinstance(obj, set):
+        if inplace:
+            new = {_to_bytes(i, encoding) for i in obj}
+            obj.clear()
+            obj.update(new)
+            return obj
+        return {_to_bytes(i, encoding) for i in obj}
+    return _to_bytes(obj, encoding)
+
+
+def round(x, d=0):
+    """Python-2-style half-away-from-zero rounding (reference keeps this
+    semantics on py3 too)."""
+    if x in (float("inf"), float("-inf")) or x != x:
+        return x
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    assert exc is not None
+    return str(exc)

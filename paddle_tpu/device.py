@@ -1,0 +1,21 @@
+"""paddle.device — device selection & capability queries
+(reference python/paddle/device.py:24). TPU-first: get/set_device map
+onto the Place layer over jax devices (core/place.py); CUDA-specific
+queries report absence rather than raising."""
+from .core.place import XPUPlace, get_device, set_device  # noqa: F401
+
+__all__ = ["get_cudnn_version", "set_device", "get_device", "XPUPlace",
+           "is_compiled_with_xpu"]
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def get_cudnn_version():
+    """No cuDNN in a TPU build (reference returns None when absent)."""
+    return None

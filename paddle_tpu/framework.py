@@ -473,3 +473,36 @@ def _unwrap(x):
 def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
     """paddle.to_tensor equivalent."""
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# -- paddle.framework namespace parity (PEP 562 lazy re-exports) ------------
+# Reference python/paddle/framework/__init__.py:16 exports the names below
+# from this module path; the implementations live elsewhere in this
+# package, and importing them eagerly here would be circular.
+_FRAMEWORK_EXPORTS = {
+    "create_parameter": ("paddle_tpu.ops.creation", "create_parameter"),
+    "ParamAttr": ("paddle_tpu.nn.param_attr", "ParamAttr"),
+    "CPUPlace": ("paddle_tpu.core.place", "CPUPlace"),
+    "CUDAPlace": ("paddle_tpu.core.place", "CUDAPlace"),
+    "CUDAPinnedPlace": ("paddle_tpu.core.place", "CUDAPinnedPlace"),
+    "get_default_dtype": ("paddle_tpu.core.dtypes", "get_default_dtype"),
+    "set_default_dtype": ("paddle_tpu.core.dtypes", "set_default_dtype"),
+    "grad": ("paddle_tpu.autograd_utils", "partial_grad"),
+    "LayerList": ("paddle_tpu.nn.layer.container", "LayerList"),
+    "load": ("paddle_tpu.serialization", "load"),
+    "save": ("paddle_tpu.serialization", "save"),
+    "DataParallel": ("paddle_tpu.distributed.parallel", "DataParallel"),
+    "seed": ("paddle_tpu.core.generator", "seed"),
+    "random": ("paddle_tpu.core.generator", None),
+}
+
+
+def __getattr__(name):
+    try:
+        modname, attr = _FRAMEWORK_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    mod = importlib.import_module(modname)
+    return mod if attr is None else getattr(mod, attr)

@@ -1,0 +1,8 @@
+"""paddle.incubate — incubating APIs
+(reference python/paddle/incubate/__init__.py: re-exports optimizer
+extras and the contrib reader namespace)."""
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..io import dataloader as reader  # noqa: F401
+
+__all__ = ["reader", "optimizer"] + optimizer.__all__

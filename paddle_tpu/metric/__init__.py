@@ -171,3 +171,10 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         hit = jnp.any(top == l[..., None], axis=-1)
         return jnp.mean(hit.astype(jnp.float32))
     return run_op("accuracy", impl, (input, label), {})
+
+
+# reference metric/__init__.py does `from . import metrics`; this module
+# IS the implementation, so the submodule name aliases back to it
+import sys as _sys
+metrics = _sys.modules[__name__]
+_sys.modules[__name__ + ".metrics"] = metrics

@@ -221,3 +221,7 @@ squeeze_ = _functional_inplace(manipulation.squeeze)
 unsqueeze_ = _functional_inplace(manipulation.unsqueeze)
 scatter_ = _functional_inplace(manipulation.scatter)
 tanh_ = _functional_inplace(math.tanh)
+
+# paddle.tensor namespace carries to_tensor too (reference
+# tensor/creation.py to_tensor); implementation lives in framework.py
+from ..framework import to_tensor  # noqa: F401,E402

@@ -20,6 +20,7 @@ from ..framework import Tensor, _unwrap
 from .registry import register_op
 
 __all__ = ["TensorArray", "write_to_array", "read_from_array",
+           "array_write", "array_read",
            "array_length", "tensor_array_to_tensor", "create_array"]
 
 
@@ -84,6 +85,15 @@ def read_from_array(array, i):
 def array_length(array):
     """ref lod_array_length op."""
     return len(array)
+
+
+# paddle.tensor namespace names for the same ops; note array_write's
+# reference signature is (x, i, array=None) — tensor/array.py:89
+def array_write(x, i, array=None):
+    return write_to_array(array, i, x)
+
+
+array_read = read_from_array
 
 
 @register_op("tensor_array_to_tensor")

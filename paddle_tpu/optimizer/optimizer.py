@@ -34,14 +34,16 @@ class Optimizer:
             else None
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        self._wd_mode = "l2"
         if isinstance(weight_decay, float) or isinstance(weight_decay, int):
             self._weight_decay = float(weight_decay)
         elif weight_decay is None:
             self._weight_decay = 0.0
-        else:  # L2Decay-like object with a coeff
+        else:  # regularizer.L1Decay/L2Decay-like object with a coeff
             self._weight_decay = float(
                 getattr(weight_decay, "_coeff",
                         getattr(weight_decay, "coeff", 0.0)))
+            self._wd_mode = getattr(weight_decay, "mode", "l2")
         # state: id(param) -> dict name->jax array
         self._accumulators: Dict[int, Dict[str, Any]] = {}
         self._step_count = 0
@@ -81,7 +83,8 @@ class Optimizer:
             inner = {k: v for k, v in state.items() if k != "master_weight"}
             g32 = g.astype(jnp.float32)
             if wd and not self._decoupled_wd:
-                g32 = g32 + wd * master
+                g32 = g32 + wd * (jnp.sign(master)
+                                  if self._wd_mode == "l1" else master)
             new_master, new_state = self.update_rule(master, g32, inner, lr)
             if self._decoupled_wd and wd:
                 new_master = new_master - lr * wd * master
@@ -89,7 +92,7 @@ class Optimizer:
             return new_master.astype(p.dtype), new_state
         g = g.astype(p.dtype)
         if wd and not self._decoupled_wd:
-            g = g + wd * p
+            g = g + wd * (jnp.sign(p) if self._wd_mode == "l1" else p)
         new_p, new_state = self.update_rule(p, g, state, lr)
         if self._decoupled_wd and wd:
             new_p = new_p - lr * wd * p

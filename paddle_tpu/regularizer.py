@@ -1,0 +1,28 @@
+"""paddle.regularizer — L1/L2 weight decay
+(reference python/paddle/regularizer.py:15). The reference injects
+regularization as extra grad ops during append_backward; here the
+optimizer's pure update rule fuses the decay term into the (jitted)
+parameter update (optimizer/optimizer.py _apply_one), which XLA folds
+into the same fusion as the optimizer math."""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    mode = "l2"
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """Adds coeff * sign(param) to the gradient (sparsity-encouraging)."""
+    mode = "l1"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """Adds coeff * param to the gradient."""
+    mode = "l2"

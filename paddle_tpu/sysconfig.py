@@ -1,0 +1,19 @@
+"""paddle.sysconfig — header/library install paths
+(reference python/paddle/sysconfig.py:15). Points at the csrc tree
+whose C API (paddle_tpu_capi.h) and shared objects back the native
+runtime."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include():
+    """Directory containing the C/C++ headers (csrc/)."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    """Directory containing the built native libraries."""
+    return os.path.join(_ROOT, "csrc", "build")

@@ -54,12 +54,28 @@ def imported_names(path, pattern=r"^from\s+[.\w]+\s+import\s+(.+)$"):
         return []
     names = []
     for m in re.finditer(pattern, txt, re.M):
+        if "__future__" in m.group(0):
+            continue
         seg = m.group(1).split("#")[0]  # strip trailing comments
         for part in seg.strip().strip("()").split(","):
             nm = part.split("#")[0].strip().split(" as ")[-1].strip()
             if nm.isidentifier() and not nm.startswith("_"):
                 names.append(nm)
     return sorted(set(names))
+
+
+def ref_top_modules():
+    """Top-level modules the reference's paddle/__init__.py imports —
+    DISCOVERED from the source, not hand-enumerated (the round-2 audit
+    missed paddle.distribution exactly because of a hand list)."""
+    txt = open(f"{REF}/__init__.py").read()
+    mods = set(re.findall(r"^import paddle\.([a-z_]+)", txt, re.M))
+    for grp in re.findall(r"^from \. import (.+)$", txt, re.M):
+        for nm in grp.split("#")[0].split(","):
+            nm = nm.strip()
+            if nm.isidentifier():
+                mods.add(nm)
+    return sorted(m for m in mods if not m.startswith("_"))
 
 
 def main():
@@ -125,23 +141,61 @@ def main():
     surfaces.append(("Tensor methods",
                      [n for n in alias if n not in not_methods], t))
 
+    # -- discovered module surfaces: every module the reference's
+    # __init__ imports must exist here and have its names audited
+    # the reference's device.py __all__ lacks a comma, so two adjacent
+    # string literals concatenate (source artifact, not a real name)
+    CONCAT_ARTIFACTS = {
+        "is_compiled_with_xpuis_compiled_with_cuda":
+            ["is_compiled_with_xpu", "is_compiled_with_cuda"]}
+
+    def _import_target(m):
+        try:
+            return __import__("paddle_tpu." + m, fromlist=["x"])
+        except ImportError:
+            # namespace alias (paddle.tensor is the ops module)
+            return getattr(paddle, m, None)
+
+    discovered = ref_top_modules()
+    empty_mod_surfaces = []
+    missing_modules = [m for m in discovered
+                       if not hasattr(paddle, m) and
+                       _import_target(m) is None]
+    for m in discovered:
+        path = f"{REF}/{m}.py"
+        if not os.path.exists(path):
+            path = f"{REF}/{m}/__init__.py"
+        names = ref_all(path) or imported_names(path)
+        names = sorted({x for n in names
+                        for x in CONCAT_ARTIFACTS.get(n, [n])})
+        if not names and os.path.exists(path) and \
+                os.path.getsize(path) > 2000:
+            # a substantial reference module whose surface parses to
+            # nothing is a parser regression, not a vacuous green
+            empty_mod_surfaces.append(m)
+        surfaces.append((f"mod:{m}", names, _import_target(m)))
+
     total_missing = 0
     empty_surfaces = []
     print(f"{'surface':18s} {'ref':>4s} {'missing':>7s}")
     for label, names, target in surfaces:
-        if not names:
+        if not names and not label.startswith("mod:"):
             # an empty reference surface means the parser found nothing
             # — treat as an audit defect, never as a vacuous green
+            # (discovered modules may legitimately export nothing)
             empty_surfaces.append(label)
         missing = [n for n in names if not hasattr(target, n)]
         total_missing += len(missing)
         tail = f"  {missing[:6]}" if missing else ""
         print(f"{label:18s} {len(names):4d} {len(missing):7d}{tail}")
-    print(f"\nTOTAL missing: {total_missing}")
-    if empty_surfaces:
+    print(f"\nDISCOVERED modules: {len(discovered)}; "
+          f"absent: {missing_modules or 0}")
+    print(f"TOTAL missing: {total_missing}")
+    if empty_surfaces or empty_mod_surfaces:
         print(f"AUDIT DEFECT: empty reference surfaces "
-              f"{empty_surfaces}")
-    if args.fail and (total_missing or empty_surfaces):
+              f"{empty_surfaces + empty_mod_surfaces}")
+    if args.fail and (total_missing or empty_surfaces or
+                      empty_mod_surfaces or missing_modules):
         sys.exit(1)
 
 
