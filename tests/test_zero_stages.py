@@ -1,0 +1,127 @@
+"""ZeRO stage 1/2/3 verified paths (reference contract:
+fleet/meta_optimizers/sharding_optimizer.py:33 minimize_impl — params /
+grads / optimizer state partitioned per rank; here the partitioning is
+ShardingPlan specs and XLA SPMD places the collectives).
+
+Assertions are on observable contracts, not compiler choices:
+  - per-device shard bytes of optimizer state (stage>=1) and params
+    (stage 3) are 1/dp of global;
+  - the compiled step contains a cross-replica grad reduction and, for
+    sharded state, param re-assembly gathers (the CPU partitioner may
+    legally pick all-reduce+dynamic-slice over reduce-scatter);
+  - training dynamics are IDENTICAL across stages (loss equality).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.static import TrainStep
+
+DP = 8
+
+
+def _build(stage, seed=0):
+    mesh = dist.build_mesh({"dp": DP}, devices=jax.devices()[:DP])
+    dist.set_mesh(mesh)
+    plan = dist.ShardingPlan(mesh, zero_stage=stage)
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(64, 128), paddle.nn.ReLU(),
+        paddle.nn.Linear(128, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                     mesh=mesh, sharding_plan=plan)
+    return step
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 64).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    return x, y
+
+
+def _shard_frac(arr):
+    return (np.prod(arr.addressable_shards[0].data.shape)
+            / np.prod(arr.shape))
+
+
+def _compiled_text(step, x, y):
+    lowered = step._step_fn.lower(
+        step.params, step.opt_state, step.buffers, step.strategy_state,
+        jax.random.key(0), jnp.float32(1e-3), (x._data,), (y._data,))
+    return lowered.compile().as_text()
+
+
+@pytest.mark.parametrize("stage", [1, 2])
+def test_zero12_shards_optimizer_state(stage):
+    step = _build(stage)
+    x, y = _data()
+    step(x, y)
+    # every >=1-D moment is sharded to 1/dp; params stay whole
+    for k, st in step.opt_state.items():
+        for n, v in st.items():
+            if np.ndim(v) > 0 and np.prod(v.shape) % DP == 0:
+                assert _shard_frac(v) == pytest.approx(1 / DP), (k, n)
+    for k, p in step.params.items():
+        assert _shard_frac(p) == pytest.approx(1.0), k
+    txt = _compiled_text(step, x, y)
+    # grad reduction across dp + param re-assembly from sharded updates
+    assert ("all-reduce" in txt) or ("reduce-scatter" in txt)
+    assert "all-gather" in txt
+
+
+def test_zero3_shards_params_too():
+    step = _build(3)
+    x, y = _data()
+    step(x, y)
+    sharded = [k for k, p in step.params.items()
+               if _shard_frac(p) < 1.0]
+    assert sharded, "stage 3 sharded no parameters"
+    # weight matrices divisible by dp must be 1/dp per device
+    for k in ("0.weight", "2.weight"):
+        assert _shard_frac(step.params[k]) == pytest.approx(1 / DP), k
+    txt = _compiled_text(step, x, y)
+    assert "all-gather" in txt  # forward must reassemble sharded params
+    assert ("all-reduce" in txt) or ("reduce-scatter" in txt)
+
+
+def test_zero_stages_match_plain_dp_losses():
+    """sharding must not change the math: stage 0/1/2/3 produce the same
+    loss trajectory (sharding_optimizer contract — same updates, less
+    memory)."""
+    x, y = _data()
+    traces = {}
+    for stage in (0, 1, 2, 3):
+        step = _build(stage, seed=123)
+        traces[stage] = [float(step(x, y).item()) for _ in range(3)]
+    for stage in (1, 2, 3):
+        np.testing.assert_allclose(traces[stage], traces[0], rtol=2e-4,
+                                   err_msg=f"stage {stage}")
+
+
+def test_zero_memory_accounting():
+    """the point of ZeRO: per-device optimizer-state bytes shrink ~1/dp
+    at stage>=1; param bytes shrink too at stage 3."""
+    def device_bytes(tree):
+        total = 0
+        for v in jax.tree_util.tree_leaves(tree):
+            if hasattr(v, "addressable_shards"):
+                s = v.addressable_shards[0].data
+                total += np.prod(s.shape) * s.dtype.itemsize
+        return total
+
+    steps = {s: _build(s) for s in (0, 1, 3)}
+    x, y = _data()
+    for s in steps.values():
+        s(x, y)
+    opt0 = device_bytes(steps[0].opt_state)
+    opt1 = device_bytes(steps[1].opt_state)
+    assert opt1 < 0.3 * opt0, (opt1, opt0)
+    par0 = device_bytes(steps[0].params)
+    par3 = device_bytes(steps[3].params)
+    assert par3 < 0.3 * par0, (par3, par0)
