@@ -38,13 +38,20 @@ class Generator:
             return sub
 
     def get_state(self):
-        return {"seed": self._seed, "offset": self._offset}
+        # key material travels in the state so restore is O(1); seed and
+        # offset stay for readability + legacy states
+        return {"seed": self._seed, "offset": self._offset,
+                "key_data": np.asarray(jax.random.key_data(self._key))}
 
     def set_state(self, state):
         self.manual_seed(state["seed"])
-        key = jax.random.key(self._seed)
-        for _ in range(state["offset"]):
-            key, _ = jax.random.split(key)
+        if state.get("key_data") is not None:
+            key = jax.random.wrap_key_data(
+                jax.numpy.asarray(state["key_data"]))
+        else:  # legacy {seed, offset} state: replay the splits
+            key = jax.random.key(self._seed)
+            for _ in range(state["offset"]):
+                key, _ = jax.random.split(key)
         with self._lock:
             self._key = key
             self._offset = state["offset"]

@@ -143,6 +143,9 @@ class AutoCheckpoint:
             self.model.set_state_dict(bundle["model"])
         if self.optimizer is not None and bundle.get("opt") is not None:
             self.optimizer.set_state_dict(bundle["opt"])
+        if bundle.get("rng") is not None:
+            from ..core.generator import default_generator
+            default_generator().set_state(bundle["rng"])
         return epoch
 
     def _restore_legacy(self) -> int:
@@ -161,12 +164,16 @@ class AutoCheckpoint:
         return epoch
 
     def save_epoch(self, epoch: int):
+        from ..core.generator import default_generator
         bundle = {
             "epoch": epoch,
             "job_id": self.job_id,
             "model": None if self.model is None else self.model.state_dict(),
             "opt": (None if self.optimizer is None
                     else self.optimizer.state_dict()),
+            # RNG state too: a resumed run must replay the interrupted
+            # epoch's dropout masks / shuffles exactly
+            "rng": default_generator().get_state(),
         }
         tmp = self._state_path + ".tmp"
         serialization.save(bundle, tmp)

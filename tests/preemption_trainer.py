@@ -1,0 +1,87 @@
+"""Child process for the preemption drill (tests/test_preemption.py).
+
+Trains a small dropout model through train_epoch_range; in --kill-at
+mode it SIGKILLs ITSELF mid-epoch (simulated preemption, the
+auto_checkpoint.py:598 scenario). On completion it dumps final params,
+optimizer accumulators, LR, RNG state, and the last-epoch loss
+trajectory for exact-restoration comparison.
+"""
+import argparse
+import os
+import pickle
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--kill-at", default=None,
+                    help="epoch:step at which to SIGKILL self")
+    args = ap.parse_args()
+    kill_at = None
+    if args.kill_at:
+        e, s = args.kill_at.split(":")
+        kill_at = (int(e), int(s))
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.checkpoint import train_epoch_range
+
+    paddle.seed(42)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Dropout(0.5),
+                        nn.Linear(16, 4))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.05, step_size=2,
+                                          gamma=0.5)
+    opt = paddle.optimizer.Adam(learning_rate=sched,
+                                parameters=net.parameters())
+
+    rng = np.random.RandomState(7)
+    xs = rng.randn(5, 16, 8).astype(np.float32)
+    ys = rng.randn(5, 16, 4).astype(np.float32)
+
+    losses = []
+    for epoch in train_epoch_range(6, job_id="drill",
+                                   checkpoint_dir=args.ckpt_dir,
+                                   model=net, optimizer=opt):
+        losses = []
+        for step in range(5):
+            if kill_at == (epoch, step):
+                os.kill(os.getpid(), signal.SIGKILL)
+            x = paddle.to_tensor(xs[step])
+            y = paddle.to_tensor(ys[step])
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        sched.step()
+
+    from paddle_tpu.core.generator import default_generator
+    out = {
+        "params": {k: np.asarray(v._data)
+                   for k, v in net.state_dict().items()},
+        "opt": {k: ({n: np.asarray(t._data) for n, t in v.items()}
+                    if isinstance(v, dict) else v)
+                for k, v in opt.state_dict().items()
+                if k != "LR_Scheduler"},
+        "lr": float(sched()),
+        "lr_epoch": sched.state_dict(),
+        "rng": default_generator().get_state(),
+        "last_epoch_losses": losses,
+    }
+    with open(args.out, "wb") as f:
+        pickle.dump(out, f)
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Preemption drill: SIGKILL a train_epoch_range run mid-epoch, restart
+it, and require EXACT state restoration — epoch skip-forward, optimizer
+accumulators + step count, LR scheduler position, RNG state, and the
+re-run epoch's loss trajectory identical to a never-killed control run.
+Reference contract: fluid/incubate/checkpoint/auto_checkpoint.py:71,598
+(epoch-guard auto-save/auto-resume after job restart)."""
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(HERE, "preemption_trainer.py")
+
+
+def _run(ckpt_dir, out, kill_at=None, timeout=600):
+    cmd = [sys.executable, CHILD, "--ckpt-dir", ckpt_dir, "--out", out]
+    if kill_at:
+        cmd += ["--kill-at", kill_at]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_sigkill_mid_epoch_then_exact_resume(tmp_path):
+    control_dir = str(tmp_path / "control")
+    drill_dir = str(tmp_path / "drill")
+    control_out = str(tmp_path / "control.pkl")
+    drill_out = str(tmp_path / "drill.pkl")
+
+    # control: uninterrupted run
+    p = _run(control_dir, control_out)
+    assert p.returncode == 0, p.stderr[-2000:]
+
+    # drill: killed at epoch 3 step 2 (mid-epoch, checkpoint has epochs
+    # 0-2) — the process dies with SIGKILL, nothing flushes
+    p = _run(drill_dir, drill_out, kill_at="3:2")
+    assert p.returncode == -signal.SIGKILL
+    assert not os.path.exists(drill_out)
+    # the epoch-2 checkpoint survived the kill
+    assert os.path.exists(os.path.join(drill_dir, "drill", "state.pdckpt"))
+
+    # restart: must skip epochs 0-2, replay 3-5 exactly
+    p = _run(drill_dir, drill_out)
+    assert p.returncode == 0, p.stderr[-2000:]
+
+    with open(control_out, "rb") as f:
+        control = pickle.load(f)
+    with open(drill_out, "rb") as f:
+        drill = pickle.load(f)
+
+    # params identical
+    for k in control["params"]:
+        np.testing.assert_array_equal(control["params"][k],
+                                      drill["params"][k], err_msg=k)
+    # optimizer accumulators + step count identical
+    assert control["opt"]["_step_count"] == drill["opt"]["_step_count"]
+    for k, v in control["opt"].items():
+        if isinstance(v, dict):
+            for n in v:
+                np.testing.assert_array_equal(v[n], drill["opt"][k][n],
+                                              err_msg=f"{k}.{n}")
+    # LR scheduler position identical
+    assert control["lr"] == pytest.approx(drill["lr"])
+    assert control["lr_epoch"] == drill["lr_epoch"]
+    # RNG state identical (same seed path after replay)
+    assert control["rng"]["seed"] == drill["rng"]["seed"]
+    assert control["rng"]["offset"] == drill["rng"]["offset"]
+    np.testing.assert_array_equal(control["rng"]["key_data"],
+                                  drill["rng"]["key_data"])
+    # the interrupted epoch's loss trajectory replayed exactly
+    np.testing.assert_allclose(control["last_epoch_losses"],
+                               drill["last_epoch_losses"], rtol=0, atol=0)
+
+
+def test_resume_skips_completed_epochs(tmp_path):
+    """second run of a completed job does zero epochs (epoch guard)."""
+    d = str(tmp_path / "job")
+    out1 = str(tmp_path / "o1.pkl")
+    out2 = str(tmp_path / "o2.pkl")
+    p = _run(d, out1)
+    assert p.returncode == 0, p.stderr[-2000:]
+    p = _run(d, out2)
+    assert p.returncode == 0, p.stderr[-2000:]
+    with open(out2, "rb") as f:
+        rerun = pickle.load(f)
+    # no epochs ran: the loop body never executed, losses list is empty
+    assert rerun["last_epoch_losses"] == []
+    with open(out1, "rb") as f:
+        first = pickle.load(f)
+    for k in first["params"]:
+        np.testing.assert_array_equal(first["params"][k],
+                                      rerun["params"][k])
