@@ -1,0 +1,124 @@
+"""Artifact format versioning + per-op version registry.
+
+Reference: paddle/fluid/framework/op_version_registry.h — every op
+carries a version so checkpoints written by an older framework can be
+migrated (or rejected with a clear error) at load time. Here the same
+contract covers the two durable artifact kinds:
+
+- serialized Programs (static/program.py to_bytes): a program-format
+  version plus the per-op versions in force at save time; load migrates
+  older formats stepwise and runs per-op migrations for ops whose
+  registered version moved.
+- paddle.save state bundles (serialization.py): an envelope format
+  version; pre-envelope blobs load as legacy (v0).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+__all__ = [
+    "PROGRAM_FORMAT_VERSION", "STATE_FORMAT_VERSION",
+    "register_op_version", "op_version", "register_op_migration",
+    "migrate_program_dict", "migrate_op_entry", "check_state_format",
+]
+
+# program pickle layout: v1 = round-2 layout (no op_versions field);
+# v2 = adds "op_versions" {op_type: int}
+PROGRAM_FORMAT_VERSION = 2
+# paddle.save envelope: v0 = raw pickled payload (legacy), v1 = envelope
+STATE_FORMAT_VERSION = 1
+
+# -- per-op versions (op_version_registry.h analogue) -----------------------
+_OP_VERSIONS: Dict[str, int] = {}
+# (op_type, from_version) -> fn(const_args, kwargs) -> (const_args, kwargs)
+_OP_MIGRATIONS: Dict[tuple, Callable] = {}
+
+
+def register_op_version(op_type: str, version: int):
+    """Declare the current version of an op's serialized attribute
+    layout. Unregistered ops are implicitly version 1."""
+    _OP_VERSIONS[op_type] = int(version)
+
+
+def op_version(op_type: str) -> int:
+    return _OP_VERSIONS.get(op_type, 1)
+
+
+def register_op_migration(op_type: str, from_version: int):
+    """Decorator: migration of one op's saved (const_args, kwargs) from
+    `from_version` to `from_version + 1`."""
+    def deco(fn):
+        _OP_MIGRATIONS[(op_type, from_version)] = fn
+        return fn
+    return deco
+
+
+def migrate_op_entry(op_type: str, saved_version: int, const_args,
+                     kwargs):
+    """Bring one deserialized op's attributes up to the current
+    registered version."""
+    current = op_version(op_type)
+    if saved_version > current:
+        raise ValueError(
+            f"op '{op_type}' was saved at version {saved_version} but "
+            f"this framework implements version {current}; upgrade the "
+            "framework to load this program")
+    v = saved_version
+    while v < current:
+        fn = _OP_MIGRATIONS.get((op_type, v))
+        if fn is None:
+            raise ValueError(
+                f"op '{op_type}' has no registered migration from "
+                f"version {v} -> {v + 1}")
+        const_args, kwargs = fn(const_args, kwargs)
+        v += 1
+    return const_args, kwargs
+
+
+# -- program format ---------------------------------------------------------
+_PROGRAM_MIGRATIONS: Dict[int, Callable[[dict], dict]] = {}
+
+
+def _register_program_migration(from_version: int):
+    def deco(fn):
+        _PROGRAM_MIGRATIONS[from_version] = fn
+        return fn
+    return deco
+
+
+@_register_program_migration(1)
+def _program_v1_to_v2(d: dict) -> dict:
+    # v1 had no op_versions: everything it could save was version 1
+    d = dict(d)
+    d["op_versions"] = {}
+    d["version"] = 2
+    return d
+
+
+def migrate_program_dict(d: dict) -> dict:
+    v = int(d.get("version", 1))
+    if v > PROGRAM_FORMAT_VERSION:
+        raise ValueError(
+            f"program was saved with format version {v}; this framework "
+            f"reads up to {PROGRAM_FORMAT_VERSION} — upgrade to load it")
+    while v < PROGRAM_FORMAT_VERSION:
+        fn = _PROGRAM_MIGRATIONS.get(v)
+        if fn is None:
+            raise ValueError(f"no program migration from version {v}")
+        d = fn(d)
+        v = int(d["version"])
+    return d
+
+
+# -- state bundle envelope --------------------------------------------------
+def check_state_format(data: Any):
+    """Return (payload, version) for a loaded paddle.save blob; raises on
+    a future format."""
+    if isinstance(data, dict) and "__paddle_tpu_format__" in data:
+        v = int(data["__paddle_tpu_format__"])
+        if v > STATE_FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint was saved with format version {v}; this "
+                f"framework reads up to {STATE_FORMAT_VERSION}")
+        return data["payload"], v
+    return data, 0  # legacy pre-envelope blob

@@ -63,14 +63,18 @@ def _decode(obj, return_numpy=False):
 
 
 def save(obj: Any, path: str, protocol: int = 4):
+    from .core.version_compat import STATE_FORMAT_VERSION
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, "wb") as f:
-        pickle.dump(_encode(obj), f, protocol=protocol)
+        pickle.dump({"__paddle_tpu_format__": STATE_FORMAT_VERSION,
+                     "payload": _encode(obj)}, f, protocol=protocol)
 
 
 def load(path: str, return_numpy: bool = False, **kwargs) -> Any:
+    from .core.version_compat import check_state_format
     with open(path, "rb") as f:
         data = pickle.load(f)
-    return _decode(data, return_numpy=return_numpy)
+    payload, _version = check_state_format(data)
+    return _decode(payload, return_numpy=return_numpy)

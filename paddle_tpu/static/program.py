@@ -257,8 +257,13 @@ class Program:
             vid: (t.name, np.asarray(t._data) if include_params else None,
                   str(t._data.dtype))
             for vid, t in self.params.items()}
+        from ..core.version_compat import (PROGRAM_FORMAT_VERSION,
+                                           op_version)
         return pickle.dumps({
-            "version": 1, "vars": vars_meta, "ops": ops,
+            "version": PROGRAM_FORMAT_VERSION,
+            "op_versions": {n.op_type: op_version(n.op_type)
+                            for n in self.ops},
+            "vars": vars_meta, "ops": ops,
             "feeds": list(self.feeds), "params": params,
             "buffer_ids": sorted(self.buffer_ids),
             "buffer_writes": list(self._buffer_writes),
@@ -268,7 +273,10 @@ class Program:
     @staticmethod
     def from_bytes(blob: bytes) -> "Program":
         import pickle
-        d = pickle.loads(blob)
+        from ..core.version_compat import (migrate_program_dict,
+                                           migrate_op_entry)
+        d = migrate_program_dict(pickle.loads(blob))
+        saved_op_versions = d.get("op_versions", {})
 
         def dec(v):
             if isinstance(v, tuple) and len(v) == 2:
@@ -296,9 +304,13 @@ class Program:
         for op_type, in_ids, const_args, kwargs, out_ids, multi in \
                 d["ops"]:
             fn = _registry.get_op(op_type).fn
-            p.ops.append(OpNode(op_type, fn, in_ids,
-                                [dec(c) for c in const_args],
-                                {k: dec(v) for k, v in kwargs.items()},
+            const_args = [dec(c) for c in const_args]
+            kwargs = {k: dec(v) for k, v in kwargs.items()}
+            # per-op version check + migration (op_version_registry.h)
+            const_args, kwargs = migrate_op_entry(
+                op_type, int(saved_op_versions.get(op_type, 1)),
+                const_args, kwargs)
+            p.ops.append(OpNode(op_type, fn, in_ids, const_args, kwargs,
                                 out_ids, multi))
         p.feeds = list(d["feeds"])
         p.buffer_ids = set(d.get("buffer_ids", ()))

@@ -1,0 +1,119 @@
+"""Artifact versioning: format stamps written + checked on load, legacy
+blobs migrate, future versions fail loudly, per-op migrations run.
+Reference contract: paddle/fluid/framework/op_version_registry.h."""
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import version_compat as vc
+from paddle_tpu import serialization
+
+
+def _capture_program():
+    from paddle_tpu.static import Program, program_guard
+
+    main = Program()
+    with program_guard(main):
+        x = paddle.static.data("x", [4, 8], "float32")
+        w = paddle.create_parameter([8, 2], "float32")
+        y = x @ w
+    return main, y
+
+
+def test_program_roundtrip_carries_versions():
+    main, _ = _capture_program()
+    blob = main.to_bytes()
+    d = pickle.loads(blob)
+    assert d["version"] == vc.PROGRAM_FORMAT_VERSION
+    assert "matmul_v2" in d["op_versions"]
+    from paddle_tpu.static import Program
+    p2 = Program.from_bytes(blob)
+    assert [n.op_type for n in p2.ops] == [n.op_type for n in main.ops]
+
+
+def test_v1_program_blob_migrates():
+    """a round-2-layout blob (version 1, no op_versions) still loads."""
+    main, _ = _capture_program()
+    d = pickle.loads(main.to_bytes())
+    del d["op_versions"]
+    d["version"] = 1
+    from paddle_tpu.static import Program
+    p2 = Program.from_bytes(pickle.dumps(d, protocol=4))
+    assert [n.op_type for n in p2.ops] == [n.op_type for n in main.ops]
+
+
+def test_future_program_version_rejected():
+    main, _ = _capture_program()
+    d = pickle.loads(main.to_bytes())
+    d["version"] = vc.PROGRAM_FORMAT_VERSION + 1
+    from paddle_tpu.static import Program
+    with pytest.raises(ValueError, match="format version"):
+        Program.from_bytes(pickle.dumps(d, protocol=4))
+
+
+def test_op_migration_runs_on_load():
+    """an op whose registered version moved gets its saved attrs
+    migrated (op_version_registry.h per-op contract)."""
+    main, _ = _capture_program()
+    blob = main.to_bytes()
+    old = vc.op_version("matmul_v2")
+    try:
+        vc.register_op_version("matmul_v2", old + 1)
+
+        @vc.register_op_migration("matmul_v2", old)
+        def _mig(const_args, kwargs):
+            kwargs = dict(kwargs, migrated=True)
+            return const_args, kwargs
+
+        from paddle_tpu.static import Program
+        p2 = Program.from_bytes(blob)
+        mm = [n for n in p2.ops if n.op_type == "matmul_v2"][0]
+        assert mm.kwargs.get("migrated") is True
+    finally:
+        vc._OP_VERSIONS.pop("matmul_v2", None)
+        vc._OP_MIGRATIONS.pop(("matmul_v2", old), None)
+
+
+def test_op_saved_newer_than_framework_rejected():
+    main, _ = _capture_program()
+    d = pickle.loads(main.to_bytes())
+    d["op_versions"] = dict(d["op_versions"], matmul_v2=99)
+    from paddle_tpu.static import Program
+    with pytest.raises(ValueError, match="version 99"):
+        Program.from_bytes(pickle.dumps(d, protocol=4))
+
+
+def test_state_dict_envelope_roundtrip(tmp_path):
+    p = str(tmp_path / "m.pdparams")
+    net = paddle.nn.Linear(4, 2)
+    serialization.save(net.state_dict(), p)
+    with open(p, "rb") as f:
+        raw = pickle.load(f)
+    assert raw["__paddle_tpu_format__"] == vc.STATE_FORMAT_VERSION
+    loaded = serialization.load(p)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["weight"]._data),
+        np.asarray(net.state_dict()["weight"]._data))
+
+
+def test_legacy_unversioned_state_blob_loads(tmp_path):
+    """pre-envelope (round-2) paddle.save blobs load as format v0."""
+    p = str(tmp_path / "legacy.pdparams")
+    from paddle_tpu.serialization import _encode
+    net = paddle.nn.Linear(4, 2)
+    with open(p, "wb") as f:
+        pickle.dump(_encode(net.state_dict()), f, protocol=4)
+    loaded = serialization.load(p)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["bias"]._data),
+        np.asarray(net.state_dict()["bias"]._data))
+
+
+def test_future_state_format_rejected(tmp_path):
+    p = str(tmp_path / "future.pdparams")
+    with open(p, "wb") as f:
+        pickle.dump({"__paddle_tpu_format__": 99, "payload": {}}, f)
+    with pytest.raises(ValueError, match="format version 99"):
+        serialization.load(p)

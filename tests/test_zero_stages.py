@@ -23,6 +23,13 @@ from paddle_tpu.static import TrainStep
 DP = 8
 
 
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
 def _build(stage, seed=0):
     mesh = dist.build_mesh({"dp": DP}, devices=jax.devices()[:DP])
     dist.set_mesh(mesh)
