@@ -59,6 +59,7 @@ def test_op_migration_runs_on_load():
     main, _ = _capture_program()
     blob = main.to_bytes()
     old = vc.op_version("matmul_v2")
+    had_entry = "matmul_v2" in vc._OP_VERSIONS
     try:
         vc.register_op_version("matmul_v2", old + 1)
 
@@ -72,7 +73,10 @@ def test_op_migration_runs_on_load():
         mm = [n for n in p2.ops if n.op_type == "matmul_v2"][0]
         assert mm.kwargs.get("migrated") is True
     finally:
-        vc._OP_VERSIONS.pop("matmul_v2", None)
+        if had_entry:  # restore the real registration, don't unregister
+            vc._OP_VERSIONS["matmul_v2"] = old
+        else:
+            vc._OP_VERSIONS.pop("matmul_v2", None)
         vc._OP_MIGRATIONS.pop(("matmul_v2", old), None)
 
 
