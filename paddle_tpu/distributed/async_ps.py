@@ -1,0 +1,234 @@
+"""Async / geo parameter-server semantics over the host embedding KV.
+
+Reference capabilities covered (the round-2 gap):
+  - async communicator (operators/distributed/communicator.cc): trainer
+    pushes grads into per-table queues; background communicator threads
+    MERGE pending batches by key (sum, up to max_merge_var_num) and
+    apply them to the table off the critical path. Staleness is bounded:
+    past `max_pending` queued batches the push blocks (the reference's
+    half-async barrier; communicator.cc merged-grad queue cap).
+  - geo-SGD (AsyncConfig, distributed_strategy.proto:106): each worker
+    trains dense params locally; every k steps it ships the param DELTA
+    since its last sync, deltas are summed across workers, and every
+    worker rebases onto snapshot + sum(deltas) — local progress is kept,
+    remote progress arrives k-step-late (the geo staleness contract).
+
+TPU-first shape: the "server" is the host KV table (embedding_kv.py);
+merging is numpy by key; cross-worker delta reduction rides the same
+XLA collective path as training (psum over the dp axis of the global
+mesh) instead of BRPC — on a pod that is ICI/DCN, in the multiprocess
+test it is the coordination-service CPU backend.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .embedding_kv import EmbeddingKV
+
+__all__ = ["AsyncEmbeddingKV", "GeoSGD"]
+
+
+class AsyncEmbeddingKV:
+    """communicator.cc analogue around an EmbeddingKV.
+
+    push() enqueues and returns immediately; a daemon communicator
+    thread merges up to `merge_var_num` pending (ids, grads) batches by
+    key and applies them as ONE sparse update. pull() reads the live
+    table (stale by at most `max_pending` merged batches — the bounded-
+    staleness knob; push blocks when the queue is full).
+    """
+
+    def __init__(self, kv: EmbeddingKV, merge_var_num: int = 20,
+                 max_pending: int = 64):
+        self.kv = kv
+        self.merge_var_num = int(merge_var_num)
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(max_pending))
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._communicate,
+                                        daemon=True,
+                                        name="kv-communicator")
+        self._thread.start()
+
+    def _raise_if_failed(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "kv communicator thread failed applying a pushed "
+                "batch") from err
+
+    # -- trainer side -------------------------------------------------------
+    def pull(self, ids) -> np.ndarray:
+        return self.kv.pull(ids)
+
+    def push(self, ids, grads, block: bool = True) -> None:
+        """Enqueue a sparse grad batch. Blocks when `max_pending` batches
+        are outstanding (bounded staleness / half-async back-pressure)."""
+        self._raise_if_failed()
+        ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], -1)
+        self._idle.clear()
+        self._q.put((ids, grads.copy()), block=block)
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Barrier: wait until every queued push has been applied (the
+        reference's Communicator::Barrier on sync points). Raises
+        TimeoutError past `timeout`, and re-raises any communicator
+        failure instead of hanging on work that will never finish."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            self._raise_if_failed()
+            if self._q.unfinished_tasks == 0 and self._idle.is_set():
+                return
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"kv communicator barrier not reached in {timeout}s "
+                    f"({self._q.unfinished_tasks} batches outstanding)")
+            _time.sleep(0.005)
+
+    def close(self) -> None:
+        if not self._stop.is_set():
+            self.flush()
+            self._stop.set()
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- communicator thread ------------------------------------------------
+    def _communicate(self):
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                self._idle.set()
+                continue
+            batch = [first]
+            # merge window: whatever else is already queued, capped
+            while len(batch) < self.merge_var_num:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            ids = np.concatenate([b[0] for b in batch])
+            grads = np.concatenate([b[1] for b in batch], axis=0)
+            uniq, inverse = np.unique(ids, return_inverse=True)
+            merged = np.zeros((uniq.shape[0], grads.shape[1]), np.float32)
+            np.add.at(merged, inverse, grads)  # sum-merge by key
+            try:
+                self.kv.push(uniq, merged)
+            except BaseException as e:  # surface on the trainer thread
+                self._error = e
+            finally:
+                for _ in batch:
+                    self._q.task_done()
+            if self._q.empty():
+                self._idle.set()
+
+
+class GeoSGD:
+    """Geo-SGD periodic dense sync (AsyncConfig k_steps contract).
+
+    Workers train local copies; every `sync_steps` calls of step(),
+    each worker computes delta = param - snapshot, the deltas are summed
+    across workers by `reduce_fn`, and every worker rebases to
+    snapshot + sum(deltas). With one worker this degenerates to a no-op
+    rebase (the SPMD degeneration the launcher docs describe).
+
+    reduce_fn(tree of np arrays) -> tree of np arrays; default uses a
+    cross-process psum over the global device mesh when
+    jax.distributed is initialized, else identity.
+    """
+
+    def __init__(self, params: Dict[str, object], sync_steps: int = 4,
+                 reduce_fn: Optional[Callable] = None):
+        from ..framework import Tensor
+        self._tensors = {k: v for k, v in params.items()}
+        self.sync_steps = int(sync_steps)
+        self.reduce_fn = reduce_fn or _default_delta_reduce
+        self._step = 0
+        self._snapshot = {
+            k: np.asarray(v._data if isinstance(v, Tensor) else v).copy()
+            for k, v in self._tensors.items()}
+
+    def step(self) -> bool:
+        """Count one local step; runs the geo sync when due. Returns
+        True when a sync happened."""
+        self._step += 1
+        if self._step % self.sync_steps != 0:
+            return False
+        self.sync()
+        return True
+
+    def sync(self) -> None:
+        from ..framework import Tensor
+        import jax.numpy as jnp
+        deltas = {}
+        for k, t in self._tensors.items():
+            cur = np.asarray(t._data if isinstance(t, Tensor) else t)
+            deltas[k] = cur - self._snapshot[k]
+        total = self.reduce_fn(deltas)
+        for k, t in self._tensors.items():
+            new = self._snapshot[k] + total[k]
+            self._snapshot[k] = new.copy()
+            if isinstance(t, Tensor):
+                t._data = jnp.asarray(new)
+            else:
+                # write IN PLACE: the caller keeps training on this very
+                # array, a rebind would silently detach it
+                t[...] = new
+
+
+def _default_delta_reduce(deltas: Dict[str, np.ndarray]):
+    """Sum deltas across processes via the XLA collective path (the
+    BRPC-send replacement). Single-process: identity."""
+    import jax
+    if jax.process_count() <= 1:
+        return deltas
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+    # one device per process (consistent choice on every controller)
+    first_by_proc = {}
+    for d in jax.devices():
+        first_by_proc.setdefault(d.process_index, d)
+    devs = [first_by_proc[p] for p in sorted(first_by_proc)]
+    mesh = Mesh(np.array(devs), ("geo",))
+    summed = _sum_over_procs(mesh)
+    out = {}
+    for k, d in deltas.items():
+        # stack local delta on the process axis, psum via jitted sum
+        local = jnp.asarray(d)[None]
+        garr = jax.make_array_from_single_device_arrays(
+            (len(devs),) + d.shape,
+            NamedSharding(mesh, P("geo")),
+            [jax.device_put(local, jax.local_devices()[0])])
+        out[k] = np.asarray(summed(garr))
+    return out
+
+
+_SUM_JIT_CACHE: dict = {}
+
+
+def _sum_over_procs(mesh):
+    """One cached jitted reduction per mesh (new lambda per call would
+    miss the jit cache and recompile every key every sync)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    key = tuple(d.id for d in mesh.devices.flat)
+    fn = _SUM_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda a: jnp.sum(a, axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+        _SUM_JIT_CACHE[key] = fn
+    return fn

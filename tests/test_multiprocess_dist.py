@@ -114,3 +114,24 @@ def test_two_process_dp_trainstep(tmp_path):
         y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
         control.append(float(step(x, y).item()))
     np.testing.assert_allclose(results[0]["losses"], control, rtol=2e-4)
+
+
+def test_two_process_geo_sgd_sync(tmp_path):
+    """geo-SGD delta aggregation across two real processes: both ranks
+    converge to snapshot + sum of every rank's local delta."""
+    env = dict(os.environ)
+    env.update({
+        "PD_TEST_COORD_PORT": str(_free_port()),
+        "PD_TEST_OUT": str(tmp_path),
+        "XLA_FLAGS": "",
+    })
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2",
+           os.path.join(REPO, "tests", "geo_sgd_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=180)
+    assert res.returncode == 0, (
+        f"launch failed\nstdout:\n{res.stdout}\nstderr:\n{res.stderr}")
+    for r in range(2):
+        out = json.loads((tmp_path / f"rank{r}.json").read_text())
+        np.testing.assert_allclose(out["param"], [23.0] * 4, rtol=1e-6)
