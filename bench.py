@@ -291,6 +291,24 @@ def main():
     except Exception as e:  # pragma: no cover
         add_us = mm_us = -1.0
         errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
+    # pipeline receipt runs in its own process (needs a multi-device
+    # virtual CPU mesh, which this process may not be able to provide
+    # once a TPU backend is initialized)
+    pipeline_stats = None
+    try:
+        import subprocess
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "pipeline_bench.py")],
+            capture_output=True, text=True, timeout=600)
+        if p.returncode == 0 and p.stdout.strip():
+            pipeline_stats = json.loads(
+                p.stdout.strip().splitlines()[-1])
+        else:
+            errors["pipeline"] = (p.stderr or "no output").strip()[-300:]
+    except Exception as e:  # pragma: no cover
+        errors["pipeline"] = f"{type(e).__name__}: {e}"
 
     # record which attention path the ERNIE step actually used (the
     # dropout kernel self-check can fall back to SDPA-with-dropout)
@@ -327,6 +345,7 @@ def main():
             "eager_add_overhead_us": round(add_us, 1),
             "eager_matmul_overhead_us": round(mm_us, 1),
             "attention_path": attn_path,
+            **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),
         },
     }))

@@ -183,7 +183,15 @@ class _Stage:
 
         first = self.is_first
 
-        def bwd(params, buffers, key, x, gy):
+        def _acc(acc, gp):
+            # grad accumulation FUSED into the backward executable (a
+            # standalone tree_map add would be one extra dispatch per
+            # microbatch); acc=None on the stage's first backward
+            if acc is None:
+                return gp
+            return jax.tree_util.tree_map(jnp.add, acc, gp)
+
+        def bwd(params, buffers, key, x, gy, acc):
             # rematerialize the forward; differentiate wrt params (+ the
             # incoming activation unless this is stage 0 — its input is
             # raw data, often integer ids, and nothing consumes its grad)
@@ -193,16 +201,16 @@ class _Stage:
                     return y
                 _, vjp = jax.vjp(f0, params)
                 (gp,) = vjp(gy)
-                return gp, None
+                return _acc(acc, gp), None
 
             def f(p, xx):
                 y, _ = run(p, buffers, key, xx)
                 return y
             _, vjp = jax.vjp(f, params, x)
             gp, gx = vjp(gy)
-            return gp, gx
+            return _acc(acc, gp), gx
 
-        def last_fwd(params, buffers, key, x, labels, scale):
+        def last_fwd(params, buffers, key, x, labels, scale, acc):
             # grads are of (loss * scale) — fp16 loss scaling; the
             # reported loss stays unscaled (aux)
             if first:  # single-stage pipeline: input is raw data
@@ -212,7 +220,7 @@ class _Stage:
                     return l * scale, (l, nb)
                 (_, (loss, nb)), gp = jax.value_and_grad(
                     f0, has_aux=True)(params)
-                return loss, nb, gp, None
+                return loss, nb, _acc(acc, gp), None
 
             def f(p, xx):
                 y, nb = run(p, buffers, key, xx)
@@ -220,11 +228,12 @@ class _Stage:
                 return l * scale, (l, nb)
             (_, (loss, nb)), (gp, gx) = jax.value_and_grad(
                 f, argnums=(0, 1), has_aux=True)(params, x)
-            return loss, nb, gp, gx
+            return loss, nb, _acc(acc, gp), gx
 
         self.fwd_jit = jax.jit(fwd)
-        self.bwd_jit = jax.jit(bwd)
-        self.last_jit = jax.jit(last_fwd) if self.is_last else None
+        self.bwd_jit = jax.jit(bwd, donate_argnums=(5,))
+        self.last_jit = jax.jit(last_fwd, donate_argnums=(6,)) \
+            if self.is_last else None
 
     def place_input(self, x, dp_shard: bool = True):
         """Move an activation/batch onto this stage's submesh (the
@@ -276,14 +285,35 @@ class PipelineParallel:
             for i, layer in enumerate(stages)]
         self.opt_states = [optimizer.init_state_tree(s.params)
                            for s in self.stages]
-        self._opt_jit = jax.jit(
-            lambda p, g, st, lr: optimizer.apply_gradients_tree(
-                p, g, st, lr=lr))
-        from ..amp.functional import check_finite_and_unscale_tree
-        self._unscale_jit = jax.jit(check_finite_and_unscale_tree)
+        M = self.num_micro
+
+        # ONE jitted call per stage for the whole optimize phase: the
+        # microbatch mean, the loss-scale unscale, the finite-gated
+        # where-select (skipped-step semantics), and the optimizer update
+        # all fuse — no host bool decides whether to dispatch (the
+        # reference SectionWorker's optimize ops, amp ops included)
+        def update(params, grads, opt_state, lr, scale, found_inf):
+            grads = jax.tree_util.tree_map(
+                lambda g: g / (M * scale), grads)
+            new_p, new_st = optimizer.apply_gradients_tree(
+                params, grads, opt_state, lr=lr)
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(found_inf, o, n), new, old)
+            return keep(new_p, params), keep(new_st, opt_state)
+        # only grads donate: params/opt_state feed the found_inf
+        # where-select, so both old and new values are live at once
+        self._opt_jit = jax.jit(update, donate_argnums=(1,))
+
+        def found_inf_flag(grads):
+            leaves = [jnp.all(jnp.isfinite(g))
+                      for g in jax.tree_util.tree_leaves(grads)]
+            return ~jnp.stack(leaves).all()
+        self._inf_jit = jax.jit(found_inf_flag)
+        self._any_jit = jax.jit(lambda *fs: jnp.stack(fs).any())
         self._sched = build_1f1b_schedule(len(stages), self.num_micro,
                                           schedule)
         self._step_count = 0
+        self.last_dispatch_count = 0  # jit dispatches in the last batch
 
     # -- one full batch ------------------------------------------------------
     def train_batch(self, inputs, labels=(), scaler=None):
@@ -326,15 +356,9 @@ class PipelineParallel:
         gys: List[Dict[int, Any]] = [dict() for _ in range(S)]
         keys = [[jax.random.fold_in(jax.random.fold_in(key, s), m)
                  for m in range(M)] for s in range(S)]
-        grad_acc = [None] * S
+        grad_acc = [None] * S  # carried INSIDE the fused bwd calls
         losses = []
-
-        def add_grads(s, gp):
-            if grad_acc[s] is None:
-                grad_acc[s] = gp
-            else:
-                grad_acc[s] = jax.tree_util.tree_map(
-                    jnp.add, grad_acc[s], gp)
+        dispatches = 0
 
         for op, s, m in self._sched:
             stage = self.stages[s]
@@ -347,54 +371,56 @@ class PipelineParallel:
                 acts[s][m] = x
                 if stage.is_last:
                     lbl = stage.place_input(micro(lbl_arrays, m))
-                    loss, nb, gp, gx = stage.last_jit(
+                    loss, nb, grad_acc[s], gx = stage.last_jit(
                         stage.params, stage.buffers, keys[s][m], x, lbl,
-                        scale_val)
+                        scale_val, grad_acc[s])
                     stage.buffers = nb
                     losses.append(loss)
-                    add_grads(s, gp)
                     gys[s][m] = gx  # consumed by this stage's own B
                 else:
                     y, nb = stage.fwd_jit(stage.params, stage.buffers,
                                           keys[s][m], x)
                     stage.buffers = nb
                     acts[s + 1][m] = self.stages[s + 1].place_input(y)
+                dispatches += 1
             else:  # B
                 if stage.is_last:
                     # grads were produced together with the loss in F
                     gx = gys[s].pop(m)
                 else:
                     gy = gys[s].pop(m)
-                    gp, gx = stage.bwd_jit(stage.params, stage.buffers,
-                                           keys[s][m], acts[s][m], gy)
-                    add_grads(s, gp)
+                    grad_acc[s], gx = stage.bwd_jit(
+                        stage.params, stage.buffers, keys[s][m],
+                        acts[s][m], gy, grad_acc[s])
+                    dispatches += 1
                 del acts[s][m]  # 1f1b frees this activation now
                 if s > 0:
                     gys[s - 1][m] = self.stages[s - 1].place_input(gx)
 
-        # optimize (reference SectionWorker optimize phase)
+        # optimize (reference SectionWorker optimize phase): one fused
+        # update dispatch per stage; the overflow check gates the update
+        # IN-GRAPH (jnp.where), so no host bool sits between backward
+        # and the updates
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         self._step_count += 1
         mean_losses = jnp.mean(jnp.stack(
             [jnp.asarray(l) for l in losses]))
-        stage_grads = [
-            jax.tree_util.tree_map(lambda g: g / M, grad_acc[s])
-            for s in range(len(self.stages))]
         if use_scaler:
-            unscaled, flags = [], []
-            for g in stage_grads:
-                ug, inf = self._unscale_jit(g, scale_val)
-                unscaled.append(ug)
-                flags.append(inf)
-            found_inf = bool(np.any([np.asarray(f) for f in flags]))
-            if found_inf:  # skip the whole update, decay the scale
-                scaler._update(True)
-                return Tensor(mean_losses)
-            stage_grads = unscaled
-            scaler._update(False)
+            flags = [self._inf_jit(g) for g in grad_acc]
+            found_inf = self._any_jit(*flags)
+            dispatches += S + 1
+        else:
+            found_inf = jnp.asarray(False)
         for s, stage in enumerate(self.stages):
             stage.params, self.opt_states[s] = self._opt_jit(
-                stage.params, stage_grads[s], self.opt_states[s], lr)
+                stage.params, grad_acc[s], self.opt_states[s], lr,
+                scale_val, found_inf)
+            dispatches += 1
+        if use_scaler:
+            # the scaler's host state machine advances AFTER every device
+            # update is dispatched — the read no longer gates any work
+            scaler._update(bool(np.asarray(found_inf)))
+        self.last_dispatch_count = dispatches
         return Tensor(mean_losses)
 
     # predict-only path (no labels/backward)

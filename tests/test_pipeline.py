@@ -257,3 +257,27 @@ class TestErnieStagesMask:
         # masking pads must change the logits at unmasked positions
         assert not np.allclose(np.asarray(out_masked[0]._data[:, 0]),
                                np.asarray(out_plain[0]._data[:, 0]))
+
+
+class TestDispatchBudget:
+    def test_dispatches_per_step_counted_and_fused(self):
+        """orchestration receipt: grad accumulation and the optimizer
+        update (incl. AMP gating) are fused into the per-microbatch
+        calls — dispatches/step is exactly S*M forwards + (S-1)*M
+        backwards + S updates (+S+1 AMP flag ops with a scaler), with
+        no standalone accumulate/unscale dispatches."""
+        S, M = 3, 4
+        stages = _mlp_stages()
+        opt = paddle.optimizer.SGD(learning_rate=1e-3)
+        pp = PipelineParallel(stages, lambda o, y: F.mse_loss(o, y),
+                              opt, num_micro=M)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        pp.train_batch(x, y)
+        assert pp.last_dispatch_count == S * M + (S - 1) * M + S
+
+        from paddle_tpu.amp import GradScaler
+        scaler = GradScaler(init_loss_scaling=2.0 ** 8)
+        pp.train_batch(x, y, scaler=scaler)
+        assert pp.last_dispatch_count == S * M + (S - 1) * M + S + S + 1

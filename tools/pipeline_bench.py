@@ -1,0 +1,177 @@
+"""Pipeline throughput receipt (run by bench.py in a subprocess with a
+forced virtual-CPU mesh; also runnable standalone).
+
+Prints ONE JSON line: pipeline tokens/s over pp=S stage submeshes vs
+the identical model as a single-device TrainStep, the ideal speedup
+S*M/(M+S-1) (perfect split, 1F1B bubble), the schedule efficiency
+(measured speedup / ideal), and the host dispatch count per step
+(section_worker.cc:34's tight loop is the contract: orchestration must
+not dominate).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEV = int(os.environ.get("PD_PIPE_BENCH_DEVICES", 4))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", N_DEV)
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.static import TrainStep
+
+    S = N_DEV          # one stage per device
+    M = 4              # microbatches
+    batch, width, depth_per_stage = 64, 1024, 3
+    steps = 5
+
+    def make_stage():
+        layers = []
+        for _ in range(depth_per_stage):
+            layers += [nn.Linear(width, width), nn.ReLU()]
+        return nn.Sequential(*layers)
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+
+    # -- pipeline over pp=S ------------------------------------------------
+    paddle.seed(0)
+    stages = [make_stage() for _ in range(S)]
+    mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+    opt = paddle.optimizer.SGD(learning_rate=1e-3)
+    engine = dist.PipelineParallel(stages, loss_fn, opt, num_micro=M,
+                                   mesh=mesh)
+    engine.train_batch(x, y)            # compile
+    float(engine.train_batch(x, y).item())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(x, y)
+    float(loss.item())
+    pipe_t = (time.perf_counter() - t0) / steps
+    dispatches = engine.last_dispatch_count
+
+    # -- identical model, single device ------------------------------------
+    paddle.seed(0)
+    whole = nn.Sequential(*[make_stage() for _ in range(S)])
+    opt2 = paddle.optimizer.SGD(learning_rate=1e-3,
+                                parameters=whole.parameters())
+    dist.set_mesh(None)
+    step = TrainStep(whole, loss_fn, opt2)
+    step(x, y)
+    float(step(x, y).item())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss.item())
+    single_t = (time.perf_counter() - t0) / steps
+
+    # schedule efficiency against the measured per-microbatch stage
+    # cost: ideal 1F1B step = (M + S - 1) ticks x (tF + tB). This
+    # isolates bubble + orchestration overhead from how well the N
+    # virtual CPU devices actually parallelize (they share cores here;
+    # on real chips the same formula is the true bubble receipt).
+    st0 = engine.stages[0]
+    micro_x = st0.place_input((x._data[: batch // M],))[0]
+    import jax as _jax
+    y0, _ = st0.fwd_jit(st0.params, st0.buffers,
+                        _jax.random.key(0), micro_x)
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        y0, _ = st0.fwd_jit(st0.params, st0.buffers,
+                            _jax.random.key(0), micro_x)
+    np.asarray(y0).ravel()[:1]
+    t_f = (time.perf_counter() - t0) / reps
+    gacc, gx = st0.bwd_jit(st0.params, st0.buffers, _jax.random.key(0),
+                           micro_x, y0, None)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        gacc, gx = st0.bwd_jit(st0.params, st0.buffers,
+                               _jax.random.key(0), micro_x, y0, None)
+    np.asarray(next(iter(
+        jax.tree_util.tree_leaves(gacc)))).ravel()[:1]
+    t_b = (time.perf_counter() - t0) / reps
+    ideal_step = (M + S - 1) * (t_f + t_b)
+    ideal = S * M / (M + S - 1)
+
+    # -- whole-graph pipeline: ONE dispatch per step --------------------
+    # (pipeline.py gpipe_schedule: stacked stage params sharded over pp,
+    # ppermute ring, fwd+bwd+update all inside a single jitted program —
+    # the dispatch-bound answer when stages are homogeneous)
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.pipeline import gpipe_schedule
+    import paddle_tpu.distributed.env as env
+
+    rngk = np.random.RandomState(1)
+    wg_params = {}
+    for i in range(depth_per_stage):
+        wg_params[f"w{i}"] = jnp.asarray(
+            rngk.randn(S, width, width).astype(np.float32) * 0.02)
+        wg_params[f"b{i}"] = jnp.zeros((S, width), jnp.float32)
+    micro_b = batch // M
+    xg = jnp.asarray(rng.randn(M, micro_b, width).astype(np.float32))
+    yg = jnp.asarray(rng.randn(M, micro_b, width).astype(np.float32))
+
+    def block_fn(p, xm):
+        h = xm
+        for i in range(depth_per_stage):
+            h = jnp.maximum(h @ p[f"w{i}"] + p[f"b{i}"], 0.0)
+        return h
+
+    def spmd(params, x, yy):
+        local = {k: v[0] for k, v in params.items()}
+        with env.axis_context("pp"):
+            out = gpipe_schedule(block_fn, local, x, M, axis="pp")
+        return ((out - yy) ** 2).mean()
+
+    loss_g = shard_map(spmd, mesh=mesh,
+                       in_specs=(P("pp"), P(), P()), out_specs=P(),
+                       check_vma=False)
+
+    @jax.jit
+    def wg_step(params, x, yy):
+        g = jax.grad(lambda p: loss_g(p, x, yy))(params)
+        return jax.tree_util.tree_map(
+            lambda p, gg: p - 1e-3 * gg, params, g)
+
+    wg_params = wg_step(wg_params, xg, yg)   # compile
+    np.asarray(wg_params["w0"]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        wg_params = wg_step(wg_params, xg, yg)
+    np.asarray(wg_params["w0"]).ravel()[:1]
+    wg_t = (time.perf_counter() - t0) / steps
+    print(json.dumps({
+        "pipeline_rows_per_sec": round(batch / pipe_t, 1),
+        "single_chip_rows_per_sec": round(batch / single_t, 1),
+        "speedup_vs_single": round(single_t / pipe_t, 3),
+        "ideal_speedup": round(ideal, 3),
+        "stage_micro_fwd_ms": round(t_f * 1e3, 3),
+        "stage_micro_bwd_ms": round(t_b * 1e3, 3),
+        "schedule_efficiency": round(ideal_step / pipe_t, 3),
+        "dispatches_per_step": dispatches,
+        "whole_graph_rows_per_sec": round(batch / wg_t, 1),
+        "whole_graph_dispatches_per_step": 1,
+        "stages": S, "num_micro": M,
+    }))
+
+
+if __name__ == "__main__":
+    main()
