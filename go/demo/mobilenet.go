@@ -1,0 +1,54 @@
+// Demo mirroring the reference go/demo/mobilenet.go: load a saved
+// inference model and run one batch.
+//
+//	go run mobilenet.go -model /path/to/prefix -params /path/to/prefix.pdiparams
+package main
+
+import (
+	"flag"
+	"fmt"
+	"log"
+
+	paddle "paddle_tpu/go/paddle"
+)
+
+func main() {
+	model := flag.String("model", "model", "inference model prefix")
+	params := flag.String("params", "", "params path (defaults beside prefix)")
+	repo := flag.String("repo", "../..", "paddle_tpu repo root")
+	flag.Parse()
+
+	if err := paddle.Init(*repo); err != nil {
+		log.Fatal(err)
+	}
+	defer paddle.Finalize()
+
+	cfg := paddle.NewAnalysisConfig()
+	defer cfg.Delete()
+	cfg.SetModel(*model, *params)
+
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		log.Fatal(err)
+	}
+	defer pred.Delete()
+
+	batch := []float32{}
+	for i := 0; i < 1*3*224*224; i++ {
+		batch = append(batch, 0.5)
+	}
+	in := paddle.NewFloatTensor(pred.GetInputName(0),
+		[]int64{1, 3, 224, 224}, batch)
+	if err := pred.SetInput(in); err != nil {
+		log.Fatal(err)
+	}
+	if err := pred.Run(); err != nil {
+		log.Fatal(err)
+	}
+	out, err := pred.GetOutput(0)
+	if err != nil {
+		log.Fatal(err)
+	}
+	fmt.Printf("output shape %v, first vals %v\n",
+		out.Shape, out.FloatData[:4])
+}

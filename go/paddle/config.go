@@ -1,0 +1,70 @@
+// Package paddle is the Go inference/training client over the
+// paddle_tpu C API (csrc/paddle_tpu_capi.h), mirroring the reference
+// go/paddle/{config,predictor,tensor}.go surface.
+//
+// Build: the cgo directives below expect the shared library built by
+// `make -C csrc libpaddletpu_capi.so`; set CGO_LDFLAGS/LD_LIBRARY_PATH
+// to the csrc directory. NOTE: this build image ships no Go toolchain,
+// so this client is compile-verified only against the C header — run
+// `go vet ./...` + the demo on a machine with Go installed.
+package paddle
+
+/*
+#cgo CFLAGS: -I${SRCDIR}/../../csrc
+#cgo LDFLAGS: -L${SRCDIR}/../../csrc -lpaddletpu_capi
+#include <stdlib.h>
+#include "paddle_tpu_capi.h"
+*/
+import "C"
+import (
+	"errors"
+	"unsafe"
+)
+
+// Init starts the embedded runtime; call once, before anything else.
+func Init(repoRoot string) error {
+	c := C.CString(repoRoot)
+	defer C.free(unsafe.Pointer(c))
+	if C.PD_Init(c) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Finalize tears the runtime down.
+func Finalize() { C.PD_Finalize() }
+
+func lastError() error {
+	msg := C.GoString(C.PD_GetLastError())
+	if msg == "" {
+		msg = "unknown paddle_tpu C API error"
+	}
+	return errors.New(msg)
+}
+
+// AnalysisConfig mirrors the reference's config.go over
+// PD_AnalysisConfig.
+type AnalysisConfig struct {
+	c *C.PD_AnalysisConfig
+}
+
+func NewAnalysisConfig() *AnalysisConfig {
+	return &AnalysisConfig{c: C.PD_NewAnalysisConfig()}
+}
+
+// SetModel points the config at a saved inference model
+// (static.save_inference_model prefix + params path).
+func (cfg *AnalysisConfig) SetModel(modelPrefix, paramsPath string) {
+	m := C.CString(modelPrefix)
+	p := C.CString(paramsPath)
+	defer C.free(unsafe.Pointer(m))
+	defer C.free(unsafe.Pointer(p))
+	C.PD_SetModel(cfg.c, m, p)
+}
+
+func (cfg *AnalysisConfig) Delete() {
+	if cfg.c != nil {
+		C.PD_DeleteAnalysisConfig(cfg.c)
+		cfg.c = nil
+	}
+}

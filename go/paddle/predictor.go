@@ -1,0 +1,177 @@
+package paddle
+
+/*
+#include <stdlib.h>
+#include "paddle_tpu_capi.h"
+*/
+import "C"
+import (
+	"fmt"
+	"unsafe"
+)
+
+// Predictor mirrors the reference predictor.go over PD_Predictor
+// (csrc/capi.cpp AnalysisPredictor path: jax.export-compiled program).
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+func NewPredictor(cfg *AnalysisConfig) (*Predictor, error) {
+	p := C.PD_NewPredictor(cfg.c)
+	if p == nil {
+		return nil, lastError()
+	}
+	return &Predictor{c: p}, nil
+}
+
+func (p *Predictor) Delete() {
+	if p.c != nil {
+		C.PD_DeletePredictor(p.c)
+		p.c = nil
+	}
+}
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+func (p *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+}
+
+// SetInput feeds one named input tensor.
+func (p *Predictor) SetInput(t *Tensor) error {
+	var data unsafe.Pointer
+	switch t.Dtype {
+	case "float32":
+		data = unsafe.Pointer(&t.FloatData[0])
+	case "int32":
+		data = unsafe.Pointer(&t.Int32Data[0])
+	case "int64":
+		data = unsafe.Pointer(&t.Int64Data[0])
+	default:
+		return fmt.Errorf("unsupported input dtype %q", t.Dtype)
+	}
+	name := C.CString(t.Name)
+	dtype := C.CString(t.Dtype)
+	defer C.free(unsafe.Pointer(name))
+	defer C.free(unsafe.Pointer(dtype))
+	rc := C.PD_PredictorSetInput(
+		p.c, name, data, dtype,
+		(*C.int64_t)(unsafe.Pointer(&t.Shape[0])),
+		C.int(len(t.Shape)))
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Run executes the compiled program on the feeds set so far.
+func (p *Predictor) Run() error {
+	if C.PD_PredictorRun(p.c) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// GetOutput copies output i (converted to float32 by the C API).
+func (p *Predictor) GetOutput(i int) (*Tensor, error) {
+	ndim := int(C.PD_GetOutputNdim(p.c, C.int(i)))
+	if ndim < 0 {
+		return nil, lastError()
+	}
+	shape := make([]int64, ndim)
+	if ndim > 0 {
+		if C.PD_GetOutputShape(p.c, C.int(i),
+			(*C.int64_t)(unsafe.Pointer(&shape[0]))) != 0 {
+			return nil, lastError()
+		}
+	}
+	n := int64(1)
+	for _, s := range shape {
+		n *= s
+	}
+	out := make([]float32, n)
+	var dst *C.float
+	if n > 0 {
+		dst = (*C.float)(unsafe.Pointer(&out[0]))
+	}
+	got := int64(C.PD_CopyOutputFloat(p.c, C.int(i), dst, C.int64_t(n)))
+	if got < 0 {
+		return nil, lastError()
+	}
+	return &Tensor{Shape: shape, Dtype: "float32",
+		FloatData: out[:got]}, nil
+}
+
+// TrainSession wraps PD_TrainSession (the C++ train-demo capability:
+// load a serialized Program, run optimizer steps, save params back).
+type TrainSession struct {
+	c *C.PD_TrainSession
+}
+
+func NewTrainSession(programPath, lossName, optimizer string,
+	lr float32) (*TrainSession, error) {
+	pp := C.CString(programPath)
+	ln := C.CString(lossName)
+	op := C.CString(optimizer)
+	defer C.free(unsafe.Pointer(pp))
+	defer C.free(unsafe.Pointer(ln))
+	defer C.free(unsafe.Pointer(op))
+	s := C.PD_NewTrainSession(pp, ln, op, C.float(lr))
+	if s == nil {
+		return nil, lastError()
+	}
+	return &TrainSession{c: s}, nil
+}
+
+func (s *TrainSession) Delete() {
+	if s.c != nil {
+		C.PD_DeleteTrainSession(s.c)
+		s.c = nil
+	}
+}
+
+func (s *TrainSession) SetFeed(t *Tensor) error {
+	var data unsafe.Pointer
+	switch t.Dtype {
+	case "float32":
+		data = unsafe.Pointer(&t.FloatData[0])
+	case "int64":
+		data = unsafe.Pointer(&t.Int64Data[0])
+	case "int32":
+		data = unsafe.Pointer(&t.Int32Data[0])
+	default:
+		return fmt.Errorf("unsupported feed dtype %q", t.Dtype)
+	}
+	name := C.CString(t.Name)
+	dtype := C.CString(t.Dtype)
+	defer C.free(unsafe.Pointer(name))
+	defer C.free(unsafe.Pointer(dtype))
+	rc := C.PD_TrainSessionSetFeed(
+		s.c, name, data, dtype,
+		(*C.int64_t)(unsafe.Pointer(&t.Shape[0])),
+		C.int(len(t.Shape)))
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// RunStep runs one fused train step and returns the loss.
+func (s *TrainSession) RunStep() (float32, error) {
+	var loss C.float
+	if C.PD_TrainSessionRunStep(s.c, &loss) != 0 {
+		return 0, lastError()
+	}
+	return float32(loss), nil
+}
+
+// Save writes trained parameters back into the program file at path.
+func (s *TrainSession) Save(path string) error {
+	p := C.CString(path)
+	defer C.free(unsafe.Pointer(p))
+	if C.PD_TrainSessionSave(s.c, p) != 0 {
+		return lastError()
+	}
+	return nil
+}

@@ -1,0 +1,35 @@
+package paddle
+
+// Tensor is the host-side value passed to / received from a Predictor
+// (the reference tensor.go holds shape + data; dtype here is the C API
+// dtype string: "float32", "int32", "int64", "bool").
+type Tensor struct {
+	Name  string
+	Shape []int64
+	Dtype string
+	// exactly one of these is set, matching Dtype
+	FloatData []float32
+	Int32Data []int32
+	Int64Data []int64
+	BoolData  []bool
+}
+
+// NewFloatTensor builds a float32 input tensor.
+func NewFloatTensor(name string, shape []int64, data []float32) *Tensor {
+	return &Tensor{Name: name, Shape: shape, Dtype: "float32",
+		FloatData: data}
+}
+
+// NewInt64Tensor builds an int64 input tensor (ids, labels).
+func NewInt64Tensor(name string, shape []int64, data []int64) *Tensor {
+	return &Tensor{Name: name, Shape: shape, Dtype: "int64",
+		Int64Data: data}
+}
+
+func (t *Tensor) numel() int64 {
+	n := int64(1)
+	for _, s := range t.Shape {
+		n *= s
+	}
+	return n
+}
