@@ -21,6 +21,7 @@ from .embedding_kv import (EmbeddingKV, SparseEmbedding,  # noqa: F401
                            distributed_lookup_table, pull_sparse,
                            push_sparse)
 from .async_ps import AsyncEmbeddingKV, GeoSGD  # noqa: F401
+from .moe import MoELayer, moe_dispatch  # noqa: F401
 from .pipeline_engine import (PipelineParallel, build_1f1b_schedule,  # noqa: F401
                               stage_submeshes)
 from .recompute import recompute, recompute_sequential  # noqa: F401

@@ -1,0 +1,163 @@
+"""Mixture-of-Experts with expert parallelism (the 'ep' mesh axis).
+
+Reference lineage: PaddlePaddle grew MoE later
+(incubate/distributed/models/moe — MoELayer with a gate, per-rank
+experts, and an all-to-all token exchange); this snapshot predates it,
+but expert parallelism is a first-class strategy of the driver contract
+(tp/pp/dp/sp/ep), so the TPU build carries it natively.
+
+TPU-first (GShard-style dense dispatch): gating and the token->expert
+exchange are einsums over a dense dispatch mask — no host-side
+scatter. Experts are ONE stacked weight tensor with a leading expert
+axis annotated `P("ep", ...)`; under jit on an ep mesh, XLA lowers the
+dispatch/combine einsums into the all-to-all over ICI, exactly the
+exchange the reference performs with explicit collective calls. On a
+mesh without 'ep' the same program runs replicated (ShardingPlan
+sanitization drops the axis).
+
+Capacity semantics: each expert processes at most
+ceil(tokens/num_experts * capacity_factor * top_k) tokens per batch
+(each token takes up to top_k slots across experts); overflow
+tokens are DROPPED from the expert path (their combine weight is zero,
+the residual/skip path of the surrounding model carries them) — the
+GShard/Switch contract. The auxiliary load-balancing loss
+(Switch eq. 4) is returned for the trainer to add.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.initializer import XavierNormal
+
+__all__ = ["MoELayer", "moe_dispatch"]
+
+EXPERT_AXIS = "ep"
+
+
+def moe_dispatch(gate_logits, num_experts: int, top_k: int,
+                 capacity: int):
+    """Top-k token-choice routing with per-expert capacity.
+
+    gate_logits [N, E] -> (combine [N, E, C], dispatch [N, E, C] bool,
+    aux_loss scalar). Pure jnp; differentiable through the gate probs.
+    """
+    n = gate_logits.shape[0]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((n, num_experts, capacity), jnp.float32)
+    dispatch = jnp.zeros((n, num_experts, capacity), bool)
+    remaining = probs
+    # per-expert fill counters evolve across the k rounds
+    fill = jnp.zeros((num_experts,), jnp.int32)
+    first_choice_mask = None
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)               # [N]
+        onehot = jax.nn.one_hot(choice, num_experts,
+                                dtype=jnp.float32)            # [N, E]
+        if first_choice_mask is None:
+            first_choice_mask = onehot
+        # position of each token within its chosen expert (batch order —
+        # the deterministic GShard fill rule), offset by prior rounds
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + fill[None, :]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)              # [N]
+        keep = pos_tok < capacity
+        gate_val = jnp.sum(probs * onehot, axis=-1) * keep    # [N]
+        pos_idx = jnp.clip(pos_tok.astype(jnp.int32), 0, capacity - 1)
+        cap_onehot = jax.nn.one_hot(pos_idx, capacity,
+                                    dtype=jnp.float32)        # [N, C]
+        slot = onehot[:, :, None] * cap_onehot[:, None, :]    # [N, E, C]
+        combine = combine + gate_val[:, None, None] * slot
+        dispatch = dispatch | (slot > 0) & keep[:, None, None]
+        fill = fill + jnp.sum(onehot * keep[:, None],
+                              axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)  # next round: 2nd best
+
+    # Switch-style load-balancing loss on the FIRST choice: E * sum_e
+    # (fraction of tokens routed to e) * (mean gate prob of e)
+    density = jnp.mean(first_choice_mask, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(density * density_proxy)
+    return combine, dispatch, aux
+
+
+class MoELayer(Layer):
+    """Top-k gated mixture of expert FFNs over a stacked expert tensor.
+
+    forward(x [B, S, D]) -> y [B, S, D]; the auxiliary loss of the last
+    forward is on `.aux_loss` (a Tensor) — add it to the training loss
+    scaled by `aux_weight` (MoE trainers' standard contract).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 aux_weight: float = 0.01, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_weight = float(aux_weight)
+        self.gate = self.create_parameter(
+            (d_model, num_experts), default_initializer=XavierNormal())
+        self.w1 = self.create_parameter(
+            (num_experts, d_model, d_hidden),
+            default_initializer=XavierNormal())
+        self.b1 = self.create_parameter((num_experts, d_hidden),
+                                        is_bias=True)
+        self.w2 = self.create_parameter(
+            (num_experts, d_hidden, d_model),
+            default_initializer=XavierNormal())
+        self.b2 = self.create_parameter((num_experts, d_model),
+                                        is_bias=True)
+        # expert axis sharded over 'ep' (dropped automatically by
+        # ShardingPlan on meshes without it)
+        from jax.sharding import PartitionSpec as P
+        self.w1.sharding_spec = P(EXPERT_AXIS, None, None)
+        self.b1.sharding_spec = P(EXPERT_AXIS, None)
+        self.w2.sharding_spec = P(EXPERT_AXIS, None, None)
+        self.b2.sharding_spec = P(EXPERT_AXIS, None)
+        self.aux_loss: Optional[Tensor] = None
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(self.top_k, int(math.ceil(
+            n_tokens / self.num_experts * self.capacity_factor
+            * self.top_k)))
+
+    def forward(self, x):
+        from ..ops.registry import run_op
+
+        b, s = x.shape[0], x.shape[1]
+        cap = self._capacity(int(b) * int(s))
+
+        def impl(xd, gate, w1, b1, w2, b2):
+            tok = xd.reshape(-1, self.d_model)                 # [N, D]
+            logits = tok.astype(jnp.float32) @ gate            # [N, E]
+            combine, dispatch, aux = moe_dispatch(
+                logits, self.num_experts, self.top_k, cap)
+            # token -> expert slots (the all-to-all under an ep mesh)
+            expert_in = jnp.einsum(
+                "nec,nd->ecd", dispatch.astype(xd.dtype), tok)
+            h = jnp.maximum(
+                jnp.einsum("ecd,edh->ech", expert_in, w1)
+                + b1[:, None, :], 0.0)
+            out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+            y = jnp.einsum("nec,ecd->nd",
+                           combine.astype(xd.dtype), out)
+            return y.reshape(xd.shape), aux
+
+        y, aux = run_op("moe_layer", impl,
+                        (x, self.gate, self.w1, self.b1, self.w2,
+                         self.b2), {})
+        self.aux_loss = aux
+        return y
+
+    def extra_repr(self):
+        return (f"d_model={self.d_model}, experts={self.num_experts}, "
+                f"top_k={self.top_k}")

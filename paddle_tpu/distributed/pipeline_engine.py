@@ -22,6 +22,14 @@ form wins).
 Backward rematerializes the stage forward (jax.vjp inside the jitted
 backward) instead of shipping residuals across programs — the standard
 TPU trade (HBM is the bottleneck, recompute is cheap on the MXU).
+
+Controller scope: this engine drives per-stage executables from ONE
+controller, so every stage's devices must be addressable — one host's
+chips, or a Pathways-style single-controller runtime. On a
+multi-controller pod (standard jax.distributed), use the SPMD form
+instead (pipeline.py gpipe_schedule: the whole pipeline in one program
+over shard_map, identical on every controller); DESIGN.md records the
+trade.
 """
 from __future__ import annotations
 

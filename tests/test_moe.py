@@ -1,0 +1,162 @@
+"""MoE + expert parallelism (distributed/moe.py — GShard-style dense
+dispatch; ep-axis sharded stacked experts)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import MoELayer
+from paddle_tpu.distributed.moe import moe_dispatch
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_top1_huge_capacity_matches_manual_routing():
+    """top_k=1 with capacity >= tokens: y[token] must equal
+    gate_prob * FFN_{argmax expert}(token) exactly."""
+    paddle.seed(1)
+    d, h, e = 8, 16, 4
+    moe = MoELayer(d, h, num_experts=e, top_k=1, capacity_factor=float(e))
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 6, d).astype(np.float32)
+    y = _np(moe(paddle.to_tensor(x)))
+
+    tok = x.reshape(-1, d)
+    logits = tok @ _np(moe.gate)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    w1, b1 = _np(moe.w1), _np(moe.b1)
+    w2, b2 = _np(moe.w2), _np(moe.b2)
+    want = np.zeros_like(tok)
+    for i, t in enumerate(tok):
+        ex = int(np.argmax(probs[i]))
+        hdn = np.maximum(t @ w1[ex] + b1[ex], 0.0)
+        want[i] = probs[i, ex] * (hdn @ w2[ex] + b2[ex])
+    np.testing.assert_allclose(y.reshape(-1, d), want, rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_top2_combines_two_experts():
+    paddle.seed(2)
+    d, h, e = 8, 16, 4
+    moe = MoELayer(d, h, num_experts=e, top_k=2, capacity_factor=float(e))
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 5, d).astype(np.float32)
+    y = _np(moe(paddle.to_tensor(x)))
+
+    tok = x.reshape(-1, d)
+    logits = tok @ _np(moe.gate)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    w1, b1 = _np(moe.w1), _np(moe.b1)
+    w2, b2 = _np(moe.w2), _np(moe.b2)
+    want = np.zeros_like(tok)
+    for i, t in enumerate(tok):
+        top2 = np.argsort(-probs[i])[:2]
+        for ex in top2:
+            hdn = np.maximum(t @ w1[ex] + b1[ex], 0.0)
+            want[i] += probs[i, ex] * (hdn @ w2[ex] + b2[ex])
+    np.testing.assert_allclose(y.reshape(-1, d), want, rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_capacity_drops_overflow_tokens():
+    """5 tokens all routed to one expert, capacity 2: tokens 3+ get a
+    zero combine weight (GShard overflow-drop contract)."""
+    logits = jnp.asarray(np.tile([5.0, 0.0, 0.0], (5, 1)), jnp.float32)
+    combine, dispatch, _ = moe_dispatch(logits, num_experts=3, top_k=1,
+                                        capacity=2)
+    per_tok = np.asarray(combine.sum(axis=(1, 2)))
+    assert (per_tok[:2] > 0).all()
+    np.testing.assert_allclose(per_tok[2:], 0.0)
+    # dispatched slots: exactly 2, in batch order
+    assert int(np.asarray(dispatch).sum()) == 2
+
+
+def test_aux_loss_matches_switch_formula():
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(32, 4).astype(np.float32))
+    _, _, aux = moe_dispatch(logits, num_experts=4, top_k=2, capacity=32)
+    probs = np.exp(np.asarray(logits))
+    probs /= probs.sum(-1, keepdims=True)
+    first = np.zeros((32, 4))
+    first[np.arange(32), probs.argmax(-1)] = 1.0
+    want = 4 * np.sum(first.mean(0) * probs.mean(0))
+    np.testing.assert_allclose(float(aux), want, rtol=1e-5)
+    # balanced routing scores ~1, collapse scores ~E: uniform probs give
+    # aux ~= E * (1 * 1/E) = 1 for the density term of the argmax expert
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_moe_trains_and_loss_decreases():
+    paddle.seed(4)
+    moe = MoELayer(8, 16, num_experts=4, top_k=2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=moe.parameters())
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(4, 8, 8).astype(np.float32))
+    tgt = paddle.to_tensor(rng.randn(4, 8, 8).astype(np.float32))
+    losses = []
+    for _ in range(12):
+        y = moe(x)
+        loss = ((y - tgt) ** 2).mean() + moe.aux_weight * moe.aux_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_expert_parallel_sharding_and_equality():
+    """on an ep x dp mesh the stacked expert weights shard 1/ep per
+    device and the TrainStep loss matches the unsharded run."""
+    from paddle_tpu.static import TrainStep
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.moe = MoELayer(16, 32, num_experts=4, top_k=2,
+                                capacity_factor=4.0)
+
+        def forward(self, x):
+            return self.moe(x)
+
+    def build(mesh, plan):
+        paddle.seed(11)
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        return net, TrainStep(
+            net, lambda o, y: ((o - y) ** 2).mean(), opt,
+            mesh=mesh, sharding_plan=plan)
+
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(8, 4, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4, 16).astype(np.float32))
+
+    net0, plain = build(None, None)
+    ref = [float(plain(x, y).item()) for _ in range(3)]
+
+    mesh = dist.build_mesh({"ep": 4, "dp": 2},
+                           devices=jax.devices()[:8])
+    dist.set_mesh(mesh)
+    plan = dist.ShardingPlan(mesh, dp_axis="dp")
+    net1, sharded = build(mesh, plan)
+    got = [float(sharded(x, y).item()) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+    w1 = sharded.params["moe.w1"]
+    frac = (np.prod(w1.addressable_shards[0].data.shape)
+            / np.prod(w1.shape))
+    assert frac == pytest.approx(1 / 4), "expert axis not sharded"
