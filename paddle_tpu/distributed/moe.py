@@ -96,7 +96,8 @@ class MoELayer(Layer):
 
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  top_k: int = 2, capacity_factor: float = 1.25,
-                 aux_weight: float = 0.01, name=None):
+                 aux_weight: float = 0.01, activation: str = "relu",
+                 name=None):
         super().__init__()
         self.d_model = d_model
         self.d_hidden = d_hidden
@@ -104,6 +105,8 @@ class MoELayer(Layer):
         self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
         self.aux_weight = float(aux_weight)
+        self.activation = activation
+        self._act = getattr(jax.nn, activation)  # relu/gelu/silu/...
         self.gate = self.create_parameter(
             (d_model, num_experts), default_initializer=XavierNormal())
         self.w1 = self.create_parameter(
@@ -144,9 +147,9 @@ class MoELayer(Layer):
             # token -> expert slots (the all-to-all under an ep mesh)
             expert_in = jnp.einsum(
                 "nec,nd->ecd", dispatch.astype(xd.dtype), tok)
-            h = jnp.maximum(
+            h = self._act(
                 jnp.einsum("ecd,edh->ech", expert_in, w1)
-                + b1[:, None, :], 0.0)
+                + b1[:, None, :])
             out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
             y = jnp.einsum("nec,ecd->nd",
                            combine.astype(xd.dtype), out)

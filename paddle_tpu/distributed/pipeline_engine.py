@@ -186,6 +186,22 @@ class _Stage:
                                   *(x if isinstance(x, tuple) else (x,)))
             return out, {k: new_state[k] for k in buffers}
 
+        # stage-local losses (MoE load-balancing aux etc.): a stage Layer
+        # may expose pipeline_local_loss() -> traced scalar computed from
+        # its LAST forward; it joins the objective through this stage's
+        # own vjp (cotangent = loss scale), so the engine needs no
+        # cross-stage aux plumbing
+        local_fn = getattr(layer, "pipeline_local_loss", None)
+
+        def _local():
+            if local_fn is None:
+                return jnp.zeros((), jnp.float32)
+            a = local_fn()
+            if a is None:
+                return jnp.zeros((), jnp.float32)
+            a = a._data if isinstance(a, Tensor) else a
+            return a.astype(jnp.float32)
+
         def fwd(params, buffers, key, x):
             return run(params, buffers, key, x)
 
@@ -199,33 +215,35 @@ class _Stage:
                 return gp
             return jax.tree_util.tree_map(jnp.add, acc, gp)
 
-        def bwd(params, buffers, key, x, gy, acc):
+        def bwd(params, buffers, key, x, gy, scale, acc):
             # rematerialize the forward; differentiate wrt params (+ the
             # incoming activation unless this is stage 0 — its input is
-            # raw data, often integer ids, and nothing consumes its grad)
+            # raw data, often integer ids, and nothing consumes its grad).
+            # The (y, local) pair gets cotangent (gy, scale): the stage's
+            # local loss joins the (scaled) objective right here.
             if first:
                 def f0(p):
                     y, _ = run(p, buffers, key, x)
-                    return y
+                    return y, _local()
                 _, vjp = jax.vjp(f0, params)
-                (gp,) = vjp(gy)
+                (gp,) = vjp((gy, scale.astype(jnp.float32)))
                 return _acc(acc, gp), None
 
             def f(p, xx):
                 y, _ = run(p, buffers, key, xx)
-                return y
+                return y, _local()
             _, vjp = jax.vjp(f, params, x)
-            gp, gx = vjp(gy)
+            gp, gx = vjp((gy, scale.astype(jnp.float32)))
             return _acc(acc, gp), gx
 
         def last_fwd(params, buffers, key, x, labels, scale, acc):
-            # grads are of (loss * scale) — fp16 loss scaling; the
-            # reported loss stays unscaled (aux)
+            # grads are of ((loss + local) * scale) — fp16 loss scaling;
+            # the reported loss stays unscaled main loss (aux)
             if first:  # single-stage pipeline: input is raw data
                 def f0(p):
                     y, nb = run(p, buffers, key, x)
                     l = loss_pure(y, labels)
-                    return l * scale, (l, nb)
+                    return (l + _local()) * scale, (l, nb)
                 (_, (loss, nb)), gp = jax.value_and_grad(
                     f0, has_aux=True)(params)
                 return loss, nb, _acc(acc, gp), None
@@ -233,13 +251,13 @@ class _Stage:
             def f(p, xx):
                 y, nb = run(p, buffers, key, xx)
                 l = loss_pure(y, labels)
-                return l * scale, (l, nb)
+                return (l + _local()) * scale, (l, nb)
             (_, (loss, nb)), (gp, gx) = jax.value_and_grad(
                 f, argnums=(0, 1), has_aux=True)(params, x)
             return loss, nb, _acc(acc, gp), gx
 
         self.fwd_jit = jax.jit(fwd)
-        self.bwd_jit = jax.jit(bwd, donate_argnums=(5,))
+        self.bwd_jit = jax.jit(bwd, donate_argnums=(6,))
         self.last_jit = jax.jit(last_fwd, donate_argnums=(6,)) \
             if self.is_last else None
 
@@ -399,7 +417,7 @@ class PipelineParallel:
                     gy = gys[s].pop(m)
                     grad_acc[s], gx = stage.bwd_jit(
                         stage.params, stage.buffers, keys[s][m],
-                        acts[s][m], gy, grad_acc[s])
+                        acts[s][m], gy, scale_val, grad_acc[s])
                     dispatches += 1
                 del acts[s][m]  # 1f1b frees this activation now
                 if s > 0:

@@ -36,7 +36,9 @@ class ErnieConfig:
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
                  initializer_range=0.02, layer_norm_eps=1e-12,
-                 use_flash_attention=True):
+                 use_flash_attention=True, moe_num_experts=0,
+                 moe_top_k=2, moe_every_n_layers=2,
+                 moe_capacity_factor=1.25, moe_aux_weight=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -50,6 +52,17 @@ class ErnieConfig:
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
         self.use_flash_attention = use_flash_attention
+        # MoE variant: every n-th layer's FFN becomes a top-k expert
+        # mixture over the 'ep' mesh axis (distributed/moe.py); 0 = dense
+        self.moe_num_experts = moe_num_experts
+        self.moe_top_k = moe_top_k
+        self.moe_every_n_layers = moe_every_n_layers
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_weight = moe_aux_weight
+        if moe_num_experts > 0 and moe_every_n_layers < 1:
+            raise ValueError(
+                "moe_every_n_layers must be >= 1 when experts are "
+                "enabled (set moe_num_experts=0 for a dense model)")
 
     @classmethod
     def base(cls, **kw):
@@ -110,19 +123,29 @@ class ErnieSelfAttention(nn.Layer):
 
 
 class ErnieLayer(nn.Layer):
-    def __init__(self, config: ErnieConfig):
+    def __init__(self, config: ErnieConfig, use_moe: bool = False):
         super().__init__()
         h = config.hidden_size
         std = config.initializer_range
         self.attention = ErnieSelfAttention(config)
         self.attn_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
-        self.ffn_in = _init_linear(nn.Linear(h, config.intermediate_size),
-                                   std)
-        self.ffn_in.weight.sharding_spec = P(None, TENSOR_AXIS)
-        self.ffn_in.bias.sharding_spec = P(TENSOR_AXIS)
-        self.ffn_out = _init_linear(
-            nn.Linear(config.intermediate_size, h), std)
-        self.ffn_out.weight.sharding_spec = P(TENSOR_AXIS, None)
+        self.use_moe = bool(use_moe and config.moe_num_experts > 0)
+        if self.use_moe:
+            from ..distributed.moe import MoELayer
+            self.moe = MoELayer(
+                h, config.intermediate_size, config.moe_num_experts,
+                top_k=config.moe_top_k,
+                capacity_factor=config.moe_capacity_factor,
+                aux_weight=config.moe_aux_weight,
+                activation=config.hidden_act)
+        else:
+            self.ffn_in = _init_linear(
+                nn.Linear(h, config.intermediate_size), std)
+            self.ffn_in.weight.sharding_spec = P(None, TENSOR_AXIS)
+            self.ffn_in.bias.sharding_spec = P(TENSOR_AXIS)
+            self.ffn_out = _init_linear(
+                nn.Linear(config.intermediate_size, h), std)
+            self.ffn_out.weight.sharding_spec = P(TENSOR_AXIS, None)
         self.ffn_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self.act = config.hidden_act
@@ -130,9 +153,19 @@ class ErnieLayer(nn.Layer):
     def forward(self, x, attn_mask=None):
         attn = self.attention(x, attn_mask)
         x = self.attn_norm(x + self.dropout(attn))
-        ffn = self.ffn_out(getattr(F, self.act)(self.ffn_in(x)))
+        if self.use_moe:
+            ffn = self.moe(x)
+        else:
+            ffn = self.ffn_out(getattr(F, self.act)(self.ffn_in(x)))
         x = self.ffn_norm(x + self.dropout(ffn))
         return x
+
+
+def _is_moe_layer(config: ErnieConfig, i: int) -> bool:
+    """MoE placement rule: every n-th block (1-indexed), when the config
+    enables experts — the standard interleaved-MoE transformer layout."""
+    return (config.moe_num_experts > 0
+            and (i + 1) % config.moe_every_n_layers == 0)
 
 
 class ErnieEmbeddings(nn.Layer):
@@ -169,10 +202,22 @@ class ErnieModel(nn.Layer):
         self.config = config or ErnieConfig(**kwargs)
         self.embeddings = ErnieEmbeddings(self.config)
         self.encoder = nn.LayerList(
-            [ErnieLayer(self.config)
-             for _ in range(self.config.num_hidden_layers)])
+            [ErnieLayer(self.config, use_moe=_is_moe_layer(self.config, i))
+             for i in range(self.config.num_hidden_layers)])
         self.pooler = nn.Linear(self.config.hidden_size,
                                 self.config.hidden_size)
+
+    def moe_aux_loss(self):
+        """Sum of the last forward's expert load-balancing losses (None
+        for a dense config). Traced Tensors: usable inside a TrainStep
+        loss_fn during the same forward trace."""
+        total = None
+        for lyr in self.encoder:
+            if getattr(lyr, "use_moe", False) and \
+                    lyr.moe.aux_loss is not None:
+                total = lyr.moe.aux_loss if total is None \
+                    else total + lyr.moe.aux_loss
+        return total
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
@@ -193,6 +238,7 @@ class ErnieForPretraining(nn.Layer):
     def __init__(self, config: ErnieConfig = None, **kwargs):
         super().__init__()
         self.ernie = ErnieModel(config, **kwargs)
+        self.moe_aux_loss = self.ernie.moe_aux_loss
         cfg = self.ernie.config
         self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
@@ -261,6 +307,18 @@ class ErnieForSequenceClassification(nn.Layer):
 # buys nothing at pretraining loss parity, so we keep stages independent
 # and document the decision here).
 
+def _stage_moe_aux(blocks):
+    """Weighted sum of the blocks' MoE aux losses from the last forward
+    (None when the stage is dense) — the pipeline engine's
+    pipeline_local_loss contract."""
+    total = None
+    for b in blocks:
+        if getattr(b, "use_moe", False) and b.moe.aux_loss is not None:
+            a = b.moe.aux_weight * b.moe.aux_loss
+            total = a if total is None else total + a
+    return total
+
+
 class ErnieStageFirst(nn.Layer):
     """Embeddings + leading encoder blocks -> hidden states.
 
@@ -268,11 +326,14 @@ class ErnieStageFirst(nn.Layer):
     once and threaded to later stages as part of the activation tuple
     (the same mask plumbing ErnieModel.forward does in one program)."""
 
-    def __init__(self, config: ErnieConfig, num_blocks: int):
+    def __init__(self, config: ErnieConfig, num_blocks: int,
+                 first_index: int = 0):
         super().__init__()
         self.embeddings = ErnieEmbeddings(config)
         self.blocks = nn.LayerList(
-            [ErnieLayer(config) for _ in range(num_blocks)])
+            [ErnieLayer(config, use_moe=_is_moe_layer(config,
+                                                      first_index + j))
+             for j in range(num_blocks)])
 
     def forward(self, input_ids, attention_mask=None):
         x = self.embeddings(input_ids)
@@ -285,14 +346,20 @@ class ErnieStageFirst(nn.Layer):
             return x, attention_mask
         return x
 
+    def pipeline_local_loss(self):
+        return _stage_moe_aux(self.blocks)
+
 
 class ErnieStageMiddle(nn.Layer):
     """A run of encoder blocks (hidden -> hidden)."""
 
-    def __init__(self, config: ErnieConfig, num_blocks: int):
+    def __init__(self, config: ErnieConfig, num_blocks: int,
+                 first_index: int = 0):
         super().__init__()
         self.blocks = nn.LayerList(
-            [ErnieLayer(config) for _ in range(num_blocks)])
+            [ErnieLayer(config, use_moe=_is_moe_layer(config,
+                                                      first_index + j))
+             for j in range(num_blocks)])
 
     def forward(self, x, attention_mask=None):
         for b in self.blocks:
@@ -301,14 +368,20 @@ class ErnieStageMiddle(nn.Layer):
             return x, attention_mask
         return x
 
+    def pipeline_local_loss(self):
+        return _stage_moe_aux(self.blocks)
+
 
 class ErnieStageLast(nn.Layer):
     """Trailing blocks + pooler + MLM/NSP heads (hidden -> logits)."""
 
-    def __init__(self, config: ErnieConfig, num_blocks: int):
+    def __init__(self, config: ErnieConfig, num_blocks: int,
+                 first_index: int = 0):
         super().__init__()
         self.blocks = nn.LayerList(
-            [ErnieLayer(config) for _ in range(num_blocks)])
+            [ErnieLayer(config, use_moe=_is_moe_layer(config,
+                                                      first_index + j))
+             for j in range(num_blocks)])
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
         self.mlm_transform = nn.Linear(config.hidden_size,
                                        config.hidden_size)
@@ -330,6 +403,9 @@ class ErnieStageLast(nn.Layer):
             [b0, s0, -1])
         return logits, self.nsp(pooled)
 
+    def pipeline_local_loss(self):
+        return _stage_moe_aux(self.blocks)
+
 
 def ernie_pipeline_stages(config: ErnieConfig, num_stages: int):
     """Split an ERNIE pretraining model into heterogeneous pp stages.
@@ -347,13 +423,19 @@ def ernie_pipeline_stages(config: ErnieConfig, num_stages: int):
             def __init__(self):
                 super().__init__()
                 self.first = ErnieStageFirst(config, 0)
-                self.last = ErnieStageLast(config, L)
+                self.last = ErnieStageLast(config, L, first_index=0)
 
             def forward(self, input_ids):
                 return self.last(self.first(input_ids))
+
+            def pipeline_local_loss(self):
+                return self.last.pipeline_local_loss()
         return [_Solo()]
     stages = [ErnieStageFirst(config, counts[0])]
+    start = counts[0]
     for i in range(1, num_stages - 1):
-        stages.append(ErnieStageMiddle(config, counts[i]))
-    stages.append(ErnieStageLast(config, counts[-1]))
+        stages.append(ErnieStageMiddle(config, counts[i],
+                                       first_index=start))
+        start += counts[i]
+    stages.append(ErnieStageLast(config, counts[-1], first_index=start))
     return stages

@@ -18,6 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 N_DEV = int(os.environ.get("PD_PIPE_BENCH_DEVICES", 4))
 
 import jax
+import jax.numpy as jnp
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", N_DEV)
@@ -97,12 +98,14 @@ def main():
                             _jax.random.key(0), micro_x)
     np.asarray(y0).ravel()[:1]
     t_f = (time.perf_counter() - t0) / reps
+    one = jnp.ones((), jnp.float32)
     gacc, gx = st0.bwd_jit(st0.params, st0.buffers, _jax.random.key(0),
-                           micro_x, y0, None)
+                           micro_x, y0, one, None)
     t0 = time.perf_counter()
     for _ in range(reps):
         gacc, gx = st0.bwd_jit(st0.params, st0.buffers,
-                               _jax.random.key(0), micro_x, y0, None)
+                               _jax.random.key(0), micro_x, y0, one,
+                               None)
     np.asarray(next(iter(
         jax.tree_util.tree_leaves(gacc)))).ravel()[:1]
     t_b = (time.perf_counter() - t0) / reps
@@ -113,7 +116,6 @@ def main():
     # (pipeline.py gpipe_schedule: stacked stage params sharded over pp,
     # ppermute ring, fwd+bwd+update all inside a single jitted program —
     # the dispatch-bound answer when stages are homogeneous)
-    import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed.pipeline import gpipe_schedule
