@@ -38,7 +38,8 @@ class ErnieConfig:
                  initializer_range=0.02, layer_norm_eps=1e-12,
                  use_flash_attention=True, moe_num_experts=0,
                  moe_top_k=2, moe_every_n_layers=2,
-                 moe_capacity_factor=1.25, moe_aux_weight=0.01):
+                 moe_capacity_factor=1.25, moe_aux_weight=0.01,
+                 sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -63,6 +64,16 @@ class ErnieConfig:
             raise ValueError(
                 "moe_every_n_layers must be >= 1 when experts are "
                 "enabled (set moe_num_experts=0 for a dense model)")
+        # long-context mode: attention runs as the ppermute ring over the
+        # 'sp' mesh axis (distributed/ring.py) — each chip holds 1/sp of
+        # the sequence. Requires attention dropout 0 (the ring kernel
+        # carries no dropout state across hops).
+        self.sequence_parallel = sequence_parallel
+        if sequence_parallel and attention_probs_dropout_prob > 0:
+            raise ValueError(
+                "sequence_parallel requires "
+                "attention_probs_dropout_prob=0 (ring attention carries "
+                "no dropout)")
 
     @classmethod
     def base(cls, **kw):
@@ -90,6 +101,33 @@ def _init_linear(layer, std, col_spec=None, row_spec=None):
     return layer
 
 
+_RING_CACHE = {}
+
+
+def _ring_attention_fn(mesh):
+    """One shard_map'd ring-attention closure per mesh, shared by every
+    attention layer (a per-layer closure would re-trace its vjp per
+    layer per step). Layout [b, s_local, heads, dim]; batch rides 'dp'
+    and heads stay 'tp'-sharded when those axes exist, so the ring
+    composes with dp/tp without gathering."""
+    key = id(mesh)
+    fn = _RING_CACHE.get(key)
+    if fn is None:
+        import paddle_tpu.distributed as dist
+        batch_ax = "dp" if "dp" in mesh.axis_names else None
+        head_ax = TENSOR_AXIS if TENSOR_AXIS in mesh.axis_names else None
+        spec = P(batch_ax, "sp", head_ax, None)
+
+        def body(qq, kk, vv):
+            return dist.ring_flash_attention(qq, kk, vv, causal=False,
+                                             group="sp")
+        fn = dist.shard_parallel(
+            body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axes=("sp",)).__wrapped_smap__
+        _RING_CACHE[key] = fn
+    return fn
+
+
 class ErnieSelfAttention(nn.Layer):
     def __init__(self, config: ErnieConfig):
         super().__init__()
@@ -98,6 +136,7 @@ class ErnieSelfAttention(nn.Layer):
         self.head_dim = h // self.num_heads
         self.use_flash = config.use_flash_attention
         self.dropout_p = config.attention_probs_dropout_prob
+        self.seq_parallel = config.sequence_parallel
         std = config.initializer_range
         self.qkv = _init_linear(nn.Linear(h, 3 * h), std)
         self.qkv.weight.sharding_spec = P(None, TENSOR_AXIS)
@@ -111,6 +150,23 @@ class ErnieSelfAttention(nn.Layer):
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
+        if self.seq_parallel:
+            from ..distributed.env import get_mesh
+            from ..ops.registry import run_op
+            if attn_mask is not None:
+                raise ValueError(
+                    "sequence_parallel attention takes no attention_mask"
+                    " — pad to full blocks (io/sampler.py bucketing) so"
+                    " every position is real, or run the dense model")
+            mesh = get_mesh()
+            if mesh is None or "sp" not in mesh.axis_names:
+                raise ValueError(
+                    "sequence_parallel=True needs the global mesh to "
+                    "carry an 'sp' axis: dist.set_mesh(build_mesh("
+                    "{'dp': ..., 'sp': ...}))")
+            ring = _ring_attention_fn(mesh)
+            ctx = run_op("ring_attention_sp", ring, (q, k, v), {})
+            return self.out(ctx.reshape([b, s, h]))
         if attn_mask is None and self.use_flash:
             ctx = F.flash_attention(q, k, v, dropout=self.dropout_p,
                                     training=self.training)
