@@ -1,6 +1,7 @@
 from ... import recompute as _recompute_mod
 from .fs import FS, HDFSClient, LocalFS  # noqa: F401
 from .http_server import KVClient, KVServer  # noqa: F401
+from .heartbeat import HeartbeatMonitor, HeartbeatWorker  # noqa: F401
 
 # fleet.utils.recompute parity (reference fleet/utils/__init__.py)
 recompute = _recompute_mod.recompute

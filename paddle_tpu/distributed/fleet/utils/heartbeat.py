@@ -1,0 +1,147 @@
+"""Worker heartbeat / failure detection.
+
+Reference: operators/distributed/heart_beat_monitor.cc — the PS counts
+each trainer's BATCH_BARRIER messages and marks a trainer dead when its
+last beat is older than the timeout, completing the job without it.
+
+TPU-native shape: no PS exists, so the beat channel is the fleet HTTP
+KV store (the same rendezvous substrate, fleet/utils/http_server.py).
+Each worker runs a HeartbeatWorker daemon PUTting a monotonic counter
+under hb/<rank>; any process (typically rank 0 or the launcher) runs a
+HeartbeatMonitor that sweeps the table and reports workers whose beat
+has not advanced within `timeout`. Recovery is the checkpoint story
+(distributed/checkpoint.py train_epoch_range: restart and resume) —
+detection here, restoration there, matching the reference's division
+of labor.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import urllib.request
+
+__all__ = ["HeartbeatWorker", "HeartbeatMonitor"]
+
+
+def _put(endpoint: str, key: str, value: str, timeout: float):
+    req = urllib.request.Request(
+        f"http://{endpoint}/{key}", data=value.encode(), method="PUT")
+    urllib.request.urlopen(req, timeout=timeout).read()
+
+
+def _get(endpoint: str, key: str, timeout: float):
+    """-> ("ok", value) | ("missing", None) | ("unreachable", None).
+    Transport failure must stay distinguishable from an absent key: a
+    monitor-side KV outage is NOT evidence any worker died."""
+    import urllib.error
+    try:
+        with urllib.request.urlopen(f"http://{endpoint}/{key}",
+                                    timeout=timeout) as r:
+            return "ok", r.read().decode()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return "missing", None
+        return "unreachable", None
+    except Exception:
+        return "unreachable", None
+
+
+class HeartbeatWorker:
+    """Daemon thread beating hb/<rank> on the fleet KV endpoint."""
+
+    def __init__(self, endpoint: str, rank: int,
+                 interval: float = 1.0):
+        self.endpoint = endpoint
+        self.rank = int(rank)
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heartbeat-{rank}")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._count += 1
+            try:
+                _put(self.endpoint, f"hb/{self.rank}",
+                     f"{self._count}:{time.time():.3f}",
+                     timeout=max(1.0, self.interval))
+            except Exception:
+                pass  # transient KV unavailability: keep beating
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class HeartbeatMonitor:
+    """Sweeps hb/<rank> keys; a worker whose counter stops advancing for
+    `timeout` seconds is dead (heart_beat_monitor.cc:
+    LostWorkerMonitor)."""
+
+    def __init__(self, endpoint: str, world_size: int,
+                 timeout: float = 10.0, startup_timeout: float = 120.0,
+                 on_dead: Optional[Callable[[int], None]] = None):
+        self.endpoint = endpoint
+        self.world_size = int(world_size)
+        self.timeout = float(timeout)
+        # a worker that has NEVER beaten is still starting (importing,
+        # compiling) — the stall clock only runs from its first beat,
+        # like the reference counting from the first barrier message;
+        # startup_timeout bounds a worker that never comes up at all
+        self.startup_timeout = float(startup_timeout)
+        self.on_dead = on_dead
+        self._start = time.monotonic()
+        self._last: Dict[int, tuple] = {}  # rank -> (count, local_ts)
+        self._dead: set = set()
+
+    def sweep(self) -> List[int]:
+        """One pass; returns ranks newly detected dead."""
+        now = time.monotonic()
+        newly = []
+        for rank in range(self.world_size):
+            if rank in self._dead:
+                continue
+            status, raw = _get(self.endpoint, f"hb/{rank}", timeout=2.0)
+            if status == "unreachable":
+                continue  # inconclusive sweep: never kill on a KV outage
+            count = int(raw.split(":")[0]) if raw else -1
+            prev = self._last.get(rank)
+            # ANY counter change is a beat — a restarted worker resets
+            # its counter to 1, which is life, not a stall
+            if prev is None or count != prev[0]:
+                self._last[rank] = (count, now)
+                continue
+            never_beat = prev[0] < 0
+            limit = self.startup_timeout if never_beat else self.timeout
+            ref_ts = self._start if never_beat else prev[1]
+            if now - ref_ts > limit:
+                self._dead.add(rank)
+                newly.append(rank)
+                if self.on_dead is not None:
+                    self.on_dead(rank)
+        return newly
+
+    @property
+    def dead(self) -> List[int]:
+        return sorted(self._dead)
+
+    def alive(self) -> List[int]:
+        return [r for r in range(self.world_size)
+                if r not in self._dead]
+
+    def watch(self, poll: float = 1.0, stop_event=None):
+        """Blocking sweep loop until every worker is dead or stop_event
+        fires; yields nothing — use on_dead for reactions."""
+        stop_event = stop_event or threading.Event()
+        while not stop_event.is_set() and \
+                len(self._dead) < self.world_size:
+            self.sweep()
+            stop_event.wait(poll)

@@ -13,6 +13,7 @@ NSP heads. TPU-first construction:
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax.numpy as jnp
@@ -101,31 +102,26 @@ def _init_linear(layer, std, col_spec=None, row_spec=None):
     return layer
 
 
-_RING_CACHE = {}
-
-
+@functools.lru_cache(maxsize=8)
 def _ring_attention_fn(mesh):
-    """One shard_map'd ring-attention closure per mesh, shared by every
+    """One shard_map'd ring-attention closure per mesh (Mesh is hashable
+    — equal-but-distinct meshes share an entry, and lru eviction keeps
+    retired meshes from pinning device refs forever), shared by every
     attention layer (a per-layer closure would re-trace its vjp per
     layer per step). Layout [b, s_local, heads, dim]; batch rides 'dp'
     and heads stay 'tp'-sharded when those axes exist, so the ring
     composes with dp/tp without gathering."""
-    key = id(mesh)
-    fn = _RING_CACHE.get(key)
-    if fn is None:
-        import paddle_tpu.distributed as dist
-        batch_ax = "dp" if "dp" in mesh.axis_names else None
-        head_ax = TENSOR_AXIS if TENSOR_AXIS in mesh.axis_names else None
-        spec = P(batch_ax, "sp", head_ax, None)
+    import paddle_tpu.distributed as dist
+    batch_ax = "dp" if "dp" in mesh.axis_names else None
+    head_ax = TENSOR_AXIS if TENSOR_AXIS in mesh.axis_names else None
+    spec = P(batch_ax, "sp", head_ax, None)
 
-        def body(qq, kk, vv):
-            return dist.ring_flash_attention(qq, kk, vv, causal=False,
-                                             group="sp")
-        fn = dist.shard_parallel(
-            body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            axes=("sp",)).__wrapped_smap__
-        _RING_CACHE[key] = fn
-    return fn
+    def body(qq, kk, vv):
+        return dist.ring_flash_attention(qq, kk, vv, causal=False,
+                                         group="sp")
+    return dist.shard_parallel(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axes=("sp",)).__wrapped_smap__
 
 
 class ErnieSelfAttention(nn.Layer):

@@ -24,6 +24,7 @@ compiled on TPU).
 from __future__ import annotations
 
 import functools
+import os
 import math
 
 import jax
@@ -37,8 +38,10 @@ __all__ = ["flash_attention_mha", "pallas_available"]
 # per-grid-step overhead (DMA setup + Mosaic loop) — with head_dim 64 a
 # 128x128 block is only ~4 MFLOP, far too little to hide ~1us/step; 512-wide
 # blocks put ~134 MFLOP per step while staying well under VMEM (~1.5 MB).
-_BQ = 512
-_BK = 512
+# Env-tunable (PD_FLASH_BQ / PD_FLASH_BK) so a hardware session can sweep
+# per-generation VMEM sweet spots without code edits.
+_BQ = int(os.environ.get("PD_FLASH_BQ", 512))
+_BK = int(os.environ.get("PD_FLASH_BK", 512))
 _NEG = -1e30
 
 

@@ -1,0 +1,90 @@
+"""Failure detection: heartbeat worker/monitor over the fleet KV store
+(reference operators/distributed/heart_beat_monitor.cc — dead-trainer
+detection by stalled beats; recovery itself is the checkpoint story,
+tests/test_preemption.py)."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import (HeartbeatMonitor,
+                                                HeartbeatWorker, KVServer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_monitor_sees_beats_and_detects_stall():
+    with KVServer(0, host="127.0.0.1") as srv:
+        ep = f"127.0.0.1:{srv.port}"
+        w0 = HeartbeatWorker(ep, rank=0, interval=0.1).start()
+        w1 = HeartbeatWorker(ep, rank=1, interval=0.1).start()
+        mon = HeartbeatMonitor(ep, world_size=2, timeout=1.0)
+        time.sleep(0.4)
+        assert mon.sweep() == []
+        assert mon.alive() == [0, 1]
+        # rank 1 stops beating (simulated hang — thread stopped, process
+        # alive, exactly the case a liveness check must catch)
+        w1.stop()
+        deadline = time.time() + 6
+        dead = []
+        while time.time() < deadline and not dead:
+            time.sleep(0.3)
+            dead = mon.sweep()
+        assert dead == [1]
+        assert mon.alive() == [0]
+        w0.stop()
+
+
+def test_monitor_detects_sigkilled_process():
+    """a real process killed with SIGKILL stops beating and is
+    detected (the trainer-death case the reference PS handles)."""
+    with KVServer(0, host="127.0.0.1") as srv:
+        ep = f"127.0.0.1:{srv.port}"
+        code = (
+            "import sys, time;"
+            f"sys.path.insert(0, {REPO!r});"
+            "from paddle_tpu.distributed.fleet.utils import "
+            "HeartbeatWorker;"
+            f"HeartbeatWorker({ep!r}, rank=0, interval=0.1).start();"
+            "time.sleep(60)")
+        proc = subprocess.Popen([sys.executable, "-c", code])
+        try:
+            mon = HeartbeatMonitor(ep, world_size=1, timeout=1.0)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                mon.sweep()
+                if mon._last.get(0, (-1,))[0] > 0:
+                    break
+                time.sleep(0.2)
+            assert mon._last.get(0, (-1,))[0] > 0, "no beat ever seen"
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            deadline = time.time() + 8
+            while time.time() < deadline and not mon.dead:
+                time.sleep(0.3)
+                mon.sweep()
+            assert mon.dead == [0]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+def test_on_dead_callback_fires_once():
+    with KVServer(0, host="127.0.0.1") as srv:
+        ep = f"127.0.0.1:{srv.port}"
+        seen = []
+        mon = HeartbeatMonitor(ep, world_size=1, timeout=0.5,
+                               on_dead=seen.append)
+        w = HeartbeatWorker(ep, rank=0, interval=0.1).start()
+        time.sleep(0.3)
+        mon.sweep()
+        w.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and not seen:
+            time.sleep(0.2)
+            mon.sweep()
+        mon.sweep()
+        assert seen == [0]  # once, not per sweep
