@@ -42,6 +42,19 @@ class AsyncEmbeddingKV:
     staleness knob; push blocks when the queue is full).
     """
 
+    @classmethod
+    def from_strategy(cls, kv: EmbeddingKV, strategy) -> "AsyncEmbeddingKV":
+        """Build from a fleet DistributedStrategy's a_sync_configs
+        (AsyncConfig proto mirror)."""
+        cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        if int(cfg.get("k_steps", 0)) > 0:
+            raise ValueError(
+                "a_sync_configs['k_steps'] > 0 selects geo-SGD — use "
+                "GeoSGD.from_strategy, not the async communicator")
+        return cls(kv,
+                   merge_var_num=int(cfg.get("max_merge_var_num", 20)),
+                   max_pending=int(cfg.get("send_queue_size", 16)) * 4)
+
     def __init__(self, kv: EmbeddingKV, merge_var_num: int = 20,
                  max_pending: int = 64):
         self.kv = kv
@@ -149,6 +162,19 @@ class GeoSGD:
     cross-process psum over the global device mesh when
     jax.distributed is initialized, else identity.
     """
+
+    @classmethod
+    def from_strategy(cls, params, strategy,
+                      reduce_fn: Optional[Callable] = None) -> "GeoSGD":
+        """Build from a fleet DistributedStrategy whose a_sync_configs
+        k_steps > 0 selects geo mode (AsyncConfig proto mirror)."""
+        cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        k = int(cfg.get("k_steps", 0))
+        if k <= 0:
+            raise ValueError(
+                "geo mode needs a_sync_configs['k_steps'] > 0 "
+                "(k_steps == 0 is plain async — use AsyncEmbeddingKV)")
+        return cls(params, sync_steps=k, reduce_fn=reduce_fn)
 
     def __init__(self, params: Dict[str, object], sync_steps: int = 4,
                  reduce_fn: Optional[Callable] = None):

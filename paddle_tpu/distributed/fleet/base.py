@@ -73,6 +73,17 @@ class DistributedStrategy:
         self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
                             "sparsity": [0.999]}
         self.fp16_allreduce = False
+        # PS consistency mode (AsyncConfig, distributed_strategy.proto:
+        # 106): a_sync=True -> async communicator semantics; k_steps>0 ->
+        # geo-SGD. Consumed by distributed.async_ps (AsyncEmbeddingKV /
+        # GeoSGD .from_strategy)
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": 0, "max_merge_var_num": 20,
+                               "send_queue_size": 16,
+                               "independent_recv_thread": False,
+                               "thread_pool_size": 1,
+                               "send_wait_times": 1,
+                               "launch_barrier": True}
         self.find_unused_parameters = False
         self.gradient_scale_configs = {"scale_strategy": "avg"}
         self.nccl_comm_num = 1  # parity no-op (no NCCL here)

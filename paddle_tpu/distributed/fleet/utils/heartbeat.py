@@ -5,47 +5,25 @@ each trainer's BATCH_BARRIER messages and marks a trainer dead when its
 last beat is older than the timeout, completing the job without it.
 
 TPU-native shape: no PS exists, so the beat channel is the fleet HTTP
-KV store (the same rendezvous substrate, fleet/utils/http_server.py).
-Each worker runs a HeartbeatWorker daemon PUTting a monotonic counter
-under hb/<rank>; any process (typically rank 0 or the launcher) runs a
-HeartbeatMonitor that sweeps the table and reports workers whose beat
-has not advanced within `timeout`. Recovery is the checkpoint story
-(distributed/checkpoint.py train_epoch_range: restart and resume) —
-detection here, restoration there, matching the reference's division
-of labor.
+KV store (the same rendezvous substrate, fleet/utils/http_server.py
+KVClient/KVServer). Each worker runs a HeartbeatWorker daemon PUTting a
+monotonic counter under hb/<rank>; any process (typically rank 0 or the
+launcher) runs a HeartbeatMonitor that sweeps the table and reports
+workers whose beat has not advanced within `timeout`. Recovery is the
+checkpoint story (distributed/checkpoint.py train_epoch_range: restart
+and resume) — detection here, restoration there, matching the
+reference's division of labor.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-import urllib.request
+from .http_server import KVClient
 
 __all__ = ["HeartbeatWorker", "HeartbeatMonitor"]
-
-
-def _put(endpoint: str, key: str, value: str, timeout: float):
-    req = urllib.request.Request(
-        f"http://{endpoint}/{key}", data=value.encode(), method="PUT")
-    urllib.request.urlopen(req, timeout=timeout).read()
-
-
-def _get(endpoint: str, key: str, timeout: float):
-    """-> ("ok", value) | ("missing", None) | ("unreachable", None).
-    Transport failure must stay distinguishable from an absent key: a
-    monitor-side KV outage is NOT evidence any worker died."""
-    import urllib.error
-    try:
-        with urllib.request.urlopen(f"http://{endpoint}/{key}",
-                                    timeout=timeout) as r:
-            return "ok", r.read().decode()
-    except urllib.error.HTTPError as e:
-        if e.code == 404:
-            return "missing", None
-        return "unreachable", None
-    except Exception:
-        return "unreachable", None
 
 
 class HeartbeatWorker:
@@ -53,9 +31,9 @@ class HeartbeatWorker:
 
     def __init__(self, endpoint: str, rank: int,
                  interval: float = 1.0):
-        self.endpoint = endpoint
         self.rank = int(rank)
         self.interval = float(interval)
+        self._kv = KVClient(endpoint, timeout=max(1.0, interval))
         self._stop = threading.Event()
         self._count = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -69,9 +47,8 @@ class HeartbeatWorker:
         while not self._stop.is_set():
             self._count += 1
             try:
-                _put(self.endpoint, f"hb/{self.rank}",
-                     f"{self._count}:{time.time():.3f}",
-                     timeout=max(1.0, self.interval))
+                self._kv.put(f"hb/{self.rank}",
+                             f"{self._count}:{time.time():.3f}")
             except Exception:
                 pass  # transient KV unavailability: keep beating
             self._stop.wait(self.interval)
@@ -84,12 +61,14 @@ class HeartbeatWorker:
 class HeartbeatMonitor:
     """Sweeps hb/<rank> keys; a worker whose counter stops advancing for
     `timeout` seconds is dead (heart_beat_monitor.cc:
-    LostWorkerMonitor)."""
+    LostWorkerMonitor). Conservative by design: KV transport failures —
+    and missing keys for a worker that has already beaten (a KV that
+    restarted empty) — are inconclusive, never evidence of death."""
 
     def __init__(self, endpoint: str, world_size: int,
                  timeout: float = 10.0, startup_timeout: float = 120.0,
-                 on_dead: Optional[Callable[[int], None]] = None):
-        self.endpoint = endpoint
+                 on_dead: Optional[Callable[[int], None]] = None,
+                 max_parallel_gets: int = 16):
         self.world_size = int(world_size)
         self.timeout = float(timeout)
         # a worker that has NEVER beaten is still starting (importing,
@@ -98,22 +77,45 @@ class HeartbeatMonitor:
         # startup_timeout bounds a worker that never comes up at all
         self.startup_timeout = float(startup_timeout)
         self.on_dead = on_dead
+        # per-request timeout derives from the monitor's own clock so a
+        # slow KV can't stretch one sweep past the detection window
+        self._kv = KVClient(endpoint,
+                            timeout=max(0.5, min(2.0, self.timeout / 4)))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(int(max_parallel_gets),
+                            max(self.world_size, 1)))
         self._start = time.monotonic()
         self._last: Dict[int, tuple] = {}  # rank -> (count, local_ts)
         self._dead: set = set()
 
+    def _fetch(self, rank: int):
+        try:
+            raw = self._kv.get(f"hb/{rank}")
+        except Exception:
+            return rank, "unreachable", None
+        if raw is None:
+            return rank, "missing", None
+        return rank, "ok", raw.decode()
+
     def sweep(self) -> List[int]:
-        """One pass; returns ranks newly detected dead."""
+        """One pass (GETs fanned out in parallel); returns ranks newly
+        detected dead."""
         now = time.monotonic()
+        targets = [r for r in range(self.world_size)
+                   if r not in self._dead]
         newly = []
-        for rank in range(self.world_size):
-            if rank in self._dead:
-                continue
-            status, raw = _get(self.endpoint, f"hb/{rank}", timeout=2.0)
+        for rank, status, raw in self._pool.map(self._fetch, targets):
             if status == "unreachable":
-                continue  # inconclusive sweep: never kill on a KV outage
-            count = int(raw.split(":")[0]) if raw else -1
+                continue  # inconclusive: never kill on a KV outage
             prev = self._last.get(rank)
+            if status == "missing":
+                if prev is not None and prev[0] >= 0:
+                    # has beaten before; an empty key now means the KV
+                    # lost state, not that the worker died
+                    continue
+                count = -1
+            else:
+                count = int(raw.split(":")[0])
             # ANY counter change is a beat — a restarted worker resets
             # its counter to 1, which is life, not a stall
             if prev is None or count != prev[0]:

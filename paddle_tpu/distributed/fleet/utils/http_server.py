@@ -92,21 +92,24 @@ class KVClient:
     """Client for KVServer (reference exposes raw http.client calls from
     role_maker; a client object keeps the surface tidy)."""
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, timeout: float = 10.0):
         if not endpoint.startswith("http"):
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
+        self.timeout = float(timeout)
 
     def put(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
         req = _urlreq.Request(f"{self.endpoint}/{key}", data=data,
                               method="PUT")
-        _urlreq.urlopen(req, timeout=10).read()
+        _urlreq.urlopen(req, timeout=self.timeout).read()
 
     def get(self, key: str) -> Optional[bytes]:
+        """value bytes, or None for a missing key; transport errors
+        raise (callers distinguish outage from absence)."""
         try:
             return _urlreq.urlopen(f"{self.endpoint}/{key}",
-                                   timeout=10).read()
+                                   timeout=self.timeout).read()
         except HTTPError as e:
             if e.code == 404:
                 return None
@@ -114,4 +117,4 @@ class KVClient:
 
     def delete(self, key: str) -> None:
         req = _urlreq.Request(f"{self.endpoint}/{key}", method="DELETE")
-        _urlreq.urlopen(req, timeout=10).read()
+        _urlreq.urlopen(req, timeout=self.timeout).read()

@@ -65,10 +65,16 @@ class ErnieConfig:
             raise ValueError(
                 "moe_every_n_layers must be >= 1 when experts are "
                 "enabled (set moe_num_experts=0 for a dense model)")
-        # long-context mode: attention runs as the ppermute ring over the
+        # long-context mode: attention runs sequence-parallel over the
         # 'sp' mesh axis (distributed/ring.py) — each chip holds 1/sp of
-        # the sequence. Requires attention dropout 0 (the ring kernel
-        # carries no dropout state across hops).
+        # the sequence. True/"ring" = ppermute ring (blockwise, O(s/P)
+        # memory); "ulysses" = all-to-all head resharding (local full
+        # attention over n/P heads). Requires attention dropout 0 (no
+        # dropout state across hops/resharding).
+        if sequence_parallel not in (False, True, "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel must be False/True/'ring'/'ulysses',"
+                f" got {sequence_parallel!r}")
         self.sequence_parallel = sequence_parallel
         if sequence_parallel and attention_probs_dropout_prob > 0:
             raise ValueError(
@@ -103,7 +109,7 @@ def _init_linear(layer, std, col_spec=None, row_spec=None):
 
 
 @functools.lru_cache(maxsize=8)
-def _ring_attention_fn(mesh):
+def _ring_attention_fn(mesh, mode="ring"):
     """One shard_map'd ring-attention closure per mesh (Mesh is hashable
     — equal-but-distinct meshes share an entry, and lru eviction keeps
     retired meshes from pinning device refs forever), shared by every
@@ -116,9 +122,11 @@ def _ring_attention_fn(mesh):
     head_ax = TENSOR_AXIS if TENSOR_AXIS in mesh.axis_names else None
     spec = P(batch_ax, "sp", head_ax, None)
 
+    attn = dist.ring_flash_attention if mode != "ulysses" \
+        else dist.ulysses_attention
+
     def body(qq, kk, vv):
-        return dist.ring_flash_attention(qq, kk, vv, causal=False,
-                                         group="sp")
+        return attn(qq, kk, vv, causal=False, group="sp")
     return dist.shard_parallel(
         body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axes=("sp",)).__wrapped_smap__
@@ -160,8 +168,9 @@ class ErnieSelfAttention(nn.Layer):
                     "sequence_parallel=True needs the global mesh to "
                     "carry an 'sp' axis: dist.set_mesh(build_mesh("
                     "{'dp': ..., 'sp': ...}))")
-            ring = _ring_attention_fn(mesh)
-            ctx = run_op("ring_attention_sp", ring, (q, k, v), {})
+            mode = "ulysses" if self.seq_parallel == "ulysses" else "ring"
+            ring = _ring_attention_fn(mesh, mode)
+            ctx = run_op(f"{mode}_attention_sp", ring, (q, k, v), {})
             return self.out(ctx.reshape([b, s, h]))
         if attn_mask is None and self.use_flash:
             ctx = F.flash_attention(q, k, v, dropout=self.dropout_p,

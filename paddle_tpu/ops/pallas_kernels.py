@@ -39,9 +39,22 @@ __all__ = ["flash_attention_mha", "pallas_available"]
 # 128x128 block is only ~4 MFLOP, far too little to hide ~1us/step; 512-wide
 # blocks put ~134 MFLOP per step while staying well under VMEM (~1.5 MB).
 # Env-tunable (PD_FLASH_BQ / PD_FLASH_BK) so a hardware session can sweep
-# per-generation VMEM sweet spots without code edits.
-_BQ = int(os.environ.get("PD_FLASH_BQ", 512))
-_BK = int(os.environ.get("PD_FLASH_BK", 512))
+# per-generation VMEM sweet spots without code edits. Values must be
+# 128-multiples (>= 128): _pick_block would otherwise silently round,
+# turning a sweep data point into a duplicate measurement.
+
+
+def _block_env(name: str, default: int) -> int:
+    v = int(os.environ.get(name, default))
+    if v < 128 or v % 128:
+        raise ValueError(
+            f"{name}={v} invalid: flash block sizes must be multiples "
+            "of 128 (MXU tile), >= 128")
+    return v
+
+
+_BQ = _block_env("PD_FLASH_BQ", 512)
+_BK = _block_env("PD_FLASH_BK", 512)
 _NEG = -1e30
 
 

@@ -103,3 +103,28 @@ def test_geo_sgd_two_worker_delta_sum_math():
     geo.step()
     np.testing.assert_allclose(np.asarray(w._data),
                                [12.5, 11.5])  # 10 + 2 + (0.5,-0.5)
+
+
+def test_from_strategy_construction():
+    """AsyncConfig (distributed_strategy.proto:106) mirror: the fleet
+    strategy's a_sync knobs build the matching consistency objects."""
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    s.a_sync = True
+    s.a_sync_configs = {**s.a_sync_configs, "max_merge_var_num": 7,
+                        "send_queue_size": 2}
+    kv = AsyncEmbeddingKV.from_strategy(EmbeddingKV(4, lr=0.5), s)
+    assert kv.merge_var_num == 7
+    kv.push(np.array([1], np.int64), np.ones((1, 4), np.float32))
+    kv.flush()
+    kv.close()
+
+    s.a_sync_configs = {**s.a_sync_configs, "k_steps": 3}
+    w = paddle.create_parameter([2], "float32")
+    geo = GeoSGD.from_strategy({"w": w}, s)
+    assert geo.sync_steps == 3
+
+    s.a_sync_configs = {**s.a_sync_configs, "k_steps": 0}
+    with pytest.raises(ValueError, match="k_steps"):
+        GeoSGD.from_strategy({"w": w}, s)
