@@ -9,19 +9,26 @@ sharding/collective path is exercised in CI without TPU hardware.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# PD_TEST_TPU=1 opts OUT of the CPU forcing so the TPU-gated tests
+# (tests/test_pallas_attention.py -k tpu) can reach the real chip
+# (tools/tpu_first_light.py sets it).
+_USE_TPU = os.environ.get("PD_TEST_TPU") == "1"
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 # exact matmuls for numpy-reference comparisons (CPU default is low-prec).
 # NB: pytest plugins import jax before this conftest, so set the config
 # directly rather than via env.
 import jax  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
-# JAX config snapshots env at import, and pytest plugins import jax before
-# this conftest — so force the CPU platform via config, not env.
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    # JAX config snapshots env at import, and pytest plugins import jax
+    # before this conftest — force the CPU platform via config, not env.
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""One command for the moment TPU hardware is reachable again.
+
+Runs, in order, each in its own subprocess with generous timeouts
+(never SIGKILL mid-TPU-work — it can wedge the tunnel):
+  1. probe    — backend init + matmul + host read
+  2. kernels  — the TPU-gated Pallas attention tests (PD_TEST_TPU=1
+                disables the conftest CPU forcing)
+  3. bench    — python bench.py (writes the JSON metric line)
+  4. profile  — one profiled ERNIE step, printing the top device ops
+                (the r2 bottleneck hunt: MLM head copies / remat)
+  5. sweep    — optional flash block-size sweep (--sweep)
+
+Usage:  python tools/tpu_first_light.py [--sweep] [--skip-tests]
+Exit 0 when the probe + bench succeed; stages report individually.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(name, cmd, timeout, env=None):
+    print(f"== {name}: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.Popen(cmd, cwd=REPO, env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        out, _ = p.communicate(timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            out, _ = p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        rc = -1
+        out = (out or "") + f"\n[timed out after {timeout}s]"
+    dt = time.time() - t0
+    tail = "\n".join((out or "").strip().splitlines()[-8:])
+    print(f"-- {name}: rc={rc} in {dt:.0f}s\n{tail}\n", flush=True)
+    return rc, out
+
+
+PROFILE_SNIPPET = r"""
+import sys, os
+sys.path.insert(0, %r)
+import numpy as np, jax
+import paddle_tpu as paddle
+from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+from paddle_tpu.static import TrainStep
+paddle.seed(0)
+cfg = ErnieConfig(vocab_size=30528, hidden_size=768, num_hidden_layers=12,
+                  num_attention_heads=12, intermediate_size=3072,
+                  max_position_embeddings=512)
+model = ErnieForPretraining(cfg)
+opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                             parameters=model.parameters())
+step = TrainStep(model, lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
+                 opt, amp_level="O1", amp_dtype="bfloat16")
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (48, 512)).astype(np.int32))
+lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (48, 512)).astype(np.int32))
+step(ids, lbl); float(step(ids, lbl).item())
+import tempfile
+d = tempfile.mkdtemp(prefix="xplane_")
+with jax.profiler.trace(d):
+    for _ in range(3):
+        loss = step(ids, lbl)
+    float(loss.item())
+from jax.profiler import ProfileData
+import glob
+xs = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+pd = ProfileData.from_serialized_xspace(open(xs[-1], "rb").read())
+tot = {}
+for plane in pd.planes:
+    if "TPU" not in plane.name and "tpu" not in plane.name:
+        continue
+    for line in plane.lines:
+        for ev in line.events:
+            ns = ev.duration_ns
+            tot[ev.name] = tot.get(ev.name, 0) + ns
+top = sorted(tot.items(), key=lambda kv: -kv[1])[:15]
+print("top device ops over 3 steps:")
+for name, ns in top:
+    print(f"  {ns/1e6/3:9.2f} ms/step  {name[:90]}")
+""" % (REPO,)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--skip-tests", action="store_true")
+    args = ap.parse_args()
+    py = sys.executable
+    results = {}
+
+    # the one wedge-safe probe lives in bench.py (_probe_tpu): subprocess
+    # init + matmul + host read, SIGTERM grace, and the platform check
+    # (a CPU-fallback jax must NOT read as first light)
+    sys.path.insert(0, REPO)
+    from bench import _probe_tpu
+    print("== probe (bench._probe_tpu)", flush=True)
+    on_tpu, info = _probe_tpu(timeout_s=300)
+    results["probe"] = 0 if on_tpu else 1
+    print(f"-- probe: on_tpu={on_tpu} ({info})\n", flush=True)
+    if not on_tpu:
+        print("TPU not reachable; stopping.")
+        sys.exit(1)
+
+    if not args.skip_tests:
+        env = dict(os.environ, PD_TEST_TPU="1")
+        rc, _ = run("kernels",
+                    [py, "-m", "pytest",
+                     "tests/test_pallas_attention.py", "-q"],
+                    timeout=1800, env=env)
+        results["kernels"] = rc
+
+    rc, out = run("bench", [py, "bench.py"], timeout=3600)
+    results["bench"] = rc
+    for line in (out or "").splitlines():
+        if line.strip().startswith("{"):
+            try:
+                d = json.loads(line)
+                print("bench metric:", d["metric"], d["value"], d["unit"],
+                      "| mfu", d["extras"].get("mfu"))
+            except Exception:
+                pass
+
+    rc, _ = run("profile", [py, "-c", PROFILE_SNIPPET], timeout=2400)
+    results["profile"] = rc
+
+    if args.sweep:
+        for bq in (256, 512, 1024):
+            env = dict(os.environ, PD_FLASH_BQ=str(bq),
+                       PD_FLASH_BK=str(bq))
+            run(f"sweep bq={bq}", [py, "bench.py"], timeout=3600,
+                env=env)
+
+    print("summary:", results)
+    sys.exit(0 if results.get("bench") == 0 else 2)
+
+
+if __name__ == "__main__":
+    main()
