@@ -215,45 +215,11 @@ def bench_eager_dispatch():
 
 
 def _probe_tpu(timeout_s=None):
-    """Liveness-check the TPU backend in a THROWAWAY subprocess.
-
-    A wedged tunnel hangs jax backend init forever, and an in-process
-    hang is unrecoverable (round-2: bench rc=1, dryrun rc=124) — so the
-    first jax call of this process must never be the gamble. The probe
-    also executes + host-reads a matmul because block_until_ready is a
-    no-op under the tunnel and init can succeed while execution wedges.
-    Returns (on_tpu, platform_or_error)."""
-    import subprocess
-    timeout_s = timeout_s or float(os.environ.get("PD_TPU_PROBE_TIMEOUT",
-                                                  180))
-    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
-            "x = jnp.ones((128, 128)) @ jnp.ones((128, 128)); "
-            "assert float(x[0, 0]) == 128.0; "
-            "print('PLATFORM', d[0].platform, flush=True)")
-    # SIGTERM first with a grace period: a hard SIGKILL mid-TPU-execution
-    # can wedge a merely-slow tunnel permanently (round-2 postmortem)
-    proc = subprocess.Popen([sys.executable, "-c", code],
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        proc.terminate()
-        try:
-            proc.communicate(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.communicate()
-        return False, (f"backend init/exec timed out after {timeout_s:.0f}s"
-                       " (wedged TPU tunnel)")
-    if proc.returncode != 0:
-        tail = (stderr or "").strip().splitlines()[-1:] or ["no stderr"]
-        return False, f"backend init failed rc={proc.returncode}: {tail[0]}"
-    out = (stdout or "").strip().split()
-    plat = out[-1] if out else "?"
-    if plat in ("tpu", "axon"):
-        return True, plat
-    return False, plat  # healthy non-TPU host (plat == "cpu"): not an error
+    """Wedge-safe TPU liveness probe (shared implementation:
+    paddle_tpu/core/tpu_probe.py). Returns (on_tpu,
+    platform_or_error)."""
+    from paddle_tpu.core.tpu_probe import probe_tpu
+    return probe_tpu(timeout_s)
 
 
 def main():

@@ -28,6 +28,10 @@ def main():
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # prefer the accelerator but never hang on a dead tunnel
+        from paddle_tpu.core.tpu_probe import ensure_tpu_or_cpu
+        ensure_tpu_or_cpu()
 
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM

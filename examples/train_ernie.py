@@ -31,6 +31,10 @@ def main():
     if args.cpu or args.tiny:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # prefer the accelerator but never hang on a dead tunnel
+        from paddle_tpu.core.tpu_probe import ensure_tpu_or_cpu
+        ensure_tpu_or_cpu()
 
     import paddle_tpu as paddle
     from paddle_tpu.models import ErnieConfig, ErnieForPretraining

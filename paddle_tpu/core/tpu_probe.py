@@ -1,0 +1,67 @@
+"""Wedge-safe TPU liveness probe.
+
+The first jax call of a process must never gamble on a hung backend: a
+wedged TPU tunnel blocks backend init forever and an in-process hang is
+unrecoverable (the round-2 postmortem: bench rc=1, dryrun rc=124). The
+probe initializes the backend, runs a matmul, and host-reads the result
+in a THROWAWAY subprocess under a timeout — SIGTERM with a grace period
+before SIGKILL, because a hard kill mid-TPU-execution can wedge a
+merely-slow tunnel permanently.
+
+Consumers: bench.py, tools/tpu_first_light.py, examples that default to
+the accelerator but must degrade to CPU instead of hanging.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+__all__ = ["probe_tpu", "ensure_tpu_or_cpu"]
+
+
+def probe_tpu(timeout_s: float = None):
+    """-> (on_tpu: bool, platform_or_error: str)."""
+    timeout_s = timeout_s or float(os.environ.get("PD_TPU_PROBE_TIMEOUT",
+                                                  180))
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((128, 128)) @ jnp.ones((128, 128)); "
+            "assert float(x[0, 0]) == 128.0; "
+            "print('PLATFORM', d[0].platform, flush=True)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return False, (f"backend init/exec timed out after {timeout_s:.0f}s"
+                       " (wedged TPU tunnel)")
+    if proc.returncode != 0:
+        tail = (stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return False, f"backend init failed rc={proc.returncode}: {tail[0]}"
+    out = (stdout or "").strip().split()
+    plat = out[-1] if out else "?"
+    if plat in ("tpu", "axon"):
+        return True, plat
+    return False, plat  # healthy non-TPU host: not an error
+
+
+def ensure_tpu_or_cpu(timeout_s: float = None, quiet: bool = False):
+    """Probe; on failure force the CPU platform BEFORE any jax call in
+    this process. Returns (on_tpu, info). For program entry points that
+    prefer the accelerator but must never hang on a dead one."""
+    on_tpu, info = probe_tpu(timeout_s)
+    if not on_tpu:
+        if not quiet and info != "cpu":
+            print(f"[paddle_tpu] TPU unavailable ({info}); "
+                  "falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return on_tpu, info

@@ -101,13 +101,13 @@ def main():
     py = sys.executable
     results = {}
 
-    # the one wedge-safe probe lives in bench.py (_probe_tpu): subprocess
-    # init + matmul + host read, SIGTERM grace, and the platform check
-    # (a CPU-fallback jax must NOT read as first light)
+    # the one wedge-safe probe lives in paddle_tpu/core/tpu_probe.py:
+    # subprocess init + matmul + host read, SIGTERM grace, and the
+    # platform check (a CPU-fallback jax must NOT read as first light)
     sys.path.insert(0, REPO)
-    from bench import _probe_tpu
-    print("== probe (bench._probe_tpu)", flush=True)
-    on_tpu, info = _probe_tpu(timeout_s=300)
+    from paddle_tpu.core.tpu_probe import probe_tpu
+    print("== probe (core.tpu_probe)", flush=True)
+    on_tpu, info = probe_tpu(timeout_s=300)
     results["probe"] = 0 if on_tpu else 1
     print(f"-- probe: on_tpu={on_tpu} ({info})\n", flush=True)
     if not on_tpu:
