@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from ..framework import Tensor
 from ..nn.layer.layers import Layer
 from ..nn.initializer import XavierNormal
+from ..ops.registry import register_op
 
 __all__ = ["MoELayer", "moe_dispatch"]
 
@@ -86,6 +87,26 @@ def moe_dispatch(gate_logits, num_experts: int, top_k: int,
     return combine, dispatch, aux
 
 
+@register_op("moe_layer")
+def _moe_layer_op(x, gate, w1, b1, w2, b2, *, num_experts, top_k,
+                  capacity, activation="relu"):
+    """Registered op (serializable in Programs): dense-dispatch MoE —
+    route, expert FFNs over the stacked weights, combine. Returns
+    (y, aux_loss)."""
+    act = getattr(jax.nn, activation)
+    d_model = x.shape[-1]
+    tok = x.reshape(-1, d_model)                           # [N, D]
+    logits = tok.astype(jnp.float32) @ gate                # [N, E]
+    combine, dispatch, aux = moe_dispatch(logits, num_experts, top_k,
+                                          capacity)
+    # token -> expert slots (the all-to-all under an ep mesh)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tok)
+    h = act(jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :])
+    out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    return y.reshape(x.shape), aux
+
+
 class MoELayer(Layer):
     """Top-k gated mixture of expert FFNs over a stacked expert tensor.
 
@@ -134,30 +155,12 @@ class MoELayer(Layer):
             * self.top_k)))
 
     def forward(self, x):
-        from ..ops.registry import run_op
-
         b, s = x.shape[0], x.shape[1]
         cap = self._capacity(int(b) * int(s))
-
-        def impl(xd, gate, w1, b1, w2, b2):
-            tok = xd.reshape(-1, self.d_model)                 # [N, D]
-            logits = tok.astype(jnp.float32) @ gate            # [N, E]
-            combine, dispatch, aux = moe_dispatch(
-                logits, self.num_experts, self.top_k, cap)
-            # token -> expert slots (the all-to-all under an ep mesh)
-            expert_in = jnp.einsum(
-                "nec,nd->ecd", dispatch.astype(xd.dtype), tok)
-            h = self._act(
-                jnp.einsum("ecd,edh->ech", expert_in, w1)
-                + b1[:, None, :])
-            out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
-            y = jnp.einsum("nec,ecd->nd",
-                           combine.astype(xd.dtype), out)
-            return y.reshape(xd.shape), aux
-
-        y, aux = run_op("moe_layer", impl,
-                        (x, self.gate, self.w1, self.b1, self.w2,
-                         self.b2), {})
+        y, aux = _moe_layer_op(
+            x, self.gate, self.w1, self.b1, self.w2, self.b2,
+            num_experts=self.num_experts, top_k=self.top_k,
+            capacity=cap, activation=self.activation)
         self.aux_loss = aux
         return y
 

@@ -418,3 +418,30 @@ def test_ernie_ulysses_mode_matches_dense():
     step = build("ulysses", mesh, plan)
     got = [float(step(ids, labels).item()) for _ in range(2)]
     np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+def test_moe_program_serializes_and_replays():
+    """moe_layer is a registered op: captured Programs serialize and a
+    deserialized program reproduces the forward (static/program.py
+    contract — ad-hoc closures cannot do this)."""
+    from paddle_tpu.static import Program, program_guard
+
+    paddle.seed(13)
+    moe = MoELayer(8, 16, num_experts=2, top_k=1, capacity_factor=2.0)
+    main = Program()
+    with program_guard(main):
+        x = paddle.static.data("x", [2, 4, 8], "float32")
+        y = moe(x)
+
+    blob = main.to_bytes()
+    p2 = Program.from_bytes(blob)
+    rng = np.random.RandomState(0)
+    feed = rng.randn(2, 4, 8).astype(np.float32)
+
+    from paddle_tpu.static import Executor
+    exe = Executor()
+    (out1,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    y2 = p2.vars[y.var_id]
+    (out2,) = exe.run(p2, feed={"x": feed}, fetch_list=[y2])
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5)
