@@ -322,8 +322,14 @@ class ErnieForPretraining(nn.Layer):
         b, s = h.shape[0], h.shape[1]
         w = self.ernie.embeddings.word_embeddings.weight
         h2 = h.reshape([-1, h.shape[-1]])
-        logits = (F.linear(h2, manipulation.t(w))
-                  + self.mlm_bias).reshape([b, s, -1])
+        lg = F.linear(h2, manipulation.t(w))
+        # bias in the LOGITS dtype: under AMP O1 the f32 bias param would
+        # promote the whole [b*s, vocab] tensor to f32 — the exact
+        # multi-GB head buffer the fused-CE rework removed
+        # (tests/test_head_hlo_receipt.py guards this)
+        bias = self.mlm_bias if self.mlm_bias.dtype == lg.dtype \
+            else self.mlm_bias.astype(lg.dtype)
+        logits = (lg + bias).reshape([b, s, -1])
         nsp_logits = self.nsp(pooled)
         return logits, nsp_logits
 

@@ -1,0 +1,86 @@
+"""Static receipt for the fused-CE head optimization (r2 commit
+4d19110, built from the v5e profile that showed the MLM head's f32
+logits copies at >50% of the ERNIE step).
+
+Checked at the StableHLO level (the program we emit — backend codegen
+differs; CPU legalizes bf16 via f32 and would false-positive). The
+contract is NOT "no f32 [N, vocab] values at all": the fused CE's
+internal f32 chain (convert -> subtract -> exp -> reduce) is exactly
+the every-f32-feeds-a-fusion design. The bug signatures the r2 profile
+flagged are what must be absent:
+  - f32 full-vocab logits crossing a function boundary (a buffer)
+  - a transpose of f32 full-vocab logits (the 3 GB copy.703 move)
+  - an add producing f32 full-vocab logits (f32 bias promoting the
+    bf16 matmul output — the regression this test originally caught)
+  - any 3-D [b, s, vocab] f32 tensor (batch-major layout copies)
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+from paddle_tpu.static import TrainStep
+
+VOCAB = 30528  # full BERT vocab: the buffer the r2 profile flagged
+
+
+def test_no_f32_fullvocab_logits_buffers_in_program():
+    paddle.seed(0)
+    # NB: b*s must differ from hidden_size, or the logits shape aliases
+    # the (legitimately f32) transposed weight [hidden, vocab]
+    cfg = ErnieConfig(vocab_size=VOCAB, hidden_size=48,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      intermediate_size=96, max_position_embeddings=32,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(
+        model, lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
+        opt, amp_level="O1", amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    b, s = 4, 8
+    ids = paddle.to_tensor(
+        rng.randint(0, VOCAB, (b, s)).astype(np.int32))
+    lbl = paddle.to_tensor(
+        rng.randint(0, VOCAB, (b, s)).astype(np.int32))
+    step(ids, lbl)  # build + compile
+
+    lowered = step._step_fn.lower(
+        step.params, step.opt_state, step.buffers, step.strategy_state,
+        jax.random.key(0), jnp.float32(1e-4),
+        (ids._data,), (lbl._data,))
+    shlo = lowered.as_text()
+
+    n = b * s
+    logits2d_bf16 = f"tensor<{n}x{VOCAB}xbf16>"
+    logits2d_f32 = f"tensor<{n}x{VOCAB}xf32>"
+
+    # the head really computes full-vocab bf16 logits
+    assert logits2d_bf16 in shlo, "no bf16 full-vocab logits found"
+
+    offenders = []
+    for line in shlo.splitlines():
+        if logits2d_f32 not in line:
+            continue
+        stripped = line.strip()
+        # bug signature 1: f32 logits as a function-boundary buffer
+        if stripped.startswith(("func.func", "return")):
+            offenders.append(("func-boundary", stripped[:120]))
+        # bug signature 2: the transpose copy
+        if "stablehlo.transpose" in stripped:
+            offenders.append(("transpose", stripped[:120]))
+        # bug signature 3: bias promotion (add PRODUCING f32 logits)
+        if re.search(r"stablehlo\.add .*->\s*" + re.escape(logits2d_f32),
+                     stripped) or (
+                "stablehlo.add" in stripped
+                and stripped.endswith(f": {logits2d_f32}")):
+            offenders.append(("add-promotion", stripped[:120]))
+    # bug signature 4: 3-D f32 logits (batch-major layout copies)
+    assert f"tensor<{b}x{s}x{VOCAB}xf32>" not in shlo, \
+        "3-D f32 full-vocab tensor in the program"
+    assert not offenders, offenders
