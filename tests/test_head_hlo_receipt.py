@@ -84,3 +84,35 @@ def test_no_f32_fullvocab_logits_buffers_in_program():
     assert f"tensor<{b}x{s}x{VOCAB}xf32>" not in shlo, \
         "3-D f32 full-vocab tensor in the program"
     assert not offenders, offenders
+
+
+def test_gpt_head_also_clean():
+    """same contract for the GPT causal-LM head (weight-tied, no bias)."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=48, num_layers=1,
+                    num_heads=4, max_seq_len=32, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(model, lambda o, l: GPTForCausalLM.lm_loss(o, l),
+                     opt, amp_level="O1", amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    b, s = 4, 8
+    ids = paddle.to_tensor(rng.randint(0, VOCAB, (b, s)).astype(np.int32))
+    step(ids, ids)
+    lowered = step._step_fn.lower(
+        step.params, step.opt_state, step.buffers, step.strategy_state,
+        jax.random.key(0), jnp.float32(1e-4), (ids._data,),
+        (ids._data,))
+    shlo = lowered.as_text()
+    n = b * s
+    logits2d_f32 = f"tensor<{n}x{VOCAB}xf32>"
+    for line in shlo.splitlines():
+        if logits2d_f32 in line:
+            stripped = line.strip()
+            assert not stripped.startswith(("func.func", "return")), \
+                stripped[:120]
+            assert "stablehlo.transpose" not in stripped, stripped[:120]
+    assert f"tensor<{b}x{s}x{VOCAB}xf32>" not in shlo
