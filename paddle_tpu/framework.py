@@ -384,9 +384,13 @@ class Tensor:
         return self.numpy().tolist()
 
     def astype(self, dtype) -> "Tensor":
+        # route through the REGISTERED cast op (dtype as a serializable
+        # attribute) — an ad-hoc lambda here made every program that
+        # contained an astype unserializable
         from .ops.registry import run_op
-        d = _dtypes.convert_dtype(dtype)
-        return run_op("cast", lambda x: x.astype(d), (self,), {})
+        from .ops.manipulation import cast as _cast_op
+        return run_op("cast", _cast_op.__pure_fn__, (self,),
+                      {"dtype": str(_dtypes.convert_dtype(dtype))})
 
     def cast(self, dtype):
         return self.astype(dtype)
