@@ -1,0 +1,71 @@
+"""Gradient-path consistency sweep: for each op family, the eager tape
+gradient must equal the static append_backward gradient fetched through
+the Executor AFTER a serialize/deserialize roundtrip — the
+backward.py:1337 static-autodiff contract over the whole
+capture/save/load/run pipeline."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+RNG = np.random.RandomState(7)
+W0 = RNG.randn(3, 4).astype(np.float32)
+V0 = (np.abs(RNG.randn(3, 4)) + 0.5).astype(np.float32)
+
+# (name, build(w Tensor/Var) -> scalar, init value)
+CASES = [
+    ("square_sum", lambda w: (w * w).sum(), W0),
+    ("matmul", lambda w: (w @ paddle.to_tensor(
+        np.ones((4, 2), np.float32))).sum(), W0),
+    ("relu", lambda w: paddle.nn.functional.relu(w).sum(), W0),
+    ("sigmoid", lambda w: paddle.nn.functional.sigmoid(w).sum(), W0),
+    ("tanh", lambda w: paddle.tanh(w).sum(), W0),
+    ("exp", lambda w: paddle.exp(w).sum(), W0),
+    ("log", lambda w: paddle.log(w).sum(), V0),
+    ("sqrt", lambda w: paddle.sqrt(w).sum(), V0),
+    ("softmax_ce", lambda w: paddle.nn.functional.cross_entropy(
+        w, paddle.to_tensor(np.array([0, 3, 1], np.int64))), W0),
+    ("mean", lambda w: paddle.mean(w * 3.0), W0),
+    ("transpose", lambda w: (paddle.transpose(w, [1, 0])
+                             * paddle.to_tensor(np.ones(
+                                 (4, 3), np.float32))).sum(), W0),
+    ("reshape", lambda w: (paddle.reshape(w, [12]) ** 2).sum(), W0),
+    ("concat", lambda w: paddle.concat([w, w], axis=0).sum(), W0),
+    ("slice", lambda w: (w[1:, :2] * 2.0).sum(), W0),
+    ("layer_norm", lambda w: paddle.nn.functional.layer_norm(
+        w, [4],
+        weight=paddle.to_tensor(np.ones(4, np.float32)),
+        bias=paddle.to_tensor(np.zeros(4, np.float32))).sum(), W0),
+    ("max_reduce", lambda w: paddle.max(w, axis=1).sum(), W0),
+    ("clip", lambda w: paddle.clip(w, -0.5, 0.5).sum(), W0),
+    ("pow", lambda w: paddle.pow(w, 3.0).sum(), W0),
+]
+
+
+@pytest.mark.parametrize("name,build,w0", CASES,
+                         ids=[c[0] for c in CASES])
+def test_eager_grad_equals_static_append_backward(name, build, w0):
+    # eager tape gradient
+    w = paddle.create_parameter(list(w0.shape), "float32")
+    w.set_value(w0)
+    loss = build(w)
+    loss.backward()
+    want = np.asarray(w.grad._data)
+
+    # static: capture, append_backward, serialize, replay, fetch grad
+    main = static.Program()
+    with static.program_guard(main):
+        wv = paddle.create_parameter(list(w0.shape), "float32")
+        wv.set_value(w0)
+        sloss = build(wv)
+        pairs = static.append_backward(sloss)
+    grads = {id(p): g for p, g in pairs}
+    gvar = pairs[0][1]
+    blob = main.to_bytes()
+    p2 = static.Program.from_bytes(blob)
+    exe = static.Executor()
+    (got,) = exe.run(p2, feed={},
+                     fetch_list=[p2.vars[gvar.var_id]])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6, err_msg=name)
