@@ -23,8 +23,12 @@ __all__ = [
 ]
 
 # program pickle layout: v1 = round-2 layout (no op_versions field);
-# v2 = adds "op_versions" {op_type: int}
-PROGRAM_FORMAT_VERSION = 2
+# v2 = adds "op_versions" {op_type: int};
+# v3 = adds the backward + optimize sections ("grad_target",
+#      "grad_pairs", "var_grads", "optimize", "opt_state") so a saved
+#      training program keeps its whole graph — the framework.proto:178
+#      contract where grad ops serialize as ordinary block ops
+PROGRAM_FORMAT_VERSION = 3
 # paddle.save envelope: v0 = raw pickled payload (legacy), v1 = envelope
 STATE_FORMAT_VERSION = 1
 
@@ -92,6 +96,22 @@ def _program_v1_to_v2(d: dict) -> dict:
     d = dict(d)
     d["op_versions"] = {}
     d["version"] = 2
+    return d
+
+
+@_register_program_migration(2)
+def _program_v2_to_v3(d: dict) -> dict:
+    # v2 dropped the backward/optimize bookkeeping on the floor (the
+    # round-3 lost-backward defect); a v2 blob genuinely has none, so
+    # the migration is empty sections — loading then fetching a grad var
+    # raises NotFoundError loudly instead of returning None
+    d = dict(d)
+    d.setdefault("grad_target", None)
+    d.setdefault("grad_pairs", [])
+    d.setdefault("var_grads", [])
+    d.setdefault("optimize", None)
+    d.setdefault("opt_state", None)
+    d["version"] = 3
     return d
 
 

@@ -132,10 +132,14 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     return loss
 
 
-def cross_entropy(input, label, weight=None, ignore_index=-100,
-                  reduction="mean", soft_label=False, axis=-1,
-                  use_softmax=True, label_smoothing=0.0, name=None):
-    from .activation import log_softmax as _ls
+@register_op("cross_entropy")
+def _cross_entropy_op(logits, lbl, weight=None, ignore_index=-100,
+                      reduction="mean", soft_label=False, axis=-1,
+                      use_softmax=True, label_smoothing=0.0):
+    """Registered pure form of paddle.nn.functional.cross_entropy: all
+    configuration rides in serializable attrs so captured programs
+    round-trip through to_bytes/from_bytes (the round-3 lost-op defect —
+    this op used to capture an ad-hoc closure)."""
 
     def impl(logits, lbl, weight=None):
         axis_ = axis % logits.ndim
@@ -198,11 +202,21 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             return jnp.sum(loss) / denom
         return loss
 
-    from ...ops.registry import run_op
-    out = run_op("cross_entropy", lambda *a, **k: _ce_dispatch(
-        impl, reduction, *a, **k), (input, label) if weight is None
-        else (input, label, weight), {})
-    return out
+    return _ce_dispatch(impl, reduction, logits, lbl, weight)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    if weight is None:
+        return _cross_entropy_op(
+            input, label, ignore_index=ignore_index, reduction=reduction,
+            soft_label=soft_label, axis=axis, use_softmax=use_softmax,
+            label_smoothing=label_smoothing)
+    return _cross_entropy_op(
+        input, label, weight, ignore_index=ignore_index,
+        reduction=reduction, soft_label=soft_label, axis=axis,
+        use_softmax=use_softmax, label_smoothing=label_smoothing)
 
 
 def _ce_dispatch(impl, reduction, logits, lbl, weight=None):

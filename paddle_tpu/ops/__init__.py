@@ -51,6 +51,20 @@ __all__ = (creation.__all__ + math.__all__ + manipulation.__all__
 # operator overloads (math_op_patch.py analogue)
 # ---------------------------------------------------------------------------
 
+# Indexing as registered ops: the index (ints/slices/Ellipsis/arrays)
+# rides in the `idx` attribute so captured programs serialize — the
+# previous ad-hoc lambdas made any program containing x[...] unsaveable
+# (reference slice_op / set_value_op are likewise ordinary proto ops).
+@register_op("getitem")
+def _getitem_op(x, idx=()):
+    return x[idx]
+
+
+@register_op("setitem")
+def _setitem_op(x, v, idx=()):
+    return x.at[idx].set(v.astype(x.dtype) if hasattr(v, "astype") else v)
+
+
 def _binary_method(fn, reverse=False):
     def method(self, other):
         if isinstance(other, (list, tuple, np.ndarray)):
@@ -116,27 +130,18 @@ def _patch_tensor_methods():
     T.__or__ = _binary_method(logic.logical_or)
     T.__xor__ = _binary_method(logic.logical_xor)
 
+    def _unwrap_item(it):
+        if isinstance(it, Tensor):
+            return it._data
+        if isinstance(it, tuple):
+            return tuple(_unwrap_item(i) for i in it)
+        return it
+
     def _getitem(self, item):
-        def unwrap_item(it):
-            if isinstance(it, Tensor):
-                return it._data
-            if isinstance(it, tuple):
-                return tuple(unwrap_item(i) for i in it)
-            return it
-        return run_op("getitem", lambda x: x[unwrap_item(item)], (self,), {})
+        return _getitem_op(self, idx=_unwrap_item(item))
 
     def _setitem(self, item, value):
-        def unwrap_item(it):
-            if isinstance(it, Tensor):
-                return it._data
-            if isinstance(it, tuple):
-                return tuple(unwrap_item(i) for i in it)
-            return it
-        idx = unwrap_item(item)
-        out = run_op("setitem",
-                     lambda x, v: x.at[idx].set(
-                         v.astype(x.dtype) if hasattr(v, "astype") else v),
-                     (self, value), {})
+        out = _setitem_op(self, value, idx=_unwrap_item(item))
         _rebind_inplace(self, out)
 
     T.__getitem__ = _getitem

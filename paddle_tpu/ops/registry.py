@@ -139,6 +139,11 @@ def _hashable(v):
         return str(v)
     if isinstance(v, type):
         return v
+    if isinstance(v, slice):  # getitem attrs
+        return ("slice", _hashable(v.start), _hashable(v.stop),
+                _hashable(v.step))
+    if v is Ellipsis:
+        return "..."
     if isinstance(v, (tuple, list)):
         items = tuple(_hashable(x) for x in v)
         return _UNHASHABLE if _UNHASHABLE in items else items
@@ -245,10 +250,13 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
     if name not in _EAGER_NOJIT and flag_value("eager_op_jit"):
         info = OPS.get(name)
         # only registry fns are cacheable: ad-hoc closures passed to
-        # run_op (getitem lambdas, rnn cell steps) capture call state the
-        # key can't see, and "rng"-tagged ops draw generator keys inside
-        # the fn body — jit would freeze the first key as a constant
-        if info is not None and info.fn is fn and "rng" not in info.tags:
+        # run_op (rnn cell steps) capture call state the key can't see,
+        # "rng"-tagged ops draw generator keys inside the fn body — jit
+        # would freeze the first key as a constant — and "mesh"-tagged
+        # ops resolve the global device mesh at call time (a cached
+        # closure would pin a retired mesh)
+        if info is not None and info.fn is fn and "rng" not in info.tags \
+                and "mesh" not in info.tags:
             fast = _fast_entry(name, pure, plain_args, tensor_pos,
                                plain_kwargs, tensor_keys)
 

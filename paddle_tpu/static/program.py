@@ -118,7 +118,11 @@ class Program:
         # value back into the live buffer (BN running stats)
         self._buffer_writes: List[Tuple[int, int]] = []
         self._counter = 0
-        self._optimize = None  # (optimizer, loss_var, grad_map)
+        self._optimize = None  # (optimizer, loss_var)
+        # live optimizer accumulator tree for the static train path; owned
+        # by the Program (not the Executor cache) so a serialized
+        # mid-training program resumes with exact moments/step counts
+        self._opt_state = None
         self.random_seed = None
 
     def _new_var_id(self, var) -> int:
@@ -156,6 +160,20 @@ class Program:
         p._buffer_writes = list(self._buffer_writes)
         p._counter = self._counter
         p.random_seed = self.random_seed
+        if not for_test:
+            # backward/optimize bookkeeping travels with a train clone;
+            # a test clone is forward-only by construction (reference
+            # clone(for_test=True) prunes the backward blocks)
+            p._grad_target = getattr(self, "_grad_target", None)
+            p._grad_pairs = list(getattr(self, "_grad_pairs", ()))
+            p._var_grads = list(getattr(self, "_var_grads", ()))
+            p._optimize = self._optimize
+            # COPY, not alias: the Executor donates the opt-state
+            # buffers into its jitted step, which would leave the other
+            # program holding deleted arrays after one train run
+            if self._opt_state is not None:
+                p._opt_state = jax.tree_util.tree_map(
+                    jnp.array, self._opt_state)
         if for_test:
             # flip train-mode ops (reference clone prunes/rewires the
             # test program: dropout becomes identity/downscale,
@@ -220,6 +238,28 @@ class Program:
         p.buffer_ids = {b for b in self.buffer_ids if b in needed}
         p._buffer_writes = [(b, v) for b, v in self._buffer_writes
                             if b in needed and v in live]
+        # carry backward bookkeeping only where every referenced var
+        # survived the slice (pruning to an inference target drops it)
+        gt = getattr(self, "_grad_target", None)
+        if gt is not None and gt in live:
+            p._grad_target = gt
+            p._grad_pairs = [(pv, gv)
+                             for pv, gv in getattr(self, "_grad_pairs", ())
+                             if pv.var_id in live]
+            for pv, gv in p._grad_pairs:
+                p.vars.setdefault(gv.var_id, gv)
+                if gv.name:
+                    p.var_names.setdefault(gv.name, gv.var_id)
+        p._var_grads = [
+            s for s in getattr(self, "_var_grads", ())
+            if all(t in live for t in s["targets"])
+            and all(i in live for i in s["inputs"])]
+        for s in p._var_grads:
+            for gid in s["grad_vars"]:
+                gv = self.vars[gid]
+                p.vars.setdefault(gid, gv)
+                if gv.name:
+                    p.var_names.setdefault(gv.name, gid)
         p._counter = self._counter
         p.random_seed = self.random_seed
         return p
@@ -236,6 +276,10 @@ class Program:
                     # rng-key consts (dropout keys): store the raw bits
                     return ("__key__", np.asarray(jax.random.key_data(v)))
                 return ("__arr__", np.asarray(v))
+            if isinstance(v, (tuple, list)):
+                # nested containers (getitem idx attrs hold arrays inside
+                # tuples); markers above can't collide with real data
+                return type(v)(enc(x) for x in v)
             return v
         ops = []
         for n in self.ops:
@@ -257,6 +301,34 @@ class Program:
             vid: (t.name, np.asarray(t._data) if include_params else None,
                   str(t._data.dtype))
             for vid, t in self.params.items()}
+        # -- backward + optimize sections (format v3). In the reference,
+        # append_backward's grad ops are ordinary ops inside the
+        # serialized ProgramDesc blocks (framework.proto:178,
+        # backward.py:1337) so a saved training program keeps its whole
+        # graph; here the equivalent bookkeeping is the grad target /
+        # (param, grad) ids / gradients() specs plus the optimize stage.
+        grad_pairs = [(pv.var_id, gv.var_id)
+                      for pv, gv in getattr(self, "_grad_pairs", ())]
+        var_grads = [
+            {"targets": list(s["targets"]), "inputs": list(s["inputs"]),
+             "grad_vars": list(s["grad_vars"]),
+             "tgrads": [None if g is None else np.asarray(g)
+                        for g in s["tgrads"]]}
+            for s in getattr(self, "_var_grads", ())]
+        optimize = None
+        if self._optimize is not None:
+            import copy
+            opt, loss_var = self._optimize[0], self._optimize[1]
+            opt2 = copy.copy(opt)
+            # live Parameters / eager accumulators don't belong in the
+            # artifact; the static path's state rides _opt_state below
+            opt2._parameters = None
+            opt2._accumulators = {}
+            optimize = (pickle.dumps(opt2, protocol=4), loss_var.var_id)
+        opt_state = None
+        if self._opt_state is not None:
+            opt_state = jax.tree_util.tree_map(
+                lambda x: np.asarray(x), self._opt_state)
         from ..core.version_compat import (PROGRAM_FORMAT_VERSION,
                                            op_version)
         return pickle.dumps({
@@ -268,6 +340,9 @@ class Program:
             "buffer_ids": sorted(self.buffer_ids),
             "buffer_writes": list(self._buffer_writes),
             "counter": self._counter, "random_seed": self.random_seed,
+            "grad_target": getattr(self, "_grad_target", None),
+            "grad_pairs": grad_pairs, "var_grads": var_grads,
+            "optimize": optimize, "opt_state": opt_state,
         }, protocol=4)
 
     @staticmethod
@@ -284,6 +359,8 @@ class Program:
                     return jnp.asarray(v[1])
                 if v[0] == "__key__":
                     return jax.random.wrap_key_data(jnp.asarray(v[1]))
+            if isinstance(v, (tuple, list)):
+                return type(v)(dec(x) for x in v)
             return v
         p = Program()
         for vid, meta in sorted(d["vars"].items()):
@@ -324,6 +401,24 @@ class Program:
             p.params[vid] = t
         p._counter = d["counter"]
         p.random_seed = d.get("random_seed")
+        # -- backward + optimize sections (v3) --
+        gt = d.get("grad_target")
+        if gt is not None:
+            p._grad_target = gt
+        pairs = [(p.vars[pvid], p.vars[gvid])
+                 for pvid, gvid in d.get("grad_pairs", ())]
+        if pairs:
+            p._grad_pairs = pairs
+        vgs = d.get("var_grads", ())
+        if vgs:
+            p._var_grads = [dict(s) for s in vgs]
+        opt = d.get("optimize")
+        if opt is not None:
+            opt_blob, loss_vid = opt
+            p._optimize = (pickle.loads(opt_blob), p.vars[loss_vid])
+        if d.get("opt_state") is not None:
+            p._opt_state = jax.tree_util.tree_map(
+                jnp.asarray, d["opt_state"])
         return p
 
     def save(self, path: str, include_params: bool = True):
@@ -657,6 +752,7 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Any, Any] = {}
+        self._computable_cache: Dict[Any, set] = {}
 
     # -- Dataset-driven loops (trainer.h:53 / executor.py
     #    train_from_dataset capability; see io/fleet_dataset.py) --------------
@@ -747,6 +843,46 @@ class Executor:
         if train:
             grad_ids = [prog._optimize[1].var_id]
 
+        # Every fetch must be statically computable by this program —
+        # feed, captured param/buffer, op output, or a grad var whose
+        # backward section is present. A silent None here hid the
+        # lost-backward serialization bug for a whole round; the
+        # reference's enforce machinery (enforce.h, op_call_stack) turns
+        # exactly this class into a loud NotFoundError. Cached per
+        # program shape: run() is the per-batch hot path and the set is
+        # invariant for a given (program, op count, grad sections).
+        comp_key = (id(prog), len(prog.ops), bool(grad_ids),
+                    len(getattr(prog, "_var_grads", ())))
+        computable = self._computable_cache.get(comp_key)
+        if computable is None:
+            computable = set(prog.feeds) | set(prog.params.keys())
+            for node in prog.ops:
+                computable.update(node.out_ids)
+            if grad_ids:
+                computable.update(
+                    gv.var_id
+                    for _, gv in getattr(prog, "_grad_pairs", ()))
+            for s in getattr(prog, "_var_grads", ()):
+                computable.update(s["grad_vars"])
+            self._computable_cache[comp_key] = computable
+        for fid in fetch_ids:
+            if fid not in computable:
+                v = prog.vars.get(fid)
+                name = getattr(v, "name", None) or f"<id {fid}>"
+                kind = getattr(v, "kind", "?")
+                hint = ""
+                if kind == "grad":
+                    hint = ("; this is a grad var but the program has no "
+                            "active backward section (append_backward/"
+                            "gradients bookkeeping absent — was the "
+                            "program serialized by an older framework?)")
+                raise NotFoundError(
+                    f"fetch var '{name}' (id {fid}, kind={kind}) is not "
+                    f"producible by this program: it is not a feed, "
+                    f"captured parameter, or output of any of its "
+                    f"{len(prog.ops)} ops{hint}",
+                    op_type="fetch")
+
         # BN running stats etc.: fetch the updated values and write them
         # back into the live buffers after the run
         buffer_writes = list(getattr(prog, "_buffer_writes", ()))
@@ -774,15 +910,13 @@ class Executor:
                         new_params[k] = a
                     return fetches, new_params, opt_t
                 jitted = jax.jit(train_fn, donate_argnums=(1, 2))
-                opt_state = prog._optimize[0].init_state_tree(
-                    [prog.params[param_ids[k]]._data for k in train_pos])
-                entry = ("train", jitted, param_ids, opt_state)
+                entry = ("train", jitted, param_ids)
             else:
                 jitted = jax.jit(pure)
-                entry = ("infer", jitted, param_ids, None)
+                entry = ("infer", jitted, param_ids)
             self._cache[sig] = entry
 
-        kind, jitted, param_ids, opt_state = entry
+        kind, jitted, param_ids = entry
         feed_arrays = []
         for vid in prog.feeds:
             nm = prog.vars[vid].name
@@ -793,12 +927,19 @@ class Executor:
         key = next_key()
         if kind == "train":
             optimizer = prog._optimize[0]
+            # accumulator tree lives on the Program (not this cache) so
+            # to_bytes mid-training captures it and a loaded program
+            # resumes with exact moments
+            if prog._opt_state is None:
+                prog._opt_state = optimizer.init_state_tree(
+                    [prog.params[i]._data for i in param_ids
+                     if i not in prog.buffer_ids])
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
             fetches, new_params, new_opt = jitted(
-                feed_arrays, param_arrays, opt_state, lr, key)
+                feed_arrays, param_arrays, prog._opt_state, lr, key)
             for vid, arr in zip(param_ids, new_params):
                 prog.params[vid]._data = arr
-            self._cache[sig] = (kind, jitted, param_ids, new_opt)
+            prog._opt_state = new_opt
         else:
             fetches, _ = jitted(feed_arrays, param_arrays, key)
         n_user = len(fetch_ids)
@@ -813,3 +954,4 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._computable_cache.clear()
