@@ -140,12 +140,15 @@ class ShardingPlan:
 
         p_shard = {k: self.named(self.param_spec(k, state_tensors.get(k)))
                    for k in params}
-        # optimizer state mirrors each param's spec (+zero)
+        # optimizer state mirrors each param's spec (+zero); leaves may
+        # be ShapeDtypeStructs on the abstract (aot_lower) path
+        def _nd(v):
+            return len(v.shape) if hasattr(v, "shape") else np.ndim(v)
         opt_shard = {}
         for k, st in train_step.opt_state.items():
             opt_shard[k] = {
                 n: (self.named(self.state_spec(k, state_tensors.get(k)))
-                    if np.ndim(v) > 0 else self.replicated())
+                    if _nd(v) > 0 else self.replicated())
                 for n, v in st.items()}
         buf_shard = {k: self.replicated() for k in train_step.buffers}
 

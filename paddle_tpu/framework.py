@@ -277,7 +277,15 @@ class Tensor:
                  name=None):
         if isinstance(data, Tensor):
             data = data._data
-        if not isinstance(data, jax.Array):
+        if isinstance(data, jax.ShapeDtypeStruct):
+            # abstract tensor (shape/dtype only, nothing materialized) —
+            # the meta-init path for AOT memory receipts of models too
+            # big to build concretely (utils/abstract_init.py); mirrors
+            # static.Var's aval-only storage
+            if dtype is not None:
+                data = jax.ShapeDtypeStruct(
+                    data.shape, np.dtype(_dtypes.convert_dtype(dtype)))
+        elif not isinstance(data, jax.Array):
             np_dtype = _dtypes.convert_dtype(dtype) if dtype else None
             arr = np.asarray(data)
             if np_dtype is None and arr.dtype == np.float64:

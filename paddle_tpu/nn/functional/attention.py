@@ -91,34 +91,43 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                     scale=scale)
 
 
-def _flash_fwd(q, k, v, is_causal, scale, block_k):
-    """Blockwise attention with online softmax, scanning KV chunks.
+def _flash_carry_init(b, n, sq, hd):
+    """Fresh online-softmax carry (acc, m, l) for blockwise attention."""
+    return (jnp.zeros((b, n, sq, hd), jnp.float32),
+            jnp.full((b, n, sq), -jnp.inf, jnp.float32),
+            jnp.zeros((b, n, sq), jnp.float32))
 
-    q,k,v: [b, n, s, h] (head-major internally). Returns out, (m, l) stats.
+
+def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
+                        is_causal):
+    """Consume one KV shard [b, n, s_kv, h] in block_k chunks, updating
+    the online-softmax carry (acc, m, l).
+
+    Carry-in/carry-out so multiple shards can be consumed sequentially —
+    the unit the ring-attention hop reuses: each hop's remote KV shard
+    streams through here, so no s×s logits ever materialize (peak extra
+    memory is one [.., sq, block_k] block). `pos_k0` is the shard's
+    global key offset, `sk` its true (unpadded) length; `pos_q` carries
+    the queries' global positions for causal masking across shards.
     """
-    b, n, sq, hd = q.shape
-    sk = k.shape[2]
-    nblocks = (sk + block_k - 1) // block_k
-    pad = nblocks * block_k - sk
+    b, n, skl, hd = k.shape
+    nblocks = (skl + block_k - 1) // block_k
+    pad = nblocks * block_k - skl
     if pad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     kb = k.reshape(b, n, nblocks, block_k, hd)
     vb = v.reshape(b, n, nblocks, block_k, hd)
 
-    q32 = q.astype(jnp.float32) * scale
-    pos_q = jnp.arange(sq)
-
     def body(carry, blk):
         acc, m, l = carry
         kj, vj, jidx = blk
         logits = jnp.einsum("bnqh,bnkh->bnqk", q32,
                             kj.astype(jnp.float32))
-        pos_k = jidx * block_k + jnp.arange(block_k)
-        valid = pos_k < sk
+        pos_k = pos_k0 + jidx * block_k + jnp.arange(block_k)
+        valid = pos_k < pos_k0 + sk
         if is_causal:
-            cm = pos_q[:, None] >= pos_k[None, :]
-            valid = valid[None, :] & cm
+            valid = valid[None, :] & (pos_q[:, None] >= pos_k[None, :])
             logits = jnp.where(valid, logits, -jnp.inf)
         else:
             logits = jnp.where(valid[None, :], logits, -jnp.inf)
@@ -133,15 +142,30 @@ def _flash_fwd(q, k, v, is_causal, scale, block_k):
             "bnqk,bnkh->bnqh", p, vj.astype(jnp.float32))
         return (acc_new, m_new, l_new), None
 
-    acc0 = jnp.zeros((b, n, sq, hd), jnp.float32)
-    m0 = jnp.full((b, n, sq), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, n, sq), jnp.float32)
-    (acc, m, l), _ = jax.lax.scan(
-        body, (acc0, m0, l0),
+    carry, _ = jax.lax.scan(
+        body, carry,
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0),
          jnp.arange(nblocks)))
-    out = acc / jnp.maximum(l[..., None], 1e-30)
-    return out.astype(q.dtype)
+    return carry
+
+
+def _flash_finish(carry, dtype):
+    acc, _, l = carry
+    return (acc / jnp.maximum(l[..., None], 1e-30)).astype(dtype)
+
+
+def _flash_fwd(q, k, v, is_causal, scale, block_k):
+    """Blockwise attention with online softmax, scanning KV chunks.
+
+    q,k,v: [b, n, s, h] (head-major internally).
+    """
+    b, n, sq, hd = q.shape
+    sk = k.shape[2]
+    q32 = q.astype(jnp.float32) * scale
+    carry = _flash_carry_init(b, n, sq, hd)
+    carry = _flash_carry_update(q32, k, v, carry, block_k,
+                                jnp.arange(sq), 0, sk, is_causal)
+    return _flash_finish(carry, q.dtype)
 
 
 @register_op("flash_attention_op")

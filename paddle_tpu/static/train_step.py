@@ -95,7 +95,24 @@ class TrainStep:
         self._buffer_names = [k for k, t in state.items() if t.stop_gradient]
         self.params = {k: state[k]._data for k in self._trainable_names}
         self.buffers = {k: state[k]._data for k in self._buffer_names}
-        if amp_level == "O2":
+        # abstract (meta-init) layer: params are ShapeDtypeStructs — the
+        # step can only be AOT-lowered (aot_lower), never executed;
+        # optimizer state stays abstract via eval_shape
+        self._abstract = any(
+            isinstance(v, jax.ShapeDtypeStruct)
+            for v in self.params.values())
+        if self._abstract:
+            if amp_level == "O2":
+                dt = jnp.dtype(amp_dtype)
+                self.params = {
+                    k: (jax.ShapeDtypeStruct(v.shape, dt)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in self.params.items()}
+                if not optimizer._multi_precision:
+                    optimizer._multi_precision = True
+            self.opt_state = jax.eval_shape(optimizer.init_state_tree,
+                                            self.params)
+        elif amp_level == "O2":
             # pure-low-precision mode (reference amp O2 / pure_fp16):
             # params themselves are cast down; the optimizer keeps fp32
             # masters (multi_precision is mandatory for fp16 training)
@@ -130,8 +147,11 @@ class TrainStep:
         self._donate = donate
         self._step_fn = None  # built lazily (data shardings need structure)
         self._grad_fn = None
-        if self.mesh is not None and self.sharding_plan is not None:
+        if self.mesh is not None and self.sharding_plan is not None \
+                and not self._abstract:
             # place params/opt-state/buffers per the plan up front
+            # (abstract states can't be device_put; aot_lower's
+            # in_shardings carry the placement instead)
             plan = self.sharding_plan
             state = layer.state_dict()
             self.params = {
@@ -268,6 +288,33 @@ class TrainStep:
             jit_kwargs["in_shardings"] = in_sh + (data_in, lbl_in)
             jit_kwargs["out_shardings"] = out_sh
         return jax.jit(step, **jit_kwargs)
+
+    # -- AOT lowering (memory receipts) -------------------------------------
+    def aot_lower(self, inputs, labels=()):
+        """Lower (and let the caller .compile()) the full training step
+        from avals alone — no parameter, optimizer-state, or activation
+        bytes are ever allocated. Pairs with
+        utils.abstract_init.abstract_parameters() for models too big to
+        materialize; `compiled.memory_analysis()` then yields the
+        per-device peak the step would need — the hardware-independent
+        fits-in-HBM receipt (tests/test_memory_receipts.py)."""
+        def aval(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        in_avals = jax.tree_util.tree_map(aval, tuple(inputs))
+        lbl_avals = jax.tree_util.tree_map(aval, tuple(labels))
+        step = self._build(in_avals, lbl_avals)
+        key_aval = jax.eval_shape(lambda: jax.random.key(0))
+        lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
+        strat_avals = jax.tree_util.tree_map(aval, self.strategy_state)
+        buf_avals = jax.tree_util.tree_map(aval, self.buffers)
+        opt_avals = jax.tree_util.tree_map(aval, self.opt_state)
+        param_avals = jax.tree_util.tree_map(aval, self.params)
+        return step.lower(param_avals, opt_avals, buf_avals, strat_avals,
+                          key_aval, lr_aval, in_avals, lbl_avals)
 
     # -- eval / predict -----------------------------------------------------
     def build_eval_fn(self):
