@@ -70,11 +70,13 @@ class AsyncEmbeddingKV:
         self._thread.start()
 
     def _raise_if_failed(self):
+        # sticky: the failed batch is gone either way, so every later
+        # push/flush keeps reporting the broken communicator instead of
+        # silently resuming after the first surfaced error (ADVICE r3)
         if self._error is not None:
-            err, self._error = self._error, None
             raise RuntimeError(
                 "kv communicator thread failed applying a pushed "
-                "batch") from err
+                "batch") from self._error
 
     # -- trainer side -------------------------------------------------------
     def pull(self, ids) -> np.ndarray:
@@ -106,17 +108,29 @@ class AsyncEmbeddingKV:
                     f"({self._q.unfinished_tasks} batches outstanding)")
             _time.sleep(0.005)
 
-    def close(self) -> None:
+    def close(self, suppress_errors: bool = False) -> None:
         if not self._stop.is_set():
-            self.flush()
+            try:
+                # during exception propagation (__exit__), don't let the
+                # barrier stall teardown for the full 60s — the caller's
+                # exception matters more than draining a stuck queue
+                self.flush(timeout=5.0 if suppress_errors else 60.0)
+            except BaseException:
+                if not suppress_errors:
+                    self._stop.set()
+                    self._thread.join(timeout=10)
+                    raise
             self._stop.set()
             self._thread.join(timeout=10)
 
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        # when an exception is already propagating, a flush failure here
+        # must not mask it (ADVICE r3); the sticky _error still surfaces
+        # through any later _raise_if_failed
+        self.close(suppress_errors=exc_type is not None)
 
     # -- communicator thread ------------------------------------------------
     def _communicate(self):
@@ -179,6 +193,24 @@ class GeoSGD:
     def __init__(self, params: Dict[str, object], sync_steps: int = 4,
                  reduce_fn: Optional[Callable] = None):
         from ..framework import Tensor
+        import jax
+        for k, v in params.items():
+            # sync() writes non-Tensors in place (`t[...] = new`); a raw
+            # jax.Array is immutable and would only fail at the FIRST
+            # sync, sync_steps steps into training (ADVICE r3) — reject
+            # at construction with the fix spelled out
+            writable = isinstance(v, Tensor) or (
+                isinstance(v, np.ndarray) and v.flags.writeable)
+            if not writable:
+                kind = type(v).__name__
+                if isinstance(v, np.ndarray):
+                    kind += " (read-only — np.asarray of a jax.Array?)"
+                hint = (" (wrap it: paddle.to_tensor(arr), or pass "
+                        "np.asarray(arr).copy())"
+                        if not isinstance(v, Tensor) else "")
+                raise TypeError(
+                    f"GeoSGD param '{k}' must be a Tensor or a writable "
+                    f"np.ndarray, got {kind}{hint}")
         self._tensors = {k: v for k, v in params.items()}
         self.sync_steps = int(sync_steps)
         self.reduce_fn = reduce_fn or _default_delta_reduce

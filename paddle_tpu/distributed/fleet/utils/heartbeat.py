@@ -27,21 +27,41 @@ __all__ = ["HeartbeatWorker", "HeartbeatMonitor"]
 
 
 class HeartbeatWorker:
-    """Daemon thread beating hb/<rank> on the fleet KV endpoint."""
+    """Beats hb/<rank> on the fleet KV endpoint.
+
+    Two modes: `interval > 0` starts a daemon thread (liveness beats —
+    the process is up); `interval=None` disables the thread and the
+    trainer calls `pulse()` per step (progress beats — the reference's
+    BATCH_BARRIER semantics, where a hung-but-alive trainer stops
+    beating and gets detected)."""
 
     def __init__(self, endpoint: str, rank: int,
-                 interval: float = 1.0):
+                 interval: Optional[float] = 1.0):
         self.rank = int(rank)
-        self.interval = float(interval)
-        self._kv = KVClient(endpoint, timeout=max(1.0, interval))
+        self.interval = None if interval is None else float(interval)
+        self._kv = KVClient(endpoint,
+                            timeout=max(1.0, self.interval or 1.0))
         self._stop = threading.Event()
         self._count = 0
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"heartbeat-{rank}")
+        self._thread = None
+        if self.interval is not None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"heartbeat-{rank}")
 
     def start(self):
-        self._thread.start()
+        if self._thread is not None:
+            self._thread.start()
         return self
+
+    def pulse(self):
+        """One progress-tied beat (call per training step/batch)."""
+        self._count += 1
+        try:
+            self._kv.put(f"hb/{self.rank}",
+                         f"{self._count}:{time.time():.3f}")
+        except Exception:
+            pass  # transient KV unavailability: keep training
 
     def _run(self):
         while not self._stop.is_set():
@@ -55,7 +75,8 @@ class HeartbeatWorker:
 
     def stop(self):
         self._stop.set()
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
 
 class HeartbeatMonitor:
@@ -130,6 +151,18 @@ class HeartbeatMonitor:
                 if self.on_dead is not None:
                     self.on_dead(rank)
         return newly
+
+    def close(self):
+        """Release the GET fan-out pool; long-lived launchers create one
+        monitor per job and would otherwise leak its threads (ADVICE
+        r3)."""
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     @property
     def dead(self) -> List[int]:

@@ -171,6 +171,20 @@ class Categorical(Distribution):
         self.name = name or "Categorical"
         self.logits = _as_array(logits)
         self.dtype = self.logits.dtype
+        # the constructor arg is UNNORMALIZED WEIGHTS (reference quirk,
+        # distribution.py:640); a negative weight is meaningless — the
+        # reference's multinomial kernel errors on it, while silently
+        # clamping at sample time and NaN-ing in probs() diverged
+        # (ADVICE r3). Traced logits (inside jit) can't be validated.
+        try:
+            has_neg = bool(jnp.any(self.logits < 0))
+        except jax.errors.TracerBoolConversionError:
+            has_neg = False
+        if has_neg:
+            raise ValueError(
+                "Categorical weights must be non-negative (the "
+                "constructor takes unnormalized probabilities, not "
+                "log-probabilities)")
 
     def sample(self, shape, seed=0):
         shape = tuple(int(s) for s in shape)

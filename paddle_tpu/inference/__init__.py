@@ -94,6 +94,21 @@ class Predictor:
     def __init__(self, config: Config):
         from jax import export as jax_export
         self.config = config
+        if config._device is not None and config._device[0] == "cpu":
+            # disable_gpu() must actually pin the CPU backend: the TPU
+            # plugin overrides JAX_PLATFORMS on its own, and a wedged
+            # tunnel would otherwise hang the first exported.call. The
+            # update is a silent no-op once any backend has initialized,
+            # so verify and fail LOUDLY rather than hang later.
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            backend = jax.default_backend()
+            if backend != "cpu":
+                raise RuntimeError(
+                    f"Config.disable_gpu(): jax already initialized the "
+                    f"'{backend}' backend in this process — construct "
+                    "the Predictor before any other jax use, or set "
+                    "JAX_PLATFORMS=cpu in the environment")
         prefix = config._prefix
         with open(prefix + ".pdmodel", "rb") as f:
             self._exported = jax_export.deserialize(f.read())

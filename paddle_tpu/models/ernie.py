@@ -132,6 +132,26 @@ def _ring_attention_fn(mesh, mode="ring"):
         axes=("sp",)).__wrapped_smap__
 
 
+from ..ops.registry import register_op as _register_op  # noqa: E402
+
+
+@_register_op("attention_sp", tags=("mesh",))
+def _attention_sp_op(q, k, v, mode="ring"):
+    """Sequence-parallel attention as a REGISTERED op: only `mode` is an
+    attribute; the mesh is re-resolved from the runtime at every call
+    (dist.set_mesh state, like a Place — not program state), so captured
+    programs serialize and a loaded program runs under whatever 'sp'
+    mesh the resuming host has. The previous ad-hoc closure made
+    save succeed and load fail (ADVICE r3)."""
+    from ..distributed.env import get_mesh
+    mesh = get_mesh()
+    if mesh is None or "sp" not in mesh.axis_names:
+        raise ValueError(
+            "attention_sp op needs the global mesh to carry an 'sp' "
+            "axis: dist.set_mesh(build_mesh({'dp': ..., 'sp': ...}))")
+    return _ring_attention_fn(mesh, mode)(q, k, v)
+
+
 class ErnieSelfAttention(nn.Layer):
     def __init__(self, config: ErnieConfig):
         super().__init__()
@@ -155,22 +175,15 @@ class ErnieSelfAttention(nn.Layer):
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
         if self.seq_parallel:
-            from ..distributed.env import get_mesh
-            from ..ops.registry import run_op
             if attn_mask is not None:
                 raise ValueError(
                     "sequence_parallel attention takes no attention_mask"
                     " — pad to full blocks (io/sampler.py bucketing) so"
                     " every position is real, or run the dense model")
-            mesh = get_mesh()
-            if mesh is None or "sp" not in mesh.axis_names:
-                raise ValueError(
-                    "sequence_parallel=True needs the global mesh to "
-                    "carry an 'sp' axis: dist.set_mesh(build_mesh("
-                    "{'dp': ..., 'sp': ...}))")
+            # mesh presence is validated inside the registered op (the
+            # single serialization-safe entry point)
             mode = "ulysses" if self.seq_parallel == "ulysses" else "ring"
-            ring = _ring_attention_fn(mesh, mode)
-            ctx = run_op(f"{mode}_attention_sp", ring, (q, k, v), {})
+            ctx = _attention_sp_op(q, k, v, mode=mode)
             return self.out(ctx.reshape([b, s, h]))
         if attn_mask is None and self.use_flash:
             ctx = F.flash_attention(q, k, v, dropout=self.dropout_p,

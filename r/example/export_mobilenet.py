@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Export a MobileNet inference artifact + golden IO for the R demo
+(reference r/example/mobilenet.py prepares data/model + data/*.txt)."""
+import os
+
+import numpy as np
+
+import jax
+
+
+def main():
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import mobilenet_v1
+
+    os.makedirs("data/model", exist_ok=True)
+    net = mobilenet_v1(num_classes=10)
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+    out = net(x)
+
+    paddle.jit.save(
+        net, "data/model/mobilenet",
+        input_spec=[paddle.static.InputSpec([1, 3, 64, 64], "float32",
+                                            name="x")])
+    np.save("data/data.npy", np.asarray(x._data))
+    np.save("data/result.npy", np.asarray(out._data))
+    print("exported data/model/mobilenet + golden IO")
+
+
+if __name__ == "__main__":
+    main()

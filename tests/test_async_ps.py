@@ -128,3 +128,34 @@ def test_from_strategy_construction():
     s.a_sync_configs = {**s.a_sync_configs, "k_steps": 0}
     with pytest.raises(ValueError, match="k_steps"):
         GeoSGD.from_strategy({"w": w}, s)
+
+
+def test_geo_sgd_rejects_immutable_params_at_construction():
+    """A raw jax.Array would only fail at the FIRST sync, k steps into
+    training (ADVICE r3); the constructor rejects it with the fix."""
+    import jax.numpy as jnp
+    with pytest.raises(TypeError, match="to_tensor"):
+        GeoSGD({"w": jnp.ones((4,))}, sync_steps=2)
+    # np arrays and Tensors still pass
+    g = GeoSGD({"a": np.ones(3, np.float32),
+                "b": paddle.create_parameter([2], "float32")},
+               sync_steps=2)
+    assert g.sync_steps == 2
+
+
+def test_async_kv_error_is_sticky():
+    """After the communicator thread dies on a bad batch, EVERY later
+    push keeps failing — the error is not one-shot (ADVICE r3)."""
+    from paddle_tpu.distributed.embedding_kv import EmbeddingKV
+    kv = EmbeddingKV(dim=4)
+    akv = AsyncEmbeddingKV(kv, merge_var_num=2, max_pending=8)
+    akv._error = RuntimeError("synthetic communicator failure")
+    ids = np.array([1], np.int64)
+    g = np.ones((1, 4), np.float32)
+    for _ in range(2):  # stays raised on repeat calls
+        with pytest.raises(RuntimeError, match="communicator thread"):
+            akv.push(ids, g)
+    # __exit__ with an in-flight exception must not mask it
+    with pytest.raises(KeyError, match="original"):
+        with akv:
+            raise KeyError("original")

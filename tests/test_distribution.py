@@ -145,3 +145,25 @@ def test_base_class_raises():
         with pytest.raises(NotImplementedError):
             getattr(d, m)(*([0] if m in ("sample", "log_prob", "probs")
                             else []))
+
+
+def test_categorical_negative_weights_rejected():
+    """Constructor takes unnormalized probabilities; a negative weight
+    raises at construction (the reference's multinomial errors too)
+    instead of clamp-sampling while probs() NaNs (ADVICE r3)."""
+    with pytest.raises(ValueError, match="non-negative"):
+        Categorical(np.array([0.5, -1.0, 2.0], np.float32))
+
+
+def test_categorical_traced_logits_skip_validation():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.generator import key_scope
+
+    def f(key, w):
+        with key_scope(key):
+            return Categorical(w).sample([4])._data
+
+    out = jax.jit(f)(jax.random.key(0),
+                     jnp.array([1.0, 2.0, 3.0], jnp.float32))
+    assert out.shape == (4,)

