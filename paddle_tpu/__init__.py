@@ -46,6 +46,7 @@ from . import device  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import incubate  # noqa: F401
+from . import quant  # noqa: F401
 from .batch import batch  # noqa: F401  (paddle.batch is the function)
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401

@@ -1,0 +1,424 @@
+"""Quantization workflow: QAT wrapping, PTQ calibration, program pass.
+
+Reference:
+/root/reference/python/paddle/fluid/contrib/slim/quantization/
+  quantization_pass.py      (QuantizationTransformPass: auto-insert
+                             fake_quant/dequant around targeted ops;
+                             QuantizationFreezePass: int8 inference form)
+  post_training_quantization.py (PTQ: calibrate scales over sample data)
+  imperative/qat.py         (ImperativeQuantAware: dygraph layer wrap)
+
+TPU-first shape: the fake-quant op family (ops/quant_ops.py, STE
+custom_vjp) already compiles into the training step; this module adds
+the WORKFLOW on top —
+
+- ImperativeQuantAware.quantize(layer): swap each Linear/Conv2D sublayer
+  for a Quanted* wrapper: per-channel weight quant-dequant + EMA
+  (moving-average abs-max) activation quant-dequant, state carried in
+  buffers so TrainStep's functional buffer path updates it in-graph.
+- convert(layer): freeze to the inference form — int8 weight storage
+  with per-channel scales, frozen activation scales (the
+  QuantizationFreezePass capability on the dygraph path).
+- PostTrainingQuantization: run calibration batches in eval mode,
+  observe abs-max activation scales, emit the converted int8 model.
+- QuantizationTransformPass: the static-Program form — rewrites a
+  captured Program in place, inserting channel-wise weight
+  quant-dequant and dynamic abs-max activation quant-dequant before
+  every matmul/conv op. Dynamic (stateless) activation scales replace
+  the reference's stateful in-graph scale vars: a functional graph
+  prefers recomputing max|x| (one reduction, fused by XLA) over
+  threading mutable scale state through the program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework import Tensor
+from ..nn import functional as F
+from ..ops.quant_ops import (
+    fake_channel_wise_quantize_abs_max,
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+)
+
+__all__ = [
+    "QuantConfig", "ImperativeQuantAware", "quant_aware", "convert",
+    "PostTrainingQuantization", "QuantizationTransformPass",
+    "QuantedLinear", "QuantedConv2D", "FrozenQuantLinear",
+    "FrozenQuantConv2D",
+]
+
+_DEFAULT_TYPES = (nn.Linear, nn.Conv2D)
+
+
+class QuantConfig:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        assert weight_quantize_type in ("channel_wise_abs_max",
+                                        "abs_max")
+        assert activation_quantize_type in ("moving_average_abs_max",
+                                            "abs_max")
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.moving_rate = float(moving_rate)
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+
+
+class _QuantedBase(nn.Layer):
+    """Shared activation-observer plumbing for Quanted* wrappers."""
+
+    def _init_observer(self, cfg: QuantConfig):
+        self.cfg = cfg
+        # EMA state as buffers: functional through TrainStep, in-place
+        # in eager (moving_average_abs_max state vars of the reference)
+        self.register_buffer("_act_accum",
+                             Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("_act_state",
+                             Tensor(jnp.zeros((), jnp.float32)))
+
+    def _quant_act(self, x):
+        cfg = self.cfg
+        if cfg.activation_quantize_type == "abs_max":
+            out, scale = fake_quantize_dequantize_abs_max(
+                x, bit_length=cfg.activation_bits)
+            if self.training:
+                # keep the EMA observer moving even in dynamic abs_max
+                # mode so convert()/PTQ can freeze a scale — otherwise
+                # this config value dead-ends the freeze workflow
+                arr = scale._data if isinstance(scale, Tensor) \
+                    else scale
+                self._act_accum._data = (cfg.moving_rate
+                                         * self._act_accum._data + arr)
+                self._act_state._data = (cfg.moving_rate
+                                         * self._act_state._data + 1.0)
+            return out
+        out, _scale, accum, state = \
+            fake_quantize_dequantize_moving_average_abs_max(
+                x, self._act_accum, self._act_state,
+                moving_rate=cfg.moving_rate,
+                bit_length=cfg.activation_bits,
+                is_test=not self.training)
+        if self.training:
+            self._act_accum._data = accum._data \
+                if isinstance(accum, Tensor) else accum
+            self._act_state._data = state._data \
+                if isinstance(state, Tensor) else state
+        return out
+
+    def _quant_weight(self, w, channel_axis):
+        cfg = self.cfg
+        if cfg.weight_quantize_type == "abs_max":
+            out, _ = fake_quantize_dequantize_abs_max(
+                w, bit_length=cfg.weight_bits)
+            return out
+        out, _ = fake_channel_wise_quantize_dequantize_abs_max(
+            w, bit_length=cfg.weight_bits, quant_axis=channel_axis)
+        return out
+
+    def activation_scale(self) -> float:
+        a = float(np.asarray(self._act_accum._data))
+        s = float(np.asarray(self._act_state._data))
+        return a / max(s, 1e-8)
+
+
+class QuantedLinear(_QuantedBase):
+    """QAT form of nn.Linear (imperative/qat.py QuantizedLinear): both
+    the input and the weight pass through fake quant-dequant (STE
+    backward), weight per OUTPUT channel (axis 1 for [in, out])."""
+
+    def __init__(self, inner: "nn.Linear", cfg: QuantConfig):
+        super().__init__()
+        self._init_observer(cfg)
+        self.weight = inner.weight
+        self.bias = inner.bias
+
+    def forward(self, x):
+        xq = self._quant_act(x)
+        wq = self._quant_weight(self.weight, channel_axis=1)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    """QAT form of nn.Conv2D; weight [out, in, kh, kw] → channel 0."""
+
+    def __init__(self, inner: "nn.Conv2D", cfg: QuantConfig):
+        super().__init__()
+        self._init_observer(cfg)
+        self.weight = inner.weight
+        self.bias = inner.bias
+        self._stride = inner.stride
+        self._padding = inner.padding
+        self._dilation = inner.dilation
+        self._groups = inner.groups
+        self._data_format = inner.data_format or "NCHW"
+
+    def forward(self, x):
+        xq = self._quant_act(x)
+        wq = self._quant_weight(self.weight, channel_axis=0)
+        return F.conv2d(xq, wq, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+def _qmax(bits):
+    return float((1 << (bits - 1)) - 1)
+
+
+class _FrozenBase(nn.Layer):
+    """Inference form: weights STORED int8 (per-channel scales), the
+    activation scale frozen from training/calibration — the
+    QuantizationFreezePass product."""
+
+    def _freeze_weight(self, w, channel_axis, bits):
+        arr = np.asarray(w._data, np.float32)
+        axes = tuple(i for i in range(arr.ndim) if i != channel_axis)
+        scales = np.maximum(np.abs(arr).max(axis=axes), 1e-8)
+        shape = [1] * arr.ndim
+        shape[channel_axis] = -1
+        q = np.clip(np.round(arr / scales.reshape(shape) * _qmax(bits)),
+                    -_qmax(bits) - 1, _qmax(bits)).astype(np.int8)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer(
+            "weight_scales", Tensor(jnp.asarray(scales, jnp.float32)))
+        self._channel_axis = channel_axis
+        self._wbits = bits
+
+    def _dequant_weight(self):
+        shape = [1] * self.weight_int8.ndim
+        shape[self._channel_axis] = -1
+        s = self.weight_scales._data.reshape(shape)
+        return Tensor(self.weight_int8._data.astype(jnp.float32) * s
+                      / _qmax(self._wbits))
+
+    def _quant_act_frozen(self, x, bits):
+        s = max(float(self._act_scale), 1e-8)
+        q = _qmax(bits)
+        arr = x._data if isinstance(x, Tensor) else x
+        return Tensor(jnp.round(jnp.clip(arr / s, -1.0, 1.0) * q)
+                      * s / q)
+
+
+class FrozenQuantLinear(_FrozenBase):
+    def __init__(self, src, act_scale: float, cfg: QuantConfig):
+        super().__init__()
+        self._freeze_weight(src.weight, 1, cfg.weight_bits)
+        self.bias = src.bias
+        self._act_scale = float(act_scale)
+        self._abits = cfg.activation_bits
+
+    def forward(self, x):
+        xq = self._quant_act_frozen(x, self._abits)
+        return F.linear(xq, self._dequant_weight(), self.bias)
+
+
+class FrozenQuantConv2D(_FrozenBase):
+    def __init__(self, src, act_scale: float, cfg: QuantConfig):
+        super().__init__()
+        self._freeze_weight(src.weight, 0, cfg.weight_bits)
+        self.bias = src.bias
+        self._act_scale = float(act_scale)
+        self._abits = cfg.activation_bits
+        def attr(quanted_name, conv_name):
+            # src is a QuantedConv2D (post-QAT) or a raw Conv2D; 0 is a
+            # legitimate value (padding=0), so no falsy-or chains
+            if hasattr(src, quanted_name):
+                return getattr(src, quanted_name)
+            return getattr(src, conv_name)
+        self._stride = attr("_stride", "stride")
+        self._padding = attr("_padding", "padding")
+        self._dilation = attr("_dilation", "dilation")
+        self._groups = attr("_groups", "groups")
+        self._data_format = attr("_data_format", "data_format") or "NCHW"
+
+    def forward(self, x):
+        xq = self._quant_act_frozen(x, self._abits)
+        return F.conv2d(xq, self._dequant_weight(), self.bias,
+                        self._stride, self._padding, self._dilation,
+                        self._groups, self._data_format)
+
+
+def _swap_sublayers(layer, factory, types):
+    """Replace matching sublayers in place (recursively); returns count."""
+    n = 0
+    for name, sub in list(layer._sub_layers.items()):
+        if isinstance(sub, types):
+            layer._sub_layers[name] = factory(sub)
+            n += 1
+        else:
+            n += _swap_sublayers(sub, factory, types)
+    return n
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT entry (imperative/qat.py contract): `quantize(model)`
+    swaps every Linear/Conv2D for its Quanted* wrapper IN PLACE."""
+
+    def __init__(self, config: Optional[QuantConfig] = None, **kw):
+        self.cfg = config or QuantConfig(**kw)
+
+    def quantize(self, model) -> int:
+        cfg = self.cfg
+
+        def factory(sub):
+            if isinstance(sub, nn.Conv2D):
+                return QuantedConv2D(sub, cfg)
+            return QuantedLinear(sub, cfg)
+        n = _swap_sublayers(model, factory, _DEFAULT_TYPES)
+        if n == 0:
+            raise ValueError(
+                "quantize() found no Linear/Conv2D sublayers to wrap")
+        return n
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from ..jit.api import save as jit_save
+        frozen = convert(model, self.cfg)
+        jit_save(frozen, path, input_spec=input_spec)
+        return frozen
+
+
+def quant_aware(model, config: Optional[QuantConfig] = None, **kw):
+    """paddleslim-style convenience: wrap in place and return model."""
+    ImperativeQuantAware(config, **kw).quantize(model)
+    return model
+
+
+def convert(model, config: Optional[QuantConfig] = None):
+    """Freeze a QAT model to the int8 inference form (weights stored
+    int8 + per-channel scales; activation scales frozen from the EMA
+    observers). Returns the model with Quanted* sublayers swapped for
+    Frozen* IN PLACE."""
+    cfg = config or QuantConfig()
+
+    def factory(sub):
+        scale = sub.activation_scale()
+        if scale <= 0:
+            raise ValueError(
+                "convert(): activation observer never ran — train (QAT) "
+                "or calibrate (PTQ) before converting")
+        if isinstance(sub, QuantedConv2D):
+            return FrozenQuantConv2D(sub, scale, sub.cfg)
+        return FrozenQuantLinear(sub, scale, sub.cfg)
+    n = _swap_sublayers(model, factory, (QuantedLinear, QuantedConv2D))
+    if n == 0:
+        raise ValueError("convert() found no Quanted* sublayers; call "
+                         "quantize()/PTQ first")
+    model.eval()
+    return model
+
+
+class PostTrainingQuantization:
+    """PTQ (post_training_quantization.py contract): wrap the model,
+    run `batch_nums` calibration batches in EVAL mode so only the
+    EMA observers move (weights untouched), then freeze to int8."""
+
+    def __init__(self, model, data_loader, batch_nums: int = 10,
+                 config: Optional[QuantConfig] = None, **kw):
+        self.model = model
+        self.data_loader = data_loader
+        self.batch_nums = int(batch_nums)
+        self.cfg = config or QuantConfig(**kw)
+
+    def quantize(self):
+        ImperativeQuantAware(self.cfg).quantize(self.model)
+        # calibration: observers must ACCUMULATE (training-mode op path)
+        # while weights stay frozen — no optimizer runs
+        self.model.train()
+        seen = 0
+        for batch in self.data_loader:
+            xs = batch if isinstance(batch, (list, tuple)) else (batch,)
+            self.model(*xs)
+            seen += 1
+            if seen >= self.batch_nums:
+                break
+        if seen == 0:
+            raise ValueError("PTQ data_loader yielded no batches")
+        return convert(self.model, self.cfg)
+
+    def save_quantized_model(self, path, input_spec=None):
+        from ..jit.api import save as jit_save
+        jit_save(self.model, path, input_spec=input_spec)
+
+
+# ---------------------------------------------------------------------------
+# static Program pass (quantization_pass.py QuantizationTransformPass)
+# ---------------------------------------------------------------------------
+
+_QUANT_TARGET_OPS = {
+    "matmul": 1, "matmul_v2": 1, "mul": 1,     # weight slot, [in, out]
+    "linear": 1,
+    "conv2d": 1,                               # weight slot, [out,...]
+}
+
+
+class QuantizationTransformPass:
+    """Insert fake quant-dequant around matmul/conv ops of a captured
+    static Program, in place: per-output-channel weight quant for
+    captured Parameters, dynamic abs-max quant for activations."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_op_type=None):
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.targets = dict(_QUANT_TARGET_OPS)
+        if quantizable_op_type is not None:
+            self.targets = {k: v for k, v in self.targets.items()
+                            if k in set(quantizable_op_type)}
+
+    def apply(self, program) -> int:
+        from ..ops.registry import get_op
+        from ..static.program import OpNode, Var
+
+        w_op = "fake_channel_wise_quantize_dequantize_abs_max"
+        a_op = "fake_quantize_dequantize_abs_max"
+        w_fn, a_fn = get_op(w_op).fn, get_op(a_op).fn
+
+        new_ops: List[OpNode] = []
+        n_inserted = 0
+        for node in program.ops:
+            if node.op_type in self.targets:
+                weight_slot = self.targets[node.op_type]
+                for slot, vid in enumerate(node.in_ids):
+                    if vid is None:
+                        continue
+                    src = program.vars[vid]
+                    is_weight = vid in program.params and \
+                        vid not in program.buffer_ids
+                    if is_weight and slot == weight_slot:
+                        axis = 1 if "conv" not in node.op_type else 0
+                        qv = Var(program, f"{src.name}.quantized",
+                                 src._data.shape, src._data.dtype)
+                        sv = Var(program, f"{src.name}.quant_scale",
+                                 (src._data.shape[axis],),
+                                 src._data.dtype)
+                        new_ops.append(OpNode(
+                            w_op, w_fn, [vid], [None],
+                            {"bit_length": self.weight_bits,
+                             "quant_axis": axis},
+                            [qv.var_id, sv.var_id], True))
+                    elif not is_weight:
+                        qv = Var(program,
+                                 f"{src.name or 'act'}.quantized",
+                                 src._data.shape, src._data.dtype)
+                        sv = Var(program,
+                                 f"{src.name or 'act'}.quant_scale",
+                                 (), src._data.dtype)
+                        new_ops.append(OpNode(
+                            a_op, a_fn, [vid], [None],
+                            {"bit_length": self.activation_bits},
+                            [qv.var_id, sv.var_id], True))
+                    else:
+                        continue
+                    node.in_ids = list(node.in_ids)
+                    node.in_ids[slot] = qv.var_id
+                    n_inserted += 1
+            new_ops.append(node)
+        program.ops = new_ops
+        return n_inserted
