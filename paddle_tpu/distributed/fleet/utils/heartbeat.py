@@ -152,6 +152,22 @@ class HeartbeatMonitor:
                     self.on_dead(rank)
         return newly
 
+    def revive(self, rank: int):
+        """Forget a death verdict after the launcher restarts `rank`.
+
+        The KV slot is reset to the never-beat sentinel so the rank gets
+        the STARTUP grace period again — otherwise the stale pre-restart
+        counter would put the restarted worker (still importing/
+        compiling, or fast-forwarding past completed work without
+        pulsing) on the short stall clock and re-kill it."""
+        self._dead.discard(int(rank))
+        self._last.pop(int(rank), None)
+        self._start = time.monotonic()  # restart the startup clock
+        try:
+            self._kv.put(f"hb/{rank}", f"-1:{time.time():.3f}")
+        except Exception:
+            pass  # KV outage: conservative sweep logic still applies
+
     def close(self):
         """Release the GET fan-out pool; long-lived launchers create one
         monitor per job and would otherwise leak its threads (ADVICE

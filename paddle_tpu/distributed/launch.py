@@ -1,18 +1,32 @@
 """Launcher CLI (reference fleet/launch.py:334 `fleetrun` parity).
 
 Usage: python -m paddle_tpu.distributed.launch [--nproc_per_node N]
-       [--ips host1,host2] [--master ip:port] training_script [args...]
+       [--ips host1,host2] [--master ip:port] [--elastic] script [args...]
 
 On TPU a single process drives all local chips (SPMD), so single-host
 launch is exec-with-env. Multi-host: one process per host, coordinated via
 the JAX coordination service (PADDLE_MASTER → jax.distributed.initialize,
 replacing the reference's PADDLE_TRAINER_ENDPOINTS TCP NCCL-id exchange).
+
+--elastic closes the failure-detection loop (reference
+heart_beat_monitor.cc detects; elastic/fault-tolerant launchers restart):
+the launcher starts a fleet KV, workers beat hb/<rank> (ideally
+progress-tied via HeartbeatWorker.pulse per step), a HeartbeatMonitor
+sweeps for stalls, and a dead/hung/crashed worker triggers a restart —
+workers resume from their auto-checkpoints (the preemption drill's
+contract). Policy `gang` (default) restarts every rank together — the
+right semantics for XLA-collective jobs, where the coordination service
+cannot re-admit a single rank mid-job (whole-slice restart is also how
+TPU pods recover); policy `rank` restarts only the dead rank — for
+loosely-coupled jobs (PS/geo-SGD, embarrassingly-parallel sweeps).
 """
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
+import time
 
 
 def parse_args(argv):
@@ -29,36 +43,180 @@ def parse_args(argv):
     p.add_argument("--ips", type=str, default="",
                    help="comma list of host ips (informational)")
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise workers: heartbeat + crash detection, "
+                        "restart on failure (workers resume from "
+                        "auto-checkpoint)")
+    p.add_argument("--elastic_policy", choices=("gang", "rank"),
+                   default="gang")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--heartbeat_endpoint", type=str, default="",
+                   help="fleet KV for heartbeats; empty = launcher "
+                        "starts its own")
+    p.add_argument("--heartbeat_timeout", type=float, default=10.0)
+    p.add_argument("--heartbeat_startup_timeout", type=float,
+                   default=120.0)
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs="...")
     return p.parse_args(argv)
 
 
+def _worker_env(args, local_rank, world, extra=None):
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+    })
+    if args.master:
+        host, _, port = args.master.partition(":")
+        env["PADDLE_MASTER"] = host
+        env["MASTER_PORT"] = port or "8476"
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _spawn(args, local_rank, world, extra_env=None):
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    cmd = [sys.executable, args.script] + list(args.script_args)
+    stdout = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(os.path.join(args.log_dir,
+                                   f"worker.{rank}.log"), "a")
+    try:
+        proc = subprocess.Popen(
+            cmd, env=_worker_env(args, local_rank, world, extra_env),
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+    finally:
+        # the child holds its own copy of the fd; closing the parent's
+        # stops the elastic loop from leaking one per respawn
+        if stdout is not None:
+            stdout.close()
+    return proc
+
+
+def _terminate(proc, grace=5.0):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+def _elastic_supervise(args, world) -> int:
+    from .fleet.utils import KVServer
+    from .fleet.utils.heartbeat import HeartbeatMonitor
+
+    if args.nnodes > 1:
+        # a launcher-private KV can't see remote ranks, and a gang
+        # bounce of only the LOCAL procs would leave remote peers in
+        # the old collective incarnation — wedged, not recovered
+        raise SystemExit(
+            "--elastic is single-node in this release: multi-node "
+            "recovery needs one supervisor per node coordinating over "
+            "a shared KV (run the job under an external elastic "
+            "orchestrator, or one elastic launcher per node with "
+            "nnodes=1 and PS-style loose coupling)")
+    server = None
+    endpoint = args.heartbeat_endpoint
+    if not endpoint:
+        server = KVServer(0).start()
+        endpoint = f"127.0.0.1:{server.port}"
+    extra = {"PADDLE_HEARTBEAT_ENDPOINT": endpoint}
+
+    def respawn(local_rank, incarnation):
+        return _spawn(args, local_rank, world,
+                      dict(extra,
+                           PADDLE_RESTART_COUNT=str(incarnation)))
+
+    procs = {}
+    try:
+        procs = {lr: respawn(lr, 0) for lr in range(args.nproc_per_node)}
+        incarnation = {lr: 0 for lr in procs}
+        completed: set = set()
+        restarts = 0
+        monitor = HeartbeatMonitor(
+            endpoint, world, timeout=args.heartbeat_timeout,
+            startup_timeout=args.heartbeat_startup_timeout)
+        while True:
+            time.sleep(0.25)
+            failed = []
+            for lr, p in procs.items():
+                if lr in completed:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    completed.add(lr)
+                else:
+                    failed.append((lr, f"exit rc={rc}"))
+            # hung-but-alive workers: heartbeat counter stopped moving
+            for rank in monitor.sweep():
+                lr = rank - args.node_rank * args.nproc_per_node
+                if lr in procs and lr not in completed and \
+                        not any(f[0] == lr for f in failed):
+                    failed.append((lr, "heartbeat stall"))
+            if len(completed) == len(procs):
+                monitor.close()
+                return 0
+            if not failed:
+                continue
+            restarts += 1
+            if restarts > args.max_restarts:
+                print(f"[elastic] rank(s) {[f[0] for f in failed]} "
+                      f"failed and max_restarts={args.max_restarts} "
+                      "exhausted; aborting job", file=sys.stderr)
+                for p in procs.values():
+                    _terminate(p)
+                monitor.close()
+                return 1
+            for lr, why in failed:
+                print(f"[elastic] rank {lr} down ({why}); restart "
+                      f"{restarts}/{args.max_restarts} "
+                      f"(policy={args.elastic_policy})", file=sys.stderr)
+            if args.elastic_policy == "gang":
+                # collective jobs can't re-admit one rank: bounce the
+                # gang; completed ranks re-run too and fast-forward via
+                # their epoch guard (test_preemption resume-skip)
+                for p in procs.values():
+                    _terminate(p)
+                completed.clear()
+                for lr in procs:
+                    incarnation[lr] += 1
+                    monitor.revive(args.node_rank * args.nproc_per_node
+                                   + lr)
+                    procs[lr] = respawn(lr, incarnation[lr])
+            else:
+                for lr, _why in failed:
+                    _terminate(procs[lr])
+                    incarnation[lr] += 1
+                    monitor.revive(args.node_rank * args.nproc_per_node
+                                   + lr)
+                    procs[lr] = respawn(lr, incarnation[lr])
+    finally:
+        # a supervisor crash (KeyboardInterrupt, EMFILE, ...) must not
+        # orphan training processes holding the chips
+        for p in procs.values():
+            try:
+                _terminate(p)
+            except Exception:
+                pass
+        if server is not None:
+            server.stop()
+
+
 def launch(argv=None):
     args = parse_args(argv if argv is not None else sys.argv[1:])
     world = args.nnodes * args.nproc_per_node
-    procs = []
-    for local_rank in range(args.nproc_per_node):
-        rank = args.node_rank * args.nproc_per_node + local_rank
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_LOCAL_RANK": str(local_rank),
-        })
-        if args.master:
-            host, _, port = args.master.partition(":")
-            env["PADDLE_MASTER"] = host
-            env["MASTER_PORT"] = port or "8476"
-        cmd = [sys.executable, args.script] + list(args.script_args)
-        stdout = None
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            stdout = open(os.path.join(args.log_dir,
-                                       f"worker.{rank}.log"), "w")
-        procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
-                                      stderr=subprocess.STDOUT
-                                      if stdout else None))
+    if args.elastic:
+        sys.exit(_elastic_supervise(args, world))
+    procs = [_spawn(args, lr, world) for lr in range(args.nproc_per_node)]
     rc = 0
     for p in procs:
         p.wait()

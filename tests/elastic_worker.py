@@ -1,0 +1,92 @@
+"""Worker for the elastic-launch drill (tests/test_elastic_launch.py).
+
+Deterministic eager SGD on a fixed dataset with per-step auto-checkpoint
+and progress-tied heartbeats (HeartbeatWorker.pulse per step). On its
+FIRST incarnation the designated fail rank either SIGKILLs itself
+(crash) or stops beating forever (hang) at --fail-at-step; after the
+launcher restarts it, the checkpoint resume must make the final params
+identical to an undisturbed run."""
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out-dir", required=True)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--fail-mode", choices=("none", "crash", "hang"),
+                    default="none")
+    ap.add_argument("--fail-rank", type=int, default=1)
+    ap.add_argument("--fail-at-step", type=int, default=5)
+    args = ap.parse_args()
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", 0))
+    hb = None
+    endpoint = os.environ.get("PADDLE_HEARTBEAT_ENDPOINT")
+    if endpoint:
+        from paddle_tpu.distributed.fleet.utils.heartbeat import \
+            HeartbeatWorker
+        hb = HeartbeatWorker(endpoint, rank, interval=None)  # pulse-only
+
+    rng = np.random.RandomState(100 + rank)
+    X = rng.randn(32, 4).astype(np.float32)
+    Y = (X @ rng.randn(4, 1)).astype(np.float32)
+
+    w = paddle.create_parameter([4, 1], "float32")
+    w.set_value(np.zeros((4, 1), np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
+
+    ckpt = os.path.join(args.ckpt_dir, f"rank{rank}.npz")
+    start = 0
+    if os.path.exists(ckpt):
+        d = np.load(ckpt)
+        w.set_value(d["w"])
+        start = int(d["step"]) + 1
+
+    for step in range(start, args.steps):
+        every_time = bool(os.environ.get("PADDLE_FAIL_EVERY_TIME"))
+        if (args.fail_mode != "none"
+                and (incarnation == 0 or every_time)
+                and rank == args.fail_rank
+                and step == args.fail_at_step):
+            if args.fail_mode == "crash":
+                os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(600)  # hang: alive, no pulses — monitor's job
+        xb = paddle.to_tensor(X)
+        yb = paddle.to_tensor(Y)
+        loss = ((xb @ w - yb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # atomic per-step checkpoint, THEN the progress beat
+        tmp = ckpt + ".tmp.npz"
+        np.savez(tmp, w=np.asarray(w._data), step=step)
+        os.replace(tmp, ckpt)
+        if hb is not None:
+            hb.pulse()
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    with open(os.path.join(args.out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump({"w": np.asarray(w._data).tolist(),
+                   "incarnation": incarnation}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
