@@ -193,7 +193,6 @@ class GeoSGD:
     def __init__(self, params: Dict[str, object], sync_steps: int = 4,
                  reduce_fn: Optional[Callable] = None):
         from ..framework import Tensor
-        import jax
         for k, v in params.items():
             # sync() writes non-Tensors in place (`t[...] = new`); a raw
             # jax.Array is immutable and would only fail at the FIRST

@@ -171,25 +171,26 @@ class Categorical(Distribution):
         self.name = name or "Categorical"
         self.logits = _as_array(logits)
         self.dtype = self.logits.dtype
-        # the constructor arg is UNNORMALIZED WEIGHTS (reference quirk,
-        # distribution.py:640); a negative weight is meaningless — the
-        # reference's multinomial kernel errors on it, while silently
-        # clamping at sample time and NaN-ing in probs() diverged
-        # (ADVICE r3). Traced logits (inside jit) can't be validated.
-        try:
-            has_neg = bool(jnp.any(self.logits < 0))
-        except jax.errors.TracerBoolConversionError:
-            has_neg = False
-        if has_neg:
-            raise ValueError(
-                "Categorical weights must be non-negative (the "
-                "constructor takes unnormalized probabilities, not "
-                "log-probabilities)")
 
     def sample(self, shape, seed=0):
         shape = tuple(int(s) for s in shape)
         num = int(np.prod(shape)) if shape else 1
         logits = self.logits
+        # sample() consumes the constructor arg as UNNORMALIZED
+        # PROBABILITIES (reference quirk: entropy/kl treat the same arg
+        # in log space) — a negative weight here is meaningless and the
+        # reference's multinomial kernel errors on it; silently clamping
+        # diverged from probs() (ADVICE r3). Only at sample time:
+        # log-space construction for entropy/kl stays valid. Traced
+        # logits (inside jit) can't be validated.
+        try:
+            if bool(jnp.any(logits < 0)):
+                raise ValueError(
+                    "Categorical.sample needs non-negative weights "
+                    "(the constructor arg is unnormalized "
+                    "probabilities for sampling, not log-probs)")
+        except jax.errors.TracerBoolConversionError:
+            pass
         batch = logits.shape[:-1]
         # sample indices with replacement from the normalized weights
         lg = jnp.log(jnp.maximum(logits, 1e-30))

@@ -147,12 +147,20 @@ def test_base_class_raises():
                             else []))
 
 
-def test_categorical_negative_weights_rejected():
-    """Constructor takes unnormalized probabilities; a negative weight
-    raises at construction (the reference's multinomial errors too)
-    instead of clamp-sampling while probs() NaNs (ADVICE r3)."""
+def test_categorical_negative_weights_rejected_at_sample():
+    """sample() consumes the arg as unnormalized probabilities; a
+    negative weight raises there (the reference's multinomial errors
+    too) instead of clamp-sampling while probs() NaNs (ADVICE r3).
+    Construction stays permissive: entropy/kl treat the same arg in
+    log space (documented reference quirk), where negatives are
+    legitimate."""
+    c = Categorical(np.array([0.5, -1.0, 2.0], np.float32))
     with pytest.raises(ValueError, match="non-negative"):
-        Categorical(np.array([0.5, -1.0, 2.0], np.float32))
+        c.sample([4])
+    # log-space usage still works end-to-end
+    lg = Categorical(np.log(np.array([0.2, 0.3, 0.5], np.float32)))
+    ent = np.asarray(lg.entropy()._data)
+    assert np.isfinite(ent).all()
 
 
 def test_categorical_traced_logits_skip_validation():

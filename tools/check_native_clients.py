@@ -73,10 +73,18 @@ def check_r() -> dict:
         return {"client": "r", "toolchain": False, "ran": False}
     with tempfile.TemporaryDirectory() as td:
         env = dict(os.environ, PYTHONPATH=REPO)
-        subprocess.run([sys.executable,
-                        os.path.join(rdir, "example",
-                                     "export_mobilenet.py")],
-                       cwd=td, env=env, capture_output=True, timeout=600)
+        prep = subprocess.run(
+            [sys.executable,
+             os.path.join(rdir, "example", "export_mobilenet.py")],
+            cwd=td, env=env, capture_output=True, text=True,
+            timeout=600)
+        if prep.returncode != 0:
+            # blame the Python export, not the R demo downstream of it
+            status = ("export_mobilenet.py (Python prep) FAILED: "
+                      f"{prep.stderr.strip()[:400]}")
+            _set_status(os.path.join(rdir, "README.md"), status)
+            return {"client": "r", "toolchain": True, "ran": False,
+                    "stderr": prep.stderr[-1000:]}
         r = subprocess.run([exe, os.path.join(rdir, "example",
                                               "mobilenet.r")],
                            cwd=td, env=env, capture_output=True,
