@@ -16,16 +16,18 @@ from __future__ import annotations
 import functools
 import math
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
 from ..distributed.env import TENSOR_AXIS
-from ..framework import Tensor
+from ..framework import Parameter, Tensor
 from ..ops import creation, manipulation
 
 __all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining",
+           "ErnieScannedEncoder",
            "ErnieForSequenceClassification", "ErnieStageFirst",
            "ErnieStageMiddle", "ErnieStageLast", "ernie_pipeline_stages"]
 
@@ -40,7 +42,7 @@ class ErnieConfig:
                  use_flash_attention=True, moe_num_experts=0,
                  moe_top_k=2, moe_every_n_layers=2,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 sequence_parallel=False):
+                 sequence_parallel=False, scan_layers=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -81,6 +83,16 @@ class ErnieConfig:
                 "sequence_parallel requires "
                 "attention_probs_dropout_prob=0 (ring attention carries "
                 "no dropout)")
+        # scan_layers: run all encoder blocks as ONE lax.scan over
+        # stacked parameters — compile time and HLO size O(1) in depth
+        # (a 48-layer model lowers as fast as a 2-layer one). Requires
+        # homogeneous blocks: no interleaved MoE.
+        self.scan_layers = bool(scan_layers)
+        if self.scan_layers and moe_num_experts > 0:
+            raise ValueError(
+                "scan_layers needs homogeneous blocks; interleaved MoE "
+                "layers differ from dense ones (set moe_num_experts=0 "
+                "or scan_layers=False)")
 
     @classmethod
     def base(cls, **kw):
@@ -235,6 +247,105 @@ class ErnieLayer(nn.Layer):
         return x
 
 
+class ErnieScannedEncoder(nn.Layer):
+    """All encoder blocks as ONE ``lax.scan`` over stacked parameters.
+
+    TPU-first rationale: XLA compiles an unrolled L-layer transformer as
+    L copies of the same HLO — compile time and program size grow
+    linearly in depth (the practical blocker for 10B-class single-
+    program compiles). Stacking each block parameter to ``[L, *shape]``
+    and scanning one block body makes both O(1) in depth; per-layer
+    weights stream through the same compiled body. The reference has no
+    equivalent (its Program unrolls ops per layer).
+
+    Parameters are the SAME count/shapes as the unrolled encoder, just
+    stacked: ``encoder.0.attention.qkv.weight [h,3h]`` x L becomes
+    ``attention.qkv.weight [L,h,3h]``; tp sharding specs shift right by
+    the stack axis. ``load_from_layers`` imports unrolled weights, so
+    the parity tests compare the two forms on identical values.
+
+    The whole scan runs through ``run_op`` so the eager tape
+    differentiates it as one node (jax.vjp through lax.scan); under the
+    compiled TrainStep it traces like any op. Static Program capture of
+    a scanned encoder is rejected at save time (the body closure is not
+    a registered op) — use the unrolled form for serialized programs.
+    """
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.L = int(config.num_hidden_layers)
+        # structure + init + specs come from real per-layer modules;
+        # construction cost equals the unrolled encoder's, paid once
+        layers = [ErnieLayer(config) for _ in range(self.L)]
+        tmpl = layers[0]
+        # the template executes the scan body; it is deliberately NOT a
+        # registered sublayer (its own params never train — the stacked
+        # tensors are the real ones)
+        object.__setattr__(self, "_template", tmpl)
+        self._names = list(tmpl.state_dict().keys())
+        self._mangled = {n: "stk__" + n.replace(".", "__")
+                         for n in self._names}
+        for n in self._names:
+            per = [l.state_dict()[n] for l in layers]
+            stacked = jnp.stack([t._data for t in per])
+            p = Parameter(stacked, name=self._mangled[n])
+            p.stop_gradient = per[0].stop_gradient
+            spec = getattr(per[0], "sharding_spec", None)
+            if spec is not None:
+                p.sharding_spec = P(*((None,) + tuple(spec)))
+            setattr(self, self._mangled[n], p)
+
+    def load_from_layers(self, layer_list):
+        """Import an unrolled encoder's (LayerList of ErnieLayer)
+        weights into the stacks."""
+        assert len(layer_list) == self.L
+        for n in self._names:
+            stacked = jnp.stack(
+                [lyr.state_dict()[n]._data for lyr in layer_list])
+            getattr(self, self._mangled[n])._data = stacked
+
+    def forward(self, x, attn_mask=None):
+        from ..core.generator import next_key
+        from ..jit.api import functionalize
+        from ..ops.registry import run_op
+        tmpl = self._template
+        # mirror train/eval onto the body template (dropout mode)
+        for lyr in tmpl.sublayers(include_self=True):
+            lyr.training = self.training
+        pure = functionalize(tmpl.forward, tmpl)
+        names = self._names
+        key0 = next_key()  # folded per layer inside the scan
+        L = self.L
+
+        def scan_body(x_arr, mask_arr, flat):
+            from ..ops.registry import no_static_capture
+            stacks = dict(zip(names, flat))
+
+            def body(h, xs):
+                layer_state, i = xs
+                out, _ = pure(layer_state, jax.random.fold_in(key0, i),
+                              h, mask_arr)
+                return out, None
+
+            with no_static_capture():
+                out, _ = jax.lax.scan(
+                    body, x_arr, (stacks, jnp.arange(L)))
+            return out
+
+        flat = [getattr(self, self._mangled[n]) for n in names]
+        # the mask rides as a real op input (not a closure), so static
+        # capture sees a plain tensor slot instead of crashing on a
+        # closed-over symbolic Var
+        if attn_mask is None:
+            return run_op("ernie_scanned_encoder",
+                          lambda x_arr, *fl: scan_body(x_arr, None, fl),
+                          (x, *flat), {})
+        return run_op(
+            "ernie_scanned_encoder_masked",
+            lambda x_arr, m, *fl: scan_body(x_arr, m, fl),
+            (x, attn_mask, *flat), {})
+
+
 def _is_moe_layer(config: ErnieConfig, i: int) -> bool:
     """MoE placement rule: every n-th block (1-indexed), when the config
     enables experts — the standard interleaved-MoE transformer layout."""
@@ -275,9 +386,13 @@ class ErnieModel(nn.Layer):
         super().__init__()
         self.config = config or ErnieConfig(**kwargs)
         self.embeddings = ErnieEmbeddings(self.config)
-        self.encoder = nn.LayerList(
-            [ErnieLayer(self.config, use_moe=_is_moe_layer(self.config, i))
-             for i in range(self.config.num_hidden_layers)])
+        if self.config.scan_layers:
+            self.encoder = ErnieScannedEncoder(self.config)
+        else:
+            self.encoder = nn.LayerList(
+                [ErnieLayer(self.config,
+                            use_moe=_is_moe_layer(self.config, i))
+                 for i in range(self.config.num_hidden_layers)])
         self.pooler = nn.Linear(self.config.hidden_size,
                                 self.config.hidden_size)
 
@@ -285,6 +400,8 @@ class ErnieModel(nn.Layer):
         """Sum of the last forward's expert load-balancing losses (None
         for a dense config). Traced Tensors: usable inside a TrainStep
         loss_fn during the same forward trace."""
+        if isinstance(self.encoder, ErnieScannedEncoder):
+            return None  # scan_layers excludes MoE by construction
         total = None
         for lyr in self.encoder:
             if getattr(lyr, "use_moe", False) and \
@@ -300,8 +417,11 @@ class ErnieModel(nn.Layer):
             # [b, s] 1/0 mask -> additive [b, 1, 1, s]
             am = manipulation.unsqueeze(attention_mask, [1, 2])
             attention_mask = (1.0 - am.astype("float32")) * -1e9
-        for layer in self.encoder:
-            x = layer(x, attention_mask)
+        if isinstance(self.encoder, ErnieScannedEncoder):
+            x = self.encoder(x, attention_mask)
+        else:
+            for layer in self.encoder:
+                x = layer(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

@@ -70,6 +70,24 @@ def set_static_tracer(tracer):
     _static_tracer = tracer
 
 
+@contextlib.contextmanager
+def no_static_capture():
+    """Suspend program capture inside a composite op's body.
+
+    A captured op whose fn itself executes layers (the scanned ERNIE
+    encoder) would otherwise re-enter the tracer during add_op's
+    eval_shape: the inner ops get appended to the Program a second time
+    with shape-inference tracers baked into their attrs. The composite
+    is the op; its internals are not program structure."""
+    global _static_tracer
+    prev = _static_tracer
+    _static_tracer = None
+    try:
+        yield
+    finally:
+        _static_tracer = prev
+
+
 def get_op(name: str) -> OpInfo:
     if name not in OPS:
         raise _enforce.NotFoundError(f"op '{name}' is not registered")
