@@ -176,6 +176,17 @@ def moving_average_abs_max_scale(x, in_accum, in_state, moving_rate=0.9,
 
 
 @register_op("fake_dequantize_max_abs")
-def fake_dequantize_max_abs(x, scale, max_range, name=None):
-    """out = x * scale / max_range (ref fake_dequantize_op.cc)."""
+def fake_dequantize_max_abs(x, scale, max_range, quant_axis=None,
+                            name=None):
+    """out = x * scale / max_range (ref fake_dequantize_op.cc).
+
+    quant_axis: broadcast a per-channel [C] scale along that axis of x
+    (the freeze-pass form where x is an int8-stored weight); None keeps
+    the reference's plain trailing-dim broadcast."""
+    if quant_axis is not None and jnp.ndim(scale) == 1:
+        shape = [1] * jnp.ndim(x)
+        shape[quant_axis] = -1
+        scale = jnp.reshape(scale, shape)
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer):
+        x = jnp.asarray(x).astype(jnp.float32)  # int8-stored weights
     return x * scale / max_range

@@ -50,6 +50,7 @@ from ..ops.quant_ops import (
 __all__ = [
     "QuantConfig", "ImperativeQuantAware", "quant_aware", "convert",
     "PostTrainingQuantization", "QuantizationTransformPass",
+    "QuantizationFreezePass",
     "QuantedLinear", "QuantedConv2D", "FrozenQuantLinear",
     "FrozenQuantConv2D",
 ]
@@ -422,3 +423,94 @@ class QuantizationTransformPass:
             new_ops.append(node)
         program.ops = new_ops
         return n_inserted
+
+
+class QuantizationFreezePass:
+    """Freeze a QAT static Program for inference
+    (quantization_pass.py QuantizationFreezePass): every per-channel
+    weight quant-dequant node becomes an int8-STORED parameter plus a
+    `fake_dequantize_max_abs` op with baked per-channel scales.
+    Activation quant stays the dynamic abs-max form the transform pass
+    inserted (stateless in-graph scales — no calibration vars to
+    freeze; the reference bakes its moving-average vars instead).
+
+    Apply to `program.clone(for_test=True)` AFTER training; the frozen
+    program still serializes (all ops registered, int8 param values
+    ride the params section)."""
+
+    def __init__(self, weight_bits=8):
+        self.weight_bits = int(weight_bits)
+
+    def apply(self, program) -> int:
+        import jax.numpy as _jnp
+        from ..framework import Parameter as _Param
+        from ..ops.registry import get_op
+
+        w_op = "fake_channel_wise_quantize_dequantize_abs_max"
+        dq_op = "fake_dequantize_max_abs"
+        dq_fn = get_op(dq_op).fn
+        n_frozen = 0
+        frozen_scales = {}  # wid -> (scales, qmax): tied weights feed
+        #                     several quant nodes; quantize ONCE and
+        #                     reuse — re-quantizing the already-int8
+        #                     store would bake ~qmax-sized scales
+        for node in program.ops:
+            if node.op_type != w_op:
+                continue
+            wid = node.in_ids[0]
+            if wid is None or wid not in program.params:
+                continue
+            axis = int(node.kwargs.get("quant_axis", 0))
+            # freeze with the SAME bit width the node trained with
+            qmax = _qmax(int(node.kwargs.get("bit_length",
+                                             self.weight_bits)))
+            if wid in frozen_scales:
+                scales, qmax = frozen_scales[wid]
+                arr_shape = program.params[wid]._data.shape
+            else:
+                arr = np.asarray(program.params[wid]._data, np.float32)
+                arr_shape = arr.shape
+                axes = tuple(i for i in range(arr.ndim) if i != axis)
+                scales = np.maximum(np.abs(arr).max(axis=axes), 1e-8)
+                shape = [1] * arr.ndim
+                shape[axis] = -1
+                q = np.clip(
+                    np.round(arr / scales.reshape(shape) * qmax),
+                    -qmax - 1, qmax).astype(np.int8)
+                # the live parameter becomes the int8 store
+                p8 = _Param(_jnp.asarray(q))
+                p8.name = program.params[wid].name
+                p8.stop_gradient = True
+                program.params[wid] = p8
+                program.buffer_ids.add(wid)  # frozen: no grads/updates
+                frozen_scales[wid] = (scales, qmax)
+            # clone() shares Var objects with the source program —
+            # replace, never mutate, or the TRAINING program's weight
+            # var would silently turn int8 too
+            from ..static.program import Var as _Var
+            old = program.vars[wid]
+            if getattr(old, "_frozen_int8", False) is False:
+                nv = _Var.__new__(_Var)
+                nv._init_symbolic(tuple(arr_shape), np.dtype(np.int8))
+                nv.program = program
+                nv.name = old.name
+                nv.kind = old.kind
+                nv.orig_shape = getattr(old, "orig_shape",
+                                        tuple(arr_shape))
+                nv.symbolic_dims = getattr(old, "symbolic_dims", set())
+                nv.var_id = wid
+                nv._frozen_int8 = True
+                program.vars[wid] = nv
+            # rewrite the node: quant-dequant -> dequant(int8, scales)
+            node.op_type = dq_op
+            node.fn = dq_fn
+            node.in_ids = [wid, None, None]
+            node.const_args = [None, _jnp.asarray(scales, _jnp.float32),
+                               float(qmax)]
+            node.kwargs = {"quant_axis": axis}
+            # keep only the dequant output; the old scale output var
+            # stays in vars but is produced by nothing (never fetched)
+            node.out_ids = node.out_ids[:1]
+            node.multi = False
+            n_frozen += 1
+        return n_frozen

@@ -156,3 +156,57 @@ def test_static_transform_pass_inserts_and_serializes():
                                     fetch_list=[p2.var_by_name(
                                         main.vars[loss.var_id].name)])
     np.testing.assert_array_equal(got, got2)
+
+
+def test_static_freeze_pass_int8_program():
+    """QuantizationFreezePass: after QAT training, the inference clone
+    stores weights as int8 + per-channel scales via dequant ops, still
+    runs, and still serializes (quantization_pass.py freeze contract)."""
+    from paddle_tpu.quant import QuantizationFreezePass
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 8])
+        w = paddle.create_parameter([8, 6], "float32")
+        w.set_value(RNG.randn(8, 6).astype(np.float32) * 0.5)
+        b = paddle.create_parameter([6], "float32")
+        b.set_value(np.zeros(6, np.float32))
+        y = static.data("y", [8, 6])
+        out = paddle.matmul(x, w) + b
+        loss = paddle.mean((out - y) ** 2)
+        QuantizationTransformPass().apply(main)
+        opt = paddle.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    xv = RNG.randn(8, 8).astype(np.float32)
+    yv = RNG.randn(8, 6).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(15)]
+    assert losses[-1] < losses[0], losses  # QAT training works (STE)
+
+    infer = main.clone(for_test=True)
+    ref = exe.run(infer, feed={"x": xv, "y": yv}, fetch_list=[out])[0]
+    n = QuantizationFreezePass().apply(infer)
+    assert n == 1
+    # weight now STORED int8 in the frozen program, untouched in main
+    wid = [vid for vid, p in infer.params.items()
+           if np.asarray(p._data).dtype == np.int8]
+    assert len(wid) == 1
+    assert np.asarray(main.params[wid[0]]._data).dtype == np.float32
+    types = [op.op_type for op in infer.ops]
+    assert "fake_dequantize_max_abs" in types
+    assert "fake_channel_wise_quantize_dequantize_abs_max" not in types
+
+    got = exe.run(infer, feed={"x": xv, "y": yv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    # the frozen int8 program round-trips through serialization
+    p2 = static.Program.from_bytes(infer.to_bytes())
+    assert np.asarray(p2.params[wid[0]]._data).dtype == np.int8
+    got2 = static.Executor().run(
+        p2, feed={"x": xv, "y": yv},
+        fetch_list=[p2.vars[out.var_id]])[0]
+    np.testing.assert_array_equal(got, got2)
+    # and the ORIGINAL training program still trains fp32 after freeze
+    more = float(exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[loss])[0])
+    assert np.isfinite(more)
