@@ -247,103 +247,20 @@ class ErnieLayer(nn.Layer):
         return x
 
 
-class ErnieScannedEncoder(nn.Layer):
-    """All encoder blocks as ONE ``lax.scan`` over stacked parameters.
-
-    TPU-first rationale: XLA compiles an unrolled L-layer transformer as
-    L copies of the same HLO — compile time and program size grow
-    linearly in depth (the practical blocker for 10B-class single-
-    program compiles). Stacking each block parameter to ``[L, *shape]``
-    and scanning one block body makes both O(1) in depth; per-layer
-    weights stream through the same compiled body. The reference has no
-    equivalent (its Program unrolls ops per layer).
-
-    Parameters are the SAME count/shapes as the unrolled encoder, just
-    stacked: ``encoder.0.attention.qkv.weight [h,3h]`` x L becomes
-    ``attention.qkv.weight [L,h,3h]``; tp sharding specs shift right by
-    the stack axis. ``load_from_layers`` imports unrolled weights, so
-    the parity tests compare the two forms on identical values.
-
-    The whole scan runs through ``run_op`` so the eager tape
-    differentiates it as one node (jax.vjp through lax.scan); under the
-    compiled TrainStep it traces like any op. Static Program capture of
-    a scanned encoder is rejected at save time (the body closure is not
-    a registered op) — use the unrolled form for serialized programs.
-    """
+class ErnieScannedEncoder(nn.ScannedStack):
+    """All encoder blocks as ONE ``lax.scan`` over stacked parameters
+    (nn.ScannedStack) — compile time and HLO size O(1) in depth.
+    ``encoder.0.attention.qkv.weight [h,3h]`` x L becomes
+    ``attention.qkv.weight [L,h,3h]``; tp specs shift past the stack
+    axis. ``load_from_layers`` imports unrolled weights (parity tests
+    compare both forms on identical values); the attention mask rides
+    as a real op input."""
 
     def __init__(self, config: ErnieConfig):
-        super().__init__()
-        self.L = int(config.num_hidden_layers)
-        # structure + init + specs come from real per-layer modules;
-        # construction cost equals the unrolled encoder's, paid once
-        layers = [ErnieLayer(config) for _ in range(self.L)]
-        tmpl = layers[0]
-        # the template executes the scan body; it is deliberately NOT a
-        # registered sublayer (its own params never train — the stacked
-        # tensors are the real ones)
-        object.__setattr__(self, "_template", tmpl)
-        self._names = list(tmpl.state_dict().keys())
-        self._mangled = {n: "stk__" + n.replace(".", "__")
-                         for n in self._names}
-        for n in self._names:
-            per = [l.state_dict()[n] for l in layers]
-            stacked = jnp.stack([t._data for t in per])
-            p = Parameter(stacked, name=self._mangled[n])
-            p.stop_gradient = per[0].stop_gradient
-            spec = getattr(per[0], "sharding_spec", None)
-            if spec is not None:
-                p.sharding_spec = P(*((None,) + tuple(spec)))
-            setattr(self, self._mangled[n], p)
-
-    def load_from_layers(self, layer_list):
-        """Import an unrolled encoder's (LayerList of ErnieLayer)
-        weights into the stacks."""
-        assert len(layer_list) == self.L
-        for n in self._names:
-            stacked = jnp.stack(
-                [lyr.state_dict()[n]._data for lyr in layer_list])
-            getattr(self, self._mangled[n])._data = stacked
-
-    def forward(self, x, attn_mask=None):
-        from ..core.generator import next_key
-        from ..jit.api import functionalize
-        from ..ops.registry import run_op
-        tmpl = self._template
-        # mirror train/eval onto the body template (dropout mode)
-        for lyr in tmpl.sublayers(include_self=True):
-            lyr.training = self.training
-        pure = functionalize(tmpl.forward, tmpl)
-        names = self._names
-        key0 = next_key()  # folded per layer inside the scan
-        L = self.L
-
-        def scan_body(x_arr, mask_arr, flat):
-            from ..ops.registry import no_static_capture
-            stacks = dict(zip(names, flat))
-
-            def body(h, xs):
-                layer_state, i = xs
-                out, _ = pure(layer_state, jax.random.fold_in(key0, i),
-                              h, mask_arr)
-                return out, None
-
-            with no_static_capture():
-                out, _ = jax.lax.scan(
-                    body, x_arr, (stacks, jnp.arange(L)))
-            return out
-
-        flat = [getattr(self, self._mangled[n]) for n in names]
-        # the mask rides as a real op input (not a closure), so static
-        # capture sees a plain tensor slot instead of crashing on a
-        # closed-over symbolic Var
-        if attn_mask is None:
-            return run_op("ernie_scanned_encoder",
-                          lambda x_arr, *fl: scan_body(x_arr, None, fl),
-                          (x, *flat), {})
-        return run_op(
-            "ernie_scanned_encoder_masked",
-            lambda x_arr, m, *fl: scan_body(x_arr, m, fl),
-            (x, attn_mask, *flat), {})
+        super().__init__(
+            [ErnieLayer(config)
+             for _ in range(config.num_hidden_layers)],
+            op_name="ernie_scanned_encoder")
 
 
 def _is_moe_layer(config: ErnieConfig, i: int) -> bool:

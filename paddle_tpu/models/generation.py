@@ -48,13 +48,34 @@ def _block_params(blk):
     }
 
 
+# decode-key -> state_dict-name, derived from the one layout table in
+# _block_params so a GPTBlock param rename can't go stale here
+_SCAN_BLOCK_KEYS = {
+    k: k[:-2] + (".weight" if k.endswith("_w") else ".bias")
+    for k in ("ln1_w", "ln1_b", "ln2_w", "ln2_b", "qkv_w", "qkv_b",
+              "proj_w", "proj_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+}
+
+
 def _gpt_params(model):
     gpt = model.gpt
+    from ..nn.layer.scanned import ScannedStack
+    if isinstance(gpt.blocks, ScannedStack):
+        # scan_layers: slice the [L, ...] stacks into per-layer dicts —
+        # the decode loop is already per-layer, so generation works
+        # identically off either parameter layout
+        stk = gpt.blocks
+        get = {k: getattr(stk, stk._mangled[n])._data
+               for k, n in _SCAN_BLOCK_KEYS.items()}
+        blocks = [{k: v[i] for k, v in get.items()}
+                  for i in range(stk.L)]
+    else:
+        blocks = [_block_params(b) for b in gpt.blocks]
     return {
         "wte": gpt.wte.weight._data,
         "wpe": gpt.wpe.weight._data,
         "lnf_w": gpt.ln_f.weight._data, "lnf_b": gpt.ln_f.bias._data,
-        "blocks": [_block_params(b) for b in gpt.blocks],
+        "blocks": blocks,
     }
 
 

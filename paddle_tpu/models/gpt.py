@@ -20,7 +20,8 @@ __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, max_seq_len=1024, dropout=0.1,
-                 layer_norm_eps=1e-5, use_flash_attention=True):
+                 layer_norm_eps=1e-5, use_flash_attention=True,
+                 scan_layers=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -29,6 +30,9 @@ class GPTConfig:
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
         self.use_flash_attention = use_flash_attention
+        # one lax.scan over stacked block params — compile time / HLO
+        # size O(1) in depth (nn.ScannedStack; see models/ernie.py)
+        self.scan_layers = bool(scan_layers)
 
     @classmethod
     def tiny(cls, **kw):
@@ -84,8 +88,13 @@ class GPTModel(nn.Layer):
         self.wte.weight.sharding_spec = P(TENSOR_AXIS, None)
         self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
-        self.blocks = nn.LayerList([GPTBlock(cfg)
-                                    for _ in range(cfg.num_layers)])
+        if cfg.scan_layers:
+            self.blocks = nn.ScannedStack(
+                [GPTBlock(cfg) for _ in range(cfg.num_layers)],
+                op_name="gpt_scanned_blocks")
+        else:
+            self.blocks = nn.LayerList([GPTBlock(cfg)
+                                        for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size,
                                  epsilon=cfg.layer_norm_eps)
 
@@ -94,8 +103,11 @@ class GPTModel(nn.Layer):
         pos = creation.arange(s, dtype="int32")
         pos = manipulation.expand(manipulation.unsqueeze(pos, 0), [b, s])
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for blk in self.blocks:
-            x = blk(x)
+        if isinstance(self.blocks, nn.ScannedStack):
+            x = self.blocks(x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         return self.ln_f(x)
 
 

@@ -1,0 +1,114 @@
+"""ScannedStack: L structurally-identical blocks as ONE lax.scan.
+
+TPU-first depth scaling (no reference equivalent — its Program unrolls
+ops per layer): XLA compiles an unrolled L-block transformer as L
+copies of the same HLO, so compile time and program size grow linearly
+in depth — the practical blocker for 10B-class single-program compiles.
+Stacking each block parameter to [L, *shape] and scanning one block
+body makes both O(1) in depth; per-layer weights stream through the
+same compiled body.
+
+Used by models.ernie.ErnieScannedEncoder and models.gpt (GPTConfig
+scan_layers). Parameters keep the unrolled count/shapes, just stacked;
+sharding specs shift right past the stack axis. The whole scan rides
+run_op so the eager tape differentiates it as one node; static Program
+capture records it as a single (unregistered) op and to_bytes rejects
+it loudly at save — serialize the unrolled form instead.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import Parameter, Tensor
+from .layers import Layer
+
+__all__ = ["ScannedStack"]
+
+
+class ScannedStack(Layer):
+    def __init__(self, layers, op_name: str = "scanned_stack"):
+        """`layers`: constructed, structurally-identical blocks whose
+        forward is `block(x, *extra)` with x the carried tensor and
+        `extra` per-call (non-scanned) side inputs."""
+        super().__init__()
+        assert len(layers) >= 1
+        from jax.sharding import PartitionSpec as P
+        self.L = len(layers)
+        self._op_name = op_name
+        tmpl = layers[0]
+        # the template executes the scan body; deliberately NOT a
+        # registered sublayer (its own values never train — the stacked
+        # tensors are the real parameters)
+        object.__setattr__(self, "_template", tmpl)
+        self._names = list(tmpl.state_dict().keys())
+        self._mangled = {n: "stk__" + n.replace(".", "__")
+                         for n in self._names}
+        for n in self._names:
+            per = [l.state_dict()[n] for l in layers]
+            stacked = jnp.stack([t._data for t in per])
+            p = Parameter(stacked, name=self._mangled[n])
+            p.stop_gradient = per[0].stop_gradient
+            spec = getattr(per[0], "sharding_spec", None)
+            if spec is not None:
+                p.sharding_spec = P(*((None,) + tuple(spec)))
+            setattr(self, self._mangled[n], p)
+
+    def load_from_layers(self, layer_list):
+        """Import an unrolled stack's (iterable of blocks) weights."""
+        layer_list = list(layer_list)
+        assert len(layer_list) == self.L
+        for n in self._names:
+            stacked = jnp.stack(
+                [lyr.state_dict()[n]._data for lyr in layer_list])
+            getattr(self, self._mangled[n])._data = stacked
+
+    def export_to_layers(self, layer_list):
+        """Write the stacks back into an unrolled stack's blocks (the
+        inverse of load_from_layers — checkpoint interop both ways)."""
+        layer_list = list(layer_list)
+        assert len(layer_list) == self.L
+        for n in self._names:
+            stacked = getattr(self, self._mangled[n])._data
+            for i, lyr in enumerate(layer_list):
+                lyr.state_dict()[n]._data = stacked[i]
+
+    def forward(self, x, *extra):
+        from ...core.generator import next_key
+        from ...jit.api import functionalize
+        from ...ops.registry import no_static_capture, run_op
+        tmpl = self._template
+        for lyr in tmpl.sublayers(include_self=True):
+            lyr.training = self.training
+        pure = functionalize(tmpl.forward, tmpl)
+        names = self._names
+        key0 = next_key()  # folded per layer inside the scan
+        L = self.L
+        # side inputs ride as real op inputs (never closures): static
+        # capture then sees plain tensor slots; trailing Nones drop so
+        # the template's own defaults apply
+        extra = list(extra)
+        while extra and extra[-1] is None:
+            extra.pop()
+        n_extra = len(extra)
+
+        def scan_body(x_arr, extra_arrs, flat):
+            stacks = dict(zip(names, flat))
+
+            def body(h, xs):
+                layer_state, i = xs
+                out, _ = pure(layer_state, jax.random.fold_in(key0, i),
+                              h, *extra_arrs)
+                return out, None
+
+            with no_static_capture():
+                out, _ = jax.lax.scan(
+                    body, x_arr, (stacks, jnp.arange(L)))
+            return out
+
+        flat = [getattr(self, self._mangled[n]) for n in names]
+
+        def op_fn(x_arr, *rest):
+            return scan_body(x_arr, rest[:n_extra], rest[n_extra:])
+
+        return run_op(self._op_name, op_fn, (x, *extra, *flat), {})

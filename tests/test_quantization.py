@@ -210,3 +210,31 @@ def test_static_freeze_pass_int8_program():
     more = float(exe.run(main, feed={"x": xv, "y": yv},
                          fetch_list=[loss])[0])
     assert np.isfinite(more)
+
+
+def test_quantized_model_deploys_through_predictor(tmp_path):
+    """QAT → save_quantized_model → inference.Predictor: the int8 model
+    exports as a jax.export artifact and serves through the deployment
+    surface, matching the in-process frozen model (the
+    slim → AnalysisPredictor deployment chain of the reference)."""
+    from paddle_tpu import inference
+    paddle.seed(14)
+    net = LeNet(num_classes=10)
+    iqa = ImperativeQuantAware()
+    iqa.quantize(net)
+    _train(net, steps=5)
+    net.eval()
+    prefix = str(tmp_path / "lenet_int8")
+    frozen = iqa.save_quantized_model(
+        net, prefix,
+        input_spec=[paddle.static.InputSpec([1, 1, 28, 28], "float32")])
+    ref = np.asarray(frozen(paddle.to_tensor(X[:1]))._data)
+
+    cfg = inference.Config(prefix)
+    cfg.disable_gpu()
+    p = inference.create_predictor(cfg)
+    h = p.get_input_handle(p.get_input_names()[0])
+    h.copy_from_cpu(X[:1])
+    p.run()
+    out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
