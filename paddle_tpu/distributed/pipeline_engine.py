@@ -109,6 +109,110 @@ def build_1f1b_schedule(n_stages: int, num_micro: int,
     return emitted
 
 
+def build_interleaved_schedule(n_dev: int, v: int,
+                               num_micro: int) -> List[Tuple[str, int, int]]:
+    """Virtual-pipeline (Megatron-interleaved) order for n_dev physical
+    ranks each hosting v model chunks (stage s runs on rank s % n_dev):
+    the bubble shrinks from (p-1)/(M+p-1) to (p-1)/(vM+p-1) — measured
+    EXACTLY by simulate_schedule for the divisible case (the schedule
+    receipt in tests/test_interleaved_pipeline.py).
+
+    Construction: each rank's op program is the standard interleaved
+    1F1B — chunk index rotates every n_dev microbatches
+    (c(k) = (k // p) mod v), warmup (p-d-1)·2 + (v-1)·p forwards, then
+    strict F/B alternation, then drain — and the per-rank programs are
+    merged into one valid global order by a unit-time tick machine
+    honoring the cross-rank dependencies. Requires M % n_dev == 0
+    (padding microbatches up is the caller's knob; the plain 1f1b
+    builder covers the non-divisible case).
+    """
+    p = int(n_dev)
+    if num_micro % p != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_micro % n_dev == 0 "
+            f"(got M={num_micro}, p={p}); pad the microbatch count or "
+            "use schedule='1f1b'")
+    Mv = num_micro * v
+    S = p * v
+
+    def f_op(d, k):
+        c = (k // p) % v
+        m = (k % p) + p * (k // (p * v))
+        return ("F", c * p + d, m)
+
+    def b_op(d, k):
+        c = v - 1 - ((k // p) % v)
+        m = (k % p) + p * (k // (p * v))
+        return ("B", c * p + d, m)
+
+    progs = []
+    for d in range(p):
+        w = min(Mv, (p - d - 1) * 2 + (v - 1) * p)
+        seq = [f_op(d, k) for k in range(w)]
+        nf, nb = w, 0
+        while nb < Mv:
+            if nf < Mv:
+                seq.append(f_op(d, nf))
+                nf += 1
+            seq.append(b_op(d, nb))
+            nb += 1
+        progs.append(seq)
+    order, _ = _run_ticks(progs, S)
+    return order
+
+
+def _run_ticks(queues: List[List[Tuple[str, int, int]]],
+               n_stages: int) -> Tuple[List[Tuple[str, int, int]], int]:
+    """Unit-time tick machine shared by the interleaved builder and the
+    simulator (ONE copy of the dependency rules): each rank executes
+    its queue in order, one op per tick, waiting for F(s-1,m)→F(s,m)
+    and {F(s,m), B(s+1,m)}→B(s,m). Returns (global order, ticks)."""
+    finish: Dict[Tuple[str, int, int], int] = {}
+    pos = [0] * len(queues)
+    tick = 0
+    order: List[Tuple[str, int, int]] = []
+    total = sum(len(q) for q in queues)
+    while len(order) < total:
+        tick += 1
+        ran = False
+        for d in range(len(queues)):
+            if pos[d] >= len(queues[d]):
+                continue
+            op, s, m = queues[d][pos[d]]
+            deps = []
+            if op == "F" and s > 0:
+                deps.append(("F", s - 1, m))
+            if op == "B":
+                deps.append(("F", s, m))
+                if s < n_stages - 1:
+                    deps.append(("B", s + 1, m))
+            if all(finish.get(dp, tick + 1) < tick for dp in deps):
+                finish[(op, s, m)] = tick
+                pos[d] += 1
+                order.append((op, s, m))
+                ran = True
+        assert ran, "schedule deadlock"
+    return order, tick
+
+
+def simulate_schedule(sched: List[Tuple[str, int, int]], n_dev: int,
+                      dev_of=None) -> Tuple[int, float]:
+    """Unit-time pipeline simulation of a global op order: each rank
+    executes its ops in the given order, one per tick, waiting for
+    cross-rank dependencies (the same _run_ticks machine the
+    interleaved builder uses — one copy of the dependency rules).
+    Returns (ticks, bubble_fraction) — the hardware-independent receipt
+    that a schedule really shrinks the bubble."""
+    dev_of = dev_of or (lambda s: s % n_dev)
+    queues: List[List[Tuple[str, int, int]]] = [[] for _ in range(n_dev)]
+    for op in sched:
+        queues[dev_of(op[1])].append(op)
+    S = 1 + max(s for _, s, _ in sched)
+    _, tick = _run_ticks(queues, S)
+    bubble = 1.0 - len(sched) / float(tick * n_dev)
+    return tick, bubble
+
+
 def stage_submeshes(mesh: Mesh, n_stages: int,
                     pp_axis: str = "pp") -> List[Optional[Mesh]]:
     """Slice the pp axis off a global mesh: stage i gets
@@ -298,12 +402,28 @@ class PipelineParallel:
     def __init__(self, stages: Sequence[Layer], loss_fn: Callable,
                  optimizer, num_micro: int = 1, mesh: Optional[Mesh] = None,
                  pp_axis: str = "pp", schedule: str = "1f1b",
-                 param_spec_fn=None):
+                 param_spec_fn=None, virtual_pipeline_degree: int = 1):
         assert len(stages) >= 1
         self.num_micro = int(num_micro)
         self.schedule_policy = schedule
         self.optimizer = optimizer
-        subs = stage_submeshes(mesh, len(stages), pp_axis)
+        # virtual pipeline (Megatron interleaving): each physical pp
+        # rank hosts `v` model chunks — stage i runs on rank i % pp —
+        # shrinking the 1F1B bubble from (p-1)/(M+p-1) toward
+        # (p-1)/(vM+p-1) at the cost of v× more p2p hops. len(stages)
+        # must be pp·v; schedule="interleaved" emits the chunk-aware
+        # order (build_interleaved_schedule + simulate_schedule receipt).
+        self.virtual_pipeline_degree = v = int(virtual_pipeline_degree)
+        if v > 1:
+            if len(stages) % v != 0:
+                raise ValueError(
+                    f"virtual_pipeline_degree={v} needs len(stages) "
+                    f"divisible by it, got {len(stages)}")
+            pp = len(stages) // v
+            phys = stage_submeshes(mesh, pp, pp_axis)
+            subs = [phys[i % pp] for i in range(len(stages))]
+        else:
+            subs = stage_submeshes(mesh, len(stages), pp_axis)
         self.stages = [
             _Stage(layer, i, len(stages),
                    loss_fn if i == len(stages) - 1 else None, subs[i],
@@ -336,8 +456,18 @@ class PipelineParallel:
             return ~jnp.stack(leaves).all()
         self._inf_jit = jax.jit(found_inf_flag)
         self._any_jit = jax.jit(lambda *fs: jnp.stack(fs).any())
-        self._sched = build_1f1b_schedule(len(stages), self.num_micro,
-                                          schedule)
+        if schedule == "interleaved" or v > 1:
+            if v > 1 and schedule not in ("1f1b", "interleaved"):
+                raise ValueError(
+                    f"virtual_pipeline_degree={v} only runs the "
+                    f"interleaved schedule; schedule={schedule!r} would "
+                    "be silently ignored — drop it or set v=1")
+            self.schedule_policy = "interleaved"
+            self._sched = build_interleaved_schedule(
+                len(stages) // v, v, self.num_micro)
+        else:
+            self._sched = build_1f1b_schedule(len(stages),
+                                              self.num_micro, schedule)
         self._step_count = 0
         self.last_dispatch_count = 0  # jit dispatches in the last batch
 

@@ -1,0 +1,100 @@
+"""Virtual-pipeline (interleaved 1F1B) receipts.
+
+Megatron-style interleaving: each physical pp rank hosts v model
+chunks, shrinking the pipeline bubble from (p-1)/(M+p-1) to
+(p-1)/(vM+p-1). The reference ships only the basic F-then-B section
+worker (section_worker.cc); this is a capability beyond it, with two
+hardware-independent receipts:
+
+1. schedule: a unit-time tick simulation of the emitted global order
+   reproduces the theoretical bubble EXACTLY — both for plain 1F1B and
+   the interleaved form — so the schedule itself is proven, not hoped.
+2. numerics: the interleaved engine's training trajectory matches the
+   plain 1F1B engine's on identical weights/data.
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.pipeline_engine import (
+    build_1f1b_schedule, build_interleaved_schedule, simulate_schedule)
+
+
+@pytest.mark.parametrize("p,v,M", [(4, 2, 8), (4, 2, 16), (4, 4, 8),
+                                   (2, 2, 4), (2, 3, 6)])
+def test_interleaved_bubble_matches_theory(p, v, M):
+    sched = build_interleaved_schedule(p, v, M)
+    assert len(sched) == 2 * p * v * M  # every op exactly once
+    assert len(set(sched)) == len(sched)
+    _, bubble = simulate_schedule(sched, p)
+    theory = (p - 1) / (v * M + p - 1)
+    assert bubble == pytest.approx(theory, abs=1e-9), (bubble, theory)
+
+
+@pytest.mark.parametrize("p,M", [(4, 8), (4, 16)])
+def test_plain_1f1b_bubble_matches_theory_and_is_larger(p, M):
+    s1 = build_1f1b_schedule(p, M, "1f1b")
+    _, b1 = simulate_schedule(s1, p, dev_of=lambda s: s)
+    assert b1 == pytest.approx((p - 1) / (M + p - 1), abs=1e-9)
+    s2 = build_interleaved_schedule(p, 2, M)
+    _, b2 = simulate_schedule(s2, p)
+    assert b2 < b1  # interleaving strictly shrinks the bubble
+
+
+def test_interleaved_needs_divisible_micro():
+    with pytest.raises(ValueError, match="num_micro"):
+        build_interleaved_schedule(4, 2, 6)
+
+
+def test_interleaved_engine_matches_plain_engine():
+    """4 chunks on pp=2 ranks (v=2) vs the same 4 stages on pp=4 —
+    identical weights and data must give identical loss trajectories."""
+    def make_stages():
+        paddle.seed(33)
+        return [nn.Sequential(nn.Linear(16, 16), nn.ReLU())
+                for _ in range(4)]
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+
+    runs = {}
+    for which in ("plain", "interleaved"):
+        stages = make_stages()
+        opt = paddle.optimizer.SGD(learning_rate=0.05)
+        if which == "plain":
+            mesh = dist.build_mesh({"pp": 4},
+                                   devices=jax.devices()[:4])
+            engine = dist.PipelineParallel(stages, loss_fn, opt,
+                                           num_micro=4, mesh=mesh)
+        else:
+            mesh = dist.build_mesh({"pp": 2},
+                                   devices=jax.devices()[:2])
+            engine = dist.PipelineParallel(
+                stages, loss_fn, opt, num_micro=4, mesh=mesh,
+                virtual_pipeline_degree=2)
+        runs[which] = [float(engine.train_batch(x, y).item())
+                       for _ in range(4)]
+    np.testing.assert_allclose(runs["plain"], runs["interleaved"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_engine_stage_placement():
+    """Chunk i must live on physical rank i % pp (Megatron placement)."""
+    stages = [nn.Linear(4, 4) for _ in range(4)]
+    mesh = dist.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    opt = paddle.optimizer.SGD(learning_rate=0.01)
+    engine = dist.PipelineParallel(stages, loss_fn=lambda o, y: (o - y)
+                                   .abs().mean(), optimizer=opt,
+                                   num_micro=2, mesh=mesh,
+                                   virtual_pipeline_degree=2)
+    meshes = [st.submesh for st in engine.stages]
+    assert meshes[0] == meshes[2]
+    assert meshes[1] == meshes[3]
+    assert meshes[0] != meshes[1]
