@@ -53,17 +53,23 @@ def bench_ernie(on_tpu):
     from paddle_tpu.models import ErnieConfig, ErnieForPretraining
     from paddle_tpu.static import TrainStep
 
+    # PD_BENCH_SCAN_LAYERS=1 benches the lax.scan encoder form (same
+    # math, O(1)-in-depth compile) — sweep both on hardware to record
+    # which layout XLA:TPU schedules faster at depth 12
+    scan = bool(int(os.environ.get("PD_BENCH_SCAN_LAYERS", "0")))
     if on_tpu:
         cfg = ErnieConfig(vocab_size=30528, hidden_size=768,
                           num_hidden_layers=12, num_attention_heads=12,
                           intermediate_size=3072,
-                          max_position_embeddings=512)
+                          max_position_embeddings=512,
+                          scan_layers=scan)
         batch, seqlen, steps = 48, 512, 24
     else:
         cfg = ErnieConfig(vocab_size=8192, hidden_size=256,
                           num_hidden_layers=4, num_attention_heads=8,
                           intermediate_size=1024,
-                          max_position_embeddings=128)
+                          max_position_embeddings=128,
+                          scan_layers=scan)
         batch, seqlen, steps = 8, 128, 4
 
     paddle.seed(0)

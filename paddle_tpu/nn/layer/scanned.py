@@ -41,6 +41,18 @@ class ScannedStack(Layer):
         # registered sublayer (its own values never train — the stacked
         # tensors are the real parameters)
         object.__setattr__(self, "_template", tmpl)
+        buffers = [k for k, t in tmpl.state_dict().items()
+                   if t.stop_gradient]
+        if buffers:
+            # the scan body discards functionalize's new_state, so a
+            # buffer-carrying block (BatchNorm running stats) would
+            # train fine but serve stale statistics forever — refuse
+            # loudly instead of silently freezing them
+            raise ValueError(
+                f"ScannedStack blocks must be buffer-free; template "
+                f"carries {buffers} — buffer updates would be dropped "
+                "by the scan (use the unrolled form, or normalize with "
+                "buffer-less layers like LayerNorm)")
         self._names = list(tmpl.state_dict().keys())
         self._mangled = {n: "stk__" + n.replace(".", "__")
                          for n in self._names}

@@ -56,10 +56,15 @@ def abstract_parameters():
             np.dtype(_dtypes.convert_dtype(dtype)))
 
     patched = []
+    seen = set()
     for name in dir(init_mod):
         cls = getattr(init_mod, name)
         if isinstance(cls, type) and issubclass(cls, init_mod.Initializer) \
-                and "__call__" in cls.__dict__:
+                and "__call__" in cls.__dict__ and id(cls) not in seen:
+            # dedupe aliases (BilinearInitializer = Bilinear): visiting
+            # the alias after patching would capture the PATCH as the
+            # "original" and leave it active after restore
+            seen.add(id(cls))
             patched.append((cls, cls.__dict__["__call__"]))
             cls.__call__ = aval_init
 

@@ -142,6 +142,10 @@ def main():
                        PD_FLASH_BK=str(bq))
             run(f"sweep bq={bq}", [py, "bench.py"], timeout=3600,
                 env=env)
+        # encoder layout: unrolled (default) vs lax.scan-over-layers
+        env = dict(os.environ, PD_BENCH_SCAN_LAYERS="1")
+        run("sweep scan_layers=1", [py, "bench.py"], timeout=3600,
+            env=env)
 
     print("summary:", results)
     sys.exit(0 if results.get("bench") == 0 else 2)
