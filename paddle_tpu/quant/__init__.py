@@ -49,6 +49,7 @@ from ..ops.quant_ops import (
 
 __all__ = [
     "QuantConfig", "ImperativeQuantAware", "quant_aware", "convert",
+    "weight_only_quantize",
     "PostTrainingQuantization", "QuantizationTransformPass",
     "QuantizationFreezePass",
     "QuantedLinear", "QuantedConv2D", "FrozenQuantLinear",
@@ -65,8 +66,11 @@ class QuantConfig:
                  activation_quantize_type="moving_average_abs_max"):
         assert weight_quantize_type in ("channel_wise_abs_max",
                                         "abs_max")
+        # "none" = weight-only quantization (the LLM-serving form):
+        # activations stay full precision, no observers needed —
+        # conversion is data-free
         assert activation_quantize_type in ("moving_average_abs_max",
-                                            "abs_max")
+                                            "abs_max", "none")
         self.weight_bits = int(weight_bits)
         self.activation_bits = int(activation_bits)
         self.moving_rate = float(moving_rate)
@@ -88,6 +92,8 @@ class _QuantedBase(nn.Layer):
 
     def _quant_act(self, x):
         cfg = self.cfg
+        if cfg.activation_quantize_type == "none":
+            return x
         if cfg.activation_quantize_type == "abs_max":
             out, scale = fake_quantize_dequantize_abs_max(
                 x, bit_length=cfg.activation_bits)
@@ -178,13 +184,19 @@ class _FrozenBase(nn.Layer):
     activation scale frozen from training/calibration — the
     QuantizationFreezePass product."""
 
-    def _freeze_weight(self, w, channel_axis, bits):
+    def _freeze_weight(self, w, channel_axis, bits, per_channel=True):
         arr = np.asarray(w._data, np.float32)
-        axes = tuple(i for i in range(arr.ndim) if i != channel_axis)
-        scales = np.maximum(np.abs(arr).max(axis=axes), 1e-8)
-        shape = [1] * arr.ndim
-        shape[channel_axis] = -1
-        q = np.clip(np.round(arr / scales.reshape(shape) * _qmax(bits)),
+        if per_channel:
+            axes = tuple(i for i in range(arr.ndim)
+                         if i != channel_axis)
+            scales = np.maximum(np.abs(arr).max(axis=axes), 1e-8)
+            shape = [1] * arr.ndim
+            shape[channel_axis] = -1
+            sb = scales.reshape(shape)
+        else:  # weight_quantize_type="abs_max": one scale per tensor
+            scales = np.maximum(np.abs(arr).max(), 1e-8)
+            sb = scales
+        q = np.clip(np.round(arr / sb * _qmax(bits)),
                     -_qmax(bits) - 1, _qmax(bits)).astype(np.int8)
         self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
         self.register_buffer(
@@ -193,13 +205,17 @@ class _FrozenBase(nn.Layer):
         self._wbits = bits
 
     def _dequant_weight(self):
-        shape = [1] * self.weight_int8.ndim
-        shape[self._channel_axis] = -1
-        s = self.weight_scales._data.reshape(shape)
+        s = self.weight_scales._data
+        if s.ndim == 1:  # per-channel
+            shape = [1] * self.weight_int8.ndim
+            shape[self._channel_axis] = -1
+            s = s.reshape(shape)
         return Tensor(self.weight_int8._data.astype(jnp.float32) * s
                       / _qmax(self._wbits))
 
     def _quant_act_frozen(self, x, bits):
+        if self._act_scale is None:  # weight-only mode
+            return x
         s = max(float(self._act_scale), 1e-8)
         q = _qmax(bits)
         arr = x._data if isinstance(x, Tensor) else x
@@ -208,11 +224,13 @@ class _FrozenBase(nn.Layer):
 
 
 class FrozenQuantLinear(_FrozenBase):
-    def __init__(self, src, act_scale: float, cfg: QuantConfig):
+    def __init__(self, src, act_scale, cfg: QuantConfig):
         super().__init__()
-        self._freeze_weight(src.weight, 1, cfg.weight_bits)
+        self._freeze_weight(
+            src.weight, 1, cfg.weight_bits,
+            cfg.weight_quantize_type == "channel_wise_abs_max")
         self.bias = src.bias
-        self._act_scale = float(act_scale)
+        self._act_scale = None if act_scale is None else float(act_scale)
         self._abits = cfg.activation_bits
 
     def forward(self, x):
@@ -221,11 +239,13 @@ class FrozenQuantLinear(_FrozenBase):
 
 
 class FrozenQuantConv2D(_FrozenBase):
-    def __init__(self, src, act_scale: float, cfg: QuantConfig):
+    def __init__(self, src, act_scale, cfg: QuantConfig):
         super().__init__()
-        self._freeze_weight(src.weight, 0, cfg.weight_bits)
+        self._freeze_weight(
+            src.weight, 0, cfg.weight_bits,
+            cfg.weight_quantize_type == "channel_wise_abs_max")
         self.bias = src.bias
-        self._act_scale = float(act_scale)
+        self._act_scale = None if act_scale is None else float(act_scale)
         self._abits = cfg.activation_bits
         def attr(quanted_name, conv_name):
             # src is a QuantedConv2D (post-QAT) or a raw Conv2D; 0 is a
@@ -291,6 +311,31 @@ def quant_aware(model, config: Optional[QuantConfig] = None, **kw):
     return model
 
 
+def weight_only_quantize(model, weight_bits: int = 8,
+                         weight_quantize_type="channel_wise_abs_max"):
+    """Data-free weight-only int8 (the LLM-serving form): every
+    Linear/Conv2D weight is stored int8 with per-channel scales and
+    dequantized at use; activations stay full precision, so no
+    training or calibration is needed — quantize and deploy. In place;
+    returns the model in eval mode."""
+    cfg = QuantConfig(weight_bits=weight_bits,
+                      weight_quantize_type=weight_quantize_type,
+                      activation_quantize_type="none")
+
+    # single pass straight to the Frozen* form: no throwaway Quanted*
+    # wrappers or observer buffers (Frozen* accept raw Linear/Conv2D)
+    def factory(sub):
+        if isinstance(sub, nn.Conv2D):
+            return FrozenQuantConv2D(sub, None, cfg)
+        return FrozenQuantLinear(sub, None, cfg)
+    n = _swap_sublayers(model, factory, _DEFAULT_TYPES)
+    if n == 0:
+        raise ValueError(
+            "weight_only_quantize() found no Linear/Conv2D sublayers")
+    model.eval()
+    return model
+
+
 def convert(model, config: Optional[QuantConfig] = None):
     """Freeze a QAT model to the int8 inference form (weights stored
     int8 + per-channel scales; activation scales frozen from the EMA
@@ -299,11 +344,14 @@ def convert(model, config: Optional[QuantConfig] = None):
     cfg = config or QuantConfig()
 
     def factory(sub):
-        scale = sub.activation_scale()
-        if scale <= 0:
-            raise ValueError(
-                "convert(): activation observer never ran — train (QAT) "
-                "or calibrate (PTQ) before converting")
+        if sub.cfg.activation_quantize_type == "none":
+            scale = None  # weight-only: no activation quant at all
+        else:
+            scale = sub.activation_scale()
+            if scale <= 0:
+                raise ValueError(
+                    "convert(): activation observer never ran — train "
+                    "(QAT) or calibrate (PTQ) before converting")
         if isinstance(sub, QuantedConv2D):
             return FrozenQuantConv2D(sub, scale, sub.cfg)
         return FrozenQuantLinear(sub, scale, sub.cfg)

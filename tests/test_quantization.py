@@ -238,3 +238,24 @@ def test_quantized_model_deploys_through_predictor(tmp_path):
     p.run()
     out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_weight_only_quantize_data_free():
+    """Weight-only int8 (the LLM-serving form): no training, no
+    calibration — quantize a trained model in one call; activations
+    stay fp32, weights stored int8 per-channel, outputs close."""
+    from paddle_tpu.quant import weight_only_quantize
+    paddle.seed(15)
+    net = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 8))
+    xb = paddle.to_tensor(RNG.randn(4, 32).astype(np.float32))
+    net.eval()
+    ref = np.asarray(net(xb)._data)
+    weight_only_quantize(net)
+    frozen = [s for s in net.sublayers() if hasattr(s, "weight_int8")]
+    assert len(frozen) == 2
+    for s in frozen:
+        assert np.asarray(s.weight_int8._data).dtype == np.int8
+    out = np.asarray(net(xb)._data)
+    denom = np.mean(np.abs(ref)) + 1e-6
+    assert np.mean(np.abs(out - ref)) / denom < 0.05, \
+        np.mean(np.abs(out - ref)) / denom
