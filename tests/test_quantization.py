@@ -259,3 +259,29 @@ def test_weight_only_quantize_data_free():
     denom = np.mean(np.abs(ref)) + 1e-6
     assert np.mean(np.abs(out - ref)) / denom < 0.05, \
         np.mean(np.abs(out - ref)) / denom
+
+
+def test_weight_only_model_exports_through_predictor(tmp_path):
+    """The weight-only surface (_act_scale=None trace branch, Frozen*
+    built from raw layers) must survive jax.export + Predictor — the
+    serving path it exists for."""
+    from paddle_tpu import inference
+    from paddle_tpu.jit.api import save as jit_save
+    from paddle_tpu.quant import weight_only_quantize
+    paddle.seed(16)
+    net = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    net.eval()
+    xb = paddle.to_tensor(RNG.randn(2, 16).astype(np.float32))
+    weight_only_quantize(net)
+    ref = np.asarray(net(xb)._data)
+    prefix = str(tmp_path / "wo_int8")
+    jit_save(net, prefix,
+             input_spec=[paddle.static.InputSpec([2, 16], "float32")])
+    cfg = inference.Config(prefix)
+    cfg.disable_gpu()
+    p = inference.create_predictor(cfg)
+    h = p.get_input_handle(p.get_input_names()[0])
+    h.copy_from_cpu(np.asarray(xb._data))
+    p.run()
+    out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
