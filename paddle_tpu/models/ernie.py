@@ -256,10 +256,11 @@ class ErnieScannedEncoder(nn.ScannedStack):
     compare both forms on identical values); the attention mask rides
     as a real op input."""
 
-    def __init__(self, config: ErnieConfig):
+    def __init__(self, config: ErnieConfig, num_blocks=None):
+        n = config.num_hidden_layers if num_blocks is None \
+            else int(num_blocks)
         super().__init__(
-            [ErnieLayer(config)
-             for _ in range(config.num_hidden_layers)],
+            [ErnieLayer(config) for _ in range(n)],
             op_name="ernie_scanned_encoder")
 
 
@@ -424,10 +425,35 @@ class ErnieForSequenceClassification(nn.Layer):
 # buys nothing at pretraining loss parity, so we keep stages independent
 # and document the decision here).
 
+def _stage_blocks(config, num_blocks, first_index):
+    """A pipeline stage's run of encoder blocks: a ScannedStack when
+    config.scan_layers (compile O(1) in the stage's depth — the same
+    win per stage as for the whole encoder), else an unrolled
+    LayerList (required for interleaved MoE placement)."""
+    if config.scan_layers and num_blocks > 0:
+        # num_blocks == 0 (more stages than layers, or the solo-stage
+        # split) stays an empty LayerList: the identity stage
+        return ErnieScannedEncoder(config, num_blocks)
+    return nn.LayerList(
+        [ErnieLayer(config, use_moe=_is_moe_layer(config,
+                                                  first_index + j))
+         for j in range(num_blocks)])
+
+
+def _run_blocks(blocks, x, attention_mask):
+    if isinstance(blocks, nn.ScannedStack):
+        return blocks(x, attention_mask)
+    for b in blocks:
+        x = b(x, attention_mask)
+    return x
+
+
 def _stage_moe_aux(blocks):
     """Weighted sum of the blocks' MoE aux losses from the last forward
     (None when the stage is dense) — the pipeline engine's
     pipeline_local_loss contract."""
+    if isinstance(blocks, nn.ScannedStack):
+        return None  # scan_layers excludes MoE by construction
     total = None
     for b in blocks:
         if getattr(b, "use_moe", False) and b.moe.aux_loss is not None:
@@ -447,18 +473,14 @@ class ErnieStageFirst(nn.Layer):
                  first_index: int = 0):
         super().__init__()
         self.embeddings = ErnieEmbeddings(config)
-        self.blocks = nn.LayerList(
-            [ErnieLayer(config, use_moe=_is_moe_layer(config,
-                                                      first_index + j))
-             for j in range(num_blocks)])
+        self.blocks = _stage_blocks(config, num_blocks, first_index)
 
     def forward(self, input_ids, attention_mask=None):
         x = self.embeddings(input_ids)
         if attention_mask is not None:
             am = manipulation.unsqueeze(attention_mask, [1, 2])
             attention_mask = (1.0 - am.astype("float32")) * -1e9
-        for b in self.blocks:
-            x = b(x, attention_mask)
+        x = _run_blocks(self.blocks, x, attention_mask)
         if attention_mask is not None:
             return x, attention_mask
         return x
@@ -473,14 +495,10 @@ class ErnieStageMiddle(nn.Layer):
     def __init__(self, config: ErnieConfig, num_blocks: int,
                  first_index: int = 0):
         super().__init__()
-        self.blocks = nn.LayerList(
-            [ErnieLayer(config, use_moe=_is_moe_layer(config,
-                                                      first_index + j))
-             for j in range(num_blocks)])
+        self.blocks = _stage_blocks(config, num_blocks, first_index)
 
     def forward(self, x, attention_mask=None):
-        for b in self.blocks:
-            x = b(x, attention_mask)
+        x = _run_blocks(self.blocks, x, attention_mask)
         if attention_mask is not None:
             return x, attention_mask
         return x
@@ -495,10 +513,7 @@ class ErnieStageLast(nn.Layer):
     def __init__(self, config: ErnieConfig, num_blocks: int,
                  first_index: int = 0):
         super().__init__()
-        self.blocks = nn.LayerList(
-            [ErnieLayer(config, use_moe=_is_moe_layer(config,
-                                                      first_index + j))
-             for j in range(num_blocks)])
+        self.blocks = _stage_blocks(config, num_blocks, first_index)
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
         self.mlm_transform = nn.Linear(config.hidden_size,
                                        config.hidden_size)
@@ -509,8 +524,7 @@ class ErnieStageLast(nn.Layer):
         self.nsp = nn.Linear(config.hidden_size, 2)
 
     def forward(self, x, attention_mask=None):
-        for b in self.blocks:
-            x = b(x, attention_mask)
+        x = _run_blocks(self.blocks, x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         h = self.mlm_norm(F.gelu(self.mlm_transform(x)))
         # 2D decoder matmul for the same layout reason as
