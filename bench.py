@@ -197,6 +197,36 @@ def bench_dynamic_shapes(on_tpu):
     return n_imgs / dt, int(compiles), len(buckets)
 
 
+def bench_generate(on_tpu):
+    """Serving-side decode throughput: GPT KV-cache greedy generation
+    (compiled as one XLA program) — new tokens/sec after warmup."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, dropout=0.0)
+        batch, prompt_len, new_tokens = 8, 128, 128
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                        num_heads=4, max_seq_len=256, dropout=0.0)
+        batch, prompt_len, new_tokens = 2, 16, 32
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompt = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size,
+                    (batch, prompt_len)).astype(np.int32))
+    out = model.generate(prompt, max_new_tokens=new_tokens)  # compile
+    np.asarray(out._data).ravel()[:1]
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=new_tokens)
+    np.asarray(out._data).ravel()[:1]
+    dt = time.perf_counter() - t0
+    return batch * new_tokens / dt
+
+
 def bench_eager_dispatch():
     """op_tester.cc analogue: per-op eager overhead (dispatch + tape)."""
     import paddle_tpu as paddle
@@ -263,6 +293,11 @@ def main():
     except Exception as e:  # pragma: no cover
         add_us = mm_us = -1.0
         errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
+    try:
+        decode_tps = bench_generate(on_tpu)
+    except Exception as e:  # pragma: no cover
+        decode_tps = -1.0
+        errors["generate"] = f"{type(e).__name__}: {e}"
     # pipeline receipt runs in its own process (needs a multi-device
     # virtual CPU mesh, which this process may not be able to provide
     # once a TPU backend is initialized)
@@ -316,6 +351,7 @@ def main():
             "recompile_storm": compiles > n_buckets,
             "eager_add_overhead_us": round(add_us, 1),
             "eager_matmul_overhead_us": round(mm_us, 1),
+            "decode_new_tokens_per_sec": round(decode_tps, 1),
             "attention_path": attn_path,
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),
