@@ -132,11 +132,18 @@ def _masked_probs(q, k, lse_row, i, j, *, scale, causal, bq, bk, sk):
 
 # ---------------------------------------------------------------- forward
 
-def _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p):
+def _drop_mask(seed_ref, bh, i, j, nq, nk, bq, bk, dropout_p):
     """Deterministic per-(batch·head, q-block, k-block) keep mask: the
     backward kernels REGENERATE the forward's mask from the same seed
-    tuple instead of storing an O(s²) mask (the flash-dropout trick)."""
-    pltpu.prng_seed(seed_ref[0], bh, i, j)
+    tuple instead of storing an O(s²) mask (the flash-dropout trick).
+
+    Mosaic on real TPU rejects prng_seed with >2 values ("Setting seed
+    with more than 2 values is not supported", v5e libtpu 0.0.34), so
+    the (bh, i, j) block coordinate folds into ONE collision-free
+    linear index (nq/nk are static grid bounds) and we seed with
+    exactly (user_seed, block_index)."""
+    block_index = (bh * nq + i) * nk + j
+    pltpu.prng_seed(seed_ref[0], block_index)
     bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.uint32)
     threshold = jnp.uint32(min(int(dropout_p * 4294967296.0),
                                4294967295))
@@ -145,7 +152,7 @@ def _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
-                *, scale, causal, bq, bk, nk, sk, dropout_p):
+                *, scale, causal, bq, bk, nq, nk, sk, dropout_p):
     bh = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -185,7 +192,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # entries of the NORMALIZED probs), so l uses the unmasked p
         l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
         if dropout_p > 0.0:
-            keep = _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p)
+            keep = _drop_mask(seed_ref, bh, i, j, nq, nk, bq, bk, dropout_p)
             p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -221,7 +228,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, interpret, dropout_p=0.0,
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        bq=bq, bk=bk, nk=nk, sk=sk, dropout_p=float(dropout_p))
+        bq=bq, bk=bk, nq=nq, nk=nk, sk=sk, dropout_p=float(dropout_p))
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
@@ -252,7 +259,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, interpret, dropout_p=0.0,
 # --------------------------------------------------------------- backward
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, acc_ref, *, scale, causal, bq, bk, nk, sk,
+               dq_ref, acc_ref, *, scale, causal, bq, bk, nq, nk, sk,
                dropout_p):
     bh = pl.program_id(0)
     i = pl.program_id(1)
@@ -275,7 +282,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            keep = _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p)
+            keep = _drop_mask(seed_ref, bh, i, j, nq, nk, bq, bk, dropout_p)
             dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta_ref[0, 0][:, None])
         acc_ref[:] += jax.lax.dot_general(
@@ -289,7 +296,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, bq, bk, nq, sk, dropout_p):
+                *, scale, causal, bq, bk, nq, nk, sk, dropout_p):
     bh = pl.program_id(0)
     j = pl.program_id(1)  # k block
     i = pl.program_id(2)  # q block (innermost)
@@ -311,7 +318,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           causal=causal, bq=bq, bk=bk, sk=sk)
         if dropout_p > 0.0:
             # same seed tuple (bh, q-block i, k-block j) as the forward
-            keep = _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p)
+            keep = _drop_mask(seed_ref, bh, i, j, nq, nk, bq, bk, dropout_p)
             pd = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         else:
             pd = p
@@ -361,7 +368,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, sk=sk,
+                          bq=bq, bk=bk, nq=nq, nk=nk, sk=sk,
                           dropout_p=float(dropout_p)),
         grid=(bh, nq, nk),
         in_specs=[
@@ -381,7 +388,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, interpret,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, sk=sk,
+                          bq=bq, bk=bk, nq=nq, nk=nk, sk=sk,
                           dropout_p=float(dropout_p)),
         grid=(bh, nk, nq),
         in_specs=[
