@@ -74,7 +74,16 @@ def kernel_dropout_available() -> bool:
     link dropped), so the dropout kernel must only be trusted where a
     tiny probe shows real RNG behavior: deterministic per seed,
     seed-sensitive, and not degenerate. Cached per process; callers
-    fall back to SDPA-with-dropout when this fails."""
+    fall back to SDPA-with-dropout when this fails.
+
+    PD_KERNEL_DROPOUT=0/1 overrides the probe entirely: a degraded
+    tunnel can stall any device work, and this probe runs in-process
+    (a subprocess cannot share the exclusively-held TPU), so a
+    supervisor that already probed in a throwaway process can pin the
+    decision and keep the main run hang-safe."""
+    forced = (os.environ.get("PD_KERNEL_DROPOUT") or "").strip().lower()
+    if forced:
+        return forced not in ("0", "false", "no")
     if not pallas_available():
         return False
     try:

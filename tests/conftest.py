@@ -14,6 +14,11 @@ import sys
 # (tools/tpu_first_light.py sets it).
 _USE_TPU = os.environ.get("PD_TEST_TPU") == "1"
 
+# the suite asserts the kernel-dropout self-check's own behavior; a
+# PD_KERNEL_DROPOUT pin inherited from a bench/first-light shell would
+# invert those assertions
+os.environ.pop("PD_KERNEL_DROPOUT", None)
+
 if not _USE_TPU:
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")

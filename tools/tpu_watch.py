@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Round-long TPU window supervisor.
+
+Runs the opportunistic-capture pattern end to end: probe the tunnel
+every --interval seconds (appending to TPU_PROBES_r04.jsonl via
+tools/tpu_probe_loop.py); the moment a probe answers, run
+tools/tpu_first_light.py --sweep which benches, tests, profiles and
+writes TPU_CAPTURE_r04.json / TPU_WINDOWS_r04.jsonl. By default the
+supervisor exits after the first completed first-light attempt so the
+caller can commit the captured numbers; --forever loops for
+--max-hours.
+"""
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=1200.0)
+    ap.add_argument("--max-hours", type=float, default=10.5)
+    ap.add_argument("--forever", action="store_true")
+    args = ap.parse_args()
+    py = sys.executable
+    deadline = time.time() + args.max_hours * 3600
+
+    while time.time() < deadline:
+        hours_left = (deadline - time.time()) / 3600
+        rc = subprocess.call(
+            [py, os.path.join(REPO, "tools", "tpu_probe_loop.py"),
+             "--interval", str(args.interval),
+             "--max-hours", str(max(0.01, hours_left))], cwd=REPO)
+        if rc != 0:  # probe loop gave up: round is over
+            print(f"watch: probe loop exited rc={rc}; done", flush=True)
+            return 3
+        print("watch: tunnel ALIVE -> first light", flush=True)
+        rc = subprocess.call(
+            [py, os.path.join(REPO, "tools", "tpu_first_light.py"),
+             "--sweep"], cwd=REPO)
+        print(f"watch: first light rc={rc}", flush=True)
+        if not args.forever:
+            return rc
+        time.sleep(args.interval)
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
