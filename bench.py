@@ -57,6 +57,13 @@ def bench_ernie(on_tpu):
     # math, O(1)-in-depth compile) — sweep both on hardware to record
     # which layout XLA:TPU schedules faster at depth 12
     scan = bool(int(os.environ.get("PD_BENCH_SCAN_LAYERS", "0")))
+    # hardware-sweep knobs (TPU config only; the CPU smoke stays tiny):
+    # per-chip batch and AMP level are the two cheapest MFU levers —
+    # larger batch raises arithmetic intensity, O2 keeps bf16 weights
+    # (half the weight/grad HBM traffic vs O1's f32 master-everything)
+    amp_level = os.environ.get("PD_BENCH_AMP", "O1").upper()
+    if amp_level not in ("O1", "O2"):
+        raise ValueError(f"PD_BENCH_AMP={amp_level!r}: must be O1 or O2")
     if on_tpu:
         cfg = ErnieConfig(vocab_size=30528, hidden_size=768,
                           num_hidden_layers=12, num_attention_heads=12,
@@ -64,6 +71,7 @@ def bench_ernie(on_tpu):
                           max_position_embeddings=512,
                           scan_layers=scan)
         batch, seqlen, steps = 48, 512, 24
+        batch = int(os.environ.get("PD_BENCH_ERNIE_BATCH", batch))
     else:
         cfg = ErnieConfig(vocab_size=8192, hidden_size=256,
                           num_hidden_layers=4, num_attention_heads=8,
@@ -79,7 +87,7 @@ def bench_ernie(on_tpu):
                                  weight_decay=0.01)
     step = TrainStep(
         model, lambda out, labels: ErnieForPretraining.pretraining_loss(
-            out, labels), opt, amp_level="O1", amp_dtype="bfloat16")
+            out, labels), opt, amp_level=amp_level, amp_dtype="bfloat16")
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32)
@@ -117,8 +125,10 @@ def bench_resnet(on_tpu):
     from paddle_tpu.static import TrainStep
 
     paddle.seed(0)
+    amp_level = os.environ.get("PD_BENCH_AMP", "O1").upper()
     if on_tpu:
         model, batch, size, steps = resnet50(num_classes=1000), 64, 224, 12
+        batch = int(os.environ.get("PD_BENCH_RESNET_BATCH", batch))
     else:
         model, batch, size, steps = resnet18(num_classes=10), 4, 32, 2
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
@@ -126,7 +136,7 @@ def bench_resnet(on_tpu):
                                     weight_decay=1e-4)
     step = TrainStep(model,
                      lambda out, y: F.cross_entropy(out, y), opt,
-                     amp_level="O1", amp_dtype="bfloat16")
+                     amp_level=amp_level, amp_dtype="bfloat16")
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(
         rng.randn(batch, 3, size, size).astype(np.float32))

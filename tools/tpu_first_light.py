@@ -226,13 +226,41 @@ def main():
                    if "ms/step" in ln][:6]
             capture["profile_top"] = top
 
+    if gate("breakdown"):
+        rc, out = run("breakdown",
+                      [py, "tools/tpu_breakdown.py"],
+                      timeout=1800, env=bench_env)
+        results["breakdown"] = rc
+        for line in (out or "").splitlines():
+            if line.startswith("breakdown:"):
+                try:
+                    capture["breakdown"] = json.loads(
+                        line.split("breakdown:", 1)[1])
+                except Exception:
+                    pass
+
     if args.sweep:
         sweeps = {}
+        # ordered by expected information value per ~400 s of window:
+        # batch and AMP level are the big MFU levers; flash block size
+        # only matters once the kernel path is live; scan_layers is a
+        # layout A/B
         for tag, envd in (
+                ("batch96", {"PD_BENCH_ERNIE_BATCH": "96",
+                             "PD_BENCH_RESNET_BATCH": "256"}),
+                ("ampO2", {"PD_BENCH_AMP": "O2"}),
+                ("batch96+ampO2", {"PD_BENCH_ERNIE_BATCH": "96",
+                                   "PD_BENCH_RESNET_BATCH": "256",
+                                   "PD_BENCH_AMP": "O2"}),
                 ("bq256", {"PD_FLASH_BQ": "256", "PD_FLASH_BK": "256"}),
-                ("bq1024", {"PD_FLASH_BQ": "1024", "PD_FLASH_BK": "1024"}),
                 ("scan_layers", {"PD_BENCH_SCAN_LAYERS": "1"}),
         ):
+            if tag == "bq256" and not kd_ok:
+                # with the kernel path pinned off, flash block sizes
+                # are dead knobs — the sweep would re-measure baseline
+                print("-- skip bq256: kernel dropout pinned off",
+                      flush=True)
+                continue
             if not gate(f"sweep:{tag}"):
                 break
             env = dict(bench_env, **envd)
@@ -240,10 +268,15 @@ def main():
                           timeout=2400, env=env)
             b = parse_bench_json(out)
             if b:
-                sweeps[tag] = {"tokens_per_sec": b.get("value"),
-                               "mfu": b.get("extras", {}).get("mfu"),
-                               "platform": b.get("extras", {}).get(
-                                   "platform")}
+                bx = b.get("extras", {})
+                sweeps[tag] = {
+                    "tokens_per_sec": b.get("value"),
+                    "mfu": bx.get("mfu"),
+                    "platform": bx.get("platform"),
+                    "resnet50_images_per_sec": bx.get(
+                        "resnet50_images_per_sec"),
+                    "attention_path": bx.get("attention_path"),
+                }
         capture["sweeps"] = sweeps
 
     finish(capture, results)
