@@ -228,13 +228,21 @@ def bench_generate(on_tpu):
     prompt = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size,
                     (batch, prompt_len)).astype(np.int32))
-    out = model.generate(prompt, max_new_tokens=new_tokens)  # compile
+    # serving dtype: bf16 by default (decode is HBM-bound on weight
+    # reads; sampling/layernorm stay f32 inside generate) —
+    # PD_BENCH_DECODE_DTYPE=float32 measures the exact-greedy path
+    dt_env = os.environ.get(
+        "PD_BENCH_DECODE_DTYPE",
+        "bfloat16" if on_tpu else "float32").strip().lower()
+    dtype = None if dt_env in ("", "none", "float32", "f32") else dt_env
+    out = model.generate(prompt, max_new_tokens=new_tokens,
+                         dtype=dtype)  # compile
     np.asarray(out._data).ravel()[:1]
     t0 = time.perf_counter()
-    out = model.generate(prompt, max_new_tokens=new_tokens)
+    out = model.generate(prompt, max_new_tokens=new_tokens, dtype=dtype)
     np.asarray(out._data).ravel()[:1]
     dt = time.perf_counter() - t0
-    return batch * new_tokens / dt
+    return batch * new_tokens / dt, (dtype or "float32")
 
 
 def bench_eager_dispatch():
@@ -304,9 +312,9 @@ def main():
         add_us = mm_us = -1.0
         errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
     try:
-        decode_tps = bench_generate(on_tpu)
+        decode_tps, decode_dtype = bench_generate(on_tpu)
     except Exception as e:  # pragma: no cover
-        decode_tps = -1.0
+        decode_tps, decode_dtype = -1.0, "?"
         errors["generate"] = f"{type(e).__name__}: {e}"
     # pipeline receipt runs in its own process (needs a multi-device
     # virtual CPU mesh, which this process may not be able to provide
@@ -362,6 +370,7 @@ def main():
             "eager_add_overhead_us": round(add_us, 1),
             "eager_matmul_overhead_us": round(mm_us, 1),
             "decode_new_tokens_per_sec": round(decode_tps, 1),
+            "decode_dtype": decode_dtype,
             "attention_path": attn_path,
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),

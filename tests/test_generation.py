@@ -81,7 +81,7 @@ class TestKVCacheDecode:
         model.generate(paddle.to_tensor(ids), max_new_tokens=4)
         run = _build_run(float(model.gpt.config.layer_norm_eps),
                          model.gpt.config.num_heads, 0.0, None, None,
-                         0, 4, 6, 10)
+                         0, 4, 6, 10, None)
         before = run._cache_size()
         model.generate(paddle.to_tensor(ids), max_new_tokens=4)
         model.generate(paddle.to_tensor(ids + 1), max_new_tokens=4)
@@ -112,7 +112,8 @@ class TestBeamSearch:
                                       max_new_tokens=6)._data)
         cfg = model.gpt.config
         run = _build_beam_run(float(cfg.layer_norm_eps),
-                              int(cfg.num_heads), 1, None, 0, 6, 5, 11)
+                              int(cfg.num_heads), 1, None, 0, 6, 5, 11,
+                              None)
         b, _ = run(_gpt_params(model), ids, jax.random.key(0))
         np.testing.assert_array_equal(g, np.asarray(b))
 
@@ -144,3 +145,44 @@ class TestBeamSearch:
         gen = out[4:]
         assert gen[0] == first
         assert (gen[1:] == 96).all()
+
+
+class TestServingDtype:
+    """dtype="bfloat16" serving decode (generation.py generate_gpt):
+    bf16 weights + KV cache, f32 layernorm moments and sampling."""
+
+    def test_bf16_deterministic_and_sane(self, model):
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 97, (2, 7)).astype(np.int32)
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=9,
+                           temperature=0.0, dtype="bfloat16")
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=9,
+                           temperature=0.0, dtype="bfloat16")
+        a, b = np.asarray(a._data), np.asarray(b._data)
+        np.testing.assert_array_equal(a, b)  # deterministic
+        assert a.shape == (2, 16) and a.dtype == np.int32
+        np.testing.assert_array_equal(a[:, :7], ids)  # prompt kept
+        assert ((a >= 0) & (a < 97)).all()
+
+    def test_bf16_mostly_agrees_with_f32_greedy(self, model):
+        # bf16 rounding may flip near-tie argmaxes; demand strong but
+        # not exact agreement so the test is hardware-independent
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 97, (4, 7)).astype(np.int32)
+        f32 = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                             temperature=0.0)
+        b16 = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                             temperature=0.0, dtype="bfloat16")
+        f32 = np.asarray(f32._data)[:, 7:]
+        b16 = np.asarray(b16._data)[:, 7:]
+        agree = (f32 == b16).mean()
+        assert agree >= 0.75, f"bf16 decode agreement {agree}"
+
+    def test_bf16_beam_runs(self, model):
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, 97, (2, 5)).astype(np.int32)
+        out = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                             num_beams=3, dtype="bfloat16")
+        out = np.asarray(out._data)
+        assert out.shape == (2, 11)
+        np.testing.assert_array_equal(out[:, :5], ids)
