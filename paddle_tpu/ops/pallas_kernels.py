@@ -97,10 +97,19 @@ def kernel_dropout_available() -> bool:
                                             seed=3))
         b = np.asarray(flash_attention_mha(q, q, q, dropout_p=0.5,
                                            seed=4))
+        # the backward kernels REGENERATE the mask (same prng_seed
+        # path but their own Mosaic lowering) — a training step hits
+        # them immediately, so the probe must too, or a bwd-only
+        # rejection would crash the step instead of falling back
+        g = np.asarray(jax.grad(
+            lambda q: flash_attention_mha(q, q, q, dropout_p=0.5,
+                                          seed=3).sum())(q))
         return (np.allclose(a, a2)
                 and np.abs(a - b).max() > 1e-6
                 and np.abs(a).max() > 1e-6
-                and np.abs(a - base).max() > 1e-6)
+                and np.abs(a - base).max() > 1e-6
+                and np.isfinite(g).all()
+                and np.abs(g).max() > 1e-6)
     except Exception:  # pragma: no cover — kernel/backend quirk
         return False
 
