@@ -338,12 +338,15 @@ def main():
     # record which attention path the ERNIE step actually used (the
     # dropout kernel self-check can fall back to SDPA-with-dropout)
     try:
-        from paddle_tpu.ops.pallas_kernels import kernel_dropout_available
-        # the ERNIE step trains with attention dropout, so its attention
-        # either runs the Pallas kernel WITH in-kernel dropout or the
-        # SDPA-with-dropout fallback — there is no no-dropout tier here
-        attn_path = ("pallas+kernel_dropout" if kernel_dropout_available()
-                     else "sdpa_dropout_fallback")
+        from paddle_tpu.nn.functional.attention import (
+            attention_dropout_impl)
+        # the ERNIE step trains with attention dropout; three tiers
+        # (nn/functional/attention.py attention_dropout_impl)
+        attn_path = {
+            "kernel": "pallas+kernel_dropout",
+            "blockwise": "flash_blockwise_dropout",
+            "sdpa": "sdpa_dropout_fallback",
+        }[attention_dropout_impl()]
     except Exception as e:  # pragma: no cover
         attn_path = f"unknown: {type(e).__name__}"
 

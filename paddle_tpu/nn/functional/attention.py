@@ -99,7 +99,7 @@ def _flash_carry_init(b, n, sq, hd):
 
 
 def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
-                        is_causal):
+                        is_causal, dropout=None):
     """Consume one KV shard [b, n, s_kv, h] in block_k chunks, updating
     the online-softmax carry (acc, m, l).
 
@@ -109,6 +109,16 @@ def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
     memory is one [.., sq, block_k] block). `pos_k0` is the shard's
     global key offset, `sk` its true (unpadded) length; `pos_q` carries
     the queries' global positions for causal masking across shards.
+
+    dropout=(key, p) applies flash-style attention-probs dropout: the
+    denominator l sums the UNDROPPED probs (dropout zeroes entries of
+    the normalized matrix — same contract as the Pallas kernel,
+    ops/pallas_kernels.py _fwd_kernel) while acc accumulates
+    p·keep/(1-p)·V with a per-block mask from fold_in(key, block).
+    The scan body is rematerialized (jax.checkpoint) so the backward
+    REGENERATES each block's mask instead of saving O(s²) residuals —
+    the pure-JAX form of the flash-dropout trick, used as the TPU
+    fallback tier when the Mosaic kernel RNG is unavailable.
     """
     b, n, skl, hd = k.shape
     nblocks = (skl + block_k - 1) // block_k
@@ -138,10 +148,17 @@ def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
         p = jnp.where(jnp.isfinite(logits), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1)
+        if dropout is not None:
+            dkey, dp = dropout
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dkey, jidx), 1.0 - dp, p.shape)
+            p = jnp.where(keep, p / (1.0 - dp), 0.0)
         acc_new = acc * corr[..., None] + jnp.einsum(
             "bnqk,bnkh->bnqh", p, vj.astype(jnp.float32))
         return (acc_new, m_new, l_new), None
 
+    if dropout is not None:
+        body = jax.checkpoint(body)
     carry, _ = jax.lax.scan(
         body, carry,
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0),
@@ -154,18 +171,49 @@ def _flash_finish(carry, dtype):
     return (acc / jnp.maximum(l[..., None], 1e-30)).astype(dtype)
 
 
-def _flash_fwd(q, k, v, is_causal, scale, block_k):
+def _flash_fwd(q, k, v, is_causal, scale, block_k, dropout=None):
     """Blockwise attention with online softmax, scanning KV chunks.
 
-    q,k,v: [b, n, s, h] (head-major internally).
+    q,k,v: [b, n, s, h] (head-major internally). dropout=(key, p)
+    enables the rematerialized flash-dropout path (see
+    _flash_carry_update).
     """
     b, n, sq, hd = q.shape
     sk = k.shape[2]
     q32 = q.astype(jnp.float32) * scale
     carry = _flash_carry_init(b, n, sq, hd)
     carry = _flash_carry_update(q32, k, v, carry, block_k,
-                                jnp.arange(sq), 0, sk, is_causal)
+                                jnp.arange(sq), 0, sk, is_causal,
+                                dropout=dropout)
     return _flash_finish(carry, q.dtype)
+
+
+def _flash_headmajor(query, key, value, causal, block_size,
+                     dropout=None):
+    """Shared paddle-layout wrapper over _flash_fwd: [b,s,n,h] in/out,
+    head-major inside, 1/sqrt(h) scaling, block clamped to sk. Both
+    the no-dropout fallback and the blockwise dropout tier route here
+    so layout/scaling fixes cannot diverge."""
+    q = jnp.einsum("bsnh->bnsh", query)
+    k = jnp.einsum("bsnh->bnsh", key)
+    v = jnp.einsum("bsnh->bnsh", value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    blk = min(block_size, k.shape[2])
+    out = _flash_fwd(q, k, v, causal, scale, blk, dropout=dropout)
+    return jnp.einsum("bnsh->bsnh", out)
+
+
+def _flash_dropout_blockwise(query, key, value, drop_key, causal,
+                             dropout_p, block_k=512):
+    """Pure-JAX blockwise flash attention WITH dropout — the middle
+    dispatch tier: exact flash-dropout semantics at O(seq·block)
+    forward memory (backward ≤ O(seq²·hd/block) carry residuals, still
+    ~8× under materialized probs at hd=64/block=512) without any
+    Mosaic-lowered RNG. Selected when the Pallas kernel RNG probe
+    fails on real hardware (kernel_dropout_available() False but a TPU
+    is present), or forced via PD_ATTN_DROPOUT_IMPL=blockwise."""
+    return _flash_headmajor(query, key, value, causal, block_k,
+                            dropout=(drop_key, float(dropout_p)))
 
 
 @register_op("flash_attention_op")
@@ -175,31 +223,57 @@ def _flash_attention_op(query, key, value, causal=False, block_size=512):
     from ...ops import pallas_kernels as _pk
     if _pk.pallas_available():
         return _pk.flash_attention_mha(query, key, value, causal=causal)
-    q = jnp.einsum("bsnh->bnsh", query)
-    k = jnp.einsum("bsnh->bnsh", key)
-    v = jnp.einsum("bsnh->bnsh", value)
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    blk = min(block_size, k.shape[2])
-    out = _flash_fwd(q, k, v, causal, scale, blk)
-    return jnp.einsum("bnsh->bsnh", out)
+    return _flash_headmajor(query, key, value, causal, block_size)
+
+
+def attention_dropout_impl() -> str:
+    """Which implementation training-mode attention dropout dispatches
+    to on this backend: "kernel" (Pallas in-kernel RNG), "blockwise"
+    (pure-JAX flash-dropout, the TPU tier when the Mosaic RNG probe
+    fails), or "sdpa" (materialized probs — CPU/test tier).
+    PD_ATTN_DROPOUT_IMPL forces a tier (bench sweeps / debugging)."""
+    import os
+    from ...ops import pallas_kernels as _pk
+    forced = os.environ.get("PD_ATTN_DROPOUT_IMPL", "").strip().lower()
+    if forced:
+        if forced not in ("kernel", "blockwise", "sdpa"):
+            # reject typos loudly — a silent auto-detect fallback would
+            # turn a tier sweep data point into a duplicate measurement
+            # (same convention as pallas_kernels._block_env)
+            raise ValueError(
+                f"PD_ATTN_DROPOUT_IMPL={forced!r}: must be kernel, "
+                "blockwise, or sdpa")
+        return forced
+    if _pk.kernel_dropout_available():
+        return "kernel"
+    if _pk.pallas_available():
+        return "blockwise"  # TPU with broken kernel RNG: stay flash
+    return "sdpa"
 
 
 @register_op("flash_attention_dropout", tags=("rng",))
 def _flash_attention_dropout_op(query, key, value, drop_key,
-                                causal=False, dropout_p=0.0):
-    """Training-mode flash attention with in-kernel attention-probs
-    dropout (ops/pallas_kernels.py — the backward regenerates each
-    block's keep mask from a seed derived from drop_key; O(seq·block)
-    memory stands). drop_key is a real PRNG key so static replay can
-    refresh it per run like every other rng op. The non-TPU path falls
-    back to SDPA-with-dropout: exact reference semantics, O(seq²)
-    memory (test sizes only)."""
+                                causal=False, dropout_p=0.0,
+                                block_size=512):
+    """Training-mode flash attention with attention-probs dropout.
+    Three tiers (attention_dropout_impl): Pallas in-kernel RNG
+    (ops/pallas_kernels.py — backward regenerates each block's mask
+    from the seed; O(seq·block) memory), pure-JAX blockwise
+    flash-dropout (same math, rematerialized masks, no Mosaic RNG),
+    or SDPA-with-dropout (exact reference semantics, O(seq²) memory —
+    CPU/test sizes only). drop_key is a real PRNG key so static
+    replay can refresh it per run like every other rng op."""
     from ...ops import pallas_kernels as _pk
-    if _pk.kernel_dropout_available():
+    impl = attention_dropout_impl()
+    if impl == "kernel":
         seed = jax.random.randint(drop_key, (1,), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)
         return _pk.flash_attention_mha(query, key, value, causal=causal,
                                        dropout_p=dropout_p, seed=seed)
+    if impl == "blockwise":
+        return _flash_dropout_blockwise(query, key, value, drop_key,
+                                        causal, dropout_p,
+                                        block_k=block_size)
     return _sdpa_impl(query, key, value, None, dropout_p, causal, None,
                       drop_key=drop_key)
 
@@ -220,7 +294,8 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         from ...core.generator import next_key
         return _flash_attention_dropout_op(query, key, value, next_key(),
                                            causal=causal,
-                                           dropout_p=float(dropout))
+                                           dropout_p=float(dropout),
+                                           block_size=block_size)
     if not return_softmax:
         return _flash_attention_op(query, key, value, causal=causal,
                                    block_size=block_size)

@@ -204,3 +204,120 @@ class TestModelAttentionDropout:
         d, _ = m(ids)
         np.testing.assert_allclose(np.asarray(c._data),
                                    np.asarray(d._data))
+
+
+class TestBlockwiseDropoutTier:
+    """The middle dispatch tier (attention.py _flash_dropout_blockwise):
+    pure-JAX flash-dropout — flash semantics (denominator over ALL
+    links, dropout on the normalized probs, per-block regenerated
+    masks) with no Mosaic RNG. Selected on TPU when the kernel RNG
+    probe fails; forceable via PD_ATTN_DROPOUT_IMPL=blockwise."""
+
+    def _qkv(self, b=2, s=64, n=2, h=16, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: rng.randn(b, s, n, h).astype(np.float32) * 0.5
+        return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+    def test_p0_equals_no_dropout_flash(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_dropout_blockwise, _flash_attention_op)
+        q, k, v = self._qkv()
+        base = _flash_attention_op.__pure_fn__(q, k, v, causal=False)
+        drop0 = _flash_dropout_blockwise(q, k, v, jax.random.key(3),
+                                         False, 0.0, block_k=16)
+        np.testing.assert_allclose(np.asarray(drop0), np.asarray(base),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_deterministic_per_key_and_key_sensitive(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_dropout_blockwise)
+        q, k, v = self._qkv()
+        a = _flash_dropout_blockwise(q, k, v, jax.random.key(7), False,
+                                     0.4, block_k=16)
+        a2 = _flash_dropout_blockwise(q, k, v, jax.random.key(7), False,
+                                      0.4, block_k=16)
+        c = _flash_dropout_blockwise(q, k, v, jax.random.key(8), False,
+                                     0.4, block_k=16)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
+        assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-6
+
+    def test_mean_preserved(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_dropout_blockwise, _flash_attention_op)
+        q, k, v = self._qkv(b=1, s=32, n=1, h=8)
+        base = np.asarray(_flash_attention_op.__pure_fn__(
+            q, k, v, causal=False))
+        acc = np.zeros_like(base)
+        m = 64
+        for sd in range(m):
+            acc += np.asarray(_flash_dropout_blockwise(
+                q, k, v, jax.random.key(sd), False, 0.3, block_k=8))
+        err = np.abs(acc / m - base).max() / (np.abs(base).max() + 1e-9)
+        assert err < 0.12, f"dropout mean drift {err}"
+
+    def test_causal_p0_matches_flash_causal(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_dropout_blockwise, _flash_attention_op)
+        q, k, v = self._qkv()
+        base = _flash_attention_op.__pure_fn__(q, k, v, causal=True)
+        drop0 = _flash_dropout_blockwise(q, k, v, jax.random.key(0),
+                                         True, 0.0, block_k=16)
+        np.testing.assert_allclose(np.asarray(drop0), np.asarray(base),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_finite_and_p0_grad_matches(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_dropout_blockwise, _flash_attention_op)
+        q, k, v = self._qkv()
+        g_base = jax.grad(lambda q: _flash_attention_op.__pure_fn__(
+            q, k, v, causal=False).sum())(q)
+        g_p0 = jax.grad(lambda q: _flash_dropout_blockwise(
+            q, k, v, jax.random.key(1), False, 0.0, block_k=16).sum())(q)
+        np.testing.assert_allclose(np.asarray(g_p0), np.asarray(g_base),
+                                   rtol=1e-4, atol=1e-4)
+        g_drop = jax.grad(lambda q: _flash_dropout_blockwise(
+            q, k, v, jax.random.key(1), False, 0.4, block_k=16).sum())(q)
+        g_drop = np.asarray(g_drop)
+        assert np.isfinite(g_drop).all() and np.abs(g_drop).max() > 1e-6
+
+    def test_backward_has_no_dense_probs_buffer(self):
+        # grad at sq=sk=512, block 128: the rematerialized backward must
+        # not hold any 512x512 probs/logits buffer (sdpa fallback would)
+        import re
+        from paddle_tpu.nn.functional.attention import (
+            _flash_dropout_blockwise)
+        s = 512
+        q = jnp.zeros((1, s, 1, 32), jnp.float32)
+
+        def loss(q):
+            return _flash_dropout_blockwise(
+                q, q, q, jax.random.key(0), False, 0.2,
+                block_k=128).sum()
+
+        text = jax.jit(jax.grad(loss)).lower(q).as_text()
+        hits = [ln for ln in text.splitlines()
+                if re.search(rf"{s}x{s}", ln)]
+        assert not hits, "dense 512x512 buffer in blockwise-dropout " \
+            "backward:\n" + "\n".join(hits[:5])
+
+    def test_env_forces_tier(self, monkeypatch):
+        from paddle_tpu.nn.functional import attention as am
+        monkeypatch.setenv("PD_ATTN_DROPOUT_IMPL", "blockwise")
+        assert am.attention_dropout_impl() == "blockwise"
+        monkeypatch.setenv("PD_ATTN_DROPOUT_IMPL", "sdpa")
+        assert am.attention_dropout_impl() == "sdpa"
+        monkeypatch.delenv("PD_ATTN_DROPOUT_IMPL")
+        # CPU default: no pallas backend -> sdpa
+        assert am.attention_dropout_impl() == "sdpa"
+
+    def test_functional_routes_blockwise(self, monkeypatch):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        monkeypatch.setenv("PD_ATTN_DROPOUT_IMPL", "blockwise")
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(2, 32, 2, 16).astype("float32"))
+        q.stop_gradient = False
+        out = F.flash_attention(q, q, q, dropout=0.3, training=True)
+        out.sum().backward()
+        g = q.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
