@@ -1,0 +1,175 @@
+"""YOLOv3-class detector (BASELINE config 4's trainable workload).
+
+The reference core ships the YOLO op family — training loss
+(/root/reference/paddle/fluid/operators/detection/yolov3_loss_op.cc),
+box decode (yolo_box_op.cc), multi-class NMS (multiclass_nms_op.cc) —
+and PaddleDetection composes them into PP-YOLO models. This module is
+the TPU-native composition: a static-shape DarkNet-tiny backbone +
+FPN-style top-down neck + three scale heads, trained through the same
+TrainStep/AMP machinery as every other model and served through
+yolo_box + multiclass_nms (ops/detection.py — already static-shape /
+MXU-friendly). Variable input sizes go through the bucketing policy
+(io/sampler.py): one XLA compilation per bucket, no recompile storms
+(tests/test_yolo.py asserts the compile count).
+
+TPU-first choices vs the reference composition:
+- everything static-shape: gt boxes ride a fixed [N, B, 4] pad-to-max
+  layout (the loss masks invalid rows), NMS outputs fixed
+  [N, keep_top_k, 6] with valid counts — no LoD/dynamic tensors;
+- BN + leaky stay in f32 under AMP O1 while convs run bf16 on the MXU;
+- the three heads return a tuple (pp would shard them; XLA fuses the
+  shared neck), loss is the sum of per-scale yolov3_loss means.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+from ..ops import detection as det
+from ..ops.manipulation import concat
+
+__all__ = ["YOLOv3", "DarkNetTiny", "yolov3_default_anchors"]
+
+# COCO-style 9 anchors (w, h in input pixels), smallest → largest
+yolov3_default_anchors = (10, 13, 16, 30, 33, 23,
+                          30, 61, 62, 45, 59, 119,
+                          116, 90, 156, 198, 373, 326)
+
+
+class _ConvBN(nn.Layer):
+    """conv → BN → leaky_relu, the darknet unit."""
+
+    def __init__(self, cin, cout, k=3, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=k // 2, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.leaky_relu(self.bn(self.conv(x)), 0.1)
+
+
+class DarkNetTiny(nn.Layer):
+    """Compact darknet: returns (c3, c4, c5) at strides 8/16/32 with
+    channels (4w, 8w, 16w). width=16 gives the darknet-tiny scale;
+    tests shrink it."""
+
+    def __init__(self, width=16):
+        super().__init__()
+        w = width
+        self.stem = _ConvBN(3, w)                      # /1
+        self.d1 = _ConvBN(w, 2 * w, stride=2)          # /2
+        self.d2 = _ConvBN(2 * w, 2 * w)
+        self.d3 = _ConvBN(2 * w, 4 * w, stride=2)      # /4
+        self.d4 = _ConvBN(4 * w, 4 * w)
+        self.d5 = _ConvBN(4 * w, 4 * w, stride=2)      # /8  -> c3
+        self.d6 = _ConvBN(4 * w, 8 * w)
+        self.d7 = _ConvBN(8 * w, 8 * w, stride=2)      # /16 -> c4
+        self.d8 = _ConvBN(8 * w, 16 * w)
+        self.d9 = _ConvBN(16 * w, 16 * w, stride=2)    # /32 -> c5
+        self.out_channels = (4 * w, 8 * w, 16 * w)
+
+    def forward(self, x):
+        x = self.d2(self.d1(self.stem(x)))
+        c3 = self.d5(self.d4(self.d3(x)))
+        c4 = self.d7(self.d6(c3))
+        c5 = self.d9(self.d8(c4))
+        return c3, c4, c5
+
+
+class YOLOv3(nn.Layer):
+    """Three-scale YOLOv3 head over a feature backbone.
+
+    forward(images [N,3,H,W]) -> (p5, p4, p3): per-scale raw head
+    outputs [N, A*(5+C), H/d, W/d] for d in (32, 16, 8) — the exact
+    layout yolov3_loss / yolo_box consume.
+    """
+
+    downsamples = (32, 16, 8)
+
+    def __init__(self, num_classes=80,
+                 anchors=yolov3_default_anchors,
+                 anchor_masks=((6, 7, 8), (3, 4, 5), (0, 1, 2)),
+                 width=16, ignore_thresh=0.7, backbone=None):
+        super().__init__()
+        self.num_classes = int(num_classes)
+        self.anchors = tuple(anchors)
+        self.anchor_masks = tuple(tuple(m) for m in anchor_masks)
+        self.ignore_thresh = float(ignore_thresh)
+        self.backbone = backbone or DarkNetTiny(width)
+        c3, c4, c5 = self.backbone.out_channels
+        per = lambda m: len(m) * (5 + self.num_classes)
+
+        self.neck5 = _ConvBN(c5, c5 // 2, k=1)
+        self.head5 = nn.Sequential(
+            _ConvBN(c5 // 2, c5),
+            nn.Conv2D(c5, per(self.anchor_masks[0]), 1))
+
+        self.lat4 = _ConvBN(c5 // 2, c4 // 2, k=1)     # to upsample
+        self.neck4 = _ConvBN(c4 + c4 // 2, c4 // 2, k=1)
+        self.head4 = nn.Sequential(
+            _ConvBN(c4 // 2, c4),
+            nn.Conv2D(c4, per(self.anchor_masks[1]), 1))
+
+        self.lat3 = _ConvBN(c4 // 2, c3 // 2, k=1)
+        self.neck3 = _ConvBN(c3 + c3 // 2, c3 // 2, k=1)
+        self.head3 = nn.Sequential(
+            _ConvBN(c3 // 2, c3),
+            nn.Conv2D(c3, per(self.anchor_masks[2]), 1))
+
+    def forward(self, images):
+        c3, c4, c5 = self.backbone(images)
+        t5 = self.neck5(c5)
+        p5 = self.head5(t5)
+        u4 = F.interpolate(self.lat4(t5), scale_factor=2,
+                           mode="nearest")
+        t4 = self.neck4(concat((c4, u4), axis=1))
+        p4 = self.head4(t4)
+        u3 = F.interpolate(self.lat3(t4), scale_factor=2,
+                           mode="nearest")
+        t3 = self.neck3(concat((c3, u3), axis=1))
+        p3 = self.head3(t3)
+        return p5, p4, p3
+
+    # -- training -------------------------------------------------------
+    def loss(self, outputs, gt_box, gt_label, gt_score=None):
+        """Sum of per-scale yolov3_loss means. gt_box [N,B,4] cx,cy,w,h
+        normalized to the image; invalid rows have w=h=0."""
+        total = None
+        for out, mask, down in zip(outputs, self.anchor_masks,
+                                   self.downsamples):
+            per_img = det.yolov3_loss(
+                out, gt_box, gt_label, anchors=list(self.anchors),
+                anchor_mask=list(mask), class_num=self.num_classes,
+                ignore_thresh=self.ignore_thresh,
+                downsample_ratio=down, gt_score=gt_score)
+            scale_loss = per_img.mean()
+            total = scale_loss if total is None else total + scale_loss
+        return total
+
+    # -- inference ------------------------------------------------------
+    def predict(self, outputs, im_size, conf_thresh=0.05,
+                nms_threshold=0.45, keep_top_k=100):
+        """Decode + multi-class NMS. im_size [N,2] int (h, w).
+        Returns (dets [N, keep_top_k, 6] rows [label, score, x1,y1,x2,y2],
+        valid_counts [N]) — static shapes, padded rows label -1."""
+        boxes, scores = [], []
+        for out, mask, down in zip(outputs, self.anchor_masks,
+                                   self.downsamples):
+            lvl_anchors = []
+            for a in mask:
+                lvl_anchors += [self.anchors[2 * a],
+                                self.anchors[2 * a + 1]]
+            b, s = det.yolo_box(out, im_size, anchors=lvl_anchors,
+                                class_num=self.num_classes,
+                                conf_thresh=conf_thresh,
+                                downsample_ratio=down)
+            boxes.append(b)
+            scores.append(s)
+        from ..ops.manipulation import transpose
+        allb = concat(boxes, axis=1)
+        alls = transpose(concat(scores, axis=1), [0, 2, 1])
+        return det.multiclass_nms(
+            allb, alls,
+            score_threshold=conf_thresh, nms_threshold=nms_threshold,
+            keep_top_k=keep_top_k, background_label=-1,
+            normalized=False)

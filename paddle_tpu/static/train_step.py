@@ -95,6 +95,13 @@ class TrainStep:
         self._buffer_names = [k for k, t in state.items() if t.stop_gradient]
         self.params = {k: state[k]._data for k in self._trainable_names}
         self.buffers = {k: state[k]._data for k in self._buffer_names}
+        # name -> live Tensor, so every step can re-point the Layer's
+        # tensors at the freshly-returned arrays (zero-copy pointer
+        # swap). Without this, the donated step deletes the arrays the
+        # Layer still references and any later eager use of the model
+        # (predict after training — ordinary dygraph flow) dies with
+        # "Array has been deleted".
+        self._state_tensors = dict(state)
         # abstract (meta-init) layer: params are ShapeDtypeStructs — the
         # step can only be AOT-lowered (aot_lower), never executed;
         # optimizer state stays abstract via eval_shape
@@ -354,14 +361,21 @@ class TrainStep:
             key, lr, in_arrays, lbl_arrays)
         if isinstance(self.optimizer._lr, LRScheduler):
             pass  # caller steps the scheduler per its own schedule
+        # keep the Layer's tensors pointing at live (undonated) arrays —
+        # dygraph semantics: the model is usable eagerly at any time
+        self.sync_to_layer()
         return Tensor(loss)
 
     def sync_to_layer(self):
-        """Write compiled-state arrays back into the Layer's Tensors (for
-        checkpointing / switching back to eager)."""
-        state = self.layer.state_dict()
-        for k, a in {**self.params, **self.buffers}.items():
-            state[k]._data = a
+        """Re-point the Layer's Tensors at the step's live arrays
+        (zero-copy). Called after every step — the donated executable
+        deletes the arrays the Layer previously referenced — and kept
+        public for checkpoint/restore flows."""
+        st = self._state_tensors
+        for k, a in self.params.items():
+            st[k]._data = a
+        for k, a in self.buffers.items():
+            st[k]._data = a
 
     def state_dict(self):
         self.sync_to_layer()

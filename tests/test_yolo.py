@@ -1,0 +1,83 @@
+"""YOLOv3 detector (models/yolo.py): BASELINE config 4's trainable
+workload — backbone+neck+heads composed over the reference's YOLO op
+family (yolov3_loss / yolo_box / multiclass_nms,
+ref paddle/fluid/operators/detection/), static shapes throughout.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import YOLOv3
+from paddle_tpu.static import TrainStep
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(7)
+    return YOLOv3(num_classes=4, width=4)
+
+
+def _batch(n=2, size=64, nb=3, seed=0):
+    rng = np.random.RandomState(seed)
+    imgs = rng.randn(n, 3, size, size).astype(np.float32) * 0.1
+    # normalized cx,cy,w,h with a couple of valid boxes (w=h=0 pads)
+    gt_box = np.zeros((n, nb, 4), np.float32)
+    gt_box[:, 0] = [0.5, 0.5, 0.4, 0.3]
+    gt_box[:, 1] = [0.25, 0.3, 0.2, 0.25]
+    gt_label = rng.randint(0, 4, (n, nb)).astype(np.int32)
+    return (paddle.to_tensor(imgs), paddle.to_tensor(gt_box),
+            paddle.to_tensor(gt_label))
+
+
+class TestYOLOv3:
+    def test_forward_shapes(self, tiny):
+        x, _, _ = _batch(size=64)
+        p5, p4, p3 = tiny(x)
+        a = 3 * (5 + 4)  # three anchors per scale, 5+C channels each
+        assert list(p5.shape) == [2, a, 2, 2]
+        assert list(p4.shape) == [2, a, 4, 4]
+        assert list(p3.shape) == [2, a, 8, 8]
+
+    def test_trains_loss_decreases(self, tiny):
+        paddle.seed(1)
+        model = YOLOv3(num_classes=4, width=4)
+        opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                    parameters=model.parameters())
+        step = TrainStep(model, lambda outs, box, lbl:
+                         model.loss(outs, box, lbl), opt)
+        x, box, lbl = _batch()
+        losses = [float(step(x, (box, lbl)).item()) for _ in range(12)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_predict_static_shapes(self, tiny):
+        x, _, _ = _batch(size=64)
+        outs = tiny(x)
+        im_size = paddle.to_tensor(
+            np.array([[64, 64], [64, 64]], np.int32))
+        dets, counts = tiny.predict(outs, im_size, keep_top_k=10)
+        dets = np.asarray(dets._data)
+        counts = np.asarray(counts._data)
+        assert dets.shape == (2, 10, 6)
+        assert counts.shape == (2,) and (counts >= 0).all()
+        valid = dets[dets[..., 0] >= 0]
+        if len(valid):
+            # boxes clipped to the image, scores in [0, 1]
+            assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
+            assert (valid[:, 2:] >= -1e-3).all()
+            assert (valid[:, [2, 4]] <= 64 + 1e-3).all()
+
+    def test_bucketing_no_recompile_storm(self):
+        # two input buckets -> exactly two XLA compilations of the same
+        # jitted step (the dynamic-shape policy BASELINE config 4 needs)
+        import jax
+        paddle.seed(2)
+        model = YOLOv3(num_classes=4, width=4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        step = TrainStep(model, lambda outs, box, lbl:
+                         model.loss(outs, box, lbl), opt)
+        for size in (64, 96, 64, 96, 64):
+            x, box, lbl = _batch(size=size)
+            step(x, (box, lbl))
+        assert step._step_fn._cache_size() == 2
