@@ -25,7 +25,7 @@ from __future__ import annotations
 from .. import nn
 from ..nn import functional as F
 from ..ops import detection as det
-from ..ops.manipulation import concat
+from ..ops.manipulation import concat, transpose
 
 __all__ = ["YOLOv3", "DarkNetTiny", "yolov3_default_anchors"]
 
@@ -165,7 +165,6 @@ class YOLOv3(nn.Layer):
                                 downsample_ratio=down)
             boxes.append(b)
             scores.append(s)
-        from ..ops.manipulation import transpose
         allb = concat(boxes, axis=1)
         alls = transpose(concat(scores, axis=1), [0, 2, 1])
         return det.multiclass_nms(

@@ -38,7 +38,7 @@ class TestYOLOv3:
         assert list(p4.shape) == [2, a, 4, 4]
         assert list(p3.shape) == [2, a, 8, 8]
 
-    def test_trains_loss_decreases(self, tiny):
+    def test_trains_loss_decreases(self):
         paddle.seed(1)
         model = YOLOv3(num_classes=4, width=4)
         opt = paddle.optimizer.Adam(learning_rate=2e-3,
