@@ -1,0 +1,36 @@
+"""AMP precision receipt: every dot_general in the O1 ERNIE train step
+must lower with bf16 operands. An f32 dot on TPU decomposes into up to
+6 bf16 MXU passes — a silent precision leak here would halve (or
+worse) the bench MFU without failing any numeric test. Verified at the
+StableHLO level like tests/test_head_hlo_receipt.py."""
+import re
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+from paddle_tpu.static import TrainStep
+
+
+def test_o1_step_has_only_bf16_dots():
+    paddle.seed(0)
+    cfg = ErnieConfig(vocab_size=512, hidden_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      intermediate_size=128,
+                      max_position_embeddings=64)
+    m = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=m.parameters())
+    step = TrainStep(
+        m, lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
+        opt, amp_level="O1", amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 512, (4, 32)).astype(np.int32)
+    y = rng.randint(0, 512, (4, 32)).astype(np.int32)
+    text = step.aot_lower((x,), (y,)).as_text()
+    lines = [ln for ln in text.splitlines() if "dot_general" in ln]
+    assert len(lines) >= 15, "expected a full fwd+bwd step's dots"
+    bad = [ln.strip()[:120] for ln in lines
+           if re.search(r"tensor<[0-9x]*f32>", ln.split("->")[0])]
+    assert not bad, "f32-operand dot_general in the O1 step:\n" + \
+        "\n".join(bad[:6])
