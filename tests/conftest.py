@@ -37,3 +37,12 @@ if not _USE_TPU:
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def shard_frac(arr):
+    """Fraction of a sharded array materialized on this process's first
+    shard — 1/n under an n-way sharding, 1.0 when replicated. Shared by
+    the ZeRO/sharding receipts (test_zero_stages, test_yolo)."""
+    import numpy as _np
+    return (_np.prod(arr.addressable_shards[0].data.shape)
+            / _np.prod(arr.shape))

@@ -52,9 +52,7 @@ def _data():
     return x, y
 
 
-def _shard_frac(arr):
-    return (np.prod(arr.addressable_shards[0].data.shape)
-            / np.prod(arr.shape))
+from conftest import shard_frac as _shard_frac  # noqa: E402
 
 
 def _compiled_text(step, x, y):
