@@ -126,3 +126,51 @@ class TestYOLODistributed:
         finally:
             dist.set_mesh(None)
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+class TestYOLOExport:
+    """Deployment loop for the detector: forward + decode + NMS
+    exported as ONE inference program (jax.export handles the NMS
+    while_loops), served back through load_inference_model and the
+    Predictor handle API."""
+
+    def test_export_serve_end_to_end(self, tiny, tmp_path):
+        import os
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import InputSpec
+
+        class ServingYOLO(nn.Layer):
+            def __init__(self, det, hw):
+                super().__init__()
+                self.det = det
+                self.hw = hw
+
+            def forward(self, images):
+                outs = self.det(images)
+                n = images.shape[0]
+                im = paddle.to_tensor(
+                    np.full((n, 2), self.hw, np.int32))
+                dets, counts = self.det.predict(outs, im,
+                                                conf_thresh=0.1,
+                                                keep_top_k=16)
+                return dets, counts
+
+        tiny.eval()
+        serving = ServingYOLO(tiny, 64)
+        serving.eval()
+        x = np.random.RandomState(0).randn(2, 3, 64, 64).astype(
+            np.float32) * 0.1
+        with paddle.no_grad():
+            ref_d, ref_c = serving(paddle.to_tensor(x))
+        ref_d = np.asarray(ref_d._data)
+        ref_c = np.asarray(ref_c._data)
+
+        prefix = os.path.join(str(tmp_path), "yolo/inference")
+        paddle.static.save_inference_model(
+            prefix, layer=serving,
+            input_spec=[InputSpec([2, 3, 64, 64], "float32")])
+        pred, feeds, fetches = paddle.static.load_inference_model(
+            prefix)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref_d, rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(out[1], ref_c)
