@@ -64,15 +64,29 @@ def bench_ernie(on_tpu):
     amp_level = os.environ.get("PD_BENCH_AMP", "O1").upper()
     if amp_level not in ("O1", "O2"):
         raise ValueError(f"PD_BENCH_AMP={amp_level!r}: must be O1 or O2")
+    size = os.environ.get("PD_BENCH_ERNIE", "base").strip().lower()
+    if size not in ("base", "large"):
+        raise ValueError(f"PD_BENCH_ERNIE={size!r}: must be base or "
+                         "large")
     if on_tpu:
-        cfg = ErnieConfig(vocab_size=30528, hidden_size=768,
-                          num_hidden_layers=12, num_attention_heads=12,
-                          intermediate_size=3072,
+        # (hidden, layers, heads, intermediate, batch, steps);
+        # large: bigger GEMMs raise achievable MFU — a second hardware
+        # data point on the MFU-vs-shape curve
+        h, L, nh, inter, batch, steps = {
+            "base": (768, 12, 12, 3072, 48, 24),
+            "large": (1024, 24, 16, 4096, 16, 12),
+        }[size]
+        cfg = ErnieConfig(vocab_size=30528, hidden_size=h,
+                          num_hidden_layers=L, num_attention_heads=nh,
+                          intermediate_size=inter,
                           max_position_embeddings=512,
                           scan_layers=scan)
-        batch, seqlen, steps = 48, 512, 24
+        seqlen = 512
         batch = int(os.environ.get("PD_BENCH_ERNIE_BATCH", batch))
     else:
+        if size != "base":
+            print(f"# PD_BENCH_ERNIE={size} ignored: CPU smoke always "
+                  "runs the tiny config", file=sys.stderr)
         cfg = ErnieConfig(vocab_size=8192, hidden_size=256,
                           num_hidden_layers=4, num_attention_heads=8,
                           intermediate_size=1024,
@@ -309,44 +323,54 @@ def main():
         errors["ernie"] = f"{type(e).__name__}: {e}"
     # secondary benches never sink the primary metric; failures are
     # reported in extras["errors"]
-    try:
-        images_per_sec = bench_resnet(on_tpu)
-    except Exception as e:  # pragma: no cover
-        images_per_sec = -1.0
-        errors["resnet"] = f"{type(e).__name__}: {e}"
-    try:
-        dyn_ips, compiles, n_buckets = bench_dynamic_shapes(on_tpu)
-    except Exception as e:  # pragma: no cover
-        dyn_ips, compiles, n_buckets = -1.0, -1, -1
-        errors["dynamic_shapes"] = f"{type(e).__name__}: {e}"
-    try:
-        add_us, mm_us = bench_eager_dispatch()
-    except Exception as e:  # pragma: no cover
-        add_us = mm_us = -1.0
-        errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
-    try:
-        decode_tps, decode_dtype = bench_generate(on_tpu)
-    except Exception as e:  # pragma: no cover
-        decode_tps, decode_dtype = -1.0, "?"
-        errors["generate"] = f"{type(e).__name__}: {e}"
+    # PD_BENCH_ONLY=ernie skips the secondary legs — sweep entries that
+    # vary only the ERNIE config (flash blocks, scan_layers, model
+    # size) would otherwise burn scarce TPU-window minutes re-measuring
+    # identical ResNet/decode/pipeline numbers
+    only_ernie = (os.environ.get("PD_BENCH_ONLY", "").strip().lower()
+                  == "ernie")
+    images_per_sec = -1.0
+    dyn_ips, compiles, n_buckets = -1.0, -1, -1
+    add_us = mm_us = -1.0
+    decode_tps, decode_dtype = -1.0, "skipped" if only_ernie else "?"
+    if not only_ernie:
+        try:
+            images_per_sec = bench_resnet(on_tpu)
+        except Exception as e:  # pragma: no cover
+            errors["resnet"] = f"{type(e).__name__}: {e}"
+        try:
+            dyn_ips, compiles, n_buckets = bench_dynamic_shapes(on_tpu)
+        except Exception as e:  # pragma: no cover
+            errors["dynamic_shapes"] = f"{type(e).__name__}: {e}"
+        try:
+            add_us, mm_us = bench_eager_dispatch()
+        except Exception as e:  # pragma: no cover
+            errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
+        try:
+            decode_tps, decode_dtype = bench_generate(on_tpu)
+        except Exception as e:  # pragma: no cover
+            decode_dtype = "?"
+            errors["generate"] = f"{type(e).__name__}: {e}"
     # pipeline receipt runs in its own process (needs a multi-device
     # virtual CPU mesh, which this process may not be able to provide
     # once a TPU backend is initialized)
     pipeline_stats = None
-    try:
-        import subprocess
-        here = os.path.dirname(os.path.abspath(__file__))
-        p = subprocess.run(
-            [sys.executable, os.path.join(here, "tools",
-                                          "pipeline_bench.py")],
-            capture_output=True, text=True, timeout=600)
-        if p.returncode == 0 and p.stdout.strip():
-            pipeline_stats = json.loads(
-                p.stdout.strip().splitlines()[-1])
-        else:
-            errors["pipeline"] = (p.stderr or "no output").strip()[-300:]
-    except Exception as e:  # pragma: no cover
-        errors["pipeline"] = f"{type(e).__name__}: {e}"
+    if not only_ernie:
+        try:
+            import subprocess
+            here = os.path.dirname(os.path.abspath(__file__))
+            p = subprocess.run(
+                [sys.executable, os.path.join(here, "tools",
+                                              "pipeline_bench.py")],
+                capture_output=True, text=True, timeout=600)
+            if p.returncode == 0 and p.stdout.strip():
+                pipeline_stats = json.loads(
+                    p.stdout.strip().splitlines()[-1])
+            else:
+                errors["pipeline"] = (p.stderr
+                                      or "no output").strip()[-300:]
+        except Exception as e:  # pragma: no cover
+            errors["pipeline"] = f"{type(e).__name__}: {e}"
 
     # record which attention path the ERNIE step actually used (the
     # dropout kernel self-check can fall back to SDPA-with-dropout)
@@ -364,10 +388,18 @@ def main():
         attn_path = f"unknown: {type(e).__name__}"
 
     # A100 BERT-base-class pretraining sustains ~25k tokens/s/chip
-    # (derived from published A100 BERT results; see module docstring)
-    baseline = 25000.0 if on_tpu else 1.0
+    # (derived from published A100 BERT results; see module docstring).
+    # Other model sizes (PD_BENCH_ERNIE=large) normalize by FLOPs/token
+    # so vs_baseline stays an equal-compute ratio, and the metric name
+    # carries the size.
+    ernie_size = os.environ.get("PD_BENCH_ERNIE", "base").strip().lower()
+    _BASE_FPT = 717289356.0  # ERNIE-base flops/token at the bench shape
+    if on_tpu:
+        baseline = 25000.0 * (_BASE_FPT / fpt) if fpt > 0 else 25000.0
+    else:
+        baseline = 1.0
     print(json.dumps({
-        "metric": "ernie_base_pretrain_tokens_per_sec_per_chip"
+        "metric": f"ernie_{ernie_size}_pretrain_tokens_per_sec_per_chip"
         if on_tpu else "ernie_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",

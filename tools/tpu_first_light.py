@@ -252,8 +252,12 @@ def main():
                 ("batch96+ampO2", {"PD_BENCH_ERNIE_BATCH": "96",
                                    "PD_BENCH_RESNET_BATCH": "256",
                                    "PD_BENCH_AMP": "O2"}),
-                ("bq256", {"PD_FLASH_BQ": "256", "PD_FLASH_BK": "256"}),
-                ("scan_layers", {"PD_BENCH_SCAN_LAYERS": "1"}),
+                ("bq256", {"PD_FLASH_BQ": "256", "PD_FLASH_BK": "256",
+                           "PD_BENCH_ONLY": "ernie"}),
+                ("scan_layers", {"PD_BENCH_SCAN_LAYERS": "1",
+                                 "PD_BENCH_ONLY": "ernie"}),
+                ("ernie_large", {"PD_BENCH_ERNIE": "large",
+                                 "PD_BENCH_ONLY": "ernie"}),
         ):
             if tag == "bq256" and not kd_ok:
                 # with the kernel path pinned off, flash block sizes
