@@ -186,3 +186,24 @@ class TestServingDtype:
         out = np.asarray(out._data)
         assert out.shape == (2, 11)
         np.testing.assert_array_equal(out[:, :5], ids)
+
+    def test_bf16_decode_hlo_receipt(self, model):
+        # the serving-dtype claim is "weight reads are bf16": lower the
+        # decode program at dtype=bfloat16 and assert no f32-operand
+        # dot_general remains (mirrors tests/test_amp_dot_receipt.py)
+        import re
+        import jax
+        from paddle_tpu.models.generation import (_build_run,
+                                                  _gpt_params)
+        run = _build_run(float(model.gpt.config.layer_norm_eps),
+                         model.gpt.config.num_heads, 0.0, None, None,
+                         0, 4, 6, 10, "bfloat16")
+        params = _gpt_params(model)
+        ids = np.zeros((2, 6), np.int32)
+        text = run.lower(params, ids, jax.random.key(0)).as_text()
+        lines = [ln for ln in text.splitlines() if "dot_general" in ln]
+        assert len(lines) >= 4, "expected prefill+decode dots"
+        bad = [ln.strip()[:120] for ln in lines
+               if re.search(r"tensor<[0-9x]*f32>", ln.split("->")[0])]
+        assert not bad, "f32-operand dot in bf16 decode:\n" + \
+            "\n".join(bad[:4])
