@@ -229,7 +229,11 @@ class TestGPTFlashWiring:
         a = np.asarray(m_sdpa(ids)._data)
         b = np.asarray(m_flash(ids)._data)
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
-        # and the flash model decodes identically through the KV cache
-        g1 = np.asarray(m_sdpa.generate(ids, max_new_tokens=6)._data)
-        g2 = np.asarray(m_flash.generate(ids, max_new_tokens=6)._data)
-        np.testing.assert_array_equal(g1, g2)
+        # decode receipt that exercises flash: naive re-forward greedy
+        # THROUGH the flash forward path must equal the KV-cache decode
+        # (generate's own attention is cache-specialized, not flash —
+        # this pins the two against each other)
+        g_cache = np.asarray(m_flash.generate(ids,
+                                              max_new_tokens=6)._data)
+        g_naive = _naive_greedy(m_flash, np.asarray(ids._data), 6)
+        np.testing.assert_array_equal(g_cache, g_naive)
