@@ -121,6 +121,16 @@ def _init_linear(layer, std, col_spec=None, row_spec=None):
 
 
 @functools.lru_cache(maxsize=8)
+def _lens_to_additive_mask(kv_lens, s):
+    """[b] right-padding lengths -> additive [b, 1, 1, s] mask (the
+    SDPA fallback form; the flash path consumes kv_lens directly)."""
+    pos = creation.arange(0, s, 1, "int64")
+    am = (manipulation.unsqueeze(pos, [0])
+          < manipulation.unsqueeze(kv_lens, [1]))
+    return (1.0 - manipulation.unsqueeze(
+        am, [1, 2]).astype("float32")) * -1e9
+
+
 def _ring_attention_fn(mesh, mode="ring"):
     """One shard_map'd ring-attention closure per mesh (Mesh is hashable
     — equal-but-distinct meshes share an entry, and lru eviction keeps
@@ -180,27 +190,33 @@ class ErnieSelfAttention(nn.Layer):
         self.out = _init_linear(nn.Linear(h, h), std)
         self.out.weight.sharding_spec = P(TENSOR_AXIS, None)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, kv_lens=None):
         b, s, h = x.shape
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
         if self.seq_parallel:
-            if attn_mask is not None:
+            if attn_mask is not None or kv_lens is not None:
                 raise ValueError(
                     "sequence_parallel attention takes no attention_mask"
-                    " — pad to full blocks (io/sampler.py bucketing) so"
-                    " every position is real, or run the dense model")
+                    "/kv_lens — pad to full blocks (io/sampler.py"
+                    " bucketing) so every position is real, or run the"
+                    " dense model")
             # mesh presence is validated inside the registered op (the
             # single serialization-safe entry point)
             mode = "ulysses" if self.seq_parallel == "ulysses" else "ring"
             ctx = _attention_sp_op(q, k, v, mode=mode)
             return self.out(ctx.reshape([b, s, h]))
         if attn_mask is None and self.use_flash:
+            # kv_lens (right-padded batches) keeps the blockwise flash
+            # form — a [b, s] padding mask need not force SDPA
             ctx = F.flash_attention(q, k, v, dropout=self.dropout_p,
-                                    training=self.training)
+                                    training=self.training,
+                                    kv_lens=kv_lens)
         else:
+            if kv_lens is not None and attn_mask is None:
+                attn_mask = _lens_to_additive_mask(kv_lens, s)
             ctx = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
                 training=self.training)
@@ -236,8 +252,8 @@ class ErnieLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self.act = config.hidden_act
 
-    def forward(self, x, attn_mask=None):
-        attn = self.attention(x, attn_mask)
+    def forward(self, x, attn_mask=None, kv_lens=None):
+        attn = self.attention(x, attn_mask, kv_lens=kv_lens)
         x = self.attn_norm(x + self.dropout(attn))
         if self.use_moe:
             ffn = self.moe(x)
@@ -329,17 +345,34 @@ class ErnieModel(nn.Layer):
         return total
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, seq_lens=None):
         x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and seq_lens is not None:
+            raise ValueError("pass attention_mask OR seq_lens, not both")
         if attention_mask is not None:
-            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
+            # [b, s] 1/0 mask -> additive [b, 1, 1, s]. A 1/0 mask is
+            # GENERAL key masking (it need not be contiguous), so it
+            # cannot be silently folded to lengths — right-padded
+            # batches should pass seq_lens, which keeps the blockwise
+            # varlen flash form instead of materialized SDPA.
             am = manipulation.unsqueeze(attention_mask, [1, 2])
             attention_mask = (1.0 - am.astype("float32")) * -1e9
+        if seq_lens is not None and not self.config.use_flash_attention:
+            # non-flash configs take the additive form ONCE here rather
+            # than per layer (the flash path consumes kv_lens directly)
+            attention_mask = _lens_to_additive_mask(
+                seq_lens, x.shape[1])
+            seq_lens = None
         if isinstance(self.encoder, ErnieScannedEncoder):
+            if seq_lens is not None:
+                raise ValueError(
+                    "scan_layers encoder takes attention_mask, not "
+                    "seq_lens (the scanned stack carries the additive "
+                    "mask form)")
             x = self.encoder(x, attention_mask)
         else:
             for layer in self.encoder:
-                x = layer(x, attention_mask)
+                x = layer(x, attention_mask, kv_lens=seq_lens)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

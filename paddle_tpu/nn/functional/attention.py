@@ -99,7 +99,7 @@ def _flash_carry_init(b, n, sq, hd):
 
 
 def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
-                        is_causal, dropout=None):
+                        is_causal, dropout=None, kv_lens=None):
     """Consume one KV shard [b, n, s_kv, h] in block_k chunks, updating
     the online-softmax carry (acc, m, l).
 
@@ -119,6 +119,12 @@ def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
     REGENERATES each block's mask instead of saving O(s²) residuals —
     the pure-JAX form of the flash-dropout trick, used as the TPU
     fallback tier when the Mosaic kernel RNG is unavailable.
+
+    kv_lens [b] int (varlen): per-batch true key length — keys at
+    pos_k >= kv_lens[i] are masked for batch row i (right-padded
+    batches, the layout io/sampler.py's bucketing produces). Replaces
+    the scalar `sk` bound per row; the reference's varlen flash
+    (flash_attn_varlen) capability in blockwise form.
     """
     b, n, skl, hd = k.shape
     nblocks = (skl + block_k - 1) // block_k
@@ -135,9 +141,20 @@ def _flash_carry_update(q32, k, v, carry, block_k, pos_q, pos_k0, sk,
         logits = jnp.einsum("bnqh,bnkh->bnqk", q32,
                             kj.astype(jnp.float32))
         pos_k = pos_k0 + jidx * block_k + jnp.arange(block_k)
-        valid = pos_k < pos_k0 + sk
+        valid = pos_k < pos_k0 + sk            # [bk]
+        if kv_lens is not None:
+            # per-batch right-padding bound: [b, 1, 1, bk]
+            valid = (valid[None, :]
+                     & (pos_k[None, :] < kv_lens[:, None]))[:, None,
+                                                            None, :]
         if is_causal:
-            valid = valid[None, :] & (pos_q[:, None] >= pos_k[None, :])
+            cmask = pos_q[:, None] >= pos_k[None, :]   # [sq, bk]
+            if kv_lens is not None:
+                valid = valid & cmask[None, None]
+            else:
+                valid = valid[None, :] & cmask
+            logits = jnp.where(valid, logits, -jnp.inf)
+        elif kv_lens is not None:
             logits = jnp.where(valid, logits, -jnp.inf)
         else:
             logits = jnp.where(valid[None, :], logits, -jnp.inf)
@@ -171,12 +188,13 @@ def _flash_finish(carry, dtype):
     return (acc / jnp.maximum(l[..., None], 1e-30)).astype(dtype)
 
 
-def _flash_fwd(q, k, v, is_causal, scale, block_k, dropout=None):
+def _flash_fwd(q, k, v, is_causal, scale, block_k, dropout=None,
+               kv_lens=None):
     """Blockwise attention with online softmax, scanning KV chunks.
 
     q,k,v: [b, n, s, h] (head-major internally). dropout=(key, p)
-    enables the rematerialized flash-dropout path (see
-    _flash_carry_update).
+    enables the rematerialized flash-dropout path; kv_lens [b] the
+    varlen right-padding bound (see _flash_carry_update).
     """
     b, n, sq, hd = q.shape
     sk = k.shape[2]
@@ -184,12 +202,12 @@ def _flash_fwd(q, k, v, is_causal, scale, block_k, dropout=None):
     carry = _flash_carry_init(b, n, sq, hd)
     carry = _flash_carry_update(q32, k, v, carry, block_k,
                                 jnp.arange(sq), 0, sk, is_causal,
-                                dropout=dropout)
+                                dropout=dropout, kv_lens=kv_lens)
     return _flash_finish(carry, q.dtype)
 
 
 def _flash_headmajor(query, key, value, causal, block_size,
-                     dropout=None):
+                     dropout=None, kv_lens=None):
     """Shared paddle-layout wrapper over _flash_fwd: [b,s,n,h] in/out,
     head-major inside, 1/sqrt(h) scaling, block clamped to sk. Both
     the no-dropout fallback and the blockwise dropout tier route here
@@ -199,7 +217,8 @@ def _flash_headmajor(query, key, value, causal, block_size,
     v = jnp.einsum("bsnh->bnsh", value)
     scale = 1.0 / math.sqrt(q.shape[-1])
     blk = min(block_size, k.shape[2])
-    out = _flash_fwd(q, k, v, causal, scale, blk, dropout=dropout)
+    out = _flash_fwd(q, k, v, causal, scale, blk, dropout=dropout,
+                     kv_lens=kv_lens)
     return jnp.einsum("bnsh->bsnh", out)
 
 
@@ -217,13 +236,18 @@ def _flash_dropout_blockwise(query, key, value, drop_key, causal,
 
 
 @register_op("flash_attention_op")
-def _flash_attention_op(query, key, value, causal=False, block_size=512):
+def _flash_attention_op(query, key, value, kv_lens=None, causal=False,
+                        block_size=512):
     """No-dropout flash attention: Pallas kernel on TPU, lax.scan
-    online-softmax elsewhere."""
+    online-softmax elsewhere. kv_lens [b] (varlen right-padding) takes
+    the blockwise path everywhere — the Pallas kernel's key bound is a
+    compile-time scalar, and extending it per-batch is Mosaic work
+    that cannot be validated while the tunnel is down."""
     from ...ops import pallas_kernels as _pk
-    if _pk.pallas_available():
+    if kv_lens is None and _pk.pallas_available():
         return _pk.flash_attention_mha(query, key, value, causal=causal)
-    return _flash_headmajor(query, key, value, causal, block_size)
+    return _flash_headmajor(query, key, value, causal, block_size,
+                            kv_lens=kv_lens)
 
 
 def attention_dropout_impl() -> str:
@@ -253,8 +277,8 @@ def attention_dropout_impl() -> str:
 
 @register_op("flash_attention_dropout", tags=("rng",))
 def _flash_attention_dropout_op(query, key, value, drop_key,
-                                causal=False, dropout_p=0.0,
-                                block_size=512):
+                                kv_lens=None, causal=False,
+                                dropout_p=0.0, block_size=512):
     """Training-mode flash attention with attention-probs dropout.
     Three tiers (attention_dropout_impl): Pallas in-kernel RNG
     (ops/pallas_kernels.py — backward regenerates each block's mask
@@ -265,42 +289,61 @@ def _flash_attention_dropout_op(query, key, value, drop_key,
     replay can refresh it per run like every other rng op."""
     from ...ops import pallas_kernels as _pk
     impl = attention_dropout_impl()
-    if impl == "kernel":
+    if impl == "kernel" and kv_lens is None:
         seed = jax.random.randint(drop_key, (1,), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)
         return _pk.flash_attention_mha(query, key, value, causal=causal,
                                        dropout_p=dropout_p, seed=seed)
-    if impl == "blockwise":
-        return _flash_dropout_blockwise(query, key, value, drop_key,
-                                        causal, dropout_p,
-                                        block_k=block_size)
+    if impl in ("kernel", "blockwise"):
+        # varlen rides the blockwise tier (per-batch key bound is not
+        # in the Mosaic kernel); plain kernel-tier calls never get here
+        return _flash_headmajor(query, key, value, causal, block_size,
+                                dropout=(drop_key, float(dropout_p)),
+                                kv_lens=kv_lens)
+    if kv_lens is not None:
+        mask = (jnp.arange(key.shape[1])[None, :]
+                < kv_lens[:, None])[:, None, None, :]
+        return _sdpa_impl(query, key, value, mask, dropout_p, causal,
+                          None, drop_key=drop_key)
     return _sdpa_impl(query, key, value, None, dropout_p, causal, None,
                       drop_key=drop_key)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, block_size=512, training=True,
-                    name=None):
+                    kv_lens=None, name=None):
     """paddle.nn.functional.flash_attention-compatible entry.
 
     Layout: [batch, seq, num_heads, head_dim]. Memory O(seq·block)
     instead of O(seq²). Training-mode attention dropout runs INSIDE the
     Pallas kernel on TPU (block-seeded mask, regenerated in the
     backward); eval or dropout=0 takes the deterministic kernel.
+
+    kv_lens [b] int32 (TPU-native extension; the reference's
+    flash_attn_varlen capability): per-batch true key length for
+    right-padded batches — keys at positions >= kv_lens[i] are masked
+    while keeping the blockwise O(seq·block) memory form. Right
+    padding is exactly what io/sampler.py's bucketing produces, so
+    masked batches need not fall back to materialized SDPA.
     """
+    # kv_lens rides POSITIONALLY: static capture stores keyword tensors
+    # as frozen constants (and rejects keyword Vars), so a traced
+    # per-batch length must occupy an input slot
     if dropout and training:
         # return_softmax is an API-parity flag (no path here has ever
         # returned the probs); training-mode dropout must still apply
         from ...core.generator import next_key
         return _flash_attention_dropout_op(query, key, value, next_key(),
+                                           kv_lens,
                                            causal=causal,
                                            dropout_p=float(dropout),
                                            block_size=block_size)
     if not return_softmax:
-        return _flash_attention_op(query, key, value, causal=causal,
+        return _flash_attention_op(query, key, value, kv_lens,
+                                   causal=causal,
                                    block_size=block_size)
     # return_softmax form: the blockwise reference path (pure jnp),
     # sharing the registered op's implementation
-    return _flash_attention_op.__pure_fn__(query, key, value,
+    return _flash_attention_op.__pure_fn__(query, key, value, kv_lens,
                                            causal=causal,
                                            block_size=block_size)

@@ -201,12 +201,17 @@ class Program:
                     node.kwargs = {k: v for k, v in node.kwargs.items()
                                    if k != "dropout_p"}
                 elif node.op_type == "flash_attention_dropout":
+                    # (q, k, v, drop_key, kv_lens) -> deterministic
+                    # flash over (q, k, v, kv_lens): drop ONLY the rng
+                    # key; the varlen bound must survive into the eval
+                    # clone or it would attend over padding keys
                     node.op_type = "flash_attention_op"
                     node.fn = _registry.get_op("flash_attention_op").fn
-                    node.in_ids = node.in_ids[:3]
-                    node.const_args = node.const_args[:3]
+                    node.in_ids = node.in_ids[:3] + node.in_ids[4:5]
+                    node.const_args = (node.const_args[:3]
+                                       + node.const_args[4:5])
                     node.kwargs = {k: v for k, v in node.kwargs.items()
-                                   if k == "causal"}
+                                   if k in ("causal", "block_size")}
                 elif node.op_type == "batch_norm_op":
                     node.kwargs = dict(node.kwargs, training=False)
         return p

@@ -321,3 +321,132 @@ class TestBlockwiseDropoutTier:
         out.sum().backward()
         g = q.grad.numpy()
         assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+class TestVarlenKvLens:
+    """kv_lens (per-batch right-padding bound) through the blockwise
+    flash path — the reference's flash_attn_varlen capability without
+    materializing masks (attention.py _flash_carry_update)."""
+
+    def _qkv(self, b=3, s=48, n=2, h=16, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: rng.randn(b, s, n, h).astype(np.float32) * 0.5
+        return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+    def _sdpa_masked(self, q, k, v, lens, causal=False):
+        from paddle_tpu.nn.functional import attention as am
+        mask = (np.arange(k.shape[1])[None, :]
+                < np.asarray(lens)[:, None])[:, None, None, :]
+        return am._sdpa_impl(q, k, v, jnp.asarray(mask), 0.0, causal,
+                             None)
+
+    def test_matches_masked_sdpa(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_attention_op)
+        q, k, v = self._qkv()
+        lens = jnp.asarray([48, 17, 1], jnp.int32)
+        got = _flash_attention_op.__pure_fn__(q, k, v, kv_lens=lens,
+                                              block_size=16)
+        want = self._sdpa_masked(q, k, v, lens)
+        got, want = np.asarray(got), np.asarray(want)
+        # only rows attending over >=1 valid key are defined; all are
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_causal_matches_masked_sdpa(self):
+        from paddle_tpu.nn.functional.attention import (
+            _flash_attention_op)
+        q, k, v = self._qkv(seed=1)
+        lens = jnp.asarray([40, 25, 9], jnp.int32)
+        got = _flash_attention_op.__pure_fn__(q, k, v, kv_lens=lens,
+                                              causal=True,
+                                              block_size=16)
+        want = self._sdpa_masked(q, k, v, lens, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_dropout_p0_and_determinism(self):
+        from paddle_tpu.nn.functional.attention import _flash_headmajor
+        q, k, v = self._qkv(seed=2)
+        lens = jnp.asarray([48, 30, 12], jnp.int32)
+        base = _flash_headmajor(q, k, v, False, 16, kv_lens=lens)
+        p0 = _flash_headmajor(q, k, v, False, 16,
+                              dropout=(jax.random.key(5), 0.0),
+                              kv_lens=lens)
+        np.testing.assert_allclose(np.asarray(p0), np.asarray(base),
+                                   rtol=1e-5, atol=1e-5)
+        d1 = _flash_headmajor(q, k, v, False, 16,
+                              dropout=(jax.random.key(5), 0.4),
+                              kv_lens=lens)
+        d2 = _flash_headmajor(q, k, v, False, 16,
+                              dropout=(jax.random.key(5), 0.4),
+                              kv_lens=lens)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_ernie_seq_lens_matches_padding_mask(self):
+        # explicit seq_lens (varlen flash path) must equal the same
+        # model under the equivalent right-padded [b, s] additive mask
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ErnieConfig, ErnieModel
+        kw = dict(vocab_size=211, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32,
+                  hidden_dropout_prob=0.0,
+                  attention_probs_dropout_prob=0.0)
+        paddle.seed(6)
+        m_flash = ErnieModel(ErnieConfig(use_flash_attention=True, **kw))
+        paddle.seed(6)
+        m_sdpa = ErnieModel(ErnieConfig(use_flash_attention=False, **kw))
+        m_flash.eval(), m_sdpa.eval()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, 211, (3, 16)).astype(np.int32))
+        lens = (16, 9, 4)
+        mask = np.zeros((3, 16), np.int32)
+        for i, L in enumerate(lens):
+            mask[i, :L] = 1
+        a, _ = m_flash(ids, seq_lens=paddle.to_tensor(
+            np.asarray(lens, np.int32)))
+        b, _ = m_sdpa(ids, attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(np.asarray(a._data),
+                                   np.asarray(b._data),
+                                   rtol=2e-4, atol=2e-4)
+        # mask OR lens, never both
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="not both"):
+            m_flash(ids, attention_mask=paddle.to_tensor(mask),
+                    seq_lens=paddle.to_tensor(
+                        np.asarray(lens, np.int32)))
+
+    def test_static_capture_and_eval_clone_keep_kv_lens(self):
+        # kv_lens rides an INPUT slot: a static program can feed
+        # per-batch lengths at run time, and clone(for_test) — which
+        # rewrites flash_attention_dropout to the deterministic op —
+        # must carry the varlen bound through (dropping it would
+        # silently attend over padding keys in the eval program)
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import static
+
+        rng = np.random.RandomState(3)
+        qv = rng.randn(2, 32, 2, 8).astype(np.float32)
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            q = static.data("q", [2, 32, 2, 8], "float32")
+            lens = static.data("lens", [2], "int32")
+            out = F.flash_attention(q, q, q, dropout=0.3,
+                                    training=True, kv_lens=lens)
+        ev = main.clone(for_test=True)
+        exe = static.Executor()
+        full = np.asarray([32, 32], np.int32)
+        short = np.asarray([32, 5], np.int32)
+        o_full = exe.run(ev, feed={"q": qv, "lens": full},
+                         fetch_list=[out])[0]
+        o_short = exe.run(ev, feed={"q": qv, "lens": short},
+                          fetch_list=[out])[0]
+        # row 0 identical (same lens), row 1 must differ (fewer keys)
+        np.testing.assert_allclose(o_full[0], o_short[0], rtol=1e-6)
+        assert np.abs(o_full[1] - o_short[1]).max() > 1e-6
+        # and the eval clone is deterministic (rng key dropped)
+        o_again = exe.run(ev, feed={"q": qv, "lens": short},
+                          fetch_list=[out])[0]
+        np.testing.assert_array_equal(o_short, o_again)
