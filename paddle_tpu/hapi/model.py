@@ -67,15 +67,28 @@ class Model:
             return data
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
 
-    @staticmethod
-    def _split_batch(batch, n_labels=1):
-        if isinstance(batch, (list, tuple)):
-            items = list(batch)
-            inputs = items[:-n_labels] if len(items) > n_labels else \
-                items[:1]
-            labels = items[len(inputs):]
-            return tuple(inputs), tuple(labels)
-        return (batch,), ()
+    def _split_batch(self, batch):
+        """Split a loader batch into (inputs, labels) honoring the
+        Model's inputs=/labels= specs (reference hapi contract).
+        Declared INPUT count is the primary rule — it serves fit
+        (trailing items are labels), evaluate, and predict (a
+        label-free batch of exactly n_in items yields no labels) —
+        with the declared-labels count as fallback when only labels
+        are given, and the single-label heuristic last."""
+        if not isinstance(batch, (list, tuple)):
+            return (batch,), ()
+        items = list(batch)
+        if self._inputs is not None:
+            ins = self._inputs
+            n_in = len(ins) if isinstance(ins, (list, tuple)) else 1
+            return tuple(items[:n_in]), tuple(items[n_in:])
+        n_labels = 1
+        if self._labels is not None:
+            ls = self._labels
+            n_labels = len(ls) if isinstance(ls, (list, tuple)) else 1
+        inputs = items[:-n_labels] if len(items) > n_labels else \
+            items[:1]
+        return tuple(inputs), tuple(items[len(inputs):])
 
     def train_batch(self, inputs, labels=None):
         loss = self._train_step(tuple(inputs), tuple(labels or ()))
@@ -88,12 +101,22 @@ class Model:
             out = self.network(*inputs)
             metrics = []
             for m in self._metrics:
-                corr = m.compute(out, *labels)
+                # multi-output forwards unpack (reference hapi passes
+                # to_list(outputs) + to_list(labels) to compute)
+                if isinstance(out, (list, tuple)):
+                    corr = m.compute(*out, *labels)
+                else:
+                    corr = m.compute(out, *labels)
                 m.update(corr)
                 metrics.append(m.accumulate())
             loss = None
             if self._loss is not None and labels:
-                loss = float(self._loss(out, *labels).item())
+                # multi-output forwards unpack, matching the train
+                # path's apply_loss(*out, *labels) convention
+                if isinstance(out, (list, tuple)):
+                    loss = float(self._loss(*out, *labels).item())
+                else:
+                    loss = float(self._loss(out, *labels).item())
             return loss, metrics
         finally:
             self.network.train()

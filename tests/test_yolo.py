@@ -178,3 +178,49 @@ class TestYOLOExport:
         # epsilon of a threshold — assert with slack, not equality
         assert np.abs(out[1].astype(np.int64)
                       - ref_c.astype(np.int64)).max() <= 1
+
+
+class TestYOLOHapi:
+    """The detector rides hapi Model.fit end-to-end (the
+    PaddleDetection-entrypoint shape): multi-label batches
+    (img, gt_box, gt_label) split per the labels= specs."""
+
+    def test_fit_multi_label(self):
+        import paddle_tpu.hapi as hapi
+        from paddle_tpu.io import Dataset
+        from paddle_tpu.static import InputSpec
+
+        class SynthDet(Dataset):
+            def __init__(self, n=8):
+                self.n = n
+                rng = np.random.RandomState(0)
+                self.items = []
+                for _ in range(n):
+                    img = rng.randn(3, 64, 64).astype(np.float32) * 0.1
+                    box = np.zeros((2, 4), np.float32)
+                    box[0] = [0.5, 0.5, 0.4, 0.3]
+                    lbl = rng.randint(0, 4, (2,)).astype(np.int32)
+                    self.items.append((img, box, lbl))
+
+            def __len__(self):
+                return self.n
+
+            def __getitem__(self, i):
+                return self.items[i]
+
+        paddle.seed(3)
+        net = YOLOv3(num_classes=4, width=4)
+        model = hapi.Model(
+            net,
+            inputs=[InputSpec([None, 3, 64, 64], "float32", "img")],
+            labels=[InputSpec([None, 2, 4], "float32", "gt_box"),
+                    InputSpec([None, 2], "int32", "gt_label")])
+        opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                    parameters=net.parameters())
+        # hapi unpacks multi-output forwards: loss(*outputs, *labels)
+        model.prepare(optimizer=opt,
+                      loss=lambda p5, p4, p3, box, lbl: net.loss(
+                          (p5, p4, p3), box, lbl))
+        h1 = model.fit(SynthDet(), batch_size=4, epochs=3, verbose=0)
+        ev = model.evaluate(SynthDet(), batch_size=4, verbose=0)
+        assert np.isfinite(ev["loss"][0])
