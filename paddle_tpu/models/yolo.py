@@ -148,10 +148,14 @@ class YOLOv3(nn.Layer):
 
     # -- inference ------------------------------------------------------
     def predict(self, outputs, im_size, conf_thresh=0.05,
-                nms_threshold=0.45, keep_top_k=100):
+                nms_threshold=0.45, keep_top_k=100, nms_type="hard"):
         """Decode + multi-class NMS. im_size [N,2] int (h, w).
         Returns (dets [N, keep_top_k, 6] rows [label, score, x1,y1,x2,y2],
-        valid_counts [N]) — static shapes, padded rows label -1."""
+        valid_counts [N]) — static shapes, padded rows label -1.
+
+        nms_type: "hard" (multiclass_nms, while-loop suppression) or
+        "matrix" (matrix_nms — PP-YOLOv2's default; score decay by
+        max-IoU, pure matrix math, the MXU-friendly form)."""
         boxes, scores = [], []
         for out, mask, down in zip(outputs, self.anchor_masks,
                                    self.downsamples):
@@ -167,6 +171,14 @@ class YOLOv3(nn.Layer):
             scores.append(s)
         allb = concat(boxes, axis=1)
         alls = transpose(concat(scores, axis=1), [0, 2, 1])
+        if nms_type == "matrix":
+            return det.matrix_nms(
+                allb, alls, score_threshold=conf_thresh,
+                post_threshold=conf_thresh, keep_top_k=keep_top_k,
+                background_label=-1, normalized=False)
+        if nms_type != "hard":
+            raise ValueError(f"nms_type={nms_type!r}: must be 'hard' "
+                             "or 'matrix'")
         return det.multiclass_nms(
             allb, alls,
             score_threshold=conf_thresh, nms_threshold=nms_threshold,

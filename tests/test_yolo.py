@@ -224,3 +224,36 @@ class TestYOLOHapi:
         h1 = model.fit(SynthDet(), batch_size=4, epochs=3, verbose=0)
         ev = model.evaluate(SynthDet(), batch_size=4, verbose=0)
         assert np.isfinite(ev["loss"][0])
+
+
+class TestYOLOMatrixNMS:
+    def test_matrix_nms_predict(self, tiny):
+        # PP-YOLOv2's serving NMS: same static output contract, and the
+        # top surviving boxes should substantially overlap hard-NMS
+        x, _, _ = _batch(size=64)
+        outs = tiny(x)
+        im = paddle.to_tensor(np.array([[64, 64]] * 2, np.int32))
+        hard, _ = tiny.predict(outs, im, conf_thresh=0.1,
+                               keep_top_k=12)
+        mat, mc = tiny.predict(outs, im, conf_thresh=0.1,
+                               keep_top_k=12, nms_type="matrix")
+        hard, mat = np.asarray(hard._data), np.asarray(mat._data)
+        assert mat.shape == (2, 12, 6)
+        assert (np.asarray(mc._data) >= 0).all()
+        valid = mat[mat[..., 0] >= 0]
+        assert len(valid)
+        assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
+        # the top surviving matrix-NMS box must closely overlap SOME
+        # hard-NMS box of the same image (decay keeps the argmax box)
+        for i in range(2):
+            mrow = mat[i, 0]
+            hrows = hard[i][hard[i, :, 0] >= 0]
+            def iou(a, b):
+                x1 = max(a[2], b[2]); y1 = max(a[3], b[3])
+                x2 = min(a[4], b[4]); y2 = min(a[5], b[5])
+                inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+                ar = lambda r: max(0.0, r[4] - r[2]) * max(0.0, r[5] - r[3])
+                return inter / max(ar(a) + ar(b) - inter, 1e-9)
+            assert any(iou(mrow, h) > 0.8 for h in hrows)
+        with pytest.raises(ValueError, match="nms_type"):
+            tiny.predict(outs, im, nms_type="soft")
