@@ -83,9 +83,14 @@ def _gpt_params(model):
 
 
 def _attend(q, kc, vc, n_valid, scale):
-    """q [B,N,1,hd] over cache kc/vc [B,N,T,hd], masked to n_valid."""
+    """q [B,N,1,hd] over cache kc/vc [B,N,T,hd], masked to n_valid
+    (scalar, or [B] for ragged per-row prompt lengths)."""
     s = jnp.einsum("bnqh,bnkh->bnqk", q, kc) * scale
-    mask = jnp.arange(kc.shape[2])[None, None, None, :] < n_valid
+    pos = jnp.arange(kc.shape[2])
+    if getattr(n_valid, "ndim", 0):
+        mask = pos[None, None, None, :] < n_valid[:, None, None, None]
+    else:
+        mask = pos[None, None, None, :] < n_valid
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bnkh->bnqh", p, vc)
@@ -95,11 +100,13 @@ def _step_hidden(params, eps, n_heads, x, caches, pos):
     """One token's hidden state through all blocks, updating caches.
 
     x: [B, 1, H]; caches: list of (k [B,N,T,hd], v [B,N,T,hd]);
-    pos: scalar index where this token's K/V land (attention covers
-    cache[:pos+1])."""
+    pos: index where this token's K/V land — a scalar (uniform
+    prompts) or [B] (ragged prompts: each row writes at its own next
+    position and attends over its own valid prefix)."""
     new_caches = []
     hd = x.shape[-1] // n_heads
     scale = 1.0 / math.sqrt(hd)
+    ragged = bool(getattr(pos, "ndim", 0))
     for bp, (kc, vc) in zip(params["blocks"], caches):
         b = x.shape[0]
         xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
@@ -108,8 +115,16 @@ def _step_hidden(params, eps, n_heads, x, caches, pos):
         q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])
         k = jnp.einsum("bsnh->bnsh", qkv[:, :, 1])
         v = jnp.einsum("bsnh->bnsh", qkv[:, :, 2])
-        kc = jax.lax.dynamic_update_slice_in_dim(kc, k, pos, axis=2)
-        vc = jax.lax.dynamic_update_slice_in_dim(vc, v, pos, axis=2)
+        if ragged:
+            # per-row scatter: row i writes its K/V at pos[i]
+            bi = jnp.arange(b)
+            kc = kc.at[bi, :, pos].set(k[:, :, 0])
+            vc = vc.at[bi, :, pos].set(v[:, :, 0])
+        else:
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, k, pos,
+                                                     axis=2)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, v, pos,
+                                                     axis=2)
         ctx = _attend(q, kc, vc, pos + 1, scale)
         ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
         x = x + ctx @ bp["proj_w"] + bp["proj_b"]
@@ -121,14 +136,24 @@ def _step_hidden(params, eps, n_heads, x, caches, pos):
     return x, new_caches
 
 
-def _prefill(params, eps, n_heads, ids, total_len):
+def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
     """Full forward over the prompt, returning per-layer caches sized to
     total_len and the last hidden state. Uses the same big-matmul form
-    as training (the MXU-efficient path) — only decode is token-wise."""
+    as training (the MXU-efficient path) — only decode is token-wise.
+
+    prompt_lens [B] (ragged, right-padded prompts): keys beyond each
+    row's true length are masked; their junk cache slots are
+    progressively OVERWRITTEN by the decode loop's per-row scatter, so
+    they are never attended to."""
     b, s = ids.shape
     hd = params["wte"].shape[1] // n_heads
     scale = 1.0 / math.sqrt(hd)
     x = params["wte"][ids] + params["wpe"][jnp.arange(s)][None]
+    cm = jnp.tril(jnp.ones((s, s), bool))
+    if prompt_lens is not None:
+        cm = (cm[None, None]
+              & (jnp.arange(s)[None, :]
+                 < prompt_lens[:, None])[:, None, None, :])
     caches = []
     for bp in params["blocks"]:
         xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
@@ -138,7 +163,6 @@ def _prefill(params, eps, n_heads, ids, total_len):
         k = jnp.einsum("bsnh->bnsh", qkv[:, :, 1])
         v = jnp.einsum("bsnh->bnsh", qkv[:, :, 2])
         att = jnp.einsum("bnqh,bnkh->bnqk", q, k) * scale
-        cm = jnp.tril(jnp.ones((s, s), bool))
         att = jnp.where(cm, att, -1e30)
         p = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(
             x.dtype)
@@ -185,16 +209,31 @@ def _cast_params(params, dtype):
 
 @functools.lru_cache(maxsize=64)
 def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
-               pad_token_id, max_new_tokens, prompt, total, dtype):
+               pad_token_id, max_new_tokens, prompt, total, dtype,
+               ragged=False):
     """One jitted decode program per static signature — repeated
     generate() calls with the same shapes/sampling config reuse the
-    compiled executable (params/ids/key are traced arguments)."""
+    compiled executable (params/ids/key[/prompt_lens] are traced
+    arguments). ragged=True compiles the per-row-position form: each
+    batch row prefills over its own prompt_lens[i]-long prefix, then
+    decodes writing K/V at its own next position."""
 
-    def run(params, ids, key):
+    def run(params, ids, key, prompt_lens=None):
         params = _cast_params(params, dtype)
         b = ids.shape[0]
-        x, caches = _prefill(params, eps, n_heads, ids, total)
-        h_last = _ln(x[:, -1:], params["lnf_w"], params["lnf_b"], eps)
+        pl = prompt_lens if ragged else None
+        x, caches = _prefill(params, eps, n_heads, ids, total,
+                             prompt_lens=pl)
+        if ragged:
+            idx = (prompt_lens - 1).astype(jnp.int32)
+            last = jnp.take_along_axis(
+                x, idx[:, None, None], axis=1)          # [B, 1, H]
+            h_last = _ln(last, params["lnf_w"], params["lnf_b"], eps)
+            pos0 = prompt_lens.astype(jnp.int32)
+        else:
+            h_last = _ln(x[:, -1:], params["lnf_w"], params["lnf_b"],
+                         eps)
+            pos0 = jnp.int32(prompt)
         logits = (h_last[:, 0] @ params["wte"].T)
 
         def body(carry, step_key):
@@ -203,8 +242,9 @@ def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
             if eos_token_id is not None:
                 tok = jnp.where(done, pad_token_id, tok)
                 done = done | (tok == eos_token_id)
-            x = (params["wte"][tok]
-                 + params["wpe"][pos][None])[:, None, :]
+            emb_pos = (params["wpe"][pos] if ragged
+                       else params["wpe"][pos][None])
+            x = (params["wte"][tok] + emb_pos)[:, None, :]
             x, caches = _step_hidden(params, eps, n_heads, x, caches,
                                      pos)
             h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
@@ -214,7 +254,7 @@ def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
         keys = jax.random.split(key, max_new_tokens)
         done0 = jnp.zeros((b,), bool)
         (_, _, _, _), toks = jax.lax.scan(
-            body, (caches, logits, jnp.int32(prompt), done0), keys)
+            body, (caches, logits, pos0, done0), keys)
         return jnp.concatenate([ids, toks.T], axis=1)
 
     return jax.jit(run)
@@ -295,9 +335,18 @@ def _build_beam_run(eps, n_heads, num_beams, eos_token_id, pad_token_id,
 def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k: Optional[int] = None,
                  eos_token_id: Optional[int] = None, pad_token_id=0,
-                 num_beams=1, seed=0, dtype=None):
+                 num_beams=1, seed=0, dtype=None, prompt_lens=None):
     """KV-cache decode for GPTForCausalLM. temperature=0 -> greedy;
     num_beams>1 -> beam search (temperature/top_k ignored).
+
+    prompt_lens [B] int (ragged batching — the reference's LoD-driven
+    dynamic_decode capability, TPU-style): input_ids is right-padded
+    to a common length with any valid token id (pad_token_id by
+    convention); row i's true prompt is its first prompt_lens[i] ids.
+    Each row prefill-masks its padding, then decode writes K/V at its
+    OWN next position, so rows of different lengths batch in one
+    compiled program. Generated tokens still land in out[:, P:] for
+    every row (out[i, prompt_lens[i]:P] keeps the pad filler).
 
     dtype="bfloat16" casts the float params (and with them the KV
     cache) for the decode — single-token decode is HBM-bound on
@@ -320,6 +369,9 @@ def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
             f"prompt+max_new_tokens={total} exceeds max_seq_len="
             f"{cfg.max_seq_len}")
     if num_beams > 1:
+        if prompt_lens is not None:
+            raise ValueError("prompt_lens is not supported with beam "
+                             "search yet — pad to a common length")
         run = _build_beam_run(
             float(cfg.layer_norm_eps), int(cfg.num_heads),
             int(num_beams),
@@ -328,10 +380,32 @@ def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
             dtype)
         out, _scores = run(params, ids, jax.random.key(seed))
         return Tensor(out)
+    ragged = prompt_lens is not None
+    if ragged:
+        import numpy as _np
+        pl_host = _np.asarray(prompt_lens._data
+                              if isinstance(prompt_lens, Tensor)
+                              else prompt_lens)
+        # fail loudly host-side: under jit, out-of-range lengths clamp
+        # silently and the decode attends junk cache slots
+        if pl_host.shape != (b,):
+            raise ValueError(
+                f"prompt_lens shape {pl_host.shape} != ({b},)")
+        if pl_host.min() < 1 or pl_host.max() > prompt:
+            raise ValueError(
+                f"prompt_lens must be in [1, {prompt}] (padded prompt "
+                f"width); got min={pl_host.min()} max={pl_host.max()}")
     run = _build_run(
         float(cfg.layer_norm_eps), int(cfg.num_heads),
         float(temperature), None if top_k is None else int(top_k),
         None if eos_token_id is None else int(eos_token_id),
-        int(pad_token_id), int(max_new_tokens), prompt, total, dtype)
-    out = run(params, ids, jax.random.key(seed))
+        int(pad_token_id), int(max_new_tokens), prompt, total, dtype,
+        ragged)
+    if ragged:
+        pl = jnp.asarray(prompt_lens._data
+                         if isinstance(prompt_lens, Tensor)
+                         else prompt_lens, jnp.int32)
+        out = run(params, ids, jax.random.key(seed), pl)
+    else:
+        out = run(params, ids, jax.random.key(seed))
     return Tensor(out)

@@ -133,15 +133,17 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k=None, eos_token_id=None, pad_token_id=0,
-                 num_beams=1, seed=0, dtype=None):
+                 num_beams=1, seed=0, dtype=None, prompt_lens=None):
         """KV-cache autoregressive decode compiled as one XLA program
         (models/generation.py); temperature=0 is greedy, num_beams>1
         is beam search over the same cache machinery. dtype="bfloat16"
         serves in bf16 (≈2× decode throughput on TPU; sampling and
-        layernorm stay f32)."""
+        layernorm stay f32). prompt_lens [B] batches ragged
+        (right-padded) prompts in one program."""
         from .generation import generate_gpt
         return generate_gpt(self, input_ids, max_new_tokens=max_new_tokens,
                             temperature=temperature, top_k=top_k,
                             eos_token_id=eos_token_id,
                             pad_token_id=pad_token_id,
-                            num_beams=num_beams, seed=seed, dtype=dtype)
+                            num_beams=num_beams, seed=seed, dtype=dtype,
+                            prompt_lens=prompt_lens)
