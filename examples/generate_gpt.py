@@ -49,6 +49,25 @@ def main():
     for r, row in enumerate(arr):
         print(f"[{r}] prompt={[int(t) for t in row[:8]]} -> {[int(t) for t in row[8:]]}")
 
+    if args.beams == 1:
+        # serving-shaped call: ragged (right-padded) prompts of three
+        # different lengths, bf16 weights/cache, one compiled program
+        lens = np.asarray([8, 5, 2], np.int32)
+        ragged = np.zeros((3, 8), np.int32)
+        for i, L in enumerate(lens):
+            ragged[i, :L] = rng.randint(0, 512, L)
+        out = model.generate(paddle.to_tensor(ragged),
+                             max_new_tokens=args.tokens,
+                             temperature=args.temperature,
+                             top_k=args.top_k, dtype="bfloat16",
+                             prompt_lens=paddle.to_tensor(lens))
+        arr = np.asarray(out.numpy())
+        print("ragged + bf16 serving:")
+        for r, row in enumerate(arr):
+            L = int(lens[r])
+            print(f"[{r}] len={L} prompt={[int(t) for t in row[:L]]}"
+                  f" -> {[int(t) for t in row[8:]]}")
+
 
 if __name__ == "__main__":
     main()
