@@ -52,8 +52,9 @@ def main():
     if args.beams == 1:
         # serving-shaped call: ragged (right-padded) prompts of three
         # different lengths, bf16 weights/cache, one compiled program
-        lens = np.asarray([8, 5, 2], np.int32)
-        ragged = np.zeros((3, 8), np.int32)
+        P = 8
+        lens = np.asarray([P, 5, 2], np.int32)
+        ragged = np.zeros((3, P), np.int32)
         for i, L in enumerate(lens):
             ragged[i, :L] = rng.randint(0, 512, L)
         out = model.generate(paddle.to_tensor(ragged),
@@ -66,7 +67,7 @@ def main():
         for r, row in enumerate(arr):
             L = int(lens[r])
             print(f"[{r}] len={L} prompt={[int(t) for t in row[:L]]}"
-                  f" -> {[int(t) for t in row[8:]]}")
+                  f" -> {[int(t) for t in row[P:]]}")
 
 
 if __name__ == "__main__":
