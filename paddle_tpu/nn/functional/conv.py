@@ -51,7 +51,7 @@ def _dim_numbers(n, channel_last):
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n,
-          channel_last):
+          channel_last, preferred_element_type=None):
     dn = jax.lax.conv_dimension_numbers(
         x.shape, weight.shape, _dim_numbers(n, channel_last))
     out = jax.lax.conv_general_dilated(
@@ -61,6 +61,9 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
         rhs_dilation=_norm_tuple(dilation, n),
         dimension_numbers=dn,
         feature_group_count=groups,
+        # int8 quantized inference accumulates exactly in int32 (the
+        # MXU double-rate path); float convs leave this None
+        preferred_element_type=preferred_element_type,
     )
     if bias is not None:
         bshape = [1] * out.ndim
@@ -78,9 +81,14 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 @register_op("conv2d")
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW", name=None):
+           data_format="NCHW", name=None, preferred_element_type=None):
+    # preferred_element_type ("int32" for int8 quantized inference)
+    # rides as a STRING attr so captured programs stay serializable
+    pet = (None if preferred_element_type is None
+           else jnp.dtype(preferred_element_type))
     return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
-                 channel_last=data_format == "NHWC")
+                 channel_last=data_format == "NHWC",
+                 preferred_element_type=pet)
 
 
 @register_op("conv3d")

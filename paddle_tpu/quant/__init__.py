@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -63,7 +64,8 @@ class QuantConfig:
     def __init__(self, weight_bits=8, activation_bits=8,
                  moving_rate=0.9,
                  weight_quantize_type="channel_wise_abs_max",
-                 activation_quantize_type="moving_average_abs_max"):
+                 activation_quantize_type="moving_average_abs_max",
+                 int8_compute=False):
         assert weight_quantize_type in ("channel_wise_abs_max",
                                         "abs_max")
         # "none" = weight-only quantization (the LLM-serving form):
@@ -76,6 +78,14 @@ class QuantConfig:
         self.moving_rate = float(moving_rate)
         self.weight_quantize_type = weight_quantize_type
         self.activation_quantize_type = activation_quantize_type
+        # int8_compute=True makes frozen layers EXECUTE the matmul/conv
+        # in int8 (int8×int8→int32, the MXU's double-rate path; v5e:
+        # 394 int8 TOPS vs 197 bf16 TFLOPS) instead of the float
+        # simulation (dequantized weights, fake-quantized activations).
+        # Needs 8-bit weights+activations and a calibrated act scale;
+        # numerics differ from the simulation only by accumulation
+        # order (int32 exact vs f32).
+        self.int8_compute = bool(int8_compute)
 
 
 class _QuantedBase(nn.Layer):
@@ -204,23 +214,42 @@ class _FrozenBase(nn.Layer):
         self._channel_axis = channel_axis
         self._wbits = bits
 
+    def _weight_dequant_factor(self):
+        """Per-channel (or scalar) weight dequant factor sw/qmax."""
+        return self.weight_scales._data / _qmax(self._wbits)
+
     def _dequant_weight(self):
-        s = self.weight_scales._data
-        if s.ndim == 1:  # per-channel
+        f = self._weight_dequant_factor()
+        if getattr(f, "ndim", 0):  # per-channel
             shape = [1] * self.weight_int8.ndim
             shape[self._channel_axis] = -1
-            s = s.reshape(shape)
-        return Tensor(self.weight_int8._data.astype(jnp.float32) * s
-                      / _qmax(self._wbits))
+            f = f.reshape(shape)
+        return Tensor(self.weight_int8._data.astype(jnp.float32) * f)
+
+    def _act_codes(self, x, bits):
+        """x -> (float integer codes, dequant factor s/qmax) — ONE
+        source of truth for the activation rounding, shared by the
+        float simulation and the int8 execution paths."""
+        arr = x._data if isinstance(x, Tensor) else x
+        s = max(float(self._act_scale), 1e-8)
+        q = _qmax(bits)
+        return jnp.round(jnp.clip(arr / s, -1.0, 1.0) * q), s / q
 
     def _quant_act_frozen(self, x, bits):
         if self._act_scale is None:  # weight-only mode
             return x
-        s = max(float(self._act_scale), 1e-8)
-        q = _qmax(bits)
-        arr = x._data if isinstance(x, Tensor) else x
-        return Tensor(jnp.round(jnp.clip(arr / s, -1.0, 1.0) * q)
-                      * s / q)
+        codes, factor = self._act_codes(x, bits)
+        return Tensor(codes * factor)
+
+    # -- true int8 execution (cfg.int8_compute) -------------------------
+    def _int8_ready(self):
+        return (self._int8_exec and self._act_scale is not None
+                and self._abits == 8 and self._wbits == 8)
+
+    def _quant_act_int8(self, x):
+        """x -> (int8 codes, dequant factor s/qmax)."""
+        codes, factor = self._act_codes(x, self._abits)
+        return codes.astype(jnp.int8), factor
 
 
 class FrozenQuantLinear(_FrozenBase):
@@ -232,8 +261,22 @@ class FrozenQuantLinear(_FrozenBase):
         self.bias = src.bias
         self._act_scale = None if act_scale is None else float(act_scale)
         self._abits = cfg.activation_bits
+        self._int8_exec = bool(getattr(cfg, "int8_compute", False))
 
     def forward(self, x):
+        if self._int8_ready():
+            # true int8 execution: int8×int8→int32 dot (MXU
+            # double-rate), one float rescale per output channel
+            codes, sx = self._quant_act_int8(x)
+            wq = self.weight_int8._data                  # [in, out]
+            acc = jax.lax.dot_general(
+                codes, wq, (((codes.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = (acc.astype(jnp.float32) * sx
+                 * self._weight_dequant_factor())        # [out] bcast
+            if self.bias is not None:
+                y = y + self.bias._data
+            return Tensor(y)
         xq = self._quant_act_frozen(x, self._abits)
         return F.linear(xq, self._dequant_weight(), self.bias)
 
@@ -247,6 +290,7 @@ class FrozenQuantConv2D(_FrozenBase):
         self.bias = src.bias
         self._act_scale = None if act_scale is None else float(act_scale)
         self._abits = cfg.activation_bits
+        self._int8_exec = bool(getattr(cfg, "int8_compute", False))
         def attr(quanted_name, conv_name):
             # src is a QuantedConv2D (post-QAT) or a raw Conv2D; 0 is a
             # legitimate value (padding=0), so no falsy-or chains
@@ -260,6 +304,29 @@ class FrozenQuantConv2D(_FrozenBase):
         self._data_format = attr("_data_format", "data_format") or "NCHW"
 
     def forward(self, x):
+        if self._int8_ready():
+            # int8 conv on the MXU: int8×int8→int32 accumulation, one
+            # per-out-channel float rescale (+bias) after — through the
+            # public conv2d functional (the registered op)
+            codes, sx = self._quant_act_int8(x)
+            channel_last = self._data_format == "NHWC"
+            acc = F.conv2d(Tensor(codes), self.weight_int8, None,
+                           self._stride, self._padding, self._dilation,
+                           self._groups, self._data_format,
+                           preferred_element_type="int32")
+            acc = acc._data if isinstance(acc, Tensor) else acc
+            sw = self._weight_dequant_factor()
+            ch_axis = acc.ndim - 1 if channel_last else 1
+            if getattr(sw, "ndim", 0):
+                shape = [1] * acc.ndim
+                shape[ch_axis] = -1
+                sw = sw.reshape(shape)
+            y = acc.astype(jnp.float32) * sx * sw
+            if self.bias is not None:
+                bshape = [1] * y.ndim
+                bshape[ch_axis] = -1
+                y = y + jnp.reshape(self.bias._data, bshape)
+            return Tensor(y)
         xq = self._quant_act_frozen(x, self._abits)
         return F.conv2d(xq, self._dequant_weight(), self.bias,
                         self._stride, self._padding, self._dilation,
@@ -340,8 +407,13 @@ def convert(model, config: Optional[QuantConfig] = None):
     """Freeze a QAT model to the int8 inference form (weights stored
     int8 + per-channel scales; activation scales frozen from the EMA
     observers). Returns the model with Quanted* sublayers swapped for
-    Frozen* IN PLACE."""
-    cfg = config or QuantConfig()
+    Frozen* IN PLACE.
+
+    Freezing honors each sublayer's QAT-time cfg for the QUANTIZATION
+    shape (bits, per-channel-ness — those were trained in), but a
+    config passed HERE decides the execution form: int8_compute=True
+    at freeze time turns on true int8 execution even if QAT ran with
+    the default config."""
 
     def factory(sub):
         if sub.cfg.activation_quantize_type == "none":
@@ -352,9 +424,19 @@ def convert(model, config: Optional[QuantConfig] = None):
                 raise ValueError(
                     "convert(): activation observer never ran — train "
                     "(QAT) or calibrate (PTQ) before converting")
+        cfg = sub.cfg
+        if config is not None and config.int8_compute \
+                and not cfg.int8_compute:
+            cfg = QuantConfig(
+                weight_bits=cfg.weight_bits,
+                activation_bits=cfg.activation_bits,
+                moving_rate=cfg.moving_rate,
+                weight_quantize_type=cfg.weight_quantize_type,
+                activation_quantize_type=cfg.activation_quantize_type,
+                int8_compute=True)
         if isinstance(sub, QuantedConv2D):
-            return FrozenQuantConv2D(sub, scale, sub.cfg)
-        return FrozenQuantLinear(sub, scale, sub.cfg)
+            return FrozenQuantConv2D(sub, scale, cfg)
+        return FrozenQuantLinear(sub, scale, cfg)
     n = _swap_sublayers(model, factory, (QuantedLinear, QuantedConv2D))
     if n == 0:
         raise ValueError("convert() found no Quanted* sublayers; call "

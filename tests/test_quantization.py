@@ -285,3 +285,96 @@ def test_weight_only_model_exports_through_predictor(tmp_path):
     p.run()
     out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestInt8Execution:
+    """cfg.int8_compute: frozen layers EXECUTE in int8 (int8×int8→int32
+    dot/conv + one float rescale) — the MXU double-rate path — and must
+    match the float simulation to accumulation-order tolerance."""
+
+    def _cfg(self, **kw):
+        from paddle_tpu.quant import QuantConfig
+        return QuantConfig(activation_quantize_type="abs_max",
+                           int8_compute=True, **kw)
+
+    def test_linear_matches_float_sim(self):
+        from paddle_tpu.quant import FrozenQuantLinear, QuantConfig
+        paddle.seed(0)
+        lin = paddle.nn.Linear(24, 16)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(5, 24).astype(np.float32))
+        scale = float(np.abs(x.numpy()).max())
+        f_sim = FrozenQuantLinear(
+            lin, scale, QuantConfig(activation_quantize_type="abs_max"))
+        f_int8 = FrozenQuantLinear(lin, scale, self._cfg())
+        a = np.asarray(f_sim(x)._data)
+        b = np.asarray(f_int8(x)._data)
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=2e-4)
+
+    def test_linear_int8_hlo_receipt(self):
+        # the claim is EXECUTION in int8: the lowered program must
+        # contain a dot with s8 operands and s32 accumulation
+        import re
+        import jax
+        from paddle_tpu.quant import FrozenQuantLinear
+        paddle.seed(1)
+        lin = paddle.nn.Linear(32, 8)
+        f = FrozenQuantLinear(lin, 1.0, self._cfg())
+        import jax.numpy as jnp
+
+        def run(x):
+            return f(paddle.Tensor(x))._data
+
+        text = jax.jit(run).lower(
+            jnp.zeros((4, 32), jnp.float32)).as_text()
+        assert re.search(r"dot_general.*tensor<[0-9x]*i8>", text), \
+            "no int8-operand dot in lowered program"
+        assert "i32" in text
+
+    def test_conv_matches_float_sim(self):
+        from paddle_tpu.quant import FrozenQuantConv2D, QuantConfig
+        paddle.seed(2)
+        conv = paddle.nn.Conv2D(3, 8, 3, padding=1)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 12, 12).astype(
+                np.float32))
+        scale = float(np.abs(x.numpy()).max())
+        f_sim = FrozenQuantConv2D(
+            conv, scale,
+            QuantConfig(activation_quantize_type="abs_max"))
+        f_int8 = FrozenQuantConv2D(conv, scale, self._cfg())
+        a = np.asarray(f_sim(x)._data)
+        b = np.asarray(f_int8(x)._data)
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=3e-4)
+
+    def test_weight_only_mode_ignores_flag(self):
+        # no act scale -> int8 execution impossible; float fallback
+        from paddle_tpu.quant import FrozenQuantLinear
+        paddle.seed(3)
+        lin = paddle.nn.Linear(8, 4)
+        f = FrozenQuantLinear(lin, None, self._cfg())
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 8).astype(np.float32))
+        out = np.asarray(f(x)._data)
+        assert np.isfinite(out).all()
+
+    def test_convert_override_enables_int8(self):
+        # QAT with the default cfg, int8 execution decided at FREEZE
+        # time via convert(model, QuantConfig(int8_compute=True))
+        from paddle_tpu.quant import (quant_aware, convert, QuantConfig,
+                                      FrozenQuantLinear)
+        paddle.seed(4)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 4))
+        quant_aware(net)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(4, 8).astype(np.float32))
+        net.train()
+        net(x)  # observers move
+        convert(net, QuantConfig(int8_compute=True))
+        frozen = [m for m in net.sublayers()
+                  if isinstance(m, FrozenQuantLinear)]
+        assert frozen and all(f._int8_ready() for f in frozen)
+        out = np.asarray(net(x)._data)
+        assert np.isfinite(out).all()
