@@ -81,3 +81,21 @@ train(wo)
 weight_only_quantize(wo)
 print("weight-only int8 sublayers:",
       sum(hasattr(s, "weight_int8") for s in wo.sublayers()))
+
+# 4) TRUE int8 execution: same PTQ flow but the frozen layers run
+# int8 x int8 -> int32 on the MXU (double-rate path) with one float
+# rescale — not a float simulation
+from paddle_tpu.quant import QuantConfig  # noqa: E402
+
+paddle.seed(3)
+fp32b = LeNet(num_classes=10)
+train(fp32b)
+fp32b.eval()
+# quantize() converts the model IN PLACE — take the fp32 reference first
+ref = np.asarray(fp32b(paddle.to_tensor(X[:32]))._data).argmax(-1)
+q8 = PostTrainingQuantization(
+    fp32b, (paddle.to_tensor(X[i * 16:(i + 1) * 16]) for i in range(4)),
+    batch_nums=4, config=QuantConfig(int8_compute=True)).quantize()
+got = np.asarray(q8(paddle.to_tensor(X[:32]))._data).argmax(-1)
+print(f"int8-EXECUTING model argmax agreement vs fp32: "
+      f"{(ref == got).mean():.2f}")
