@@ -300,6 +300,21 @@ def main():
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache"))
+    # PD_BENCH_ONLY: comma list of SECONDARY legs to keep (resnet,
+    # dynamic, eager, decode, pipeline) — the primary ERNIE metric
+    # always runs ("ernie" in the list is accepted, always-on). Sweep
+    # entries that vary only one model's config would otherwise burn
+    # scarce TPU-window minutes re-measuring identical numbers.
+    # Validated HERE, before any bench leg spends window time.
+    only = {s.strip() for s in os.environ.get("PD_BENCH_ONLY", "")
+            .lower().split(",") if s.strip()}
+    unknown = only - {"ernie", "resnet", "dynamic", "eager", "decode",
+                      "pipeline"}
+    if unknown:
+        raise ValueError(
+            f"PD_BENCH_ONLY: unknown legs {sorted(unknown)}")
+    leg = lambda name: not only or name in only
+
     on_tpu, probe_info = _probe_tpu()
     if not on_tpu:
         if probe_info != "cpu":
@@ -323,29 +338,26 @@ def main():
         errors["ernie"] = f"{type(e).__name__}: {e}"
     # secondary benches never sink the primary metric; failures are
     # reported in extras["errors"]
-    # PD_BENCH_ONLY=ernie skips the secondary legs — sweep entries that
-    # vary only the ERNIE config (flash blocks, scan_layers, model
-    # size) would otherwise burn scarce TPU-window minutes re-measuring
-    # identical ResNet/decode/pipeline numbers
-    only_ernie = (os.environ.get("PD_BENCH_ONLY", "").strip().lower()
-                  == "ernie")
     images_per_sec = -1.0
     dyn_ips, compiles, n_buckets = -1.0, -1, -1
     add_us = mm_us = -1.0
-    decode_tps, decode_dtype = -1.0, "skipped" if only_ernie else "?"
-    if not only_ernie:
+    decode_tps, decode_dtype = -1.0, "?" if leg("decode") else "skipped"
+    if leg("resnet"):
         try:
             images_per_sec = bench_resnet(on_tpu)
         except Exception as e:  # pragma: no cover
             errors["resnet"] = f"{type(e).__name__}: {e}"
+    if leg("dynamic"):
         try:
             dyn_ips, compiles, n_buckets = bench_dynamic_shapes(on_tpu)
         except Exception as e:  # pragma: no cover
             errors["dynamic_shapes"] = f"{type(e).__name__}: {e}"
+    if leg("eager"):
         try:
             add_us, mm_us = bench_eager_dispatch()
         except Exception as e:  # pragma: no cover
             errors["eager_dispatch"] = f"{type(e).__name__}: {e}"
+    if leg("decode"):
         try:
             decode_tps, decode_dtype = bench_generate(on_tpu)
         except Exception as e:  # pragma: no cover
@@ -355,7 +367,7 @@ def main():
     # virtual CPU mesh, which this process may not be able to provide
     # once a TPU backend is initialized)
     pipeline_stats = None
-    if not only_ernie:
+    if leg("pipeline"):
         try:
             import subprocess
             here = os.path.dirname(os.path.abspath(__file__))

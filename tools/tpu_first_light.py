@@ -246,12 +246,18 @@ def main():
         # only matters once the kernel path is live; scan_layers is a
         # layout A/B
         for tag, envd in (
+                # batch/amp sweeps affect only the two model benches —
+                # skip the dynamic/eager/decode/pipeline legs they
+                # cannot change (each would burn ~5 min of window)
                 ("batch96", {"PD_BENCH_ERNIE_BATCH": "96",
-                             "PD_BENCH_RESNET_BATCH": "256"}),
-                ("ampO2", {"PD_BENCH_AMP": "O2"}),
+                             "PD_BENCH_RESNET_BATCH": "256",
+                             "PD_BENCH_ONLY": "ernie,resnet"}),
+                ("ampO2", {"PD_BENCH_AMP": "O2",
+                           "PD_BENCH_ONLY": "ernie,resnet"}),
                 ("batch96+ampO2", {"PD_BENCH_ERNIE_BATCH": "96",
                                    "PD_BENCH_RESNET_BATCH": "256",
-                                   "PD_BENCH_AMP": "O2"}),
+                                   "PD_BENCH_AMP": "O2",
+                                   "PD_BENCH_ONLY": "ernie,resnet"}),
                 ("bq256", {"PD_FLASH_BQ": "256", "PD_FLASH_BK": "256",
                            "PD_BENCH_ONLY": "ernie"}),
                 ("scan_layers", {"PD_BENCH_SCAN_LAYERS": "1",
