@@ -394,9 +394,9 @@ class ErnieForPretraining(nn.Layer):
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, seq_lens=None):
         seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
-                                 attention_mask)
+                                 attention_mask, seq_lens=seq_lens)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
         # weight-tied decoder: logits = h @ E^T  (vocab-sharded matmul).
         # Done in 2D [b*s, hidden] — a 3D dot here gives the [b, s, V]
