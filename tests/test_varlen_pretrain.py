@@ -4,7 +4,6 @@ ignored — the full data story for BASELINE config 3 with real
 (ragged) corpora. Composes ErnieForPretraining(seq_lens=...),
 TrainStep+AMP, and ignore_index loss masking."""
 import numpy as np
-import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models import ErnieConfig, ErnieForPretraining
