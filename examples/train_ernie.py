@@ -75,6 +75,21 @@ def main():
     print(f"loss {loss0:.4f} -> {last:.4f} | "
           f"{dt / args.steps * 1e3:.1f} ms/step | {toks:,.0f} tokens/s")
 
+    # ragged corpora: right-padded batch + seq_lens rides the varlen
+    # flash path (blockwise key masking, no materialized s*s mask);
+    # padded label positions are ignore_index
+    lens = rng.randint(seqlen // 4, seqlen + 1,
+                       batch).astype(np.int32)
+    ids = np.zeros((batch, seqlen), np.int32)
+    lbl = np.full((batch, seqlen), -100, np.int32)
+    for i, L in enumerate(lens):
+        ids[i, :L] = rng.randint(0, cfg.vocab_size, L)
+        lbl[i, :L] = rng.randint(0, cfg.vocab_size, L)
+    vloss = step((paddle.to_tensor(ids), None, None, None,
+                  paddle.to_tensor(lens)), (paddle.to_tensor(lbl),))
+    print(f"varlen batch (mean len {lens.mean():.0f}/{seqlen}) "
+          f"loss {float(vloss.item()):.4f}")
+
 
 if __name__ == "__main__":
     main()
