@@ -323,6 +323,21 @@ def main():
         # plugin initialize would hang this process too
         from __graft_entry__ import _force_cpu_devices
         _force_cpu_devices(1)
+    elif not (os.environ.get("PD_KERNEL_DROPOUT") or "").strip():
+        # decide the kernel-dropout tier in a THROWAWAY process and pin
+        # it: the in-process probe compiles Mosaic kernels, and a hang
+        # there would take down this unattended run (first-light pins
+        # the same way; this covers the driver's direct `python
+        # bench.py`). Wedge-safe SIGTERM-grace semantics live in the
+        # one shared helper.
+        from paddle_tpu.core.tpu_probe import probe_kernel_dropout
+        verdict = probe_kernel_dropout()
+        os.environ["PD_KERNEL_DROPOUT"] = ("1" if verdict == "ok"
+                                           else "0")
+        if verdict != "ok":
+            # "fallback" = clean self-check refusal (expected on a
+            # Mosaic RNG regression); "error: ..." = crashed/hung probe
+            errors["kernel_dropout"] = verdict
     import jax
     try:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",

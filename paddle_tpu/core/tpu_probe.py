@@ -17,7 +17,7 @@ import os
 import subprocess
 import sys
 
-__all__ = ["probe_tpu", "ensure_tpu_or_cpu"]
+__all__ = ["probe_tpu", "ensure_tpu_or_cpu", "probe_kernel_dropout"]
 
 
 def probe_tpu(timeout_s: float = None):
@@ -50,6 +50,45 @@ def probe_tpu(timeout_s: float = None):
     if plat in ("tpu", "axon"):
         return True, plat
     return False, plat  # healthy non-TPU host: not an error
+
+
+def probe_kernel_dropout(timeout_s: float = 600.0):
+    """Run kernel_dropout_available() in a THROWAWAY subprocess with
+    the same SIGTERM-grace semantics as probe_tpu (a hard kill mid-
+    Mosaic-compile can wedge a merely-slow tunnel). The ONE shared
+    implementation for bench.py and tools/tpu_first_light.py.
+
+    -> "ok" | "fallback" | "error: <detail>" — callers pin
+    PD_KERNEL_DROPOUT to "1" only for "ok"."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from paddle_tpu.ops.pallas_kernels import "
+            "kernel_dropout_available; "
+            "print('KD_OK' if kernel_dropout_available() else 'KD_NO',"
+            " flush=True)" % repo)
+    env = dict(os.environ)
+    env.pop("PD_KERNEL_DROPOUT", None)  # a stale pin would
+    # short-circuit the probe and re-propagate itself
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return f"error: probe timed out after {timeout_s:.0f}s"
+    if "KD_OK" in (stdout or ""):
+        return "ok"
+    if "KD_NO" in (stdout or ""):
+        return "fallback"  # clean self-check refusal (e.g. MosaicError)
+    tail = (stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+    return f"error: rc={proc.returncode}: {tail[0][:160]}"
 
 
 def ensure_tpu_or_cpu(timeout_s: float = None, quiet: bool = False):

@@ -54,15 +54,6 @@ def run(name, cmd, timeout, env=None):
     return rc, out
 
 
-DROPOUT_PROBE_SNIPPET = r"""
-import sys
-sys.path.insert(0, %r)
-from paddle_tpu.ops.pallas_kernels import kernel_dropout_available
-print("KERNEL_DROPOUT_OK" if kernel_dropout_available()
-      else "KERNEL_DROPOUT_FALLBACK")
-""" % (REPO,)
-
-
 PROFILE_SNIPPET = r"""
 import sys, os
 sys.path.insert(0, %r)
@@ -159,19 +150,17 @@ def main():
         finish(capture, results)
         sys.exit(2)
 
-    # Decide the kernel-dropout path in a throwaway process, then pin
-    # it for the bench via PD_KERNEL_DROPOUT so the bench's in-process
-    # probe (which cannot be timed out) never runs on hardware.
-    probe_env = dict(os.environ)
-    probe_env.pop("PD_KERNEL_DROPOUT", None)  # a stale pin would
-    # short-circuit the probe and re-propagate itself to the bench
-    rc, out = run("dropout-probe", [py, "-c", DROPOUT_PROBE_SNIPPET],
-                  timeout=600, env=probe_env)
-    kd_ok = rc == 0 and "KERNEL_DROPOUT_OK" in (out or "")
+    # Decide the kernel-dropout path in a throwaway process (the ONE
+    # shared wedge-safe helper), then pin it for the bench via
+    # PD_KERNEL_DROPOUT so the bench's in-process probe (which cannot
+    # be timed out) never runs on hardware.
+    from paddle_tpu.core.tpu_probe import probe_kernel_dropout
+    print("== dropout-probe (core.tpu_probe)", flush=True)
+    verdict = probe_kernel_dropout()
+    kd_ok = verdict == "ok"
+    print(f"-- dropout-probe: {verdict}\n", flush=True)
     results["dropout_probe"] = 0 if kd_ok else 1
-    capture["kernel_dropout_probe"] = (
-        "ok" if kd_ok else
-        ("fallback" if rc == 0 else f"rc={rc} (hang/crash — pinned off)"))
+    capture["kernel_dropout_probe"] = verdict
     bench_env = dict(os.environ, PD_KERNEL_DROPOUT="1" if kd_ok else "0")
 
     rc, out = run("bench", [py, "bench.py"], timeout=2400, env=bench_env)
