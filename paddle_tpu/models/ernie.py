@@ -120,7 +120,6 @@ def _init_linear(layer, std, col_spec=None, row_spec=None):
     return layer
 
 
-@functools.lru_cache(maxsize=8)
 def _lens_to_additive_mask(kv_lens, s):
     """[b] right-padding lengths -> additive [b, 1, 1, s] mask (the
     SDPA fallback form; the flash path consumes kv_lens directly)."""
@@ -131,6 +130,7 @@ def _lens_to_additive_mask(kv_lens, s):
         am, [1, 2]).astype("float32")) * -1e9
 
 
+@functools.lru_cache(maxsize=8)
 def _ring_attention_fn(mesh, mode="ring"):
     """One shard_map'd ring-attention closure per mesh (Mesh is hashable
     — equal-but-distinct meshes share an entry, and lru eviction keeps

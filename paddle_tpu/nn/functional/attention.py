@@ -311,7 +311,7 @@ def _flash_attention_dropout_op(query, key, value, drop_key,
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, block_size=512, training=True,
-                    kv_lens=None, name=None):
+                    name=None, kv_lens=None):
     """paddle.nn.functional.flash_attention-compatible entry.
 
     Layout: [batch, seq, num_heads, head_dim]. Memory O(seq·block)

@@ -82,10 +82,14 @@ def kernel_dropout_available() -> bool:
     supervisor that already probed in a throwaway process can pin the
     decision and keep the main run hang-safe."""
     forced = (os.environ.get("PD_KERNEL_DROPOUT") or "").strip().lower()
-    if forced:
-        return forced not in ("0", "false", "no")
-    if not pallas_available():
+    if forced in ("0", "false", "no"):
         return False
+    if not pallas_available():
+        # a stale =1 pin must not route dropout into a kernel that
+        # cannot run here (e.g. the pin leaked onto a CPU-only host)
+        return False
+    if forced:
+        return True
     try:
         import numpy as np
         rng = np.random.RandomState(0)

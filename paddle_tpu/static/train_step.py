@@ -377,7 +377,23 @@ class TrainStep:
         for k, a in self.buffers.items():
             st[k]._data = a
 
+    def rebind_layer(self):
+        """Re-resolve the Tensor cache against the LIVE layer. The
+        per-step sync_to_layer uses a construction-time name->Tensor
+        cache (an O(tensors) state_dict() walk per step would be
+        hot-loop drag); if the layer's tensors are REPLACED after
+        construction (re-init, sublayer swap, quant convert()), that
+        cache feeds orphaned Tensor objects while the live layer keeps
+        donated/deleted arrays. Checkpoint flows call this; call it
+        yourself after any in-place layer surgery while a TrainStep is
+        bound."""
+        live = self.layer.state_dict()
+        for k, t in live.items():
+            if k in self._state_tensors:
+                self._state_tensors[k] = t
+
     def state_dict(self):
+        self.rebind_layer()
         self.sync_to_layer()
         return {"model": self.layer.state_dict(),
                 "opt_state": self.opt_state,
@@ -390,6 +406,7 @@ class TrainStep:
         rampup counters — into the step). Arrays are COPIED: the compiled
         step donates its state buffers each call, so sharing them with the
         checkpoint source would invalidate the source's state."""
+        self.rebind_layer()
         def copy_arr(v):
             a = v._data if isinstance(v, Tensor) else v
             return jnp.array(np.asarray(a))

@@ -14,8 +14,10 @@ Order: probe -> dropout-probe (subprocess) -> bench -> [gate] ->
 kernels (-v, so a hang names its test) -> [gate] -> profile ->
 [gate] -> sweeps (--sweep).
 
-Writes TPU_CAPTURE_r04.json whenever the bench ran on real TPU, and
-always appends one summary line to TPU_WINDOWS_r04.jsonl.
+Writes TPU_CAPTURE_{PD_ROUND}.json (default r05) whenever the bench ran
+on real TPU, always appends one summary line to
+TPU_WINDOWS_{PD_ROUND}.jsonl, and git-commits the receipt files so an
+unattended window lands its numbers.
 
 Usage:  python tools/tpu_first_light.py [--sweep] [--skip-tests]
 Exit 0 when the bench succeeded ON TPU; 2 otherwise.
@@ -28,6 +30,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND = os.environ.get("PD_ROUND", "r05")
 
 
 def run(name, cmd, timeout, env=None):
@@ -284,12 +287,40 @@ def main():
 
 def finish(capture, results):
     capture["results"] = results
-    with open(os.path.join(REPO, "TPU_WINDOWS_r04.jsonl"), "a") as f:
+    windows = f"TPU_WINDOWS_{ROUND}.jsonl"
+    cap_file = f"TPU_CAPTURE_{ROUND}.json"
+    with open(os.path.join(REPO, windows), "a") as f:
         f.write(json.dumps(capture) + "\n")
-    if capture.get("platform") in ("tpu", "axon"):
-        with open(os.path.join(REPO, "TPU_CAPTURE_r04.json"), "w") as f:
+    got_tpu = capture.get("platform") in ("tpu", "axon")
+    if got_tpu:
+        with open(os.path.join(REPO, cap_file), "w") as f:
             json.dump(capture, f, indent=1)
     print("summary:", json.dumps(results), flush=True)
+    # Idempotent receipt commit: a 3 a.m. window must land its numbers
+    # even with nobody at the keyboard. Only the receipt files are
+    # staged so an unattended run can't sweep up unrelated WIP.
+    try:
+        paths = [windows] + ([cap_file] if got_tpu else [])
+        paths += [p for p in (f"TPU_PROBES_{ROUND}.jsonl",) if
+                  os.path.exists(os.path.join(REPO, p))]
+        rc = subprocess.call(["git", "add", "--"] + paths, cwd=REPO)
+        if rc != 0:
+            print(f"!! receipt commit: git add rc={rc} — receipts NOT "
+                  "committed", flush=True)
+            return
+        msg = ("TPU window capture: bench on hardware"
+               if got_tpu else "TPU window attempt: no hardware bench")
+        b = capture.get("bench") or {}
+        if b.get("value"):
+            msg += (f" ({b.get('value'):.0f} tok/s, mfu {b.get('mfu')},"
+                    f" attn {b.get('attention_path')})")
+        rc = subprocess.call(["git", "commit", "-m", msg, "--", *paths],
+                             cwd=REPO)
+        if rc != 0:
+            print(f"!! receipt commit: git commit rc={rc} — receipts "
+                  "NOT committed (identity/lock issue?)", flush=True)
+    except Exception as e:  # never let the commit kill the capture
+        print(f"receipt commit failed: {e}", flush=True)
 
 
 if __name__ == "__main__":

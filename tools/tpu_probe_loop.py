@@ -26,7 +26,7 @@ def main() -> int:
     ap.add_argument("--max-hours", type=float, default=11.0)
     ap.add_argument("--log", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "TPU_PROBES_r04.jsonl"))
+        f"TPU_PROBES_{os.environ.get('PD_ROUND', 'r05')}.jsonl"))
     args = ap.parse_args()
 
     deadline = time.time() + args.max_hours * 3600

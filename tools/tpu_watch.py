@@ -2,10 +2,11 @@
 """Round-long TPU window supervisor.
 
 Runs the opportunistic-capture pattern end to end: probe the tunnel
-every --interval seconds (appending to TPU_PROBES_r04.jsonl via
+every --interval seconds (appending to TPU_PROBES_{PD_ROUND}.jsonl via
 tools/tpu_probe_loop.py); the moment a probe answers, run
-tools/tpu_first_light.py --sweep which benches, tests, profiles and
-writes TPU_CAPTURE_r04.json / TPU_WINDOWS_r04.jsonl. By default the
+tools/tpu_first_light.py --sweep which benches, tests, profiles,
+writes TPU_CAPTURE_{PD_ROUND}.json / TPU_WINDOWS_{PD_ROUND}.jsonl
+(default round r05) and commits the receipts. By default the
 supervisor exits after the first completed first-light attempt so the
 caller can commit the captured numbers; --forever loops for
 --max-hours.
