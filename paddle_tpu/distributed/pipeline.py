@@ -26,7 +26,8 @@ from ..nn.layer.layers import Layer
 from ..ops.registry import run_op
 from .env import PIPE_AXIS, current_axis_name
 
-__all__ = ["PipelineLayer", "gpipe_schedule", "LayerDesc"]
+__all__ = ["PipelineLayer", "gpipe_schedule", "one_f_one_b_schedule",
+           "LayerDesc"]
 
 
 class LayerDesc:
@@ -95,6 +96,125 @@ def gpipe_schedule(block_fn: Callable, stage_params, x, num_micro: int,
         # stages so replicated out_specs read the real result
         outputs = lax.psum(outputs, axis)
     return outputs
+
+
+def one_f_one_b_schedule(block_fn, loss_grad_fn, stage_params, x,
+                         num_micro: int, axis: str = PIPE_AXIS):
+    """The 1F1B pipeline schedule as ONE compiled SPMD program.
+
+    The host-driven engine (pipeline_engine.py) runs 1F1B with ~60
+    dispatches/step and needs a controller that can address every
+    device (single-host or Pathways). This form compiles the ENTIRE
+    schedule — warmup, steady-state 1F1B, cooldown, both transfers —
+    into one XLA program under shard_map, so it runs on standard
+    multi-controller meshes with dispatches_per_step == 1. Reference
+    semantics: /root/reference/paddle/fluid/framework/section_worker.cc:34
+    microbatch loop + send_v2/recv_v2 p2p, without its per-op host loop.
+
+    Mechanics (call under shard_map over `axis`, like gpipe_schedule):
+    each tick every stage conditionally runs one forward and one
+    backward (lax.cond on its axis_index — XLA compiles a real
+    branch, so warmup/cooldown ticks don't pay for masked work the way
+    the jnp.where-masked gpipe form does). Forward of microbatch m at
+    stage s fires at tick m+s; backward at tick m + 2S-1 - s; total
+    ticks T = M + 2S - 2 + 1. Backward REMATERIALIZES the stage forward
+    (jax.vjp at B-time from the saved input) — the standard pipeline
+    recompute trade: saved state per stage is a ring of at most
+    min(M, 2S) stage INPUTS, not M carry slots like AD-of-scan gpipe.
+
+    block_fn(params, x) -> y  : one stage (input/output same aval;
+      must contain NO collectives — both cond branches must be
+      uniform-execution-free; tp-sharded blocks need the masked gpipe
+      form instead).
+    loss_grad_fn(y, mb) -> (loss, dy) : evaluated on the LAST stage
+      only; closes over labels (slice them by `mb`).
+    stage_params: this stage's param pytree (the local shard).
+    x: [num_micro, micro_batch, ...] microbatched input (stage 0 reads
+      it; later stages ignore).
+
+    Returns (loss_sum, grad_acc): loss summed over microbatches (valid
+    after psum over `axis` — only the last stage contributes), and the
+    stage's UNAVERAGED grad accumulator (divide by num_micro outside).
+    """
+    S = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    M = int(num_micro)
+    T = M + 2 * S - 1
+    R = min(M, 2 * S)
+
+    x0 = x[0]
+    act = jax.eval_shape(block_fn, stage_params, x0)
+    if (act.shape, act.dtype) != (x0.shape, x0.dtype):
+        raise ValueError(
+            f"1F1B stages must map aval->same aval (ring pipeline); got "
+            f"{x0.shape}/{x0.dtype} -> {act.shape}/{act.dtype}")
+    zeros_act = jnp.zeros(act.shape, act.dtype)
+    is_last = s == S - 1
+    perm_fwd = [(r, (r + 1) % S) for r in range(S)]
+    perm_bwd = [(r, (r - 1) % S) for r in range(S)]
+
+    def tick(carry, t):
+        act_in, dy_in, saved, dyring, gacc, lacc = carry
+        mb_f = t - s
+        mb_b = t - (2 * S - 1 - s)
+        f_act = (mb_f >= 0) & (mb_f < M)
+        b_act = (mb_b >= 0) & (mb_b < M)
+        mb_f_c = jnp.clip(mb_f, 0, M - 1)
+        mb_b_c = jnp.clip(mb_b, 0, M - 1)
+        inp = jnp.where(
+            s == 0,
+            lax.dynamic_index_in_dim(x, mb_f_c, 0, keepdims=False),
+            act_in)
+
+        def do_f(ops):
+            saved, dyring, lacc = ops
+            y = block_fn(stage_params, inp)
+            saved = lax.dynamic_update_index_in_dim(
+                saved, inp, mb_f_c % R, 0)
+
+            def at_last(ops2):
+                dyring, lacc = ops2
+                l, dy = loss_grad_fn(y, mb_f_c)
+                dyring = lax.dynamic_update_index_in_dim(
+                    dyring, dy, mb_f_c % 2, 0)
+                return dyring, lacc + l.astype(jnp.float32)
+            dyring, lacc = lax.cond(is_last, at_last, lambda o: o,
+                                    (dyring, lacc))
+            return y, saved, dyring, lacc
+
+        y_f, saved, dyring, lacc = lax.cond(
+            f_act, do_f,
+            lambda ops: (zeros_act, ops[0], ops[1], ops[2]),
+            (saved, dyring, lacc))
+
+        def do_b(gacc):
+            x_saved = lax.dynamic_index_in_dim(
+                saved, mb_b_c % R, 0, keepdims=False)
+            dy = jnp.where(
+                is_last,
+                lax.dynamic_index_in_dim(dyring, mb_b_c % 2, 0,
+                                         keepdims=False),
+                dy_in)
+            _, vjp = jax.vjp(block_fn, stage_params, x_saved)
+            gp, gx = vjp(dy)
+            gacc = jax.tree_util.tree_map(jnp.add, gacc, gp)
+            return gx, gacc
+
+        gx_b, gacc = lax.cond(b_act, do_b,
+                              lambda g: (zeros_act, g), gacc)
+
+        act_in = lax.ppermute(y_f, axis, perm_fwd)
+        dy_in = lax.ppermute(gx_b, axis, perm_bwd)
+        return (act_in, dy_in, saved, dyring, gacc, lacc), None
+
+    carry0 = (zeros_act, zeros_act,
+              jnp.zeros((R,) + x0.shape, x0.dtype),
+              jnp.zeros((2,) + act.shape, act.dtype),
+              jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+              jnp.zeros((), jnp.float32))
+    (ai, di, sv, dr, gacc, lacc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+    return lacc, gacc
 
 
 class PipelineLayer(Layer):
