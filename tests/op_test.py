@@ -19,6 +19,16 @@ import paddle_tpu as paddle
 from paddle_tpu.framework import Tensor
 
 
+# per-dtype tolerances, the reference's check_output_with_place
+# fp16/bf16 contract (unittests/op_test.py:1285): bf16 keeps ~3
+# significant decimal digits, fp16 ~3.3; grads looser still because the
+# numeric reference is the exact f32 op's gradient
+DTYPE_TOL = {
+    "bfloat16": dict(rtol=2e-2, atol=2e-2, mre=8e-2, delta=5e-3),
+    "float16": dict(rtol=2e-3, atol=2e-3, mre=3e-2, delta=5e-3),
+}
+
+
 class OpTest:
     op_fn: Callable = None           # the paddle_tpu functional op
     ref_fn: Callable = None          # numpy reference
@@ -132,3 +142,102 @@ class OpTest:
                 f"max rel err {rel.max():.2e} > {mre:.2e}\n"
                 f"analytic={analytic.ravel()[:5]}, "
                 f"numeric={numeric.ravel()[:5]}")
+
+    # -- low-precision sweeps (check_output_with_place dtype contract) ----
+    def _round_trip_inputs(self, dtype):
+        """Float inputs quantized to `dtype` and brought back to f32, so
+        the low-precision op and the numpy reference evaluate at the
+        SAME representable points (input-quantization error is excluded
+        from the tolerance budget; only the op's internal rounding is
+        under test)."""
+        rt = {}
+        for k, v in self.inputs.items():
+            arr = np.asarray(v)
+            if np.issubdtype(arr.dtype, np.floating):
+                rt[k] = np.asarray(
+                    jnp.asarray(arr).astype(dtype).astype(jnp.float32))
+            else:
+                rt[k] = arr
+        return rt
+
+    def check_output_with_dtype(self, dtype, out_dtype=None):
+        """Run the op with float inputs cast to `dtype`; compare against
+        the f64 numpy reference evaluated at the round-tripped values,
+        under per-dtype tolerances. out_dtype overrides the expected
+        output dtype for ops that upcast BY DESIGN (AMP black-list ops
+        like cross_entropy compute and return f32)."""
+        tol = DTYPE_TOL[dtype]
+        expect = jnp.dtype(out_dtype or dtype)
+        rt = self._round_trip_inputs(dtype)
+        tensors = {}
+        for k, v in rt.items():
+            if np.issubdtype(v.dtype, np.floating):
+                tensors[k] = Tensor(jnp.asarray(v).astype(dtype))
+            else:
+                tensors[k] = paddle.to_tensor(v)
+        out = self._call(tensors)
+        ref = type(self).ref_fn(
+            *[v.astype(np.float64) if np.issubdtype(v.dtype, np.floating)
+              else v for v in rt.values()], **self.attrs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        refs = ref if isinstance(ref, (list, tuple)) else (ref,)
+        for o, r in zip(outs, refs):
+            if jnp.issubdtype(o.dtype, jnp.inexact):
+                assert o.dtype == expect, (
+                    f"{type(self).__name__}: op left {dtype} "
+                    f"(got {o.dtype}, expected {expect}) — dtype "
+                    "promotion leak")
+                got = np.asarray(o._data.astype(jnp.float32),
+                                 np.float64)
+            else:
+                got = np.asarray(o._data)
+            np.testing.assert_allclose(
+                got, np.asarray(r, np.float64), rtol=tol["rtol"],
+                atol=tol["atol"],
+                err_msg=(f"op {type(self).__name__} {dtype} output "
+                         "mismatch"))
+
+    def check_grad_with_dtype(self, dtype, inputs_to_check=None):
+        """Analytic grads of the `dtype` op vs central finite
+        differences of the f32 op at the same round-tripped points."""
+        tol = DTYPE_TOL[dtype]
+        names = (inputs_to_check or self.grad_inputs
+                 or [k for k, v in self.inputs.items()
+                     if np.issubdtype(np.asarray(v).dtype, np.floating)])
+        rt = self._round_trip_inputs(dtype)
+        tensors = {}
+        for k, v in rt.items():
+            if np.issubdtype(v.dtype, np.floating):
+                tensors[k] = Tensor(jnp.asarray(v).astype(dtype))
+            else:
+                tensors[k] = paddle.to_tensor(v)
+        for k in names:
+            tensors[k].stop_gradient = False
+        out = self._call(tensors)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        loss = None
+        for o in outs:
+            if jnp.issubdtype(o.dtype, jnp.inexact):
+                s = o.sum() if o.ndim else o
+                loss = s if loss is None else loss + s.astype(loss.dtype)
+        loss.backward()
+        saved_inputs, saved_delta = self.inputs, self.numeric_delta
+        try:
+            # numeric reference: the f32 op at the quantized points
+            self.inputs = rt
+            self.numeric_delta = tol["delta"]
+            for k in names:
+                analytic = np.asarray(
+                    tensors[k].grad._data.astype(jnp.float32),
+                    np.float64)
+                numeric = self._numeric_grad(k)
+                denom = np.maximum(np.abs(numeric), 1.0)
+                rel = np.abs(analytic - numeric) / denom
+                assert rel.max() <= tol["mre"], (
+                    f"{dtype} gradient mismatch for '{k}' in "
+                    f"{type(self).__name__}: max rel err "
+                    f"{rel.max():.2e} > {tol['mre']:.2e}\n"
+                    f"analytic={analytic.ravel()[:5]}, "
+                    f"numeric={numeric.ravel()[:5]}")
+        finally:
+            self.inputs, self.numeric_delta = saved_inputs, saved_delta

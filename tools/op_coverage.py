@@ -294,16 +294,45 @@ def main():
     ops = load_registry()
     find_test = build_test_index()
 
+    # dtype receipts: ops swept under bf16/fp16 with per-dtype
+    # tolerances (tests/test_op_dtype_sweep.py, the reference's
+    # check_output_with_place fp16/bf16 contract)
+    sweep_path = os.path.join(ROOT, "tests", "test_op_dtype_sweep.py")
+    sweep_text = (open(sweep_path).read()
+                  if os.path.exists(sweep_path) else "")
+
+    # only declared sweep cases count — a name in FP16_SKIP/F32_OUT or
+    # a comment is not a receipt. Cases appear as case("tok", ...) or
+    # as ("tok", F.fn, ref) rows of the activation table.
+    sweep_cases = set(re.findall(r'\bcase\(\s*"([^"]+)"', sweep_text))
+    sweep_cases |= set(re.findall(
+        r'\(\s*"([a-z0-9_]+)"\s*,\s*(?:F|paddle|np)\b', sweep_text))
+    fp16_skips = set()
+    m = re.search(r"FP16_SKIP\s*=\s*\{(.*?)\}", sweep_text, re.S)
+    if m:
+        fp16_skips = set(re.findall(r'"([^"]+)"\s*:', m.group(1)))
+
+    def dtype_receipt(name, impl):
+        tok = impl.split(":")[-1] if ":" in impl else impl
+        for t in (name, tok):
+            for cand in (t, f"{t}_hot"):
+                if cand in sweep_cases:
+                    return ("bf16" if cand in fp16_skips
+                            else "bf16+fp16")
+        return ""
+
     rows = []
     counts = {"op": 0, "alias": 0, "autodiff": 0, "design": 0, "missing": 0}
     for name in ref:
         st, impl = classify(name, ops)
         counts[st] += 1
         test = None
+        dt = ""
         if st in ("op", "alias"):
             tok = impl.split(":")[-1] if ":" in impl else impl
             test = find_test(tok) or find_test(name)
-        rows.append((name, st, impl, test or ""))
+            dt = dtype_receipt(name, impl)
+        rows.append((name, st, impl, test or "", dt))
 
     total = len(ref)
     covered = total - counts["missing"]
@@ -312,9 +341,11 @@ def main():
           f"[direct {counts['op']}, alias {counts['alias']}, "
           f"autodiff(grad) {counts['autodiff']}, design {counts['design']}]")
     print(f"missing: {counts['missing']}")
-    missing = [n for n, st, _, _ in rows if st == "missing"]
+    missing = [n for n, st, _, _, _ in rows if st == "missing"]
+    n_dtype = sum(1 for r in rows if r[4])
     if missing:
         print("  " + " ".join(missing))
+    print(f"bf16/fp16 swept: {n_dtype}")
     print(f"repo registered ops: {len(ops)}")
 
     if args.write:
@@ -341,12 +372,17 @@ def main():
                 f"{counts['op']} direct, {counts['alias']} alias, "
                 f"{counts['autodiff']} autodiff, {counts['design']} design, "
                 f"{counts['missing']} missing. "
-                f"Repo registry: {len(ops)} ops.\n\n"
-                "| reference op | status | implementation | test |\n"
-                "|---|---|---|---|\n")
-            for name, st, impl, test in rows:
+                f"Repo registry: {len(ops)} ops. "
+                f"Hot-path ops with low-precision receipts "
+                f"(tests/test_op_dtype_sweep.py, per-dtype tolerances): "
+                f"{n_dtype}.\n\n"
+                "| reference op | status | implementation | test | "
+                "dtypes |\n"
+                "|---|---|---|---|---|\n")
+            for name, st, impl, test, dt in rows:
                 impl_s = impl.replace("|", "\\|")
-                f.write(f"| `{name}` | {st} | {impl_s} | {test} |\n")
+                f.write(f"| `{name}` | {st} | {impl_s} | {test} | "
+                        f"{dt} |\n")
         print(f"wrote {out}")
 
     print(json.dumps({"total": total, "covered": covered, **counts}))
