@@ -9,6 +9,8 @@ from .train_step import TrainStep  # noqa: F401
 from .program import (Program, program_guard, default_main_program,
                       default_startup_program, data, Executor,
                       append_backward, gradients)  # noqa: F401
+from .passes import (Pass, PassBuilder, apply_pass,  # noqa: F401
+                     PASS_REGISTRY, register_pass)
 from . import nn  # noqa: F401
 from . import io  # noqa: F401
 from .io import (save_inference_model, load_inference_model,  # noqa: F401

@@ -1,0 +1,377 @@
+"""Program-IR pass framework (reference framework/ir/pass.h:43 +
+~88 passes; the pass CONCEPTS are reused, not the implementations).
+
+Design stance recorded in DESIGN.md: XLA subsumes the reference's
+fusion/layout/memory passes (fc_fuse, conv_bn_fuse, inplace/memory
+reuse, stream analysis — a hand fusion pass on this IR would fight the
+compiler). What a TPU-native Program IR still legitimately wants are
+the passes that shrink or canonicalize the TRACED graph before it is
+jitted — they cut retrace/compile time and serialized-program size,
+which XLA cannot do because they happen before XLA sees the module:
+
+- constant_folding_pass: ops whose every input slot is a captured
+  literal run ONCE at pass time; consumers read the folded literal
+  (reference ir/constant_folding equivalent at the Program level).
+- cse_pass: structurally identical ops (same type/inputs/attrs) are
+  deduplicated; later consumers rewire to the first occurrence.
+- identity_elimination_pass: identity scale/cast/reshape/dropout-eval
+  ops drop out; consumers rewire to the op's input.
+- dead_code_elimination_pass(targets): backward slice to the ops the
+  targets need (framework/prune.cc semantics, in-place form of
+  Program.prune).
+
+Passes register in PASS_REGISTRY (REGISTER_PASS analogue) and run via
+apply_pass(program, name) or a PassBuilder pipeline
+(details/build_strategy.h pass-builder analogue). Every pass returns a
+NEW Program; the input is never mutated.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["Pass", "PASS_REGISTRY", "register_pass", "apply_pass",
+           "PassBuilder"]
+
+PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    def deco(fn):
+        PASS_REGISTRY[name] = fn
+        fn.pass_name = name
+        return fn
+    return deco
+
+
+def apply_pass(program, names, **kwargs):
+    """Run one pass (str) or a sequence of passes over `program`;
+    returns the transformed clone (ir.apply_pass analogue)."""
+    if isinstance(names, str):
+        names = [names]
+    p = program
+    for n in names:
+        if n not in PASS_REGISTRY:
+            raise KeyError(
+                f"unknown pass '{n}' (registered: "
+                f"{sorted(PASS_REGISTRY)})")
+        p = PASS_REGISTRY[n](p, **kwargs)
+    return p
+
+
+class Pass:
+    """Subclassable form (reference ir::Pass): set name, override
+    apply(program) -> program. Instantiating registers it."""
+
+    name: str = ""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.name:
+            PASS_REGISTRY[cls.name] = lambda prog, _c=cls, **k: \
+                _c().apply(prog, **k)
+
+    def apply(self, program, **kwargs):
+        raise NotImplementedError
+
+
+class PassBuilder:
+    """Ordered pass pipeline (details/build_strategy pass-builder
+    analogue): append/insert/remove passes, then apply_all."""
+
+    def __init__(self, passes: Optional[Sequence[str]] = None):
+        self._passes: List[str] = list(passes or [])
+
+    def append_pass(self, name: str):
+        if name not in PASS_REGISTRY:
+            raise KeyError(f"unknown pass '{name}'")
+        self._passes.append(name)
+        return self
+
+    def insert_pass(self, idx: int, name: str):
+        if name not in PASS_REGISTRY:
+            raise KeyError(f"unknown pass '{name}'")
+        self._passes.insert(idx, name)
+        return self
+
+    def remove_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+        return self
+
+    def all_passes(self) -> List[str]:
+        return list(self._passes)
+
+    def apply_all(self, program, **kwargs):
+        return apply_pass(program, self._passes, **kwargs)
+
+
+# -------------------------------------------------------------------------
+# helpers
+# -------------------------------------------------------------------------
+
+def _is_prng_key(c):
+    try:
+        return hasattr(c, "dtype") and jax.dtypes.issubdtype(
+            c.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _target_ids(prog, targets) -> set:
+    """Resolve Var objects / names to var ids. Pass `targets=` to any
+    eliminating pass to keep intermediates you intend to FETCH later —
+    an eliminated var's fetch fails loudly in the Executor (computable
+    check), never silently."""
+    out = set()
+    for t in (targets or ()):
+        out.add(t.var_id if hasattr(t, "var_id")
+                else prog.var_by_name(t).var_id)
+    return out
+
+
+def _protected_ids(prog) -> set:
+    """Var ids a pass must keep producible: buffer write-backs, grad
+    bookkeeping, optimizer loss."""
+    keep = {v for _, v in getattr(prog, "_buffer_writes", ())}
+    keep |= {b for b, _ in getattr(prog, "_buffer_writes", ())}
+    gt = getattr(prog, "_grad_target", None)
+    if gt is not None:
+        keep.add(gt)
+    for _, gv in getattr(prog, "_grad_pairs", ()):
+        keep.add(gv.var_id)
+    for s in getattr(prog, "_var_grads", ()):
+        keep.update(s.get("targets", ()))
+        keep.update(s.get("inputs", ()))
+        keep.update(s.get("grad_vars", ()))
+    if prog._optimize is not None:
+        keep.add(prog._optimize[1].var_id)
+    return keep
+
+
+def _rewire(ops, mapping: Dict[int, int]):
+    """Replace consumed var ids per `mapping` in every op's in_ids."""
+    for node in ops:
+        node.in_ids = [mapping.get(i, i) if i is not None else None
+                       for i in node.in_ids]
+
+
+def _rewire_const(ops, folded: Dict[int, object]):
+    """Turn consumed var ids in `folded` into literal const slots."""
+    for node in ops:
+        for k, i in enumerate(node.in_ids):
+            if i is not None and i in folded:
+                node.in_ids[k] = None
+                node.const_args[k] = folded[i]
+
+
+def _const_digest(c):
+    if _is_prng_key(c):
+        return ("<key>",)
+    if hasattr(c, "shape") and hasattr(c, "dtype"):
+        arr = np.asarray(c)
+        if arr.size > 4096:   # don't hash big captured tensors
+            return ("<big>", id(c))
+        return ("arr", str(arr.dtype), arr.shape, arr.tobytes())
+    if isinstance(c, (list, tuple)):
+        return (type(c).__name__,) + tuple(_const_digest(x) for x in c)
+    try:
+        hash(c)
+        return ("lit", c)
+    except TypeError:
+        return ("<unhash>", id(c))
+
+
+# -------------------------------------------------------------------------
+# the passes
+# -------------------------------------------------------------------------
+
+# ops whose replay draws fresh rng or mutates state — never folded/CSE'd
+_IMPURE = {"dropout_op", "dropout_nd", "alpha_dropout", "sdpa_dropout",
+           "flash_attention_dropout", "uniform_random",
+           "gaussian_random", "randint", "bernoulli", "multinomial",
+           "randperm", "batch_norm_op"}
+
+
+def _impure(node):
+    return (node.op_type in _IMPURE
+            or any(_is_prng_key(c) for c in node.const_args))
+
+
+@register_pass("constant_folding_pass")
+def constant_folding_pass(prog, freeze_buffers=False, targets=None, **_):
+    """Evaluate ops whose every input is a compile-time constant once
+    at pass time; consumers get the result as a literal slot.
+
+    Constants are literal const slots (python scalars / numpy arrays
+    passed positionally). With freeze_buffers=True — the reference's
+    fold-for-INFERENCE scenario — captured stop_gradient buffers that
+    the program never writes back are treated as constants too and get
+    BAKED IN: later mutation of the live buffer tensor no longer
+    affects the folded program (same contract as the quant freeze
+    pass). Never use freeze_buffers on a training program."""
+    p = prog.clone()
+    folded: Dict[int, object] = {}
+    if freeze_buffers:
+        written = {b for b, _ in getattr(p, "_buffer_writes", ())}
+        for vid in p.buffer_ids:
+            if vid not in written and vid in p.params:
+                folded[vid] = p.params[vid]._data
+    kept = []
+    protected = _protected_ids(p) | _target_ids(p, targets)
+    for node in p.ops:
+        _rewire_const([node], folded)
+        can = (not _impure(node)
+               and all(i is None for i in node.in_ids)
+               and not any(o in protected for o in node.out_ids))
+        if not can:
+            kept.append(node)
+            continue
+        res = node.fn(*node.const_args, **node.kwargs)
+        res = tuple(res) if isinstance(res, (list, tuple)) else (res,)
+        for vid, r in zip(node.out_ids, res):
+            folded[vid] = r
+            # NOTE: Var objects are SHARED with the input program
+            # (clone() is shallow over vars) — never write folded
+            # values onto them; the fold lives only in const slots
+    p.ops = kept
+    return p
+
+
+@register_pass("cse_pass")
+def cse_pass(prog, targets=None, **_):
+    """Common-subexpression elimination: later ops structurally equal
+    to an earlier one are dropped; consumers rewire to the first.
+    Protected vars (buffer writes, grad bookkeeping, optimizer loss)
+    and explicit `targets` keep their producing op."""
+    p = prog.clone()
+    protected = _protected_ids(p) | _target_ids(p, targets)
+    seen: Dict[tuple, List[int]] = {}
+    mapping: Dict[int, int] = {}
+    kept = []
+    for node in p.ops:
+        _rewire([node], mapping)
+        if _impure(node) or any(o in protected for o in node.out_ids):
+            kept.append(node)
+            continue
+        key = (node.op_type, tuple(node.in_ids),
+               tuple(_const_digest(c) for c in node.const_args),
+               tuple(sorted((k, _const_digest(v))
+                            for k, v in node.kwargs.items())))
+        prev = seen.get(key)
+        if prev is None:
+            seen[key] = node.out_ids
+            kept.append(node)
+        else:
+            for old, new in zip(node.out_ids, prev):
+                mapping[old] = new
+    p.ops = kept
+    return p
+
+
+# identity detectors: op_type -> fn(node, prog) -> input slot to
+# forward, or None when not an identity
+def _ident_scale(node, prog):
+    kw = node.kwargs
+    cargs = node.const_args
+
+    def attr(name, pos, default):
+        if name in kw:
+            return kw[name]
+        if len(cargs) > pos and node.in_ids[pos] is None \
+                and cargs[pos] is not None:
+            return cargs[pos]
+        return default
+    scale = attr("scale", 1, 1.0)
+    bias = attr("bias", 2, 0.0)
+    if scale == 1.0 and bias == 0.0 and node.in_ids[0] is not None:
+        return 0
+    return None
+
+
+def _ident_cast(node, prog):
+    vid = node.in_ids[0]
+    if vid is None:
+        return None
+    src = prog.vars.get(vid)
+    out = prog.vars.get(node.out_ids[0])
+    if src is not None and out is not None and \
+            str(src.dtype) == str(out.dtype):
+        return 0
+    return None
+
+
+def _ident_reshape(node, prog):
+    vid = node.in_ids[0]
+    if vid is None:
+        return None
+    src = prog.vars.get(vid)
+    out = prog.vars.get(node.out_ids[0])
+    if src is not None and out is not None and \
+            tuple(src.shape) == tuple(out.shape):
+        return 0
+    return None
+
+
+_IDENTITY = {"scale": _ident_scale, "cast": _ident_cast,
+             "reshape": _ident_reshape}
+
+
+@register_pass("identity_elimination_pass")
+def identity_elimination_pass(prog, targets=None, **_):
+    """Drop no-op scale(1,0)/cast-to-same/reshape-to-same ops and
+    rewire consumers to the input."""
+    p = prog.clone()
+    mapping: Dict[int, int] = {}
+    protected = _protected_ids(p) | _target_ids(p, targets)
+    kept = []
+    for node in p.ops:
+        _rewire([node], mapping)
+        det = _IDENTITY.get(node.op_type)
+        slot = det(node, p) if det else None
+        if slot is None or node.out_ids[0] in protected:
+            kept.append(node)
+            continue
+        mapping[node.out_ids[0]] = node.in_ids[slot]
+    p.ops = kept
+    return p
+
+
+@register_pass("quantization_transform_pass")
+def quantization_transform_pass(prog, weight_bits=8, activation_bits=8,
+                                quantizable_op_type=None, **_):
+    """Adapter: the quant QAT rewrite (quant/__init__.py
+    QuantizationTransformPass) through the unified pass registry."""
+    from ..quant import QuantizationTransformPass
+    p = prog.clone()
+    QuantizationTransformPass(
+        weight_bits=weight_bits, activation_bits=activation_bits,
+        quantizable_op_type=quantizable_op_type).apply(p)
+    return p
+
+
+@register_pass("quantization_freeze_pass")
+def quantization_freeze_pass(prog, weight_bits=8, **_):
+    """Adapter: int8 inference freeze (quant/__init__.py
+    QuantizationFreezePass) through the unified pass registry."""
+    from ..quant import QuantizationFreezePass
+    p = prog.clone()
+    QuantizationFreezePass(weight_bits=weight_bits).apply(p)
+    return p
+
+
+@register_pass("dead_code_elimination_pass")
+def dead_code_elimination_pass(prog, targets=None, **_):
+    """Backward slice: keep only ops needed for `targets` (+ protected
+    state: buffer writes, grad bookkeeping, optimizer loss). With no
+    targets, keeps ops reachable from protected state only —
+    equivalent to pruning pure dead tails. Shares Program.prune's
+    liveness algorithm (program.py backward_slice)."""
+    from .program import backward_slice
+    p = prog.clone()
+    needed = _protected_ids(p) | _target_ids(p, targets)
+    if not needed:
+        return p
+    p.ops, _ = backward_slice(p.ops, needed)
+    return p

@@ -104,6 +104,20 @@ class OpNode:
         self.multi = multi
 
 
+def backward_slice(ops, target_ids):
+    """Liveness slice shared by Program.prune and the
+    dead_code_elimination_pass (static/passes.py): returns (kept_ops,
+    needed_var_ids) for the ops that can reach `target_ids`."""
+    needed = set(target_ids)
+    kept: List[OpNode] = []
+    for node in reversed(ops):
+        if any(o in needed for o in node.out_ids):
+            kept.append(node)
+            needed.update(i for i in node.in_ids if i is not None)
+    kept.reverse()
+    return kept, needed
+
+
 class Program:
     """Captured graph (ProgramDesc analogue)."""
 
@@ -224,13 +238,7 @@ class Program:
                 else [targets]:
             target_ids.add(t.var_id if isinstance(t, Var)
                            else self.var_by_name(t).var_id)
-        needed = set(target_ids)
-        kept: List[OpNode] = []
-        for node in reversed(self.ops):
-            if any(o in needed for o in node.out_ids):
-                kept.append(node)
-                needed.update(i for i in node.in_ids if i is not None)
-        kept.reverse()
+        kept, needed = backward_slice(self.ops, target_ids)
         p = Program()
         p.ops = kept
         live = set(needed) | {o for n in kept for o in n.out_ids}

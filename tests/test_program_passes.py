@@ -1,0 +1,201 @@
+"""Program-IR pass framework receipts (reference ir/pass.h:43 pass
+concept + prune.cc/constant-folding semantics, TPU-design rationale in
+static/passes.py's docstring: only pre-XLA graph shrinking lives here;
+fusion/layout/memory passes are deliberately left to the compiler).
+
+Contract per pass: op count strictly drops on a program built with the
+targeted redundancy AND Executor.run fetches are bit-identical before
+vs after.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static import (Executor, PassBuilder, apply_pass,
+                               program_guard)
+from paddle_tpu.static.program import Program
+
+
+def _run(prog, feed, fetch):
+    return Executor().run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_constant_folding_pass():
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 3], "float32")
+        # stop_gradient capture = buffer var; the (c*3+1) -> sqrt chain
+        # never touches the feed
+        c = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        k = paddle.scale(paddle.Tensor(c._data), scale=3.0, bias=1.0)
+        k2 = paddle.sqrt(k)
+        y = paddle.add(x, k2)
+    n0 = len(main.ops)
+    # default: captured buffers are LIVE state — nothing folds
+    assert len(apply_pass(main, "constant_folding_pass").ops) == n0
+    # freeze_buffers (inference scenario): the constant chain folds
+    folded = apply_pass(main, "constant_folding_pass",
+                        freeze_buffers=True)
+    assert len(folded.ops) < n0
+    feed = {"x": np.ones((2, 3), np.float32)}
+    np.testing.assert_array_equal(
+        _run(main, feed, [y.name])[0],
+        _run(folded, feed, [y.name])[0])
+    # the add must survive (depends on the feed)
+    assert any("add" in n.op_type for n in folded.ops)
+
+
+def test_cse_pass():
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 3], "float32")
+        a = paddle.exp(x)
+        b = paddle.exp(x)          # structurally identical
+        y = paddle.add(a, b)
+    n0 = len(main.ops)
+    deduped = apply_pass(main, "cse_pass")
+    assert len(deduped.ops) == n0 - 1
+    feed = {"x": np.random.RandomState(0).randn(2, 3).astype(np.float32)}
+    np.testing.assert_array_equal(
+        _run(main, feed, [y.name])[0],
+        _run(deduped, feed, [y.name])[0])
+
+
+def test_identity_elimination_pass():
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 3], "float32")
+        a = paddle.scale(x, scale=1.0, bias=0.0)    # identity
+        b = paddle.reshape(a, [2, 3])               # same-shape reshape
+        c = b.astype("float32")                     # same-dtype cast
+        y = paddle.tanh(c)
+    n0 = len(main.ops)
+    slim = apply_pass(main, "identity_elimination_pass")
+    assert len(slim.ops) <= n0 - 2
+    feed = {"x": np.random.RandomState(1).randn(2, 3).astype(np.float32)}
+    np.testing.assert_array_equal(
+        _run(main, feed, [y.name])[0],
+        _run(slim, feed, [y.name])[0])
+
+
+def test_dead_code_elimination_pass():
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 3], "float32")
+        y = paddle.tanh(x)
+        dead = paddle.exp(paddle.scale(x, scale=2.0))  # nothing uses it
+        _ = paddle.sqrt(dead)
+    n0 = len(main.ops)
+    live = apply_pass(main, "dead_code_elimination_pass", targets=[y])
+    assert len(live.ops) < n0
+    feed = {"x": np.random.RandomState(2).randn(2, 3).astype(np.float32)}
+    np.testing.assert_array_equal(
+        _run(main, feed, [y.name])[0],
+        _run(live, feed, [y.name])[0])
+
+
+def test_pass_builder_pipeline_and_registry():
+    from paddle_tpu.static import PASS_REGISTRY
+    for name in ("constant_folding_pass", "cse_pass",
+                 "identity_elimination_pass",
+                 "dead_code_elimination_pass"):
+        assert name in PASS_REGISTRY
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        a = paddle.scale(x, scale=1.0, bias=0.0)   # identity
+        e1 = paddle.exp(a)
+        e2 = paddle.exp(a)                          # CSE fodder
+        c = paddle.to_tensor(np.ones((2, 2), np.float32))
+        k = paddle.scale(paddle.Tensor(c._data), scale=2.0)  # foldable
+        y = paddle.add(paddle.add(e1, e2), k)
+    builder = PassBuilder()
+    builder.append_pass("identity_elimination_pass") \
+           .append_pass("cse_pass") \
+           .append_pass("constant_folding_pass")
+    builder.append_pass("dead_code_elimination_pass")
+    assert len(builder.all_passes()) == 4
+    builder.remove_pass("dead_code_elimination_pass")
+    out = builder.apply_all(main, freeze_buffers=True)
+    assert len(out.ops) <= len(main.ops) - 3
+    feed = {"x": np.random.RandomState(3).randn(2, 2).astype(np.float32)}
+    np.testing.assert_array_equal(
+        _run(main, feed, [y.name])[0],
+        _run(out, feed, [y.name])[0])
+    with pytest.raises(KeyError, match="unknown pass"):
+        builder.append_pass("nope_pass")
+
+
+def test_identity_elimination_keeps_positional_bias_scale():
+    """scale(x, 1.0, 5.0) passed POSITIONALLY is not an identity; the
+    pass must keep it (review regression: bias read only from kwargs)."""
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = paddle.tanh(paddle.scale(x, 1.0, 5.0))
+    slim = apply_pass(main, "identity_elimination_pass")
+    assert len(slim.ops) == len(main.ops)
+    feed = {"x": np.zeros((2, 2), np.float32)}
+    np.testing.assert_array_equal(
+        _run(main, feed, [y.name])[0], _run(slim, feed, [y.name])[0])
+
+
+def test_cse_keeps_var_grad_targets():
+    """CSE must not eliminate an op whose output id is referenced by
+    static gradients() bookkeeping (review regression)."""
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        a = paddle.exp(x)
+        t = paddle.exp(x)        # duplicate, but a grad target below
+        (g,) = static.gradients([t], [x])
+    deduped = apply_pass(main, "cse_pass")
+    feed = {"x": np.random.RandomState(6).randn(2, 2).astype(np.float32)}
+    np.testing.assert_allclose(
+        _run(deduped, feed, [g.name])[0],
+        np.exp(feed["x"]), rtol=1e-6)
+
+
+def test_quant_passes_via_registry():
+    """The quant rewrites ride the same registry (unified pass
+    framework): apply_pass inserts fake-quant nodes and the rewritten
+    program still runs."""
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        lin = paddle.nn.Linear(4, 3)
+        y = lin(x)
+    q = apply_pass(main, "quantization_transform_pass")
+    assert len(q.ops) > len(main.ops)
+    assert any("quantize" in n.op_type for n in q.ops)
+    feed = {"x": np.random.RandomState(5).randn(2, 4).astype(np.float32)}
+    out = _run(q, feed, [y.name])[0]
+    ref = _run(main, feed, [y.name])[0]
+    np.testing.assert_allclose(out, ref, atol=0.2)  # int8 quant error
+
+
+def test_passes_never_touch_train_bookkeeping():
+    """A train program (optimizer attached) passes through DCE with its
+    loss/backward intact and still trains identically."""
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [4, 3], "float32")
+        lin = paddle.nn.Linear(3, 2)
+        out = lin(x)
+        dead = paddle.exp(out)  # dead tail
+        loss = paddle.mean(out * out)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+    slim = apply_pass(main, "dead_code_elimination_pass",
+                      targets=[loss])
+    assert len(slim.ops) < len(main.ops)
+    feed = {"x": np.random.RandomState(4).randn(4, 3).astype(np.float32)}
+    l0 = [_run(main, feed, [loss.name])[0] for _ in range(2)]
+    # fresh params for the slim copy? params are shared Tensors — run
+    # on the ORIGINAL weights would diverge after main trained. Assert
+    # instead that slim still trains: loss strictly decreases.
+    l1 = [_run(slim, feed, [loss.name])[0] for _ in range(2)]
+    assert float(l0[1]) < float(l0[0])
+    assert float(l1[1]) < float(l1[0])
