@@ -154,7 +154,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
     method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
               "trilinear": "linear", "bicubic": "cubic",
-              "area": "linear"}[mode]
+              "area": "area"}[mode]
     if method == "nearest":
         out = x
         for ax, (in_s, out_s) in zip(spatial_axes, zip(in_sizes, out_sizes)):
@@ -162,7 +162,23 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 jnp.int32)
             out = jnp.take(out, idx, axis=ax)
         return out
-    # linear/cubic via jax.image.resize (align_corners=False semantics)
+    if method == "area":
+        # true area semantics = adaptive average pooling (reference
+        # interpolate mode='area'; the old linear mapping diverged from
+        # the contract for non-integer ratios)
+        from .pooling import _adaptive
+        return _adaptive(x, tuple(out_sizes), len(out_sizes),
+                         not data_format.startswith("NC"), "avg")
+    if method == "cubic":
+        # Keys cubic with a=-0.75 and edge-clamped taps — the
+        # reference's bicubic contract for BOTH align modes
+        # (jax.image.resize uses a=-0.5, which diverges numerically)
+        out = x
+        for ax, (in_s, out_s) in zip(spatial_axes,
+                                     zip(in_sizes, out_sizes)):
+            out = _cubic_axis(out, ax, in_s, out_s, align_corners, nd)
+        return out
+    # linear via jax.image.resize (align_corners=False semantics)
     new_shape = list(x.shape)
     for ax, out_s in zip(spatial_axes, out_sizes):
         new_shape[ax] = out_s
@@ -181,6 +197,40 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                    + jnp.take(out, hi, axis=ax) * w)
         return out
     return jax.image.resize(x, tuple(new_shape), method=method)
+
+
+def _cubic_axis(x, ax, in_s, out_s, align_corners, nd):
+    """Separable 1-axis bicubic resample, Keys kernel a=-0.75 with
+    replicate-clamped border taps (weights always sum to 1)."""
+    if align_corners:
+        # out_s == 1 samples index 0 (matches the bilinear
+        # align_corners branch and the reference contract)
+        pos = (jnp.arange(out_s) * ((in_s - 1) / (out_s - 1))
+               if out_s > 1 else jnp.zeros((out_s,)))
+    else:
+        pos = (jnp.arange(out_s) + 0.5) * (in_s / out_s) - 0.5
+    base = jnp.floor(pos)
+    frac = pos - base
+    a = -0.75
+
+    def w(t):
+        at = jnp.abs(t)
+        return jnp.where(
+            at <= 1.0, (a + 2.0) * at ** 3 - (a + 3.0) * at ** 2 + 1.0,
+            jnp.where(at < 2.0,
+                      a * at ** 3 - 5.0 * a * at ** 2 + 8.0 * a * at
+                      - 4.0 * a,
+                      0.0))
+
+    shape = [1] * nd
+    shape[ax] = out_s
+    acc = None
+    for k in (-1, 0, 1, 2):
+        idx = jnp.clip(base.astype(jnp.int32) + k, 0, in_s - 1)
+        wk = jnp.reshape(w(frac - k), shape).astype(x.dtype)
+        term = jnp.take(x, idx, axis=ax) * wk
+        acc = term if acc is None else acc + term
+    return acc
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",

@@ -178,7 +178,10 @@ def _const_digest(c):
         return (type(c).__name__,) + tuple(_const_digest(x) for x in c)
     try:
         hash(c)
-        return ("lit", c)
+        # tag the python type: 2, 2.0 and True hash equal but bake
+        # different dtype promotions (same hazard registry._hashable
+        # guards against in the eager jit cache)
+        return ("lit", type(c).__name__, c)
     except TypeError:
         return ("<unhash>", id(c))
 
@@ -272,6 +275,9 @@ def cse_pass(prog, targets=None, **_):
 
 # identity detectors: op_type -> fn(node, prog) -> input slot to
 # forward, or None when not an identity
+_UNKNOWN = object()  # runtime-tensor attr slot: value not known statically
+
+
 def _ident_scale(node, prog):
     kw = node.kwargs
     cargs = node.const_args
@@ -279,8 +285,9 @@ def _ident_scale(node, prog):
     def attr(name, pos, default):
         if name in kw:
             return kw[name]
-        if len(cargs) > pos and node.in_ids[pos] is None \
-                and cargs[pos] is not None:
+        if len(node.in_ids) > pos and node.in_ids[pos] is not None:
+            return _UNKNOWN       # traced var: can't prove identity
+        if len(cargs) > pos and cargs[pos] is not None:
             return cargs[pos]
         return default
     scale = attr("scale", 1, 1.0)
@@ -288,6 +295,18 @@ def _ident_scale(node, prog):
     if scale == 1.0 and bias == 0.0 and node.in_ids[0] is not None:
         return 0
     return None
+
+
+def _ident_dropout_eval(node, prog):
+    """dropout_eval is identity unless downscale_in_infer scales by
+    (1-p) (nn/functional/common.py _dropout_eval)."""
+    if node.in_ids[0] is None:
+        return None
+    mode = node.kwargs.get("mode", "upscale_in_train")
+    p = node.kwargs.get("p", 0.5)
+    if mode == "downscale_in_infer" and p != 0.0:
+        return None
+    return 0
 
 
 def _ident_cast(node, prog):
@@ -315,7 +334,8 @@ def _ident_reshape(node, prog):
 
 
 _IDENTITY = {"scale": _ident_scale, "cast": _ident_cast,
-             "reshape": _ident_reshape}
+             "reshape": _ident_reshape,
+             "dropout_eval": _ident_dropout_eval}
 
 
 @register_pass("identity_elimination_pass")
