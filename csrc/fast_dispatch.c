@@ -1,0 +1,276 @@
+/* fast_dispatch.c — C eager fast path for the op registry.
+ *
+ * Reference analogue: the build-time codegen'd per-op C entry points
+ * (paddle/fluid/pybind/op_function_generator.cc:488 emits one
+ * PyObject* fast function per op; dygraph python calls core.ops.<op>).
+ * Here ONE generic C entry serves every registry op: it scans the
+ * call, keys a C-held cache (op name + tensor-position mask + typed
+ * scalar attrs), calls the cached jitted forward, and wraps outputs as
+ * Tensor objects — all via the CPython C API, no Python bytecode.
+ *
+ * Scope (returns NotImplemented so registry.run_op falls back for):
+ *   - any arg/kwarg that is not a Tensor or a simple scalar
+ *     (int/float/bool/str/None),
+ *   - grad-required calls (grad enabled and any input requires grad),
+ *   - cache misses resolve through a one-time Python callback
+ *     (make_jit) which may refuse (rng/mesh/blacklisted ops -> None is
+ *     cached and the op permanently falls back).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *g_tensor_cls = NULL;   /* framework.Tensor */
+static PyObject *g_make_jit = NULL;     /* python callback on miss */
+static PyObject *g_cache = NULL;        /* key -> jitfn or None */
+static PyObject *g_marker = NULL;       /* tensor-slot key marker */
+static PyObject *g_zero = NULL;         /* cached int 0 for _out_idx */
+static PyObject *s_data = NULL;         /* "_data" */
+static PyObject *s_stop_gradient = NULL;
+static PyObject *s_grad = NULL;         /* "_grad" */
+static PyObject *s_node = NULL;         /* "_node" */
+static PyObject *s_out_idx = NULL;      /* "_out_idx" */
+static PyObject *s_name = NULL;
+static PyObject *s_persistable = NULL;
+static PyObject *s_retain = NULL;       /* "_retain_grad" */
+static PyObject *s_hooks = NULL;        /* "_grad_hooks" */
+static PyObject *s_sharding = NULL;     /* "sharding_spec" */
+
+/* wrap one jax array as a fresh Tensor (all __slots__ initialized the
+ * way Tensor.__init__ would for stop_gradient=True output) */
+static PyObject *
+wrap_tensor(PyObject *arr)
+{
+    PyTypeObject *cls = (PyTypeObject *)g_tensor_cls;
+    PyObject *empty = PyTuple_New(0);
+    if (!empty) return NULL;
+    PyObject *t = cls->tp_new(cls, empty, NULL);
+    Py_DECREF(empty);
+    if (!t) return NULL;
+    PyObject *hooks = PyList_New(0);
+    if (!hooks) { Py_DECREF(t); return NULL; }
+    if (PyObject_SetAttr(t, s_data, arr) < 0 ||
+        PyObject_SetAttr(t, s_stop_gradient, Py_True) < 0 ||
+        PyObject_SetAttr(t, s_grad, Py_None) < 0 ||
+        PyObject_SetAttr(t, s_node, Py_None) < 0 ||
+        PyObject_SetAttr(t, s_out_idx, g_zero) < 0 ||
+        PyObject_SetAttr(t, s_name, Py_None) < 0 ||
+        PyObject_SetAttr(t, s_persistable, Py_False) < 0 ||
+        PyObject_SetAttr(t, s_retain, Py_False) < 0 ||
+        PyObject_SetAttr(t, s_hooks, hooks) < 0 ||
+        PyObject_SetAttr(t, s_sharding, Py_None) < 0) {
+        Py_DECREF(hooks);
+        Py_DECREF(t);
+        return NULL;
+    }
+    Py_DECREF(hooks);
+    return t;
+}
+
+static int
+is_simple_const(PyObject *o)
+{
+    return (o == Py_None || PyLong_Check(o) || PyFloat_Check(o) ||
+            PyBool_Check(o) || PyUnicode_Check(o));
+}
+
+/* fast_op(name, fn, args, kwargs, grad_enabled) ->
+ *   result | NotImplemented */
+static PyObject *
+fast_op(PyObject *self, PyObject *call_args)
+{
+    PyObject *name, *fn, *args, *kwargs;
+    int grad_enabled;
+    if (!PyArg_ParseTuple(call_args, "OOO!O!p", &name, &fn,
+                          &PyTuple_Type, &args,
+                          &PyDict_Type, &kwargs, &grad_enabled))
+        return NULL;
+
+    Py_ssize_t nargs = PyTuple_GET_SIZE(args);
+    Py_ssize_t nkw = PyDict_GET_SIZE(kwargs);
+    /* key: [name, per-arg component..., per-kwarg (k, comp)...] */
+    PyObject *key = PyTuple_New(1 + nargs + nkw);
+    if (!key) return NULL;
+    Py_INCREF(name);
+    PyTuple_SET_ITEM(key, 0, name);
+
+    PyObject *datas = PyTuple_New(nargs);  /* over-alloc; shrink later */
+    if (!datas) { Py_DECREF(key); return NULL; }
+    Py_ssize_t ndata = 0;
+
+    for (Py_ssize_t i = 0; i < nargs; i++) {
+        PyObject *a = PyTuple_GET_ITEM(args, i);
+        if (PyObject_TypeCheck(a, (PyTypeObject *)g_tensor_cls)) {
+            if (grad_enabled) {
+                PyObject *sg = PyObject_GetAttr(a, s_stop_gradient);
+                if (!sg) goto fail;
+                int stop = PyObject_IsTrue(sg);
+                Py_DECREF(sg);
+                if (stop < 0) goto fail;
+                if (!stop) goto notimpl;   /* grad path: fall back */
+            }
+            PyObject *d = PyObject_GetAttr(a, s_data);
+            if (!d) goto fail;
+            PyTuple_SET_ITEM(datas, ndata++, d);
+            Py_INCREF(g_marker);
+            PyTuple_SET_ITEM(key, 1 + i, g_marker);
+        } else if (is_simple_const(a)) {
+            /* (type, value): 2 vs 2.0 vs True bake different dtypes */
+            PyObject *comp = PyTuple_Pack(2, (PyObject *)Py_TYPE(a), a);
+            if (!comp) goto fail;
+            PyTuple_SET_ITEM(key, 1 + i, comp);
+        } else {
+            goto notimpl;   /* tuple/list/array attr: python path */
+        }
+    }
+    if (nkw > 0) {
+        /* sorted kwarg components: keyword-order-permuted calls of the
+         * same signature must share one cache entry (parity with the
+         * python _fast_entry key, which sorts) */
+        PyObject *keys = PyDict_Keys(kwargs);
+        if (!keys) goto fail;
+        if (nkw > 1 && PyList_Sort(keys) < 0) {
+            Py_DECREF(keys);
+            goto fail;
+        }
+        for (Py_ssize_t j = 0; j < nkw; j++) {
+            PyObject *k = PyList_GET_ITEM(keys, j);
+            PyObject *v = PyDict_GetItemWithError(kwargs, k);
+            if (!v || !is_simple_const(v)) {
+                Py_DECREF(keys);
+                if (v || !PyErr_Occurred())
+                    goto notimpl;   /* incl. Tensor kwargs */
+                goto fail;
+            }
+            PyObject *comp = PyTuple_Pack(3, k, (PyObject *)Py_TYPE(v),
+                                          v);
+            if (!comp) { Py_DECREF(keys); goto fail; }
+            PyTuple_SET_ITEM(key, 1 + nargs + j, comp);
+        }
+        Py_DECREF(keys);
+    }
+
+    PyObject *jitfn = PyDict_GetItemWithError(g_cache, key); /* borrowed */
+    if (!jitfn) {
+        if (PyErr_Occurred()) goto fail;
+        /* one-time miss: ask python to build (or refuse) the jit */
+        PyObject *built = PyObject_CallFunctionObjArgs(
+            g_make_jit, name, fn, args, kwargs, NULL);
+        if (!built) goto fail;
+        if (PyDict_SetItem(g_cache, key, built) < 0) {
+            Py_DECREF(built);
+            goto fail;
+        }
+        Py_DECREF(built);
+        jitfn = PyDict_GetItem(g_cache, key);
+    }
+    if (jitfn == Py_None)
+        goto notimpl;   /* op refused (rng/mesh/unjittable) */
+
+    if (ndata != nargs) {
+        /* shrink datas to the actual tensor count */
+        PyObject *trim = PyTuple_GetSlice(datas, 0, ndata);
+        Py_DECREF(datas);
+        if (!trim) { Py_DECREF(key); return NULL; }
+        datas = trim;
+    }
+    PyObject *out = PyObject_CallObject(jitfn, datas);
+    Py_DECREF(datas);
+    Py_DECREF(key);
+    if (!out) return NULL;
+
+    if (PyTuple_Check(out)) {
+        Py_ssize_t n = PyTuple_GET_SIZE(out);
+        PyObject *res = PyTuple_New(n);
+        if (!res) { Py_DECREF(out); return NULL; }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *w = wrap_tensor(PyTuple_GET_ITEM(out, i));
+            if (!w) { Py_DECREF(out); Py_DECREF(res); return NULL; }
+            PyTuple_SET_ITEM(res, i, w);
+        }
+        Py_DECREF(out);
+        return res;
+    }
+    PyObject *w = wrap_tensor(out);
+    Py_DECREF(out);
+    return w;
+
+notimpl:
+    Py_DECREF(datas);
+    Py_DECREF(key);
+    Py_RETURN_NOTIMPLEMENTED;
+fail:
+    Py_DECREF(datas);
+    Py_DECREF(key);
+    return NULL;
+}
+
+static PyObject *
+init_fastpath(PyObject *self, PyObject *args)
+{
+    PyObject *tensor_cls, *make_jit;
+    if (!PyArg_ParseTuple(args, "OO", &tensor_cls, &make_jit))
+        return NULL;
+    Py_XDECREF(g_tensor_cls);
+    Py_XDECREF(g_make_jit);
+    Py_INCREF(tensor_cls);
+    Py_INCREF(make_jit);
+    g_tensor_cls = tensor_cls;
+    g_make_jit = make_jit;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+cache_size(PyObject *self, PyObject *noargs)
+{
+    return PyLong_FromSsize_t(PyDict_GET_SIZE(g_cache));
+}
+
+static PyObject *
+cache_clear(PyObject *self, PyObject *noargs)
+{
+    PyDict_Clear(g_cache);
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"fast_op", fast_op, METH_VARARGS,
+     "fast_op(name, fn, args, kwargs, grad_enabled) -> result or "
+     "NotImplemented"},
+    {"init_fastpath", init_fastpath, METH_VARARGS,
+     "init_fastpath(tensor_cls, make_jit_callback)"},
+    {"cache_size", cache_size, METH_NOARGS, "entries in the C cache"},
+    {"cache_clear", cache_clear, METH_NOARGS, "drop every cached jit"},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "paddle_tpu_cfast",
+    "C eager fast dispatch (core.ops codegen analogue)", -1, methods
+};
+
+PyMODINIT_FUNC
+PyInit_paddle_tpu_cfast(void)
+{
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m) return NULL;
+    g_cache = PyDict_New();
+    g_zero = PyLong_FromLong(0);
+    g_marker = PyUnicode_InternFromString("<tensor>");
+    s_data = PyUnicode_InternFromString("_data");
+    s_stop_gradient = PyUnicode_InternFromString("stop_gradient");
+    s_grad = PyUnicode_InternFromString("_grad");
+    s_node = PyUnicode_InternFromString("_node");
+    s_out_idx = PyUnicode_InternFromString("_out_idx");
+    s_name = PyUnicode_InternFromString("name");
+    s_persistable = PyUnicode_InternFromString("persistable");
+    s_retain = PyUnicode_InternFromString("_retain_grad");
+    s_hooks = PyUnicode_InternFromString("_grad_hooks");
+    s_sharding = PyUnicode_InternFromString("sharding_spec");
+    if (!g_cache || !g_zero || !g_marker || !s_data || !s_stop_gradient ||
+        !s_grad || !s_node || !s_out_idx || !s_name ||
+        !s_persistable || !s_retain || !s_hooks || !s_sharding) {
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

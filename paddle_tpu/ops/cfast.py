@@ -1,0 +1,119 @@
+"""Bridge to the C eager fast dispatch (csrc/fast_dispatch.c).
+
+Reference analogue: build-time codegen of one C function per op
+(/root/reference/paddle/fluid/pybind/op_function_generator.cc:488 —
+`core.ops.<op>` fast entries used by dygraph python). Here one generic
+C entry covers the whole registry: C scans the call, keys its own
+cache, invokes the cached jitted forward and wraps the outputs as
+Tensors without executing Python bytecode. run_op consults it first
+and falls back seamlessly (the C entry returns NotImplemented for
+grad-required calls, non-scalar attrs, rng/mesh ops, unjittable ops).
+
+Builds on demand through csrc/Makefile; every consumer must tolerate
+`cfast_module() is None` (no toolchain) — native is the fast path,
+never a dependency.
+"""
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["cfast_module", "make_jit"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "paddle_tpu_cfast.so")
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_CSRC, "fast_dispatch.c")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return _SO
+    res = subprocess.run(
+        ["make", "-C", _CSRC, "paddle_tpu_cfast.so"],
+        capture_output=True, text=True)
+    if res.returncode != 0 or not os.path.exists(_SO):
+        return None
+    return _SO
+
+
+def make_jit(name, fn, args, kwargs):
+    """One-time cache-miss callback from C: build the jitted forward
+    for this (op, signature), or None when the op must stay on the
+    python path (rng/mesh tags, blacklisted, jit disabled, or the
+    first real call fails to trace)."""
+    import jax
+
+    from ..framework import Tensor
+    from .registry import OPS, _EAGER_NOJIT
+
+    info = OPS.get(name)
+    if (name in _EAGER_NOJIT or info is None or info.fn is not fn
+            or "rng" in info.tags or "mesh" in info.tags):
+        return None
+    tensor_pos = [i for i, a in enumerate(args)
+                  if isinstance(a, Tensor)]
+    arg_template = tuple(None if isinstance(a, Tensor) else a
+                         for a in args)
+    kw = dict(kwargs)
+
+    def pure(*diff):
+        full = list(arg_template)
+        for p, v in zip(tensor_pos, diff):
+            full[p] = v
+        res = fn(*full, **kw)
+        return tuple(res) if isinstance(res, list) else res
+
+    # validate by ABSTRACT trace: catches untraceable ops (and plain
+    # bad calls) without executing or compiling. Refusing here caches
+    # None for THIS signature only — a genuinely erroneous call (shape
+    # mismatch) must not blacklist the op the way a slow-path-proven
+    # jit failure does (registry run_op blacklists only after the slow
+    # path succeeded where jit failed).
+    avals = [jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+             for a in args if isinstance(a, Tensor)]
+    try:
+        jax.eval_shape(pure, *avals)
+    except Exception:
+        return None
+    return jax.jit(pure)
+
+
+def cfast_module():
+    """The loaded C extension module, or None (built lazily once)."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    with _lock:
+        if _mod is not None or _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("PD_DISABLE_CFAST", "").strip() in (
+                "1", "true", "yes"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            loader = importlib.machinery.ExtensionFileLoader(
+                "paddle_tpu_cfast", so)
+            spec = importlib.util.spec_from_loader(
+                "paddle_tpu_cfast", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            from ..framework import Tensor
+            mod.init_fastpath(Tensor, make_jit)
+            _mod = mod
+        except Exception:
+            _mod = None
+        return _mod

@@ -203,6 +203,25 @@ def _check_nan_inf(name, arrays):
                     f"NaN or Inf found in output of op", op_type=name)
 
 
+_cfast_checked = False
+_cfast = None
+
+
+def _slow_flags():
+    """Debug flags that must see every op on the python path."""
+    return (flag_value("check_nan_inf") or flag_value("op_stats")
+            or not flag_value("eager_op_jit"))
+
+
+def _get_cfast():
+    global _cfast, _cfast_checked
+    if not _cfast_checked:
+        _cfast_checked = True
+        from .cfast import cfast_module
+        _cfast = cfast_module()
+    return _cfast
+
+
 def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
     """Execute one op eagerly, recording a tape node if grads are needed.
     Under a program_guard, append to the captured Program instead."""
@@ -210,6 +229,27 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
         return _static_tracer(name, fn, args, kwargs)
     if _amp_hook is not None:
         args, kwargs = _amp_hook(name, args, kwargs)
+    elif _cfast is not None or not _cfast_checked:
+        # C fast path (op_function_generator.cc core.ops analogue):
+        # no-grad scalar-attr calls dispatch fully in C — scan, cache
+        # key, jit call, Tensor wrap — and return here. NotImplemented
+        # = fall through to the python path (grads, complex attrs,
+        # rng/mesh ops). Debug flags force the python path so their
+        # hooks still observe every op.
+        cf = _cfast if _cfast is not None else _get_cfast()
+        if cf is not None and not _slow_flags():
+            from .. import profiler as _profiler
+            if not _profiler._enabled:
+                try:
+                    res = cf.fast_op(name, fn, args, kwargs,
+                                     is_grad_enabled())
+                except _enforce.EnforceNotMet:
+                    raise
+                except Exception as e:
+                    # same op attribution the python path gives
+                    raise _enforce.wrap_op_error(name, e) from e
+                if res is not NotImplemented:
+                    return res
 
     # split positional args and kwargs into diff-tensor slots and
     # pass-through slots; Tensor/jax.Array in either position is a
