@@ -16,7 +16,9 @@ from .env import (build_mesh, ensure_mesh, get_mesh, set_mesh, get_rank,
 from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
 from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                               VocabParallelEmbedding, split)  # noqa: F401
-from .pipeline import LayerDesc, PipelineLayer, gpipe_schedule  # noqa: F401
+from .pipeline import (LayerDesc, PipelineLayer,  # noqa: F401
+                       SpmdPipelineParallel, gpipe_schedule,
+                       one_f_one_b_schedule)
 from .embedding_kv import (EmbeddingKV, SparseEmbedding,  # noqa: F401
                            distributed_lookup_table, pull_sparse,
                            push_sparse)

@@ -27,7 +27,7 @@ from ..ops.registry import run_op
 from .env import PIPE_AXIS, current_axis_name
 
 __all__ = ["PipelineLayer", "gpipe_schedule", "one_f_one_b_schedule",
-           "LayerDesc"]
+           "SpmdPipelineParallel", "LayerDesc"]
 
 
 class LayerDesc:
@@ -215,6 +215,214 @@ def one_f_one_b_schedule(block_fn, loss_grad_fn, stage_params, x,
     (ai, di, sv, dr, gacc, lacc), _ = lax.scan(
         tick, carry0, jnp.arange(T))
     return lacc, gacc
+
+
+class SpmdPipelineParallel:
+    """PipelineParallel's train_batch surface over the SPMD 1F1B
+    schedule: warmup / steady 1F1B / cooldown / ring transfers /
+    grad accumulation / optimizer update — ONE compiled XLA program
+    per step (dispatches_per_step == 1), runnable on standard
+    multi-controller meshes. The host-driven engine
+    (pipeline_engine.PipelineParallel) remains the choice for
+    heterogeneous stages; this engine requires structurally IDENTICAL
+    stage Layers (same state_dict names/shapes/dtypes — the stacked
+    [S, ...] parameter layout rides the 'pp' mesh axis), no mutable
+    buffers (BN running stats can't ride the scan carry), and
+    deterministic-per-step rng (one step key shared by every
+    microbatch; the rematerialized backward replays it exactly).
+
+    Reference semantics:
+    /root/reference/paddle/fluid/framework/section_worker.cc:34 (1F1B-
+    less section loop) without its per-op host round-trips.
+    """
+
+    def __init__(self, stages: Sequence[Layer], loss_fn: Callable,
+                 optimizer, num_micro: int = 1, mesh=None,
+                 pp_axis: str = PIPE_AXIS):
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..jit.api import functionalize
+        from .env import get_mesh
+
+        if len(stages) < 1:
+            raise ValueError("need at least one stage")
+        self.mesh = mesh if mesh is not None else get_mesh()
+        if self.mesh is None or pp_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"SpmdPipelineParallel needs a mesh with a "
+                f"'{pp_axis}' axis")
+        if int(self.mesh.shape[pp_axis]) != len(stages):
+            raise ValueError(
+                f"{len(stages)} stages vs pp={self.mesh.shape[pp_axis]}")
+        self.pp_axis = pp_axis
+        self.stages = list(stages)
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.num_micro = int(num_micro)
+
+        sds = [s.state_dict() for s in stages]
+        ref = sds[0]
+        # only stage 0's FORWARD is traced (stacked params, one block
+        # body) — stages must be the same class so the code is the same;
+        # a structural param match alone would let a divergent forward
+        # silently run stage 0's computation everywhere
+        for i, st in enumerate(stages[1:], 1):
+            if type(st) is not type(stages[0]):
+                raise ValueError(
+                    f"stage {i} is {type(st).__name__}, stage 0 is "
+                    f"{type(stages[0]).__name__}: SPMD 1F1B traces ONE "
+                    "stage body; use the host-driven PipelineParallel "
+                    "for heterogeneous stages")
+            sd = sds[i]
+            if set(sd) != set(ref) or any(
+                    tuple(sd[k].shape) != tuple(ref[k].shape)
+                    or sd[k].dtype != ref[k].dtype for k in ref):
+                raise ValueError(
+                    f"stage {i} is not structurally identical to stage "
+                    "0 (SPMD 1F1B stacks stage params; use the "
+                    "host-driven PipelineParallel for heterogeneous "
+                    "stages)")
+        frozen = [k for sd in sds for k, t in sd.items()
+                  if t.stop_gradient]
+        if frozen:
+            raise ValueError(
+                "stages carry stop_gradient tensors "
+                f"({sorted(set(frozen))[:3]}...): mutable buffers (BN "
+                "running stats) can't ride the 1F1B scan, and frozen "
+                "weights aren't supported by the stacked-grad update "
+                "yet; use the host-driven engine for either")
+
+        spec_p = NamedSharding(self.mesh, P(pp_axis))
+        S = len(stages)
+
+        def stacked(k):
+            # per-shard materialization: never builds the unsharded
+            # [S, ...] array on one device (a model picked for pp
+            # because ONE stage barely fits must not OOM at init)
+            shape = (S,) + tuple(ref[k].shape)
+
+            def cb(index):
+                lo = index[0].start or 0
+                hi = index[0].stop if index[0].stop is not None else S
+                import numpy as _np
+                arr = _np.stack([_np.asarray(sds[j][k]._data)
+                                 for j in range(lo, hi)])
+                return arr[(slice(None),) + tuple(index[1:])]
+            return jax.make_array_from_callback(shape, spec_p, cb)
+
+        self.params = {k: stacked(k) for k in ref}
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a: (jax.device_put(a, spec_p)
+                       if hasattr(a, "ndim") and a.ndim > 0 else a),
+            optimizer.init_state_tree(self.params))
+        self._pure = functionalize(stages[0].forward, stages[0])
+        self._step = None
+        self.last_dispatch_count = 0  # measured per train_batch
+
+    def _build(self):
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        from ..framework import Tensor as T
+        from .env import axis_context
+
+        M = self.num_micro
+        axis = self.pp_axis
+        pure = self._pure
+        loss_fn = self.loss_fn
+        mesh = self.mesh
+        # data rides 'dp' when the mesh has one (batch dim of each
+        # microbatch); pp-only meshes replicate
+        dp = "dp" if "dp" in mesh.axis_names else None
+        data_spec = P(None, dp)
+
+        def spmd(stacked, key, x, labels):
+            local = {k: v[0] for k, v in stacked.items()}
+
+            def block(p, xm):
+                out, _ = pure(p, key, xm)
+                return out
+
+            def lg(y, mb):
+                def lf(yy):
+                    lbl = jax.tree_util.tree_map(
+                        lambda a: lax.dynamic_index_in_dim(
+                            a, mb, 0, keepdims=False), labels)
+                    val = loss_fn(T(yy), *[T(l) for l in lbl])
+                    return val._data.astype(jnp.float32)
+                return jax.value_and_grad(lf)(y)
+
+            with axis_context(axis):
+                loss, g = one_f_one_b_schedule(block, lg, local, x, M,
+                                               axis=axis)
+            loss = lax.psum(loss, axis) / M
+            if dp is not None:
+                loss = lax.pmean(loss, dp)
+                g = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, dp), g)
+            g = jax.tree_util.tree_map(lambda a: a[None] / M, g)
+            return loss, g
+
+        smapped = shard_map(
+            spmd, mesh=mesh,
+            in_specs=({k: P(axis) for k in self.params}, P(),
+                      data_spec, data_spec),
+            out_specs=(P(), {k: P(axis) for k in self.params}),
+            check_vma=False)
+        opt = self.optimizer
+
+        def step(stacked, opt_state, key, lr, x, labels):
+            loss, grads = smapped(stacked, key, x, labels)
+            new_p, new_s = opt.apply_gradients_tree(
+                stacked, grads, opt_state, lr=lr)
+            return new_p, new_s, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_batch(self, inputs, labels=(), scaler=None):
+        import numpy as np
+        from ..core.generator import next_key
+        from ..framework import Tensor
+
+        if scaler is not None:
+            raise ValueError(
+                "loss scaling rides the host-driven engine; SPMD 1F1B "
+                "trains in f32/bf16 without a scaler")
+        x = inputs._data if isinstance(inputs, Tensor) else \
+            jnp.asarray(inputs)
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            (labels,)
+        lbl = tuple(l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                    for l in labels)
+        M = self.num_micro
+        if x.shape[0] % M != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by num_micro {M}")
+
+        def micro(a):
+            return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+        x = micro(x)
+        lbl = tuple(micro(l) for l in lbl)
+        if self._step is None:
+            self._step = self._build()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        dispatches = 0
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, next_key(), lr, x, lbl)
+        dispatches += 1   # count every compiled-program call here
+        self.last_dispatch_count = dispatches
+        return Tensor(loss)
+
+    def sync_to_layers(self):
+        """Write each stage's param slice back into its live Layer."""
+        for i, stage in enumerate(self.stages):
+            sd = stage.state_dict()
+            for k, v in self.params.items():
+                sd[k]._data = v[i]
+
+    def state_dict(self):
+        self.sync_to_layers()
+        return {"stages": [s.state_dict() for s in self.stages],
+                "opt_state": self.opt_state}
 
 
 class PipelineLayer(Layer):

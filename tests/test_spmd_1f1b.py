@@ -41,6 +41,19 @@ def _data(seed=0):
     return x, t, w, b
 
 
+class _TanhStage(nn.Layer):
+    """Linear+tanh stage used by both engine-parity tests."""
+
+    def __init__(self, wi, bi):
+        super().__init__()
+        self.lin = nn.Linear(H, H)
+        self.lin.weight.set_value(np.asarray(wi))
+        self.lin.bias.set_value(np.asarray(bi))
+
+    def forward(self, xx):
+        return paddle.tanh(self.lin(xx))
+
+
 def _loss_grad_fn(tgt):
     def lg(y, mb):
         t = lax.dynamic_index_in_dim(tgt, mb, 0, keepdims=False)
@@ -90,19 +103,8 @@ def test_1f1b_matches_host_engine_trajectory():
     lr = 1e-2
     x, tgt, w0, b0 = _data(seed=1)
 
-    # host-driven engine: one Linear+tanh Layer per stage, same weights
-    class Stage(nn.Layer):
-        def __init__(self, wi, bi):
-            super().__init__()
-            self.lin = nn.Linear(H, H)
-            self.lin.weight.set_value(np.asarray(wi))
-            self.lin.bias.set_value(np.asarray(bi))
-
-        def forward(self, xx):
-            return paddle.tanh(self.lin(xx))
-
     paddle.seed(0)
-    stages = [Stage(w0[i], b0[i]) for i in range(S)]
+    stages = [_TanhStage(w0[i], b0[i]) for i in range(S)]
     mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
     opt = paddle.optimizer.SGD(learning_rate=lr)
     engine = dist.PipelineParallel(
@@ -167,6 +169,76 @@ def test_1f1b_memory_is_ring_not_full_microbatch():
     assert not any(f"tensor<{m2}x{MB}x{H}xf32>" in ln
                    for ln in writes), (
         "activation stash is M-deep — 1F1B memory property lost")
+
+
+def test_spmd_engine_matches_host_engine():
+    """SpmdPipelineParallel (one program/step) vs the host-driven
+    PipelineParallel: same stages, same Adam, identical per-step
+    losses through the same train_batch surface."""
+    lr = 1e-2
+    x, tgt, w0, b0 = _data(seed=2)
+
+    mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+    xf = paddle.to_tensor(np.asarray(x.reshape(M * MB, H)))
+    tf = paddle.to_tensor(np.asarray(tgt.reshape(M * MB, H)))
+
+    paddle.seed(0)
+    host = dist.PipelineParallel(
+        [_TanhStage(w0[i], b0[i]) for i in range(S)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.Adam(learning_rate=lr), num_micro=M,
+        mesh=mesh)
+    host_losses = [float(host.train_batch(xf, tf).item())
+                   for _ in range(3)]
+
+    paddle.seed(0)
+    spmd = dist.SpmdPipelineParallel(
+        [_TanhStage(w0[i], b0[i]) for i in range(S)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.Adam(learning_rate=lr), num_micro=M,
+        mesh=mesh)
+    spmd_losses = [float(spmd.train_batch(xf, tf).item())
+                   for _ in range(3)]
+    assert spmd.last_dispatch_count == 1
+    np.testing.assert_allclose(spmd_losses, host_losses, rtol=2e-5)
+
+    # param slices written back into the live stage Layers
+    spmd.sync_to_layers()
+    w_after = np.asarray(spmd.params["lin.weight"])
+    np.testing.assert_array_equal(
+        np.asarray(spmd.stages[1].lin.weight._data), w_after[1])
+
+
+def test_spmd_engine_rejects_heterogeneous_and_buffered():
+    mesh = dist.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+
+    class A(nn.Layer):
+        def __init__(self, n):
+            super().__init__()
+            self.lin = nn.Linear(H, n)
+
+        def forward(self, xx):
+            return self.lin(xx)
+
+    with pytest.raises(ValueError, match="structurally identical"):
+        dist.SpmdPipelineParallel(
+            [A(H), A(H + 1)], lambda o, t: o.mean(),
+            paddle.optimizer.SGD(learning_rate=0.1), num_micro=2,
+            mesh=mesh)
+
+    class B(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(H)
+
+        def forward(self, xx):
+            return self.bn(xx)
+
+    with pytest.raises(ValueError, match="buffers"):
+        dist.SpmdPipelineParallel(
+            [B(), B()], lambda o, t: o.mean(),
+            paddle.optimizer.SGD(learning_rate=0.1), num_micro=2,
+            mesh=mesh)
 
 
 def test_1f1b_rejects_shape_changing_block():
