@@ -57,6 +57,10 @@ def bench_ernie(on_tpu):
     # math, O(1)-in-depth compile) — sweep both on hardware to record
     # which layout XLA:TPU schedules faster at depth 12
     scan = bool(int(os.environ.get("PD_BENCH_SCAN_LAYERS", "0")))
+    # PD_BENCH_CHUNKED_CE=1 streams the MLM head + CE through vocab
+    # blocks (F.linear_cross_entropy) — the [b*s, vocab] logits never
+    # materialize; A/B lever for head-side HBM traffic
+    chunked = bool(int(os.environ.get("PD_BENCH_CHUNKED_CE", "0")))
     # hardware-sweep knobs (TPU config only; the CPU smoke stays tiny):
     # per-chip batch and AMP level are the two cheapest MFU levers —
     # larger batch raises arithmetic intensity, O2 keeps bf16 weights
@@ -80,7 +84,7 @@ def bench_ernie(on_tpu):
                           num_hidden_layers=L, num_attention_heads=nh,
                           intermediate_size=inter,
                           max_position_embeddings=512,
-                          scan_layers=scan)
+                          scan_layers=scan, chunked_ce=chunked)
         seqlen = 512
         batch = int(os.environ.get("PD_BENCH_ERNIE_BATCH", batch))
     else:
@@ -91,7 +95,7 @@ def bench_ernie(on_tpu):
                           num_hidden_layers=4, num_attention_heads=8,
                           intermediate_size=1024,
                           max_position_embeddings=128,
-                          scan_layers=scan)
+                          scan_layers=scan, chunked_ce=chunked)
         batch, seqlen, steps = 8, 128, 4
 
     paddle.seed(0)
@@ -99,9 +103,11 @@ def bench_ernie(on_tpu):
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters(),
                                  weight_decay=0.01)
-    step = TrainStep(
-        model, lambda out, labels: ErnieForPretraining.pretraining_loss(
-            out, labels), opt, amp_level=amp_level, amp_dtype="bfloat16")
+    loss_fn = (model.chunked_pretraining_loss if chunked
+               else (lambda out, labels:
+                     ErnieForPretraining.pretraining_loss(out, labels)))
+    step = TrainStep(model, loss_fn, opt, amp_level=amp_level,
+                     amp_dtype="bfloat16")
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32)

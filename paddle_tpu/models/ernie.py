@@ -42,7 +42,8 @@ class ErnieConfig:
                  use_flash_attention=True, moe_num_experts=0,
                  moe_top_k=2, moe_every_n_layers=2,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 sequence_parallel=False, scan_layers=False):
+                 sequence_parallel=False, scan_layers=False,
+                 chunked_ce=False, ce_vocab_block=2048):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -56,6 +57,14 @@ class ErnieConfig:
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
         self.use_flash_attention = use_flash_attention
+        # chunked_ce: the MLM head + CE stream through vocab blocks
+        # (F.linear_cross_entropy) — the [b*s, vocab] logits are never
+        # materialized. forward() then returns the transformed HIDDEN
+        # states in place of logits, and pretraining_loss must be the
+        # INSTANCE method chunked_pretraining_loss (it owns the tied
+        # decoder weights); eval/generate flows should keep this off
+        self.chunked_ce = chunked_ce
+        self.ce_vocab_block = ce_vocab_block
         # MoE variant: every n-th layer's FFN becomes a top-k expert
         # mixture over the 'ep' mesh axis (distributed/moe.py); 0 = dense
         self.moe_num_experts = moe_num_experts
@@ -385,6 +394,7 @@ class ErnieForPretraining(nn.Layer):
         self.ernie = ErnieModel(config, **kwargs)
         self.moe_aux_loss = self.ernie.moe_aux_loss
         cfg = self.ernie.config
+        self.config = cfg
         self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
                                      epsilon=cfg.layer_norm_eps)
@@ -398,6 +408,11 @@ class ErnieForPretraining(nn.Layer):
         seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                  attention_mask, seq_lens=seq_lens)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        if self.config.chunked_ce:
+            # the head matmul moves INTO the loss
+            # (chunked_pretraining_loss streams it through vocab
+            # blocks); logits are never built
+            return h, self.nsp(pooled)
         # weight-tied decoder: logits = h @ E^T  (vocab-sharded matmul).
         # Done in 2D [b*s, hidden] — a 3D dot here gives the [b, s, V]
         # logits a batch-major layout that XLA then has to transpose-copy
@@ -416,6 +431,28 @@ class ErnieForPretraining(nn.Layer):
         logits = (lg + bias).reshape([b, s, -1])
         nsp_logits = self.nsp(pooled)
         return logits, nsp_logits
+
+    def chunked_pretraining_loss(self, outputs, mlm_labels,
+                                 nsp_labels=None, ignore_index=-100):
+        """Loss for chunked_ce=True models: outputs carry HIDDEN states
+        (forward skipped the head matmul); the tied-decoder projection
+        + CE stream through vocab blocks via F.linear_cross_entropy —
+        no [b*s, vocab] logits ever exist. Bind as the TrainStep
+        loss_fn: TrainStep(model, model.chunked_pretraining_loss, ...)
+        — the tied weights are read inside the traced step, so their
+        grads flow exactly like the dense path's."""
+        h, nsp_logits = outputs
+        w_t = manipulation.t(self.ernie.embeddings.word_embeddings.weight)
+        mlm = F.linear_cross_entropy(
+            h.reshape([-1, h.shape[-1]]), w_t, self.mlm_bias,
+            mlm_labels.reshape([-1]),
+            vocab_block=min(self.config.ce_vocab_block,
+                            self.config.vocab_size),
+            ignore_index=ignore_index)
+        if nsp_labels is None:
+            return mlm
+        nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
+        return mlm + nsp
 
     @staticmethod
     def pretraining_loss(outputs, mlm_labels, nsp_labels=None,

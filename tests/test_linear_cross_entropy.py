@@ -1,0 +1,121 @@
+"""Vocab-chunked fused projection+CE (F.linear_cross_entropy): the
+[N, vocab] logits never exist — flash-attention's online-softmax trick
+applied to the vocabulary axis, custom backward rematerializes per
+block. Capability beyond the reference (its softmax-with-CE operator
+consumes pre-materialized logits —
+/root/reference/paddle/fluid/operators, the softmax+CE fused kernel).
+
+Receipts: value+grad parity vs the dense path (incl. ignore_index,
+non-divisible vocab padding, bf16), the no-logits HLO check on a full
+ERNIE train step, and TrainStep loss parity dense vs chunked.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+from paddle_tpu.static import TrainStep
+
+R = np.random.RandomState
+
+
+@pytest.mark.parametrize("v,block", [(64, 16), (60, 16), (64, 64)])
+def test_parity_vs_dense(v, block):
+    rng = R(0)
+    n, d = 12, 16
+    h = paddle.to_tensor(rng.randn(n, d).astype(np.float32),
+                         stop_gradient=False)
+    wt = paddle.to_tensor(rng.randn(d, v).astype(np.float32) * 0.2,
+                          stop_gradient=False)
+    b = paddle.to_tensor(rng.randn(v).astype(np.float32) * 0.1,
+                         stop_gradient=False)
+    lbl = rng.randint(0, v, (n,)).astype(np.int64)
+    lbl[3] = -100
+    lblt = paddle.to_tensor(lbl)
+    loss = F.linear_cross_entropy(h, wt, b, lblt, vocab_block=block)
+    loss.backward()
+
+    hh = paddle.to_tensor(np.asarray(h._data), stop_gradient=False)
+    ww = paddle.to_tensor(np.asarray(wt._data), stop_gradient=False)
+    bb = paddle.to_tensor(np.asarray(b._data), stop_gradient=False)
+    ref = F.cross_entropy(paddle.add(hh @ ww, bb), lblt,
+                          ignore_index=-100)
+    ref.backward()
+    np.testing.assert_allclose(float(loss.item()), float(ref.item()),
+                               rtol=1e-6)
+    for got, want in ((h, hh), (wt, ww), (b, bb)):
+        np.testing.assert_allclose(np.asarray(got.grad._data),
+                                   np.asarray(want.grad._data),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_inputs_keep_f32_accumulation():
+    rng = R(1)
+    n, d, v = 8, 16, 32
+    h32 = rng.randn(n, d).astype(np.float32)
+    w32 = (rng.randn(d, v) * 0.2).astype(np.float32)
+    lbl = paddle.to_tensor(rng.randint(0, v, (n,)).astype(np.int64))
+    h = paddle.Tensor(jnp.asarray(h32).astype(jnp.bfloat16))
+    wt = paddle.Tensor(jnp.asarray(w32).astype(jnp.bfloat16))
+    loss = F.linear_cross_entropy(h, wt, None, lbl, vocab_block=16)
+    assert loss.dtype == jnp.float32    # losses reduce in f32
+    ref = F.cross_entropy(
+        paddle.Tensor(jnp.asarray(h32) @ jnp.asarray(w32)), lbl)
+    np.testing.assert_allclose(float(loss.item()), float(ref.item()),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_no_logits_buffer_in_ernie_train_step():
+    """chunked_ce=True ERNIE: the LOWERED full train step contains no
+    [b*s, vocab]-shaped tensor — the multi-GB head buffer is gone."""
+    paddle.seed(0)
+    cfg = ErnieConfig.tiny(chunked_ce=True, ce_vocab_block=256)
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(model, model.chunked_pretraining_loss, opt)
+    rng = R(0)
+    bsz, seq = 2, 16
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (bsz, seq)).astype(np.int32))
+    lbl = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (bsz, seq)).astype(np.int32))
+    lowered = step.aot_lower((ids,), (lbl,))
+    txt = lowered.as_text()
+    n_tok = bsz * seq
+    bad = [f"tensor<{n_tok}x{cfg.vocab_size}x",
+           f"tensor<{bsz}x{seq}x{cfg.vocab_size}x"]
+    hits = [b for b in bad if b in txt]
+    assert not hits, f"full logits buffer present: {hits}"
+    # the chunk shape IS there (the streaming working set)
+    assert f"tensor<{n_tok}x{min(256, cfg.vocab_size)}x" in txt
+
+
+def test_trainstep_loss_parity_dense_vs_chunked():
+    """Same weights/batch: chunked-CE TrainStep loss == dense-path
+    TrainStep loss (first step, Adam)."""
+    rng = R(2)
+    bsz, seq = 2, 16
+    ids = rng.randint(0, 1024, (bsz, seq)).astype(np.int32)
+    lbl = rng.randint(0, 1024, (bsz, seq)).astype(np.int32)
+
+    def run(chunked):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny(chunked_ce=chunked, ce_vocab_block=256,
+                               hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0)
+        model = ErnieForPretraining(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        loss_fn = (model.chunked_pretraining_loss if chunked
+                   else (lambda o, l:
+                         ErnieForPretraining.pretraining_loss(o, l)))
+        step = TrainStep(model, loss_fn, opt)
+        return [float(step(paddle.to_tensor(ids),
+                           paddle.to_tensor(lbl)).item())
+                for _ in range(2)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)

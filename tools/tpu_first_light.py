@@ -254,6 +254,8 @@ def main():
                            "PD_BENCH_ONLY": "ernie"}),
                 ("scan_layers", {"PD_BENCH_SCAN_LAYERS": "1",
                                  "PD_BENCH_ONLY": "ernie"}),
+                ("chunked_ce", {"PD_BENCH_CHUNKED_CE": "1",
+                                "PD_BENCH_ONLY": "ernie"}),
                 ("ernie_large", {"PD_BENCH_ERNIE": "large",
                                  "PD_BENCH_ONLY": "ernie"}),
         ):
