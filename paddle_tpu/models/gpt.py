@@ -21,7 +21,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, max_seq_len=1024, dropout=0.1,
                  layer_norm_eps=1e-5, use_flash_attention=True,
-                 scan_layers=False):
+                 scan_layers=False, chunked_ce=False,
+                 ce_vocab_block=2048):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -30,6 +31,13 @@ class GPTConfig:
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
         self.use_flash_attention = use_flash_attention
+        # chunked_ce: training-only — forward returns HIDDEN states and
+        # chunked_lm_loss streams the tied head through vocab blocks
+        # (F.linear_cross_entropy, no [b*s, vocab] logits). generate()
+        # reads weights directly (models/generation.py) and is
+        # unaffected, but logits-consuming eval flows need this off
+        self.chunked_ce = chunked_ce
+        self.ce_vocab_block = ce_vocab_block
         # one lax.scan over stacked block params — compile time / HLO
         # size O(1) in depth (nn.ScannedStack; see models/ernie.py)
         self.scan_layers = bool(scan_layers)
@@ -118,6 +126,8 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         h = self.gpt(input_ids)
+        if self.gpt.config.chunked_ce:
+            return h   # head moves into chunked_lm_loss
         w = self.gpt.wte.weight
         # 2D head matmul: keeps the [b*s, vocab] logits row-major so XLA
         # never transpose-copies the largest tensor (see ernie.py)
@@ -130,6 +140,18 @@ class GPTForCausalLM(nn.Layer):
         return F.cross_entropy(
             logits[:, :-1].reshape([-1, logits.shape[-1]]),
             labels[:, 1:].reshape([-1]))
+
+    def chunked_lm_loss(self, hidden, labels):
+        """Loss for chunked_ce=True models: `hidden` is forward()'s
+        output; the tied head + CE stream through vocab blocks — the
+        [b*s, vocab] logits never exist. Bind as the TrainStep loss_fn:
+        TrainStep(model, model.chunked_lm_loss, ...)."""
+        cfg = self.gpt.config
+        h2 = hidden[:, :-1].reshape([-1, hidden.shape[-1]])
+        w_t = manipulation.t(self.gpt.wte.weight)
+        return F.linear_cross_entropy(
+            h2, w_t, None, labels[:, 1:].reshape([-1]),
+            vocab_block=min(cfg.ce_vocab_block, cfg.vocab_size))
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k=None, eos_token_id=None, pad_token_id=0,

@@ -94,6 +94,29 @@ def test_no_logits_buffer_in_ernie_train_step():
     assert f"tensor<{n_tok}x{min(256, cfg.vocab_size)}x" in txt
 
 
+def test_gpt_chunked_lm_loss_parity():
+    """GPT path: chunked_ce TrainStep losses == dense lm_loss path."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    rng = R(3)
+    ids = rng.randint(0, 512, (2, 16)).astype(np.int32)
+
+    def run(chunked):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dropout=0.0,
+                        chunked_ce=chunked, ce_vocab_block=128)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        loss_fn = (model.chunked_lm_loss if chunked
+                   else (lambda o, l: GPTForCausalLM.lm_loss(o, l)))
+        step = TrainStep(model, loss_fn, opt)
+        x = paddle.to_tensor(ids)
+        return [float(step(x, x).item()) for _ in range(2)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
 def test_trainstep_loss_parity_dense_vs_chunked():
     """Same weights/batch: chunked-CE TrainStep loss == dense-path
     TrainStep loss (first step, Adam)."""
