@@ -400,6 +400,10 @@ class SpmdPipelineParallel:
 
         def micro(a):
             return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+        # host-local batches are valid jit inputs even on a
+        # multi-process mesh (every process provides the same batch —
+        # deterministic loader contract; verified by
+        # tests/test_spmd_1f1b_multiproc.py)
         x = micro(x)
         lbl = tuple(micro(l) for l in lbl)
         if self._step is None:

@@ -1,0 +1,71 @@
+"""Multi-controller receipt for the SPMD 1F1B engine: pp CROSSES a
+real process boundary (2 processes x 2 devices -> pp=4 through the
+repo's own launcher + jax.distributed). This is the configuration the
+host-driven engine cannot run at all (its controller must address
+every stage's devices); the one-program schedule must train with
+per-rank losses equal to each other AND to the 1-process control.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_spmd_1f1b_across_process_boundary(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "PD_TEST_COORD_PORT": str(_free_port()),
+        "PD_TEST_OUT": str(tmp_path),
+        "XLA_FLAGS": "",
+    })
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2",
+           os.path.join(REPO, "tests", "dist_spmd_pipeline_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=420)
+    assert res.returncode == 0, (
+        f"launch failed\nstdout:\n{res.stdout[-2000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+    results = []
+    for r in range(2):
+        path = tmp_path / f"rank{r}.json"
+        assert path.exists(), (f"rank {r} wrote no result; "
+                               f"stderr:\n{res.stderr[-3000:]}")
+        results.append(json.loads(path.read_text()))
+    np.testing.assert_allclose(results[0]["losses"],
+                               results[1]["losses"], rtol=1e-6)
+
+    # 1-process control: same pp=4 mesh shape on 4 local devices
+    script = r"""
+import json, sys
+sys.path.insert(0, %r)
+sys.path.insert(0, %r)
+import jax
+from dist_spmd_pipeline_worker import build_and_run  # pins 2 devices
+jax.config.update("jax_num_cpu_devices", 4)          # control wants 4
+import paddle_tpu.distributed as dist
+mesh = dist.build_mesh({"pp": 4})
+print("CONTROL:" + json.dumps(build_and_run(mesh)))
+""" % (REPO, os.path.join(REPO, "tests"))
+    ctl = subprocess.run([sys.executable, "-c", script], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert ctl.returncode == 0, ctl.stderr[-3000:]
+    control = json.loads(
+        [l for l in ctl.stdout.splitlines()
+         if l.startswith("CONTROL:")][-1][len("CONTROL:"):])
+    np.testing.assert_allclose(results[0]["losses"], control,
+                               rtol=2e-5)
