@@ -18,6 +18,7 @@ from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                               VocabParallelEmbedding, split)  # noqa: F401
 from .pipeline import (LayerDesc, PipelineLayer,  # noqa: F401
                        SpmdPipelineParallel, gpipe_schedule,
+                       interleaved_one_f_one_b_schedule,
                        one_f_one_b_schedule)
 from .embedding_kv import (EmbeddingKV, SparseEmbedding,  # noqa: F401
                            distributed_lookup_table, pull_sparse,

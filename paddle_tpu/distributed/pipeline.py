@@ -27,7 +27,8 @@ from ..ops.registry import run_op
 from .env import PIPE_AXIS, current_axis_name
 
 __all__ = ["PipelineLayer", "gpipe_schedule", "one_f_one_b_schedule",
-           "SpmdPipelineParallel", "LayerDesc"]
+           "interleaved_one_f_one_b_schedule", "SpmdPipelineParallel",
+           "LayerDesc"]
 
 
 class LayerDesc:
@@ -214,6 +215,248 @@ def one_f_one_b_schedule(block_fn, loss_grad_fn, stage_params, x,
               jnp.zeros((), jnp.float32))
     (ai, di, sv, dr, gacc, lacc), _ = lax.scan(
         tick, carry0, jnp.arange(T))
+    return lacc, gacc
+
+
+def _min_slots(intervals_by_m):
+    """Smallest R such that slot m % R never holds two overlapping
+    live intervals (the exact ring size the static timetable needs)."""
+    ms = sorted(intervals_by_m)
+    for r in range(1, len(ms) + 1):
+        ok = True
+        for i, m1 in enumerate(ms):
+            for m2 in ms[i + 1:]:
+                if m1 % r != m2 % r:
+                    continue
+                a1, b1 = intervals_by_m[m1]
+                a2, b2 = intervals_by_m[m2]
+                if a1 <= b2 and a2 <= b1:
+                    ok = False
+                    break
+            if not ok:
+                break
+        if ok:
+            return r
+    return max(1, len(ms))
+
+
+def interleaved_one_f_one_b_schedule(block_fn, loss_grad_fn,
+                                     stage_params, x, num_micro: int,
+                                     v: int, axis: str = PIPE_AXIS):
+    """Megatron-interleaved (virtual pipeline) 1F1B as ONE compiled
+    SPMD program: each device hosts `v` model chunks (global stage
+    g = c·S + d lives at chunk c of device d), shrinking the bubble
+    from (p−1)/(M+p−1) toward (p−1)/(vM+p−1). The per-tick work
+    assignment comes from the SAME schedule machinery the host engine
+    proves by simulation (pipeline_engine.build_interleaved_schedule +
+    tick_table) and is compiled in as static int32 tables consumed by
+    `lax.cond` branches — every forward hop is the +1 ring and every
+    backward hop the −1 ring (stage g → g+1 is device g%S → (g+1)%S),
+    so one ppermute pair per tick carries all transfers. Backward
+    rematerializes the chunk forward from arrival buffers whose ring
+    sizes are computed EXACTLY from the timetable's live intervals
+    (_min_slots) — bounded like non-interleaved 1F1B, not M-deep.
+
+    stage_params: this device's chunk pytree, leading dim v. Stack the
+    GLOBAL parameters device-major: an [S, v, ...] array whose [d, c]
+    row holds global stage g = c·S + d, sharded P(axis) on dim 0 —
+    inside shard_map pass the squeezed local [v, ...] shard.
+    x: [num_micro, micro_batch, ...]; block input aval == output aval.
+    Returns (loss_sum, grad_acc [v, ...]) like one_f_one_b_schedule.
+    """
+    import numpy as np
+    from .pipeline_engine import build_interleaved_schedule
+
+    S = lax.axis_size(axis)
+    # the schedule tables need the CONCRETE mesh size — resolve from
+    # the enclosing mesh (axis_size is traced only inside shard_map;
+    # here it's a ShapedArray-free int under shard_map tracing)
+    S = int(S)
+    M = int(num_micro)
+    v = int(v)
+    Sg = v * S
+
+    _, finish = build_interleaved_schedule(S, v, M,
+                                           return_finish=True)
+    T = max(finish.values())
+
+    def dev(s):
+        return s % S
+
+    def chunk(s):
+        return s // S
+
+    # -- static per-tick per-device tables (T+2: an arrival row lands
+    # at t+1; by the dependency argument no sender finishes at T, but
+    # the extra row keeps table building total) ---------------------------
+    z = lambda: np.zeros((T + 2, S), np.int32)
+    f_act, f_chunk, f_mb, f_s0, f_last = z(), z(), z(), z(), z()
+    b_act, b_chunk, b_mb = z(), z(), z()
+    rf_store, rf_chunk, rf_mb = z(), z(), z()
+    rb_store, rb_chunk, rb_mb = z(), z(), z()
+    for (op, s, m), t in finish.items():
+        d = dev(s)
+        c = chunk(s)
+        if op == "F":
+            f_act[t, d], f_chunk[t, d], f_mb[t, d] = 1, c, m
+            f_s0[t, d] = 1 if s == 0 else 0
+            f_last[t, d] = 1 if s == Sg - 1 else 0
+            if s < Sg - 1:   # arrival at the consumer NEXT tick
+                rf_store[t + 1, dev(s + 1)] = 1
+                rf_chunk[t + 1, dev(s + 1)] = chunk(s + 1)
+                rf_mb[t + 1, dev(s + 1)] = m
+        else:
+            b_act[t, d], b_chunk[t, d], b_mb[t, d] = 1, c, m
+            if s > 0:
+                rb_store[t + 1, dev(s - 1)] = 1
+                rb_chunk[t + 1, dev(s - 1)] = chunk(s - 1)
+                rb_mb[t + 1, dev(s - 1)] = m
+
+    # -- exact ring sizes from live intervals ------------------------------
+    # act slot (d, c): stores at arrival (or at F for s==0), last read
+    # by B's remat; dy slot: stores at arrival (or at last-stage F),
+    # read by B
+    need_r = 1
+    need_rb = 1
+    for d in range(S):
+        for c in range(v):
+            s = c * S + d
+            acts = {}
+            dys = {}
+            for m in range(M):
+                store = (finish[("F", s, m)] if s == 0
+                         else finish[("F", s - 1, m)] + 1)
+                acts[m] = (store, finish[("B", s, m)])
+                dstore = (finish[("F", s, m)] if s == Sg - 1
+                          else finish[("B", s + 1, m)] + 1)
+                dys[m] = (dstore, finish[("B", s, m)])
+            need_r = max(need_r, _min_slots(acts))
+            need_rb = max(need_rb, _min_slots(dys))
+    R, Rb = need_r, need_rb
+
+    x0 = x[0]
+    one_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    act = jax.eval_shape(block_fn, one_params, x0)
+    if (act.shape, act.dtype) != (x0.shape, x0.dtype):
+        raise ValueError(
+            f"interleaved 1F1B stages must map aval->same aval; got "
+            f"{x0.shape}/{x0.dtype} -> {act.shape}/{act.dtype}")
+    zeros_act = jnp.zeros(act.shape, act.dtype)
+    d_idx = lax.axis_index(axis)
+    perm_fwd = [(r, (r + 1) % S) for r in range(S)]
+    perm_bwd = [(r, (r - 1) % S) for r in range(S)]
+
+    # rows 0 and T+1 are provably all-zero (finish starts at 1; no
+    # sender finishes at T) — slice them off so the compiled step
+    # doesn't execute two dead ticks of ppermute+cond
+    assert rf_store[T + 1].sum() == 0 and rb_store[T + 1].sum() == 0, (
+        "schedule invariant broken: an arrival landed past tick T")
+    tables = tuple(jnp.asarray(a[1:T + 1]) for a in (
+        f_act, f_chunk, f_mb, f_s0, f_last, b_act, b_chunk, b_mb,
+        rf_store, rf_chunk, rf_mb, rb_store, rb_chunk, rb_mb))
+
+    def pick(vec):
+        return lax.dynamic_index_in_dim(vec, d_idx, 0, keepdims=False)
+
+    def cparams(c):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            stage_params)
+
+    def tick(carry, xs):
+        act_in, dy_in, actbuf, dybuf, gacc, lacc = carry
+        (fa, fc, fm, fs0, fl, ba, bc, bm,
+         rfs, rfc, rfm, rbs, rbc, rbm) = [pick(t_) for t_ in xs]
+
+        # 1) store last tick's arrivals
+        def store_act(buf):
+            return lax.dynamic_update_index_in_dim(
+                lax.dynamic_index_in_dim(buf, rfc, 0, keepdims=False),
+                act_in, rfm % R, 0)
+        actbuf = lax.cond(
+            rfs == 1,
+            lambda b: lax.dynamic_update_index_in_dim(
+                b, store_act(b), rfc, 0),
+            lambda b: b, actbuf)
+
+        def store_dy(buf):
+            return lax.dynamic_update_index_in_dim(
+                lax.dynamic_index_in_dim(buf, rbc, 0, keepdims=False),
+                dy_in, rbm % Rb, 0)
+        dybuf = lax.cond(
+            rbs == 1,
+            lambda b: lax.dynamic_update_index_in_dim(
+                b, store_dy(b), rbc, 0),
+            lambda b: b, dybuf)
+
+        # 2) forward unit
+        def do_f(ops):
+            actbuf, dybuf, lacc = ops
+            inp = jnp.where(
+                fs0 == 1,
+                lax.dynamic_index_in_dim(x, fm, 0, keepdims=False),
+                lax.dynamic_index_in_dim(
+                    lax.dynamic_index_in_dim(actbuf, fc, 0,
+                                             keepdims=False),
+                    fm % R, 0, keepdims=False))
+            # save the input for the remat backward (s==0 has no
+            # arrival store; others overwrite the same slot — harmless)
+            row = lax.dynamic_update_index_in_dim(
+                lax.dynamic_index_in_dim(actbuf, fc, 0, keepdims=False),
+                inp, fm % R, 0)
+            actbuf = lax.dynamic_update_index_in_dim(actbuf, row, fc, 0)
+            y = block_fn(cparams(fc), inp)
+
+            def at_last(ops2):
+                dybuf, lacc = ops2
+                l, dy = loss_grad_fn(y, fm)
+                drow = lax.dynamic_update_index_in_dim(
+                    lax.dynamic_index_in_dim(dybuf, v - 1, 0,
+                                             keepdims=False),
+                    dy, fm % Rb, 0)
+                dybuf = lax.dynamic_update_index_in_dim(
+                    dybuf, drow, v - 1, 0)
+                return dybuf, lacc + l.astype(jnp.float32)
+            dybuf, lacc = lax.cond(fl == 1, at_last, lambda o: o,
+                                   (dybuf, lacc))
+            y_send = jnp.where(fl == 1, jnp.zeros_like(y), y)
+            return y_send, actbuf, dybuf, lacc
+
+        y_f, actbuf, dybuf, lacc = lax.cond(
+            fa == 1, do_f,
+            lambda ops: (zeros_act, ops[0], ops[1], ops[2]),
+            (actbuf, dybuf, lacc))
+
+        # 3) backward unit (rematerialized)
+        def do_b(gacc):
+            x_saved = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(actbuf, bc, 0, keepdims=False),
+                bm % R, 0, keepdims=False)
+            dy = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(dybuf, bc, 0, keepdims=False),
+                bm % Rb, 0, keepdims=False)
+            p = cparams(bc)
+            _, vjp = jax.vjp(block_fn, p, x_saved)
+            gp, gx = vjp(dy)
+            gacc = jax.tree_util.tree_map(
+                lambda G, g: lax.dynamic_update_index_in_dim(
+                    G, lax.dynamic_index_in_dim(
+                        G, bc, 0, keepdims=False) + g, bc, 0),
+                gacc, gp)
+            return gx, gacc
+
+        gx_b, gacc = lax.cond(ba == 1, do_b,
+                              lambda g: (zeros_act, g), gacc)
+        act_in = lax.ppermute(y_f, axis, perm_fwd)
+        dy_in = lax.ppermute(gx_b, axis, perm_bwd)
+        return (act_in, dy_in, actbuf, dybuf, gacc, lacc), None
+
+    carry0 = (zeros_act, zeros_act,
+              jnp.zeros((v, R) + x0.shape, x0.dtype),
+              jnp.zeros((v, Rb) + act.shape, act.dtype),
+              jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+              jnp.zeros((), jnp.float32))
+    (ai, di, ab, db, gacc, lacc), _ = lax.scan(tick, carry0, tables)
     return lacc, gacc
 
 

@@ -109,8 +109,8 @@ def build_1f1b_schedule(n_stages: int, num_micro: int,
     return emitted
 
 
-def build_interleaved_schedule(n_dev: int, v: int,
-                               num_micro: int) -> List[Tuple[str, int, int]]:
+def build_interleaved_schedule(n_dev: int, v: int, num_micro: int,
+                               return_finish: bool = False):
     """Virtual-pipeline (Megatron-interleaved) order for n_dev physical
     ranks each hosting v model chunks (stage s runs on rank s % n_dev):
     the bubble shrinks from (p-1)/(M+p-1) to (p-1)/(vM+p-1) — measured
@@ -157,16 +157,20 @@ def build_interleaved_schedule(n_dev: int, v: int,
             seq.append(b_op(d, nb))
             nb += 1
         progs.append(seq)
-    order, _ = _run_ticks(progs, S)
+    order, _, finish = _run_ticks(progs, S, return_finish=True)
+    if return_finish:
+        return order, finish
     return order
 
 
 def _run_ticks(queues: List[List[Tuple[str, int, int]]],
-               n_stages: int) -> Tuple[List[Tuple[str, int, int]], int]:
-    """Unit-time tick machine shared by the interleaved builder and the
-    simulator (ONE copy of the dependency rules): each rank executes
-    its queue in order, one op per tick, waiting for F(s-1,m)→F(s,m)
-    and {F(s,m), B(s+1,m)}→B(s,m). Returns (global order, ticks)."""
+               n_stages: int, return_finish: bool = False):
+    """Unit-time tick machine shared by the interleaved builder, the
+    simulator, and the SPMD interleaved schedule's static tables (ONE
+    copy of the dependency rules): each rank executes its queue in
+    order, one op per tick, waiting for F(s-1,m)→F(s,m) and
+    {F(s,m), B(s+1,m)}→B(s,m). Returns (global order, ticks); the
+    per-op tick assignment is exposed via tick_table()."""
     finish: Dict[Tuple[str, int, int], int] = {}
     pos = [0] * len(queues)
     tick = 0
@@ -192,7 +196,23 @@ def _run_ticks(queues: List[List[Tuple[str, int, int]]],
                 order.append((op, s, m))
                 ran = True
         assert ran, "schedule deadlock"
+    if return_finish:
+        return order, tick, finish
     return order, tick
+
+
+def tick_table(sched: List[Tuple[str, int, int]], n_dev: int,
+               dev_of=None) -> Dict[Tuple[str, int, int], int]:
+    """Per-op tick assignment of a global order under the same machine
+    (consumers run strictly after producers' ticks) — the static
+    timetable the SPMD interleaved schedule compiles against."""
+    dev_of = dev_of or (lambda s: s % n_dev)
+    queues: List[List[Tuple[str, int, int]]] = [[] for _ in range(n_dev)]
+    for op in sched:
+        queues[dev_of(op[1])].append(op)
+    S = 1 + max(s for _, s, _ in sched)
+    _, _, finish = _run_ticks(queues, S, return_finish=True)
+    return finish
 
 
 def simulate_schedule(sched: List[Tuple[str, int, int]], n_dev: int,

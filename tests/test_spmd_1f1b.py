@@ -241,6 +241,91 @@ def test_spmd_engine_rejects_heterogeneous_and_buffered():
             mesh=mesh)
 
 
+def _stage_layout(Wg, Bg, S, V, Hd):
+    """Global [Sg, ...] stacks -> device-major [S, V, ...] layout
+    (global stage g = c*S + d lives at [d, c])."""
+    W = np.zeros((S, V) + Wg.shape[1:], np.float32)
+    B = np.zeros((S, V) + Bg.shape[1:], np.float32)
+    for g in range(S * V):
+        W[g % S, g // S] = Wg[g]
+        B[g % S, g // S] = Bg[g]
+    return jnp.asarray(W), jnp.asarray(B)
+
+
+@pytest.mark.parametrize("s,v,m", [(2, 2, 4), (4, 2, 8), (2, 3, 6)])
+def test_interleaved_1f1b_parity(s, v, m):
+    """Interleaved (virtual pipeline) SPMD 1F1B: loss AND per-stage
+    grads == analytic AD through all v*s global stages, for several
+    (devices, chunks, microbatches) shapes. The per-tick tables come
+    from the SAME schedule machine the host engine proves by
+    simulation (pipeline_engine.tick_table)."""
+    from paddle_tpu.distributed.pipeline import (
+        interleaved_one_f_one_b_schedule)
+    sg = s * v
+    mesh = dist.build_mesh({"pp": s}, devices=jax.devices()[:s])
+    rng = np.random.RandomState(0)
+    Wg = rng.randn(sg, H, H).astype(np.float32) * 0.3
+    Bg = rng.randn(sg, H).astype(np.float32) * 0.1
+    W, B = _stage_layout(Wg, Bg, s, v, H)
+    x = jnp.asarray(rng.randn(m, MB, H).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(m, MB, H).astype(np.float32))
+
+    def spmd(x, t, W, B):
+        with env.axis_context("pp"):
+            loss, (gw, gb) = interleaved_one_f_one_b_schedule(
+                _block_fn, _loss_grad_fn(t), (W[0], B[0]), x, m, v,
+                axis="pp")
+        return (lax.psum(loss, "pp") / m, gw[None] / m, gb[None] / m)
+
+    loss, gw, gb = jax.jit(shard_map(
+        spmd, mesh=mesh, in_specs=(P(), P(), P("pp"), P("pp")),
+        out_specs=(P(), P("pp"), P("pp")), check_vma=False))(
+        x, tgt, W, B)
+
+    def ref(Wg, Bg):
+        tot = 0.0
+        for mm in range(m):
+            y = x[mm]
+            for g in range(sg):
+                y = jnp.tanh(y @ Wg[g] + Bg[g])
+            tot = tot + jnp.mean((y - tgt[mm]) ** 2)
+        return tot / m
+
+    rl, (rgW, rgB) = jax.value_and_grad(ref, argnums=(0, 1))(
+        jnp.asarray(Wg), jnp.asarray(Bg))
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+    rgW_l, rgB_l = _stage_layout(np.asarray(rgW), np.asarray(rgB),
+                                 s, v, H)
+    np.testing.assert_allclose(
+        np.asarray(gw).reshape(s, v, H, H), np.asarray(rgW_l),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(gb).reshape(s, v, H), np.asarray(rgB_l),
+        rtol=1e-4, atol=1e-6)
+
+
+def test_interleaved_requires_divisible_micro():
+    from paddle_tpu.distributed.pipeline import (
+        interleaved_one_f_one_b_schedule)
+    mesh = dist.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    x = jnp.ones((3, MB, H))
+    t = jnp.ones((3, MB, H))
+    w = jnp.ones((2, 2, H, H))
+    b = jnp.zeros((2, 2, H))
+
+    def spmd(x, t, w, b):
+        with env.axis_context("pp"):
+            return interleaved_one_f_one_b_schedule(
+                _block_fn, _loss_grad_fn(t), (w[0], b[0]), x, 3, 2,
+                axis="pp")[0]
+
+    with pytest.raises(ValueError, match="num_micro"):
+        jax.jit(shard_map(spmd, mesh=mesh,
+                          in_specs=(P(), P(), P("pp"), P("pp")),
+                          out_specs=P(), check_vma=False)
+                ).lower(x, t, w, b)
+
+
 def test_1f1b_rejects_shape_changing_block():
     mesh = dist.build_mesh({"pp": 2}, devices=jax.devices()[:2])
     x = jnp.ones((4, 2, H))
