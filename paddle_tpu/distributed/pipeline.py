@@ -481,7 +481,8 @@ class SpmdPipelineParallel:
 
     def __init__(self, stages: Sequence[Layer], loss_fn: Callable,
                  optimizer, num_micro: int = 1, mesh=None,
-                 pp_axis: str = PIPE_AXIS):
+                 pp_axis: str = PIPE_AXIS,
+                 virtual_pipeline_degree: int = 1):
 
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..jit.api import functionalize
@@ -494,9 +495,18 @@ class SpmdPipelineParallel:
             raise ValueError(
                 f"SpmdPipelineParallel needs a mesh with a "
                 f"'{pp_axis}' axis")
-        if int(self.mesh.shape[pp_axis]) != len(stages):
+        # virtual pipeline (Megatron interleaving): each pp rank hosts
+        # v chunks; global stage g runs at chunk g//S of device g%S
+        self.v = v = int(virtual_pipeline_degree)
+        pp = int(self.mesh.shape[pp_axis])
+        if len(stages) != pp * v:
             raise ValueError(
-                f"{len(stages)} stages vs pp={self.mesh.shape[pp_axis]}")
+                f"{len(stages)} stages vs pp={pp} x "
+                f"virtual_pipeline_degree={v}")
+        if v > 1 and int(num_micro) % pp != 0:
+            raise ValueError(
+                f"interleaved schedule needs num_micro % pp == 0 "
+                f"(got M={num_micro}, pp={pp})")
         self.pp_axis = pp_axis
         self.stages = list(stages)
         self.loss_fn = loss_fn
@@ -536,20 +546,29 @@ class SpmdPipelineParallel:
                 "yet; use the host-driven engine for either")
 
         spec_p = NamedSharding(self.mesh, P(pp_axis))
-        S = len(stages)
+        S = pp
 
         def stacked(k):
             # per-shard materialization: never builds the unsharded
-            # [S, ...] array on one device (a model picked for pp
-            # because ONE stage barely fits must not OOM at init)
-            shape = (S,) + tuple(ref[k].shape)
+            # stack on one device (a model picked for pp because ONE
+            # stage barely fits must not OOM at init). Layout:
+            # v == 1 -> [S, ...] (row d = stage d);
+            # v > 1  -> [S, v, ...] device-major (row [d, c] = global
+            # stage c*S + d, the interleaved placement)
+            shape = ((S,) if v == 1 else (S, v)) + tuple(ref[k].shape)
 
             def cb(index):
                 lo = index[0].start or 0
                 hi = index[0].stop if index[0].stop is not None else S
                 import numpy as _np
-                arr = _np.stack([_np.asarray(sds[j][k]._data)
-                                 for j in range(lo, hi)])
+                if v == 1:
+                    arr = _np.stack([_np.asarray(sds[j][k]._data)
+                                     for j in range(lo, hi)])
+                else:
+                    arr = _np.stack([
+                        _np.stack([_np.asarray(sds[c * S + d][k]._data)
+                                   for c in range(v)])
+                        for d in range(lo, hi)])
                 return arr[(slice(None),) + tuple(index[1:])]
             return jax.make_array_from_callback(shape, spec_p, cb)
 
@@ -595,8 +614,12 @@ class SpmdPipelineParallel:
                 return jax.value_and_grad(lf)(y)
 
             with axis_context(axis):
-                loss, g = one_f_one_b_schedule(block, lg, local, x, M,
-                                               axis=axis)
+                if self.v > 1:
+                    loss, g = interleaved_one_f_one_b_schedule(
+                        block, lg, local, x, M, self.v, axis=axis)
+                else:
+                    loss, g = one_f_one_b_schedule(block, lg, local,
+                                                   x, M, axis=axis)
             loss = lax.psum(loss, axis) / M
             if dp is not None:
                 loss = lax.pmean(loss, dp)
@@ -660,11 +683,14 @@ class SpmdPipelineParallel:
         return Tensor(loss)
 
     def sync_to_layers(self):
-        """Write each stage's param slice back into its live Layer."""
-        for i, stage in enumerate(self.stages):
+        """Write each stage's param slice back into its live Layer
+        (global stage g lives at [g % pp, g // pp] when interleaved)."""
+        pp = int(self.mesh.shape[self.pp_axis])
+        for g, stage in enumerate(self.stages):
             sd = stage.state_dict()
-            for k, v in self.params.items():
-                sd[k]._data = v[i]
+            for k, val in self.params.items():
+                sd[k]._data = (val[g] if self.v == 1
+                               else val[g % pp, g // pp])
 
     def state_dict(self):
         self.sync_to_layers()

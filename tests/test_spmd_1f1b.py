@@ -304,6 +304,44 @@ def test_interleaved_1f1b_parity(s, v, m):
         rtol=1e-4, atol=1e-6)
 
 
+def test_spmd_engine_interleaved_matches_host_engine():
+    """virtual_pipeline_degree=2 through BOTH engines (2 physical pp
+    ranks x 2 chunks = 4 global stages): identical per-step Adam
+    losses via the same train_batch surface."""
+    lr = 1e-2
+    s, v = 2, 2
+    x, tgt, w0, b0 = _data(seed=3)   # provides S=4 stage params
+
+    mesh = dist.build_mesh({"pp": s}, devices=jax.devices()[:s])
+    xf = paddle.to_tensor(np.asarray(x.reshape(M * MB, H)))
+    tf = paddle.to_tensor(np.asarray(tgt.reshape(M * MB, H)))
+
+    paddle.seed(0)
+    host = dist.PipelineParallel(
+        [_TanhStage(w0[i], b0[i]) for i in range(s * v)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.Adam(learning_rate=lr), num_micro=M,
+        mesh=mesh, schedule="interleaved", virtual_pipeline_degree=v)
+    host_losses = [float(host.train_batch(xf, tf).item())
+                   for _ in range(3)]
+
+    paddle.seed(0)
+    spmd = dist.SpmdPipelineParallel(
+        [_TanhStage(w0[i], b0[i]) for i in range(s * v)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.Adam(learning_rate=lr), num_micro=M,
+        mesh=mesh, virtual_pipeline_degree=v)
+    spmd_losses = [float(spmd.train_batch(xf, tf).item())
+                   for _ in range(3)]
+    assert spmd.last_dispatch_count == 1
+    np.testing.assert_allclose(spmd_losses, host_losses, rtol=2e-5)
+    # interleaved write-back: global stage g -> [g % pp, g // pp]
+    spmd.sync_to_layers()
+    w_after = np.asarray(spmd.params["lin.weight"])
+    np.testing.assert_array_equal(
+        np.asarray(spmd.stages[3].lin.weight._data), w_after[1, 1])
+
+
 def test_interleaved_requires_divisible_micro():
     from paddle_tpu.distributed.pipeline import (
         interleaved_one_f_one_b_schedule)
