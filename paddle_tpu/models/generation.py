@@ -181,15 +181,38 @@ def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
     return x, caches
 
 
-def _pick(logits, key, temperature, top_k):
+def _pick(logits, key, temperature, top_k, top_p=None):
     logits = logits.astype(jnp.float32)  # sampling math in f32 even
     # when the matmuls ran in bf16 (argmax is cast-invariant)
     if temperature == 0.0:  # greedy (static python branch)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
+    need_p = top_p is not None and float(top_p) < 1.0
+    if top_k is not None or need_p:
+        # ONE descending sort serves both filters (vocab-size sort is
+        # the dominant sampling cost per decode step)
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
     if top_k is not None:
         k = min(int(top_k), logits.shape[-1])  # HF-style clamp
-        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
+        kth = sorted_l[:, k - 1][:, None]
+        logits = jnp.where(logits >= kth, logits, -1e30)
+    if need_p:
+        # nucleus sampling: keep the smallest prefix of the
+        # descending-probability order whose mass reaches top_p (the
+        # first token past the threshold stays in — HF semantics; the
+        # top token's EXCLUSIVE mass is 0, so it always survives).
+        # Sequential-filter semantics: when top_k is also set, the
+        # nucleus mass is computed over the top_k-masked distribution
+        # (HF warper order). Static-shape: sort + cumsum + where.
+        base = sorted_l
+        if top_k is not None:
+            base = jnp.where(
+                jnp.arange(base.shape[-1])[None, :] < k, base, -1e30)
+        probs = jax.nn.softmax(base, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < float(top_p)
+        kth = jnp.min(jnp.where(keep, base, jnp.inf), axis=-1,
+                      keepdims=True)
         logits = jnp.where(logits >= kth, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
@@ -210,7 +233,7 @@ def _cast_params(params, dtype):
 @functools.lru_cache(maxsize=64)
 def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
                pad_token_id, max_new_tokens, prompt, total, dtype,
-               ragged=False):
+               ragged=False, top_p=None):
     """One jitted decode program per static signature — repeated
     generate() calls with the same shapes/sampling config reuse the
     compiled executable (params/ids/key[/prompt_lens] are traced
@@ -238,7 +261,8 @@ def _build_run(eps, n_heads, temperature, top_k, eos_token_id,
 
         def body(carry, step_key):
             caches, logits, pos, done = carry
-            tok = _pick(logits, step_key, temperature, top_k)
+            tok = _pick(logits, step_key, temperature, top_k,
+                        top_p)
             if eos_token_id is not None:
                 tok = jnp.where(done, pad_token_id, tok)
                 done = done | (tok == eos_token_id)
@@ -335,9 +359,11 @@ def _build_beam_run(eps, n_heads, num_beams, eos_token_id, pad_token_id,
 def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k: Optional[int] = None,
                  eos_token_id: Optional[int] = None, pad_token_id=0,
-                 num_beams=1, seed=0, dtype=None, prompt_lens=None):
+                 num_beams=1, seed=0, dtype=None, prompt_lens=None,
+                 top_p: Optional[float] = None):
     """KV-cache decode for GPTForCausalLM. temperature=0 -> greedy;
-    num_beams>1 -> beam search (temperature/top_k ignored).
+    num_beams>1 -> beam search (temperature/top_k/top_p ignored —
+    beams expand by log-prob, not sampling).
 
     prompt_lens [B] int (ragged batching — the reference's LoD-driven
     dynamic_decode capability, TPU-style): input_ids is right-padded
@@ -363,6 +389,10 @@ def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
     ids = jnp.asarray(input_ids._data if isinstance(input_ids, Tensor)
                       else input_ids, jnp.int32)
     b, prompt = ids.shape
+    if top_p is not None and not (0.0 < float(top_p) <= 1.0):
+        # fail loudly host-side: top_p<=0 would mask EVERY token and
+        # degenerate to uniform sampling over the whole vocab
+        raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
     total = prompt + int(max_new_tokens)
     if total > cfg.max_seq_len:
         raise ValueError(
@@ -400,7 +430,7 @@ def generate_gpt(model, input_ids, max_new_tokens=32, temperature=0.0,
         float(temperature), None if top_k is None else int(top_k),
         None if eos_token_id is None else int(eos_token_id),
         int(pad_token_id), int(max_new_tokens), prompt, total, dtype,
-        ragged)
+        ragged, None if top_p is None else float(top_p))
     if ragged:
         pl = jnp.asarray(prompt_lens._data
                          if isinstance(prompt_lens, Tensor)

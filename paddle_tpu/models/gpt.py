@@ -155,7 +155,8 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k=None, eos_token_id=None, pad_token_id=0,
-                 num_beams=1, seed=0, dtype=None, prompt_lens=None):
+                 num_beams=1, seed=0, dtype=None, prompt_lens=None,
+                 top_p=None):
         """KV-cache autoregressive decode compiled as one XLA program
         (models/generation.py); temperature=0 is greedy, num_beams>1
         is beam search over the same cache machinery. dtype="bfloat16"
@@ -168,4 +169,4 @@ class GPTForCausalLM(nn.Layer):
                             eos_token_id=eos_token_id,
                             pad_token_id=pad_token_id,
                             num_beams=num_beams, seed=seed, dtype=dtype,
-                            prompt_lens=prompt_lens)
+                            prompt_lens=prompt_lens, top_p=top_p)

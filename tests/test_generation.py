@@ -327,3 +327,80 @@ class TestRaggedPrompts:
                            num_beams=2,
                            prompt_lens=paddle.to_tensor(
                                np.asarray([4, 2], np.int32)))
+
+
+class TestTopP:
+    """Nucleus (top_p) sampling: smallest descending-probability prefix
+    whose mass reaches top_p stays; everything else is cut. Capability
+    beyond the reference's greedy/beam decode surface."""
+
+    def test_pick_semantics(self):
+        from paddle_tpu.models.generation import _pick
+        import jax
+        import jax.numpy as jnp
+        # probs ~ [0.6, 0.3, 0.08, 0.02]: top_p=0.7 keeps {0, 1}
+        logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]],
+                                     jnp.float32))
+        toks = [int(_pick(logits, jax.random.key(s), 1.0, None, 0.7)[0])
+                for s in range(200)]
+        assert set(toks) <= {0, 1}
+        assert len(set(toks)) == 2     # both survivors actually drawn
+        # top_p=0.55: only token 0's mass is needed -> deterministic
+        toks = [int(_pick(logits, jax.random.key(s), 1.0, None, 0.55)[0])
+                for s in range(50)]
+        assert set(toks) == {0}
+        # top_p=1.0 is a no-op vs plain sampling
+        a = int(_pick(logits, jax.random.key(7), 1.0, None, 1.0)[0])
+        b = int(_pick(logits, jax.random.key(7), 1.0, None, None)[0])
+        assert a == b
+
+    def test_generate_top_p_deterministic_and_in_range(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig(vocab_size=97, hidden_size=32,
+                                         num_layers=2, num_heads=4,
+                                         max_seq_len=32, dropout=0.0))
+        model.eval()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 97, (2, 5)).astype(np.int32))
+        out = np.asarray(model.generate(ids, max_new_tokens=6,
+                                        temperature=0.8, top_p=0.9,
+                                        seed=5)._data)
+        out2 = np.asarray(model.generate(ids, max_new_tokens=6,
+                                         temperature=0.8, top_p=0.9,
+                                         seed=5)._data)
+        np.testing.assert_array_equal(out, out2)
+        assert ((out >= 0) & (out < 97)).all()
+        # combines with top_k
+        out3 = np.asarray(model.generate(ids, max_new_tokens=4,
+                                         temperature=0.8, top_k=10,
+                                         top_p=0.9, seed=5)._data)
+        assert out3.shape == (2, 9)
+
+    def test_top_p_validation_and_topk_combination(self):
+        from paddle_tpu.models.generation import _pick
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig.tiny(dropout=0.0))
+        model.eval()
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        with pytest.raises(ValueError, match="top_p"):
+            model.generate(ids, max_new_tokens=2, temperature=0.8,
+                           top_p=0.0)
+        # sequential semantics: top_k=2 first, then nucleus over the
+        # RENORMALIZED top-2 mass — top_p=0.7 keeps only token 0
+        # (0.6/0.9 = 0.667 >= ... first token exclusive mass 0, second
+        # token exclusive mass 0.667 < 0.7 -> both kept); top_p=0.6
+        # keeps only token 0
+        logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]],
+                                     jnp.float32))
+        toks = [int(_pick(logits, jax.random.key(s), 1.0, 2, 0.6)[0])
+                for s in range(60)]
+        assert set(toks) == {0}
+        toks = [int(_pick(logits, jax.random.key(s), 1.0, 2, 0.7)[0])
+                for s in range(200)]
+        assert set(toks) == {0, 1}
