@@ -239,6 +239,42 @@ class Fleet:
         from ..parallel import DataParallel
         return DataParallel(model)
 
+    def build_pipeline(self, stages, loss_fn, optimizer, strategy=None,
+                       schedule="spmd_1f1b"):
+        """Pipeline-engine factory off the fleet strategy.
+        pipeline_configs['accumulate_steps'] is the MICROBATCH COUNT
+        (reference PipelineConfig semantics: the global batch is
+        micro_batch_size x accumulate_steps; the engines slice the
+        batch they receive into accumulate_steps microbatches and
+        reject non-divisible batches at train_batch). schedule picks
+        the form: 'spmd_1f1b' (one compiled program,
+        multi-controller-safe; virtual_pipeline_degree from
+        pipeline_configs when set) or '1f1b'/'interleaved'/'fthenb'
+        (host-driven engine, heterogeneous stages)."""
+        from ..pipeline import SpmdPipelineParallel
+        from ..pipeline_engine import PipelineParallel
+        known = ("spmd_1f1b", "1f1b", "interleaved", "fthenb")
+        if schedule not in known:
+            raise ValueError(
+                f"schedule={schedule!r}: pick one of {known}")
+        strategy = strategy or self.strategy or DistributedStrategy()
+        if not self._initialized:
+            # init with the RESOLVED strategy — a bare init() would
+            # build a default (pp-less) mesh and clobber self.strategy
+            self.init(is_collective=True, strategy=strategy)
+        cfgs = dict(strategy.pipeline_configs or {})
+        micro = int(cfgs.get("accumulate_steps", 1))
+        v = int(cfgs.get("virtual_pipeline_degree", 1))
+        inner = optimizer.inner_opt if isinstance(
+            optimizer, DistributedOptimizer) else optimizer
+        if schedule == "spmd_1f1b":
+            return SpmdPipelineParallel(
+                stages, loss_fn, inner, num_micro=micro,
+                mesh=self.mesh, virtual_pipeline_degree=v)
+        return PipelineParallel(
+            stages, loss_fn, inner, num_micro=micro, mesh=self.mesh,
+            schedule=schedule, virtual_pipeline_degree=v)
+
     def build_sharding_plan(self, strategy=None) -> ShardingPlan:
         strategy = strategy or self.strategy or DistributedStrategy()
         zero = 0

@@ -383,3 +383,45 @@ def test_1f1b_rejects_shape_changing_block():
                           in_specs=(P(), P(), P("pp")),
                           out_specs=P(), check_vma=False)
                 ).lower(x, t, w)
+
+
+def test_fleet_build_pipeline_factory():
+    """fleet.build_pipeline: strategy-driven engine factory — the SPMD
+    and host-driven forms produce the same first-step loss from the
+    same stages (pipeline_configs supplies the microbatch count)."""
+    from paddle_tpu.distributed import fleet
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(H, H)
+
+        def forward(self, xx):
+            return paddle.tanh(self.lin(xx))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": S}
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "micro_batch_size": MB}
+    fleet.init(is_collective=True, strategy=strategy)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(M * MB, H).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(M * MB, H).astype(np.float32))
+
+    paddle.seed(0)
+    spmd = fleet.fleet.build_pipeline(
+        [Block() for _ in range(S)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.Adam(learning_rate=1e-3))
+    l_spmd = float(spmd.train_batch(x, y).item())
+    assert spmd.last_dispatch_count == 1
+
+    paddle.seed(0)
+    host = fleet.fleet.build_pipeline(
+        [Block() for _ in range(S)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.Adam(learning_rate=1e-3), schedule="1f1b")
+    l_host = float(host.train_batch(x, y).item())
+    np.testing.assert_allclose(l_spmd, l_host, rtol=2e-5)
