@@ -22,6 +22,8 @@ def main():
     ap.add_argument("--beams", type=int, default=1)
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top_p", type=float, default=None,
+                    help="nucleus sampling mass (0,1]")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -44,7 +46,8 @@ def main():
     out = model.generate(paddle.to_tensor(prompt),
                          max_new_tokens=args.tokens,
                          temperature=args.temperature,
-                         top_k=args.top_k, num_beams=args.beams)
+                         top_k=args.top_k, top_p=args.top_p,
+                         num_beams=args.beams)
     arr = np.asarray(out.numpy())
     for r, row in enumerate(arr):
         print(f"[{r}] prompt={[int(t) for t in row[:8]]} -> {[int(t) for t in row[8:]]}")
