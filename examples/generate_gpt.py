@@ -22,7 +22,7 @@ def main():
     ap.add_argument("--beams", type=int, default=1)
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--temperature", type=float, default=0.0)
-    ap.add_argument("--top_p", type=float, default=None,
+    ap.add_argument("--top-p", type=float, default=None,
                     help="nucleus sampling mass (0,1]")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()

@@ -231,6 +231,23 @@ def main():
         f1b_params, f1b_loss = f1b_step(f1b_params, xg, yg)
     np.asarray(f1b_params["w0"]).ravel()[:1]
     f1b_t = (time.perf_counter() - t0) / steps
+
+    # -- SPMD 1F1B ENGINE: the user-facing train_batch surface ----------
+    # (same stage Layers and SGD as the host engine above — the
+    # apples-to-apples engine comparison incl. functionalize overhead)
+    paddle.seed(0)
+    eng_stages = [make_stage() for _ in range(S)]
+    spmd_engine = dist.SpmdPipelineParallel(
+        eng_stages, loss_fn,
+        paddle.optimizer.SGD(learning_rate=1e-3), num_micro=M,
+        mesh=mesh)
+    spmd_engine.train_batch(x, y)            # compile
+    float(spmd_engine.train_batch(x, y).item())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = spmd_engine.train_batch(x, y)
+    float(loss.item())
+    eng_t = (time.perf_counter() - t0) / steps
     print(json.dumps({
         "pipeline_rows_per_sec": round(batch / pipe_t, 1),
         "single_chip_rows_per_sec": round(batch / single_t, 1),
@@ -248,6 +265,9 @@ def main():
         "whole_graph_dispatches_per_step": 1,
         "spmd_1f1b_rows_per_sec": round(batch / f1b_t, 1),
         "spmd_1f1b_dispatches_per_step": 1,
+        "spmd_engine_rows_per_sec": round(batch / eng_t, 1),
+        "spmd_engine_dispatches_per_step":
+            spmd_engine.last_dispatch_count,
         "stages": S, "num_micro": M,
         # with host_cores == 1 every virtual device timeshares one
         # core, so NO pipeline form can beat single-chip rows/s here;
