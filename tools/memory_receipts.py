@@ -7,6 +7,11 @@ meshes shaped like real TPU slices and reads XLA's
 - `v5e8`:  ERNIE-base TrainStep (AMP O1, ZeRO-1 dp=8, batch 48/chip,
            seq 512 — the bench configuration) on a virtual v5e-8;
            budget 16 GiB HBM/chip.
+- `v5e8_chunked`: the same configuration with chunked_ce (the head
+           streams through vocab blocks); receipt = the CHUNKED leg's
+           cpu_temp must be LOWER than the baseline's (the logits'
+           removal shows up as a temp-memory delta), enforced in the
+           `all` run.
 - `v4_32`: ERNIE-10B-class (h=4096, L=48, heads=32, ffn=16384) hybrid
            tp=4 × pp=4 × dp=2 on a virtual v4-32; each pipeline stage
            lowered as its own TrainStep over the stage submesh (dp×tp
@@ -22,8 +27,9 @@ an approximation of TPU-XLA's, but the dominant terms (params,
 optimizer moments, remat'd activation peaks, collective buffers) are
 backend-independent shape arithmetic. Headroom 15% absorbs the rest.
 
-Usage: python tools/memory_receipts.py [v5e8|v4_32]  (prints one JSON
-line per leg; rc=1 if any leg exceeds its budget).
+Usage: python tools/memory_receipts.py [v5e8|v5e8_chunked|v4_32|all]
+(prints one JSON line per leg; rc=1 if any leg exceeds its budget or
+the chunked-vs-baseline temp delta inverts).
 """
 from __future__ import annotations
 
@@ -72,9 +78,12 @@ def _stats(lowered):
     }
 
 
-def receipt_v5e8():
+def _receipt_v5e8_impl(chunked: bool):
     """ERNIE-base, dp=8 ZeRO-1, AMP O1, global batch 384 (48/chip),
-    seq 512 — mirrors bench.py's measured configuration."""
+    seq 512 — mirrors bench.py's measured configuration. With
+    chunked=True the head streams through vocab blocks
+    (chunked_pretraining_loss) and the [b*s, vocab] logits drop out
+    of the lowered step; the `all` run asserts the temp delta."""
     _force_cpu(8)
     import jax
     import jax.numpy as jnp
@@ -85,24 +94,35 @@ def receipt_v5e8():
     from paddle_tpu.utils.abstract_init import abstract_parameters
 
     paddle.seed(0)
-    cfg = ErnieConfig()  # base: L12 H768 A12 I3072 vocab 30522
+    cfg = ErnieConfig(chunked_ce=chunked, ce_vocab_block=2048)
     with abstract_parameters():
         model = ErnieForPretraining(cfg)
     mesh = dist.build_mesh({"dp": 8})
     dist.set_mesh(mesh)
     plan = dist.ShardingPlan(mesh, zero_stage=1)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4)
-    step = TrainStep(
-        model,
-        lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
-        opt, amp_level="O1", mesh=mesh, sharding_plan=plan, remat=True)
+    loss_fn = (model.chunked_pretraining_loss if chunked
+               else (lambda o, l:
+                     ErnieForPretraining.pretraining_loss(o, l)))
+    step = TrainStep(model, loss_fn, opt, amp_level="O1", mesh=mesh,
+                     sharding_plan=plan, remat=True)
     ids = jax.ShapeDtypeStruct((48 * 8, 512), jnp.int32)
     st = _stats(step.aot_lower((ids,), (ids,)))
     budget = 16.0
-    st.update(leg="v5e8_ernie_base", mesh="dp=8", budget_gib=budget,
+    st.update(leg=("v5e8_ernie_base_chunked_ce" if chunked
+                   else "v5e8_ernie_base"),
+              mesh="dp=8", budget_gib=budget,
               required_peak_gib=st["state_residency_gib"],
               ok=st["state_residency_gib"] <= budget * HEADROOM)
     return st
+
+
+def receipt_v5e8():
+    return _receipt_v5e8_impl(chunked=False)
+
+
+def receipt_v5e8_chunked_ce():
+    return _receipt_v5e8_impl(chunked=True)
 
 
 def receipt_v4_32():
@@ -190,7 +210,7 @@ def main():
         import subprocess
         ok = True
         results = []
-        for leg in ("v5e8", "v4_32"):
+        for leg in ("v5e8", "v5e8_chunked", "v4_32"):
             r = subprocess.run([sys.executable, "-u",
                                 os.path.abspath(__file__), leg],
                                text=True, capture_output=True)
@@ -201,6 +221,23 @@ def main():
             if r.returncode != 0:
                 sys.stderr.write(r.stderr[-2000:])
                 ok = False
+        # the chunked leg's capability receipt: removing the [b*s, V]
+        # logits must show up as LOWER temp memory than the baseline
+        # (state residency is identical by construction, so the budget
+        # gate alone could not catch a re-materialization regression)
+        by_leg = {x["leg"]: x for x in results}
+        base = by_leg.get("v5e8_ernie_base")
+        chk = by_leg.get("v5e8_ernie_base_chunked_ce")
+        if base and chk:
+            delta_ok = chk["cpu_temp_gib"] < base["cpu_temp_gib"]
+            chk["ok"] = bool(chk["ok"] and delta_ok)
+            chk["temp_delta_vs_dense_gib"] = round(
+                base["cpu_temp_gib"] - chk["cpu_temp_gib"], 2)
+            if not delta_ok:
+                sys.stderr.write(
+                    "chunked_ce leg temp >= dense leg temp — the "
+                    "logits came back\n")
+                ok = False
         if results:
             with open(os.path.join(REPO, "MEMORY_RECEIPTS.json"),
                       "w") as f:
@@ -209,7 +246,15 @@ def main():
                                                 for x in results)}, f,
                           indent=1)
         return 0 if ok else 1
-    r = receipt_v5e8() if which == "v5e8" else receipt_v4_32()
+    fns = {"v5e8": receipt_v5e8,
+           "v5e8_chunked": receipt_v5e8_chunked_ce,
+           "v4_32": receipt_v4_32}
+    if which not in fns:
+        sys.stderr.write(
+            f"unknown leg {which!r}: pick one of "
+            f"{sorted(fns)} or 'all'\n")
+        return 2
+    r = fns[which]()
     print(json.dumps(r))
     return 0 if r["ok"] else 1
 
