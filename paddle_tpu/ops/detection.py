@@ -647,6 +647,12 @@ def roi_align(input, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
 
     input: [N,C,H,W]; rois: [R,4] xyxy (image coords) or [R,5] with batch idx
     in col 0 (when rois_num is None and width 5). Differentiable.
+
+    sampling_ratio<=0: the reference picks ceil(roi_size/out) samples
+    PER ROI (data-dependent); static XLA shapes can't — this op uses a
+    fixed 2x2 grid instead, the value detection heads overwhelmingly
+    configure explicitly. Pass a positive sampling_ratio for exact
+    reference parity (tests/test_op_config_grids.py sweeps those).
     """
     if isinstance(output_size, int):
         ph = pw = output_size
