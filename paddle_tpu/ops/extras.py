@@ -67,8 +67,14 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         fy = jnp.clip(fy, 0, h - 1)
     elif padding_mode == "reflection":
         def reflect(v, lo, hi):
+            # triangular wave over [lo, hi]: identity in range,
+            # mirrored outside (the previous abs(...%..)-rng form was
+            # the INVERTED wave — it flipped in-range coordinates too;
+            # caught by the torch grid_sample cross-check)
             rng = hi - lo
-            v = jnp.abs((v - lo) % (2 * rng) - rng)
+            if rng <= 0:
+                return jnp.full_like(v, lo)
+            v = rng - jnp.abs((v - lo) % (2 * rng) - rng)
             return v + lo
         if align_corners:
             fx = reflect(fx, 0.0, w - 1.0)

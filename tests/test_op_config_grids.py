@@ -225,3 +225,33 @@ def test_roi_align_grid(osize, scale, ratio):
                         np.asarray([2, 1], np.int32)))
     np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
                                atol=1e-4)
+
+
+# -------------------------------------------------------------------------
+# grid_sample: mode x padding_mode x align_corners vs torch
+# -------------------------------------------------------------------------
+GS_GRID = [
+    ("bilinear", "zeros", True), ("bilinear", "zeros", False),
+    ("bilinear", "border", True), ("bilinear", "border", False),
+    ("nearest", "zeros", True), ("nearest", "border", False),
+    ("bilinear", "reflection", True),
+    ("bilinear", "reflection", False), ("nearest", "reflection", True),
+    ("nearest", "reflection", False),
+]
+
+
+@pytest.mark.parametrize("mode,pad,align", GS_GRID)
+def test_grid_sample_grid(mode, pad, align):
+    from paddle_tpu.ops.extras import grid_sample
+    x = R(11).randn(2, 3, 6, 5).astype(np.float32)
+    # grid slightly outside [-1,1] so padding_mode semantics matter
+    grid = (R(12).rand(2, 4, 7, 2).astype(np.float32) * 2.6 - 1.3)
+    ref = TF.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                         mode=mode, padding_mode=pad,
+                         align_corners=align).numpy()
+    out = grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                      mode=mode, padding_mode=pad,
+                      align_corners=align)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-4,
+                               err_msg=f"{mode}/{pad}/align={align}")
