@@ -255,3 +255,103 @@ def test_grid_sample_grid(mode, pad, align):
     np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
                                atol=1e-4,
                                err_msg=f"{mode}/{pad}/align={align}")
+
+
+# -------------------------------------------------------------------------
+# pad modes, pixel shuffle, unfold/fold, affine_grid, normalize & friends
+# — torch as the oracle across attr combinations
+# -------------------------------------------------------------------------
+PAD_GRID = [("constant", (1, 2, 0, 3)), ("reflect", (1, 2, 2, 1)),
+            ("replicate", (2, 0, 1, 2)), ("circular", (1, 1, 2, 0))]
+
+
+@pytest.mark.parametrize("mode,pad", PAD_GRID)
+def test_pad_modes_grid(mode, pad):
+    x = R(13).randn(2, 3, 5, 6).astype(np.float32)
+    kw = {"value": 1.5} if mode == "constant" else {}
+    ref = TF.pad(torch.from_numpy(x), pad, mode=mode, **kw).numpy()
+    out = F.pad(paddle.to_tensor(x), list(pad), mode=mode, **kw)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6,
+                               atol=1e-6, err_msg=f"pad {mode}")
+
+
+@pytest.mark.parametrize("factor", [2, 3])
+def test_pixel_shuffle_grid(factor):
+    c = 2 * factor * factor
+    x = R(14).randn(2, c, 3, 4).astype(np.float32)
+    ref = TF.pixel_shuffle(torch.from_numpy(x), factor).numpy()
+    out = F.pixel_shuffle(paddle.to_tensor(x), factor)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    back = F.pixel_unshuffle(out, factor)
+    np.testing.assert_allclose(np.asarray(back._data), x, rtol=1e-6)
+
+
+@pytest.mark.parametrize("k,s,p,d", [(2, 1, 0, 1), (3, 2, 1, 1),
+                                     (2, 2, 0, 2)])
+def test_unfold_grid(k, s, p, d):
+    x = R(15).randn(2, 3, 7, 8).astype(np.float32)
+    ref = TF.unfold(torch.from_numpy(x), k, dilation=d, padding=p,
+                    stride=s).numpy()
+    out = F.unfold(paddle.to_tensor(x), k, strides=s, paddings=p,
+                   dilations=d)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6,
+                               err_msg=f"unfold k{k} s{s} p{p} d{d}")
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_affine_grid_grid(align):
+    theta = (R(16).randn(2, 2, 3) * 0.3
+             + np.array([[1, 0, 0], [0, 1, 0]])).astype(np.float32)
+    ref = TF.affine_grid(torch.from_numpy(theta), (2, 3, 4, 5),
+                         align_corners=align).numpy()
+    out = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                        align_corners=align)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_normalize_cosine_lrn_prelu_glu_vs_torch():
+    x = R(17).randn(3, 6, 4, 5).astype(np.float32)
+    y = R(18).randn(3, 6, 4, 5).astype(np.float32)
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+    for p, axis in ((2.0, 1), (1.0, -1)):
+        ref = TF.normalize(tx, p=p, dim=axis).numpy()
+        out = F.normalize(paddle.to_tensor(x), p=p, axis=axis)
+        np.testing.assert_allclose(np.asarray(out._data), ref,
+                                   rtol=1e-5, atol=1e-6)
+    ref = TF.cosine_similarity(tx, ty, dim=1).numpy()
+    out = paddle.nn.functional.cosine_similarity(
+        paddle.to_tensor(x), paddle.to_tensor(y), axis=1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+    ref = TF.local_response_norm(tx, size=3, alpha=1e-4, beta=0.75,
+                                 k=1.0).numpy()
+    out = F.local_response_norm(paddle.to_tensor(x), size=3,
+                                alpha=1e-4, beta=0.75, k=1.0)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+    w = np.asarray([0.25], np.float32)
+    ref = TF.prelu(tx, torch.from_numpy(w)).numpy()
+    out = F.prelu(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    ref = TF.glu(tx, dim=1).numpy()
+    out = F.glu(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_shrink_and_elu_family_vs_torch():
+    x = (R(19).randn(4, 5) * 2).astype(np.float32)
+    tx = torch.from_numpy(x)
+    for name, tfn, pfn in (
+            ("softshrink", TF.softshrink, F.softshrink),
+            ("hardshrink", TF.hardshrink, F.hardshrink),
+            ("tanhshrink", TF.tanhshrink, F.tanhshrink),
+            ("celu", TF.celu, F.celu),
+            ("selu", TF.selu, F.selu)):
+        ref = tfn(tx).numpy()
+        out = pfn(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._data), ref,
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
