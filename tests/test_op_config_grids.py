@@ -355,3 +355,99 @@ def test_shrink_and_elu_family_vs_torch():
         np.testing.assert_allclose(np.asarray(out._data), ref,
                                    rtol=1e-5, atol=1e-6,
                                    err_msg=name)
+
+
+# -------------------------------------------------------------------------
+# loss attr grids vs torch: weight / ignore_index / reduction /
+# pos_weight / label_smoothing — the attr combinations the reference's
+# OpTest grids sweep per loss op
+# -------------------------------------------------------------------------
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+@pytest.mark.parametrize("weighted,ignore", [(False, False),
+                                             (True, False),
+                                             (False, True),
+                                             (True, True)])
+def test_cross_entropy_attr_grid(reduction, weighted, ignore):
+    n, c = 12, 5
+    logits = R(20).randn(n, c).astype(np.float32)
+    lbl = R(21).randint(0, c, (n,)).astype(np.int64)
+    if ignore:
+        lbl[2] = -100
+        lbl[7] = -100
+    w = ((R(22).rand(c) + 0.5).astype(np.float32) if weighted else None)
+    tkw = dict(reduction=reduction, ignore_index=-100)
+    if w is not None:
+        tkw["weight"] = torch.from_numpy(w)
+    ref = TF.cross_entropy(torch.from_numpy(logits),
+                           torch.from_numpy(lbl), **tkw).numpy()
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(lbl),
+                          weight=(None if w is None
+                                  else paddle.to_tensor(w)),
+                          ignore_index=-100, reduction=reduction)
+    np.testing.assert_allclose(
+        np.asarray(out._data), ref, rtol=1e-5, atol=1e-6,
+        err_msg=f"ce red={reduction} w={weighted} ign={ignore}")
+
+
+def test_cross_entropy_label_smoothing_vs_torch():
+    n, c = 8, 6
+    logits = R(23).randn(n, c).astype(np.float32)
+    lbl = R(24).randint(0, c, (n,)).astype(np.int64)
+    ref = TF.cross_entropy(torch.from_numpy(logits),
+                           torch.from_numpy(lbl),
+                           label_smoothing=0.1).numpy()
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(lbl), label_smoothing=0.1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("pos_weighted", [False, True])
+def test_bce_with_logits_attr_grid(pos_weighted):
+    x = R(25).randn(6, 4).astype(np.float32)
+    y = (R(26).rand(6, 4) > 0.5).astype(np.float32)
+    pw = ((R(27).rand(4) * 2 + 0.5).astype(np.float32)
+          if pos_weighted else None)
+    tkw = {}
+    if pw is not None:
+        tkw["pos_weight"] = torch.from_numpy(pw)
+    ref = TF.binary_cross_entropy_with_logits(
+        torch.from_numpy(x), torch.from_numpy(y), **tkw).numpy()
+    out = F.binary_cross_entropy_with_logits(
+        paddle.to_tensor(x), paddle.to_tensor(y),
+        pos_weight=(None if pw is None else paddle.to_tensor(pw)))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_misc_losses_vs_torch():
+    x = R(28).randn(6, 5).astype(np.float32)
+    y = R(29).randn(6, 5).astype(np.float32)
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+    ref = TF.smooth_l1_loss(tx, ty, beta=0.7).numpy()
+    out = F.smooth_l1_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                           delta=0.7)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
+    a, b = np.abs(x) + 0.1, np.abs(y) + 0.1
+    pa, pb = a / a.sum(-1, keepdims=True), b / b.sum(-1, keepdims=True)
+    ref = TF.kl_div(torch.from_numpy(np.log(pa)),
+                    torch.from_numpy(pb), reduction="mean").numpy()
+    out = F.kl_div(paddle.to_tensor(np.log(pa)), paddle.to_tensor(pb),
+                   reduction="mean")
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
+    t = R(30).choice([-1.0, 1.0], (6,)).astype(np.float32)
+    ref = TF.margin_ranking_loss(tx[:, 0], ty[:, 0],
+                                 torch.from_numpy(t),
+                                 margin=0.3).numpy()
+    out = F.margin_ranking_loss(paddle.to_tensor(x[:, 0]),
+                                paddle.to_tensor(y[:, 0]),
+                                paddle.to_tensor(t), margin=0.3)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
+    z = R(31).randn(6, 5).astype(np.float32)
+    ref = TF.triplet_margin_loss(tx, ty, torch.from_numpy(z),
+                                 margin=0.8, p=2).numpy()
+    out = F.triplet_margin_loss(paddle.to_tensor(x),
+                                paddle.to_tensor(y),
+                                paddle.to_tensor(z), margin=0.8, p=2)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
