@@ -431,10 +431,13 @@ def test_misc_losses_vs_torch():
     np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
     a, b = np.abs(x) + 0.1, np.abs(y) + 0.1
     pa, pb = a / a.sum(-1, keepdims=True), b / b.sum(-1, keepdims=True)
+    # 'batchmean' pins a stable definition (torch deprecates
+    # reduction='mean' semantics for kl_div)
     ref = TF.kl_div(torch.from_numpy(np.log(pa)),
-                    torch.from_numpy(pb), reduction="mean").numpy()
+                    torch.from_numpy(pb),
+                    reduction="batchmean").numpy()
     out = F.kl_div(paddle.to_tensor(np.log(pa)), paddle.to_tensor(pb),
-                   reduction="mean")
+                   reduction="batchmean")
     np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
     t = R(30).choice([-1.0, 1.0], (6,)).astype(np.float32)
     ref = TF.margin_ranking_loss(tx[:, 0], ty[:, 0],
