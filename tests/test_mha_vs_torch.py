@@ -1,0 +1,109 @@
+"""MultiHeadAttention vs torch with copied projections (the reference
+backs this with the fused multihead_matmul kernels —
+/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu —
+whose math torch's nn.MultiheadAttention shares).
+"""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+
+R = np.random.RandomState
+E, NH, B, T = 8, 2, 3, 5
+
+
+def _copy_mha(sd, prefix, th_attn):
+    """torch MultiheadAttention -> paddle q/k/v/out projections
+    (torch in_proj_weight is [3E, E] [out,in]; paddle Linear is
+    [in, out])."""
+    w = th_attn.in_proj_weight.detach().numpy()
+    b = th_attn.in_proj_bias.detach().numpy()
+    for i, name in enumerate(("q_proj", "k_proj", "v_proj")):
+        sd[f"{prefix}{name}.weight"].set_value(w[i * E:(i + 1) * E].T)
+        sd[f"{prefix}{name}.bias"].set_value(b[i * E:(i + 1) * E])
+    sd[f"{prefix}out_proj.weight"].set_value(
+        th_attn.out_proj.weight.detach().numpy().T)
+    sd[f"{prefix}out_proj.bias"].set_value(
+        th_attn.out_proj.bias.detach().numpy())
+
+
+def _build_pair(seed=0):
+    paddle.seed(seed)
+    torch.manual_seed(seed)
+    th = torch.nn.MultiheadAttention(E, NH, batch_first=True)
+    pd = paddle.nn.MultiHeadAttention(E, NH, dropout=0.0)
+    _copy_mha(pd.state_dict(), "", th)
+    return pd, th
+
+
+def test_self_attention_matches_torch():
+    pd, th = _build_pair()
+    x = R(0).randn(B, T, E).astype(np.float32)
+    with torch.no_grad():
+        t_out, _ = th(torch.from_numpy(x), torch.from_numpy(x),
+                      torch.from_numpy(x), need_weights=False)
+    p_out = pd(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(p_out._data), t_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cross_attention_matches_torch():
+    pd, th = _build_pair(seed=1)
+    q = R(1).randn(B, T, E).astype(np.float32)
+    kv = R(2).randn(B, T + 2, E).astype(np.float32)
+    with torch.no_grad():
+        t_out, _ = th(torch.from_numpy(q), torch.from_numpy(kv),
+                      torch.from_numpy(kv), need_weights=False)
+    p_out = pd(paddle.to_tensor(q), paddle.to_tensor(kv),
+               paddle.to_tensor(kv))
+    np.testing.assert_allclose(np.asarray(p_out._data), t_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_masked_attention_matches_torch():
+    """Additive float mask (paddle semantics) vs torch bool mask."""
+    pd, th = _build_pair(seed=2)
+    x = R(3).randn(B, T, E).astype(np.float32)
+    # causal mask
+    bool_mask = np.triu(np.ones((T, T), bool), k=1)   # True = blocked
+    add_mask = np.where(bool_mask, -1e9, 0.0).astype(np.float32)
+    with torch.no_grad():
+        t_out, _ = th(torch.from_numpy(x), torch.from_numpy(x),
+                      torch.from_numpy(x),
+                      attn_mask=torch.from_numpy(bool_mask),
+                      need_weights=False)
+    p_out = pd(paddle.to_tensor(x),
+               attn_mask=paddle.to_tensor(
+                   add_mask[None, None]))  # [1,1,T,T] broadcast
+    np.testing.assert_allclose(np.asarray(p_out._data), t_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder_layer_matches_torch():
+    """Full encoder layer: MHA + FFN + the two layernorms
+    (post-norm), weights copied from torch."""
+    paddle.seed(3)
+    torch.manual_seed(3)
+    ff = 16
+    th = torch.nn.TransformerEncoderLayer(
+        E, NH, dim_feedforward=ff, dropout=0.0, batch_first=True,
+        activation="relu")
+    pd = paddle.nn.TransformerEncoderLayer(
+        E, NH, ff, dropout=0.0, activation="relu",
+        attn_dropout=0.0, act_dropout=0.0)
+    sd = pd.state_dict()
+    _copy_mha(sd, "self_attn.", th.self_attn)
+    for pname, tmod in (("linear1", th.linear1),
+                        ("linear2", th.linear2)):
+        sd[f"{pname}.weight"].set_value(
+            tmod.weight.detach().numpy().T)
+        sd[f"{pname}.bias"].set_value(tmod.bias.detach().numpy())
+    for pname, tmod in (("norm1", th.norm1), ("norm2", th.norm2)):
+        sd[f"{pname}.weight"].set_value(tmod.weight.detach().numpy())
+        sd[f"{pname}.bias"].set_value(tmod.bias.detach().numpy())
+    x = R(4).randn(B, T, E).astype(np.float32)
+    with torch.no_grad():
+        t_out = th(torch.from_numpy(x)).numpy()
+    p_out = pd(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(p_out._data), t_out,
+                               rtol=1e-4, atol=1e-5)
