@@ -1,0 +1,115 @@
+"""Norm family vs torch: training-mode batch stats, running-stat
+updates (paddle momentum is the COMPLEMENT of torch's: running =
+m*running + (1-m)*batch vs torch's (1-m)*running + m*batch), eval
+mode, and instance/group/layer norms — the semantics the reference's
+batch_norm_op.cc family implements. Plus conv1d/conv3d attr checks.
+"""
+import numpy as np
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState
+
+
+def test_batch_norm_train_and_running_stats():
+    c = 4
+    x = R(0).randn(6, c, 5, 5).astype(np.float32)
+    th = torch.nn.BatchNorm2d(c, momentum=0.1)  # torch convention
+    pd = paddle.nn.BatchNorm2D(c, momentum=0.9)  # paddle == 1 - torch
+    w = R(1).rand(c).astype(np.float32) + 0.5
+    b = R(2).randn(c).astype(np.float32)
+    with torch.no_grad():
+        th.weight.copy_(torch.from_numpy(w))
+        th.bias.copy_(torch.from_numpy(b))
+    sd = pd.state_dict()
+    sd["weight"].set_value(w)
+    sd["bias"].set_value(b)
+
+    th.train()
+    pd.train()
+    ref = th(torch.from_numpy(x)).detach().numpy()
+    out = pd(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-5)
+    # running stats after ONE training step follow the (mapped)
+    # momentum conventions. running_mean matches torch exactly;
+    # running_var follows the REFERENCE convention (biased batch
+    # variance, batch_norm_op.cc) where torch uses the unbiased one —
+    # assert each against its own contract
+    np.testing.assert_allclose(
+        np.asarray(sd["_mean"]._data), th.running_mean.numpy(),
+        rtol=1e-4, atol=1e-5)
+    biased_var = x.var(axis=(0, 2, 3))            # paddle convention
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    unbiased_var = biased_var * n / (n - 1)       # torch convention
+    np.testing.assert_allclose(
+        np.asarray(sd["_variance"]._data),
+        0.9 * 1.0 + 0.1 * biased_var, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        th.running_var.numpy(), 0.9 * 1.0 + 0.1 * unbiased_var,
+        rtol=1e-4, atol=1e-5)
+
+    # eval mode consumes the running stats identically (sync torch's
+    # running_var to paddle's biased value first so the EVAL MATH is
+    # compared, not the variance convention checked above)
+    th.eval()
+    pd.eval()
+    with torch.no_grad():
+        th.running_var.copy_(
+            torch.from_numpy(np.array(sd["_variance"]._data)))
+    x2 = R(3).randn(6, c, 5, 5).astype(np.float32)
+    ref = th(torch.from_numpy(x2)).detach().numpy()
+    out = pd(paddle.to_tensor(x2))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_instance_and_layer_norm_vs_torch():
+    x = R(4).randn(3, 4, 6, 5).astype(np.float32)
+    tx = torch.from_numpy(x)
+    ref = TF.instance_norm(tx).numpy()
+    out = F.instance_norm(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-5)
+    w = (R(5).rand(5).astype(np.float32) + 0.5)
+    b = R(6).randn(5).astype(np.float32)
+    ref = TF.layer_norm(tx, (5,), torch.from_numpy(w),
+                        torch.from_numpy(b)).numpy()
+    out = F.layer_norm(paddle.to_tensor(x), 5, paddle.to_tensor(w),
+                       paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_conv1d_conv3d_vs_torch():
+    x1 = R(7).randn(2, 3, 11).astype(np.float32)
+    w1 = (R(8).randn(5, 3, 3) * 0.2).astype(np.float32)
+    ref = TF.conv1d(torch.from_numpy(x1), torch.from_numpy(w1),
+                    stride=2, padding=1, dilation=2).numpy()
+    out = F.conv1d(paddle.to_tensor(x1), paddle.to_tensor(w1),
+                   stride=2, padding=1, dilation=2)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=2e-4,
+                               atol=2e-4)
+    x3 = R(9).randn(1, 2, 5, 6, 4).astype(np.float32)
+    w3 = (R(10).randn(3, 2, 2, 2, 2) * 0.2).astype(np.float32)
+    ref = TF.conv3d(torch.from_numpy(x3), torch.from_numpy(w3),
+                    stride=1, padding=1).numpy()
+    out = F.conv3d(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                   stride=1, padding=1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_embedding_and_one_hot_vs_torch():
+    w = R(11).randn(7, 4).astype(np.float32)
+    ids = np.asarray([[0, 3], [6, 2]], np.int64)
+    ref = TF.embedding(torch.from_numpy(ids),
+                       torch.from_numpy(w)).numpy()
+    out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    ref = TF.one_hot(torch.from_numpy(ids), 7).numpy()
+    out = F.one_hot(paddle.to_tensor(ids), 7)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=0)
