@@ -70,8 +70,7 @@ def _max_pool(x, ksize, stride, padding, n, channel_last, ceil_mode=False):
             for i, p in enumerate(pad)
         ]
     # -inf init is required for jax's reduce_window max AD rule
-    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.inexact)
-           else jnp.iinfo(x.dtype).min)
+    neg = _neg_init(x.dtype)
     return jax.lax.reduce_window(
         x, neg, jax.lax.max, dims, strides, _full_padding(pad, n,
                                                           channel_last))
@@ -109,6 +108,11 @@ def _avg_pool(x, ksize, stride, padding, n, channel_last, exclusive=True,
 @register_op("max_pool1d")
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCL", name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask is implemented for max_pool2d only; 1d/3d "
+            "masks raise loudly rather than silently ignoring the "
+            "flag")
     return _max_pool(x, kernel_size, stride, padding, 1,
                      data_format == "NLC", ceil_mode)
 
@@ -116,13 +120,92 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 @register_op("max_pool2d")
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        # argmax indices into the FLATTENED h*w input map (reference
+        # max_pool2d_with_index / unpool contract) — previously this
+        # flag was silently ignored
+        if data_format != "NCHW":
+            raise ValueError(
+                "return_mask=True supports NCHW only")
+        return _max_pool2d_with_mask(x, kernel_size, stride, padding,
+                                     ceil_mode)
     return _max_pool(x, kernel_size, stride, padding, 2,
                      data_format == "NHWC", ceil_mode)
+
+
+def _pool_out_size(size, k, s, pad_lo, pad_hi, ceil_mode):
+    """Output extent with the torch/Caffe ceil-mode clamp: the last
+    window must START inside the input-plus-leading-pad region (a
+    window living entirely in trailing padding is dropped)."""
+    import math
+    total = size + pad_lo + pad_hi - k
+    out = (math.ceil(total / s) if ceil_mode else total // s) + 1
+    if ceil_mode and (out - 1) * s >= size + pad_lo:
+        out -= 1
+    return int(out)
+
+
+def _neg_init(dtype):
+    """Identity for a max reduction in `dtype` (shared by the
+    reduce_window path and the mask path)."""
+    return (-jnp.inf if jnp.issubdtype(dtype, jnp.inexact)
+            else jnp.iinfo(dtype).min)
+
+
+def _max_pool2d_with_mask(x, ksize, stride, padding, ceil_mode):
+    """(out, mask): window-shifted slice stacks + one argmax — static
+    shapes, first-occurrence tie-breaking (torch/reference order)."""
+    k = _norm_tuple(ksize, 2)
+    s = _norm_tuple(stride if stride is not None else ksize, 2)
+    pad = _pad_pairs(padding, 2)
+    if isinstance(pad, str):
+        raise ValueError(
+            f"return_mask=True needs explicit padding, got {pad!r}")
+    n, c, h, w = x.shape
+    out_h = _pool_out_size(h, k[0], s[0], pad[0][0], pad[0][1],
+                           ceil_mode)
+    out_w = _pool_out_size(w, k[1], s[1], pad[1][0], pad[1][1],
+                           ceil_mode)
+    # pad values with -inf and the flat-index map with -1, sized so
+    # every window slice below is in bounds
+    need_h = (out_h - 1) * s[0] + k[0]
+    need_w = (out_w - 1) * s[1] + k[1]
+    ph = (pad[0][0], max(pad[0][1], need_h - h - pad[0][0]))
+    pw = (pad[1][0], max(pad[1][1], need_w - w - pad[1][0]))
+    neg = _neg_init(x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), ph, pw), constant_values=neg)
+    iota = jnp.arange(h * w, dtype=jnp.int32).reshape(h, w)
+    ip = jnp.pad(iota, (ph, pw), constant_values=-1)
+    vals, idxs = [], []
+    for di in range(k[0]):
+        for dj in range(k[1]):
+            vals.append(jax.lax.slice(
+                xp, (0, 0, di, dj),
+                (n, c, di + (out_h - 1) * s[0] + 1,
+                 dj + (out_w - 1) * s[1] + 1),
+                (1, 1, s[0], s[1])))
+            idxs.append(jax.lax.slice(
+                ip, (di, dj),
+                (di + (out_h - 1) * s[0] + 1,
+                 dj + (out_w - 1) * s[1] + 1), (s[0], s[1])))
+    v = jnp.stack(vals, axis=-1)            # [N,C,OH,OW,kk]
+    ids = jnp.stack(idxs, axis=-1)          # [OH,OW,kk]
+    am = jnp.argmax(v, axis=-1)
+    out = jnp.take_along_axis(v, am[..., None], axis=-1)[..., 0]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(ids, v.shape), am[..., None],
+        axis=-1)[..., 0]
+    return out, mask
 
 
 @register_op("max_pool3d")
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask is implemented for max_pool2d only; 1d/3d "
+            "masks raise loudly rather than silently ignoring the "
+            "flag")
     return _max_pool(x, kernel_size, stride, padding, 3,
                      data_format == "NDHWC", ceil_mode)
 
@@ -207,14 +290,26 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 @register_op("adaptive_max_pool1d")
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive max-pool masks are not implemented; raising "
+            "loudly rather than silently ignoring return_mask")
     return _adaptive(x, output_size, 1, False, "max")
 
 
 @register_op("adaptive_max_pool2d")
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive max-pool masks are not implemented; raising "
+            "loudly rather than silently ignoring return_mask")
     return _adaptive(x, output_size, 2, False, "max")
 
 
 @register_op("adaptive_max_pool3d")
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive max-pool masks are not implemented; raising "
+            "loudly rather than silently ignoring return_mask")
     return _adaptive(x, output_size, 3, False, "max")

@@ -1,0 +1,147 @@
+"""Tensor-math / linalg / indexing ops vs torch: the long-tail
+reference ops whose existing receipts are single numpy cases get an
+independent oracle across attr combinations (reference
+unittests/op_test.py grid style).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.linalg as L
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_fold_round_trip_and_vs_torch():
+    x = R(0).randn(2, 3, 8, 6).astype(np.float32)
+    k, s = 2, 2
+    u = TF.unfold(torch.from_numpy(x), k, stride=s)
+    ref = TF.fold(u, (8, 6), k, stride=s).numpy()
+    pu = F.unfold(paddle.to_tensor(x), k, strides=s)
+    out = F.fold(pu, (8, 6), k, strides=s)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-5, atol=1e-6)
+    # non-overlapping fold(unfold(x)) == x
+    np.testing.assert_allclose(_np(out), x, rtol=1e-5, atol=1e-6)
+
+
+def test_max_unpool2d_vs_torch():
+    x = R(1).randn(2, 3, 6, 6).astype(np.float32)
+    tx = torch.from_numpy(x)
+    t_out, t_idx = TF.max_pool2d(tx, 2, return_indices=True)
+    ref = TF.max_unpool2d(t_out, t_idx, 2).numpy()
+    p_out, p_idx = F.max_pool2d(paddle.to_tensor(x), 2,
+                                return_mask=True)
+    out = F.max_unpool2d(p_out, p_idx, 2)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-6)
+
+
+def test_cumulative_ops_vs_torch():
+    x = R(2).randn(3, 5).astype(np.float32)
+    tx = torch.from_numpy(x)
+    np.testing.assert_allclose(
+        _np(paddle.cumprod(paddle.to_tensor(x), dim=1)),
+        torch.cumprod(tx, dim=1).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _np(paddle.logcumsumexp(paddle.to_tensor(x), axis=1)),
+        torch.logcumsumexp(tx, dim=1).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _np(paddle.diff(paddle.to_tensor(x), axis=1)),
+        torch.diff(tx, dim=1).numpy(), rtol=1e-6)
+
+
+def test_search_and_rank_ops_vs_torch():
+    sorted_seq = np.sort(R(3).randn(4, 6).astype(np.float32), axis=1)
+    vals = R(4).randn(4, 3).astype(np.float32)
+    ref = torch.searchsorted(torch.from_numpy(sorted_seq),
+                             torch.from_numpy(vals)).numpy()
+    out = paddle.searchsorted(paddle.to_tensor(sorted_seq),
+                              paddle.to_tensor(vals))
+    np.testing.assert_array_equal(_np(out), ref)
+    x = R(5).randn(3, 7).astype(np.float32)
+    tx = torch.from_numpy(x)
+    tv, ti = torch.kthvalue(tx, 3, dim=1)
+    pv, pi = paddle.kthvalue(paddle.to_tensor(x), 3, axis=1)
+    np.testing.assert_allclose(_np(pv), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(_np(pi), ti.numpy())
+    # median over an odd-length axis has a unique answer
+    np.testing.assert_allclose(
+        _np(paddle.median(paddle.to_tensor(x), axis=1)),
+        torch.median(tx, dim=1).values.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        _np(paddle.quantile(paddle.to_tensor(x), 0.25, axis=1)),
+        torch.quantile(tx, 0.25, dim=1).numpy(), rtol=1e-5,
+        atol=1e-6)
+
+
+def test_histogram_bincount_vs_torch():
+    x = R(6).rand(50).astype(np.float32) * 10
+    ref = torch.histc(torch.from_numpy(x), bins=7, min=0,
+                      max=10).numpy()
+    out = paddle.histogram(paddle.to_tensor(x), bins=7, min=0, max=10)
+    np.testing.assert_array_equal(_np(out), ref)
+    ids = R(7).randint(0, 9, (40,)).astype(np.int64)
+    ref = torch.bincount(torch.from_numpy(ids), minlength=12).numpy()
+    out = paddle.bincount(paddle.to_tensor(ids), minlength=12)
+    np.testing.assert_array_equal(_np(out), ref)
+
+
+def test_linalg_vs_torch():
+    a = R(8).randn(4, 4).astype(np.float32)
+    a = a @ a.T + 4 * np.eye(4, dtype=np.float32)   # well-conditioned
+    ta = torch.from_numpy(a)
+    np.testing.assert_allclose(
+        _np(L.matrix_power(paddle.to_tensor(a), 3)),
+        torch.linalg.matrix_power(ta, 3).numpy(), rtol=1e-4)
+    np.testing.assert_allclose(
+        _np(L.pinv(paddle.to_tensor(a))),
+        torch.linalg.pinv(ta).numpy(), rtol=1e-3, atol=1e-4)
+    sign_ref, logdet_ref = torch.linalg.slogdet(ta)
+    sign, logdet = L.slogdet(paddle.to_tensor(a))
+    np.testing.assert_allclose(float(_np(sign)), float(sign_ref),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(_np(logdet)), float(logdet_ref),
+                               rtol=1e-5)
+    b = R(9).randn(4, 2).astype(np.float32)
+    ref = torch.linalg.lstsq(ta, torch.from_numpy(b)).solution.numpy()
+    out = L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+    sol = out[0] if isinstance(out, (tuple, list)) else out
+    np.testing.assert_allclose(_np(sol), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_max_pool_mask_ceil_padding_vs_torch():
+    """ceil_mode+padding: the last-window-starts-in-input clamp must
+    match torch's output shape, and the mask must round-trip through
+    max_unpool2d (review regression: unclamped ceil emitted all-pad
+    windows whose -1 sentinel wrapped to the last cell)."""
+    x = R(12).randn(1, 1, 3, 3).astype(np.float32)
+    tx = torch.from_numpy(x)
+    t_out, t_idx = TF.max_pool2d(tx, 2, stride=2, padding=1,
+                                 ceil_mode=True, return_indices=True)
+    p_out, p_idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                padding=1, ceil_mode=True,
+                                return_mask=True)
+    assert tuple(p_out.shape) == tuple(t_out.shape)
+    np.testing.assert_allclose(_np(p_out), t_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(_np(p_idx), t_idx.numpy())
+    ref = TF.max_unpool2d(t_out, t_idx, 2, stride=2, padding=1,
+                          output_size=(3, 3)).numpy()
+    out = F.max_unpool2d(p_out, p_idx, 2, stride=2, padding=1,
+                         output_size=(3, 3))
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-6)
+
+
+def test_max_pool_mask_flag_errors_loudly_where_unimplemented():
+    import pytest as _pytest
+    x = paddle.to_tensor(R(13).randn(1, 2, 8).astype(np.float32))
+    with _pytest.raises(Exception, match="max_pool2d only"):
+        F.max_pool1d(x, 2, return_mask=True)
+    x3 = paddle.to_tensor(R(14).randn(1, 2, 4, 4, 4).astype(np.float32))
+    with _pytest.raises(Exception, match="max_pool2d only"):
+        F.max_pool3d(x3, 2, return_mask=True)
