@@ -454,3 +454,77 @@ def test_misc_losses_vs_torch():
                                 paddle.to_tensor(y),
                                 paddle.to_tensor(z), margin=0.8, p=2)
     np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_interpolate_linear_1d_grid(align):
+    x = R(32).randn(2, 3, 9).astype(np.float32)
+    ref = TF.interpolate(torch.from_numpy(x), size=14, mode="linear",
+                         align_corners=align).numpy()
+    out = F.interpolate(paddle.to_tensor(x), size=[14], mode="linear",
+                        align_corners=align, data_format="NCW")
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-5, err_msg=f"linear1d {align}")
+
+
+def test_interpolate_nearest_3d():
+    x = R(33).randn(1, 2, 3, 4, 3).astype(np.float32)
+    ref = TF.interpolate(torch.from_numpy(x), scale_factor=2,
+                         mode="nearest").numpy()
+    out = F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                        mode="nearest", data_format="NCDHW")
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+
+
+def test_sort_topk_argsort_vs_torch():
+    x = R(34).randn(4, 9).astype(np.float32)
+    tx = torch.from_numpy(x)
+    for desc in (False, True):
+        tv, ti = torch.sort(tx, dim=1, descending=desc, stable=True)
+        pv = paddle.sort(paddle.to_tensor(x), axis=1,
+                         descending=desc)
+        pi = paddle.argsort(paddle.to_tensor(x), axis=1,
+                            descending=desc)
+        np.testing.assert_allclose(np.asarray(pv._data), tv.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(pi._data),
+                                      ti.numpy())
+    tv, ti = torch.topk(tx, 3, dim=1)
+    pv, pi = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+    np.testing.assert_allclose(np.asarray(pv._data), tv.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(pi._data), ti.numpy())
+    # smallest-k variant
+    tv, ti = torch.topk(tx, 3, dim=1, largest=False)
+    pv, pi = paddle.topk(paddle.to_tensor(x), 3, axis=1,
+                         largest=False)
+    np.testing.assert_allclose(np.asarray(pv._data), tv.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(pi._data), ti.numpy())
+
+
+def test_gather_scatter_index_ops_vs_torch():
+    x = R(35).randn(4, 6).astype(np.float32)
+    # unique-per-row indices: duplicate scatter targets are explicitly
+    # nondeterministic in BOTH frameworks and would make the oracle
+    # flaky across versions/backends
+    idx = np.stack([R(36 + i).permutation(6)[:3]
+                    for i in range(4)]).astype(np.int64)
+    tx = torch.from_numpy(x)
+    ref = torch.gather(tx, 1, torch.from_numpy(idx)).numpy()
+    out = paddle.take_along_axis(paddle.to_tensor(x),
+                                 paddle.to_tensor(idx), axis=1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    upd = R(37).randn(4, 3).astype(np.float32)
+    ref = torch.scatter(tx, 1, torch.from_numpy(idx),
+                        torch.from_numpy(upd)).numpy()
+    out = paddle.put_along_axis(paddle.to_tensor(x),
+                                paddle.to_tensor(idx),
+                                paddle.to_tensor(upd), axis=1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    # index_select
+    sel = np.asarray([3, 0, 5], np.int64)
+    ref = torch.index_select(tx, 1, torch.from_numpy(sel)).numpy()
+    out = paddle.index_select(paddle.to_tensor(x),
+                              paddle.to_tensor(sel), axis=1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
