@@ -528,3 +528,55 @@ def test_gather_scatter_index_ops_vs_torch():
     out = paddle.index_select(paddle.to_tensor(x),
                               paddle.to_tensor(sel), axis=1)
     np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+
+
+def test_manipulation_ops_vs_torch():
+    x = R(40).randn(3, 4, 5).astype(np.float32)
+    tx = torch.from_numpy(x)
+    np.testing.assert_allclose(
+        np.asarray(paddle.roll(paddle.to_tensor(x), 2, axis=1)._data),
+        torch.roll(tx, 2, dims=1).numpy(), rtol=0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.flip(paddle.to_tensor(x), axis=[0, 2])._data),
+        torch.flip(tx, dims=[0, 2]).numpy(), rtol=0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.repeat_interleave(
+            paddle.to_tensor(x), 3, axis=1)._data),
+        torch.repeat_interleave(tx, 3, dim=1).numpy(), rtol=0)
+    reps = np.asarray([1, 3, 2, 1], np.int64)
+    np.testing.assert_allclose(
+        np.asarray(paddle.repeat_interleave(
+            paddle.to_tensor(x), paddle.to_tensor(reps),
+            axis=1)._data),
+        torch.repeat_interleave(tx, torch.from_numpy(reps),
+                                dim=1).numpy(), rtol=0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.rot90(paddle.to_tensor(x), 1,
+                                axes=[1, 2])._data),
+        torch.rot90(tx, 1, dims=[1, 2]).numpy(), rtol=0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.moveaxis(paddle.to_tensor(x), 0, 2)._data),
+        torch.movedim(tx, 0, 2).numpy(), rtol=0)
+
+
+def test_chunk_unbind_split_sections_vs_torch():
+    x = R(41).randn(2, 6, 4).astype(np.float32)
+    tx = torch.from_numpy(x)
+    # NOTE: paddle.chunk requires divisibility (reference contract);
+    # torch allows ragged chunks — compare on the shared case only
+    t_parts = torch.chunk(tx, 3, dim=1)
+    p_parts = paddle.chunk(paddle.to_tensor(x), 3, axis=1)
+    assert len(t_parts) == len(p_parts)
+    for tp, pp in zip(t_parts, p_parts):
+        np.testing.assert_allclose(np.asarray(pp._data), tp.numpy(),
+                                   rtol=0)
+    t_parts = torch.split(tx, [2, 3, 1], dim=1)
+    p_parts = paddle.split(paddle.to_tensor(x), [2, 3, 1], axis=1)
+    for tp, pp in zip(t_parts, p_parts, strict=True):
+        np.testing.assert_allclose(np.asarray(pp._data), tp.numpy(),
+                                   rtol=0)
+    t_parts = torch.unbind(tx, dim=0)
+    p_parts = paddle.unbind(paddle.to_tensor(x), axis=0)
+    for tp, pp in zip(t_parts, p_parts, strict=True):
+        np.testing.assert_allclose(np.asarray(pp._data), tp.numpy(),
+                                   rtol=0)
