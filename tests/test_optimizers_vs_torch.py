@@ -1,0 +1,76 @@
+"""Optimizer update math vs torch on identical params/grads/hyper-
+params: 5-step trajectories for SGD(+momentum+nesterov), Adam, AdamW
+(decoupled decay) and Adagrad — the update rules the reference
+implements in operators/optimizers/*.cc. (RMSProp is deliberately NOT
+torch-compared: the reference puts epsilon INSIDE the sqrt —
+sqrt(ms + eps), rmsprop_op semantics this repo follows — where torch
+uses sqrt(ms) + eps; its receipt is the numpy reference in the op
+tests.)
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+R = np.random.RandomState
+SHAPE = (4, 3)
+
+
+def _run_paddle(opt_name, kwargs, grads):
+    paddle.seed(0)
+    w = paddle.to_tensor(np.ones(SHAPE, np.float32),
+                         stop_gradient=False)
+    opt = getattr(paddle.optimizer, opt_name)(parameters=[w], **kwargs)
+    for g in grads:
+        loss = (w * paddle.to_tensor(g)).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(w._data)
+
+
+def _run_torch(cls, kwargs, grads):
+    w = torch.ones(SHAPE, requires_grad=True)
+    opt = cls([w], **kwargs)
+    for g in grads:
+        opt.zero_grad()
+        (w * torch.from_numpy(g)).sum().backward()
+        opt.step()
+    return w.detach().numpy()
+
+
+GRADS = [R(i).randn(*SHAPE).astype(np.float32) for i in range(5)]
+
+CASES = [
+    ("SGD", dict(learning_rate=0.1), torch.optim.SGD, dict(lr=0.1),
+     1e-6),
+    ("Momentum", dict(learning_rate=0.05, momentum=0.9),
+     torch.optim.SGD, dict(lr=0.05, momentum=0.9), 1e-6),
+    ("Momentum", dict(learning_rate=0.05, momentum=0.9,
+                      use_nesterov=True),
+     torch.optim.SGD, dict(lr=0.05, momentum=0.9, nesterov=True),
+     1e-5),
+    ("Adam", dict(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8),
+     torch.optim.Adam, dict(lr=0.01, betas=(0.9, 0.999), eps=1e-8),
+     1e-5),
+    ("AdamW", dict(learning_rate=0.01, weight_decay=0.1),
+     torch.optim.AdamW, dict(lr=0.01, weight_decay=0.1), 1e-5),
+    ("Adagrad", dict(learning_rate=0.05, initial_accumulator_value=0.1,
+                     epsilon=1e-10),
+     torch.optim.Adagrad, dict(lr=0.05, initial_accumulator_value=0.1,
+                               eps=1e-10), 1e-5),
+]
+
+
+@pytest.mark.parametrize(
+    "pname,pkw,tcls,tkw,tol", CASES,
+    ids=[c[0] + ("_nesterov" if c[1].get("use_nesterov") else "")
+         + ("_wd" if c[1].get("weight_decay") else "")
+         for c in CASES])
+def test_optimizer_trajectory_matches_torch(pname, pkw, tcls, tkw,
+                                            tol):
+    got = _run_paddle(pname, pkw, GRADS)
+    want = _run_torch(tcls, tkw, GRADS)
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
