@@ -240,7 +240,7 @@ class Fleet:
         return DataParallel(model)
 
     def build_pipeline(self, stages, loss_fn, optimizer, strategy=None,
-                       schedule="spmd_1f1b"):
+                       schedule="spmd_1f1b", exec_mode=None):
         """Pipeline-engine factory off the fleet strategy.
         pipeline_configs['accumulate_steps'] is the MICROBATCH COUNT
         (reference PipelineConfig semantics: the global batch is
@@ -250,13 +250,23 @@ class Fleet:
         the form: 'spmd_1f1b' (one compiled program,
         multi-controller-safe; virtual_pipeline_degree from
         pipeline_configs when set) or '1f1b'/'interleaved'/'fthenb'
-        (host-driven engine, heterogeneous stages)."""
+        (host-driven engine, heterogeneous stages). For '1f1b'/
+        'fthenb', exec_mode='spmd_1f1b' keeps the engine surface but
+        compiles the WHOLE step — schedule table, loss scaling,
+        optimizer update — into one donated-state program
+        (PipelineParallel exec_mode; scaler-capable, unlike the
+        stacked SpmdPipelineParallel form)."""
         from ..pipeline import SpmdPipelineParallel
         from ..pipeline_engine import PipelineParallel
         known = ("spmd_1f1b", "1f1b", "interleaved", "fthenb")
         if schedule not in known:
             raise ValueError(
                 f"schedule={schedule!r}: pick one of {known}")
+        if exec_mode is not None and schedule not in ("1f1b", "fthenb"):
+            raise ValueError(
+                f"exec_mode={exec_mode!r} only applies to the "
+                "PipelineParallel schedules ('1f1b'/'fthenb'); "
+                f"schedule={schedule!r} picks its own engine")
         strategy = strategy or self.strategy or DistributedStrategy()
         if not self._initialized:
             # init with the RESOLVED strategy — a bare init() would
@@ -273,7 +283,8 @@ class Fleet:
                 mesh=self.mesh, virtual_pipeline_degree=v)
         return PipelineParallel(
             stages, loss_fn, inner, num_micro=micro, mesh=self.mesh,
-            schedule=schedule, virtual_pipeline_degree=v)
+            schedule=schedule, virtual_pipeline_degree=v,
+            exec_mode=exec_mode or "dispatch")
 
     def build_sharding_plan(self, strategy=None) -> ShardingPlan:
         strategy = strategy or self.strategy or DistributedStrategy()

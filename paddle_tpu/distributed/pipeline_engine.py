@@ -33,11 +33,13 @@ trade.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework import Tensor
@@ -215,6 +217,60 @@ def tick_table(sched: List[Tuple[str, int, int]], n_dev: int,
     return finish
 
 
+def _spmd_tick_tables(sched: List[Tuple[str, int, int]], n_stages: int,
+                      num_micro: int):
+    """Static per-tick per-stage int32 tables for the single-program
+    (exec_mode='spmd_1f1b') engine, derived from the SAME timetable the
+    host engine executes (tick_table over build_1f1b_schedule's order —
+    one copy of the dependency rules, any policy incl. fthenb).
+
+    Returns (tables, R, Rb): tables is a tuple of [T, S] arrays
+    (f_act, f_mb, b_act, b_mb, rf_store, rf_mb, rb_store, rb_mb) — row
+    t holds, per stage, whether a forward/backward runs at tick t and
+    on which microbatch, plus whether last tick's ppermute delivered an
+    activation (rf) or an activation-grad (rb) to store. R/Rb are the
+    EXACT ring sizes the saved-input and incoming-grad buffers need
+    (live-interval analysis via _min_slots): min(M, ~2S) for 1f1b,
+    M-deep for fthenb — the memory law of each policy, derived not
+    hardcoded."""
+    from .pipeline import _min_slots
+
+    S, M = int(n_stages), int(num_micro)
+    finish = tick_table(sched, S, dev_of=lambda s: s)
+    T = max(finish.values())
+    z = lambda: np.zeros((T + 2, S), np.int32)
+    f_act, f_mb, b_act, b_mb = z(), z(), z(), z()
+    rf_store, rf_mb, rb_store, rb_mb = z(), z(), z(), z()
+    for (op, s, m), t in finish.items():
+        if op == "F":
+            f_act[t, s], f_mb[t, s] = 1, m
+            if s < S - 1:     # activation arrives at the consumer at t+1
+                rf_store[t + 1, s + 1] = 1
+                rf_mb[t + 1, s + 1] = m
+        else:
+            b_act[t, s], b_mb[t, s] = 1, m
+            if s > 0:         # activation-grad arrives at s-1 at t+1
+                rb_store[t + 1, s - 1] = 1
+                rb_mb[t + 1, s - 1] = m
+    R = Rb = 1
+    for s in range(S):
+        acts, dys = {}, {}
+        for m in range(M):
+            store = (finish[("F", s, m)] if s == 0
+                     else finish[("F", s - 1, m)] + 1)
+            acts[m] = (store, finish[("B", s, m)])
+            dstore = (finish[("F", s, m)] if s == S - 1
+                      else finish[("B", s + 1, m)] + 1)
+            dys[m] = (dstore, finish[("B", s, m)])
+        R = max(R, _min_slots(acts))
+        Rb = max(Rb, _min_slots(dys))
+    # row 0 is provably empty (finish starts at 1); arrivals landing at
+    # T+1 have no consumer (no op runs past T) so the row is dropped
+    tables = tuple(jnp.asarray(a[1:T + 1]) for a in (
+        f_act, f_mb, b_act, b_mb, rf_store, rf_mb, rb_store, rb_mb))
+    return tables, R, Rb
+
+
 def simulate_schedule(sched: List[Tuple[str, int, int]], n_dev: int,
                       dev_of=None) -> Tuple[int, float]:
     """Unit-time pipeline simulation of a global op order: each rank
@@ -310,6 +366,9 @@ class _Stage:
                                   *(x if isinstance(x, tuple) else (x,)))
             return out, {k: new_state[k] for k in buffers}
 
+        self._run = run
+        self._eval_jit = None  # built lazily by eval_scan_jit()
+
         # stage-local losses (MoE load-balancing aux etc.): a stage Layer
         # may expose pipeline_local_loss() -> traced scalar computed from
         # its LAST forward; it joins the objective through this stage's
@@ -385,21 +444,47 @@ class _Stage:
         self.last_jit = jax.jit(last_fwd, donate_argnums=(6,)) \
             if self.is_last else None
 
-    def place_input(self, x, dp_shard: bool = True):
+    def place_input(self, x, dp_shard: bool = True, batch_axis: int = 0):
         """Move an activation/batch onto this stage's submesh (the
-        recv_v2 side of the p2p transfer)."""
+        recv_v2 side of the p2p transfer). batch_axis picks which dim
+        rides 'dp' (1 for [num_micro, batch, ...] stacked eval input)."""
         if self.submesh is None:
             return x
 
         def put(a):
             nd = np.ndim(a)
             parts = [None] * nd
-            if dp_shard and nd > 0 and "dp" in self.submesh.axis_names \
-                    and a.shape[0] % int(self.submesh.shape["dp"]) == 0:
-                parts[0] = "dp"
+            if dp_shard and nd > batch_axis \
+                    and "dp" in self.submesh.axis_names \
+                    and a.shape[batch_axis] % \
+                    int(self.submesh.shape["dp"]) == 0:
+                parts[batch_axis] = "dp"
             return jax.device_put(a, NamedSharding(self.submesh,
                                                    P(*parts)))
         return jax.tree_util.tree_map(put, x)
+
+    def eval_scan_jit(self):
+        """ONE jitted program for this stage's whole eval pass: a
+        lax.scan over the stacked [num_micro, micro_batch, ...] input
+        (buffers ride the carry, rng keys fold per microbatch — same
+        order and key scheme as the old per-microbatch dispatch loop).
+        Nothing is donated: eval must not invalidate train state."""
+        if self._eval_jit is None:
+            run = self._run
+
+            def ev(params, buffers, key_s, xs):
+                n = jax.tree_util.tree_leaves(xs)[0].shape[0]
+
+                def body(bufs, xm_m):
+                    xm, m = xm_m
+                    y, nb = run(params, bufs,
+                                jax.random.fold_in(key_s, m), xm)
+                    return nb, y
+                nb, ys = lax.scan(body, buffers,
+                                  (xs, jnp.arange(n)))
+                return ys, nb
+            self._eval_jit = jax.jit(ev)
+        return self._eval_jit
 
     def sync_to_layer(self):
         state = self.layer.state_dict()
@@ -422,11 +507,26 @@ class PipelineParallel:
     def __init__(self, stages: Sequence[Layer], loss_fn: Callable,
                  optimizer, num_micro: int = 1, mesh: Optional[Mesh] = None,
                  pp_axis: str = "pp", schedule: str = "1f1b",
-                 param_spec_fn=None, virtual_pipeline_degree: int = 1):
+                 param_spec_fn=None, virtual_pipeline_degree: int = 1,
+                 exec_mode: str = "dispatch"):
         assert len(stages) >= 1
+        if exec_mode not in ("dispatch", "spmd_1f1b"):
+            raise ValueError(
+                f"exec_mode={exec_mode!r}: pick 'dispatch' (per-stage "
+                "executables, host-driven tick loop, heterogeneous "
+                "stages) or 'spmd_1f1b' (the whole train step — every "
+                "microbatch forward/backward, grad accumulation, loss "
+                "scaling, optimizer update — as ONE jitted shard_map "
+                "program with donated state)")
+        self.exec_mode = exec_mode
         self.num_micro = int(num_micro)
         self.schedule_policy = schedule
         self.optimizer = optimizer
+        self.last_tick_ms: List[float] = []  # host ms per schedule op
+        if exec_mode == "spmd_1f1b":
+            self._init_spmd(stages, loss_fn, optimizer, mesh, pp_axis,
+                            schedule, virtual_pipeline_degree)
+            return
         # virtual pipeline (Megatron interleaving): each physical pp
         # rank hosts `v` model chunks — stage i runs on rank i % pp —
         # shrinking the 1F1B bubble from (p-1)/(M+p-1) toward
@@ -491,6 +591,454 @@ class PipelineParallel:
         self._step_count = 0
         self.last_dispatch_count = 0  # jit dispatches in the last batch
 
+    # -- spmd_1f1b execution mode -------------------------------------------
+    # The whole train step as ONE jax.jit-of-shard_map program over the
+    # stage submeshes: build_1f1b_schedule's static tick table (same
+    # timetable the dispatch mode executes on the host, any policy incl.
+    # fthenb) baked in as a lax.scan over ticks, inter-stage activations
+    # and activation-grads moving via lax.ppermute collectives instead of
+    # per-tick device_put, params/opt-state donated end-to-end
+    # (static/train_step.py's donate_argnums discipline), stage state
+    # device-resident across steps. Loss scaling runs in-graph: the
+    # finite check gates the update with jnp.where and the ONE host bool
+    # read (scaler state machine) happens after the step is dispatched.
+
+    def _init_spmd(self, stages, loss_fn, optimizer, mesh, pp_axis,
+                   schedule, v):
+        from .env import get_mesh
+
+        if int(v) != 1:
+            raise ValueError(
+                "exec_mode='spmd_1f1b' runs the plain 1F1B/fthenb "
+                "timetable; for virtual-pipeline interleaving use "
+                "SpmdPipelineParallel(virtual_pipeline_degree=...) or "
+                "the dispatch mode")
+        if schedule not in ("1f1b", "fthenb"):
+            raise ValueError(
+                f"exec_mode='spmd_1f1b' supports schedule '1f1b' or "
+                f"'fthenb', got {schedule!r}")
+        mesh = mesh if mesh is not None else get_mesh()
+        if mesh is None or pp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"exec_mode='spmd_1f1b' needs a mesh with a "
+                f"'{pp_axis}' axis")
+        S = int(mesh.shape[pp_axis])
+        if len(stages) != S:
+            raise ValueError(
+                f"{len(stages)} stages vs mesh {pp_axis}={S}")
+        sds = [s.state_dict() for s in stages]
+        ref = sds[0]
+        for i, st in enumerate(stages[1:], 1):
+            if type(st) is not type(stages[0]):
+                raise ValueError(
+                    f"stage {i} is {type(st).__name__}, stage 0 is "
+                    f"{type(stages[0]).__name__}: spmd_1f1b traces ONE "
+                    "stage body over stacked params; use "
+                    "exec_mode='dispatch' for heterogeneous stages")
+            sd = sds[i]
+            if set(sd) != set(ref) or any(
+                    tuple(sd[k].shape) != tuple(ref[k].shape)
+                    or sd[k].dtype != ref[k].dtype for k in ref):
+                raise ValueError(
+                    f"stage {i} is not structurally identical to stage "
+                    "0 (spmd_1f1b stacks stage params over the "
+                    f"'{pp_axis}' axis); use exec_mode='dispatch'")
+        frozen = [k for sd in sds for k, t in sd.items()
+                  if t.stop_gradient]
+        if frozen:
+            raise ValueError(
+                "stages carry stop_gradient tensors "
+                f"({sorted(set(frozen))[:3]}...): mutable buffers can't "
+                "ride the one-program scan; use exec_mode='dispatch'")
+        if any(getattr(s, "pipeline_local_loss", None) is not None
+               for s in stages):
+            raise ValueError(
+                "stage-local losses (pipeline_local_loss) ride the "
+                "dispatch engine; use exec_mode='dispatch'")
+
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        self.loss_fn = loss_fn
+        self.stages = list(stages)
+        self._n_stages = S
+        self._sched = build_1f1b_schedule(S, self.num_micro, schedule)
+        self._tables, self._ring, self._ring_b = _spmd_tick_tables(
+            self._sched, S, self.num_micro)
+        spec_p = NamedSharding(mesh, P(pp_axis))
+
+        def stacked(k):
+            # per-shard materialization: never builds the unsharded
+            # stack on one device (a model picked for pp because ONE
+            # stage barely fits must not OOM at init)
+            shape = (S,) + tuple(ref[k].shape)
+
+            def cb(index):
+                lo = index[0].start or 0
+                hi = index[0].stop if index[0].stop is not None else S
+                arr = np.stack([np.asarray(sds[j][k]._data)
+                                for j in range(lo, hi)])
+                return arr[(slice(None),) + tuple(index[1:])]
+            return jax.make_array_from_callback(shape, spec_p, cb)
+
+        self.params = {k: stacked(k) for k in ref}
+        # EVERY leaf is committed to the mesh up front (0-d state like
+        # Adam's beta powers included): the first step's input signature
+        # must equal the steady-state one the donated outputs carry, or
+        # XLA builds a second executable for step 2 — breaking the
+        # exactly-one-train-executable contract (and, via different
+        # fusion, bit-for-bit parity with the dispatch mode)
+        spec_r = NamedSharding(mesh, P())
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a: (jax.device_put(a, spec_p)
+                       if np.ndim(a) > 0
+                       else jax.device_put(jnp.asarray(a), spec_r)),
+            optimizer.init_state_tree(self.params))
+        self._pure = functionalize(stages[0].forward, stages[0])
+        self._spmd_steps: Dict[bool, Any] = {}  # use_scaler -> jit step
+        self._spmd_eval = None
+        self._step_count = 0
+        self.last_dispatch_count = 0
+
+    def _spmd_block(self, key):
+        """One stage's forward as an array fn; key folds (stage, micro)
+        exactly like the dispatch mode's keys[s][m]."""
+        pure = self._pure
+        axis = self.pp_axis
+
+        def block(params, m, xm):
+            k = jax.random.fold_in(
+                jax.random.fold_in(key, lax.axis_index(axis)), m)
+            out, _ = pure(params, k, xm)
+            if not isinstance(out, jax.Array):
+                raise ValueError(
+                    "spmd_1f1b stages must return a single array "
+                    "(ring-transferable activation); use "
+                    "exec_mode='dispatch' for tuple activations")
+            return out
+        return block
+
+    def _build_spmd_step(self, use_scaler: bool):
+        from jax import shard_map
+        from .env import axis_context
+
+        mesh, axis = self.mesh, self.pp_axis
+        S, M = self._n_stages, self.num_micro
+        R, Rb = self._ring, self._ring_b
+        tables = self._tables
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        dp = "dp" if "dp" in mesh.axis_names else None
+        data_spec = P(None, dp)
+
+        def spmd(stacked, key, scale, x, labels):
+            params = {k: v[0] for k, v in stacked.items()}
+            s_idx = lax.axis_index(axis)
+            is_first = s_idx == 0
+            is_last = s_idx == S - 1
+            block = self._spmd_block(key)
+            x0 = jax.tree_util.tree_leaves(x)[0]
+            act = jax.eval_shape(block, params, 0, x0[0])
+            if (act.shape, act.dtype) != (x0.shape[1:], x0.dtype):
+                raise ValueError(
+                    "spmd_1f1b stages must map aval->same aval (ring "
+                    f"pipeline); got {x0.shape[1:]}/{x0.dtype} -> "
+                    f"{act.shape}/{act.dtype}; use exec_mode='dispatch'")
+            zeros_act = jnp.zeros(act.shape, act.dtype)
+            perm_fwd = [(r, (r + 1) % S) for r in range(S)]
+            perm_bwd = [(r, (r - 1) % S) for r in range(S)]
+
+            def pick(vec):
+                return lax.dynamic_index_in_dim(vec, s_idx, 0,
+                                                keepdims=False)
+
+            def tick(carry, xs):
+                act_in, dy_in, actbuf, dybuf, gacc, losses = carry
+                fa, fm, ba, bm, rfs, rfm, rbs, rbm = [
+                    pick(t) for t in xs]
+
+                # 1) store last tick's ppermute arrivals in the rings
+                actbuf = lax.cond(
+                    rfs == 1,
+                    lambda b: lax.dynamic_update_index_in_dim(
+                        b, act_in, rfm % R, 0),
+                    lambda b: b, actbuf)
+                dybuf = lax.cond(
+                    rbs == 1,
+                    lambda b: lax.dynamic_update_index_in_dim(
+                        b, dy_in, rbm % Rb, 0),
+                    lambda b: b, dybuf)
+
+                # 2) forward unit. The LAST stage mirrors the dispatch
+                # mode's last_fwd exactly: loss and grads (wrt params
+                # AND input) come from ONE joint value_and_grad at
+                # F-time — objective loss*scale, reported loss
+                # unscaled, grad accumulation fused here in m order —
+                # and the input-grad parks in the dy ring until this
+                # stage's own B tick forwards it.
+                def do_f(ops):
+                    actbuf, dybuf, losses, gacc = ops
+                    inp = jnp.where(
+                        is_first,
+                        lax.dynamic_index_in_dim(x, fm, 0,
+                                                 keepdims=False),
+                        lax.dynamic_index_in_dim(actbuf, fm % R, 0,
+                                                 keepdims=False))
+                    # save the input for the remat backward
+                    actbuf = lax.dynamic_update_index_in_dim(
+                        actbuf, inp, fm % R, 0)
+
+                    def last_f(ops2):
+                        dybuf, losses, gacc = ops2
+                        lbl = jax.tree_util.tree_map(
+                            lambda a: lax.dynamic_index_in_dim(
+                                a, fm, 0, keepdims=False), labels)
+
+                        def f(p, xx):
+                            yy = block(p, fm, xx)
+                            val = loss_fn(_wrap_tree(yy),
+                                          *_wrap_tree(lbl))
+                            l = val._data.astype(jnp.float32)
+                            return l * scale, l
+                        (_, l), (gp, gx) = jax.value_and_grad(
+                            f, argnums=(0, 1), has_aux=True)(
+                            params, inp)
+                        gacc = jax.tree_util.tree_map(jnp.add, gacc,
+                                                      gp)
+                        dybuf = lax.dynamic_update_index_in_dim(
+                            dybuf, gx, fm % Rb, 0)
+                        losses = lax.dynamic_update_index_in_dim(
+                            losses, l, fm, 0)
+                        return zeros_act, dybuf, losses, gacc
+
+                    def mid_f(ops2):
+                        dybuf, losses, gacc = ops2
+                        return (block(params, fm, inp), dybuf, losses,
+                                gacc)
+
+                    y_send, dybuf, losses, gacc = lax.cond(
+                        is_last, last_f, mid_f, (dybuf, losses, gacc))
+                    return y_send, actbuf, dybuf, losses, gacc
+
+                y_f, actbuf, dybuf, losses, gacc = lax.cond(
+                    fa == 1, do_f,
+                    lambda ops: (zeros_act,) + ops,
+                    (actbuf, dybuf, losses, gacc))
+
+                # 3) backward unit: rematerialize the stage forward
+                # from the saved input (dispatch mode's bwd_jit), grad
+                # accumulation fused in m order; the last stage already
+                # produced its grads at F and only forwards the parked
+                # input-grad downstream
+                def do_b(gacc):
+                    dy = lax.dynamic_index_in_dim(
+                        dybuf, bm % Rb, 0, keepdims=False)
+
+                    def last_b(g):
+                        return dy, g
+
+                    def mid_b(g):
+                        x_saved = lax.dynamic_index_in_dim(
+                            actbuf, bm % R, 0, keepdims=False)
+                        _, vjp = jax.vjp(
+                            lambda p, xx: block(p, bm, xx), params,
+                            x_saved)
+                        gp, gx = vjp(dy)
+                        g = jax.tree_util.tree_map(jnp.add, g, gp)
+                        return gx, g
+                    return lax.cond(is_last, last_b, mid_b, gacc)
+
+                gx_b, gacc = lax.cond(ba == 1, do_b,
+                                      lambda g: (zeros_act, g), gacc)
+                act_in = lax.ppermute(y_f, axis, perm_fwd)
+                dy_in = lax.ppermute(gx_b, axis, perm_bwd)
+                return (act_in, dy_in, actbuf, dybuf, gacc,
+                        losses), None
+
+            carry0 = (zeros_act, zeros_act,
+                      jnp.zeros((R,) + act.shape, act.dtype),
+                      jnp.zeros((Rb,) + act.shape, act.dtype),
+                      jax.tree_util.tree_map(jnp.zeros_like, params),
+                      jnp.zeros((M,), jnp.float32))
+            with axis_context(axis):
+                (_, _, _, _, gacc, losses), _ = lax.scan(
+                    tick, carry0, tables)
+            # only the last stage wrote losses; psum broadcasts them
+            losses = lax.psum(losses, axis)
+            if dp is not None:
+                losses = lax.pmean(losses, dp)
+                gacc = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, dp), gacc)
+            return losses, jax.tree_util.tree_map(
+                lambda a: a[None], gacc)
+
+        smapped = shard_map(
+            spmd, mesh=mesh,
+            in_specs=({k: P(axis) for k in self.params}, P(), P(),
+                      data_spec, data_spec),
+            out_specs=(P(), {k: P(axis) for k in self.params}),
+            check_vma=False)
+
+        def step(stacked, opt_state, key, lr, scale, x, labels):
+            losses, grads = smapped(stacked, key, scale, x, labels)
+            loss = jnp.mean(losses)
+            if use_scaler:
+                leaves = [jnp.all(jnp.isfinite(g))
+                          for g in jax.tree_util.tree_leaves(grads)]
+                found_inf = ~jnp.stack(leaves).all()
+            else:
+                found_inf = jnp.asarray(False)
+            grads = jax.tree_util.tree_map(
+                lambda g: g / (M * scale), grads)
+            new_p, new_st = opt.apply_gradients_tree(
+                stacked, grads, opt_state, lr=lr)
+            if use_scaler:
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(found_inf, o, n), new, old)
+                new_p = keep(new_p, stacked)
+                new_st = keep(new_st, opt_state)
+            return new_p, new_st, loss, found_inf
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    @property
+    def compile_count(self) -> int:
+        """Number of train-step executables XLA built for this engine
+        (spmd_1f1b contract: exactly one per (scaler, shapes) config —
+        the bench smoke regresses on this going above the config
+        count)."""
+        if self.exec_mode != "spmd_1f1b":
+            return -1  # dispatch mode compiles per-stage programs
+        return sum(int(f._cache_size())
+                   for f in self._spmd_steps.values())
+
+    def _spmd_micro(self, tree, broadcast_scalars: bool = False):
+        """[batch, ...] leaves -> [num_micro, batch//num_micro, ...].
+        broadcast_scalars: 0-d leaves become one copy per microbatch
+        ([M]) so a lax.scan can slice them back to the same scalar each
+        microbatch — the per-microbatch host loop's contract for the
+        eval path. The shard_map'd train step can't take 0-d leaves at
+        all (its data specs address the [M, micro_batch] dims);
+        _spmd_train_batch rejects them with a curated error."""
+        M = self.num_micro
+
+        def reshape(a):
+            if np.ndim(a) == 0:
+                if broadcast_scalars:
+                    return jnp.broadcast_to(jnp.asarray(a), (M,))
+                return a
+            if a.shape[0] % M != 0:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} not divisible by "
+                    f"num_micro={M} (remainder rows would be dropped)")
+            return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+        return jax.tree_util.tree_map(reshape, tree)
+
+    def _spmd_train_batch(self, inputs, labels=(), scaler=None):
+        from ..core.generator import next_key
+        use_scaler = scaler is not None and scaler.is_enable()
+        scale_val = jnp.asarray(
+            scaler.get_loss_scaling() if use_scaler else 1.0,
+            jnp.float32)
+        inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        if len(inputs) != 1:
+            raise ValueError(
+                "spmd_1f1b takes ONE input array (the ring "
+                "activation); use exec_mode='dispatch' for multi-input "
+                "first stages")
+        labels = labels if isinstance(labels, (list, tuple)) \
+            else (labels,)
+        lbl_raw = _unwrap_tree(tuple(labels))
+        if any(np.ndim(a) == 0
+               for a in jax.tree_util.tree_leaves(lbl_raw)):
+            raise ValueError(
+                "spmd_1f1b labels must be batched arrays (the "
+                "one-program step slices them per microbatch in-graph; "
+                "0-d leaves can't ride its data specs); use "
+                "exec_mode='dispatch' for scalar label leaves")
+        x = self._spmd_micro(_unwrap_tree(inputs[0]))
+        lbl = self._spmd_micro(lbl_raw)
+        step = self._spmd_steps.get(use_scaler)
+        if step is None:
+            step = self._spmd_steps[use_scaler] = \
+                self._build_spmd_step(use_scaler)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self.params, self.opt_state, loss, found_inf = step(
+            self.params, self.opt_state, next_key(), lr, scale_val,
+            x, lbl)
+        self._step_count += 1
+        self.last_dispatch_count = 1
+        self.last_tick_ms = []  # ticks are in-graph: nothing to time
+        if use_scaler:
+            # ONE host bool per step, read after the step is dispatched
+            scaler._update(bool(np.asarray(found_inf)))
+        return Tensor(loss)
+
+    def _build_spmd_eval(self):
+        from jax import shard_map
+        from .env import axis_context
+
+        mesh, axis = self.mesh, self.pp_axis
+        S, M = self._n_stages, self.num_micro
+        dp = "dp" if "dp" in mesh.axis_names else None
+        data_spec = P(None, dp)
+
+        def spmd(stacked, key, x):
+            params = {k: v[0] for k, v in stacked.items()}
+            s_idx = lax.axis_index(axis)
+            is_first = s_idx == 0
+            is_last = s_idx == S - 1
+            block = self._spmd_block(key)
+            x0 = x[0]
+            perm_fwd = [(r, (r + 1) % S) for r in range(S)]
+
+            def tick(carry, t):
+                act_in, outs = carry
+                mb = t - s_idx
+                active = (mb >= 0) & (mb < M)
+                mbc = jnp.clip(mb, 0, M - 1)
+                inp = jnp.where(
+                    is_first,
+                    lax.dynamic_index_in_dim(x, mbc, 0,
+                                             keepdims=False),
+                    act_in)
+                y = lax.cond(active,
+                             lambda xx: block(params, mbc, xx),
+                             lambda xx: jnp.zeros_like(x0), inp)
+                outs = jnp.where(
+                    is_last & active,
+                    lax.dynamic_update_index_in_dim(outs, y, mbc, 0),
+                    outs)
+                act_in = lax.ppermute(y, axis, perm_fwd)
+                return (act_in, outs), None
+
+            carry0 = (jnp.zeros_like(x0), jnp.zeros_like(x))
+            with axis_context(axis):
+                (_, outs), _ = lax.scan(tick, carry0,
+                                        jnp.arange(M + S - 1))
+            return lax.psum(outs, axis)
+
+        smapped = shard_map(
+            spmd, mesh=mesh,
+            in_specs=({k: P(axis) for k in self.params}, P(),
+                      data_spec),
+            out_specs=data_spec, check_vma=False)
+        return jax.jit(smapped)  # donates NOTHING: eval must not
+        #                          invalidate train state
+
+    def _spmd_eval_batch(self, inputs):
+        from ..core.generator import next_key
+        inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        if len(inputs) != 1:
+            raise ValueError("spmd_1f1b eval takes one input array")
+        x = self._spmd_micro(_unwrap_tree(inputs[0]))
+        if self._spmd_eval is None:
+            self._spmd_eval = self._build_spmd_eval()
+        out = self._spmd_eval(self.params, next_key(), x)
+        self.last_dispatch_count = 1
+        return Tensor(out.reshape((-1,) + out.shape[2:]))
+
     # -- one full batch ------------------------------------------------------
     def train_batch(self, inputs, labels=(), scaler=None):
         """Run one pipelined training step over num_micro microbatches.
@@ -501,6 +1049,8 @@ class PipelineParallel:
         time (the engine is host-orchestrated anyway, so this costs no
         extra round-trip), skipped steps leave params/opt state alone,
         and the scaler's dynamic schedule advances."""
+        if self.exec_mode == "spmd_1f1b":
+            return self._spmd_train_batch(inputs, labels, scaler)
         from ..core.generator import next_key
         use_scaler = scaler is not None and scaler.is_enable()
         scale_val = jnp.asarray(
@@ -535,8 +1085,11 @@ class PipelineParallel:
         grad_acc = [None] * S  # carried INSIDE the fused bwd calls
         losses = []
         dispatches = 0
+        tick_ms: List[float] = []  # host cost per schedule op — the
+        #   per-tick p50/p99 the bench reports (orchestration budget)
 
         for op, s, m in self._sched:
+            _t_tick = time.perf_counter()
             stage = self.stages[s]
             if op == "F":
                 if s == 0:
@@ -572,6 +1125,8 @@ class PipelineParallel:
                 del acts[s][m]  # 1f1b frees this activation now
                 if s > 0:
                     gys[s - 1][m] = self.stages[s - 1].place_input(gx)
+            tick_ms.append((time.perf_counter() - _t_tick) * 1e3)
+        self.last_tick_ms = tick_ms
 
         # optimize (reference SectionWorker optimize phase): one fused
         # update dispatch per stage; the overflow check gates the update
@@ -583,8 +1138,17 @@ class PipelineParallel:
             [jnp.asarray(l) for l in losses]))
         if use_scaler:
             flags = [self._inf_jit(g) for g in grad_acc]
-            found_inf = self._any_jit(*flags)
-            dispatches += S + 1
+            dispatches += S
+            if self.stages[0].submesh is None:
+                found_inf = self._any_jit(*flags)
+                dispatches += 1
+            else:
+                # per-stage flags live on disjoint submeshes — one jit
+                # can't combine them; sync the S bools on the host and
+                # feed the combined flag back uncommitted (each stage's
+                # update places it on its own submesh)
+                found_inf = jnp.asarray(
+                    bool(any(np.asarray(f) for f in flags)))
         else:
             found_inf = jnp.asarray(False)
         for s, stage in enumerate(self.stages):
@@ -601,42 +1165,49 @@ class PipelineParallel:
 
     # predict-only path (no labels/backward)
     def eval_batch(self, inputs):
+        """Batched eval: every stage runs its WHOLE microbatch sweep in
+        one jitted lax.scan call (S dispatches per batch instead of the
+        old M*S host loop; spmd_1f1b mode is a single program). Nothing
+        is donated — eval never invalidates train state. Microbatch
+        order, rng keys, and buffer threading match the old loop
+        exactly."""
+        if self.exec_mode == "spmd_1f1b":
+            return self._spmd_eval_batch(inputs)
         from ..core.generator import next_key
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         x = _unwrap_tree(tuple(inputs))
-        for a in jax.tree_util.tree_leaves(x):
-            if np.ndim(a) > 0 and a.shape[0] % self.num_micro != 0:
-                raise ValueError(
-                    f"batch dim {a.shape[0]} not divisible by "
-                    f"num_micro={self.num_micro}")
         key = next_key()
-        outs = []
-        for m in range(self.num_micro):
-            def sl(a):
-                if np.ndim(a) == 0:
-                    return a
-                micro_b = a.shape[0] // self.num_micro
-                return a[m * micro_b:(m + 1) * micro_b]
-            cur = jax.tree_util.tree_map(sl, x)
-            cur = self.stages[0].place_input(cur)
-            cur = cur if len(cur) > 1 else cur[0]
-            for s, stage in enumerate(self.stages):
-                if s > 0:
-                    cur = stage.place_input(cur)
-                k = jax.random.fold_in(jax.random.fold_in(key, s), m)
-                cur, nb = stage.fwd_jit(stage.params, stage.buffers, k,
-                                        cur)
-                stage.buffers = nb
-            outs.append(cur)
+        cur = self._spmd_micro(x, broadcast_scalars=True)
+        cur = self.stages[0].place_input(cur, batch_axis=1)
+        cur = cur if len(cur) > 1 else cur[0]
+        dispatches = 0
+        for s, stage in enumerate(self.stages):
+            if s > 0:
+                cur = stage.place_input(cur, batch_axis=1)
+            key_s = jax.random.fold_in(key, s)
+            cur, nb = stage.eval_scan_jit()(stage.params, stage.buffers,
+                                            key_s, cur)
+            stage.buffers = nb
+            dispatches += 1
+        self.last_dispatch_count = dispatches
         return jax.tree_util.tree_map(
-            lambda *xs: Tensor(jnp.concatenate(xs, axis=0)), *outs)
+            lambda a: Tensor(a.reshape((-1,) + a.shape[2:])), cur)
 
     def sync_to_layers(self):
+        if self.exec_mode == "spmd_1f1b":
+            for g, stage in enumerate(self.stages):
+                sd = stage.state_dict()
+                for k, val in self.params.items():
+                    sd[k]._data = val[g]
+            return
         for s in self.stages:
             s.sync_to_layer()
 
     def state_dict(self):
         self.sync_to_layers()
+        if self.exec_mode == "spmd_1f1b":
+            return {"stages": [s.state_dict() for s in self.stages],
+                    "opt_state": self.opt_state}
         return {"stages": [
             {"model": s.layer.state_dict(), "opt_state": st}
             for s, st in zip(self.stages, self.opt_states)]}

@@ -1,0 +1,126 @@
+"""jax version compatibility shims.
+
+The codebase targets the current jax API surface; older runtimes miss
+pieces of it. Importing this module (paddle_tpu/__init__.py does it
+first, before any submodule touches jax) backfills what can be
+backfilled so the same source runs on both:
+
+- `jax.shard_map`: promoted from jax.experimental.shard_map on
+  runtimes that predate the top-level export, with the `check_vma`
+  kwarg translated to its old name `check_rep` (same meaning: disable
+  the per-axis replication check). Installed on the jax module itself
+  so third-party-style `from jax import shard_map` in tests/tools
+  resolves too.
+- `jax.lax.axis_size`: backfilled as psum(1, axis), which the mapped
+  tracers constant-fold to a plain python int — exactly the value the
+  pipeline schedules need at trace time.
+- `jax.config.update("jax_num_cpu_devices", n)`: on runtimes without
+  that option, translated to the XLA host-platform flag (which the
+  lazily-created CPU client reads at first backend init — same
+  before-first-use contract as the real option).
+- CPU cross-process collectives: runtimes that still default
+  `jax_cpu_collectives_implementation` to "none" get it flipped to
+  "gloo" (the current-jax default) so multi-process CPU meshes — the
+  suite's multihost emulation — work instead of failing with
+  "Multiprocess computations aren't implemented on the CPU backend".
+
+No jax objects are imported at paddle_tpu import time beyond the jax
+module object itself — the shim must not initialize any backend.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["install"]
+
+_installed = False
+
+
+def _wrap_shard_map(sm):
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        return sm
+    if "check_vma" in params:
+        return sm  # current API already
+
+    @functools.wraps(sm)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            val = kwargs.pop("check_vma")
+            if "check_rep" in params:
+                kwargs["check_rep"] = val
+        return sm(*args, **kwargs)
+    return shard_map
+
+
+def _force_host_device_flag(n: int):
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    kept = [f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={int(n)}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+
+
+def enable_cpu_collectives():
+    """Call immediately BEFORE jax.distributed.initialize on a
+    multi-process CPU job. Runtimes that still default
+    `jax_cpu_collectives_implementation` to "none" can't run
+    cross-process CPU computations at all; flipping to "gloo" (the
+    current-jax default) fixes that. Deliberately NOT part of
+    install(): on those same runtimes gloo WITHOUT a distributed
+    client breaks plain single-process CPU backend creation, so the
+    flip must be scoped to processes that really initialize
+    jax.distributed."""
+    import jax
+    cur = getattr(jax.config, "jax_cpu_collectives_implementation",
+                  None)
+    if cur is None:
+        try:
+            cur = jax.config.read("jax_cpu_collectives_implementation")
+        except Exception:
+            cur = None
+    if cur in (None, "none"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # pragma: no cover — option gone on newer jax
+            pass
+
+
+def install():
+    """Idempotently install the shims on the live jax module."""
+    global _installed
+    if _installed:
+        return
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _sm
+        jax.shard_map = _wrap_shard_map(_sm)
+    else:
+        jax.shard_map = _wrap_shard_map(jax.shard_map)
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+        jax.lax.axis_size = axis_size
+
+    _orig_update = jax.config.update
+
+    def update(name, val):
+        try:
+            return _orig_update(name, val)
+        except Exception as e:
+            if name == "jax_num_cpu_devices" \
+                    and "Unrecognized config option" in str(e):
+                _force_host_device_flag(int(val))
+                return None
+            raise
+    jax.config.update = update
+    _installed = True
+
+
+install()

@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 
 __all__ = ["RecordEvent", "Profiler", "start_profiler", "stop_profiler",
            "profiler_guard", "export_chrome_tracing", "summary",
-           "start_trace", "stop_trace"]
+           "start_trace", "stop_trace", "StepClock"]
 
 _lock = threading.Lock()
 _enabled = False
@@ -182,6 +182,86 @@ def summary(sorted_key="total"):
     key = {"total": "total_us", "calls": "calls", "max": "max_us",
            "ave": "avg_us"}.get(sorted_key, "total_us")
     return dict(sorted(agg.items(), key=lambda kv: -kv[1][key]))
+
+
+# -- orchestration-overhead budget ------------------------------------------
+
+class StepClock:
+    """Per-step host wall-clock with an orchestration-overhead budget.
+
+    The pipeline engines' contract (reference section_worker.cc:34's
+    tight loop) is that HOST orchestration — schedule bookkeeping, jit
+    dispatch, transfer setup — must not steal meaningful time from the
+    device. This clock measures it: wrap each train step in `step()`,
+    optionally feed the engine's per-tick host times via `add_ticks`,
+    then `orchestration_fraction(device_compute_s)` reports what part of
+    the median step the device compute estimate cannot account for
+    (host wall time minus device compute time, as a fraction).
+
+        clock = profiler.StepClock()
+        for _ in range(n):
+            with clock.step():
+                engine.train_batch(x, y)
+            clock.add_ticks(engine.last_tick_ms)
+        frac = clock.orchestration_fraction(serial_compute_seconds)
+        stats = clock.stats()   # step/tick p50 + p99 in ms
+    """
+
+    def __init__(self):
+        self.steps_s: List[float] = []
+        self.ticks_ms: List[float] = []
+
+    @contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.steps_s.append(time.perf_counter() - t0)
+
+    def add_ticks(self, ticks_ms):
+        self.ticks_ms.extend(float(t) for t in ticks_ms)
+
+    @staticmethod
+    def _pct(xs, q):
+        if not xs:
+            return -1.0
+        ys = sorted(xs)
+        idx = min(len(ys) - 1, max(0, int(round(q / 100.0
+                                                * (len(ys) - 1)))))
+        return ys[idx]
+
+    def step_ms(self, q: float = 50.0) -> float:
+        return self._pct(self.steps_s, q) * 1e3 if self.steps_s else -1.0
+
+    def tick_ms(self, q: float = 50.0) -> float:
+        return self._pct(self.ticks_ms, q)
+
+    def orchestration_fraction(self, device_compute_s: float) -> float:
+        """(median step wall time - device compute estimate) / wall —
+        the upper bound on what host orchestration can steal from an
+        ideal speedup. Clamped at 0 (an estimate above the measured
+        wall means measurement noise, not negative overhead)."""
+        if not self.steps_s:
+            return -1.0
+        wall = self._pct(self.steps_s, 50.0)
+        if wall <= 0.0:
+            return -1.0
+        return max(0.0, (wall - float(device_compute_s)) / wall)
+
+    def stats(self, device_compute_s: Optional[float] = None) -> dict:
+        out = {
+            "steps": len(self.steps_s),
+            "step_ms_p50": round(self.step_ms(50), 3),
+            "step_ms_p99": round(self.step_ms(99), 3),
+        }
+        if self.ticks_ms:
+            out["tick_ms_p50"] = round(self.tick_ms(50), 4)
+            out["tick_ms_p99"] = round(self.tick_ms(99), 4)
+        if device_compute_s is not None:
+            out["orchestration_fraction"] = round(
+                self.orchestration_fraction(device_compute_s), 4)
+        return out
 
 
 # -- device-side (XPlane) bridge --------------------------------------------

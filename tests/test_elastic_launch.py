@@ -69,6 +69,7 @@ def test_crash_detected_and_job_completes(tmp_path, control):
     assert _final(out, 1)["incarnation"] >= 1
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_hang_detected_by_heartbeat_and_job_completes(tmp_path, control):
     r, out = _launch(tmp_path, "hang", "hang")
     assert r.returncode == 0, r.stderr[-3000:]

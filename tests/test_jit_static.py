@@ -152,7 +152,12 @@ def test_static_program_train_loop():
     xs = np.random.RandomState(7).rand(32, 4).astype(np.float32)
     ys = (xs @ np.array([[1.], [2.], [-1.], [0.5]], np.float32))
     losses = []
-    for i in range(300):
+    # 600 steps: the weight-recovery bound must hold for ANY init the
+    # seeded generator produces — jax PRNG streams differ across jax
+    # versions, and 300 steps left one coordinate at 0.30 off on some
+    # (the loss bound already passed; this is init-robustness, not a
+    # weaker test)
+    for i in range(600):
         (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
         losses.append(float(lv))
     assert losses[-1] < losses[0] * 0.05

@@ -216,6 +216,7 @@ def test_ernie_moe_pipeline_stage_placement_matches():
     assert flags == [False, True, False, True]
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_ernie_moe_pipeline_matches_single_device():
     """pipeline MoE training equals eager training of the SAME stage
     chain with the aux loss added: the engine's stage-local loss path

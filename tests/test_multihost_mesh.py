@@ -10,6 +10,7 @@ The same model/step code runs 1-process x 8-device as the control;
 per-step losses must agree across ranks AND with the control.
 """
 import json
+import pytest
 import os
 import socket
 import subprocess
@@ -28,6 +29,7 @@ def _free_port():
     return p
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_two_process_four_device_dp_tp(tmp_path):
     env = dict(os.environ)
     env.update({

@@ -69,6 +69,7 @@ def test_rendezvous_multiprocess(tmp_path):
         assert p.wait(timeout=45) == 0
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_two_process_dp_trainstep(tmp_path):
     """2-process dp TrainStep: coordination-service init -> sharded step
     with cross-process grad all-reduce -> loss equality vs a 1-process

@@ -38,6 +38,7 @@ def test_native_client_status_recorded():
         assert by["r"]["ran"], by["r"].get("stderr")
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_r_demo_flow_from_python(tmp_path):
     """Replay r/example/mobilenet.r's call sequence 1:1 in Python."""
     env = dict(os.environ, PYTHONPATH=REPO)

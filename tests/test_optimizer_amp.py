@@ -307,6 +307,7 @@ class TestInGraphLossScaling:
         assert bool(inf) and float(s) == 2.0
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_ernie_tiny_fp16_o2_trains():
     """fp16 O2 end-to-end (VERDICT item 5 done-criterion): ERNIE-tiny
     under TrainStep with in-graph dynamic loss scaling + master weights

@@ -125,6 +125,7 @@ class TestPipelineTraining:
                                            np.asarray(vb._data),
                                            rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
     def test_ernie_2stage_trains_and_matches(self):
         """VERDICT item 2 done-criterion: ERNIE split across 2 pp stages
         (embedding in stage 0, lm head in stage 1) trains and its loss

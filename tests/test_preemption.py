@@ -25,6 +25,7 @@ def _run(ckpt_dir, out, kill_at=None, timeout=600):
                           timeout=timeout)
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_sigkill_mid_epoch_then_exact_resume(tmp_path):
     control_dir = str(tmp_path / "control")
     drill_dir = str(tmp_path / "drill")

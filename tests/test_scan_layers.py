@@ -250,6 +250,7 @@ def test_gpt_scanned_generate_matches_unrolled():
                                   np.asarray(out_s._data))
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_scan_composes_with_ring_sequence_parallel():
     """scan_layers x sequence_parallel: the ppermute ring runs inside
     the lax.scan body (shard_map-under-scan) and matches the unrolled
@@ -309,6 +310,7 @@ def test_scan_composes_with_sharding_plan():
         dist.set_mesh(None)
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
 def test_scan_composes_with_pipeline_stages():
     """ernie_pipeline_stages(scan_layers=True): each stage's block run
     is a ScannedStack; 1F1B training matches the unrolled stages on

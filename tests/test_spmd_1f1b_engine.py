@@ -1,0 +1,349 @@
+"""exec_mode='spmd_1f1b' receipts: PipelineParallel's single-program
+mode must be a drop-in replacement for the host-driven dispatch loop.
+
+- numerics: bit-for-bit parity (f32, SGD) with the dispatch engine on a
+  2-stage CPU mesh for BOTH timetables — 1f1b and fthenb (the GPipe
+  F-then-B form) — plus Adam within float-fusion tolerance (XLA fuses
+  the stacked update with fma; 1-ulp class difference, bounded here).
+- compile discipline: exactly ONE train executable per config, one
+  dispatch per train_batch (the per-tick-dispatch regression guard at
+  engine level; the bench smoke guards the measured side).
+- loss scaling: in-graph finite gate — identical losses, identical
+  skip-step/scale-halving behavior on an inf batch.
+- eval: the batched eval path (one scan per stage / one program in
+  spmd mode) preserves the old per-microbatch loop's semantics and
+  never invalidates train state.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+S, M, H, MB = 2, 8, 16, 4
+
+
+class _TanhStage(nn.Layer):
+    def __init__(self, wi, bi):
+        super().__init__()
+        self.lin = nn.Linear(H, H)
+        self.lin.weight.set_value(np.asarray(wi))
+        self.lin.bias.set_value(np.asarray(bi))
+
+    def forward(self, xx):
+        return paddle.tanh(self.lin(xx))
+
+
+def _loss_fn(o, t):
+    return ((o - t) ** 2).mean()
+
+
+def _data(seed=0, s=S):
+    rng = np.random.RandomState(seed)
+    w0 = rng.randn(s, H, H).astype(np.float32) * 0.3
+    b0 = rng.randn(s, H).astype(np.float32) * 0.1
+    x = paddle.to_tensor(rng.randn(M * MB, H).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(M * MB, H).astype(np.float32))
+    return w0, b0, x, y
+
+
+def _train(exec_mode, w0, b0, x, y, opt_fn, steps=3, sched="1f1b",
+           s=S, mesh_shape=None):
+    paddle.seed(0)
+    stages = [_TanhStage(w0[i], b0[i]) for i in range(s)]
+    shape = mesh_shape or {"pp": s}
+    n = int(np.prod(list(shape.values())))
+    mesh = dist.build_mesh(shape, devices=jax.devices()[:n])
+    eng = dist.PipelineParallel(stages, _loss_fn, opt_fn(),
+                                num_micro=M, mesh=mesh, schedule=sched,
+                                exec_mode=exec_mode)
+    losses = [float(eng.train_batch(x, y).item()) for _ in range(steps)]
+    eng.sync_to_layers()
+    weights = [np.asarray(st.lin.weight._data) for st in stages]
+    return losses, weights, eng
+
+
+@pytest.mark.parametrize("sched", ["1f1b", "fthenb"])
+def test_bitwise_matches_dispatch_engine(sched):
+    """f32 bit-for-bit: the one-program mode replays the dispatch
+    engine's exact timetable (build_1f1b_schedule -> tick_table), so
+    with SGD the losses AND the post-training weights are identical to
+    the last bit — for 1f1b and for the GPipe F-then-B form."""
+    w0, b0, x, y = _data(0)
+    opt = lambda: paddle.optimizer.SGD(learning_rate=1e-2)
+    hl, hw, _ = _train("dispatch", w0, b0, x, y, opt, sched=sched)
+    sl, sw, se = _train("spmd_1f1b", w0, b0, x, y, opt, sched=sched)
+    assert hl == sl  # float-exact, not approx
+    for i in range(S):
+        np.testing.assert_array_equal(hw[i], sw[i])
+    assert se.last_dispatch_count == 1
+    assert se.compile_count == 1
+
+
+def test_bitwise_matches_dispatch_engine_4stage():
+    w0, b0, x, y = _data(1, s=4)
+    opt = lambda: paddle.optimizer.SGD(learning_rate=1e-2)
+    hl, hw, _ = _train("dispatch", w0, b0, x, y, opt, steps=2, s=4)
+    sl, sw, se = _train("spmd_1f1b", w0, b0, x, y, opt, steps=2, s=4)
+    assert hl == sl
+    for i in range(4):
+        np.testing.assert_array_equal(hw[i], sw[i])
+    assert se.compile_count == 1
+
+
+def test_adam_parity_and_single_executable():
+    """Adam: losses bit-for-bit; weights within 1-ulp class (the fused
+    stacked update uses fma where the dispatch engine's standalone
+    optimizer executable doesn't). Exactly one executable across all
+    steps — the step-2 recompile (uncommitted 0-d Adam state) is the
+    regression this pins."""
+    w0, b0, x, y = _data(2)
+    opt = lambda: paddle.optimizer.Adam(learning_rate=1e-2)
+    hl, hw, _ = _train("dispatch", w0, b0, x, y, opt, steps=4)
+    sl, sw, se = _train("spmd_1f1b", w0, b0, x, y, opt, steps=4)
+    assert hl == sl
+    for i in range(S):
+        np.testing.assert_allclose(hw[i], sw[i], rtol=0, atol=1e-7)
+    assert se.compile_count == 1
+    assert se._spmd_steps[False]._cache_size() == 1
+
+
+def test_scaler_parity_and_skip_step():
+    """GradScaler through the one-program mode: identical losses, and
+    an inf batch skips the update in-graph (params untouched, scale
+    halved) exactly like the dispatch engine."""
+    w0, b0, x, y = _data(3)
+    xn = np.asarray(x._data)
+
+    def run(exec_mode):
+        paddle.seed(0)
+        stages = [_TanhStage(w0[i], b0[i]) for i in range(S)]
+        mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+        eng = dist.PipelineParallel(
+            stages, _loss_fn, paddle.optimizer.SGD(learning_rate=1e-2),
+            num_micro=M, mesh=mesh, exec_mode=exec_mode)
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        losses = [float(eng.train_batch(x, y, scaler=scaler).item())
+                  for _ in range(3)]
+        eng.sync_to_layers()
+        w_before = [np.asarray(st.lin.weight._data).copy()
+                    for st in stages]
+        bad = xn.copy()
+        bad[0, 0] = np.inf
+        eng.train_batch(paddle.to_tensor(bad), y, scaler=scaler)
+        eng.sync_to_layers()
+        w_after = [np.asarray(st.lin.weight._data) for st in stages]
+        return (losses, float(scaler.get_loss_scaling()), w_before,
+                w_after, eng)
+
+    hl, hs, hwb, hwa, _ = run("dispatch")
+    sl, ss, swb, swa, se = run("spmd_1f1b")
+    assert hl == sl
+    assert hs == ss == 512.0          # 1024 halved by the inf skip
+    for i in range(S):
+        # skipped step: params identical before/after the inf batch
+        np.testing.assert_array_equal(swb[i], swa[i])
+        np.testing.assert_array_equal(hwa[i], swa[i])
+    assert se.compile_count == 1      # one executable (scaler config)
+
+
+def test_eval_single_program_matches_dispatch_and_keeps_state():
+    w0, b0, x, y = _data(4)
+
+    def evalrun(exec_mode):
+        paddle.seed(0)
+        stages = [_TanhStage(w0[i], b0[i]) for i in range(S)]
+        mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+        eng = dist.PipelineParallel(
+            stages, _loss_fn, paddle.optimizer.SGD(learning_rate=1e-2),
+            num_micro=M, mesh=mesh, exec_mode=exec_mode)
+        l0 = float(eng.train_batch(x, y).item())
+        paddle.seed(7)
+        out = eng.eval_batch(x)
+        ev_disp = eng.last_dispatch_count
+        # eval must not invalidate (or donate away) train state:
+        l1 = float(eng.train_batch(x, y).item())
+        return np.asarray(out._data), l0, l1, ev_disp
+
+    oh, hl0, hl1, hd = evalrun("dispatch")
+    os_, sl0, sl1, sd = evalrun("spmd_1f1b")
+    np.testing.assert_array_equal(oh, os_)
+    assert (hl0, hl1) == (sl0, sl1)
+    assert hd == S   # one scan dispatch per stage, not M*S
+    assert sd == 1   # one program
+    assert oh.shape == (M * MB, H)
+
+
+def test_dispatch_eval_scan_preserves_buffered_loop_semantics():
+    """The batched eval (one lax.scan per stage) must thread mutable
+    buffers across microbatches exactly like the old per-microbatch
+    dispatch loop — BatchNorm running stats included."""
+
+    class BNStage(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(H, H)
+            self.bn = nn.BatchNorm1D(H)
+
+        def forward(self, xx):
+            return self.bn(self.lin(xx))
+
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(M * MB, H).astype(np.float32))
+
+    def build():
+        paddle.seed(0)
+        stages = [BNStage() for _ in range(S)]
+        mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+        return dist.PipelineParallel(
+            stages, _loss_fn, paddle.optimizer.SGD(learning_rate=1e-2),
+            num_micro=M, mesh=mesh)
+
+    # reference: the old algorithm, stage-by-stage per microbatch
+    ref = build()
+    paddle.seed(9)
+    from paddle_tpu.core.generator import next_key
+    key = next_key()
+    outs = []
+    for m in range(M):
+        cur = (np.asarray(x._data)[m * MB:(m + 1) * MB],)
+        cur = ref.stages[0].place_input(cur)[0]
+        for s, stage in enumerate(ref.stages):
+            if s > 0:
+                cur = stage.place_input(cur)
+            k = jax.random.fold_in(jax.random.fold_in(key, s), m)
+            cur, nb = stage.fwd_jit(stage.params, stage.buffers, k, cur)
+            stage.buffers = nb
+        outs.append(np.asarray(cur))
+    expected = np.concatenate(outs, axis=0)
+    ref_buf = {k: np.asarray(v) for k, v in ref.stages[0].buffers.items()}
+
+    eng = build()
+    paddle.seed(9)
+    got = eng.eval_batch(x)
+    np.testing.assert_allclose(np.asarray(got._data), expected,
+                               rtol=1e-6, atol=1e-6)
+    assert eng.last_dispatch_count == S
+    for k, v in eng.stages[0].buffers.items():
+        np.testing.assert_allclose(np.asarray(v), ref_buf[k],
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_dispatch_eval_passes_scalar_leaves_through():
+    """The old per-microbatch eval loop forwarded 0-d input leaves
+    unsliced; the batched scan path must keep that contract (scalars
+    broadcast to [M] and sliced back to the same 0-d value)."""
+
+    class ScaledStage(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(H, H)
+
+        def forward(self, xx, gain):
+            return self.lin(xx) * gain
+
+    rng = np.random.RandomState(8)
+    x = paddle.to_tensor(rng.randn(M * MB, H).astype(np.float32))
+    gain = paddle.to_tensor(np.float32(2.0))
+
+    paddle.seed(0)
+    stages = [ScaledStage()]
+    mesh = dist.build_mesh({"pp": 1}, devices=jax.devices()[:1])
+    eng = dist.PipelineParallel(
+        stages, _loss_fn, paddle.optimizer.SGD(learning_rate=1e-2),
+        num_micro=M, mesh=mesh)
+    out = eng.eval_batch((x, gain))
+    expected = 2.0 * np.asarray(
+        stages[0].lin(x)._data)
+    np.testing.assert_allclose(np.asarray(out._data), expected,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_spmd_mode_dp_axis_matches_dispatch_loss():
+    """pp x dp mesh: the one-program mode pmean-reduces grads/loss over
+    dp; trajectory matches the dispatch engine (not bitwise — the
+    reduction orders differ across forms)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    w0, b0, x, y = _data(6)
+    opt = lambda: paddle.optimizer.SGD(learning_rate=1e-2)
+    hl, _, _ = _train("dispatch", w0, b0, x, y, opt, steps=3)
+    sl, _, _ = _train("spmd_1f1b", w0, b0, x, y, opt, steps=3,
+                      mesh_shape={"pp": S, "dp": 2})
+    np.testing.assert_allclose(sl, hl, rtol=2e-5)
+
+
+def test_spmd_mode_rejections():
+    mesh2 = dist.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+
+    class A(nn.Layer):
+        def __init__(self, n):
+            super().__init__()
+            self.lin = nn.Linear(H, n)
+
+        def forward(self, xx):
+            return self.lin(xx)
+
+    with pytest.raises(ValueError, match="structurally identical"):
+        dist.PipelineParallel([A(H), A(H + 1)], _loss_fn, opt,
+                              num_micro=2, mesh=mesh2,
+                              exec_mode="spmd_1f1b")
+
+    class B(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(H)
+
+        def forward(self, xx):
+            return self.bn(xx)
+
+    with pytest.raises(ValueError, match="stop_gradient"):
+        dist.PipelineParallel([B(), B()], _loss_fn, opt, num_micro=2,
+                              mesh=mesh2, exec_mode="spmd_1f1b")
+
+    with pytest.raises(ValueError, match="interleav"):
+        dist.PipelineParallel([A(H) for _ in range(4)], _loss_fn, opt,
+                              num_micro=2, mesh=mesh2,
+                              virtual_pipeline_degree=2,
+                              exec_mode="spmd_1f1b")
+
+    with pytest.raises(ValueError, match="schedule"):
+        dist.PipelineParallel([A(H), A(H)], _loss_fn, opt,
+                              num_micro=2, mesh=mesh2,
+                              schedule="interleaved",
+                              exec_mode="spmd_1f1b")
+
+    with pytest.raises(ValueError, match="mesh"):
+        dist.set_mesh(None)
+        dist.PipelineParallel([A(H), A(H)], _loss_fn, opt,
+                              num_micro=2, mesh=None,
+                              exec_mode="spmd_1f1b")
+
+    with pytest.raises(ValueError, match="exec_mode"):
+        dist.PipelineParallel([A(H), A(H)], _loss_fn, opt,
+                              num_micro=2, mesh=mesh2,
+                              exec_mode="bogus")
+
+    eng = dist.PipelineParallel([A(H), A(H)], _loss_fn, opt,
+                                num_micro=2, mesh=mesh2,
+                                exec_mode="spmd_1f1b")
+    with pytest.raises(ValueError, match="ONE input"):
+        eng.train_batch((paddle.ones([4, H]), paddle.ones([4, H])),
+                        paddle.ones([4, H]))
+
+
+def test_spmd_mode_state_dict_roundtrip():
+    w0, b0, x, y = _data(7)
+    _, _, eng = _train("spmd_1f1b", w0, b0, x, y,
+                       lambda: paddle.optimizer.SGD(learning_rate=1e-2),
+                       steps=1)
+    sd = eng.state_dict()
+    assert len(sd["stages"]) == S
+    # live layer slices match the stacked state
+    np.testing.assert_array_equal(
+        np.asarray(sd["stages"][1]["lin.weight"]._data),
+        np.asarray(eng.params["lin.weight"][1]))

@@ -67,6 +67,7 @@ class TestYOLOv3:
             assert (valid[:, 2:] >= -1e-3).all()
             assert (valid[:, [2, 4]] <= 64 + 1e-3).all()
 
+    @pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
     def test_bucketing_no_recompile_storm(self):
         # two input buckets -> exactly two XLA compilations of the same
         # jitted step (the dynamic-shape policy BASELINE config 4 needs)
@@ -88,6 +89,7 @@ class TestYOLODistributed:
     parallelism with ZeRO-1 optimizer sharding over the virtual mesh,
     loss equal to the single-device run (same global batch)."""
 
+    @pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
     def test_dp_zero1_matches_single_device(self):
         import jax
         import paddle_tpu.distributed as dist
@@ -185,6 +187,7 @@ class TestYOLOHapi:
     PaddleDetection-entrypoint shape): multi-label batches
     (img, gt_box, gt_label) split per the labels= specs."""
 
+    @pytest.mark.slow  # >15 s on the tier-1 sandbox; run via -m slow
     def test_fit_multi_label(self):
         import paddle_tpu.hapi as hapi
         from paddle_tpu.io import Dataset
