@@ -25,23 +25,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-# bf16 peak FLOP/s per chip by TPU generation (public cloud specs);
-# override with PD_PEAK_FLOPS for unlisted hardware.
-_PEAK_BY_KIND = {
-    "TPU v2": 45e12, "TPU v3": 123e12, "TPU v4": 275e12,
-    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
-}
-
-
 def _chip_peak_flops(dev) -> float:
-    if os.environ.get("PD_PEAK_FLOPS"):
-        return float(os.environ["PD_PEAK_FLOPS"])
-    kind = getattr(dev, "device_kind", "") or ""
-    for k, v in _PEAK_BY_KIND.items():
-        if kind.lower().startswith(k.lower()):
-            return v
-    return 275e12  # assume v4-class when unidentifiable
+    """Per-chip peak FLOP/s — table AND lookup live in
+    observability.mfu (one copy of the hardware truth, shared with the
+    MFU reporter). The fallback is pinned to the historical v4-class
+    default so CPU BENCH artifacts stay comparable across rounds."""
+    from paddle_tpu.observability.mfu import chip_peak_flops
+    return chip_peak_flops(dev, fallback=275e12)
 
 
 def _param_count(params) -> int:
@@ -561,7 +551,7 @@ def main():
         baseline = 25000.0 * (_BASE_FPT / fpt) if fpt > 0 else 25000.0
     else:
         baseline = 1.0
-    print(json.dumps({
+    report = {
         "metric": f"ernie_{ernie_size}_pretrain_tokens_per_sec_per_chip"
         if on_tpu else "ernie_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
@@ -589,7 +579,20 @@ def main():
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),
         },
-    }))
+    }
+    # one code path for the printed artifact and the metrics runtime:
+    # the whole report rides bench.* gauges + the JSONL series
+    # (PD_OBS_JSONL), and what's printed is rebuilt from the registry
+    # snapshot — BENCH_r* fields and the exported series can't diverge
+    try:
+        from paddle_tpu.observability import exporters as obs_exporters
+        report = obs_exporters.emit_report(
+            report, jsonl_path=os.environ.get("PD_OBS_JSONL"),
+            prefix="bench")
+    except Exception as e:  # pragma: no cover — the artifact survives
+        report.setdefault("extras", {}).setdefault(
+            "errors", {})["obs_export"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(report))
 
 
 if __name__ == "__main__":

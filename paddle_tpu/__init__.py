@@ -50,6 +50,7 @@ from . import incubate  # noqa: F401
 from . import quant  # noqa: F401
 from .batch import batch  # noqa: F401  (paddle.batch is the function)
 from . import hapi  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import onnx  # noqa: F401
 from .hapi import Model  # noqa: F401

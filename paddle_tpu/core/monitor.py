@@ -1,55 +1,45 @@
-"""Stat monitor: lock-free-ish named stat registry.
+"""Stat monitor: named stat registry (compat shim).
 
 Reference: paddle/fluid/platform/monitor.h:44 (StatValue<T> registry,
 STAT_GPU memory counters, ExportedStatValue dump).
+
+This is now a thin compatibility surface over the full metrics runtime
+in ``paddle_tpu.observability.metrics``: ``stat(name)`` resolves to an
+always-on gauge there (monitor stats are explicitly requested by their
+caller, so they bypass the observability enable gate — the
+FLAGS_op_stats contract predates the gate), and ``get_stats`` dumps
+only the stats created through this API, keeping its historical
+"name -> value" shape.
 """
 from __future__ import annotations
 
-import threading
-from typing import Dict, List
+from typing import Dict
+
+from ..observability import metrics as _metrics
 
 __all__ = ["StatValue", "stat", "get_stats", "reset_all", "log_stat"]
 
+# names created through this API — instruments are re-resolved from the
+# registry on every access, so a metrics.clear() (test isolation) can't
+# leave monitor callers counting into detached gauges the exporters
+# never see
+_mine: set = set()
 
-class StatValue:
-    """A named monotonic/gauge counter (StatValue<T> analogue)."""
-
-    __slots__ = ("name", "_value", "_lock")
+class StatValue(_metrics.Gauge):
+    """The observability Gauge with monitor.h's unconditional-count
+    semantics baked in: a directly-constructed StatValue records
+    regardless of the metrics enable gate (as the pre-shim class did).
+    Instances built here are standalone (not registry-resident); use
+    stat() for exporter-visible stats."""
 
     def __init__(self, name: str):
-        self.name = name
-        self._value = 0
-        self._lock = threading.Lock()
-
-    def add(self, v=1):
-        with self._lock:
-            self._value += v
-        return self
-
-    def set(self, v):
-        with self._lock:
-            self._value = v
-        return self
-
-    def get(self):
-        return self._value
-
-    def reset(self):
-        with self._lock:
-            self._value = 0
-
-
-_registry: Dict[str, StatValue] = {}
-_registry_lock = threading.Lock()
+        super().__init__(name, labels=(), always=True)
 
 
 def stat(name: str) -> StatValue:
     """Get-or-create the named stat (STAT_INT registration analogue)."""
-    s = _registry.get(name)
-    if s is None:
-        with _registry_lock:
-            s = _registry.setdefault(name, StatValue(name))
-    return s
+    _mine.add(name)
+    return _metrics.gauge(name, _always=True)
 
 
 def log_stat(name: str, value):
@@ -57,10 +47,11 @@ def log_stat(name: str, value):
 
 
 def get_stats() -> Dict[str, int]:
-    """ExportedStatValue dump."""
-    return {k: v.get() for k, v in sorted(_registry.items())}
+    """ExportedStatValue dump (monitor-created stats only)."""
+    return {k: _metrics.gauge(k, _always=True).get()
+            for k in sorted(_mine)}
 
 
 def reset_all():
-    for v in _registry.values():
-        v.reset()
+    for k in _mine:
+        _metrics.gauge(k, _always=True).reset()

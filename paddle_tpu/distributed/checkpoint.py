@@ -17,12 +17,14 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 from typing import Any, Iterator, Optional
 
 import jax
 import numpy as np
 
 from ..framework import Tensor
+from ..observability import metrics as _obs
 from .. import serialization
 
 __all__ = ["save_sharded", "load_sharded", "train_epoch_range",
@@ -44,9 +46,21 @@ def _barrier(name: str):
         multihost_utils.sync_global_devices(name)
 
 
+def _ckpt_record(kind: str, arrays, t0: float):
+    if not _obs._enabled:
+        return
+    from .collective import _payload_bytes  # ONE byte-accounting walk
+    _obs.counter(f"checkpoint.{kind}s_total").add(1)
+    _obs.counter(f"checkpoint.{kind}_bytes_total").add(
+        _payload_bytes(arrays))
+    _obs.histogram(f"checkpoint.{kind}_ms").observe(
+        (time.perf_counter() - t0) * 1e3)
+
+
 def save_sharded(state: dict, path: str):
     """Save a (possibly sharded) pytree of jax arrays. Orbax when
     available (multi-host safe), pickle fallback."""
+    _t0 = time.perf_counter()
     ocp = _orbax()
     arrays = jax.tree_util.tree_map(
         lambda v: v._data if isinstance(v, Tensor) else v, state)
@@ -83,11 +97,13 @@ def save_sharded(state: dict, path: str):
         serialization.save(
             jax.tree_util.tree_map(np.asarray, arrays), tmp)
         os.replace(tmp, path + ".pkl")
+    _ckpt_record("save", arrays, _t0)
 
 
 def load_sharded(path: str, target: Optional[dict] = None) -> dict:
     """Restore; when `target` (pytree of arrays with shardings) is given,
     arrays are restored onto those shardings (re-sharding on mesh change)."""
+    _t0 = time.perf_counter()
     ocp = _orbax()
     # a crash between the two swap renames in save_sharded leaves the new
     # checkpoint at .saving (complete — orbax commits before the swap) or
@@ -106,9 +122,13 @@ def load_sharded(path: str, target: Optional[dict] = None) -> dict:
                 lambda a: jax.ShapeDtypeStruct(
                     a.shape, a.dtype,
                     sharding=getattr(a, "sharding", None)), tgt)
-            return ckptr.restore(os.path.abspath(path), ref)
-        return ckptr.restore(os.path.abspath(path))
-    return serialization.load(path + ".pkl")
+            out = ckptr.restore(os.path.abspath(path), ref)
+        else:
+            out = ckptr.restore(os.path.abspath(path))
+    else:
+        out = serialization.load(path + ".pkl")
+    _ckpt_record("load", out, _t0)
+    return out
 
 
 class AutoCheckpoint:

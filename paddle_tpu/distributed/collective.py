@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework import Tensor, _unwrap
+from ..observability import metrics as _obs
 from ..ops.registry import run_op
 from .env import axis_context, current_axes, current_axis_name
 
@@ -79,6 +80,37 @@ def _live_axis_sizes():
     return sizes
 
 
+def _payload_bytes(*tensors) -> int:
+    """Sum of payload bytes across arrays/Tensors/tracers (shape×itemsize
+    — works on tracers inside a shard_map/jit trace too)."""
+    import numpy as np
+    total = 0
+    for t in tensors:
+        for leaf in jax.tree_util.tree_leaves(t):
+            if isinstance(leaf, Tensor):
+                leaf = leaf._data
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            total += int(np.prod(shape, dtype=np.int64)
+                         * np.dtype(dtype).itemsize)
+    return total
+
+
+def _record(op: str, *tensors):
+    """Collective telemetry (EQuARX's premise: per-collective speedups
+    must be measured, so every collective reports op count + payload
+    bytes). Counted at CALL time: eager collectives count per
+    execution; collectives inside a jit/shard_map trace count once per
+    TRACE (the executable then replays them for free — the trace-time
+    count is the per-program collective inventory)."""
+    if _obs._enabled:
+        _obs.counter("collective.calls", op=op).add(1)
+        _obs.counter("collective.bytes", op=op).add(
+            _payload_bytes(*tensors))
+
+
 def _axis_for(group) -> Optional[str]:
     if isinstance(group, Group):
         axis = group.axis
@@ -97,6 +129,7 @@ def _axis_for(group) -> Optional[str]:
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_allreduce_{sum,max,min,prod} (c_allreduce_op.h:111) → lax.p*."""
+    _record("allreduce_" + op, tensor)
     axis = _axis_for(group)
     if axis is None:
         return tensor  # world size 1
@@ -129,12 +162,14 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     `tensor_list`; functional style all_gather(x) returns stacked array."""
     if tensor is None:
         x = tensor_list
+        _record("allgather", x)
         ax = _axis_for(group)
         if ax is None:
             return x
         return run_op("c_allgather",
                       lambda a: lax.all_gather(a, ax, axis=0, tiled=False),
                       (x,), {})
+    _record("allgather", tensor)
     ax = _axis_for(group)
     if ax is None:
         tensor_list.append(tensor)
@@ -150,6 +185,7 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """c_broadcast: every replica takes src's value."""
+    _record("broadcast", tensor)
     axis = _axis_for(group)
     if axis is None:
         return tensor
@@ -169,6 +205,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_reduce_*: reduced value lands on dst, others keep theirs
     (SPMD form: select by rank)."""
+    _record("reduce_" + op, tensor)
     axis = _axis_for(group)
     if axis is None:
         return tensor
@@ -188,6 +225,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """c_scatter: src's i-th chunk goes to rank i."""
+    _record("scatter", tensor)
     axis = _axis_for(group)
     if axis is None:
         return tensor
@@ -203,6 +241,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_reducescatter → lax.psum_scatter."""
+    _record("reduce_scatter", tensor)
     axis = _axis_for(group)
     if axis is None:
         return tensor
@@ -216,6 +255,7 @@ def all_to_all(out_tensor_or_in, in_tensor=None, group=None, sync_op=True,
                split_axis=0, concat_axis=0):
     """alltoall → lax.all_to_all (the Ulysses primitive)."""
     x = in_tensor if in_tensor is not None else out_tensor_or_in
+    _record("alltoall", x)
     axis = _axis_for(group)
     if axis is None:
         return x
@@ -231,6 +271,7 @@ alltoall = all_to_all
 
 def barrier(group=None):
     """barrier op: a psum of a scalar forces synchronization."""
+    _record("barrier")
     axis = _axis_for(group)
     if axis is None:
         return
@@ -254,6 +295,7 @@ recv = send
 def p2p_shift(x, shift=1, group=None):
     """Ring shift by `shift` positions over the group axis (ppermute) —
     the TPU-native send_v2/recv_v2 pair for ring/pipeline schedules."""
+    _record("ppermute", x)
     axis = _axis_for(group)
     if axis is None:
         return x

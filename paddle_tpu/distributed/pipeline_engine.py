@@ -45,6 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..framework import Tensor
 from ..jit.api import _unwrap_tree, _wrap_tree, functionalize
 from ..nn.layer.layers import Layer
+from ..observability import metrics as _obs
+from ..observability.sentinel import RecompileSentinel, signature_of
 
 __all__ = ["PipelineParallel", "build_1f1b_schedule", "stage_submeshes"]
 
@@ -588,6 +590,9 @@ class PipelineParallel:
         else:
             self._sched = build_1f1b_schedule(len(stages),
                                               self.num_micro, schedule)
+        _, self.schedule_bubble_fraction = simulate_schedule(
+            self._sched, len(stages) // v)
+        self.recompile_sentinel = None  # dispatch mode: per-stage jits
         self._step_count = 0
         self.last_dispatch_count = 0  # jit dispatches in the last batch
 
@@ -696,6 +701,10 @@ class PipelineParallel:
         self._pure = functionalize(stages[0].forward, stages[0])
         self._spmd_steps: Dict[bool, Any] = {}  # use_scaler -> jit step
         self._spmd_eval = None
+        _, self.schedule_bubble_fraction = simulate_schedule(
+            self._sched, S, dev_of=lambda s: s)
+        # runtime guard for the exactly-one-train-executable contract
+        self.recompile_sentinel = RecompileSentinel("train")
         self._step_count = 0
         self.last_dispatch_count = 0
 
@@ -911,6 +920,36 @@ class PipelineParallel:
         return sum(int(f._cache_size())
                    for f in self._spmd_steps.values())
 
+    def train_flops_per_step(self, inputs, labels=(),
+                             scaler=None) -> float:
+        """FLOPs of the ONE-program train step from XLA's own
+        cost_analysis of the lowered executable (spmd_1f1b only) — the
+        MFU numerator (observability.mfu). AOT lowering is separate
+        from the jit call cache, so this never trips the recompile
+        sentinel."""
+        if self.exec_mode != "spmd_1f1b":
+            return -1.0
+        from ..observability.mfu import flops_of_compiled
+        use_scaler = scaler is not None and scaler.is_enable()
+        inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        labels = labels if isinstance(labels, (list, tuple)) \
+            else (labels,)
+        x = self._spmd_micro(_unwrap_tree(inputs[0]))
+        lbl = self._spmd_micro(_unwrap_tree(tuple(labels)))
+        step = self._spmd_steps.get(use_scaler)
+        if step is None:
+            step = self._spmd_steps[use_scaler] = \
+                self._build_spmd_step(use_scaler)
+        # constant key, NOT next_key(): lowering only needs the aval,
+        # and observation must not advance the training RNG stream
+        # (bit-for-bit parity discipline)
+        lowered = step.lower(
+            self.params, self.opt_state, jax.random.key(0),
+            jnp.asarray(0.0, jnp.float32),
+            jnp.asarray(1.0, jnp.float32), x, lbl)
+        return flops_of_compiled(lowered.compile())
+
     def _spmd_micro(self, tree, broadcast_scalars: bool = False):
         """[batch, ...] leaves -> [num_micro, batch//num_micro, ...].
         broadcast_scalars: 0-d leaves become one copy per microbatch
@@ -963,12 +1002,33 @@ class PipelineParallel:
             step = self._spmd_steps[use_scaler] = \
                 self._build_spmd_step(use_scaler)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        # captured ONCE: a mid-step enable() from another thread must
+        # not pair the tail block with an unset _t0
+        _rec = _obs._enabled
+        _t0 = time.perf_counter() if _rec else 0.0
         self.params, self.opt_state, loss, found_inf = step(
             self.params, self.opt_state, next_key(), lr, scale_val,
             x, lbl)
         self._step_count += 1
         self.last_dispatch_count = 1
         self.last_tick_ms = []  # ticks are in-graph: nothing to time
+        if _rec:
+            # step/dispatch/bubble telemetry
+            _obs.histogram("pipeline.step_ms").observe(
+                (time.perf_counter() - _t0) * 1e3)
+            _obs.counter("pipeline.steps_total").add(1)
+            _obs.counter("pipeline.microbatches_total").add(
+                self.num_micro)
+            _obs.gauge("pipeline.dispatches_per_step").set(1)
+            _obs.gauge("pipeline.bubble_fraction").set(
+                round(self.schedule_bubble_fraction, 4))
+        # the recompile sentinel is ALWAYS on (its counter bypasses the
+        # metrics gate by the same contract): a silent retrace is a
+        # violation whether or not anyone is scraping, and the per-step
+        # cost is one cache-size read + a shapes walk of the inputs
+        self.recompile_sentinel.observe(
+            self.compile_count, expected=len(self._spmd_steps),
+            signature=signature_of((x, lbl, scale_val, lr)))
         if use_scaler:
             # ONE host bool per step, read after the step is dispatched
             scaler._update(bool(np.asarray(found_inf)))
@@ -1052,6 +1112,8 @@ class PipelineParallel:
         if self.exec_mode == "spmd_1f1b":
             return self._spmd_train_batch(inputs, labels, scaler)
         from ..core.generator import next_key
+        _rec = _obs._enabled  # captured once; see _spmd_train_batch
+        _t_step = time.perf_counter() if _rec else 0.0
         use_scaler = scaler is not None and scaler.is_enable()
         scale_val = jnp.asarray(
             scaler.get_loss_scaling() if use_scaler else 1.0,
@@ -1161,6 +1223,15 @@ class PipelineParallel:
             # update is dispatched — the read no longer gates any work
             scaler._update(bool(np.asarray(found_inf)))
         self.last_dispatch_count = dispatches
+        if _rec:
+            _obs.histogram("pipeline.step_ms").observe(
+                (time.perf_counter() - _t_step) * 1e3)
+            _obs.histogram("pipeline.tick_ms").observe_many(tick_ms)
+            _obs.counter("pipeline.steps_total").add(1)
+            _obs.counter("pipeline.microbatches_total").add(M)
+            _obs.gauge("pipeline.dispatches_per_step").set(dispatches)
+            _obs.gauge("pipeline.bubble_fraction").set(
+                round(self.schedule_bubble_fraction, 4))
         return Tensor(mean_losses)
 
     # predict-only path (no labels/backward)
@@ -1190,6 +1261,8 @@ class PipelineParallel:
             stage.buffers = nb
             dispatches += 1
         self.last_dispatch_count = dispatches
+        if _obs._enabled:
+            _obs.counter("pipeline.eval_batches_total").add(1)
         return jax.tree_util.tree_map(
             lambda a: Tensor(a.reshape((-1,) + a.shape[2:])), cur)
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework import Tensor
+from ..observability import metrics as _obs
 from ..ops.registry import run_op
 from .env import SEQUENCE_AXIS, current_axis_name
 
@@ -34,6 +35,17 @@ __all__ = ["ring_flash_attention", "ulysses_attention",
 def _ring_block_size(s_loc):
     import os
     return int(os.environ.get("PD_RING_BK", 0)) or min(512, s_loc)
+
+
+def _record_sp(op: str, q, k, v):
+    """Sequence-parallel collective telemetry: one call + the KV bytes
+    that transit the ring / all-to-all per invocation (trace-time count,
+    same convention as collective._record)."""
+    if not _obs._enabled:
+        return
+    from .collective import _payload_bytes
+    _obs.counter("collective.calls", op=op).add(1)
+    _obs.counter("collective.bytes", op=op).add(_payload_bytes(q, k, v))
 
 
 def _ring_attn_impl(q, k, v, axis, causal, scale):
@@ -90,6 +102,7 @@ def ring_flash_attention(query, key, value, causal=False, group=None,
     if axis is None:
         from ..nn.functional.attention import flash_attention
         return flash_attention(query, key, value, causal=causal)
+    _record_sp("ring_attention", query, key, value)
 
     def impl(q, k, v):
         qh = jnp.einsum("bsnh->bnsh", q)
@@ -112,6 +125,7 @@ def ulysses_attention(query, key, value, causal=False, group=None,
     if axis is None:
         from ..nn.functional.attention import flash_attention
         return flash_attention(query, key, value, causal=causal)
+    _record_sp("ulysses_attention", query, key, value)
 
     def impl(q, k, v):
         # [b, s/P, n, d] -> all_to_all over heads -> [b, s, n/P, d]

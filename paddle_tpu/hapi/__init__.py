@@ -1,5 +1,5 @@
 from .model import Model  # noqa: F401
 from .callbacks import (Callback, EarlyStopping, LRScheduler as
-                        LRSchedulerCallback, ModelCheckpoint,
-                        ProgBarLogger)  # noqa: F401
+                        LRSchedulerCallback, MetricsLogger,
+                        ModelCheckpoint, ProgBarLogger)  # noqa: F401
 from .summary import summary  # noqa: F401

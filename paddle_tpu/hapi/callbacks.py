@@ -8,7 +8,7 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "config_callbacks"]
+           "EarlyStopping", "MetricsLogger", "config_callbacks"]
 
 
 class Callback:
@@ -147,6 +147,81 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+class MetricsLogger(Callback):
+    """Stream training telemetry through the observability runtime.
+
+    Turns the metrics registry on for the duration of fit(), publishes
+    per-batch gauges/counters (train.loss, train.batches_total,
+    throughput.examples_per_sec when batch_size is known), and exports:
+
+      jsonl_path  one JSONL snapshot record every `log_freq` batches
+                  and at train end (exporters.JsonlExporter)
+      prom_path   a Prometheus text dump rewritten every `log_freq`
+                  batches (point a node_exporter textfile collector or
+                  a sidecar scrape at it) and at train end
+
+    The hapi surface of the observability tentpole: ProgBarLogger shows
+    a human the loss; this shows the fleet. Fleet-level rollups are the
+    reader's job (tools/obs_report.py / observability.fleet.aggregate).
+    """
+
+    def __init__(self, log_freq=10, jsonl_path=None, prom_path=None,
+                 batch_size=None, enable_metrics=True):
+        super().__init__()
+        self.log_freq = max(int(log_freq), 1)
+        self.jsonl_path = jsonl_path
+        self.prom_path = prom_path
+        self.batch_size = batch_size
+        self.enable_metrics = enable_metrics
+        self._jsonl = None
+        self._was_enabled = None
+        self._batches = 0
+        self._t_last = None
+
+    def on_train_begin(self, logs=None):
+        from ..observability import exporters, metrics
+        if self.enable_metrics:
+            self._was_enabled = metrics.enabled()
+            metrics.enable()
+        if self.jsonl_path:
+            self._jsonl = exporters.JsonlExporter(self.jsonl_path)
+        self._batches = 0
+        self._t_last = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..observability import metrics
+        self._batches += 1
+        metrics.counter("train.batches_total").add(1)
+        loss = (logs or {}).get("loss")
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        if isinstance(loss, (int, float)):
+            metrics.gauge("train.loss").set(round(float(loss), 6))
+        now = time.perf_counter()
+        if self.batch_size and self._t_last is not None \
+                and now > self._t_last:
+            metrics.gauge("throughput.examples_per_sec").set(
+                round(self.batch_size / (now - self._t_last), 3))
+            metrics.counter("throughput.examples_total").add(
+                self.batch_size)
+        self._t_last = now
+        if self._batches % self.log_freq == 0:
+            self._export(step=self._batches)
+
+    def on_train_end(self, logs=None):
+        from ..observability import metrics
+        self._export(step=self._batches)
+        if self.enable_metrics and self._was_enabled is not None:
+            metrics.enable(self._was_enabled)
+
+    def _export(self, step):
+        from ..observability import exporters
+        if self._jsonl is not None:
+            self._jsonl.write(step=step)
+        if self.prom_path:
+            exporters.write_prometheus(self.prom_path)
 
 
 class EarlyStopping(Callback):

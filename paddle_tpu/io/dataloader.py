@@ -213,9 +213,13 @@ class DataLoader:
         return put(batch)
 
     def __iter__(self):
+        from ..observability import metrics as _obs
+        import time as _time
         gen = self._batches()
         if not self.use_buffer_reader:
             for b in gen:
+                if _obs._enabled:
+                    _obs.counter("dataloader.batches_total").add(1)
                 yield self._to_device(b)
             return
         # double-buffer: device-put batch N+1 while N is consumed
@@ -237,7 +241,19 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            if _obs._enabled:
+                # host-input-pipeline health: time the consumer spends
+                # BLOCKED on the prefetch queue (≈0 when the loader
+                # keeps ahead of the step) + standing queue depth
+                _t0 = _time.perf_counter()
+                item = q.get()
+                _obs.histogram("dataloader.wait_ms").observe(
+                    (_time.perf_counter() - _t0) * 1e3)
+                _obs.gauge("dataloader.prefetch_depth").set(q.qsize())
+                if not (item is sentinel or isinstance(item, _Error)):
+                    _obs.counter("dataloader.batches_total").add(1)
+            else:
+                item = q.get()
             if item is sentinel:
                 return
             if isinstance(item, _Error):

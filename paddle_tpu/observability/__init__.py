@@ -1,0 +1,40 @@
+"""paddle_tpu.observability: pod-scale telemetry runtime.
+
+The StatRegistry metrics layer (platform/monitor.h analogue) plus what a
+TPU-pod training job needs on top of raw counters:
+
+  metrics     counters/gauges/histograms, thread-sharded, one-bool
+              disabled gate (wired through eager dispatch, the pipeline
+              engines, collectives, checkpoint and dataloader paths)
+  sentinel    RecompileSentinel — runtime guard for the one-train-
+              executable contract, logs the shape/dtype delta that
+              caused a retrace (train_recompiles_total)
+  mfu         ThroughputMeter — examples/sec + MFU from the lowered
+              executable's own cost_analysis() FLOPs
+  fleet       cross-host snapshot rollups over the existing CPU/ICI
+              collectives
+  exporters   Prometheus text format, JSONL time series, chrome-trace
+              counter marks, and the bench-report bridge (emit_report)
+
+Everything is off by default: `metrics.enable()` (or the hapi
+MetricsLogger callback / tools/obs_report.py) turns the wired hot paths
+on. See DESIGN.md "Observability" for the naming scheme and how this
+maps to the reference's monitor.h / timeline.py machinery.
+"""
+from . import metrics  # noqa: F401
+from . import exporters  # noqa: F401
+from . import fleet  # noqa: F401
+from . import mfu  # noqa: F401
+from . import sentinel  # noqa: F401
+from .metrics import (counter, gauge, histogram, enable, disable,  # noqa: F401
+                      enabled, enabled_scope, snapshot, reset)
+from .mfu import ThroughputMeter, chip_peak_flops, step_flops  # noqa: F401
+from .sentinel import RecompileSentinel, signature_of  # noqa: F401
+
+__all__ = [
+    "metrics", "exporters", "fleet", "mfu", "sentinel",
+    "counter", "gauge", "histogram", "enable", "disable", "enabled",
+    "enabled_scope", "snapshot", "reset",
+    "ThroughputMeter", "chip_peak_flops", "step_flops",
+    "RecompileSentinel", "signature_of",
+]

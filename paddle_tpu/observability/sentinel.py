@@ -1,0 +1,166 @@
+"""Recompile sentinel: runtime guard for the one-train-executable rule.
+
+The spmd_1f1b engine and TrainStep both promise exactly ONE XLA train
+executable per (scaler, shapes) config — a silent retrace (a new batch
+shape, a dtype drift from a preprocessing change) turns every affected
+step into a multi-second compile stall and doubles HBM executable
+footprint, and nothing in stock jax tells you *why* it happened. The
+sentinel watches the executable count each step and, when it grows past
+the expected config count, logs the offending shape/dtype delta against
+the previous step's signature and bumps ``train_recompiles_total``
+(always-on counter: a contract violation is counted even when the rest
+of the metrics runtime is disabled).
+
+Engines call ``observe(executables, expected, signature)`` once per
+step; ``signature_of`` turns arbitrary pytrees of arrays into a
+comparable (path, shape, dtype) tuple. ``watch``/``check`` wrap a bare
+jax.jit function for code outside the engines.
+
+``attach_jax_compile_hook()`` additionally taps jax.monitoring compile
+events into ``jax.compiles_total`` — a coarse, framework-wide compile
+odometer (best-effort: older runtimes without jax.monitoring are a
+no-op).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+from . import metrics
+
+__all__ = ["RecompileSentinel", "signature_of", "diff_signatures",
+           "attach_jax_compile_hook"]
+
+logger = logging.getLogger("paddle_tpu.observability")
+
+
+def signature_of(*trees) -> Tuple[Tuple[str, Tuple[int, ...], str], ...]:
+    """Flatten pytrees of arrays/Tensors into ((path, shape, dtype), ...)
+    — the comparable identity a jit cache keys on."""
+    import jax
+    import numpy as np
+
+    from ..framework import Tensor
+
+    out = []
+    leaves = jax.tree_util.tree_leaves_with_path(tuple(trees))
+    for path, leaf in leaves:
+        if isinstance(leaf, Tensor):
+            leaf = leaf._data
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        out.append((jax.tree_util.keystr(path), shape, dtype))
+    return tuple(out)
+
+
+def diff_signatures(old, new) -> str:
+    """Human-readable shape/dtype delta between two signatures."""
+    if old is None:
+        return "no prior signature recorded"
+    o = {p: (s, d) for p, s, d in old}
+    n = {p: (s, d) for p, s, d in new}
+    lines = []
+    for p in sorted(set(o) | set(n)):
+        if p not in o:
+            lines.append(f"{p}: (new input) {n[p][0]}/{n[p][1]}")
+        elif p not in n:
+            lines.append(f"{p}: (dropped input) was {o[p][0]}/{o[p][1]}")
+        elif o[p] != n[p]:
+            lines.append(
+                f"{p}: {o[p][0]}/{o[p][1]} -> {n[p][0]}/{n[p][1]}")
+    return "; ".join(lines) if lines else \
+        "identical input signature (retrace from non-shape cause: " \
+        "static args, new config, or cache eviction)"
+
+
+class RecompileSentinel:
+    """Per-engine watcher for the compile_count contract.
+
+    events: list of {step, executables, expected, diff} — one entry per
+    violation, newest last. The counter is the cross-engine rollup; the
+    events carry the per-engine forensic detail.
+    """
+
+    def __init__(self, name: str = "train"):
+        self.name = name
+        # the contract counter keeps the reference's flat Prometheus
+        # name so it greps identically in every exporter
+        self.counter = metrics.counter(f"{name}_recompiles_total",
+                                       _always=True)
+        self.events: List[dict] = []
+        self._last_sig = None
+        self._allowed: Optional[int] = None
+        self._steps = 0
+        self._watched = None
+
+    def observe(self, executables: int, expected: int = 1,
+                signature: Any = None):
+        """Record one step's executable count. Fires when the count
+        exceeds the allowed figure (expected config count, or whatever
+        higher count was already accounted for)."""
+        self._steps += 1
+        if self._allowed is None:
+            # first step: however many executables exist now are the
+            # baseline (compiles up to and including the first step are
+            # the contract, not a violation)
+            self._allowed = max(int(executables), int(expected))
+            self._last_sig = signature
+            return self
+        allowed = max(self._allowed, int(expected))
+        if executables > allowed:
+            delta = diff_signatures(self._last_sig, signature) \
+                if signature is not None else "signature not captured"
+            event = {"step": self._steps, "executables": int(executables),
+                     "expected": allowed, "diff": delta}
+            self.events.append(event)
+            self.counter.add(executables - allowed)
+            logger.warning(
+                "recompile sentinel [%s]: train executable count grew "
+                "%d -> %d at step %d; input delta: %s",
+                self.name, allowed, executables, self._steps, delta)
+        self._allowed = max(allowed, int(executables))
+        if signature is not None:
+            self._last_sig = signature
+        return self
+
+    # -- bare-jit convenience ------------------------------------------------
+    def watch(self, jitted):
+        """Attach to a jax.jit function; pair with check(*args) after
+        each call."""
+        self._watched = jitted
+        return jitted
+
+    def check(self, *args, **kwargs):
+        if self._watched is None:
+            raise RuntimeError("watch() a jitted function first")
+        sig = signature_of(tuple(args), kwargs)
+        return self.observe(int(self._watched._cache_size()),
+                            expected=1, signature=sig)
+
+    @property
+    def fired(self) -> int:
+        return len(self.events)
+
+
+_jax_hook_attached = False
+
+
+def attach_jax_compile_hook():
+    """Best-effort global compile odometer via jax.monitoring events
+    ('/jax/core/compile'-family). Idempotent; silently unavailable on
+    runtimes without jax.monitoring."""
+    global _jax_hook_attached
+    if _jax_hook_attached:
+        return True
+    try:
+        import jax.monitoring as _mon
+
+        def _listener(event: str, **kw):
+            if "compile" in event:
+                metrics.counter("jax.compiles_total", _always=True).add(1)
+
+        _mon.register_event_listener(_listener)
+        _jax_hook_attached = True
+        return True
+    except Exception:
+        return False

@@ -31,6 +31,7 @@ import numpy as np
 from ..core import enforce as _enforce
 from ..core.flags import flag_value
 from ..framework import Tensor, _unwrap, global_tape, is_grad_enabled
+from ..observability import metrics as _obs
 
 __all__ = ["register_op", "run_op", "get_op", "OPS", "op_wrapper"]
 
@@ -227,6 +228,10 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
     Under a program_guard, append to the captured Program instead."""
     if _static_tracer is not None:
         return _static_tracer(name, fn, args, kwargs)
+    if _obs._enabled:
+        # per-op dispatch counter (monitor.h STAT_ADD wired into TraceOp;
+        # the disabled path above is one module-bool read)
+        _obs.counter("op.dispatch.total", op=name).add(1)
     if _amp_hook is not None:
         args, kwargs = _amp_hook(name, args, kwargs)
     elif _cfast is not None or not _cfast_checked:
@@ -343,6 +348,8 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict):
                     else:
                         out = pure(*arrays)
                     _EAGER_NOJIT.add(name)
+                    if _obs._enabled:
+                        _obs.counter("op.fallback.total", op=name).add(1)
             elif requires:
                 out, vjp_fn = jax.vjp(pure, *arrays)
             else:

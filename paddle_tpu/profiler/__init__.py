@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 
 __all__ = ["RecordEvent", "Profiler", "start_profiler", "stop_profiler",
            "profiler_guard", "export_chrome_tracing", "summary",
-           "start_trace", "stop_trace", "StepClock"]
+           "SummaryDict", "start_trace", "stop_trace", "StepClock"]
 
 _lock = threading.Lock()
 _enabled = False
@@ -54,12 +54,18 @@ class RecordEvent:
         self.name = name
         self.event_type = event_type
         self._t0 = None
+        self._backend = None
 
     def begin(self):
         if not _enabled:
             return self
-        if _native is not None:
-            self._t0 = _native.pd_prof_now()
+        # capture the backend ONCE: if start_profiler resolves the
+        # native lib between this span's begin and end, end() must not
+        # hand a Python-clock _t0 to pd_prof_span (different epoch) or
+        # leak the Python path's _tls.depth increment
+        self._backend = _native
+        if self._backend is not None:
+            self._t0 = self._backend.pd_prof_now()
             return self
         self._t0 = time.perf_counter_ns()
         depth = getattr(_tls, "depth", 0)
@@ -68,16 +74,23 @@ class RecordEvent:
         return self
 
     def end(self):
-        if not _enabled or self._t0 is None:
+        if self._t0 is None:
             return
-        if _native is not None:
-            _native.pd_prof_span(self.name.encode(),
-                                 self.event_type.encode(), self._t0,
-                                 _native.pd_prof_now(),
-                                 threading.get_ident() % (1 << 31))
+        if self._backend is not None:
+            if not _enabled:
+                return  # native span: nothing thread-local to unwind
+            self._backend.pd_prof_span(self.name.encode(),
+                                       self.event_type.encode(), self._t0,
+                                       self._backend.pd_prof_now(),
+                                       threading.get_ident() % (1 << 31))
             return
+        # python path: begin() bumped _tls.depth — unwind it even when
+        # stop_profiler() landed between begin and end (the span itself
+        # is dropped, the nesting bookkeeping must not tear)
         t1 = time.perf_counter_ns()
         _tls.depth = max(getattr(_tls, "depth", 1) - 1, 0)
+        if not _enabled:
+            return
         with _lock:
             _events.append({
                 "name": self.name, "cat": self.event_type,
@@ -135,34 +148,79 @@ def profiler_guard(state="All", sorted_key="total",
         stop_profiler(sorted_key, profile_path)
 
 
+def _metric_marks():
+    """Metric counter events for the host trace (observability overlay;
+    empty when the metrics runtime is off or holds nothing — a process
+    that never enabled metrics must not pay a dump reparse just because
+    always-on instruments exist)."""
+    try:
+        from ..observability import exporters, metrics
+        if not metrics.enabled() or not metrics.registry_size():
+            return []
+        return exporters.chrome_trace_events()
+    except Exception:
+        return []
+
+
 def export_chrome_tracing(path: str):
-    """Write chrome://tracing JSON (tools/timeline.py analogue)."""
+    """Write chrome://tracing JSON (tools/timeline.py analogue). Metric
+    values from the observability registry ride along as counter
+    ("ph":"C") events on the same timeline."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     out = path if path.endswith(".json") else path + ".json"
+    marks = _metric_marks()
     if _native is not None:
         if _native.pd_prof_dump(out.encode()) != 0:
             raise OSError(f"cannot write trace to {out}")
+        if marks:  # merge marks into the native dump
+            with open(out) as f:
+                data = json.load(f)
+            data.setdefault("traceEvents", []).extend(marks)
+            with open(out, "w") as f:
+                json.dump(data, f)
         return out
     with _lock:
-        data = {"traceEvents": list(_events)}
+        data = {"traceEvents": list(_events) + marks}
     with open(out, "w") as f:
         json.dump(data, f)
     return out
 
 
+class SummaryDict(dict):
+    """summary() result: a plain sorted dict of per-span stats plus a
+    `truncated` flag (True only if the native collector held more
+    distinct span names than the hard buffer ceiling — reported instead
+    of silently dropped)."""
+    truncated = False
+
+
+_SUMMARY_CAP_MAX = 1 << 16
+
+
 def summary(sorted_key="total"):
     """Aggregated per-span stats (DisableProfiler sorted report)."""
     agg: Dict[str, dict] = {}
+    truncated = False
     if _native is not None:
         import ctypes
+        # pd_prof_summary drops distinct names beyond cap, returning
+        # n == cap as the only tell; re-call with a grown buffer until
+        # every name fits (or the hard ceiling is hit, then say so)
         cap = 512
-        names = ctypes.create_string_buffer(64 * cap)
-        calls = (ctypes.c_int64 * cap)()
-        total = (ctypes.c_int64 * cap)()
-        mx = (ctypes.c_int64 * cap)()
-        n = _native.pd_prof_summary(names, calls, total, mx, cap)
+        while True:
+            names = ctypes.create_string_buffer(64 * cap)
+            calls = (ctypes.c_int64 * cap)()
+            total = (ctypes.c_int64 * cap)()
+            mx = (ctypes.c_int64 * cap)()
+            n = _native.pd_prof_summary(names, calls, total, mx, cap)
+            if n < cap:
+                break
+            if cap >= _SUMMARY_CAP_MAX:
+                truncated = True
+                break
+            cap *= 4
         for i in range(n):
             nm = names.raw[64 * i:64 * (i + 1)].split(b"\0")[0].decode()
             agg[nm] = {"calls": int(calls[i]),
@@ -181,7 +239,9 @@ def summary(sorted_key="total"):
         s["avg_us"] = s["total_us"] / max(s["calls"], 1)
     key = {"total": "total_us", "calls": "calls", "max": "max_us",
            "ave": "avg_us"}.get(sorted_key, "total_us")
-    return dict(sorted(agg.items(), key=lambda kv: -kv[1][key]))
+    out = SummaryDict(sorted(agg.items(), key=lambda kv: -kv[1][key]))
+    out.truncated = truncated
+    return out
 
 
 # -- orchestration-overhead budget ------------------------------------------
@@ -262,6 +322,17 @@ class StepClock:
             out["orchestration_fraction"] = round(
                 self.orchestration_fraction(device_compute_s), 4)
         return out
+
+    def publish(self, prefix: str = "train",
+                device_compute_s: Optional[float] = None) -> dict:
+        """Push this clock's stats into the observability registry as
+        `<prefix>.<stat>` gauges (the step/tick percentiles become
+        scrapeable next to the engines' own histograms)."""
+        from ..observability import metrics as _metrics
+        stats = self.stats(device_compute_s)
+        for k, v in stats.items():
+            _metrics.gauge(f"{prefix}.{k}").set(v)
+        return stats
 
 
 # -- device-side (XPlane) bridge --------------------------------------------

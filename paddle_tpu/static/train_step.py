@@ -25,6 +25,8 @@ from ..core.generator import key_scope, next_key
 from ..framework import Tensor, no_grad
 from ..jit.api import _unwrap_tree, _wrap_tree
 from ..nn.layer.layers import Layer
+from ..observability import metrics as _obs
+from ..observability.sentinel import RecompileSentinel, signature_of
 from ..optimizer.optimizer import Optimizer
 from ..optimizer.lr import LRScheduler
 
@@ -154,6 +156,9 @@ class TrainStep:
         self._donate = donate
         self._step_fn = None  # built lazily (data shardings need structure)
         self._grad_fn = None
+        # one-train-executable guard, observed every step (always-on —
+        # the counter bypasses the metrics gate)
+        self.recompile_sentinel = RecompileSentinel("train")
         if self.mesh is not None and self.sharding_plan is not None \
                 and not self._abstract:
             # place params/opt-state/buffers per the plan up front
@@ -361,6 +366,14 @@ class TrainStep:
             key, lr, in_arrays, lbl_arrays)
         if isinstance(self.optimizer._lr, LRScheduler):
             pass  # caller steps the scheduler per its own schedule
+        if _obs._enabled:
+            _obs.counter("train.steps_total").add(1)
+        # sentinel is ALWAYS on (counter bypasses the metrics gate): a
+        # silent retrace is a contract violation whether or not anyone
+        # is scraping; cost is one cache-size read + input-shapes walk
+        self.recompile_sentinel.observe(
+            int(self._step_fn._cache_size()), expected=1,
+            signature=signature_of((in_arrays, lbl_arrays)))
         # keep the Layer's tensors pointing at live (undonated) arrays —
         # dygraph semantics: the model is usable eagerly at any time
         self.sync_to_layer()

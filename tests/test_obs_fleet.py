@@ -1,0 +1,93 @@
+"""Fleet metric aggregation receipts.
+
+- merge_snapshots (pure function): counter summing, gauge min/max/mean,
+  histogram count-weighted percentile folding — unit-level, no pod.
+- the multi-process CPU run (reference test_dist_base.py forked-trainer
+  pattern): two real processes each record host-local metrics, then
+  observability.fleet.aggregate() reduces the snapshots over the same
+  coordination-service + gloo collectives the trainers use. The rollup
+  must be host-count-scaled (counter = world × per-host value) and see
+  the cross-host gauge spread.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_merge_snapshots_pure():
+    from paddle_tpu.observability.fleet import merge_snapshots
+    a = {
+        "c": {"type": "counter", "value": 10},
+        "g": {"type": "gauge", "value": 1.0},
+        "h": {"type": "histogram", "count": 2, "sum": 3.0,
+              "min": 1.0, "max": 2.0, "p50": 1.5, "p99": 2.0},
+    }
+    b = {
+        "c": {"type": "counter", "value": 32},
+        "g": {"type": "gauge", "value": 3.0},
+        "h": {"type": "histogram", "count": 2, "sum": 30.0,
+              "min": 10.0, "max": 20.0, "p50": 15.0, "p99": 20.0},
+        "only_b": {"type": "counter", "value": 7},
+    }
+    m = merge_snapshots([a, b])
+    assert m["c"]["value"] == 42
+    assert m["g"]["min"] == 1.0 and m["g"]["max"] == 3.0
+    assert m["g"]["value"] == pytest.approx(2.0)  # mean
+    assert m["h"]["count"] == 4 and m["h"]["sum"] == 33.0
+    assert m["h"]["min"] == 1.0 and m["h"]["max"] == 20.0
+    assert m["h"]["p50"] == pytest.approx(8.25)  # count-weighted
+    assert m["only_b"]["value"] == 7
+
+
+def test_aggregate_single_process():
+    from paddle_tpu.observability import fleet, metrics
+    metrics.clear()
+    try:
+        with metrics.enabled_scope(True):
+            metrics.counter("obs.sp.c").add(5)
+        merged = fleet.aggregate()
+        assert merged["fleet.host_count"]["value"] == 1
+        assert merged["obs.sp.c"]["value"] == 5
+    finally:
+        metrics.clear()
+
+
+def test_two_process_fleet_rollup(tmp_path):
+    """Host-count-scaled rollups on a real 2-process CPU run."""
+    env = dict(os.environ)
+    env.update({
+        "PD_TEST_RDZV_PORT": str(_free_port()),
+        "PD_TEST_COORD_PORT": str(_free_port()),
+        "PD_TEST_OUT": str(tmp_path),
+        # children pick their own backend; scrub the test-session forcing
+        "XLA_FLAGS": "",
+    })
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2",
+           os.path.join(REPO, "tests", "obs_fleet_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=150)
+    assert res.returncode == 0, (
+        f"launch failed\nstdout:\n{res.stdout}\nstderr:\n{res.stderr}")
+    for r in range(2):
+        path = tmp_path / f"rank{r}.json"
+        assert path.exists(), f"rank {r} wrote no result; " \
+                              f"stderr:\n{res.stderr}"
+        got = json.loads(path.read_text())
+        assert got["host_count"] == 2
+        assert got["examples"] == 20      # 10 per host × 2 hosts
+        assert got["gauge_min"] == 1.0    # rank 0
+        assert got["gauge_max"] == 2.0    # rank 1
+        assert got["lat_count"] == 6      # 3 per host × 2 hosts

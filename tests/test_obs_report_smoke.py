@@ -1,0 +1,76 @@
+"""Tier-1 smoke for tools/obs_report.py --demo (the observability
+acceptance surface): a 2-stage CPU-mesh run must produce a Prometheus
+text dump and JSONL series carrying per-op dispatch counts, collective
+bytes, step_ms percentiles, examples/sec, an MFU estimate, and
+train_recompiles_total == 0; the --force-recompile leg must flip the
+recompile counter to exactly 1 with a logged shape diff."""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PD_OBS_DEMO_DEVICES": "2",
+    "PD_OBS_DEMO_MICRO": "4",
+    "PD_OBS_DEMO_WIDTH": "64",
+    "PD_OBS_DEMO_DEPTH": "1",
+    "PD_OBS_DEMO_BATCH": "16",
+    "PD_OBS_DEMO_STEPS": "2",
+}
+# the parent test process pins a different virtual device count; the
+# demo subprocess must pick its own
+_ENV.pop("XLA_FLAGS", None)
+
+
+def _run(tmp_path, *extra):
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         "--demo", "--out", str(tmp_path), *extra],
+        capture_output=True, text=True, timeout=300, env=_ENV,
+        cwd=ROOT)
+    assert p.returncode == 0, (p.stdout + "\n" + p.stderr)[-2000:]
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def test_demo_full_surface_and_forced_recompile(tmp_path):
+    # ONE subprocess proves both acceptance legs: the exports are
+    # written from the steady-shape run (train_recompiles_total == 0),
+    # the forced shape change afterwards flips the sentinel to 1
+    s = _run(tmp_path, "--force-recompile")
+    assert s["ok"], s
+    assert s["op_dispatch_counts"], s
+    assert any(v > 0 for v in s["collective_bytes"].values()), s
+    assert s["step_ms_p99"] >= s["step_ms_p50"] > 0
+    assert s["examples_per_sec"] > 0
+    assert s["mfu"] != 0 and s["model_flops_per_step"] > 0
+    assert s["fleet_host_count"] == 1
+
+    # steady-shape leg: zero recompiles in the exported artifacts
+    assert s["steady_recompiles_total"] == 0
+    prom = open(s["prometheus"]).read()
+    assert "train_recompiles_total 0" in prom
+    assert "paddle_tpu_op_dispatch_total" in prom
+    assert "paddle_tpu_collective_bytes" in prom
+    assert 'paddle_tpu_pipeline_step_ms{quantile="0.5"}' in prom
+    assert "paddle_tpu_throughput_examples_per_sec" in prom
+    assert "paddle_tpu_throughput_mfu" in prom
+    rec = json.loads(open(s["jsonl"]).read().splitlines()[-1])
+    m = rec["metrics"]
+    assert m["train_recompiles_total"] == 0
+    assert any(k.startswith("op.dispatch.total") for k in m)
+    assert any(k.startswith("collective.bytes") for k in m)
+    assert m["pipeline.step_ms"]["p50"] > 0
+    assert m["throughput.examples_per_sec"] > 0
+    assert "throughput.mfu" in m
+    # metric marks merged into the host chrome trace
+    tr = json.load(open(s["trace"]))
+    assert any(e.get("ph") == "C" for e in tr["traceEvents"])
+
+    # forced-shape-change leg: counter flips to exactly 1, diff logged
+    assert s["train_recompiles_total"] == 1
+    assert s["recompile_diff"] and "->" in s["recompile_diff"], s

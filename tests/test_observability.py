@@ -1,0 +1,363 @@
+"""Observability runtime receipts: StatRegistry metrics (thread-sharded
+counters, gauges, histograms, the one-bool disabled gate), hot-path
+wiring (eager op dispatch, collectives, pipeline engines), exporters
+(Prometheus text, JSONL, chrome-trace marks, bench emit_report bridge),
+ThroughputMeter/MFU, MetricsLogger callback, and the profiler
+satellites (RecordEvent backend capture, summary truncation flag)."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import exporters, metrics, mfu
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    """Each test gets a clean registry and a disabled gate."""
+    metrics.clear()
+    metrics.disable()
+    yield
+    metrics.clear()
+    metrics.disable()
+
+
+# -- core instruments --------------------------------------------------------
+
+def test_counter_thread_sharded_sum():
+    c = metrics.counter("t.c")
+    with metrics.enabled_scope(True):
+        def work():
+            for _ in range(1000):
+                c.add(1)
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        c.add(5)
+    assert c.value() == 4005
+    c.reset()
+    assert c.value() == 0
+
+
+def test_gauge_and_labels():
+    with metrics.enabled_scope(True):
+        metrics.gauge("t.g", stage="0").set(3.5)
+        metrics.gauge("t.g", stage="1").set(4.5)
+    snap = metrics.snapshot()
+    assert snap["t.g{stage=0}"]["value"] == 3.5
+    assert snap["t.g{stage=1}"]["value"] == 4.5
+
+
+def test_histogram_percentiles_and_decimation():
+    h = metrics.histogram("t.h")
+    with metrics.enabled_scope(True):
+        for v in range(10000):  # exceeds the reservoir cap
+            h.observe(float(v))
+    d = h.dump()
+    assert d["count"] == 10000
+    assert d["min"] == 0.0 and d["max"] == 9999.0
+    assert abs(d["p50"] - 5000.0) < 500    # decimated reservoir
+    assert d["p99"] > d["p50"]
+
+
+def test_disabled_gate_records_nothing():
+    metrics.counter("t.off").add(100)
+    metrics.gauge("t.off.g").set(9)
+    metrics.histogram("t.off.h").observe(1.0)
+    snap = metrics.snapshot()
+    assert snap["t.off"]["value"] == 0
+    assert snap["t.off.g"]["value"] == 0
+    assert snap["t.off.h"]["count"] == 0
+
+
+def test_always_on_instruments_bypass_gate():
+    c = metrics.counter("t.always", _always=True)
+    c.add(3)
+    assert c.value() == 3
+
+
+def test_disabled_counter_increment_under_one_microsecond():
+    """Satellite: the eager-dispatch hot path wires counters
+    unconditionally; with observability disabled an increment must stay
+    under ~1µs median (one module-bool read + call overhead)."""
+    c = metrics.counter("t.perf")
+    n = 10000
+    medians = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.add(1)
+        medians.append((time.perf_counter() - t0) / n)
+    med = sorted(medians)[len(medians) // 2]
+    assert med < 1e-6, f"disabled counter.add costs {med * 1e9:.0f}ns"
+    assert c.value() == 0  # and recorded nothing
+
+
+def test_kind_collision_raises():
+    metrics.counter("t.kind")
+    with pytest.raises(TypeError):
+        metrics.gauge("t.kind")
+
+
+# -- hot-path wiring ---------------------------------------------------------
+
+def test_op_dispatch_counters():
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    _ = a + a  # disabled: no counter appears
+    assert not any(k.startswith("op.dispatch")
+                   for k in metrics.snapshot())
+    with metrics.enabled_scope(True):
+        _ = a + a
+        _ = paddle.matmul(a, a)
+    snap = metrics.snapshot()
+    assert snap["op.dispatch.total{op=elementwise_add}"]["value"] == 1
+    assert snap["op.dispatch.total{op=matmul_v2}"]["value"] == 1
+
+
+def test_collective_call_and_byte_counters():
+    import paddle_tpu.distributed as dist
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    with metrics.enabled_scope(True):
+        dist.all_reduce(x)          # world-size-1 identity, still counted
+    snap = metrics.snapshot()
+    assert snap["collective.calls{op=allreduce_sum}"]["value"] == 1
+    assert snap["collective.bytes{op=allreduce_sum}"]["value"] == \
+        4 * 8 * 4
+
+
+def test_monitor_compat_shim():
+    from paddle_tpu.core import monitor
+    monitor.stat("t.mon").add(3)
+    monitor.stat("t.mon").add(2)
+    assert monitor.get_stats()["t.mon"] == 5  # gate-independent
+    monitor.reset_all()
+    assert monitor.get_stats()["t.mon"] == 0
+
+
+def test_monitor_survives_registry_clear():
+    """metrics.clear() must not sever monitor stats from the export
+    pipeline: the shim re-resolves instruments from the registry, so
+    post-clear counts land where snapshot()/Prometheus can see them."""
+    from paddle_tpu.core import monitor
+    monitor.stat("t.mon2").add(100)
+    metrics.clear()
+    monitor.stat("t.mon2").add(5)
+    assert monitor.get_stats()["t.mon2"] == 5
+    assert metrics.snapshot()["t.mon2"]["value"] == 5  # exporters see it
+
+
+# -- exporters ---------------------------------------------------------------
+
+def test_prometheus_text_format():
+    with metrics.enabled_scope(True):
+        metrics.counter("exp.c", op="add").add(2)
+        metrics.counter("exp.c", op="mul").add(3)
+        metrics.gauge("exp.g").set(1.5)
+        metrics.gauge("exp.s").set("not-a-number")
+        metrics.histogram("exp.h").observe_many([1.0, 2.0, 3.0])
+    text = exporters.to_prometheus()
+    # exactly ONE TYPE line per family (strict parsers reject dupes),
+    # even with several labeled series — and snapshot-rendered dumps
+    # (fleet rollups) go through the same renderer
+    assert text.count("# TYPE paddle_tpu_exp_c counter") == 1
+    assert exporters.to_prometheus(metrics.snapshot()).count(
+        "# TYPE paddle_tpu_exp_c counter") == 1
+    assert 'paddle_tpu_exp_c{op="add"} 2' in text
+    assert 'paddle_tpu_exp_c{op="mul"} 3' in text
+    assert "paddle_tpu_exp_g 1.5" in text
+    assert "exp_s" not in text               # non-numeric gauge skipped
+    assert 'paddle_tpu_exp_h{quantile="0.5"} 2.0' in text
+    assert "paddle_tpu_exp_h_count 3" in text
+
+
+def test_jsonl_exporter(tmp_path):
+    with metrics.enabled_scope(True):
+        metrics.counter("exp.j").add(7)
+    path = tmp_path / "m.jsonl"
+    exporters.JsonlExporter(str(path)).write(step=3)
+    exporters.JsonlExporter(str(path)).write(step=4)
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["step"] == 3 and rec["metrics"]["exp.j"] == 7
+
+
+def test_chrome_trace_marks_merged(tmp_path):
+    from paddle_tpu import profiler
+    with metrics.enabled_scope(True):
+        metrics.counter("exp.t").add(1)
+        profiler.start_profiler()
+        with profiler.RecordEvent("span_x"):
+            pass
+        # marks merge only while the metrics runtime is enabled
+        profiler.stop_profiler(profile_path=str(tmp_path / "tr"))
+    data = json.load(open(str(tmp_path / "tr.json")))
+    names = [e.get("name") for e in data["traceEvents"]]
+    assert any(n == "metric:exp.t" for n in names), names
+    # metrics disabled: a fresh export carries NO metric marks
+    profiler.start_profiler()
+    with profiler.RecordEvent("span_y"):
+        pass
+    profiler.stop_profiler(profile_path=str(tmp_path / "tr2"))
+    data2 = json.load(open(str(tmp_path / "tr2.json")))
+    assert not any(str(e.get("name", "")).startswith("metric:")
+                   for e in data2["traceEvents"])
+
+
+def test_emit_report_round_trip(tmp_path):
+    report = {"a": 1, "b": 2.5, "extras": {"c": "text", "d": [1, 2],
+                                           "flag": True}}
+    path = tmp_path / "bench.jsonl"
+    out = exporters.emit_report(report, jsonl_path=str(path),
+                                prefix="bench.test")
+    assert out == report
+    rec = json.loads(path.read_text().splitlines()[0])
+    assert rec["metrics"]["bench.test.a"] == 1
+    assert rec["metrics"]["bench.test.extras.c"] == "text"
+
+
+# -- throughput / MFU --------------------------------------------------------
+
+def test_step_flops_and_meter():
+    import jax.numpy as jnp
+    x = jnp.ones((64, 64), jnp.float32)
+    flops = mfu.step_flops(lambda a: a @ a, x)
+    assert flops >= 2 * 64 ** 3 * 0.5      # ~2·n³, backend-fuzzed
+    meter = mfu.ThroughputMeter(examples_per_step=64,
+                                flops_per_step=flops,
+                                peak_flops=1e12, n_devices=1)
+    for _ in range(3):
+        meter.step(0.01)
+    with metrics.enabled_scope(True):
+        rep = meter.report()
+    assert rep["examples_per_sec"] == pytest.approx(6400, rel=0.01)
+    assert rep["mfu"] == pytest.approx(flops / 0.01 / 1e12, rel=0.01)
+    snap = metrics.snapshot()
+    assert snap["throughput.examples_per_sec"]["value"] > 0
+    assert snap["throughput.mfu"]["value"] > 0
+
+
+def test_chip_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("PD_PEAK_FLOPS", "123.0")
+    assert mfu.chip_peak_flops() == 123.0
+
+
+def test_jax_compile_hook_counts_compiles():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.observability.sentinel import attach_jax_compile_hook
+    assert attach_jax_compile_hook()       # idempotent best-effort
+    assert attach_jax_compile_hook()
+    before = (metrics.get("jax.compiles_total") or
+              metrics.counter("jax.compiles_total", _always=True)).value()
+    jax.jit(lambda x: x * 3 + 1)(jnp.ones((7,)))
+    after = metrics.counter("jax.compiles_total",
+                            _always=True).value()
+    assert after > before
+
+
+# -- hapi MetricsLogger ------------------------------------------------------
+
+def test_metrics_logger_callback(tmp_path):
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+    jsonl = tmp_path / "train.jsonl"
+    prom = tmp_path / "train.prom"
+    cb = MetricsLogger(log_freq=2, jsonl_path=str(jsonl),
+                       prom_path=str(prom), batch_size=8)
+    cb.on_train_begin()
+    assert metrics.enabled()
+    for step in range(4):
+        cb.on_train_batch_end(step, {"loss": [0.5 - 0.1 * step]})
+    cb.on_train_end()
+    assert not metrics.enabled()          # restored
+    recs = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(recs) >= 2
+    last = recs[-1]["metrics"]
+    assert last["train.batches_total"] == 4
+    assert last["throughput.examples_total"] == 32
+    assert last["train.loss"] == pytest.approx(0.2)
+    assert "paddle_tpu_train_batches_total 4" in prom.read_text()
+
+
+# -- profiler satellites -----------------------------------------------------
+
+def test_record_event_backend_captured_once():
+    """A span begun on the Python path before start_profiler resolves
+    the native lib must END on the Python path too (no pd_prof_span
+    with a Python-clock t0, no _tls.depth leak)."""
+    import paddle_tpu.profiler as prof
+    prof.start_profiler()
+    try:
+        ev = prof.RecordEvent("tear_check")
+        ev.begin()
+        backend_at_begin = ev._backend
+        ev.end()                           # must use the captured backend
+        assert ev._backend is backend_at_begin
+        rep = prof.summary()
+        assert "tear_check" in rep
+    finally:
+        prof.stop_profiler(profile_path=None)
+
+
+def test_record_event_depth_unwound_when_stopped_mid_span():
+    """stop_profiler() landing between begin() and end() must not leak
+    _tls.depth (the span is dropped; nesting bookkeeping survives)."""
+    import paddle_tpu.profiler as prof
+    prof.start_profiler()
+    try:
+        if prof._native is not None:
+            pytest.skip("native collector active: no python-path depth")
+        ev = prof.RecordEvent("torn").begin()
+        depth_mid = prof._tls.depth
+        prof.stop_profiler(profile_path=None)
+        ev.end()                            # disabled now — must unwind
+        assert prof._tls.depth == depth_mid - 1
+    finally:
+        if prof._enabled:
+            prof.stop_profiler(profile_path=None)
+
+
+def test_flops_probe_does_not_advance_rng():
+    """train_flops_per_step is pure observation: it must not consume
+    the global RNG stream (bit-for-bit parity discipline)."""
+    from paddle_tpu.core.generator import default_generator
+    import jax
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    paddle.seed(7)
+    mesh = dist.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    eng = dist.PipelineParallel(
+        [nn.Sequential(nn.Linear(8, 8)) for _ in range(2)],
+        lambda o, t: ((o - t) ** 2).mean(),
+        paddle.optimizer.SGD(learning_rate=1e-3), num_micro=2,
+        mesh=mesh, exec_mode="spmd_1f1b")
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 8), np.float32))
+    eng.train_batch(x, y)
+    before = default_generator()._offset
+    eng.train_flops_per_step(x, y)
+    assert default_generator()._offset == before
+
+
+def test_summary_reports_truncation_flag():
+    import paddle_tpu.profiler as prof
+    prof.start_profiler()
+    try:
+        # >512 distinct span names: the old native path silently dropped
+        # everything past cap=512; now the buffer regrows (and the
+        # result carries an explicit truncated flag either way)
+        for i in range(600):
+            with prof.RecordEvent(f"span_{i:04d}"):
+                pass
+        rep = prof.summary()
+        assert hasattr(rep, "truncated")
+        assert rep.truncated is False
+        assert len([k for k in rep if k.startswith("span_")]) == 600
+    finally:
+        prof.stop_profiler(profile_path=None)

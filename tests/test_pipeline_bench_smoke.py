@@ -49,19 +49,32 @@ def _seed_floor():
         seed["parsed"]["extras"]["pipeline"]["speedup_vs_single"])
 
 
-def _run_bench():
+def _run_bench(jsonl=None):
+    env = _ENV if jsonl is None else {**_ENV, "PD_OBS_JSONL": jsonl}
     p = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools",
                                       "pipeline_bench.py")],
-        capture_output=True, text=True, timeout=300, env=_ENV,
+        capture_output=True, text=True, timeout=300, env=env,
         cwd=ROOT)
     assert p.returncode == 0, p.stderr[-2000:]
     return json.loads(p.stdout.strip().splitlines()[-1])
 
 
-def test_pipeline_bench_single_dispatch_and_speedup_floor():
+def test_pipeline_bench_single_dispatch_and_speedup_floor(tmp_path):
     floor = _seed_floor()
-    stats = _run_bench()
+    jsonl = str(tmp_path / "bench.jsonl")
+    stats = _run_bench(jsonl=jsonl)
+
+    # the printed report and the metrics-runtime JSONL export come from
+    # ONE code path (observability.exporters.emit_report): the exported
+    # series must carry exactly the printed fields, value-identical
+    rec = json.loads(open(jsonl).read().splitlines()[-1])
+    exported = {k[len("bench.pipeline."):]: v["value"] if isinstance(
+        v, dict) and "value" in v else v
+        for k, v in rec["metrics"].items()
+        if k.startswith("bench.pipeline.")}
+    assert exported == stats, (
+        "JSONL export diverged from the printed bench report")
 
     # structural contracts — single shot, load-independent
     assert stats["compile_count"] == 1, stats

@@ -1,0 +1,148 @@
+"""Recompile sentinel receipts (observability tentpole satellite).
+
+The spmd_1f1b engine and TrainStep promise exactly ONE train executable
+per (scaler, shapes) config. The sentinel must:
+  - stay silent over steady-shape steps (zero false positives),
+  - fire EXACTLY ONCE when a changed batch shape forces a retrace,
+    logging the offending shape diff,
+  - not re-fire on subsequent steps at the new (now-baselined) shape,
+  - treat a legitimate new scaler config as expected, not a violation.
+"""
+import logging
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability.sentinel import (RecompileSentinel,
+                                               diff_signatures,
+                                               signature_of)
+
+S, M, H = 2, 4, 16
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.clear()
+    metrics.disable()
+    yield
+    metrics.clear()
+    metrics.disable()
+
+
+def _loss(o, t):
+    return ((o - t) ** 2).mean()
+
+
+class _Stage(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(H, H)
+
+    def forward(self, xx):
+        return paddle.tanh(self.lin(xx))
+
+
+def _engine():
+    paddle.seed(0)
+    mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+    return dist.PipelineParallel(
+        [_Stage() for _ in range(S)], _loss,
+        paddle.optimizer.SGD(learning_rate=1e-3), num_micro=M,
+        mesh=mesh, exec_mode="spmd_1f1b")
+
+
+def _batch(rows, seed=0):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randn(rows, H).astype(np.float32)),
+            paddle.to_tensor(rng.randn(rows, H).astype(np.float32)))
+
+
+def test_steady_zero_then_shape_change_fires_once(caplog):
+    """One engine, both legs: steady shapes must stay silent (zero
+    false positives), then a halved batch fires EXACTLY once."""
+    eng = _engine()
+    x, y = _batch(M * 4)
+    x2, y2 = _batch(M * 2, seed=1)       # halved batch: forced retrace
+    with metrics.enabled_scope(True):
+        for _ in range(3):
+            eng.train_batch(x, y)
+        assert eng.recompile_sentinel.fired == 0
+        assert eng.recompile_sentinel.counter.value() == 0
+        assert metrics.snapshot()[
+            "train_recompiles_total"]["value"] == 0
+        with caplog.at_level(logging.WARNING,
+                             logger="paddle_tpu.observability"):
+            eng.train_batch(x2, y2)
+        # steady at the NEW shape: no re-fire
+        eng.train_batch(x2, y2)
+    sent = eng.recompile_sentinel
+    assert sent.fired == 1
+    assert sent.counter.value() == 1
+    assert metrics.snapshot()["train_recompiles_total"]["value"] == 1
+    # the event carries the per-microbatch shape delta (16 -> 8 rows)
+    diff = sent.events[0]["diff"]
+    assert "(4, 4, 16)" in diff and "(4, 2, 16)" in diff, diff
+    assert any("recompile sentinel" in r.message
+               for r in caplog.records), caplog.records
+
+
+def test_scaler_config_is_expected_not_violation():
+    from paddle_tpu.amp import GradScaler
+    eng = _engine()
+    x, y = _batch(M * 4)
+    with metrics.enabled_scope(True):
+        eng.train_batch(x, y)
+        eng.train_batch(x, y)
+        # new scaler config builds a SECOND legitimate executable
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+        eng.train_batch(x, y, scaler=scaler)
+        eng.train_batch(x, y, scaler=scaler)
+    assert eng.recompile_sentinel.fired == 0
+    assert eng.recompile_sentinel.counter.value() == 0
+    assert eng.compile_count == 2        # one per config — by design
+
+
+def test_trainstep_sentinel_fires_on_retrace():
+    from paddle_tpu.static import TrainStep
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(H, H), nn.ReLU())
+    opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                               parameters=net.parameters())
+    dist.set_mesh(None)
+    step = TrainStep(net, _loss, opt)
+    x, y = _batch(8)
+    x2, y2 = _batch(6, seed=1)
+    with metrics.enabled_scope(True):
+        step(x, y)
+        step(x, y)
+        step(x2, y2)                     # retrace: new batch dim
+    assert step.recompile_sentinel.fired == 1
+    diff = step.recompile_sentinel.events[0]["diff"]
+    assert "(8, 16)" in diff and "(6, 16)" in diff, diff
+
+
+def test_signature_diff_helper():
+    a = signature_of((np.zeros((4, 8), np.float32),))
+    b = signature_of((np.zeros((2, 8), np.float32),))
+    d = diff_signatures(a, b)
+    assert "(4, 8)" in d and "(2, 8)" in d
+    assert diff_signatures(a, a).startswith("identical")
+
+
+def test_bare_jit_watch_check():
+    import jax.numpy as jnp
+    sent = RecompileSentinel("probe")
+    f = sent.watch(jax.jit(lambda v: v * 2))
+    a, b = jnp.ones((3,)), jnp.ones((5,))
+    f(a); sent.check(a)
+    f(a); sent.check(a)
+    assert sent.fired == 0
+    f(b); sent.check(b)
+    assert sent.fired == 1
+    assert "(3,)" in sent.events[0]["diff"]
+    assert "(5,)" in sent.events[0]["diff"]

@@ -12,6 +12,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -24,6 +25,10 @@ def _free_port():
     return p
 
 
+@pytest.mark.slow  # ~18 s: heaviest tier-1 entry; faster siblings stay
+# in tier-1 (test_spmd_1f1b_engine.py covers the engine on virtual
+# devices, test_multiprocess_dist.py + test_obs_fleet.py cover real
+# cross-process collectives through the same launcher+coordination path)
 def test_spmd_1f1b_across_process_boundary(tmp_path):
     env = dict(os.environ)
     env.update({

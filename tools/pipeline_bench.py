@@ -240,6 +240,20 @@ def main():
         out.update(_full_legs(mesh, S, M, batch, width,
                               depth_per_stage, steps, rng, x, y,
                               loss_fn, make_stage))
+    # ONE code path for the printed report and the exported series:
+    # every field becomes a bench.pipeline.* gauge in the metrics
+    # runtime, the JSONL record is written from the registry snapshot,
+    # and the dict printed below is REBUILT from that same snapshot
+    # (PD_OBS_JSONL names the series file; bench.py sets it when
+    # collecting BENCH_r* artifacts). Guarded: an exporter failure
+    # (unwritable PD_OBS_JSONL path) must not sink measured legs.
+    try:
+        from paddle_tpu.observability import exporters as obs_exporters
+        out = obs_exporters.emit_report(
+            out, jsonl_path=os.environ.get("PD_OBS_JSONL"),
+            prefix="bench.pipeline")
+    except Exception as e:  # pragma: no cover — the artifact survives
+        out["obs_export_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))
 
 
