@@ -464,12 +464,40 @@ def main():
     except Exception:  # pragma: no cover — older jax name
         pass
 
+    # goodput accounting over the primary training leg: the flight
+    # recorder brackets every TrainStep call, the jax.monitoring hook
+    # attributes compile seconds, and the resulting productive /
+    # compile / checkpoint / dataloader / stalled fractions ride the
+    # report (and, via emit_report + goodput.publish, the
+    # Prometheus/JSONL exports and fleet rollups)
+    goodput_stats = None
+    try:
+        from paddle_tpu.observability import (flight_recorder as _fr,
+                                              goodput as _goodput,
+                                              sentinel as _sentinel)
+        _sentinel.attach_jax_compile_hook()
+        _goodput.reset()
+        # crash_handlers: a bench crash/preemption leaves a black box.
+        # sync_steps=False: bench_ernie times its own loop with ONE
+        # final sync — a per-step block_until_ready would serialize
+        # host dispatch with device compute and distort the headline
+        # tokens_per_sec/MFU across rounds
+        _fr.enable(crash_handlers=True, sync_steps=False)
+    except Exception as e:  # pragma: no cover — bench must survive
+        _fr = _goodput = None
+        errors["goodput_arm"] = f"{type(e).__name__}: {e}"
     try:
         tokens_per_sec, mfu, n_params, fpt = bench_ernie(on_tpu)
     except Exception as e:  # pragma: no cover - JSON line must survive
         tokens_per_sec = mfu = fpt = -1.0
         n_params = -1
         errors["ernie"] = f"{type(e).__name__}: {e}"
+    if _fr is not None:
+        try:
+            goodput_stats = _goodput.publish()
+            _fr.disable()
+        except Exception as e:  # pragma: no cover
+            errors["goodput"] = f"{type(e).__name__}: {e}"
     # secondary benches never sink the primary metric; failures are
     # reported in extras["errors"]
     images_per_sec = -1.0
@@ -575,6 +603,7 @@ def main():
             "decode_new_tokens_per_sec": round(decode_tps, 1),
             "decode_dtype": decode_dtype,
             "attention_path": attn_path,
+            **({"goodput": goodput_stats} if goodput_stats else {}),
             **({"serving": serving_stats} if serving_stats else {}),
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),

@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from ..framework import Tensor
+from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from .. import serialization
 
@@ -47,19 +48,27 @@ def _barrier(name: str):
 
 
 def _ckpt_record(kind: str, arrays, t0: float):
-    if not _obs._enabled:
+    """Metrics + flight-recorder close-out for one save/load (each
+    gate is one module-bool read when its plane is disabled)."""
+    if not (_obs._enabled or _fr._enabled):
         return
-    from .collective import _payload_bytes  # ONE byte-accounting walk
-    _obs.counter(f"checkpoint.{kind}s_total").add(1)
-    _obs.counter(f"checkpoint.{kind}_bytes_total").add(
-        _payload_bytes(arrays))
-    _obs.histogram(f"checkpoint.{kind}_ms").observe(
-        (time.perf_counter() - t0) * 1e3)
+    from .collective import _payload_bytes
+    nbytes = _payload_bytes(arrays)  # ONE byte-accounting walk
+    if _obs._enabled:
+        _obs.counter(f"checkpoint.{kind}s_total").add(1)
+        _obs.counter(f"checkpoint.{kind}_bytes_total").add(nbytes)
+        _obs.histogram(f"checkpoint.{kind}_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+    if _fr._enabled:
+        # t0 doubles as the ckpt_begin token: one interval feeds the
+        # event's duration and the goodput checkpoint bucket
+        _fr.ckpt_end(kind, t0, nbytes=nbytes)
 
 
 def save_sharded(state: dict, path: str):
     """Save a (possibly sharded) pytree of jax arrays. Orbax when
     available (multi-host safe), pickle fallback."""
+    _fr.ckpt_begin("save")  # black-box marker (no-op when disabled)
     _t0 = time.perf_counter()
     ocp = _orbax()
     arrays = jax.tree_util.tree_map(
@@ -103,6 +112,7 @@ def save_sharded(state: dict, path: str):
 def load_sharded(path: str, target: Optional[dict] = None) -> dict:
     """Restore; when `target` (pytree of arrays with shardings) is given,
     arrays are restored onto those shardings (re-sharding on mesh change)."""
+    _fr.ckpt_begin("load")  # black-box marker (no-op when disabled)
     _t0 = time.perf_counter()
     ocp = _orbax()
     # a crash between the two swap renames in save_sharded leaves the new

@@ -11,13 +11,15 @@ reference cannot do this — its collective ops require initialized NCCL).
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..framework import Tensor, _unwrap
+from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from ..ops.registry import run_op
 from .env import axis_context, current_axes, current_axis_name
@@ -83,7 +85,6 @@ def _live_axis_sizes():
 def _payload_bytes(*tensors) -> int:
     """Sum of payload bytes across arrays/Tensors/tracers (shape×itemsize
     — works on tracers inside a shard_map/jit trace too)."""
-    import numpy as np
     total = 0
     for t in tensors:
         for leaf in jax.tree_util.tree_leaves(t):
@@ -98,17 +99,50 @@ def _payload_bytes(*tensors) -> int:
     return total
 
 
-def _record(op: str, *tensors):
+def _record(op: str, axis: Optional[str], *tensors):
     """Collective telemetry (EQuARX's premise: per-collective speedups
     must be measured, so every collective reports op count + payload
-    bytes). Counted at CALL time: eager collectives count per
-    execution; collectives inside a jit/shard_map trace count once per
-    TRACE (the executable then replays them for free — the trace-time
-    count is the per-program collective inventory)."""
+    bytes — and, one level deeper, per-collective SEQUENCING: the
+    flight recorder stamps each call with a monotonically increasing
+    per-(axis, op) sequence number, the cross-rank divergence signal
+    tools/tpu_doctor.py diffs when a pod hangs). Counted at CALL time:
+    eager collectives count per execution; collectives inside a
+    jit/shard_map trace count once per TRACE (the executable then
+    replays them for free — the trace-time count is the per-program
+    collective inventory, and the trace-time seq is the per-program
+    collective ORDER).
+
+    Returns the exit hook to call after the collective body (records
+    collective.exit with the same seq), or None when the recorder is
+    off — callers do ``done = _record(...); ...; done and done()``."""
+    if not (_obs._enabled or _fr._enabled):
+        return None
+    nbytes = _payload_bytes(*tensors)  # ONE tree walk for both planes
     if _obs._enabled:
         _obs.counter("collective.calls", op=op).add(1)
-        _obs.counter("collective.bytes", op=op).add(
-            _payload_bytes(*tensors))
+        _obs.counter("collective.bytes", op=op).add(nbytes)
+    if _fr._enabled:
+        seq = _fr.collective_seq(axis, op)
+        _fr.record("collective.enter", op=op, axis=axis, seq=seq,
+                   bytes=nbytes)
+        return lambda: _fr.record("collective.exit", op=op, axis=axis,
+                                  seq=seq)
+    return None
+
+
+def _mirror_into(tensor, src):
+    """paddle's collectives mutate their input in place; mirror the
+    result's data AND autograd linkage — a stale _node would backprop
+    through the pre-collective value."""
+    if isinstance(src, Tensor):
+        tensor._data = src._data
+        tensor._node = src._node
+        tensor._out_idx = src._out_idx
+    else:
+        tensor._data = jnp.asarray(src)
+        tensor._node = None
+        tensor._out_idx = 0
+    return tensor
 
 
 def _axis_for(group) -> Optional[str]:
@@ -129,9 +163,10 @@ def _axis_for(group) -> Optional[str]:
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_allreduce_{sum,max,min,prod} (c_allreduce_op.h:111) → lax.p*."""
-    _record("allreduce_" + op, tensor)
     axis = _axis_for(group)
+    done = _record("allreduce_" + op, axis, tensor)
     if axis is None:
+        done and done()
         return tensor  # world size 1
 
     def impl(x):
@@ -147,12 +182,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             return jnp.exp(lax.psum(jnp.log(x), axis))
         raise ValueError(op)
     out = run_op("c_allreduce_" + op, impl, (tensor,), {})
-    if isinstance(tensor, Tensor) and not isinstance(tensor, type(None)):
-        # paddle mutates in place; mirror that surface
-        tensor._data = out._data
-        tensor._node = out._node
-        tensor._out_idx = out._out_idx
-        return tensor
+    done and done()
+    if isinstance(tensor, Tensor):
+        return _mirror_into(tensor, out)
     return out
 
 
@@ -162,21 +194,26 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     `tensor_list`; functional style all_gather(x) returns stacked array."""
     if tensor is None:
         x = tensor_list
-        _record("allgather", x)
         ax = _axis_for(group)
+        done = _record("allgather", ax, x)
         if ax is None:
+            done and done()
             return x
-        return run_op("c_allgather",
-                      lambda a: lax.all_gather(a, ax, axis=0, tiled=False),
-                      (x,), {})
-    _record("allgather", tensor)
+        out = run_op("c_allgather",
+                     lambda a: lax.all_gather(a, ax, axis=0, tiled=False),
+                     (x,), {})
+        done and done()
+        return out
     ax = _axis_for(group)
+    done = _record("allgather", ax, tensor)
     if ax is None:
+        done and done()
         tensor_list.append(tensor)
         return tensor_list
     gathered = run_op("c_allgather",
                       lambda a: lax.all_gather(a, ax, axis=0, tiled=False),
                       (tensor,), {})
+    done and done()
     n = gathered.shape[0]
     for i in range(n):
         tensor_list.append(gathered[i])
@@ -185,29 +222,29 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """c_broadcast: every replica takes src's value."""
-    _record("broadcast", tensor)
     axis = _axis_for(group)
+    done = _record("broadcast", axis, tensor)
     if axis is None:
+        done and done()
         return tensor
 
     def impl(x):
         full = lax.all_gather(x, axis, axis=0, tiled=False)
         return full[src]
     out = run_op("c_broadcast", impl, (tensor,), {})
+    done and done()
     if isinstance(tensor, Tensor):
-        tensor._data = out._data
-        tensor._node = out._node
-        tensor._out_idx = out._out_idx
-        return tensor
+        return _mirror_into(tensor, out)
     return out
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_reduce_*: reduced value lands on dst, others keep theirs
     (SPMD form: select by rank)."""
-    _record("reduce_" + op, tensor)
     axis = _axis_for(group)
+    done = _record("reduce_" + op, axis, tensor)
     if axis is None:
+        done and done()
         return tensor
 
     def impl(x):
@@ -217,17 +254,18 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         idx = lax.axis_index(axis)
         return jnp.where(idx == dst, red, x)
     out = run_op("c_reduce_" + op, impl, (tensor,), {})
+    done and done()
     if isinstance(tensor, Tensor):
-        tensor._data = out._data
-        return tensor
+        return _mirror_into(tensor, out)
     return out
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """c_scatter: src's i-th chunk goes to rank i."""
-    _record("scatter", tensor)
     axis = _axis_for(group)
+    done = _record("scatter", axis, tensor)
     if axis is None:
+        done and done()
         return tensor
 
     def impl(x):
@@ -236,34 +274,42 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         n = lax.axis_size(axis)
         chunk = x.shape[0] // n
         return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
-    return run_op("c_scatter", impl, (tensor,), {})
+    out = run_op("c_scatter", impl, (tensor,), {})
+    done and done()
+    return out
 
 
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_reducescatter → lax.psum_scatter."""
-    _record("reduce_scatter", tensor)
     axis = _axis_for(group)
+    done = _record("reduce_scatter", axis, tensor)
     if axis is None:
+        done and done()
         return tensor
-    return run_op("c_reducescatter",
-                  lambda x: lax.psum_scatter(x, axis, scatter_dimension=0,
-                                             tiled=True),
-                  (tensor,), {})
+    out = run_op("c_reducescatter",
+                 lambda x: lax.psum_scatter(x, axis, scatter_dimension=0,
+                                            tiled=True),
+                 (tensor,), {})
+    done and done()
+    return out
 
 
 def all_to_all(out_tensor_or_in, in_tensor=None, group=None, sync_op=True,
                split_axis=0, concat_axis=0):
     """alltoall → lax.all_to_all (the Ulysses primitive)."""
     x = in_tensor if in_tensor is not None else out_tensor_or_in
-    _record("alltoall", x)
     axis = _axis_for(group)
+    done = _record("alltoall", axis, x)
     if axis is None:
+        done and done()
         return x
-    return run_op(
+    out = run_op(
         "c_alltoall",
         lambda a: lax.all_to_all(a, axis, split_axis=split_axis,
                                  concat_axis=concat_axis, tiled=True),
         (x,), {})
+    done and done()
+    return out
 
 
 alltoall = all_to_all
@@ -271,40 +317,114 @@ alltoall = all_to_all
 
 def barrier(group=None):
     """barrier op: a psum of a scalar forces synchronization."""
-    _record("barrier")
     axis = _axis_for(group)
+    done = _record("barrier", axis)
     if axis is None:
+        done and done()
         return
     run_op("barrier", lambda x: lax.psum(x, axis),
            (jnp.zeros((), jnp.int32),), {})
+    done and done()
+
+
+# send_v2/recv_v2 are fused on TPU: a p2p pair is ONE ppermute, and in
+# the SPMD model every rank executes both calls. send() stages
+# (axis, dst, value); the matching recv() pops the stage and issues the
+# single-pair ppermute [(src, dst)] — dst ranks get the payload, other
+# ranks keep their own buffer (or zeros). World size 1 is the loopback
+# identity, so the same model file runs anywhere. FIFO staging mirrors
+# the reference's in-order send_v2/recv_v2 queue semantics per ring —
+# which also inherits its hazard: a send() whose matching recv() never
+# runs (exception between the pair) leaves its entry queued and shifts
+# every later pairing by one. recv() guards the axis, but in-order
+# discipline between the pair is the caller's contract, exactly as with
+# the reference's send_v2/recv_v2 queues.
+_p2p_staged: List[Tuple[Optional[str], int, Any]] = []
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """send_v2/recv_v2 are fused on TPU: p2p = ppermute. send() stages the
-    value; the matching recv() on the destination issues the ppermute.
-    SPMD model: use p2p_shift below for ring patterns instead."""
-    raise NotImplementedError(
-        "raw send/recv is not SPMD-expressible; use "
-        "paddle_tpu.distributed.p2p_shift (ppermute) — pipeline/ring "
-        "schedules are built on it")
+    """send_v2: stage the value for the matching recv() (p2p = ppermute
+    on TPU; the recv side issues the transfer). For ring/pipeline
+    schedules use p2p_shift — one full-ring ppermute beats N pairs."""
+    axis = _axis_for(group)
+    done = _record("send", axis, tensor)
+    _p2p_staged.append((axis, int(dst), tensor))
+    done and done()
+    return tensor
 
 
-recv = send
+def recv(tensor=None, src=0, group=None, sync_op=True):
+    """recv_v2: complete the p2p the matching send() staged, as the
+    single-pair ppermute [(src, dst)] over the group axis. Every rank
+    calls this (SPMD); the return value is the sent payload on the
+    destination rank and `tensor` (or zeros) elsewhere. World size 1:
+    loopback — returns the staged value directly."""
+    axis = _axis_for(group)
+    # the staged payload is what actually moves; `tensor` is only the
+    # destination buffer (None in functional style) — record the real
+    # bytes or collective.bytes{op=recv} reads 0 against a full send
+    payload = _p2p_staged[0][2] if (tensor is None and _p2p_staged) \
+        else tensor
+    done = _record("recv", axis, payload)
+    if not _p2p_staged:
+        done and done()
+        raise RuntimeError(
+            "recv() without a staged send(): SPMD p2p pairs one send() "
+            "with one recv(), both executed by every rank — stage the "
+            "value with send(x, dst=...) first (ring patterns: use "
+            "p2p_shift)")
+    s_axis, dst, staged = _p2p_staged[0]  # peek: a mismatch must not
+    if s_axis != axis:                    # consume the staged send
+        done and done()
+        raise RuntimeError(
+            f"recv(group over axis {axis!r}) does not pair with the "
+            f"staged send (axis {s_axis!r}): SPMD p2p pairs send/recv "
+            "in FIFO order over the SAME group")
+    _p2p_staged.pop(0)
+    if axis is None:
+        # world-size-1 loopback (or eager outside any axis scope)
+        out = staged
+        if isinstance(tensor, Tensor):
+            _mirror_into(tensor, staged)
+            done and done()
+            return tensor
+        done and done()
+        return out
+
+    def impl(s, buf):
+        moved = lax.ppermute(s, axis, [(src, dst)])
+        if buf is None:
+            return moved
+        idx = lax.axis_index(axis)
+        return jnp.where(idx == dst, moved, buf)
+
+    buf = tensor
+    if buf is None:
+        out = run_op("recv_v2", lambda s: impl(s, None), (staged,), {})
+    else:
+        out = run_op("recv_v2", impl, (staged, buf), {})
+    done and done()
+    if isinstance(tensor, Tensor):
+        return _mirror_into(tensor, out)
+    return out
 
 
 def p2p_shift(x, shift=1, group=None):
     """Ring shift by `shift` positions over the group axis (ppermute) —
     the TPU-native send_v2/recv_v2 pair for ring/pipeline schedules."""
-    _record("ppermute", x)
     axis = _axis_for(group)
+    done = _record("ppermute", axis, x)
     if axis is None:
+        done and done()
         return x
 
     def impl(a):
         n = lax.axis_size(axis)
         perm = [(i, (i + shift) % n) for i in range(n)]
         return lax.ppermute(a, axis, perm)
-    return run_op("p2p_shift", impl, (x,), {})
+    out = run_op("p2p_shift", impl, (x,), {})
+    done and done()
+    return out
 
 
 def wait(tensor, group=None, use_calc_stream=True):

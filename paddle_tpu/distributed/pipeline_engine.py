@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..framework import Tensor
 from ..jit.api import _unwrap_tree, _wrap_tree, functionalize
 from ..nn.layer.layers import Layer
+from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from ..observability.sentinel import RecompileSentinel, signature_of
 
@@ -1006,9 +1007,13 @@ class PipelineParallel:
         # not pair the tail block with an unset _t0
         _rec = _obs._enabled
         _t0 = time.perf_counter() if _rec else 0.0
+        _tok = _fr.step_begin("pipeline_spmd", self._step_count)
         self.params, self.opt_state, loss, found_inf = step(
             self.params, self.opt_state, next_key(), lr, scale_val,
             x, lbl)
+        if _tok is not None and _fr.sync_steps():
+            jax.block_until_ready(loss)
+        _fr.step_end("pipeline_spmd", self._step_count, _tok)
         self._step_count += 1
         self.last_dispatch_count = 1
         self.last_tick_ms = []  # ticks are in-graph: nothing to time
@@ -1114,6 +1119,7 @@ class PipelineParallel:
         from ..core.generator import next_key
         _rec = _obs._enabled  # captured once; see _spmd_train_batch
         _t_step = time.perf_counter() if _rec else 0.0
+        _tok = _fr.step_begin("pipeline", self._step_count)
         use_scaler = scaler is not None and scaler.is_enable()
         scale_val = jnp.asarray(
             scaler.get_loss_scaling() if use_scaler else 1.0,
@@ -1232,6 +1238,9 @@ class PipelineParallel:
             _obs.gauge("pipeline.dispatches_per_step").set(dispatches)
             _obs.gauge("pipeline.bubble_fraction").set(
                 round(self.schedule_bubble_fraction, 4))
+        if _tok is not None and _fr.sync_steps():
+            jax.block_until_ready(mean_losses)
+        _fr.step_end("pipeline", self._step_count - 1, _tok)
         return Tensor(mean_losses)
 
     # predict-only path (no labels/backward)

@@ -24,7 +24,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework import Tensor
-from ..observability import metrics as _obs
 from ..ops.registry import run_op
 from .env import SEQUENCE_AXIS, current_axis_name
 
@@ -37,15 +36,15 @@ def _ring_block_size(s_loc):
     return int(os.environ.get("PD_RING_BK", 0)) or min(512, s_loc)
 
 
-def _record_sp(op: str, q, k, v):
-    """Sequence-parallel collective telemetry: one call + the KV bytes
-    that transit the ring / all-to-all per invocation (trace-time count,
-    same convention as collective._record)."""
-    if not _obs._enabled:
-        return
-    from .collective import _payload_bytes
-    _obs.counter("collective.calls", op=op).add(1)
-    _obs.counter("collective.bytes", op=op).add(_payload_bytes(q, k, v))
+def _record_sp(op: str, axis, q, k, v):
+    """Sequence-parallel collective telemetry: delegates to
+    collective._record so ring/ulysses attention gets the same call +
+    byte counters AND flight-recorder enter/exit events with
+    per-(axis, op) seq numbers (trace-time count — a hang inside ring
+    attention must be nameable by tpu_doctor like any collective).
+    Returns the exit hook (or None)."""
+    from .collective import _record
+    return _record(op, axis, q, k, v)
 
 
 def _ring_attn_impl(q, k, v, axis, causal, scale):
@@ -102,7 +101,7 @@ def ring_flash_attention(query, key, value, causal=False, group=None,
     if axis is None:
         from ..nn.functional.attention import flash_attention
         return flash_attention(query, key, value, causal=causal)
-    _record_sp("ring_attention", query, key, value)
+    done = _record_sp("ring_attention", axis, query, key, value)
 
     def impl(q, k, v):
         qh = jnp.einsum("bsnh->bnsh", q)
@@ -111,7 +110,9 @@ def ring_flash_attention(query, key, value, causal=False, group=None,
         scale = 1.0 / math.sqrt(q.shape[-1])
         out = _ring_attn_impl(qh, kh, vh, axis, causal, scale)
         return jnp.einsum("bnsh->bsnh", out)
-    return run_op("ring_flash_attention", impl, (query, key, value), {})
+    out = run_op("ring_flash_attention", impl, (query, key, value), {})
+    done and done()
+    return out
 
 
 def ulysses_attention(query, key, value, causal=False, group=None,
@@ -125,7 +126,7 @@ def ulysses_attention(query, key, value, causal=False, group=None,
     if axis is None:
         from ..nn.functional.attention import flash_attention
         return flash_attention(query, key, value, causal=causal)
-    _record_sp("ulysses_attention", query, key, value)
+    done = _record_sp("ulysses_attention", axis, query, key, value)
 
     def impl(q, k, v):
         # [b, s/P, n, d] -> all_to_all over heads -> [b, s, n/P, d]
@@ -146,7 +147,9 @@ def ulysses_attention(query, key, value, causal=False, group=None,
         out = _flash_fwd(qh, kh, vh, causal, scale, blk)
         out = jnp.einsum("bnsh->bsnh", out)
         return reshard_bwd(out)
-    return run_op("ulysses_attention", impl, (query, key, value), {})
+    out = run_op("ulysses_attention", impl, (query, key, value), {})
+    done and done()
+    return out
 
 
 class RingAttention:

@@ -213,6 +213,7 @@ class DataLoader:
         return put(batch)
 
     def __iter__(self):
+        from ..observability import flight_recorder as _fr
         from ..observability import metrics as _obs
         import time as _time
         gen = self._batches()
@@ -241,17 +242,25 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
-            if _obs._enabled:
+            _rec_m, _rec_f = _obs._enabled, _fr._enabled
+            if _rec_m or _rec_f:
                 # host-input-pipeline health: time the consumer spends
                 # BLOCKED on the prefetch queue (≈0 when the loader
                 # keeps ahead of the step) + standing queue depth
                 _t0 = _time.perf_counter()
                 item = q.get()
-                _obs.histogram("dataloader.wait_ms").observe(
-                    (_time.perf_counter() - _t0) * 1e3)
-                _obs.gauge("dataloader.prefetch_depth").set(q.qsize())
-                if not (item is sentinel or isinstance(item, _Error)):
-                    _obs.counter("dataloader.batches_total").add(1)
+                _wait_s = _time.perf_counter() - _t0
+                if _rec_m:
+                    _obs.histogram("dataloader.wait_ms").observe(
+                        _wait_s * 1e3)
+                    _obs.gauge("dataloader.prefetch_depth").set(
+                        q.qsize())
+                    if not (item is sentinel
+                            or isinstance(item, _Error)):
+                        _obs.counter("dataloader.batches_total").add(1)
+                if _rec_f:
+                    # black box + goodput: input-starved wall-clock
+                    _fr.dataloader_wait(_wait_s)
             else:
                 item = q.get()
             if item is sentinel:

@@ -3,38 +3,58 @@
 The StatRegistry metrics layer (platform/monitor.h analogue) plus what a
 TPU-pod training job needs on top of raw counters:
 
-  metrics     counters/gauges/histograms, thread-sharded, one-bool
-              disabled gate (wired through eager dispatch, the pipeline
-              engines, collectives, checkpoint and dataloader paths)
-  sentinel    RecompileSentinel — runtime guard for the one-train-
-              executable contract, logs the shape/dtype delta that
-              caused a retrace (train_recompiles_total)
-  mfu         ThroughputMeter — examples/sec + MFU from the lowered
-              executable's own cost_analysis() FLOPs
-  fleet       cross-host snapshot rollups over the existing CPU/ICI
-              collectives
-  exporters   Prometheus text format, JSONL time series, chrome-trace
-              counter marks, and the bench-report bridge (emit_report)
+  metrics          counters/gauges/histograms, thread-sharded, one-bool
+                   disabled gate (wired through eager dispatch, the
+                   pipeline engines, collectives, checkpoint and
+                   dataloader paths)
+  sentinel         RecompileSentinel — runtime guard for the one-train-
+                   executable contract, logs the shape/dtype delta that
+                   caused a retrace (train_recompiles_total)
+  mfu              ThroughputMeter — examples/sec + MFU from the lowered
+                   executable's own cost_analysis() FLOPs
+  fleet            cross-host snapshot rollups over the existing CPU/ICI
+                   collectives
+  exporters        Prometheus text format, JSONL time series,
+                   chrome-trace counter marks, and the bench-report
+                   bridge (emit_report)
+  flight_recorder  the black box: fixed-size ring of structured events
+                   (collective enter/exit with per-(axis, op) seq
+                   numbers, step/checkpoint/dataloader/recompile),
+                   dumped with per-thread stacks on demand, on crash,
+                   and on SIGTERM/SIGQUIT
+  watchdog         HangWatchdog — detects no-step-progress against a
+                   rolling p99 step time, dumps the recorder + stacks,
+                   pokes peer hosts so every rank dumps
+  goodput          wall-clock decomposition into productive / compile /
+                   checkpoint / dataloader-wait / stalled fractions,
+                   published as goodput.* gauges
 
-Everything is off by default: `metrics.enable()` (or the hapi
-MetricsLogger callback / tools/obs_report.py) turns the wired hot paths
-on. See DESIGN.md "Observability" for the naming scheme and how this
+Everything is off by default: `metrics.enable()` turns the counter hot
+paths on, `flight_recorder.enable()` arms the forensics plane (events +
+goodput), and the hapi MetricsLogger callback / tools/obs_report.py do
+both. tools/tpu_doctor.py merges per-host dumps and names the diverging
+rank. See DESIGN.md "Observability" for the naming scheme and how this
 maps to the reference's monitor.h / timeline.py machinery.
 """
 from . import metrics  # noqa: F401
 from . import exporters  # noqa: F401
 from . import fleet  # noqa: F401
+from . import goodput  # noqa: F401
+from . import flight_recorder  # noqa: F401
 from . import mfu  # noqa: F401
 from . import sentinel  # noqa: F401
+from . import watchdog  # noqa: F401
 from .metrics import (counter, gauge, histogram, enable, disable,  # noqa: F401
                       enabled, enabled_scope, snapshot, reset)
 from .mfu import ThroughputMeter, chip_peak_flops, step_flops  # noqa: F401
 from .sentinel import RecompileSentinel, signature_of  # noqa: F401
+from .watchdog import HangWatchdog  # noqa: F401
 
 __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
+    "flight_recorder", "watchdog", "goodput",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset",
     "ThroughputMeter", "chip_peak_flops", "step_flops",
-    "RecompileSentinel", "signature_of",
+    "RecompileSentinel", "signature_of", "HangWatchdog",
 ]

@@ -19,14 +19,20 @@ jax.jit function for code outside the engines.
 ``attach_jax_compile_hook()`` additionally taps jax.monitoring compile
 events into ``jax.compiles_total`` — a coarse, framework-wide compile
 odometer (best-effort: older runtimes without jax.monitoring are a
-no-op).
+no-op). The listener is scoped to the actual ``/jax/core/compile``
+event family (a bare ``"compile" in event`` substring would also count
+compilation-cache bookkeeping like
+``/jax/compilation_cache/compile_requests_use_cache``), and compile
+*durations* — the per-phase ``*_duration`` events, or a duration kwarg
+when one rides a plain event — feed the goodput ``compile`` fraction
+and a ``jax.compile_secs`` histogram.
 """
 from __future__ import annotations
 
 import logging
 from typing import Any, List, Optional, Tuple
 
-from . import metrics
+from . import goodput, metrics
 
 __all__ = ["RecompileSentinel", "signature_of", "diff_signatures",
            "attach_jax_compile_hook"]
@@ -114,6 +120,13 @@ class RecompileSentinel:
                      "expected": allowed, "diff": delta}
             self.events.append(event)
             self.counter.add(executables - allowed)
+            # black-box breadcrumb: a recompile storm shows up in the
+            # flight recorder's event stream with the shape delta that
+            # caused each retrace (tpu_doctor flags the storm)
+            from . import flight_recorder as _fr
+            _fr.record("recompile", engine=self.name,
+                       step=self._steps, executables=int(executables),
+                       expected=allowed, diff=delta)
             logger.warning(
                 "recompile sentinel [%s]: train executable count grew "
                 "%d -> %d at step %d; input delta: %s",
@@ -144,10 +157,35 @@ class RecompileSentinel:
 
 _jax_hook_attached = False
 
+# the actual compile event family (jax _src/dispatch.py constants);
+# compilation-cache bookkeeping events also contain "compile" in their
+# names and must NOT count as compiles
+_COMPILE_EVENT_PREFIX = "/jax/core/compile"
+# one executable == one backend compile; the jaxpr-trace and
+# to-mlir-module phases are parts of the same compile, counted once
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _is_compile_event(event: str) -> bool:
+    return event.startswith(_COMPILE_EVENT_PREFIX)
+
+
+def _record_compile_duration(event: str, duration: float):
+    if duration and duration > 0:
+        metrics.histogram("jax.compile_secs", _always=True).observe(
+            duration)
+        # the goodput "compile" bucket: every phase of a compile is
+        # time the MXU sat idle (flight_recorder.step_end subtracts
+        # this from the train bucket, keeping the fractions disjoint)
+        goodput.account("compile", float(duration))
+
 
 def attach_jax_compile_hook():
     """Best-effort global compile odometer via jax.monitoring events
-    ('/jax/core/compile'-family). Idempotent; silently unavailable on
+    (the '/jax/core/compile' family, scoped — cache bookkeeping events
+    are excluded). Counts backend compiles into ``jax.compiles_total``
+    and feeds per-phase compile durations into ``jax.compile_secs`` +
+    the goodput compile fraction. Idempotent; silently unavailable on
     runtimes without jax.monitoring."""
     global _jax_hook_attached
     if _jax_hook_attached:
@@ -156,10 +194,32 @@ def attach_jax_compile_hook():
         import jax.monitoring as _mon
 
         def _listener(event: str, **kw):
-            if "compile" in event:
-                metrics.counter("jax.compiles_total", _always=True).add(1)
+            if not _is_compile_event(event):
+                return
+            metrics.counter("jax.compiles_total", _always=True).add(1)
+            # some runtimes ride the duration on the event kwargs
+            # instead of the duration channel
+            for key in ("duration_secs", "duration_sec", "duration"):
+                if key in kw:
+                    try:
+                        _record_compile_duration(event, float(kw[key]))
+                    except (TypeError, ValueError):
+                        pass
+                    break
+
+        def _dur_listener(event: str, duration: float, **kw):
+            if not _is_compile_event(event):
+                return
+            if event == _BACKEND_COMPILE_EVENT:
+                metrics.counter("jax.compiles_total",
+                                _always=True).add(1)
+            _record_compile_duration(event, duration)
 
         _mon.register_event_listener(_listener)
+        try:
+            _mon.register_event_duration_secs_listener(_dur_listener)
+        except Exception:
+            pass  # count-only on runtimes without the duration channel
         _jax_hook_attached = True
         return True
     except Exception:

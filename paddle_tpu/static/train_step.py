@@ -25,6 +25,7 @@ from ..core.generator import key_scope, next_key
 from ..framework import Tensor, no_grad
 from ..jit.api import _unwrap_tree, _wrap_tree
 from ..nn.layer.layers import Layer
+from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from ..observability.sentinel import RecompileSentinel, signature_of
 from ..optimizer.optimizer import Optimizer
@@ -153,6 +154,7 @@ class TrainStep:
                                            jnp.asarray(0, jnp.int32))
         self._accum_grads = None
         self._accum_count = 0
+        self._steps_done = 0
         self._donate = donate
         self._step_fn = None  # built lazily (data shardings need structure)
         self._grad_fn = None
@@ -360,10 +362,20 @@ class TrainStep:
             self._step_fn = self._build(in_arrays, lbl_arrays)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = next_key()
+        # black-box step bracket (one bool read when disabled): the
+        # flight recorder's step events drive the hang watchdog's
+        # progress clock and the goodput "train" bucket
+        _tok = _fr.step_begin("train_step", self._steps_done)
         (self.params, self.opt_state, self.buffers, self.strategy_state,
          loss) = self._step_fn(
             self.params, self.opt_state, self.buffers, self.strategy_state,
             key, lr, in_arrays, lbl_arrays)
+        if _tok is not None and _fr.sync_steps():
+            # device-complete before the bracket closes, so step.end
+            # durations measure real work, not async dispatch latency
+            jax.block_until_ready(loss)
+        _fr.step_end("train_step", self._steps_done, _tok)
+        self._steps_done += 1
         if isinstance(self.optimizer._lr, LRScheduler):
             pass  # caller steps the scheduler per its own schedule
         if _obs._enabled:

@@ -1,0 +1,793 @@
+"""Flight recorder / hang watchdog / goodput receipts (the pod-scale
+failure-forensics tentpole).
+
+- ring buffer semantics: bounded, ordered, lock-light; disabled-path
+  record() under the same <1 µs bar as PR 3's metrics gate (tier-1
+  guard)
+- collective seq wiring: eager calls bump per-(axis, op) counters per
+  execution, in-trace collectives once per TRACE (collective._record's
+  documented counting)
+- dumps: events + per-thread stacks + goodput, on demand / on crash
+  (sys.excepthook chain) / on SIGTERM (subprocess)
+- goodput taxonomy: disjoint buckets, fractions sum to ~1.0, published
+  gauges ride the Prometheus exporter and fleet.aggregate()
+- watchdog: induced stall -> one dump per episode, with stacks, job
+  stays alive; peer poke file -> every rank dumps
+- tpu_doctor: divergence / straggler / recompile-storm diagnosis on
+  synthetic dumps (the 2-process receipt is test_doctor_divergence.py)
+"""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.observability import flight_recorder as fr
+from paddle_tpu.observability import goodput, metrics
+from paddle_tpu.observability import watchdog as wd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    """Each test gets a clean recorder/goodput/registry, a private
+    dump dir, and restored crash handlers."""
+    monkeypatch.setenv("PD_FR_DIR", str(tmp_path / "fr"))
+    monkeypatch.delenv("PD_FR_POKE_DIR", raising=False)
+    metrics.clear()
+    metrics.disable()
+    fr.uninstall_crash_handlers()
+    fr.enable(False, capacity=fr._DEFAULT_CAPACITY)
+    fr.reset()
+    goodput.reset()
+    yield
+    fr.uninstall_crash_handlers()
+    fr.enable(False, capacity=fr._DEFAULT_CAPACITY)
+    fr.reset()
+    goodput.reset()
+    metrics.clear()
+    metrics.disable()
+
+
+# -- ring buffer -------------------------------------------------------------
+
+def test_ring_is_bounded_and_ordered():
+    fr.enable(capacity=8)
+    for i in range(20):
+        fr.record("ev", n=i)
+    evs = fr.get_recorder().events()
+    assert len(evs) == 8                       # old events evicted
+    assert [e["n"] for e in evs] == list(range(12, 20))
+    assert [e["i"] for e in evs] == sorted(e["i"] for e in evs)
+    assert all(e["k"] == "ev" and "t" in e for e in evs)
+
+
+def test_disabled_record_under_one_microsecond():
+    """CI guard (same harness as PR 3's metrics gate): the recorder is
+    wired into eager dispatch + collective hot paths unconditionally;
+    with the plane disabled one record() must stay under ~1 µs median
+    (one module-bool read + call overhead)."""
+    assert not fr.enabled()
+    n = 10000
+    medians = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fr.record("perf.guard", a=1)
+        medians.append((time.perf_counter() - t0) / n)
+    med = sorted(medians)[len(medians) // 2]
+    assert med < 1e-6, f"disabled record() costs {med * 1e9:.0f}ns"
+    assert fr.get_recorder().events() == []    # and stored nothing
+
+
+# -- collective seq wiring ---------------------------------------------------
+
+def test_eager_collective_bumps_seq_per_execution():
+    fr.enable()
+    x = paddle.to_tensor(np.ones(4, dtype=np.float32))
+    dist.all_reduce(x)
+    dist.all_reduce(x)
+    dist.barrier()
+    seqs = fr.seq_table()
+    assert seqs["-|allreduce_sum"] == 2        # eager: per execution
+    assert seqs["-|barrier"] == 1
+    kinds = [(e["k"], e.get("op"), e.get("seq"))
+             for e in fr.get_recorder().events()]
+    assert ("collective.enter", "allreduce_sum", 0) in kinds
+    assert ("collective.exit", "allreduce_sum", 0) in kinds
+    assert ("collective.enter", "allreduce_sum", 1) in kinds
+    enter = next(e for e in fr.get_recorder().events()
+                 if e["k"] == "collective.enter"
+                 and e.get("op") == "allreduce_sum")
+    assert enter["bytes"] == 16                # 4 × f32
+
+
+def test_traced_collective_counts_once_per_trace():
+    """Inside jit(shard_map) the seq is stamped at TRACE time: the
+    compiled replay adds nothing — the seq table is the per-program
+    collective ORDER, identical across ranks running one program."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    fr.enable()
+    mesh = dist.build_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    try:
+        def body(x):
+            return dist.all_reduce(x.clone(), op=dist.ReduceOp.SUM)
+
+        wrapped = dist.shard_parallel(body, mesh, in_specs=P("dp"),
+                                      out_specs=P("dp"))
+        jitted = jax.jit(wrapped.__wrapped_smap__)
+        x = np.arange(8, dtype=np.float32)
+        np.asarray(jitted(x))
+        np.asarray(jitted(x))                  # replay: no retrace
+        assert fr.seq_table()["dp|allreduce_sum"] == 1
+    finally:
+        dist.set_mesh(None)
+
+
+# -- dumps and crash handlers ------------------------------------------------
+
+def test_dump_carries_events_stacks_seq_goodput(tmp_path):
+    fr.enable()
+    fr.record("ev", n=1)
+    goodput.account("train", 0.5)
+    path = str(tmp_path / "box.json")
+    doc = fr.dump(path=path, reason="unit")
+    assert doc["path"] == path and os.path.exists(path)
+    ondisk = json.load(open(path))
+    assert ondisk["reason"] == "unit"
+    assert any(e["k"] == "ev" for e in ondisk["events"])
+    assert ondisk["goodput"]["train_seconds"] == pytest.approx(0.5)
+    # per-thread stacks: this thread's frames must be in there
+    assert any("test_dump_carries_events" in "\n".join(fs)
+               for fs in ondisk["stacks"].values())
+
+
+def test_dump_works_while_disabled(tmp_path):
+    """A crash handler must never refuse to write the evidence: dump()
+    flushes whatever the ring still holds even after disable()."""
+    fr.enable()
+    fr.record("ev", n=1)
+    fr.disable()
+    doc = fr.dump(path=str(tmp_path / "late.json"), reason="post")
+    assert doc["enabled"] is False
+    assert any(e["k"] == "ev" for e in doc["events"])
+
+
+def test_excepthook_dumps_and_chains(tmp_path, monkeypatch):
+    seen = []
+    monkeypatch.setattr(sys, "excepthook",
+                        lambda *a: seen.append(a))
+    fr.install_crash_handlers(signals=())
+    fr.enable()
+    fr.record("pre.crash")
+    err = ValueError("boom")
+    sys.excepthook(ValueError, err, None)
+    assert seen and seen[0][1] is err          # previous hook chained
+    dumps = glob.glob(os.path.join(os.environ["PD_FR_DIR"],
+                                   "flight_*.json"))
+    assert dumps
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "crash:ValueError"
+    assert any(e["k"] == "pre.crash" for e in doc["events"])
+
+
+def test_sigterm_dumps_then_dies(tmp_path):
+    """Preemption forensics: SIGTERM writes the black box, then the
+    default die-on-TERM semantics the supervisor expects still apply."""
+    code = (
+        "import os, signal\n"
+        "from paddle_tpu.observability import flight_recorder as fr\n"
+        "fr.enable()\n"
+        "fr.record('preempt.ev', n=7)\n"
+        "fr.install_crash_handlers()\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+    )
+    env = {**os.environ, "PD_FR_DIR": str(tmp_path),
+           "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=120)
+    assert res.returncode != 0                 # SIGTERM still kills
+    dumps = glob.glob(str(tmp_path / "flight_*.json"))
+    assert dumps, res.stderr[-2000:]
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "signal:SIGTERM"
+    assert any(e["k"] == "preempt.ev" for e in doc["events"])
+    assert doc["stacks"]
+
+
+# -- goodput -----------------------------------------------------------------
+
+def test_uninstall_restores_sig_dfl_for_c_level_prev_handler():
+    """A C-level previous handler reads back as None from
+    signal.signal(); uninstall must restore SIG_DFL (signal(sig, None)
+    raises TypeError) so test/bench teardown never explodes and the
+    remaining handlers still get restored."""
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        fr.install_crash_handlers(signals=(signal.SIGTERM,))
+        fr._prev_signal[signal.SIGTERM] = None   # as a C handler reads
+        fr.uninstall_crash_handlers()            # must not raise
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+        assert not fr._prev_signal
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_goodput_fractions_sum_to_one():
+    goodput.start()
+    goodput.account("train", 0.2)
+    goodput.account("compile", 0.1)
+    goodput.account("checkpoint", 0.05)
+    rep = goodput.report(elapsed=1.0)
+    assert rep["productive_fraction"] == pytest.approx(0.2)
+    assert rep["compile_fraction"] == pytest.approx(0.1)
+    assert rep["checkpoint_fraction"] == pytest.approx(0.05)
+    assert rep["other_fraction"] == pytest.approx(0.65)
+    total = sum(v for k, v in rep.items() if k.endswith("_fraction"))
+    assert total == pytest.approx(1.0)
+
+
+def test_goodput_rejects_unknown_category():
+    with pytest.raises(ValueError):
+        goodput.account("coffee", 1.0)
+
+
+def test_step_end_keeps_buckets_disjoint():
+    """Compile seconds that accrue DURING a step are subtracted from
+    the train bucket (flight_recorder.step_end), so productive +
+    compile never double-counts the same wall-clock."""
+    fr.enable()
+    tok = fr.step_begin("t", 0)
+    goodput.account("compile", 0.05)           # mid-step retrace
+    time.sleep(0.09)
+    fr.step_end("t", 0, tok)
+    train = goodput.accrued("train")
+    assert 0.0 < train < 0.09                  # wall minus compile
+    assert goodput.accrued("compile") == pytest.approx(0.05)
+
+
+def test_goodput_publish_rides_exporters_and_fleet():
+    from paddle_tpu.observability import exporters, fleet
+    goodput.account("train", 0.3)
+    goodput.publish(elapsed=1.0)
+    snap = metrics.snapshot()
+    assert snap["goodput.productive_fraction"]["value"] == \
+        pytest.approx(0.3)
+    text = exporters.to_prometheus(snap)
+    assert "paddle_tpu_goodput_productive_fraction 0.3" in text
+    merged = fleet.aggregate()
+    assert merged["goodput.productive_fraction"]["value"] == \
+        pytest.approx(0.3)
+
+
+# -- wired layers ------------------------------------------------------------
+
+def test_train_step_emits_step_events_and_goodput():
+    fr.enable()
+    paddle.seed(7)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    from paddle_tpu.static import TrainStep
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+    step(x, y)
+    step(x, y)
+    kinds = [e["k"] for e in fr.get_recorder().events()]
+    assert kinds.count("step.begin") == 2
+    assert kinds.count("step.end") == 2
+    prog = fr.progress()
+    assert prog["steps"] == 2
+    assert prog["last_step_age_s"] is not None
+    assert goodput.accrued("train") > 0
+
+
+def test_checkpoint_emits_ckpt_events(tmp_path):
+    from paddle_tpu.distributed import checkpoint
+    fr.enable()
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    path = str(tmp_path / "ckpt")
+    checkpoint.save_sharded(state, path)
+    checkpoint.load_sharded(path)
+    kinds = [e["k"] for e in fr.get_recorder().events()]
+    assert "ckpt.save.begin" in kinds and "ckpt.save.end" in kinds
+    assert "ckpt.load.begin" in kinds and "ckpt.load.end" in kinds
+    assert goodput.accrued("checkpoint") > 0
+
+
+def test_dataloader_iteration_survives_recorder():
+    from paddle_tpu.io import DataLoader, TensorDataset
+    fr.enable()
+    ds = TensorDataset([paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(16, 1))])
+    out = list(DataLoader(ds, batch_size=4))
+    assert len(out) == 4
+    assert goodput.accrued("dataloader") >= 0.0
+
+
+def test_recompile_sentinel_breadcrumb_in_recorder():
+    from paddle_tpu.observability.sentinel import (RecompileSentinel,
+                                                   signature_of)
+    fr.enable()
+    s = RecompileSentinel("t_eng")
+    a = signature_of(np.zeros((2, 2), np.float32))
+    b = signature_of(np.zeros((3, 2), np.float32))
+    s.observe(1, expected=1, signature=a)
+    s.observe(2, expected=1, signature=b)      # violation: retrace
+    evs = [e for e in fr.get_recorder().events()
+           if e["k"] == "recompile"]
+    assert len(evs) == 1
+    assert evs[0]["engine"] == "t_eng"
+    assert "(2, 2)" in evs[0]["diff"] and "(3, 2)" in evs[0]["diff"]
+
+
+# -- compile-event scoping (sentinel satellite) ------------------------------
+
+def test_compile_listener_scoped_to_core_compile_events():
+    from paddle_tpu.observability import sentinel
+    assert sentinel._is_compile_event(
+        "/jax/core/compile/backend_compile_duration")
+    # cache bookkeeping contains "compile" but is NOT a compile
+    assert not sentinel._is_compile_event(
+        "/jax/compilation_cache/compile_requests_use_cache")
+    assert not sentinel._is_compile_event("/jax/core/trace")
+
+
+def test_compile_duration_feeds_goodput():
+    from paddle_tpu.observability import sentinel
+    sentinel._record_compile_duration(
+        "/jax/core/compile/backend_compile_duration", 0.25)
+    assert goodput.accrued("compile") == pytest.approx(0.25)
+    assert metrics.snapshot()["jax.compile_secs"]["count"] == 1
+
+
+# -- hang watchdog -----------------------------------------------------------
+
+def test_watchdog_dumps_on_induced_stall(tmp_path):
+    """Induced-stall receipt: steps stop -> ONE dump per episode with
+    per-thread stacks, stalled goodput accrues, job is NOT killed."""
+    fr.enable()
+    tok = fr.step_begin("t", 0)
+    fr.step_end("t", 0, tok)                   # arms the progress clock
+    w = wd.HangWatchdog(min_timeout=0.25, timeout_factor=5.0,
+                        poll_interval=0.05, peer_poke=False,
+                        dump_dir=str(tmp_path))
+    w.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while w.stall_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.3)                        # extra polls, same episode
+    finally:
+        w.stop()
+    assert w.stall_count == 1                  # one dump per episode
+    assert w.last_dump is not None
+    assert w.last_dump["reason"] == "watchdog_stall"
+    assert w.last_dump["stacks"]               # hung-thread forensics
+    stall_events = [e for e in fr.get_recorder().events()
+                    if e["k"] == "watchdog.stall"]
+    assert len(stall_events) == 1
+    assert stall_events[0]["age_s"] > 0.25
+    assert goodput.accrued("stalled") > 0
+    assert glob.glob(str(tmp_path / "flight_stall_*.json"))
+    assert metrics.snapshot()["watchdog.stalls_total"]["value"] == 1
+
+
+def test_watchdog_stall_does_not_double_count_other_buckets(tmp_path):
+    """A long checkpoint (or retrace) pauses step progress; when the
+    stall claim reaches back over that window the wall-clock is already
+    accounted to the checkpoint bucket — the stalled bucket must claim
+    only the NET no-progress time, or the goodput fractions sum past
+    1.0 (found by driving ckpt + watchdog together end-to-end)."""
+    fr.enable()
+    goodput.start()
+    tok = fr.step_begin("t", 0)
+    fr.step_end("t", 0, tok)                   # arms the progress clock
+    w = wd.HangWatchdog(min_timeout=0.2, timeout_factor=5.0,
+                        poll_interval=0.05, peer_poke=False,
+                        dump_dir=str(tmp_path))
+    w.start()
+    try:
+        # the whole no-step window is checkpoint time, accounted as the
+        # watchdog polls — stalled must not re-claim it
+        deadline = time.monotonic() + 10.0
+        t_ck = time.monotonic()
+        while w.stall_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+            now = time.monotonic()
+            goodput.account("checkpoint", now - t_ck)
+            t_ck = now
+        time.sleep(0.3)                        # more polls, same episode
+        now = time.monotonic()
+        # span lands in ONE lump at its end (ckpt_end semantics): the
+        # watchdog must retract the stalled seconds it claimed while
+        # the span was still in flight
+        goodput.account("checkpoint", now - t_ck)
+        deadline = time.monotonic() + 10.0
+        while (goodput.accrued("stalled") > 0.1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)                   # a poll sees the lump
+    finally:
+        w.stop()
+    assert w.stall_count == 1
+    rep = goodput.report()
+    total = sum(v for k, v in rep.items() if k.endswith("_fraction"))
+    assert total <= 1.05, f"goodput fractions sum to {total}: {rep}"
+
+
+def test_watchdog_recovery_retraction_capped_at_episode_claim(tmp_path):
+    """The recovery branch: a span that lands in ONE lump (ckpt_end)
+    right before the recovering step must be retracted from the
+    stalled bucket — but capped at what THIS episode claimed, so it
+    never eats stalled seconds a previous episode legitimately owns.
+    Drives _check_progress() by hand for deterministic lump/recovery
+    ordering (the threaded poll loop races the lump)."""
+    fr.enable()
+    goodput.start()
+    goodput.account("stalled", 5.0)            # a previous episode's loss
+    tok = fr.step_begin("t", 0)
+    fr.step_end("t", 0, tok)                   # arms the progress clock
+    w = wd.HangWatchdog(min_timeout=0.2, timeout_factor=5.0,
+                        poll_interval=3600.0, peer_poke=False,
+                        dump_dir=str(tmp_path))
+    time.sleep(0.35)
+    w._check_progress()                        # stall fires, claims time
+    claimed = goodput.accrued("stalled") - 5.0
+    assert claimed > 0
+    assert w._stalled_since is not None
+    # the whole no-step window was really a checkpoint, landing in one
+    # lump at its end; the very next poll sees a completed step
+    goodput.account("checkpoint", 0.35)
+    tok = fr.step_begin("t", 1)
+    fr.step_end("t", 1, tok)
+    w._check_progress()                        # recovery branch retracts
+    assert w._stalled_since is None
+    # episode claim fully retracted (lump > claim), previous 5.0 intact
+    assert goodput.accrued("stalled") == pytest.approx(5.0, abs=0.05)
+    assert w._episode_claimed == 0.0
+
+
+def test_watchdog_midstall_retraction_capped_at_episode_claim(tmp_path):
+    """Same cap, MID-episode: a huge span landing in one lump while
+    still stalled makes the incremental delta very negative; uncapped,
+    adjust()'s global zero floor would eat stalled seconds a PREVIOUS
+    episode legitimately claimed."""
+    fr.enable()
+    goodput.start()
+    goodput.account("stalled", 5.0)            # a previous episode's loss
+    tok = fr.step_begin("t", 0)
+    fr.step_end("t", 0, tok)
+    w = wd.HangWatchdog(min_timeout=0.2, timeout_factor=5.0,
+                        poll_interval=3600.0, peer_poke=False,
+                        dump_dir=str(tmp_path))
+    time.sleep(0.35)
+    w._check_progress()                        # stall fires, claims time
+    claimed = goodput.accrued("stalled") - 5.0
+    assert claimed > 0
+    # a 10 s checkpoint lump lands while STILL stalled (no step yet):
+    # next poll's delta ≈ poll_dt − 10 — must be capped at −claimed
+    goodput.account("checkpoint", 10.0)
+    w._check_progress()
+    assert w.stall_count == 1                  # same episode
+    assert goodput.accrued("stalled") == pytest.approx(5.0, abs=0.05)
+    assert w._episode_claimed == 0.0
+
+
+def test_watchdog_stop_keeps_handle_while_thread_wedged(tmp_path,
+                                                        monkeypatch):
+    """stop() must not discard the thread handle when join() times out
+    (dump wedged on a hung shared-FS mount) — a later start() would
+    run TWO watchdogs, double-counting stalls and stalled seconds."""
+    fr.enable()
+    tok = fr.step_begin("t", 0)
+    fr.step_end("t", 0, tok)
+    gate = threading.Event()
+
+    def wedged_dump(*a, **k):
+        gate.wait(20.0)
+        return {"reason": "wedged", "stacks": {}}
+    monkeypatch.setattr(wd._fr, "dump", wedged_dump)
+    w = wd.HangWatchdog(min_timeout=0.1, timeout_factor=5.0,
+                        poll_interval=0.02, peer_poke=False,
+                        dump_dir=str(tmp_path))
+    w.start()
+    deadline = time.monotonic() + 10.0
+    while w.stall_count == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert w.stall_count == 1                  # thread now wedged in dump
+    w.stop()                                   # join times out
+    assert w._thread is not None and w._thread.is_alive()
+    wedged = w._thread
+    w.start()                                  # must NOT spawn a second
+    assert w._thread is wedged
+    names = [t.name for t in threading.enumerate()
+             if t.name == "pd-hang-watchdog"]
+    assert len(names) == 1
+    gate.set()                                 # unwedge; _stop still set
+    wedged.join(timeout=5.0)
+    assert not wedged.is_alive()
+    w.start()                                  # restart works now
+    assert w._thread is not wedged and w._thread.is_alive()
+    w.stop()
+
+
+def test_enable_resize_preserves_events_seq_and_progress():
+    """enable(capacity=N) mid-incident must re-size the ring, not wipe
+    it — a second arming layer (operator raising capacity during a
+    hang) erasing buffered events + seq counters would fake a massive
+    divergence in tpu_doctor's cross-rank diff."""
+    fr.enable(True, capacity=64)
+    for i in range(10):
+        fr.record("ev", n=i)
+    fr.collective_seq("x", "allreduce_sum")
+    fr.get_recorder().note_step(0.5)
+    fr.enable(True, capacity=128)              # grow
+    evs = [e for e in fr.get_recorder().events() if e["k"] == "ev"]
+    assert [e["n"] for e in evs] == list(range(10))
+    assert fr.seq_table() == {"x|allreduce_sum": 1}
+    assert fr.progress()["steps"] == 1
+    fr.enable(True, capacity=8)                # shrink keeps the newest
+    evs = [e for e in fr.get_recorder().events() if e["k"] == "ev"]
+    assert evs and evs[-1]["n"] == 9 and len(evs) <= 8
+    fr.record("after.resize")                  # ring still writable
+    assert any(e["k"] == "after.resize"
+               for e in fr.get_recorder().events())
+
+
+def test_recv_records_staged_payload_bytes():
+    """Functional-style recv (tensor=None) must report the STAGED
+    payload's bytes on its collective.enter event — the destination
+    buffer is None, but the bytes that move are the send's."""
+    fr.enable()
+    x = paddle.to_tensor(np.arange(256, dtype=np.float32))
+    dist.send(x, dst=0)
+    dist.recv(src=0)
+    evs = [e for e in fr.get_recorder().events()
+           if e["k"] == "collective.enter" and e["op"] == "recv"]
+    assert evs and evs[-1]["bytes"] == 256 * 4
+
+
+def test_watchdog_adapts_timeout_to_step_p99():
+    fr.enable()
+    for _ in range(20):
+        fr.get_recorder().note_step(2.0)       # slow job: 2 s steps
+    w = wd.HangWatchdog(min_timeout=1.0, timeout_factor=5.0)
+    assert w.timeout() == pytest.approx(10.0)  # 5 × p99, above floor
+    w2 = wd.HangWatchdog(min_timeout=60.0, timeout_factor=5.0)
+    assert w2.timeout() == pytest.approx(60.0)  # floor wins
+
+
+def test_peer_poke_triggers_dump(tmp_path, monkeypatch):
+    """request_fleet_dump() touches the shared poke file; every rank's
+    watchdog dumps once per poke mtime — no collectives involved, so
+    it works even while the main thread is wedged."""
+    monkeypatch.setenv("PD_FR_POKE_DIR", str(tmp_path))
+    fr.enable()
+    fr.record("before.poke")
+    w = wd.HangWatchdog(min_timeout=3600.0, poll_interval=0.05,
+                        peer_poke=True, dump_dir=str(tmp_path))
+    w.start()
+    try:
+        wd.request_fleet_dump(reason="unit")
+        deadline = time.monotonic() + 10.0
+        while w.last_dump is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        w.stop()
+    assert w.last_dump is not None
+    assert w.last_dump["reason"] == "peer_poke"
+    assert glob.glob(str(tmp_path / "flight_poked_*.json"))
+
+
+def test_stale_poke_file_is_ignored_at_start(tmp_path, monkeypatch):
+    """A poke left on the shared FS by a previous incident must not
+    make a freshly started watchdog dump — only pokes newer than
+    start() count."""
+    monkeypatch.setenv("PD_FR_POKE_DIR", str(tmp_path))
+    fr.enable()
+    wd.request_fleet_dump(reason="last_week")   # stale leftover
+    w = wd.HangWatchdog(min_timeout=3600.0, poll_interval=0.05,
+                        peer_poke=True, dump_dir=str(tmp_path))
+    w.start()
+    try:
+        time.sleep(0.4)                        # several polls
+        assert w.last_dump is None             # stale poke ignored
+        time.sleep(0.05)                       # ensure mtime advances
+        wd.request_fleet_dump(reason="fresh")  # live poke still works
+        deadline = time.monotonic() + 10.0
+        while w.last_dump is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        w.stop()
+    assert w.last_dump is not None and \
+        w.last_dump["reason"] == "peer_poke"
+
+
+# -- tpu_doctor (unit; the 2-process run is test_doctor_divergence) ----------
+
+def _dump(rank, seqs, p50=None, events=(), gp=None):
+    return {"rank": rank, "collective_seq": seqs,
+            "progress": {"step_s_p50": p50}, "events": list(events),
+            "goodput": gp or {}, "reason": "test"}
+
+
+def test_doctor_names_diverging_rank_and_seq():
+    from tools.tpu_doctor import diagnose, format_report
+    dumps = [
+        _dump(0, {"pp|allreduce_sum": 5, "-|barrier": 2}),
+        _dump(1, {"pp|allreduce_sum": 3, "-|barrier": 2}),
+    ]
+    div = diagnose(dumps)["divergence"]
+    assert div["diverging_rank"] == 1
+    assert div["axis"] == "pp" and div["op"] == "allreduce_sum"
+    assert div["mismatched_seq"] == 3          # first seq not everywhere
+    text = format_report(diagnose(dumps))
+    assert "DIVERGENCE" in text and "rank 1" in text
+
+
+def test_doctor_consistent_ranks_are_clean():
+    from tools.tpu_doctor import diagnose
+    dumps = [_dump(r, {"pp|allreduce_sum": 5}, p50=0.01)
+             for r in range(4)]
+    diag = diagnose(dumps)
+    assert diag["divergence"] is None
+    assert diag["stragglers"] == []
+
+
+def test_doctor_flags_straggler_and_storm():
+    from tools.tpu_doctor import diagnose
+    storm = [{"k": "recompile", "diff": "x: (2,2)->(3,2)"}] * 3
+    dumps = [_dump(0, {}, p50=0.010),
+             _dump(1, {}, p50=0.011, events=storm),
+             _dump(2, {}, p50=0.055)]
+    diag = diagnose(dumps)
+    assert [s["rank"] for s in diag["stragglers"]] == [2]
+    assert diag["recompile_storm"]["total"] == 3
+    assert diag["recompile_storm"]["per_rank"] == {"1": 3}
+
+
+def test_doctor_flags_straggler_on_two_host_pod():
+    """Even rank counts use the true median (mean of middles) — with
+    the upper-middle element a 2-host pod's slow rank would be its own
+    reference and never flag."""
+    from tools.tpu_doctor import diagnose
+    diag = diagnose([_dump(0, {}, p50=1.0), _dump(1, {}, p50=10.0)])
+    assert [s["rank"] for s in diag["stragglers"]] == [1]
+
+
+def test_doctor_storm_last_diffs_are_newest_by_time():
+    """Carried-over evidence events are APPENDED after the kept dump's
+    ring — 'last shape deltas' must order by timestamp, not list
+    position (within a rank AND across ranks), or triage reads the
+    OLDEST input change as the latest."""
+    from tools.tpu_doctor import diagnose
+    evs = ([{"k": "recompile", "t": 100.0 + i, "diff": f"new{i}"}
+            for i in range(2)]
+           + [{"k": "recompile", "t": 1.0 + i, "diff": f"old{i}"}
+              for i in range(2)])                # carried, older, last
+    diag = diagnose([_dump(0, {}, events=evs)])
+    assert diag["recompile_storm"]["total"] == 4
+    assert diag["recompile_storm"]["last_diffs"][-2:] == ["new0", "new1"]
+    # across ranks: rank 1 iterates later but its diffs are hours old
+    diag = diagnose([
+        _dump(0, {}, events=[{"k": "recompile", "t": 100.0 + i,
+                              "diff": f"live{i}"} for i in range(2)]),
+        _dump(1, {}, events=[{"k": "recompile", "t": 5.0 + i,
+                              "diff": f"stale{i}"} for i in range(2)]),
+    ])
+    assert diag["recompile_storm"]["last_diffs"][-2:] == \
+        ["live0", "live1"]
+
+
+def test_doctor_keeps_newest_dump_per_rank(tmp_path):
+    """A dump dir holds several black boxes per rank (watchdog stall +
+    poked files, stale runs); merging two snapshots of ONE rank taken
+    at different times must not fake a divergence."""
+    from tools.tpu_doctor import diagnose, load_dumps
+    old = {"rank": 0, "ts": 100.0, "collective_seq":
+           {"pp|allreduce_sum": 3}, "reason": "stale"}
+    new = {"rank": 0, "ts": 200.0, "collective_seq":
+           {"pp|allreduce_sum": 7}, "reason": "fresh"}
+    peer = {"rank": 1, "ts": 201.0, "collective_seq":
+            {"pp|allreduce_sum": 7}, "reason": "fresh"}
+    paths = []
+    for i, d in enumerate([old, new, peer]):
+        p = tmp_path / f"flight_{i}.json"
+        p.write_text(json.dumps(d))
+        paths.append(str(p))
+    dumps = load_dumps(paths)
+    assert [d["rank"] for d in dumps] == [0, 1]
+    assert dumps[0]["reason"] == "fresh"   # newest ts won
+    assert diagnose(dumps)["divergence"] is None  # healthy pod
+
+
+def test_doctor_headline_picks_deepest_gap_not_cross_stream_min():
+    """Seq numbers are per-(axis, op) counters with no global ordering
+    — the headline must name the deepest divergence (the allreduce a
+    rank actually stopped making), not whichever unrelated stream
+    happens to hold the smallest seq value."""
+    from tools.tpu_doctor import diagnose
+    dumps = [
+        _dump(0, {"dp|allreduce_sum": 500, "dp|barrier": 3}),
+        _dump(1, {"dp|allreduce_sum": 480, "dp|barrier": 2}),
+    ]
+    div = diagnose(dumps)["divergence"]
+    assert div["op"] == "allreduce_sum"        # gap 20 beats gap 1
+    assert div["mismatched_seq"] == 480
+
+
+def test_doctor_live_one_call_lag_is_skew_not_divergence():
+    """Dumps are not a barrier: two snapshots of a healthy,
+    actively-stepping pod taken milliseconds apart differ by in-flight
+    calls. A 1-call lag where the lagging rank was LIVE at dump time
+    must not produce a DIVERGENCE verdict (or exit 1)."""
+    from tools.tpu_doctor import diagnose, format_report
+    live = {"step_s_p50": 0.01, "last_step_age_s": 0.05}
+    dumps = [
+        {"rank": 0, "collective_seq": {"dp|allreduce_sum": 1000},
+         "progress": live, "events": [], "goodput": {}, "reason": "t"},
+        {"rank": 1, "collective_seq": {"dp|allreduce_sum": 1001},
+         "progress": live, "events": [], "goodput": {}, "reason": "t"},
+    ]
+    div = diagnose(dumps)["divergence"]
+    assert div.get("diverging_rank") is None
+    assert div["possible_skew"][0]["counts"] == {"0": 1000, "1": 1001}
+    text = format_report(diagnose(dumps))
+    assert "DIVERGENCE" not in text and "snapshot skew" in text
+    # a QUIESCED rank (no recent step) one call behind IS a skip
+    dumps[0]["progress"] = {"step_s_p50": 0.01,
+                            "last_step_age_s": 120.0}
+    assert diagnose(dumps)["divergence"]["diverging_rank"] == 0
+
+
+def test_doctor_carries_stall_evidence_past_newer_dump(tmp_path):
+    """Newest-per-rank filtering must not discard the mid-hang stall
+    record: once the ring wraps past the watchdog.stall event, the
+    only copy lives in the superseded stall dump — load_dumps carries
+    it (pointing back at the file holding the mid-hang stacks)."""
+    from tools.tpu_doctor import diagnose, load_dumps
+    stall = {"rank": 0, "ts": 100.0, "reason": "watchdog_stall",
+             "collective_seq": {}, "stacks": {"MainThread:1": ["f"]},
+             "events": [{"k": "watchdog.stall", "i": 7, "t": 99.0,
+                         "age_s": 42.0, "limit_s": 5.0}]}
+    later = {"rank": 0, "ts": 200.0, "reason": "manual",
+             "collective_seq": {}, "stacks": {},
+             "events": []}                     # ring wrapped: no stall
+    paths = []
+    for i, d in enumerate([stall, later]):
+        p = tmp_path / f"flight_{i}.json"
+        p.write_text(json.dumps(d))
+        paths.append(str(p))
+    dumps = load_dumps(paths)
+    assert len(dumps) == 1 and dumps[0]["reason"] == "manual"
+    hangs = diagnose(dumps)["hangs"]
+    assert len(hangs) == 1 and hangs[0]["age_s"] == 42.0
+    assert hangs[0]["stacks_in_dump"] is True  # stacks in SOURCE dump
+    assert hangs[0]["dump"] == paths[0]
+
+
+def test_doctor_goodput_fleet_mean():
+    from tools.tpu_doctor import diagnose
+    gp = {"elapsed_seconds": 10.0, "productive_fraction": 0.8,
+          "stalled_fraction": 0.1}
+    gp2 = {"elapsed_seconds": 10.0, "productive_fraction": 0.6,
+           "stalled_fraction": 0.3}
+    diag = diagnose([_dump(0, {}, gp=gp), _dump(1, {}, gp=gp2)])
+    assert diag["goodput"]["productive_fraction"] == \
+        pytest.approx(0.7)
+    assert diag["goodput"]["stalled_fraction"] == pytest.approx(0.2)

@@ -15,6 +15,10 @@ Modes:
               batch with a CHANGED shape: the sentinel must flip
               train_recompiles_total to exactly 1 and log the shape
               delta (printed in the summary as recompile_diff).
+  --doctor DIR   forensics bridge: hand the flight-recorder dumps in
+              DIR to tools/tpu_doctor.py and print its diagnosis
+              (diverging rank + last mismatched collective seq,
+              stragglers, recompile storms, goodput breakdown).
   default     aggregate + export whatever the current process's
               registry holds (for embedding in training scripts).
 
@@ -39,25 +43,35 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 N_DEV = int(os.environ.get("PD_OBS_DEMO_DEVICES", 2))
 
-# virtual CPU devices must be pinned before the backend exists
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + f" --xla_force_host_platform_device_count={N_DEV}"
-    ).strip()
+jax = None  # bound by _jax_setup()
+np = None
 
-from paddle_tpu import jax_compat  # noqa: E402,F401 (shims first)
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", N_DEV)
-
-import numpy as np  # noqa: E402
+def _jax_setup():
+    """Pin virtual CPU devices and import jax — lazily, so the
+    --doctor forensics path (and a bare module import) stays
+    stdlib-only: the runbook runs it on a triage host where jax may be
+    wedged, broken, or absent."""
+    global jax, np
+    if jax is not None:
+        return
+    # virtual CPU devices must be pinned before the backend exists
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={N_DEV}"
+        ).strip()
+    from paddle_tpu import jax_compat  # noqa: F401 (shims first)
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+    _jax.config.update("jax_num_cpu_devices", N_DEV)
+    import numpy as _np
+    jax, np = _jax, _np
 
 
 def run_demo(args):
+    _jax_setup()
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
     import paddle_tpu.nn as nn
@@ -197,6 +211,7 @@ def run_demo(args):
 
 def run_export(args):
     """Non-demo mode: export whatever the registry holds right now."""
+    _jax_setup()
     from paddle_tpu.observability import exporters, fleet, metrics
     merged = fleet.aggregate()
     if args.prom:
@@ -208,15 +223,31 @@ def run_export(args):
     return 0
 
 
+def run_doctor(args):
+    """One operator surface: obs_report is where pod telemetry is read,
+    so the hang/divergence forensics bridge lives here too."""
+    from tools import tpu_doctor
+    argv = ["--dir", args.doctor]
+    if args.doctor_json:
+        argv.append("--json")
+    return tpu_doctor.main(argv)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--demo", action="store_true")
     ap.add_argument("--force-recompile", action="store_true")
+    ap.add_argument("--doctor", default=None, metavar="DIR",
+                    help="diagnose flight-recorder dumps in DIR "
+                         "(tools/tpu_doctor.py bridge)")
+    ap.add_argument("--doctor-json", action="store_true")
     ap.add_argument("--out", default="/tmp/pd_obs")
     ap.add_argument("--prom", default=None)
     ap.add_argument("--jsonl", default=None)
     ap.add_argument("--trace", default=None)
     args = ap.parse_args(argv)
+    if args.doctor:
+        return run_doctor(args)
     if args.demo:
         return run_demo(args)
     return run_export(args)

@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""tpu_doctor: merge per-host flight-recorder dumps and diagnose why a
+pod job stopped making progress.
+
+When training stalls at step 40k the framework itself must say which
+rank, which collective, and what it cost. Each rank's flight recorder
+(paddle_tpu.observability.flight_recorder — dumped by the hang
+watchdog, a crash, SIGTERM/SIGQUIT, or `request_fleet_dump()`) is one
+JSON black box; this tool reads all of them and reports:
+
+  divergence   per-(axis, op) collective sequence numbers are diffed
+               across ranks — the rank(s) whose counter fell behind
+               skipped a collective, and the first missing seq is the
+               last mismatched call (the exact point the pod's SPMD
+               programs stopped agreeing)
+  stragglers   step-duration histogram skew: ranks whose median step
+               time sits far above the fleet median are dragging every
+               collective (checker-with-the-slowest-rank law)
+  recompile storms   recompile events (the sentinel's shape/dtype
+               diffs ride along) above a storm threshold
+  hangs        watchdog.stall events with the no-progress age and the
+               per-thread stacks captured mid-hang
+  goodput      the fleet-mean wall-clock decomposition (productive /
+               compile / checkpoint / dataloader-wait / stalled)
+
+Pure functions (`load_dumps`, `diagnose`) are importable — the
+2-process divergence test drives them directly; `tools/obs_report.py
+--doctor DIR` bridges here too.
+
+Usage:
+  python tools/tpu_doctor.py dump1.json dump2.json ...
+  python tools/tpu_doctor.py --dir /tmp/pd_flight        # flight_*.json
+  python tools/tpu_doctor.py --dir ... --json            # machine output
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["load_dumps", "diagnose", "format_report", "main"]
+
+STRAGGLER_FACTOR = 1.5     # median step > 1.5x fleet median => straggler
+RECOMPILE_STORM = 3        # >= this many recompile events => storm
+# a rank stepping within this many seconds of its dump was LIVE — its
+# seq counters are a moving target, so a 1-call lag vs peers is
+# explainable by snapshot timing, not a skipped collective
+LIVE_STEP_AGE_S = 10.0
+# incident-evidence event kinds carried over from superseded dumps of
+# the same rank (newest-per-rank filtering must not discard the
+# mid-hang stall record once the ring wraps past it)
+_EVIDENCE_KINDS = ("watchdog.stall", "recompile")
+
+
+def load_dumps(paths: List[str]) -> List[dict]:
+    """Load dumps, keeping only the NEWEST (by embedded ts) per rank:
+    a dump dir naturally accumulates several black boxes per rank (the
+    watchdog's stall + poked files, stale runs), and merging two
+    snapshots of the same rank taken at different times would fake a
+    seq divergence on a healthy pod. Incident evidence
+    (watchdog.stall / recompile events) from the superseded dumps is
+    carried over into the kept dump — the stall record with its
+    mid-hang stacks must survive a later routine dump whose ring has
+    wrapped past it (merged events carry `src_dump`/`src_stacks`
+    pointing back at the file that holds the stacks)."""
+    dumps = []
+    for p in paths:
+        with open(p) as f:
+            d = json.load(f)
+        d.setdefault("rank", len(dumps))
+        d["_path"] = p
+        dumps.append(d)
+    newest: Dict[Any, dict] = {}
+    superseded: Dict[Any, List[dict]] = {}
+    for d in dumps:
+        prev = newest.get(d["rank"])
+        if prev is None or d.get("ts", 0) >= prev.get("ts", 0):
+            if prev is not None:
+                superseded.setdefault(d["rank"], []).append(prev)
+            newest[d["rank"]] = d
+        else:
+            superseded.setdefault(d["rank"], []).append(d)
+    for rank, olds in superseded.items():
+        kept = newest[rank]
+        seen = {(e.get("k"), e.get("i"), e.get("t"))
+                for e in kept.get("events", [])}
+        for old in sorted(olds, key=lambda d: d.get("ts", 0)):
+            for e in old.get("events", []):
+                if e.get("k") not in _EVIDENCE_KINDS:
+                    continue
+                key = (e.get("k"), e.get("i"), e.get("t"))
+                if key in seen:   # still resident in the kept ring
+                    continue
+                seen.add(key)
+                carried = dict(e)
+                carried["src_dump"] = old["_path"]
+                carried["src_stacks"] = bool(old.get("stacks"))
+                kept.setdefault("events", []).append(carried)
+    return sorted(newest.values(), key=lambda d: d["rank"])
+
+
+def _rank_live(dump: dict) -> bool:
+    """Was this rank still completing steps when its dump snapped? A
+    live rank's seq counters are a moving target — two live snapshots
+    taken milliseconds apart legitimately differ by in-flight calls."""
+    age = (dump.get("progress") or {}).get("last_step_age_s")
+    return age is not None and age < LIVE_STEP_AGE_S
+
+
+def _divergence(dumps: List[dict]) -> Optional[dict]:
+    """Diff per-(axis, op) seq counters across ranks. The counter value
+    is the NEXT seq to issue, i.e. the count of calls made; ranks that
+    agree made the same calls. For every key where ranks disagree, the
+    rank(s) below the maximum skipped calls, and min(count) is the
+    first seq number not executed everywhere — the last mismatched
+    collective. A 1-call lag where every lagging rank was LIVE at dump
+    time is snapshot skew, not a skip (dumps are not a barrier) — such
+    mismatches are reported under `possible_skew`, never as the
+    DIVERGENCE verdict."""
+    if len(dumps) < 2:
+        return None
+    live = {d["rank"]: _rank_live(d) for d in dumps}
+    keys = set()
+    for d in dumps:
+        keys.update(d.get("collective_seq", {}))
+    mismatches, skew = [], []
+    for key in sorted(keys):
+        counts = {d["rank"]: d.get("collective_seq", {}).get(key, 0)
+                  for d in dumps}
+        if len(set(counts.values())) == 1:
+            continue
+        hi = max(counts.values())
+        lagging = sorted(r for r, c in counts.items() if c < hi)
+        axis, _, op = key.partition("|")
+        entry = {
+            "axis": None if axis == "-" else axis, "op": op,
+            "counts": {str(r): c for r, c in counts.items()},
+            "diverging_ranks": lagging,
+            "mismatched_seq": min(counts.values()),
+            "gap": hi - min(counts.values()),
+        }
+        if entry["gap"] <= 1 and all(live.get(r) for r in lagging):
+            skew.append(entry)
+        else:
+            mismatches.append(entry)
+    if not mismatches:
+        return ({"possible_skew": skew, "detail": []} if skew
+                else None)
+    # the headline mismatch: seq numbers are per-key counters (no
+    # global ordering across streams), so the DEEPEST gap — tie-broken
+    # by the busiest stream — is the most diagnostic place to look
+    head = max(mismatches,
+               key=lambda m: (m["gap"], max(m["counts"].values())))
+    return {
+        "diverging_rank": head["diverging_ranks"][0],
+        "diverging_ranks": head["diverging_ranks"],
+        "axis": head["axis"], "op": head["op"],
+        "mismatched_seq": head["mismatched_seq"],
+        "detail": mismatches,
+        "possible_skew": skew,
+    }
+
+
+def _stragglers(dumps: List[dict]) -> List[dict]:
+    meds = {}
+    for d in dumps:
+        p50 = (d.get("progress") or {}).get("step_s_p50")
+        if p50:
+            meds[d["rank"]] = float(p50)
+    if len(meds) < 2:
+        return []
+    vals = sorted(meds.values())
+    n = len(vals)
+    # true median (mean of middles when even): with the upper-middle
+    # element a 2-host pod's slow rank would be its own reference and
+    # never flag
+    fleet_med = vals[n // 2] if n % 2 else \
+        (vals[n // 2 - 1] + vals[n // 2]) / 2.0
+    if fleet_med <= 0:
+        return []
+    return [{"rank": r, "step_s_p50": m,
+             "vs_fleet_median": round(m / fleet_med, 3)}
+            for r, m in sorted(meds.items())
+            if m > STRAGGLER_FACTOR * fleet_med]
+
+
+def _recompile_storm(dumps: List[dict]) -> Optional[dict]:
+    per_rank = {}
+    examples = []
+    for d in dumps:
+        # carried-over evidence events are APPENDED after the kept
+        # dump's ring — order by timestamp, not list position, or the
+        # "last shape deltas" would show the oldest diffs
+        evs = sorted((e for e in d.get("events", [])
+                      if e.get("k") == "recompile"),
+                     key=lambda e: e.get("t", 0))
+        if evs:
+            per_rank[str(d["rank"])] = len(evs)
+            examples.extend((e.get("t", 0), e.get("diff"))
+                            for e in evs[-2:])
+    total = sum(per_rank.values())
+    if total < RECOMPILE_STORM:
+        return None
+    # ... and the same ordering ACROSS ranks: a later-iterated rank's
+    # hours-old diffs must not displace the live storm's newest
+    examples.sort(key=lambda td: td[0])
+    return {"total": total, "per_rank": per_rank,
+            "last_diffs": [d for _, d in examples if d][-3:]}
+
+
+def _hangs(dumps: List[dict]) -> List[dict]:
+    out = []
+    for d in dumps:
+        for e in d.get("events", []):
+            if e.get("k") == "watchdog.stall":
+                # a carried-over stall (load_dumps evidence merge) has
+                # its mid-hang stacks in the SOURCE dump, not this one
+                out.append({"rank": d["rank"],
+                            "age_s": e.get("age_s"),
+                            "limit_s": e.get("limit_s"),
+                            "stacks_in_dump": e.get(
+                                "src_stacks", bool(d.get("stacks"))),
+                            "dump": e.get("src_dump",
+                                          d.get("_path"))})
+    return out
+
+
+def _goodput(dumps: List[dict]) -> Optional[dict]:
+    reps = [d.get("goodput") for d in dumps if d.get("goodput")]
+    reps = [r for r in reps if r.get("elapsed_seconds", 0) > 0]
+    if not reps:
+        return None
+    keys = set().union(*(r.keys() for r in reps))
+    return {k: round(sum(float(r.get(k, 0.0)) for r in reps)
+                     / len(reps), 6)
+            for k in sorted(keys)}
+
+
+def diagnose(dumps: List[dict]) -> dict:
+    """Merge per-host dumps into one diagnosis dict (pure function)."""
+    return {
+        "hosts": len(dumps),
+        "ranks": [d["rank"] for d in dumps],
+        "reasons": sorted({d.get("reason", "?") for d in dumps}),
+        "divergence": _divergence(dumps),
+        "stragglers": _stragglers(dumps),
+        "recompile_storm": _recompile_storm(dumps),
+        "hangs": _hangs(dumps),
+        "goodput": _goodput(dumps),
+    }
+
+
+def format_report(diag: dict) -> str:
+    """Operator-readable rendering of a diagnosis (the runbook output:
+    lead with the verdict, then the evidence)."""
+    lines = [f"tpu_doctor: {diag['hosts']} host dump(s), ranks "
+             f"{diag['ranks']}, reasons {diag['reasons']}"]
+    div = diag.get("divergence")
+    if div and div.get("diverging_rank") is not None:
+        ax = div["axis"] or "<eager>"
+        lines.append(
+            f"DIVERGENCE: rank {div['diverging_rank']} skipped "
+            f"collective(s) — last mismatched (axis={ax}, "
+            f"op={div['op']}, seq={div['mismatched_seq']}); lagging "
+            f"ranks {div['diverging_ranks']}")
+        for m in div["detail"]:
+            lines.append(f"  {m['op']}@{m['axis'] or '<eager>'}: "
+                         f"per-rank call counts {m['counts']}")
+    else:
+        lines.append("collective sequencing: consistent across ranks")
+    for s in (div or {}).get("possible_skew", []):
+        lines.append(
+            f"  (snapshot skew? {s['op']}@{s['axis'] or '<eager>'} "
+            f"counts {s['counts']} — lagging rank(s) were live at "
+            "dump time; re-dump a quiesced pod to confirm)")
+    for s in diag.get("stragglers", []):
+        lines.append(
+            f"STRAGGLER: rank {s['rank']} median step "
+            f"{s['step_s_p50'] * 1e3:.1f} ms = "
+            f"{s['vs_fleet_median']}x fleet median")
+    storm = diag.get("recompile_storm")
+    if storm:
+        lines.append(
+            f"RECOMPILE STORM: {storm['total']} retrace(s) "
+            f"{storm['per_rank']}; last shape deltas: "
+            f"{storm['last_diffs']}")
+    for h in diag.get("hangs", []):
+        lines.append(
+            f"HANG: rank {h['rank']} made no step progress for "
+            f"{h['age_s']}s (limit {h['limit_s']}s); per-thread "
+            f"stacks {'captured' if h['stacks_in_dump'] else 'MISSING'}"
+            " in its dump")
+    gp = diag.get("goodput")
+    if gp:
+        lines.append(
+            "goodput (fleet mean): "
+            f"productive={gp.get('productive_fraction', 0):.3f} "
+            f"compile={gp.get('compile_fraction', 0):.3f} "
+            f"checkpoint={gp.get('checkpoint_fraction', 0):.3f} "
+            f"dataloader={gp.get('dataloader_fraction', 0):.3f} "
+            f"stalled={gp.get('stalled_fraction', 0):.3f} "
+            f"other={gp.get('other_fraction', 0):.3f} "
+            f"over {gp.get('elapsed_seconds', 0):.1f}s")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dumps", nargs="*", help="flight-recorder JSONs")
+    ap.add_argument("--dir", default=None,
+                    help="scan DIR for flight_*.json")
+    ap.add_argument("--json", action="store_true",
+                    help="print the diagnosis dict instead of text")
+    args = ap.parse_args(argv)
+    paths = list(args.dumps)
+    if args.dir:
+        paths += sorted(glob.glob(os.path.join(args.dir,
+                                               "flight_*.json")))
+    if not paths:
+        print("tpu_doctor: no dumps given (pass files or --dir)",
+              file=sys.stderr)
+        return 2
+    diag = diagnose(load_dumps(paths))
+    if args.json:
+        print(json.dumps(diag))
+    else:
+        print(format_report(diag))
+    # exit status is the triage verdict: 1 = something is wrong
+    # (skew-only divergence — live snapshots one call apart — is not)
+    div = diag["divergence"]
+    bad = bool((div and div.get("diverging_rank") is not None)
+               or diag["stragglers"]
+               or diag["recompile_storm"] or diag["hangs"])
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
