@@ -414,9 +414,16 @@ def main():
     # persistent XLA compilation cache: TPU windows are scarce and a
     # cold ERNIE/ResNet compile costs 20-40 s each — cached executables
     # give that time back to sweeps on every rerun within (and across)
-    # windows. Opt out with JAX_COMPILATION_CACHE_DIR="".
+    # windows. One knob for every entry point (core.flags
+    # apply_compile_cache; hits countable via jax.compile_cache.*
+    # sentinel counters). Opt out with PD_COMPILE_CACHE_DIR="". A
+    # user's previous-generation JAX_COMPILATION_CACHE_DIR override
+    # (incl. ="" opt-out) seeds the default so the rename can't
+    # silently move or re-enable their cache.
+    legacy = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
+        "PD_COMPILE_CACHE_DIR",
+        legacy if legacy is not None else
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache"))
     # PD_BENCH_ONLY: comma list of SECONDARY legs to keep (resnet,
@@ -458,6 +465,8 @@ def main():
             # Mosaic RNG regression); "error: ..." = crashed/hung probe
             errors["kernel_dropout"] = verdict
     import jax
+    from paddle_tpu.core.flags import apply_compile_cache
+    apply_compile_cache()  # reads PD_COMPILE_CACHE_DIR set above
     try:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           2.0)

@@ -9,6 +9,8 @@ from .collective import (ReduceOp, Group, all_gather, all_reduce, alltoall,
                          all_to_all, barrier, broadcast, get_group,
                          new_group, p2p_shift, recv, reduce, reduce_scatter,
                          scatter, send, wait)  # noqa: F401
+from .comm import (CommConfig, GradSynchronizer,  # noqa: F401
+                   planned_all_reduce)
 from .env import (build_mesh, ensure_mesh, get_mesh, set_mesh, get_rank,
                   get_world_size, axis_context, current_axis_name,
                   DATA_AXIS, TENSOR_AXIS, PIPE_AXIS, SEQUENCE_AXIS,

@@ -99,7 +99,8 @@ def _payload_bytes(*tensors) -> int:
     return total
 
 
-def _record(op: str, axis: Optional[str], *tensors):
+def _record(op: str, axis: Optional[str], *tensors,
+            nbytes: Optional[int] = None):
     """Collective telemetry (EQuARX's premise: per-collective speedups
     must be measured, so every collective reports op count + payload
     bytes — and, one level deeper, per-collective SEQUENCING: the
@@ -114,10 +115,15 @@ def _record(op: str, axis: Optional[str], *tensors):
 
     Returns the exit hook to call after the collective body (records
     collective.exit with the same seq), or None when the recorder is
-    off — callers do ``done = _record(...); ...; done and done()``."""
+    off — callers do ``done = _record(...); ...; done and done()``.
+
+    `nbytes` overrides the payload walk for callers whose wire bytes
+    differ from the tensor bytes (comm.py's fused/quantized collectives
+    report COMPRESSED on-wire bytes, the receipt comm_bench pins)."""
     if not (_obs._enabled or _fr._enabled):
         return None
-    nbytes = _payload_bytes(*tensors)  # ONE tree walk for both planes
+    if nbytes is None:
+        nbytes = _payload_bytes(*tensors)  # ONE tree walk, both planes
     if _obs._enabled:
         _obs.counter("collective.calls", op=op).add(1)
         _obs.counter("collective.bytes", op=op).add(nbytes)
@@ -161,8 +167,20 @@ def _axis_for(group) -> Optional[str]:
         return None
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """c_allreduce_{sum,max,min,prod} (c_allreduce_op.h:111) → lax.p*."""
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               comm_config=None):
+    """c_allreduce_{sum,max,min,prod} (c_allreduce_op.h:111) → lax.p*.
+
+    `comm_config` (a distributed.comm.CommConfig) routes SUM through
+    the planned path: per-payload algorithm choice (flat / rs+ag /
+    hierarchical on factored meshes) and optional bf16/int8 wire
+    compression, with comm.* receipts. Default (None) keeps the exact
+    flat lowering unchanged; non-SUM reductions ignore the config
+    (the planner only decomposes sums)."""
+    if comm_config is not None and op == ReduceOp.SUM:
+        from .comm import planned_all_reduce
+        return planned_all_reduce(tensor, config=comm_config,
+                                  group=group)
     axis = _axis_for(group)
     done = _record("allreduce_" + op, axis, tensor)
     if axis is None:

@@ -73,6 +73,17 @@ class DistributedStrategy:
         self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
                             "sparsity": [0.999]}
         self.fp16_allreduce = False
+        # comm-optimized gradient sync (distributed.comm): planner +
+        # bucketing + quantized collectives as a fleet strategy. The
+        # f32 default is bit-for-bit against the unplanned path;
+        # compress picks the wire tier (f32|bf16|int8_ef), algorithm
+        # forces one (auto|flat|rs_ag|hierarchical), hierarchy names
+        # the factored mesh axes for the two-level schedule.
+        self.comm_opt = False
+        self.comm_opt_configs = {"algorithm": "auto", "bucket_mb": 4.0,
+                                 "compress": "f32",
+                                 "flat_threshold_kb": 128,
+                                 "hierarchy": None, "int8_block": 256}
         # PS consistency mode (AsyncConfig, distributed_strategy.proto:
         # 106): a_sync=True -> async communicator semantics; k_steps>0 ->
         # geo-SGD. Consumed by distributed.async_ps (AsyncEmbeddingKV /
@@ -118,7 +129,8 @@ class DistributedStrategy:
     def __repr__(self):
         on = [k for k in ("amp", "recompute", "sharding", "pipeline",
                           "tensor_parallel", "gradient_merge", "lamb",
-                          "lars", "localsgd", "dgc") if getattr(self, k)]
+                          "lars", "localsgd", "dgc", "comm_opt")
+              if getattr(self, k)]
         return f"DistributedStrategy(enabled={on})"
 
 

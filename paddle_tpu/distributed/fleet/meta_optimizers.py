@@ -30,7 +30,8 @@ import numpy as np
 
 __all__ = ["TrainStepSpec", "MetaOptimizerBase", "StrategyCompiler",
            "META_OPTIMIZERS", "LocalSGDStep", "make_dgc_transform",
-           "make_fp16_allreduce_transform", "chain_grad_transforms"]
+           "make_fp16_allreduce_transform", "make_comm_sync_transform",
+           "chain_grad_transforms"]
 
 
 @dataclasses.dataclass
@@ -150,6 +151,22 @@ def make_fp16_allreduce_transform(dtype=jnp.bfloat16):
             if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
         return grads, state
     return init, fn
+
+
+def make_comm_sync_transform(config=None, axes=None):
+    """Comm-optimized gradient sync as a grad transform (the
+    distributed.comm tentpole on the fleet surface): grads are fused
+    into size-targeted buckets and all-reduced with the planned
+    algorithm and wire tier; int8_ef error-feedback residuals ride the
+    strategy state like DGC's buffers (checkpointed with the step).
+    Under TrainStep's partitioner-sharded world the collective is the
+    identity (XLA already reduced the grads) but bucketing/quantization
+    and their comm.* receipts run for real — the convergence contract
+    is testable off-pod; in the explicit shard_map world the fused
+    collectives hit the wire."""
+    from ..comm import GradSynchronizer
+    sync = GradSynchronizer(config, axes=axes)
+    return sync.as_grad_transform()
 
 
 # ---------------------------------------------------------------------------
@@ -337,6 +354,36 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
         spec.applied.append(self.name)
 
 
+class CommOptimizer(MetaOptimizerBase):
+    """strategy.comm_opt -> distributed.comm planned/bucketed/quantized
+    gradient sync. Conflicts mirror its neighbors: DGC and
+    fp16_allreduce already own the grad-wire rewrite (stacking two
+    compressions would double-quantize), and LocalSGD's replica step
+    has no grad-transform slot."""
+    name = "comm_opt"
+    order = 74
+    conflicts = ("dgc", "fp16_allreduce", "localsgd")
+
+    def can_apply(self, strategy):
+        return getattr(strategy, "comm_opt", False)
+
+    def apply(self, spec, strategy, fleet=None):
+        from ..comm import CommConfig
+        cfg = getattr(strategy, "comm_opt_configs", None) or {}
+        hierarchy = cfg.get("hierarchy")
+        config = CommConfig(
+            algorithm=str(cfg.get("algorithm", "auto")),
+            bucket_bytes=int(float(cfg.get("bucket_mb", 4.0))
+                             * (1 << 20)),
+            compress=str(cfg.get("compress", "f32")),
+            flat_threshold=int(cfg.get("flat_threshold_kb", 128)) << 10,
+            hierarchy=tuple(hierarchy) if hierarchy else None,
+            int8_block=int(cfg.get("int8_block", 256)))
+        init, fn = make_comm_sync_transform(config)
+        spec.grad_transforms.append((self.name, init, fn))
+        spec.applied.append(self.name)
+
+
 class LocalSGDOptimizer(MetaOptimizerBase):
     name = "localsgd"
     order = 80
@@ -430,7 +477,8 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
 META_OPTIMIZERS: List[MetaOptimizerBase] = [
     RecomputeOptimizer(), AMPOptimizer(), ShardingOptimizer(),
     TensorParallelOptimizer(), PipelineOptimizer(),
-    GradientMergeOptimizer(), DGCOptimizer(), FP16AllReduceOptimizer(),
+    GradientMergeOptimizer(), DGCOptimizer(), CommOptimizer(),
+    FP16AllReduceOptimizer(),
     LocalSGDOptimizer(), AdaptiveLocalSGDOptimizer(), LambOptimizer(),
     LarsOptimizer(), GraphExecutionOptimizer(),
 ]

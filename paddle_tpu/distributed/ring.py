@@ -21,9 +21,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..framework import Tensor
+from ..observability import metrics as _obs
 from ..ops.registry import run_op
 from .env import SEQUENCE_AXIS, current_axis_name
 
@@ -47,7 +49,34 @@ def _record_sp(op: str, axis, q, k, v):
     return _record(op, axis, q, k, v)
 
 
-def _ring_attn_impl(q, k, v, axis, causal, scale):
+def _record_ring_wire(axis, k, v, wire_dtype):
+    """comm.* receipts for the ring's KV rotation: one enter/exit pair
+    per TRACE (the scan body's two ppermutes replay per hop for free —
+    same trace-time convention as every collective), wire bytes = one
+    hop's compressed K+V payload. Gate first, imports module-level —
+    the disabled path on a collective dispatch must stay one bool
+    read."""
+    if not _obs._enabled:
+        return
+
+    def _unwrap(t):
+        return t._data if isinstance(t, Tensor) else t
+
+    def _n(t):
+        return int(np.prod(np.shape(_unwrap(t)), dtype=np.int64))
+    if wire_dtype is None:
+        # no compression tier: KV cross the ring in their OWN dtype
+        # (a bf16/AMP model already moves 2-byte elements — reporting
+        # f32 would inflate the receipt 2x)
+        wire_dtype = jnp.dtype(getattr(_unwrap(k), "dtype",
+                                       jnp.float32))
+    nbytes = int((_n(k) + _n(v)) * jnp.dtype(wire_dtype).itemsize)
+    compress = "bf16" if wire_dtype == jnp.bfloat16 else "f32"
+    _obs.counter("comm.algo", algo="ring", compress=compress).add(1)
+    _obs.counter("comm.wire_bytes").add(nbytes)
+
+
+def _ring_attn_impl(q, k, v, axis, causal, scale, wire_dtype=None):
     """q,k,v local shards [b, n, s_local, d]; seq dim sharded over `axis`.
 
     Each ring hop streams the currently-held remote KV shard through the
@@ -69,13 +98,24 @@ def _ring_attn_impl(q, k, v, axis, causal, scale):
     q32 = q.astype(jnp.float32) * scale
     pos_q = my * s_loc + jnp.arange(s_loc)
     blk = _ring_block_size(s_loc)
+    # comm-optimized KV rotation (CommConfig(compress="bf16")): the
+    # ring's per-hop ICI payload — 2 tensors x (n-1) hops — is the
+    # dominant wire cost of context parallelism; carrying KV in bf16
+    # halves it. The carry itself holds the wire dtype so every hop
+    # moves compressed bytes; blockwise softmax math stays f32
+    # (_flash_carry_update upcasts its inputs).
+    if wire_dtype is not None:
+        k = k.astype(wire_dtype)
+        v = v.astype(wire_dtype)
 
     def step(carry, i):
         acc, m, l, kv_k, kv_v = carry
         # KV block currently held arrived from rank (my - i) mod n
         src = (my - i) % n_dev
+        kk, vv = ((kv_k, kv_v) if wire_dtype is None else
+                  (kv_k.astype(q32.dtype), kv_v.astype(q32.dtype)))
         acc, m, l = _flash_carry_update(
-            q32, kv_k, kv_v, (acc, m, l), blk, pos_q, src * s_loc,
+            q32, kk, vv, (acc, m, l), blk, pos_q, src * s_loc,
             s_loc, causal)
         # rotate KV around the ring (send to next rank)
         perm = [(r, (r + 1) % n_dev) for r in range(n_dev)]
@@ -90,25 +130,39 @@ def _ring_attn_impl(q, k, v, axis, causal, scale):
 
 
 def ring_flash_attention(query, key, value, causal=False, group=None,
-                         name=None):
+                         name=None, comm_config=None):
     """Context-parallel attention. Layout [batch, seq_local, heads, dim];
     the sequence dim is the local shard of a global sequence distributed
     over the 'sp' mesh axis. Must run inside shard_map over that axis
-    (paddle_tpu.distributed.sp_shard_map sets this up)."""
+    (paddle_tpu.distributed.sp_shard_map sets this up).
+
+    comm_config (distributed.comm.CommConfig): compress="bf16" rotates
+    the KV shards around the ring in bfloat16 — half the per-hop ICI
+    bytes, softmax math still f32. int8_ef is a *reduction* codec
+    (error feedback needs a sum to hide in) and is rejected here."""
     axis = group if isinstance(group, str) else (
         group.axis if group is not None else
         current_axis_name(SEQUENCE_AXIS))
+    wire_dtype = None
+    if comm_config is not None and comm_config.compress != "f32":
+        if comm_config.compress != "bf16":
+            raise ValueError(
+                f"ring KV rotation supports compress='bf16' (or the "
+                f"'f32' default), got {comm_config.compress!r}")
+        wire_dtype = jnp.bfloat16
     if axis is None:
         from ..nn.functional.attention import flash_attention
         return flash_attention(query, key, value, causal=causal)
     done = _record_sp("ring_attention", axis, query, key, value)
+    _record_ring_wire(axis, key, value, wire_dtype)
 
     def impl(q, k, v):
         qh = jnp.einsum("bsnh->bnsh", q)
         kh = jnp.einsum("bsnh->bnsh", k)
         vh = jnp.einsum("bsnh->bnsh", v)
         scale = 1.0 / math.sqrt(q.shape[-1])
-        out = _ring_attn_impl(qh, kh, vh, axis, causal, scale)
+        out = _ring_attn_impl(qh, kh, vh, axis, causal, scale,
+                              wire_dtype=wire_dtype)
         return jnp.einsum("bnsh->bsnh", out)
     out = run_op("ring_flash_attention", impl, (query, key, value), {})
     done and done()
@@ -155,12 +209,14 @@ def ulysses_attention(query, key, value, causal=False, group=None,
 class RingAttention:
     """Strategy handle selecting ring vs ulysses (config object parity)."""
 
-    def __init__(self, mode="ring", group=None):
+    def __init__(self, mode="ring", group=None, comm_config=None):
         assert mode in ("ring", "ulysses")
         self.mode = mode
         self.group = group
+        self.comm_config = comm_config
 
     def __call__(self, q, k, v, causal=False):
         if self.mode == "ring":
-            return ring_flash_attention(q, k, v, causal, self.group)
+            return ring_flash_attention(q, k, v, causal, self.group,
+                                        comm_config=self.comm_config)
         return ulysses_attention(q, k, v, causal, self.group)

@@ -164,10 +164,22 @@ _COMPILE_EVENT_PREFIX = "/jax/core/compile"
 # one executable == one backend compile; the jaxpr-trace and
 # to-mlir-module phases are parts of the same compile, counted once
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# persistent-cache bookkeeping (jax _src/compiler.py): excluded from
+# the compile odometer above, but counted on their OWN meters — the
+# hit ratio is the receipt that PD_COMPILE_CACHE_DIR actually pays
+_CACHE_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 
 def _is_compile_event(event: str) -> bool:
     return event.startswith(_COMPILE_EVENT_PREFIX)
+
+
+def _record_cache_event(event: str):
+    if event == _CACHE_REQUEST_EVENT:
+        metrics.counter("jax.compile_cache.requests", _always=True).add(1)
+    elif event == _CACHE_HIT_EVENT:
+        metrics.counter("jax.compile_cache.hits", _always=True).add(1)
 
 
 def _record_compile_duration(event: str, duration: float):
@@ -195,6 +207,7 @@ def attach_jax_compile_hook():
 
         def _listener(event: str, **kw):
             if not _is_compile_event(event):
+                _record_cache_event(event)
                 return
             metrics.counter("jax.compiles_total", _always=True).add(1)
             # some runtimes ride the duration on the event kwargs

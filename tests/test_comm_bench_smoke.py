@@ -1,0 +1,59 @@
+"""Tier-1 comm-bench smoke: guards the ISSUE-5 acceptance receipts
+against regression —
+  - bucketing keeps the fused collective count at <= 1/4 of the
+    per-tensor count at ERNIE-tiny scale (the >=4x reduction),
+  - bf16 wire bytes stay <= 0.55x the f32 baseline,
+  - the f32 default remains bit-for-bit against the pre-PR sync,
+  - the flight recorder sees enter/exit per FUSED collective.
+
+Runs tools/comm_bench.py (single-process leg; the 2-process gloo leg
+stays out of tier-1 — tests/test_comm_hier_dist.py covers cross-process
+collectives) in a subprocess, mirroring test_pipeline_bench_smoke.py.
+Budget: <15 s (ROADMAP tier-1 rebalance policy)."""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+# the parent test process pins an 8-device virtual mesh; the bench
+# subprocess picks its own backend
+_ENV.pop("XLA_FLAGS", None)
+_ENV.pop("PD_COMM_BENCH_DIST", None)
+
+
+def test_comm_bench_receipts(tmp_path):
+    jsonl = str(tmp_path / "comm_bench.jsonl")
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "comm_bench.py")],
+        capture_output=True, text=True, timeout=240,
+        env={**_ENV, "PD_OBS_JSONL": jsonl}, cwd=ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+
+    # the printed report and the JSONL series come from ONE code path
+    rec = json.loads(open(jsonl).read().splitlines()[-1])
+    exported = {k[len("bench.comm."):]: v["value"] if isinstance(
+        v, dict) and "value" in v else v
+        for k, v in rec["metrics"].items()
+        if k.startswith("bench.comm.")}
+    assert exported == stats, (
+        "JSONL export diverged from the printed bench report")
+
+    # fused-bucket count: >= 4x fewer collectives than per-tensor
+    assert stats["per_tensor_collectives"] == stats["n_grad_tensors"]
+    assert stats["fused_collectives"] >= 1
+    assert stats["collective_count_ratio"] <= 0.25, stats
+
+    # wire-bytes receipts: the counters ARE the accounting
+    assert stats["wire_bytes_f32"] == stats["per_tensor_wire_bytes"]
+    assert stats["wire_ratio_bf16"] <= 0.55, stats
+    assert stats["wire_ratio_int8_ef"] <= 0.30, stats
+
+    # exactness + flight-recorder convention (per fused collective,
+    # not per tensor)
+    assert stats["f32_bit_exact"] is True
+    assert stats["fr_enter_events"] == stats["fused_collectives"]
