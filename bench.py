@@ -116,6 +116,32 @@ def bench_ernie(on_tpu):
     dt = time.perf_counter() - t0
     tokens_per_sec = batch * seqlen * steps / dt
 
+    # step anatomy AFTER the timed loop: the per-scope FLOPs share
+    # table of the ONE executable just measured, printed next to the
+    # goodput breakdown via the same emit_report path. NB this pays a
+    # full SECOND compile of the step every run — train_step_anatomy
+    # deliberately bypasses the persistent compile cache (a cache hit
+    # can return a metadata-stripped ancestor whose HLO names no
+    # scopes) — but it runs outside the throughput window, so only
+    # bench wall time is spent. PD_BENCH_ANATOMY=0 opts out of that
+    # cost on compile-heavy sweeps.
+    anatomy_stats = None
+    if os.environ.get("PD_BENCH_ANATOMY", "1") != "0":
+        try:
+            from paddle_tpu.observability import anatomy as _anatomy
+            res = _anatomy.train_step_anatomy(step, (x,), (y,),
+                                              publish_gauges=True)
+            anatomy_stats = {
+                "scope_shares": {k: round(v["share"], 4)
+                                 for k, v in res["scopes"].items()},
+                "unattributed_share": round(
+                    res["unattributed_share"], 4),
+                "hlo_model_flops": res["total_flops"],
+                "cost_analysis_flops": res["cost_analysis_flops"],
+            }
+        except Exception as e:  # pragma: no cover — bench must survive
+            anatomy_stats = {"error": f"{type(e).__name__}: {e}"}
+
     # MFU from first principles. Train FLOPs/token ~= 6*N + 12*L*h*s
     # (fwd 2N + attention 4*L*h*s for scores+values; x3 for fwd+bwd).
     n_params = _param_count(step.params)
@@ -124,7 +150,7 @@ def bench_ernie(on_tpu):
     import jax
     peak = _chip_peak_flops(jax.devices()[0])
     mfu = tokens_per_sec * flops_per_token / peak
-    return tokens_per_sec, mfu, n_params, flops_per_token
+    return tokens_per_sec, mfu, n_params, flops_per_token, anatomy_stats
 
 
 def bench_resnet(on_tpu):
@@ -495,8 +521,10 @@ def main():
     except Exception as e:  # pragma: no cover — bench must survive
         _fr = _goodput = None
         errors["goodput_arm"] = f"{type(e).__name__}: {e}"
+    anatomy_stats = None
     try:
-        tokens_per_sec, mfu, n_params, fpt = bench_ernie(on_tpu)
+        (tokens_per_sec, mfu, n_params, fpt,
+         anatomy_stats) = bench_ernie(on_tpu)
     except Exception as e:  # pragma: no cover - JSON line must survive
         tokens_per_sec = mfu = fpt = -1.0
         n_params = -1
@@ -613,6 +641,7 @@ def main():
             "decode_dtype": decode_dtype,
             "attention_path": attn_path,
             **({"goodput": goodput_stats} if goodput_stats else {}),
+            **({"anatomy": anatomy_stats} if anatomy_stats else {}),
             **({"serving": serving_stats} if serving_stats else {}),
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),

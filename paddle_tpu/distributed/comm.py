@@ -51,6 +51,7 @@ from jax import lax
 
 from ..framework import Tensor
 from ..observability import metrics as _obs
+from ..observability.anatomy import scope as _scope
 from ..ops.registry import run_op
 from .collective import Group, _mirror_into, _record
 from .env import DATA_AXIS, current_axis_name
@@ -484,10 +485,15 @@ def planned_all_reduce(tensor, config: Optional[CommConfig] = None,
     done = _record_fused(algo, compress, live, wire)
 
     def impl(a):
-        flat = jnp.reshape(a, (-1,))
-        out, _ = _allreduce_flat(flat, live, algo, compress,
-                                 None, config.int8_block)
-        return jnp.reshape(out, a.shape)
+        # "grad_sync" anatomy scope: the collective lowers with the
+        # scope in its HLO metadata, so xprof's device tier can split
+        # fused-sync kernels out of generic comm and the overlap
+        # receipt names THIS path, not collectives at large
+        with _scope("grad_sync"):
+            flat = jnp.reshape(a, (-1,))
+            out, _ = _allreduce_flat(flat, live, algo, compress,
+                                     None, config.int8_block)
+            return jnp.reshape(out, a.shape)
 
     out = run_op("comm_allreduce_" + algo, impl, (tensor,), {})
     done and done()
@@ -554,7 +560,6 @@ class GradSynchronizer:
             _obs.counter("comm.fused_buckets").add(len(specs))
         out = dict(grads)
         for spec in specs:
-            flat = flatten_bucket(grads, spec)
             compress = cfg.compress if jnp.issubdtype(
                 spec.dtype, jnp.floating) else "f32"
             algo = choose_algorithm(spec.nbytes, live,
@@ -573,12 +578,18 @@ class GradSynchronizer:
                 # rebuild) starts from zero — error feedback must
                 # never be silently dropped, only reset
                 res = jnp.zeros((spec.num_elements,), jnp.float32)
-            reduced, new_res = _allreduce_flat(
-                flat, live, algo, compress, res, cfg.int8_block)
+            # "grad_sync" anatomy scope: flatten + collective +
+            # unflatten attribute to the comm plane in the fused step's
+            # HLO (the overlap receipt's denominator)
+            with _scope("grad_sync"):
+                flat = flatten_bucket(grads, spec)
+                reduced, new_res = _allreduce_flat(
+                    flat, live, algo, compress, res, cfg.int8_block)
+                unflat = unflatten_bucket(reduced, spec)
             done and done()
             if new_res is not None:
                 state[rkey] = new_res
-            out.update(unflatten_bucket(reduced, spec))
+            out.update(unflat)
         # purge residuals of vanished bucket layouts so state can't
         # grow without bound across structure changes
         valid = {s.residual_key for s in specs}

@@ -47,6 +47,7 @@ from ..jit.api import _unwrap_tree, _wrap_tree, functionalize
 from ..nn.layer.layers import Layer
 from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
+from ..observability.anatomy import scope as _scope
 from ..observability.sentinel import RecompileSentinel, signature_of
 
 __all__ = ["PipelineParallel", "build_1f1b_schedule", "stage_submeshes"]
@@ -562,13 +563,16 @@ class PipelineParallel:
         # all fuse — no host bool decides whether to dispatch (the
         # reference SectionWorker's optimize ops, amp ops included)
         def update(params, grads, opt_state, lr, scale, found_inf):
-            grads = jax.tree_util.tree_map(
-                lambda g: g / (M * scale), grads)
-            new_p, new_st = optimizer.apply_gradients_tree(
-                params, grads, opt_state, lr=lr)
-            keep = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(found_inf, o, n), new, old)
-            return keep(new_p, params), keep(new_st, opt_state)
+            with _scope("loss_scale"):
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / (M * scale), grads)
+            with _scope("optimizer"):
+                new_p, new_st = optimizer.apply_gradients_tree(
+                    params, grads, opt_state, lr=lr)
+            with _scope("loss_scale"):
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(found_inf, o, n), new, old)
+                return keep(new_p, params), keep(new_st, opt_state)
         # only grads donate: params/opt_state feed the found_inf
         # where-select, so both old and new values are live at once
         self._opt_jit = jax.jit(update, donate_argnums=(1,))
@@ -859,8 +863,11 @@ class PipelineParallel:
 
                 gx_b, gacc = lax.cond(ba == 1, do_b,
                                       lambda g: (zeros_act, g), gacc)
-                act_in = lax.ppermute(y_f, axis, perm_fwd)
-                dy_in = lax.ppermute(gx_b, axis, perm_bwd)
+                # "pp_ring" anatomy scope: the inter-stage activation/
+                # grad transfers — xprof splits ring time from compute
+                with _scope("pp_ring"):
+                    act_in = lax.ppermute(y_f, axis, perm_fwd)
+                    dy_in = lax.ppermute(gx_b, axis, perm_bwd)
                 return (act_in, dy_in, actbuf, dybuf, gacc,
                         losses), None
 
@@ -892,20 +899,26 @@ class PipelineParallel:
             losses, grads = smapped(stacked, key, scale, x, labels)
             loss = jnp.mean(losses)
             if use_scaler:
-                leaves = [jnp.all(jnp.isfinite(g))
-                          for g in jax.tree_util.tree_leaves(grads)]
-                found_inf = ~jnp.stack(leaves).all()
+                with _scope("loss_scale"):
+                    leaves = [jnp.all(jnp.isfinite(g))
+                              for g in jax.tree_util.tree_leaves(grads)]
+                    found_inf = ~jnp.stack(leaves).all()
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g / (M * scale), grads)
             else:
                 found_inf = jnp.asarray(False)
-            grads = jax.tree_util.tree_map(
-                lambda g: g / (M * scale), grads)
-            new_p, new_st = opt.apply_gradients_tree(
-                stacked, grads, opt_state, lr=lr)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / (M * scale), grads)
+            with _scope("optimizer"):
+                new_p, new_st = opt.apply_gradients_tree(
+                    stacked, grads, opt_state, lr=lr)
             if use_scaler:
-                keep = lambda new, old: jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(found_inf, o, n), new, old)
-                new_p = keep(new_p, stacked)
-                new_st = keep(new_st, opt_state)
+                with _scope("loss_scale"):
+                    keep = lambda new, old: jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(found_inf, o, n),
+                        new, old)
+                    new_p = keep(new_p, stacked)
+                    new_st = keep(new_st, opt_state)
             return new_p, new_st, loss, found_inf
 
         return jax.jit(step, donate_argnums=(0, 1))
@@ -921,16 +934,15 @@ class PipelineParallel:
         return sum(int(f._cache_size())
                    for f in self._spmd_steps.values())
 
-    def train_flops_per_step(self, inputs, labels=(),
-                             scaler=None) -> float:
-        """FLOPs of the ONE-program train step from XLA's own
-        cost_analysis of the lowered executable (spmd_1f1b only) — the
-        MFU numerator (observability.mfu). AOT lowering is separate
-        from the jit call cache, so this never trips the recompile
-        sentinel."""
+    def aot_lower_train(self, inputs, labels=(), scaler=None):
+        """AOT-lower the ONE-program train step (spmd_1f1b only) —
+        separate from the jit call cache, so observation (MFU FLOPs,
+        anatomy scope shares) never trips the recompile sentinel."""
         if self.exec_mode != "spmd_1f1b":
-            return -1.0
-        from ..observability.mfu import flops_of_compiled
+            raise ValueError(
+                "aot_lower_train needs exec_mode='spmd_1f1b' (the "
+                "dispatch engine compiles per-stage programs, not one "
+                "train executable)")
         use_scaler = scaler is not None and scaler.is_enable()
         inputs = inputs if isinstance(inputs, (list, tuple)) \
             else (inputs,)
@@ -945,11 +957,21 @@ class PipelineParallel:
         # constant key, NOT next_key(): lowering only needs the aval,
         # and observation must not advance the training RNG stream
         # (bit-for-bit parity discipline)
-        lowered = step.lower(
+        return step.lower(
             self.params, self.opt_state, jax.random.key(0),
             jnp.asarray(0.0, jnp.float32),
             jnp.asarray(1.0, jnp.float32), x, lbl)
-        return flops_of_compiled(lowered.compile())
+
+    def train_flops_per_step(self, inputs, labels=(),
+                             scaler=None) -> float:
+        """FLOPs of the ONE-program train step from XLA's own
+        cost_analysis of the lowered executable (spmd_1f1b only) — the
+        MFU numerator (observability.mfu)."""
+        if self.exec_mode != "spmd_1f1b":
+            return -1.0
+        from ..observability.mfu import flops_of_compiled
+        return flops_of_compiled(
+            self.aot_lower_train(inputs, labels, scaler).compile())
 
     def _spmd_micro(self, tree, broadcast_scalars: bool = False):
         """[batch, ...] leaves -> [num_micro, batch//num_micro, ...].

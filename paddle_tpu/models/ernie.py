@@ -24,6 +24,7 @@ from .. import nn
 from ..nn import functional as F
 from ..distributed.env import TENSOR_AXIS
 from ..framework import Parameter, Tensor
+from ..observability.anatomy import scope as _scope
 from ..ops import creation, manipulation
 
 __all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining",
@@ -200,6 +201,13 @@ class ErnieSelfAttention(nn.Layer):
         self.out.weight.sharding_spec = P(TENSOR_AXIS, None)
 
     def forward(self, x, attn_mask=None, kv_lens=None):
+        # anatomy scope: everything here (qkv/proj matmuls, the
+        # attention math) attributes to "attn" in the one-executable
+        # HLO — backward included (transpose(jvp(attn)) paths)
+        with _scope("attn"):
+            return self._forward(x, attn_mask, kv_lens)
+
+    def _forward(self, x, attn_mask=None, kv_lens=None):
         b, s, h = x.shape
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q = qkv[:, :, 0]
@@ -263,12 +271,14 @@ class ErnieLayer(nn.Layer):
 
     def forward(self, x, attn_mask=None, kv_lens=None):
         attn = self.attention(x, attn_mask, kv_lens=kv_lens)
-        x = self.attn_norm(x + self.dropout(attn))
-        if self.use_moe:
-            ffn = self.moe(x)
-        else:
-            ffn = self.ffn_out(getattr(F, self.act)(self.ffn_in(x)))
-        x = self.ffn_norm(x + self.dropout(ffn))
+        with _scope("attn"):
+            x = self.attn_norm(x + self.dropout(attn))
+        with _scope("mlp"):
+            if self.use_moe:
+                ffn = self.moe(x)
+            else:
+                ffn = self.ffn_out(getattr(F, self.act)(self.ffn_in(x)))
+            x = self.ffn_norm(x + self.dropout(ffn))
         return x
 
 
@@ -311,17 +321,18 @@ class ErnieEmbeddings(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None):
-        b, s = input_ids.shape
-        if position_ids is None:
-            position_ids = creation.arange(s, dtype="int32")
-            position_ids = manipulation.expand(
-                manipulation.unsqueeze(position_ids, 0), [b, s])
-        if token_type_ids is None:
-            token_type_ids = creation.zeros([b, s], dtype="int32")
-        emb = (self.word_embeddings(input_ids)
-               + self.position_embeddings(position_ids)
-               + self.token_type_embeddings(token_type_ids))
-        return self.dropout(self.layer_norm(emb))
+        with _scope("embed"):
+            b, s = input_ids.shape
+            if position_ids is None:
+                position_ids = creation.arange(s, dtype="int32")
+                position_ids = manipulation.expand(
+                    manipulation.unsqueeze(position_ids, 0), [b, s])
+            if token_type_ids is None:
+                token_type_ids = creation.zeros([b, s], dtype="int32")
+            emb = (self.word_embeddings(input_ids)
+                   + self.position_embeddings(position_ids)
+                   + self.token_type_embeddings(token_type_ids))
+            return self.dropout(self.layer_norm(emb))
 
 
 class ErnieModel(nn.Layer):
@@ -407,30 +418,32 @@ class ErnieForPretraining(nn.Layer):
                 attention_mask=None, seq_lens=None):
         seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                  attention_mask, seq_lens=seq_lens)
-        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
-        if self.config.chunked_ce:
-            # the head matmul moves INTO the loss
-            # (chunked_pretraining_loss streams it through vocab
-            # blocks); logits are never built
-            return h, self.nsp(pooled)
-        # weight-tied decoder: logits = h @ E^T  (vocab-sharded matmul).
-        # Done in 2D [b*s, hidden] — a 3D dot here gives the [b, s, V]
-        # logits a batch-major layout that XLA then has to transpose-copy
-        # (a multi-GB move at vocab scale); the flat matmul keeps the
-        # natural row-major layout and reshape back is a free bitcast.
-        b, s = h.shape[0], h.shape[1]
-        w = self.ernie.embeddings.word_embeddings.weight
-        h2 = h.reshape([-1, h.shape[-1]])
-        lg = F.linear(h2, manipulation.t(w))
-        # bias in the LOGITS dtype: under AMP O1 the f32 bias param would
-        # promote the whole [b*s, vocab] tensor to f32 — the exact
-        # multi-GB head buffer the fused-CE rework removed
-        # (tests/test_head_hlo_receipt.py guards this)
-        bias = self.mlm_bias if self.mlm_bias.dtype == lg.dtype \
-            else self.mlm_bias.astype(lg.dtype)
-        logits = (lg + bias).reshape([b, s, -1])
-        nsp_logits = self.nsp(pooled)
-        return logits, nsp_logits
+        with _scope("mlm_head_ce"):
+            h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+            if self.config.chunked_ce:
+                # the head matmul moves INTO the loss
+                # (chunked_pretraining_loss streams it through vocab
+                # blocks); logits are never built
+                return h, self.nsp(pooled)
+            # weight-tied decoder: logits = h @ E^T  (vocab-sharded
+            # matmul). Done in 2D [b*s, hidden] — a 3D dot here gives
+            # the [b, s, V] logits a batch-major layout that XLA then
+            # has to transpose-copy (a multi-GB move at vocab scale);
+            # the flat matmul keeps the natural row-major layout and
+            # reshape back is a free bitcast.
+            b, s = h.shape[0], h.shape[1]
+            w = self.ernie.embeddings.word_embeddings.weight
+            h2 = h.reshape([-1, h.shape[-1]])
+            lg = F.linear(h2, manipulation.t(w))
+            # bias in the LOGITS dtype: under AMP O1 the f32 bias param
+            # would promote the whole [b*s, vocab] tensor to f32 — the
+            # exact multi-GB head buffer the fused-CE rework removed
+            # (tests/test_head_hlo_receipt.py guards this)
+            bias = self.mlm_bias if self.mlm_bias.dtype == lg.dtype \
+                else self.mlm_bias.astype(lg.dtype)
+            logits = (lg + bias).reshape([b, s, -1])
+            nsp_logits = self.nsp(pooled)
+            return logits, nsp_logits
 
     def chunked_pretraining_loss(self, outputs, mlm_labels,
                                  nsp_labels=None, ignore_index=-100):
@@ -442,29 +455,35 @@ class ErnieForPretraining(nn.Layer):
         — the tied weights are read inside the traced step, so their
         grads flow exactly like the dense path's."""
         h, nsp_logits = outputs
-        w_t = manipulation.t(self.ernie.embeddings.word_embeddings.weight)
-        mlm = F.linear_cross_entropy(
-            h.reshape([-1, h.shape[-1]]), w_t, self.mlm_bias,
-            mlm_labels.reshape([-1]),
-            vocab_block=min(self.config.ce_vocab_block,
-                            self.config.vocab_size),
-            ignore_index=ignore_index)
-        if nsp_labels is None:
-            return mlm
-        nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
-        return mlm + nsp
+        with _scope("mlm_head_ce"):
+            w_t = manipulation.t(
+                self.ernie.embeddings.word_embeddings.weight)
+            mlm = F.linear_cross_entropy(
+                h.reshape([-1, h.shape[-1]]), w_t, self.mlm_bias,
+                mlm_labels.reshape([-1]),
+                vocab_block=min(self.config.ce_vocab_block,
+                                self.config.vocab_size),
+                ignore_index=ignore_index)
+            if nsp_labels is None:
+                return mlm
+            nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
+            return mlm + nsp
 
     @staticmethod
     def pretraining_loss(outputs, mlm_labels, nsp_labels=None,
                          ignore_index=-100):
+        # CE belongs to the head's scope: the fused softmax-CE over the
+        # [b*s, vocab] logits IS the "+ce" half of mlm_head_ce (the
+        # ~20%-of-FLOPs row the anatomy receipt pins)
         logits, nsp_logits = outputs
-        mlm = F.cross_entropy(
-            logits.reshape([-1, logits.shape[-1]]),
-            mlm_labels.reshape([-1]), ignore_index=ignore_index)
-        if nsp_labels is None:
-            return mlm
-        nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
-        return mlm + nsp
+        with _scope("mlm_head_ce"):
+            mlm = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                mlm_labels.reshape([-1]), ignore_index=ignore_index)
+            if nsp_labels is None:
+                return mlm
+            nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
+            return mlm + nsp
 
 
 class ErnieForSequenceClassification(nn.Layer):
@@ -595,14 +614,16 @@ class ErnieStageLast(nn.Layer):
 
     def forward(self, x, attention_mask=None):
         x = _run_blocks(self.blocks, x, attention_mask)
-        pooled = F.tanh(self.pooler(x[:, 0]))
-        h = self.mlm_norm(F.gelu(self.mlm_transform(x)))
-        # 2D decoder matmul for the same layout reason as
-        # ErnieForPretraining.forward (vocab-sized logits stay row-major)
-        b0, s0 = h.shape[0], h.shape[1]
-        logits = self.decoder(h.reshape([-1, h.shape[-1]])).reshape(
-            [b0, s0, -1])
-        return logits, self.nsp(pooled)
+        with _scope("mlm_head_ce"):
+            pooled = F.tanh(self.pooler(x[:, 0]))
+            h = self.mlm_norm(F.gelu(self.mlm_transform(x)))
+            # 2D decoder matmul for the same layout reason as
+            # ErnieForPretraining.forward (vocab-sized logits stay
+            # row-major)
+            b0, s0 = h.shape[0], h.shape[1]
+            logits = self.decoder(h.reshape([-1, h.shape[-1]])).reshape(
+                [b0, s0, -1])
+            return logits, self.nsp(pooled)
 
     def pipeline_local_loss(self):
         return _stage_moe_aux(self.blocks)

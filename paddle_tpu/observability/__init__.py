@@ -28,6 +28,14 @@ TPU-pod training job needs on top of raw counters:
   goodput          wall-clock decomposition into productive / compile /
                    checkpoint / dataloader-wait / stalled fractions,
                    published as goodput.* gauges
+  anatomy          step anatomy: scope("attn") annotations that survive
+                   lowering into HLO op metadata, plus the static
+                   attribution tier (per-scope FLOPs share table from
+                   the one train executable's HLO)
+  xprof            the measured tier: XPlane/trace.json parser mapping
+                   device kernels back to scopes — per-scope device ms,
+                   idle time, and the comm-overlap receipt
+                   (comm.overlap_fraction)
 
 Everything is off by default: `metrics.enable()` turns the counter hot
 paths on, `flight_recorder.enable()` arms the forensics plane (events +
@@ -37,13 +45,16 @@ rank. See DESIGN.md "Observability" for the naming scheme and how this
 maps to the reference's monitor.h / timeline.py machinery.
 """
 from . import metrics  # noqa: F401
+from . import anatomy  # noqa: F401
 from . import exporters  # noqa: F401
+from . import xprof  # noqa: F401
 from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import mfu  # noqa: F401
 from . import sentinel  # noqa: F401
 from . import watchdog  # noqa: F401
+from .anatomy import scope  # noqa: F401
 from .metrics import (counter, gauge, histogram, enable, disable,  # noqa: F401
                       enabled, enabled_scope, snapshot, reset)
 from .mfu import ThroughputMeter, chip_peak_flops, step_flops  # noqa: F401
@@ -52,9 +63,9 @@ from .watchdog import HangWatchdog  # noqa: F401
 
 __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
-    "flight_recorder", "watchdog", "goodput",
+    "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
-    "enabled_scope", "snapshot", "reset",
+    "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",
     "RecompileSentinel", "signature_of", "HangWatchdog",
 ]

@@ -15,11 +15,14 @@ it publishes ``throughput.examples_per_sec``, ``throughput.mfu`` and
 """
 from __future__ import annotations
 
+import logging
 import os
 import time
-from typing import Optional
+from typing import Optional, Set
 
 from . import metrics
+
+logger = logging.getLogger("paddle_tpu.observability")
 
 __all__ = ["chip_peak_flops", "flops_of_compiled", "step_flops",
            "ThroughputMeter", "PEAK_FLOPS_BY_KIND"]
@@ -38,13 +41,27 @@ PEAK_FLOPS_BY_KIND = {
 # from TPU runs (or PD_PEAK_FLOPS pinning the truth for other chips).
 _CPU_CORE_PEAK = 5e10
 
+# the v4-class default assumed for accelerators the spec table can't
+# name — every use is LOUD (warn-once + always-on counter below): an
+# MFU built on a guessed denominator is off by up to 3.3x across the
+# table, and a silent guess skews hardware receipts undetectably
+_UNKNOWN_CHIP_GUESS = 275e12
+_warned_kinds: Set[str] = set()
+
 
 def chip_peak_flops(device=None, fallback: Optional[float] = None) -> float:
     """Peak FLOP/s for one device: PD_PEAK_FLOPS > spec table >
     `fallback` when given (bench.py pins 275e12 so CPU BENCH artifacts
     stay comparable across rounds) > CPU core estimate > v4-class
     default for unidentifiable accelerators. The ONE lookup both the
-    MFU reporter and bench.py use."""
+    MFU reporter and bench.py use.
+
+    The unidentifiable-accelerator guess is never silent: it bumps the
+    always-on ``mfu.peak_flops_guess_total`` counter (rides every
+    exporter whether or not the metrics gate is up) and logs one
+    warning per unknown device_kind, naming the kind and the override
+    knob — a skewed MFU receipt must be traceable to its denominator.
+    """
     env = os.environ.get("PD_PEAK_FLOPS")
     if env:
         return float(env)
@@ -59,7 +76,16 @@ def chip_peak_flops(device=None, fallback: Optional[float] = None) -> float:
         return fallback
     if getattr(device, "platform", "") == "cpu":
         return _CPU_CORE_PEAK * (os.cpu_count() or 1)
-    return 275e12  # assume v4-class when unidentifiable
+    metrics.counter("mfu.peak_flops_guess_total", _always=True).add(1)
+    if kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        logger.warning(
+            "chip_peak_flops: unrecognized device_kind %r — assuming "
+            "v4-class %.0e FLOP/s; MFU figures from this device are "
+            "estimates. Pin the truth with PD_PEAK_FLOPS=<per-chip "
+            "peak> (or extend PEAK_FLOPS_BY_KIND).",
+            kind, _UNKNOWN_CHIP_GUESS)
+    return _UNKNOWN_CHIP_GUESS
 
 
 def flops_of_compiled(compiled) -> float:

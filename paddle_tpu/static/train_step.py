@@ -27,6 +27,7 @@ from ..jit.api import _unwrap_tree, _wrap_tree
 from ..nn.layer.layers import Layer
 from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
+from ..observability.anatomy import scope as _scope
 from ..observability.sentinel import RecompileSentinel, signature_of
 from ..optimizer.optimizer import Optimizer
 from ..optimizer.lr import LRScheduler
@@ -263,30 +264,34 @@ class TrainStep:
             if scale is not None:
                 from ..amp.functional import (check_finite_and_unscale_tree,
                                               update_loss_scaling_state)
-                grads, found_inf = check_finite_and_unscale_tree(grads,
-                                                                 scale)
-                loss = loss / scale
+                with _scope("loss_scale"):
+                    grads, found_inf = check_finite_and_unscale_tree(
+                        grads, scale)
+                    loss = loss / scale
             if self.grad_transform is not None:
                 grads, strat = self.grad_transform(grads, strat, params)
-            new_params, new_opt = optimizer.apply_gradients_tree(
-                params, grads, opt_state, lr=lr)
+            with _scope("optimizer"):
+                new_params, new_opt = optimizer.apply_gradients_tree(
+                    params, grads, opt_state, lr=lr)
             if found_inf is not None:
                 # skipped-step semantics: on overflow keep params and
                 # optimizer state exactly as they were
-                keep = lambda new, old: jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(found_inf, o, n), new, old)
-                new_params = keep(new_params, params)
-                new_opt = keep(new_opt, opt_state)
-                strat = dict(strat)
-                if scaler_cfg["dynamic"]:
-                    ns, ng, nb = update_loss_scaling_state(
-                        scale, strat["amp_good"], strat["amp_bad"],
-                        found_inf,
-                        incr_ratio=scaler_cfg["incr_ratio"],
-                        decr_ratio=scaler_cfg["decr_ratio"],
-                        incr_every_n=scaler_cfg["incr_every_n"],
-                        decr_every_n=scaler_cfg["decr_every_n"])
-                    strat.update(amp_scale=ns, amp_good=ng, amp_bad=nb)
+                with _scope("loss_scale"):
+                    keep = lambda new, old: jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(found_inf, o, n), new, old)
+                    new_params = keep(new_params, params)
+                    new_opt = keep(new_opt, opt_state)
+                    strat = dict(strat)
+                    if scaler_cfg["dynamic"]:
+                        ns, ng, nb = update_loss_scaling_state(
+                            scale, strat["amp_good"], strat["amp_bad"],
+                            found_inf,
+                            incr_ratio=scaler_cfg["incr_ratio"],
+                            decr_ratio=scaler_cfg["decr_ratio"],
+                            incr_every_n=scaler_cfg["incr_every_n"],
+                            decr_every_n=scaler_cfg["decr_every_n"])
+                        strat.update(amp_scale=ns, amp_good=ng,
+                                     amp_bad=nb)
             return new_params, new_opt, new_buffers, strat, loss
 
         jit_kwargs = {}

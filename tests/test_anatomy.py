@@ -1,0 +1,244 @@
+"""Step-anatomy receipts (ISSUE 6 acceptance, CPU tier-1):
+
+- scope() names survive lowering into HLO op metadata, through the
+  backward (transpose(jvp(...))), and cost ZERO extra executables
+  (RecompileSentinel-guarded);
+- the static attribution engine's per-scope FLOPs shares from the
+  lowered single-dispatch ERNIE step sum to 1.0 ± 0.02 with the
+  mlm_head_ce scope inside [0.15, 0.30] (the known ≈20% share);
+- the share table rides the PR 3 exporters;
+- the obs_report --anatomy bridge self-checks the same surface.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import anatomy, flight_recorder as fr
+from paddle_tpu.observability import exporters, metrics
+
+
+# ---------------------------------------------------------------------------
+# scope(): the annotation plane
+# ---------------------------------------------------------------------------
+
+class TestScope:
+    def test_registers_name(self):
+        with anatomy.scope("my_custom_scope"):
+            pass
+        assert "my_custom_scope" in anatomy.known_scopes()
+        assert set(anatomy.CORE_SCOPES) <= anatomy.known_scopes()
+
+    def test_rejects_path_separators(self):
+        with pytest.raises(ValueError):
+            anatomy.register_scope("a/b")
+
+    def test_survives_into_hlo_metadata_fwd_and_bwd(self):
+        def f(w, x):
+            with anatomy.scope("attn"):
+                y = x @ w
+            with anatomy.scope("mlp"):
+                return jnp.tanh(y).sum()
+
+        w = jnp.ones((8, 8), jnp.float32)
+        x = jnp.ones((4, 8), jnp.float32)
+        text = jax.jit(jax.grad(f)).lower(w, x).compile().as_text()
+        # forward scope on the matmul AND backward scope through the
+        # transpose(jvp(...)) wrapper — the contract the attribution
+        # engine parses
+        assert "/attn/" in text or "jvp(attn)" in text
+        assert "transpose(jvp(attn))" in text
+
+    def test_scope_of_op_name_unwraps_transforms(self):
+        f = anatomy.scope_of_op_name
+        assert f("jit(step)/jit(main)/attn/dot_general") == "attn"
+        assert f("jit(step)/transpose(jvp(mlp))/dot_general") == "mlp"
+        # innermost (deepest) registered scope wins
+        assert f("jit(s)/attn/mlp/add") == "mlp"
+        assert f("jit(s)/vmap(jvp(embed))/gather") == "embed"
+        assert f("jit(s)/jit(main)/no_such/add") is None
+
+    def test_breadcrumb_once_per_name(self):
+        fr.reset()
+        anatomy._BREADCRUMBED.discard("bc_test_scope")
+        fr.enable()
+        try:
+            with anatomy.scope("bc_test_scope"):
+                pass
+            with anatomy.scope("bc_test_scope"):
+                pass
+            evs = [e for e in fr.get_recorder().events()
+                   if e["k"] == "scope"
+                   and e.get("name") == "bc_test_scope"]
+            assert len(evs) == 1  # once: model blocks enter per forward
+        finally:
+            fr.disable()
+            fr.reset()
+
+
+# ---------------------------------------------------------------------------
+# the mini cost model (pure parser units, no jax needed)
+# ---------------------------------------------------------------------------
+
+_HLO = """HloModule test, is_scheduled=true
+
+%fused_computation (param_0.1: f32[4,8]) -> f32[4,8] {
+  %param_0.1 = f32[4,8]{1,0} parameter(0)
+  %tanh.9 = f32[4,8]{1,0} tanh(f32[4,8]{1,0} %param_0.1), metadata={op_name="jit(f)/jit(main)/transpose(jvp(mlp))/tanh" source_file="x.py" source_line=7}
+}
+
+ENTRY %main.17 (Arg_0.1: f32[4,16], Arg_1.2: f32[16,8]) -> f32[4,8] {
+  %Arg_0.1 = f32[4,16]{1,0} parameter(0)
+  %Arg_1.2 = f32[16,8]{1,0} parameter(1)
+  %dot.5 = f32[4,8]{1,0} dot(f32[4,16]{1,0} %Arg_0.1, f32[16,8]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/jit(main)/attn/dot_general" source_file="x.py" source_line=5}
+  %fusion.1 = f32[4,8]{1,0} fusion(f32[4,8]{1,0} %dot.5), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(f)/jit(main)/transpose(jvp(mlp))/tanh"}
+  ROOT %add.16 = f32[4,8]{1,0} add(f32[4,8]{1,0} %fusion.1, f32[4,8]{1,0} %dot.5)
+}
+"""
+
+
+class TestHloCostModel:
+    def test_dot_flops_and_scope_grouping(self):
+        res = anatomy.attribute_hlo_text(_HLO)
+        scopes = res["scopes"]
+        # dot: 2 * prod(result 4x8) * contracted 16 = 1024 FLOPs
+        assert scopes["attn"]["flops"] == 1024.0
+        # tanh inside the fused computation: 32 elements, once (the
+        # fusion call itself is free — no double count)
+        assert scopes["mlp"]["flops"] == 32.0
+        assert scopes["mlp"]["ops"] == 1
+        # the metadata-less ROOT add lands in unattributed
+        assert scopes["unattributed"]["flops"] == 32.0
+        assert res["total_flops"] == 1088.0
+        assert sum(v["share"] for v in scopes.values()) == \
+            pytest.approx(1.0)
+
+    def test_bytes_counted_for_data_movement(self):
+        res = anatomy.attribute_hlo_text(_HLO)
+        # parameters carry 0 FLOPs but real bytes (4*16*4 = 256 etc.)
+        unatt = res["scopes"]["unattributed"]
+        assert unatt["bytes"] >= 256
+        assert res["total_bytes"] > 0
+
+    def test_empty_text(self):
+        res = anatomy.attribute_hlo_text("HloModule empty\n")
+        assert res["total_flops"] == 0.0
+        assert res["scopes"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance receipt: the lowered single-dispatch ERNIE step
+# ---------------------------------------------------------------------------
+
+def _ernie_step(vocab, hidden, layers, heads, inter, batch, seq):
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    from paddle_tpu.static import TrainStep
+    paddle.seed(0)
+    cfg = ErnieConfig(vocab_size=vocab, hidden_size=hidden,
+                      num_hidden_layers=layers,
+                      num_attention_heads=heads,
+                      intermediate_size=inter,
+                      max_position_embeddings=seq)
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    step = TrainStep(
+        model, lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
+        opt, amp_level="O1", amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, vocab, (batch, seq)).astype(np.int32))
+    lbl = paddle.to_tensor(
+        rng.randint(0, vocab, (batch, seq)).astype(np.int32))
+    return step, ids, lbl
+
+
+def test_ernie_step_scope_shares():
+    # vocab sized so mlm_head_ce carries the known ≈20-26% share at
+    # this depth (the full-size analogue: vocab 30528 / h 768 / L 12
+    # ≈ 0.22) — tools/obs_report.py --anatomy prints the same table
+    # for this exact config. AOT-only: no live steps needed, one
+    # compile (tier-1 time budget).
+    step, ids, lbl = _ernie_step(512, 64, 2, 4, 256, 2, 32)
+    res = anatomy.train_step_anatomy(step, (ids,), (lbl,))
+    shares = {k: v["share"] for k, v in res["scopes"].items()}
+    # the ISSUE acceptance: shares sum to 1.0 +- 0.02
+    assert sum(shares.values()) == pytest.approx(1.0, abs=0.02)
+    # the known head share window (≈20% at the full-size shape)
+    assert 0.15 <= shares["mlm_head_ce"] <= 0.30, shares
+    # every wired model scope shows up in the one executable
+    for name in ("embed", "attn", "mlp", "optimizer"):
+        assert name in shares, shares
+    # attribution is near-total: strays under 5%
+    assert res["unattributed_share"] < 0.05
+    # the compiler's own total agrees within 2x (coverage receipt: the
+    # mini model prices dots exactly; elementwise constants differ)
+    ca = res["cost_analysis_flops"]
+    assert ca > 0
+    assert 0.5 < res["total_flops"] / ca < 2.0
+
+
+def test_compile_uncached_carries_scopes_and_restores_config(tmp_path):
+    # regression (found live in bench): jax's persistent-cache key
+    # strips op metadata, so a stale cache hit returns a PRE-anatomy
+    # executable and zeroes the share table. compile_uncached must
+    # bypass the cache for the attributed compile and leave the
+    # trainer's cache config exactly as it found it.
+    from paddle_tpu.core.flags import apply_compile_cache
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_en = bool(jax.config.jax_enable_compilation_cache)
+    try:
+        apply_compile_cache(str(tmp_path), min_compile_secs=0.0)
+
+        def f(w):
+            with anatomy.scope("attn"):
+                return (w @ w).sum()
+
+        lowered = jax.jit(jax.grad(f)).lower(jnp.ones((8, 8)))
+        text = anatomy.compile_uncached(lowered).as_text()
+        assert "attn" in text
+        assert bool(jax.config.jax_enable_compilation_cache) is True
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_enable_compilation_cache", prev_en)
+
+
+def test_publish_rides_exporters_and_report_table():
+    res = anatomy.attribute_hlo_text(_HLO)
+    res["cost_analysis_flops"] = 1100.0
+    anatomy.publish(res)
+    prom = exporters.to_prometheus()
+    assert 'paddle_tpu_anatomy_flops_share{scope="attn"}' in prom
+    assert "paddle_tpu_anatomy_total_flops 1088" in prom
+    table = anatomy.format_table(res)
+    assert "attn" in table and "mlp" in table
+    snap = metrics.snapshot(prefix="anatomy.")
+    assert snap['anatomy.flops_share{scope=attn}']["value"] == \
+        pytest.approx(1024.0 / 1088.0, abs=1e-4)
+
+
+def test_obs_report_anatomy_bridge(monkeypatch, capsys):
+    # the --anatomy bridge runs the receipt end to end (in-process: the
+    # CLI path is identical minus interpreter startup). Micro shapes to
+    # stay in the tier-1 time budget — the head-share WINDOW is pinned
+    # by test_ernie_step_scope_shares at the calibrated config; here
+    # the bridge's own self-checks are the contract, including the
+    # RecompileSentinel guard over its LIVE steps: scope annotation
+    # must stay metadata-only (0 recompiles, exactly 1 executable).
+    for k, v in (("VOCAB", "256"), ("HIDDEN", "32"), ("LAYERS", "1"),
+                 ("HEADS", "2"), ("INTER", "128"), ("BATCH", "2"),
+                 ("SEQ", "16")):
+        monkeypatch.setenv(f"PD_ANATOMY_{k}", v)
+    from tools import obs_report
+    rc = obs_report.main(["--anatomy"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert rc == 0 and summary["ok"], summary
+    assert summary["share_sum"] == pytest.approx(1.0, abs=0.02)
+    assert summary["scope_shares"]["mlm_head_ce"] > 0
+    assert summary["train_recompiles"] == 0
+    assert summary["train_executables"] == 1

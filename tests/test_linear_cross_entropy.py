@@ -117,6 +117,9 @@ def test_gpt_chunked_lm_loss_parity():
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox (PR 6 rebalance);
+#                    the op-parity grid + GPT chunked-loss parity +
+#                    no-logits-buffer receipt keep tier-1 coverage
 def test_trainstep_loss_parity_dense_vs_chunked():
     """Same weights/batch: chunked-CE TrainStep loss == dense-path
     TrainStep loss (first step, Adam)."""

@@ -1,0 +1,83 @@
+"""chip_peak_flops device-kind table + the loud-guess contract
+(ISSUE 6 satellite): every v2-v6e spelling resolves from the spec
+table, and the unidentifiable-accelerator fallback to the v4-class
+guess is warn-once + always-on-counter — never silent (a guessed
+denominator skews every MFU receipt downstream)."""
+import logging
+
+import pytest
+
+from paddle_tpu.observability import metrics, mfu
+
+
+class FakeDev:
+    def __init__(self, kind, platform="tpu"):
+        self.device_kind = kind
+        self.platform = platform
+
+
+def _guesses() -> int:
+    return metrics.counter("mfu.peak_flops_guess_total").value()
+
+
+@pytest.mark.parametrize("kind,peak", [
+    # both cloud spellings per generation where they differ
+    ("TPU v2", 45e12),
+    ("TPU v3", 123e12),
+    ("TPU v4", 275e12),
+    ("TPU v5 lite", 197e12),
+    ("TPU v5e", 197e12),
+    ("TPU v5p", 459e12),
+    ("TPU v6 lite", 918e12),
+    ("TPU v6e", 918e12),
+    # suffixed real-world kinds resolve by prefix
+    ("TPU v4 MegaCore", 275e12),
+    ("TPU v5p pod slice", 459e12),
+    # case drift must not break the lookup
+    ("tpu v3", 123e12),
+])
+def test_peak_table_spellings(kind, peak, monkeypatch):
+    monkeypatch.delenv("PD_PEAK_FLOPS", raising=False)
+    before = _guesses()
+    assert mfu.chip_peak_flops(FakeDev(kind)) == peak
+    assert _guesses() == before  # a table hit is not a guess
+
+
+def test_unknown_accelerator_guess_is_loud(monkeypatch, caplog):
+    monkeypatch.delenv("PD_PEAK_FLOPS", raising=False)
+    mfu._warned_kinds.discard("Axon X1")
+    before = _guesses()
+    with caplog.at_level(logging.WARNING,
+                         logger="paddle_tpu.observability"):
+        assert mfu.chip_peak_flops(FakeDev("Axon X1")) == 275e12
+        assert mfu.chip_peak_flops(FakeDev("Axon X1")) == 275e12
+    # always-on counter: one bump per guess, metrics gate or not
+    assert not metrics.enabled()
+    assert _guesses() == before + 2
+    # warn-once per kind: two guesses, ONE log line
+    hits = [r for r in caplog.records if "Axon X1" in r.getMessage()]
+    assert len(hits) == 1
+    assert "PD_PEAK_FLOPS" in hits[0].getMessage()
+
+
+def test_cpu_fallback_is_not_a_guess(monkeypatch):
+    monkeypatch.delenv("PD_PEAK_FLOPS", raising=False)
+    before = _guesses()
+    peak = mfu.chip_peak_flops(FakeDev("Unknown CPU thing",
+                                       platform="cpu"))
+    assert peak > 0
+    assert _guesses() == before
+
+
+def test_explicit_fallback_wins_over_guess(monkeypatch):
+    monkeypatch.delenv("PD_PEAK_FLOPS", raising=False)
+    before = _guesses()
+    # bench.py pins 275e12 explicitly: a DELIBERATE figure, no warning
+    assert mfu.chip_peak_flops(FakeDev("Mystery"),
+                               fallback=123.0) == 123.0
+    assert _guesses() == before
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("PD_PEAK_FLOPS", "1e15")
+    assert mfu.chip_peak_flops(FakeDev("TPU v4")) == 1e15

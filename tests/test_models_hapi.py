@@ -28,6 +28,8 @@ def test_resnet18_forward():
     assert out.shape == [2, 10]
 
 
+@pytest.mark.slow  # >15 s on the tier-1 sandbox (PR 6 rebalance);
+#                    lenet/resnet18 forwards keep the zoo path in tier-1
 def test_mobilenetv2_forward():
     net = mobilenet_v2(num_classes=7)
     x = paddle.randn([2, 3, 32, 32])

@@ -1,0 +1,103 @@
+"""tools/tier1_budget.py smoke (ISSUE 6 satellite): the parser reads
+pytest's --durations format, the checker applies the ROADMAP bars
+(per-test 15 s, suite 870 s), and the CLI exits nonzero on violations.
+"""
+import json
+
+import pytest
+
+from tools import tier1_budget
+
+
+_CLEAN = """\
+============================= slowest durations ==============================
+12.34s call     tests/test_heavy.py::test_big_mesh
+8.01s call     tests/test_other.py::test_medium
+14.99s setup    tests/test_heavy.py::test_big_mesh
+0.50s teardown tests/test_heavy.py::test_big_mesh
+(1200 durations < 0.005s hidden.  Use -vv to show these durations.)
+================= 1230 passed, 7 skipped in 722.33s (0:12:02) =================
+"""
+
+_OVER = """\
+17.20s call     tests/test_fat.py::test_too_slow
+16.00s call     tests/test_fat.py::test_also_slow
+3.00s call     tests/test_ok.py::test_fine
+============ 3 passed in 901.10s =============
+"""
+
+
+class TestParse:
+    def test_durations_and_wall(self):
+        p = tier1_budget.parse_durations(_CLEAN)
+        assert len(p["tests"]) == 4
+        assert p["total_call_s"] == pytest.approx(20.35)
+        assert p["wall_s"] == pytest.approx(722.33)
+
+    def test_no_summary_line(self):
+        p = tier1_budget.parse_durations("1.00s call tests/a.py::t\n")
+        assert p["wall_s"] is None
+        assert p["total_call_s"] == 1.0
+
+
+class TestCheck:
+    def test_clean_run_ok(self):
+        rep = tier1_budget.check_budget(
+            tier1_budget.parse_durations(_CLEAN))
+        assert rep["ok"]
+        assert rep["over"] == []
+        assert rep["headroom_s"] == pytest.approx(870 - 722.33)
+
+    def test_setup_phase_does_not_trip_the_bar(self):
+        # the 14.99s SETUP above is infrastructure, not the test's cost
+        rep = tier1_budget.check_budget(
+            tier1_budget.parse_durations(_CLEAN), per_test_s=10.0)
+        assert [t["id"] for t in rep["over"]] == \
+            ["tests/test_heavy.py::test_big_mesh"]
+
+    def test_offenders_slowest_first_and_budget(self):
+        rep = tier1_budget.check_budget(
+            tier1_budget.parse_durations(_OVER))
+        assert not rep["ok"]
+        assert [t["id"] for t in rep["over"]] == [
+            "tests/test_fat.py::test_too_slow",
+            "tests/test_fat.py::test_also_slow"]
+        assert rep["over_budget"]  # 901.1 > 870
+
+
+class TestCli:
+    def _run(self, tmp_path, text, capsys, extra=()):
+        p = tmp_path / "t1.log"
+        p.write_text(text)
+        rc = tier1_budget.main([str(p), *extra])
+        return rc, capsys.readouterr().out
+
+    def test_clean_exit_zero(self, tmp_path, capsys):
+        rc, out = self._run(tmp_path, _CLEAN, capsys)
+        assert rc == 0
+        rep = json.loads(out.strip().splitlines()[-1]
+                         .split("tier1_budget:", 1)[1])
+        assert rep["ok"] and rep["wall_s"] == pytest.approx(722.33)
+
+    def test_violations_exit_one_and_name_offenders(self, tmp_path,
+                                                    capsys):
+        rc, out = self._run(tmp_path, _OVER, capsys)
+        assert rc == 1
+        assert "tests/test_fat.py::test_too_slow" in out
+        assert "slow-tier candidate" in out
+        assert "OVER BUDGET" in out
+
+    def test_custom_bars(self, tmp_path, capsys):
+        rc, _ = self._run(tmp_path, _OVER, capsys,
+                          extra=["--per-test", "20", "--budget", "950"])
+        assert rc == 0
+
+    def test_empty_log_fails_loudly(self, tmp_path, capsys):
+        # a log produced without --durations must exit 1, not report
+        # the bars as enforced (CI mis-wiring guard)
+        rc, out = self._run(tmp_path, "= 3 passed in 10.00s =", capsys)
+        assert rc == 1
+        assert "NO DURATION LINES" in out
+        rep = json.loads(out.strip().splitlines()[-1]
+                         .split("tier1_budget:", 1)[1])
+        assert rep["no_durations"] and not rep["ok"]

@@ -1,0 +1,232 @@
+"""Device-time attribution receipts (observability.xprof, CPU tier-1):
+a recorded-trace fixture drives the parser -> per-scope device ms,
+idle time, and a DETERMINISTIC comm-overlap fraction — no hardware
+needed; the published gauge rides the exporters and fleet.aggregate().
+"""
+import gzip
+import json
+import os
+import time
+
+import pytest
+
+from paddle_tpu.observability import exporters, fleet, metrics, xprof
+
+
+def _fixture_trace():
+    """Synthetic chrome trace mimicking a TPU XPlane export: one device
+    plane (compute lane + async-collective lane), one host plane that
+    must be ignored. Times in µs, crafted so the receipt pins exactly:
+
+      compute: attn [0,100) + mlp-bwd [100,200) + optimizer [230,270)
+      comm:    grad_sync all-reduce [150,250): 50µs hidden behind mlp,
+               20µs behind optimizer, 30µs exposed -> overlap 0.70
+      idle:    device span [0,270), busy union [0,270) minus [200,230)
+               gap NOT covered by comm? comm covers [200,230) -> no
+               idle; host plane contributes nothing.
+    """
+    return {"traceEvents": [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 7, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 7, "tid": 2, "name": "thread_name",
+         "args": {"name": "Async collectives"}},
+        {"ph": "M", "pid": 99, "name": "process_name",
+         "args": {"name": "python main thread"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1",
+         "ts": 0, "dur": 100,
+         "args": {"tf_op": "jit(step)/jit(main)/attn/dot_general"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.2",
+         "ts": 100, "dur": 100,
+         "args": {"tf_op": "jit(step)/transpose(jvp(mlp))/dot_general"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.3.optimizer",
+         "ts": 230, "dur": 40, "args": {}},
+        {"ph": "X", "pid": 7, "tid": 2, "name": "all-reduce-start.7",
+         "ts": 150, "dur": 100,
+         "args": {"hlo_op": "jit(step)/grad_sync/psum"}},
+        # host-side python span: NOT device time
+        {"ph": "X", "pid": 99, "tid": 5, "name": "train_loop",
+         "ts": 0, "dur": 10000, "args": {}},
+    ]}
+
+
+@pytest.fixture
+def trace_path(tmp_path):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(_fixture_trace()))
+    return str(p)
+
+
+class TestLoad:
+    def test_device_planes_only(self, trace_path):
+        evs = xprof.load_profile(trace_path)
+        assert len(evs) == 4  # the host span is excluded
+        assert all(ev["device"] == "/device:TPU:0" for ev in evs)
+        assert {ev["line"] for ev in evs} == \
+            {"XLA Ops", "Async collectives"}
+
+    def test_gzip_roundtrip(self, tmp_path):
+        p = tmp_path / "trace.json.gz"
+        with gzip.open(p, "wt") as f:
+            json.dump(_fixture_trace(), f)
+        assert len(xprof.load_profile(str(p))) == 4
+
+    def test_dir_falls_back_to_trace_json(self, tmp_path):
+        sub = tmp_path / "plugins" / "profile" / "run1"
+        sub.mkdir(parents=True)
+        (sub / "host.trace.json").write_text(
+            json.dumps(_fixture_trace()))
+        assert len(xprof.load_profile(str(tmp_path))) == 4
+
+    def test_dir_with_nothing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            xprof.load_profile(str(tmp_path))
+
+    def test_find_xplane_newest_wins(self, tmp_path):
+        a = tmp_path / "run1" / "a.xplane.pb"
+        b = tmp_path / "run2" / "b.xplane.pb"
+        for p in (a, b):
+            p.parent.mkdir()
+            p.write_bytes(b"")
+        past = time.time() - 100
+        os.utime(a, (past, past))
+        assert xprof.find_xplane(str(tmp_path)) == str(b)
+        assert xprof.find_xplane(str(tmp_path / "run1")) == str(a)
+        assert xprof.find_xplane(str(tmp_path / "empty")) is None
+
+
+class TestClassify:
+    def test_is_comm_kernel(self):
+        assert xprof.is_comm_kernel("all-reduce-start.3")
+        assert xprof.is_comm_kernel("fusion.9",
+                                    {"tf_op": "x/fused_allreduce_hier"})
+        assert xprof.is_comm_kernel("collective-permute.1")
+        assert not xprof.is_comm_kernel("fusion.12", {"tf_op": "x/mlp"})
+
+    def test_scope_via_args_and_name(self):
+        ev = {"name": "fusion.1",
+              "args": {"tf_op": "jit(s)/transpose(jvp(attn))/dot"}}
+        assert xprof.scope_of_event(ev) == "attn"
+        # kernel-name token fallback when no metadata args survive
+        assert xprof.scope_of_event(
+            {"name": "fusion.3.optimizer", "args": {}}) == "optimizer"
+        assert xprof.scope_of_event(
+            {"name": "fusion.77", "args": {}}) is None
+
+
+class TestAttribution:
+    def test_deterministic_overlap_receipt(self, trace_path):
+        evs = xprof.load_profile(trace_path)
+        res = xprof.attribute_device_time(evs)
+        # the pinned receipt: 70/100 µs of collective time hidden
+        # behind concurrently-running compute
+        assert res["comm"]["comm_ms"] == pytest.approx(0.1)
+        assert res["comm"]["hidden_ms"] == pytest.approx(0.07)
+        assert res["comm"]["exposed_ms"] == pytest.approx(0.03)
+        assert res["comm"]["overlap_fraction"] == pytest.approx(0.7)
+        # per-scope device ms from kernel->scope mapping
+        assert res["per_scope_ms"]["attn"] == pytest.approx(0.1)
+        assert res["per_scope_ms"]["mlp"] == pytest.approx(0.1)
+        assert res["per_scope_ms"]["grad_sync"] == pytest.approx(0.1)
+        assert res["per_scope_ms"]["optimizer"] == pytest.approx(0.04)
+        # span [0, 270) fully covered once comm bridges [200, 230)
+        assert res["device_span_ms"] == pytest.approx(0.27)
+        assert res["idle_ms"] == pytest.approx(0.0)
+        assert res["devices"] == 1
+
+    def test_idle_gap_measured(self, tmp_path):
+        doc = {"traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "f.1", "ts": 0,
+             "dur": 100, "args": {}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "f.2", "ts": 300,
+             "dur": 100, "args": {}},
+        ]}
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(doc))
+        res = xprof.attribute_device_time(xprof.load_profile(str(p)))
+        # the step gap: [100, 300) has no kernel in flight
+        assert res["idle_ms"] == pytest.approx(0.2)
+        assert res["device_busy_ms"] == pytest.approx(0.2)
+
+    def test_aggregate_lanes_excluded(self, tmp_path):
+        # real XPlanes carry aggregate lanes ("XLA Modules" = one
+        # jit_step-sized event, "Steps" = step markers) whose spans
+        # would sit in the compute union and saturate the overlap
+        # receipt at ~1.0 / zero the idle figure — they must be
+        # dropped at load time, keeping only kernel lanes
+        doc = _fixture_trace()
+        doc["traceEvents"] += [
+            {"ph": "M", "pid": 7, "tid": 8, "name": "thread_name",
+             "args": {"name": "XLA Modules"}},
+            {"ph": "M", "pid": 7, "tid": 9, "name": "thread_name",
+             "args": {"name": "Steps"}},
+            {"ph": "X", "pid": 7, "tid": 8, "name": "jit_step",
+             "ts": 0, "dur": 270, "args": {}},
+            {"ph": "X", "pid": 7, "tid": 9, "name": "3", "ts": 0,
+             "dur": 270, "args": {}},
+        ]
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(doc))
+        evs = xprof.load_profile(str(p))
+        assert len(evs) == 4  # the two aggregate-lane events are gone
+        res = xprof.attribute_device_time(evs)
+        # receipt unchanged vs the kernel-only fixture
+        assert res["comm"]["overlap_fraction"] == pytest.approx(0.7)
+
+    def test_comm_without_scope_lands_on_comm_row(self, tmp_path):
+        doc = {"traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "all-gather.3",
+             "ts": 0, "dur": 50, "args": {}},
+        ]}
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(doc))
+        res = xprof.attribute_device_time(xprof.load_profile(str(p)))
+        assert res["per_scope_ms"] == {"comm": pytest.approx(0.05)}
+        # all comm, nothing concurrent: fully exposed
+        assert res["comm"]["overlap_fraction"] == 0.0
+
+    def test_no_comm_reports_minus_one(self, trace_path):
+        evs = [e for e in xprof.load_profile(trace_path)
+               if not xprof.is_comm_kernel(e["name"], e["args"])]
+        res = xprof.attribute_device_time(evs)
+        assert res["comm"]["overlap_fraction"] == -1.0
+
+    def test_steps_divides_per_step_figures(self, trace_path):
+        evs = xprof.load_profile(trace_path)
+        res1 = xprof.attribute_device_time(evs, steps=1)
+        res2 = xprof.attribute_device_time(evs, steps=2)
+        assert res2["per_scope_ms"]["attn"] == \
+            pytest.approx(res1["per_scope_ms"]["attn"] / 2)
+        assert res2["device_span_ms"] == \
+            pytest.approx(res1["device_span_ms"] / 2)
+
+
+def test_publish_rides_exporters_and_fleet(trace_path):
+    res = xprof.attribute_device_time(xprof.load_profile(trace_path))
+    xprof.publish(res)
+    # the headline ROADMAP 3(d) receipt is a plain gauge: Prometheus...
+    prom = exporters.to_prometheus()
+    assert "paddle_tpu_comm_overlap_fraction 0.7" in prom
+    # ...and the pod rollup both see it
+    merged = fleet.aggregate()
+    assert merged["comm.overlap_fraction"]["value"] == \
+        pytest.approx(0.7)
+    assert metrics.get("anatomy.device_ms", scope="attn") is not None
+
+
+def test_top_ops_per_step():
+    evs = [{"device": "d", "line": "l", "name": "f.1", "ts": 0,
+            "dur": 3000, "args": {}},
+           {"device": "d", "line": "l", "name": "f.1", "ts": 5000,
+            "dur": 3000, "args": {}},
+           {"device": "d", "line": "l", "name": "f.2", "ts": 3000,
+            "dur": 1000, "args": {}}]
+    top = xprof.top_ops(evs, steps=2)
+    assert top[0] == ("f.1", pytest.approx(3.0))  # 6ms over 2 steps
+    text = xprof.format_top_ops(evs, steps=2)
+    assert "ms/step" in text and "f.1" in text

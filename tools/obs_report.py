@@ -19,6 +19,13 @@ Modes:
               DIR to tools/tpu_doctor.py and print its diagnosis
               (diverging rank + last mismatched collective seq,
               stragglers, recompile storms, goodput breakdown).
+  --anatomy   step-anatomy bridge: build the CPU-smoke ERNIE TrainStep
+              (tools/step_anatomy.py's config, PD_ANATOMY_* tunable),
+              attribute its ONE executable by scope
+              (observability.anatomy), publish anatomy.* gauges, and
+              print the share table as ONE JSON line — the
+              zero-to-attribution receipt (scope shares sum to ~1.0,
+              sentinel stays at zero).
   default     aggregate + export whatever the current process's
               registry holds (for embedding in training scripts).
 
@@ -209,6 +216,67 @@ def run_demo(args):
     return 0 if summary["ok"] else 1
 
 
+def run_anatomy(args):
+    """Step-anatomy bridge: one process, one tiny ERNIE TrainStep, the
+    per-scope share table of its single executable. Self-checks the
+    acceptance surface (shares sum to 1, the head scope exists, zero
+    recompiles) so a drive-by refactor that drops scope annotations
+    fails loudly here."""
+    # lighter setup than _jax_setup: anatomy needs ONE device, not a
+    # pinned mesh — and must also run in-process next to an
+    # already-initialized jax (the tier-1 smoke), where re-pinning
+    # device counts would fight the live backend
+    global jax, np
+    if jax is None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu import jax_compat  # noqa: F401 (shims first)
+        import jax as _jax
+        import numpy as _np
+        jax, np = _jax, _np
+    from paddle_tpu.observability import anatomy, exporters
+    from tools.step_anatomy import build_step
+
+    step, ids, lbl, shape = build_step(False)
+    float(step(ids, lbl).item())  # compile (sentinel baselines here)
+    float(step(ids, lbl).item())  # steady step: sentinel must stay 0
+    res = anatomy.train_step_anatomy(step, (ids,), (lbl,),
+                                     publish_gauges=True)
+    if args.prom:
+        exporters.write_prometheus(args.prom)
+    if args.jsonl:
+        exporters.JsonlExporter(args.jsonl).write(extra={
+            "phase": "anatomy"})
+    shares = {k: round(v["share"], 4) for k, v in res["scopes"].items()}
+    summary = {
+        "ok": True,
+        "shape": shape,
+        "scope_shares": shares,
+        "share_sum": round(sum(shares.values()), 4),
+        "unattributed_share": round(res["unattributed_share"], 4),
+        "total_flops": res["total_flops"],
+        "cost_analysis_flops": res["cost_analysis_flops"],
+        "train_recompiles": step.recompile_sentinel.fired,
+        "train_executables": int(step._step_fn._cache_size()),
+        "prometheus": args.prom, "jsonl": args.jsonl,
+    }
+    problems = []
+    if abs(summary["share_sum"] - 1.0) > 0.02:
+        problems.append(f"shares sum to {summary['share_sum']}, not 1")
+    if "mlm_head_ce" not in shares:
+        problems.append("no mlm_head_ce scope in the lowered step")
+    if summary["train_recompiles"] != 0 or \
+            summary["train_executables"] != 1:
+        problems.append(
+            f"scope annotation must be metadata-only: "
+            f"{summary['train_recompiles']} recompiles, "
+            f"{summary['train_executables']} executables (want 0/1)")
+    if problems:
+        summary["ok"] = False
+        summary["problems"] = problems
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
 def run_export(args):
     """Non-demo mode: export whatever the registry holds right now."""
     _jax_setup()
@@ -236,6 +304,7 @@ def run_doctor(args):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--demo", action="store_true")
+    ap.add_argument("--anatomy", action="store_true")
     ap.add_argument("--force-recompile", action="store_true")
     ap.add_argument("--doctor", default=None, metavar="DIR",
                     help="diagnose flight-recorder dumps in DIR "
@@ -248,6 +317,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.doctor:
         return run_doctor(args)
+    if args.anatomy:
+        return run_anatomy(args)
     if args.demo:
         return run_demo(args)
     return run_export(args)

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Tier-1 test budget checker: the ROADMAP discipline as a tool.
+
+PRs 2-5 enforced "new tier-1 tests < 15 s each, suite within the 870 s
+budget" by hand-reading `pytest --durations` output. This parses it:
+
+  python -m pytest tests/ -q -m 'not slow' --durations=0 | tee t1.log
+  python tools/tier1_budget.py t1.log            # or pipe via stdin
+
+Reports every test whose `call` phase exceeds the per-test bar (the
+candidates for the `slow` tier — PR 2's rebalance policy: heaviest
+sibling moves, faster coverage stays), the summed call time, and the
+suite wall clock against the budget. Exit 1 when a test is over the
+bar, the wall clock blows the budget, OR the log contains no duration
+lines at all (a mis-wired CI invocation must fail loudly, not pass
+with the bars unenforced) — CI-wireable.
+
+Parsing contract (pytest's stable text format):
+  `12.34s call     tests/test_x.py::test_y`   duration lines
+  `= 1230 passed, 7 skipped in 722.33s =`     the wall-clock summary
+"""
+import argparse
+import json
+import re
+import sys
+
+__all__ = ["parse_durations", "check_budget", "main"]
+
+_DUR_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
+_WALL_RE = re.compile(r"\bin (\d+(?:\.\d+)?)s(?:\s|=|$)")
+_SUMMARY_HINT = re.compile(r"\b(passed|failed|error|skipped|no tests)\b")
+
+
+def parse_durations(text: str) -> dict:
+    """pytest output -> {"tests": [{id, phase, dur_s}...],
+    "total_call_s", "wall_s" (None when no summary line present)}."""
+    tests = []
+    wall = None
+    for line in text.splitlines():
+        m = _DUR_RE.match(line)
+        if m:
+            tests.append({"dur_s": float(m.group(1)),
+                          "phase": m.group(2),
+                          "id": m.group(3)})
+            continue
+        if _SUMMARY_HINT.search(line):
+            w = _WALL_RE.search(line)
+            if w:
+                wall = float(w.group(1))  # last summary line wins
+    return {
+        "tests": tests,
+        "total_call_s": round(sum(t["dur_s"] for t in tests
+                                  if t["phase"] == "call"), 2),
+        "wall_s": wall,
+    }
+
+
+def check_budget(parsed: dict, per_test_s: float = 15.0,
+                 budget_s: float = 870.0) -> dict:
+    """Apply the ROADMAP bars. `over` lists call-phase offenders,
+    slowest first (setup/teardown phases are infrastructure, not the
+    test's own cost — they don't trip the bar but ride `tests`)."""
+    over = sorted(
+        (t for t in parsed["tests"]
+         if t["phase"] == "call" and t["dur_s"] > per_test_s),
+        key=lambda t: -t["dur_s"])
+    wall = parsed.get("wall_s")
+    over_budget = wall is not None and wall > budget_s
+    # an empty parse is a FAILURE, not a pass: a CI job feeding this a
+    # log produced without --durations (or a run that died at
+    # collection) must not report the bars as enforced when nothing
+    # was measured
+    empty = not parsed["tests"]
+    return {
+        "per_test_bar_s": per_test_s,
+        "budget_s": budget_s,
+        "over": over,
+        "total_call_s": parsed["total_call_s"],
+        "wall_s": wall,
+        "headroom_s": (round(budget_s - wall, 2)
+                       if wall is not None else None),
+        "over_budget": over_budget,
+        "no_durations": empty,
+        "ok": not over and not over_budget and not empty,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log", nargs="?", default=None,
+                    help="pytest output file (default: stdin)")
+    ap.add_argument("--per-test", type=float, default=15.0,
+                    help="per-test call-phase bar in seconds")
+    ap.add_argument("--budget", type=float, default=870.0,
+                    help="suite wall-clock budget in seconds")
+    args = ap.parse_args(argv)
+    text = (open(args.log).read() if args.log
+            else sys.stdin.read())
+    parsed = parse_durations(text)
+    rep = check_budget(parsed, args.per_test, args.budget)
+    if rep["no_durations"]:
+        print("NO DURATION LINES FOUND — run pytest with "
+              "--durations=0 (or --durations=N); the bars were NOT "
+              "checked, failing rather than silently passing",
+              flush=True)
+    for t in rep["over"]:
+        print(f"OVER {t['dur_s']:8.2f}s > {args.per_test:.0f}s  "
+              f"{t['id']}  (slow-tier candidate)", flush=True)
+    wall = rep["wall_s"]
+    print(f"total call time: {rep['total_call_s']:.1f}s across "
+          f"{sum(1 for t in parsed['tests'] if t['phase'] == 'call')} "
+          "timed tests", flush=True)
+    if wall is not None:
+        verdict = "OVER BUDGET" if rep["over_budget"] else "within"
+        print(f"suite wall clock: {wall:.1f}s / {args.budget:.0f}s "
+              f"budget ({verdict}; headroom {rep['headroom_s']}s)",
+              flush=True)
+    print("tier1_budget:", json.dumps(rep), flush=True)
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,6 +277,27 @@ def main():
 
     section("eager_split", comp_eager)
 
+    # -- scope-taxonomy rollup: the SAME rows observability.anatomy /
+    # xprof / tools/step_anatomy.py report, filled from this tool's
+    # ISOLATED timings — so the isolated and in-situ tables line up
+    # column-for-column on the next hardware window ("attn here is the
+    # same attn there"). Keys missing when their component errored.
+    def scope_columns(res):
+        cols = {}
+        attn = res.get("attn_pallas_fwdbwd_ms",
+                       res.get("attn_sdpa_dropout_fwdbwd_ms"))
+        if attn is not None:
+            cols["attn"] = attn
+        if "head_ce_fwdbwd_ms" in res:
+            cols["mlm_head_ce"] = res["head_ce_fwdbwd_ms"]
+        if "step_opt_ms_approx" in res:
+            cols["optimizer"] = res["step_opt_ms_approx"]
+        if "step_full_ms" in res:
+            cols["step_total"] = res["step_full_ms"]
+        return cols
+
+    emit("scope_ms", scope_columns(results))
+
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(results, f, indent=1)

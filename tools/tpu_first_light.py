@@ -57,8 +57,13 @@ def run(name, cmd, timeout, env=None):
     return rc, out
 
 
+# The profile stage: capture 3 live steps and hand the XPlane to
+# observability.xprof — the ONE parser + glob contract (the inline
+# ProfileData walk that used to live here is superseded; same move as
+# PR 4's default_dump_path). On top of the r04-style top-op list this
+# now prints per-scope device ms and the comm-overlap receipt.
 PROFILE_SNIPPET = r"""
-import sys, os
+import sys, os, json
 sys.path.insert(0, %r)
 import numpy as np, jax
 import paddle_tpu as paddle
@@ -83,22 +88,12 @@ with jax.profiler.trace(d):
     for _ in range(3):
         loss = step(ids, lbl)
     float(loss.item())
-from jax.profiler import ProfileData
-import glob
-xs = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
-pd = ProfileData.from_serialized_xspace(open(xs[-1], "rb").read())
-tot = {}
-for plane in pd.planes:
-    if "TPU" not in plane.name and "tpu" not in plane.name:
-        continue
-    for line in plane.lines:
-        for ev in line.events:
-            ns = ev.duration_ns
-            tot[ev.name] = tot.get(ev.name, 0) + ns
-top = sorted(tot.items(), key=lambda kv: -kv[1])[:15]
-print("top device ops over 3 steps:")
-for name, ns in top:
-    print(f"  {ns/1e6/3:9.2f} ms/step  {name[:90]}")
+from paddle_tpu.observability import xprof
+events = xprof.load_profile(d)
+print(xprof.format_top_ops(events, steps=3))
+dev = xprof.attribute_device_time(events, steps=3)
+print("per-scope device ms/step:", json.dumps(dev["per_scope_ms"]))
+print("comm overlap receipt:", json.dumps(dev["comm"]))
 """ % (REPO,)
 
 
@@ -217,6 +212,21 @@ def main():
             top = [ln.strip() for ln in (out or "").splitlines()
                    if "ms/step" in ln][:6]
             capture["profile_top"] = top
+            for ln in (out or "").splitlines():
+                # the ROADMAP 3(d) receipt: grad-sync overlap measured
+                # in situ — carried in the window capture artifact
+                if ln.startswith("comm overlap receipt:"):
+                    try:
+                        capture["comm_overlap"] = json.loads(
+                            ln.split(":", 1)[1])
+                    except Exception:
+                        pass
+                elif ln.startswith("per-scope device ms/step:"):
+                    try:
+                        capture["scope_device_ms"] = json.loads(
+                            ln.split(":", 1)[1])
+                    except Exception:
+                        pass
 
     if gate("breakdown"):
         rc, out = run("breakdown",
