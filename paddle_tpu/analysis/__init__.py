@@ -1,0 +1,34 @@
+"""paddle_tpu.analysis — graph_lint, the pre-launch program auditor.
+
+Proves the fused train/serve programs safe *before* they run: one
+metadata-preserving lowering (anatomy's compile_uncached discipline),
+pluggable passes over the optimized HLO and the trace-time collective
+schedule, structured findings with severity + ``path:op`` locations,
+and baseline files so CI gates on NEW findings only.
+
+Surfaces: ``tools/graph_lint.py`` (CLI, exit 1 on new findings),
+``tools/repo_lint.py`` (the source pass standalone), always-on
+``lint.findings_total{rule=}`` counters through the PR 3 exporters.
+DESIGN.md "Static analysis" documents the rules table and the
+seq-extraction contract shared with the flight recorder.
+"""
+from .findings import (Finding, exit_code, fingerprint, format_findings,
+                       load_baseline, new_findings, write_baseline)
+from .engine import (GraphLintConfig, HloInstr, ProgramAudit,
+                     iter_hlo_instructions, publish_findings,
+                     registered_rules, rule, run_rules)
+from . import hlo_rules  # noqa: F401  (registers the launch rules)
+from .hlo_rules import LAUNCH_RULES
+from .schedule import (assign_seqs, capture_collective_schedule,
+                       schedule_of, verify_collective_schedules)
+from .source_lint import ALLOWLIST, lint_package, lint_source
+
+__all__ = [
+    "Finding", "GraphLintConfig", "HloInstr", "ProgramAudit",
+    "LAUNCH_RULES", "ALLOWLIST",
+    "iter_hlo_instructions", "rule", "registered_rules", "run_rules",
+    "publish_findings", "fingerprint", "load_baseline",
+    "write_baseline", "new_findings", "format_findings", "exit_code",
+    "assign_seqs", "capture_collective_schedule", "schedule_of",
+    "verify_collective_schedules", "lint_package", "lint_source",
+]

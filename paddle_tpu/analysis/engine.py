@@ -1,0 +1,345 @@
+"""graph_lint rules engine: one lowering, pluggable passes, structured
+findings.
+
+The runtime forensics plane (flight recorder, tpu_doctor, step anatomy)
+diagnoses a bad program *after* it hangs, retraces, or eats HBM — but
+every one of those failure classes is statically visible in the jaxpr /
+optimized HLO before dispatch. On a pod, a trace-time catch costs
+seconds; a runtime catch costs a hung v4-32 window. This engine brings
+the GC3 discipline (verify collective programs as compiler passes, not
+runtime debugging) to the single-dispatch engines:
+
+- ``ProgramAudit`` lowers/compiles a program ONCE, reusing anatomy's
+  metadata-preserving discipline (``compile_uncached`` — a persistent
+  compile-cache hit can hand back a pre-annotation ancestor whose
+  op_names attribute nothing), and exposes the parsed HLO instruction
+  stream, the donation/aliasing tables, and an optionally-captured
+  trace-time collective schedule to every rule.
+- Rules register via ``@rule(name)`` and emit ``Finding`` records with
+  severity + ``path:op`` locations; ``run_rules`` evaluates them and
+  publishes always-on ``lint.findings_total{rule=}`` counters through
+  the PR 3 exporters, so a fleet dashboard sees lint debt without any
+  per-host scraping.
+- Baselines (findings.py) gate CI on *new* findings only.
+
+The built-in passes live in ``hlo_rules`` (importing
+``paddle_tpu.analysis`` registers them); the cross-program
+collective-schedule verifier lives in ``schedule`` because it compares
+N programs, not one.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, replace
+from typing import (Callable, Dict, Iterable, List, Optional,
+                    Sequence, Set, Tuple)
+
+from ..observability import metrics as _obs
+from ..observability.anatomy import (_ITEMSIZE as ITEMSIZE, _META_RE,
+                                     _SHAPE_RE, compile_uncached,
+                                     scope_of_op_name)
+from .findings import Finding
+
+__all__ = [
+    "GraphLintConfig", "HloInstr", "ProgramAudit", "rule",
+    "registered_rules", "run_rules", "publish_findings",
+    "iter_hlo_instructions",
+]
+
+
+@dataclass(frozen=True)
+class GraphLintConfig:
+    """Per-rule byte thresholds. Defaults target the hazards the rules
+    exist for (MiB-scale buffers that double HBM or bloat executables);
+    tests and the hlo_copy_audit shim tighten them to exact shapes."""
+    donation_bytes: int = 64 << 10       # donated buffer must alias
+    constant_bytes: int = 1 << 20        # baked closure constants
+    promotion_bytes: int = 1 << 20       # bf16/f16 -> f32 upcasts
+    replication_bytes: int = 1 << 20     # full-size all-gathers
+    copy_bytes: int = 1 << 20            # f32 full-table copies
+    # scopes where f32 math is the CONTRACT, not a leak: loss unscaling,
+    # fp32 master-weight optimizer updates, grad-sync dequantize
+    amp_exempt_scopes: Tuple[str, ...] = (
+        "loss_scale", "optimizer", "grad_sync")
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing (anatomy's conventions: one line = one instruction)
+# ---------------------------------------------------------------------------
+
+# Unlike anatomy's instruction regex (which deliberately prices only
+# single-shape results — tuple producers are data movement in its cost
+# model), the lint parser MUST see multi-element tuple results: the
+# async collective/copy forms a real TPU schedule emits look like
+#   %copy-start.1 = (f32[V,H]{1,0:T(8,128)}, f32[V,H]{1,0:T(8,128)},
+#                    u32[]{:T(128)}) copy-start(..)
+# and the VERDICT r4 weakness was exactly copy-START. The type group
+# therefore has a parenthesized-tuple alternative that tolerates one
+# nesting level of parens INSIDE the tuple — TPU layouts carry tiling
+# annotations like {1,0:T(8,128)(4,1)} that a naive [^)]* stops at.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<type>\((?:[^()]|\([^)]*\))*\)"
+    r"|[a-z0-9]+\[[\d,]*\][^\s]*)\s+"
+    r"(?P<op>[\w\-]+)\(")
+_ALIAS_BLOCK_RE = re.compile(
+    r"input_output_alias=\{(.*?)\},\s*(?:entry_computation_layout|"
+    r"frontend_attributes|num_partitions|alias_passthrough_params)")
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),")
+
+
+def _prod(dims: Sequence[int]) -> int:
+    out = 1
+    for d in dims:
+        out *= int(d)
+    return out
+
+
+@dataclass(frozen=True)
+class HloInstr:
+    """One parsed HLO instruction line."""
+    name: str            # instruction name (%-stripped)
+    opcode: str
+    dtype: str           # result dtype (first shape of tuple results)
+    dims: Tuple[int, ...]
+    nbytes: int          # result bytes (first shape)
+    type_str: str        # full result type expression (incl. tuples)
+    op_name: str         # metadata op_name path ("" when absent)
+    operands: str        # raw text inside the opcode's parens
+    line: str
+
+    @property
+    def location(self) -> str:
+        """path:op — the stable scope path when metadata survives,
+        the instruction name otherwise."""
+        return f"{self.op_name or self.name}:{self.opcode}"
+
+    def max_nbytes(self) -> int:
+        """Largest shape in the result type — async start ops yield
+        tuples whose FIRST element is the (smaller) input buffer; the
+        materialized result is the biggest member."""
+        return max(
+            (_prod(tuple(int(d) for d in m.group(2).split(",") if d))
+             * ITEMSIZE.get(m.group(1), 4)
+             for m in _SHAPE_RE.finditer(self.type_str)),
+            default=self.nbytes)
+
+    def scope(self) -> Optional[str]:
+        return scope_of_op_name(self.op_name) if self.op_name else None
+
+
+def _operand_segment(line: str, op: str) -> str:
+    i = line.find(op + "(")
+    if i < 0:
+        return ""
+    j = line.find(")", i)
+    return line[i + len(op) + 1: j if j > 0 else len(line)]
+
+
+def iter_hlo_instructions(text: str):
+    """Yield every instruction in an HLO module's ``as_text()`` dump
+    (entry + subcomputations — fused/while bodies are where the real
+    work lives)."""
+    for line in text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        sm = _SHAPE_RE.search(m.group("type"))
+        if not sm:
+            continue
+        dims = tuple(int(d) for d in sm.group(2).split(",") if d)
+        dtype = sm.group(1)
+        meta = _META_RE.search(line)
+        yield HloInstr(
+            name=m.group("name"),
+            opcode=m.group("op"),
+            dtype=dtype,
+            dims=dims,
+            nbytes=_prod(dims) * ITEMSIZE.get(dtype, 4),
+            type_str=m.group("type"),
+            op_name=meta.group(1) if meta else "",
+            operands=_operand_segment(line, m.group("op")),
+            line=line,
+        )
+
+
+# ---------------------------------------------------------------------------
+# the audited program
+# ---------------------------------------------------------------------------
+
+class ProgramAudit:
+    """Everything the rules need about ONE program, computed lazily and
+    cached: optimized HLO text (compiled cache-bypassed so op metadata
+    is THIS program's), parsed instructions, donation tables from the
+    jax side, the aliasing table from the XLA side, and an optional
+    trace-time collective schedule."""
+
+    def __init__(self, name: str, lowered=None, compiled=None,
+                 hlo_text: Optional[str] = None,
+                 config: Optional[GraphLintConfig] = None,
+                 schedule: Optional[List[dict]] = None):
+        if lowered is None and compiled is None and hlo_text is None:
+            raise ValueError(
+                "ProgramAudit needs a lowered, a compiled, or hlo_text")
+        self.name = name
+        self.lowered = lowered
+        self._compiled = compiled
+        self._hlo_text = hlo_text
+        self.config = config or GraphLintConfig()
+        self.schedule = schedule
+
+    @property
+    def compiled(self):
+        if self._compiled is None:
+            # cache-BYPASSED: jax's persistent-cache key strips op
+            # metadata, so a stale hit would hand back an executable
+            # whose op_names attribute nothing (the anatomy lesson)
+            self._compiled = compile_uncached(self.lowered)
+        return self._compiled
+
+    @property
+    def hlo_text(self) -> str:
+        if self._hlo_text is None:
+            self._hlo_text = self.compiled.as_text()
+        return self._hlo_text
+
+    def instructions(self) -> List[HloInstr]:
+        cached = getattr(self, "_instrs", None)
+        if cached is None:
+            cached = self._instrs = list(
+                iter_hlo_instructions(self.hlo_text))
+        return cached
+
+    # -- donation / aliasing ------------------------------------------------
+    def alias_param_numbers(self) -> Set[int]:
+        """Entry parameter numbers XLA aliased to an output (the
+        ``input_output_alias={ {out}: (param, ...) }`` module-header
+        table — the receipt that a donation actually took)."""
+        header = self.hlo_text.splitlines()[0] if self.hlo_text else ""
+        m = _ALIAS_BLOCK_RE.search(header)
+        block = m.group(1) if m else header
+        return {int(p) for p in _ALIAS_ENTRY_RE.findall(block)}
+
+    def flat_args(self) -> List[dict]:
+        """Flattened jax-side argument table: for every leaf arg its
+        pytree path, aval bytes, donation flag, whether lowering KEPT
+        it (unused args are pruned before XLA ever sees them), and —
+        for kept args — its entry parameter number (rank within the
+        kept set; jax emits kept args as entry parameters in flat
+        order)."""
+        if self.lowered is None:
+            return []
+        cached = getattr(self, "_flat_args", None)
+        if cached is not None:
+            return cached
+        import numpy as np
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        leaves, _ = tree_flatten_with_path(self.lowered.args_info)
+        kept = None
+        try:  # private but load-bearing: exact flat-arg -> param map
+            kept = self.lowered._lowering.compile_args.get(
+                "kept_var_idx")
+        except AttributeError:
+            pass
+        kept = set(range(len(leaves))) if kept is None else set(kept)
+        param_of = {idx: rank
+                    for rank, idx in enumerate(sorted(kept))}
+        out = []
+        for idx, (path, info) in enumerate(leaves):
+            aval = getattr(info, "aval", None)
+            if aval is None:
+                aval = info._aval
+            try:  # extended dtypes (RNG keys: key<fry>) have no
+                itemsize = np.dtype(aval.dtype).itemsize  # np.dtype
+                dtype_str = str(np.dtype(aval.dtype))
+            except TypeError:
+                itemsize = getattr(aval.dtype, "itemsize", 4)
+                dtype_str = str(aval.dtype)
+            out.append({
+                "index": idx,
+                "path": keystr(path),
+                "dtype": dtype_str,
+                "nbytes": _prod(aval.shape) * itemsize,
+                "donated": bool(getattr(info, "donated", False)),
+                "kept": idx in kept,
+                "param": param_of.get(idx),
+            })
+        self._flat_args = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RuleSpec:
+    name: str
+    severity: str
+    doc: str
+    fn: Callable[[ProgramAudit], List[Finding]]
+
+
+_RULES: Dict[str, RuleSpec] = {}
+
+
+def rule(name: str, severity: str = "error"):
+    """Register a pass: ``fn(audit) -> [Finding]``. The decorator wires
+    severity and rule name into every finding so passes only state
+    location + message."""
+    def deco(fn):
+        def wrapped(audit: ProgramAudit) -> List[Finding]:
+            return [
+                f if f.rule else replace(
+                    f, rule=name, severity=f.severity or severity,
+                    program=f.program or audit.name)
+                for f in fn(audit)
+            ]
+        _RULES[name] = RuleSpec(name=name, severity=severity,
+                                doc=(fn.__doc__ or "").strip(),
+                                fn=wrapped)
+        return fn
+    return deco
+
+
+def finding(location: str, message: str) -> Finding:
+    """Rule-internal shorthand: rule/severity/program are filled in by
+    the ``@rule`` wrapper."""
+    return Finding(rule="", severity="", location=location,
+                   message=message)
+
+
+def registered_rules() -> List[RuleSpec]:
+    return list(_RULES.values())
+
+
+def run_rules(audit: ProgramAudit,
+              only: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Evaluate registered passes over one program; publish the
+    always-on per-rule counters (zero-count series included, so a
+    dashboard can tell 'rule ran clean' from 'rule never ran')."""
+    names = list(only) if only is not None else list(_RULES)
+    unknown = [n for n in names if n not in _RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown graph_lint rule(s) {unknown}; registered: "
+            f"{sorted(_RULES)}")
+    findings: List[Finding] = []
+    for n in names:
+        findings.extend(_RULES[n].fn(audit))
+    publish_findings(findings, rules_evaluated=names)
+    return findings
+
+
+def publish_findings(findings: Iterable[Finding],
+                     rules_evaluated: Iterable[str] = ()) -> None:
+    """lint.findings_total{rule=} — ALWAYS-on (bypasses the metrics
+    gate): lint debt is a fleet-health signal whether or not anyone
+    armed per-host telemetry, same contract as train_recompiles_total."""
+    per: Dict[str, int] = {n: 0 for n in rules_evaluated}
+    for f in findings:
+        per[f.rule] = per.get(f.rule, 0) + 1
+    for name, count in per.items():
+        _obs.counter("lint.findings_total", _always=True,
+                     rule=name).add(count)

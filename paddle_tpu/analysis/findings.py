@@ -1,0 +1,121 @@
+"""Findings + baselines: graph_lint's structured output contract.
+
+Every rule emits ``Finding`` records — severity, a ``path:op`` location
+(the HLO metadata op_name path or jax arg path, then the opcode), and a
+message naming the hazard and the bytes at stake. Findings fingerprint
+deterministically so a **baseline** file can pin the currently-accepted
+set: CI gates on *new* findings only (the RecompileSentinel/tpu_doctor
+philosophy applied pre-launch — an auditor that cries on day-one debt
+gets turned off; one that catches regressions gets trusted).
+
+Baseline semantics (DESIGN.md "Static analysis"):
+- a baseline maps fingerprint -> human-readable summary, so the file is
+  reviewable in a PR diff (an opaque hash list hides what was waived);
+- ``new_findings(findings, baseline)`` filters to fingerprints absent
+  from the baseline — those gate (exit 1);
+- re-anchor deliberately with ``--write-baseline`` after triaging, the
+  same flow as tier1_budget's rebalance policy.
+
+This module imports no jax: the source-lint pass and the repo_lint CLI
+must run without paying a backend import.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+__all__ = [
+    "Finding", "fingerprint", "load_baseline", "write_baseline",
+    "new_findings", "format_findings", "exit_code",
+]
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location.
+
+    ``location`` follows the ``path:op`` convention: the most precise
+    stable path available (HLO metadata op_name, jax argument path,
+    ``axis`` stream, or ``file:line`` for source findings), a colon,
+    then the op (HLO opcode, ``parameter``, collective op name, or the
+    lint check name)."""
+    rule: str
+    severity: str
+    location: str
+    message: str
+    program: str = ""
+
+    def fingerprint(self) -> str:
+        return fingerprint(self.rule, self.program, self.location)
+
+    def summary(self) -> str:
+        prog = f"[{self.program}] " if self.program else ""
+        return (f"{self.severity.upper():<7} {self.rule:<22} {prog}"
+                f"{self.location}: {self.message}")
+
+
+def fingerprint(rule: str, program: str, location: str) -> str:
+    """Stable identity of a finding for baseline membership. Deliberately
+    excludes the message: byte counts and instruction suffixes may drift
+    with compiler versions while the (rule, program, location) triple
+    names the same accepted hazard."""
+    raw = "|".join((rule, program, location))
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Fingerprints accepted by a baseline file (empty set when the
+    file does not exist — a missing baseline means everything is
+    new)."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("fingerprints", {}))
+
+
+def write_baseline(findings: Iterable[Finding], path: str) -> dict:
+    """Re-anchor: accept the current findings. The file keeps a human
+    summary per fingerprint so the waiver is reviewable in diffs."""
+    data = {
+        "version": 1,
+        "fingerprints": {
+            f.fingerprint(): f.summary() for f in findings
+        },
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def new_findings(findings: Iterable[Finding],
+                 baseline: Optional[Set[str]] = None) -> List[Finding]:
+    """The findings that gate: everything not waived by the baseline."""
+    base = baseline or set()
+    return [f for f in findings if f.fingerprint() not in base]
+
+
+def format_findings(findings: Iterable[Finding],
+                    baseline: Optional[Set[str]] = None) -> str:
+    base = baseline or set()
+    lines = []
+    for f in findings:
+        tag = "  (baselined)" if f.fingerprint() in base else ""
+        lines.append(f.summary() + tag)
+    return "\n".join(lines)
+
+
+def exit_code(findings: Iterable[Finding],
+              baseline: Optional[Set[str]] = None) -> int:
+    """CI contract: exit 1 iff any NEW finding (any severity — a rule
+    that should not gate belongs in the baseline or a config
+    threshold, not in a severity loophole)."""
+    return 1 if new_findings(findings, baseline) else 0

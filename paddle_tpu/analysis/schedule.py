@@ -1,0 +1,214 @@
+"""Collective-schedule extraction + cross-rank/stage verification.
+
+The deadliest pod failure is also the simplest: one rank's traced
+program issues a different collective sequence than its peers — a
+python branch on ``rank``, a stage that skips a sync, a bucket layout
+that diverged — and the fleet deadlocks at runtime with every rank
+blocked in a different collective. PR 4's flight recorder catches this
+*post-mortem* (per-(axis, op) seq tables diffed by tpu_doctor); this
+module catches it **pre-launch**: collectives are counted at TRACE
+time (collective._record's documented counting — in-trace collectives
+count once per trace, which IS the per-program collective inventory in
+program order), so capturing during ``lower()`` yields the exact
+static schedule the executable will replay, before anything is
+dispatched.
+
+Contract shared with the flight recorder (DESIGN.md "Static
+analysis"): entries are stamped with the same monotonically increasing
+per-(axis, op) sequence numbers the recorder emits at runtime — a lint
+finding ``allreduce_sum@dp seq 3 missing on rank1`` names the same
+event tpu_doctor would have named after the hang.
+
+Capture is a context manager arming ``collective._schedule_capture``;
+everything routed through ``collective._record`` lands in it —
+collective.py's public ops, comm.py's fused/quantized buckets (with
+algo/compress/elements meta), and the spmd_1f1b ring ppermutes.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .findings import Finding
+
+__all__ = [
+    "capture_collective_schedule", "schedule_of", "assign_seqs",
+    "verify_collective_schedules",
+]
+
+
+@contextmanager
+def capture_collective_schedule():
+    """Arm the trace-time capture; yields the (live) entry list.
+
+    Use around anything that traces/lowers a program::
+
+        with capture_collective_schedule() as entries:
+            engine.aot_lower_train(x, y)
+
+    Nesting-safe (the previous capture list is restored); entries are
+    finalized with per-(axis, op) seq numbers on exit."""
+    from ..distributed import collective as _coll
+
+    entries: List[dict] = []
+    prev = _coll._schedule_capture
+    _coll._schedule_capture = entries
+    try:
+        yield entries
+    finally:
+        _coll._schedule_capture = prev
+        entries[:] = assign_seqs(entries)
+
+
+def schedule_of(thunk: Callable[[], Any]) -> List[dict]:
+    """Capture the collective schedule a thunk traces (the thunk's
+    return value is discarded — lower, don't run)."""
+    with capture_collective_schedule() as entries:
+        thunk()
+    return list(entries)
+
+
+def assign_seqs(entries: List[dict]) -> List[dict]:
+    """Stamp the flight recorder's seq convention: per-(axis, op)
+    counters starting at 1, in capture order (idempotent)."""
+    counters: Dict[Tuple[Optional[str], str], int] = {}
+    out = []
+    for e in entries:
+        key = (e.get("axis"), e["op"])
+        counters[key] = counters.get(key, 0) + 1
+        e = dict(e)
+        e["seq"] = counters[key]
+        out.append(e)
+    return out
+
+
+def _sig(e: dict) -> tuple:
+    """The static signature two ranks must agree on: (axis, op, shapes,
+    dtypes, bytes) — plus the fused-collective meta (elements) when
+    present, so a diverged bucket layout with equal wire bytes still
+    mismatches."""
+    meta = e.get("meta") or {}
+    return (e.get("axis"), e["op"],
+            tuple(tuple(s) for s in e.get("shapes", ())),
+            tuple(e.get("dtypes", ())), e.get("bytes"),
+            meta.get("elements"))
+
+
+def _sig_str(e: dict) -> str:
+    shapes = ",".join("x".join(map(str, s))
+                      for s in e.get("shapes", ())) or "-"
+    return (f"{e['op']}@{e.get('axis') or 'replica'} "
+            f"seq={e.get('seq', '?')} shapes={shapes} "
+            f"dtypes={','.join(e.get('dtypes', ())) or '-'} "
+            f"bytes={e.get('bytes')}")
+
+
+def verify_collective_schedules(
+        schedules: Dict[str, List[dict]]) -> List[Finding]:
+    """Prove all ranks/stages issue MATCHING static collective
+    sequences; name the divergent program and the (axis, op, seq)
+    where it splits — tpu_doctor's divergence diff, pre-launch.
+
+    The reference sequence is the majority (programs grouped by full
+    signature stream; largest group wins, first name breaks ties).
+    Findings, most-specific first:
+
+    - a program missing collectives on an (axis, op) stream (the
+      deadlock: peers block in ``seq N`` it never issues);
+    - extra collectives on a stream (same deadlock, other side);
+    - equal counts but a signature/order mismatch at position i.
+    """
+    names = sorted(schedules)
+    if len(names) < 2:
+        return []
+    streams = {n: [_sig(e) for e in assign_seqs(list(schedules[n]))]
+               for n in names}
+    groups: Dict[tuple, List[str]] = {}
+    for n in names:
+        groups.setdefault(tuple(streams[n]), []).append(n)
+    if len(groups) == 1:
+        return []
+    ref_members = max(groups.values(),
+                      key=lambda ms: (len(ms), ms[0] == names[0]))
+    ref_name = ref_members[0]
+    ref = schedules[ref_name]
+    ref_entries = assign_seqs(list(ref))
+    findings: List[Finding] = []
+    for n in names:
+        if n in ref_members:
+            continue
+        mine = assign_seqs(list(schedules[n]))
+        # per-(axis, op) stream counts first: a MISSING collective is
+        # the headline (that is the hang), order skew second
+        ref_counts: Dict[Tuple[Optional[str], str], int] = {}
+        for e in ref_entries:
+            k = (e.get("axis"), e["op"])
+            ref_counts[k] = ref_counts.get(k, 0) + 1
+        my_counts: Dict[Tuple[Optional[str], str], int] = {}
+        for e in mine:
+            k = (e.get("axis"), e["op"])
+            my_counts[k] = my_counts.get(k, 0) + 1
+        # first position where the raw streams stop agreeing — the
+        # earliest call peers and this rank no longer line up on
+        # (which statically-identical calls were skipped is
+        # undecidable from counts alone, so the message reports the
+        # seq-table REACH per stream — the tpu_doctor diff — plus
+        # this position, never a guessed tail range)
+        my_stream = streams[n]
+        ref_stream = [_sig(e) for e in ref_entries]
+        first_div = next(
+            (i for i, (a, b) in enumerate(zip(ref_stream, my_stream))
+             if a != b), min(len(ref_stream), len(my_stream)))
+        count_diff = False
+        for k in sorted(set(ref_counts) | set(my_counts),
+                        key=lambda kk: (kk[0] or "", kk[1])):
+            axis, op = k
+            r, m = ref_counts.get(k, 0), my_counts.get(k, 0)
+            if r == m:
+                continue
+            count_diff = True
+            loc = f"{axis or 'replica'}:{op}"
+            if m < r:
+                findings.append(Finding(
+                    rule="collective-schedule", severity="error",
+                    location=loc, program=n,
+                    message=(f"{op} seq on axis {axis or 'replica'} "
+                             f"reaches {m} on this rank vs {r} on "
+                             f"the fleet majority "
+                             f"({len(ref_members)} program(s), e.g. "
+                             f"{ref_name}) — {r - m} collective(s) "
+                             "missing from this rank's stream (first "
+                             f"schedule divergence at position "
+                             f"{first_div + 1}); peers would "
+                             "deadlock waiting")))
+            else:
+                findings.append(Finding(
+                    rule="collective-schedule", severity="error",
+                    location=loc, program=n,
+                    message=(f"{op} seq on axis {axis or 'replica'} "
+                             f"reaches {m} on this rank vs {r} on "
+                             f"the fleet majority — {m - r} "
+                             "collective(s) have no peer (first "
+                             f"schedule divergence at position "
+                             f"{first_div + 1}); this rank would "
+                             "deadlock waiting")))
+        if count_diff:
+            continue
+        # counts agree: first position whose signature differs
+        for i, (re_, me) in enumerate(zip(ref_entries, mine)):
+            if _sig(re_) == _sig(me):
+                continue
+            findings.append(Finding(
+                rule="collective-schedule", severity="error",
+                location=f"{me.get('axis') or 'replica'}:{me['op']}",
+                program=n,
+                message=(f"collective sequence diverges from "
+                         f"{ref_name} at position {i + 1}: expected "
+                         f"{_sig_str(re_)}, got {_sig_str(me)} — "
+                         "mismatched payloads corrupt silently when "
+                         "they do not deadlock")))
+            break
+    # counters ride the same always-on series as the per-program rules
+    from .engine import publish_findings
+    publish_findings(findings, rules_evaluated=("collective-schedule",))
+    return findings

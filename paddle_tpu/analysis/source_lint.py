@@ -1,0 +1,243 @@
+"""Source pass: observability calls must gate on ``_obs._enabled``.
+
+The recurring PR 4/PR 5 review lesson, now enforced instead of
+re-learned: a metrics-instrument call (``_obs.counter(...)``,
+``metrics.gauge(...)``, ``_obs.histogram(...)``) builds label dicts and
+formats names BEFORE the registry's internal gate can reject the work —
+on the eager-dispatch and collective hot paths that is real per-call
+cost. Every call site in ``paddle_tpu/`` must therefore either:
+
+- sit under an ``if <alias>._enabled`` guard (any ancestor ``if`` /
+  conditional expression whose test reads an ``_enabled`` attribute or
+  calls ``enabled()``, or a preceding early-return guard in the same
+  function — collective._record's shape), or
+- declare itself always-on at the call site with ``_always=True``
+  (cold-path exporters, contract counters like
+  ``train_recompiles_total`` — an explicit, reviewable opt-out), or
+- appear in ``ALLOWLIST`` with a reason.
+
+This is an AST pass, not a grep: aliases are resolved from imports, so
+``from ..observability import metrics as _obs`` and
+``from . import metrics`` are both covered, and a call inside a guarded
+helper is distinguished from an unguarded one. Findings use the shared
+``Finding`` shape (rule ``obs-gate``, location ``file:line``), so the
+graph_lint CLI can run this as its "source" pass and tools/repo_lint.py
+stays a thin shim. Imports no jax.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+__all__ = ["ALLOWLIST", "lint_source", "lint_file", "lint_package"]
+
+_INSTRUMENTS = {"counter", "gauge", "histogram"}
+
+# "<relpath>::<qualified fn>" -> reason. The two legitimate ungated
+# call sites: explicit PUBLISH surfaces, where the user's call is
+# itself the opt-in and the registry's internal gate still applies —
+# cold paths by contract (a rollup per report, not per step).
+ALLOWLIST: Dict[str, str] = {
+    "paddle_tpu/observability/mfu.py::ThroughputMeter.report":
+        "explicit publish surface: one gauge rollup per report() call "
+        "(bench/CLI cadence), never on the step hot path",
+    "paddle_tpu/profiler/__init__.py::StepClock.publish":
+        "explicit publish surface: pushes clock stats once when the "
+        "caller asks; pipeline_bench cadence, not per tick",
+}
+
+
+def _attr_src(node: ast.AST) -> str:
+    """Best-effort dotted-source rendering for guard tests."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — very old ast
+        return ""
+
+
+def _mentions_gate(test: ast.AST,
+                   gate_vars: Optional[Set[str]] = None) -> bool:
+    """Does an if/while/conditional test read an ``_enabled``
+    attribute, call ``enabled()``, or read a local bool previously
+    assigned from one (the ``_rec = _obs._enabled`` idiom the engines
+    use to read the gate once per step)?"""
+    vars_ = gate_vars or set()
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "_enabled", "enabled"):
+            return True
+        if isinstance(sub, ast.Name) and (
+                sub.id == "_enabled" or sub.id in vars_):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Name) and fn.id == "enabled":
+                return True
+            if isinstance(fn, ast.Attribute) and fn.attr == "enabled":
+                return True
+    return False
+
+
+def _gate_var_targets(stmt: ast.AST) -> Set[str]:
+    """Names bound by an assignment whose value reads a gate
+    (``_rec = _obs._enabled`` / ``a, b = x._enabled, y._enabled``)."""
+    if not isinstance(stmt, ast.Assign) or not _mentions_gate(
+            stmt.value):
+        return set()
+    out: Set[str] = set()
+    for tgt in stmt.targets:
+        for sub in ast.walk(tgt):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    return out
+
+
+def _metric_aliases(tree: ast.Module) -> Set[str]:
+    """Names this module binds to the observability metrics module."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if a.name == "metrics" and (
+                        "observability" in mod or node.level > 0
+                        or mod == ""):
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("observability.metrics"):
+                    aliases.add(a.asname or a.name.split(".")[0])
+    return aliases
+
+
+def _qualname_of(stack: List[ast.AST]) -> str:
+    parts = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(parts) or "<module>"
+
+
+def _has_always_kw(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "_always":
+            # any non-False value counts (literal True is the idiom);
+            # a computed value is an explicit decision either way
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return False
+
+
+def _guarded(stack: List[ast.AST], call: ast.Call) -> bool:
+    """Ancestor if/ifexp/while gate, or a preceding early-return gate
+    (``if not ..._enabled...: return``) in the nearest function. Local
+    bools assigned from a gate read earlier in that function count as
+    gates (``_rec = _obs._enabled; ... if _rec:``)."""
+    # collect gate-vars bound before the call in the nearest function
+    gate_vars: Set[str] = set()
+    for anc in reversed(stack):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in ast.walk(anc):
+                if getattr(stmt, "lineno", call.lineno) < call.lineno:
+                    gate_vars |= _gate_var_targets(stmt)
+            break
+    for anc in reversed(stack):
+        if isinstance(anc, (ast.If, ast.IfExp, ast.While)) and \
+                _mentions_gate(anc.test, gate_vars):
+            return True
+        if isinstance(anc, ast.BoolOp):
+            if any(_mentions_gate(v, gate_vars) for v in anc.values):
+                return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in anc.body:
+                if stmt.lineno >= call.lineno:
+                    break
+                if isinstance(stmt, ast.If) and \
+                        _mentions_gate(stmt.test, gate_vars) and any(
+                            isinstance(s, (ast.Return, ast.Raise))
+                            for s in ast.walk(stmt)):
+                    return True
+            return False  # nearest function decides
+    return False
+
+
+def lint_source(text: str, relpath: str,
+                allowlist: Optional[Dict[str, str]] = None
+                ) -> List[Finding]:
+    """Lint one module's source text; ``relpath`` names it in findings
+    and allowlist keys (posix-style, repo-relative)."""
+    allow = ALLOWLIST if allowlist is None else allowlist
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:  # a broken file is its own finding
+        return [Finding(
+            rule="obs-gate", severity="error",
+            location=f"{relpath}:{e.lineno or 0}",
+            message=f"unparseable python: {e.msg}")]
+    aliases = _metric_aliases(tree)
+    if not aliases:
+        return []
+    findings: List[Finding] = []
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _INSTRUMENTS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in aliases:
+            if not _has_always_kw(node) and not _guarded(stack, node):
+                qual = _qualname_of(stack)
+                key = f"{relpath}::{qual}"
+                if key not in allow:
+                    findings.append(Finding(
+                        rule="obs-gate", severity="error",
+                        location=f"{relpath}:{node.lineno}",
+                        message=(
+                            f"{_attr_src(node.func)}() in {qual} runs "
+                            "ungated: wrap in `if "
+                            f"{node.func.value.id}._enabled:` (hot "
+                            "path) or pass `_always=True` (deliberate "
+                            "always-on contract counter) — the PR 4/5 "
+                            "telemetry-cost lesson, enforced")))
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        stack.pop()
+
+    visit(tree)
+    return findings
+
+
+def lint_file(path: str, root: Optional[str] = None,
+              allowlist: Optional[Dict[str, str]] = None
+              ) -> List[Finding]:
+    rel = os.path.relpath(path, root).replace(os.sep, "/") if root \
+        else os.path.basename(path)
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), rel, allowlist)
+
+
+def lint_package(package_dir: Optional[str] = None,
+                 allowlist: Optional[Dict[str, str]] = None
+                 ) -> List[Finding]:
+    """Lint every .py under paddle_tpu/ (or an explicit directory).
+    Returns findings sorted by location for stable output."""
+    if package_dir is None:
+        package_dir = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    root = os.path.dirname(os.path.abspath(package_dir))
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(
+                    os.path.join(dirpath, fn), root, allowlist))
+    findings.sort(key=lambda f: f.location)
+    from .engine import publish_findings
+    publish_findings(findings, rules_evaluated=("obs-gate",))
+    return findings

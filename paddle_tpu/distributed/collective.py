@@ -99,8 +99,46 @@ def _payload_bytes(*tensors) -> int:
     return total
 
 
+# graph_lint schedule capture (analysis.schedule): when armed, every
+# _record call appends its static signature (op, axis, shapes, dtypes,
+# bytes) to this list AT TRACE TIME — the per-program collective
+# inventory in the exact order the flight recorder would stamp seq
+# numbers at runtime. One `is not None` read when disarmed; armed only
+# inside analysis.capture_collective_schedule().
+_schedule_capture: Optional[List[dict]] = None
+
+
+def _capture_entry(op: str, axis: Optional[str], tensors,
+                   nbytes: Optional[int], meta=None) -> dict:
+    shapes, dtypes = [], []
+    for t in tensors:
+        for leaf in jax.tree_util.tree_leaves(t):
+            if isinstance(leaf, Tensor):
+                leaf = leaf._data
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            shapes.append([int(d) for d in shape])
+            try:
+                dtypes.append(str(np.dtype(dtype)))
+            except TypeError:
+                dtypes.append(str(dtype))
+    entry = {
+        "op": op,
+        "axis": axis,
+        "shapes": shapes,
+        "dtypes": dtypes,
+        "bytes": int(nbytes) if nbytes is not None
+        else _payload_bytes(*tensors),
+    }
+    if meta:
+        entry["meta"] = dict(meta)
+    return entry
+
+
 def _record(op: str, axis: Optional[str], *tensors,
-            nbytes: Optional[int] = None):
+            nbytes: Optional[int] = None, meta=None):
     """Collective telemetry (EQuARX's premise: per-collective speedups
     must be measured, so every collective reports op count + payload
     bytes — and, one level deeper, per-collective SEQUENCING: the
@@ -119,7 +157,13 @@ def _record(op: str, axis: Optional[str], *tensors,
 
     `nbytes` overrides the payload walk for callers whose wire bytes
     differ from the tensor bytes (comm.py's fused/quantized collectives
-    report COMPRESSED on-wire bytes, the receipt comm_bench pins)."""
+    report COMPRESSED on-wire bytes, the receipt comm_bench pins);
+    `meta` rides only the graph_lint schedule capture (comm.py attaches
+    algo/compress/elements so the lint verifier can compare fused
+    collectives whose payload never appears as a tensor here)."""
+    if _schedule_capture is not None:
+        _schedule_capture.append(
+            _capture_entry(op, axis, tensors, nbytes, meta))
     if not (_obs._enabled or _fr._enabled):
         return None
     if nbytes is None:

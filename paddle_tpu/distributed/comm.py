@@ -416,19 +416,25 @@ def _allreduce_flat(flat, axes: Tuple[str, ...], algo: str,
 # ---------------------------------------------------------------------------
 
 def _record_fused(algo: str, compress: str, axes: Tuple[str, ...],
-                  nbytes: int):
+                  nbytes: int, elements: Optional[int] = None):
     """comm.* counters + the collective telemetry plane (one
     collective.enter/exit pair with a per-(axis, op) seq number per
     fused collective — the doctor's divergence signal covers bucketed
     grad sync). Returns the exit hook or None. Imports are module
     level — this sits on the collective dispatch path, where the
     disabled cost must stay one bool read (the _payload_bytes lesson
-    from PR 4)."""
+    from PR 4). `elements` (the flat bucket length) rides the
+    graph_lint schedule capture as meta so the pre-launch verifier can
+    diff fused collectives by payload, not just wire bytes — a rank
+    whose bucket layout diverged has matching op/axis but different
+    element counts."""
     if _obs._enabled:
         _obs.counter("comm.algo", algo=algo, compress=compress).add(1)
         _obs.counter("comm.wire_bytes").add(nbytes)
     axis_label = "+".join(axes) if axes else None
-    return _record(f"fused_allreduce_{algo}", axis_label, nbytes=nbytes)
+    return _record(f"fused_allreduce_{algo}", axis_label, nbytes=nbytes,
+                   meta={"algo": algo, "compress": compress,
+                         "elements": elements})
 
 
 # ---------------------------------------------------------------------------
@@ -482,7 +488,8 @@ def planned_all_reduce(tensor, config: Optional[CommConfig] = None,
     algo = choose_algorithm(nbytes, live, plan_cfg)
     wire = _wire_bytes(algo, compress, int(x.size),
                        x.dtype.itemsize, config.int8_block)
-    done = _record_fused(algo, compress, live, wire)
+    done = _record_fused(algo, compress, live, wire,
+                         elements=int(x.size))
 
     def impl(a):
         # "grad_sync" anatomy scope: the collective lowers with the
@@ -569,7 +576,8 @@ class GradSynchronizer:
             wire = _wire_bytes(algo, compress, spec.num_elements,
                                np.dtype(spec.dtype).itemsize,
                                cfg.int8_block)
-            done = _record_fused(algo, compress, live, wire)
+            done = _record_fused(algo, compress, live, wire,
+                                 elements=spec.num_elements)
             rkey = spec.residual_key
             res = state.get(rkey)
             if compress == "int8_ef" and res is None:

@@ -49,6 +49,7 @@ from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from ..observability.anatomy import scope as _scope
 from ..observability.sentinel import RecompileSentinel, signature_of
+from .collective import _record as _record_collective
 
 __all__ = ["PipelineParallel", "build_1f1b_schedule", "stage_submeshes"]
 
@@ -864,10 +865,20 @@ class PipelineParallel:
                 gx_b, gacc = lax.cond(ba == 1, do_b,
                                       lambda g: (zeros_act, g), gacc)
                 # "pp_ring" anatomy scope: the inter-stage activation/
-                # grad transfers — xprof splits ring time from compute
+                # grad transfers — xprof splits ring time from compute.
+                # Routed through collective._record so the ring hops
+                # land in the flight-recorder seq tables and the
+                # graph_lint schedule capture (trace-time counting:
+                # once per program — the scan body traces once — which
+                # IS the per-program collective inventory the doctor
+                # and the pre-launch verifier both diff).
                 with _scope("pp_ring"):
+                    done = _record_collective("ppermute", axis, y_f)
                     act_in = lax.ppermute(y_f, axis, perm_fwd)
+                    done and done()
+                    done = _record_collective("ppermute", axis, gx_b)
                     dy_in = lax.ppermute(gx_b, axis, perm_bwd)
+                    done and done()
                 return (act_in, dy_in, actbuf, dybuf, gacc,
                         losses), None
 
@@ -934,10 +945,15 @@ class PipelineParallel:
         return sum(int(f._cache_size())
                    for f in self._spmd_steps.values())
 
-    def aot_lower_train(self, inputs, labels=(), scaler=None):
+    def aot_lower_train(self, inputs, labels=(), scaler=None,
+                        _fresh_step: bool = False):
         """AOT-lower the ONE-program train step (spmd_1f1b only) —
         separate from the jit call cache, so observation (MFU FLOPs,
-        anatomy scope shares) never trips the recompile sentinel."""
+        anatomy scope shares) never trips the recompile sentinel.
+        ``_fresh_step`` traces a throwaway jit object instead of the
+        engine's cached one (jit.lower reuses the cached jaxpr, so a
+        second lower of the SAME jit object never re-runs the python —
+        trace-time capture needs a genuinely fresh trace)."""
         if self.exec_mode != "spmd_1f1b":
             raise ValueError(
                 "aot_lower_train needs exec_mode='spmd_1f1b' (the "
@@ -950,10 +966,15 @@ class PipelineParallel:
             else (labels,)
         x = self._spmd_micro(_unwrap_tree(inputs[0]))
         lbl = self._spmd_micro(_unwrap_tree(tuple(labels)))
-        step = self._spmd_steps.get(use_scaler)
-        if step is None:
-            step = self._spmd_steps[use_scaler] = \
-                self._build_spmd_step(use_scaler)
+        if _fresh_step:
+            # local object, never cached: the engine's compile_count /
+            # sentinel bookkeeping must not see observation traces
+            step = self._build_spmd_step(use_scaler)
+        else:
+            step = self._spmd_steps.get(use_scaler)
+            if step is None:
+                step = self._spmd_steps[use_scaler] = \
+                    self._build_spmd_step(use_scaler)
         # constant key, NOT next_key(): lowering only needs the aval,
         # and observation must not advance the training RNG stream
         # (bit-for-bit parity discipline)
@@ -961,6 +982,22 @@ class PipelineParallel:
             self.params, self.opt_state, jax.random.key(0),
             jnp.asarray(0.0, jnp.float32),
             jnp.asarray(1.0, jnp.float32), x, lbl)
+
+    def train_collective_schedule(self, inputs, labels=(), scaler=None):
+        """Static per-(axis, op) collective sequence of the ONE-program
+        train step, captured at trace time over a fresh lowering
+        (spmd_1f1b only). Same seq convention the flight recorder
+        stamps at runtime — this is the pre-launch side of
+        tools/tpu_doctor.py's divergence diff: feed per-rank/per-stage
+        schedules to ``analysis.verify_collective_schedules`` and a
+        rank that statically skips a collective is named before
+        dispatch (constant key, no RNG advance — same observation
+        discipline as train_flops_per_step)."""
+        from ..analysis.schedule import capture_collective_schedule
+        with capture_collective_schedule() as entries:
+            self.aot_lower_train(inputs, labels, scaler,
+                                 _fresh_step=True)
+        return list(entries)
 
     def train_flops_per_step(self, inputs, labels=(),
                              scaler=None) -> float:
@@ -1096,7 +1133,9 @@ class PipelineParallel:
                     is_last & active,
                     lax.dynamic_update_index_in_dim(outs, y, mbc, 0),
                     outs)
+                done = _record_collective("ppermute", axis, y)
                 act_in = lax.ppermute(y, axis, perm_fwd)
+                done and done()
                 return (act_in, outs), None
 
             carry0 = (jnp.zeros_like(x0), jnp.zeros_like(x))

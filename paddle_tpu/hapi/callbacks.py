@@ -193,20 +193,27 @@ class MetricsLogger(Callback):
     def on_train_batch_end(self, step, logs=None):
         from ..observability import metrics
         self._batches += 1
-        metrics.counter("train.batches_total").add(1)
-        loss = (logs or {}).get("loss")
-        if isinstance(loss, (list, tuple)) and loss:
-            loss = loss[0]
-        if isinstance(loss, (int, float)):
-            metrics.gauge("train.loss").set(round(float(loss), 6))
-        now = time.perf_counter()
-        if self.batch_size and self._t_last is not None \
-                and now > self._t_last:
-            metrics.gauge("throughput.examples_per_sec").set(
-                round(self.batch_size / (now - self._t_last), 3))
-            metrics.counter("throughput.examples_total").add(
-                self.batch_size)
-        self._t_last = now
+        # per-batch path: gate before building any instrument lookup
+        # (the registry would no-op anyway, but the name/label work
+        # runs first — the repo_lint obs-gate rule). Behavior is
+        # unchanged: a disabled registry recorded nothing before too.
+        if metrics._enabled:
+            metrics.counter("train.batches_total").add(1)
+            loss = (logs or {}).get("loss")
+            if isinstance(loss, (list, tuple)) and loss:
+                loss = loss[0]
+            if isinstance(loss, (int, float)):
+                metrics.gauge("train.loss").set(round(float(loss), 6))
+            now = time.perf_counter()
+            if self.batch_size and self._t_last is not None \
+                    and now > self._t_last:
+                metrics.gauge("throughput.examples_per_sec").set(
+                    round(self.batch_size / (now - self._t_last), 3))
+                metrics.counter("throughput.examples_total").add(
+                    self.batch_size)
+            self._t_last = now
+        else:
+            self._t_last = time.perf_counter()
         if self._batches % self.log_freq == 0:
             self._export(step=self._batches)
 

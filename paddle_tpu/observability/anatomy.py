@@ -166,9 +166,10 @@ _LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
 _FEATURE_GROUP_RE = re.compile(r"feature_group_count=(\d+)")
 _DIM_LABELS_RE = re.compile(r"dim_labels=\w+_(\w+)->")
 
-_ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
-             "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8,
-             "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+_ITEMSIZE = {"pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2,
+             "u16": 2, "f16": 2, "bf16": 2, "f8e4m3": 1, "f8e5m2": 1,
+             "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+             "f64": 8, "c64": 8, "c128": 16}
 
 # opcodes priced at 1 FLOP per result element (arithmetic +
 # transcendental — precision of the per-op constant washes out of a

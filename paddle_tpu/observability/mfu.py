@@ -160,9 +160,14 @@ class ThroughputMeter:
         if seconds is None or seconds <= 0:
             return self
         self._steps_s.append(float(seconds))
-        metrics.counter("throughput.examples_total").add(
-            self.examples_per_step)
-        metrics.histogram(f"{self.name}.step_ms").observe(seconds * 1e3)
+        # per-step path: gate before the instrument name/label work
+        # (the repo_lint obs-gate rule; the registry would no-op the
+        # disabled write anyway)
+        if metrics._enabled:
+            metrics.counter("throughput.examples_total").add(
+                self.examples_per_step)
+            metrics.histogram(f"{self.name}.step_ms").observe(
+                seconds * 1e3)
         return self
 
     # -- reporting -----------------------------------------------------------

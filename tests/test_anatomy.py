@@ -221,6 +221,9 @@ def test_publish_rides_exporters_and_report_table():
         pytest.approx(1024.0 / 1088.0, abs=1e-4)
 
 
+@pytest.mark.slow  # 9.7 s (live steps + fresh compiles); the 12
+#   anatomy units + ernie_step_scope_shares keep the static tier,
+#   test_obs_report_smoke keeps the CLI surface
 def test_obs_report_anatomy_bridge(monkeypatch, capsys):
     # the --anatomy bridge runs the receipt end to end (in-process: the
     # CLI path is identical minus interpreter startup). Micro shapes to

@@ -57,6 +57,8 @@ def control(tmp_path_factory):
     return {rank: _final(out, rank) for rank in (0, 1)}
 
 
+@pytest.mark.slow  # 14.1 s; hang-detection, rank-policy and
+#   max-restarts drills keep elastic recovery in tier-1
 def test_crash_detected_and_job_completes(tmp_path, control):
     r, out = _launch(tmp_path, "crash", "crash")
     assert r.returncode == 0, r.stderr[-3000:]
@@ -81,6 +83,8 @@ def test_hang_detected_by_heartbeat_and_job_completes(tmp_path, control):
     assert _final(out, 1)["incarnation"] >= 1
 
 
+@pytest.mark.slow  # 12.6 s; hang-detection + max-restarts drills
+#   keep elastic recovery in tier-1
 def test_rank_policy_restarts_only_dead_rank(tmp_path, control):
     r, out = _launch(tmp_path, "rankpol", "crash",
                      extra_launch=("--elastic_policy", "rank"))

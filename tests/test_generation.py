@@ -34,6 +34,8 @@ def _naive_greedy(model, ids, n_new):
 
 
 class TestKVCacheDecode:
+    @pytest.mark.slow  # 13.6 s; beam1_equals_greedy + ragged
+    #   rows_match_unbatched keep decode-parity in tier-1
     def test_greedy_matches_full_reforward(self, model):
         rng = np.random.RandomState(0)
         ids = rng.randint(0, 97, (2, 7)).astype(np.int32)
@@ -354,6 +356,8 @@ class TestTopP:
         b = int(_pick(logits, jax.random.key(7), 1.0, None, None)[0])
         assert a == b
 
+    @pytest.mark.slow  # 7.9 s; pick_semantics + validation/topk
+    #   siblings keep top-p in tier-1
     def test_generate_top_p_deterministic_and_in_range(self):
         import paddle_tpu as paddle
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM

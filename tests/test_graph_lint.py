@@ -1,0 +1,472 @@
+"""graph_lint (ISSUE 7 tentpole): the rules engine, each launch rule
+firing on a deliberately seeded violation with exit 1 and a path:op
+location, the collective-schedule verifier, the trace-time capture
+contract, and baseline semantics. All on CPU XLA; programs are tiny
+jit functions so each seed compiles in well under a second."""
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.analysis import (
+    Finding, GraphLintConfig, ProgramAudit, assign_seqs,
+    capture_collective_schedule, exit_code, format_findings,
+    iter_hlo_instructions, load_baseline, new_findings, run_rules,
+    verify_collective_schedules, write_baseline)
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed.env import axis_context
+from paddle_tpu.framework import Tensor
+from paddle_tpu.observability import metrics
+
+
+CFG = GraphLintConfig()
+
+
+def _arr(t):
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _lower(fn, *avals, donate=()):
+    return jax.jit(fn, donate_argnums=donate).lower(*avals)
+
+
+F32_1M = jax.ShapeDtypeStruct((512, 512), jnp.float32)  # 1.00 MiB
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: every launch rule fires, names a path:op, exits 1
+# ---------------------------------------------------------------------------
+
+class TestSeededViolations:
+    def test_dropped_donation_is_named(self):
+        # p is donated but never used: the donation dies at lowering
+        lo = _lower(lambda p, x: x * 2.0, F32_1M, F32_1M, donate=(0,))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # jax's own unused-donation
+            fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                           only=["donation"])
+        assert len(fs) == 1 and fs[0].rule == "donation"
+        assert fs[0].location.endswith(":parameter")
+        assert "never used" in fs[0].message
+        assert exit_code(fs) == 1
+
+    def test_unaliasable_donation_is_named(self):
+        # p is USED but the only output is bf16 — XLA cannot alias the
+        # f32 donation: the silent HBM-doubling case
+        lo = _lower(lambda p, x: (p + x).astype(jnp.bfloat16),
+                    F32_1M, F32_1M, donate=(0,))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                           only=["donation"])
+        assert len(fs) == 1
+        assert fs[0].severity == "error"
+        assert "NOT aliased" in fs[0].message
+        assert exit_code(fs) == 1
+
+    def test_clean_donation_passes(self):
+        lo = _lower(lambda p, x: p + x, F32_1M, F32_1M, donate=(0,))
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["donation"])
+        assert fs == [] and exit_code(fs) == 0
+
+    def test_baked_constant_is_named(self):
+        big = np.random.RandomState(0).rand(512, 512).astype(np.float32)
+        lo = _lower(lambda x: x + big, F32_1M)
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["baked-constant"])
+        assert len(fs) == 1 and fs[0].location.endswith(":constant")
+        assert "1.00 MiB" in fs[0].message
+        assert exit_code(fs) == 1
+
+    def test_argument_passed_constant_is_clean(self):
+        lo = _lower(lambda x, t: x + t, F32_1M, F32_1M)
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["baked-constant"])
+        assert fs == []
+
+    def test_f32_upcast_under_amp_is_named(self):
+        def h(a, b):
+            ab = a.astype(jnp.bfloat16) @ b.astype(jnp.bfloat16)
+            return (ab.astype(jnp.float32) ** 2).sum()
+        lo = _lower(h, jax.ShapeDtypeStruct((512, 640), jnp.float32),
+                    jax.ShapeDtypeStruct((640, 512), jnp.float32))
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["dtype-promotion"])
+        assert fs, "the explicit .astype(f32) upcast must be flagged"
+        assert all(f.location.endswith(":convert") for f in fs)
+        assert "bf16 -> f32" in fs[0].message
+        assert exit_code(fs) == 1
+
+    def test_implicit_replication_is_named(self):
+        mesh = dist.build_mesh({"dp": 8})
+        sm = jax.shard_map(
+            lambda x: jax.lax.all_gather(x, "dp", tiled=True),
+            mesh=mesh, in_specs=P("dp"), out_specs=P(),
+            check_vma=False)
+        lo = jax.jit(sm).lower(
+            jax.ShapeDtypeStruct((1024, 512), jnp.float32))
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["implicit-replication"])
+        assert len(fs) == 1
+        assert fs[0].location.endswith(":all-gather")
+        assert "all_gather" in fs[0].location  # scope path survives
+        assert exit_code(fs) == 1
+
+    def test_sharded_output_is_clean(self):
+        mesh = dist.build_mesh({"dp": 8})
+        sm = jax.shard_map(lambda x: x * 2.0, mesh=mesh,
+                           in_specs=P("dp"), out_specs=P("dp"),
+                           check_vma=False)
+        lo = jax.jit(sm).lower(
+            jax.ShapeDtypeStruct((1024, 512), jnp.float32))
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["implicit-replication"])
+        assert fs == []
+
+    def test_f32_full_table_copy_is_named(self):
+        # a donated buffer returned both raw and updated forces XLA to
+        # materialize a real full-size copy of the original
+        lo = _lower(lambda p: (p, p * 1.0 + 0.0),
+                    jax.ShapeDtypeStruct((1024, 512), jnp.float32),
+                    donate=(0,))
+        fs = run_rules(ProgramAudit("seed", lowered=lo, config=CFG),
+                       only=["f32-table-copy"])
+        assert len(fs) == 1 and fs[0].location.endswith(":copy")
+        assert "2.00 MiB" in fs[0].message
+        assert exit_code(fs) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule mechanics on hand-written HLO (the anatomy unit-test tier):
+# thresholds, exempt scopes, tuple results
+# ---------------------------------------------------------------------------
+
+_HLO_TEMPLATE = """\
+HloModule seed, is_scheduled=true, input_output_alias={{ {alias} }}, entry_computation_layout={{(f32[512,512]{{1,0}})->f32[512,512]{{1,0}}}}
+
+ENTRY %main (Arg_0.1: f32[512,512]) -> f32[512,512] {{
+  %Arg_0.1 = f32[512,512]{{1,0}} parameter(0)
+{body}
+}}
+"""
+
+
+def _hlo(body, alias="{0}: (0, {}, may-alias)"):
+    return _HLO_TEMPLATE.format(alias=alias, body=body)
+
+
+class TestRuleMechanics:
+    def test_exempt_scope_suppresses_promotion(self):
+        body = (
+            '  %convert.1 = f32[524288]{0} convert(bf16[524288]{0} '
+            '%a), metadata={op_name="jit(s)/jit(main)/loss_scale/'
+            'convert_element_type"}\n'
+            '  %convert.2 = f32[524288]{0} convert(bf16[524288]{0} '
+            '%b), metadata={op_name="jit(s)/jit(main)/attn/'
+            'convert_element_type"}\n'
+            '  %convert.3 = f32[524288]{0} convert(bf16[524288]{0} '
+            '%c)\n')
+        audit = ProgramAudit("hand", hlo_text=_hlo(body), config=CFG)
+        fs = run_rules(audit, only=["dtype-promotion"])
+        # loss_scale exempt; attn + unattributed flagged
+        assert len(fs) == 2
+        locs = sorted(f.location for f in fs)
+        assert locs[0].startswith("convert.3")          # no metadata
+        assert "attn" in locs[1]
+        assert all("loss_scale" not in f.location for f in fs)
+
+    def test_thresholds_gate_findings(self):
+        body = ('  %constant.9 = f32[1024]{0} constant({...})\n'
+                '  %copy.9 = f32[1024]{0} copy(f32[1024]{0} %x)\n')
+        audit = ProgramAudit("hand", hlo_text=_hlo(body), config=CFG)
+        assert run_rules(audit, only=["baked-constant",
+                                      "f32-table-copy"]) == []
+        tight = GraphLintConfig(constant_bytes=1024, copy_bytes=1024)
+        audit2 = ProgramAudit("hand", hlo_text=_hlo(body),
+                              config=tight)
+        fs = run_rules(audit2, only=["baked-constant",
+                                     "f32-table-copy"])
+        assert sorted(f.rule for f in fs) == ["baked-constant",
+                                              "f32-table-copy"]
+
+    def test_async_copy_start_tuple_result_is_parsed(self):
+        # the VERDICT r4 weakness was copy-START — a tuple-result
+        # instruction the old hand regex matched explicitly; the
+        # engine parser must not lose it (review regression: the
+        # single-shape type group skipped every multi-element tuple)
+        body = ('  %copy-start.1 = (f32[30528,768]{1,0}, '
+                'f32[30528,768]{1,0}, u32[]) copy-start('
+                'f32[30528,768]{1,0} %table)\n')
+        audit = ProgramAudit("hand", hlo_text=_hlo(body), config=CFG)
+        fs = run_rules(audit, only=["f32-table-copy"])
+        assert len(fs) == 1 and fs[0].location.endswith(":copy-start")
+        assert "89." in fs[0].message  # 89.41 MiB table
+
+    def test_tpu_tiled_layouts_and_copy_done_still_detected(self):
+        # review regression x2: real TPU dumps print tiling parens
+        # inside the tuple layout ({1,0:T(8,128)}) which a naive
+        # [^)]* tuple match stops at; and the done half of the async
+        # pair must trip the rule on its own (legacy hlo_copy_audit
+        # op set) so detection never hinges on one line parsing
+        body = (
+            '  %copy-start.3 = (f32[30528,768]{1,0:T(8,128)}, '
+            'f32[30528,768]{1,0:T(8,128)}, u32[]{:T(128)}) '
+            'copy-start(f32[30528,768]{1,0:T(8,128)} %table)\n'
+            '  %copy-done.3 = f32[30528,768]{1,0:T(8,128)} '
+            'copy-done((f32[30528,768]{1,0:T(8,128)}, '
+            'f32[30528,768]{1,0:T(8,128)}, u32[]{:T(128)}) '
+            '%copy-start.3)\n')
+        audit = ProgramAudit("hand", hlo_text=_hlo(body), config=CFG)
+        fs = run_rules(audit, only=["f32-table-copy"])
+        assert sorted(f.location.rsplit(":", 1)[1] for f in fs) == \
+            ["copy-done", "copy-start"]
+
+    def test_async_all_gather_start_sizes_by_largest_member(self):
+        # async all-gather tuple is (input shard, full output): the
+        # materialized buffer is the LARGEST member, not the first
+        body = ('  %all-gather-start.2 = (f32[128,512]{1,0}, '
+                'f32[1024,512]{1,0}) all-gather-start('
+                'f32[128,512]{1,0} %shard), replica_groups={{0,1,2,3,'
+                '4,5,6,7}}, dimensions={0}\n')
+        audit = ProgramAudit("hand", hlo_text=_hlo(body), config=CFG)
+        fs = run_rules(audit, only=["implicit-replication"])
+        assert len(fs) == 1
+        assert fs[0].location.endswith(":all-gather-start")
+        assert "2.00 MiB" in fs[0].message
+
+    def test_instruction_parser_reads_metadata_and_bytes(self):
+        body = ('  %dot.5 = bf16[64,64]{1,0} dot(bf16[64,32]{1,0} %a, '
+                'bf16[32,64]{1,0} %b), metadata={op_name="jit(s)/'
+                'mlp/dot_general"}\n')
+        ins = [i for i in iter_hlo_instructions(_hlo(body))
+               if i.opcode == "dot"]
+        assert len(ins) == 1
+        assert ins[0].nbytes == 64 * 64 * 2
+        assert ins[0].scope() == "mlp"
+        assert ins[0].location == "jit(s)/mlp/dot_general:dot"
+
+    def test_unknown_rule_raises(self):
+        audit = ProgramAudit("hand", hlo_text=_hlo(""))
+        with pytest.raises(ValueError, match="no-such-rule"):
+            run_rules(audit, only=["no-such-rule"])
+
+    def test_counters_ride_always_on_series(self):
+        # lint.findings_total{rule=} must publish with the metrics
+        # gate DOWN (the train_recompiles_total contract)
+        assert not metrics._enabled
+        before = metrics.snapshot("lint.findings_total")
+        body = '  %constant.7 = f32[1048576]{0} constant({...})\n'
+        run_rules(ProgramAudit("hand", hlo_text=_hlo(body),
+                               config=CFG), only=["baked-constant"])
+        after = metrics.snapshot("lint.findings_total")
+        key = "lint.findings_total{rule=baked-constant}"
+        assert after[key]["value"] >= \
+            before.get(key, {}).get("value", 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# trace-time schedule capture (collective._record hook)
+# ---------------------------------------------------------------------------
+
+class TestScheduleCapture:
+    def test_capture_orders_and_seqs_collectives(self):
+        mesh = dist.build_mesh({"dp": 8})
+
+        def body(x):
+            with axis_context("dp"):
+                y = _arr(collective.all_reduce(x))
+                y = _arr(collective.all_reduce(y))
+                return _arr(collective.p2p_shift(y, 1))
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"), check_vma=False)
+        with capture_collective_schedule() as entries:
+            jax.jit(sm).lower(jax.ShapeDtypeStruct((8, 4),
+                                                   jnp.float32))
+        assert [e["op"] for e in entries] == \
+            ["allreduce_sum", "allreduce_sum", "ppermute"]
+        # the flight recorder's convention: per-(axis, op) seqs from 1
+        assert [e["seq"] for e in entries] == [1, 2, 1]
+        assert all(e["axis"] == "dp" for e in entries)
+        assert entries[0]["shapes"] == [[1, 4]]  # per-shard payload
+        assert entries[0]["dtypes"] == ["float32"]
+        # capture disarmed on exit
+        assert collective._schedule_capture is None
+
+    def test_fused_collectives_carry_meta(self):
+        from paddle_tpu.distributed.comm import (CommConfig,
+                                                 planned_all_reduce)
+        mesh = dist.build_mesh({"dp": 8})
+
+        def body(x):
+            with axis_context("dp"):
+                return _arr(planned_all_reduce(
+                    x, CommConfig(algorithm="flat"), axes=("dp",)))
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"), check_vma=False)
+        with capture_collective_schedule() as entries:
+            jax.jit(sm).lower(jax.ShapeDtypeStruct((8, 16),
+                                                   jnp.float32))
+        assert len(entries) == 1
+        e = entries[0]
+        assert e["op"] == "fused_allreduce_flat"
+        assert e["meta"]["elements"] == 16  # per-shard flat length
+        assert e["meta"]["compress"] == "f32"
+
+    def test_capture_nesting_restores_outer_list(self):
+        with capture_collective_schedule() as outer:
+            collective._schedule_capture.append(
+                {"op": "a", "axis": None, "shapes": [], "dtypes": [],
+                 "bytes": 0})
+            with capture_collective_schedule() as inner:
+                collective._schedule_capture.append(
+                    {"op": "b", "axis": None, "shapes": [],
+                     "dtypes": [], "bytes": 0})
+            collective._schedule_capture.append(
+                {"op": "c", "axis": None, "shapes": [], "dtypes": [],
+                 "bytes": 0})
+        assert [e["op"] for e in outer] == ["a", "c"]
+        assert [e["op"] for e in inner] == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# cross-rank/stage schedule verification
+# ---------------------------------------------------------------------------
+
+def _entry(op, axis="dp", shape=(4,), dtype="float32", nbytes=16):
+    return {"op": op, "axis": axis, "shapes": [list(shape)],
+            "dtypes": [dtype], "bytes": nbytes}
+
+
+class TestScheduleVerifier:
+    def test_matching_schedules_are_clean(self):
+        s = [_entry("allreduce_sum"), _entry("ppermute")]
+        assert verify_collective_schedules(
+            {"rank0": s, "rank1": list(s), "rank2": list(s)}) == []
+
+    def test_missing_collective_names_rank_and_seq(self):
+        full = [_entry("allreduce_sum"), _entry("allreduce_sum"),
+                _entry("ppermute")]
+        short = [_entry("allreduce_sum"), _entry("ppermute")]
+        fs = verify_collective_schedules(
+            {"rank0": full, "rank1": short, "rank2": list(full)})
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "collective-schedule"
+        assert f.program == "rank1"
+        assert f.location == "dp:allreduce_sum"
+        # the seq-table diff (doctor convention): per-stream REACH,
+        # plus where the streams stop agreeing
+        assert "reaches 1 on this rank vs 2" in f.message
+        assert "divergence at position 2" in f.message
+        assert "deadlock" in f.message
+        assert exit_code(fs) == 1
+
+    def test_skipped_first_collective_not_misreported_as_tail(self):
+        # review regression: when the SKIPPED collective is not the
+        # last on its stream (identical signatures make which-one
+        # undecidable), the finding must report stream reach + first
+        # divergence position — never claim the tail seq was the
+        # missing one
+        full = [_entry("allreduce_sum"), _entry("allreduce_sum"),
+                _entry("ppermute")]
+        skip_first = [_entry("allreduce_sum"), _entry("ppermute")]
+        fs = verify_collective_schedules(
+            {"rank0": full, "rank1": skip_first,
+             "rank2": [dict(e) for e in full]})
+        assert len(fs) == 1
+        assert "seq 2..2" not in fs[0].message
+        assert "reaches 1 on this rank vs 2" in fs[0].message
+        assert "position 2" in fs[0].message  # ar-vs-ppermute split
+
+    def test_extra_collective_names_rank(self):
+        base = [_entry("allreduce_sum")]
+        extra = [_entry("allreduce_sum"), _entry("allreduce_sum")]
+        fs = verify_collective_schedules(
+            {"rank0": base, "rank1": extra, "rank2": list(base)})
+        assert len(fs) == 1 and fs[0].program == "rank1"
+        assert "no peer" in fs[0].message
+
+    def test_payload_mismatch_names_position(self):
+        a = [_entry("allreduce_sum", shape=(4,))]
+        b = [_entry("allreduce_sum", shape=(8,), nbytes=32)]
+        fs = verify_collective_schedules(
+            {"rank0": a, "rank1": b, "rank2": [dict(a[0])]})
+        assert len(fs) == 1 and fs[0].program == "rank1"
+        assert "position 1" in fs[0].message
+
+    def test_order_swap_names_position(self):
+        ab = [_entry("allreduce_sum"), _entry("allgather")]
+        ba = [_entry("allgather"), _entry("allreduce_sum")]
+        fs = verify_collective_schedules(
+            {"rank0": ab, "rank1": ba, "rank2": [dict(e) for e in ab]})
+        assert len(fs) == 1 and "position 1" in fs[0].message
+
+    def test_single_schedule_is_vacuously_clean(self):
+        assert verify_collective_schedules(
+            {"only": [_entry("allreduce_sum")]}) == []
+
+    def test_assign_seqs_is_idempotent(self):
+        s = assign_seqs([_entry("x"), _entry("x"), _entry("y")])
+        assert [e["seq"] for e in s] == [1, 2, 1]
+        assert [e["seq"] for e in assign_seqs(s)] == [1, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# baselines: CI gates on NEW findings only
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _findings(self):
+        return [Finding(rule="baked-constant", severity="error",
+                        location="constant.7:constant", message="m1",
+                        program="p"),
+                Finding(rule="donation", severity="error",
+                        location="params['w']:parameter",
+                        message="m2", program="p")]
+
+    def test_roundtrip_waives_known_findings(self, tmp_path):
+        fs = self._findings()
+        path = str(tmp_path / "baseline.json")
+        write_baseline(fs, path)
+        base = load_baseline(path)
+        assert new_findings(fs, base) == []
+        assert exit_code(fs, base) == 0
+        # the file is reviewable: fingerprints map to human summaries
+        data = json.loads((tmp_path / "baseline.json").read_text())
+        assert any("baked-constant" in v
+                   for v in data["fingerprints"].values())
+
+    def test_new_finding_still_gates(self, tmp_path):
+        fs = self._findings()
+        path = str(tmp_path / "baseline.json")
+        write_baseline(fs[:1], path)
+        base = load_baseline(path)
+        new = new_findings(fs, base)
+        assert [f.rule for f in new] == ["donation"]
+        assert exit_code(fs, base) == 1
+        # format marks the waived one
+        txt = format_findings(fs, base)
+        assert txt.count("(baselined)") == 1
+
+    def test_missing_baseline_means_everything_gates(self):
+        assert load_baseline("/nonexistent/baseline.json") == set()
+        assert exit_code(self._findings(), set()) == 1
+
+    def test_message_drift_does_not_bust_the_baseline(self, tmp_path):
+        f1 = Finding(rule="r", severity="error", location="a:op",
+                     message="1.00 MiB", program="p")
+        f2 = Finding(rule="r", severity="error", location="a:op",
+                     message="1.25 MiB after an XLA upgrade",
+                     program="p")
+        path = str(tmp_path / "b.json")
+        write_baseline([f1], path)
+        assert new_findings([f2], load_baseline(path)) == []

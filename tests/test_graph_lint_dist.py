@@ -1,0 +1,79 @@
+"""Collective-schedule verifier across a REAL process boundary (ISSUE
+7 satellite): 2 trainer processes on the gloo-backed dp=4 mesh each
+TRACE a shard_map program whose python statically skips one collective
+on rank 1 — the canonical pod deadlock. The ranks only lower (nothing
+compiles, nothing dispatches, nothing hangs); their captured schedules
+are merged and the verifier names rank 1 and the missing
+(axis, op, seq) at lint time — the same diff tpu_doctor would produce
+from flight-recorder dumps AFTER the hang, issued before launch."""
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import (exit_code,
+                                 verify_collective_schedules)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def rank_schedules(tmp_path_factory):
+    out = tmp_path_factory.mktemp("graph_lint_sched")
+    env = dict(os.environ)
+    env.update({
+        "PD_TEST_RDZV_PORT": str(_free_port()),
+        "PD_TEST_COORD_PORT": str(_free_port()),
+        "PD_TEST_OUT": str(out),
+        "XLA_FLAGS": "",  # children pick their own device count
+    })
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2",
+           os.path.join(REPO, "tests",
+                        "graph_lint_schedule_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=150)
+    assert res.returncode == 0, (
+        f"launch failed\nstdout:\n{res.stdout}\nstderr:\n{res.stderr}")
+    paths = sorted(glob.glob(str(out / "rank*.json")))
+    assert len(paths) == 2, paths
+    reports = {}
+    for p in paths:
+        with open(p) as f:
+            data = json.load(f)
+        reports[f"rank{data['rank']}"] = data["schedule"]
+    return reports
+
+
+def test_skipping_rank_is_named_at_lint_time(rank_schedules):
+    fs = verify_collective_schedules(rank_schedules)
+    assert len(fs) == 1, "\n".join(f.summary() for f in fs)
+    f = fs[0]
+    assert f.rule == "collective-schedule"
+    assert f.program == "rank1"                  # the divergent rank
+    assert f.location == "dp:allreduce_sum"      # the missing stream
+    assert "reaches 1 on this rank vs 2" in f.message  # seq-table diff
+    assert "deadlock" in f.message
+    assert exit_code(fs) == 1                    # lint gates, CI fails
+
+
+def test_schedules_were_captured_at_trace_time(rank_schedules):
+    # non-vacuity: both ranks really traced the full program shape —
+    # rank 0 has both allreduces + the ring shift, rank 1 skipped one
+    ops0 = [e["op"] for e in rank_schedules["rank0"]]
+    ops1 = [e["op"] for e in rank_schedules["rank1"]]
+    assert ops0 == ["allreduce_sum", "allreduce_sum", "ppermute"]
+    assert ops1 == ["allreduce_sum", "ppermute"]
+    # per-device shard payloads with the recorder's seq convention
+    assert all(e["axis"] == "dp" for e in rank_schedules["rank0"])
+    assert [e["seq"] for e in rank_schedules["rank0"]] == [1, 2, 1]

@@ -162,6 +162,8 @@ def test_expert_parallel_sharding_and_equality():
     assert frac == pytest.approx(1 / 4), "expert axis not sharded"
 
 
+@pytest.mark.slow  # 7.9 s; moe_trains_and_loss_decreases +
+#   expert-parallel + pipeline-placement siblings stay
 def test_ernie_moe_variant_trains_with_aux():
     """ERNIE-MoE: every-2nd-layer expert FFN, aux loss flows through a
     compiled TrainStep, loss decreases; the MoE stack keeps parity with
@@ -323,6 +325,8 @@ def test_ernie_moe_pipeline_matches_single_device():
         assert moved > 3e-3, (k, moved)  # tolerance << training signal
 
 
+@pytest.mark.slow  # 11.5 s; the eager-backward sequence-parallel
+#   sibling and the ring-attention suites keep sp in tier-1
 def test_ernie_sequence_parallel_matches_dense():
     """long-context mode: ErnieConfig(sequence_parallel=True) on a
     dp x sp mesh routes attention through the ppermute ring; the

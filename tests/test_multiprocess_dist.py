@@ -117,6 +117,8 @@ def test_two_process_dp_trainstep(tmp_path):
     np.testing.assert_allclose(results[0]["losses"], control, rtol=2e-4)
 
 
+@pytest.mark.slow  # 8.7 s; two_process_allreduce keeps the 2-proc
+#   path, test_async_ps keeps geo-SGD consistency in tier-1
 def test_two_process_geo_sgd_sync(tmp_path):
     """geo-SGD delta aggregation across two real processes: both ranks
     converge to snapshot + sum of every rank's local delta."""

@@ -64,6 +64,9 @@ def test_aggregate_single_process():
         metrics.clear()
 
 
+@pytest.mark.slow  # 20.5 s; merge_snapshots_pure + aggregate_
+#   single_process keep the rollup math in tier-1, and four other
+#   2-process launcher tests keep the cross-process path
 def test_two_process_fleet_rollup(tmp_path):
     """Host-count-scaled rollups on a real 2-process CPU run."""
     env = dict(os.environ)

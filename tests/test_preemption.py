@@ -77,6 +77,7 @@ def test_sigkill_mid_epoch_then_exact_resume(tmp_path):
                                drill["last_epoch_losses"], rtol=0, atol=0)
 
 
+@pytest.mark.slow  # 11.0 s; the SIGKILL exact-resume drill stays
 def test_resume_skips_completed_epochs(tmp_path):
     """second run of a completed job does zero epochs (epoch guard)."""
     d = str(tmp_path / "job")

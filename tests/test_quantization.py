@@ -44,6 +44,8 @@ def _train(model, steps=30, lr=0.005, bs=16):
     return losses
 
 
+@pytest.mark.slow  # 7.6 s; convert/PTQ/static-pass/int8-compute
+#   suites keep quantization in tier-1
 def test_qat_lenet_trains_close_to_fp32():
     paddle.seed(10)
     fp32 = LeNet(num_classes=10)

@@ -1,0 +1,184 @@
+"""repo_lint / the graph_lint obs-gate source pass (ISSUE 7
+satellite): observability helpers must gate on ``_obs._enabled``
+before doing any work — the recurring PR 4/PR 5 review lesson,
+enforced over paddle_tpu/ with an allowlist for the two legitimate
+publish surfaces. Pure-AST: no jax anywhere in these tests."""
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.analysis.source_lint import (ALLOWLIST, lint_package,
+                                             lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HEADER = "from paddle_tpu.observability import metrics as _obs\n"
+
+
+def _lint(body, allowlist=None):
+    return lint_source(_HEADER + textwrap.dedent(body), "mod.py",
+                       allowlist=allowlist if allowlist is not None
+                       else {})
+
+
+class TestGateDetection:
+    def test_ungated_call_is_flagged(self):
+        fs = _lint("""
+            def f(op):
+                _obs.counter("op.dispatch.total", op=op).add(1)
+            """)
+        assert len(fs) == 1
+        assert fs[0].rule == "obs-gate"
+        assert fs[0].location == "mod.py:4"  # header + blank + def
+        assert "_obs._enabled" in fs[0].message
+
+    def test_if_enabled_guard_passes(self):
+        fs = _lint("""
+            def f(op):
+                if _obs._enabled:
+                    _obs.counter("x", op=op).add(1)
+            """)
+        assert fs == []
+
+    def test_always_true_passes(self):
+        fs = _lint("""
+            def f():
+                _obs.counter("train_recompiles_total",
+                             _always=True).add(1)
+            """)
+        assert fs == []
+
+    def test_always_false_is_still_flagged(self):
+        fs = _lint("""
+            def f():
+                _obs.counter("x", _always=False).add(1)
+            """)
+        assert len(fs) == 1
+
+    def test_early_return_guard_passes(self):
+        # collective._record's shape
+        fs = _lint("""
+            def f(op):
+                if not _obs._enabled:
+                    return None
+                _obs.counter("x", op=op).add(1)
+            """)
+        assert fs == []
+
+    def test_local_bool_guard_passes(self):
+        # the engines' read-the-gate-once idiom
+        fs = _lint("""
+            def f():
+                _rec = _obs._enabled
+                work()
+                if _rec:
+                    _obs.histogram("step_ms").observe(1.0)
+            """)
+        assert fs == []
+
+    def test_tuple_unpacked_gate_vars_pass(self):
+        # dataloader: _rec_m, _rec_f = _obs._enabled, _fr._enabled
+        fs = _lint("""
+            def f(_fr):
+                _rec_m, _rec_f = _obs._enabled, _fr._enabled
+                if _rec_m:
+                    _obs.counter("batches").add(1)
+            """)
+        assert fs == []
+
+    def test_unrelated_local_bool_does_not_count(self):
+        fs = _lint("""
+            def f(flag):
+                ok = bool(flag)
+                if ok:
+                    _obs.counter("x").add(1)
+            """)
+        assert len(fs) == 1
+
+    def test_conditional_expression_guard_passes(self):
+        fs = _lint("""
+            def f():
+                return _obs.gauge("x").set(1) if _obs._enabled else None
+            """)
+        assert fs == []
+
+    def test_enabled_call_guard_passes(self):
+        fs = _lint("""
+            def f():
+                if _obs.enabled():
+                    _obs.counter("x").add(1)
+            """)
+        assert fs == []
+
+    def test_module_level_ungated_call_is_flagged(self):
+        fs = _lint('_obs.counter("import.time").add(1)\n')
+        assert len(fs) == 1 and "<module>" in fs[0].message
+
+
+class TestAliasResolution:
+    def test_plain_metrics_import_is_covered(self):
+        src = ("from ..observability import metrics\n"
+               "def f():\n"
+               "    metrics.counter('x').add(1)\n")
+        assert len(lint_source(src, "m.py", allowlist={})) == 1
+
+    def test_unrelated_object_attribute_is_ignored(self):
+        src = ("class C:\n"
+               "    def f(self):\n"
+               "        self.registry.counter('x').add(1)\n")
+        assert lint_source(src, "m.py", allowlist={}) == []
+
+    def test_file_without_metrics_import_is_skipped(self):
+        src = "def counter(x):\n    return x\n"
+        assert lint_source(src, "m.py", allowlist={}) == []
+
+    def test_syntax_error_is_its_own_finding(self):
+        fs = lint_source(_HEADER + "def f(:\n", "m.py", allowlist={})
+        assert len(fs) == 1 and "unparseable" in fs[0].message
+
+
+class TestAllowlist:
+    def test_allowlisted_qualname_is_waived(self):
+        body = """
+            class Meter:
+                def report(self):
+                    _obs.gauge("mfu").set(0.4)
+            """
+        assert len(_lint(body)) == 1
+        assert _lint(body,
+                     allowlist={"mod.py::Meter.report": "ok"}) == []
+
+
+class TestRepoIsClean:
+    def test_paddle_tpu_package_is_clean(self):
+        # THE regression test: the whole package under the shipped
+        # allowlist. A new ungated telemetry call anywhere in
+        # paddle_tpu/ fails here with its file:line.
+        fs = lint_package()
+        assert fs == [], "\n".join(f.summary() for f in fs)
+
+    def test_allowlist_is_exactly_the_two_publish_surfaces(self):
+        assert sorted(ALLOWLIST) == [
+            "paddle_tpu/observability/mfu.py::ThroughputMeter.report",
+            "paddle_tpu/profiler/__init__.py::StepClock.publish",
+        ]
+
+    def test_allowlisted_sites_still_exist_and_still_fire(self):
+        # the waiver must not outlive the code it waives: with the
+        # allowlist cleared, exactly those two surfaces (and nothing
+        # else) are reported
+        fs = lint_package(allowlist={})
+        quals = {f.location.rsplit(":", 1)[0] for f in fs}
+        assert quals == {"paddle_tpu/observability/mfu.py",
+                         "paddle_tpu/profiler/__init__.py"}
+
+    def test_cli_exits_zero_without_jax(self):
+        env = dict(os.environ)
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "repo_lint.py")],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=120)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "repo_lint: 0 finding(s)" in res.stdout

@@ -1,12 +1,23 @@
 """tools/tier1_budget.py smoke (ISSUE 6 satellite): the parser reads
 pytest's --durations format, the checker applies the ROADMAP bars
 (per-test 15 s, suite 870 s), and the CLI exits nonzero on violations.
+
+ISSUE 7 satellite adds the verify-flow end-to-end leg: a REAL pytest
+run's captured log (not a hand-written fixture) flows through the CLI
+subprocess — and a log captured from an invocation mis-wired without
+--durations fails loudly with no_durations=true, the exact CI gap the
+unit-level smoke could not cover.
 """
 import json
+import os
+import subprocess
+import sys
 
 import pytest
 
 from tools import tier1_budget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 _CLEAN = """\
@@ -99,5 +110,65 @@ class TestCli:
         assert rc == 1
         assert "NO DURATION LINES" in out
         rep = json.loads(out.strip().splitlines()[-1]
+                         .split("tier1_budget:", 1)[1])
+        assert rep["no_durations"] and not rep["ok"]
+
+
+class TestVerifyFlowEndToEnd:
+    """The tier-1 verify flow, actually driven: pytest subprocess ->
+    captured log -> tier1_budget CLI subprocess (both in clean
+    processes, no repo conftest / no jax — the pytest target lives in
+    tmp_path)."""
+
+    _TARGET = (
+        "import time\n"
+        "def test_fast():\n"
+        "    assert 1 + 1 == 2\n"
+        "def test_timed():\n"
+        "    time.sleep(0.05)\n"
+    )
+
+    def _pytest_log(self, tmp_path, extra_args):
+        (tmp_path / "test_target.py").write_text(self._TARGET)
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("PYTEST_")}
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", "test_target.py", "-q",
+             "-p", "no:cacheprovider", *extra_args],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert res.returncode == 0, res.stdout + res.stderr
+        log = tmp_path / "t1.log"
+        log.write_text(res.stdout)
+        return log
+
+    def _budget_cli(self, log, *extra):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "tier1_budget.py"),
+             str(log), *extra],
+            capture_output=True, text=True, timeout=120)
+
+    def test_captured_durations_log_passes_the_bars(self, tmp_path):
+        log = self._pytest_log(tmp_path, ["--durations=0",
+                                          "-vv"])  # show <5ms too
+        res = self._budget_cli(log)
+        assert res.returncode == 0, res.stdout + res.stderr
+        rep = json.loads(res.stdout.strip().splitlines()[-1]
+                         .split("tier1_budget:", 1)[1])
+        assert rep["ok"] and not rep["no_durations"]
+        assert rep["wall_s"] is not None  # real summary line parsed
+        assert rep["total_call_s"] >= 0.05  # the sleeping test timed
+
+    def test_miswired_run_without_durations_fails_loudly(self,
+                                                         tmp_path):
+        # the CI gap: same real pytest run, --durations forgotten —
+        # the budget tool must exit 1 with no_durations=true instead
+        # of reporting the bars as enforced
+        log = self._pytest_log(tmp_path, [])
+        res = self._budget_cli(log)
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "NO DURATION LINES" in res.stdout
+        rep = json.loads(res.stdout.strip().splitlines()[-1]
                          .split("tier1_budget:", 1)[1])
         assert rep["no_durations"] and not rep["ok"]

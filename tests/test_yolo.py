@@ -38,6 +38,8 @@ class TestYOLOv3:
         assert list(p4.shape) == [2, a, 4, 4]
         assert list(p3.shape) == [2, a, 8, 8]
 
+    @pytest.mark.slow  # 22.8 s; forward/predict/matrix-nms +
+    #   export-e2e siblings keep YOLO tier-1 coverage
     def test_trains_loss_decreases(self):
         paddle.seed(1)
         model = YOLOv3(num_classes=4, width=4)
@@ -136,6 +138,8 @@ class TestYOLOExport:
     while_loops), served back through load_inference_model and the
     Predictor handle API."""
 
+    @pytest.mark.slow  # 14.2 s; predict_static_shapes +
+    #   program-serialization/export suites keep the serve path
     def test_export_serve_end_to_end(self, tmp_path):
         import os
         import paddle_tpu.nn as nn

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""graph_lint CLI: prove the fused train programs safe BEFORE they run.
+
+Lowers each target program once (metadata-preserving, cache-bypassed —
+anatomy's compile_uncached discipline), runs every registered
+paddle_tpu.analysis pass over the optimized HLO + the trace-time
+collective schedule, and exits 1 on findings not waived by the
+baseline:
+
+  donation              donated params/opt-state actually alias
+  baked-constant        no >=1 MiB closure constant folded in
+  dtype-promotion       no >=1 MiB bf16->f32 upcast in AMP regions
+  implicit-replication  no >=1 MiB full all-gather materialization
+  f32-table-copy        no full-table f32 copies (hlo_copy_audit rule)
+  obs-gate (--source)   repo_lint's _obs._enabled discipline
+
+Programs (both by default; shapes env-free, flag-tunable):
+  ernie   the ERNIE TrainStep (AMP O1 bf16) — the tier-1 smoke pins
+          this clean at tiny shapes; pass --vocab 30528 --hidden 768
+          --layers 2 for the full-size audit
+  spmd    the spmd_1f1b one-program pipeline engine (2 stages), with
+          its ring-ppermute collective schedule captured at trace time
+
+Baselines: --baseline FILE gates on NEW findings only;
+--write-baseline re-anchors (the tier1_budget rebalance flow). Always
+prints a final ``graph_lint: {json}`` receipt line; findings counters
+ride the always-on lint.findings_total{rule=} series.
+
+Usage:
+  python tools/graph_lint.py                       # both programs
+  python tools/graph_lint.py --program ernie --vocab 30528 --hidden 768
+  python tools/graph_lint.py --source --baseline lint_baseline.json
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEV = int(os.environ.get("PD_LINT_DEVICES", 2))
+
+
+def _force_cpu_devices():
+    """CPU XLA with >=2 virtual devices for the spmd program. Must act
+    before the jax backend exists; inside pytest the conftest already
+    forced 8, so an initialized backend with enough devices is left
+    alone."""
+    import paddle_tpu.jax_compat  # noqa: F401 (shard_map shim first)
+    import jax
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_DEV}"
+        ).strip()
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", N_DEV)
+    except Exception:
+        pass  # backend already up (pytest): use what it has
+    return jax
+
+
+def build_ernie(args, config):
+    """ERNIE TrainStep audit target (the hlo_copy_audit program,
+    lint-sized by default)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import ProgramAudit, \
+        capture_collective_schedule
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    from paddle_tpu.static import TrainStep
+
+    paddle.seed(0)
+    cfg = ErnieConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=args.heads,
+                      intermediate_size=args.hidden * 4,
+                      max_position_embeddings=max(args.seq, 64))
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 parameters=model.parameters())
+    step = TrainStep(
+        model, lambda o, l: ErnieForPretraining.pretraining_loss(o, l),
+        opt, amp_level=args.amp, amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (args.batch, args.seq)).astype(np.int32)
+    lbl = rng.randint(0, cfg.vocab_size,
+                      (args.batch, args.seq)).astype(np.int32)
+    with capture_collective_schedule() as sched:
+        lowered = step.aot_lower((paddle.to_tensor(ids),),
+                                 (paddle.to_tensor(lbl),))
+    return ProgramAudit("ernie_train_step", lowered=lowered,
+                        config=config, schedule=list(sched))
+
+
+def build_spmd(args, config):
+    """spmd_1f1b one-program pipeline audit target (pipeline_bench's
+    2-stage shape at lint size), collective schedule captured while
+    the same lowering traces."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.analysis import ProgramAudit, \
+        capture_collective_schedule
+
+    S = min(2, jax.device_count())
+    width, M, batch = args.width, 2, 8
+    mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+    paddle.seed(0)
+    stages = [nn.Sequential(nn.Linear(width, width), nn.ReLU())
+              for _ in range(S)]
+    eng = dist.PipelineParallel(
+        stages, lambda o, y: ((o - y) ** 2).mean(),
+        paddle.optimizer.SGD(learning_rate=1e-3),
+        num_micro=M, mesh=mesh, exec_mode="spmd_1f1b")
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+    with capture_collective_schedule() as sched:
+        lowered = eng.aot_lower_train(x, y)
+    return ProgramAudit("spmd_1f1b", lowered=lowered, config=config,
+                        schedule=list(sched))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--program", choices=("ernie", "spmd", "all",
+                                          "none"),
+                    default="all",
+                    help="which programs to lower and audit "
+                         "(none: --source only)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--source", action="store_true",
+                    help="also run the repo_lint obs-gate source pass")
+    ap.add_argument("--baseline", default="",
+                    help="baseline file: gate on NEW findings only")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-anchor: accept current findings into "
+                         "--baseline and exit 0")
+    # ernie shapes (defaults = lint size; full-size flags match
+    # tools/hlo_copy_audit.py)
+    ap.add_argument("--amp", default="O1")
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--width", type=int, default=32,
+                    help="spmd stage width")
+    args = ap.parse_args(argv)
+
+    _force_cpu_devices()
+    from paddle_tpu.analysis import (
+        GraphLintConfig, exit_code, format_findings, lint_package,
+        load_baseline, new_findings, run_rules, write_baseline)
+
+    config = GraphLintConfig()
+    only = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        or None
+    findings = []
+    programs = []
+    schedules = {}
+    want = ("ernie", "spmd") if args.program == "all" else \
+        () if args.program == "none" else (args.program,)
+    builders = {"ernie": build_ernie, "spmd": build_spmd}
+    for name in want:
+        audit = builders[name](args, config)
+        programs.append(audit.name)
+        schedules[audit.name] = audit.schedule or []
+        findings.extend(run_rules(audit, only=only))
+    # NOTE: verify_collective_schedules diffs N ranks/stages of the
+    # SAME logical program (tests/test_graph_lint_dist.py feeds it
+    # per-rank captures); the CLI's two targets are different programs,
+    # so their schedules are reported, not diffed
+    if args.source:
+        findings.extend(lint_package())
+        programs.append("paddle_tpu/ sources")
+
+    baseline = load_baseline(args.baseline)
+    if args.write_baseline:
+        if not args.baseline:
+            ap.error("--write-baseline requires --baseline FILE")
+        write_baseline(findings, args.baseline)
+        print(f"baseline re-anchored: {len(findings)} finding(s) -> "
+              f"{args.baseline}", flush=True)
+        return 0
+    if findings:
+        print(format_findings(findings, baseline), flush=True)
+    new = new_findings(findings, baseline)
+    summary = {
+        "programs": programs,
+        "findings": len(findings),
+        "new": len(new),
+        "baselined": len(findings) - len(new),
+        "by_rule": {},
+        "schedule_collectives": {k: len(v)
+                                 for k, v in schedules.items()},
+    }
+    for f in findings:
+        summary["by_rule"][f.rule] = summary["by_rule"].get(f.rule,
+                                                           0) + 1
+    verdict = "CLEAN" if not findings else (
+        "BASELINED" if not new else "NEW FINDINGS")
+    print(f"graph_lint over {', '.join(programs) or 'nothing'}: "
+          f"{len(findings)} finding(s), {len(new)} new — {verdict}",
+          flush=True)
+    print("graph_lint:", json.dumps(summary), flush=True)
+    return exit_code(findings, baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

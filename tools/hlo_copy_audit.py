@@ -3,16 +3,22 @@
 copies (VERDICT r4 weak #2: ~6.3 ms/step of copy-start on
 f32[30528,768] buffers under AMP).
 
+Since ISSUE 7 this is a thin shim over the graph_lint rules engine:
+the hand-written shape scan became the ``f32-table-copy`` pass
+(paddle_tpu/analysis/hlo_rules.py) with the byte threshold pinned to
+the exact vocab-table size, so the VERDICT receipt command — and its
+``full_table_f32_copies=N`` line + exit-1-on-findings contract — keep
+working unchanged while the rule also runs in every graph_lint
+invocation.
+
 Runs entirely on CPU XLA: lowers the ERNIE train step from avals,
-compiles, and counts `copy`/`copy-start`/`fusion` instructions whose
-output is the f32 vocab-table shape. Exit 1 when any full-table f32
-copy survives in the optimized module.
+compiles (cache-bypassed, so the audited text is THIS program's), and
+exits 1 when any full-table f32 copy survives in the optimized module.
 
 Usage: python tools/hlo_copy_audit.py [--amp O1|O2] [--layers N]
 """
 import argparse
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -34,6 +40,8 @@ def main():
 
     import numpy as np
     import paddle_tpu as paddle
+    from paddle_tpu.analysis import GraphLintConfig, ProgramAudit, \
+        run_rules
     from paddle_tpu.models import ErnieConfig, ErnieForPretraining
     from paddle_tpu.static import TrainStep
 
@@ -56,42 +64,41 @@ def main():
                       (args.batch, args.seq)).astype(np.int32)
     lowered = step.aot_lower((paddle.to_tensor(ids),),
                              (paddle.to_tensor(lbl),))
-    compiled = lowered.compile()
-    hlo = compiled.as_text()
+
+    # the rule threshold IS the table: any surviving f32 copy of
+    # vocab-table bytes or more is the r4 weakness
+    table_bytes = args.vocab * args.hidden * 4
+    audit = ProgramAudit(
+        "ernie_train_step", lowered=lowered,
+        config=GraphLintConfig(copy_bytes=table_bytes))
     if args.dump:
         with open(args.dump, "w") as f:
-            f.write(hlo)
+            f.write(audit.hlo_text)
 
-    table = rf"f32\[{args.vocab},{args.hidden}\]"
-    findings = []
-    for line in hlo.splitlines():
-        ls = line.strip()
-        # plain results AND tuple results (copy-start yields
-        # `(f32[V,H]{...}, f32[V,H]{...}, u32[]) copy-start(...)`)
-        m = re.match(
-            rf"(?:ROOT )?%?[\w.\-]+ = (?:{table}[^ ]*"
-            rf"|\({table}[^)]*\)) (\w[\w\-]*)\(", ls)
-        if not m:
-            continue
-        op = m.group(1)
-        if op in ("parameter", "get-tuple-element", "tuple", "bitcast"):
-            continue
-        findings.append((op, ls))
-
+    # legacy receipt lines: producers of the exact table shape, by op
+    table_dims = (args.vocab, args.hidden)
     by_op = {}
-    for op, _ in findings:
-        by_op[op] = by_op.get(op, 0) + 1
+    upcasts = []
+    for ins in audit.instructions():
+        if ins.dims != table_dims or ins.dtype != "f32":
+            continue
+        if ins.opcode in ("parameter", "get-tuple-element", "tuple",
+                          "bitcast"):
+            continue
+        by_op[ins.opcode] = by_op.get(ins.opcode, 0) + 1
+        if ins.opcode in ("convert", "fusion") and "bf16" in ins.line:
+            upcasts.append(ins)
     print(f"ops producing f32[{args.vocab},{args.hidden}] "
           f"(amp={args.amp}): {by_op}")
-    copies = [(o, l) for o, l in findings
-              if o in ("copy", "copy-start", "copy-done")]
-    upcasts = [(o, l) for o, l in findings
-               if o in ("convert", "fusion") and "bf16" in l]
-    for o, l in (copies + upcasts)[:12]:
-        print(f"  {o}: {l[:160]}")
-    n_bad = len(copies)
-    print(f"full_table_f32_copies={n_bad} upcast_fusions={len(upcasts)}")
-    return 1 if n_bad else 0
+
+    findings = run_rules(audit, only=["f32-table-copy"])
+    for f in findings[:12]:
+        print(f"  {f.summary()}")
+    for ins in upcasts[:4]:
+        print(f"  upcast: {ins.line.strip()[:160]}")
+    print(f"full_table_f32_copies={len(findings)} "
+          f"upcast_fusions={len(upcasts)}")
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""repo_lint: the graph_lint "source" pass, standalone.
+
+Enforces the recurring PR 4/PR 5 review lesson over ``paddle_tpu/``:
+observability helpers must gate on ``_obs._enabled`` before doing any
+work (or declare themselves always-on with ``_always=True`` at the
+call site). AST-based — aliases resolved from imports, guard idioms
+including the ``_rec = _obs._enabled`` local-bool pattern recognized;
+the allowlist (two explicit publish surfaces) lives in
+``paddle_tpu.analysis.source_lint.ALLOWLIST``.
+
+Imports no jax — safe in any CI leg. Exit 1 on findings.
+
+Usage:
+  python tools/repo_lint.py [DIR]           # default: paddle_tpu/
+  python tools/repo_lint.py --no-allowlist  # show waived sites too
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dir", nargs="?",
+                    default=os.path.join(REPO, "paddle_tpu"))
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="ignore the shipped allowlist (audit the "
+                         "waivers themselves)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis.source_lint import ALLOWLIST, lint_package
+    allow = {} if args.no_allowlist else None
+    findings = lint_package(args.dir, allowlist=allow)
+    for f in findings:
+        print(f.summary(), flush=True)
+    print(f"repo_lint: {len(findings)} finding(s) "
+          f"({len(ALLOWLIST)} allowlisted site(s)"
+          f"{' IGNORED' if args.no_allowlist else ''})", flush=True)
+    print("repo_lint:", json.dumps({
+        "findings": len(findings),
+        "allowlist": sorted(ALLOWLIST),
+    }), flush=True)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
