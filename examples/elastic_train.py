@@ -11,6 +11,14 @@ worker (`kill -9 <pid>`) mid-run: the launcher detects the death (or a
 silent hang, via the stalled heartbeat), restarts the gang, and workers
 fast-forward from their checkpoints. Run standalone (no launcher) it
 just trains.
+
+The supervisor is verdict-driven (DESIGN.md "Self-healing fleet"):
+add `--elastic_shrink` to evict a doctor-named bad rank and keep
+training on the survivors, `--restart_budget N --restart_window S`
+for the crash-loop guard, and read the per-episode remediation
+receipts under $PD_ELASTIC_DIR. For a reproducible fault instead of a
+manual kill, arm the chaos hooks: PD_CHAOS_MODE=kill PD_CHAOS_STEP=5
+PD_CHAOS_RANK=1 (see tools/chaos_drill.py for the full drill).
 """
 import os
 import sys

@@ -26,6 +26,11 @@ from .embedding_kv import (EmbeddingKV, SparseEmbedding,  # noqa: F401
                            distributed_lookup_table, pull_sparse,
                            push_sparse)
 from .async_ps import AsyncEmbeddingKV, GeoSGD  # noqa: F401
+from .checkpoint import (save_sharded, load_sharded,  # noqa: F401
+                         load_with_topology, load_topology,
+                         topology_manifest, DataShardCursor)
+from .elastic import SupervisorPolicy  # noqa: F401
+from . import chaos  # noqa: F401
 from .moe import MoELayer, moe_dispatch  # noqa: F401
 from .pipeline_engine import (PipelineParallel, build_1f1b_schedule,  # noqa: F401
                               stage_submeshes)

@@ -1,0 +1,152 @@
+"""Deterministic chaos injection: reproducible faults at a named step.
+
+Every self-healing claim needs a drill, and a drill that fires at a
+random moment can't be debugged or replayed in CI. This module reads a
+``PD_CHAOS_*`` plan from the environment once and injects exactly one
+fault at exactly the named (rank, step):
+
+  PD_CHAOS_MODE     kill | stall | corrupt_ckpt   (anything else: off)
+  PD_CHAOS_STEP     step number to fire at (default 5)
+  PD_CHAOS_RANK     rank to fire on (default 1)
+  PD_CHAOS_EVERY    "1": fire on every incarnation (default: only the
+                    first — PADDLE_RESTART_COUNT == 0 — so the
+                    restarted worker survives, which is the drill)
+  PD_CHAOS_STALL_S  stall duration in seconds (default 600: longer
+                    than any heartbeat timeout, shorter than CI)
+
+Faults:
+  kill          SIGKILL self — no atexit, no flush, the preemption shape
+  stall         sleep in the train loop: alive but silent, the
+                hung-but-alive shape only progress-tied heartbeats catch
+  corrupt_ckpt  overwrite the checkpoint payload with garbage, THEN
+                SIGKILL — the restart must survive restoring a corrupt
+                primary (checkpoint.load_sharded's manifest fallback)
+
+The injection point (``maybe_inject``) is called by the training loop
+once per step; it is a no-op (one env-parse-once dict read) when no
+plan is armed, and it records a ``chaos.inject`` flight-recorder event
+before firing so the black box names the fault that was injected —
+tools/chaos_drill.py then checks the remediation receipt against the
+plan.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+from ..observability import flight_recorder as _fr
+
+__all__ = ["ChaosPlan", "plan", "maybe_inject", "reset_plan_cache"]
+
+MODES = ("kill", "stall", "corrupt_ckpt")
+
+
+class ChaosPlan:
+    def __init__(self, mode: str, step: int, rank: int, every: bool,
+                 stall_s: float):
+        self.mode = mode
+        self.step = int(step)
+        self.rank = int(rank)
+        self.every = bool(every)
+        self.stall_s = float(stall_s)
+
+    def __repr__(self):
+        return (f"ChaosPlan(mode={self.mode!r}, step={self.step}, "
+                f"rank={self.rank}, every={self.every})")
+
+
+_plan_cache: Optional[ChaosPlan] = None
+_plan_parsed = False
+
+
+def plan() -> Optional[ChaosPlan]:
+    """The armed plan, parsed from the environment ONCE (a drill sets
+    the env before exec; re-reading per step would let a mid-run env
+    mutation change the drill under CI's feet)."""
+    global _plan_cache, _plan_parsed
+    if _plan_parsed:
+        return _plan_cache
+    _plan_parsed = True
+    mode = os.environ.get("PD_CHAOS_MODE", "").strip().lower()
+    if mode not in MODES:
+        _plan_cache = None
+        return None
+    _plan_cache = ChaosPlan(
+        mode=mode,
+        step=int(os.environ.get("PD_CHAOS_STEP", "5")),
+        rank=int(os.environ.get("PD_CHAOS_RANK", "1")),
+        every=os.environ.get("PD_CHAOS_EVERY", "") == "1",
+        stall_s=float(os.environ.get("PD_CHAOS_STALL_S", "600")))
+    return _plan_cache
+
+
+def reset_plan_cache():
+    """Re-read the environment on the next plan() call (tests)."""
+    global _plan_cache, _plan_parsed
+    _plan_cache = None
+    _plan_parsed = False
+
+
+def _corrupt(path: str):
+    """Overwrite the checkpoint payload at `path` with garbage. Handles
+    every layout the checkpoint layer writes: an orbax directory
+    (every regular file inside is smashed — a half-dead host doesn't
+    corrupt politely), a plain file (npz), and the pickle fallback's
+    `<path>.pkl` suffix the caller's base path doesn't name."""
+    targets = [path, path + ".pkl"]
+    hit = False
+    for t in targets:
+        if os.path.isdir(t):
+            for root, _dirs, files in os.walk(t):
+                for fn in files:
+                    try:
+                        with open(os.path.join(root, fn), "wb") as f:
+                            f.write(b"\0chaos\0" * 16)
+                        hit = True
+                    except OSError:
+                        pass
+        elif os.path.exists(t):
+            try:
+                with open(t, "wb") as f:
+                    f.write(b"\0chaos\0" * 16)
+                hit = True
+            except OSError:
+                pass
+    if not hit:
+        # a corrupt_ckpt drill that corrupted NOTHING would "pass" by
+        # restoring a pristine checkpoint — say so in the black box
+        _fr.record("chaos.corrupt_miss", path=path)
+
+
+def maybe_inject(step: int, rank: Optional[int] = None,
+                 incarnation: Optional[int] = None,
+                 ckpt_path: Optional[str] = None) -> Optional[str]:
+    """Fire the armed fault if (rank, step, incarnation) match the
+    plan. Returns the mode it fired (stall returns after sleeping;
+    kill/corrupt_ckpt never return), None when nothing fired."""
+    p = plan()
+    if p is None:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if incarnation is None:
+        incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    if rank != p.rank or int(step) != p.step:
+        return None
+    if incarnation != 0 and not p.every:
+        return None
+    # black-box breadcrumb BEFORE firing: the dump (on SIGTERM or the
+    # stall's eventual termination) must name the injected fault
+    _fr.record("chaos.inject", mode=p.mode, step=int(step),
+               rank=int(rank))
+    if p.mode == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if p.mode == "corrupt_ckpt":
+        if ckpt_path:
+            _corrupt(ckpt_path)
+        os.kill(os.getpid(), signal.SIGKILL)
+    # stall: alive, not stepping, not pulsing — the monitor's job
+    time.sleep(p.stall_s)
+    return p.mode

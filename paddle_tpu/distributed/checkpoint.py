@@ -11,14 +11,35 @@ its shards; restore re-shards onto the current mesh — the multi-host
 TPU-pod checkpoint path), with a pickle fallback for plain arrays.
 train_epoch_range keeps the reference's exact contract: wrap the epoch
 loop, epochs already done are skipped on restart.
+
+Self-healing-fleet additions (DESIGN.md "Self-healing fleet"):
+
+- ``save_sharded(..., async_write=True)`` takes the write off the hot
+  path: the training loop blocks only for the device→host snapshot
+  (plus back-pressure: joining a still-in-flight previous write), then
+  a background thread runs the same write-new-then-swap commit. The
+  goodput ``checkpoint`` bucket records the small blocking interval;
+  the overlapped write lands in ``checkpoint.async_write_ms`` only.
+- an integrity MANIFEST (per-leaf crc32 + shape/dtype) is written with
+  every checkpoint and verified on restore; a corrupted candidate
+  (truncated pickle, half-written orbax leaf) makes ``load_sharded``
+  fall back to ``.old``/``.saving`` instead of aborting the very
+  resume the checkpoint exists for.
+- a TOPOLOGY manifest (mesh/dp shape, global batch, data-shard cursor)
+  makes restore topology-elastic: a dp=N checkpoint resumes at dp=M
+  through ``load_sharded(target=)``'s resharding plus
+  ``DataShardCursor`` — the cursor counts examples in GLOBAL order, so
+  shrink/grow neither skips nor duplicates an example.
 """
 from __future__ import annotations
 
 import json
 import os
 import shutil
+import threading
 import time
-from typing import Any, Iterator, Optional
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -28,8 +49,13 @@ from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from .. import serialization
 
-__all__ = ["save_sharded", "load_sharded", "train_epoch_range",
-           "AutoCheckpoint"]
+__all__ = ["save_sharded", "load_sharded", "load_with_topology",
+           "load_at_or_before", "wait_pending", "topology_manifest",
+           "load_topology", "DataShardCursor", "train_epoch_range",
+           "AutoCheckpoint", "MANIFEST_NAME", "TOPOLOGY_NAME"]
+
+MANIFEST_NAME = "PD_MANIFEST.json"
+TOPOLOGY_NAME = "PD_TOPOLOGY.json"
 
 
 def _orbax():
@@ -65,17 +91,136 @@ def _ckpt_record(kind: str, arrays, t0: float):
         _fr.ckpt_end(kind, t0, nbytes=nbytes)
 
 
-def save_sharded(state: dict, path: str):
-    """Save a (possibly sharded) pytree of jax arrays. Orbax when
-    available (multi-host safe), pickle fallback."""
-    _fr.ckpt_begin("save")  # black-box marker (no-op when disabled)
-    _t0 = time.perf_counter()
-    ocp = _orbax()
-    arrays = jax.tree_util.tree_map(
+def _unwrap(state):
+    return jax.tree_util.tree_map(
         lambda v: v._data if isinstance(v, Tensor) else v, state)
+
+
+# -- integrity manifest -------------------------------------------------------
+
+def _leaf_name(keypath) -> str:
+    return jax.tree_util.keystr(keypath)
+
+
+def _manifest_doc(arrays) -> dict:
+    """Per-leaf crc32 + shape/dtype over the HOST bytes. Leaves that are
+    not fully addressable on this host (multi-host shards) get a
+    checksum-less entry — shape/dtype are still verified on restore."""
+    leaves = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(arrays)
+    for kp, leaf in flat:
+        entry: Dict[str, Any] = {
+            "shape": list(np.shape(leaf)),
+            "dtype": str(np.asarray(leaf).dtype
+                         if not hasattr(leaf, "dtype") else leaf.dtype),
+        }
+        if getattr(leaf, "is_fully_addressable", True):
+            arr = np.asarray(leaf)
+            entry["crc32"] = zlib.crc32(arr.tobytes())
+            entry["nbytes"] = int(arr.nbytes)
+        leaves[_leaf_name(kp)] = entry
+    return {"version": 1, "leaves": leaves}
+
+
+def _verify_manifest(arrays, manifest: dict) -> Optional[str]:
+    """None when `arrays` match `manifest`, else a human reason. A leaf
+    present in the manifest but missing from the restore (or vice
+    versa) is corruption too — a half-written checkpoint can lose whole
+    leaves, not just bytes."""
+    want = manifest.get("leaves", {})
+    flat, _ = jax.tree_util.tree_flatten_with_path(arrays)
+    got = {_leaf_name(kp): leaf for kp, leaf in flat}
+    missing = set(want) - set(got)
+    extra = set(got) - set(want)
+    if missing or extra:
+        return (f"leaf set mismatch (missing={sorted(missing)[:3]}, "
+                f"extra={sorted(extra)[:3]})")
+    for name, entry in want.items():
+        leaf = got[name]
+        if list(np.shape(leaf)) != entry["shape"]:
+            return (f"{name}: shape {list(np.shape(leaf))} != saved "
+                    f"{entry['shape']}")
+        got_dt = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        if entry.get("dtype") and got_dt != entry["dtype"]:
+            # dtype is the only integrity signal for non-addressable
+            # (multi-host) leaves, where no crc32 was recorded
+            return f"{name}: dtype {got_dt} != saved {entry['dtype']}"
+        if "crc32" not in entry:
+            continue
+        if not getattr(leaf, "is_fully_addressable", True):
+            continue  # resharded multi-host restore: bytes not local
+        arr = np.asarray(leaf)
+        if zlib.crc32(arr.tobytes()) != entry["crc32"]:
+            return f"{name}: checksum mismatch"
+    return None
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def topology_manifest(step: int, data_cursor: Optional[dict] = None,
+                      mesh=None, dp: Optional[int] = None,
+                      global_batch: Optional[int] = None,
+                      extra: Optional[dict] = None) -> dict:
+    """Build the topology manifest saved next to the arrays: everything
+    a DIFFERENTLY-shaped resume needs that the arrays themselves don't
+    carry. `data_cursor` is a DataShardCursor.state_dict() (or any
+    dict); dp defaults to jax.process_count() when a mesh isn't given."""
+    doc: Dict[str, Any] = {"version": 1, "step": int(step)}
+    if mesh is not None:
+        doc["mesh_shape"] = dict(
+            zip([str(a) for a in mesh.axis_names], mesh.devices.shape))
+    doc["dp"] = int(dp) if dp is not None else int(jax.process_count())
+    if global_batch is not None:
+        doc["global_batch"] = int(global_batch)
+    if data_cursor is not None:
+        doc["data_cursor"] = dict(data_cursor)
+    if extra:
+        doc["extra"] = dict(extra)
+    return doc
+
+
+# -- async writer (at most one write in flight per process) ------------------
+
+_async_lock = threading.Lock()
+_async_thread: Optional[threading.Thread] = None
+_async_error: Optional[BaseException] = None
+
+
+def wait_pending(timeout: Optional[float] = None) -> bool:
+    """Join the in-flight background checkpoint write, re-raising any
+    error it hit (a failed checkpoint must not stay silent until the
+    restore that needed it). True when nothing is (any longer) in
+    flight."""
+    global _async_thread, _async_error
+    with _async_lock:
+        t = _async_thread
+    if t is not None:
+        t.join(timeout)
+        if t.is_alive():
+            return False
+        with _async_lock:
+            if _async_thread is t:
+                _async_thread = None
+    with _async_lock:
+        err, _async_error = _async_error, None
+    if err is not None:
+        raise RuntimeError("async checkpoint write failed") from err
+    return True
+
+
+def _write_payload(arrays, path: str, manifest: bool = True,
+                   topology: Optional[dict] = None):
+    """The commit: write-new-then-swap (crash-safe — the previous good
+    checkpoint survives any mid-write death), shared by the sync path
+    and the background writer."""
+    ocp = _orbax()
     if ocp is not None:
-        # write-new-then-swap so a crash mid-save never loses the previous
-        # good checkpoint (the only copy for preemption recovery)
         path = os.path.abspath(path)
         tmp = path + ".saving"
         if jax.process_index() == 0:
@@ -89,45 +234,164 @@ def save_sharded(state: dict, path: str):
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(tmp, arrays)
         ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            # sidecar files ride INSIDE the directory so the swap (and
+            # the .old/.saving fallback) moves them with the arrays
+            if manifest:
+                with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+                    json.dump(_manifest_doc(arrays), f)
+            if topology is not None:
+                with open(os.path.join(tmp, TOPOLOGY_NAME), "w") as f:
+                    json.dump(topology, f)
         _barrier("ckpt_post_save")
         # directory renames touch the shared filesystem once: process 0 only
         if jax.process_index() == 0:
-            old = path + ".old"
+            # retention rotation: previous good checkpoints stay at
+            # .old/.old2 — the corruption fallback AND the
+            # consistent-cut rollback pool (commit skew between ranks
+            # under async writes is bounded by 1 barrier step + 1
+            # in-flight write, so depth 2 always holds the cut).
+            # PD_CKPT_KEEP_OLD=0 restores the delete-after-swap legacy.
+            keep = os.environ.get("PD_CKPT_KEEP_OLD", "1") != "0"
+            old, old2 = path + ".old", path + ".old2"
+            if os.path.exists(old2):
+                shutil.rmtree(old2)
             if os.path.exists(old):
-                shutil.rmtree(old)
+                if keep:
+                    os.rename(old, old2)
+                else:
+                    shutil.rmtree(old)
             if os.path.exists(path):
                 os.rename(path, old)
             os.rename(tmp, path)
-            if os.path.exists(old):
-                shutil.rmtree(old)
+            if not keep:
+                for stale in (old, old2):
+                    if os.path.exists(stale):
+                        shutil.rmtree(stale)
         _barrier("ckpt_post_swap")
     else:
-        tmp = path + ".pkl.tmp"
-        serialization.save(
-            jax.tree_util.tree_map(np.asarray, arrays), tmp)
-        os.replace(tmp, path + ".pkl")
+        host = jax.tree_util.tree_map(np.asarray, arrays)
+        pkl = path + ".pkl"
+        tmp = pkl + ".tmp"
+        serialization.save(host, tmp)
+        side: List[Tuple[str, dict]] = []
+        if manifest:
+            side.append((pkl + ".manifest.json", _manifest_doc(host)))
+        if topology is not None:
+            side.append((pkl + ".topology.json", topology))
+        for spath, doc in side:
+            with open(spath + ".tmp", "w") as f:
+                json.dump(doc, f)
+        # same depth-2 retention rotation (and the same
+        # PD_CKPT_KEEP_OLD=0 opt-out) as the directory path: previous
+        # goods become .old/.old2 (corruption fallback +
+        # consistent-cut rollback pool), then the new one commits
+        keep = os.environ.get("PD_CKPT_KEEP_OLD", "1") != "0"
+        written = {spath for spath, _doc in side}
+        for suffix in ("", ".manifest.json", ".topology.json"):
+            cur, old, old2 = (pkl + suffix, pkl + ".old" + suffix,
+                              pkl + ".old2" + suffix)
+            if keep:
+                if os.path.exists(old):
+                    os.replace(old, old2)
+                if os.path.exists(cur):
+                    os.replace(cur, old)
+            else:
+                for stale in (old, old2):
+                    if os.path.exists(stale):
+                        os.remove(stale)
+                # NEVER pre-delete the current payload — os.replace
+                # overwrites atomically, and a crash between a delete
+                # and the replace would leave ZERO restorable
+                # checkpoints. Only sidecars this save does not
+                # rewrite are removed (a stale topology must not
+                # outlive its arrays).
+                if suffix and cur not in written and \
+                        os.path.exists(cur):
+                    os.remove(cur)
+        os.replace(tmp, pkl)
+        for spath, _doc in side:
+            os.replace(spath + ".tmp", spath)
+
+
+def save_sharded(state: dict, path: str, async_write: bool = False,
+                 manifest: bool = True, topology: Optional[dict] = None):
+    """Save a (possibly sharded) pytree of jax arrays. Orbax when
+    available (multi-host safe), pickle fallback.
+
+    async_write=True blocks only for (a) joining a still-in-flight
+    previous write (back-pressure) and (b) the device→host snapshot;
+    the write-new-then-swap commit runs on a background thread. Only
+    the blocking interval accrues to the goodput `checkpoint` bucket;
+    the overlapped write reports via `checkpoint.async_write_ms`.
+    Multi-process jobs degrade to the sync path: the swap barriers are
+    collectives and must not run on a side thread racing the main
+    thread's program order."""
+    _fr.ckpt_begin("save")  # black-box marker (no-op when disabled)
+    _t0 = time.perf_counter()
+    arrays = _unwrap(state)
+    if async_write and jax.process_count() == 1:
+        global _async_thread
+        wait_pending()  # at most one in flight; join time is visible
+        # the pinned-host copy: after this, device buffers are free to
+        # be donated/overwritten by the next step
+        snapshot = jax.device_get(arrays)
+        if _obs._enabled:
+            _obs.counter("checkpoint.saves_total").add(1)
+            _obs.histogram("checkpoint.save_block_ms").observe(
+                (time.perf_counter() - _t0) * 1e3)
+        if _fr._enabled:
+            from .collective import _payload_bytes
+            _fr.ckpt_end("save", _t0, nbytes=_payload_bytes(snapshot))
+
+        def _writer():
+            global _async_error
+            w0 = time.perf_counter()
+            try:
+                _write_payload(snapshot, path, manifest=manifest,
+                               topology=topology)
+            except BaseException as e:  # surfaced by wait_pending/next save
+                with _async_lock:
+                    _async_error = e
+                return
+            dur_ms = (time.perf_counter() - w0) * 1e3
+            if _obs._enabled:
+                _obs.counter("checkpoint.async_saves_total").add(1)
+                _obs.histogram("checkpoint.async_write_ms").observe(dur_ms)
+            _fr.ckpt_async_end("save", dur_ms)
+
+        t = threading.Thread(target=_writer, name="pd-ckpt-writer")
+        with _async_lock:
+            _async_thread = t
+        t.start()  # non-daemon: interpreter exit joins it (no torn file)
+        return
+    _write_payload(arrays, path, manifest=manifest, topology=topology)
     _ckpt_record("save", arrays, _t0)
 
 
-def load_sharded(path: str, target: Optional[dict] = None) -> dict:
-    """Restore; when `target` (pytree of arrays with shardings) is given,
-    arrays are restored onto those shardings (re-sharding on mesh change)."""
-    _fr.ckpt_begin("load")  # black-box marker (no-op when disabled)
-    _t0 = time.perf_counter()
-    ocp = _orbax()
-    # a crash between the two swap renames in save_sharded leaves the new
-    # checkpoint at .saving (complete — orbax commits before the swap) or
-    # the previous one at .old; fall back rather than fail auto-resume
-    if ocp is not None and not os.path.isdir(path):
-        for suffix in (".saving", ".old"):
-            if os.path.isdir(path + suffix):
-                path = path + suffix
-                break
+def _load_candidates(path: str, is_dir: bool) -> List[str]:
+    """Restore candidates in preference order. Primary first; a
+    corrupted primary falls back to `.old`/`.old2` (previous goods,
+    depth-2 retention), then `.saving` (a crash between the swap
+    renames). A MISSING primary prefers `.saving` (newest complete)
+    over the olds."""
+    if is_dir:
+        if os.path.isdir(path):
+            cands = [path, path + ".old", path + ".old2",
+                     path + ".saving"]
+        else:
+            cands = [path + ".saving", path + ".old", path + ".old2"]
+        return [c for c in cands if os.path.isdir(c)]
+    pkl = path + ".pkl"
+    return [c for c in (pkl, pkl + ".old", pkl + ".old2")
+            if os.path.exists(c)]
+
+
+def _restore_one(path: str, target, ocp):
     if ocp is not None and os.path.isdir(path):
         ckptr = ocp.StandardCheckpointer()
         if target is not None:
-            tgt = jax.tree_util.tree_map(
-                lambda v: v._data if isinstance(v, Tensor) else v, target)
+            tgt = _unwrap(target)
             ref = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(
                     a.shape, a.dtype,
@@ -135,10 +399,224 @@ def load_sharded(path: str, target: Optional[dict] = None) -> dict:
             out = ckptr.restore(os.path.abspath(path), ref)
         else:
             out = ckptr.restore(os.path.abspath(path))
+        manifest = _read_json(os.path.join(path, MANIFEST_NAME))
     else:
-        out = serialization.load(path + ".pkl")
-    _ckpt_record("load", out, _t0)
+        out = serialization.load(path)
+        manifest = _read_json(path + ".manifest.json")
+    if manifest is not None:
+        reason = _verify_manifest(out, manifest)
+        if reason is not None:
+            raise ValueError(f"checkpoint integrity: {reason}")
     return out
+
+
+def _load_first_good(path: str,
+                     target: Optional[dict]) -> Tuple[dict, str]:
+    """The candidate walk: restore+verify newest-first, skipping (and
+    counting) corrupt candidates. Returns (state, candidate_path)."""
+    _fr.ckpt_begin("load")  # black-box marker (no-op when disabled)
+    _t0 = time.perf_counter()
+    ocp = _orbax()
+    cands = _load_candidates(path, is_dir=ocp is not None)
+    if not cands:
+        # keep the legacy error shape: a missing pickle checkpoint
+        # raises from serialization.load
+        out = serialization.load(path + ".pkl")
+        _ckpt_record("load", out, _t0)
+        return out, path + ".pkl"
+    last_err: Optional[BaseException] = None
+    for cand in cands:
+        try:
+            out = _restore_one(cand, target, ocp)
+        except Exception as e:
+            last_err = e
+            # cold path, but the skip must be visible even with the
+            # hot-path gate down — a silent fallback hides data loss
+            _obs.counter("checkpoint.corruptions_total",
+                         _always=True).add(1)
+            _fr.record("ckpt.corrupt", path=cand, error=str(e)[:200])
+            continue
+        if cand != path and cand != path + ".pkl":
+            _fr.record("ckpt.fallback", path=cand)
+        _ckpt_record("load", out, _t0)
+        return out, cand
+    raise RuntimeError(
+        f"no restorable checkpoint at {path} (tried {cands})"
+    ) from last_err
+
+
+def load_sharded(path: str, target: Optional[dict] = None) -> dict:
+    """Restore; when `target` (pytree of arrays with shardings) is given,
+    arrays are restored onto those shardings (re-sharding on mesh — and
+    topology — change). Every candidate is verified against its
+    integrity manifest; a corrupted or unreadable candidate falls back
+    to `.old`/`.saving` instead of aborting the resume (the recovery
+    the checkpoint exists for), with `checkpoint.corruptions_total`
+    counting the skips."""
+    out, _cand = _load_first_good(path, target)
+    return out
+
+
+def load_with_topology(path: str, target: Optional[dict] = None
+                       ) -> Tuple[Optional[dict], Optional[dict]]:
+    """Restore (state, topology) FROM THE SAME CANDIDATE. Pairing
+    separate `load_sharded` + `load_topology` calls is a consistency
+    hazard: corruption that hits only an array leaf sends the state
+    restore to `.old` while the primary's still-parseable topology
+    JSON reports the newer step — the resume would then skip the
+    rolled-back step's update while the cursor claims its examples
+    were consumed. Returns (None, None) when no checkpoint exists."""
+    try:
+        out, cand = _load_first_good(path, target)
+    except (RuntimeError, FileNotFoundError, OSError):
+        return None, None
+    return out, _candidate_topology(cand)
+
+
+def _candidate_topology(cand: str) -> Optional[dict]:
+    return _read_json(os.path.join(cand, TOPOLOGY_NAME)
+                      if os.path.isdir(cand)
+                      else cand + ".topology.json")
+
+
+def load_topology(path: str) -> Optional[dict]:
+    """Read the topology manifest for the checkpoint at `path`,
+    following the same .old/.saving fallback as load_sharded — but
+    only past a candidate that is actually DAMAGED. A healthy newest
+    save that simply carried no topology (a caller sharing the path
+    without passing one) returns None; serving the `.old` sidecar's
+    stale step/cursor as current would silently rewind the resume."""
+    ocp = _orbax()
+    for i, cand in enumerate(_load_candidates(path,
+                                              is_dir=ocp is not None)):
+        doc = _candidate_topology(cand)
+        if doc is not None:
+            return doc
+        # no parseable topology here. For the newest candidate decide
+        # WHY: a parseable integrity manifest means the save is healthy
+        # and legitimately topology-less — stop; otherwise treat the
+        # candidate as damaged and fall back like load_sharded would.
+        if i == 0:
+            man = _read_json(os.path.join(cand, MANIFEST_NAME)
+                             if os.path.isdir(cand)
+                             else cand + ".manifest.json")
+            if man is not None:
+                return None
+    return None
+
+
+def load_at_or_before(path: str, step: int,
+                      target: Optional[dict] = None,
+                      best_effort: bool = True) -> Tuple[dict, dict]:
+    """Restore the newest candidate whose topology step is <= `step`
+    — the CONSISTENT-CUT rollback for per-rank checkpoints. When a
+    rank is EVICTED mid-step, survivors may have committed steps the
+    dead rank never did; resuming each survivor from its newest
+    checkpoint would silently skip the evicted rank's shard of those
+    torn steps. Each survivor takes the minimum committed step across
+    the gone ranks and rolls back here; the depth-2 `.old`/`.old2`
+    retention covers the commit skew a lock-step gang can accumulate
+    (1 barrier step + 1 in-flight async write).
+
+    best_effort=True: when even `.old2` is newer than the cut (a rank
+    that died long-lagged or never saved), restore the OLDEST
+    verifiable candidate and record the uncovered gap as a
+    ``ckpt.rollback_gap`` flight-recorder event + always-on counter —
+    partial data loss, reported loudly, instead of an unrecoverable
+    job. Returns (state, topology)."""
+    ocp = _orbax()
+    last_err: Optional[BaseException] = None
+    too_new: List[Tuple[str, dict]] = []  # newest-first
+    for cand in _load_candidates(path, is_dir=ocp is not None):
+        topo = _candidate_topology(cand)
+        if topo is None or topo.get("step") is None:
+            continue
+        if int(topo["step"]) > int(step):
+            too_new.append((cand, topo))
+            continue
+        try:
+            out = _restore_one(cand, target, ocp)
+        except Exception as e:
+            last_err = e
+            _obs.counter("checkpoint.corruptions_total",
+                         _always=True).add(1)
+            _fr.record("ckpt.corrupt", path=cand, error=str(e)[:200])
+            continue
+        return out, topo
+    if best_effort:
+        # oldest too-new candidate first (smallest gap); a corrupt one
+        # falls through to the next, same discipline as the main walk
+        for cand, topo in reversed(too_new):
+            try:
+                out = _restore_one(cand, target, ocp)
+            except Exception as e:
+                last_err = e
+                _obs.counter("checkpoint.corruptions_total",
+                             _always=True).add(1)
+                _fr.record("ckpt.corrupt", path=cand,
+                           error=str(e)[:200])
+                continue
+            _obs.counter("checkpoint.rollback_gaps_total",
+                         _always=True).add(1)
+            _fr.record("ckpt.rollback_gap", path=cand,
+                       wanted_step=int(step),
+                       got_step=int(topo["step"]))
+            return out, topo
+    raise RuntimeError(
+        f"no checkpoint at or before step {step} under {path} — the "
+        "consistent-cut rollback needs the olds retained by "
+        "save_sharded") from last_err
+
+
+class DataShardCursor:
+    """Global-order data cursor: the shrink/grow data-shard contract.
+
+    The dataset is traversed in one fixed GLOBAL order; every optimizer
+    step consumes `global_batch` consecutive examples starting at the
+    cursor, split contiguously across the dp ranks. Because the cursor
+    counts global examples (not per-rank steps), a checkpoint saved at
+    dp=N resumes at any dp=M dividing `global_batch` with no example
+    skipped or repeated — and with the SAME global batches, so the loss
+    trajectory matches the undisturbed run."""
+
+    def __init__(self, dataset_size: int, global_batch: int,
+                 offset: int = 0, epoch: int = 0):
+        if global_batch <= 0 or dataset_size <= 0:
+            raise ValueError("dataset_size and global_batch must be > 0")
+        self.dataset_size = int(dataset_size)
+        self.global_batch = int(global_batch)
+        self.offset = int(offset)      # examples consumed this epoch
+        self.epoch = int(epoch)
+
+    def indices(self, rank: int, dp: int) -> np.ndarray:
+        """This step's example indices for `rank` of `dp` ranks."""
+        if self.global_batch % dp:
+            raise ValueError(
+                f"global_batch={self.global_batch} not divisible by "
+                f"dp={dp}; shrink/grow would tear a batch")
+        if not 0 <= rank < dp:
+            raise ValueError(f"rank {rank} out of range for dp={dp}")
+        per = self.global_batch // dp
+        base = self.offset + rank * per
+        return (np.arange(base, base + per) % self.dataset_size)
+
+    def advance(self):
+        """One global step consumed (call ONCE per step, not per rank)."""
+        self.offset += self.global_batch
+        while self.offset >= self.dataset_size:
+            self.offset -= self.dataset_size
+            self.epoch += 1
+
+    def state_dict(self) -> dict:
+        return {"dataset_size": self.dataset_size,
+                "global_batch": self.global_batch,
+                "offset": self.offset, "epoch": self.epoch}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "DataShardCursor":
+        return cls(state["dataset_size"], state["global_batch"],
+                   offset=state.get("offset", 0),
+                   epoch=state.get("epoch", 0))
 
 
 class AutoCheckpoint:

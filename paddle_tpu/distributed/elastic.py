@@ -1,0 +1,368 @@
+"""Self-healing elastic fleet: the verdict→remediation state machine.
+
+PRs 4–7 made pod failures *diagnosable* — the flight recorder dumps a
+per-rank black box, the watchdog names stalls, ``tools/tpu_doctor.py``
+merges the dumps and names the diverging rank. This module is the part
+that *acts* on a diagnosis. It deliberately contains no subprocess or
+socket code: ``SupervisorPolicy`` is a pure state machine the launcher
+(``distributed/launch.py --elastic``) drives, so every evict / shrink /
+backoff / abort decision is unit-testable against canned doctor
+verdicts with no processes at all.
+
+The pieces:
+
+``SupervisorPolicy``
+    Consumes one failure episode at a time — the supervisor's own
+    evidence (process exits, heartbeat stalls) plus the doctor's merged
+    verdict — and returns a ``Decision``: respawn the gang / one rank,
+    evict the named rank and shrink the gang to the survivors, grow
+    back when a replacement appears, or abort. Between respawns it
+    imposes exponential backoff, and two crash-loop guards bound a
+    worker that dies at import: a lifetime ``max_restarts`` budget and
+    a restarts-per-window budget.
+
+``effective_verdict``
+    The doctor's verdict when it names a rank; otherwise synthesized
+    from the supervisor's own detection (``crash`` from a process exit,
+    ``heartbeat_stall`` from the monitor) so the remediation receipt
+    always records *why* the action was taken.
+
+``emit_receipt``
+    One structured JSON remediation receipt per episode (episode,
+    verdict, action, resume step, goodput delta) written to
+    ``$PD_ELASTIC_DIR`` (default: the flight-recorder dump dir), plus
+    always-on ``elastic.*`` counters riding the PR 3 exporters — a
+    supervisor that healed a pod at 3am must leave the paper trail
+    even when the hot-path telemetry gate is down.
+
+``collect_diagnosis``
+    Runs the tpu_doctor merge in-process over a dump directory (the
+    dumps SIGTERM'd workers leave behind) and returns the diagnosis,
+    verdict, and resume-step / goodput evidence in one bundle.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..observability import metrics as _obs
+
+__all__ = ["Decision", "SupervisorPolicy", "effective_verdict",
+           "translate_verdict_rank", "collect_diagnosis",
+           "emit_receipt", "receipts_dir", "NONE_VERDICT"]
+
+NONE_VERDICT = {"kind": "none", "rank": None, "source": "doctor",
+                "evidence": {}}
+
+# verdict kinds that name a culpable rank precisely enough to evict it;
+# a straggler or recompile storm is a cost, not a fault — respawn, don't
+# shrink
+_EVICTABLE = ("divergence", "hang", "heartbeat_stall", "crash")
+
+
+@dataclass
+class Decision:
+    """One remediation decision. action ∈ respawn_gang / respawn_rank /
+    evict_shrink / grow / abort."""
+    action: str
+    ranks: List[int] = field(default_factory=list)  # evicted/grown slots
+    delay_s: float = 0.0       # backoff to sleep BEFORE respawning
+    reason: str = ""
+    episode: int = 0
+    verdict: dict = field(default_factory=lambda: dict(NONE_VERDICT))
+
+
+def translate_verdict_rank(verdict: Optional[dict],
+                           ranks_now: Sequence[int]) -> Optional[dict]:
+    """Map a doctor verdict's rank — the CONTIGUOUS gang rank the
+    dump's PADDLE_TRAINER_ID recorded — onto the stable slot id the
+    policy tracks. After a shrink renumbers the gang (slots [0,2,3]
+    run as ranks 0,1,2), comparing the raw rank against slot ids would
+    evict a healthy slot or silently skip the eviction. Out-of-range
+    ranks (a stale dump from a larger gang) drop the rank rather than
+    guess."""
+    if not verdict or verdict.get("rank") is None:
+        return verdict
+    v = dict(verdict)
+    r = int(v["rank"])
+    if 0 <= r < len(ranks_now):
+        v["rank"] = int(ranks_now[r])
+    else:
+        v["rank"] = None
+    return v
+
+
+def effective_verdict(failures: Sequence[Tuple[int, str]],
+                      doctor_verdict: Optional[dict]) -> dict:
+    """The doctor's verdict when it names a rank; else the supervisor's
+    own detection, so every receipt records what drove the action.
+
+    One guard: a doctor HANG naming a rank the supervisor's own
+    detection did NOT flag is suspect — when one rank wedges, every
+    peer blocked on its collective also stops stepping and dumps a
+    stall, so the hang set usually contains casualties. The
+    supervisor's failure evidence (that rank stopped pulsing / its
+    process died) is the more precise signal then. A divergence
+    verdict is proof and always wins."""
+    if doctor_verdict and doctor_verdict.get("rank") is not None:
+        v = dict(doctor_verdict)
+        failed = {int(r) for r, _ in failures}
+        if v.get("kind") != "hang" or not failed or v["rank"] in failed:
+            return v
+    if failures:
+        rank, why = failures[0]
+        kind = "heartbeat_stall" if "heartbeat" in why else "crash"
+        return {"kind": kind, "rank": int(rank), "source": "supervisor",
+                "evidence": {"why": why,
+                             "all_failed": [int(r) for r, _ in failures]}}
+    return dict(NONE_VERDICT)
+
+
+class SupervisorPolicy:
+    """Pure decision core of the elastic supervisor.
+
+    State: the set of active ranks (shrink removes, grow restores),
+    respawn timestamps (for the per-window budget), and the
+    consecutive-failure count (for exponential backoff — reset by
+    ``note_progress`` once the job has run cleanly for ``heal_after_s``).
+    """
+
+    def __init__(self, world: int, max_restarts: int = 3,
+                 policy: str = "gang",
+                 backoff_base: float = 0.5, backoff_factor: float = 2.0,
+                 backoff_max: float = 30.0,
+                 restart_window_s: float = 60.0,
+                 restart_budget: int = 0,
+                 allow_shrink: bool = False, min_world: int = 1,
+                 grow_after_s: float = 0.0,
+                 heal_after_s: float = 20.0):
+        if policy not in ("gang", "rank"):
+            raise ValueError(f"unknown elastic policy {policy!r}")
+        self.world = int(world)
+        self.max_restarts = int(max_restarts)
+        self.policy = policy
+        self.backoff_base = float(backoff_base)
+        self.backoff_factor = float(backoff_factor)
+        self.backoff_max = float(backoff_max)
+        self.restart_window_s = float(restart_window_s)
+        self.restart_budget = int(restart_budget)  # 0 = disabled
+        self.allow_shrink = bool(allow_shrink)
+        self.min_world = max(1, int(min_world))
+        self.grow_after_s = float(grow_after_s)
+        self.heal_after_s = float(heal_after_s)
+        self.active: List[int] = list(range(self.world))
+        self.evicted: Dict[int, float] = {}     # rank -> eviction ts
+        self.episode = 0
+        self.restarts = 0                        # lifetime respawn count
+        self._respawn_ts: List[float] = []       # for the window budget
+        self._consecutive = 0
+        self._last_respawn: Optional[float] = None
+
+    # -- observations --------------------------------------------------------
+    def note_progress(self, now: Optional[float] = None):
+        """Call on any healthy tick: once the job has run cleanly for
+        heal_after_s since the last respawn, the backoff ladder resets
+        (a one-off preemption must not leave 30 s penalties behind)."""
+        now = time.monotonic() if now is None else now
+        if (self._consecutive and self._last_respawn is not None
+                and now - self._last_respawn >= self.heal_after_s):
+            self._consecutive = 0
+
+    def record_respawn(self, now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        self.restarts += 1
+        self._respawn_ts.append(now)
+        self._last_respawn = now
+
+    # -- decisions -----------------------------------------------------------
+    def backoff_delay(self) -> float:
+        return min(self.backoff_max,
+                   self.backoff_base
+                   * self.backoff_factor ** self._consecutive)
+
+    def decide(self, failures: Sequence[Tuple[int, str]],
+               doctor_verdict: Optional[dict] = None,
+               now: Optional[float] = None) -> Decision:
+        """One failure episode → one Decision. `failures` are
+        (global_rank, why) pairs from the supervisor's own detection."""
+        now = time.monotonic() if now is None else now
+        self.episode += 1
+        v = effective_verdict(failures, doctor_verdict)
+        # crash-loop guards run BEFORE any respawn so a worker dying at
+        # import cannot burn the budget in seconds
+        if self.restarts + 1 > self.max_restarts:
+            return Decision(
+                "abort", reason=f"max_restarts={self.max_restarts}",
+                episode=self.episode, verdict=v)
+        if self.restart_budget:
+            recent = [t for t in self._respawn_ts
+                      if now - t <= self.restart_window_s]
+            if len(recent) + 1 > self.restart_budget:
+                return Decision(
+                    "abort",
+                    reason=(f"restart budget {self.restart_budget}/"
+                            f"{self.restart_window_s:g}s"),
+                    episode=self.episode, verdict=v)
+        delay = self.backoff_delay()
+        self._consecutive += 1
+        # eviction: verdict names a rank precisely, shrink is allowed,
+        # and the survivors still form a viable gang
+        if (self.allow_shrink and v.get("kind") in _EVICTABLE
+                and v.get("rank") in self.active
+                and len(self.active) - 1 >= self.min_world):
+            rank = int(v["rank"])
+            self.active.remove(rank)
+            self.evicted[rank] = now
+            return Decision("evict_shrink", ranks=[rank], delay_s=delay,
+                            reason=f"evict rank {rank} ({v['kind']})",
+                            episode=self.episode, verdict=v)
+        if self.policy == "rank":
+            ranks = sorted({int(r) for r, _ in failures}) or list(
+                self.active)
+            return Decision("respawn_rank", ranks=ranks, delay_s=delay,
+                            reason="rank restart", episode=self.episode,
+                            verdict=v)
+        return Decision("respawn_gang", ranks=list(self.active),
+                        delay_s=delay, reason="gang restart",
+                        episode=self.episode, verdict=v)
+
+    def maybe_grow(self, now: Optional[float] = None) -> Optional[Decision]:
+        """Grow back to full size once a replacement slot is available
+        — here, once the evicted rank's cooldown (`grow_after_s`)
+        passed, modeling a preempted host coming back. Disabled when
+        grow_after_s == 0."""
+        if not self.grow_after_s or not self.evicted:
+            return None
+        now = time.monotonic() if now is None else now
+        ready = sorted(r for r, ts in self.evicted.items()
+                       if now - ts >= self.grow_after_s)
+        if not ready:
+            return None
+        for r in ready:
+            del self.evicted[r]
+            self.active.append(r)
+        self.active.sort()
+        self.episode += 1
+        return Decision("grow", ranks=ready, delay_s=0.0,
+                        reason=f"replacement for rank(s) {ready}",
+                        episode=self.episode,
+                        verdict=dict(NONE_VERDICT))
+
+
+# -- doctor bridge ------------------------------------------------------------
+
+def _import_doctor():
+    """tools/tpu_doctor.py: importable as `tools.tpu_doctor` in a repo
+    checkout (repo root on sys.path); else loaded by file path relative
+    to this package."""
+    try:
+        from tools import tpu_doctor  # type: ignore
+        return tpu_doctor
+    except ImportError:
+        pass
+    import importlib.util
+    p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        "tools", "tpu_doctor.py")
+    if not os.path.exists(p):
+        return None
+    spec = importlib.util.spec_from_file_location("_pd_tpu_doctor", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def collect_diagnosis(dump_dir: str,
+                      since_ts: Optional[float] = None) -> dict:
+    """Run the tpu_doctor merge in-process over `dump_dir` and bundle
+    what the supervisor needs: the diagnosis, the verdict, the deepest
+    resume step seen, and the fleet-mean goodput. `since_ts` filters
+    out black boxes from earlier runs sharing the directory."""
+    doctor = _import_doctor()
+    paths = sorted(glob.glob(os.path.join(dump_dir, "flight_*.json")))
+    if since_ts is not None:
+        paths = [p for p in paths
+                 if os.path.getmtime(p) >= since_ts]
+    out = {"dumps": len(paths), "diagnosis": None,
+           "verdict": dict(NONE_VERDICT), "resume_step": None,
+           "goodput": None}
+    if not paths or doctor is None:
+        return out
+    try:
+        dumps = doctor.load_dumps(paths)
+        diag = doctor.diagnose(dumps)
+    except Exception:
+        return out  # an unreadable dump must not kill the supervisor
+    out["diagnosis"] = diag
+    out["verdict"] = doctor.verdict(diag)
+    steps = [(d.get("progress") or {}).get("steps") for d in dumps]
+    steps = [s for s in steps if s is not None]  # step 0 is a step
+    if steps:
+        out["resume_step"] = int(max(steps))
+    out["goodput"] = diag.get("goodput")
+    return out
+
+
+# -- remediation receipts -----------------------------------------------------
+
+def receipts_dir() -> str:
+    return os.environ.get(
+        "PD_ELASTIC_DIR",
+        os.environ.get("PD_FR_DIR", "/tmp/pd_flight"))
+
+
+def emit_receipt(episode: int, verdict: dict, action: str,
+                 ranks: Sequence[int], world_before: int,
+                 world_after: int, resume_step: Optional[int] = None,
+                 goodput: Optional[dict] = None,
+                 goodput_delta: Optional[float] = None,
+                 delay_s: float = 0.0, reason: str = "",
+                 out_dir: Optional[str] = None) -> dict:
+    """Write one structured remediation receipt and mirror it into the
+    always-on ``elastic.*`` registry series (counters stay visible with
+    the hot-path gate down — remediation at 3am must leave evidence)."""
+    doc = {
+        "version": 1,
+        "ts": time.time(),
+        "episode": int(episode),
+        "verdict": dict(verdict or NONE_VERDICT),
+        "action": action,
+        "ranks": [int(r) for r in ranks],
+        "world_before": int(world_before),
+        "world_after": int(world_after),
+        "resume_step": resume_step,
+        "goodput": goodput,
+        "goodput_delta": goodput_delta,
+        "backoff_s": round(float(delay_s), 3),
+        "reason": reason,
+    }
+    d = out_dir or receipts_dir()
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"receipt_ep{int(episode)}_pid{os.getpid()}.json")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        doc["path"] = path
+    except OSError:
+        doc["path"] = None  # receipt still returned to the caller
+    _obs.counter("elastic.episodes_total", _always=True).add(1)
+    _obs.counter("elastic.actions_total", _always=True,
+                 action=action).add(1)
+    if action == "evict_shrink":
+        _obs.counter("elastic.evictions_total", _always=True).add(
+            len(doc["ranks"]))
+    if action in ("respawn_gang", "respawn_rank", "evict_shrink",
+                  "grow"):
+        _obs.counter("elastic.restarts_total", _always=True).add(1)
+    _obs.counter("elastic.backoff_seconds_total",
+                 _always=True).add(float(delay_s))
+    _obs.gauge("elastic.world_size", _always=True).set(int(world_after))
+    _obs.gauge("elastic.last_episode", _always=True).set(int(episode))
+    return doc

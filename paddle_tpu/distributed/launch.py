@@ -19,6 +19,19 @@ right semantics for XLA-collective jobs, where the coordination service
 cannot re-admit a single rank mid-job (whole-slice restart is also how
 TPU pods recover); policy `rank` restarts only the dead rank — for
 loosely-coupled jobs (PS/geo-SGD, embarrassingly-parallel sweeps).
+
+The supervision loop is VERDICT-DRIVEN (DESIGN.md "Self-healing
+fleet"): every decision — respawn, evict+shrink, grow, abort, how long
+to back off — comes from distributed/elastic.SupervisorPolicy, fed
+with the supervisor's own detection (process exits, heartbeat stalls)
+plus the tpu_doctor verdict merged in-process from the flight-recorder
+dumps the SIGTERM'd workers leave behind. Each episode emits a
+structured remediation receipt (elastic.emit_receipt) naming the
+verdict that drove the action. --elastic_shrink lets the supervisor
+evict a doctor-named rank and run the survivors at dp=N-1 (workers
+re-shard via the topology manifest's data cursor); --grow_after T
+grows back once the slot has been clear for T seconds. Exponential
+backoff plus a restarts-per-window budget bound crash loops.
 """
 from __future__ import annotations
 
@@ -26,6 +39,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -56,6 +70,28 @@ def parse_args(argv):
     p.add_argument("--heartbeat_timeout", type=float, default=10.0)
     p.add_argument("--heartbeat_startup_timeout", type=float,
                    default=120.0)
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   help="base seconds of exponential backoff between "
+                        "respawns (doubles per consecutive failure)")
+    p.add_argument("--restart_backoff_max", type=float, default=30.0)
+    p.add_argument("--restart_window", type=float, default=60.0,
+                   help="sliding window for --restart_budget")
+    p.add_argument("--restart_budget", type=int, default=0,
+                   help="max respawns per --restart_window (0 = only "
+                        "the lifetime --max_restarts budget applies)")
+    p.add_argument("--elastic_shrink", action="store_true",
+                   help="evict a verdict-named bad rank and run the "
+                        "survivors at the smaller world size (workers "
+                        "re-shard via the checkpoint topology manifest)")
+    p.add_argument("--min_world", type=int, default=1,
+                   help="never shrink below this many ranks")
+    p.add_argument("--grow_after", type=float, default=0.0,
+                   help="seconds after an eviction to grow back to "
+                        "full size (0 = stay shrunk)")
+    p.add_argument("--dump_grace", type=float, default=0.75,
+                   help="seconds to wait for SIGTERM'd workers to dump "
+                        "their flight recorders before running the "
+                        "doctor")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs="...")
     return p.parse_args(argv)
@@ -111,6 +147,7 @@ def _terminate(proc, grace=5.0):
 def _elastic_supervise(args, world) -> int:
     from .fleet.utils import KVServer
     from .fleet.utils.heartbeat import HeartbeatMonitor
+    from . import elastic
 
     if args.nnodes > 1:
         # a launcher-private KV can't see remote ranks, and a gang
@@ -127,27 +164,106 @@ def _elastic_supervise(args, world) -> int:
     if not endpoint:
         server = KVServer(0).start()
         endpoint = f"127.0.0.1:{server.port}"
-    extra = {"PADDLE_HEARTBEAT_ENDPOINT": endpoint}
+    # workers dump their flight recorders here (SIGTERM chains into the
+    # black-box dump when they arm crash handlers); the doctor merge
+    # and the remediation receipts read/write the same directory
+    dump_dir = os.environ.get("PD_FR_DIR")
+    if not dump_dir:
+        dump_dir = (os.path.join(args.log_dir, "flight") if args.log_dir
+                    else tempfile.mkdtemp(prefix="pd_elastic_fr_"))
+    receipts = os.environ.get("PD_ELASTIC_DIR", dump_dir)
+    extra = {"PADDLE_HEARTBEAT_ENDPOINT": endpoint,
+             "PD_FR_DIR": dump_dir}
 
-    def respawn(local_rank, incarnation):
-        return _spawn(args, local_rank, world,
+    policy = elastic.SupervisorPolicy(
+        world=world, max_restarts=args.max_restarts,
+        policy=args.elastic_policy,
+        backoff_base=args.restart_backoff,
+        backoff_max=args.restart_backoff_max,
+        restart_window_s=args.restart_window,
+        restart_budget=args.restart_budget,
+        allow_shrink=args.elastic_shrink, min_world=args.min_world,
+        grow_after_s=args.grow_after)
+
+    incarnation = {lr: 0 for lr in range(args.nproc_per_node)}
+    completed: set = set()
+    prev_goodput = None
+    # doctor-merge window: dumps older than the last bounce belong to
+    # an already-remediated episode (each incarnation has a fresh pid,
+    # so old dump files accumulate) — merging them again could pin a
+    # stale verdict on a now-healthy rank. Pre-detection evidence for
+    # the CURRENT episode (a watchdog stall dump minutes before the
+    # monitor trips) is still inside the window: it postdates the
+    # bounce that spawned this incarnation.
+    since_ts = {"v": time.time()}
+
+    # slots evicted from the gang: their checkpoints hold the last
+    # step they COMMITTED, and the survivors must roll back to that
+    # consistent cut so the gone rank's shard of any torn step is
+    # replayed, not skipped (a slot that merely respawns replays its
+    # own lost tail itself — no rollback needed for it)
+    gone_slots = {"v": ""}
+    # bumped on every gang bounce and shared by the whole gang: workers
+    # namespace their KV step-gate keys with it, so stale gate values
+    # from a previous incarnation can never satisfy (and so void) the
+    # lock-step barrier after a rollback
+    gang_epoch = {"v": 0}
+
+    def spawn_slot(lr):
+        # PADDLE_TRAINER_ID is the CONTIGUOUS rank in the current
+        # (possibly shrunk) gang; PD_SLOT_ID is the stable slot
+        # identity workers key their checkpoints on across re-numbering
+        ranks = sorted(policy.active)
+        return _spawn(args, lr, len(ranks),
                       dict(extra,
-                           PADDLE_RESTART_COUNT=str(incarnation)))
+                           PADDLE_RESTART_COUNT=str(incarnation[lr]),
+                           PADDLE_TRAINER_ID=str(ranks.index(lr)),
+                           PADDLE_TRAINERS_NUM=str(len(ranks)),
+                           PD_SLOT_ID=str(lr),
+                           PD_GANG_EPOCH=str(gang_epoch["v"]),
+                           PD_GONE_SLOTS=gone_slots["v"]))
+
+    def bounce_gang(monitor):
+        # collective jobs can't re-admit one rank: bounce the gang;
+        # completed ranks re-run too and fast-forward via their epoch
+        # guard (test_preemption resume-skip)
+        for p in procs.values():
+            _terminate(p)
+        procs.clear()
+        completed.clear()
+        gang_epoch["v"] += 1
+        since_ts["v"] = time.time()  # close this episode's dump window
+        for lr in policy.active:
+            incarnation[lr] += 1
+            procs[lr] = spawn_slot(lr)
+        # fresh monitor: the gang's world size / rank numbering may
+        # have changed, and every restarted rank gets the startup
+        # grace period again. revive() resets each KV slot to the
+        # never-beat sentinel — otherwise the monitor reads the STALE
+        # pre-bounce counter as a first beat and puts the restarted
+        # (still importing) worker on the short stall clock
+        monitor.close()
+        fresh = HeartbeatMonitor(
+            endpoint, len(policy.active),
+            timeout=args.heartbeat_timeout,
+            startup_timeout=args.heartbeat_startup_timeout)
+        for r in range(len(policy.active)):
+            fresh.revive(r)
+        return fresh
 
     procs = {}
+    monitor = None
     try:
-        procs = {lr: respawn(lr, 0) for lr in range(args.nproc_per_node)}
-        incarnation = {lr: 0 for lr in procs}
-        completed: set = set()
-        restarts = 0
+        procs = {lr: spawn_slot(lr) for lr in policy.active}
         monitor = HeartbeatMonitor(
-            endpoint, world, timeout=args.heartbeat_timeout,
+            endpoint, len(policy.active), timeout=args.heartbeat_timeout,
             startup_timeout=args.heartbeat_startup_timeout)
         while True:
             time.sleep(0.25)
+            policy.note_progress()
             failed = []
-            for lr, p in procs.items():
-                if lr in completed:
+            for lr, p in list(procs.items()):
+                if lr in completed or lr not in policy.active:
                     continue
                 rc = p.poll()
                 if rc is None:
@@ -157,48 +273,129 @@ def _elastic_supervise(args, world) -> int:
                 else:
                     failed.append((lr, f"exit rc={rc}"))
             # hung-but-alive workers: heartbeat counter stopped moving
-            for rank in monitor.sweep():
-                lr = rank - args.node_rank * args.nproc_per_node
+            ranks_now = sorted(policy.active)
+            for mrank in monitor.sweep():
+                if mrank >= len(ranks_now):
+                    continue
+                lr = ranks_now[mrank]
                 if lr in procs and lr not in completed and \
                         not any(f[0] == lr for f in failed):
                     failed.append((lr, "heartbeat stall"))
-            if len(completed) == len(procs):
+            if len(completed) >= len(policy.active):
                 monitor.close()
                 return 0
             if not failed:
+                grow = policy.maybe_grow()
+                if grow is not None:
+                    print(f"[elastic] growing back rank(s) "
+                          f"{grow.ranks}: {grow.reason}",
+                          file=sys.stderr)
+                    wb = len(policy.active) - len(grow.ranks)
+                    monitor = bounce_gang(monitor)
+                    elastic.emit_receipt(
+                        episode=grow.episode, verdict=grow.verdict,
+                        action="grow", ranks=grow.ranks,
+                        world_before=wb,
+                        world_after=len(policy.active),
+                        reason=grow.reason, out_dir=receipts)
                 continue
-            restarts += 1
-            if restarts > args.max_restarts:
+
+            # ---- failure episode -----------------------------------------
+            world_before = len(policy.active)
+            # terminate first: SIGTERM chains into the workers'
+            # flight-recorder dumps — the doctor's evidence
+            gang_down = args.elastic_policy == "gang" or \
+                args.elastic_shrink
+            if gang_down:
+                for p in procs.values():
+                    _terminate(p)
+            else:
+                for lr, _why in failed:
+                    _terminate(procs[lr])
+            time.sleep(args.dump_grace)
+            bundle = elastic.collect_diagnosis(dump_dir,
+                                               since_ts=since_ts["v"])
+            # dumps record CONTIGUOUS gang ranks; the policy tracks
+            # stable slots — translate before any slot comparison
+            verdict = elastic.translate_verdict_rank(
+                bundle["verdict"], ranks_now)
+            decision = policy.decide(failed, verdict)
+            if decision.action == "abort":
                 print(f"[elastic] rank(s) {[f[0] for f in failed]} "
-                      f"failed and max_restarts={args.max_restarts} "
+                      f"failed and {decision.reason} "
                       "exhausted; aborting job", file=sys.stderr)
                 for p in procs.values():
                     _terminate(p)
+                elastic.emit_receipt(
+                    episode=decision.episode, verdict=decision.verdict,
+                    action="abort", ranks=[f[0] for f in failed],
+                    world_before=world_before,
+                    world_after=world_before,
+                    resume_step=bundle["resume_step"],
+                    goodput=bundle["goodput"],
+                    reason=decision.reason, out_dir=receipts)
                 monitor.close()
                 return 1
             for lr, why in failed:
                 print(f"[elastic] rank {lr} down ({why}); restart "
-                      f"{restarts}/{args.max_restarts} "
+                      f"{policy.restarts + 1}/{args.max_restarts} "
                       f"(policy={args.elastic_policy})", file=sys.stderr)
-            if args.elastic_policy == "gang":
-                # collective jobs can't re-admit one rank: bounce the
-                # gang; completed ranks re-run too and fast-forward via
-                # their epoch guard (test_preemption resume-skip)
-                for p in procs.values():
-                    _terminate(p)
-                completed.clear()
-                for lr in procs:
-                    incarnation[lr] += 1
-                    monitor.revive(args.node_rank * args.nproc_per_node
-                                   + lr)
-                    procs[lr] = respawn(lr, incarnation[lr])
-            else:
-                for lr, _why in failed:
+            if decision.verdict.get("kind") not in (None, "none"):
+                print(f"[elastic] verdict: {decision.verdict['kind']} "
+                      f"rank {decision.verdict.get('rank')} "
+                      f"(source={decision.verdict.get('source')}) -> "
+                      f"{decision.action}", file=sys.stderr)
+            if decision.delay_s > 0:
+                print(f"[elastic] backoff {decision.delay_s:.2f}s "
+                      "before respawn", file=sys.stderr)
+                time.sleep(decision.delay_s)
+            policy.record_respawn()
+            if decision.action == "evict_shrink":
+                print(f"[elastic] evicting rank(s) {decision.ranks}; "
+                      f"gang shrinks {world_before} -> "
+                      f"{len(policy.active)}", file=sys.stderr)
+                for r in decision.ranks:
+                    p = procs.pop(r, None)
+                    if p is not None:
+                        _terminate(p)
+                # only THIS bounce rolls back to the evicted slots'
+                # cut; once the survivors have replayed the torn
+                # steps, later bounces must not drag the gang back
+                gone_slots["v"] = ",".join(str(r)
+                                           for r in decision.ranks)
+                monitor = bounce_gang(monitor)
+                gone_slots["v"] = ""
+            elif decision.action == "respawn_rank" and not gang_down:
+                since_ts["v"] = time.time()
+                for lr in decision.ranks:
                     _terminate(procs[lr])
                     incarnation[lr] += 1
-                    monitor.revive(args.node_rank * args.nproc_per_node
-                                   + lr)
-                    procs[lr] = respawn(lr, incarnation[lr])
+                    monitor.revive(lr)
+                    procs[lr] = spawn_slot(lr)
+            else:  # respawn_gang (or the gang was already taken down)
+                monitor = bounce_gang(monitor)
+            gp = bundle.get("goodput")
+            delta = None
+            if gp and prev_goodput:
+                delta = round(
+                    gp.get("productive_fraction", 0.0)
+                    - prev_goodput.get("productive_fraction", 0.0), 6)
+            if gp:
+                prev_goodput = gp
+            receipt = elastic.emit_receipt(
+                episode=decision.episode, verdict=decision.verdict,
+                action=decision.action,
+                ranks=(decision.ranks
+                       if decision.action == "evict_shrink"
+                       else [f[0] for f in failed]),
+                world_before=world_before,
+                world_after=len(policy.active),
+                resume_step=bundle["resume_step"], goodput=gp,
+                goodput_delta=delta, delay_s=decision.delay_s,
+                reason=decision.reason, out_dir=receipts)
+            if receipt.get("path"):
+                print(f"[elastic] remediation receipt: "
+                      f"{receipt['path']}", file=sys.stderr)
     finally:
         # a supervisor crash (KeyboardInterrupt, EMFILE, ...) must not
         # orphan training processes holding the chips
@@ -207,6 +404,8 @@ def _elastic_supervise(args, world) -> int:
                 _terminate(p)
             except Exception:
                 pass
+        if monitor is not None:
+            monitor.close()
         if server is not None:
             server.stop()
 

@@ -9,9 +9,19 @@ multi-host jobs still need is a pre-`jax.distributed.initialize` channel
 for the coordinator address / cluster topology / experiment config. Same
 rank-0-broadcast shape, native C++ sockets (csrc/runtime.cpp pd_rdzv_*)
 with a pure-Python fallback.
+
+Timeout discipline (DESIGN.md "Self-healing fleet"): the old
+hard-coded single-attempt 120 s budget is now configurable — per-call
+arguments first, then ``PD_RDZV_TIMEOUT_S`` / ``PD_RDZV_ATTEMPTS`` /
+``PD_RDZV_BACKOFF_S`` env (an elastic respawn storm needs shorter,
+retried budgets than a cold pod bring-up) — with bounded retry and
+exponential backoff between attempts, and every failure names the
+endpoint and the attempt count (a TimeoutError that doesn't say WHERE
+it waited is a 2am page with no lead).
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -20,19 +30,60 @@ from typing import Optional
 
 from ..core.native_lib import runtime_lib
 
-__all__ = ["broadcast_bootstrap", "Rendezvous"]
+__all__ = ["broadcast_bootstrap", "Rendezvous", "default_timeout",
+           "default_attempts", "default_backoff"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def default_timeout() -> float:
+    """Per-attempt budget in seconds (PD_RDZV_TIMEOUT_S, default 120)."""
+    return _env_float("PD_RDZV_TIMEOUT_S", 120.0)
+
+
+def default_attempts() -> int:
+    """Bounded retry count (PD_RDZV_ATTEMPTS, default 1 — exactly the
+    legacy single-attempt behavior unless opted into)."""
+    return max(1, int(_env_float("PD_RDZV_ATTEMPTS", 1)))
+
+
+def default_backoff() -> float:
+    """Base backoff between attempts (PD_RDZV_BACKOFF_S, default 0.5;
+    doubles per retry)."""
+    return _env_float("PD_RDZV_BACKOFF_S", 0.5)
 
 
 class Rendezvous:
-    """One rank-0-broadcast exchange on (host, port)."""
+    """One rank-0-broadcast exchange on (host, port). `timeout` is the
+    PER-ATTEMPT budget; `attempts`/`backoff` bound the retry loop —
+    constructor values (or the PD_RDZV_* env) are the defaults each
+    call can still override."""
 
-    def __init__(self, endpoint: str, rank: int, nranks: int):
+    def __init__(self, endpoint: str, rank: int, nranks: int,
+                 timeout: Optional[float] = None,
+                 attempts: Optional[int] = None,
+                 backoff: Optional[float] = None):
         host, port = endpoint.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.rank, self.nranks = rank, nranks
+        self.timeout = default_timeout() if timeout is None else \
+            float(timeout)
+        self.attempts = default_attempts() if attempts is None else \
+            max(1, int(attempts))
+        self.backoff = default_backoff() if backoff is None else \
+            float(backoff)
         self._handle = None
         self._py_thread = None
         self._py_done = threading.Event()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
 
     # -- rank 0 --------------------------------------------------------------
     def serve(self, payload: bytes):
@@ -74,11 +125,13 @@ class Rendezvous:
         self._py_thread = threading.Thread(target=run, daemon=True)
         self._py_thread.start()
 
-    def wait_served(self, timeout: float = 120.0) -> bool:
+    def wait_served(self, timeout: Optional[float] = None) -> bool:
         """Block until all (nranks-1) peers have fetched (rank 0 only).
         The reference's SendBroadCastCommID completes every send before
         returning; this is the explicit-wait equivalent for the
         background-thread server."""
+        if timeout is None:
+            timeout = self.timeout
         if self.nranks <= 1:
             return True
         if self._handle is not None:
@@ -94,7 +147,8 @@ class Rendezvous:
         return True
 
     # -- peers ---------------------------------------------------------------
-    def fetch(self, timeout: float = 120.0, max_len: int = 1 << 20) -> bytes:
+    def _fetch_once(self, timeout: float, max_len: int) -> bytes:
+        """One bounded attempt (the legacy body); raises TimeoutError."""
         lib = runtime_lib()
         if lib is not None:
             import ctypes
@@ -103,14 +157,15 @@ class Rendezvous:
                                   max_len, int(timeout * 1000))
             if n < 0:
                 raise TimeoutError(
-                    f"rendezvous fetch from {self.host}:{self.port} "
+                    f"rendezvous fetch from {self.endpoint} "
                     f"failed ({n})")
             return buf.raw[:n]
         deadline = time.time() + timeout
         while True:
             try:
-                with socket.create_connection((self.host, self.port),
-                                              timeout=2.0) as conn:
+                with socket.create_connection(
+                        (self.host, self.port),
+                        timeout=max(0.05, min(2.0, timeout))) as conn:
                     hdr = conn.recv(4, socket.MSG_WAITALL)
                     if len(hdr) < 4:  # server closed early: retry
                         raise ConnectionError("short header")
@@ -127,9 +182,35 @@ class Rendezvous:
                 pass
             if time.time() > deadline:
                 raise TimeoutError(
-                    f"rendezvous fetch from {self.host}:{self.port} "
-                    f"timed out")
-            time.sleep(0.1)
+                    f"rendezvous fetch from {self.endpoint} timed out")
+            time.sleep(min(0.1, max(0.01, timeout / 10)))
+
+    def fetch(self, timeout: Optional[float] = None,
+              max_len: int = 1 << 20,
+              attempts: Optional[int] = None,
+              backoff: Optional[float] = None) -> bytes:
+        """Fetch the broadcast blob: `attempts` bounded tries of
+        `timeout` seconds each, exponential backoff between them. The
+        terminal error names the endpoint, the attempt count and the
+        total wall spent — everything the on-call needs."""
+        if timeout is None:
+            timeout = self.timeout
+        attempts = self.attempts if attempts is None else max(1,
+                                                              int(attempts))
+        backoff = self.backoff if backoff is None else float(backoff)
+        t0 = time.time()
+        last: Optional[BaseException] = None
+        for i in range(attempts):
+            try:
+                return self._fetch_once(timeout, max_len)
+            except (TimeoutError, OSError) as e:
+                last = e
+                if i + 1 < attempts:
+                    time.sleep(backoff * (2 ** i))
+        raise TimeoutError(
+            f"rendezvous fetch from {self.endpoint} failed after "
+            f"{attempts} attempt(s) over {time.time() - t0:.1f}s "
+            f"(per-attempt timeout {timeout:g}s)") from last
 
     def close(self):
         lib = runtime_lib()
@@ -148,21 +229,24 @@ class Rendezvous:
 
 
 def broadcast_bootstrap(payload: Optional[bytes], endpoint: str, rank: int,
-                        nranks: int, timeout: float = 120.0) -> bytes:
+                        nranks: int, timeout: Optional[float] = None,
+                        attempts: Optional[int] = None) -> bytes:
     """Rank 0 passes its payload; every rank returns the payload
-    (gen_comm_id one-shot convenience)."""
-    rv = Rendezvous(endpoint, rank, nranks)
+    (gen_comm_id one-shot convenience). timeout/attempts default to the
+    PD_RDZV_* env knobs (legacy 120 s single attempt)."""
+    rv = Rendezvous(endpoint, rank, nranks, timeout=timeout,
+                    attempts=attempts)
     if rank == 0:
         assert payload is not None
         rv.serve(payload)
         # complete all sends before returning (SendBroadCastCommID
         # semantics), then release the listening socket so the port is
         # reusable in-process
-        ok = rv.wait_served(timeout)
+        ok = rv.wait_served()
         rv.close()
         if not ok:
             raise TimeoutError(
                 f"rendezvous: not all {nranks - 1} peers fetched from "
-                f"{endpoint} within {timeout}s")
+                f"{endpoint} within {rv.timeout:g}s")
         return payload
-    return rv.fetch(timeout=timeout)
+    return rv.fetch()

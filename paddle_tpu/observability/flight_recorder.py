@@ -61,7 +61,7 @@ from . import goodput
 __all__ = [
     "FlightRecorder", "enable", "disable", "enabled", "record",
     "get_recorder", "reset", "collective_seq", "seq_table", "dump",
-    "step_begin", "step_end", "ckpt_begin", "ckpt_end",
+    "step_begin", "step_end", "ckpt_begin", "ckpt_end", "ckpt_async_end",
     "dataloader_wait", "progress", "install_crash_handlers",
     "uninstall_crash_handlers", "default_dump_path",
 ]
@@ -330,6 +330,17 @@ def ckpt_end(kind: str, token, nbytes: int = -1):
     _recorder.record(f"ckpt.{kind}.end", dur_ms=round(dt * 1e3, 3),
                      bytes=int(nbytes))
     goodput.account("checkpoint", dt)
+
+
+def ckpt_async_end(kind: str, dur_ms: float, nbytes: int = -1):
+    """Close-out for a checkpoint write that ran on a BACKGROUND thread
+    (distributed/checkpoint.py async_write): event only, no goodput
+    accrual — the write overlapped training, and the blocking snapshot
+    interval already claimed its (small) share via ckpt_end."""
+    if not _enabled:
+        return
+    _recorder.record(f"ckpt.{kind}.async_end",
+                     dur_ms=round(float(dur_ms), 3), bytes=int(nbytes))
 
 
 def dataloader_wait(seconds: float):

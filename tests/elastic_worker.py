@@ -1,11 +1,28 @@
-"""Worker for the elastic-launch drill (tests/test_elastic_launch.py).
+"""Worker for the elastic-launch + chaos drills (tests/test_elastic_launch.py,
+tests/test_chaos_drill.py, tools/chaos_drill.py).
 
-Deterministic eager SGD on a fixed dataset with per-step auto-checkpoint
-and progress-tied heartbeats (HeartbeatWorker.pulse per step). On its
-FIRST incarnation the designated fail rank either SIGKILLs itself
-(crash) or stops beating forever (hang) at --fail-at-step; after the
-launcher restarts it, the checkpoint resume must make the final params
-identical to an undisturbed run."""
+Deterministic eager SGD with per-step checkpoints and progress-tied
+heartbeats (HeartbeatWorker.pulse per step). Two checkpoint modes:
+
+- legacy (default): per-rank npz, per-rank dataset — the original
+  elastic drill, whose control/chaos runs must stay bit-identical.
+- --sharded-ckpt: the framework path — distributed.checkpoint
+  save_sharded (async write, integrity manifest) with a topology
+  manifest carrying the DataShardCursor, batches drawn from ONE global
+  dataset in global order — so the worker keeps training correctly
+  when the supervisor shrinks/grows the gang (PADDLE_TRAINERS_NUM
+  changes between incarnations; PD_SLOT_ID is the stable identity the
+  checkpoint is keyed on).
+
+Faults come from two sources: the legacy --fail-mode flags (used by
+test_elastic_launch.py) and the PD_CHAOS_* env hooks
+(distributed.chaos.maybe_inject — kill / stall / corrupt_ckpt at a
+named step, first incarnation only by default). The flight recorder is
+armed with crash handlers, so the supervisor's SIGTERM makes every
+rank leave a black box for the in-process tpu_doctor merge; --watchdog
+additionally arms a HangWatchdog so a chaos stall produces a
+``watchdog.stall`` record (the doctor's hang verdict) before the
+supervisor acts."""
 import argparse
 import json
 import os
@@ -22,6 +39,9 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed import chaos  # noqa: E402
+from paddle_tpu.distributed import checkpoint as dckpt  # noqa: E402
+from paddle_tpu.observability import flight_recorder as fr  # noqa: E402
 
 
 def main():
@@ -29,14 +49,36 @@ def main():
     ap.add_argument("--ckpt-dir", required=True)
     ap.add_argument("--out-dir", required=True)
     ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--step-time", type=float, default=0.0,
+                    help="extra seconds of 'work' per step (drill load)")
     ap.add_argument("--fail-mode", choices=("none", "crash", "hang"),
                     default="none")
     ap.add_argument("--fail-rank", type=int, default=1)
     ap.add_argument("--fail-at-step", type=int, default=5)
+    ap.add_argument("--sharded-ckpt", action="store_true",
+                    help="save_sharded async checkpoints + topology "
+                         "manifest + DataShardCursor (elastic mode)")
+    ap.add_argument("--ckpt-every", type=int, default=1)
+    ap.add_argument("--watchdog", action="store_true",
+                    help="arm a HangWatchdog (stall forensics)")
     args = ap.parse_args()
 
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    slot = int(os.environ.get("PD_SLOT_ID", rank))
     incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", 0))
+    # the black box: SIGTERM from the supervisor dumps events + seq
+    # tables + progress for the in-process doctor merge
+    fr.enable(crash_handlers=True)
+    watchdog = None
+    if args.watchdog:
+        from paddle_tpu.observability.watchdog import HangWatchdog
+        # fire BELOW the launcher's heartbeat timeout and the stalled
+        # rank records watchdog.stall before SIGTERM lands — the
+        # doctor's hang verdict instead of the supervisor's fallback
+        watchdog = HangWatchdog(
+            min_timeout=float(os.environ.get("PD_WD_MIN_TIMEOUT", "3")),
+            poll_interval=0.5, peer_poke=False).start()
     hb = None
     endpoint = os.environ.get("PADDLE_HEARTBEAT_ENDPOINT")
     if endpoint:
@@ -44,6 +86,31 @@ def main():
             HeartbeatWorker
         hb = HeartbeatWorker(endpoint, rank, interval=None)  # pulse-only
 
+    if args.sharded_ckpt:
+        run_sharded(args, rank, world, slot, incarnation, hb)
+    else:
+        run_legacy(args, rank, slot, incarnation, hb)
+    if watchdog is not None:
+        watchdog.stop()
+    return 0
+
+
+def _inject_faults(args, rank, incarnation, step, ckpt_path):
+    """Legacy --fail-mode flags plus the PD_CHAOS_* env hooks."""
+    every_time = bool(os.environ.get("PADDLE_FAIL_EVERY_TIME"))
+    if (args.fail_mode != "none"
+            and (incarnation == 0 or every_time)
+            and rank == args.fail_rank
+            and step == args.fail_at_step):
+        if args.fail_mode == "crash":
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(600)  # hang: alive, no pulses — monitor's job
+    chaos.maybe_inject(step, rank=rank, incarnation=incarnation,
+                       ckpt_path=ckpt_path)
+
+
+def run_legacy(args, rank, slot, incarnation, hb):
+    """Original npz drill: per-rank data, bit-identical control/chaos."""
     rng = np.random.RandomState(100 + rank)
     X = rng.randn(32, 4).astype(np.float32)
     Y = (X @ rng.randn(4, 1)).astype(np.float32)
@@ -52,7 +119,7 @@ def main():
     w.set_value(np.zeros((4, 1), np.float32))
     opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
 
-    ckpt = os.path.join(args.ckpt_dir, f"rank{rank}.npz")
+    ckpt = os.path.join(args.ckpt_dir, f"rank{slot}.npz")
     start = 0
     if os.path.exists(ckpt):
         d = np.load(ckpt)
@@ -60,20 +127,17 @@ def main():
         start = int(d["step"]) + 1
 
     for step in range(start, args.steps):
-        every_time = bool(os.environ.get("PADDLE_FAIL_EVERY_TIME"))
-        if (args.fail_mode != "none"
-                and (incarnation == 0 or every_time)
-                and rank == args.fail_rank
-                and step == args.fail_at_step):
-            if args.fail_mode == "crash":
-                os.kill(os.getpid(), signal.SIGKILL)
-            time.sleep(600)  # hang: alive, no pulses — monitor's job
+        _inject_faults(args, rank, incarnation, step, ckpt)
+        tok = fr.step_begin("elastic_worker", step)
+        if args.step_time:
+            time.sleep(args.step_time)
         xb = paddle.to_tensor(X)
         yb = paddle.to_tensor(Y)
         loss = ((xb @ w - yb) ** 2).mean()
         loss.backward()
         opt.step()
         opt.clear_grad()
+        fr.step_end("elastic_worker", step, tok, loss=loss._data)
         # atomic per-step checkpoint, THEN the progress beat
         tmp = ckpt + ".tmp.npz"
         np.savez(tmp, w=np.asarray(w._data), step=step)
@@ -81,11 +145,146 @@ def main():
         if hb is not None:
             hb.pulse()
 
+    _write_out(args, slot, rank, w=np.asarray(w._data).tolist(),
+               incarnation=incarnation, steps_done=args.steps)
+
+
+def _step_barrier(kv, rank, world, step, hb=None, poll=0.05):
+    """Lock-step gate modeling the gradient collective a real dp job
+    blocks on: no rank enters step k+1 until every rank reached k. A
+    dead peer therefore stalls the gang within ONE step — which is
+    what bounds the consistent-cut rollback to the one `.old` each
+    save retains. The waiting rank keeps pulsing (it is alive and
+    blocked on a peer, not the culprit) so detection stays pointed at
+    the rank that actually stopped."""
+    if kv is None or world <= 1:
+        return
+    # keys are namespaced by the launcher's gang epoch: stale gate
+    # values from a previous incarnation must never satisfy (= void)
+    # the barrier after a rollback, or commit skew could outgrow the
+    # depth-2 retention the consistent cut relies on
+    epoch = os.environ.get("PD_GANG_EPOCH", "0")
+    try:
+        kv.put(f"gate/{epoch}/{rank}", str(step))
+    except Exception:
+        return
+    # count the gate ENTRY in the flight recorder's per-(axis, op) seq
+    # table — the same call-time convention real collectives use — so
+    # the doctor names the rank that never entered the gate by seq
+    # DIVERGENCE (its highest-confidence verdict), not by comparing
+    # hang ages between the culprit and the ranks blocked on it
+    fr.collective_seq("gang", "step_gate")
+    while True:
+        ready = True
+        for r in range(world):
+            if r == rank:
+                continue
+            try:
+                v = kv.get(f"gate/{epoch}/{r}")
+            except Exception:
+                return  # KV outage: don't wedge the job on telemetry
+            if v is None or int(v) < step:
+                ready = False
+                break
+        if ready:
+            return
+        if hb is not None:
+            hb.pulse()
+        time.sleep(poll)
+
+
+def run_sharded(args, rank, world, slot, incarnation, hb):
+    """Elastic mode: one GLOBAL dataset sharded by the cursor, async
+    sharded checkpoints keyed on the stable slot id. The gang size may
+    differ between incarnations (supervisor shrink/grow) — the resumed
+    cursor guarantees no example is skipped or repeated."""
+    rng = np.random.RandomState(42)  # same data on every rank
+    n, gb = 64, 8
+    X = rng.randn(n, 4).astype(np.float32)
+    Y = (X @ rng.randn(4, 1)).astype(np.float32)
+
+    w = paddle.create_parameter([4, 1], "float32")
+    w.set_value(np.zeros((4, 1), np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
+
+    ckpt = os.path.join(args.ckpt_dir, f"slot{slot}")
+    cursor = dckpt.DataShardCursor(dataset_size=n, global_batch=gb)
+    start = 0
+    # state and topology must come from the SAME candidate: pairing
+    # independent loads lets leaf-only corruption hand us .old weights
+    # with the primary's newer cursor — a silently dropped update
+    state, topo = dckpt.load_with_topology(ckpt, target={"w": w._data})
+    if topo is not None:
+        # consistent cut: an EVICTED rank's last committed step bounds
+        # the resume — it died mid-step and nobody will replay its
+        # shard of the torn steps unless the survivors roll back to
+        # its cut (a slot that merely respawns replays its own lost
+        # tail itself, so only gone slots constrain us)
+        cut = int(topo["step"])
+        for s in os.environ.get("PD_GONE_SLOTS", "").split(","):
+            if not s.strip() or int(s) == slot:
+                continue
+            other = dckpt.load_topology(
+                os.path.join(args.ckpt_dir, f"slot{int(s)}"))
+            cut = min(cut, int(other["step"])
+                      if other and other.get("step") is not None
+                      else 0)   # gone rank never committed: replay all
+        if cut < int(topo["step"]):
+            state, topo = dckpt.load_at_or_before(
+                ckpt, cut, target={"w": w._data})
+        w.set_value(np.asarray(state["w"]))
+        cursor = dckpt.DataShardCursor.from_state(topo["data_cursor"])
+        start = int(topo["step"]) + 1
+
+    kv = None
+    endpoint = os.environ.get("PADDLE_HEARTBEAT_ENDPOINT")
+    if endpoint and world > 1:
+        from paddle_tpu.distributed.fleet.utils.http_server import \
+            KVClient
+        kv = KVClient(endpoint, timeout=2.0)
+
+    exlog = os.path.join(args.out_dir, f"examples_slot{slot}.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
-    with open(os.path.join(args.out_dir, f"rank{rank}.json"), "w") as f:
-        json.dump({"w": np.asarray(w._data).tolist(),
-                   "incarnation": incarnation}, f)
-    return 0
+    for step in range(start, args.steps):
+        _inject_faults(args, rank, incarnation, step, ckpt)
+        _step_barrier(kv, rank, world, step, hb=hb)
+        tok = fr.step_begin("elastic_worker", step)
+        if args.step_time:
+            time.sleep(args.step_time)
+        idx = cursor.indices(rank, world)
+        xb = paddle.to_tensor(X[idx])
+        yb = paddle.to_tensor(Y[idx])
+        loss = ((xb @ w - yb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        fr.step_end("elastic_worker", step, tok, loss=loss._data)
+        cursor.advance()
+        if (step + 1) % args.ckpt_every == 0 or step == args.steps - 1:
+            dckpt.save_sharded(
+                {"w": w._data}, ckpt, async_write=True,
+                topology=dckpt.topology_manifest(
+                    step=step, data_cursor=cursor.state_dict(),
+                    dp=world, global_batch=gb))
+        # committed-work audit trail for the drill's no-skip/no-dup check
+        with open(exlog, "a") as f:
+            f.write(json.dumps({"step": step, "rank": rank,
+                                "world": world, "inc": incarnation,
+                                "ids": [int(i) for i in idx]}) + "\n")
+        if hb is not None:
+            hb.pulse()
+
+    dckpt.wait_pending()
+    _write_out(args, slot, rank, w=np.asarray(w._data).tolist(),
+               incarnation=incarnation, steps_done=args.steps,
+               world=world)
+
+
+def _write_out(args, slot, rank, **doc):
+    os.makedirs(args.out_dir, exist_ok=True)
+    doc.setdefault("rank", rank)
+    with open(os.path.join(args.out_dir, f"rank{slot}.json"), "w") as f:
+        json.dump(doc, f)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,264 @@
+"""Chaos harness drills. Tier-1: the PD_CHAOS_* hook mechanics
+(distributed/chaos.py, no subprocesses) plus ONE fast end-to-end
+shrink drill (single elastic launch, ~8 s — the named sibling of the
+slow full drills). Slow tier: the acceptance drill — control vs chaos
+runs long enough to amortize one recovery, goodput ratio >= 0.9, and
+the committed-examples audit across an eviction."""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed import chaos
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "elastic_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    for var in ("PD_CHAOS_MODE", "PD_CHAOS_STEP", "PD_CHAOS_RANK",
+                "PD_CHAOS_EVERY", "PD_CHAOS_STALL_S"):
+        monkeypatch.delenv(var, raising=False)
+    chaos.reset_plan_cache()
+    yield
+    chaos.reset_plan_cache()
+
+
+class TestChaosHooks:
+    def test_no_plan_is_noop(self):
+        assert chaos.plan() is None
+        assert chaos.maybe_inject(5, rank=1, incarnation=0) is None
+
+    def test_plan_parsed_once(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "stall")
+        monkeypatch.setenv("PD_CHAOS_STEP", "7")
+        monkeypatch.setenv("PD_CHAOS_RANK", "0")
+        p = chaos.plan()
+        assert p.mode == "stall" and p.step == 7 and p.rank == 0
+        monkeypatch.setenv("PD_CHAOS_STEP", "99")  # ignored: cached
+        assert chaos.plan().step == 7
+        chaos.reset_plan_cache()
+        assert chaos.plan().step == 99
+
+    def test_unknown_mode_disarms(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "meteor")
+        assert chaos.plan() is None
+
+    def test_wrong_rank_or_step_is_noop(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "stall")
+        monkeypatch.setenv("PD_CHAOS_STEP", "5")
+        monkeypatch.setenv("PD_CHAOS_RANK", "1")
+        monkeypatch.setenv("PD_CHAOS_STALL_S", "0.01")
+        assert chaos.maybe_inject(5, rank=0, incarnation=0) is None
+        assert chaos.maybe_inject(4, rank=1, incarnation=0) is None
+
+    def test_stall_fires_at_named_step_first_incarnation_only(
+            self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "stall")
+        monkeypatch.setenv("PD_CHAOS_STEP", "5")
+        monkeypatch.setenv("PD_CHAOS_RANK", "1")
+        monkeypatch.setenv("PD_CHAOS_STALL_S", "0.05")
+        t0 = time.time()
+        assert chaos.maybe_inject(5, rank=1, incarnation=0) == "stall"
+        assert time.time() - t0 >= 0.05
+        # the restarted incarnation survives the same (rank, step)
+        assert chaos.maybe_inject(5, rank=1, incarnation=1) is None
+
+    def test_every_flag_fires_on_all_incarnations(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "stall")
+        monkeypatch.setenv("PD_CHAOS_STEP", "2")
+        monkeypatch.setenv("PD_CHAOS_RANK", "0")
+        monkeypatch.setenv("PD_CHAOS_STALL_S", "0.01")
+        monkeypatch.setenv("PD_CHAOS_EVERY", "1")
+        assert chaos.maybe_inject(2, rank=0, incarnation=3) == "stall"
+
+    def test_corrupt_handles_file_and_dir(self, tmp_path):
+        f = tmp_path / "ck.pkl"
+        f.write_bytes(b"x" * 100)
+        chaos._corrupt(str(f))
+        assert b"chaos" in f.read_bytes()
+        d = tmp_path / "ckdir" / "leaf"
+        d.mkdir(parents=True)
+        (d / "0.0").write_bytes(b"y" * 100)
+        chaos._corrupt(str(tmp_path / "ckdir"))
+        assert b"chaos" in (d / "0.0").read_bytes()
+
+    def test_corrupt_finds_pickle_suffix_from_base_path(self, tmp_path):
+        # workers pass the BASE checkpoint path; the pickle fallback's
+        # payload lives at <base>.pkl — a miss here would degrade the
+        # corrupt_ckpt drill to a plain kill that "passes" vacuously
+        (tmp_path / "slot1.pkl").write_bytes(b"x" * 100)
+        chaos._corrupt(str(tmp_path / "slot1"))
+        assert b"chaos" in (tmp_path / "slot1.pkl").read_bytes()
+
+    def test_kill_mode_really_kills(self, tmp_path):
+        # in a subprocess: maybe_inject(kill) must die via SIGKILL with
+        # no output after the injection point
+        code = (
+            "import os\n"
+            "os.environ.update(PD_CHAOS_MODE='kill', PD_CHAOS_STEP='3',"
+            " PD_CHAOS_RANK='0', PADDLE_TRAINER_ID='0',"
+            " PADDLE_RESTART_COUNT='0')\n"
+            f"import sys; sys.path.insert(0, {REPO!r})\n"
+            "from paddle_tpu.distributed import chaos\n"
+            "for step in range(6):\n"
+            "    print('step', step, flush=True)\n"
+            "    chaos.maybe_inject(step)\n"
+            "print('survived', flush=True)\n")
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == -signal.SIGKILL
+        assert "step 3" in r.stdout and "survived" not in r.stdout
+
+
+class TestDrillCli:
+    def test_check_receipt_logic(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_drill
+
+        class A:
+            mode, rank = "kill", 1
+
+        good = {"receipts": [
+            {"action": "evict_shrink", "ranks": [1], "episode": 1,
+             "verdict": {"kind": "crash", "rank": 1,
+                         "source": "supervisor"}}]}
+        assert chaos_drill.check_receipt(A, good)["ok"]
+        wrong_rank = {"receipts": [
+            {"action": "respawn_gang", "ranks": [0],
+             "verdict": {"kind": "crash", "rank": 0}}]}
+        assert not chaos_drill.check_receipt(A, wrong_rank)["ok"]
+        wrong_kind = {"receipts": [
+            {"action": "respawn_gang", "ranks": [1],
+             "verdict": {"kind": "hang", "rank": 1}}]}
+        assert not chaos_drill.check_receipt(A, wrong_kind)["ok"]
+
+
+def _launch_elastic(tmp_path, *, chaos_env=None, extra=(), steps=10,
+                    timeout=300, nproc=2, worker_extra=()):
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "out")
+    receipts = str(tmp_path / "receipts")
+    os.makedirs(ckpt, exist_ok=True)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc), "--elastic",
+           "--heartbeat_timeout", "5",
+           "--restart_backoff", "0.1", "--dump_grace", "0.5",
+           *extra,
+           WORKER, "--ckpt-dir", ckpt, "--out-dir", out,
+           "--steps", str(steps), "--sharded-ckpt", *worker_extra]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PD_ELASTIC_DIR=receipts)
+    env.pop("PD_CHAOS_MODE", None)
+    if chaos_env:
+        env.update(chaos_env)
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout, env=env, cwd=REPO)
+    recs = []
+    for f in sorted(glob.glob(os.path.join(receipts, "receipt_*.json"))):
+        with open(f) as fh:
+            recs.append(json.load(fh))
+    return r, out, recs
+
+
+def _examples_audit(out_dir):
+    """Committed-examples audit: replays of the same step must consume
+    the SAME ids, and the per-step union must be the cursor's global
+    batch — no example skipped or repeated across shrink/resume."""
+    per_step = {}
+    for f in glob.glob(os.path.join(out_dir, "examples_slot*.jsonl")):
+        for line in open(f):
+            rec = json.loads(line)
+            per_step.setdefault(rec["step"], []).append(rec)
+    return per_step
+
+
+class TestShrinkDrillFast:
+    """Tier-1 sibling of the slow acceptance drill: one elastic launch,
+    kill rank 1, supervisor evicts it and the survivor finishes at
+    dp=1 with the data cursor intact (~8 s)."""
+
+    def test_kill_evict_shrink_resume(self, tmp_path):
+        r, out, recs = _launch_elastic(
+            tmp_path,
+            chaos_env={"PD_CHAOS_MODE": "kill", "PD_CHAOS_STEP": "4",
+                       "PD_CHAOS_RANK": "1"},
+            extra=("--elastic_shrink",), steps=10)
+        assert r.returncode == 0, r.stderr[-3000:]
+        # remediation receipt names the evicted rank and the verdict
+        evict = [x for x in recs if x["action"] == "evict_shrink"]
+        assert evict, [x["action"] for x in recs]
+        assert evict[0]["ranks"] == [1]
+        assert evict[0]["verdict"]["kind"] == "crash"
+        assert evict[0]["verdict"]["rank"] == 1
+        assert evict[0]["world_before"] == 2
+        assert evict[0]["world_after"] == 1
+        # survivor (slot 0) finished all steps at the shrunk world
+        with open(os.path.join(out, "rank0.json")) as f:
+            surv = json.load(f)
+        assert surv["steps_done"] == 10
+        assert surv["world"] == 1  # resumed at dp=1
+        # no example skipped or repeated: every committed step consumed
+        # EXACTLY its cursor window of the global order — at dp=2
+        # before the eviction, at dp=1 after — nothing else
+        per_step = _examples_audit(out)
+        assert set(per_step) == set(range(10))
+        for step in range(10):
+            got = {i for rec in per_step[step] for i in rec["ids"]}
+            want = {(step * 8 + j) % 64 for j in range(8)}
+            assert got == want, (step, sorted(got))
+
+
+@pytest.mark.slow  # ~2 min: control + chaos runs sized so one
+#   recovery costs < 10% of the job (the ISSUE's goodput >= 0.9 bar);
+#   tier-1 siblings: TestShrinkDrillFast + the chaos-hook units above
+class TestAcceptanceDrill:
+    def test_kill_drill_goodput_and_receipt(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_drill
+        # recovery costs ~5.5 s (detection + dump grace + backoff +
+        # one worker re-import) regardless of job length; 220 steps x
+        # 0.3 s puts the expected ratio near 0.93 — a real margin over
+        # the 0.9 bar, not a razor's edge
+        rc = chaos_drill.main([
+            "--mode", "kill", "--steps", "220", "--step-time", "0.3",
+            "--ckpt-every", "5", "--step", "30",
+            "--goodput-bar", "0.9",
+            "--workdir", str(tmp_path)])
+        assert rc == 0
+
+    def test_stall_drill_doctor_verdict(self, tmp_path):
+        # shorter job (bar not the point): this leg pins that the
+        # DOCTOR names the stalling rank from the merged dumps —
+        # step-gate seq divergence (the stalled rank never entered the
+        # gate) or its watchdog.stall record — not just the monitor
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_drill
+        rc = chaos_drill.main([
+            "--mode", "stall", "--steps", "30", "--step-time", "0.1",
+            "--heartbeat_timeout", "5", "--goodput-bar", "0.3",
+            "--workdir", str(tmp_path)])
+        assert rc == 0
+        with open(glob.glob(os.path.join(
+                str(tmp_path), "receipts_chaos",
+                "receipt_*.json"))[0]) as f:
+            rec = json.load(f)
+        assert rec["verdict"]["kind"] in ("divergence", "hang")
+        assert rec["verdict"]["source"] == "doctor"
+        assert rec["verdict"]["rank"] == 1
+
+    def test_corrupt_ckpt_drill(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_drill
+        rc = chaos_drill.main([
+            "--mode", "corrupt_ckpt", "--steps", "30", "--step-time",
+            "0.1", "--goodput-bar", "0.3",
+            "--workdir", str(tmp_path)])
+        assert rc == 0

@@ -1,0 +1,506 @@
+"""Async checkpointing, integrity-manifest fallback and topology-elastic
+resume (distributed/checkpoint.py) — tier-1, all in-process.
+
+The three acceptance receipts from the self-healing-fleet issue:
+- the goodput checkpoint bucket under async_write is ≤ 0.25× the
+  synchronous baseline at equal cadence, and training steps proceed
+  while the background write runs;
+- a corrupted checkpoint (bit-flipped leaf, garbage metadata) falls
+  back to .old/.saving instead of aborting the resume;
+- a dp=2 checkpoint resumes at dp=1 with the data-shard cursor intact
+  (no example skipped or repeated) and a loss trajectory matching the
+  undisturbed run.
+"""
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import checkpoint as ck
+from paddle_tpu.observability import flight_recorder as fr
+from paddle_tpu.observability import goodput, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    ck.wait_pending()
+    fr.disable()
+    fr.reset()
+    goodput.reset()
+    metrics.reset()
+    yield
+    ck.wait_pending()
+    fr.disable()
+    fr.reset()
+    goodput.reset()
+    metrics.reset()
+
+
+def _state(scale=1.0):
+    return {"w": jnp.arange(24.0).reshape(4, 6) * scale,
+            "b": jnp.ones((6,)) * scale}
+
+
+def _slow_writer(monkeypatch, delay_s):
+    real = ck._write_payload
+
+    def slow(*a, **kw):
+        time.sleep(delay_s)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ck, "_write_payload", slow)
+
+
+class TestAsyncWrite:
+    def test_roundtrip_and_async_event(self, tmp_path):
+        fr.enable()
+        p = str(tmp_path / "ck")
+        st = _state()
+        ck.save_sharded(st, p, async_write=True)
+        assert ck.wait_pending()
+        out = ck.load_sharded(p, target=st)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(st["w"]))
+        kinds = [e["k"] for e in fr.get_recorder().events()]
+        assert "ckpt.save.begin" in kinds
+        assert "ckpt.save.end" in kinds        # the blocking snapshot
+        assert "ckpt.save.async_end" in kinds  # the overlapped write
+
+    def test_steps_proceed_during_background_write(self, tmp_path,
+                                                   monkeypatch):
+        _slow_writer(monkeypatch, 0.5)
+        p = str(tmp_path / "ck")
+        t0 = time.perf_counter()
+        ck.save_sharded(_state(), p, async_write=True)
+        blocked = time.perf_counter() - t0
+        # "training" continues while the writer sleeps
+        acc = 0.0
+        for i in range(50):
+            acc += float(np.square(np.arange(100.0)).sum())
+        stepped_by = time.perf_counter() - t0
+        assert blocked < 0.25, f"snapshot blocked {blocked:.3f}s"
+        assert stepped_by < 0.45, "steps did not overlap the write"
+        assert ck.wait_pending()
+        assert ck.load_sharded(p, target=_state()) is not None
+
+    def test_goodput_checkpoint_bucket_quarter_of_sync(self, tmp_path,
+                                                       monkeypatch):
+        """THE receipt: equal cadence, async bucket ≤ 0.25× sync."""
+        _slow_writer(monkeypatch, 0.05)
+        fr.enable()
+        saves = 4
+
+        goodput.reset()
+        for i in range(saves):
+            ck.save_sharded(_state(i + 1.0),
+                            str(tmp_path / "sync"))
+        sync_bucket = goodput.accrued("checkpoint")
+
+        goodput.reset()
+        for i in range(saves):
+            ck.save_sharded(_state(i + 1.0), str(tmp_path / "async"),
+                            async_write=True)
+            ck.wait_pending()   # equal cadence; join happens OUTSIDE
+                                # the save, like steps would
+        async_bucket = goodput.accrued("checkpoint")
+
+        assert sync_bucket >= saves * 0.05
+        assert async_bucket <= 0.25 * sync_bucket, (
+            f"async checkpoint bucket {async_bucket:.4f}s vs sync "
+            f"{sync_bucket:.4f}s")
+        # the overlapped write is still visible — in its own metric
+        fr.disable()
+
+    def test_async_metrics_split_block_from_write(self, tmp_path,
+                                                  monkeypatch):
+        _slow_writer(monkeypatch, 0.05)
+        with metrics.enabled_scope():
+            ck.save_sharded(_state(), str(tmp_path / "ck"),
+                            async_write=True)
+            ck.wait_pending()
+            snap = metrics.snapshot()
+        assert snap["checkpoint.saves_total"]["value"] == 1
+        assert snap["checkpoint.async_saves_total"]["value"] == 1
+        assert snap["checkpoint.async_write_ms"]["count"] == 1
+        assert snap["checkpoint.async_write_ms"]["min"] >= 50.0
+        assert snap["checkpoint.save_block_ms"]["max"] < 50.0
+
+    def test_write_error_propagates_on_wait(self, tmp_path, monkeypatch):
+        def boom(*a, **kw):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ck, "_write_payload", boom)
+        ck.save_sharded(_state(), str(tmp_path / "ck"),
+                        async_write=True)
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            ck.wait_pending()
+        # error is cleared: the plane keeps working afterwards
+        monkeypatch.undo()
+        ck.save_sharded(_state(), str(tmp_path / "ck2"),
+                        async_write=True)
+        assert ck.wait_pending()
+
+    def test_second_save_joins_inflight_write(self, tmp_path,
+                                              monkeypatch):
+        _slow_writer(monkeypatch, 0.2)
+        p = str(tmp_path / "ck")
+        ck.save_sharded(_state(1.0), p, async_write=True)
+        t0 = time.perf_counter()
+        ck.save_sharded(_state(2.0), p, async_write=True)  # must join
+        assert time.perf_counter() - t0 >= 0.15
+        assert ck.wait_pending()
+        out = ck.load_sharded(p, target=_state())
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(_state(2.0)["w"]))
+
+
+def _smash_files(root, keep_json=False):
+    for f in glob.glob(os.path.join(root, "**", "*"), recursive=True):
+        if os.path.isfile(f) and not (keep_json and f.endswith(".json")):
+            with open(f, "wb") as fh:
+                fh.write(b"garbage")
+
+
+class TestIntegrityManifest:
+    def test_corrupt_data_blobs_fall_back_to_old(self, tmp_path):
+        p = str(tmp_path / "ck")
+        old_state, new_state = _state(2.0), _state(1.0)
+        ck.save_sharded(old_state, p)
+        ck.save_sharded(new_state, p)   # old_state now at .old
+        # flip the tail of every data blob (content-addressed stores
+        # keep replicas — a single-file flip can hit an unread copy)
+        for f in glob.glob(os.path.join(p, "**", "*"), recursive=True):
+            if os.path.isfile(f) and not f.endswith(".json") \
+                    and os.path.getsize(f) > 40:
+                raw = open(f, "rb").read()
+                with open(f, "wb") as fh:
+                    fh.write(raw[:-8] + b"\xffchaos\xff\xff")
+        out = ck.load_sharded(p, target=_state())
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(old_state["w"]))
+        snap = metrics.snapshot()
+        assert snap["checkpoint.corruptions_total"]["value"] >= 1
+
+    def test_silent_bitflip_caught_by_manifest_pickle_path(
+            self, tmp_path, monkeypatch):
+        """The manifest's raison d'être: a flip the container format
+        itself never notices. The pickle fallback has no CRC of its
+        own — flip array bytes IN PLACE (unpickle still succeeds,
+        values silently differ) and only the manifest can catch it."""
+        monkeypatch.setattr(ck, "_orbax", lambda: None)
+        p = str(tmp_path / "ck")
+        old_state, new_state = _state(2.0), _state(1.0)
+        ck.save_sharded(old_state, p)
+        ck.save_sharded(new_state, p)
+        pkl = p + ".pkl"
+        raw = open(pkl, "rb").read()
+        needle = np.float32(7.0).tobytes()      # a value inside w
+        assert needle in raw
+        patched = raw.replace(needle, np.float32(99.0).tobytes(), 1)
+        with open(pkl, "wb") as fh:
+            fh.write(patched)
+        # sanity: the flip IS silent at the container level
+        from paddle_tpu import serialization
+        silently_loaded = serialization.load(pkl)
+        assert float(np.asarray(silently_loaded["w"]).max()) == 99.0
+        out = ck.load_sharded(p, target=_state())
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(old_state["w"]))
+        snap = metrics.snapshot()
+        assert snap["checkpoint.corruptions_total"]["value"] >= 1
+
+    def test_trashed_primary_falls_back_to_old(self, tmp_path):
+        p = str(tmp_path / "ck")
+        ck.save_sharded(_state(2.0), p)
+        ck.save_sharded(_state(1.0), p)
+        _smash_files(p)
+        out = ck.load_sharded(p, target=_state())
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(_state(2.0)["w"]))
+
+    def test_all_candidates_corrupt_raises(self, tmp_path):
+        p = str(tmp_path / "ck")
+        ck.save_sharded(_state(2.0), p)
+        ck.save_sharded(_state(1.0), p)
+        _smash_files(p)
+        _smash_files(p + ".old")
+        with pytest.raises(RuntimeError, match="no restorable"):
+            ck.load_sharded(p, target=_state())
+
+    def test_manifest_catches_missing_leaf(self):
+        arrays = {"w": np.ones((2, 2), np.float32),
+                  "b": np.zeros((2,), np.float32)}
+        man = ck._manifest_doc(arrays)
+        assert ck._verify_manifest(arrays, man) is None
+        del arrays["b"]
+        reason = ck._verify_manifest(arrays, man)
+        assert reason and "missing" in reason
+
+    def test_manifest_catches_value_change(self):
+        arrays = {"w": np.ones((2, 2), np.float32)}
+        man = ck._manifest_doc(arrays)
+        assert "checksum" in ck._verify_manifest(
+            {"w": np.full((2, 2), 2.0, np.float32)}, man)
+
+    def test_manifest_catches_dtype_change(self):
+        # dtype is the ONLY integrity signal for non-addressable
+        # (multi-host) leaves where no crc32 was recorded
+        arrays = {"w": np.ones((2, 2), np.float32)}
+        man = ck._manifest_doc(arrays)
+        del man["leaves"]["['w']"]["crc32"]  # checksum-less entry
+        assert "dtype" in ck._verify_manifest(
+            {"w": np.ones((2, 2), np.float16)}, man)
+
+
+class TestLoadWithTopology:
+    def test_state_and_topology_from_same_candidate(self, tmp_path,
+                                                    monkeypatch):
+        """Leaf-only corruption (sidecars intact) must NOT pair .old
+        weights with the primary's newer cursor — that silently drops
+        the rolled-back step's update while the cursor claims its
+        examples were consumed."""
+        monkeypatch.setattr(ck, "_orbax", lambda: None)
+        p = str(tmp_path / "ck")
+        cur = ck.DataShardCursor(64, 8)
+        ck.save_sharded(_state(2.0), p, topology=ck.topology_manifest(
+            step=3, data_cursor=cur.state_dict()))
+        ck.save_sharded(_state(1.0), p, topology=ck.topology_manifest(
+            step=4, data_cursor=cur.state_dict()))
+        # corrupt ONLY the primary payload; its topology still parses
+        raw = open(p + ".pkl", "rb").read()
+        needle = np.float32(7.0).tobytes()
+        with open(p + ".pkl", "wb") as fh:
+            fh.write(raw.replace(needle, np.float32(99.0).tobytes(), 1))
+        assert ck.load_topology(p)["step"] == 4  # primary doc parses
+        state, topo = ck.load_with_topology(p, target=_state())
+        np.testing.assert_array_equal(np.asarray(state["w"]),
+                                      np.asarray(_state(2.0)["w"]))
+        assert topo["step"] == 3  # the .old topology, SAME candidate
+
+    def test_missing_checkpoint_is_none_pair(self, tmp_path):
+        state, topo = ck.load_with_topology(str(tmp_path / "nope"))
+        assert state is None and topo is None
+
+
+class TestTopology:
+    def test_roundtrip_with_fallback(self, tmp_path):
+        p = str(tmp_path / "ck")
+        cur = ck.DataShardCursor(64, 8)
+        for _ in range(3):
+            cur.advance()
+        ck.save_sharded(_state(2.0), p, topology=ck.topology_manifest(
+            step=2, data_cursor=cur.state_dict(), dp=2, global_batch=8))
+        cur.advance()
+        ck.save_sharded(_state(1.0), p, topology=ck.topology_manifest(
+            step=3, data_cursor=cur.state_dict(), dp=2, global_batch=8))
+        topo = ck.load_topology(p)
+        assert topo["step"] == 3 and topo["dp"] == 2
+        assert topo["data_cursor"]["offset"] == 32
+        # corrupted primary: topology follows the arrays to .old
+        _smash_files(p, keep_json=False)
+        assert ck.load_topology(p)["step"] == 2
+
+    def test_missing_topology_is_none(self, tmp_path):
+        p = str(tmp_path / "ck")
+        ck.save_sharded(_state(), p)
+        assert ck.load_topology(p) is None
+
+    def test_healthy_topology_less_save_does_not_serve_stale_old(
+            self, tmp_path):
+        # a later save WITHOUT topology rotates the old sidecar to
+        # .old; serving that stale step/cursor as current would rewind
+        # the resume — a healthy topology-less newest save means None
+        p = str(tmp_path / "ck")
+        cur = ck.DataShardCursor(64, 8)
+        ck.save_sharded(_state(2.0), p, topology=ck.topology_manifest(
+            step=40, data_cursor=cur.state_dict()))
+        ck.save_sharded(_state(1.0), p)  # no topology, healthy
+        assert ck.load_topology(p) is None
+        # ...but a DAMAGED newest save still falls back to .old
+        _smash_files(p)
+        assert ck.load_topology(p)["step"] == 40
+
+    def test_keep_old_opt_out_pickle_path(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(ck, "_orbax", lambda: None)
+        monkeypatch.setenv("PD_CKPT_KEEP_OLD", "0")
+        p = str(tmp_path / "ck")
+        ck.save_sharded(_state(2.0), p)
+        ck.save_sharded(_state(1.0), p)
+        assert os.path.exists(p + ".pkl")
+        assert not os.path.exists(p + ".pkl.old")
+        assert not os.path.exists(p + ".pkl.old.manifest.json")
+
+    def test_keep_old_zero_crash_mid_commit_keeps_previous(
+            self, tmp_path, monkeypatch):
+        """PD_CKPT_KEEP_OLD=0 must not pre-delete the current payload:
+        a crash between a delete and the atomic replace would leave
+        ZERO restorable checkpoints."""
+        monkeypatch.setattr(ck, "_orbax", lambda: None)
+        monkeypatch.setenv("PD_CKPT_KEEP_OLD", "0")
+        p = str(tmp_path / "ck")
+        ck.save_sharded(_state(2.0), p)
+        real_replace = os.replace
+
+        def dying_replace(src, dst):
+            if dst == p + ".pkl":          # the payload commit
+                raise OSError("simulated crash at commit")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(ck.os, "replace", dying_replace)
+        with pytest.raises(OSError):
+            ck.save_sharded(_state(1.0), p)
+        monkeypatch.setattr(ck.os, "replace", real_replace)
+        out = ck.load_sharded(p, target=_state())
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(_state(2.0)["w"]))
+
+    def test_rollback_best_effort_skips_corrupt_oldest(
+            self, tmp_path, monkeypatch):
+        """best_effort must apply the same corruption discipline as
+        the main walk: a corrupt oldest too-new candidate falls
+        through to the next, recording evidence — not an unguarded
+        raise out of the rollback."""
+        monkeypatch.setattr(ck, "_orbax", lambda: None)
+        metrics.reset()
+        p = str(tmp_path / "ck")
+        cur = ck.DataShardCursor(64, 8)
+        for step, scale in ((10, 3.0), (11, 2.0), (12, 1.0)):
+            ck.save_sharded(_state(scale), p,
+                            topology=ck.topology_manifest(
+                                step=step,
+                                data_cursor=cur.state_dict()))
+        # corrupt the OLDEST retained (.old2 = step 10) payload only
+        raw = open(p + ".pkl.old2", "rb").read()
+        needle = np.float32(7.0 * 3.0).tobytes()
+        with open(p + ".pkl.old2", "wb") as fh:
+            fh.write(raw.replace(needle, np.float32(-1.0).tobytes(), 1))
+        out, topo = ck.load_at_or_before(p, 5, target=_state())
+        assert topo["step"] == 11  # next-oldest, with the gap reported
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(_state(2.0)["w"]))
+        snap = metrics.snapshot()
+        assert snap["checkpoint.rollback_gaps_total"]["value"] == 1
+        assert snap["checkpoint.corruptions_total"]["value"] >= 1
+
+
+class TestDataShardCursor:
+    def test_no_skip_no_dup_across_shrink(self):
+        cur = ck.DataShardCursor(dataset_size=32, global_batch=8)
+        seen = []
+        for _step in range(2):          # dp=2 phase
+            for r in range(2):
+                seen += list(cur.indices(r, 2))
+            cur.advance()
+        resumed = ck.DataShardCursor.from_state(cur.state_dict())
+        for _step in range(2):          # dp=1 phase after shrink
+            seen += list(resumed.indices(0, 1))
+            resumed.advance()
+        assert seen == list(range(32))  # exactly once each, in order
+
+    def test_grow_path_too(self):
+        cur = ck.DataShardCursor(dataset_size=32, global_batch=8)
+        cur.advance()                   # dp=1 consumed [0..8)
+        seen = list(range(8))
+        for r in range(4):              # grow to dp=4
+            seen += list(cur.indices(r, 4))
+        assert seen == list(range(16))
+
+    def test_divisibility_enforced(self):
+        cur = ck.DataShardCursor(32, 8)
+        with pytest.raises(ValueError, match="not divisible"):
+            cur.indices(0, 3)
+        with pytest.raises(ValueError, match="out of range"):
+            cur.indices(2, 2)
+
+    def test_epoch_wrap(self):
+        cur = ck.DataShardCursor(8, 8)
+        cur.advance()
+        assert cur.epoch == 1 and cur.offset == 0
+
+
+class TestTopologyElasticResume:
+    """dp=2 checkpoint resumes at dp=1: cursor intact, loss trajectory
+    matching the undisturbed run (grad averaging over equal-size shards
+    == global-batch gradient, so the SAME global batches give the SAME
+    updates)."""
+
+    N, GB, LR, STEPS, CKPT_AT = 64, 8, 0.05, 12, 5
+
+    def _data(self):
+        rng = np.random.RandomState(7)
+        X = rng.randn(self.N, 4)
+        Y = X @ rng.randn(4, 1)
+        return X, Y
+
+    @staticmethod
+    def _grad(w, X, Y):
+        b = X.shape[0]
+        return (2.0 / b) * X.T @ (X @ w - Y)
+
+    @staticmethod
+    def _loss(w, X, Y):
+        return float(np.mean((X @ w - Y) ** 2))
+
+    def _control(self):
+        X, Y = self._data()
+        w = np.zeros((4, 1))
+        cur = ck.DataShardCursor(self.N, self.GB)
+        losses, batches = [], []
+        for _ in range(self.STEPS):
+            idx = cur.indices(0, 1)
+            batches.append(list(idx))
+            losses.append(self._loss(w, X[idx], Y[idx]))
+            w = w - self.LR * self._grad(w, X[idx], Y[idx])
+            cur.advance()
+        return w, losses, batches
+
+    def test_dp2_to_dp1_resume_matches_control(self, tmp_path):
+        X, Y = self._data()
+        p = str(tmp_path / "ck")
+        w = np.zeros((4, 1))
+        cur = ck.DataShardCursor(self.N, self.GB)
+        losses, batches = [], []
+        for step in range(self.CKPT_AT + 1):     # dp=2 phase
+            idx_all, g, ls = [], 0.0, 0.0
+            for r in range(2):
+                idx = cur.indices(r, 2)
+                idx_all += list(idx)
+                g = g + self._grad(w, X[idx], Y[idx]) / 2.0
+                ls += self._loss(w, X[idx], Y[idx]) / 2.0
+            batches.append(idx_all)
+            losses.append(ls)
+            w = w - self.LR * g
+            cur.advance()
+            ck.save_sharded(
+                {"w": jnp.asarray(w)}, p, async_write=True,
+                topology=ck.topology_manifest(
+                    step=step, data_cursor=cur.state_dict(), dp=2,
+                    global_batch=self.GB))
+        ck.wait_pending()
+
+        # "restart" at dp=1: fresh state, restore from disk only
+        topo = ck.load_topology(p)
+        assert topo["dp"] == 2
+        restored = ck.load_sharded(
+            p, target={"w": jnp.zeros((4, 1))})
+        w2 = np.asarray(restored["w"], dtype=np.float64)
+        cur2 = ck.DataShardCursor.from_state(topo["data_cursor"])
+        for step in range(topo["step"] + 1, self.STEPS):  # dp=1 phase
+            idx = cur2.indices(0, 1)
+            batches.append(list(idx))
+            losses.append(self._loss(w2, X[idx], Y[idx]))
+            w2 = w2 - self.LR * self._grad(w2, X[idx], Y[idx])
+            cur2.advance()
+
+        wc, losses_c, batches_c = self._control()
+        # no example skipped or repeated: the global batch sequence is
+        # IDENTICAL to the undisturbed run's
+        assert batches == batches_c
+        # trajectory parity: the checkpoint round-trips through f32
+        # (jax default), so one ~1e-8 rounding of w at the resume step;
+        # the math itself (mean-of-shards == global mean) is exact
+        np.testing.assert_allclose(losses, losses_c, rtol=1e-6)
+        np.testing.assert_allclose(w2, wc, rtol=1e-6)

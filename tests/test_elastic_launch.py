@@ -96,6 +96,36 @@ def test_rank_policy_restarts_only_dead_rank(tmp_path, control):
                                    control[rank]["w"], rtol=0, atol=0)
 
 
+def test_crash_loop_guard_backoff_and_window_budget(tmp_path):
+    # a worker that dies at import/step-0 EVERY incarnation must not
+    # burn a big lifetime budget in seconds: the restarts-per-window
+    # budget aborts first, and exponential backoff separates the
+    # respawns it does grant
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "out")
+    os.makedirs(ckpt, exist_ok=True)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "1", "--elastic",
+           "--max_restarts", "50", "--restart_budget", "2",
+           "--restart_window", "60", "--restart_backoff", "0.3",
+           "--heartbeat_timeout", "5",
+           WORKER, "--ckpt-dir", ckpt, "--out-dir", out,
+           "--fail-mode", "crash", "--fail-rank", "0",
+           "--fail-at-step", "0"]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PADDLE_FAIL_EVERY_TIME="1")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                       env=env, cwd=REPO)
+    assert r.returncode == 1
+    assert "restart budget 2/60s exhausted" in r.stderr, \
+        r.stderr[-3000:]
+    # the backoff ladder ran between the granted respawns
+    assert "backoff 0.30s" in r.stderr
+    assert "backoff 0.60s" in r.stderr
+    # the big lifetime budget was NOT burned
+    assert "restart 3/50" not in r.stderr
+
+
 def test_max_restarts_exhaustion_fails_loudly(tmp_path):
     # a worker that dies every incarnation must abort after the budget
     ckpt = str(tmp_path / "ckpt")

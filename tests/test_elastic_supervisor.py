@@ -1,0 +1,368 @@
+"""Tier-1 drills for the verdict-driven supervisor state machine
+(distributed/elastic.py) — every evict/shrink/backoff/abort decision
+against canned doctor verdicts, no subprocesses (<1 s each; the full
+2-process chaos drills live in tests/test_chaos_drill.py, slow tier).
+"""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.distributed import elastic
+from paddle_tpu.distributed.elastic import (SupervisorPolicy,
+                                            effective_verdict,
+                                            translate_verdict_rank)
+from paddle_tpu.observability import metrics
+
+
+DIVERGENCE = {"kind": "divergence", "rank": 1, "source": "doctor",
+              "evidence": {"axis": "dp", "op": "allreduce_sum",
+                           "seq": 7}}
+HANG = {"kind": "hang", "rank": 2, "source": "doctor",
+        "evidence": {"age_s": 42.0}}
+STRAGGLER = {"kind": "straggler", "rank": 3, "source": "doctor",
+             "evidence": {"vs_fleet_median": 2.1}}
+NONE_V = dict(elastic.NONE_VERDICT)
+
+
+def _policy(**kw):
+    kw.setdefault("world", 4)
+    kw.setdefault("backoff_base", 1.0)
+    kw.setdefault("backoff_factor", 2.0)
+    return SupervisorPolicy(**kw)
+
+
+class TestVerdictDecisions:
+    def test_divergence_verdict_evicts_named_rank_when_shrink_allowed(self):
+        p = _policy(allow_shrink=True)
+        d = p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
+        assert d.action == "evict_shrink"
+        assert d.ranks == [1]
+        assert d.verdict["kind"] == "divergence"
+        assert p.active == [0, 2, 3]
+        assert 1 in p.evicted
+
+    def test_hang_verdict_evicts(self):
+        p = _policy(allow_shrink=True)
+        d = p.decide([(2, "heartbeat stall")], HANG, now=0.0)
+        assert d.action == "evict_shrink" and d.ranks == [2]
+
+    def test_straggler_verdict_respawns_not_evicts(self):
+        # a straggler is a cost, not a fault: never shrink on it
+        p = _policy(allow_shrink=True)
+        d = p.decide([(3, "exit rc=1")], STRAGGLER, now=0.0)
+        assert d.action == "respawn_gang"
+        assert p.active == [0, 1, 2, 3]
+
+    def test_no_shrink_flag_means_gang_respawn(self):
+        p = _policy(allow_shrink=False)
+        d = p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
+        assert d.action == "respawn_gang"
+        assert p.active == [0, 1, 2, 3]
+
+    def test_min_world_floor_blocks_eviction(self):
+        p = _policy(world=2, allow_shrink=True, min_world=2)
+        d = p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
+        assert d.action == "respawn_gang"  # survivors < min_world
+        assert p.active == [0, 1]
+
+    def test_rank_policy_respawns_only_failed(self):
+        p = _policy(policy="rank")
+        d = p.decide([(2, "exit rc=1")], None, now=0.0)
+        assert d.action == "respawn_rank" and d.ranks == [2]
+
+    def test_verdict_for_unknown_rank_cannot_evict(self):
+        # a stale dump naming an already-evicted rank must not shrink
+        # the gang twice
+        p = _policy(allow_shrink=True)
+        p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)  # evicts 1
+        d = p.decide([(0, "exit rc=1")], DIVERGENCE, now=1.0)
+        assert d.action == "respawn_gang"
+        assert p.active == [0, 2, 3]
+
+
+class TestVerdictRankTranslation:
+    def test_shrunk_gang_rank_maps_to_slot(self):
+        # slots [0,2,3] run as contiguous ranks 0,1,2: a dump naming
+        # rank 2 means SLOT 3 — evicting slot 2 would kill a healthy
+        # rank while the diverging one keeps corrupting the gang
+        v = translate_verdict_rank({"kind": "divergence", "rank": 2},
+                                   ranks_now=[0, 2, 3])
+        assert v["rank"] == 3
+
+    def test_unshrunk_gang_is_identity(self):
+        v = translate_verdict_rank({"kind": "hang", "rank": 1},
+                                   ranks_now=[0, 1, 2, 3])
+        assert v["rank"] == 1
+
+    def test_out_of_range_rank_dropped_not_guessed(self):
+        v = translate_verdict_rank({"kind": "divergence", "rank": 3},
+                                   ranks_now=[0, 2])
+        assert v["rank"] is None
+
+    def test_none_verdict_passthrough(self):
+        assert translate_verdict_rank(None, [0, 1]) is None
+        v = translate_verdict_rank(dict(NONE_V), [0, 1])
+        assert v["rank"] is None
+
+    def test_translated_eviction_targets_right_slot(self):
+        p = _policy(allow_shrink=True)
+        p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)  # evict 1
+        assert p.active == [0, 2, 3]
+        # now slot 3 (running as rank 2) diverges; the dump says rank 2
+        raw = {"kind": "divergence", "rank": 2, "source": "doctor",
+               "evidence": {}}
+        v = translate_verdict_rank(raw, ranks_now=sorted(p.active))
+        d = p.decide([(3, "exit rc=1")], v, now=1.0)
+        assert d.action == "evict_shrink" and d.ranks == [3]
+        assert p.active == [0, 2]
+
+
+class TestEffectiveVerdict:
+    def test_doctor_verdict_wins_when_it_names_a_rank(self):
+        v = effective_verdict([(0, "exit rc=1")], DIVERGENCE)
+        assert v["kind"] == "divergence" and v["rank"] == 1
+
+    def test_crash_synthesized_from_process_exit(self):
+        v = effective_verdict([(1, "exit rc=-9")], NONE_V)
+        assert v == {"kind": "crash", "rank": 1, "source": "supervisor",
+                     "evidence": {"why": "exit rc=-9",
+                                  "all_failed": [1]}}
+
+    def test_heartbeat_stall_synthesized(self):
+        v = effective_verdict([(0, "heartbeat stall")], None)
+        assert v["kind"] == "heartbeat_stall" and v["rank"] == 0
+
+    def test_no_evidence_at_all_is_none(self):
+        assert effective_verdict([], None)["kind"] == "none"
+
+    def test_doctor_hang_for_unflagged_rank_yields_to_supervisor(self):
+        # rank 0 dumped a stall because it was BLOCKED on rank 1's
+        # wedged collective; the supervisor saw rank 1 (and only rank
+        # 1) stop pulsing — the casualty must not get evicted
+        v = effective_verdict([(1, "heartbeat stall")],
+                              {"kind": "hang", "rank": 0,
+                               "source": "doctor", "evidence": {}})
+        assert v["kind"] == "heartbeat_stall" and v["rank"] == 1
+
+    def test_doctor_hang_for_flagged_rank_is_kept(self):
+        v = effective_verdict([(2, "heartbeat stall")], HANG)
+        assert v["kind"] == "hang" and v["source"] == "doctor"
+
+    def test_divergence_always_wins_over_supervisor_evidence(self):
+        v = effective_verdict([(0, "heartbeat stall")], DIVERGENCE)
+        assert v["kind"] == "divergence" and v["rank"] == 1
+
+
+class TestBackoffAndBudgets:
+    def test_exponential_backoff_ladder_capped(self):
+        p = _policy(backoff_base=1.0, backoff_factor=2.0,
+                    backoff_max=5.0, max_restarts=100)
+        delays = []
+        for i in range(5):
+            d = p.decide([(1, "exit rc=1")], None, now=float(i))
+            p.record_respawn(now=float(i))
+            delays.append(d.delay_s)
+        assert delays == [1.0, 2.0, 4.0, 5.0, 5.0]  # capped at max
+
+    def test_heal_window_resets_the_ladder(self):
+        p = _policy(backoff_base=1.0, heal_after_s=10.0,
+                    max_restarts=100)
+        p.decide([(1, "exit rc=1")], None, now=0.0)
+        p.record_respawn(now=0.0)
+        assert p.backoff_delay() == 2.0
+        p.note_progress(now=5.0)       # too soon: ladder holds
+        assert p.backoff_delay() == 2.0
+        p.note_progress(now=11.0)      # healthy for heal_after_s
+        assert p.backoff_delay() == 1.0
+
+    def test_max_restarts_budget_aborts_with_reason(self):
+        p = _policy(max_restarts=1)
+        d1 = p.decide([(1, "exit rc=1")], None, now=0.0)
+        assert d1.action != "abort"
+        p.record_respawn(now=0.0)
+        d2 = p.decide([(1, "exit rc=1")], None, now=1.0)
+        assert d2.action == "abort"
+        assert d2.reason == "max_restarts=1"
+
+    def test_restarts_per_window_budget_aborts(self):
+        # crash-loop guard: a worker dying at import must not burn the
+        # lifetime budget in seconds — the WINDOW budget trips first
+        p = _policy(max_restarts=100, restart_budget=2,
+                    restart_window_s=60.0)
+        for i in range(2):
+            d = p.decide([(1, "exit rc=1")], None, now=float(i))
+            assert d.action != "abort"
+            p.record_respawn(now=float(i))
+        d = p.decide([(1, "exit rc=1")], None, now=2.0)
+        assert d.action == "abort"
+        assert "restart budget 2" in d.reason
+
+    def test_window_budget_recovers_once_window_slides(self):
+        p = _policy(max_restarts=100, restart_budget=2,
+                    restart_window_s=10.0)
+        for i in range(2):
+            p.decide([(1, "exit rc=1")], None, now=float(i))
+            p.record_respawn(now=float(i))
+        # outside the window the same budget allows a new respawn
+        d = p.decide([(1, "exit rc=1")], None, now=50.0)
+        assert d.action != "abort"
+
+
+class TestGrow:
+    def test_grow_after_cooldown_restores_evicted_rank(self):
+        p = _policy(allow_shrink=True, grow_after_s=30.0)
+        p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
+        assert p.active == [0, 2, 3]
+        assert p.maybe_grow(now=10.0) is None      # cooldown not over
+        g = p.maybe_grow(now=31.0)
+        assert g is not None and g.action == "grow" and g.ranks == [1]
+        assert p.active == [0, 1, 2, 3] and not p.evicted
+
+    def test_grow_disabled_by_default(self):
+        p = _policy(allow_shrink=True)
+        p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
+        assert p.maybe_grow(now=1e9) is None
+
+
+class TestReceipts:
+    def test_receipt_written_and_counters_always_on(self, tmp_path):
+        metrics.reset()
+        assert not metrics.enabled()  # gate DOWN: receipts still count
+        doc = elastic.emit_receipt(
+            episode=3, verdict=DIVERGENCE, action="evict_shrink",
+            ranks=[1], world_before=4, world_after=3, resume_step=120,
+            goodput={"productive_fraction": 0.8},
+            goodput_delta=-0.05, delay_s=2.0, reason="evict rank 1",
+            out_dir=str(tmp_path))
+        assert doc["path"] and os.path.exists(doc["path"])
+        with open(doc["path"]) as f:
+            on_disk = json.load(f)
+        assert on_disk["verdict"]["kind"] == "divergence"
+        assert on_disk["ranks"] == [1]
+        assert on_disk["resume_step"] == 120
+        assert on_disk["world_after"] == 3
+        snap = metrics.snapshot()
+        assert snap["elastic.episodes_total"]["value"] == 1
+        assert snap["elastic.evictions_total"]["value"] == 1
+        assert snap["elastic.actions_total{action=evict_shrink}"][
+            "value"] == 1
+        assert snap["elastic.world_size"]["value"] == 3
+        metrics.reset()
+
+    def test_unwritable_dir_still_returns_receipt(self):
+        doc = elastic.emit_receipt(
+            episode=1, verdict=NONE_V, action="respawn_gang",
+            ranks=[0], world_before=2, world_after=2,
+            out_dir="/proc/definitely/not/writable")
+        assert doc["path"] is None and doc["action"] == "respawn_gang"
+        metrics.reset()
+
+
+class TestDoctorBridge:
+    def _dump(self, tmp_path, rank, seq, ts=1000.0, steps=10):
+        d = {"version": 1, "reason": "signal:SIGTERM", "ts": ts,
+             "rank": rank, "world": 2, "events": [],
+             "collective_seq": seq,
+             "progress": {"steps": steps, "last_step_age_s": 99.0,
+                          "step_s_p50": 0.01, "step_s_p99": 0.02},
+             "goodput": {"elapsed_seconds": 10.0,
+                         "productive_fraction": 0.9}}
+        p = tmp_path / f"flight_signal_SIGTERM_rank{rank}_pid{rank}.json"
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    def test_collect_diagnosis_names_diverging_rank(self, tmp_path):
+        self._dump(tmp_path, 0, {"dp|allreduce_sum": 8}, steps=12)
+        self._dump(tmp_path, 1, {"dp|allreduce_sum": 5}, steps=9)
+        bundle = elastic.collect_diagnosis(str(tmp_path))
+        assert bundle["dumps"] == 2
+        assert bundle["verdict"]["kind"] == "divergence"
+        assert bundle["verdict"]["rank"] == 1
+        assert bundle["resume_step"] == 12
+        assert bundle["goodput"]["productive_fraction"] == \
+            pytest.approx(0.9)
+
+    def test_resume_step_zero_is_reported_not_dropped(self, tmp_path):
+        # an import-time crash loop dies during step 0: the receipt
+        # must say resume_step=0, not null
+        self._dump(tmp_path, 0, {}, steps=0)
+        self._dump(tmp_path, 1, {}, steps=0)
+        bundle = elastic.collect_diagnosis(str(tmp_path))
+        assert bundle["resume_step"] == 0
+
+    def test_collect_diagnosis_empty_dir_is_none_verdict(self, tmp_path):
+        bundle = elastic.collect_diagnosis(str(tmp_path))
+        assert bundle["dumps"] == 0
+        assert bundle["verdict"]["kind"] == "none"
+
+    def test_since_ts_filters_stale_dumps(self, tmp_path):
+        p = self._dump(tmp_path, 0, {"dp|allreduce_sum": 8})
+        os.utime(p, (1.0, 1.0))  # ancient
+        bundle = elastic.collect_diagnosis(str(tmp_path), since_ts=100.0)
+        assert bundle["dumps"] == 0
+
+    def test_unreadable_dump_does_not_kill_the_supervisor(self, tmp_path):
+        (tmp_path / "flight_x_rank0_pid1.json").write_text("{not json")
+        bundle = elastic.collect_diagnosis(str(tmp_path))
+        assert bundle["verdict"]["kind"] == "none"
+
+
+class TestDoctorVerdictUnits:
+    def _doctor(self):
+        from paddle_tpu.distributed.elastic import _import_doctor
+        return _import_doctor()
+
+    def test_priority_divergence_over_hang(self):
+        doctor = self._doctor()
+        diag = {"divergence": {"diverging_rank": 1, "axis": "dp",
+                               "op": "allreduce_sum",
+                               "mismatched_seq": 3,
+                               "diverging_ranks": [1]},
+                "hangs": [{"rank": 0, "age_s": 50.0}]}
+        v = doctor.verdict(diag)
+        assert v["kind"] == "divergence" and v["rank"] == 1
+        assert v["evidence"]["op"] == "allreduce_sum"
+
+    def test_hang_then_straggler_then_storm(self):
+        doctor = self._doctor()
+        assert doctor.verdict(
+            {"hangs": [{"rank": 2, "age_s": 9.0}],
+             "stragglers": [{"rank": 1, "vs_fleet_median": 3.0}]}
+        )["kind"] == "hang"
+        assert doctor.verdict(
+            {"stragglers": [{"rank": 1, "vs_fleet_median": 3.0}]}
+        )["rank"] == 1
+        v = doctor.verdict(
+            {"recompile_storm": {"total": 9, "per_rank": {"0": 2,
+                                                          "1": 7}}})
+        assert v["kind"] == "recompile_storm" and v["rank"] == 1
+
+    def test_clean_pod_is_none(self):
+        doctor = self._doctor()
+        v = doctor.verdict({"divergence": None, "hangs": [],
+                            "stragglers": [], "recompile_storm": None})
+        assert v == {"kind": "none", "rank": None, "source": "doctor",
+                     "evidence": {}}
+
+    def test_hang_tiebreak_prefers_rank_lagging_collectives(self):
+        # every rank blocked on the wedged one's collective dumps a
+        # stall too; the culprit is the one whose seq streams lag —
+        # even a 1-call "possible skew" lag breaks the tie
+        doctor = self._doctor()
+        diag = {"divergence": {"possible_skew": [
+                    {"diverging_ranks": [1], "gap": 1}],
+                    "detail": []},
+                "hangs": [{"rank": 0, "age_s": 3.4},
+                          {"rank": 1, "age_s": 3.3}]}
+        v = doctor.verdict(diag)
+        assert v["kind"] == "hang" and v["rank"] == 1
+        assert v["evidence"]["lags_collectives"] is True
+
+    def test_skew_only_divergence_is_not_a_verdict(self):
+        # live-snapshot skew must not evict anyone
+        doctor = self._doctor()
+        v = doctor.verdict(
+            {"divergence": {"possible_skew": [{"gap": 1}],
+                            "detail": []}})
+        assert v["kind"] == "none"

@@ -1,0 +1,125 @@
+"""Rendezvous hardening (distributed/rendezvous.py): the old hard-coded
+single-attempt 120 s budgets are configurable (args + PD_RDZV_* env)
+with bounded retry + backoff, and failures name the endpoint and the
+attempt count. Tier-1: everything here is loopback sockets, <1 s."""
+import socket
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import rendezvous as rdzv
+from paddle_tpu.distributed.rendezvous import Rendezvous
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestRetry:
+    def test_failure_names_endpoint_and_attempts(self):
+        port = _free_port()  # nothing listening
+        rv = Rendezvous(f"127.0.0.1:{port}", 1, 2, timeout=0.2,
+                        attempts=3, backoff=0.01)
+        with pytest.raises(TimeoutError) as ei:
+            rv.fetch()
+        msg = str(ei.value)
+        assert f"127.0.0.1:{port}" in msg
+        assert "3 attempt(s)" in msg
+        assert "0.2s" in msg  # per-attempt budget named too
+
+    def test_backoff_between_attempts(self):
+        port = _free_port()
+        rv = Rendezvous(f"127.0.0.1:{port}", 1, 2, timeout=0.1,
+                        attempts=2, backoff=0.3)
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            rv.fetch()
+        # 2 x 0.1s attempts + one 0.3s backoff sleep
+        assert time.time() - t0 >= 0.4
+
+    def test_per_call_override_beats_constructor(self):
+        port = _free_port()
+        rv = Rendezvous(f"127.0.0.1:{port}", 1, 2, timeout=30.0,
+                        attempts=5)
+        t0 = time.time()
+        with pytest.raises(TimeoutError) as ei:
+            rv.fetch(timeout=0.1, attempts=1, backoff=0.0)
+        assert time.time() - t0 < 5.0
+        assert "1 attempt(s)" in str(ei.value)
+
+    def test_retry_recovers_when_server_appears_late(self):
+        port = _free_port()
+        payload = b"coordinator=10.0.0.1:8476"
+        server = Rendezvous(f"127.0.0.1:{port}", 0, 2)
+
+        def serve_later():
+            time.sleep(0.35)
+            server.serve(payload)
+
+        t = threading.Thread(target=serve_later, daemon=True)
+        t.start()
+        try:
+            client = Rendezvous(f"127.0.0.1:{port}", 1, 2, timeout=0.25,
+                                attempts=6, backoff=0.05)
+            assert client.fetch() == payload
+        finally:
+            t.join()
+            server.close()
+
+
+class TestEnvKnobs:
+    def test_env_defaults_respected(self, monkeypatch):
+        monkeypatch.setenv("PD_RDZV_TIMEOUT_S", "7.5")
+        monkeypatch.setenv("PD_RDZV_ATTEMPTS", "4")
+        monkeypatch.setenv("PD_RDZV_BACKOFF_S", "0.25")
+        rv = Rendezvous("127.0.0.1:1", 1, 2)
+        assert rv.timeout == 7.5
+        assert rv.attempts == 4
+        assert rv.backoff == 0.25
+
+    def test_legacy_defaults_without_env(self, monkeypatch):
+        for var in ("PD_RDZV_TIMEOUT_S", "PD_RDZV_ATTEMPTS",
+                    "PD_RDZV_BACKOFF_S"):
+            monkeypatch.delenv(var, raising=False)
+        rv = Rendezvous("127.0.0.1:1", 1, 2)
+        assert rv.timeout == 120.0
+        assert rv.attempts == 1  # exactly the old single-attempt shape
+
+    def test_garbage_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PD_RDZV_TIMEOUT_S", "not-a-number")
+        assert rdzv.default_timeout() == 120.0
+
+
+class TestWaitServed:
+    def test_wait_served_uses_configured_timeout(self):
+        port = _free_port()
+        rv = Rendezvous(f"127.0.0.1:{port}", 0, 2, timeout=0.2)
+        rv.serve(b"blob")
+        try:
+            t0 = time.time()
+            assert rv.wait_served() is False  # no peer ever fetches
+            assert time.time() - t0 < 2.0
+        finally:
+            rv.close()
+
+    def test_broadcast_bootstrap_end_to_end_with_retry_config(self):
+        port = _free_port()
+        payload = b"topo:v4-8"
+        out = {}
+
+        def peer():
+            out["got"] = rdzv.broadcast_bootstrap(
+                None, f"127.0.0.1:{port}", rank=1, nranks=2,
+                timeout=5.0, attempts=3)
+
+        t = threading.Thread(target=peer, daemon=True)
+        t.start()
+        got0 = rdzv.broadcast_bootstrap(payload, f"127.0.0.1:{port}",
+                                        rank=0, nranks=2, timeout=5.0)
+        t.join(timeout=10)
+        assert got0 == payload and out["got"] == payload

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""chaos_drill: reproducible fault-injection drill for the self-healing
+elastic fleet — the receipt that detection → verdict → remediation →
+resume actually composes, with the goodput cost measured.
+
+Two runs of the same 2-process elastic job (distributed/launch.py
+--elastic over tests/elastic_worker.py --sharded-ckpt, i.e. async
+sharded checkpoints + topology manifest + DataShardCursor):
+
+  control   undisturbed
+  chaos     one deterministic PD_CHAOS_* fault (kill / stall /
+            corrupt_ckpt) injected at a named (rank, step)
+
+and the drill then checks, from artifacts alone:
+
+  goodput_ratio   forward progress per wall-second, chaos vs control
+                  (steps reached / wall) — the ISSUE's ≥ 0.9 bar needs
+                  a job long enough to amortize one recovery (~5 s on
+                  CPU: detection + dump grace + backoff + re-import)
+  receipt         a remediation receipt exists, names the faulted rank
+                  and the verdict that drove the action
+  resume          every rank's out file exists (the job completed) and
+                  the restarted rank ran as incarnation >= 1 (kill /
+                  stall) or survived a corrupted primary checkpoint
+                  (corrupt_ckpt: restore fell back to .old)
+
+Usage:
+  python tools/chaos_drill.py --mode kill                 # quick look
+  python tools/chaos_drill.py --mode stall --steps 150 \
+      --step-time 0.3 --goodput-bar 0.9                   # CI drill
+  python tools/chaos_drill.py --mode kill --shrink        # evict path
+
+Prints one `chaos_drill: {json}` line; exit 1 when the receipt is
+missing/wrong or goodput_ratio < --goodput-bar.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+EXPECT_VERDICTS = {
+    # chaos mode -> verdict kinds that legitimately drive the action.
+    # kill/corrupt_ckpt SIGKILL the rank before it can dump, so the
+    # supervisor's crash evidence is the verdict; a stalled rank stays
+    # alive and the doctor names it from its dump — by step-gate seq
+    # divergence (it never entered the gate) or a watchdog hang record
+    "kill": ("crash",),
+    "stall": ("divergence", "hang", "heartbeat_stall"),
+    "corrupt_ckpt": ("crash",),
+}
+
+
+def _run_once(args, tag: str, chaos_mode: str, workdir: str) -> dict:
+    ckpt = os.path.join(workdir, f"ckpt_{tag}")
+    out = os.path.join(workdir, f"out_{tag}")
+    receipts = os.path.join(workdir, f"receipts_{tag}")
+    os.makedirs(ckpt, exist_ok=True)
+    os.makedirs(receipts, exist_ok=True)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(args.nproc), "--elastic",
+           "--heartbeat_timeout", str(args.heartbeat_timeout),
+           "--heartbeat_startup_timeout", "120",
+           "--restart_backoff", str(args.restart_backoff),
+           "--dump_grace", str(args.dump_grace),
+           "--max_restarts", "3"]
+    if args.shrink:
+        cmd += ["--elastic_shrink"]
+        if args.grow_after:
+            cmd += ["--grow_after", str(args.grow_after)]
+    cmd += [args.worker, "--ckpt-dir", ckpt, "--out-dir", out,
+            "--steps", str(args.steps), "--step-time",
+            str(args.step_time), "--sharded-ckpt",
+            "--ckpt-every", str(args.ckpt_every)]
+    if chaos_mode == "stall":
+        cmd += ["--watchdog"]  # stall forensics -> doctor hang verdict
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PD_ELASTIC_DIR=receipts)
+    env.pop("PD_CHAOS_MODE", None)
+    if chaos_mode != "none":
+        env.update(PD_CHAOS_MODE=chaos_mode,
+                   PD_CHAOS_STEP=str(args.step),
+                   PD_CHAOS_RANK=str(args.rank))
+    t0 = time.perf_counter()
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=args.timeout, env=env, cwd=REPO)
+    wall = time.perf_counter() - t0
+    outs = {}
+    for f in glob.glob(os.path.join(out, "rank*.json")):
+        with open(f) as fh:
+            outs[os.path.basename(f)] = json.load(fh)
+    recs = []
+    for f in sorted(glob.glob(os.path.join(receipts, "receipt_*.json"))):
+        with open(f) as fh:
+            recs.append(json.load(fh))
+    steps_reached = max((d.get("steps_done", 0) for d in outs.values()),
+                       default=0)
+    return {"rc": r.returncode, "wall_s": round(wall, 3),
+            "steps_reached": steps_reached,
+            "goodput_steps_per_s": round(steps_reached / wall, 4),
+            "outs": outs, "receipts": recs,
+            "stderr_tail": r.stderr[-2000:]}
+
+
+def check_receipt(args, chaos: dict) -> dict:
+    """Does a remediation receipt name the faulted rank and a verdict
+    that plausibly drove the action?"""
+    want_kinds = EXPECT_VERDICTS[args.mode]
+    for rec in chaos["receipts"]:
+        v = rec.get("verdict") or {}
+        if v.get("kind") in want_kinds and v.get("rank") == args.rank \
+                and args.rank in (rec.get("ranks") or []):
+            return {"ok": True, "episode": rec.get("episode"),
+                    "action": rec.get("action"),
+                    "verdict": {"kind": v.get("kind"),
+                                "rank": v.get("rank"),
+                                "source": v.get("source")},
+                    "resume_step": rec.get("resume_step"),
+                    "backoff_s": rec.get("backoff_s")}
+    return {"ok": False,
+            "receipts_seen": [
+                {"action": r.get("action"),
+                 "verdict": (r.get("verdict") or {}).get("kind"),
+                 "ranks": r.get("ranks")} for r in chaos["receipts"]]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=("kill", "stall", "corrupt_ckpt"),
+                    default="kill")
+    ap.add_argument("--step", type=int, default=5,
+                    help="inject at this step (deterministic)")
+    ap.add_argument("--rank", type=int, default=1)
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--step-time", type=float, default=0.1)
+    ap.add_argument("--ckpt-every", type=int, default=1)
+    ap.add_argument("--heartbeat_timeout", type=float, default=2.0)
+    ap.add_argument("--restart_backoff", type=float, default=0.1)
+    ap.add_argument("--dump_grace", type=float, default=0.5)
+    ap.add_argument("--shrink", action="store_true",
+                    help="let the supervisor evict the faulted rank "
+                         "and run the survivors (vs gang respawn)")
+    ap.add_argument("--grow-after", dest="grow_after", type=float,
+                    default=0.0)
+    ap.add_argument("--goodput-bar", type=float, default=0.0,
+                    help="fail if chaos goodput < bar x control "
+                         "(the acceptance drill uses 0.9 with a job "
+                         "long enough to amortize one recovery)")
+    ap.add_argument("--worker", default=DEFAULT_WORKER)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--json", action="store_true",
+                    help="full artifacts, not just the receipt line")
+    args = ap.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="pd_chaos_")
+    control = _run_once(args, "control", "none", workdir)
+    chaos = _run_once(args, "chaos", args.mode, workdir)
+
+    ratio = (chaos["goodput_steps_per_s"]
+             / control["goodput_steps_per_s"]) \
+        if control["goodput_steps_per_s"] else 0.0
+    receipt = check_receipt(args, chaos)
+    # completion: with --shrink the evicted slot legitimately never
+    # writes its out file; every SURVIVING slot must
+    expect_outs = args.nproc - (1 if args.shrink else 0)
+    completed = (chaos["rc"] == 0
+                 and len(chaos["outs"]) >= expect_outs)
+    restarted = any(d.get("incarnation", 0) >= 1
+                    for d in chaos["outs"].values()) or args.shrink
+
+    verdict_ok = bool(completed and receipt["ok"] and restarted)
+    summary = {
+        "mode": args.mode, "shrink": args.shrink,
+        "control": {k: control[k] for k in
+                    ("rc", "wall_s", "steps_reached",
+                     "goodput_steps_per_s")},
+        "chaos": {k: chaos[k] for k in
+                  ("rc", "wall_s", "steps_reached",
+                   "goodput_steps_per_s")},
+        "goodput_ratio": round(ratio, 4),
+        "goodput_bar": args.goodput_bar,
+        "receipt": receipt,
+        "completed": completed, "restarted": restarted,
+        "workdir": workdir,
+        "ok": verdict_ok and ratio >= args.goodput_bar,
+    }
+    if args.json:
+        summary["control_full"] = control
+        summary["chaos_full"] = chaos
+    print("chaos_drill: " + json.dumps(summary))
+    if not summary["ok"]:
+        print(f"[chaos_drill] FAILED (see {workdir}); chaos stderr "
+              "tail:\n" + chaos["stderr_tail"], file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

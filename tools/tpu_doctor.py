@@ -41,7 +41,7 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["load_dumps", "diagnose", "format_report", "main"]
+__all__ = ["load_dumps", "diagnose", "verdict", "format_report", "main"]
 
 STRAGGLER_FACTOR = 1.5     # median step > 1.5x fleet median => straggler
 RECOMPILE_STORM = 3        # >= this many recompile events => storm
@@ -253,6 +253,63 @@ def diagnose(dumps: List[dict]) -> dict:
     }
 
 
+def verdict(diag: dict) -> dict:
+    """Collapse a diagnosis into ONE actionable verdict — the record
+    the elastic supervisor (distributed/elastic.py) consumes to decide
+    evict/shrink/respawn. Priority order mirrors diagnostic confidence:
+    a seq divergence is proof a specific rank skipped a collective; a
+    hang names the rank that stopped stepping; a straggler or a
+    recompile storm names a cost, not a fault. Always returns a dict
+    ({"kind": "none"} on a clean pod) so callers never branch on None.
+    """
+    div = diag.get("divergence")
+    if div and div.get("diverging_rank") is not None:
+        return {"kind": "divergence", "rank": div["diverging_rank"],
+                "source": "doctor",
+                "evidence": {"axis": div.get("axis"),
+                             "op": div.get("op"),
+                             "seq": div.get("mismatched_seq"),
+                             "lagging_ranks": div.get("diverging_ranks")}}
+    hangs = diag.get("hangs") or []
+    if hangs:
+        # several ranks usually hang TOGETHER (everyone blocked on the
+        # wedged one's collective), with near-identical no-progress
+        # ages. The culprit is the rank that also LAGS the collective
+        # seq streams — the blocked ranks entered the call, the wedged
+        # one never did — even when the 1-call live-skew rule kept the
+        # lag out of the divergence verdict.
+        lagging = set()
+        div = diag.get("divergence") or {}
+        for m in (div.get("detail") or []) + \
+                (div.get("possible_skew") or []):
+            lagging.update(m.get("diverging_ranks") or [])
+        pool = [h for h in hangs if h["rank"] in lagging] or hangs
+        h = max(pool, key=lambda h: h.get("age_s") or 0)
+        return {"kind": "hang", "rank": h["rank"], "source": "doctor",
+                "evidence": {"age_s": h.get("age_s"),
+                             "limit_s": h.get("limit_s"),
+                             "lags_collectives": h["rank"] in lagging,
+                             "dump": h.get("dump")}}
+    strag = diag.get("stragglers") or []
+    if strag:
+        s = max(strag, key=lambda s: s.get("vs_fleet_median", 0))
+        return {"kind": "straggler", "rank": s["rank"],
+                "source": "doctor",
+                "evidence": {"step_s_p50": s.get("step_s_p50"),
+                             "vs_fleet_median": s.get("vs_fleet_median")}}
+    storm = diag.get("recompile_storm")
+    if storm:
+        per = storm.get("per_rank", {})
+        worst = max(per, key=per.get) if per else None
+        return {"kind": "recompile_storm",
+                "rank": None if worst is None else int(worst),
+                "source": "doctor",
+                "evidence": {"total": storm.get("total"),
+                             "per_rank": per}}
+    return {"kind": "none", "rank": None, "source": "doctor",
+            "evidence": {}}
+
+
 def format_report(diag: dict) -> str:
     """Operator-readable rendering of a diagnosis (the runbook output:
     lead with the verdict, then the evidence)."""
@@ -314,6 +371,9 @@ def main(argv=None) -> int:
                     help="scan DIR for flight_*.json")
     ap.add_argument("--json", action="store_true",
                     help="print the diagnosis dict instead of text")
+    ap.add_argument("--verdict", action="store_true",
+                    help="print the one-line actionable verdict JSON "
+                         "(the elastic supervisor's input)")
     args = ap.parse_args(argv)
     paths = list(args.dumps)
     if args.dir:
@@ -324,7 +384,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     diag = diagnose(load_dumps(paths))
-    if args.json:
+    if args.verdict:
+        print(json.dumps(verdict(diag)))
+    elif args.json:
         print(json.dumps(diag))
     else:
         print(format_report(diag))
