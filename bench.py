@@ -307,12 +307,15 @@ def bench_generate(on_tpu):
 
 
 def bench_serving(on_tpu):
-    """Serving LATENCY receipts (the reference treats inference as a
-    measured stack — /root/reference/paddle/fluid/inference/tests/api/
-    per-model perf tests): per-token decode latency p50/p99 at batch 1
-    and 8 through the one-program KV-cache generate (bf16 on TPU), and
-    jax.export Predictor forward latency p50/p99 through the C-API-
-    backing Python Predictor."""
+    """Serving receipts (the reference treats inference as a measured
+    stack — /root/reference/paddle/fluid/inference/tests/api/ per-model
+    perf tests): per-token decode latency p50/p99 at batch 1 and 8
+    through the one-program KV-cache generate (bf16 on TPU), jax.export
+    Predictor forward latency p50/p99, AND the continuous-batching
+    engine leg — sustained tokens/s + TTFT p50/p99 on an open-loop
+    mixed-length trace through paddle_tpu.serving, with the legacy
+    static-batch replay of the SAME trace as the comparison baseline
+    and the executable/recompile counts in the same report."""
     import tempfile
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
@@ -401,6 +404,48 @@ def bench_serving(on_tpu):
             stats[f"predictor_ms_b{batch}"] = {
                 "p50": round(float(np.percentile(ts, 50)), 3),
                 "p99": round(float(np.percentile(ts, 99)), 3)}
+
+    # continuous-batching engine vs the legacy static-batch path, one
+    # open-loop trace, one report (the emit_report bridge already wraps
+    # the whole bench artifact): paged KV cache + bucketed prefill +
+    # chunked decode, compile ladder fixed — recompile_events must stay
+    # 0 and executables == bucket count
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving.loadgen import (replay_continuous,
+                                            replay_static,
+                                            synthetic_trace)
+    n_req = 24 if on_tpu else 12
+    trace = synthetic_trace(
+        n_req, vocab_size=cfg.vocab_size, seed=0, rate_rps=40.0,
+        prompt_len_choices=(4, 8, 12, 16, 24),
+        new_token_choices=(4, 8, 12, 16))
+    eng = ServingEngine(model, ServingConfig(
+        max_slots=8, max_admit=4, block_size=8, n_blocks=96,
+        prefill_buckets=(16, 32), decode_chunk=4, max_total_tokens=48,
+        dtype=dtype))
+    t0 = time.perf_counter()
+    eng.warmup()
+    warmup_s = round(time.perf_counter() - t0, 3)
+    cont = replay_continuous(eng, trace)
+    legacy = replay_static(model, trace, batch_size=4, dtype=dtype)
+    tps_c = cont["sustained_tokens_per_sec"]
+    tps_s = legacy["sustained_tokens_per_sec"]
+    stats["continuous"] = {
+        "tokens_per_sec": tps_c,
+        "ttft_ms": cont["ttft_ms"],
+        "per_token_ms": cont["per_token_ms"],
+        "executables": cont["executables"],
+        "expected_executables": cont["expected_executables"],
+        "recompile_events": cont["recompile_events"],
+        "warmup_s": warmup_s,
+    }
+    stats["static_baseline"] = {
+        "tokens_per_sec": tps_s,
+        "ttft_ms": legacy["ttft_ms"],
+        "compiled_signatures": legacy["compiled_signatures"],
+    }
+    stats["continuous_vs_static"] = (round(tps_c / tps_s, 3)
+                                     if tps_s > 0 else -1.0)
     return stats
 
 

@@ -8,6 +8,15 @@ optimization, layout) is XLA's job at AOT-compile time, so Config's
 switches map to compile options instead of IR pass lists. The Predictor
 surface (named input/output handles, copy_from_cpu/run/copy_to_cpu)
 mirrors the reference's zero-copy API.
+
+Two serving surfaces live behind this frontend:
+- per-call artifacts: ``create_predictor(Config(...))`` below — one
+  exported program, dense inputs, the reference's deployment shape;
+- LM request streams: ``create_serving_engine(model, ...)`` — the
+  continuous-batching engine (paddle_tpu.serving: paged KV cache,
+  bucketed prefill, in-flight admission) for mixed-length traffic
+  that a per-call Predictor would serialize behind head-of-line
+  batches and per-signature recompiles.
 """
 from __future__ import annotations
 
@@ -18,7 +27,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "create_serving_engine"]
 
 
 class Config:
@@ -162,3 +172,19 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_engine(model, serving_config=None, warmup=True,
+                          **config_kw):
+    """The serving twin of create_predictor: build a warmed
+    continuous-batching ServingEngine over a live GPTForCausalLM.
+    Keyword overrides construct a paddle_tpu.serving.ServingConfig
+    (e.g. ``max_slots=16, dtype=None``); ``warmup=False`` skips the
+    ladder compile (tests that only inspect structure)."""
+    from ..serving import ServingConfig, ServingEngine
+    if serving_config is not None and config_kw:
+        raise ValueError(
+            "pass either serving_config or keyword overrides, not both")
+    cfg = serving_config or ServingConfig(**config_kw)
+    eng = ServingEngine(model, cfg)
+    return eng.warmup() if warmup else eng

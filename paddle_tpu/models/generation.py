@@ -16,6 +16,14 @@ Supports greedy and temperature/top-k sampling over GPTForCausalLM
 (weight-tied head). Correctness contract: greedy decode through the
 cache equals argmax over full re-forward logits at every step
 (tests/test_generation.py).
+
+This module is ALSO the numerical reference for the continuous-batching
+serving engine: paddle_tpu/serving/programs.py imports `_ln`, `_attend`,
+`_prefill`, `_pick` (and the engine `_gpt_params`/`_cast_params`) so the
+paged-cache decode is the same ops in the same order with only the cache
+addressing changed — that reuse is what makes the paged-vs-dense greedy
+parity contract bit-exact in f32 (tests/test_serving_engine.py). A
+change to these helpers must keep both suites green.
 """
 from __future__ import annotations
 

@@ -1,0 +1,33 @@
+"""paddle_tpu.serving — the continuous-batching production inference
+path (ROADMAP item 1, the "millions of users" gap).
+
+The reference ships inference as a first-class measured stack
+(paddle/fluid/inference/); our Predictor covers the per-call artifact
+surface, but LM serving needs an *engine*: mixed-length request
+streams, admission into a running decode, and memory that outlives one
+call. TPU-native shape (the TVM lesson — fixed executables + buckets
+beat dynamic shapes):
+
+  paged_cache  fixed pool of [n_blocks, block_size, n_heads, hd] KV
+               pages per layer + host block tables; eviction = a host
+               list splice
+  programs     TWO compiled programs (bucketed prefill, paged decode
+               step) with donated pools; steady state runs exactly
+               ladder-size executables, RecompileSentinel-pinned
+  scheduler    FIFO continuous batching: admit/retire at token
+               boundaries, whole-lifetime page reservation
+  engine       ServingEngine: bf16 decode default, f32 parity mode
+               bit-for-bit vs models/generation.py greedy
+  loadgen      open-loop trace replay + SLO stats (tools/serving_bench)
+
+Multi-replica data-parallel serving = N engines over disjoint request
+streams; the shared serving.* metrics roll up through
+observability.fleet.aggregate() like every other subsystem.
+"""
+from .engine import ServingConfig, ServingEngine
+from .paged_cache import PagedKVCache
+from .scheduler import BucketLadder, FifoScheduler, Request
+from . import loadgen
+
+__all__ = ["ServingConfig", "ServingEngine", "PagedKVCache",
+           "BucketLadder", "FifoScheduler", "Request", "loadgen"]

@@ -1,0 +1,331 @@
+"""ServingEngine: continuous-batching GPT serving over the paged cache.
+
+Ties the pieces together: a weight snapshot (bf16 serving cast by
+default — decode is HBM-bound on weight reads, PERF_PLAN lever #5; f32
+parity mode is pinned bit-for-bit against generation.py greedy), the
+page pools + host block tables (paged_cache), the FIFO
+continuous-batching scheduler, and the two per-engine compiled
+programs (programs.py). One ``step()`` is one token boundary:
+
+  retire finished -> admit queued (one bucketed prefill for the whole
+  mixed-length admit batch) -> one decode step for every active slot
+  -> sentinel check (executable count must stay == ladder size)
+
+The engine is single-threaded and host-driven by design: continuous
+batching NEEDS a host decision point every token (who retires, who
+admits), so unlike training there is no lax.scan to fuse steps into —
+the per-step dispatch is the price of in-flight admission, and the
+bench shows the batch-shape wins dominate it.
+
+Metrics ride the gated serving.* series (queue depth, active slots,
+free pages, admitted/evicted totals, TTFT + per-step histograms);
+``serving_recompiles_total`` is always-on via the RecompileSentinel.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.generation import _cast_params, _gpt_params
+from ..observability import metrics as _obs
+from ..observability.sentinel import RecompileSentinel
+from .paged_cache import PagedKVCache
+from .programs import (jit_with_donated_pools, make_decode_fn,
+                       make_prefill_fn)
+from .scheduler import BucketLadder, FifoScheduler, Request
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+@dataclass
+class ServingConfig:
+    """The serving shape contract. Every field here is STATIC — it
+    determines the executable ladder, and nothing a request carries
+    can force a new compile."""
+    max_slots: int = 8                 # concurrent decode lanes
+    max_admit: int = 4                 # prefill batch width (padded)
+    block_size: int = 16               # tokens per KV page
+    n_blocks: int = 128                # page pool size (incl. scratch)
+    prefill_buckets: Tuple[int, ...] = (32, 64, 128)
+    decode_buckets: Optional[Tuple[int, ...]] = None  # default: (max_slots,)
+    decode_chunk: int = 4              # token boundaries per dispatch
+    max_total_tokens: int = 256        # per-request prompt + new cap
+    dtype: Optional[str] = "bfloat16"  # None = f32 parity mode
+    temperature: float = 0.0           # 0 = greedy
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None # default; per-request override
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.decode_buckets is None:
+            self.decode_buckets = (self.max_slots,)
+        self.prefill_buckets = tuple(sorted(self.prefill_buckets))
+        self.decode_buckets = tuple(sorted(self.decode_buckets))
+        if self.decode_buckets[-1] != self.max_slots:
+            raise ValueError(
+                f"largest decode bucket {self.decode_buckets[-1]} "
+                f"must equal max_slots {self.max_slots}")
+        if self.max_total_tokens < self.prefill_buckets[-1]:
+            raise ValueError(
+                f"max_total_tokens={self.max_total_tokens} < largest "
+                f"prefill bucket {self.prefill_buckets[-1]}")
+        if self.decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk={self.decode_chunk} must be >= 1")
+
+    @property
+    def table_width(self) -> int:
+        """Block-table columns: enough pages for the longest possible
+        request (every program signature shares this width)."""
+        return -(-self.max_total_tokens // self.block_size)
+
+
+class ServingEngine:
+    """Continuous-batching serving over one GPTForCausalLM."""
+
+    def __init__(self, model, config: Optional[ServingConfig] = None):
+        import jax
+        self.config = cfg = config or ServingConfig()
+        mcfg = model.gpt.config
+        if cfg.max_total_tokens > mcfg.max_seq_len:
+            raise ValueError(
+                f"max_total_tokens={cfg.max_total_tokens} exceeds the "
+                f"model's max_seq_len={mcfg.max_seq_len}")
+        # weight snapshot, cast ONCE at engine build (a server's params
+        # are immutable for the engine's lifetime; push new weights by
+        # building a new engine)
+        self.params = _cast_params(_gpt_params(model), cfg.dtype)
+        self.n_heads = int(mcfg.num_heads)
+        self.eps = float(mcfg.layer_norm_eps)
+        self.vocab_size = int(mcfg.vocab_size)
+        hd = int(mcfg.hidden_size) // self.n_heads
+        pool_dtype = cfg.dtype or "float32"
+        self.cache = PagedKVCache(
+            n_layers=int(mcfg.num_layers), n_blocks=cfg.n_blocks,
+            block_size=cfg.block_size, n_heads=self.n_heads,
+            head_dim=hd, dtype=pool_dtype)
+        self.ladder = BucketLadder(cfg.prefill_buckets,
+                                   cfg.decode_buckets, cfg.block_size)
+        self.sched = FifoScheduler(cfg.max_slots, cfg.max_admit)
+        sampling = (float(cfg.temperature),
+                    None if cfg.top_k is None else int(cfg.top_k),
+                    None if cfg.top_p is None else float(cfg.top_p))
+        self._decode = jit_with_donated_pools(make_decode_fn(
+            self.eps, self.n_heads, cfg.block_size, *sampling,
+            n_steps=int(cfg.decode_chunk)))
+        self._prefill = jit_with_donated_pools(make_prefill_fn(
+            self.eps, self.n_heads, cfg.block_size, *sampling))
+        self.sentinel = RecompileSentinel("serving")
+        self._key = jax.random.key(int(cfg.seed))
+        self._step_no = 0
+        self._warmed = False
+
+    # -- compile-count contract ----------------------------------------------
+    def executable_count(self) -> int:
+        return int(self._prefill._cache_size()
+                   + self._decode._cache_size())
+
+    @property
+    def expected_executables(self) -> int:
+        return self.ladder.size
+
+    # -- request intake ------------------------------------------------------
+    def submit(self, ids, max_new_tokens: int, rid=None,
+               eos_token_id=None, arrival: Optional[float] = None):
+        """Queue one request. Fails loudly on shapes the ladder cannot
+        serve — a queued-then-unservable request would wedge FIFO
+        admission forever."""
+        req = Request(ids=ids, max_new_tokens=int(max_new_tokens),
+                      rid=rid,
+                      eos_token_id=(self.config.eos_token_id
+                                    if eos_token_id is None
+                                    else eos_token_id),
+                      arrival=(time.perf_counter()
+                               if arrival is None else arrival))
+        self.ladder.pick_prefill(req.prompt_len)  # raises if too long
+        if req.total_tokens > self.config.max_total_tokens:
+            raise ValueError(
+                f"request needs {req.total_tokens} tokens > "
+                f"max_total_tokens={self.config.max_total_tokens}")
+        need = self.cache.blocks_for(req.total_tokens)
+        if need > self.cache.n_blocks - 1:
+            raise ValueError(
+                f"request needs {need} pages > pool size "
+                f"{self.cache.n_blocks - 1}")
+        self.sched.submit(req)
+        if _obs._enabled:
+            _obs.gauge("serving.queue_depth").set(self.sched.queue_depth)
+        return req.rid
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    # -- the ladder warmup ---------------------------------------------------
+    def warmup(self):
+        """Compile the WHOLE ladder up front on dummy lanes (all-zero
+        tables: every write lands in the scratch page). A server pays
+        its compiles at startup; steady state then runs a fixed
+        executable set and the sentinel flags any growth."""
+        import jax
+        W = self.config.table_width
+        key = jax.random.key(0)
+        for s in self.ladder.prefill:
+            a = self.sched.max_admit
+            self.cache.pools, _ = self._prefill(
+                self.cache.pools, np.zeros((a, W), np.int32),
+                np.zeros((a, s), np.int32), np.ones((a,), np.int32),
+                self.params, key)
+        for b in self.ladder.decode:
+            self.cache.pools, _ = self._decode(
+                self.cache.pools, np.zeros((b, W), np.int32),
+                np.zeros((b,), np.int32), np.zeros((b,), np.int32),
+                self.params, key)
+        self.sentinel.observe(self.executable_count(),
+                              expected=self.expected_executables,
+                              signature=self._shape_signature(None, None))
+        self._warmed = True
+        return self
+
+    # -- one token boundary --------------------------------------------------
+    def step(self) -> List[Request]:
+        """Retire, admit, decode — returns the requests that FINISHED
+        at this boundary (their pages already freed)."""
+        import jax
+        cfg = self.config
+        rec = _obs._enabled
+        finished = self.sched.retire_finished()
+        for r in finished:
+            self.cache.free(r.rid)
+            r.done_ts = time.perf_counter()
+        if rec and finished:
+            _obs.counter("serving.evicted_total").add(len(finished))
+
+        batch = self.sched.take_admissible(self.cache)
+        self._step_no += 1
+        # one fresh key per boundary, then DISTINCT subkeys for the
+        # two programs: prefill's _pick consumes its key directly while
+        # decode splits its own per chunk step — handing both the same
+        # key would correlate the sampled draws (greedy is unaffected)
+        key = jax.random.fold_in(self._key, self._step_no)
+        pf_key = jax.random.fold_in(key, 0)
+        dec_key = jax.random.fold_in(key, 1)
+        prefill_sig = decode_sig = None
+        if batch:
+            t0 = time.perf_counter()
+            a = self.sched.max_admit
+            s = self.ladder.pick_prefill(
+                max(r.prompt_len for r in batch))
+            ids = np.zeros((a, s), np.int32)
+            lens = np.ones((a,), np.int32)
+            rids: List[object] = []
+            for i, r in enumerate(batch):
+                self.cache.alloc(r.rid, r.total_tokens)
+                ids[i, :r.prompt_len] = r.ids
+                lens[i] = r.prompt_len
+                rids.append(r.rid)
+            rids += [None] * (a - len(batch))
+            tables = self.cache.table_array(rids, cfg.table_width)
+            self.cache.pools, tok = self._prefill(
+                self.cache.pools, tables, ids, lens, self.params,
+                pf_key)
+            tok = np.asarray(tok)
+            now = time.perf_counter()
+            for i, r in enumerate(batch):
+                r.admitted_ts = t0
+                r.first_token_ts = now
+                r.pos = r.prompt_len
+                r.accept(int(tok[i]))
+            prefill_sig = (a, s)
+            if rec:
+                _obs.counter("serving.admitted_total").add(len(batch))
+                _obs.histogram("serving.prefill_ms").observe(
+                    (now - t0) * 1e3)
+                for r in batch:
+                    if r.arrival is not None:
+                        _obs.histogram("serving.ttft_ms").observe(
+                            (now - r.arrival) * 1e3)
+
+        active = self.sched.active()
+        if active:
+            t0 = time.perf_counter()
+            b = self.ladder.pick_decode(len(active))
+            toks = np.zeros((b,), np.int32)
+            positions = np.zeros((b,), np.int32)
+            rids = []
+            for i, r in enumerate(active):
+                toks[i] = r.out[-1]
+                positions[i] = r.pos
+                rids.append(r.rid)
+            rids += [None] * (b - len(active))
+            tables = self.cache.table_array(rids, cfg.table_width)
+            self.cache.pools, toks_out = self._decode(
+                self.cache.pools, tables, toks, positions, self.params,
+                dec_key)
+            toks_out = np.asarray(toks_out)     # [decode_chunk, B]
+            accepted = 0
+            for i, r in enumerate(active):
+                for s in range(toks_out.shape[0]):
+                    if r.done:
+                        break   # over-decoded junk: host trims
+                    r.pos += 1
+                    r.accept(int(toks_out[s, i]))
+                    accepted += 1
+            decode_sig = (b,)
+            if rec:
+                dt = (time.perf_counter() - t0) * 1e3
+                _obs.histogram("serving.decode_step_ms").observe(dt)
+                _obs.counter("serving.tokens_total").add(accepted)
+
+        if batch or active:
+            self.sentinel.observe(
+                self.executable_count(),
+                expected=self.expected_executables,
+                signature=self._shape_signature(prefill_sig,
+                                                decode_sig))
+        if rec:
+            _obs.gauge("serving.queue_depth").set(self.sched.queue_depth)
+            _obs.gauge("serving.active_slots").set(
+                len(self.sched.active()))
+            _obs.gauge("serving.pages_free").set(self.cache.n_free)
+        return finished
+
+    def _shape_signature(self, prefill_sig, decode_sig):
+        """Sentinel signature: the bucket shapes this step dispatched
+        (a violation's diff then names the drifting bucket)."""
+        sig = []
+        if prefill_sig is not None:
+            sig.append(("prefill", tuple(prefill_sig), "bucket"))
+        if decode_sig is not None:
+            sig.append(("decode", tuple(decode_sig), "bucket"))
+        return tuple(sig)
+
+    # -- convenience drains --------------------------------------------------
+    def run_to_completion(self, max_steps: int = 100000
+                          ) -> List[Request]:
+        """Drain the queue + running set; returns every finished
+        request in completion order."""
+        done: List[Request] = []
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            done.extend(self.step())
+        else:
+            raise RuntimeError(
+                f"run_to_completion: work left after {max_steps} "
+                "steps (eos never fired and budgets did not expire?)")
+        return done
+
+    def generate_tokens(self, prompts: Sequence[np.ndarray],
+                        max_new_tokens) -> List[List[int]]:
+        """Batch convenience: submit all, drain, return per-prompt
+        generated tokens in submit order (the parity-test surface)."""
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        rids = [self.submit(p, n)
+                for p, n in zip(prompts, max_new_tokens)]
+        by_rid = {r.rid: r for r in self.run_to_completion()}
+        return [list(by_rid[rid].out) for rid in rids]

@@ -1,0 +1,196 @@
+"""Open-loop load generator + SLO accounting for the serving bench.
+
+Open-loop means arrivals follow the TRACE clock, not the server: a
+slow server doesn't throttle the offered load, it grows the queue —
+which is exactly how p99 latency dies in production and why
+closed-loop benchmarks overstate serving throughput (they let the
+server set the pace).
+
+Two replay paths over the SAME trace:
+
+- ``replay_continuous``: the ServingEngine loop — submit what has
+  arrived, step one token boundary, repeat. TTFT is first-token wall
+  time minus trace arrival (queueing counts).
+- ``replay_static``: today's baseline — fixed-size batches through
+  ``model.generate`` (the per-call dense-cache path). The batch forms
+  when enough requests are waiting (head-of-line), pads every prompt
+  to the batch max, decodes max(max_new) for everyone, and pays one
+  XLA compile per NEW (prompt_pad, new_tokens) signature mid-stream —
+  the two architectural costs the paged engine exists to delete.
+  Batch rows are padded by repeating the last request so the batch
+  dim, at least, stays signature-stable (the kindest honest baseline).
+
+Both report USEFUL tokens only (each request's own max_new budget):
+the static path's over-decode beyond a row's budget is wasted work
+and is deliberately not credited.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["TraceItem", "synthetic_trace", "replay_continuous",
+           "replay_static", "summarize"]
+
+
+@dataclass(frozen=True)
+class TraceItem:
+    arrival_s: float          # offset from trace start
+    ids: np.ndarray           # 1-D int32 prompt
+    max_new_tokens: int
+
+
+def synthetic_trace(n_requests: int, vocab_size: int, seed: int = 0,
+                    rate_rps: float = 50.0,
+                    prompt_len_choices: Sequence[int] = (
+                        4, 6, 8, 12, 16, 24, 40),
+                    new_token_choices: Sequence[int] = (
+                        4, 8, 12, 16, 24, 32)) -> List[TraceItem]:
+    """Deterministic mixed-length Poisson-ish arrivals: exponential
+    inter-arrival times at ``rate_rps``, prompt/new lengths drawn
+    uniformly from the choice sets. Same seed -> same trace, so the
+    engine and the static baseline replay identical traffic."""
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    out: List[TraceItem] = []
+    for _ in range(int(n_requests)):
+        t += float(rng.exponential(1.0 / float(rate_rps)))
+        L = int(rng.choice(list(prompt_len_choices)))
+        N = int(rng.choice(list(new_token_choices)))
+        ids = rng.randint(0, vocab_size, (L,)).astype(np.int32)
+        out.append(TraceItem(arrival_s=t, ids=ids, max_new_tokens=N))
+    return out
+
+
+@dataclass
+class _Record:
+    arrival: float            # absolute perf_counter
+    first_token: float
+    done: float
+    n_tokens: int
+
+
+def _percentiles(vals: Sequence[float]) -> Dict[str, float]:
+    if not vals:
+        return {"p50": -1.0, "p99": -1.0}
+    return {"p50": round(float(np.percentile(vals, 50)), 3),
+            "p99": round(float(np.percentile(vals, 99)), 3)}
+
+
+def summarize(records: List[_Record]) -> Dict:
+    """Trace-level SLO stats: sustained useful tokens/s over the span
+    first-arrival -> last-completion, TTFT and per-token percentiles
+    in ms. ``per_token_ms`` is the inter-token stream rate (decode
+    span first-token -> done over the tokens after the first; ~0 for
+    the non-streaming static path, whose whole output lands at once —
+    its cost shows up in TTFT instead); ``request_ms_per_token`` is
+    the end-to-end number (queueing + prefill + decode, per token)."""
+    if not records:
+        return {"sustained_tokens_per_sec": 0.0, "requests": 0}
+    t_start = min(r.arrival for r in records)
+    t_end = max(r.done for r in records)
+    total_tokens = sum(r.n_tokens for r in records)
+    ttft_ms = [(r.first_token - r.arrival) * 1e3 for r in records]
+    per_tok_ms = [(r.done - r.first_token) * 1e3
+                  / max(1, r.n_tokens - 1) for r in records]
+    req_tok_ms = [(r.done - r.arrival) * 1e3 / r.n_tokens
+                  for r in records]
+    span = max(t_end - t_start, 1e-9)
+    return {
+        "requests": len(records),
+        "total_new_tokens": int(total_tokens),
+        "span_s": round(span, 3),
+        "sustained_tokens_per_sec": round(total_tokens / span, 1),
+        "ttft_ms": _percentiles(ttft_ms),
+        "per_token_ms": _percentiles(per_tok_ms),
+        "request_ms_per_token": _percentiles(req_tok_ms),
+    }
+
+
+def replay_continuous(engine, trace: List[TraceItem]) -> Dict:
+    """Drive the ServingEngine through the trace open-loop on the wall
+    clock. Returns summarize() stats + the engine's compile receipt."""
+    t0 = time.perf_counter()
+    pending = list(trace)
+    next_i = 0
+    records: List[_Record] = []
+    by_rid: Dict[object, TraceItem] = {}
+    while next_i < len(pending) or engine.has_work():
+        now = time.perf_counter() - t0
+        while (next_i < len(pending)
+               and pending[next_i].arrival_s <= now):
+            it = pending[next_i]
+            rid = engine.submit(it.ids, it.max_new_tokens,
+                                arrival=t0 + it.arrival_s)
+            by_rid[rid] = it
+            next_i += 1
+        if engine.has_work():
+            for r in engine.step():
+                records.append(_Record(
+                    arrival=r.arrival, first_token=r.first_token_ts,
+                    done=r.done_ts, n_tokens=len(r.out)))
+        elif next_i < len(pending):
+            # idle with the next arrival known and no other wake
+            # source: sleep the whole gap, don't busy-poll it away
+            time.sleep(max(pending[next_i].arrival_s - now, 0.0))
+    stats = summarize(records)
+    stats["executables"] = engine.executable_count()
+    stats["expected_executables"] = engine.expected_executables
+    stats["recompile_events"] = engine.sentinel.fired
+    return stats
+
+
+def replay_static(model, trace: List[TraceItem], batch_size: int = 4,
+                  dtype: Optional[str] = None) -> Dict:
+    """The static-batch baseline over the same trace: accumulate
+    arrivals, serve fixed-size batches through ``model.generate``
+    (dense per-call KV cache, ragged prompts via prompt_lens). Every
+    new (prompt_pad, new_tokens) signature compiles mid-stream."""
+    import paddle_tpu as paddle
+
+    t0 = time.perf_counter()
+    pending = list(trace)
+    next_i = 0
+    waiting: List[TraceItem] = []
+    records: List[_Record] = []
+    signatures = set()
+    while next_i < len(pending) or waiting:
+        now = time.perf_counter() - t0
+        while (next_i < len(pending)
+               and pending[next_i].arrival_s <= now):
+            waiting.append(pending[next_i])
+            next_i += 1
+        if not waiting or (len(waiting) < batch_size
+                           and next_i < len(pending)):
+            # batch not formed yet (both arms imply arrivals remain):
+            # sleep exactly to the next one
+            time.sleep(max(pending[next_i].arrival_s - now, 0.0))
+            continue
+        take = waiting[:batch_size]
+        del waiting[:len(take)]
+        rows = list(take)
+        while len(rows) < batch_size:      # signature-stable batch dim
+            rows.append(take[-1])
+        P = max(r.ids.size for r in rows)
+        N = max(r.max_new_tokens for r in rows)
+        ids = np.zeros((batch_size, P), np.int32)
+        lens = np.zeros((batch_size,), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, :r.ids.size] = r.ids
+            lens[i] = r.ids.size
+        signatures.add((batch_size, P, N))
+        out = model.generate(
+            paddle.to_tensor(ids), max_new_tokens=N, dtype=dtype,
+            prompt_lens=paddle.to_tensor(lens))
+        np.asarray(out._data).ravel()[:1]  # sync
+        done = time.perf_counter()
+        for r in take:
+            records.append(_Record(
+                arrival=t0 + r.arrival_s, first_token=done, done=done,
+                n_tokens=r.max_new_tokens))
+    stats = summarize(records)
+    stats["compiled_signatures"] = len(signatures)
+    return stats

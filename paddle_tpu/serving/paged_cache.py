@@ -1,0 +1,166 @@
+"""Block/paged KV cache: a fixed pool of pages + host-side block tables.
+
+The reference ships a production inference stack
+(paddle/fluid/inference/) whose KV memory is a dense per-call slab;
+models/generation.py kept that shape — the cache is `[B, T]`-dense and
+dies with the call, so a finished request can't release its memory
+without re-batching everyone else. The serving-native form (vLLM's
+PagedAttention insight, TPU-statically-shaped here) splits the cache
+into fixed-size PAGES:
+
+- device side: per layer, one K pool and one V pool of shape
+  ``[n_blocks, block_size, n_heads, head_dim]`` — allocated once at
+  engine build, donated through every compiled prefill/decode call so
+  XLA updates the pages in place (graph_lint's donation rule proves the
+  aliasing);
+- host side: a free-list allocator and a per-request block table
+  (request -> ordered page ids). A request's cache is the list of
+  pages its table names; logical token position ``p`` lives in page
+  ``table[p // block_size]`` at offset ``p % block_size``.
+
+Eviction of a finished request is therefore a host-side list append —
+no device copy, no neighbor movement, no recompile. Block id 0 is
+reserved as SCRATCH: it is never allocated, and masked/padded rows in
+the compiled programs route their writes there, so inactive lanes need
+no conditional scatter.
+
+Allocation is whole-lifetime: ``alloc(req, prompt + max_new)`` reserves
+every page the request can ever touch at admission, so a running decode
+can never OOM mid-stream (admission control is the only backpressure
+point). The invariants tests pin: no page in two live tables, and
+free + live + 1 (scratch) == n_blocks at every step.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Fixed page pool + host-side block-table allocator.
+
+    ``pools`` is the device pytree (a tuple over layers of (k, v) page
+    pools) the compiled programs consume and return; the engine swaps
+    the attribute after every donated call. Everything else is host
+    bookkeeping.
+    """
+
+    def __init__(self, n_layers: int, n_blocks: int, block_size: int,
+                 n_heads: int, head_dim: int, dtype="float32"):
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks={n_blocks}: need at least 1 allocatable "
+                "page beyond the reserved scratch block 0")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be >= 1")
+        import jax.numpy as jnp
+        self.n_layers = int(n_layers)
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.n_blocks, self.block_size, self.n_heads,
+                 self.head_dim)
+        self.pools = tuple(
+            (jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
+            for _ in range(self.n_layers))
+        # LIFO free list: hot reuse keeps the working set of pages
+        # small (freshly-freed pages go to the next admission)
+        self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._tables: Dict[object, List[int]] = {}
+
+    # -- sizing --------------------------------------------------------------
+    def blocks_for(self, n_tokens: int) -> int:
+        """Pages needed to hold n_tokens."""
+        return -(-int(n_tokens) // self.block_size)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_live(self) -> int:
+        return sum(len(t) for t in self._tables.values())
+
+    def can_alloc(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= len(self._free)
+
+    # -- allocate / free -----------------------------------------------------
+    def alloc(self, req_id, n_tokens: int) -> List[int]:
+        """Reserve the request's whole-lifetime page list. Raises on
+        double-alloc or pool exhaustion (admission control must check
+        ``can_alloc`` first — running out mid-decode is a bug)."""
+        if req_id in self._tables:
+            raise ValueError(f"request {req_id!r} already holds pages")
+        need = self.blocks_for(n_tokens)
+        if need > len(self._free):
+            raise MemoryError(
+                f"paged cache exhausted: need {need} pages for "
+                f"{req_id!r}, {len(self._free)} free "
+                f"(pool {self.n_blocks - 1} allocatable)")
+        blocks = [self._free.pop() for _ in range(need)]
+        self._tables[req_id] = blocks
+        return list(blocks)
+
+    def free(self, req_id) -> List[int]:
+        """Return a finished request's pages to the free list — a host
+        list splice; no other request's pages move."""
+        blocks = self._tables.pop(req_id, None)
+        if blocks is None:
+            raise KeyError(f"request {req_id!r} holds no pages")
+        self._free.extend(blocks)
+        return blocks
+
+    def table(self, req_id) -> List[int]:
+        return list(self._tables[req_id])
+
+    def live_requests(self) -> List:
+        return list(self._tables)
+
+    # -- program feed --------------------------------------------------------
+    def table_array(self, req_ids: Sequence, width: int) -> np.ndarray:
+        """Padded ``[len(req_ids), width]`` int32 block-table array for
+        the compiled programs. Missing entries (rows shorter than
+        width, or req_id None = a dummy admission lane) point at the
+        scratch block 0 — writes land there, reads are masked."""
+        out = np.zeros((len(req_ids), width), np.int32)
+        for i, rid in enumerate(req_ids):
+            if rid is None:
+                continue
+            blocks = self._tables[rid]
+            if len(blocks) > width:
+                raise ValueError(
+                    f"request {rid!r} holds {len(blocks)} pages > "
+                    f"table width {width}")
+            out[i, :len(blocks)] = blocks
+        return out
+
+    # -- invariants ----------------------------------------------------------
+    def check_invariants(self):
+        """Free-list conservation + no page shared by two live
+        requests + scratch never handed out. Cheap enough to call every
+        scheduler step in tests."""
+        live: List[int] = []
+        for t in self._tables.values():
+            live.extend(t)
+        live_set = set(live)
+        if len(live) != len(live_set):
+            raise AssertionError("a page is shared by two live requests")
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise AssertionError("duplicate page on the free list")
+        if live_set & free_set:
+            raise AssertionError("page both live and free")
+        if 0 in live_set or 0 in free_set:
+            raise AssertionError("scratch block 0 was allocated")
+        total = 1 + len(self._free) + len(live)
+        if total != self.n_blocks:
+            raise AssertionError(
+                f"page conservation broken: 1 scratch + "
+                f"{len(self._free)} free + {len(live)} live != "
+                f"{self.n_blocks}")
+        return True

@@ -1,0 +1,173 @@
+"""The serving engine's two compiled programs: bucketed prefill and the
+paged decode step.
+
+TVM's lesson (PAPERS.md) dictates the TPU shape: a SMALL, FIXED set of
+pre-compiled executables over static shapes, never a recompile per
+request. The whole steady-state serving loop is exactly
+
+  n_prefill_buckets   prefill executables   (admit width x bucket len)
+  n_decode_buckets    decode executables    (slot-count buckets)
+
+and the RecompileSentinel pins that count every step.
+
+Both programs take the page pools FIRST and donate them
+(``donate_argnums=(0,)``), so XLA writes K/V pages in place — the
+graph_lint donation rule proves the aliasing on the lowered module.
+The math reuses models/generation.py's helpers (`_ln`, `_attend`,
+`_prefill`, `_pick`) verbatim, which is what makes the paged-vs-dense
+greedy parity contract hold token-for-token in f32: same ops in the
+same order, only the cache addressing differs.
+
+Addressing: logical position ``p`` of a request lives in page
+``table[p // block_size]`` at offset ``p % block_size``. Masked or
+padded lanes carry an all-zeros table row — their writes land in the
+reserved scratch page 0 and their reads are iota-masked, so inactive
+lanes cost no conditional scatter. Junk K/V (pad positions a bucketed
+prefill computes past a row's true length) is either routed to scratch
+by table padding or progressively overwritten by the decode scatter —
+and never attended, because every attention masks to the row's live
+prefix.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import _attend, _ln, _pick, _prefill
+
+__all__ = ["make_decode_fn", "make_prefill_fn", "jit_with_donated_pools"]
+
+
+def _gathered(pool, tables, n_heads, hd):
+    """Pages -> contiguous logical cache: [n_blocks, bs, nh, hd]
+    gathered by [B, W] tables into [B, nh, W*bs, hd] (table order IS
+    logical order, so index j along the length axis is position j)."""
+    b, w = tables.shape
+    pages = pool[tables]                       # [B, W, bs, nh, hd]
+    flat = pages.reshape(b, w * pool.shape[1], n_heads, hd)
+    return jnp.einsum("bsnh->bnsh", flat)
+
+
+def make_decode_fn(eps: float, n_heads: int, block_size: int,
+                   temperature: float, top_k, top_p,
+                   n_steps: int = 1):
+    """``n_steps`` token boundaries for every running slot, fused into
+    one dispatch (lax.scan over the single-token body).
+
+    run(pools, tables, toks, positions, params, key)
+        -> (pools', toks [n_steps, B])
+
+    toks [B] is each slot's last emitted token, positions [B] the
+    logical index where its K/V land (== tokens held so far). The body
+    mirrors generation.py's ragged decode body exactly, with the
+    dynamic_update_slice cache write swapped for the paged scatter.
+
+    n_steps > 1 is the multi-step-scheduling lever: admission/retire
+    decisions then happen every n_steps tokens instead of every token,
+    trading a bounded TTFT granularity for host-dispatch amortization
+    (the per-token jit round-trip is the serving loop's overhead
+    floor). Rows whose budget or eos fires mid-chunk over-decode at
+    most n_steps-1 junk tokens; their writes land in their own
+    reserved pages (or clamp to their last page), which die with the
+    request — the host trims the emitted stream.
+    """
+
+    def step(pools, tables, toks, positions, params, key):
+        b = toks.shape[0]
+        hd = params["wte"].shape[1] // n_heads
+        scale = 1.0 / math.sqrt(hd)
+        x = (params["wte"][toks] + params["wpe"][positions])[:, None, :]
+        bi = jnp.arange(b)
+        blk = tables[bi, positions // block_size]        # [B]
+        off = positions % block_size                     # [B]
+        new_pools = []
+        for bp, (kp, vp) in zip(params["blocks"], pools):
+            xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
+            qkv = (xn @ bp["qkv_w"] + bp["qkv_b"]).reshape(
+                b, 1, 3, n_heads, hd)
+            q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])   # [B,nh,1,hd]
+            k_tok = qkv[:, 0, 1]                         # [B,nh,hd]
+            v_tok = qkv[:, 0, 2]
+            kp = kp.at[blk, off].set(k_tok)
+            vp = vp.at[blk, off].set(v_tok)
+            kc = _gathered(kp, tables, n_heads, hd)
+            vc = _gathered(vp, tables, n_heads, hd)
+            ctx = _attend(q, kc, vc, positions + 1, scale)
+            ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
+            x = x + ctx @ bp["proj_w"] + bp["proj_b"]
+            ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
+            ff = jax.nn.gelu(ff @ bp["fc1_w"] + bp["fc1_b"],
+                             approximate=False)
+            x = x + ff @ bp["fc2_w"] + bp["fc2_b"]
+            new_pools.append((kp, vp))
+        h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+        logits = h[:, 0] @ params["wte"].T
+        tok = _pick(logits, key, temperature, top_k, top_p)
+        return tuple(new_pools), tok
+
+    def run(pools, tables, toks, positions, params, key):
+        def body(carry, step_key):
+            pools, toks, positions = carry
+            pools, tok = step(pools, tables, toks, positions, params,
+                              step_key)
+            return (pools, tok, positions + 1), tok
+        keys = jax.random.split(key, n_steps)
+        (pools, _, _), out = jax.lax.scan(
+            body, (pools, toks, positions), keys)
+        return pools, out                              # [n_steps, B]
+
+    return run
+
+
+def make_prefill_fn(eps: float, n_heads: int, block_size: int,
+                    temperature: float, top_k, top_p):
+    """Bucketed admission prefill: the whole admit batch — MIXED true
+    lengths — shares ONE executable per (admit width, bucket len).
+
+    run(pools, tables, ids, prompt_lens, params, key) -> (pools', tok)
+
+    ids [A, S] is right-padded to the bucket width S (a multiple of
+    block_size); prompt_lens [A] drives generation.py's iota prefill
+    mask, so each row's hidden state at its own last true token is
+    exactly what the dense ragged path computes. The per-layer dense
+    K/V [A, nh, S, hd] is then scattered page-wise into the pools and
+    the first generated token is picked from the last-token logits.
+    """
+
+    def run(pools, tables, ids, prompt_lens, params, key):
+        a, s = ids.shape
+        if s % block_size:
+            raise ValueError(
+                f"prefill bucket {s} is not a multiple of "
+                f"block_size {block_size}")
+        nblk = s // block_size
+        x, caches = _prefill(params, eps, n_heads, ids, s,
+                             prompt_lens=prompt_lens)
+        new_pools = []
+        for (kp, vp), (kc, vc) in zip(pools, caches):
+            # [A, nh, S, hd] -> page chunks [A, nblk, bs, nh, hd]
+            kcs = jnp.einsum("ansh->asnh", kc).reshape(
+                a, nblk, block_size, kc.shape[1], kc.shape[3])
+            vcs = jnp.einsum("ansh->asnh", vc).reshape(
+                a, nblk, block_size, vc.shape[1], vc.shape[3])
+            kp = kp.at[tables[:, :nblk]].set(kcs)
+            vp = vp.at[tables[:, :nblk]].set(vcs)
+            new_pools.append((kp, vp))
+        idx = (prompt_lens - 1).astype(jnp.int32)
+        last = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+        h_last = _ln(last, params["lnf_w"], params["lnf_b"], eps)
+        logits = h_last[:, 0] @ params["wte"].T
+        tok = _pick(logits, key, temperature, top_k, top_p)
+        return tuple(new_pools), tok
+
+    return run
+
+
+def jit_with_donated_pools(fn):
+    """The one jit policy for both programs: pools (arg 0) donated so
+    cache pages update in place. Per-ENGINE jits (no module-level lru
+    cache): `_cache_size()` then counts exactly this engine's
+    executables, which is what the RecompileSentinel contract needs."""
+    return jax.jit(fn, donate_argnums=(0,))

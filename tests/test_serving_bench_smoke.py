@@ -1,0 +1,82 @@
+"""serving_bench receipts: the tier-1 smoke runs a micro trace through
+the full CLI path (engine + static replays + emit_report bridge) and
+pins the report shape + the zero-recompile contract; the heavyweight
+open-loop SLO drill — the >=2x acceptance bar at default shapes —
+rides the slow tier."""
+import json
+
+import pytest
+
+from tools import serving_bench
+
+
+def _run(argv):
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = serving_bench.main(argv)
+    out = buf.getvalue()
+    line = [l for l in out.splitlines()
+            if l.startswith("serving_bench:")][-1]
+    return rc, json.loads(line.split("serving_bench:", 1)[1])
+
+
+TINY = ["--requests", "6", "--rate", "200", "--vocab", "97",
+        "--hidden", "32", "--layers", "2", "--heads", "4",
+        "--max-seq-len", "64", "--slots", "4", "--admit", "2",
+        "--block-size", "4", "--n-blocks", "32",
+        "--prefill-buckets", "8,16", "--max-total", "32",
+        "--decode-chunk", "2", "--static-batch", "2",
+        "--prompt-lens", "2,4,7,12", "--new-tokens", "2,4,6"]
+
+
+class TestServingBenchSmoke:
+    def test_report_shape_and_compile_contract(self):
+        rc, rep = _run(TINY)
+        assert rc == 0
+        x = rep["extras"]
+        eng = x["engine"]
+        assert eng["requests"] == 6
+        assert eng["recompile_events"] == 0
+        assert eng["executables"] == eng["expected_executables"]
+        assert eng["sustained_tokens_per_sec"] > 0
+        for leg in ("static_cold", "static_warm"):
+            assert x[leg]["sustained_tokens_per_sec"] > 0
+            assert x[leg]["compiled_signatures"] >= 1
+        for k in ("speedup_vs_static_cold", "speedup_vs_static_warm",
+                  "p99_ttft_ms_engine", "p99_ttft_ms_static",
+                  "zero_steady_state_recompiles"):
+            assert k in x
+        # the emit_report bridge: printed numbers == registry gauges
+        from paddle_tpu.observability import metrics
+        g = metrics.get("serving.value")
+        assert g is not None and g.value() == rep["value"]
+
+    def test_replicated_rollup_smoke(self):
+        rc, rep = _run(TINY + ["--replicas", "2"])
+        assert rc == 0
+        eng = rep["extras"]["engine"]
+        assert eng["replicas"] == 2
+        assert sum(eng["per_replica_requests"]) == 6
+        assert eng["recompile_events"] == 0
+        assert eng["fleet_rollup_keys"] > 0
+
+
+@pytest.mark.slow  # ~35 s: default-shape open-loop drill; the tier-1
+#   smoke above keeps the CLI path + compile contract covered
+class TestServingSloDrill:
+    def test_default_receipt_clears_acceptance_bars(self):
+        """The ISSUE acceptance receipt at default shapes: >=2x
+        sustained tokens/s vs the static-batch baseline at
+        equal-or-better p99 TTFT, zero steady-state recompiles."""
+        rc, rep = _run(["--check"])
+        assert rc == 0
+        x = rep["extras"]
+        assert x["receipt_ok"] is True
+        assert x["speedup_vs_static_cold"] >= 2.0
+        assert (x["p99_ttft_ms_engine"]
+                <= x["p99_ttft_ms_static"])
+        assert x["zero_steady_state_recompiles"] is True
+        assert x["engine"]["executables"] == \
+            x["engine"]["expected_executables"]

@@ -14,12 +14,16 @@ baseline:
   f32-table-copy        no full-table f32 copies (hlo_copy_audit rule)
   obs-gate (--source)   repo_lint's _obs._enabled discipline
 
-Programs (both by default; shapes env-free, flag-tunable):
-  ernie   the ERNIE TrainStep (AMP O1 bf16) — the tier-1 smoke pins
-          this clean at tiny shapes; pass --vocab 30528 --hidden 768
-          --layers 2 for the full-size audit
-  spmd    the spmd_1f1b one-program pipeline engine (2 stages), with
-          its ring-ppermute collective schedule captured at trace time
+Programs (all three by default; shapes env-free, flag-tunable):
+  ernie    the ERNIE TrainStep (AMP O1 bf16) — the tier-1 smoke pins
+           this clean at tiny shapes; pass --vocab 30528 --hidden 768
+           --layers 2 for the full-size audit
+  spmd     the spmd_1f1b one-program pipeline engine (2 stages), with
+           its ring-ppermute collective schedule captured at trace time
+  serving  the continuous-batching decode-step program
+           (paddle_tpu.serving) — its donated KV page pools MUST alias
+           in input_output_alias (a dropped donation doubles serving
+           HBM every token); baseline: tools/serving_lint_baseline.json
 
 Baselines: --baseline FILE gates on NEW findings only;
 --write-baseline re-anchors (the tier1_budget rebalance flow). Always
@@ -128,12 +132,45 @@ def build_spmd(args, config):
                         schedule=list(sched))
 
 
+def build_serving(args, config):
+    """Continuous-batching decode-step audit target: the serving
+    engine's chunked decode program at pool shapes big enough for the
+    default donation threshold (each page pool is 128 KiB f32). The
+    donation rule is the load-bearing one here: the engine donates
+    every K/V page pool each token boundary, and a silently-dropped
+    donation would double serving cache HBM."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import ProgramAudit
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128, dropout=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_slots=8, max_admit=4, block_size=8, n_blocks=64,
+        prefill_buckets=(32, 64), decode_chunk=4,
+        max_total_tokens=96, dtype=None))
+    W = eng.config.table_width
+    lowered = eng._decode.lower(
+        eng.cache.pools, np.zeros((8, W), np.int32),
+        np.zeros((8,), np.int32), np.zeros((8,), np.int32),
+        eng.params, jax.random.key(0))
+    return ProgramAudit("serving_decode", lowered=lowered,
+                        config=config, schedule=[])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--program", choices=("ernie", "spmd", "all",
-                                          "none"),
+    ap.add_argument("--program", choices=("ernie", "spmd", "serving",
+                                          "all", "none"),
                     default="all",
                     help="which programs to lower and audit "
                          "(none: --source only)")
@@ -170,9 +207,10 @@ def main(argv=None) -> int:
     findings = []
     programs = []
     schedules = {}
-    want = ("ernie", "spmd") if args.program == "all" else \
+    want = ("ernie", "spmd", "serving") if args.program == "all" else \
         () if args.program == "none" else (args.program,)
-    builders = {"ernie": build_ernie, "spmd": build_spmd}
+    builders = {"ernie": build_ernie, "spmd": build_spmd,
+                "serving": build_serving}
     for name in want:
         audit = builders[name](args, config)
         programs.append(audit.name)
