@@ -5,9 +5,13 @@ random moment can't be debugged or replayed in CI. This module reads a
 ``PD_CHAOS_*`` plan from the environment once and injects exactly one
 fault at exactly the named (rank, step):
 
-  PD_CHAOS_MODE     kill | stall | corrupt_ckpt   (anything else: off)
-  PD_CHAOS_STEP     step number to fire at (default 5)
-  PD_CHAOS_RANK     rank to fire on (default 1)
+  PD_CHAOS_MODE     kill | stall | corrupt_ckpt | corrupt_swap
+                    (anything else: off; corrupt_swap is serving-only)
+  PD_CHAOS_STEP     step number to fire at (default 5) — the train
+                    step for maybe_inject, the FLEET TICK for
+                    maybe_inject_serving
+  PD_CHAOS_RANK     rank (training) / replica slot (serving) to fire
+                    on (default 1)
   PD_CHAOS_EVERY    "1": fire on every incarnation (default: only the
                     first — PADDLE_RESTART_COUNT == 0 — so the
                     restarted worker survives, which is the drill)
@@ -38,9 +42,15 @@ from typing import Optional
 
 from ..observability import flight_recorder as _fr
 
-__all__ = ["ChaosPlan", "plan", "maybe_inject", "reset_plan_cache"]
+__all__ = ["ChaosPlan", "plan", "maybe_inject", "maybe_inject_serving",
+           "reset_plan_cache"]
 
-MODES = ("kill", "stall", "corrupt_ckpt")
+# training faults execute in-process (the worker IS the victim);
+# serving faults are RETURNED to the fleet, which applies them to the
+# named replica (a host-side engine object, not a process)
+TRAIN_MODES = ("kill", "stall", "corrupt_ckpt")
+SERVING_MODES = ("kill", "stall", "corrupt_swap")
+MODES = tuple(dict.fromkeys(TRAIN_MODES + SERVING_MODES))
 
 
 class ChaosPlan:
@@ -127,7 +137,9 @@ def maybe_inject(step: int, rank: Optional[int] = None,
     plan. Returns the mode it fired (stall returns after sleeping;
     kill/corrupt_ckpt never return), None when nothing fired."""
     p = plan()
-    if p is None:
+    if p is None or p.mode not in TRAIN_MODES:
+        # a serving-only mode (corrupt_swap) armed while a training
+        # loop runs must not fall through to the stall branch
         return None
     if rank is None:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
@@ -149,4 +161,28 @@ def maybe_inject(step: int, rank: Optional[int] = None,
         os.kill(os.getpid(), signal.SIGKILL)
     # stall: alive, not stepping, not pulsing — the monitor's job
     time.sleep(p.stall_s)
+    return p.mode
+
+
+def maybe_inject_serving(tick: int, replica: int,
+                         incarnation: int = 0) -> Optional[str]:
+    """Serving-replica fault poll: fires when the armed plan's mode is
+    a SERVING mode and (PD_CHAOS_RANK, PD_CHAOS_STEP) match this
+    (replica, fleet tick). UNLIKE ``maybe_inject`` this RETURNS the
+    mode instead of executing it — a serving replica is a host-side
+    engine object inside the fleet process, so the fleet applies the
+    fault deterministically (drop the engine for ``kill``, wedge the
+    step loop for ``stall``, poison the standby weight pool for
+    ``corrupt_swap``). ``incarnation`` is the replica's respawn count:
+    like training, the default plan fires only on incarnation 0 so the
+    replacement replica survives — which is the drill."""
+    p = plan()
+    if p is None or p.mode not in SERVING_MODES:
+        return None
+    if int(replica) != p.rank or int(tick) != p.step:
+        return None
+    if int(incarnation) != 0 and not p.every:
+        return None
+    _fr.record("chaos.inject", mode=p.mode, step=int(tick),
+               rank=int(replica), scope="serving")
     return p.mode

@@ -19,7 +19,11 @@ The pieces:
     back when a replacement appears, or abort. Between respawns it
     imposes exponential backoff, and two crash-loop guards bound a
     worker that dies at import: a lifetime ``max_restarts`` budget and
-    a restarts-per-window budget.
+    a restarts-per-window budget. The SERVING mode (``decide_scale``,
+    driven by ``serving/fleet.py``) adds SLO-aware autoscale on top of
+    the same state: queue/latency watermarks pick ``scale_up`` /
+    ``scale_down`` slots under a shared cooldown, spending the same
+    restarts-per-window budget a respawn does.
 
 ``effective_verdict``
     The doctor's verdict when it names a rank; otherwise synthesized
@@ -62,6 +66,10 @@ NONE_VERDICT = {"kind": "none", "rank": None, "source": "doctor",
 # a straggler or recompile storm is a cost, not a fault — respawn, don't
 # shrink
 _EVICTABLE = ("divergence", "hang", "heartbeat_stall", "crash")
+
+# autoscale actions the SERVING mode adds (decide_scale): the fleet
+# spawns the named slot on scale_up and DRAINS it on scale_down
+SCALE_ACTIONS = ("scale_up", "scale_down")
 
 
 @dataclass
@@ -139,10 +147,16 @@ class SupervisorPolicy:
                  restart_budget: int = 0,
                  allow_shrink: bool = False, min_world: int = 1,
                  grow_after_s: float = 0.0,
-                 heal_after_s: float = 20.0):
+                 heal_after_s: float = 20.0,
+                 scale_cooldown_s: float = 5.0,
+                 initial_world: Optional[int] = None):
         if policy not in ("gang", "rank"):
             raise ValueError(f"unknown elastic policy {policy!r}")
         self.world = int(world)
+        if initial_world is not None and not (
+                1 <= int(initial_world) <= self.world):
+            raise ValueError(
+                f"initial_world={initial_world} outside [1, {world}]")
         self.max_restarts = int(max_restarts)
         self.policy = policy
         self.backoff_base = float(backoff_base)
@@ -154,13 +168,18 @@ class SupervisorPolicy:
         self.min_world = max(1, int(min_world))
         self.grow_after_s = float(grow_after_s)
         self.heal_after_s = float(heal_after_s)
-        self.active: List[int] = list(range(self.world))
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        # serving fleets start below max capacity: world is the slot
+        # budget, initial_world the live set (scale_up fills spares)
+        self.active: List[int] = list(range(
+            self.world if initial_world is None else int(initial_world)))
         self.evicted: Dict[int, float] = {}     # rank -> eviction ts
         self.episode = 0
         self.restarts = 0                        # lifetime respawn count
         self._respawn_ts: List[float] = []       # for the window budget
         self._consecutive = 0
         self._last_respawn: Optional[float] = None
+        self._last_scale: Optional[float] = None
 
     # -- observations --------------------------------------------------------
     def note_progress(self, now: Optional[float] = None):
@@ -177,6 +196,15 @@ class SupervisorPolicy:
         self.restarts += 1
         self._respawn_ts.append(now)
         self._last_respawn = now
+
+    def record_scale_spawn(self, now: Optional[float] = None):
+        """A scale_up/grow spawn spends the restarts-per-WINDOW budget
+        (spawning is the expensive action the window bounds) but NOT
+        the lifetime ``max_restarts`` crash-loop budget — routine
+        demand scaling must never erode the abort threshold a real
+        crash loop is measured against."""
+        now = time.monotonic() if now is None else now
+        self._respawn_ts.append(now)
 
     # -- decisions -----------------------------------------------------------
     def backoff_delay(self) -> float:
@@ -252,6 +280,83 @@ class SupervisorPolicy:
                         episode=self.episode,
                         verdict=dict(NONE_VERDICT))
 
+    # -- serving mode --------------------------------------------------------
+    def decide_scale(self, slo, queued: int, p99_ttft_ms: float,
+                     now: Optional[float] = None) -> Optional[Decision]:
+        """SERVING-mode autoscale: one scale decision from the
+        ``serving.*`` signals the fleet publishes every tick. Pure —
+        the fleet applies the Decision (spawn the slot on ``scale_up``,
+        drain it on ``scale_down``).
+
+        `slo` is duck-typed (serving.fleet.ServingSLO): `p99_ttft_ms`
+        (0 disables the latency trigger), `queue_high` / `queue_low`
+        (queued-requests-per-live-replica watermarks). Guards:
+
+        - one shared cooldown (`scale_cooldown_s`) for BOTH directions
+          — an up/down flap is two scale actions inside one cooldown;
+        - scale_up spends the same restarts-per-window budget as a
+          respawn (spawning an engine is the expensive action the
+          budget exists to bound) and only takes a slot that is neither
+          live nor cooling down from an eviction;
+        - scale_down needs observed traffic (p99 >= 0, i.e. at least
+          one finished request) so a fleet warming up before its first
+          arrivals is not shrunk to the floor, and never drops below
+          `min_world`. The highest live slot drains (stable low slots
+          keep their warm engines).
+        """
+        now = time.monotonic() if now is None else now
+        if (self._last_scale is not None
+                and now - self._last_scale < self.scale_cooldown_s):
+            return None
+        live = len(self.active)
+        slo_p99 = float(getattr(slo, "p99_ttft_ms", 0.0) or 0.0)
+        breach = slo_p99 > 0 and p99_ttft_ms > slo_p99
+        hot = queued > int(slo.queue_high) * max(1, live)
+        if (hot or breach) and live < self.world:
+            if self.restart_budget:
+                recent = [t for t in self._respawn_ts
+                          if now - t <= self.restart_window_s]
+                if len(recent) + 1 > self.restart_budget:
+                    return None  # flapping: let the window slide first
+            spare = sorted(set(range(self.world)) - set(self.active)
+                           - set(self.evicted))
+            if not spare:
+                return None  # every spare slot is an eviction cooldown
+            slot = spare[0]
+            self.active.append(slot)
+            self.active.sort()
+            self._last_scale = now
+            self.episode += 1
+            reason = (f"p99 TTFT {p99_ttft_ms:.0f}ms > SLO "
+                      f"{slo_p99:.0f}ms" if breach else
+                      f"queued {queued} > {slo.queue_high}/replica "
+                      f"x {live}")
+            return Decision(
+                "scale_up", ranks=[slot], episode=self.episode,
+                reason=reason,
+                verdict={"kind": "slo_breach" if breach else "overload",
+                         "rank": None, "source": "serving_policy",
+                         "evidence": {"queued": int(queued),
+                                      "p99_ttft_ms": float(p99_ttft_ms),
+                                      "live": live}})
+        if (not hot and not breach and p99_ttft_ms >= 0
+                and live > self.min_world
+                and queued <= int(slo.queue_low) * live):
+            slot = max(self.active)
+            self.active.remove(slot)
+            self._last_scale = now
+            self.episode += 1
+            return Decision(
+                "scale_down", ranks=[slot], episode=self.episode,
+                reason=(f"idle: queued {queued} <= {slo.queue_low}"
+                        f"/replica x {live}, p99 {p99_ttft_ms:.0f}ms"),
+                verdict={"kind": "underload", "rank": None,
+                         "source": "serving_policy",
+                         "evidence": {"queued": int(queued),
+                                      "p99_ttft_ms": float(p99_ttft_ms),
+                                      "live": live}})
+        return None
+
 
 # -- doctor bridge ------------------------------------------------------------
 
@@ -321,6 +426,7 @@ def emit_receipt(episode: int, verdict: dict, action: str,
                  goodput: Optional[dict] = None,
                  goodput_delta: Optional[float] = None,
                  delay_s: float = 0.0, reason: str = "",
+                 extras: Optional[dict] = None,
                  out_dir: Optional[str] = None) -> dict:
     """Write one structured remediation receipt and mirror it into the
     always-on ``elastic.*`` registry series (counters stay visible with
@@ -340,6 +446,10 @@ def emit_receipt(episode: int, verdict: dict, action: str,
         "backoff_s": round(float(delay_s), 3),
         "reason": reason,
     }
+    if extras:
+        # free-form evidence the action's subsystem wants on the paper
+        # trail (dump dir, requeue counts, per-class TTFT, ...)
+        doc["extras"] = dict(extras)
     d = out_dir or receipts_dir()
     try:
         os.makedirs(d, exist_ok=True)

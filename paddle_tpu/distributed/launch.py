@@ -297,7 +297,9 @@ def _elastic_supervise(args, world) -> int:
                         action="grow", ranks=grow.ranks,
                         world_before=wb,
                         world_after=len(policy.active),
-                        reason=grow.reason, out_dir=receipts)
+                        reason=grow.reason,
+                        extras={"dump_dir": dump_dir},
+                        out_dir=receipts)
                 continue
 
             # ---- failure episode -----------------------------------------
@@ -333,7 +335,9 @@ def _elastic_supervise(args, world) -> int:
                     world_after=world_before,
                     resume_step=bundle["resume_step"],
                     goodput=bundle["goodput"],
-                    reason=decision.reason, out_dir=receipts)
+                    reason=decision.reason,
+                    extras={"dump_dir": dump_dir},
+                    out_dir=receipts)
                 monitor.close()
                 return 1
             for lr, why in failed:
@@ -392,7 +396,11 @@ def _elastic_supervise(args, world) -> int:
                 world_after=len(policy.active),
                 resume_step=bundle["resume_step"], goodput=gp,
                 goodput_delta=delta, delay_s=decision.delay_s,
-                reason=decision.reason, out_dir=receipts)
+                reason=decision.reason,
+                # the receipt an operator reads at 3am should name
+                # where the black boxes that drove the verdict live
+                extras={"dump_dir": dump_dir},
+                out_dir=receipts)
             if receipt.get("path"):
                 print(f"[elastic] remediation receipt: "
                       f"{receipt['path']}", file=sys.stderr)

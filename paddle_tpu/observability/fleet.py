@@ -26,7 +26,7 @@ from typing import Dict, List, Optional
 
 from . import metrics
 
-__all__ = ["aggregate", "merge_snapshots"]
+__all__ = ["aggregate", "merge_snapshots", "merge_partial"]
 
 
 def _num(v) -> bool:
@@ -81,6 +81,27 @@ def merge_snapshots(snaps: List[Dict[str, dict]]) -> Dict[str, dict]:
                 if "max" in d:
                     cur["max"] = max(cur.get("max", d["max"]), d["max"])
     return dict(sorted(out.items()))
+
+
+def merge_partial(snaps: List[Optional[Dict[str, dict]]]
+                  ) -> Dict[str, dict]:
+    """Skip-and-flag partial rollup: ``None`` entries — a dead or
+    unresponsive source (replica/host) whose snapshot could not be
+    fetched — are SKIPPED instead of failing or hanging the merge, and
+    the result always carries ``fleet.sources_reporting`` /
+    ``fleet.sources_skipped`` gauges so a partial rollup can never
+    masquerade as a full one. Callers own the liveness probe (e.g.
+    ``ServingFleet.aggregate``'s per-replica snapshot timeout); this
+    is the pure merge half."""
+    live = [s for s in snaps if s is not None]
+    out = merge_snapshots(live)
+    hosts = len(live) or 1
+    out["fleet.sources_reporting"] = {
+        "type": "gauge", "value": len(live), "hosts": hosts}
+    out["fleet.sources_skipped"] = {
+        "type": "gauge", "value": len(snaps) - len(live),
+        "hosts": hosts}
+    return out
 
 
 def _allgather_blobs(data: bytes) -> List[bytes]:

@@ -19,15 +19,25 @@ beat dynamic shapes):
   engine       ServingEngine: bf16 decode default, f32 parity mode
                bit-for-bit vs models/generation.py greedy
   loadgen      open-loop trace replay + SLO stats (tools/serving_bench)
+  fleet        ServingFleet: the SLO-aware self-healing control loop —
+               supervisor-driven autoscale, exact requeue of a dead
+               replica's in-flight requests, hot weight swaps, priority
+               classes with overload shedding, chaos-drill receipts
+               (tools/serving_chaos_drill.py)
 
-Multi-replica data-parallel serving = N engines over disjoint request
-streams; the shared serving.* metrics roll up through
-observability.fleet.aggregate() like every other subsystem.
+Multi-replica serving runs through the fleet; per-replica snapshots
+roll up skip-and-flag (a dead replica can't hang the gather) and the
+shared serving.* metrics ride observability.fleet.aggregate() like
+every other subsystem.
 """
 from .engine import ServingConfig, ServingEngine
+from .fleet import (FleetConfig, FleetRequest, PRIORITY_CLASSES,
+                    Replica, ServingFleet, ServingSLO)
 from .paged_cache import PagedKVCache
 from .scheduler import BucketLadder, FifoScheduler, Request
 from . import loadgen
 
 __all__ = ["ServingConfig", "ServingEngine", "PagedKVCache",
-           "BucketLadder", "FifoScheduler", "Request", "loadgen"]
+           "BucketLadder", "FifoScheduler", "Request", "loadgen",
+           "ServingFleet", "ServingSLO", "FleetConfig", "FleetRequest",
+           "Replica", "PRIORITY_CLASSES"]

@@ -18,8 +18,22 @@ the per-step dispatch is the price of in-flight admission, and the
 bench shows the batch-shape wins dominate it.
 
 Metrics ride the gated serving.* series (queue depth, active slots,
-free pages, admitted/evicted totals, TTFT + per-step histograms);
-``serving_recompiles_total`` is always-on via the RecompileSentinel.
+free pages, admitted/retired/evicted totals, TTFT + per-step
+histograms); ``serving_recompiles_total`` is always-on via the
+RecompileSentinel. ``serving.retired_total`` counts FINISHED requests;
+``serving.evicted_total`` counts requests pulled off the engine for
+requeue (``evict_requests``) — the old conflation of the two survives
+one release as the labeled alias
+``serving.evicted_total{deprecated=retired_alias}``.
+
+The fleet surface (``serving/fleet.py``): ``swap_weights()`` flips
+the weight snapshot at a token boundary without draining or
+recompiling. ``evict_requests()`` is the single-engine operational
+surface (drain a TRUSTED engine before shutdown/handoff) — the fleet
+deliberately does NOT call it on a failed replica: a wedged or dead
+engine can't be trusted to report its own state, so fleet eviction
+rebuilds each request from the fleet-side harvested token stream and
+increments ``serving.evicted_total`` itself.
 """
 from __future__ import annotations
 
@@ -95,9 +109,9 @@ class ServingEngine:
             raise ValueError(
                 f"max_total_tokens={cfg.max_total_tokens} exceeds the "
                 f"model's max_seq_len={mcfg.max_seq_len}")
-        # weight snapshot, cast ONCE at engine build (a server's params
-        # are immutable for the engine's lifetime; push new weights by
-        # building a new engine)
+        # weight snapshot, cast ONCE at engine build; new weights land
+        # only through swap_weights() at a token boundary (same
+        # treedef/avals — the ladder never recompiles)
         self.params = _cast_params(_gpt_params(model), cfg.dtype)
         self.n_heads = int(mcfg.num_heads)
         self.eps = float(mcfg.layer_norm_eps)
@@ -202,7 +216,13 @@ class ServingEngine:
             self.cache.free(r.rid)
             r.done_ts = time.perf_counter()
         if rec and finished:
-            _obs.counter("serving.evicted_total").add(len(finished))
+            _obs.counter("serving.retired_total").add(len(finished))
+            # DEPRECATED alias (kept one release): serving.evicted_total
+            # used to (mis)count retirements. The labeled series keeps
+            # old dashboards readable while the PLAIN name now counts
+            # only real evictions (evict_requests / fleet requeue).
+            _obs.counter("serving.evicted_total",
+                         deprecated="retired_alias").add(len(finished))
 
         batch = self.sched.take_admissible(self.cache)
         self._step_no += 1
@@ -292,6 +312,80 @@ class ServingEngine:
                 len(self.sched.active()))
             _obs.gauge("serving.pages_free").set(self.cache.n_free)
         return finished
+
+    # -- fleet surface: eviction + hot weight swap ---------------------------
+    def evict_requests(self) -> List[Request]:
+        """Strip EVERY in-flight request off a TRUSTED engine for
+        exact requeue elsewhere (operational drain before shutdown or
+        handoff — the fleet's failure path instead rebuilds from its
+        own harvested streams, because a wedged engine can't be
+        trusted to report its state). Returns running requests
+        (admission order) then queued ones (FIFO); a running request
+        keeps ``ids``/``pos``/``out``, and because page reservation is
+        whole-lifetime, prompt + emitted tokens fully describe it — no
+        other device state is needed for a bit-identical replay under
+        the f32 greedy parity contract (resume = prefill(prompt +
+        emitted) on the new engine). Pages are freed; increments the
+        REAL ``serving.evicted_total``."""
+        running = list(self.sched.running.values())
+        for r in running:
+            self.cache.free(r.rid)
+        self.sched.running.clear()
+        queued = list(self.sched.queue)
+        self.sched.queue.clear()
+        evicted = running + queued
+        if _obs._enabled and evicted:
+            _obs.counter("serving.evicted_total").add(len(evicted))
+            _obs.gauge("serving.queue_depth").set(0)
+            _obs.gauge("serving.active_slots").set(0)
+            _obs.gauge("serving.pages_free").set(self.cache.n_free)
+        return evicted
+
+    def swap_weights(self, params, cast: bool = True):
+        """Install new weights at a token boundary without draining —
+        the serve half of the train→serve continuous-deployment loop.
+        The engine is host-driven, so any point between ``step()``
+        calls IS a token boundary; running requests keep their pages
+        and simply decode their next token under the new weights.
+
+        Validates treedef + shape/dtype equality against the current
+        snapshot BEFORE flipping, so a swap can never change a program
+        signature: the compiled ladder stays byte-for-byte valid and
+        the RecompileSentinel stays pinned (zero recompiles by
+        construction). ``cast=True`` runs the standby through the
+        engine's serving cast first (pass ``cast=False`` for a pool
+        already cast once and shared across replicas)."""
+        import jax
+        import jax.numpy as jnp
+        new = _cast_params(params, self.config.dtype) if cast else params
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def:
+            raise ValueError(
+                "weight swap rejected: params tree structure differs "
+                "from the serving snapshot (same model family only)")
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            if (tuple(getattr(n, "shape", ())) != tuple(o.shape)
+                    or str(getattr(n, "dtype", "?")) != str(o.dtype)):
+                raise ValueError(
+                    f"weight swap rejected: leaf {i} is "
+                    f"{tuple(getattr(n, 'shape', ()))}/"
+                    f"{getattr(n, 'dtype', '?')}, serving snapshot "
+                    f"holds {tuple(o.shape)}/{o.dtype} — a mismatch "
+                    "would recompile or corrupt the ladder")
+        # normalize AFTER validation: the engine's build-time params
+        # are UNCOMMITTED jax arrays, and commitment is part of the
+        # jit cache key — an orbax-restored leaf arrives COMMITTED to
+        # its device (and a raw numpy leaf is host-side), so flipping
+        # either in directly would RETRACE the whole ladder on the
+        # first post-flip dispatch. The host round-trip yields fresh
+        # uncommitted arrays that hit the existing executables.
+        import numpy as _np
+        self.params = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(_np.asarray(a)), new)
+        if _obs._enabled:
+            _obs.counter("serving.weight_swaps_total").add(1)
+        return self
 
     def _shape_signature(self, prefill_sig, decode_sig):
         """Sentinel signature: the bucket shapes this step dispatched

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["TraceItem", "synthetic_trace", "replay_continuous",
-           "replay_static", "summarize"]
+           "replay_fleet", "replay_static", "summarize"]
 
 
 @dataclass(frozen=True)
@@ -41,6 +41,7 @@ class TraceItem:
     arrival_s: float          # offset from trace start
     ids: np.ndarray           # 1-D int32 prompt
     max_new_tokens: int
+    cls: str = "interactive"  # priority class (fleet replays only)
 
 
 def synthetic_trace(n_requests: int, vocab_size: int, seed: int = 0,
@@ -48,12 +49,21 @@ def synthetic_trace(n_requests: int, vocab_size: int, seed: int = 0,
                     prompt_len_choices: Sequence[int] = (
                         4, 6, 8, 12, 16, 24, 40),
                     new_token_choices: Sequence[int] = (
-                        4, 8, 12, 16, 24, 32)) -> List[TraceItem]:
+                        4, 8, 12, 16, 24, 32),
+                    class_mix: Optional[Dict[str, float]] = None
+                    ) -> List[TraceItem]:
     """Deterministic mixed-length Poisson-ish arrivals: exponential
     inter-arrival times at ``rate_rps``, prompt/new lengths drawn
     uniformly from the choice sets. Same seed -> same trace, so the
-    engine and the static baseline replay identical traffic."""
+    engine and the static baseline replay identical traffic.
+    ``class_mix`` ({class: weight}) tags each request with a priority
+    class for fleet replays (default: all "interactive")."""
     rng = np.random.RandomState(seed)
+    classes, weights = None, None
+    if class_mix:
+        classes = list(class_mix)
+        w = np.asarray([float(class_mix[c]) for c in classes])
+        weights = w / w.sum()
     t = 0.0
     out: List[TraceItem] = []
     for _ in range(int(n_requests)):
@@ -61,7 +71,10 @@ def synthetic_trace(n_requests: int, vocab_size: int, seed: int = 0,
         L = int(rng.choice(list(prompt_len_choices)))
         N = int(rng.choice(list(new_token_choices)))
         ids = rng.randint(0, vocab_size, (L,)).astype(np.int32)
-        out.append(TraceItem(arrival_s=t, ids=ids, max_new_tokens=N))
+        cls = (str(rng.choice(classes, p=weights)) if classes
+               else "interactive")
+        out.append(TraceItem(arrival_s=t, ids=ids, max_new_tokens=N,
+                             cls=cls))
     return out
 
 
@@ -71,6 +84,7 @@ class _Record:
     first_token: float
     done: float
     n_tokens: int
+    cls: Optional[str] = None  # priority class (fleet replays)
 
 
 def _percentiles(vals: Sequence[float]) -> Dict[str, float]:
@@ -99,7 +113,7 @@ def summarize(records: List[_Record]) -> Dict:
     req_tok_ms = [(r.done - r.arrival) * 1e3 / r.n_tokens
                   for r in records]
     span = max(t_end - t_start, 1e-9)
-    return {
+    out = {
         "requests": len(records),
         "total_new_tokens": int(total_tokens),
         "span_s": round(span, 3),
@@ -108,6 +122,15 @@ def summarize(records: List[_Record]) -> Dict:
         "per_token_ms": _percentiles(per_tok_ms),
         "request_ms_per_token": _percentiles(req_tok_ms),
     }
+    classes = sorted({r.cls for r in records if r.cls is not None})
+    if classes:
+        out["per_class_ttft_ms"] = {
+            c: dict(_percentiles(
+                [(r.first_token - r.arrival) * 1e3
+                 for r in records if r.cls == c]),
+                requests=sum(1 for r in records if r.cls == c))
+            for c in classes}
+    return out
 
 
 def replay_continuous(engine, trace: List[TraceItem]) -> Dict:
@@ -141,6 +164,57 @@ def replay_continuous(engine, trace: List[TraceItem]) -> Dict:
     stats["expected_executables"] = engine.expected_executables
     stats["recompile_events"] = engine.sentinel.fired
     return stats
+
+
+def replay_fleet(fleet, trace: List[TraceItem], on_tick=None):
+    """Drive a ``ServingFleet`` through the trace open-loop. Arrivals
+    are submitted with their priority class; shed requests are
+    ACCOUNTED separately (they are an admission-control outcome, not a
+    drop). ``on_tick(tick, fleet)`` runs after every fleet tick — the
+    hook chaos/swap drills use to act mid-load. Returns
+    ``(stats, finished, shed)``: the JSON-safe summarize() stats +
+    fleet receipt summary, and the raw finished / shed FleetRequests
+    for exact-replay verification (kept OUT of the stats dict so no
+    caller can accidentally serialize them)."""
+    t0 = time.perf_counter()
+    next_i = 0
+    finished = []
+    shed = []
+    while next_i < len(trace) or fleet.has_work():
+        now = time.perf_counter() - t0
+        while (next_i < len(trace)
+               and trace[next_i].arrival_s <= now):
+            it = trace[next_i]
+            fr = fleet.submit(it.ids, it.max_new_tokens, cls=it.cls,
+                              arrival=t0 + it.arrival_s)
+            if fr.shed:
+                shed.append(fr)
+            next_i += 1
+        if fleet.has_work():
+            finished.extend(fleet.step())
+            if fleet.wedged:
+                raise RuntimeError(
+                    "replay_fleet: fleet aborted with queued work and "
+                    "zero live replicas (restart budgets exhausted)")
+            if on_tick is not None:
+                on_tick(fleet._tick, fleet)
+        elif next_i < len(trace):
+            time.sleep(max(trace[next_i].arrival_s - now, 0.0))
+    # only truly COMPLETED requests feed the latency stats; a
+    # requeue=False fleet surfaces losses as finish_reason="dropped"
+    # and those must not pose as completions
+    dropped = [fr for fr in finished if fr.finish_reason == "dropped"]
+    records = [
+        _Record(arrival=fr.arrival, first_token=fr.first_token_ts,
+                done=fr.done_ts, n_tokens=len(fr.emitted), cls=fr.cls)
+        for fr in finished
+        if fr.finish_reason in ("length", "eos")
+        and fr.first_token_ts is not None and fr.done_ts is not None]
+    stats = summarize(records)
+    stats["shed"] = len(shed)
+    stats["dropped_requests"] = len(dropped)
+    stats["fleet"] = fleet.summary()
+    return stats, finished, shed
 
 
 def replay_static(model, trace: List[TraceItem], batch_size: int = 4,

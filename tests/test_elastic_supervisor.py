@@ -366,3 +366,106 @@ class TestDoctorVerdictUnits:
             {"divergence": {"possible_skew": [{"gap": 1}],
                             "detail": []}})
         assert v["kind"] == "none"
+
+
+class _SLO:
+    """Duck-typed ServingSLO for the pure decide_scale drills."""
+    def __init__(self, p99=500.0, high=4, low=1):
+        self.p99_ttft_ms = p99
+        self.queue_high = high
+        self.queue_low = low
+
+
+class TestServingScaleMode:
+    """decide_scale: the serving-mode autoscale state machine — pure,
+    canned signals, injected clocks (the fleet integration rides
+    tests/test_serving_fleet.py)."""
+
+    def _policy(self, **kw):
+        kw.setdefault("world", 4)
+        kw.setdefault("initial_world", 2)
+        kw.setdefault("policy", "rank")
+        kw.setdefault("allow_shrink", True)
+        kw.setdefault("scale_cooldown_s", 5.0)
+        return SupervisorPolicy(**kw)
+
+    def test_queue_watermark_scales_up_spare_slot(self):
+        p = self._policy()
+        d = p.decide_scale(_SLO(high=4), queued=9, p99_ttft_ms=10.0,
+                           now=0.0)
+        assert d.action == "scale_up" and d.ranks == [2]
+        assert d.verdict["kind"] == "overload"
+        assert p.active == [0, 1, 2]
+
+    def test_slo_breach_scales_up_even_with_short_queue(self):
+        p = self._policy()
+        d = p.decide_scale(_SLO(p99=100.0), queued=0,
+                           p99_ttft_ms=250.0, now=0.0)
+        assert d.action == "scale_up"
+        assert d.verdict["kind"] == "slo_breach"
+
+    def test_cooldown_blocks_consecutive_scales(self):
+        p = self._policy(scale_cooldown_s=10.0)
+        assert p.decide_scale(_SLO(), 99, 10.0, now=0.0) is not None
+        assert p.decide_scale(_SLO(), 99, 10.0, now=5.0) is None
+        assert p.decide_scale(_SLO(), 99, 10.0, now=10.0) is not None
+
+    def test_restart_window_budget_blocks_scale_up_flap(self):
+        p = self._policy(restart_budget=1, restart_window_s=60.0,
+                         scale_cooldown_s=0.0)
+        p.record_respawn(now=0.0)       # the budget is shared with
+        d = p.decide_scale(_SLO(), 99, 10.0, now=1.0)  # respawns
+        assert d is None
+        d = p.decide_scale(_SLO(), 99, 10.0, now=61.0)
+        assert d is not None and d.action == "scale_up"
+
+    def test_evicted_slot_is_not_reused_for_scale_up(self):
+        p = self._policy(world=3, initial_world=2)
+        p.decide([(1, "exit rc=1")],
+                 {"kind": "crash", "rank": 1, "source": "supervisor",
+                  "evidence": {}}, now=0.0)     # evicts slot 1
+        assert p.active == [0]
+        d = p.decide_scale(_SLO(), 99, 10.0, now=1.0)
+        assert d.ranks == [2]           # the fresh spare, not slot 1
+
+    def test_scale_down_needs_traffic_and_floor(self):
+        p = self._policy(min_world=1, scale_cooldown_s=0.0)
+        # no finished request yet (p99 == -1): never shrink a warming
+        # fleet
+        assert p.decide_scale(_SLO(low=1), 0, -1.0, now=0.0) is None
+        d = p.decide_scale(_SLO(low=1), 0, 50.0, now=1.0)
+        assert d.action == "scale_down" and d.ranks == [1]
+        assert d.verdict["kind"] == "underload"
+        assert p.active == [0]
+        # at the floor: no further shrink
+        assert p.decide_scale(_SLO(low=1), 0, 50.0, now=2.0) is None
+
+    def test_initial_world_bounds_validated(self):
+        with pytest.raises(ValueError, match="initial_world"):
+            SupervisorPolicy(world=2, initial_world=3)
+
+    def test_receipt_extras_land_in_doc(self, tmp_path):
+        doc = elastic.emit_receipt(
+            episode=1, verdict=dict(NONE_V), action="scale_up",
+            ranks=[2], world_before=2, world_after=3,
+            extras={"queued": 9, "p99_ttft_ms": 42.0},
+            out_dir=str(tmp_path))
+        assert doc["extras"] == {"queued": 9, "p99_ttft_ms": 42.0}
+        on_disk = json.load(open(doc["path"]))
+        assert on_disk["extras"]["queued"] == 9
+
+    def test_scale_spawns_do_not_burn_lifetime_crash_budget(self):
+        # 8 healthy traffic waves of scale_up must not erode the
+        # max_restarts abort threshold a real crash loop is measured
+        # against (they DO count toward the per-window budget)
+        p = self._policy(world=10, initial_world=1, max_restarts=3,
+                         scale_cooldown_s=0.0)
+        for i in range(8):
+            d = p.decide_scale(_SLO(high=0), queued=99,
+                               p99_ttft_ms=10.0, now=float(i))
+            assert d is not None and d.action == "scale_up"
+            p.record_scale_spawn(now=float(i))
+        assert p.restarts == 0
+        assert len(p._respawn_ts) == 8      # window budget DID accrue
+        d = p.decide([(0, "exit rc=1")], None, now=100.0)
+        assert d.action != "abort"          # crash budget untouched

@@ -51,6 +51,26 @@ def test_merge_snapshots_pure():
     assert m["only_b"]["value"] == 7
 
 
+def test_merge_partial_skip_and_flag():
+    """A dead/unresponsive source's snapshot (None) is skipped and
+    FLAGGED, never merged as zeros and never able to pose as a full
+    rollup."""
+    from paddle_tpu.observability.fleet import merge_partial
+    a = {"c": {"type": "counter", "value": 10},
+         "g": {"type": "gauge", "value": 1.0}}
+    b = {"c": {"type": "counter", "value": 5},
+         "g": {"type": "gauge", "value": 3.0}}
+    m = merge_partial([a, None, b])
+    assert m["c"]["value"] == 15
+    assert m["g"]["value"] == pytest.approx(2.0)
+    assert m["fleet.sources_reporting"]["value"] == 2
+    assert m["fleet.sources_skipped"]["value"] == 1
+    # all dead: still a well-formed (empty) rollup, fully flagged
+    m0 = merge_partial([None, None])
+    assert m0["fleet.sources_reporting"]["value"] == 0
+    assert m0["fleet.sources_skipped"]["value"] == 2
+
+
 def test_aggregate_single_process():
     from paddle_tpu.observability import fleet, metrics
     metrics.clear()

@@ -308,3 +308,102 @@ class TestGraphLintDonation:
                                config=lint_cfg)
         findings = run_rules(audit_p, only=["donation"])
         assert findings == [], [f.message for f in findings]
+
+
+class TestRetiredEvictedCounters:
+    def test_retire_counts_retired_not_evicted(self, model):
+        """The satellite fix: finishing a request increments
+        serving.retired_total; the PLAIN serving.evicted_total stays
+        zero until a real eviction. The old conflation survives one
+        release as the labeled deprecated alias."""
+        from paddle_tpu.observability import metrics
+        eng = ServingEngine(model, f32_config())
+        rng = np.random.RandomState(11)
+        p = rng.randint(0, 97, (4,)).astype(np.int32)
+        with metrics.enabled_scope(True):
+            metrics.reset(prefix="serving.")
+            eng.generate_tokens([p], [3])
+            assert metrics.get("serving.retired_total").value() == 1
+            evicted = metrics.get("serving.evicted_total")
+            assert evicted is None or evicted.value() == 0
+            alias = metrics.get("serving.evicted_total",
+                                deprecated="retired_alias")
+            assert alias is not None and alias.value() == 1
+
+    def test_evict_requests_counts_and_frees(self, model):
+        from paddle_tpu.observability import metrics
+        eng = ServingEngine(model, f32_config())
+        rng = np.random.RandomState(12)
+        prompts = [rng.randint(0, 97, (4,)).astype(np.int32)
+                   for _ in range(3)]
+        with metrics.enabled_scope(True):
+            metrics.reset(prefix="serving.")
+            for p in prompts:
+                eng.submit(p, 6)
+            eng.step()          # admit 2 (max_admit), 1 stays queued
+            evicted = eng.evict_requests()
+            assert metrics.get("serving.evicted_total").value() == 3
+            assert metrics.get("serving.retired_total").value() == 0
+        assert len(evicted) == 3
+        # running first (with emitted state), then queued
+        assert len(evicted[0].out) >= 1
+        assert evicted[2].out == []
+        # all pages back, scheduler empty
+        assert eng.cache.n_free == eng.cache.n_blocks - 1
+        assert not eng.has_work()
+        eng.cache.check_invariants()
+
+    def test_evicted_request_resumes_exactly(self, model):
+        """Single-engine replay contract: prefill(prompt + emitted)
+        continues the stream bit-identically (the fleet requeue math,
+        provable without a fleet)."""
+        eng = ServingEngine(model, f32_config()).warmup()
+        rng = np.random.RandomState(13)
+        p = rng.randint(0, 97, (5,)).astype(np.int32)
+        eng.submit(p, 8)
+        eng.step()
+        eng.step()
+        (r,) = eng.evict_requests()
+        k = len(r.out)
+        assert 1 <= k < 8
+        resumed_ids = np.concatenate(
+            [p, np.asarray(r.out, np.int32)])
+        eng.submit(resumed_ids, 8 - k)
+        done = eng.run_to_completion()
+        suffix = done[-1].out
+        full = list(r.out) + list(suffix)
+        np.testing.assert_array_equal(
+            np.asarray(full), solo_greedy(model, p, 8))
+
+
+class TestHotWeightSwap:
+    def test_same_weights_swap_mid_stream_is_identity(self, model):
+        """Flip at a token boundary mid-decode: same weights => same
+        stream, zero sentinel events, executable count pinned."""
+        eng = ServingEngine(model, f32_config()).warmup()
+        rng = np.random.RandomState(14)
+        p = rng.randint(0, 97, (5,)).astype(np.int32)
+        from paddle_tpu.models.generation import _gpt_params
+        eng.submit(p, 8)
+        eng.step()
+        eng.step()
+        eng.swap_weights(_gpt_params(model))    # token boundary
+        done = eng.run_to_completion()
+        np.testing.assert_array_equal(
+            np.asarray(done[-1].out), solo_greedy(model, p, 8))
+        assert eng.sentinel.fired == 0
+        assert eng.executable_count() == eng.expected_executables
+
+    def test_shape_mismatch_rejected_before_flip(self, model):
+        import paddle_tpu as paddle
+        paddle.seed(15)
+        other = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0, use_flash_attention=False))
+        other.eval()
+        eng = ServingEngine(model, f32_config())
+        old = eng.params
+        from paddle_tpu.models.generation import _gpt_params
+        with pytest.raises(ValueError, match="swap rejected"):
+            eng.swap_weights(_gpt_params(other))
+        assert eng.params is old

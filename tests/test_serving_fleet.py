@@ -1,0 +1,551 @@
+"""SLO-aware self-healing serving fleet (paddle_tpu.serving.fleet):
+the PR 11 robustness contracts, no subprocesses (in-process replicas,
+deterministic faults).
+
+Receipts pinned here:
+- EXACT requeue: a request evicted at token k (replica killed
+  mid-decode) resumes on another replica and the stitched stream is
+  BIT-IDENTICAL to an uninterrupted engine run (f32 greedy parity) —
+  the satellite's staggered-admission replay bar;
+- a wedged (stalled) replica is evicted by the progress clock with a
+  ``hang`` verdict and its work requeued — zero drops either way;
+- fleet rollup tolerates a dead AND an unresponsive replica
+  (skip-and-flag within the snapshot timeout, never a hang) — the
+  1-dead-of-3 satellite;
+- priority classes: interactive dispatches ahead of batch, overload
+  sheds ONLY the lowest class, per-class TTFT histograms exist;
+- supervisor serving mode scales up on queue pressure and drains on
+  idle, with remediation receipts for every episode;
+- hot weight swap under load: flips at token boundaries, zero
+  recompiles, zero drops, same-weights swap leaves greedy outputs
+  bit-identical; a corrupted standby ABORTS the swap.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (FleetConfig, ServingConfig,
+                                ServingEngine, ServingFleet,
+                                ServingSLO)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def f32_config(**kw):
+    # requeue-capable ladder: the largest prefill bucket covers every
+    # resumable prefix (max_total - 1)
+    base = dict(max_slots=4, max_admit=2, block_size=4, n_blocks=48,
+                prefill_buckets=(24,), max_total_tokens=24,
+                decode_chunk=2, dtype=None)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def fleet_config(tmp_path, **kw):
+    base = dict(replicas=2, min_replicas=1, max_replicas=2,
+                autoscale=False, backoff_base=0.0,
+                receipts_dir=str(tmp_path))
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def solo_reference(model, prompts, budgets):
+    """Uninterrupted run of the same engine shape — the replay bar."""
+    ref = ServingEngine(model, f32_config()).warmup()
+    return ref.generate_tokens(prompts, budgets)
+
+
+class TestExactRequeue:
+    def test_kill_mid_decode_replays_bit_identical(self, model,
+                                                   tmp_path):
+        """Staggered admission, then kill the replica serving a
+        request that already emitted >= 2 tokens: the request resumes
+        elsewhere and every output is bit-identical to an
+        uninterrupted run."""
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path))
+        rng = np.random.RandomState(1)
+        specs = [(7, 8), (3, 6), (11, 5), (2, 7)]
+        prompts = [rng.randint(0, 97, (L,)).astype(np.int32)
+                   for L, _ in specs]
+        frs = [fl.submit(p, n) for p, (_, n) in zip(prompts, specs)]
+        done = []
+        for _ in range(3):
+            done.extend(fl.step())
+        target = next(fr for fr in frs
+                      if len(fr.emitted) >= 2
+                      and fr.replica is not None)
+        k = len(target.emitted)
+        slot = target.replica
+        fl.kill_replica(slot)
+        done.extend(fl.run_until_drained())
+        assert len(done) == 4
+        assert target.evictions == 1
+        # the resumed suffix continued from token k, not from scratch
+        assert len(target.emitted) >= k
+        outs = solo_reference(model, prompts,
+                              [n for _, n in specs])
+        for fr, o in zip(frs, outs):
+            assert list(fr.emitted) == [int(t) for t in o], fr.rid
+        assert fl.requeued_total >= 1
+        assert fl.recompile_events() == 0
+        # the remediation receipt names the evicted replica
+        ep = fl.episodes[0]
+        assert ep["action"] == "evict_shrink"
+        assert ep["ranks"] == [slot]
+        assert ep["verdict"]["kind"] == "crash"
+        assert ep["verdict"]["rank"] == slot
+        assert ep["extras"]["requeued"] >= 1
+        assert os.path.exists(ep["path"])
+
+    def test_queued_requests_on_dead_replica_requeue_too(self, model,
+                                                         tmp_path):
+        """Requests dispatched to a replica's local queue (not yet
+        admitted) survive its death: they re-enter the central queue
+        with an untouched budget."""
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 97, (4,)).astype(np.int32)
+                   for _ in range(5)]
+        frs = [fl.submit(p, 4) for p in prompts]
+        fl.step()          # dispatch + admit some; others local-queued
+        fl.kill_replica(0)
+        done = fl.run_until_drained()   # respawn_rank at min_world
+        assert len(done) == 5
+        outs = solo_reference(model, prompts, [4] * 5)
+        for fr, o in zip(frs, outs):
+            assert list(fr.emitted) == [int(t) for t in o]
+        # at the min_world floor the policy rebuilds the replica
+        assert fl.episodes[0]["action"] == "respawn_rank"
+        assert fl.live_replicas() == [0]
+
+    def test_requeue_validation_at_build(self, model):
+        """A ladder that cannot serve every resumable prefix is
+        rejected at fleet build (an eviction would wedge a request)."""
+        with pytest.raises(ValueError, match="resumable prefix"):
+            ServingFleet(
+                model,
+                f32_config(prefill_buckets=(8, 16),
+                           max_total_tokens=24),
+                ServingSLO(), FleetConfig(replicas=1, max_replicas=1))
+
+
+class TestStallEviction:
+    def test_stalled_replica_evicted_with_hang_verdict(self, model,
+                                                       tmp_path):
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, stall_ticks=3))
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 97, (5,)).astype(np.int32)
+                   for _ in range(4)]
+        frs = [fl.submit(p, 5) for p in prompts]
+        fl.step()
+        stalled = next(fr.replica for fr in frs
+                       if fr.replica is not None)
+        fl.stall_replica(stalled, seconds=600.0)
+        done = fl.run_until_drained()
+        assert len(done) == 4
+        outs = solo_reference(model, prompts, [5] * 4)
+        for fr, o in zip(frs, outs):
+            assert list(fr.emitted) == [int(t) for t in o]
+        ep = fl.episodes[0]
+        assert ep["verdict"]["kind"] == "hang"
+        assert ep["ranks"] == [stalled]
+
+
+class TestPartialRollup:
+    def test_one_dead_of_three_skips_and_flags(self, model, tmp_path):
+        """The satellite bar: a dead replica must not hang or fail the
+        fleet rollup — skip-and-flag."""
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=3,
+                                       max_replicas=3))
+        fl.kill_replica(1)       # dead, not yet remediated
+        m = fl.aggregate(timeout_s=1.0)
+        assert m["fleet.sources_reporting"]["value"] == 2
+        assert m["fleet.sources_skipped"]["value"] == 1
+        # the live replicas' counters still merged
+        assert m["serving.replica.executables"]["sum"] == 4
+
+    def test_unresponsive_snapshot_times_out_not_hangs(self, model,
+                                                       tmp_path):
+        import time as _time
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path))
+        rep = fl._replicas[1]
+        rep.snapshot = lambda: _time.sleep(30.0)  # wedged replica
+        t0 = _time.perf_counter()
+        m = fl.aggregate(timeout_s=0.2)
+        assert _time.perf_counter() - t0 < 5.0
+        assert m["fleet.sources_reporting"]["value"] == 1
+        assert m["fleet.sources_skipped"]["value"] == 1
+
+
+class TestPriorityClasses:
+    def test_interactive_dispatches_before_earlier_batch(self, model,
+                                                         tmp_path):
+        fl = ServingFleet(model, f32_config(max_admit=1, max_slots=1),
+                          ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        rng = np.random.RandomState(4)
+        lo = fl.submit(rng.randint(0, 97, (4,)).astype(np.int32), 3,
+                       cls="batch")
+        hi = fl.submit(rng.randint(0, 97, (4,)).astype(np.int32), 3,
+                       cls="interactive")
+        done = fl.run_until_drained()
+        order = [fr.rid for fr in done]
+        assert order.index(hi.rid) < order.index(lo.rid)
+
+    def test_overload_sheds_only_batch_and_accounts_it(self, model,
+                                                       tmp_path):
+        fl = ServingFleet(model, f32_config(),
+                          ServingSLO(shed_queue_depth=2),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        rng = np.random.RandomState(5)
+        p = rng.randint(0, 97, (4,)).astype(np.int32)
+        with metrics.enabled_scope(True):
+            metrics.reset(prefix="serving.")
+            batch = [fl.submit(p, 3, cls="batch") for _ in range(5)]
+            inter = [fl.submit(p, 3, cls="interactive")
+                     for _ in range(5)]
+            done = fl.run_until_drained()
+        shed = [fr for fr in batch if fr.shed]
+        assert len(shed) == 3            # beyond depth 2: shed
+        assert all(fr.finish_reason == "shed" for fr in shed)
+        assert not any(fr.shed for fr in inter)
+        assert len(done) == 7            # 5 interactive + 2 batch
+        assert fl.shed_total == 3
+        c = metrics.get("serving.fleet.shed_total", cls="batch")
+        assert c is not None and c.value() == 3
+        # per-class TTFT histograms exist for both classes
+        for cls in ("interactive", "batch"):
+            h = metrics.get("serving.fleet.ttft_ms", cls=cls)
+            assert h is not None and h.count() > 0
+
+    def test_unknown_class_rejected(self, model, tmp_path):
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        with pytest.raises(ValueError, match="priority class"):
+            fl.submit(np.ones(4, np.int32), 2, cls="bulk")
+
+
+class TestAutoscale:
+    def test_scale_up_on_queue_pressure_with_receipt(self, model,
+                                                     tmp_path):
+        fl = ServingFleet(
+            model, f32_config(),
+            ServingSLO(queue_high=2, queue_low=0),
+            fleet_config(tmp_path, replicas=1, max_replicas=2,
+                         autoscale=True, scale_cooldown_s=0.0))
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(0, 97, (4,)).astype(np.int32)
+                   for _ in range(8)]
+        frs = [fl.submit(p, 4) for p in prompts]
+        done = fl.run_until_drained()
+        assert len(done) == 8
+        assert any(e["action"] == "scale_up" for e in fl.episodes)
+        up = next(e for e in fl.episodes if e["action"] == "scale_up")
+        assert up["verdict"]["kind"] in ("overload", "slo_breach")
+        assert up["ranks"] == [1]
+        outs = solo_reference(model, prompts, [4] * 8)
+        for fr, o in zip(frs, outs):
+            assert list(fr.emitted) == [int(t) for t in o]
+
+    def test_scale_down_drains_gracefully(self, model, tmp_path):
+        fl = ServingFleet(
+            model, f32_config(),
+            ServingSLO(queue_high=100, queue_low=1),
+            fleet_config(tmp_path, replicas=2, max_replicas=2,
+                         autoscale=True, scale_cooldown_s=0.0))
+        rng = np.random.RandomState(7)
+        frs = [fl.submit(rng.randint(0, 97, (4,)).astype(np.int32), 4)
+               for _ in range(3)]
+        done = fl.run_until_drained()
+        for _ in range(3):
+            fl.step()       # idle ticks: a real fleet keeps ticking
+        assert len(done) == 3
+        assert all(fr.evictions == 0 for fr in frs)   # drained, not
+        assert any(e["action"] == "scale_down"        # evicted
+                   for e in fl.episodes)
+        assert fl.live_replicas() == [0]
+
+
+class TestHotSwap:
+    def test_swap_under_load_zero_recompiles_zero_drops(self, model,
+                                                        tmp_path):
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path))
+        rng = np.random.RandomState(8)
+        prompts = [rng.randint(0, 97, (L,)).astype(np.int32)
+                   for L in (5, 3, 7, 4)]
+        frs = [fl.submit(p, 6) for p in prompts]
+        for _ in range(2):
+            fl.step()
+        assert fl.swap_weights(model) is True   # same weights
+        done = fl.run_until_drained()
+        while fl._standby is not None:          # finish pending flips
+            fl.step()
+        assert len(done) == 4
+        assert fl.swaps_total == 1
+        assert fl.recompile_events() == 0
+        outs = solo_reference(model, prompts, [6] * 4)
+        for fr, o in zip(frs, outs):
+            assert list(fr.emitted) == [int(t) for t in o]
+        assert any(e["action"] == "weight_swap" for e in fl.episodes)
+
+    def test_corrupt_standby_aborts_swap(self, model, tmp_path):
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path))
+        fl._swap_sabotage = True     # what corrupt_swap chaos arms
+        old = fl._replicas[0].engine.params
+        assert fl.swap_weights(model) is False
+        assert fl.swaps_aborted == 1
+        assert fl._standby is None
+        assert fl._replicas[0].engine.params is old  # old pool serves
+        ep = fl.episodes[-1]
+        assert ep["action"] == "swap_aborted"
+        assert ep["verdict"]["kind"] == "corrupt_standby"
+
+    def test_mismatched_swap_rejected_by_engine(self, model, tmp_path):
+        paddle.seed(9)
+        other = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0, use_flash_attention=False))
+        other.eval()
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        with pytest.raises(ValueError, match="swap rejected"):
+            fl._replicas[0].engine.swap_weights(other)
+
+
+class TestChaosHooks:
+    def test_serving_chaos_kill_fires_on_named_tick(self, model,
+                                                    tmp_path,
+                                                    monkeypatch):
+        from paddle_tpu.distributed import chaos
+        monkeypatch.setenv("PD_CHAOS_MODE", "kill")
+        monkeypatch.setenv("PD_CHAOS_STEP", "2")
+        monkeypatch.setenv("PD_CHAOS_RANK", "1")
+        chaos.reset_plan_cache()
+        try:
+            fl = ServingFleet(model, f32_config(), ServingSLO(),
+                              fleet_config(tmp_path))
+            rng = np.random.RandomState(10)
+            frs = [fl.submit(rng.randint(0, 97, (4,)).astype(np.int32),
+                             4) for _ in range(4)]
+            done = fl.run_until_drained()
+        finally:
+            chaos.reset_plan_cache()
+        assert len(done) == 4
+        assert any(e["ranks"] == [1] and e["verdict"]["kind"] ==
+                   "crash" for e in fl.episodes)
+
+    def test_training_inject_ignores_serving_only_mode(self,
+                                                       monkeypatch):
+        from paddle_tpu.distributed import chaos
+        monkeypatch.setenv("PD_CHAOS_MODE", "corrupt_swap")
+        monkeypatch.setenv("PD_CHAOS_STEP", "0")
+        monkeypatch.setenv("PD_CHAOS_RANK", "0")
+        chaos.reset_plan_cache()
+        try:
+            # must NOT fall through to the 600 s stall branch
+            assert chaos.maybe_inject(0, rank=0, incarnation=0) is None
+            assert chaos.maybe_inject_serving(0, 0) == "corrupt_swap"
+        finally:
+            chaos.reset_plan_cache()
+
+
+class TestReviewHardening:
+    """Regression tests for the review findings — each was a real
+    contract break found by tracing the control loop."""
+
+    def test_scale_up_into_draining_slot_cancels_drain(self, model,
+                                                       tmp_path):
+        """A load spike right after a scale_down must not spawn OVER
+        the still-draining replica (its in-flight requests would be
+        orphaned) — the drain is cancelled instead."""
+        fl = ServingFleet(
+            model, f32_config(),
+            ServingSLO(queue_high=1, queue_low=1),
+            fleet_config(tmp_path, replicas=2, max_replicas=2,
+                         autoscale=True, scale_cooldown_s=0.0))
+        rng = np.random.RandomState(20)
+        p = rng.randint(0, 97, (4,)).astype(np.int32)
+        first = [fl.submit(p, 12) for _ in range(2)]
+        done = [*fl.step()]        # one long request on each replica
+        # the scale_down shape, pinned while slot 1 is still BUSY
+        fl.policy.active.remove(1)
+        fl.drain_replica(1)
+        draining_rep = fl._replicas[1]
+        assert draining_rep.engine.has_work()
+        burst = [fl.submit(p, 4) for _ in range(8)]    # load spike
+        done.extend(fl.run_until_drained())
+        assert len(done) == 10
+        up = [e for e in fl.episodes if e["action"] == "scale_up"]
+        assert up and "drain cancelled" in up[0]["reason"]
+        # the SAME replica object served on — never overwritten (a
+        # later idle tick may legitimately re-drain it)
+        assert fl._replicas.get(1) is draining_rep or \
+            1 not in fl._replicas
+        outs = solo_reference(model, [p] * 10, [12, 12] + [4] * 8)
+        for fr, o in zip(first + burst, outs):
+            assert list(fr.emitted) == [int(t) for t in o]
+
+    def test_draining_replica_death_still_requeues(self, model,
+                                                   tmp_path):
+        """A draining slot is outside policy.active, but its death
+        must still be detected and its in-flight requests requeued —
+        zero drops."""
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=2,
+                                       max_replicas=2))
+        rng = np.random.RandomState(21)
+        prompts = [rng.randint(0, 97, (4,)).astype(np.int32)
+                   for _ in range(4)]
+        frs = [fl.submit(p, 8) for p in prompts]
+        done = [*fl.step()]
+        victim = next(fr.replica for fr in frs
+                      if fr.replica is not None)
+        fl.drain_replica(victim)
+        fl.policy.active = [s for s in fl.policy.active
+                            if s != victim]      # scale_down shape
+        done.extend(fl.step())
+        fl.kill_replica(victim)
+        done.extend(fl.run_until_drained())
+        assert len(done) == 4
+        outs = solo_reference(model, prompts, [8] * 4)
+        for fr, o in zip(frs, outs):
+            assert list(fr.emitted) == [int(t) for t in o]
+        assert any(e["verdict"]["kind"] == "crash"
+                   and victim in e["ranks"] for e in fl.episodes)
+
+    def test_respawn_after_completed_swap_serves_new_weights(
+            self, model, tmp_path):
+        """A replica rebuilt AFTER a completed hot swap must serve the
+        swapped snapshot, not the build-time one (the deployment must
+        not silently revert)."""
+        paddle.seed(31)
+        other = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0, use_flash_attention=False))
+        other.eval()
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        assert fl.swap_weights(other) is True
+        while fl._standby is not None:
+            fl.step()                      # complete the flip
+        fl.kill_replica(0)
+        fl.step()                          # respawn_rank rebuilds it
+        rng = np.random.RandomState(22)
+        p = rng.randint(0, 97, (5,)).astype(np.int32)
+        fr = fl.submit(p, 6)
+        fl.run_until_drained()
+        ref = ServingEngine(other, f32_config()).warmup()
+        (expect,) = ref.generate_tokens([p], [6])
+        assert list(fr.emitted) == [int(t) for t in expect]
+
+    def test_requeue_disabled_surfaces_drops(self, model, tmp_path):
+        """FleetConfig(requeue=False): an eviction's losses complete
+        as finish_reason='dropped' through step() and are counted —
+        never leaked in _by_rid."""
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1, requeue=False))
+        rng = np.random.RandomState(23)
+        frs = [fl.submit(rng.randint(0, 97, (4,)).astype(np.int32), 8)
+               for _ in range(2)]
+        with metrics.enabled_scope(True):
+            metrics.reset(prefix="serving.")
+            fl.step()
+            fl.kill_replica(0)
+            done = fl.run_until_drained()
+            c = metrics.get("serving.fleet.dropped_total",
+                            cls="interactive")
+            assert c is not None and c.value() == 2
+        dropped = [fr for fr in done if fr.finish_reason == "dropped"]
+        assert len(dropped) == 2
+        assert all(fr.done_ts is not None for fr in dropped)
+        assert fl._by_rid == {}
+
+    def test_wedged_fleet_raises_not_spins(self, model, tmp_path):
+        """Restart budget exhausted with queued work and zero live
+        replicas: the drive loops must raise the diagnostic error,
+        never spin forever."""
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1, max_restarts=0))
+        rng = np.random.RandomState(24)
+        fl.submit(rng.randint(0, 97, (4,)).astype(np.int32), 4)
+        fl.step()
+        fl.kill_replica(0)
+        with pytest.raises(RuntimeError, match="zero live replicas"):
+            fl.run_until_drained()
+        assert fl.wedged
+
+    def test_incompatible_swap_raises_at_stage_time(self, model,
+                                                    tmp_path):
+        """A wrong-model standby must raise AT the swap_weights call
+        (caller bug, synchronous), never blow up the control loop
+        ticks later inside the flip."""
+        paddle.seed(41)
+        other = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0, use_flash_attention=False))
+        other.eval()
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        rng = np.random.RandomState(42)
+        fr = fl.submit(rng.randint(0, 97, (4,)).astype(np.int32), 4)
+        with pytest.raises(ValueError, match="swap rejected"):
+            fl.swap_weights(other)
+        assert fl._standby is None          # nothing staged
+        fl.run_until_drained()              # control loop unharmed
+        (expect,) = solo_reference(
+            model, [np.asarray(fr.ids)], [4])
+        assert list(fr.emitted) == [int(t) for t in expect]
+
+    def test_swap_from_checkpoint_wrapper_unwraps(self, model,
+                                                  tmp_path):
+        """The async-checkpoint plane writes {'params': ...}; the
+        fleet's checkpoint_path= surface must unwrap it and flip
+        cleanly (this path crashed the control loop before)."""
+        import os as _os
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.models.generation import _gpt_params
+        path = _os.path.join(str(tmp_path), "weights")
+        ckpt.save_sharded({"params": _gpt_params(model)}, path)
+        fl = ServingFleet(model, f32_config(), ServingSLO(),
+                          fleet_config(tmp_path, replicas=1,
+                                       max_replicas=1))
+        assert fl.swap_weights(checkpoint_path=path) is True
+        while fl._standby is not None:
+            fl.step()
+        assert fl.swaps_total == 1
+        assert fl.recompile_events() == 0
+        rng = np.random.RandomState(43)
+        p = rng.randint(0, 97, (5,)).astype(np.int32)
+        fr = fl.submit(p, 5)
+        fl.run_until_drained()
+        (expect,) = solo_reference(model, [p], [5])
+        assert list(fr.emitted) == [int(t) for t in expect]

@@ -80,54 +80,39 @@ def run_engine_leg(model, args, trace):
 
 
 def run_replicated(model, args, trace):
-    """--replicas N: N engines, trace sharded round-robin, stepped
-    cooperatively in one process. Exercises the per-replica serving.*
-    rollup through fleet.merge_snapshots; throughput is still ONE
-    host's worth of compute."""
-    from paddle_tpu.observability import fleet, metrics
-    from paddle_tpu.serving import ServingEngine
-    from paddle_tpu.serving.loadgen import _Record, summarize
+    """--replicas N: one ServingFleet of N replicas behind the central
+    priority queue (the PR 11 control loop with autoscale/chaos off —
+    a static fleet is just its degenerate mode). Exercises fleet
+    dispatch, the per-replica snapshot rollup (skip-and-flag via
+    ``ServingFleet.aggregate``), and the pod-shape registry rollup;
+    throughput is still ONE host's worth of compute."""
+    from paddle_tpu.observability import fleet as obs_fleet
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import FleetConfig, ServingFleet
+    from paddle_tpu.serving.loadgen import replay_fleet
 
-    shards = [trace[i::args.replicas] for i in range(args.replicas)]
-    engines = []
-    for _ in range(args.replicas):
-        engines.append(ServingEngine(model,
-                                     serving_config(args)).warmup())
-    t0 = time.perf_counter()
-    nxt = [0] * args.replicas
-    recs = []
-    per_replica_done = [0] * args.replicas
-    while any(n < len(s) for n, s in zip(nxt, shards)) \
-            or any(e.has_work() for e in engines):
-        now = time.perf_counter() - t0
-        idle = True
-        for ri, (eng, shard) in enumerate(zip(engines, shards)):
-            while nxt[ri] < len(shard) \
-                    and shard[nxt[ri]].arrival_s <= now:
-                it = shard[nxt[ri]]
-                eng.submit(it.ids, it.max_new_tokens,
-                           arrival=t0 + it.arrival_s)
-                nxt[ri] += 1
-            if eng.has_work():
-                idle = False
-                for r in eng.step():
-                    per_replica_done[ri] += 1
-                    recs.append(_Record(
-                        arrival=r.arrival,
-                        first_token=r.first_token_ts,
-                        done=r.done_ts, n_tokens=len(r.out)))
-        if idle:
-            time.sleep(0.0005)
-    stats = summarize(recs)
+    fl = ServingFleet(
+        model, serving_config(args),
+        fleet=FleetConfig(replicas=args.replicas, min_replicas=1,
+                          max_replicas=args.replicas, autoscale=False,
+                          # the bench ladder need not cover every
+                          # resumable prefix: no chaos, no requeue
+                          requeue=False))
+    stats, _finished, _shed = replay_fleet(fl, trace)
+    summ = stats.pop("fleet")
     stats["replicas"] = args.replicas
-    stats["per_replica_requests"] = per_replica_done
-    stats["recompile_events"] = sum(e.sentinel.fired for e in engines)
-    stats["executables"] = sum(e.executable_count() for e in engines)
-    stats["expected_executables"] = sum(e.expected_executables
-                                        for e in engines)
-    # pod-rollup shape over the shared registry (single host here;
-    # identical call under jax.distributed on a real fleet)
-    merged = fleet.aggregate(metrics.snapshot(prefix="serving."))
+    stats["per_replica_requests"] = [
+        fl._replicas[s].finished_total for s in sorted(fl._replicas)]
+    stats["recompile_events"] = summ["recompile_events"]
+    stats["executables"] = summ["executables"]
+    stats["expected_executables"] = summ["expected_executables"]
+    # per-replica snapshot rollup (dead replicas skip-and-flag)...
+    replica_rollup = fl.aggregate()
+    stats["replicas_reporting"] = \
+        replica_rollup["fleet.sources_reporting"]["value"]
+    # ...and the pod-rollup shape over the shared registry (identical
+    # call under jax.distributed on a real multi-host fleet)
+    merged = obs_fleet.aggregate(metrics.snapshot(prefix="serving."))
     stats["fleet_rollup_keys"] = len(merged)
     return stats
 
