@@ -282,7 +282,8 @@ class SupervisorPolicy:
 
     # -- serving mode --------------------------------------------------------
     def decide_scale(self, slo, queued: int, p99_ttft_ms: float,
-                     now: Optional[float] = None) -> Optional[Decision]:
+                     now: Optional[float] = None,
+                     burn_alert: bool = False) -> Optional[Decision]:
         """SERVING-mode autoscale: one scale decision from the
         ``serving.*`` signals the fleet publishes every tick. Pure —
         the fleet applies the Decision (spawn the slot on ``scale_up``,
@@ -303,6 +304,12 @@ class SupervisorPolicy:
           arrivals is not shrunk to the floor, and never drops below
           `min_world`. The highest live slot drains (stable low slots
           keep their warm engines).
+        - ``burn_alert`` is the FORWARD-LOOKING trigger: the fleet's
+          multi-window SLO error-budget burn (reqtrace.BurnMeter) says
+          the budget is being spent faster than it accrues, even when
+          the instantaneous p99 has recovered. It scales up like a
+          breach and vetoes scale_down (never shrink while the budget
+          burns).
         """
         now = time.monotonic() if now is None else now
         if (self._last_scale is not None
@@ -312,7 +319,8 @@ class SupervisorPolicy:
         slo_p99 = float(getattr(slo, "p99_ttft_ms", 0.0) or 0.0)
         breach = slo_p99 > 0 and p99_ttft_ms > slo_p99
         hot = queued > int(slo.queue_high) * max(1, live)
-        if (hot or breach) and live < self.world:
+        burn = bool(burn_alert)
+        if (hot or breach or burn) and live < self.world:
             if self.restart_budget:
                 recent = [t for t in self._respawn_ts
                           if now - t <= self.restart_window_s]
@@ -330,16 +338,21 @@ class SupervisorPolicy:
             reason = (f"p99 TTFT {p99_ttft_ms:.0f}ms > SLO "
                       f"{slo_p99:.0f}ms" if breach else
                       f"queued {queued} > {slo.queue_high}/replica "
-                      f"x {live}")
+                      f"x {live}" if hot else
+                      "SLO error budget fast-burning across every "
+                      "window (burn rate > 1)")
+            kind = ("slo_breach" if breach
+                    else "overload" if hot else "budget_burn")
             return Decision(
                 "scale_up", ranks=[slot], episode=self.episode,
                 reason=reason,
-                verdict={"kind": "slo_breach" if breach else "overload",
+                verdict={"kind": kind,
                          "rank": None, "source": "serving_policy",
                          "evidence": {"queued": int(queued),
                                       "p99_ttft_ms": float(p99_ttft_ms),
+                                      "burn_alert": burn,
                                       "live": live}})
-        if (not hot and not breach and p99_ttft_ms >= 0
+        if (not hot and not breach and not burn and p99_ttft_ms >= 0
                 and live > self.min_world
                 and queued <= int(slo.queue_low) * live):
             slot = max(self.active)

@@ -36,6 +36,11 @@ TPU-pod training job needs on top of raw counters:
                    device kernels back to scopes — per-scope device ms,
                    idle time, and the comm-overlap receipt
                    (comm.overlap_fraction)
+  reqtrace         request anatomy: per-request span timelines from the
+                   serving fleet (queue/admission/prefill/decode/
+                   requeue/swap_flip), the explain_tail attribution
+                   engine, chrome-trace request lanes, and the SLO
+                   error-budget BurnMeter
 
 Everything is off by default: `metrics.enable()` turns the counter hot
 paths on, `flight_recorder.enable()` arms the forensics plane (events +
@@ -51,6 +56,7 @@ from . import xprof  # noqa: F401
 from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import flight_recorder  # noqa: F401
+from . import reqtrace  # noqa: F401
 from . import mfu  # noqa: F401
 from . import sentinel  # noqa: F401
 from . import watchdog  # noqa: F401
@@ -64,6 +70,7 @@ from .watchdog import HangWatchdog  # noqa: F401
 __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
     "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
+    "reqtrace",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",

@@ -162,15 +162,29 @@ def _metric_marks():
         return []
 
 
+def _reqtrace_lanes():
+    """Serving request lanes (observability.reqtrace): one lane per
+    replica, spans colored by latency component. Empty when request
+    tracing is off — a training process must not pay a ring scan."""
+    try:
+        from ..observability import reqtrace
+        if not reqtrace.enabled():
+            return []
+        return reqtrace.chrome_trace_events()
+    except Exception:
+        return []
+
+
 def export_chrome_tracing(path: str):
     """Write chrome://tracing JSON (tools/timeline.py analogue). Metric
     values from the observability registry ride along as counter
-    ("ph":"C") events on the same timeline."""
+    ("ph":"C") events, and serving request lanes (reqtrace spans, one
+    lane per replica) merge onto the same timeline."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     out = path if path.endswith(".json") else path + ".json"
-    marks = _metric_marks()
+    marks = _metric_marks() + _reqtrace_lanes()
     if _native is not None:
         if _native.pd_prof_dump(out.encode()) != 0:
             raise OSError(f"cannot write trace to {out}")

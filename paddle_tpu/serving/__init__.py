@@ -29,6 +29,16 @@ Multi-replica serving runs through the fleet; per-replica snapshots
 roll up skip-and-flag (a dead replica can't hang the gather) and the
 shared serving.* metrics ride observability.fleet.aggregate() like
 every other subsystem.
+
+Request anatomy (observability.reqtrace, DESIGN.md "Request
+anatomy"): scheduler/engine/fleet emit per-request spans at the token
+boundaries they own (class-queue wait, admission, prefill bucket,
+decode chunk with replica+tick, requeue hop, swap-flip pause) behind
+one module bool; `explain_tail` attributes a p99-cohort request's
+latency to components summing to ~1.0, the SLO error-budget BurnMeter
+feeds `decide_scale(burn_alert=)`, and
+tpu_doctor.serving_breach_verdict names a breach's cause from the
+trace alone.
 """
 from .engine import ServingConfig, ServingEngine
 from .fleet import (FleetConfig, FleetRequest, PRIORITY_CLASSES,

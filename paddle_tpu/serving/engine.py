@@ -45,6 +45,7 @@ import numpy as np
 
 from ..models.generation import _cast_params, _gpt_params
 from ..observability import metrics as _obs
+from ..observability import reqtrace as _rt
 from ..observability.sentinel import RecompileSentinel
 from .paged_cache import PagedKVCache
 from .programs import (jit_with_donated_pools, make_decode_fn,
@@ -137,6 +138,11 @@ class ServingEngine:
         self._key = jax.random.key(int(cfg.seed))
         self._step_no = 0
         self._warmed = False
+        # request-trace lane labels: a ServingFleet stamps the slot at
+        # spawn and the fleet tick before every step(); standalone
+        # engines trace as replica None on their own step counter
+        self.trace_replica: Optional[int] = None
+        self.trace_tick: Optional[int] = None
 
     # -- compile-count contract ----------------------------------------------
     def executable_count(self) -> int:
@@ -170,6 +176,13 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {need} pages > pool size "
                 f"{self.cache.n_blocks - 1}")
+        if _rt._enabled:
+            if self.trace_replica is None:
+                # standalone engine: this call IS the request's arrival
+                # into the serving plane (a fleet marks submit itself,
+                # at the class-queue, with the trace-clock arrival)
+                _rt.mark(req.rid, "submit", t=req.arrival)
+            _rt.mark(req.rid, "dispatch", replica=self.trace_replica)
         self.sched.submit(req)
         if _obs._enabled:
             _obs.gauge("serving.queue_depth").set(self.sched.queue_depth)
@@ -187,6 +200,10 @@ class ServingEngine:
         import jax
         W = self.config.table_width
         key = jax.random.key(0)
+        # prime the per-boundary key derivation as well: the first
+        # step()'s fold_in chain otherwise traces+compiles mid-traffic
+        # — ~100 ms the request traces pin on the first admit batch
+        jax.random.fold_in(jax.random.fold_in(self._key, 1), 0)
         for s in self.ladder.prefill:
             a = self.sched.max_admit
             self.cache.pools, _ = self._prefill(
@@ -215,6 +232,11 @@ class ServingEngine:
         for r in finished:
             self.cache.free(r.rid)
             r.done_ts = time.perf_counter()
+        if _rt._enabled:
+            for r in finished:
+                _rt.mark(r.rid, "retire", t=r.done_ts,
+                         reason=r.finish_reason,
+                         replica=self.trace_replica)
         if rec and finished:
             _obs.counter("serving.retired_total").add(len(finished))
             # DEPRECATED alias (kept one release): serving.evicted_total
@@ -260,6 +282,14 @@ class ServingEngine:
                 r.pos = r.prompt_len
                 r.accept(int(tok[i]))
             prefill_sig = (a, s)
+            if _rt._enabled:
+                tick = (self._step_no if self.trace_tick is None
+                        else self.trace_tick)
+                for r in batch:
+                    _rt.record_span(r.rid, "prefill", t0, now,
+                                    bucket=s, width=a,
+                                    replica=self.trace_replica,
+                                    tick=tick)
             if rec:
                 _obs.counter("serving.admitted_total").add(len(batch))
                 _obs.histogram("serving.prefill_ms").observe(
@@ -295,6 +325,16 @@ class ServingEngine:
                     r.accept(int(toks_out[s, i]))
                     accepted += 1
             decode_sig = (b,)
+            if _rt._enabled:
+                t1 = time.perf_counter()
+                tick = (self._step_no if self.trace_tick is None
+                        else self.trace_tick)
+                for r in active:
+                    _rt.record_span(r.rid, "decode", t0, t1,
+                                    bucket=b,
+                                    chunk=int(toks_out.shape[0]),
+                                    replica=self.trace_replica,
+                                    tick=tick)
             if rec:
                 dt = (time.perf_counter() - t0) * 1e3
                 _obs.histogram("serving.decode_step_ms").observe(dt)

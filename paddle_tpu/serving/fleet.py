@@ -80,7 +80,9 @@ from ..distributed import chaos as _chaos
 from ..distributed import elastic as _elastic
 from ..models.generation import _cast_params, _gpt_params
 from ..observability import fleet as _obs_fleet
+from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
+from ..observability import reqtrace as _rt
 from .engine import ServingConfig, ServingEngine
 from .scheduler import BucketLadder, Request
 
@@ -97,12 +99,24 @@ class ServingSLO:
     """The declared service-level objective the supervisor scales and
     sheds against. ``queue_high``/``queue_low`` are queued-requests-
     per-live-replica watermarks; ``p99_ttft_ms`` both triggers
-    scale_up on breach and is the recovery bar chaos drills check."""
+    scale_up on breach and is the recovery bar chaos drills check.
+
+    ``target`` is the availability objective — the fraction of
+    requests that must meet ``p99_ttft_ms``; its complement is the
+    ERROR BUDGET the ``reqtrace.BurnMeter`` burns against over the
+    rolling ``burn_windows`` (fast, slow; seconds). The multi-window
+    alert (every window burning above ``burn_alert_rate``) is the
+    forward-looking scale signal ``decide_scale`` reads next to the
+    instantaneous p99, published as
+    ``serving.slo.burn_rate{window=}``."""
     p99_ttft_ms: float = 1000.0
     queue_high: int = 8
     queue_low: int = 1
     shed_queue_depth: int = 64      # lowest class sheds beyond this
     ttft_window: int = 64           # rolling finishes for p99/tokens-s
+    target: float = 0.99            # SLO: fraction meeting p99_ttft_ms
+    burn_windows: Tuple[float, float] = (5.0, 60.0)  # fast, slow (s)
+    burn_alert_rate: float = 1.0    # page when EVERY window burns past
 
 
 @dataclass
@@ -156,6 +170,10 @@ class FleetRequest:
     base: List[int] = field(default_factory=list)  # emitted at (re)submit
     replica: Optional[int] = None       # live assignment (slot id)
     evictions: int = 0
+    # last eviction (reqtrace: the requeue hop span evict->re-dispatch)
+    evicted_ts: Optional[float] = None
+    evicted_from: Optional[int] = None
+    evicted_kind: Optional[str] = None
     shed: bool = False
     first_token_ts: Optional[float] = None
     done_ts: Optional[float] = None
@@ -290,6 +308,12 @@ class ServingFleet:
         self._retired_executables = 0
         # rolling SLO window: (finish_ts, ttft_ms, cls, n_tokens)
         self._window: List[Tuple[float, float, str, int]] = []
+        # SLO error-budget burn accounting (always maintained — the
+        # autoscaler consumes it, like _window; gauges gate in _publish)
+        self._burn = _rt.BurnMeter(
+            budget=1.0 - float(self.slo.target),
+            windows=self.slo.burn_windows,
+            alert_rate=self.slo.burn_alert_rate)
         for slot in list(self.policy.active):
             self._replicas[slot] = self._spawn(slot)
 
@@ -304,6 +328,7 @@ class ServingFleet:
             cur = self._standby if self._standby is not None \
                 else self._current
             eng.swap_weights(cur, cast=False)
+        eng.trace_replica = int(slot)   # request-trace lane label
         return Replica(slot, eng, incarnation, born_tick=self._tick)
 
     @property
@@ -412,7 +437,20 @@ class ServingFleet:
             if rep is None or not rep.alive:
                 self._flip_pending.pop(0)
                 continue
+            t0 = time.perf_counter()
             rep.engine.swap_weights(self._standby, cast=False)
+            t1 = time.perf_counter()
+            _fr.record("fleet.swap_flip", replica=slot,
+                       version=self._standby_version,
+                       tick=self._tick,
+                       dur_ms=round((t1 - t0) * 1e3, 3))
+            if _rt._enabled:
+                # the flip pause lands on every request the replica
+                # was serving at this token boundary
+                for r in rep.engine.sched.running.values():
+                    _rt.record_span(r.rid, "swap_flip", t0, t1,
+                                    replica=slot,
+                                    version=self._standby_version)
             self._flip_pending.pop(0)
             break
         if not self._flip_pending:
@@ -478,7 +516,13 @@ class ServingFleet:
             self.shed_total += 1
             if _obs._enabled:
                 _obs.counter("serving.fleet.shed_total", cls=cls).add(1)
+            if _rt._enabled:
+                _rt.mark(fr.rid, "shed", cls=cls)
             return fr
+        if _rt._enabled:
+            # the request's arrival on the TRACE clock — queue wait
+            # accrues from here, exactly like the TTFT accounting
+            _rt.mark(fr.rid, "submit", t=fr.arrival, cls=cls)
         self._queues[cls].append(fr)
         self._by_rid[fr.rid] = fr
         return fr
@@ -623,8 +667,9 @@ class ServingFleet:
         incarnations = {s: self._incarnation(s) for s, _ in failures}
         decision = self.policy.decide(failures, verdict)
         requeued = 0
-        for slot, _why in failures:
-            requeued += self._evict_replica(slot)
+        for slot, why in failures:
+            requeued += self._evict_replica(
+                slot, kind="crash" if "lost" in why else "hang")
         if decision.action == "abort":
             self._aborted = True
         else:
@@ -651,7 +696,7 @@ class ServingFleet:
         rep = self._replicas.get(slot)
         return rep.incarnation if rep is not None else 0
 
-    def _evict_replica(self, slot: int) -> int:
+    def _evict_replica(self, slot: int, kind: str = "crash") -> int:
         """Remove a replica and requeue its in-flight requests EXACTLY
         (prompt + streamed tokens) at the front of their class queues.
         Zero-drop: every request the replica held re-enters the
@@ -663,6 +708,7 @@ class ServingFleet:
             self._retired_recompiles += rep.engine.sentinel.fired
             self._retired_executables += rep.engine.executable_count()
             rep.engine = None      # a wedged engine is not trusted
+        evict_ts = time.perf_counter()
         requeued: Dict[str, List[FleetRequest]] = {
             c: [] for c in self.fleet.classes}
         for fr in list(self._by_rid.values()):
@@ -683,8 +729,17 @@ class ServingFleet:
                 self._record_finish(fr)
                 self._finished_at_eviction.append(fr)
                 self._by_rid.pop(fr.rid, None)
+                if _rt._enabled:
+                    _rt.mark(fr.rid, "retire", t=fr.done_ts,
+                             reason=fr.finish_reason)
                 continue
             fr.evictions += 1
+            fr.evicted_ts = evict_ts
+            fr.evicted_from = slot
+            fr.evicted_kind = kind
+            if _rt._enabled:
+                _rt.mark(fr.rid, "evict", t=evict_ts, replica=slot,
+                         kind=kind)
             requeued[fr.cls].append(fr)
         n = 0
         for cls, frs in requeued.items():
@@ -701,11 +756,24 @@ class ServingFleet:
                     if _obs._enabled:
                         _obs.counter("serving.fleet.dropped_total",
                                      cls=cls).add(1)
+                    if _rt._enabled:
+                        _rt.mark(fr.rid, "drop", t=fr.done_ts, cls=cls)
                 continue
             # front of the class queue, original admission order kept
             self._queues[cls][:0] = frs
             n += len(frs)
+            if _obs._enabled and frs:
+                # per-class requeue visibility (was only in receipt
+                # extras): the fleet-lifecycle metric-gap satellite
+                _obs.counter("serving.fleet.requeue_total",
+                             cls=cls).add(len(frs))
+        # flight-recorder breadcrumbs: a crash dump / tpu_doctor merge
+        # must cover serving incidents like training ones (self-gated)
+        _fr.record("fleet.evict", replica=slot, fault=kind,
+                   tick=self._tick, requeued=n)
         if n:
+            _fr.record("fleet.requeue", replica=slot, requeued=n,
+                       tick=self._tick)
             self.requeued_total += n
             if _obs._enabled:
                 _obs.counter("serving.evicted_total").add(n)
@@ -714,7 +782,8 @@ class ServingFleet:
 
     def _autoscale(self):
         p99 = self._rolling_p99()
-        d = self.policy.decide_scale(self.slo, self.queue_depth, p99)
+        d = self.policy.decide_scale(self.slo, self.queue_depth, p99,
+                                     burn_alert=self._burn.alert())
         if d is None:
             return
         if d.action == "scale_up":
@@ -735,6 +804,8 @@ class ServingFleet:
             for slot in d.ranks:
                 if slot in self._replicas:
                     self.drain_replica(slot)
+        _fr.record("fleet.scale", action=d.action,
+                   ranks=list(d.ranks), tick=self._tick)
         self._emit(action=d.action, verdict=d.verdict, ranks=d.ranks,
                    reason=d.reason, episode=d.episode,
                    extras={"queue_depth": self.queue_depth,
@@ -751,6 +822,8 @@ class ServingFleet:
             self._replicas[slot] = self._spawn(
                 slot, self._incarnation(slot) + 1)
             self.policy.record_scale_spawn()
+        _fr.record("fleet.scale", action="grow", ranks=list(d.ranks),
+                   tick=self._tick)
         self._emit(action="grow", verdict=d.verdict, ranks=d.ranks,
                    reason=d.reason, episode=d.episode)
 
@@ -776,6 +849,21 @@ class ServingFleet:
                 rep = avail[0]
                 fr = q.pop(0)
                 fr.replica = rep.slot
+                if _rt._enabled:
+                    now = time.perf_counter()
+                    if fr.evicted_ts is not None:
+                        # the requeue hop: evict -> re-dispatch (the
+                        # replay's class-queue wait included)
+                        _rt.record_span(
+                            fr.rid, "requeue", fr.evicted_ts, now,
+                            replica=rep.slot,
+                            replica_from=fr.evicted_from,
+                            kind=fr.evicted_kind)
+                    else:
+                        _rt.record_span(fr.rid, "queue", fr.arrival,
+                                        now, cls=fr.cls,
+                                        replica=rep.slot)
+                fr.evicted_ts = None
                 rep.engine.submit(
                     fr.resume_ids(), fr.remaining, rid=fr.rid,
                     eos_token_id=fr.eos_token_id, arrival=fr.arrival)
@@ -788,6 +876,7 @@ class ServingFleet:
             if now < rep.wedged_until:
                 continue        # wedged: no step, no pulse
             rep.last_pulse_tick = self._tick
+            rep.engine.trace_tick = self._tick   # reqtrace lane label
             if not rep.engine.has_work():
                 if rep.state == "draining":
                     # drained: decommission (engine executables retire
@@ -837,10 +926,11 @@ class ServingFleet:
         if fr.arrival is None or fr.first_token_ts is None:
             return
         ttft = (fr.first_token_ts - fr.arrival) * 1e3
-        self._window.append((fr.done_ts or time.perf_counter(), ttft,
-                             fr.cls, len(fr.emitted)))
+        done = fr.done_ts or time.perf_counter()
+        self._window.append((done, ttft, fr.cls, len(fr.emitted)))
         if len(self._window) > self.slo.ttft_window:
             self._window = self._window[-self.slo.ttft_window:]
+        self._burn.record(done, ttft > self.slo.p99_ttft_ms)
 
     def _rolling_p99(self) -> float:
         if not self._window:
@@ -859,12 +949,22 @@ class ServingFleet:
         if not _obs._enabled:
             return
         _obs.gauge("serving.fleet.queue_depth").set(self.queue_depth)
+        # per-class central-queue depth, sampled EVERY fleet tick (the
+        # metric-gap fix: depth used to be observable only at dispatch)
+        for cls in self.fleet.classes:
+            _obs.gauge("serving.fleet.queue_depth", cls=cls).set(
+                len(self._queues[cls]))
         _obs.gauge("serving.fleet.live_replicas").set(
             len(self.live_replicas()))
         _obs.gauge("serving.fleet.p99_ttft_ms").set(
             self._rolling_p99())
         _obs.gauge("serving.fleet.tokens_per_s").set(
             self._rolling_tokens_per_s())
+        for w, r in self._burn.rates(now).items():
+            _obs.gauge("serving.slo.burn_rate",
+                       window=f"{w:g}s").set(round(r, 4))
+        _obs.gauge("serving.slo.burn_alert").set(
+            1 if self._burn.alert(now) else 0)
 
     # -- receipts / rollup ----------------------------------------------------
     def _emit(self, action: str, verdict: dict, ranks: Sequence[int],
@@ -969,5 +1069,8 @@ class ServingFleet:
             "expected_executables": self.expected_executables(),
             "rolling_p99_ttft_ms": round(self._rolling_p99(), 3),
             "per_class_ttft": per_cls,
+            "slo_burn": {f"{w:g}s": round(r, 4)
+                         for w, r in self._burn.rates().items()},
+            "burn_alert": self._burn.alert(),
             "aborted": self._aborted,
         }

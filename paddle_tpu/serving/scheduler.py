@@ -23,11 +23,14 @@ by ladder size, not by the length mix of the traffic.
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from ..observability import reqtrace as _rt
 
 __all__ = ["Request", "BucketLadder", "FifoScheduler"]
 
@@ -45,6 +48,7 @@ class Request:
     # -- runtime (engine-owned) ---------------------------------------------
     pos: int = 0                       # next K/V write position
     out: List[int] = field(default_factory=list)
+    submit_ts: Optional[float] = None  # engine-queue entry (reqtrace)
     admitted_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
     done_ts: Optional[float] = None
@@ -140,6 +144,8 @@ class FifoScheduler:
         self.running: dict = {}
 
     def submit(self, req: Request):
+        if _rt._enabled:
+            req.submit_ts = time.perf_counter()
         self.queue.append(req)
         return req.rid
 
@@ -173,6 +179,12 @@ class FifoScheduler:
             admitted.append(self.queue.popleft())
         for r in admitted:
             self.running[r.rid] = r
+        if _rt._enabled and admitted:
+            now = time.perf_counter()
+            for r in admitted:
+                _rt.record_span(
+                    r.rid, "admission",
+                    now if r.submit_ts is None else r.submit_ts, now)
         return admitted
 
     def retire_finished(self) -> List[Request]:

@@ -440,6 +440,33 @@ class TestServingScaleMode:
         # at the floor: no further shrink
         assert p.decide_scale(_SLO(low=1), 0, 50.0, now=2.0) is None
 
+    def test_burn_alert_scales_up_without_instant_breach(self):
+        """The forward-looking trigger: the error budget is burning
+        (reqtrace.BurnMeter multi-window alert) even though the
+        instantaneous p99 and queue look fine."""
+        p = self._policy()
+        d = p.decide_scale(_SLO(p99=500.0, high=100), queued=0,
+                           p99_ttft_ms=10.0, now=0.0, burn_alert=True)
+        assert d is not None and d.action == "scale_up"
+        assert d.verdict["kind"] == "budget_burn"
+        assert d.verdict["evidence"]["burn_alert"] is True
+        assert "budget" in d.reason
+
+    def test_burn_alert_vetoes_scale_down(self):
+        p = self._policy(min_world=1, scale_cooldown_s=0.0)
+        # idle by every instantaneous signal, but the budget burns:
+        # never shrink into an incident
+        assert p.decide_scale(_SLO(low=1), 0, 50.0, now=0.0,
+                              burn_alert=True) is not None  # grows
+        p2 = self._policy(min_world=1, scale_cooldown_s=0.0,
+                          world=2)
+        d = p2.decide_scale(_SLO(low=1), 0, 50.0, now=0.0,
+                            burn_alert=True)
+        assert d is None    # full world: no grow, and NO shrink
+        d = p2.decide_scale(_SLO(low=1), 0, 50.0, now=1.0,
+                            burn_alert=False)
+        assert d is not None and d.action == "scale_down"
+
     def test_initial_world_bounds_validated(self):
         with pytest.raises(ValueError, match="initial_world"):
             SupervisorPolicy(world=2, initial_world=3)

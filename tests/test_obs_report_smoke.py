@@ -74,3 +74,44 @@ def test_demo_full_surface_and_forced_recompile(tmp_path):
     # forced-shape-change leg: counter flips to exactly 1, diff logged
     assert s["train_recompiles_total"] == 1
     assert s["recompile_diff"] and "->" in s["recompile_diff"], s
+
+
+def test_serving_bridge_receipt(tmp_path):
+    """--serving: the zero-to-request-anatomy receipt — tiny fleet,
+    deterministic trace, tail attribution summing to ~1.0 per cohort
+    request, SLO burn + per-class queue-depth gauges in the exports,
+    request lanes merged into the chrome trace."""
+    prom = tmp_path / "srv.prom"
+    jsonl = tmp_path / "srv.jsonl"
+    trace = tmp_path / "srv_trace.json"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         "--serving", "--prom", str(prom), "--jsonl", str(jsonl),
+         "--trace", str(trace)],
+        capture_output=True, text=True, timeout=300, env=_ENV,
+        cwd=ROOT)
+    assert p.returncode == 0, (p.stdout + "\n" + p.stderr)[-2000:]
+    s = json.loads(p.stdout.strip().splitlines()[-1])
+    assert s["ok"], s
+    assert s["requests"] == 8
+    tail = s["tail_attribution"]
+    assert tail["cohort"]
+    for c in tail["cohort"]:
+        assert abs(c["share_sum"] - 1.0) <= 0.02, c
+        assert c["dominant"]
+    assert s["breach_verdict"]["cause"]
+    assert s["recompile_events"] == 0
+    assert any(k.startswith("serving.slo.burn_rate{window=")
+               for k in s["slo_burn_gauges"])
+    assert any("cls=interactive" in k
+               for k in s["queue_depth_by_class"])
+    prom_text = prom.read_text()
+    assert "paddle_tpu_serving_slo_burn_rate" in prom_text
+    assert "paddle_tpu_serving_fleet_queue_depth" in prom_text
+    tr = json.load(open(trace))
+    lanes = [e for e in tr["traceEvents"]
+             if e.get("cat") == "reqtrace"]
+    assert any(e.get("ph") == "X" for e in lanes)
+    assert any(e.get("ph") == "M"
+               and "serving replica" in e["args"]["name"]
+               for e in tr["traceEvents"])

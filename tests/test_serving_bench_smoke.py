@@ -13,8 +13,11 @@ from tools import serving_bench
 def _run(argv):
     import io
     from contextlib import redirect_stdout
+    from paddle_tpu.observability import metrics
     buf = io.StringIO()
-    with redirect_stdout(buf):
+    # the CLI enables the metrics gate; restore it so test order
+    # can't leak an enabled gate into gate-down assertions elsewhere
+    with metrics.enabled_scope(metrics.enabled()), redirect_stdout(buf):
         rc = serving_bench.main(argv)
     out = buf.getvalue()
     line = [l for l in out.splitlines()
@@ -52,6 +55,43 @@ class TestServingBenchSmoke:
         from paddle_tpu.observability import metrics
         g = metrics.get("serving.value")
         assert g is not None and g.value() == rep["value"]
+        # the request-anatomy receipt rides along
+        tail = x["tail_attribution"]
+        assert tail["requests"] == 6
+        assert tail["cohort"] and x["tail_components_sum_ok"]
+        assert x["breach_verdict"]["cause"]
+
+    def test_tail_attribution_and_tracing_penalty(self):
+        """The acceptance bars: p99-cohort latency components sum to
+        1.0 ± 0.02 with a dominant component named, and the measured
+        enabled-tracing throughput penalty stays <= 3%. The trace is
+        arrival-dominated (24 req @ 50/s) so both legs are paced by
+        the same open-loop clock and the penalty measurement is
+        noise-free."""
+        rc, rep = _run(["--requests", "24", "--rate", "50",
+                        "--vocab", "97", "--hidden", "32",
+                        "--layers", "2", "--heads", "4",
+                        "--max-seq-len", "64", "--slots", "4",
+                        "--admit", "2", "--block-size", "4",
+                        "--n-blocks", "32",
+                        "--prefill-buckets", "8,16",
+                        "--max-total", "32", "--decode-chunk", "2",
+                        "--static-batch", "4",
+                        "--prompt-lens", "2,4,7,12",
+                        "--new-tokens", "2,4,6"])
+        assert rc == 0
+        x = rep["extras"]
+        tail = x["tail_attribution"]
+        assert tail["requests"] == 24
+        assert tail["cohort"]
+        for c in tail["cohort"]:
+            assert abs(c["share_sum"] - 1.0) <= 0.02, c
+            assert c["dominant"] in (
+                "queue", "admission", "prefill", "decode", "other")
+        assert tail["dominant_overall"]
+        ov = x["tracing_overhead"]
+        assert ov["tokens_per_sec_on"] > 0
+        assert 0.0 <= ov["penalty"] <= 0.03, ov
 
     def test_replicated_rollup_smoke(self):
         rc, rep = _run(TINY + ["--replicas", "2"])

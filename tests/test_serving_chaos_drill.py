@@ -43,6 +43,16 @@ class TestSmokeKillDrill:
         assert x["receipt_names_replica"] is True
         assert x["expected_verdict"] == "crash"
         assert 0.0 <= x["p99_recovery_s"] <= x["recovery_bound_s"]
+        # the trace-ALONE breach verdict names the evicted replica and
+        # the requeue component (no receipts consulted)
+        v = x["breach_verdict"]
+        assert v["cause"] == "replica_kill"
+        assert v["replica"] == 1
+        assert v["component"] == "requeue"
+        assert x["trace_verdict_ok"] is True
+        assert x["tail_components_sum_ok"] is True
+        assert all(abs(c["share_sum"] - 1.0) <= 0.02
+                   for c in x["tail_attribution"]["cohort"])
         summ = x["stats"]["fleet"]
         assert summ["recompile_events"] == 0
         assert summ["requeued_total"] >= 1

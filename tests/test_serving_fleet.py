@@ -549,3 +549,160 @@ class TestReviewHardening:
         fl.run_until_drained()
         (expect,) = solo_reference(model, [p], [5])
         assert list(fr.emitted) == [int(t) for t in expect]
+
+
+class TestRequestAnatomy:
+    """PR 12: the request-trace plane over the fleet — attribution
+    under staggered admission with a mid-stream eviction, the fleet
+    lifecycle flight-recorder breadcrumbs, the per-class queue-depth /
+    requeue metric-gap fix, and the SLO burn gauges."""
+
+    def test_attribution_sums_with_midstream_eviction(self, model,
+                                                      tmp_path):
+        """The ISSUE's coverage satellite: staggered admission, one
+        replica killed mid-decode — every finished request's latency
+        components sum to 1.0 ± 0.02, the evicted request carries a
+        requeue span, and the trace-only breach verdict names the
+        replica + the requeue component."""
+        from paddle_tpu.observability import reqtrace as rt
+        from tools.tpu_doctor import serving_breach_verdict
+        rt.enable()
+        rt.reset()
+        try:
+            fl = ServingFleet(model, f32_config(), ServingSLO(),
+                              fleet_config(tmp_path))
+            rng = np.random.RandomState(5)
+            specs = [(7, 8), (3, 6), (11, 5), (2, 7)]
+            prompts = [rng.randint(0, 97, (L,)).astype(np.int32)
+                       for L, _ in specs]
+            frs = [fl.submit(p, n)
+                   for p, (_, n) in zip(prompts, specs)]
+            for _ in range(3):           # staggered: some mid-decode
+                fl.step()
+            target = next(fr for fr in frs
+                          if len(fr.emitted) >= 2
+                          and fr.replica is not None)
+            slot = target.replica
+            fl.kill_replica(slot)
+            fl.run_until_drained()
+            tail = rt.explain_tail(p=0.0)    # cohort = every request
+            assert tail["requests"] == 4
+            for c in tail["cohort"]:
+                assert abs(c["share_sum"] - 1.0) <= 0.02, c
+                assert c["dominant"]
+            evicted_row = next(c for c in tail["cohort"]
+                               if c["rid"] == target.rid)
+            assert "requeue" in evicted_row["components"]
+            tls = rt.timelines()
+            rq = [s for s in tls[target.rid]["spans"]
+                  if s["comp"] == "requeue"]
+            assert len(rq) == 1
+            assert rq[0]["replica_from"] == slot
+            assert rq[0]["kind"] == "crash"
+            v = serving_breach_verdict(rt.explain_tail())
+            assert v["cause"] == "replica_kill"
+            assert v["replica"] == slot
+            assert v["component"] == "requeue"
+        finally:
+            rt.disable()
+            rt.reset()
+
+    def test_kill_drill_dump_carries_eviction_breadcrumb(
+            self, model, tmp_path):
+        """PR 4's crash dumps must cover serving incidents: a chaos
+        kill drill's flight-recorder dump contains the fleet.evict /
+        fleet.requeue breadcrumbs and tpu_doctor surfaces them."""
+        from paddle_tpu.distributed import chaos
+        from paddle_tpu.observability import flight_recorder as fr
+        from tools import tpu_doctor
+        os.environ["PD_CHAOS_MODE"] = "kill"
+        os.environ["PD_CHAOS_STEP"] = "2"
+        os.environ["PD_CHAOS_RANK"] = "1"
+        chaos.reset_plan_cache()
+        fr.enable()
+        try:
+            fl = ServingFleet(model, f32_config(), ServingSLO(),
+                              fleet_config(tmp_path))
+            rng = np.random.RandomState(6)
+            for L, n in [(7, 8), (3, 6), (11, 5), (2, 7)]:
+                fl.submit(rng.randint(0, 97, (L,)).astype(np.int32),
+                          n)
+            fl.run_until_drained()
+            dump = fr.dump(path=str(tmp_path / "flight_kill.json"),
+                           stacks=False)
+        finally:
+            fr.disable()
+            fr.reset()
+            for k in ("PD_CHAOS_MODE", "PD_CHAOS_STEP",
+                      "PD_CHAOS_RANK"):
+                os.environ.pop(k, None)
+            chaos.reset_plan_cache()
+        kinds = [e["k"] for e in dump["events"]]
+        assert "chaos.inject" in kinds
+        assert "fleet.evict" in kinds
+        ev = next(e for e in dump["events"]
+                  if e["k"] == "fleet.evict")
+        assert ev["replica"] == 1 and ev["fault"] == "crash"
+        diag = tpu_doctor.diagnose(
+            tpu_doctor.load_dumps([dump["path"]]))
+        incidents = diag["serving_incidents"]
+        assert any(e["k"] == "fleet.evict" and e["replica"] == 1
+                   for e in incidents)
+        assert "fleet.evict" in tpu_doctor.format_report(diag)
+
+    def test_queue_depth_by_class_and_requeue_counter(self, model,
+                                                      tmp_path):
+        """Metric-gap satellite: per-class queue depth is sampled
+        every fleet tick (not just at dispatch) and requeues count per
+        class."""
+        with metrics.enabled_scope(True):
+            metrics.reset(prefix="serving.")
+            fl = ServingFleet(
+                model, f32_config(),
+                ServingSLO(queue_high=1000, shed_queue_depth=1000),
+                fleet_config(tmp_path, replicas=1, max_replicas=1))
+            rng = np.random.RandomState(7)
+            # more batch work than one tick dispatches: the class
+            # queue is non-empty when _publish samples it
+            for _ in range(6):
+                fl.submit(rng.randint(0, 97, (3,)).astype(np.int32),
+                          4, cls="batch")
+            fl.step()
+            g = metrics.get("serving.fleet.queue_depth", cls="batch")
+            assert g is not None and g.value() > 0
+            gi = metrics.get("serving.fleet.queue_depth",
+                             cls="interactive")
+            assert gi is not None and gi.value() == 0
+            fl.kill_replica(0)
+            fl.run_until_drained()
+            c = metrics.get("serving.fleet.requeue_total", cls="batch")
+            assert c is not None and c.value() >= 1
+
+    def test_burn_gauges_published_and_summary(self, model, tmp_path):
+        """serving.slo.burn_rate{window=} gauges ride the registry
+        (and so the exporters + fleet.aggregate()); an all-breach
+        window drives the burn alert and the forward-looking scale_up."""
+        from paddle_tpu.observability import exporters
+        with metrics.enabled_scope(True):
+            metrics.reset(prefix="serving.")
+            slo = ServingSLO(p99_ttft_ms=0.001, target=0.99,
+                             burn_windows=(5.0, 60.0))
+            fl = ServingFleet(model, f32_config(), slo,
+                              fleet_config(tmp_path, replicas=1,
+                                           max_replicas=1))
+            rng = np.random.RandomState(8)
+            for _ in range(3):
+                fl.submit(rng.randint(0, 97, (3,)).astype(np.int32), 4)
+            fl.run_until_drained()
+            # every finish breached the (absurd) 1µs TTFT SLO
+            g = metrics.get("serving.slo.burn_rate", window="5s")
+            assert g is not None
+            assert g.value() == pytest.approx((1.0) / 0.01, rel=1e-6)
+            assert metrics.get("serving.slo.burn_alert").value() == 1
+            summ = fl.summary()
+            assert summ["burn_alert"] is True
+            assert summ["slo_burn"]["5s"] > 1.0
+            prom = exporters.to_prometheus(
+                metrics.snapshot(prefix="serving.slo."))
+            assert "serving_slo_burn_rate" in prom
+            assert 'window="5s"' in prom

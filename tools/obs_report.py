@@ -26,6 +26,15 @@ Modes:
               print the share table as ONE JSON line — the
               zero-to-attribution receipt (scope shares sum to ~1.0,
               sentinel stays at zero).
+  --serving   request-anatomy bridge (the serving twin of --anatomy):
+              stand up a tiny ServingFleet with metrics + request
+              tracing on, replay a deterministic open-loop trace, and
+              print ONE JSON line carrying the engine/fleet gauges
+              (per-class queue depth, SLO burn rates), the
+              explain_tail attribution (per-request components sum to
+              ~1.0, dominant named) and the serving breach verdict —
+              the zero-to-request-anatomy receipt. Shapes env-tunable
+              (PD_SRV_REQUESTS/REPLICAS/RATE/HIDDEN/LAYERS).
   default     aggregate + export whatever the current process's
               registry holds (for embedding in training scripts).
 
@@ -277,6 +286,120 @@ def run_anatomy(args):
     return 0 if summary["ok"] else 1
 
 
+def run_serving(args):
+    """Request-anatomy bridge: one tiny fleet, one deterministic
+    trace, the per-request attribution + burn gauges + breach verdict
+    as one receipt line. Self-checks the acceptance surface (every
+    cohort request's components sum to 1.0 ± 0.02, the burn-rate and
+    per-class queue-depth gauges exist, zero recompiles) so a drive-by
+    refactor that un-wires a serving span site fails loudly here."""
+    global jax, np
+    if jax is None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu import jax_compat  # noqa: F401 (shims first)
+        import jax as _jax
+        import numpy as _np
+        jax, np = _jax, _np
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import exporters, metrics, reqtrace
+    from paddle_tpu.serving import (FleetConfig, ServingConfig,
+                                    ServingFleet)
+    from paddle_tpu.serving.loadgen import replay_fleet, synthetic_trace
+    from tools.tpu_doctor import serving_breach_verdict
+
+    n_req = int(os.environ.get("PD_SRV_REQUESTS", 8))
+    replicas = int(os.environ.get("PD_SRV_REPLICAS", 2))
+    rate = float(os.environ.get("PD_SRV_RATE", 300.0))
+    hidden = int(os.environ.get("PD_SRV_HIDDEN", 32))
+    layers = int(os.environ.get("PD_SRV_LAYERS", 2))
+
+    metrics.enable()
+    reqtrace.enable()
+    reqtrace.reset()
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=hidden, num_layers=layers,
+        num_heads=4, max_seq_len=64, dropout=0.0,
+        use_flash_attention=False))
+    model.eval()
+    cfg = ServingConfig(max_slots=4, max_admit=2, block_size=4,
+                        n_blocks=48, prefill_buckets=(24,),
+                        max_total_tokens=24, decode_chunk=2,
+                        dtype=None)
+    fleet = ServingFleet(model, cfg, fleet=FleetConfig(
+        replicas=replicas, min_replicas=1, max_replicas=replicas,
+        autoscale=False))
+    trace = synthetic_trace(
+        n_req, vocab_size=97, seed=0, rate_rps=rate,
+        prompt_len_choices=(2, 4, 6, 9),
+        new_token_choices=(3, 4, 6),
+        class_mix={"interactive": 0.75, "batch": 0.25})
+    stats, _finished, _shed = replay_fleet(fleet, trace)
+    tail = reqtrace.explain_tail()
+    summ = stats["fleet"]
+    verdict = serving_breach_verdict(tail, episodes=summ["episodes"],
+                                     summary=summ)
+
+    snap = metrics.snapshot()
+    if args.prom:
+        exporters.write_prometheus(args.prom)
+    if args.jsonl:
+        exporters.JsonlExporter(args.jsonl).write(
+            extra={"phase": "serving"})
+    trace_path = args.trace
+    if trace_path:
+        profiler.export_chrome_tracing(trace_path)  # request lanes
+    reqtrace.disable()
+
+    burn_gauges = {k: v["value"] for k, v in snap.items()
+                   if k.startswith("serving.slo.burn_rate")}
+    cls_depth = {k: v["value"] for k, v in snap.items()
+                 if k.startswith("serving.fleet.queue_depth{")}
+    summary = {
+        "ok": True,
+        "requests": stats.get("requests", 0),
+        "replicas": replicas,
+        "sustained_tokens_per_sec":
+            stats.get("sustained_tokens_per_sec", 0.0),
+        "ttft_ms": stats.get("ttft_ms"),
+        "tail_attribution": tail,
+        "breach_verdict": verdict,
+        "slo_burn_gauges": burn_gauges,
+        "queue_depth_by_class": cls_depth,
+        "slo_burn": summ.get("slo_burn"),
+        "recompile_events": summ["recompile_events"],
+        "episodes": summ["episodes"],
+        "prometheus": args.prom, "jsonl": args.jsonl,
+        "trace": trace_path,
+    }
+    problems = []
+    if stats.get("requests", 0) != n_req:
+        problems.append(
+            f"finished {stats.get('requests', 0)}/{n_req} requests")
+    bad_sums = [c["rid"] for c in tail["cohort"]
+                if abs(c["share_sum"] - 1.0) > 0.02]
+    if not tail["cohort"]:
+        problems.append("empty tail cohort (no request timelines)")
+    if bad_sums:
+        problems.append(f"attribution shares off 1.0 for {bad_sums}")
+    if not all(c["dominant"] for c in tail["cohort"]):
+        problems.append("cohort request without a dominant component")
+    if not burn_gauges:
+        problems.append("no serving.slo.burn_rate{window=} gauges")
+    if not cls_depth:
+        problems.append("no serving.fleet.queue_depth{cls=} gauges")
+    if summ["recompile_events"] != 0:
+        problems.append(
+            f"{summ['recompile_events']} recompiles on a steady fleet")
+    if problems:
+        summary["ok"] = False
+        summary["problems"] = problems
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
 def run_export(args):
     """Non-demo mode: export whatever the registry holds right now."""
     _jax_setup()
@@ -305,6 +428,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--demo", action="store_true")
     ap.add_argument("--anatomy", action="store_true")
+    ap.add_argument("--serving", action="store_true")
     ap.add_argument("--force-recompile", action="store_true")
     ap.add_argument("--doctor", default=None, metavar="DIR",
                     help="diagnose flight-recorder dumps in DIR "
@@ -317,6 +441,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.doctor:
         return run_doctor(args)
+    if args.serving:
+        return run_serving(args)
     if args.anatomy:
         return run_anatomy(args)
     if args.demo:

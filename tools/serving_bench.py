@@ -29,8 +29,18 @@ jax.distributed). ``--replicas N`` runs N data-parallel engine
 replicas over disjoint shards of the trace in one process —
 a topology receipt for the rollup math, not a perf claim.
 
+Request anatomy rides along: the engine leg is replayed once with
+request tracing OFF (the headline numbers) and once with it ON — the
+traced replay yields the tail-attribution receipt
+(``extras.tail_attribution``: per-request latency components summing
+to 1.0 ± 0.02 for the p99 cohort, dominant component named, plus a
+``breach_verdict``) and the measured tracing overhead
+(``extras.tracing_overhead.penalty`` — the ≤3% bar). ``--trace PATH``
+writes the chrome trace with one request lane per replica.
+
 CPU receipt bars (--check): engine >= 2x cold-static sustained
-tokens/s at equal-or-better p99 TTFT, zero steady-state recompiles.
+tokens/s at equal-or-better p99 TTFT, zero steady-state recompiles,
+tail components sum to 1.0 ± 0.02, tracing penalty <= 3%.
 """
 import argparse
 import json
@@ -133,6 +143,10 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--check", action="store_true",
                     help="exit 1 unless the CPU receipt bars hold")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write a chrome trace with request lanes "
+                         "(one lane per replica, spans colored by "
+                         "latency component)")
     # engine shape
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--admit", type=int, default=4)
@@ -154,8 +168,9 @@ def main(argv=None) -> int:
     args.dtype = args.dtype or None
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from paddle_tpu.observability import exporters, metrics
+    from paddle_tpu.observability import exporters, metrics, reqtrace
     from paddle_tpu.serving.loadgen import replay_static, synthetic_trace
+    from tools.tpu_doctor import serving_breach_verdict
 
     metrics.enable()
     model = build_model(args)
@@ -167,10 +182,40 @@ def main(argv=None) -> int:
         new_token_choices=tuple(
             int(x) for x in args.new_tokens.split(",")))
 
-    if args.replicas > 1:
-        engine_stats = run_replicated(model, args, trace)
-    else:
-        engine_stats = run_engine_leg(model, args, trace)
+    tracing_overhead = None
+    try:     # the gate is process-global: never leak it on an error
+        if args.replicas > 1:
+            # fleet path: one replay, traced (the rollup receipt is
+            # the point here, not an overhead A/B)
+            reqtrace.enable()
+            reqtrace.reset()
+            engine_stats = run_replicated(model, args, trace)
+        else:
+            # headline leg with tracing OFF, then the SAME trace with
+            # tracing ON: the traced replay yields the tail
+            # attribution and the measured overhead penalty (open-loop
+            # arrivals pace both legs, so the spans are comparable)
+            reqtrace.disable()
+            engine_stats = run_engine_leg(model, args, trace)
+            reqtrace.enable()
+            reqtrace.reset()
+            traced_stats = run_engine_leg(model, args, trace)
+            tps_off = engine_stats["sustained_tokens_per_sec"]
+            tps_on = traced_stats["sustained_tokens_per_sec"]
+            penalty = (max(0.0, 1.0 - tps_on / tps_off)
+                       if tps_off > 0 else -1.0)
+            tracing_overhead = {
+                "tokens_per_sec_off": tps_off,
+                "tokens_per_sec_on": tps_on,
+                "penalty": round(penalty, 4),
+            }
+        tail = reqtrace.explain_tail()
+        breach = serving_breach_verdict(tail, summary=engine_stats)
+        if args.trace:
+            from paddle_tpu import profiler
+            profiler.export_chrome_tracing(args.trace)
+    finally:
+        reqtrace.disable()
     static_cold = replay_static(model, trace,
                                 batch_size=args.static_batch,
                                 dtype=args.dtype)
@@ -186,7 +231,14 @@ def main(argv=None) -> int:
     p99_e = engine_stats["ttft_ms"]["p99"]
     p99_s = static_cold["ttft_ms"]["p99"]
     zero_recompiles = engine_stats.get("recompile_events", -1) == 0
-    ok = (speedup_cold >= 2.0 and p99_e <= p99_s and zero_recompiles)
+    tail_ok = bool(
+        tail["cohort"]
+        and all(abs(c["share_sum"] - 1.0) <= 0.02 and c["dominant"]
+                for c in tail["cohort"]))
+    penalty_ok = (tracing_overhead is None
+                  or 0.0 <= tracing_overhead["penalty"] <= 0.03)
+    ok = (speedup_cold >= 2.0 and p99_e <= p99_s and zero_recompiles
+          and tail_ok and penalty_ok)
 
     report = {
         "metric": "serving_sustained_tokens_per_sec",
@@ -202,6 +254,10 @@ def main(argv=None) -> int:
             "p99_ttft_ms_engine": p99_e,
             "p99_ttft_ms_static": p99_s,
             "zero_steady_state_recompiles": zero_recompiles,
+            "tail_attribution": tail,
+            "breach_verdict": breach,
+            "tail_components_sum_ok": tail_ok,
+            "tracing_overhead": tracing_overhead,
             "receipt_ok": ok,
         },
     }
@@ -212,7 +268,9 @@ def main(argv=None) -> int:
     if args.check and not ok:
         print(f"RECEIPT FAILED: speedup_cold={speedup_cold} (need "
               f">=2.0), p99 {p99_e} vs {p99_s} (need <=), "
-              f"zero_recompiles={zero_recompiles}", flush=True)
+              f"zero_recompiles={zero_recompiles}, "
+              f"tail_ok={tail_ok}, "
+              f"tracing_overhead={tracing_overhead}", flush=True)
         return 1
     return 0
 

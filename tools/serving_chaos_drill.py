@@ -12,7 +12,11 @@ mid-load (PD_CHAOS_* plan through chaos.maybe_inject_serving). Modes:
             evicted request's stitched output BIT-IDENTICAL to an
             uninterrupted engine run (f32 greedy parity), rolling p99
             TTFT recovered by drain time, one remediation receipt
-            naming the replica.
+            naming the replica — AND the request-trace breach verdict
+            (tpu_doctor.serving_breach_verdict over reqtrace's
+            explain_tail, no receipts consulted) must name the evicted
+            replica and the ``requeue`` component from the trace
+            alone.
   stall     wedge the replica's step loop instead (hung-but-alive);
             the progress clock evicts it. Same bars, verdict=hang.
   swap      hot weight swap under load: one clean swap (flip
@@ -156,7 +160,9 @@ def p99_recovery(finished, fault_ts, bound_ms, window=8):
 
 def run_fault_drill(args, mode):
     """kill / stall: one replica faulted mid-load."""
+    from paddle_tpu.observability import reqtrace
     from paddle_tpu.serving.loadgen import replay_fleet, synthetic_trace
+    from tools.tpu_doctor import serving_breach_verdict
     model = build_model(args)
     trace = synthetic_trace(
         args.requests, vocab_size=args.vocab, seed=args.seed,
@@ -166,17 +172,30 @@ def run_fault_drill(args, mode):
         new_token_choices=tuple(
             int(x) for x in args.new_tokens.split(",")))
     arm_chaos(mode, args.chaos_tick, args.chaos_replica)
+    reqtrace.enable()
+    reqtrace.reset()
     try:
-        fleet = build_fleet(model, args, autoscale=args.autoscale)
-        fault_box = {}
+        try:
+            fleet = build_fleet(model, args, autoscale=args.autoscale)
+            fault_box = {}
 
-        def on_tick(tick, fl):
-            if fault_box.get("ts") is None and fl.episodes:
-                fault_box["ts"] = time.perf_counter()
-        stats, finished, _shed = replay_fleet(fleet, trace,
-                                              on_tick=on_tick)
+            def on_tick(tick, fl):
+                if fault_box.get("ts") is None and fl.episodes:
+                    fault_box["ts"] = time.perf_counter()
+            stats, finished, _shed = replay_fleet(fleet, trace,
+                                                  on_tick=on_tick)
+        finally:
+            disarm_chaos()
+        # the "why was p99 slow" half of the receipt: the breach
+        # verdict comes from the REQUEST TRACES ALONE (no remediation
+        # receipts, no fleet summary) and must still name the evicted
+        # replica + the requeue component
+        tail = reqtrace.explain_tail()
+        breach = serving_breach_verdict(tail)
     finally:
-        disarm_chaos()
+        # the gate is process-global: a raising drill must not leave
+        # tracing on for whatever runs next in this process
+        reqtrace.disable()
     replay = verify_exact_replay(model, args, finished)
     fault_ts = fault_box.get("ts")
     rec_s = (p99_recovery(finished, fault_ts, args.slo_p99_ms)
@@ -188,6 +207,15 @@ def run_fault_drill(args, mode):
         args.chaos_replica in e["ranks"] for e in remediations)
     dropped = args.requests - stats.get("requests", 0) - stats["shed"]
     expected_verdict = "crash" if mode == "kill" else "hang"
+    expected_cause = ("replica_kill" if mode == "kill"
+                      else "covert_stall")
+    trace_verdict_ok = (breach["cause"] == expected_cause
+                        and breach["replica"] == args.chaos_replica
+                        and breach["component"] == "requeue")
+    tail_sums_ok = bool(
+        tail["cohort"]
+        and all(abs(c["share_sum"] - 1.0) <= 0.02
+                for c in tail["cohort"]))
     ok = (dropped == 0
           and replay["replayed"] >= 1
           and replay["bit_identical"] is True
@@ -195,7 +223,9 @@ def run_fault_drill(args, mode):
           and any(e["verdict"] == expected_verdict
                   for e in remediations)
           and summ["recompile_events"] == 0
-          and 0.0 <= rec_s <= args.recovery_bound_s)
+          and 0.0 <= rec_s <= args.recovery_bound_s
+          and trace_verdict_ok
+          and tail_sums_ok)
     return {
         "metric": f"serving_chaos_{mode}",
         "value": stats.get("requests", 0),
@@ -209,6 +239,10 @@ def run_fault_drill(args, mode):
             "remediation": remediations,
             "receipt_names_replica": receipt_names_replica,
             "expected_verdict": expected_verdict,
+            "tail_attribution": tail,
+            "breach_verdict": breach,
+            "trace_verdict_ok": trace_verdict_ok,
+            "tail_components_sum_ok": tail_sums_ok,
             "receipt_ok": ok,
         },
     }
@@ -218,6 +252,7 @@ def run_swap_drill(args):
     """Hot weight swap under load + a sabotaged swap that must abort."""
     from paddle_tpu.distributed import checkpoint as ckpt
     from paddle_tpu.models.generation import _gpt_params
+    from paddle_tpu.observability import reqtrace
     from paddle_tpu.serving.loadgen import replay_fleet, synthetic_trace
     import tempfile
     model = build_model(args)
@@ -234,6 +269,8 @@ def run_swap_drill(args):
         new_token_choices=tuple(
             int(x) for x in args.new_tokens.split(",")))
     swap_state = {"clean": None, "sabotaged": None}
+    reqtrace.enable()
+    reqtrace.reset()
     fleet = build_fleet(model, args, autoscale=False)
 
     def on_tick(tick, fl):
@@ -244,25 +281,31 @@ def run_swap_drill(args):
         if tick == args.chaos_tick and swap_state["clean"] is None:
             swap_state["clean"] = fl.swap_weights(
                 checkpoint_path=ckpt_path)
-    stats, finished, _shed = replay_fleet(fleet, trace,
-                                          on_tick=on_tick)
-    # flips land one-per-tick; finish any still pending (empty token
-    # boundaries — a real fleet keeps ticking between arrivals)
-    for _ in range(2 * args.replicas):
-        if fleet._standby is None:
-            break
-        fleet.step()
-    # the SABOTAGED half: arm corrupt_swap chaos on the NEXT tick,
-    # tick once so the fleet polls it, then attempt the swap — the
-    # standby verification must abort it while old weights serve on
-    arm_chaos("corrupt_swap", fleet._tick + 1, 0)
     try:
-        fleet.step()
-        swap_state["sabotaged"] = fleet.swap_weights(
-            checkpoint_path=ckpt_path)
+        stats, finished, _shed = replay_fleet(fleet, trace,
+                                              on_tick=on_tick)
+        # flips land one-per-tick; finish any still pending (empty
+        # token boundaries — a real fleet keeps ticking between
+        # arrivals)
+        for _ in range(2 * args.replicas):
+            if fleet._standby is None:
+                break
+            fleet.step()
+        # the SABOTAGED half: arm corrupt_swap chaos on the NEXT
+        # tick, tick once so the fleet polls it, then attempt the
+        # swap — the standby verification must abort it while old
+        # weights serve on
+        arm_chaos("corrupt_swap", fleet._tick + 1, 0)
+        try:
+            fleet.step()
+            swap_state["sabotaged"] = fleet.swap_weights(
+                checkpoint_path=ckpt_path)
+        finally:
+            disarm_chaos()
+        stats["fleet"] = fleet.summary()  # incl. post-drain swaps
+        tail = reqtrace.explain_tail()
     finally:
-        disarm_chaos()
-    stats["fleet"] = fleet.summary()   # includes the post-drain swaps
+        reqtrace.disable()
     # same-weights swap => greedy outputs must STILL be bit-identical
     import numpy as np
     from paddle_tpu.serving import ServingEngine
@@ -291,6 +334,8 @@ def run_swap_drill(args):
             "sabotaged_swap_aborted": swap_state["sabotaged"] is False,
             "outputs_bit_identical": bool(identical),
             "zero_recompiles": summ["recompile_events"] == 0,
+            # the flip pauses are visible per request in the trace
+            "swap_flip_spans": tail["swap_flips"],
             "receipt_ok": ok,
         },
     }
@@ -298,7 +343,9 @@ def run_swap_drill(args):
 
 def run_overload_drill(args):
     """2x sustained overload, two priority classes."""
+    from paddle_tpu.observability import reqtrace
     from paddle_tpu.serving.loadgen import replay_fleet, synthetic_trace
+    from tools.tpu_doctor import serving_breach_verdict
     model = build_model(args)
     trace = synthetic_trace(
         args.requests, vocab_size=args.vocab, seed=args.seed,
@@ -308,8 +355,15 @@ def run_overload_drill(args):
         new_token_choices=tuple(
             int(x) for x in args.new_tokens.split(",")),
         class_mix={"interactive": 0.5, "batch": 0.5})
-    fleet = build_fleet(model, args, autoscale=args.autoscale)
-    stats, finished, shed = replay_fleet(fleet, trace)
+    reqtrace.enable()
+    reqtrace.reset()
+    try:
+        fleet = build_fleet(model, args, autoscale=args.autoscale)
+        stats, finished, shed = replay_fleet(fleet, trace)
+        tail = reqtrace.explain_tail()
+        breach = serving_breach_verdict(tail, summary=stats["fleet"])
+    finally:
+        reqtrace.disable()
     summ = stats["fleet"]
     per_cls = stats.get("per_class_ttft_ms", {})
     hi = per_cls.get("interactive", {"p99": -1.0})
@@ -345,6 +399,11 @@ def run_overload_drill(args):
                       "p99_ttft_ms": lo["p99"]},
             "only_batch_shed": batch_shed,
             "low_priority_degraded": degraded,
+            # informational: the trace-side view of the overload (the
+            # kill-mode bars are the acceptance surface)
+            "breach_verdict": breach,
+            "tail_dominant": tail["dominant_overall"],
+            "slo_burn": summ.get("slo_burn"),
             "receipt_ok": ok,
         },
     }

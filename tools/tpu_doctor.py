@@ -41,7 +41,8 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["load_dumps", "diagnose", "verdict", "format_report", "main"]
+__all__ = ["load_dumps", "diagnose", "verdict", "format_report",
+           "serving_breach_verdict", "main"]
 
 STRAGGLER_FACTOR = 1.5     # median step > 1.5x fleet median => straggler
 RECOMPILE_STORM = 3        # >= this many recompile events => storm
@@ -53,6 +54,11 @@ LIVE_STEP_AGE_S = 10.0
 # the same rank (newest-per-rank filtering must not discard the
 # mid-hang stall record once the ring wraps past it)
 _EVIDENCE_KINDS = ("watchdog.stall", "recompile")
+# serving-fleet lifecycle breadcrumbs (serving/fleet.py records them
+# into the same flight-recorder ring) surfaced from merged dumps so a
+# crash dump covers serving incidents like training ones
+_SERVING_KINDS = ("fleet.evict", "fleet.requeue", "fleet.swap_flip",
+                  "fleet.scale", "chaos.inject")
 
 
 def load_dumps(paths: List[str]) -> List[dict]:
@@ -239,6 +245,26 @@ def _goodput(dumps: List[dict]) -> Optional[dict]:
             for k in sorted(keys)}
 
 
+def _serving_incidents(dumps: List[dict]) -> List[dict]:
+    """Serving-fleet lifecycle breadcrumbs (evictions, requeues, swap
+    flips, scale actions, serving chaos injections) from the merged
+    dumps, oldest-first. chaos.inject is shared with the TRAINING
+    chaos hook — only the serving-scoped ones belong here (a pure
+    training fault must not grow a 'serving incidents' section)."""
+    out = []
+    for d in dumps:
+        for e in d.get("events", []):
+            if e.get("k") not in _SERVING_KINDS:
+                continue
+            if (e.get("k") == "chaos.inject"
+                    and e.get("scope") != "serving"):
+                continue
+            row = {k: v for k, v in e.items() if k != "i"}
+            row["rank"] = d["rank"]
+            out.append(row)
+    return sorted(out, key=lambda e: e.get("t", 0))
+
+
 def diagnose(dumps: List[dict]) -> dict:
     """Merge per-host dumps into one diagnosis dict (pure function)."""
     return {
@@ -250,6 +276,7 @@ def diagnose(dumps: List[dict]) -> dict:
         "recompile_storm": _recompile_storm(dumps),
         "hangs": _hangs(dumps),
         "goodput": _goodput(dumps),
+        "serving_incidents": _serving_incidents(dumps),
     }
 
 
@@ -310,6 +337,91 @@ def verdict(diag: dict) -> dict:
             "evidence": {}}
 
 
+# -- serving breach verdict ---------------------------------------------------
+
+def _dominant_cause(tail: dict) -> dict:
+    comp = tail.get("dominant_overall")
+    cause = {"queue": "queue_overload", "admission": "queue_overload",
+             "prefill": "slow_prefill", "decode": "slow_decode",
+             "requeue": "replica_kill",
+             "swap_flip": "swap_flip"}.get(comp, "unattributed")
+    return {"cause": cause, "replica": None, "component": comp}
+
+
+def serving_breach_verdict(tail: dict, episodes: Optional[list] = None,
+                           summary: Optional[dict] = None) -> dict:
+    """Name the cause of a serving SLO breach from the request traces
+    alone (``tail`` = ``reqtrace.explain_tail()``'s report), optionally
+    corroborated by the fleet's remediation receipts (``episodes``) and
+    ``ServingFleet.summary()``. The serving twin of ``verdict()``.
+
+    Priority mirrors diagnostic confidence (DESIGN.md "Request
+    anatomy"): a replica death is proof (evict marks name the replica
+    and whether it crashed or covertly stalled; the requeue spans carry
+    the replay cost), a recompile is a named contract violation, an
+    overload shed is an admission-control outcome, a swap flip is a
+    bounded pause — and only then does the dominant tail component
+    speak (queue_overload / slow_prefill / slow_decode)."""
+    episodes = episodes or []
+    summary = summary or {}
+    evictions = tail.get("evictions") or []
+    cohort = tail.get("cohort") or []
+    comps = tail.get("cohort_components") or {}
+    if evictions:
+        # the replica most evictions name; kill outranks covert stall
+        # when one episode held both kinds of casualty
+        per: Dict[Any, int] = {}
+        for e in evictions:
+            per[e.get("replica")] = per.get(e.get("replica"), 0) + 1
+        replica = max(per, key=per.get)
+        kinds = {e.get("kind") for e in evictions
+                 if e.get("replica") == replica}
+        cause = "replica_kill" if "crash" in kinds else "covert_stall"
+        return {
+            "cause": cause, "replica": replica, "component": "requeue",
+            "source": "serving_doctor",
+            "evidence": {
+                "evicted_requests": len(evictions),
+                "kinds": sorted(k for k in kinds if k),
+                "requeue_share_of_tail": comps.get("requeue", 0.0),
+                "cohort_dominant": tail.get("dominant_overall"),
+                "receipt_corroborates": any(
+                    replica in (e.get("ranks") or [])
+                    for e in episodes),
+            }}
+    if int(summary.get("recompile_events", 0) or 0) > 0:
+        return {"cause": "recompile", "replica": None,
+                "component": tail.get("dominant_overall"),
+                "source": "serving_doctor",
+                "evidence": {"recompile_events":
+                             summary["recompile_events"]}}
+    dominant = tail.get("dominant_overall")
+    if tail.get("shed", 0) and dominant in ("queue", "admission",
+                                            "other"):
+        return {"cause": "overload_shed", "replica": None,
+                "component": "queue", "source": "serving_doctor",
+                "evidence": {"shed": tail["shed"],
+                             "queue_share": comps.get("queue", 0.0)}}
+    if tail.get("swap_flips", 0) and (
+            dominant == "swap_flip"
+            or (comps.get("swap_flip", 0.0) > 0.05
+                and any(e.get("action") == "weight_swap"
+                        for e in episodes))):
+        return {"cause": "swap_flip", "replica": None,
+                "component": "swap_flip", "source": "serving_doctor",
+                "evidence": {"swap_flips": tail["swap_flips"],
+                             "swap_share": comps.get("swap_flip",
+                                                     0.0)}}
+    if not cohort:
+        return {"cause": "none", "replica": None, "component": None,
+                "source": "serving_doctor", "evidence": {}}
+    v = _dominant_cause(tail)
+    v["source"] = "serving_doctor"
+    v["evidence"] = {"cohort_components": comps,
+                     "threshold_ms": tail.get("threshold_ms")}
+    return v
+
+
 def format_report(diag: dict) -> str:
     """Operator-readable rendering of a diagnosis (the runbook output:
     lead with the verdict, then the evidence)."""
@@ -350,6 +462,19 @@ def format_report(diag: dict) -> str:
             f"{h['age_s']}s (limit {h['limit_s']}s); per-thread "
             f"stacks {'captured' if h['stacks_in_dump'] else 'MISSING'}"
             " in its dump")
+    srv = diag.get("serving_incidents") or []
+    if srv:
+        lines.append(f"serving incidents: {len(srv)} fleet "
+                     "breadcrumb(s):")
+        for e in srv[-6:]:
+            lines.append(
+                f"  {e.get('k')}: replica {e.get('replica')} "
+                f"tick {e.get('tick')} "
+                + (f"fault={e.get('fault')} " if e.get('fault') else "")
+                + (f"requeued={e.get('requeued')} "
+                   if e.get('requeued') is not None else "")
+                + (f"action={e.get('action')}"
+                   if e.get('action') else ""))
     gp = diag.get("goodput")
     if gp:
         lines.append(
@@ -374,7 +499,35 @@ def main(argv=None) -> int:
     ap.add_argument("--verdict", action="store_true",
                     help="print the one-line actionable verdict JSON "
                          "(the elastic supervisor's input)")
+    ap.add_argument("--serving", default=None, metavar="RECEIPT.json",
+                    help="serving breach triage: read a serving "
+                         "receipt JSON (obs_report --serving / "
+                         "serving_chaos_drill output with a "
+                         "tail_attribution section) and print the "
+                         "breach verdict")
     args = ap.parse_args(argv)
+    if args.serving:
+        with open(args.serving) as f:
+            doc = json.load(f)
+        # accept every emitted receipt shape: the bare explain_tail
+        # report, obs_report --serving (top-level tail_attribution +
+        # episodes + recompile_events), and the bench/drill emit_report
+        # wrapper (everything nested under extras, fleet summary at
+        # extras.stats.fleet, remediation receipts at
+        # extras.remediation)
+        ex = doc.get("extras") or {}
+        tail = (doc.get("tail") or doc.get("tail_attribution")
+                or ex.get("tail_attribution") or doc)
+        summ = (doc.get("fleet") or doc.get("summary")
+                or (ex.get("stats") or {}).get("fleet"))
+        if summ is None and "recompile_events" in doc:
+            summ = {"recompile_events": doc.get("recompile_events")}
+        episodes = (doc.get("episodes") or ex.get("remediation")
+                    or (summ or {}).get("episodes"))
+        v = serving_breach_verdict(tail, episodes=episodes,
+                                   summary=summ)
+        print(json.dumps(v))
+        return 1 if v["cause"] not in ("none", "unattributed") else 0
     paths = list(args.dumps)
     if args.dir:
         paths += sorted(glob.glob(os.path.join(args.dir,
