@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import Tensor, no_grad
+from ..observability import flight_recorder as _fr
+from ..observability import metrics as _obs
 
 __all__ = ["AmpScaler", "GradScaler"]
 
@@ -69,6 +71,17 @@ class AmpScaler:
         pass  # state already updated in step/minimize (paddle parity shim)
 
     def _update(self, found_inf: bool):
+        # skip visibility BEFORE the dynamic gate: a found_inf step is
+        # a silent no-op update whether or not the scale adapts. The
+        # counter is always-on (3am forensics); the gauge rides the
+        # normal metrics gate. TrainStep's in-graph scaler reports the
+        # same three signals itself (it never calls _update).
+        if found_inf:
+            _obs.counter("amp.loss_scale.skipped_total",
+                         _always=True).add(1)
+            _fr.record("loss_scale.skip", scale=float(self._scale))
+        if _obs._enabled:
+            _obs.gauge("amp.loss_scale.scale").set(float(self._scale))
         if not self._dynamic:
             return
         if found_inf:

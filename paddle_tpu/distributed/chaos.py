@@ -5,8 +5,12 @@ random moment can't be debugged or replayed in CI. This module reads a
 ``PD_CHAOS_*`` plan from the environment once and injects exactly one
 fault at exactly the named (rank, step):
 
-  PD_CHAOS_MODE     kill | stall | corrupt_ckpt | corrupt_swap
-                    (anything else: off; corrupt_swap is serving-only)
+  PD_CHAOS_MODE     kill | stall | corrupt_ckpt | corrupt_swap |
+                    nan_grad | flip_bit | scale_grad
+                    (empty/unset: off; any OTHER value raises — a
+                    typo'd drill that injects nothing would otherwise
+                    read as a passing receipt; corrupt_swap is
+                    serving-only, the numeric trio training-only)
   PD_CHAOS_STEP     step number to fire at (default 5) — the train
                     step for maybe_inject, the FLEET TICK for
                     maybe_inject_serving
@@ -17,6 +21,17 @@ fault at exactly the named (rank, step):
                     restarted worker survives, which is the drill)
   PD_CHAOS_STALL_S  stall duration in seconds (default 600: longer
                     than any heartbeat timeout, shorter than CI)
+  PD_CHAOS_SCOPE    numeric modes: only leaves whose name contains
+                    this substring are eligible (default: first leaf
+                    in sorted-name order)
+  PD_CHAOS_BIT      flip_bit: which bit of the victim f32 element to
+                    XOR (default 30 — a high exponent bit, the loud
+                    SDC; low mantissa bits model the quiet one)
+  PD_CHAOS_SCALE    scale_grad multiplier (default 1e4)
+
+Malformed values (an unparseable step/rank/bit/scale, an unknown
+mode) raise ValueError NAMING the offending variable at plan() time —
+a drill must fail loudly, never arm nothing and "pass".
 
 Faults:
   kill          SIGKILL self — no atexit, no flush, the preemption shape
@@ -25,6 +40,19 @@ Faults:
   corrupt_ckpt  overwrite the checkpoint payload with garbage, THEN
                 SIGKILL — the restart must survive restoring a corrupt
                 primary (checkpoint.load_sharded's manifest fallback)
+  nan_grad      poison one gradient element with NaN at the named
+                (rank, step) — the overflow-shaped numeric fault
+  flip_bit      XOR one bit of one PARAM element — the silent-data-
+                corruption shape: nothing crashes, training continues
+                on poisoned weights until the sentry's fingerprint
+                probe names the rank
+  scale_grad    multiply one gradient leaf by PD_CHAOS_SCALE — the
+                subtle-wrong-math shape the z-score detector exists for
+
+The numeric trio executes via a HOST CALLBACK the training loop owns
+(``maybe_inject_numeric`` names the fault, ``apply_numeric`` perturbs
+the host tree) so the sentry observes the corrupted values exactly as
+it would a real chip's.
 
 The injection point (``maybe_inject``) is called by the training loop
 once per step; it is a no-op (one env-parse-once dict read) when no
@@ -38,29 +66,38 @@ from __future__ import annotations
 import os
 import signal
 import time
-from typing import Optional
+from typing import Any, Dict, Optional
 
 from ..observability import flight_recorder as _fr
 
 __all__ = ["ChaosPlan", "plan", "maybe_inject", "maybe_inject_serving",
-           "reset_plan_cache"]
+           "maybe_inject_numeric", "apply_numeric", "reset_plan_cache",
+           "NUMERIC_MODES"]
 
 # training faults execute in-process (the worker IS the victim);
 # serving faults are RETURNED to the fleet, which applies them to the
-# named replica (a host-side engine object, not a process)
+# named replica (a host-side engine object, not a process); numeric
+# faults are RETURNED to the training loop, which applies them to the
+# named host tree via apply_numeric (the host callback the sentry sees)
 TRAIN_MODES = ("kill", "stall", "corrupt_ckpt")
 SERVING_MODES = ("kill", "stall", "corrupt_swap")
-MODES = tuple(dict.fromkeys(TRAIN_MODES + SERVING_MODES))
+NUMERIC_MODES = ("nan_grad", "flip_bit", "scale_grad")
+MODES = tuple(dict.fromkeys(TRAIN_MODES + SERVING_MODES
+                            + NUMERIC_MODES))
 
 
 class ChaosPlan:
     def __init__(self, mode: str, step: int, rank: int, every: bool,
-                 stall_s: float):
+                 stall_s: float, scope: str = "", bit: int = 30,
+                 scale: float = 1e4):
         self.mode = mode
         self.step = int(step)
         self.rank = int(rank)
         self.every = bool(every)
         self.stall_s = float(stall_s)
+        self.scope = str(scope)
+        self.bit = int(bit)
+        self.scale = float(scale)
 
     def __repr__(self):
         return (f"ChaosPlan(mode={self.mode!r}, step={self.step}, "
@@ -69,34 +106,69 @@ class ChaosPlan:
 
 _plan_cache: Optional[ChaosPlan] = None
 _plan_parsed = False
+_plan_error: Optional[ValueError] = None
+
+
+def _env(name: str, default: str, cast):
+    """Parse one PD_CHAOS_* variable, failing LOUDLY with the variable
+    named — a typo'd drill that silently arms nothing would inject
+    nothing and read as a passing receipt."""
+    raw = os.environ.get(name, default)
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"chaos plan: {name}={raw!r} is not a valid "
+            f"{cast.__name__}") from None
 
 
 def plan() -> Optional[ChaosPlan]:
     """The armed plan, parsed from the environment ONCE (a drill sets
     the env before exec; re-reading per step would let a mid-run env
-    mutation change the drill under CI's feet)."""
-    global _plan_cache, _plan_parsed
+    mutation change the drill under CI's feet). Malformed values —
+    including an unknown non-empty PD_CHAOS_MODE — raise ValueError
+    naming the offending variable."""
+    global _plan_cache, _plan_parsed, _plan_error
     if _plan_parsed:
+        if _plan_error is not None:
+            raise _plan_error  # every injection point fails loudly
         return _plan_cache
     _plan_parsed = True
-    mode = os.environ.get("PD_CHAOS_MODE", "").strip().lower()
-    if mode not in MODES:
-        _plan_cache = None
-        return None
-    _plan_cache = ChaosPlan(
-        mode=mode,
-        step=int(os.environ.get("PD_CHAOS_STEP", "5")),
-        rank=int(os.environ.get("PD_CHAOS_RANK", "1")),
-        every=os.environ.get("PD_CHAOS_EVERY", "") == "1",
-        stall_s=float(os.environ.get("PD_CHAOS_STALL_S", "600")))
+    try:
+        mode = os.environ.get("PD_CHAOS_MODE", "").strip().lower()
+        if not mode:
+            _plan_cache = None
+            return None
+        if mode not in MODES:
+            raise ValueError(
+                f"chaos plan: PD_CHAOS_MODE={mode!r} is not one of "
+                f"{sorted(MODES)} (unset/empty disarms)")
+        p = ChaosPlan(
+            mode=mode,
+            step=_env("PD_CHAOS_STEP", "5", int),
+            rank=_env("PD_CHAOS_RANK", "1", int),
+            every=os.environ.get("PD_CHAOS_EVERY", "") == "1",
+            stall_s=_env("PD_CHAOS_STALL_S", "600", float),
+            scope=os.environ.get("PD_CHAOS_SCOPE", ""),
+            bit=_env("PD_CHAOS_BIT", "30", int),
+            scale=_env("PD_CHAOS_SCALE", "1e4", float))
+        if not 0 <= p.bit <= 31:
+            raise ValueError(
+                f"chaos plan: PD_CHAOS_BIT={p.bit} outside [0, 31] "
+                "(one bit of an f32 element)")
+    except ValueError as e:
+        _plan_error = e
+        raise
+    _plan_cache = p
     return _plan_cache
 
 
 def reset_plan_cache():
     """Re-read the environment on the next plan() call (tests)."""
-    global _plan_cache, _plan_parsed
+    global _plan_cache, _plan_parsed, _plan_error
     _plan_cache = None
     _plan_parsed = False
+    _plan_error = None
 
 
 def _corrupt(path: str):
@@ -186,3 +258,82 @@ def maybe_inject_serving(tick: int, replica: int,
     _fr.record("chaos.inject", mode=p.mode, step=int(tick),
                rank=int(replica), scope="serving")
     return p.mode
+
+
+def maybe_inject_numeric(step: int, rank: Optional[int] = None,
+                         incarnation: Optional[int] = None
+                         ) -> Optional[str]:
+    """Numeric-fault poll: returns the armed NUMERIC mode when
+    (rank, step, incarnation) match the plan, else None. Like the
+    serving hook this RETURNS the mode instead of executing it — the
+    training loop owns the host trees, so it applies the fault via
+    ``apply_numeric`` at the exact point (post-backward grads,
+    post-update params) a real corrupted chip would have produced it,
+    and the sentry observes the poisoned values first-hand."""
+    p = plan()
+    if p is None or p.mode not in NUMERIC_MODES:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if incarnation is None:
+        incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    if rank != p.rank or int(step) != p.step:
+        return None
+    if incarnation != 0 and not p.every:
+        return None
+    _fr.record("chaos.inject", mode=p.mode, step=int(step),
+               rank=int(rank), scope="numeric")
+    return p.mode
+
+
+def _numeric_victim(tree: Dict[str, Any], scope: str) -> Optional[str]:
+    """The leaf the fault lands on: first (sorted) floating leaf whose
+    name contains `scope` (empty scope: any floating leaf)."""
+    import numpy as np
+    for name in sorted(tree):
+        if scope and scope not in name:
+            continue
+        if np.issubdtype(np.asarray(tree[name]).dtype, np.floating):
+            return name
+    return None
+
+
+def apply_numeric(tree: Dict[str, Any], mode: str,
+                  plan_: Optional[ChaosPlan] = None) -> Dict[str, Any]:
+    """Apply a numeric fault to a host name->array dict, returning a
+    NEW dict (the caller assigns it back — the host-callback contract).
+    nan_grad: element 0 of the victim leaf becomes NaN. flip_bit: bit
+    PD_CHAOS_BIT of element 0's f32 bits is XORed (one flipped bit —
+    the literal SDC). scale_grad: the whole victim leaf is multiplied
+    by PD_CHAOS_SCALE. A fault that found no victim records a
+    ``chaos.numeric_miss`` breadcrumb (the corrupt-miss discipline: a
+    drill that injected nothing must not read as surviving one)."""
+    import numpy as np
+    p = plan_ or plan()
+    scope = p.scope if p is not None else ""
+    victim = _numeric_victim(tree, scope)
+    if victim is None:
+        _fr.record("chaos.numeric_miss", mode=mode, scope=scope)
+        return dict(tree)
+    out = dict(tree)
+    arr = np.array(np.asarray(out[victim]), copy=True)
+    flat = arr.reshape(-1)
+    if mode == "nan_grad":
+        flat[0] = np.nan
+    elif mode == "flip_bit":
+        bit = p.bit if p is not None else 30
+        # flip one bit of ELEMENT 0's f32 image and write back only
+        # that element — a whole-leaf f32 round-trip on a wider dtype
+        # would perturb every element, not the one-bit SDC shape the
+        # receipt names
+        e0 = flat[:1].astype(np.float32)
+        e0.view(np.uint32)[0] ^= np.uint32(1 << bit)
+        flat[0] = e0.astype(flat.dtype)[0]
+    elif mode == "scale_grad":
+        flat *= np.asarray(p.scale if p is not None else 1e4,
+                           flat.dtype)
+    else:
+        raise ValueError(f"apply_numeric: unknown mode {mode!r}")
+    out[victim] = arr
+    _fr.record("chaos.numeric_hit", mode=mode, leaf=victim)
+    return out

@@ -50,9 +50,10 @@ from ..observability import metrics as _obs
 from .. import serialization
 
 __all__ = ["save_sharded", "load_sharded", "load_with_topology",
-           "load_at_or_before", "wait_pending", "topology_manifest",
-           "load_topology", "DataShardCursor", "train_epoch_range",
-           "AutoCheckpoint", "MANIFEST_NAME", "TOPOLOGY_NAME"]
+           "load_at_or_before", "candidate_healthy", "decertify_after",
+           "wait_pending", "topology_manifest", "load_topology",
+           "DataShardCursor", "train_epoch_range", "AutoCheckpoint",
+           "MANIFEST_NAME", "TOPOLOGY_NAME"]
 
 MANIFEST_NAME = "PD_MANIFEST.json"
 TOPOLOGY_NAME = "PD_TOPOLOGY.json"
@@ -166,12 +167,22 @@ def _read_json(path: str) -> Optional[dict]:
 def topology_manifest(step: int, data_cursor: Optional[dict] = None,
                       mesh=None, dp: Optional[int] = None,
                       global_batch: Optional[int] = None,
-                      extra: Optional[dict] = None) -> dict:
+                      extra: Optional[dict] = None,
+                      health: Optional[dict] = None) -> dict:
     """Build the topology manifest saved next to the arrays: everything
     a DIFFERENTLY-shaped resume needs that the arrays themselves don't
     carry. `data_cursor` is a DataShardCursor.state_dict() (or any
-    dict); dp defaults to jax.process_count() when a mesh isn't given."""
+    dict); dp defaults to jax.process_count() when a mesh isn't given.
+
+    `health` is the numeric-integrity certification
+    (observability.sentry.SentryMonitor.health_stamp(): step, loss
+    finite, anomaly-clean window, fingerprint, healthy) — the stamp
+    ``load_at_or_before(require_healthy=True)`` walks for, so a
+    rollback after an SDC lands on a checkpoint *proven* good, never
+    merely the newest."""
     doc: Dict[str, Any] = {"version": 1, "step": int(step)}
+    if health is not None:
+        doc["health"] = dict(health)
     if mesh is not None:
         doc["mesh_shape"] = dict(
             zip([str(a) for a in mesh.axis_names], mesh.devices.shape))
@@ -473,10 +484,15 @@ def load_with_topology(path: str, target: Optional[dict] = None
     return out, _candidate_topology(cand)
 
 
+def _topology_sidecar(cand: str) -> str:
+    """Where a candidate's topology manifest lives (ONE path rule —
+    decertify_after rewrites what _candidate_topology reads)."""
+    return (os.path.join(cand, TOPOLOGY_NAME) if os.path.isdir(cand)
+            else cand + ".topology.json")
+
+
 def _candidate_topology(cand: str) -> Optional[dict]:
-    return _read_json(os.path.join(cand, TOPOLOGY_NAME)
-                      if os.path.isdir(cand)
-                      else cand + ".topology.json")
+    return _read_json(_topology_sidecar(cand))
 
 
 def load_topology(path: str) -> Optional[dict]:
@@ -505,9 +521,73 @@ def load_topology(path: str) -> Optional[dict]:
     return None
 
 
+def decertify_after(path: str, step: int,
+                    reason: str = "fingerprint_divergence") -> int:
+    """Mark every candidate of `path` whose topology step is GREATER
+    than `step` as unhealthy, in place. Returns how many were
+    decertified.
+
+    A truly quiet param flip records no stat anomaly, so checkpoints
+    committed between the fault and the probe that confirms it carry
+    healthy stamps over poisoned weights — and a rank that respawns in
+    place (gang/rank policy, no eviction) would walk straight back
+    onto them and quarantine-loop. The rank that self-quarantines on a
+    fingerprint divergence therefore decertifies its OWN candidates
+    newer than the last probe at which the replicas agreed (the only
+    step whose params are cross-replica-confirmed), before it exits.
+    Safe single-writer: only the quarantining rank touches its own
+    slot's sidecars."""
+    ocp = _orbax()
+    n = 0
+    for cand in _load_candidates(path, is_dir=ocp is not None):
+        side = _topology_sidecar(cand)
+        doc = _read_json(side)
+        if doc is None or doc.get("step") is None:
+            continue
+        if int(doc["step"]) <= int(step):
+            continue
+        health = dict(doc.get("health") or {})
+        if not health.get("healthy"):
+            continue
+        health["healthy"] = False
+        health["decertified"] = reason
+        doc["health"] = health
+        tmp = side + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, side)
+        except OSError as e:
+            # a certified-but-poisoned candidate we FAILED to demote
+            # is exactly the quarantine-loop hazard this function
+            # exists to close — say so loudly, like every other
+            # failure path in this module
+            _obs.counter("checkpoint.decertify_failures_total",
+                         _always=True).add(1)
+            _fr.record("ckpt.decertify_failed", path=cand,
+                       error=str(e)[:200])
+            continue
+        n += 1
+        _obs.counter("checkpoint.decertified_total",
+                     _always=True).add(1)
+        _fr.record("ckpt.decertified", path=cand,
+                   step=int(doc["step"]), reason=reason)
+    return n
+
+
+def candidate_healthy(topo: Optional[dict]) -> bool:
+    """Is this candidate CERTIFIED numerically good? Only an explicit
+    healthy sentry stamp counts — a stamp-less checkpoint (sentry not
+    armed) is not certified, and a require_healthy walk skips it in
+    the first pass (falling back loudly rather than failing)."""
+    return bool(((topo or {}).get("health") or {}).get("healthy"))
+
+
 def load_at_or_before(path: str, step: int,
                       target: Optional[dict] = None,
-                      best_effort: bool = True) -> Tuple[dict, dict]:
+                      best_effort: bool = True,
+                      require_healthy: bool = False
+                      ) -> Tuple[dict, dict]:
     """Restore the newest candidate whose topology step is <= `step`
     — the CONSISTENT-CUT rollback for per-rank checkpoints. When a
     rank is EVICTED mid-step, survivors may have committed steps the
@@ -523,44 +603,91 @@ def load_at_or_before(path: str, step: int,
     verifiable candidate and record the uncovered gap as a
     ``ckpt.rollback_gap`` flight-recorder event + always-on counter —
     partial data loss, reported loudly, instead of an unrecoverable
-    job. Returns (state, topology)."""
+    job. Returns (state, topology).
+
+    require_healthy=True: the NUMERIC rollback — only candidates whose
+    topology carries a healthy sentry stamp (``candidate_healthy``)
+    are eligible in the first pass, so a poisoned-but-committed
+    checkpoint (an SDC that trained into the weights before the sentry
+    confirmed it) is walked past, with the skip recorded loudly
+    (``checkpoint.unhealthy_skips_total`` + ``ckpt.unhealthy_skipped``).
+    When NO certified candidate survives the walk, a second pass
+    accepts uncertified ones (best-effort recovery beats an
+    unrecoverable job), recording ``checkpoint.unhealthy_fallbacks_total``
+    + ``ckpt.unhealthy_fallback`` — the operator's cue that the resume
+    point is uncertified."""
     ocp = _orbax()
     last_err: Optional[BaseException] = None
     too_new: List[Tuple[str, dict]] = []  # newest-first
-    for cand in _load_candidates(path, is_dir=ocp is not None):
-        topo = _candidate_topology(cand)
-        if topo is None or topo.get("step") is None:
-            continue
-        if int(topo["step"]) > int(step):
-            too_new.append((cand, topo))
-            continue
+    failed: set = set()  # candidates that already failed a restore —
+    #                      retrying in a later pass would double-count
+    #                      corruptions and waste a full restore
+
+    def _try_restore(cand):
+        nonlocal last_err
+        if cand in failed:
+            return None
         try:
-            out = _restore_one(cand, target, ocp)
+            return _restore_one(cand, target, ocp)
         except Exception as e:
+            failed.add(cand)
             last_err = e
             _obs.counter("checkpoint.corruptions_total",
                          _always=True).add(1)
             _fr.record("ckpt.corrupt", path=cand, error=str(e)[:200])
-            continue
-        return out, topo
-    if best_effort:
-        # oldest too-new candidate first (smallest gap); a corrupt one
-        # falls through to the next, same discipline as the main walk
-        for cand, topo in reversed(too_new):
-            try:
-                out = _restore_one(cand, target, ocp)
-            except Exception as e:
-                last_err = e
-                _obs.counter("checkpoint.corruptions_total",
+            return None
+
+    def _note_uncertified(cand, topo):
+        if require_healthy and not candidate_healthy(topo):
+            _obs.counter("checkpoint.unhealthy_fallbacks_total",
+                         _always=True).add(1)
+            _fr.record("ckpt.unhealthy_fallback", path=cand,
+                       step=int(topo["step"]))
+
+    passes = [True, False] if require_healthy else [False]
+    for healthy_only in passes:
+        for cand in _load_candidates(path, is_dir=ocp is not None):
+            topo = _candidate_topology(cand)
+            if topo is None or topo.get("step") is None:
+                continue
+            if int(topo["step"]) > int(step):
+                if healthy_only or not require_healthy:
+                    too_new.append((cand, topo))
+                continue
+            if healthy_only and not candidate_healthy(topo):
+                _obs.counter("checkpoint.unhealthy_skips_total",
                              _always=True).add(1)
-                _fr.record("ckpt.corrupt", path=cand,
-                           error=str(e)[:200])
+                _fr.record("ckpt.unhealthy_skipped", path=cand,
+                           step=int(topo["step"]))
+                continue
+            out = _try_restore(cand)
+            if out is None:
+                continue
+            _note_uncertified(cand, topo)
+            return out, topo
+    if best_effort:
+        # oldest too-new candidate first (smallest gap); under
+        # require_healthy, CERTIFIED too-new candidates outrank
+        # uncertified ones (an uncertified landing is still possible —
+        # recovery beats an unrecoverable job — but it is counted and
+        # recorded, never silent); a corrupt one falls through to the
+        # next, same discipline as the main walk
+        gap_cands = list(reversed(too_new))
+        if require_healthy:
+            gap_cands = (
+                [c for c in gap_cands if candidate_healthy(c[1])]
+                + [c for c in gap_cands
+                   if not candidate_healthy(c[1])])
+        for cand, topo in gap_cands:
+            out = _try_restore(cand)
+            if out is None:
                 continue
             _obs.counter("checkpoint.rollback_gaps_total",
                          _always=True).add(1)
             _fr.record("ckpt.rollback_gap", path=cand,
                        wanted_step=int(step),
                        got_step=int(topo["step"]))
+            _note_uncertified(cand, topo)
             return out, topo
     raise RuntimeError(
         f"no checkpoint at or before step {step} under {path} — the "

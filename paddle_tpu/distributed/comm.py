@@ -58,7 +58,7 @@ from .env import DATA_AXIS, current_axis_name
 
 __all__ = ["CommConfig", "GradSynchronizer", "planned_all_reduce",
            "choose_algorithm", "build_buckets", "flatten_bucket",
-           "unflatten_bucket"]
+           "unflatten_bucket", "purge_residual_state"]
 
 _MiB = 1 << 20
 _COMPRESS = ("f32", "bf16", "int8_ef")
@@ -507,6 +507,23 @@ def planned_all_reduce(tensor, config: Optional[CommConfig] = None,
     if isinstance(tensor, Tensor):
         return _mirror_into(tensor, out)
     return out
+
+
+def purge_residual_state(state: Dict[str, Any]) -> int:
+    """Drop every int8-EF ``residual_*`` entry from a strategy-state
+    dict IN PLACE, returning how many were removed. The residuals are
+    time-coupled to the params they quantized: after a checkpoint
+    rollback they MUST come from the same restored candidate as the
+    params — a rollback that keeps live residuals re-injects
+    quantization error from a future the restored params never saw,
+    silently breaking the error-feedback time-mean unbiasedness.
+    Restore flows that land a candidate WITHOUT strategy state call
+    this so the next sync restarts the residuals from zero (a reset is
+    unbiased; a stale residual is not)."""
+    stale = [k for k in state if k.startswith("residual_")]
+    for k in stale:
+        del state[k]
+    return len(stale)
 
 
 class GradSynchronizer:

@@ -64,8 +64,12 @@ NONE_VERDICT = {"kind": "none", "rank": None, "source": "doctor",
 
 # verdict kinds that name a culpable rank precisely enough to evict it;
 # a straggler or recompile storm is a cost, not a fault — respawn, don't
-# shrink
-_EVICTABLE = ("divergence", "hang", "heartbeat_stall", "crash")
+# shrink. "numeric" is the sentry's SDC verdict: the named chip's
+# arithmetic diverged (fingerprint minority vote / first stat spike) —
+# quarantine it, and roll the survivors back to a HEALTH-STAMPED
+# checkpoint (launch.py sets PD_ROLLBACK_HEALTHY for the bounce)
+_EVICTABLE = ("divergence", "hang", "heartbeat_stall", "crash",
+              "numeric")
 
 # autoscale actions the SERVING mode adds (decide_scale): the fleet
 # spawns the named slot on scale_up and DRAINS it on scale_down

@@ -208,6 +208,12 @@ def _elastic_supervise(args, world) -> int:
     # from a previous incarnation can never satisfy (and so void) the
     # lock-step barrier after a rollback
     gang_epoch = {"v": 0}
+    # set for the bounce remediating a NUMERIC verdict: silent data
+    # corruption may have trained into checkpoints committed after the
+    # fault, so the resume must land on a health-STAMPED candidate
+    # (checkpoint.load_at_or_before(require_healthy=True)), never
+    # merely the newest
+    rollback_healthy = {"v": ""}
 
     def spawn_slot(lr):
         # PADDLE_TRAINER_ID is the CONTIGUOUS rank in the current
@@ -221,7 +227,8 @@ def _elastic_supervise(args, world) -> int:
                            PADDLE_TRAINERS_NUM=str(len(ranks)),
                            PD_SLOT_ID=str(lr),
                            PD_GANG_EPOCH=str(gang_epoch["v"]),
-                           PD_GONE_SLOTS=gone_slots["v"]))
+                           PD_GONE_SLOTS=gone_slots["v"],
+                           PD_ROLLBACK_HEALTHY=rollback_healthy["v"]))
 
     def bounce_gang(monitor):
         # collective jobs can't re-admit one rank: bounce the gang;
@@ -354,6 +361,14 @@ def _elastic_supervise(args, world) -> int:
                       "before respawn", file=sys.stderr)
                 time.sleep(decision.delay_s)
             policy.record_respawn()
+            # NUMERIC remediation: whatever the action (quarantine-
+            # evict or gang respawn), the resuming workers must walk
+            # to a health-stamped checkpoint — corruption may have
+            # been committed before the sentry confirmed it
+            if decision.verdict.get("kind") == "numeric":
+                rollback_healthy["v"] = "1"
+                print("[elastic] numeric verdict: resume requires a "
+                      "health-stamped checkpoint", file=sys.stderr)
             if decision.action == "evict_shrink":
                 print(f"[elastic] evicting rank(s) {decision.ranks}; "
                       f"gang shrinks {world_before} -> "
@@ -369,6 +384,7 @@ def _elastic_supervise(args, world) -> int:
                                            for r in decision.ranks)
                 monitor = bounce_gang(monitor)
                 gone_slots["v"] = ""
+                rollback_healthy["v"] = ""
             elif decision.action == "respawn_rank" and not gang_down:
                 since_ts["v"] = time.time()
                 for lr in decision.ranks:
@@ -376,8 +392,14 @@ def _elastic_supervise(args, world) -> int:
                     incarnation[lr] += 1
                     monitor.revive(lr)
                     procs[lr] = spawn_slot(lr)
+                # the health requirement applies to THIS episode's
+                # respawns only — a later unrelated crash must not
+                # inherit it (stamp-less fleets would spuriously walk
+                # the uncertified-fallback path forever)
+                rollback_healthy["v"] = ""
             else:  # respawn_gang (or the gang was already taken down)
                 monitor = bounce_gang(monitor)
+                rollback_healthy["v"] = ""
             gp = bundle.get("goodput")
             delta = None
             if gp and prev_goodput:

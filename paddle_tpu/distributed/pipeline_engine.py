@@ -513,7 +513,7 @@ class PipelineParallel:
                  optimizer, num_micro: int = 1, mesh: Optional[Mesh] = None,
                  pp_axis: str = "pp", schedule: str = "1f1b",
                  param_spec_fn=None, virtual_pipeline_degree: int = 1,
-                 exec_mode: str = "dispatch"):
+                 exec_mode: str = "dispatch", sentry=None):
         assert len(stages) >= 1
         if exec_mode not in ("dispatch", "spmd_1f1b"):
             raise ValueError(
@@ -527,6 +527,13 @@ class PipelineParallel:
         self.num_micro = int(num_micro)
         self.schedule_policy = schedule
         self.optimizer = optimizer
+        # numeric-integrity sentry (observability.sentry): per-scope
+        # grad/param stats compiled into the one spmd_1f1b program as
+        # scalar outputs (the every-K fingerprint probe is a TrainStep/
+        # worker surface — the spmd step carries no step counter).
+        # None = program unchanged. spmd-only; the dispatch engine's
+        # per-stage programs keep their own eager visibility.
+        self.sentry = sentry
         self.last_tick_ms: List[float] = []  # host ms per schedule op
         if exec_mode == "spmd_1f1b":
             self._init_spmd(stages, loss_fn, optimizer, mesh, pp_axis,
@@ -930,7 +937,18 @@ class PipelineParallel:
                         new, old)
                     new_p = keep(new_p, stacked)
                     new_st = keep(new_st, opt_state)
-            return new_p, new_st, loss, found_inf
+            sentry_out = {}
+            if self.sentry is not None:
+                from ..observability.sentry import stats_by_scope
+                # pre-optimizer grads (this engine's grads are already
+                # stage-stacked; pre-sync per-rank attribution needs
+                # the TrainStep/worker path) + post-select params
+                sentry_out = {
+                    "grad": stats_by_scope(grads),
+                    "param": stats_by_scope(new_p),
+                    "loss_finite": jnp.isfinite(loss),
+                }
+            return new_p, new_st, loss, found_inf, sentry_out
 
         return jax.jit(step, donate_argnums=(0, 1))
 
@@ -1067,7 +1085,7 @@ class PipelineParallel:
         _rec = _obs._enabled
         _t0 = time.perf_counter() if _rec else 0.0
         _tok = _fr.step_begin("pipeline_spmd", self._step_count)
-        self.params, self.opt_state, loss, found_inf = step(
+        self.params, self.opt_state, loss, found_inf, sentry_out = step(
             self.params, self.opt_state, next_key(), lr, scale_val,
             x, lbl)
         if _tok is not None and _fr.sync_steps():
@@ -1093,6 +1111,8 @@ class PipelineParallel:
         self.recompile_sentinel.observe(
             self.compile_count, expected=len(self._spmd_steps),
             signature=signature_of((x, lbl, scale_val, lr)))
+        if self.sentry is not None:
+            self.sentry.consume(self._step_count - 1, sentry_out)
         if use_scaler:
             # ONE host bool per step, read after the step is dispatched
             scaler._update(bool(np.asarray(found_inf)))

@@ -132,7 +132,7 @@ class ShardingPlan:
         """(in_shardings, out_shardings) for TrainStep._build's step fn
         signature:
             step(params, opt_state, buffers, strat, key, lr, inputs, labels)
-              -> (params, opt_state, buffers, strat, loss)
+              -> (params, opt_state, buffers, strat, loss, extras)
         The inputs/labels shardings are appended by TrainStep at first call
         (structure unknown until then) via data_spec()."""
         params = train_step.params
@@ -171,8 +171,10 @@ class ShardingPlan:
 
         in_shardings = (p_shard, opt_shard, buf_shard, strat_sh,
                         self.replicated(), self.replicated())
+        # extras (amp skip flag / sentry scalars) are tiny replicated
+        # scalars riding the step outputs
         out_shardings = (p_shard, opt_shard, buf_shard, strat_sh,
-                         self.replicated())
+                         self.replicated(), self.replicated())
         return in_shardings, out_shardings
 
     def place(self, array, spec: P):

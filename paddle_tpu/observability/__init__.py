@@ -41,6 +41,13 @@ TPU-pod training job needs on top of raw counters:
                    requeue/swap_flip), the explain_tail attribution
                    engine, chrome-trace request lanes, and the SLO
                    error-budget BurnMeter
+  sentry           numeric integrity: in-graph per-scope grad/param
+                   stats + every-K param-bit fingerprints riding the
+                   one step program, a rolling z-score monitor
+                   (sentry.anomaly events, always-on counters),
+                   cross-replica fingerprint agreement naming the
+                   SDC rank, checkpoint health stamps, and fault
+                   captures for tools/replay_triage.py
 
 Everything is off by default: `metrics.enable()` turns the counter hot
 paths on, `flight_recorder.enable()` arms the forensics plane (events +
@@ -57,6 +64,7 @@ from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import reqtrace  # noqa: F401
+from . import sentry  # noqa: F401
 from . import mfu  # noqa: F401
 from . import sentinel  # noqa: F401
 from . import watchdog  # noqa: F401
@@ -70,7 +78,7 @@ from .watchdog import HangWatchdog  # noqa: F401
 __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
     "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
-    "reqtrace",
+    "reqtrace", "sentry",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",

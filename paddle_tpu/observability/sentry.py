@@ -1,0 +1,663 @@
+"""Numeric integrity sentry: in-graph SDC detection for the fused step.
+
+A TPU fleet's quietest failure is the one that trains: a flipped bit in
+a gradient, a chip whose matmuls are subtly wrong, a poisoned int8-EF
+residual — none of them crash, none of them hang, and PR 8's rollback
+machinery would happily restore a checkpoint that was already poisoned.
+The loss-scale skip branch (amp/functional.py) catches whole-step
+overflow and nothing else. This module is the rest of the defense:
+
+1. **In-graph statistics** (``stats_by_scope``) — per-scope nonfinite
+   counts, max-abs and L2 norms over the grad/param pytrees, computed
+   INSIDE the jitted TrainStep / spmd_1f1b program as a handful of
+   scalar outputs riding the existing step results: zero extra
+   dispatches, zero new executables (RecompileSentinel still pins
+   ``train_executables == 1``). Scopes reuse ``anatomy.CORE_SCOPES``
+   via a param-name token map (``scope_of_param``) so the sentry's
+   rows line up with the anatomy plane's.
+
+2. **Cross-replica agreement probe** (``fingerprint_tree``) — post-sync
+   params are bit-identical across dp replicas *by contract*, so a
+   cheap order-sensitive uint32 fingerprint of the param bits, taken
+   every K steps in-graph and compared across ranks, names the chip
+   whose arithmetic diverged — the classic TPU SDC tell.
+   ``host_fingerprint`` is the bit-exact numpy twin (pinned equal in
+   tests) so eager workers and post-hoc triage compute the same value.
+
+3. **Host-side spike detection** (``SentryMonitor``) — a rolling
+   z-score detector over every stat stream. Anomalies become
+   ``sentry.anomaly`` flight-recorder events plus the always-on
+   ``sentry.anomalies_total`` counter; streams publish as gated
+   ``sentry.*`` gauges. The monitor also owns the **health stamp**
+   (step, loss finite, anomaly-clean window, fingerprint) that
+   ``checkpoint.save_sharded`` buries in the topology manifest and
+   ``load_at_or_before(require_healthy=True)`` walks for — rollback
+   lands on the newest *certified-good* candidate, never merely the
+   newest.
+
+4. **Fault captures** (``write_fault_capture``) — on a fatal fault the
+   worker snapshots (params, batch, rng, observed stats) so
+   ``tools/replay_triage.py`` can re-execute the step and classify the
+   fault: *reproducible* (software bug — file it) vs *transient*
+   (SDC — quarantine the chip).
+
+Everything is opt-in: a ``TrainStep`` without ``sentry=`` emits the
+exact same program as before (the gate-down guard tests pin this).
+jax is imported lazily so the host-side monitor/triage paths stay
+importable on boxes without it (flight-recorder discipline).
+"""
+from __future__ import annotations
+
+import collections
+import math
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from . import flight_recorder as _fr
+from . import metrics as _obs
+
+__all__ = [
+    "SentryConfig", "NumericSentry", "SentryMonitor", "NumericFault",
+    "scope_of_param", "stats_by_scope", "fingerprint_tree",
+    "host_fingerprint", "host_stats_by_scope",
+    "write_fault_capture", "load_fault_capture",
+]
+
+_jnp = None
+
+
+def _get_jnp():
+    global _jnp
+    if _jnp is None:
+        import jax.numpy as jnp
+        _jnp = jnp
+    return _jnp
+
+
+# -- scope mapping ------------------------------------------------------------
+
+# param-name tokens -> anatomy.CORE_SCOPES buckets (first hit wins,
+# longest-prefix style: specific head/embedding tokens before the
+# generic attn/mlp ones). Unmatched names fall into "other" so the
+# stat table always partitions the tree.
+_SCOPE_TOKENS: Tuple[Tuple[str, str], ...] = (
+    ("embed", "embed"), ("embedding", "embed"), ("pos_", "embed"),
+    ("mlm", "mlm_head_ce"), ("lm_head", "mlm_head_ce"),
+    ("decoder", "mlm_head_ce"), ("cls", "mlm_head_ce"),
+    ("attn", "attn"), ("attention", "attn"), ("q_proj", "attn"),
+    ("k_proj", "attn"), ("v_proj", "attn"), ("qkv", "attn"),
+    ("out_proj", "attn"),
+    ("mlp", "mlp"), ("ffn", "mlp"), ("fc", "mlp"), ("linear", "mlp"),
+    ("expert", "mlp"),
+)
+
+
+def scope_of_param(name: str) -> str:
+    """Map a param name onto the anatomy taxonomy (CORE_SCOPES) by
+    name tokens; unmatched names bucket under "other"."""
+    low = name.lower()
+    for token, scope in _SCOPE_TOKENS:
+        if token in low:
+            return scope
+    return "other"
+
+
+# -- in-graph statistics ------------------------------------------------------
+
+def _is_inexact(leaf) -> bool:
+    return np.issubdtype(np.asarray(leaf).dtype
+                         if not hasattr(leaf, "dtype") else leaf.dtype,
+                         np.inexact)
+
+
+def stats_by_scope(tree: Mapping[str, Any],
+                   scope_fn=scope_of_param) -> Dict[str, Dict[str, Any]]:
+    """Per-scope {nonfinite, max_abs, l2} over a flat name->array dict,
+    as traced scalars — usable inside jit (the step program) and
+    eagerly. Non-floating leaves are skipped (their bits can't go
+    nonfinite and their magnitudes aren't gradient-like)."""
+    jnp = _get_jnp()
+    groups: Dict[str, List[Any]] = {}
+    for name in sorted(tree):
+        leaf = tree[name]
+        if not _is_inexact(leaf):
+            continue
+        groups.setdefault(scope_fn(name), []).append(leaf)
+    out: Dict[str, Dict[str, Any]] = {}
+    for scope_name in sorted(groups):
+        nonfinite = jnp.asarray(0, jnp.int32)
+        max_abs = jnp.asarray(0.0, jnp.float32)
+        l2sq = jnp.asarray(0.0, jnp.float32)
+        for leaf in groups[scope_name]:
+            if np.prod(np.shape(leaf), dtype=int) == 0:
+                continue  # zero-size leaf: jnp.max would reject it
+            f = jnp.asarray(leaf).astype(jnp.float32)
+            nonfinite = nonfinite + jnp.sum(
+                ~jnp.isfinite(f)).astype(jnp.int32)
+            # nan-proof the magnitude streams: a single nan would turn
+            # max/l2 into nan and blind the z-score detector to the
+            # very spike it should be reporting — the nonfinite
+            # counter already carries the nan evidence
+            f = jnp.where(jnp.isfinite(f), f, 0.0)
+            max_abs = jnp.maximum(max_abs, jnp.max(jnp.abs(f)))
+            l2sq = l2sq + jnp.sum(f * f)
+        out[scope_name] = {"nonfinite": nonfinite, "max_abs": max_abs,
+                           "l2": jnp.sqrt(l2sq)}
+    return out
+
+
+def host_stats_by_scope(tree: Mapping[str, Any],
+                        scope_fn=scope_of_param
+                        ) -> Dict[str, Dict[str, float]]:
+    """Numpy twin of ``stats_by_scope`` for eager workers (same rows,
+    plain floats)."""
+    groups: Dict[str, List[np.ndarray]] = {}
+    for name in sorted(tree):
+        arr = np.asarray(tree[name])
+        if not np.issubdtype(arr.dtype, np.inexact):
+            continue
+        groups.setdefault(scope_fn(name), []).append(arr)
+    out: Dict[str, Dict[str, float]] = {}
+    for scope_name in sorted(groups):
+        nonfinite, max_abs, l2sq = 0, 0.0, 0.0
+        for arr in groups[scope_name]:
+            # f64 accumulation: a poisoned leaf near f32-max must not
+            # overflow the l2 stream into inf (which would wedge the
+            # z-score window for a whole window length)
+            f = arr.astype(np.float64)
+            finite = np.isfinite(f)
+            nonfinite += int((~finite).sum())
+            f = np.where(finite, f, 0.0)
+            if f.size:
+                with np.errstate(over="ignore"):
+                    max_abs = max(max_abs, float(np.max(np.abs(f))))
+                    l2sq += float(np.sum(f * f))
+        out[scope_name] = {"nonfinite": nonfinite, "max_abs": max_abs,
+                           "l2": math.sqrt(l2sq)}
+    return out
+
+
+# -- fingerprints -------------------------------------------------------------
+
+_FP_MULT = 1000003  # FNV-ish odd multiplier; uint32 wraparound is the mod
+
+
+def _leaf_bits_u32(arr):
+    """Bitcast a traced array to uint32 lanes (f32 exact; narrower
+    floats widen via their uint twin; ints reinterpret mod 2**32)."""
+    import jax
+    jnp = _get_jnp()
+    a = jnp.reshape(jnp.asarray(arr), (-1,))
+    if a.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(a, jnp.uint32)
+    if a.dtype.itemsize == 2:
+        return jax.lax.bitcast_convert_type(
+            a, jnp.uint16).astype(jnp.uint32)
+    if a.dtype.itemsize == 1:
+        return jax.lax.bitcast_convert_type(
+            a, jnp.uint8).astype(jnp.uint32)
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        # f64 etc: fingerprint the f32 projection (bit-identical
+        # replicas stay bit-identical through a deterministic cast)
+        return jax.lax.bitcast_convert_type(
+            a.astype(jnp.float32), jnp.uint32)
+    return a.astype(jnp.uint32)
+
+
+def fingerprint_tree(tree: Mapping[str, Any]):
+    """Order-sensitive uint32 fingerprint of a flat name->array dict,
+    computable in-graph (traced) — the cross-replica agreement probe.
+    Replicas holding bit-identical params produce identical values;
+    any flipped bit changes it. ``host_fingerprint`` is the bit-exact
+    numpy twin."""
+    jnp = _get_jnp()
+    fp = jnp.asarray(2166136261, jnp.uint32)
+    mult = jnp.asarray(_FP_MULT, jnp.uint32)
+    for name in sorted(tree):
+        leaf_sum = jnp.sum(_leaf_bits_u32(tree[name]), dtype=jnp.uint32)
+        fp = fp * mult + leaf_sum
+    return fp
+
+
+def _host_leaf_bits_u32(arr: np.ndarray) -> np.ndarray:
+    a = np.asarray(arr)
+    if a.dtype == np.float32:
+        return np.ascontiguousarray(a).reshape(-1).view(np.uint32)
+    if a.dtype.itemsize == 2:
+        return np.ascontiguousarray(a).reshape(-1).view(
+            np.uint16).astype(np.uint32)
+    if a.dtype.itemsize == 1:
+        return np.ascontiguousarray(a).reshape(-1).view(
+            np.uint8).astype(np.uint32)
+    if np.issubdtype(a.dtype, np.floating):
+        return np.ascontiguousarray(a.astype(np.float32)).reshape(
+            -1).view(np.uint32)
+    return a.reshape(-1).astype(np.uint32)
+
+
+def host_fingerprint(tree: Mapping[str, Any]) -> int:
+    """Numpy twin of ``fingerprint_tree`` — same value, plain int."""
+    fp = 2166136261
+    for name in sorted(tree):
+        leaf_sum = int(np.sum(_host_leaf_bits_u32(tree[name]),
+                              dtype=np.uint64) & 0xFFFFFFFF)
+        fp = (fp * _FP_MULT + leaf_sum) & 0xFFFFFFFF
+    return fp
+
+
+# -- configuration ------------------------------------------------------------
+
+@dataclass
+class SentryConfig:
+    """Knobs for the sentry. ``fingerprint_every``: the in-graph probe
+    period K (0 disables the probe). ``window``/``z_threshold``: the
+    rolling spike detector. ``min_clean_for_healthy``: how many
+    consecutive anomaly-free observations certify a checkpoint."""
+    fingerprint_every: int = 16
+    window: int = 16
+    z_threshold: float = 8.0
+    min_warmup: int = 4          # observations before z-scores arm
+    min_clean_for_healthy: int = 1
+    fatal_nonfinite: bool = False   # raise NumericFault on nonfinite
+    fatal_spike: bool = False       # ... and on a param-stream spike
+
+
+class NumericFault(RuntimeError):
+    """A fatal numeric-integrity violation the policy asked to halt on.
+    Carries the anomaly record so the quarantine path (capture + black
+    box + exit) can attach the evidence."""
+
+    def __init__(self, reason: str, anomaly: Optional[dict] = None):
+        super().__init__(reason)
+        self.anomaly = dict(anomaly or {})
+
+
+# -- the host-side monitor ----------------------------------------------------
+
+class SentryMonitor:
+    """Rolling z-score spike detector + health bookkeeping over the
+    sentry's stat streams. One instance per training process; feed it
+    ``observe(step, stats, loss=...)`` each step (stats = the host-side
+    values of ``stats_by_scope``'s output, grads and/or params), and
+    ``observe_fingerprint`` at probe steps. Anomalies are recorded
+    loudly (always-on counter + flight-recorder event) whether or not
+    the hot-path metrics gate is up."""
+
+    def __init__(self, config: Optional[SentryConfig] = None):
+        self.config = config or SentryConfig()
+        # stream key (scope, stat, kind) -> deque of recent values
+        self._windows: Dict[Tuple[str, str, str], collections.deque] = {}
+        self.anomalies: List[dict] = []
+        self.last_step: Optional[int] = None
+        self.last_loss_finite = True
+        self.last_fingerprint: Optional[int] = None
+        self.last_fingerprint_step: Optional[int] = None
+        self._prev_fingerprint_step: Optional[int] = None
+        # the newest probe step at which the replicas AGREED — the
+        # last step whose params are cross-replica-confirmed good.
+        # A quiet flip is invisible until a probe disagrees, so this
+        # is the only sound rollback bound for param-level corruption
+        self.last_agreed_probe_step: Optional[int] = None
+        self._clean_streak = 0
+        self._anomaly_steps: set = set()
+        self._last_streak_step: Optional[int] = None
+
+    # -- observations --------------------------------------------------
+    def _spike(self, key, value: float) -> Optional[float]:
+        """z-score of `value` against the stream's rolling window, when
+        it exceeds the threshold (None otherwise). The window is only
+        extended AFTER the check so a spike can't vouch for itself."""
+        cfg = self.config
+        win = self._windows.setdefault(
+            key, collections.deque(maxlen=max(2, cfg.window)))
+        z = None
+        if len(win) >= max(1, cfg.min_warmup):  # empty window can't
+            #                                     baseline anything
+            mean = sum(win) / len(win)
+            var = sum((v - mean) ** 2 for v in win) / len(win)
+            std = math.sqrt(var)
+            # exact-repeat streams (std == 0, e.g. a constant max-abs)
+            # still need a floor, or any change would divide by zero;
+            # the floor is relative so tiny streams aren't hair-trigger
+            floor = max(1e-12, 1e-6 * abs(mean))
+            z = abs(value - mean) / max(std, floor)
+            if z < cfg.z_threshold:
+                z = None
+        win.append(value)
+        return z
+
+    def _record_anomaly(self, step: int, kind: str, **fields) -> dict:
+        rec = {"step": int(step), "kind": kind, "ts": time.time()}
+        rec.update(fields)
+        self.anomalies.append(rec)
+        self._anomaly_steps.add(int(step))
+        self._clean_streak = 0
+        _obs.counter("sentry.anomalies_total", _always=True,
+                     kind=kind).add(1)
+        # the event's "kind" slot is the flight recorder's own; the
+        # anomaly class rides as "fault"
+        _fr.record("sentry.anomaly",
+                   **{("fault" if k == "kind" else k): v
+                      for k, v in rec.items() if k != "ts"})
+        return rec
+
+    def observe(self, step: int, stats: Mapping[str, Mapping[str, Any]],
+                kind: str = "grad", loss=None) -> List[dict]:
+        """Feed one step's per-scope stats (host values). `kind` labels
+        the stream family ("grad" for pre-sync gradient stats, "param"
+        for post-update params). Returns the anomalies flagged at this
+        step (also recorded). Raises NumericFault per the config's
+        fatal_* policy AFTER recording, so the black box always holds
+        the evidence first."""
+        cfg = self.config
+        self.last_step = int(step)
+        flagged: List[dict] = []
+        if loss is not None:
+            lf = bool(np.isfinite(np.asarray(loss)).all())
+            self.last_loss_finite = lf
+            if not lf:
+                flagged.append(self._record_anomaly(
+                    step, "loss_nonfinite", stream=f"{kind}.loss"))
+        clean = True
+        for scope_name in sorted(stats):
+            row = stats[scope_name]
+            nonfinite = int(np.asarray(row.get("nonfinite", 0)))
+            if nonfinite:
+                clean = False
+                flagged.append(self._record_anomaly(
+                    step, "nonfinite", scope=scope_name,
+                    stream=f"{kind}.nonfinite", count=nonfinite))
+            for stat in ("max_abs", "l2"):
+                if stat not in row:
+                    continue
+                v = float(np.asarray(row[stat]))
+                if _obs._enabled:
+                    _obs.gauge(f"sentry.{kind}_{stat}",
+                               scope=scope_name).set(v)
+                if not math.isfinite(v):
+                    # an inf/nan magnitude (e.g. the in-graph f32 l2
+                    # overflowing on a near-f32-max poisoned leaf) is
+                    # an anomaly in itself and must NEVER enter the
+                    # rolling window — one inf would wedge the
+                    # mean/var at NaN for a whole window length
+                    clean = False
+                    flagged.append(self._record_anomaly(
+                        step, "spike", scope=scope_name,
+                        stream=f"{kind}.{stat}", value=v,
+                        z=float("inf")))
+                    continue
+                z = self._spike((scope_name, stat, kind), v)
+                if z is not None:
+                    clean = False
+                    flagged.append(self._record_anomaly(
+                        step, "spike", scope=scope_name,
+                        stream=f"{kind}.{stat}", value=v,
+                        z=round(z, 2)))
+        # one streak tick per STEP, not per observe() call (grad and
+        # param streams report the same step separately)
+        if clean and int(step) not in self._anomaly_steps \
+                and self._last_streak_step != int(step):
+            self._clean_streak += 1
+            self._last_streak_step = int(step)
+        if _obs._enabled:
+            _obs.gauge("sentry.clean_window").set(self._clean_streak)
+        fatal = None
+        if cfg.fatal_nonfinite:
+            # grad/loss nonfinites halt immediately (the update would
+            # poison the weights); a nonfinite PARAM means the weights
+            # already are — that path quarantines via the fingerprint
+            # probe's cross-replica confirmation, not a lone halt
+            fatal = next((a for a in flagged
+                          if a["kind"] in ("nonfinite",
+                                           "loss_nonfinite")
+                          and not str(a.get("stream", "")
+                                      ).startswith("param.")), None)
+        if fatal is None and cfg.fatal_spike:
+            fatal = next((a for a in flagged
+                          if a["kind"] == "spike"
+                          and a["stream"].startswith("param.")), None)
+        if fatal is not None:
+            raise NumericFault(
+                f"numeric fault at step {step}: {fatal['kind']} "
+                f"({fatal.get('stream')})", anomaly=fatal)
+        return flagged
+
+    def observe_fingerprint(self, step: int, fp: int) -> int:
+        """Record this rank's param fingerprint at a probe step (the
+        flight-recorder event is the doctor's minority-vote input)."""
+        fp = int(fp) & 0xFFFFFFFF
+        self.last_fingerprint = fp
+        # the tie-break window below spans (previous probe, now]: the
+        # anomalies that vouch for "my chip diverged" are the ones
+        # since the probe that last AGREED, not since this one
+        self._prev_fingerprint_step = self.last_fingerprint_step
+        self.last_fingerprint_step = int(step)
+        _fr.record("sentry.fingerprint", step=int(step), fp=fp)
+        if _obs._enabled:
+            _obs.gauge("sentry.fingerprint").set(fp)
+        return fp
+
+    def judge_fingerprints(self, rank: int, my_fp: int,
+                           peer_fps: Mapping[int, int],
+                           step: Optional[int] = None
+                           ) -> Optional[int]:
+        """Cross-replica agreement: given my fingerprint and my peers'
+        (rank -> fp) at the same probe step, name the diverging rank —
+        the MINORITY holder when a majority exists; at an even split
+        (dp=2), the rank with a recent local anomaly (its own stats
+        spiked — the pre-sync tell). None = agreement, or divergence
+        that cannot be pinned on one rank (recorded as a mismatch
+        event either way so the doctor sees it)."""
+        votes: Dict[int, List[int]] = {}
+        votes.setdefault(int(my_fp) & 0xFFFFFFFF, []).append(int(rank))
+        for r, fp in peer_fps.items():
+            votes.setdefault(int(fp) & 0xFFFFFFFF, []).append(int(r))
+        if len(votes) <= 1:
+            # agreement: params at this probe step are confirmed
+            # replica-identical — the sound rollback bound for any
+            # LATER-confirmed quiet corruption
+            self.last_agreed_probe_step = (
+                int(step) if step is not None
+                else self.last_fingerprint_step)
+            return None
+        sizes = sorted((len(rs) for rs in votes.values()), reverse=True)
+        ranks_by_size = sorted(votes.values(), key=len)
+        culprit: Optional[int] = None
+        if len(sizes) == 2 and sizes[0] > sizes[1] \
+                and len(ranks_by_size[0]) == 1:
+            culprit = ranks_by_size[0][0]
+            source = "minority_vote"
+        else:
+            # no usable majority (dp=2 split, or multi-way): fall back
+            # to the rank whose own STAT streams flagged in the window
+            # since the probe that last agreed — only the corrupted
+            # rank's pre-sync streams spiked. Mismatch records (which
+            # every rank holds bilaterally) are excluded: counting
+            # them would make BOTH sides of a tie self-convict at the
+            # next probe.
+            since = self._prev_fingerprint_step
+            local_dirty = any(
+                a for a in self.anomalies
+                if a["kind"] in ("spike", "nonfinite",
+                                 "loss_nonfinite")
+                and (since is None or a["step"] > since))
+            culprit = int(rank) if local_dirty else None
+            source = "local_anomaly" if culprit is not None else "tie"
+        _obs.counter("sentry.fingerprint_mismatches_total",
+                     _always=True).add(1)
+        _fr.record("sentry.mismatch",
+                   step=int(step if step is not None
+                            else (self.last_step or -1)),
+                   my_fp=int(my_fp) & 0xFFFFFFFF,
+                   peers={str(r): int(f) & 0xFFFFFFFF
+                          for r, f in peer_fps.items()},
+                   culprit=culprit, source=source)
+        # a mismatch is an integrity anomaly in its own right: until
+        # the replicas agree again, checkpoints on EVERY rank are
+        # uncertified (a quiet flip shows no stat anomaly at all — the
+        # dirty window from here is what keeps post-fault stamps out
+        # of the require_healthy walk)
+        self._record_anomaly(
+            int(step if step is not None else (self.last_step or -1)),
+            "mismatch", culprit=culprit, source=source)
+        return culprit
+
+    # -- health stamp --------------------------------------------------
+    def health_stamp(self, step: Optional[int] = None) -> dict:
+        """The certification buried in the checkpoint topology manifest
+        (DESIGN.md "Numeric integrity"): healthy ⇔ the last observed
+        loss was finite AND the monitor has seen
+        ``min_clean_for_healthy`` consecutive anomaly-free steps."""
+        step = self.last_step if step is None else int(step)
+        healthy = (self.last_loss_finite
+                   and self._clean_streak
+                   >= self.config.min_clean_for_healthy)
+        return {
+            "version": 1,
+            "step": step,
+            "loss_finite": bool(self.last_loss_finite),
+            "clean_window": int(self._clean_streak),
+            "anomalies_total": len(self.anomalies),
+            "fingerprint": self.last_fingerprint,
+            "healthy": bool(healthy),
+        }
+
+    @property
+    def clean_window(self) -> int:
+        return self._clean_streak
+
+
+# -- the in-graph builder -----------------------------------------------------
+
+class NumericSentry:
+    """The object a TrainStep / PipelineParallel takes as ``sentry=``:
+    a SentryConfig plus the host-side monitor, and the in-graph stat
+    builders the step program calls at trace time. The step threads
+    ``sentry_step``/``sentry_fp`` through strategy_state so the
+    every-K fingerprint probe needs no new program inputs."""
+
+    STATE_STEP = "sentry_step"
+    STATE_FP = "sentry_fp"
+
+    def __init__(self, config: Optional[SentryConfig] = None,
+                 monitor: Optional[SentryMonitor] = None):
+        self.config = config or SentryConfig()
+        self.monitor = monitor or SentryMonitor(self.config)
+
+    def init_state(self, strategy_state: Dict[str, Any]):
+        jnp = _get_jnp()
+        strategy_state.setdefault(self.STATE_STEP,
+                                  jnp.asarray(0, jnp.int32))
+        strategy_state.setdefault(self.STATE_FP,
+                                  jnp.asarray(0, jnp.uint32))
+
+    def instrument(self, grads: Mapping[str, Any],
+                   new_params: Mapping[str, Any], loss,
+                   strat: Dict[str, Any]
+                   ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Trace-time: compute the step's sentry outputs and the
+        updated strategy entries. Returns (sentry_out, strat) — all
+        scalars, riding the step's existing results."""
+        import jax
+        jnp = _get_jnp()
+        sstep = strat[self.STATE_STEP]
+        out: Dict[str, Any] = {
+            "grad": stats_by_scope(grads),
+            "param": stats_by_scope(new_params),
+            "loss_finite": jnp.isfinite(
+                jnp.asarray(loss, jnp.float32)),
+        }
+        strat = dict(strat)
+        k = int(self.config.fingerprint_every)
+        if k > 0:
+            fresh = (sstep % k) == 0
+            fp = jax.lax.cond(
+                fresh, lambda: fingerprint_tree(new_params),
+                lambda: strat[self.STATE_FP])
+            strat[self.STATE_FP] = fp
+            out["fp"] = fp
+            out["fp_fresh"] = fresh
+        strat[self.STATE_STEP] = sstep + 1
+        return out, strat
+
+    def consume(self, step: int, sentry_out: Mapping[str, Any]
+                ) -> List[dict]:
+        """Host side of the per-step loop: pull the scalar outputs and
+        feed the monitor (grad streams first — the pre-sync tell).
+        ONE batched device_get fetches every scalar in a single D2H
+        round trip — per-scalar np.asarray reads would issue dozens of
+        transfers per step on a real accelerator."""
+        import jax
+        host = jax.device_get(dict(sentry_out))
+        flagged = self.monitor.observe(
+            step, _host_stats(host.get("grad", {})), kind="grad",
+            loss=(1.0 if bool(np.asarray(host["loss_finite"]))
+                  else float("nan")))
+        flagged += self.monitor.observe(
+            step, _host_stats(host.get("param", {})), kind="param")
+        if "fp" in host and bool(np.asarray(host.get("fp_fresh",
+                                                     False))):
+            self.monitor.observe_fingerprint(
+                step, int(np.asarray(host["fp"])))
+        return flagged
+
+
+def _host_stats(stats: Mapping[str, Mapping[str, Any]]
+                ) -> Dict[str, Dict[str, float]]:
+    return {s: {k: np.asarray(v) for k, v in row.items()}
+            for s, row in stats.items()}
+
+
+# -- fault captures (replay triage) ------------------------------------------
+
+def write_fault_capture(path: str, params: Mapping[str, Any],
+                        batch: Mapping[str, Any],
+                        observed: Optional[dict] = None,
+                        rng_state: Any = None, step: int = -1,
+                        rank: int = -1,
+                        meta: Optional[dict] = None) -> str:
+    """Snapshot everything a re-execution needs: params, the exact
+    batch, the rng state, and the stats the sentry observed at fault
+    time. ``tools/replay_triage.py`` replays it to decide reproducible
+    (software) vs transient (SDC). npz keeps it dependency-free."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    import json
+    doc = {
+        "version": 1, "step": int(step), "rank": int(rank),
+        "ts": time.time(),
+        "param_names": sorted(params),
+        "batch_names": sorted(batch),
+        "observed": observed or {},
+        "meta": meta or {},
+        "rng_state": rng_state,
+    }
+    arrays = {f"param__{k}": np.asarray(v) for k, v in params.items()}
+    arrays.update({f"batch__{k}": np.asarray(v)
+                   for k, v in batch.items()})
+    arrays["__doc__"] = np.frombuffer(
+        json.dumps(doc, default=str).encode(), dtype=np.uint8)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+    _fr.record("sentry.fault_capture", path=path, step=int(step),
+               rank=int(rank))
+    return path
+
+
+def load_fault_capture(path: str) -> dict:
+    """Inverse of ``write_fault_capture``: {'params', 'batch', 'step',
+    'rank', 'observed', 'meta'}."""
+    import json
+    with np.load(path, allow_pickle=False) as z:
+        doc = json.loads(bytes(z["__doc__"].tobytes()).decode())
+        params = {k[len("param__"):]: z[k] for k in z.files
+                  if k.startswith("param__")}
+        batch = {k[len("batch__"):]: z[k] for k in z.files
+                 if k.startswith("batch__")}
+    doc["params"] = params
+    doc["batch"] = batch
+    return doc

@@ -58,7 +58,8 @@ class TrainStep:
                  donate: bool = True, grad_accum_steps: int = 1,
                  grad_transform: Optional[Callable] = None,
                  strategy_state: Optional[Dict[str, Any]] = None,
-                 remat: bool = False, remat_policy=None, scaler=None):
+                 remat: bool = False, remat_policy=None, scaler=None,
+                 sentry=None):
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -90,6 +91,15 @@ class TrainStep:
         self.grad_transform = grad_transform
         self.strategy_state = strategy_state if strategy_state is not None \
             else {}
+        # numeric-integrity sentry (observability.sentry.NumericSentry):
+        # per-scope grad/param stats + the every-K fingerprint probe
+        # compile INTO the one step program as scalar outputs; the
+        # host-side monitor turns them into sentry.* gauges and
+        # flight-recorder anomaly events. None = the program is
+        # bit-identical to a sentry-less build (gate-down guard).
+        self.sentry = sentry
+        if sentry is not None:
+            sentry.init_state(self.strategy_state)
         self.remat = remat
         self.remat_policy = remat_policy
 
@@ -152,6 +162,11 @@ class TrainStep:
             self.strategy_state.setdefault("amp_good",
                                            jnp.asarray(0, jnp.int32))
             self.strategy_state.setdefault("amp_bad",
+                                           jnp.asarray(0, jnp.int32))
+            # cumulative skipped-step count, accumulated IN-GRAPH: the
+            # always-available ground truth for loss-scale skips that
+            # needs no host sync and rides every checkpoint
+            self.strategy_state.setdefault("amp_skipped",
                                            jnp.asarray(0, jnp.int32))
         self._accum_grads = None
         self._accum_count = 0
@@ -268,6 +283,11 @@ class TrainStep:
                     grads, found_inf = check_finite_and_unscale_tree(
                         grads, scale)
                     loss = loss / scale
+            # PRE-SYNC grads: the sentry's per-rank tell — after the
+            # grad_transform's collective every replica holds the same
+            # (possibly already-poisoned) values and nothing can name
+            # the chip that produced the corruption
+            pre_sync_grads = grads
             if self.grad_transform is not None:
                 grads, strat = self.grad_transform(grads, strat, params)
             with _scope("optimizer"):
@@ -292,7 +312,23 @@ class TrainStep:
                             decr_every_n=scaler_cfg["decr_every_n"])
                         strat.update(amp_scale=ns, amp_good=ng,
                                      amp_bad=nb)
-            return new_params, new_opt, new_buffers, strat, loss
+            # tiny scalar extras riding the step's existing results
+            # (zero additional dispatches, still ONE executable):
+            # amp skip visibility + the numeric sentry's stat streams
+            extras: Dict[str, Any] = {}
+            if found_inf is not None:
+                with _scope("loss_scale"):
+                    strat = dict(strat)
+                    strat["amp_skipped"] = (
+                        strat["amp_skipped"]
+                        + found_inf.astype(jnp.int32))
+                    extras["amp"] = {"found_inf": found_inf,
+                                     "scale": strat["amp_scale"]}
+            if self.sentry is not None:
+                s_out, strat = self.sentry.instrument(
+                    pre_sync_grads, new_params, loss, strat)
+                extras["sentry"] = s_out
+            return new_params, new_opt, new_buffers, strat, loss, extras
 
         jit_kwargs = {}
         if self._donate:
@@ -372,7 +408,7 @@ class TrainStep:
         # progress clock and the goodput "train" bucket
         _tok = _fr.step_begin("train_step", self._steps_done)
         (self.params, self.opt_state, self.buffers, self.strategy_state,
-         loss) = self._step_fn(
+         loss, extras) = self._step_fn(
             self.params, self.opt_state, self.buffers, self.strategy_state,
             key, lr, in_arrays, lbl_arrays)
         if _tok is not None and _fr.sync_steps():
@@ -380,6 +416,26 @@ class TrainStep:
             # durations measure real work, not async dispatch latency
             jax.block_until_ready(loss)
         _fr.step_end("train_step", self._steps_done, _tok)
+        if "amp" in extras and (_obs._enabled or _fr._enabled):
+            # loss-scale skip visibility: the found_inf branch keeps
+            # params/opt-state untouched — a silent no-op step unless
+            # someone says so. The host read is GATED on an armed
+            # observability plane: a per-step device sync would break
+            # the no-host-sync contract of the in-graph scaler on the
+            # hottest path. The ungated ground truth is the in-graph
+            # cumulative strategy_state["amp_skipped"] (checkpointed,
+            # readable at any sync point with zero per-step cost).
+            skipped = bool(np.asarray(extras["amp"]["found_inf"]))
+            scale_v = float(np.asarray(extras["amp"]["scale"]))
+            if skipped:
+                _obs.counter("amp.loss_scale.skipped_total",
+                             _always=True).add(1)
+                _fr.record("loss_scale.skip", step=self._steps_done,
+                           scale=scale_v)
+            if _obs._enabled:
+                _obs.gauge("amp.loss_scale.scale").set(scale_v)
+        if self.sentry is not None:
+            self.sentry.consume(self._steps_done, extras["sentry"])
         self._steps_done += 1
         if isinstance(self.optimizer._lr, LRScheduler):
             pass  # caller steps the scheduler per its own schedule
@@ -458,3 +514,31 @@ class TrainStep:
         if state.get("strategy_state") is not None:
             self.strategy_state = jax.tree_util.tree_map(
                 copy_arr, state["strategy_state"])
+            # re-seed the keys THIS build requires that the restored
+            # candidate may predate (a pre-sentry checkpoint, an
+            # amp run older than the in-graph skip counter): the
+            # wholesale replace must never hand the compiled step a
+            # strategy pytree missing the keys it was traced with —
+            # that KeyErrors inside the very rollback the numeric
+            # remediation performs
+            if self._scaler_cfg is not None:
+                cfg = self._scaler_cfg
+                self.strategy_state.setdefault(
+                    "amp_scale",
+                    jnp.asarray(cfg["init_scale"], jnp.float32))
+                self.strategy_state.setdefault(
+                    "amp_good", jnp.asarray(0, jnp.int32))
+                self.strategy_state.setdefault(
+                    "amp_bad", jnp.asarray(0, jnp.int32))
+                self.strategy_state.setdefault(
+                    "amp_skipped", jnp.asarray(0, jnp.int32))
+            if self.sentry is not None:
+                self.sentry.init_state(self.strategy_state)
+        else:
+            # rollback consistency: int8-EF residuals are time-coupled
+            # to the params they quantized — restoring params WITHOUT
+            # the matching strategy state must purge live residuals
+            # (reset is unbiased; a residual from the rolled-back
+            # future is not)
+            from ..distributed.comm import purge_residual_state
+            purge_residual_state(self.strategy_state)

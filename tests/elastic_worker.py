@@ -42,6 +42,7 @@ import paddle_tpu as paddle  # noqa: E402
 from paddle_tpu.distributed import chaos  # noqa: E402
 from paddle_tpu.distributed import checkpoint as dckpt  # noqa: E402
 from paddle_tpu.observability import flight_recorder as fr  # noqa: E402
+from paddle_tpu.observability import sentry as sentry_mod  # noqa: E402
 
 
 def main():
@@ -61,6 +62,18 @@ def main():
     ap.add_argument("--ckpt-every", type=int, default=1)
     ap.add_argument("--watchdog", action="store_true",
                     help="arm a HangWatchdog (stall forensics)")
+    ap.add_argument("--sentry", action="store_true",
+                    help="arm the numeric-integrity sentry: grad/param "
+                         "stats + z-score monitor, every-K param "
+                         "fingerprint exchange over the fleet KV, "
+                         "health-stamped checkpoints, self-quarantine "
+                         "on a confirmed numeric fault (exit 13 after "
+                         "a fault capture + black-box dump)")
+    ap.add_argument("--sentry-probe-every", type=int, default=4,
+                    help="fingerprint probe period K (steps)")
+    ap.add_argument("--global-batch", type=int, default=8,
+                    help="sharded mode global batch (must divide by "
+                         "every world size the drill passes through)")
     args = ap.parse_args()
 
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
@@ -197,9 +210,14 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
     """Elastic mode: one GLOBAL dataset sharded by the cursor, async
     sharded checkpoints keyed on the stable slot id. The gang size may
     differ between incarnations (supervisor shrink/grow) — the resumed
-    cursor guarantees no example is skipped or repeated."""
+    cursor guarantees no example is skipped or repeated. --sentry adds
+    the numeric-integrity plane: per-step grad/param stats through a
+    z-score monitor, an every-K fingerprint exchange over the fleet KV
+    (minority names the corrupted rank), health-stamped checkpoints,
+    and self-quarantine (capture + dump + exit 13) on a confirmed
+    fault."""
     rng = np.random.RandomState(42)  # same data on every rank
-    n, gb = 64, 8
+    n, gb = 64, int(args.global_batch)
     X = rng.randn(n, 4).astype(np.float32)
     Y = (X @ rng.randn(4, 1)).astype(np.float32)
 
@@ -207,9 +225,31 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
     w.set_value(np.zeros((4, 1), np.float32))
     opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
 
+    sen = None
+    if args.sentry:
+        # min_clean_for_healthy exceeds the probe period: a bit flip
+        # is only CONFIRMED at the next fingerprint probe, and every
+        # checkpoint committed between the (possibly quiet) fault and
+        # its confirmation must be stamped unhealthy — the dirty
+        # window from the first local anomaly covers the gap
+        # warmup/threshold sized for a warming-up model: early-step
+        # param norms trend fast from init, and a hair-trigger z would
+        # stamp the warmup unhealthy (and hand the doctor a fake
+        # first-anomaly). An exponent-bit flip lands z >> 1e3.
+        sen = sentry_mod.SentryMonitor(sentry_mod.SentryConfig(
+            window=8, min_warmup=4,
+            z_threshold=float(os.environ.get("PD_SENTRY_Z", "20")),
+            fingerprint_every=args.sentry_probe_every,
+            min_clean_for_healthy=args.sentry_probe_every + 1,
+            fatal_nonfinite=True))
+
     ckpt = os.path.join(args.ckpt_dir, f"slot{slot}")
     cursor = dckpt.DataShardCursor(dataset_size=n, global_batch=gb)
     start = 0
+    # numeric remediation (launch.py sets it on a NUMERIC verdict):
+    # resume only onto a health-STAMPED candidate — the newest may
+    # hold weights the corruption already trained into
+    require_healthy = os.environ.get("PD_ROLLBACK_HEALTHY") == "1"
     # state and topology must come from the SAME candidate: pairing
     # independent loads lets leaf-only corruption hand us .old weights
     # with the primary's newer cursor — a silently dropped update
@@ -229,9 +269,10 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
             cut = min(cut, int(other["step"])
                       if other and other.get("step") is not None
                       else 0)   # gone rank never committed: replay all
-        if cut < int(topo["step"]):
+        if cut < int(topo["step"]) or require_healthy:
             state, topo = dckpt.load_at_or_before(
-                ckpt, cut, target={"w": w._data})
+                ckpt, cut, target={"w": w._data},
+                require_healthy=require_healthy)
         w.set_value(np.asarray(state["w"]))
         cursor = dckpt.DataShardCursor.from_state(topo["data_cursor"])
         start = int(topo["step"]) + 1
@@ -245,6 +286,7 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
 
     exlog = os.path.join(args.out_dir, f"examples_slot{slot}.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
+    losses = []
     for step in range(start, args.steps):
         _inject_faults(args, rank, incarnation, step, ckpt)
         _step_barrier(kv, rank, world, step, hb=hb)
@@ -252,20 +294,77 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
         if args.step_time:
             time.sleep(args.step_time)
         idx = cursor.indices(rank, world)
-        xb = paddle.to_tensor(X[idx])
-        yb = paddle.to_tensor(Y[idx])
+        # the UPDATE consumes the full global window — the mean grad
+        # over it equals the all-reduced mean of the per-rank shard
+        # grads, so every rank ends the step with BIT-IDENTICAL params
+        # (the post-sync contract the sentry's fingerprint probe
+        # exists to check). The audit trail still logs this rank's
+        # shard (idx) — the no-skip/no-dup bookkeeping is about which
+        # examples each rank was RESPONSIBLE for.
+        gidx = cursor.indices(0, 1)
+        xb = paddle.to_tensor(X[gidx])
+        yb = paddle.to_tensor(Y[gidx])
         loss = ((xb @ w - yb) ** 2).mean()
         loss.backward()
+        # numeric chaos rides the HOST CALLBACK between backward and
+        # the update — exactly where a corrupted chip's grads would
+        # surface — so the sentry observes the poison first-hand
+        nmode = chaos.maybe_inject_numeric(step, rank=rank,
+                                           incarnation=incarnation)
+        if nmode in ("nan_grad", "scale_grad"):
+            poisoned = chaos.apply_numeric(
+                {"w": np.asarray(w._grad)}, nmode)
+            w._grad = poisoned["w"]
+        if sen is not None:
+            grads_np = {"w": np.asarray(w._grad)}
+            try:
+                sen.observe(step, sentry_mod.host_stats_by_scope(
+                    grads_np), kind="grad", loss=np.asarray(loss._data))
+            except sentry_mod.NumericFault as e:
+                # capture the batch the step ACTUALLY consumed (the
+                # global window) — replaying the shard slice would let
+                # a bug triggered by an out-of-shard example classify
+                # as transient SDC
+                _numeric_quarantine(args, slot, rank, step, w,
+                                    X[gidx], Y[gidx], sen, str(e),
+                                    grads_np)
         opt.step()
         opt.clear_grad()
+        if nmode == "flip_bit":
+            # the SDC shape: one bit of one committed WEIGHT flips —
+            # nothing crashes, the next probe must name this rank
+            flipped = chaos.apply_numeric(
+                {"w": np.asarray(w._data)}, nmode)
+            w.set_value(flipped["w"])
+        if sen is not None:
+            sen.observe(step, sentry_mod.host_stats_by_scope(
+                {"w": np.asarray(w._data)}), kind="param")
+            if (step + 1) % max(1, args.sentry_probe_every) == 0:
+                fp = sentry_mod.host_fingerprint(
+                    {"w": np.asarray(w._data)})
+                sen.observe_fingerprint(step, fp)
+                peers = _exchange_fingerprints(kv, rank, world, step,
+                                               fp, hb=hb)
+                if peers:
+                    culprit = sen.judge_fingerprints(rank, fp, peers,
+                                                     step=step)
+                    if culprit == rank:
+                        _numeric_quarantine(
+                            args, slot, rank, step, w, X[gidx],
+                            Y[gidx], sen, "fingerprint divergence "
+                            "(cross-replica minority)", None,
+                            ckpt=ckpt)
         fr.step_end("elastic_worker", step, tok, loss=loss._data)
+        losses.append(float(np.asarray(loss._data)))
         cursor.advance()
         if (step + 1) % args.ckpt_every == 0 or step == args.steps - 1:
             dckpt.save_sharded(
                 {"w": w._data}, ckpt, async_write=True,
                 topology=dckpt.topology_manifest(
                     step=step, data_cursor=cursor.state_dict(),
-                    dp=world, global_batch=gb))
+                    dp=world, global_batch=gb,
+                    health=(sen.health_stamp(step=step)
+                            if sen is not None else None)))
         # committed-work audit trail for the drill's no-skip/no-dup check
         with open(exlog, "a") as f:
             f.write(json.dumps({"step": step, "rank": rank,
@@ -277,7 +376,85 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
     dckpt.wait_pending()
     _write_out(args, slot, rank, w=np.asarray(w._data).tolist(),
                incarnation=incarnation, steps_done=args.steps,
-               world=world)
+               world=world, losses=losses)
+
+
+def _exchange_fingerprints(kv, rank, world, step, fp, hb=None,
+                           timeout=5.0, poll=0.05):
+    """Cross-replica agreement probe over the fleet KV (the CPU drill's
+    stand-in for an in-graph all_gather over the mesh): publish mine,
+    collect my peers' for the SAME step. Best-effort — a dead peer or
+    KV outage yields a partial (or empty) dict rather than a wedge."""
+    if kv is None or world <= 1:
+        return {}
+    epoch = os.environ.get("PD_GANG_EPOCH", "0")
+    try:
+        kv.put(f"fp/{epoch}/{step}/{rank}", str(fp))
+    except Exception:
+        return {}
+    peers = {}
+    deadline = time.time() + timeout
+    for r in range(world):
+        if r == rank:
+            continue
+        while time.time() < deadline:
+            try:
+                v = kv.get(f"fp/{epoch}/{step}/{r}")
+            except Exception:
+                return peers
+            if v is not None:
+                peers[r] = int(v)
+                break
+            if hb is not None:
+                hb.pulse()
+            time.sleep(poll)
+    return peers
+
+
+def _numeric_quarantine(args, slot, rank, step, w, xb, yb, sen,
+                        reason, grads_np, ckpt=None):
+    """Self-quarantine on a confirmed numeric fault: write the fault
+    capture (replay_triage's input), leave the black box, exit 13 so
+    the supervisor treats this rank as the casualty. The capture +
+    sentry events in the dump are what turns the crash into a NUMERIC
+    verdict instead of a plain one. A FINGERPRINT-confirmed fault
+    (``ckpt`` given) additionally decertifies this slot's checkpoints
+    newer than the last probe at which the replicas agreed — a quiet
+    flip records no stat anomaly, so those checkpoints carry healthy
+    stamps over poisoned weights, and a respawn-in-place would
+    otherwise walk straight back onto them and quarantine-loop."""
+    if ckpt is not None:
+        try:
+            # commit any in-flight async save FIRST — a write landing
+            # after the decertification would rotate a fresh healthy
+            # stamp over it
+            dckpt.wait_pending()
+        except RuntimeError:
+            pass
+        agreed = sen.last_agreed_probe_step
+        dckpt.decertify_after(ckpt, agreed if agreed is not None
+                              else -1)
+    observed = {
+        "reason": reason,
+        "param": sentry_mod.host_stats_by_scope(
+            {"w": np.asarray(w._data)}),
+        "anomalies": sen.anomalies[-6:],
+    }
+    if grads_np is not None:
+        observed["grad"] = sentry_mod.host_stats_by_scope(grads_np)
+    cap = os.path.join(args.out_dir, f"fault_slot{slot}.npz")
+    try:
+        sentry_mod.write_fault_capture(
+            cap, {"w": np.asarray(w._data)},
+            {"x": np.asarray(xb), "y": np.asarray(yb)},
+            observed=observed, step=step, rank=rank,
+            meta={"model": "linear_mse", "lr": 0.05})
+    except OSError:
+        pass  # the dump below still carries the verdict evidence
+    fr.record("sentry.fault", step=int(step), rank=int(rank),
+              reason=reason)
+    fr.dump(reason="numeric_fault")
+    os._exit(13)
 
 
 def _write_out(args, slot, rank, **doc):

@@ -47,8 +47,15 @@ class TestChaosHooks:
         chaos.reset_plan_cache()
         assert chaos.plan().step == 99
 
-    def test_unknown_mode_disarms(self, monkeypatch):
+    def test_unknown_mode_fails_loudly(self, monkeypatch):
+        # ISSUE 13 satellite: a typo'd mode used to silently disarm —
+        # the drill would inject nothing and read as a passing receipt
         monkeypatch.setenv("PD_CHAOS_MODE", "meteor")
+        with pytest.raises(ValueError, match="PD_CHAOS_MODE"):
+            chaos.plan()
+
+    def test_empty_mode_disarms(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "")
         assert chaos.plan() is None
 
     def test_wrong_rank_or_step_is_noop(self, monkeypatch):

@@ -504,3 +504,200 @@ class TestTopologyElasticResume:
         # the math itself (mean-of-shards == global mean) is exact
         np.testing.assert_allclose(losses, losses_c, rtol=1e-6)
         np.testing.assert_allclose(w2, wc, rtol=1e-6)
+
+
+class TestHealthStampedRollback:
+    """ISSUE 13: load_at_or_before(require_healthy=True) lands on the
+    newest CERTIFIED-good candidate — never merely the newest — and
+    falls back loudly when nothing is certified."""
+
+    def _save(self, tmp_path, step, scale, healthy):
+        stamp = {"version": 1, "step": step, "loss_finite": True,
+                 "clean_window": 5 if healthy else 0,
+                 "anomalies_total": 0 if healthy else 2,
+                 "fingerprint": 1234, "healthy": healthy}
+        ck.save_sharded(
+            _state(scale), str(tmp_path / "ck"),
+            topology=ck.topology_manifest(step=step, health=stamp))
+
+    def test_walk_skips_unhealthy_newest(self, tmp_path):
+        fr.enable()
+        # steps 1 (healthy), 2 (healthy), 3 (POISONED but newest)
+        self._save(tmp_path, 1, 1.0, True)
+        self._save(tmp_path, 2, 2.0, True)
+        self._save(tmp_path, 3, 3.0, False)
+        state, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 3, require_healthy=True)
+        assert topo["step"] == 2
+        np.testing.assert_array_equal(np.asarray(state["w"]),
+                                      np.asarray(_state(2.0)["w"]))
+        # the skip was loud: always-on counter + fr breadcrumb
+        assert metrics.counter("checkpoint.unhealthy_skips_total"
+                               ).value() >= 1
+        evs = [e for e in fr.get_recorder().events()
+               if e.get("k") == "ckpt.unhealthy_skipped"]
+        assert evs and evs[0]["step"] == 3
+
+    def test_without_flag_newest_wins(self, tmp_path):
+        self._save(tmp_path, 1, 1.0, True)
+        self._save(tmp_path, 2, 2.0, False)
+        _state_out, topo = ck.load_at_or_before(str(tmp_path / "ck"), 9)
+        assert topo["step"] == 2  # legacy behavior untouched
+
+    def test_no_certified_candidate_falls_back_loudly(self, tmp_path):
+        fr.enable()
+        self._save(tmp_path, 1, 1.0, False)
+        self._save(tmp_path, 2, 2.0, False)
+        state, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 9, require_healthy=True)
+        assert topo["step"] == 2  # newest uncertified, but LOUD
+        assert metrics.counter("checkpoint.unhealthy_fallbacks_total"
+                               ).value() == 1
+        assert any(e.get("k") == "ckpt.unhealthy_fallback"
+                   for e in fr.get_recorder().events())
+
+    def test_gap_fallback_prefers_certified_and_counts_uncertified(
+            self, tmp_path):
+        # review regression: when every candidate is NEWER than the
+        # cut, the best-effort gap leg must (a) prefer a certified
+        # too-new candidate over an uncertified one and (b) count the
+        # landing loudly when only uncertified ones exist
+        fr.enable()
+        self._save(tmp_path, 5, 1.0, False)   # oldest gap cand: dirty
+        self._save(tmp_path, 6, 2.0, True)    # certified
+        self._save(tmp_path, 7, 3.0, False)   # newest: dirty
+        state, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 2, require_healthy=True)
+        assert topo["step"] == 6  # the certified one, not the oldest
+        assert metrics.counter("checkpoint.rollback_gaps_total"
+                               ).value() == 1
+        assert metrics.counter("checkpoint.unhealthy_fallbacks_total"
+                               ).value() == 0
+        # only-uncertified gap: lands, but LOUDLY
+        metrics.reset()
+        self._save(tmp_path, 8, 4.0, False)
+        self._save(tmp_path, 9, 5.0, False)
+        self._save(tmp_path, 10, 6.0, False)
+        _s, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 2, require_healthy=True)
+        assert metrics.counter("checkpoint.unhealthy_fallbacks_total"
+                               ).value() == 1
+
+    def test_corrupt_candidate_counted_once_across_passes(
+            self, tmp_path):
+        # review regression: a healthy candidate that fails restore in
+        # pass 1 must not be retried (and double-counted) in pass 2
+        self._save(tmp_path, 1, 1.0, True)
+        self._save(tmp_path, 2, 2.0, True)
+        # trash the newest payload, keep its sidecars parseable
+        prim = glob.glob(str(tmp_path / "ck*"))
+        newest = str(tmp_path / "ck")
+        if os.path.isdir(newest):
+            for root, _d, files in os.walk(newest):
+                for fn in files:
+                    if "MANIFEST" not in fn and "TOPOLOGY" not in fn:
+                        with open(os.path.join(root, fn), "wb") as f:
+                            f.write(b"\0garbage\0" * 8)
+        else:
+            with open(newest + ".pkl", "wb") as f:
+                f.write(b"\0garbage\0" * 8)
+        assert prim
+        state, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 9, require_healthy=True)
+        assert topo["step"] == 1  # fell back to the older good one
+        assert metrics.counter("checkpoint.corruptions_total"
+                               ).value() == 1  # once, not per pass
+
+    def test_stampless_candidates_are_not_certified(self, tmp_path):
+        # a checkpoint saved WITHOUT a sentry (no health key) must not
+        # satisfy require_healthy's first pass
+        ck.save_sharded(_state(1.0), str(tmp_path / "ck"),
+                        topology=ck.topology_manifest(step=1))
+        assert not ck.candidate_healthy(
+            ck.load_topology(str(tmp_path / "ck")))
+        _s, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 9, require_healthy=True)
+        assert topo["step"] == 1  # fallback pass still recovers it
+        assert metrics.counter("checkpoint.unhealthy_fallbacks_total"
+                               ).value() == 1
+
+
+class TestResidualRollbackConsistency:
+    """ISSUE 13 satellite: int8-EF residuals must come from the SAME
+    restored candidate as the params — a rollback that keeps live
+    residuals silently breaks error-feedback time-mean unbiasedness."""
+
+    def test_purge_helper(self):
+        from paddle_tpu.distributed.comm import purge_residual_state
+        state = {"residual_0_deadbeef": jnp.zeros(4),
+                 "residual_1_0000aaaa": jnp.ones(2),
+                 "amp_scale": jnp.asarray(1.0)}
+        assert purge_residual_state(state) == 2
+        assert sorted(state) == ["amp_scale"]
+
+    def test_set_state_dict_purges_when_candidate_has_no_strategy(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import TrainStep
+
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters())
+        step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+        # live residual state from a hypothetical int8_ef run
+        step.strategy_state["residual_0_cafe0000"] = jnp.zeros(16)
+        ckpt = {"model": m.state_dict(), "opt_state": None,
+                "opt": None, "strategy_state": None}
+        step.set_state_dict(ckpt)
+        assert not any(k.startswith("residual_")
+                       for k in step.strategy_state)
+        # ... but a candidate CARRYING strategy state replaces wholesale
+        step.strategy_state["residual_0_cafe0000"] = jnp.zeros(16)
+        ckpt["strategy_state"] = {"residual_0_beef0000": jnp.ones(8)}
+        step.set_state_dict(ckpt)
+        assert sorted(step.strategy_state) == ["residual_0_beef0000"]
+
+
+class TestDecertifyAfter:
+    """Review regression: a truly quiet flip certifies the checkpoints
+    committed before its probe confirmation — the quarantining rank
+    must decertify its own candidates newer than the last AGREED probe
+    so a respawn-in-place cannot walk back onto poisoned weights."""
+
+    def _save(self, tmp_path, step, scale):
+        stamp = {"version": 1, "step": step, "loss_finite": True,
+                 "clean_window": 9, "anomalies_total": 0,
+                 "fingerprint": 1, "healthy": True}
+        ck.save_sharded(_state(scale), str(tmp_path / "ck"),
+                        topology=ck.topology_manifest(step=step,
+                                                      health=stamp))
+
+    def test_decertifies_only_newer_than_agreed(self, tmp_path):
+        fr.enable()
+        self._save(tmp_path, 4, 1.0)   # at/before the agreed probe
+        self._save(tmp_path, 6, 2.0)   # post-fault, stamped healthy
+        self._save(tmp_path, 8, 3.0)   # post-fault, stamped healthy
+        n = ck.decertify_after(str(tmp_path / "ck"), 4)
+        assert n == 2
+        assert metrics.counter("checkpoint.decertified_total"
+                               ).value() == 2
+        # the require_healthy walk now lands on the agreed-probe-era
+        # candidate, ending the would-be quarantine loop
+        state, topo = ck.load_at_or_before(
+            str(tmp_path / "ck"), 99, require_healthy=True)
+        assert topo["step"] == 4
+        np.testing.assert_array_equal(np.asarray(state["w"]),
+                                      np.asarray(_state(1.0)["w"]))
+        assert any(e.get("k") == "ckpt.decertified"
+                   for e in fr.get_recorder().events())
+
+    def test_idempotent_and_integrity_preserved(self, tmp_path):
+        self._save(tmp_path, 2, 1.0)
+        self._save(tmp_path, 5, 2.0)
+        assert ck.decertify_after(str(tmp_path / "ck"), 2) == 1
+        assert ck.decertify_after(str(tmp_path / "ck"), 2) == 0
+        # the rewritten sidecar must not trip the integrity manifest
+        out = ck.load_sharded(str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(_state(2.0)["w"]))

@@ -1,0 +1,370 @@
+"""Numeric chaos drills (ISSUE 13). Tier-1: doctor NUMERIC verdict
+units over canned dumps, supervisor quarantine decisions, chaos env
+parse validation, replay-triage classification, and ONE fast
+end-to-end drill — flip_bit at a named (rank, step) on a dp=2 elastic
+launch: sentry names the rank, supervisor quarantine-evicts it,
+survivor resumes from a health-stamped checkpoint (~9 s, the named
+sibling of the slow acceptance drills). Slow tier: full kill-the-math
+drills (nan_grad, loud + quiet flip_bit incl. the dp=3 fingerprint
+minority vote) with post-recovery trajectory parity vs an undisturbed
+control."""
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import chaos, elastic
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "elastic_worker.py")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import tpu_doctor  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    for var in ("PD_CHAOS_MODE", "PD_CHAOS_STEP", "PD_CHAOS_RANK",
+                "PD_CHAOS_EVERY", "PD_CHAOS_STALL_S", "PD_CHAOS_BIT",
+                "PD_CHAOS_SCALE", "PD_CHAOS_SCOPE"):
+        monkeypatch.delenv(var, raising=False)
+    chaos.reset_plan_cache()
+    yield
+    chaos.reset_plan_cache()
+
+
+def _dump(rank, events):
+    return {"rank": rank, "ts": 100.0 + rank, "reason": "test",
+            "events": [dict(e, k=e["k"], i=i)
+                       for i, e in enumerate(events)],
+            "collective_seq": {}, "progress": {}}
+
+
+class TestChaosParseValidation:
+    """Satellite: malformed PD_CHAOS_* must fail LOUDLY naming the
+    variable — a typo'd drill that injects nothing otherwise reads as
+    a passing receipt."""
+
+    def test_malformed_step_names_variable(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "kill")
+        monkeypatch.setenv("PD_CHAOS_STEP", "banana")
+        with pytest.raises(ValueError, match="PD_CHAOS_STEP"):
+            chaos.plan()
+        # the error persists across calls (every injection point
+        # fails, not just the first)
+        with pytest.raises(ValueError, match="PD_CHAOS_STEP"):
+            chaos.plan()
+
+    def test_unknown_mode_raises(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "meteor")
+        with pytest.raises(ValueError, match="PD_CHAOS_MODE"):
+            chaos.plan()
+
+    def test_malformed_bit_and_range(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "flip_bit")
+        monkeypatch.setenv("PD_CHAOS_BIT", "x")
+        with pytest.raises(ValueError, match="PD_CHAOS_BIT"):
+            chaos.plan()
+        chaos.reset_plan_cache()
+        monkeypatch.setenv("PD_CHAOS_BIT", "40")
+        with pytest.raises(ValueError, match="PD_CHAOS_BIT"):
+            chaos.plan()
+
+    def test_malformed_scale_named(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "scale_grad")
+        monkeypatch.setenv("PD_CHAOS_SCALE", "huge")
+        with pytest.raises(ValueError, match="PD_CHAOS_SCALE"):
+            chaos.plan()
+
+    def test_empty_mode_still_disarms(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "")
+        assert chaos.plan() is None
+
+
+class TestNumericChaosHooks:
+    def test_numeric_mode_returned_not_executed(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "nan_grad")
+        monkeypatch.setenv("PD_CHAOS_STEP", "4")
+        monkeypatch.setenv("PD_CHAOS_RANK", "0")
+        assert chaos.maybe_inject_numeric(3, rank=0,
+                                          incarnation=0) is None
+        assert chaos.maybe_inject_numeric(4, rank=1,
+                                          incarnation=0) is None
+        assert chaos.maybe_inject_numeric(4, rank=0,
+                                          incarnation=0) == "nan_grad"
+        # restarted incarnation survives (first-incarnation default)
+        assert chaos.maybe_inject_numeric(4, rank=0,
+                                          incarnation=1) is None
+        # the TRAINING hook must not fire for a numeric mode
+        assert chaos.maybe_inject(4, rank=0, incarnation=0) is None
+
+    def test_apply_numeric_scope_selection(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "nan_grad")
+        monkeypatch.setenv("PD_CHAOS_SCOPE", "head")
+        tree = {"body.w": np.ones(4, np.float32),
+                "head.w": np.ones(4, np.float32)}
+        out = chaos.apply_numeric(tree, "nan_grad")
+        assert np.isfinite(out["body.w"]).all()
+        assert np.isnan(out["head.w"][0])
+        # input tree untouched (host-callback returns a new dict)
+        assert np.isfinite(tree["head.w"]).all()
+
+    def test_flip_bit_is_one_bit(self, monkeypatch):
+        monkeypatch.setenv("PD_CHAOS_MODE", "flip_bit")
+        monkeypatch.setenv("PD_CHAOS_BIT", "10")
+        tree = {"w": np.full(3, 0.75, np.float32)}
+        out = chaos.apply_numeric(tree, "flip_bit")
+        delta = (out["w"].view(np.uint32)
+                 ^ tree["w"].view(np.uint32))
+        assert list(delta) == [1 << 10, 0, 0]
+
+
+class TestDoctorNumericVerdict:
+    def test_fingerprint_minority_names_rank(self):
+        dumps = [
+            _dump(0, [{"k": "sentry.fingerprint", "step": 8,
+                       "fp": 111}]),
+            _dump(1, [{"k": "sentry.fingerprint", "step": 8,
+                       "fp": 222}]),
+            _dump(2, [{"k": "sentry.fingerprint", "step": 8,
+                       "fp": 111}]),
+        ]
+        diag = tpu_doctor.diagnose(dumps)
+        num = diag["numeric"]
+        assert num["diverging_rank"] == 1
+        assert num["source"] == "fingerprint"
+        v = tpu_doctor.verdict(diag)
+        assert v["kind"] == "numeric" and v["rank"] == 1
+        assert "NUMERIC" in tpu_doctor.format_report(diag)
+
+    def test_first_anomaly_breaks_dp2_tie(self):
+        dumps = [
+            _dump(0, [{"k": "sentry.fingerprint", "step": 8,
+                       "fp": 111},
+                      {"k": "sentry.anomaly", "step": 7, "t": 5.0,
+                       "fault": "spike", "stream": "param.max_abs"}]),
+            _dump(1, [{"k": "sentry.fingerprint", "step": 8,
+                       "fp": 222}]),
+        ]
+        v = tpu_doctor.verdict(tpu_doctor.diagnose(dumps))
+        # no majority at dp=2: the rank whose stats spiked FIRST
+        assert v["kind"] == "numeric" and v["rank"] == 0
+        assert v["evidence"]["source"] == "grad_stats"
+
+    def test_worker_mismatch_culprit_counts_as_vote(self):
+        dumps = [
+            _dump(0, [{"k": "sentry.mismatch", "step": 8, "my_fp": 1,
+                       "culprit": 1, "source": "minority_vote"}]),
+            _dump(1, []),
+        ]
+        v = tpu_doctor.verdict(tpu_doctor.diagnose(dumps))
+        assert v["kind"] == "numeric" and v["rank"] == 1
+
+    def test_priority_divergence_beats_numeric_beats_straggler(self):
+        sentry_ev = [{"k": "sentry.anomaly", "step": 3, "t": 1.0,
+                      "fault": "nonfinite",
+                      "stream": "grad.nonfinite"}]
+        straggle = {"progress": {"step_s_p50": 9.0}}
+        dumps = [_dump(0, []), _dump(1, sentry_ev)]
+        dumps[0]["progress"] = {"step_s_p50": 1.0}
+        dumps[1].update(straggle)
+        v = tpu_doctor.verdict(tpu_doctor.diagnose(dumps))
+        assert v["kind"] == "numeric"  # above straggler
+        # a seq divergence outranks numeric
+        dumps[0]["collective_seq"] = {"dp|allreduce_sum": 5}
+        dumps[1]["collective_seq"] = {"dp|allreduce_sum": 2}
+        v = tpu_doctor.verdict(tpu_doctor.diagnose(dumps))
+        assert v["kind"] == "divergence"
+
+    def test_clean_pod_has_no_numeric_section(self):
+        dumps = [_dump(0, []), _dump(1, [])]
+        diag = tpu_doctor.diagnose(dumps)
+        assert diag["numeric"] is None
+        assert tpu_doctor.verdict(diag)["kind"] == "none"
+
+
+class TestSupervisorQuarantine:
+    def test_numeric_verdict_is_evictable(self):
+        pol = elastic.SupervisorPolicy(world=3, allow_shrink=True,
+                                       min_world=1)
+        verdict = {"kind": "numeric", "rank": 1, "source": "doctor",
+                   "evidence": {"source": "fingerprint"}}
+        d = pol.decide([(1, "exit rc=13")], verdict)
+        assert d.action == "evict_shrink" and d.ranks == [1]
+        assert d.verdict["kind"] == "numeric"
+        assert pol.active == [0, 2]
+
+    def test_numeric_without_shrink_respawns_gang(self):
+        pol = elastic.SupervisorPolicy(world=2, allow_shrink=False)
+        d = pol.decide([(1, "exit rc=13")],
+                       {"kind": "numeric", "rank": 1,
+                        "source": "doctor", "evidence": {}})
+        assert d.action == "respawn_gang"
+        assert d.verdict["kind"] == "numeric"
+
+
+class TestReplayTriage:
+    def _capture(self, tmp_path, x):
+        from paddle_tpu.observability import sentry
+        w = np.ones((4, 1), np.float32)
+        y = np.zeros((8, 1), np.float32)
+        with np.errstate(all="ignore"):
+            g = (2.0 / 8) * (x.T @ (x @ w - y))
+        path = str(tmp_path / "cap.npz")
+        sentry.write_fault_capture(
+            path, {"w": w}, {"x": x, "y": y},
+            observed={"reason": "nonfinite grads",
+                      "grad": sentry.host_stats_by_scope({"w": g})},
+            step=5, rank=1, meta={"model": "linear_mse"})
+        return path
+
+    def test_transient_sdc(self, tmp_path):
+        import replay_triage
+        # observed nonfinite, but the captured inputs are CLEAN — the
+        # corruption came from outside the math (inject post-hoc)
+        from paddle_tpu.observability import sentry
+        x = np.ones((8, 4), np.float32)
+        path = str(tmp_path / "cap.npz")
+        sentry.write_fault_capture(
+            path, {"w": np.ones((4, 1), np.float32)},
+            {"x": x, "y": np.zeros((8, 1), np.float32)},
+            observed={"reason": "nonfinite grads",
+                      "grad": {"other": {"nonfinite": 3,
+                                         "max_abs": 1.0, "l2": 1.0}}},
+            step=5, rank=1, meta={"model": "linear_mse"})
+        cap = sentry.load_fault_capture(path)
+        res = replay_triage.classify(
+            cap, replay_triage.builder_linear_mse)
+        assert res["verdict"] == "transient"
+        assert replay_triage.main(["--capture", path]) == 0
+
+    def test_reproducible_software_bug(self, tmp_path):
+        import replay_triage
+        x = np.ones((8, 4), np.float32)
+        x[0, 0] = np.inf  # the BATCH itself produces the nonfinites
+        path = self._capture(tmp_path, x)
+        from paddle_tpu.observability import sentry
+        res = replay_triage.classify(
+            sentry.load_fault_capture(path),
+            replay_triage.builder_linear_mse)
+        assert res["verdict"] == "reproducible"
+
+
+def _launch_numeric(tmp_path, *, chaos_env, nproc=2, steps=18,
+                    extra=(), worker_extra=(), timeout=300):
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "out")
+    receipts = str(tmp_path / "receipts")
+    os.makedirs(ckpt, exist_ok=True)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc), "--elastic",
+           "--heartbeat_timeout", "5", "--restart_backoff", "0.1",
+           "--dump_grace", "0.5", *extra,
+           WORKER, "--ckpt-dir", ckpt, "--out-dir", out,
+           "--steps", str(steps), "--sharded-ckpt", "--sentry",
+           "--ckpt-every", "3", *worker_extra]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PD_ELASTIC_DIR=receipts)
+    for var in ("PD_CHAOS_MODE", "PD_CHAOS_BIT"):
+        env.pop(var, None)
+    env.update(chaos_env)
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout, env=env, cwd=REPO)
+    recs = []
+    for f in sorted(glob.glob(os.path.join(receipts,
+                                           "receipt_*.json"))):
+        with open(f) as fh:
+            recs.append(json.load(fh))
+    return r, out, recs
+
+
+class TestNumericDrillFast:
+    """Tier-1 acceptance smoke (~9 s): flip_bit on rank 1 of a dp=2
+    elastic run -> NUMERIC verdict names the rank, supervisor
+    quarantine-evicts it, survivor resumes from a health-stamped
+    checkpoint, and the fault capture triages as transient SDC."""
+
+    def test_flip_bit_quarantine_and_healthy_resume(self, tmp_path):
+        r, out, recs = _launch_numeric(
+            tmp_path,
+            chaos_env={"PD_CHAOS_MODE": "flip_bit",
+                       "PD_CHAOS_STEP": "8", "PD_CHAOS_RANK": "1",
+                       "PD_CHAOS_BIT": "30"},
+            extra=("--elastic_shrink",))
+        assert r.returncode == 0, r.stderr[-3000:]
+        evict = [x for x in recs if x["action"] == "evict_shrink"]
+        assert evict, [x["action"] for x in recs]
+        rec = evict[0]
+        assert rec["ranks"] == [1]
+        assert rec["verdict"]["kind"] == "numeric"
+        assert rec["verdict"]["rank"] == 1
+        assert rec["verdict"]["source"] == "doctor"
+        # the remediation demanded a certified-good resume
+        assert "health-stamped" in r.stderr
+        # survivor finished every step at the shrunk world
+        with open(os.path.join(out, "rank0.json")) as f:
+            surv = json.load(f)
+        assert surv["steps_done"] == 18 and surv["world"] == 1
+        # the quarantined rank left a fault capture and replay-triage
+        # classifies it deterministically (a LOUD param flip snapshots
+        # already-poisoned params, so the verdict may honestly read
+        # reproducible-from-this-state — the transient-SDC semantics
+        # are pinned by TestReplayTriage on clean-param captures)
+        caps = glob.glob(os.path.join(out, "fault_slot1.npz"))
+        assert caps
+        import replay_triage
+        assert replay_triage.main(["--capture", caps[0]]) == 0
+        from paddle_tpu.observability import sentry
+        res = replay_triage.classify(
+            sentry.load_fault_capture(caps[0]),
+            replay_triage.builder_linear_mse)
+        assert res["verdict"] in ("transient", "reproducible")
+
+
+@pytest.mark.slow  # full kill-the-math acceptance drills: each is a
+#   control + chaos elastic pair with trajectory parity; the tier-1
+#   siblings above keep the verdict/units/fast-drill coverage
+class TestNumericAcceptanceDrills:
+    def test_nan_grad_drill_trajectory_parity(self, tmp_path):
+        import chaos_drill
+        rc = chaos_drill.main([
+            "--mode", "nan_grad", "--steps", "30", "--step", "9",
+            "--goodput-bar", "0.3", "--workdir", str(tmp_path)])
+        assert rc == 0
+
+    def test_flip_bit_shrink_drill(self, tmp_path):
+        import chaos_drill
+        rc = chaos_drill.main([
+            "--mode", "flip_bit", "--steps", "30", "--step", "9",
+            "--shrink", "--goodput-bar", "0.3",
+            "--workdir", str(tmp_path)])
+        assert rc == 0
+
+    def test_quiet_flip_dp3_fingerprint_minority(self, tmp_path):
+        """The poisoned-checkpoint rollback drill: a QUIET mantissa
+        flip (no spike) is only catchable by the fingerprint minority
+        vote at dp=3; the poisoned rank's post-fault checkpoints are
+        stamped unhealthy and the resume walks past them."""
+        r, out, recs = _launch_numeric(
+            tmp_path,
+            chaos_env={"PD_CHAOS_MODE": "flip_bit",
+                       "PD_CHAOS_STEP": "12", "PD_CHAOS_RANK": "1",
+                       "PD_CHAOS_BIT": "10"},
+            nproc=3, steps=24, extra=("--elastic_shrink",),
+            worker_extra=("--global-batch", "12"))
+        assert r.returncode == 0, r.stderr[-3000:]
+        evict = [x for x in recs if x["action"] == "evict_shrink"]
+        assert evict and evict[0]["verdict"]["kind"] == "numeric"
+        assert evict[0]["verdict"]["rank"] == 1
+        assert evict[0]["verdict"]["evidence"]["source"] == \
+            "fingerprint"
+        fp = evict[0]["verdict"]["evidence"]["fingerprint"]
+        # the vote itself is in the receipt: 2 agree, rank 1 differs
+        vals = list(fp["fingerprints"].values())
+        assert vals.count(fp["fingerprints"]["1"]) == 1

@@ -1,0 +1,449 @@
+"""Numeric-integrity sentry (ISSUE 13): in-graph stats + fingerprint
+parity, the rolling z-score monitor, health stamps, fingerprint
+judging, TrainStep integration (one executable, zero recompiles, a
+bit-identical program when disabled), the loss-scale skip visibility
+satellite, and the graph_lint zero-new-findings pin for the
+sentry-instrumented program."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.analysis import GraphLintConfig, ProgramAudit, run_rules
+from paddle_tpu.observability import flight_recorder as fr
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability import sentry
+from paddle_tpu.static import TrainStep
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    metrics.reset()
+    fr.reset()
+    yield
+    metrics.disable()
+    fr.disable()
+    metrics.reset()
+    fr.reset()
+
+
+def _events(kind):
+    return [e for e in fr.get_recorder().events() if e.get("k") == kind]
+
+
+class TestScopeMap:
+    def test_core_scope_tokens(self):
+        assert sentry.scope_of_param("ernie.embeddings.word_embeddings.weight") == "embed"
+        assert sentry.scope_of_param("encoder.layer.0.attention.self.q_proj.weight") == "attn"
+        assert sentry.scope_of_param("encoder.layer.0.ffn.weight") == "mlp"
+        assert sentry.scope_of_param("cls.predictions.bias") == "mlm_head_ce"
+        assert sentry.scope_of_param("w") == "other"
+
+
+class TestFingerprint:
+    def test_host_and_jit_agree_and_bit_sensitivity(self):
+        rng = np.random.RandomState(0)
+        tree = {
+            "a": rng.randn(8, 4).astype(np.float32),
+            "b": rng.randn(3).astype(np.float32),
+            "ids": np.arange(5, dtype=np.int32),
+        }
+        host = sentry.host_fingerprint(tree)
+        jitted = int(jax.jit(sentry.fingerprint_tree)(
+            {k: jnp.asarray(v) for k, v in tree.items()}))
+        assert host == jitted
+        # one flipped mantissa bit changes the fingerprint
+        flipped = {k: np.array(v, copy=True) for k, v in tree.items()}
+        bits = flipped["a"].reshape(-1).view(np.uint32)
+        bits[3] ^= np.uint32(1 << 3)
+        assert sentry.host_fingerprint(flipped) != host
+        # and identical trees agree (replica contract)
+        assert sentry.host_fingerprint(
+            {k: np.array(v, copy=True) for k, v in tree.items()}) == host
+
+    def test_bf16_leaves_fingerprint(self):
+        tree = {"w": jnp.asarray(
+            np.random.RandomState(1).randn(6).astype(np.float32)
+        ).astype(jnp.bfloat16)}
+        host = sentry.host_fingerprint(
+            {"w": np.asarray(tree["w"]).view(np.uint16)})
+        # the jnp path bitcasts bf16 -> u16 -> u32; feeding the host
+        # twin the raw u16 view must land on the same value
+        assert int(sentry.fingerprint_tree(tree)) == host
+
+
+class TestStats:
+    def test_jit_host_parity_and_nan_proofing(self):
+        rng = np.random.RandomState(2)
+        tree = {"layer.attn.w": rng.randn(4, 4).astype(np.float32),
+                "layer.ffn.w": rng.randn(4, 4).astype(np.float32)}
+        tree["layer.ffn.w"][0, 0] = np.nan
+        host = sentry.host_stats_by_scope(tree)
+        jitted = jax.jit(sentry.stats_by_scope)(
+            {k: jnp.asarray(v) for k, v in tree.items()})
+        assert set(host) == set(jitted) == {"attn", "mlp"}
+        assert host["mlp"]["nonfinite"] == 1
+        assert int(jitted["mlp"]["nonfinite"]) == 1
+        # magnitude streams stay finite despite the nan (nan-proofed)
+        assert np.isfinite(host["mlp"]["max_abs"])
+        assert np.isfinite(float(jitted["mlp"]["max_abs"]))
+        np.testing.assert_allclose(host["attn"]["l2"],
+                                   float(jitted["attn"]["l2"]),
+                                   rtol=1e-6)
+
+
+class TestMonitor:
+    def _cfg(self, **kw):
+        base = dict(window=8, min_warmup=3, z_threshold=6.0)
+        base.update(kw)
+        return sentry.SentryConfig(**base)
+
+    def test_spike_flags_after_warmup_only(self):
+        # a wild value DURING warmup must not flag (z-scores unarmed)
+        cold = sentry.SentryMonitor(self._cfg())
+        assert cold.observe(0, {"other": {"nonfinite": 0,
+                                          "max_abs": 1e6,
+                                          "l2": 1.0}}) == []
+        mon = sentry.SentryMonitor(self._cfg())
+        for s in range(6):
+            assert mon.observe(s, {"other": {
+                "nonfinite": 0, "max_abs": 1.0 + 0.01 * s,
+                "l2": 3.0}}) == []
+        flagged = mon.observe(6, {"other": {"nonfinite": 0,
+                                            "max_abs": 1e6, "l2": 3.0}})
+        assert [a["kind"] for a in flagged] == ["spike"]
+        assert flagged[0]["stream"] == "grad.max_abs"
+        assert flagged[0]["z"] > 6.0
+
+    def test_nonfinite_always_on_counter_and_fr_event(self):
+        fr.enable()
+        assert not metrics.enabled()  # hot-path gate DOWN
+        mon = sentry.SentryMonitor(self._cfg())
+        flagged = mon.observe(3, {"attn": {"nonfinite": 2,
+                                           "max_abs": 1.0, "l2": 1.0}})
+        assert flagged[0]["kind"] == "nonfinite"
+        assert metrics.counter("sentry.anomalies_total",
+                               kind="nonfinite").value() == 1
+        evs = _events("sentry.anomaly")
+        assert len(evs) == 1
+        assert evs[0]["fault"] == "nonfinite" and evs[0]["scope"] == "attn"
+
+    def test_clean_window_counts_steps_and_health_stamp(self):
+        mon = sentry.SentryMonitor(self._cfg(min_clean_for_healthy=3))
+        for s in range(4):
+            mon.observe(s, {"o": {"nonfinite": 0, "max_abs": 1.0,
+                                  "l2": 1.0}}, kind="grad")
+            mon.observe(s, {"o": {"nonfinite": 0, "max_abs": 1.0,
+                                  "l2": 1.0}}, kind="param")
+        assert mon.clean_window == 4  # per step, not per observe call
+        assert mon.health_stamp()["healthy"]
+        mon.observe(4, {"o": {"nonfinite": 1, "max_abs": 1.0,
+                              "l2": 1.0}})
+        stamp = mon.health_stamp()
+        assert not stamp["healthy"] and stamp["clean_window"] == 0
+        for s in range(5, 7):
+            mon.observe(s, {"o": {"nonfinite": 0, "max_abs": 1.0,
+                                  "l2": 1.0}})
+        assert not mon.health_stamp()["healthy"]  # streak 2 < 3
+        mon.observe(7, {"o": {"nonfinite": 0, "max_abs": 1.0,
+                              "l2": 1.0}})
+        assert mon.health_stamp()["healthy"]
+
+    def test_fatal_policy_grad_vs_param_streams(self):
+        mon = sentry.SentryMonitor(self._cfg(fatal_nonfinite=True))
+        # nonfinite PARAMS quarantine via the fingerprint probe, not a
+        # lone halt — only grad/loss nonfinites are immediately fatal
+        mon.observe(0, {"o": {"nonfinite": 1, "max_abs": 1.0,
+                              "l2": 1.0}}, kind="param")
+        with pytest.raises(sentry.NumericFault) as ei:
+            mon.observe(1, {"o": {"nonfinite": 1, "max_abs": 1.0,
+                                  "l2": 1.0}}, kind="grad")
+        assert ei.value.anomaly["stream"] == "grad.nonfinite"
+
+    def test_fatal_spike_on_param_stream(self):
+        mon = sentry.SentryMonitor(self._cfg(fatal_spike=True))
+        for s in range(5):
+            mon.observe(s, {"o": {"max_abs": 1.0, "l2": 1.0,
+                                  "nonfinite": 0}}, kind="param")
+        with pytest.raises(sentry.NumericFault):
+            mon.observe(5, {"o": {"max_abs": 1e9, "l2": 1.0,
+                                  "nonfinite": 0}}, kind="param")
+
+    def test_judge_fingerprints(self):
+        fr.enable()
+        mon = sentry.SentryMonitor(self._cfg())
+        # agreement
+        assert mon.judge_fingerprints(0, 7, {1: 7, 2: 7}) is None
+        # minority vote at dp=3
+        assert mon.judge_fingerprints(0, 7, {1: 9, 2: 7}) == 1
+        # dp=2 tie, locally clean -> cannot pin a rank
+        assert mon.judge_fingerprints(0, 7, {1: 9}) is None
+        assert metrics.counter(
+            "sentry.fingerprint_mismatches_total").value() == 2
+        assert len(_events("sentry.mismatch")) == 2
+        # dp=2 tie with a LOCAL anomaly since the last probe -> me
+        mon.observe_fingerprint(4, 7)
+        mon.observe(5, {"o": {"nonfinite": 1, "max_abs": 1.0,
+                              "l2": 1.0}})
+        assert mon.judge_fingerprints(0, 8, {1: 7}, step=8) == 0
+
+    def test_tie_break_window_spans_back_to_previous_probe(self):
+        # review regression: the worker probes BEFORE judging, so the
+        # window must start at the PREVIOUS probe — an anomaly between
+        # the two probes (the fault step) must count as the tell
+        mon = sentry.SentryMonitor(self._cfg())
+        mon.observe_fingerprint(3, 100)          # agreed probe
+        mon.observe(5, {"o": {"nonfinite": 1, "max_abs": 1.0,
+                              "l2": 1.0}})       # the fault
+        mon.observe_fingerprint(7, 200)          # mismatching probe
+        assert mon.judge_fingerprints(0, 200, {1: 100}, step=7) == 0
+        # ... but anomalies BEFORE the agreed probe do not vouch
+        mon2 = sentry.SentryMonitor(self._cfg())
+        mon2.observe(1, {"o": {"nonfinite": 1, "max_abs": 1.0,
+                               "l2": 1.0}})
+        mon2.observe_fingerprint(3, 100)         # agreed since then
+        mon2.observe_fingerprint(7, 200)
+        assert mon2.judge_fingerprints(0, 200, {1: 100}, step=7) is None
+
+    def test_mismatch_dirties_health_but_is_not_the_local_tell(self):
+        # review regression: a tie mismatch is recorded as an anomaly
+        # (post-mismatch checkpoints are uncertified fleet-wide) but a
+        # bilateral mismatch record must never self-convict a rank at
+        # the NEXT probe
+        mon = sentry.SentryMonitor(self._cfg(min_clean_for_healthy=1))
+        mon.observe(0, {"o": {"nonfinite": 0, "max_abs": 1.0,
+                              "l2": 1.0}})
+        assert mon.health_stamp()["healthy"]
+        mon.observe_fingerprint(3, 100)
+        assert mon.judge_fingerprints(0, 100, {1: 999},
+                                      step=3) is None  # tie
+        assert not mon.health_stamp()["healthy"]  # now uncertified
+        mon.observe_fingerprint(7, 200)
+        # only the mismatch anomaly sits in the window: still a tie,
+        # NOT a self-conviction
+        assert mon.judge_fingerprints(0, 200, {1: 999},
+                                      step=7) is None
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+        self.head = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.head(self.fc(x))
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _build_step(sentry_obj=None, scaler=None, model=None):
+    paddle.seed(0)
+    m = model or _Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    return TrainStep(m, _mse, opt, sentry=sentry_obj, scaler=scaler)
+
+
+class TestTrainStepIntegration:
+    def test_sentry_rides_one_executable(self):
+        sen = sentry.NumericSentry(sentry.SentryConfig(
+            fingerprint_every=2, min_warmup=2))
+        step = _build_step(sentry_obj=sen)
+        X = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32))
+        Y = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 2).astype(np.float32))
+        for _ in range(5):
+            step(X, (Y,))
+        # ONE executable, ZERO recompiles — the sentry outputs ride
+        # the existing program (always-on counter, gate down)
+        assert int(step._step_fn._cache_size()) == 1
+        assert metrics.counter("train_recompiles_total",
+                               engine="train").value() == 0
+        # the monitor was fed every step; the probe fired on schedule
+        assert sen.monitor.last_step == 4
+        assert sen.monitor.last_fingerprint_step == 4  # steps 0,2,4
+        assert sen.monitor.last_fingerprint is not None
+        # strategy state threads the probe counter/fingerprint
+        assert "sentry_step" in step.strategy_state
+        assert "sentry_fp" in step.strategy_state
+        # in-graph fingerprint == host fingerprint of the live params
+        assert sen.monitor.last_fingerprint == sentry.host_fingerprint(
+            {k: np.asarray(v) for k, v in step.params.items()})
+
+    def test_disabled_sentry_is_bit_identical_program(self):
+        # the gate-down guard: without sentry= nothing changes — no
+        # strategy keys, no monitor, and the lowered HLO is byte-equal
+        # to a pre-sentry build (overhead exactly 0, not merely <1%)
+        plain = _build_step()
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        Y = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        plain(paddle.to_tensor(X), (paddle.to_tensor(Y),))
+        assert plain.sentry is None
+        assert "sentry_step" not in plain.strategy_state
+        armed = _build_step(sentry_obj=sentry.NumericSentry(
+            sentry.SentryConfig(fingerprint_every=2)))
+        t_plain = plain.aot_lower((X,), (Y,)).as_text()
+        t_armed = armed.aot_lower((X,), (Y,)).as_text()
+        assert "sentry" not in t_plain
+        assert t_plain != t_armed  # the armed program really differs
+
+    def test_loss_scale_skip_visibility(self):
+        fr.enable()
+        metrics.enable()
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+        step = _build_step(scaler=scaler)
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        Y = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        step(paddle.to_tensor(X), (paddle.to_tensor(Y),))
+        assert metrics.counter("amp.loss_scale.skipped_total"
+                               ).value() == 0
+        w_before = {k: np.asarray(v) for k, v in step.params.items()}
+        bad = np.array(X, copy=True)
+        bad[0, 0] = np.inf  # forced-inf step -> found_inf skip branch
+        step(paddle.to_tensor(bad), (paddle.to_tensor(Y),))
+        # all three signals: always-on counter, fr breadcrumb, gauge
+        assert metrics.counter("amp.loss_scale.skipped_total"
+                               ).value() == 1
+        evs = _events("loss_scale.skip")
+        assert len(evs) == 1 and evs[0]["step"] == 1
+        assert metrics.gauge("amp.loss_scale.scale").value() > 0
+        # and the step really was a no-op on params (skip semantics)
+        for k, v in step.params.items():
+            np.testing.assert_array_equal(w_before[k], np.asarray(v))
+
+    def test_loss_scale_skip_ground_truth_survives_gate_down(self):
+        # with every observability plane down there is NO host read on
+        # the hot path (the in-graph scaler's no-host-sync contract) —
+        # the skip count still exists as the in-graph cumulative
+        # strategy_state["amp_skipped"], checkpointed and readable at
+        # any sync point
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+        step = _build_step(scaler=scaler)
+        assert not metrics.enabled() and not fr.enabled()
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        X[0, 0] = np.inf
+        Y = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        step(paddle.to_tensor(X), (paddle.to_tensor(Y),))
+        step(paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32)),
+            (paddle.to_tensor(Y),))
+        assert int(np.asarray(
+            step.strategy_state["amp_skipped"])) == 1
+
+    def test_eager_scaler_update_instrumented(self):
+        from paddle_tpu.amp.grad_scaler import AmpScaler
+        fr.enable()
+        sc = AmpScaler(init_loss_scaling=8.0)
+        sc._update(True)
+        assert metrics.counter("amp.loss_scale.skipped_total"
+                               ).value() == 1
+        assert _events("loss_scale.skip")[0]["scale"] == 8.0
+
+    def test_sentry_detects_injected_nan_in_live_step(self):
+        # end-to-end through the compiled step: poison an input, the
+        # in-graph stats surface the nonfinite grads, the monitor
+        # records the anomaly
+        fr.enable()
+        sen = sentry.NumericSentry(sentry.SentryConfig(
+            fingerprint_every=0, min_warmup=2))
+        step = _build_step(sentry_obj=sen)
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        Y = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        step(paddle.to_tensor(X), (paddle.to_tensor(Y),))
+        bad = np.array(X, copy=True)
+        bad[0, 0] = np.nan
+        step(paddle.to_tensor(bad), (paddle.to_tensor(Y),))
+        kinds = {a["kind"] for a in sen.monitor.anomalies}
+        assert "nonfinite" in kinds or "loss_nonfinite" in kinds
+        assert not sen.monitor.health_stamp()["healthy"]
+
+
+class TestGraphLintClean:
+    def test_sentry_program_adds_zero_findings(self):
+        # the sentry-instrumented step must lint as clean as the plain
+        # one — no new donation/dtype/constant findings, one program
+        X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        Y = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        plain = _build_step()
+        armed = _build_step(sentry_obj=sentry.NumericSentry(
+            sentry.SentryConfig(fingerprint_every=4)))
+        cfg = GraphLintConfig(donation_bytes=64)  # tiny-model bar
+        f_plain = run_rules(ProgramAudit(
+            "sentry_clean", lowered=plain.aot_lower((X,), (Y,)),
+            config=cfg))
+        f_armed = run_rules(ProgramAudit(
+            "sentry_clean", lowered=armed.aot_lower((X,), (Y,)),
+            config=cfg))
+        new = ({f.fingerprint for f in f_armed}
+               - {f.fingerprint for f in f_plain})
+        assert new == set(), [f.summary for f in f_armed]
+
+
+class TestFaultCapture:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "cap.npz")
+        params = {"w": np.arange(6, dtype=np.float32).reshape(3, 2)}
+        batch = {"x": np.ones((2, 3), np.float32)}
+        sentry.write_fault_capture(
+            path, params, batch,
+            observed={"reason": "test", "grad": {"other": {
+                "nonfinite": 1, "max_abs": 2.0, "l2": 2.0}}},
+            step=7, rank=1, meta={"model": "linear_mse"})
+        cap = sentry.load_fault_capture(path)
+        assert cap["step"] == 7 and cap["rank"] == 1
+        np.testing.assert_array_equal(cap["params"]["w"], params["w"])
+        np.testing.assert_array_equal(cap["batch"]["x"], batch["x"])
+        assert cap["observed"]["reason"] == "test"
+        assert cap["meta"]["model"] == "linear_mse"
+
+
+class TestStateDictReseed:
+    def test_restoring_pre_sentry_checkpoint_reseeds_new_keys(self):
+        # review regression: a wholesale strategy_state replace from a
+        # candidate that PREDATES the sentry/amp-skip keys must not
+        # hand the compiled step a pytree missing the keys it was
+        # traced with — that KeyErrors inside the numeric rollback
+        sen = sentry.NumericSentry(sentry.SentryConfig(
+            fingerprint_every=2))
+        step = _build_step(
+            sentry_obj=sen,
+            scaler=GradScaler(init_loss_scaling=2.0 ** 10))
+        X = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32))
+        Y = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 2).astype(np.float32))
+        step(X, (Y,))
+        old = step.state_dict()
+        # a pre-PR checkpoint: amp scale state but no amp_skipped, and
+        # no sentry keys at all
+        legacy_strat = {
+            k: v for k, v in old["strategy_state"].items()
+            if k in ("amp_scale", "amp_good", "amp_bad")}
+        step.set_state_dict({"model": old["model"],
+                             "opt_state": old["opt_state"],
+                             "opt": old["opt"],
+                             "strategy_state": legacy_strat})
+        assert "amp_skipped" in step.strategy_state
+        assert "sentry_step" in step.strategy_state
+        step(X, (Y,))  # must not KeyError, must not retrace
+        assert int(step._step_fn._cache_size()) == 1
+
+
+class TestAgreementTracking:
+    def test_agreed_probe_step_advances_only_on_agreement(self):
+        mon = sentry.SentryMonitor(sentry.SentryConfig())
+        assert mon.last_agreed_probe_step is None
+        mon.observe_fingerprint(4, 7)
+        assert mon.judge_fingerprints(0, 7, {1: 7}, step=4) is None
+        assert mon.last_agreed_probe_step == 4
+        mon.observe_fingerprint(8, 9)
+        mon.judge_fingerprints(0, 9, {1: 7}, step=8)  # mismatch
+        assert mon.last_agreed_probe_step == 4  # NOT advanced

@@ -347,3 +347,33 @@ def test_spmd_mode_state_dict_roundtrip():
     np.testing.assert_array_equal(
         np.asarray(sd["stages"][1]["lin.weight"]._data),
         np.asarray(eng.params["lin.weight"][1]))
+
+
+def test_spmd_sentry_stats_ride_the_one_program():
+    """ISSUE 13: the numeric sentry's per-scope stats compile into the
+    spmd_1f1b program as scalar outputs — same executable count, the
+    monitor fed per step, anomalies surfacing on a poisoned batch."""
+    from paddle_tpu.observability import sentry as sentry_mod
+
+    w0, b0, x, y = _data(3)
+    paddle.seed(0)
+    stages = [_TanhStage(w0[i], b0[i]) for i in range(S)]
+    mesh = dist.build_mesh({"pp": S}, devices=jax.devices()[:S])
+    sen = sentry_mod.NumericSentry(sentry_mod.SentryConfig(
+        min_warmup=2))
+    eng = dist.PipelineParallel(
+        stages, _loss_fn, paddle.optimizer.SGD(learning_rate=1e-2),
+        num_micro=M, mesh=mesh, exec_mode="spmd_1f1b", sentry=sen)
+    for _ in range(3):
+        eng.train_batch(x, y)
+    assert eng.compile_count == 1
+    assert sen.monitor.last_step == 2
+    assert sen.monitor.anomalies == []
+    assert sen.monitor.health_stamp()["healthy"]
+    # poison the batch: the in-graph stats must surface nonfinites
+    bad = np.asarray(x._data).copy()
+    bad[0, 0] = np.nan
+    eng.train_batch(paddle.to_tensor(bad), y)
+    assert any(a["kind"] in ("nonfinite", "loss_nonfinite")
+               for a in sen.monitor.anomalies)
+    assert eng.compile_count == 1  # still the one program

@@ -9,7 +9,17 @@ sharded checkpoints + topology manifest + DataShardCursor):
 
   control   undisturbed
   chaos     one deterministic PD_CHAOS_* fault (kill / stall /
-            corrupt_ckpt) injected at a named (rank, step)
+            corrupt_ckpt / nan_grad / flip_bit) injected at a named
+            (rank, step)
+
+The NUMERIC modes (nan_grad, flip_bit) arm the worker's sentry
+(--sentry): the faulted rank must be named by a NUMERIC verdict —
+sentry anomaly evidence or the cross-replica fingerprint minority
+vote — quarantined, and the fleet must resume from a HEALTH-STAMPED
+checkpoint; afterwards the post-recovery loss trajectory (and the
+final weights) must match the undisturbed control bit-for-bit
+(trajectory_match below) — the kill-the-math twin of the zero-drop
+serving drill.
 
 and the drill then checks, from artifacts alone:
 
@@ -53,11 +63,22 @@ EXPECT_VERDICTS = {
     # kill/corrupt_ckpt SIGKILL the rank before it can dump, so the
     # supervisor's crash evidence is the verdict; a stalled rank stays
     # alive and the doctor names it from its dump — by step-gate seq
-    # divergence (it never entered the gate) or a watchdog hang record
+    # divergence (it never entered the gate) or a watchdog hang record.
+    # The numeric modes MUST triage as NUMERIC (the sentry's verdict,
+    # from anomaly evidence or the fingerprint minority vote) — a
+    # plain crash verdict means the sentry plane failed to attribute.
     "kill": ("crash",),
     "stall": ("divergence", "hang", "heartbeat_stall"),
     "corrupt_ckpt": ("crash",),
+    "nan_grad": ("numeric",),
+    "flip_bit": ("numeric",),
 }
+# the REMEDIATING subset of chaos.NUMERIC_MODES (deliberately not the
+# same name — this tool stays import-light and must not silently track
+# that tuple): scale_grad is visibility-only (a z-score anomaly with
+# no quarantine policy attached), drilled at unit level, so it has no
+# end-to-end remediation receipt to check here
+DRILL_NUMERIC_MODES = ("nan_grad", "flip_bit")
 
 
 def _run_once(args, tag: str, chaos_mode: str, workdir: str) -> dict:
@@ -83,13 +104,19 @@ def _run_once(args, tag: str, chaos_mode: str, workdir: str) -> dict:
             "--ckpt-every", str(args.ckpt_every)]
     if chaos_mode == "stall":
         cmd += ["--watchdog"]  # stall forensics -> doctor hang verdict
+    if args.sentry:
+        # control and chaos BOTH run the sentry: the overhead and the
+        # health stamps must be part of the baseline being compared
+        cmd += ["--sentry", "--sentry-probe-every",
+                str(args.probe_every)]
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
                PD_ELASTIC_DIR=receipts)
     env.pop("PD_CHAOS_MODE", None)
     if chaos_mode != "none":
         env.update(PD_CHAOS_MODE=chaos_mode,
                    PD_CHAOS_STEP=str(args.step),
-                   PD_CHAOS_RANK=str(args.rank))
+                   PD_CHAOS_RANK=str(args.rank),
+                   PD_CHAOS_BIT=str(args.bit))
     t0 = time.perf_counter()
     r = subprocess.run(cmd, capture_output=True, text=True,
                        timeout=args.timeout, env=env, cwd=REPO)
@@ -133,13 +160,49 @@ def check_receipt(args, chaos: dict) -> dict:
                  "ranks": r.get("ranks")} for r in chaos["receipts"]]}
 
 
+def _trajectory_match(control: dict, chaos: dict) -> dict:
+    """Post-recovery parity: every surviving slot's final weights and
+    loss tail must MATCH the undisturbed control (the sharded worker's
+    global-window updates make per-step params topology-independent,
+    so the comparison is exact — one f32 round-trip through the
+    checkpoint is the only tolerance)."""
+    import numpy as np
+    per_slot = {}
+    for name, doc in chaos["outs"].items():
+        ctrl = control["outs"].get(name)
+        if ctrl is None or "w" not in doc:
+            continue
+        w_ok = bool(np.allclose(doc["w"], ctrl["w"],
+                                rtol=1e-6, atol=1e-7))
+        tail = min(len(doc.get("losses") or []),
+                   len(ctrl.get("losses") or []), 5)
+        l_ok = bool(np.allclose((doc.get("losses") or [])[-tail:],
+                                (ctrl.get("losses") or [])[-tail:],
+                                rtol=1e-6)) if tail else None
+        per_slot[name] = {"w": w_ok, "loss_tail": l_ok}
+    ok = bool(per_slot) and all(
+        v["w"] and v["loss_tail"] is not False
+        for v in per_slot.values())
+    return {"ok": ok, "per_slot": per_slot}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--mode", choices=("kill", "stall", "corrupt_ckpt"),
+    ap.add_argument("--mode", choices=("kill", "stall", "corrupt_ckpt",
+                                       "nan_grad", "flip_bit"),
                     default="kill")
     ap.add_argument("--step", type=int, default=5,
                     help="inject at this step (deterministic)")
     ap.add_argument("--rank", type=int, default=1)
+    ap.add_argument("--bit", type=int, default=30,
+                    help="flip_bit: which f32 bit to XOR (30 = loud "
+                         "exponent flip the z-score catches; low "
+                         "mantissa bits need the fingerprint probe)")
+    ap.add_argument("--sentry", action="store_true", default=None,
+                    help="arm the worker sentry (default: on for "
+                         "numeric modes, off otherwise)")
+    ap.add_argument("--probe-every", dest="probe_every", type=int,
+                    default=4, help="sentry fingerprint period")
     ap.add_argument("--nproc", type=int, default=2)
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--step-time", type=float, default=0.1)
@@ -162,6 +225,8 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="full artifacts, not just the receipt line")
     args = ap.parse_args(argv)
+    if args.sentry is None:
+        args.sentry = args.mode in DRILL_NUMERIC_MODES
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="pd_chaos_")
     control = _run_once(args, "control", "none", workdir)
@@ -178,10 +243,15 @@ def main(argv=None) -> int:
                  and len(chaos["outs"]) >= expect_outs)
     restarted = any(d.get("incarnation", 0) >= 1
                     for d in chaos["outs"].values()) or args.shrink
+    # numeric acceptance: post-recovery trajectory == undisturbed run
+    trajectory = (_trajectory_match(control, chaos)
+                  if args.mode in DRILL_NUMERIC_MODES else None)
 
-    verdict_ok = bool(completed and receipt["ok"] and restarted)
+    verdict_ok = bool(completed and receipt["ok"] and restarted
+                      and (trajectory is None or trajectory["ok"]))
     summary = {
         "mode": args.mode, "shrink": args.shrink,
+        "trajectory_match": trajectory,
         "control": {k: control[k] for k in
                     ("rc", "wall_s", "steps_reached",
                      "goodput_steps_per_s")},

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""replay_triage: re-execute a captured fault step and classify it —
+*reproducible* (software bug: file it) vs *transient* (silent data
+corruption: quarantine the chip).
+
+This is the distinction every real TPU fleet triages on. When the
+numeric sentry halts a rank it writes a fault capture
+(observability.sentry.write_fault_capture): the exact (params, batch,
+rng) the faulting step consumed plus the stats the sentry observed.
+This tool re-executes that step N times from the capture:
+
+  - the anomaly RECURS on every replay  -> the math itself produces it
+    from these inputs: a software bug (bad data, numerically unstable
+    op, broken kernel) — deterministic, file a bug, do NOT waste a
+    chip swap on it;
+  - every replay is CLEAN               -> the captured inputs do not
+    produce the observed corruption: the original fault came from
+    outside the math (a flipped bit, a bad chip) — transient SDC,
+    quarantine the hardware;
+  - replays DISAGREE with each other    -> inconclusive (this host is
+    itself unreliable, or the step is nondeterministic — escalate).
+
+One caveat the verdict must be read with: the capture snapshots the
+params AT FAULT TIME. When the corruption landed in the params
+themselves (a weight-bit flip the sentry confirmed at a later probe),
+an honest replay reproduces the downstream nonfinites from the
+poisoned state — "reproducible" then means "the step is deterministic
+given this state", and the ORIGIN question is answered by the health
+stamps instead (the require_healthy walk already located the last
+checkpoint before the corruption; re-run triage from there to prove
+the clean-state step is clean). A grad-level fault (nan_grad shape)
+captures CLEAN params, so transient-vs-reproducible reads directly.
+
+The step re-execution comes from a BUILDER: a callable
+``builder(capture) -> per-scope host stats`` for the recomputed grads.
+``--builder module:attr`` plugs in a model-specific one; the built-in
+``linear_mse`` matches tests/elastic_worker.py's model (the capture's
+``meta.model`` selects it automatically).
+
+Usage:
+  python tools/replay_triage.py --capture /path/fault_slot1.npz
+  python tools/replay_triage.py --capture ... --trials 5 --json
+
+Prints one ``replay_triage: {json}`` line. Exit 0 = classified
+(either way — the classification IS the success), 2 = inconclusive,
+1 = unreadable capture / builder error.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+from typing import Callable, Dict
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from paddle_tpu.observability import sentry  # noqa: E402
+
+
+def builder_linear_mse(capture: dict) -> Dict[str, Dict[str, float]]:
+    """Recompute one linear-regression MSE step's gradients from the
+    capture (the elastic_worker model): loss = mean((x @ w - y)^2),
+    dL/dw = 2/N x^T (x w - y). Stats only — triage compares anomaly
+    signatures, not bit-exact grads."""
+    w = np.asarray(capture["params"]["w"], np.float32)
+    x = np.asarray(capture["batch"]["x"], np.float32)
+    y = np.asarray(capture["batch"]["y"], np.float32)
+    with np.errstate(all="ignore"):  # replaying nonfinites is the job
+        r = x @ w - y
+        g = (2.0 / x.shape[0]) * (x.T @ r)
+    return sentry.host_stats_by_scope({"w": g})
+
+
+BUILDERS: Dict[str, Callable] = {"linear_mse": builder_linear_mse}
+
+
+def _resolve_builder(spec: str, capture: dict) -> Callable:
+    if spec == "auto":
+        name = (capture.get("meta") or {}).get("model", "linear_mse")
+        if name not in BUILDERS:
+            raise ValueError(
+                f"capture meta.model={name!r} has no built-in "
+                f"builder; pass --builder module:attr")
+        return BUILDERS[name]
+    if spec in BUILDERS:
+        return BUILDERS[spec]
+    mod, _, attr = spec.partition(":")
+    if not attr:
+        raise ValueError(
+            f"--builder {spec!r}: expected 'name' or 'module:attr'")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def _signature(stats: Dict[str, Dict[str, float]]) -> dict:
+    nonfinite = sum(int(np.asarray(r.get("nonfinite", 0)))
+                    for r in stats.values())
+    max_abs = max((float(np.asarray(r.get("max_abs", 0.0)))
+                   for r in stats.values()), default=0.0)
+    return {"nonfinite": nonfinite, "max_abs": max_abs}
+
+
+def classify(capture: dict, builder: Callable,
+             trials: int = 3, spike_factor: float = 8.0) -> dict:
+    """Replay `trials` times and classify. The observed signature
+    comes from the capture's grad stats when the sentry recorded them
+    (a nonfinite/spike halt); a fingerprint-divergence capture carries
+    no grad anomaly — there the question is simply whether the step
+    is anomalous AT ALL when honestly recomputed."""
+    sigs = [_signature(builder(capture)) for _ in range(trials)]
+    if any(s != sigs[0] for s in sigs[1:]):
+        return {"verdict": "inconclusive",
+                "reason": "replays disagree with each other — this "
+                          "host is unreliable or the step is "
+                          "nondeterministic",
+                "trials": sigs}
+    replay = sigs[0]
+    observed = capture.get("observed") or {}
+    obs_grad = observed.get("grad")
+    obs_sig = _signature(obs_grad) if obs_grad else None
+    if obs_sig is not None and obs_sig["nonfinite"] > 0:
+        reproducible = replay["nonfinite"] > 0
+        why = ("recomputation reproduces the nonfinite values — the "
+               "inputs themselves produce them (software bug)"
+               if reproducible else
+               "recomputation is finite — the observed nonfinites "
+               "did not come from these inputs (transient SDC)")
+    elif obs_sig is not None and obs_sig["max_abs"] > 0:
+        # spike halt: does the magnitude recur?
+        reproducible = (replay["nonfinite"] > 0
+                        or replay["max_abs"]
+                        >= obs_sig["max_abs"] / spike_factor)
+        why = ("recomputed magnitude matches the observed spike "
+               "(software bug)" if reproducible else
+               "recomputed magnitude is far below the observed "
+               "spike (transient SDC)")
+    else:
+        # fingerprint-divergence capture: no grad anomaly observed —
+        # an honestly clean recomputation means the divergence came
+        # from outside the math
+        reproducible = replay["nonfinite"] > 0
+        why = ("recomputation is itself nonfinite (software bug)"
+               if reproducible else
+               "recomputation is clean — the fingerprint divergence "
+               "came from outside the math (transient SDC)")
+    return {
+        "verdict": "reproducible" if reproducible else "transient",
+        "action": ("file a software bug — do not swap the chip"
+                   if reproducible else
+                   "quarantine the chip — the math was not at fault"),
+        "reason": why,
+        "observed": obs_sig,
+        "replay": replay,
+        "trials_run": trials,
+        "capture_step": capture.get("step"),
+        "capture_rank": capture.get("rank"),
+        "fault_reason": (observed.get("reason")
+                         if isinstance(observed, dict) else None),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--capture", required=True,
+                    help="fault capture npz "
+                         "(sentry.write_fault_capture output)")
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--builder", default="auto",
+                    help="'auto' (capture meta.model), a built-in "
+                         "name, or module:attr")
+    ap.add_argument("--spike-factor", type=float, default=8.0,
+                    help="a replayed max-abs within observed/N counts "
+                         "as reproducing the spike")
+    ap.add_argument("--json", action="store_true",
+                    help="full capture metadata in the output")
+    args = ap.parse_args(argv)
+    try:
+        capture = sentry.load_fault_capture(args.capture)
+        builder = _resolve_builder(args.builder, capture)
+        result = classify(capture, builder, trials=args.trials,
+                          spike_factor=args.spike_factor)
+    except Exception as e:
+        print(f"replay_triage: ERROR {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        result["capture"] = {
+            "path": args.capture, "meta": capture.get("meta"),
+            "param_names": sorted(capture["params"]),
+            "batch_names": sorted(capture["batch"])}
+    print("replay_triage: " + json.dumps(result))
+    return 2 if result["verdict"] == "inconclusive" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

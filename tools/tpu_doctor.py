@@ -16,6 +16,12 @@ JSON black box; this tool reads all of them and reports:
   stragglers   step-duration histogram skew: ranks whose median step
                time sits far above the fleet median are dragging every
                collective (checker-with-the-slowest-rank law)
+  numeric      silent-data-corruption triage from the sentry plane:
+               per-rank param fingerprints (bit-identical across dp
+               replicas by contract) are minority-voted per probe
+               step to name the diverging chip; when no vote decides
+               (dp=2, or the fault never reached a probe), the rank
+               whose PRE-SYNC grad/param stats spiked first is named
   recompile storms   recompile events (the sentinel's shape/dtype
                diffs ride along) above a storm threshold
   hangs        watchdog.stall events with the no-progress age and the
@@ -52,8 +58,12 @@ RECOMPILE_STORM = 3        # >= this many recompile events => storm
 LIVE_STEP_AGE_S = 10.0
 # incident-evidence event kinds carried over from superseded dumps of
 # the same rank (newest-per-rank filtering must not discard the
-# mid-hang stall record once the ring wraps past it)
-_EVIDENCE_KINDS = ("watchdog.stall", "recompile")
+# mid-hang stall record once the ring wraps past it) — sentry numeric
+# evidence included: the anomaly that fired minutes before the bounce
+# is exactly what the NUMERIC verdict needs
+_EVIDENCE_KINDS = ("watchdog.stall", "recompile", "sentry.anomaly",
+                   "sentry.fingerprint", "sentry.mismatch",
+                   "sentry.fault_capture")
 # serving-fleet lifecycle breadcrumbs (serving/fleet.py records them
 # into the same flight-recorder ring) surfaced from merged dumps so a
 # crash dump covers serving incidents like training ones
@@ -234,6 +244,93 @@ def _hangs(dumps: List[dict]) -> List[dict]:
     return out
 
 
+def _numeric(dumps: List[dict]) -> Optional[dict]:
+    """Silent-data-corruption triage from the sentry plane's events.
+
+    Two evidence tiers, highest confidence first:
+
+    1. fingerprint minority vote — post-sync params are bit-identical
+       across dp replicas BY CONTRACT, so at any probe step where one
+       rank's ``sentry.fingerprint`` value differs from an agreeing
+       majority, that rank's chip produced different arithmetic: the
+       classic TPU SDC tell. A worker-side ``sentry.mismatch`` event
+       that already named a culprit (its KV exchange saw what the
+       dumps may not) is counted as a vote too.
+    2. earliest anomaly — when no vote decides (dp=2 tie, the fault
+       never crossed a probe), the rank whose pre-sync grad/param
+       stats spiked FIRST (lowest step, then earliest wall-clock) is
+       named: corruption spreads rank-to-rank through the grad sync,
+       so the first spike marks the origin.
+    """
+    fps: Dict[int, Dict[int, int]] = {}       # step -> rank -> fp
+    anomalies: List[dict] = []
+    culprit_votes: Dict[int, int] = {}
+    for d in dumps:
+        for e in d.get("events", []):
+            k = e.get("k")
+            if k == "sentry.fingerprint" and e.get("fp") is not None:
+                fps.setdefault(int(e.get("step", -1)), {})[
+                    d["rank"]] = int(e["fp"])
+            elif k == "sentry.anomaly":
+                anomalies.append({
+                    "rank": d["rank"], "step": e.get("step"),
+                    "t": e.get("t", 0), "fault": e.get("fault"),
+                    "stream": e.get("stream"),
+                    "scope": e.get("scope"), "z": e.get("z"),
+                    "value": e.get("value"),
+                    "count": e.get("count")})
+            elif k == "sentry.mismatch" and e.get("culprit") is not None:
+                culprit_votes[int(e["culprit"])] = \
+                    culprit_votes.get(int(e["culprit"]), 0) + 1
+    minority = None
+    for step in sorted(fps):
+        votes = fps[step]
+        if len(votes) < 2:
+            continue
+        by_fp: Dict[int, List[int]] = {}
+        for r, fp in votes.items():
+            by_fp.setdefault(fp, []).append(r)
+        if len(by_fp) < 2:
+            continue
+        groups = sorted(by_fp.values(), key=len)
+        if len(groups[0]) == 1 and len(groups[-1]) > 1:
+            minority = {"rank": groups[0][0], "step": step,
+                        "fingerprints": {str(r): v
+                                         for r, v in votes.items()}}
+            break
+    if minority is None and culprit_votes:
+        worst = max(culprit_votes, key=culprit_votes.get)
+        minority = {"rank": worst, "step": None,
+                    "from_worker_mismatch": True,
+                    "votes": dict(culprit_votes)}
+    if minority is None and not anomalies:
+        return None
+    first_anomaly = None
+    # mismatch records are BILATERAL (every rank that saw the probe
+    # disagree holds one) — they prove a divergence happened, never
+    # which rank caused it; only stat-stream anomalies attribute
+    attributable = [a for a in anomalies if a.get("fault") != "mismatch"]
+    if attributable:
+        first_anomaly = min(
+            attributable,
+            key=lambda a: (a["step"] if a["step"] is not None else 1e18,
+                           a["t"]))
+    out: Dict[str, Any] = {
+        "anomalies": sorted(
+            anomalies, key=lambda a: (a.get("step") or 0, a["t"]))[:12],
+        "anomaly_ranks": sorted({a["rank"] for a in anomalies}),
+    }
+    if minority is not None:
+        out["diverging_rank"] = minority["rank"]
+        out["source"] = "fingerprint"
+        out["fingerprint"] = minority
+    elif first_anomaly is not None:
+        out["diverging_rank"] = first_anomaly["rank"]
+        out["source"] = "grad_stats"
+        out["first_anomaly"] = first_anomaly
+    return out
+
+
 def _goodput(dumps: List[dict]) -> Optional[dict]:
     reps = [d.get("goodput") for d in dumps if d.get("goodput")]
     reps = [r for r in reps if r.get("elapsed_seconds", 0) > 0]
@@ -272,6 +369,7 @@ def diagnose(dumps: List[dict]) -> dict:
         "ranks": [d["rank"] for d in dumps],
         "reasons": sorted({d.get("reason", "?") for d in dumps}),
         "divergence": _divergence(dumps),
+        "numeric": _numeric(dumps),
         "stragglers": _stragglers(dumps),
         "recompile_storm": _recompile_storm(dumps),
         "hangs": _hangs(dumps),
@@ -285,9 +383,13 @@ def verdict(diag: dict) -> dict:
     the elastic supervisor (distributed/elastic.py) consumes to decide
     evict/shrink/respawn. Priority order mirrors diagnostic confidence:
     a seq divergence is proof a specific rank skipped a collective; a
-    hang names the rank that stopped stepping; a straggler or a
-    recompile storm names a cost, not a fault. Always returns a dict
-    ({"kind": "none"} on a clean pod) so callers never branch on None.
+    hang names the rank that stopped stepping; a NUMERIC finding names
+    the chip whose arithmetic diverged (fingerprint minority vote, or
+    the first pre-sync stat spike) — above straggler, because silent
+    corruption trains into the weights while a straggler merely costs
+    time; a straggler or a recompile storm names a cost, not a fault.
+    Always returns a dict ({"kind": "none"} on a clean pod) so callers
+    never branch on None.
     """
     div = diag.get("divergence")
     if div and div.get("diverging_rank") is not None:
@@ -317,6 +419,16 @@ def verdict(diag: dict) -> dict:
                              "limit_s": h.get("limit_s"),
                              "lags_collectives": h["rank"] in lagging,
                              "dump": h.get("dump")}}
+    num = diag.get("numeric")
+    if num and num.get("diverging_rank") is not None:
+        ev = {"source": num.get("source"),
+              "anomaly_ranks": num.get("anomaly_ranks")}
+        if num.get("fingerprint"):
+            ev["fingerprint"] = num["fingerprint"]
+        if num.get("first_anomaly"):
+            ev["first_anomaly"] = num["first_anomaly"]
+        return {"kind": "numeric", "rank": num["diverging_rank"],
+                "source": "doctor", "evidence": ev}
     strag = diag.get("stragglers") or []
     if strag:
         s = max(strag, key=lambda s: s.get("vs_fleet_median", 0))
@@ -445,6 +557,29 @@ def format_report(diag: dict) -> str:
             f"  (snapshot skew? {s['op']}@{s['axis'] or '<eager>'} "
             f"counts {s['counts']} — lagging rank(s) were live at "
             "dump time; re-dump a quiesced pod to confirm)")
+    num = diag.get("numeric")
+    if num and num.get("diverging_rank") is not None:
+        if num.get("source") == "fingerprint":
+            fpinfo = num.get("fingerprint", {})
+            lines.append(
+                f"NUMERIC: rank {num['diverging_rank']} param "
+                f"fingerprint diverges from the replica majority at "
+                f"probe step {fpinfo.get('step')} — the SDC tell "
+                "(quarantine the chip; replay_triage the capture)")
+        else:
+            fa = num.get("first_anomaly") or {}
+            lines.append(
+                f"NUMERIC: rank {num['diverging_rank']} stats spiked "
+                f"first ({fa.get('fault')} on {fa.get('stream')} at "
+                f"step {fa.get('step')}) — pre-sync origin of the "
+                "corruption")
+        for a in (num.get("anomalies") or [])[:4]:
+            lines.append(
+                f"  rank {a['rank']} step {a['step']}: {a['fault']} "
+                f"{a.get('stream')}"
+                + (f" z={a['z']}" if a.get("z") is not None else "")
+                + (f" count={a['count']}"
+                   if a.get("count") is not None else ""))
     for s in diag.get("stragglers", []):
         lines.append(
             f"STRAGGLER: rank {s['rank']} median step "
@@ -546,7 +681,9 @@ def main(argv=None) -> int:
     # exit status is the triage verdict: 1 = something is wrong
     # (skew-only divergence — live snapshots one call apart — is not)
     div = diag["divergence"]
+    num = diag.get("numeric")
     bad = bool((div and div.get("diverging_rank") is not None)
+               or (num and num.get("diverging_rank") is not None)
                or diag["stragglers"]
                or diag["recompile_storm"] or diag["hangs"])
     return 1 if bad else 0
