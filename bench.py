@@ -126,11 +126,18 @@ def bench_ernie(on_tpu):
     # bench wall time is spent. PD_BENCH_ANATOMY=0 opts out of that
     # cost on compile-heavy sweeps.
     anatomy_stats = None
+    memory_stats = None
+    lowered = compiled = None
     if os.environ.get("PD_BENCH_ANATOMY", "1") != "0":
         try:
             from paddle_tpu.observability import anatomy as _anatomy
-            res = _anatomy.train_step_anatomy(step, (x,), (y,),
-                                              publish_gauges=True)
+            from paddle_tpu.observability import memory as _memory
+            # ONE cache-bypassed compile feeds BOTH attribution planes
+            # (FLOPs + memory) — the second compile the old per-plane
+            # entry points would each pay is saved
+            lowered, compiled = _memory.compile_step(step, (x,), (y,))
+            res = _anatomy.attribute_compiled(compiled)
+            _anatomy.publish(res)
             anatomy_stats = {
                 "scope_shares": {k: round(v["share"], 4)
                                  for k, v in res["scopes"].items()},
@@ -141,6 +148,26 @@ def bench_ernie(on_tpu):
             }
         except Exception as e:  # pragma: no cover — bench must survive
             anatomy_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            if compiled is None:
+                raise RuntimeError("attribution compile failed above")
+            mres = _memory.train_step_memory(step, (x,), (y,),
+                                             lowered=lowered,
+                                             compiled=compiled,
+                                             publish_gauges=True)
+            mma = mres["memory"]
+            memory_stats = {
+                "temp_shares": {k: round(v["share"], 4)
+                                for k, v in mres["scopes"].items()},
+                "unattributed_share": round(
+                    mres["unattributed_share"], 4),
+                "peak_bytes": mma["peak_bytes"],
+                "argument_bytes": mma["argument_bytes"],
+                "temp_bytes": mma["temp_bytes"],
+                "peak_is_exact": mma["peak_is_exact"],
+            }
+        except Exception as e:  # pragma: no cover — bench must survive
+            memory_stats = {"error": f"{type(e).__name__}: {e}"}
 
     # MFU from first principles. Train FLOPs/token ~= 6*N + 12*L*h*s
     # (fwd 2N + attention 4*L*h*s for scores+values; x3 for fwd+bwd).
@@ -150,7 +177,8 @@ def bench_ernie(on_tpu):
     import jax
     peak = _chip_peak_flops(jax.devices()[0])
     mfu = tokens_per_sec * flops_per_token / peak
-    return tokens_per_sec, mfu, n_params, flops_per_token, anatomy_stats
+    return (tokens_per_sec, mfu, n_params, flops_per_token,
+            anatomy_stats, memory_stats)
 
 
 def bench_resnet(on_tpu):
@@ -567,9 +595,10 @@ def main():
         _fr = _goodput = None
         errors["goodput_arm"] = f"{type(e).__name__}: {e}"
     anatomy_stats = None
+    memory_stats = None
     try:
         (tokens_per_sec, mfu, n_params, fpt,
-         anatomy_stats) = bench_ernie(on_tpu)
+         anatomy_stats, memory_stats) = bench_ernie(on_tpu)
     except Exception as e:  # pragma: no cover - JSON line must survive
         tokens_per_sec = mfu = fpt = -1.0
         n_params = -1
@@ -687,6 +716,7 @@ def main():
             "attention_path": attn_path,
             **({"goodput": goodput_stats} if goodput_stats else {}),
             **({"anatomy": anatomy_stats} if anatomy_stats else {}),
+            **({"memory": memory_stats} if memory_stats else {}),
             **({"serving": serving_stats} if serving_stats else {}),
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             **({"errors": errors} if errors else {}),

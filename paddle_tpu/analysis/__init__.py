@@ -19,6 +19,9 @@ from .engine import (GraphLintConfig, HloInstr, ProgramAudit,
                      registered_rules, rule, run_rules)
 from . import hlo_rules  # noqa: F401  (registers the launch rules)
 from .hlo_rules import LAUNCH_RULES
+from .memory_baseline import (check_memory_baseline,
+                              load_memory_baseline, peaks_of,
+                              write_memory_baseline)
 from .schedule import (assign_seqs, capture_collective_schedule,
                        schedule_of, verify_collective_schedules)
 from .source_lint import ALLOWLIST, lint_package, lint_source
@@ -31,4 +34,6 @@ __all__ = [
     "write_baseline", "new_findings", "format_findings", "exit_code",
     "assign_seqs", "capture_collective_schedule", "schedule_of",
     "verify_collective_schedules", "lint_package", "lint_source",
+    "peaks_of", "load_memory_baseline", "write_memory_baseline",
+    "check_memory_baseline",
 ]

@@ -1,0 +1,172 @@
+"""Memory baselines: CI-gate program-peak growth like graph_lint gates
+new findings.
+
+A fused program's peak HBM is a contract the same way its executable
+count is: a PR that quietly grows the TrainStep's peak by 20% ships a
+future RESOURCE_EXHAUSTED to whoever raises the batch next. The memory
+plane (observability.memory) measures peak-live-bytes per flagship
+program from XLA's own buffer assignment; this module pins those
+numbers in a reviewable baseline file and emits graph_lint-shaped
+``Finding`` records when a program outgrows its waiver:
+
+- the baseline maps program -> {peak_bytes, per-scope temp bytes}, so a
+  regression finding can name not just the program but the SCOPE whose
+  buffers grew most (the "which component grew" receipt at fault time,
+  not launch time);
+- growth within ``tolerance`` (default +20%) passes — buffer assignment
+  jitters a few percent across compiler versions; a real regression
+  (a re-materialized logits buffer, a dropped donation) clears 20%
+  easily;
+- shrinkage never gates; re-anchor deliberately with
+  ``--write-baseline`` after triaging (the tier1_budget rebalance
+  policy), which also captures improvements;
+- a program with NO baseline entry is reported as a warning finding —
+  fingerprint-stable, so checking the updated baseline in (the same
+  flow as graph_lint's) waives it permanently.
+
+Findings ride the shared fingerprint/baseline machinery in
+``findings.py`` unchanged: ``tools/memory_anatomy.py --check`` is the
+CLI gate (exit 1 on a trip, names program + scope).
+
+This module imports no jax — the check half runs from JSON artifacts
+on any triage host.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Mapping, Optional
+
+from .findings import Finding
+
+__all__ = [
+    "RULE", "DEFAULT_TOLERANCE", "peaks_of",
+    "load_memory_baseline", "write_memory_baseline",
+    "check_memory_baseline",
+]
+
+RULE = "memory_baseline"
+DEFAULT_TOLERANCE = 0.20
+
+
+def peaks_of(results: Mapping[str, dict]) -> Dict[str, dict]:
+    """Collapse ``attribute_compiled_memory`` results (program ->
+    result) into the baseline shape: peak/temp/argument totals plus the
+    per-scope temp bytes the scope-growth attribution diffs."""
+    out: Dict[str, dict] = {}
+    for program, res in results.items():
+        ma = res.get("memory") or {}
+        out[str(program)] = {
+            "peak_bytes": int(res.get("peak_bytes")
+                              or ma.get("peak_bytes") or 0),
+            # exact (runtime-reported) and reconstructed peaks are
+            # different quantities — the gate must not diff across a
+            # definition change (see check_memory_baseline)
+            "peak_is_exact": bool(ma.get("peak_is_exact", True)),
+            "temp_bytes": int(ma.get("temp_bytes", 0)),
+            "argument_bytes": int(ma.get("argument_bytes", 0)),
+            "scopes": {name: int(row["bytes"])
+                       for name, row in res.get("scopes", {}).items()},
+        }
+    return out
+
+
+def load_memory_baseline(path: str) -> dict:
+    """The baseline doc ({} when missing — everything then reports as
+    un-baselined, the graph_lint convention)."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_memory_baseline(peaks: Mapping[str, dict], path: str,
+                          tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Re-anchor: accept the current peaks. Bytes are stored raw (the
+    file is the reviewable waiver — a PR diff shows exactly how much
+    each program's peak moved)."""
+    data = {
+        "version": 1,
+        "tolerance": float(tolerance),
+        "programs": {k: dict(v) for k, v in sorted(peaks.items())},
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def _worst_scope_growth(cur: Mapping[str, int],
+                        base: Mapping[str, int]) -> Optional[tuple]:
+    """(scope, grown_bytes) with the largest absolute growth — the
+    named culprit in a regression finding."""
+    worst = None
+    for name, nbytes in cur.items():
+        grown = int(nbytes) - int(base.get(name, 0))
+        if worst is None or grown > worst[1]:
+            worst = (name, grown)
+    return worst
+
+
+def check_memory_baseline(peaks: Mapping[str, dict], baseline: dict,
+                          tolerance: Optional[float] = None
+                          ) -> List[Finding]:
+    """The gate: error findings for programs whose peak grew past the
+    tolerance (message names the program, the growth, and the
+    top-growth scope), warning findings for programs with no baseline
+    entry. Shrinkage and in-tolerance drift pass silently."""
+    progs = baseline.get("programs", {})
+    tol = (baseline.get("tolerance", DEFAULT_TOLERANCE)
+           if tolerance is None else float(tolerance))
+    findings: List[Finding] = []
+    for program in sorted(peaks):
+        cur = peaks[program]
+        base = progs.get(program)
+        if base is None:
+            findings.append(Finding(
+                rule=RULE, severity="warning", program=program,
+                location=f"{program}:no_baseline",
+                message=("no memory baseline entry — run "
+                         "tools/memory_anatomy.py --write-baseline "
+                         "to pin this program's peak")))
+            continue
+        cur_peak = int(cur.get("peak_bytes", 0))
+        base_peak = int(base.get("peak_bytes", 0))
+        # a baseline anchored on a runtime with an exact (XLA-reported)
+        # peak is not comparable to a reconstructed peak from another
+        # runtime (reconstruction adds undonated output bytes) — flag
+        # the definition change instead of diffing mixed quantities;
+        # baselines written before the marker existed compare as before
+        if ("peak_is_exact" in base and "peak_is_exact" in cur
+                and bool(base["peak_is_exact"])
+                != bool(cur["peak_is_exact"])):
+            findings.append(Finding(
+                rule=RULE, severity="warning", program=program,
+                location=f"{program}:peak_definition",
+                message=(
+                    "peak definition changed across runtimes "
+                    f"(baseline {'exact' if base['peak_is_exact'] else 'reconstructed'}, "
+                    f"current {'exact' if cur['peak_is_exact'] else 'reconstructed'}) "
+                    "— re-anchor with --write-baseline on this "
+                    "runtime before gating")))
+            continue
+        limit = base_peak * (1.0 + tol)
+        if base_peak and cur_peak > limit:
+            worst = _worst_scope_growth(cur.get("scopes", {}),
+                                        base.get("scopes", {}))
+            scope_note = (f"; top-growth scope '{worst[0]}' "
+                          f"(+{worst[1] / 1e6:.2f} MB)"
+                          if worst and worst[1] > 0 else "")
+            findings.append(Finding(
+                rule=RULE, severity="error", program=program,
+                location=f"{program}:peak_bytes",
+                message=(
+                    f"peak {cur_peak / 1e6:.2f} MB exceeds baseline "
+                    f"{base_peak / 1e6:.2f} MB by "
+                    f"{(cur_peak / base_peak - 1.0) * 100:.1f}% "
+                    f"(tolerance {tol * 100:.0f}%){scope_note} — "
+                    "shrink it back or re-anchor deliberately with "
+                    "--write-baseline")))
+    return findings

@@ -348,9 +348,16 @@ def save_sharded(state: dict, path: str, async_write: bool = False,
         # be donated/overwritten by the next step
         snapshot = jax.device_get(arrays)
         if _obs._enabled:
+            from .collective import _payload_bytes
             _obs.counter("checkpoint.saves_total").add(1)
             _obs.histogram("checkpoint.save_block_ms").observe(
                 (time.perf_counter() - _t0) * 1e3)
+            # the async plane's hidden host-RAM double: the pinned-host
+            # copy lives until the background write completes — invisible
+            # to device HBM telemetry, very visible to the host OOM
+            # killer (the memory plane's checkpoint gauge)
+            _obs.gauge("checkpoint.host_snapshot_bytes").set(
+                _payload_bytes(snapshot))
         if _fr._enabled:
             from .collective import _payload_bytes
             _fr.ckpt_end("save", _t0, nbytes=_payload_bytes(snapshot))
@@ -359,17 +366,28 @@ def save_sharded(state: dict, path: str, async_write: bool = False,
             global _async_error
             w0 = time.perf_counter()
             try:
-                _write_payload(snapshot, path, manifest=manifest,
-                               topology=topology)
-            except BaseException as e:  # surfaced by wait_pending/next save
-                with _async_lock:
-                    _async_error = e
-                return
-            dur_ms = (time.perf_counter() - w0) * 1e3
-            if _obs._enabled:
-                _obs.counter("checkpoint.async_saves_total").add(1)
-                _obs.histogram("checkpoint.async_write_ms").observe(dur_ms)
-            _fr.ckpt_async_end("save", dur_ms)
+                try:
+                    _write_payload(snapshot, path, manifest=manifest,
+                                   topology=topology)
+                except BaseException as e:  # wait_pending/next save surface it
+                    with _async_lock:
+                        _async_error = e
+                    return
+                dur_ms = (time.perf_counter() - w0) * 1e3
+                if _obs._enabled:
+                    _obs.counter("checkpoint.async_saves_total").add(1)
+                    _obs.histogram(
+                        "checkpoint.async_write_ms").observe(dur_ms)
+                _fr.ckpt_async_end("save", dur_ms)
+            finally:
+                # the pinned-host double dies with this thread on EVERY
+                # exit path — and even if the gate flipped off while
+                # the write was in flight a stuck gauge would misreport
+                # host pressure, so zero ungated (reset() bypasses the
+                # gate; set(0) would no-op when disabled)
+                g = _obs.get("checkpoint.host_snapshot_bytes")
+                if g is not None:
+                    g.reset()
 
         t = threading.Thread(target=_writer, name="pd-ckpt-writer")
         with _async_lock:

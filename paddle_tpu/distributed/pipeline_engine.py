@@ -46,6 +46,7 @@ from ..framework import Tensor
 from ..jit.api import _unwrap_tree, _wrap_tree, functionalize
 from ..nn.layer.layers import Layer
 from ..observability import flight_recorder as _fr
+from ..observability import memory as _mem
 from ..observability import metrics as _obs
 from ..observability.anatomy import scope as _scope
 from ..observability.sentinel import RecompileSentinel, signature_of
@@ -1085,9 +1086,15 @@ class PipelineParallel:
         _rec = _obs._enabled
         _t0 = time.perf_counter() if _rec else 0.0
         _tok = _fr.step_begin("pipeline_spmd", self._step_count)
-        self.params, self.opt_state, loss, found_inf, sentry_out = step(
-            self.params, self.opt_state, next_key(), lr, scale_val,
-            x, lbl)
+        try:
+            self.params, self.opt_state, loss, found_inf, sentry_out = \
+                step(self.params, self.opt_state, next_key(), lr,
+                     scale_val, x, lbl)
+        except Exception as e:
+            # memory plane's OOM sentry at the one-dispatch boundary
+            _mem.handle_dispatch_oom("spmd_1f1b", e,
+                                     step=self._step_count)
+            raise
         if _tok is not None and _fr.sync_steps():
             jax.block_until_ready(loss)
         _fr.step_end("pipeline_spmd", self._step_count, _tok)

@@ -41,6 +41,16 @@ TPU-pod training job needs on top of raw counters:
                    requeue/swap_flip), the explain_tail attribution
                    engine, chrome-trace request lanes, and the SLO
                    error-budget BurnMeter
+  memory           HBM anatomy: per-scope memory attribution from the
+                   compiled executable's buffer assignment (temp bytes
+                   by scope summing to 1.0, argument bytes by param
+                   scope, peak-live-bytes per flagship program), live
+                   memory.* occupancy gauges (device memory_stats with
+                   host-RSS fallback, paged-cache pages, checkpoint
+                   host-snapshot bytes), and the OOM sentry at the
+                   dispatch boundaries (always-on memory.oom_total,
+                   `oom` breadcrumbs, post-mortem receipts with
+                   remediation hints)
   sentry           numeric integrity: in-graph per-scope grad/param
                    stats + every-K param-bit fingerprints riding the
                    one step program, a rolling z-score monitor
@@ -63,6 +73,7 @@ from . import xprof  # noqa: F401
 from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import flight_recorder  # noqa: F401
+from . import memory  # noqa: F401
 from . import reqtrace  # noqa: F401
 from . import sentry  # noqa: F401
 from . import mfu  # noqa: F401
@@ -78,7 +89,7 @@ from .watchdog import HangWatchdog  # noqa: F401
 __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
     "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
-    "reqtrace", "sentry",
+    "memory", "reqtrace", "sentry",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",

@@ -1,0 +1,679 @@
+"""HBM anatomy: per-scope memory attribution, live occupancy telemetry
+and OOM forensics — the memory twin of ``anatomy.py``.
+
+The reference ships a whole memory layer (allocator_facade.cc strategy
+registry, the buddy allocator, profiler memory hooks); our
+single-dispatch engines hand all of that to XLA's buffer assignment —
+which is fine until the job dies with RESOURCE_EXHAUSTED and nothing
+can say WHICH component grew. XLA already knows every buffer's size and
+(via the anatomy plane's HLO-metadata contract) which scope allocated
+it; this module reads it, in three tiers:
+
+1. **Static attribution (CPU-testable tier)** — ``attribute_hlo_memory``
+   walks a compiled executable's HLO text and groups every
+   instruction's RESULT bytes — the buffer XLA must materialize for it
+   — by the innermost registered scope (``anatomy.scope_of_op_name``).
+   ``parameter`` lines are excluded (those are *arguments*, attributed
+   separately from the jax-side flat-arg table via the sentry's
+   param-name→scope map); container ops (fusion/call/while) are priced
+   by their member instructions, never double-counted. Shares sum to
+   exactly 1.0 with an ``unattributed`` row — the same contract as
+   ``anatomy.attribute_hlo_text``, over bytes instead of FLOPs.
+   ``memory_analysis_dict`` rides alongside with XLA's own
+   argument/output/temp totals and a ``peak_bytes`` figure
+   (``peak_memory_in_bytes`` where the runtime exposes it; the
+   deterministic ``argument + temp + output − alias`` reconstruction
+   otherwise — donated outputs alias their arguments, so the fallback
+   is the same state-residency arithmetic tools/memory_receipts.py
+   budgets against).
+
+2. **Live tier** — ``sample()`` publishes gated ``memory.*`` gauges:
+   per-device ``jax`` ``memory_stats()`` where the backend provides
+   them (TPU/GPU), host-RSS fallback where it doesn't (CPU). The
+   serving fleet samples paged-cache occupancy
+   (``serving.pages_live``/``pages_free`` per replica) every fleet
+   tick in ``_publish``, and ``checkpoint.host_snapshot_bytes``
+   records the async save's hidden host-RAM double at device_get
+   time — both ride the existing exporters and ``fleet.aggregate()``.
+
+3. **Forensics tier** — ``handle_dispatch_oom`` sits behind the
+   dispatch boundaries we own (TrainStep.__call__, the spmd_1f1b
+   engine, the serving prefill/decode programs): a caught
+   RESOURCE_EXHAUSTED bumps the always-on ``memory.oom_total`` counter,
+   leaves an ``oom`` flight-recorder breadcrumb (requested vs free
+   parsed from the XLA message), and writes a post-mortem receipt —
+   program, requested/free bytes, live memory sample, the top-k scopes
+   from the program's last registered static attribution, and a
+   remediation hint (chunked_ce for a head-heavy step, remat/smaller
+   batch for activation-heavy, smaller bucket/pool for serving).
+   ``tools/tpu_doctor.py`` merges the breadcrumbs into an OOM verdict;
+   ``paddle_tpu.analysis.memory_baseline`` gates program-peak growth
+   in CI the way graph_lint gates new findings.
+
+Cost discipline (the PR 3 bar): the module imports no jax at import
+time; ``sample()`` is one gate read when telemetry is off;
+``handle_dispatch_oom`` lives in an ``except`` clause — zero cost on
+every step that does not die.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from . import flight_recorder as _fr
+from . import metrics
+from .anatomy import (_CONTAINERS, _INSTR_RE, _ITEMSIZE, _META_RE,
+                      _first_shape, _prod, compile_uncached,
+                      scope_of_op_name)
+from .sentry import scope_of_param
+
+__all__ = [
+    "memory_analysis_dict", "attribute_hlo_memory",
+    "attribute_arguments", "attribute_compiled_memory",
+    "compile_step", "train_step_memory", "program_memory",
+    "register_attribution", "attribution_of",
+    "publish", "format_table",
+    "device_memory_stats", "host_rss_bytes", "sample",
+    "is_oom", "parse_oom", "remediation_hint", "oom_postmortem",
+    "handle_dispatch_oom", "default_oom_path",
+]
+
+GIB = float(2 ** 30)
+
+
+# ---------------------------------------------------------------------------
+# static tier: XLA's buffer-assignment totals + per-scope attribution
+# ---------------------------------------------------------------------------
+
+def memory_analysis_dict(compiled) -> Dict[str, int]:
+    """``compiled.memory_analysis()`` as a plain dict with a
+    ``peak_bytes`` figure that exists on EVERY runtime: newer jaxlibs
+    expose ``peak_memory_in_bytes`` directly; older ones only the
+    component sizes, where peak is reconstructed as
+    ``argument + temp + output − alias`` (an aliased/donated output
+    reuses its argument's buffer — the same state-residency arithmetic
+    the fits-in-HBM receipts budget)."""
+    ma = compiled.memory_analysis()
+    arg = int(getattr(ma, "argument_size_in_bytes", 0))
+    out = int(getattr(ma, "output_size_in_bytes", 0))
+    tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+    alias = int(getattr(ma, "alias_size_in_bytes", 0))
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    # a present-but-zero peak means the backend left the field
+    # unpopulated — treating it as exact would anchor peak_bytes=0
+    # baselines and vacuously pass the memory-baseline CI gate
+    exact = bool(peak)
+    if not exact:
+        peak = max(arg + tmp + max(out - alias, 0), arg)
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(
+            getattr(ma, "generated_code_size_in_bytes", 0)),
+        "peak_bytes": int(peak),
+        "peak_is_exact": exact,
+    }
+
+
+# computation header: `%fused_computation.3 (p0: f32[4]) -> f32[4] {`
+# / `ENTRY %main.17 (...) -> ... {`
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
+# callee references on a container line: calls=%fc.3 /
+# body=%while_body.2 / condition=%cond.2 / to_apply=%reducer.1 /
+# branch_computations={%a, %b}
+_CALLEE_RE = re.compile(
+    r"(?:calls|body|condition|to_apply|branch_computations)="
+    r"\{?%?([\w.\-]+(?:,\s*%?[\w.\-]+)*)\}?")
+
+
+def _computation_scopes(text: str,
+                        scopes: Optional[Iterable[str]]
+                        ) -> Dict[str, Optional[str]]:
+    """Map subcomputation name -> its best-evidence scope, so members
+    XLA synthesized WITHOUT metadata (layout copies, boundary converts,
+    cloned broadcasts) can inherit it — they are real buffers, and
+    without inheritance they are the bulk of the byte table's
+    `unattributed` row. Evidence, strongest first:
+
+    1. byte-weighted vote of the computation's OWN metadata-carrying
+       members (a gelu-backward fusion whose dots/multiplies all say
+       ``transpose(jvp(mlp))`` is mlp work, whatever its clones lost);
+    2. the scope on its call-site line (fusion/call/while keep the
+       root op's metadata);
+    3. the caller's scope, transitively (a fusion called from a while
+       body inherits through it — bounded walk, the call graph is a
+       DAG)."""
+    votes: Dict[str, Dict[str, float]] = {}
+    call_scope: Dict[str, Optional[str]] = {}
+    callees: Dict[str, List[str]] = {}
+    entry: set = set()
+    cur = ""
+    for line in text.splitlines():
+        if line and not line[0].isspace():
+            m = _COMP_RE.match(line)
+            if m and line.rstrip().endswith("{"):
+                cur = m.group(1)
+                if line.startswith("ENTRY"):
+                    entry.add(cur)
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        meta = _META_RE.search(line)
+        sc = scope_of_op_name(meta.group(1), scopes) if meta else None
+        cm = _CALLEE_RE.findall(line)
+        if cm:
+            for group in cm:
+                for name in group.split(","):
+                    name = name.strip().lstrip("%")
+                    if not name:
+                        continue
+                    if call_scope.get(name) is None:
+                        call_scope[name] = sc
+                    callees.setdefault(cur, []).append(name)
+            continue            # container lines don't vote
+        if sc is not None:
+            dtype, dims = _first_shape(m.group("type"))
+            if dtype is not None:
+                nbytes = _prod(dims) * _ITEMSIZE.get(dtype, 4)
+                votes.setdefault(cur, {})[sc] = \
+                    votes.get(cur, {}).get(sc, 0.0) + nbytes
+    out: Dict[str, Optional[str]] = {}
+    for name, per in votes.items():
+        out[name] = max(per, key=per.get)
+    for name, sc in call_scope.items():
+        if out.get(name) is None:
+            out[name] = sc
+    # the ENTRY computation never inherits: its metadata-less lines are
+    # cross-scope state plumbing (donation copies, tuple packing) —
+    # attributing them to the entry's majority scope would overstate it
+    for name in entry:
+        out[name] = None
+    for _ in range(8):
+        changed = False
+        for caller, names in callees.items():
+            inherit = out.get(caller)
+            if inherit is None:
+                continue
+            for name in names:
+                if out.get(name) is None:
+                    out[name] = inherit
+                    changed = True
+        if not changed:
+            break
+    return out
+
+
+def attribute_hlo_memory(text: str,
+                         scopes: Optional[Iterable[str]] = None) -> dict:
+    """Group every HLO instruction's result bytes by scope.
+
+    Returns ``{"scopes": {name: {bytes, share, ops}}, "total_bytes",
+    "unattributed_share"}``; shares are over the counted total so they
+    sum to exactly 1.0 (``unattributed`` catches metadata-less ops).
+    ``parameter``/``constant`` lines are arguments/baked data, not the
+    program's working set — they are attributed by
+    ``attribute_arguments`` from the jax arg table instead. Containers
+    (fusion/call/while) are priced by their members only, never
+    double-counted — but a member WITHOUT its own metadata inherits
+    the scope of its computation's call site (``_computation_scopes``):
+    XLA synthesizes layout copies and boundary converts metadata-free,
+    and they are real buffers. While bodies count once per program,
+    not per trip (anatomy's convention)."""
+    comp_scope = _computation_scopes(text, scopes)
+    per: Dict[str, Dict[str, float]] = {}
+    total = 0.0
+    cur = ""
+    for line in text.splitlines():
+        if line and not line[0].isspace():
+            m = _COMP_RE.match(line)
+            if m and line.rstrip().endswith("{"):
+                cur = m.group(1)
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = m.group("op")
+        if op in _CONTAINERS or op in ("parameter", "constant"):
+            continue
+        dtype, dims = _first_shape(m.group("type"))
+        if dtype is None:
+            continue
+        nbytes = _prod(dims) * _ITEMSIZE.get(dtype, 4)
+        meta = _META_RE.search(line)
+        sc = scope_of_op_name(meta.group(1), scopes) if meta else None
+        if sc is None:
+            sc = comp_scope.get(cur)
+        key = sc or "unattributed"
+        row = per.setdefault(key, {"bytes": 0.0, "ops": 0})
+        row["bytes"] += nbytes
+        row["ops"] += 1
+        total += nbytes
+    table = {}
+    for name, row in per.items():
+        table[name] = {
+            "bytes": row["bytes"],
+            "share": (row["bytes"] / total) if total else 0.0,
+            "ops": int(row["ops"]),
+        }
+    return {
+        "scopes": dict(sorted(table.items(),
+                              key=lambda kv: -kv[1]["bytes"])),
+        "total_bytes": total,
+        "unattributed_share": table.get("unattributed",
+                                        {}).get("share", 0.0),
+    }
+
+
+def attribute_arguments(lowered) -> dict:
+    """Per-scope ARGUMENT bytes from the jax-side flat-arg table (the
+    entry parameters carry no scope metadata in HLO — the pytree paths
+    do, via the sentry's param-name→scope map). Donated bytes ride
+    alongside: donated state aliases its output, so it counts once in
+    the peak."""
+    from ..analysis.engine import ProgramAudit
+    args = ProgramAudit("_mem", lowered=lowered).flat_args()
+    per: Dict[str, Dict[str, float]] = {}
+    total = 0.0
+    for a in args:
+        if not a.get("kept", True):
+            continue
+        sc = scope_of_param(a["path"])
+        row = per.setdefault(sc, {"bytes": 0.0, "donated_bytes": 0.0})
+        row["bytes"] += a["nbytes"]
+        if a.get("donated"):
+            row["donated_bytes"] += a["nbytes"]
+        total += a["nbytes"]
+    table = {}
+    for name, row in per.items():
+        table[name] = {
+            "bytes": row["bytes"],
+            "share": (row["bytes"] / total) if total else 0.0,
+            "donated_bytes": row["donated_bytes"],
+        }
+    return {
+        "scopes": dict(sorted(table.items(),
+                              key=lambda kv: -kv[1]["bytes"])),
+        "total_bytes": total,
+    }
+
+
+def attribute_compiled_memory(compiled, lowered=None,
+                              scopes: Optional[Iterable[str]] = None
+                              ) -> dict:
+    """The full static-tier result for one program: the per-scope
+    temp-byte share table (sums to exactly 1.0), the jax-side argument
+    attribution (when the lowered is available), and XLA's own
+    buffer-assignment totals + ``peak_bytes``."""
+    out = attribute_hlo_memory(compiled.as_text(), scopes)
+    out["memory"] = memory_analysis_dict(compiled)
+    out["peak_bytes"] = out["memory"]["peak_bytes"]
+    if lowered is not None:
+        try:
+            out["arguments"] = attribute_arguments(lowered)
+        except Exception:  # pragma: no cover — private-API drift
+            out["arguments"] = None
+    return out
+
+
+def compile_step(step, inputs, labels=()):
+    """AOT-lower a TrainStep and compile it cache-bypassed (anatomy's
+    metadata-preserving discipline) ONCE, so the FLOPs plane and the
+    memory plane can both attribute the same executable without paying
+    two compiles (bench.py uses exactly this). Returns
+    ``(lowered, compiled)``."""
+    from ..jit.api import _unwrap_tree
+    inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+    labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+    lowered = step.aot_lower(_unwrap_tree(tuple(inputs)),
+                             _unwrap_tree(tuple(labels)))
+    return lowered, compile_uncached(lowered)
+
+
+def train_step_memory(step, inputs, labels=(), *,
+                      publish_gauges: bool = False,
+                      program: str = "train_step",
+                      lowered=None, compiled=None) -> dict:
+    """Per-scope memory table of a TrainStep's ONE train executable —
+    the memory twin of ``anatomy.train_step_anatomy`` (AOT from avals,
+    cache-bypassed compile; the recompile sentinel never sees it).
+    Pass ``lowered``/``compiled`` to reuse an attribution compile
+    already paid. The result is registered under ``program`` so an OOM
+    post-mortem can name the top scopes."""
+    if compiled is None:
+        lowered, compiled = compile_step(step, inputs, labels)
+    out = attribute_compiled_memory(compiled, lowered=lowered)
+    register_attribution(program, out)
+    if publish_gauges:
+        publish(out, program=program)
+    return out
+
+
+def program_memory(program: str, lowered, *,
+                   publish_gauges: bool = False) -> dict:
+    """Generic program entry (serving prefill/decode, spmd_1f1b):
+    compile cache-bypassed, attribute, register under ``program``."""
+    out = attribute_compiled_memory(compile_uncached(lowered),
+                                    lowered=lowered)
+    register_attribution(program, out)
+    if publish_gauges:
+        publish(out, program=program)
+    return out
+
+
+# the last static attribution per program — the OOM post-mortem's
+# top-buffers-by-scope evidence (dispatch sites cannot afford an
+# attribution compile at fault time)
+_ATTRIBUTIONS: Dict[str, dict] = {}
+
+
+def register_attribution(program: str, result: dict) -> dict:
+    _ATTRIBUTIONS[str(program)] = result
+    return result
+
+
+def attribution_of(program: str) -> Optional[dict]:
+    return _ATTRIBUTIONS.get(str(program))
+
+
+def publish(result: dict, program: str = "train_step",
+            prefix: str = "memory"):
+    """Route a memory table through the metrics runtime — always-on
+    (the explicit publish call is the opt-in, same contract as
+    ``anatomy.publish``): ``memory.temp_share{scope=,program=}``
+    gauges plus the per-program totals, so the receipt rides the
+    Prometheus/JSONL exporters and ``fleet.aggregate()``."""
+    for name, row in result.get("scopes", {}).items():
+        metrics.gauge(f"{prefix}.temp_share", _always=True,
+                      program=program,
+                      scope=name).set(round(row["share"], 6))
+    ma = result.get("memory") or {}
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "peak_bytes"):
+        if key in ma:
+            metrics.gauge(f"{prefix}.{key}", _always=True,
+                          program=program).set(ma[key])
+    return result
+
+
+def format_table(result: dict, title: str = "memory anatomy") -> str:
+    """Human-readable memory share table (tools/memory_anatomy.py)."""
+    ma = result.get("memory") or {}
+    lines = [
+        f"{title}: peak {ma.get('peak_bytes', 0) / GIB:.4f} GiB "
+        f"(arg {ma.get('argument_bytes', 0) / GIB:.4f}, "
+        f"temp {ma.get('temp_bytes', 0) / GIB:.4f}, "
+        f"out {ma.get('output_bytes', 0) / GIB:.4f}"
+        + ("" if ma.get("peak_is_exact") else "; peak reconstructed")
+        + ")"]
+    lines.append(f"  {'scope':<14} {'share':>7} {'mbytes':>10} "
+                 f"{'ops':>5}")
+    for name, row in result.get("scopes", {}).items():
+        lines.append(
+            f"  {name:<14} {row['share']:>6.1%} "
+            f"{row['bytes'] / 1e6:>10.2f} {row['ops']:>5}")
+    args = result.get("arguments")
+    if args:
+        lines.append(f"  arguments ({args['total_bytes'] / 1e6:.2f} MB "
+                     "by param scope):")
+        for name, row in args["scopes"].items():
+            lines.append(
+                f"    {name:<12} {row['share']:>6.1%} "
+                f"{row['bytes'] / 1e6:>10.2f} MB "
+                f"(donated {row['donated_bytes'] / 1e6:.2f})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# live tier: device memory stats with host-RSS fallback
+# ---------------------------------------------------------------------------
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device allocator stats from an ALREADY-imported jax (the
+    flight-recorder discipline: this module must work on a box where
+    jax is absent or wedged — it never triggers the import itself).
+    CPU backends return no stats; callers fall back to host RSS."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    out: List[Dict[str, Any]] = []
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    for d in devices:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if not st:
+            continue
+        out.append({
+            "device": int(getattr(d, "id", len(out))),
+            "platform": str(getattr(d, "platform", "?")),
+            "bytes_in_use": int(st.get("bytes_in_use", 0)),
+            "bytes_limit": int(st.get("bytes_limit", 0)),
+            "peak_bytes_in_use": int(st.get("peak_bytes_in_use", 0)),
+        })
+    return out
+
+
+def host_rss_bytes() -> int:
+    """Current resident set of this process (``/proc/self/statm``;
+    the ru_maxrss PEAK as a portability fallback)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                               if hasattr(os, "sysconf")
+                                               else 4096)
+    except Exception:
+        try:
+            import resource
+            return int(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss) * 1024
+        except Exception:  # pragma: no cover — exotic platform
+            return 0
+
+
+def sample(prefix: str = "memory") -> Optional[dict]:
+    """Publish the live occupancy gauges — gated: one bool read and out
+    when telemetry is off (the fleet tick calls this every iteration).
+    Device gauges where the backend reports them, ``host_rss_bytes``
+    always (the checkpoint plane's host-snapshot double and the CPU
+    tiers live there)."""
+    if not metrics._enabled:
+        return None
+    devs = device_memory_stats()
+    rss = host_rss_bytes()
+    for st in devs:
+        metrics.gauge(f"{prefix}.device_bytes_in_use",
+                      device=st["device"]).set(st["bytes_in_use"])
+        if st["bytes_limit"]:
+            metrics.gauge(f"{prefix}.device_bytes_limit",
+                          device=st["device"]).set(st["bytes_limit"])
+        if st["peak_bytes_in_use"]:
+            metrics.gauge(f"{prefix}.device_peak_bytes",
+                          device=st["device"]).set(
+                st["peak_bytes_in_use"])
+    metrics.gauge(f"{prefix}.host_rss_bytes").set(rss)
+    return {"devices": devs, "host_rss_bytes": rss}
+
+
+# ---------------------------------------------------------------------------
+# forensics tier: the OOM sentry
+# ---------------------------------------------------------------------------
+
+_OOM_TOKENS = ("resource_exhausted", "resource exhausted",
+               "out of memory", "exceeded hbm capacity")
+# "oom" only as a whole word — substring matching would classify any
+# message containing "zoom"/"mushroom" as a memory incident, and the
+# dispatch sentries see EVERY exception
+_OOM_WORD_RE = re.compile(r"\boom\b")
+
+# XLA phrasings across backends:
+#   "while trying to allocate 1.23GiB" / "allocating 123456 bytes"
+#   "Used 15.48G of 15.48G hbm" / "with 123456 bytes free"
+_SIZE = r"(\d+(?:\.\d+)?)\s*([KMGT]i?B?)?"
+_REQ_RE = re.compile(r"allocat\w*\s+(?:of\s+)?" + _SIZE, re.I)
+_FREE_RE = re.compile(_SIZE + r"\s*(?:bytes\s+)?free", re.I)
+_LIMIT_RE = re.compile(r"of\s+" + _SIZE + r"\s*(?:hbm|memory)", re.I)
+_UNIT = {None: 1, "": 1, "B": 1,
+         # bare K/M/G/T are XLA's HBM shorthand and mean BINARY
+         # ("Used 15.48G of 15.48G hbm" is 15.48 GiB); explicit
+         # KB/MB/... stay decimal, KiB/MiB/... binary
+         "K": 1024, "KB": 1000, "KiB": 1024,
+         "M": 1024 ** 2, "MB": 1000 ** 2, "MiB": 1024 ** 2,
+         "G": 1024 ** 3, "GB": 1000 ** 3, "GiB": 1024 ** 3,
+         "T": 1024 ** 4, "TB": 1000 ** 4, "TiB": 1024 ** 4}
+_UNIT_CI = {(k or "").upper(): v for k, v in _UNIT.items()}
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Is this exception an out-of-memory fault? Python's MemoryError
+    (the paged cache's exhaustion contract) or an XLA
+    RESOURCE_EXHAUSTED status (string-matched: the XlaRuntimeError
+    class is runtime-private and this module imports no jax)."""
+    if isinstance(exc, MemoryError):
+        return True
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return (any(tok in msg for tok in _OOM_TOKENS)
+            or _OOM_WORD_RE.search(msg) is not None)
+
+
+def _to_bytes(num: str, unit: Optional[str]) -> int:
+    # the size regexes match case-insensitively ("1.5gib"), so the
+    # unit lookup must too — KB (decimal) and KiB (binary) stay
+    # distinct under upper-casing
+    u = (unit or "").strip().upper()
+    return int(float(num) * _UNIT_CI.get(u, 1))
+
+
+def parse_oom(message: str) -> Dict[str, Optional[int]]:
+    """Best-effort requested/free/limit bytes from an XLA OOM message
+    (None where the backend's phrasing carries no figure)."""
+    out: Dict[str, Optional[int]] = {"requested_bytes": None,
+                                     "free_bytes": None,
+                                     "limit_bytes": None}
+    m = _REQ_RE.search(message)
+    if m:
+        out["requested_bytes"] = _to_bytes(m.group(1), m.group(2))
+    m = _FREE_RE.search(message)
+    if m:
+        out["free_bytes"] = _to_bytes(m.group(1), m.group(2))
+    m = _LIMIT_RE.search(message)
+    if m:
+        out["limit_bytes"] = _to_bytes(m.group(1), m.group(2))
+    return out
+
+
+def remediation_hint(program: str, top_scope: Optional[str]) -> str:
+    """The runbook's first move, named in the receipt (DESIGN.md
+    "Memory anatomy"): head-heavy steps stream the CE, activation-heavy
+    steps remat or shrink the batch, serving shrinks its static
+    shapes — admission control is the only other backpressure point."""
+    p = str(program)
+    if p.startswith("serving"):
+        return ("shrink the serving shapes: fewer n_blocks / smaller "
+                "prefill bucket / lower max_admit (admission control "
+                "is the only other backpressure)")
+    if top_scope == "mlm_head_ce":
+        return ("enable chunked_ce (stream the MLM head + CE through "
+                "vocab blocks — the [b*s, vocab] logits never "
+                "materialize)")
+    if top_scope in ("attn", "mlp", "embed"):
+        return ("enable remat=True (recompute activations in the "
+                "backward) or shrink the per-chip batch")
+    return "shrink the per-chip batch or raise grad_accum_steps"
+
+
+def default_oom_path(program: str) -> str:
+    """Receipt path next to the flight-recorder dumps (same
+    $PD_FR_DIR dir, ``oom_<program>_rank<r>_pid<p>.json``) so one
+    triage scoop collects both."""
+    d = os.environ.get("PD_OOM_DIR",
+                       os.environ.get("PD_FR_DIR", "/tmp/pd_flight"))
+    safe = "".join(c if c.isalnum() or c in "_.-" else "_"
+                   for c in str(program)) or "program"
+    return os.path.join(
+        d, f"oom_{safe}_rank{_fr._rank()}_pid{os.getpid()}.json")
+
+
+def oom_postmortem(program: str, exc: BaseException, top_k: int = 5,
+                   **context) -> dict:
+    """The post-mortem receipt: program, requested vs free, the live
+    memory sample, the top-k scopes from the program's last registered
+    static attribution, and the remediation hint."""
+    msg = f"{type(exc).__name__}: {exc}"
+    doc: Dict[str, Any] = {
+        "version": 1,
+        "program": str(program),
+        "ts": time.time(),
+        "rank": _fr._rank(),
+        "error": msg[:1000],
+    }
+    doc.update(parse_oom(msg))
+    doc.update({k: v for k, v in context.items() if v is not None})
+    doc["devices"] = device_memory_stats()
+    doc["host_rss_bytes"] = host_rss_bytes()
+    top_scope = None
+    att = attribution_of(program)
+    if att is not None:
+        rows = list(att.get("scopes", {}).items())[:top_k]
+        doc["top_scopes"] = [
+            {"scope": n, "bytes": r["bytes"],
+             "share": round(r["share"], 4)} for n, r in rows]
+        non_stray = [n for n, _ in rows if n != "unattributed"]
+        top_scope = non_stray[0] if non_stray else None
+        doc["peak_bytes_static"] = att.get("peak_bytes")
+    doc["top_scope"] = top_scope
+    doc["hint"] = remediation_hint(program, top_scope)
+    return doc
+
+
+def handle_dispatch_oom(program: str, exc: BaseException,
+                        receipt_path: Optional[str] = None,
+                        **context) -> Optional[dict]:
+    """The dispatch-boundary sentry: call from an ``except`` clause
+    around a compiled-program dispatch (TrainStep, spmd_1f1b, serving
+    prefill/decode) and re-raise after. Not an OOM → None, nothing
+    recorded. An OOM → the always-on counter, the flight-recorder
+    ``oom`` breadcrumb (tpu_doctor's verdict input), and the
+    post-mortem receipt written next to the flight dumps. Never raises
+    itself: forensics must not mask the original fault."""
+    if not is_oom(exc):
+        return None
+    try:
+        doc = oom_postmortem(program, exc, **context)
+    except Exception:  # pragma: no cover — forensics must not mask
+        doc = {"program": str(program), "error": str(exc)[:300],
+               "hint": remediation_hint(program, None)}
+    # always-on: an OOM is an incident whether or not anyone armed
+    # telemetry (the recompile-sentinel contract)
+    metrics.counter("memory.oom_total", _always=True,
+                    program=str(program)).add(1)
+    _fr.record("oom", program=str(program),
+               requested_bytes=doc.get("requested_bytes"),
+               free_bytes=doc.get("free_bytes"),
+               top_scope=doc.get("top_scope"),
+               hint=doc.get("hint"),
+               error=str(exc)[:300],
+               **{k: v for k, v in context.items()
+                  if isinstance(v, (int, float, str, bool))})
+    path = receipt_path or default_oom_path(program)
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, path)
+        doc["receipt_path"] = path
+    except Exception:  # pragma: no cover — disk full IS the incident
+        pass
+    return doc

@@ -44,6 +44,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..models.generation import _cast_params, _gpt_params
+from ..observability import memory as _mem
 from ..observability import metrics as _obs
 from ..observability import reqtrace as _rt
 from ..observability.sentinel import RecompileSentinel
@@ -271,9 +272,18 @@ class ServingEngine:
                 rids.append(r.rid)
             rids += [None] * (a - len(batch))
             tables = self.cache.table_array(rids, cfg.table_width)
-            self.cache.pools, tok = self._prefill(
-                self.cache.pools, tables, ids, lens, self.params,
-                pf_key)
+            try:
+                self.cache.pools, tok = self._prefill(
+                    self.cache.pools, tables, ids, lens, self.params,
+                    pf_key)
+            except Exception as e:
+                # OOM sentry (zero cost on the success path): a
+                # RESOURCE_EXHAUSTED here leaves the breadcrumb +
+                # post-mortem receipt before the engine dies
+                _mem.handle_dispatch_oom(
+                    "serving_prefill", e, bucket=s, width=a,
+                    replica=self.trace_replica, step=self._step_no)
+                raise
             tok = np.asarray(tok)
             now = time.perf_counter()
             for i, r in enumerate(batch):
@@ -312,9 +322,15 @@ class ServingEngine:
                 rids.append(r.rid)
             rids += [None] * (b - len(active))
             tables = self.cache.table_array(rids, cfg.table_width)
-            self.cache.pools, toks_out = self._decode(
-                self.cache.pools, tables, toks, positions, self.params,
-                dec_key)
+            try:
+                self.cache.pools, toks_out = self._decode(
+                    self.cache.pools, tables, toks, positions,
+                    self.params, dec_key)
+            except Exception as e:
+                _mem.handle_dispatch_oom(
+                    "serving_decode", e, bucket=b,
+                    replica=self.trace_replica, step=self._step_no)
+                raise
             toks_out = np.asarray(toks_out)     # [decode_chunk, B]
             accepted = 0
             for i, r in enumerate(active):
@@ -351,6 +367,7 @@ class ServingEngine:
             _obs.gauge("serving.active_slots").set(
                 len(self.sched.active()))
             _obs.gauge("serving.pages_free").set(self.cache.n_free)
+            _obs.gauge("serving.pages_live").set(self.cache.n_live)
         return finished
 
     # -- fleet surface: eviction + hot weight swap ---------------------------

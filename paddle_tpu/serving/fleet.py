@@ -81,6 +81,7 @@ from ..distributed import elastic as _elastic
 from ..models.generation import _cast_params, _gpt_params
 from ..observability import fleet as _obs_fleet
 from ..observability import flight_recorder as _fr
+from ..observability import memory as _mem
 from ..observability import metrics as _obs
 from ..observability import reqtrace as _rt
 from .engine import ServingConfig, ServingEngine
@@ -246,6 +247,7 @@ class Replica:
             "serving.replica.queue_depth": g(e.sched.queue_depth),
             "serving.replica.running": g(e.sched.n_running),
             "serving.replica.pages_free": g(e.cache.n_free),
+            "serving.replica.pages_live": g(e.cache.n_live),
             "serving.replica.executables": g(e.executable_count()),
             "serving.replica.recompile_events": c(e.sentinel.fired),
             "serving.replica.finished_total": c(self.finished_total),
@@ -704,6 +706,7 @@ class ServingFleet:
         rep = self._replicas.pop(slot, None)
         if rep is None:
             return 0
+        self._reset_replica_gauges(slot)
         if rep.engine is not None:
             self._retired_recompiles += rep.engine.sentinel.fired
             self._retired_executables += rep.engine.executable_count()
@@ -885,6 +888,7 @@ class ServingFleet:
                     self._retired_executables += \
                         rep.engine.executable_count()
                     self._replicas.pop(rep.slot, None)
+                    self._reset_replica_gauges(rep.slot)
                 continue
             for r in rep.engine.step():
                 fr = self._by_rid.get(r.rid)
@@ -945,9 +949,41 @@ class ServingFleet:
             return -1.0
         return sum(w[3] for w in self._window) / span
 
+    def _reset_replica_gauges(self, slot: int):
+        """A dead slot must not keep exporting its last occupancy: the
+        registry is process-shared, so a frozen labeled gauge would
+        ride every export after the replica is gone (reset() bypasses
+        the metrics gate deliberately — same discipline as the
+        checkpoint host-snapshot gauge)."""
+        for name in ("serving.pages_live", "serving.pages_free",
+                     "serving.pages_occupancy"):
+            g = _obs.get(name, replica=slot)
+            if g is not None:
+                g.reset()
+
     def _publish(self, now: float):
         if not _obs._enabled:
             return
+        # paged-cache occupancy, sampled EVERY fleet tick (the memory
+        # plane's metric-gap fix: the page invariants used to be
+        # test-only — production couldn't see a leaking pool). Labeled
+        # per replica (the registry is process-shared) + fleet totals.
+        pages_live = pages_free = 0
+        for rep in self._replicas.values():
+            if rep.engine is None:
+                continue
+            st = rep.engine.cache.stats()
+            pages_live += st["pages_live"]
+            pages_free += st["pages_free"]
+            _obs.gauge("serving.pages_live", replica=rep.slot).set(
+                st["pages_live"])
+            _obs.gauge("serving.pages_free", replica=rep.slot).set(
+                st["pages_free"])
+            _obs.gauge("serving.pages_occupancy",
+                       replica=rep.slot).set(round(st["occupancy"], 4))
+        _obs.gauge("serving.fleet.pages_live").set(pages_live)
+        _obs.gauge("serving.fleet.pages_free").set(pages_free)
+        _mem.sample()   # device/host occupancy rides the same tick
         _obs.gauge("serving.fleet.queue_depth").set(self.queue_depth)
         # per-class central-queue depth, sampled EVERY fleet tick (the
         # metric-gap fix: depth used to be observable only at dispatch)

@@ -89,6 +89,26 @@ class PagedKVCache:
     def can_alloc(self, n_tokens: int) -> bool:
         return self.blocks_for(n_tokens) <= len(self._free)
 
+    def stats(self) -> Dict[str, float]:
+        """Occupancy snapshot for the memory plane's per-tick gauges:
+        pages live/free/scratch (conservation: live + free + 1 ==
+        n_blocks, the invariant check's arithmetic), occupancy over
+        the allocatable pool, and the device bytes the pools pin
+        (fixed at build — the serving cache's whole HBM story)."""
+        allocatable = self.n_blocks - 1
+        live = self.n_live
+        page_bytes = (self.block_size * self.n_heads * self.head_dim
+                      * self.dtype.itemsize)
+        return {
+            "pages_live": live,
+            "pages_free": len(self._free),
+            "pages_scratch": 1,
+            "occupancy": (live / allocatable) if allocatable else 0.0,
+            "requests": len(self._tables),
+            "pool_bytes": 2 * self.n_layers * self.n_blocks
+            * page_bytes,
+        }
+
     # -- allocate / free -----------------------------------------------------
     def alloc(self, req_id, n_tokens: int) -> List[int]:
         """Reserve the request's whole-lifetime page list. Raises on

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import _attend, _ln, _pick, _prefill
+from ..observability.anatomy import scope as _scope
 
 __all__ = ["make_decode_fn", "make_prefill_fn", "jit_with_donated_pools"]
 
@@ -75,36 +76,44 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
     """
 
     def step(pools, tables, toks, positions, params, key):
+        # anatomy scopes (pure HLO metadata, zero program change): the
+        # memory plane attributes the paged cache's scatter/gather and
+        # the per-layer matmuls row-for-row with the train taxonomy
         b = toks.shape[0]
         hd = params["wte"].shape[1] // n_heads
         scale = 1.0 / math.sqrt(hd)
-        x = (params["wte"][toks] + params["wpe"][positions])[:, None, :]
+        with _scope("embed"):
+            x = (params["wte"][toks]
+                 + params["wpe"][positions])[:, None, :]
         bi = jnp.arange(b)
         blk = tables[bi, positions // block_size]        # [B]
         off = positions % block_size                     # [B]
         new_pools = []
         for bp, (kp, vp) in zip(params["blocks"], pools):
-            xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-            qkv = (xn @ bp["qkv_w"] + bp["qkv_b"]).reshape(
-                b, 1, 3, n_heads, hd)
-            q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])   # [B,nh,1,hd]
-            k_tok = qkv[:, 0, 1]                         # [B,nh,hd]
-            v_tok = qkv[:, 0, 2]
-            kp = kp.at[blk, off].set(k_tok)
-            vp = vp.at[blk, off].set(v_tok)
-            kc = _gathered(kp, tables, n_heads, hd)
-            vc = _gathered(vp, tables, n_heads, hd)
-            ctx = _attend(q, kc, vc, positions + 1, scale)
-            ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
-            x = x + ctx @ bp["proj_w"] + bp["proj_b"]
-            ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
-            ff = jax.nn.gelu(ff @ bp["fc1_w"] + bp["fc1_b"],
-                             approximate=False)
-            x = x + ff @ bp["fc2_w"] + bp["fc2_b"]
+            with _scope("attn"):
+                xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
+                qkv = (xn @ bp["qkv_w"] + bp["qkv_b"]).reshape(
+                    b, 1, 3, n_heads, hd)
+                q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])  # [B,nh,1,hd]
+                k_tok = qkv[:, 0, 1]                     # [B,nh,hd]
+                v_tok = qkv[:, 0, 2]
+                kp = kp.at[blk, off].set(k_tok)
+                vp = vp.at[blk, off].set(v_tok)
+                kc = _gathered(kp, tables, n_heads, hd)
+                vc = _gathered(vp, tables, n_heads, hd)
+                ctx = _attend(q, kc, vc, positions + 1, scale)
+                ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
+                x = x + ctx @ bp["proj_w"] + bp["proj_b"]
+            with _scope("mlp"):
+                ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
+                ff = jax.nn.gelu(ff @ bp["fc1_w"] + bp["fc1_b"],
+                                 approximate=False)
+                x = x + ff @ bp["fc2_w"] + bp["fc2_b"]
             new_pools.append((kp, vp))
-        h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
-        logits = h[:, 0] @ params["wte"].T
-        tok = _pick(logits, key, temperature, top_k, top_p)
+        with _scope("lm_head"):
+            h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+            logits = h[:, 0] @ params["wte"].T
+            tok = _pick(logits, key, temperature, top_k, top_p)
         return tuple(new_pools), tok
 
     def run(pools, tables, toks, positions, params, key):
@@ -143,23 +152,29 @@ def make_prefill_fn(eps: float, n_heads: int, block_size: int,
                 f"prefill bucket {s} is not a multiple of "
                 f"block_size {block_size}")
         nblk = s // block_size
-        x, caches = _prefill(params, eps, n_heads, ids, s,
-                             prompt_lens=prompt_lens)
-        new_pools = []
-        for (kp, vp), (kc, vc) in zip(pools, caches):
-            # [A, nh, S, hd] -> page chunks [A, nblk, bs, nh, hd]
-            kcs = jnp.einsum("ansh->asnh", kc).reshape(
-                a, nblk, block_size, kc.shape[1], kc.shape[3])
-            vcs = jnp.einsum("ansh->asnh", vc).reshape(
-                a, nblk, block_size, vc.shape[1], vc.shape[3])
-            kp = kp.at[tables[:, :nblk]].set(kcs)
-            vp = vp.at[tables[:, :nblk]].set(vcs)
-            new_pools.append((kp, vp))
-        idx = (prompt_lens - 1).astype(jnp.int32)
-        last = jnp.take_along_axis(x, idx[:, None, None], axis=1)
-        h_last = _ln(last, params["lnf_w"], params["lnf_b"], eps)
-        logits = h_last[:, 0] @ params["wte"].T
-        tok = _pick(logits, key, temperature, top_k, top_p)
+        with _scope("attn"):
+            # the dense forward (generation.py's _prefill: embeddings,
+            # per-layer attention + FFN) traces inside the transformer
+            # helper — its own layers carry no finer scopes, so the
+            # whole forward attributes to attn (the dominant term)
+            x, caches = _prefill(params, eps, n_heads, ids, s,
+                                 prompt_lens=prompt_lens)
+            new_pools = []
+            for (kp, vp), (kc, vc) in zip(pools, caches):
+                # [A, nh, S, hd] -> page chunks [A, nblk, bs, nh, hd]
+                kcs = jnp.einsum("ansh->asnh", kc).reshape(
+                    a, nblk, block_size, kc.shape[1], kc.shape[3])
+                vcs = jnp.einsum("ansh->asnh", vc).reshape(
+                    a, nblk, block_size, vc.shape[1], vc.shape[3])
+                kp = kp.at[tables[:, :nblk]].set(kcs)
+                vp = vp.at[tables[:, :nblk]].set(vcs)
+                new_pools.append((kp, vp))
+        with _scope("lm_head"):
+            idx = (prompt_lens - 1).astype(jnp.int32)
+            last = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+            h_last = _ln(last, params["lnf_w"], params["lnf_b"], eps)
+            logits = h_last[:, 0] @ params["wte"].T
+            tok = _pick(logits, key, temperature, top_k, top_p)
         return tuple(new_pools), tok
 
     return run

@@ -26,6 +26,7 @@ from ..framework import Tensor, no_grad
 from ..jit.api import _unwrap_tree, _wrap_tree
 from ..nn.layer.layers import Layer
 from ..observability import flight_recorder as _fr
+from ..observability import memory as _mem
 from ..observability import metrics as _obs
 from ..observability.anatomy import scope as _scope
 from ..observability.sentinel import RecompileSentinel, signature_of
@@ -407,10 +408,20 @@ class TrainStep:
         # flight recorder's step events drive the hang watchdog's
         # progress clock and the goodput "train" bucket
         _tok = _fr.step_begin("train_step", self._steps_done)
-        (self.params, self.opt_state, self.buffers, self.strategy_state,
-         loss, extras) = self._step_fn(
-            self.params, self.opt_state, self.buffers, self.strategy_state,
-            key, lr, in_arrays, lbl_arrays)
+        try:
+            (self.params, self.opt_state, self.buffers,
+             self.strategy_state, loss, extras) = self._step_fn(
+                self.params, self.opt_state, self.buffers,
+                self.strategy_state, key, lr, in_arrays, lbl_arrays)
+        except Exception as e:
+            # OOM sentry (memory plane): zero cost unless the dispatch
+            # actually dies — a RESOURCE_EXHAUSTED leaves the always-on
+            # counter, the flight-recorder `oom` breadcrumb and a
+            # post-mortem receipt (top scopes + remediation hint)
+            # before the fault propagates
+            _mem.handle_dispatch_oom("train_step", e,
+                                     step=self._steps_done)
+            raise
         if _tok is not None and _fr.sync_steps():
             # device-complete before the bracket closes, so step.end
             # durations measure real work, not async dispatch latency

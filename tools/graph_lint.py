@@ -47,24 +47,11 @@ N_DEV = int(os.environ.get("PD_LINT_DEVICES", 2))
 
 
 def _force_cpu_devices():
-    """CPU XLA with >=2 virtual devices for the spmd program. Must act
-    before the jax backend exists; inside pytest the conftest already
-    forced 8, so an initialized backend with enough devices is left
-    alone."""
-    import paddle_tpu.jax_compat  # noqa: F401 (shard_map shim first)
-    import jax
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={N_DEV}"
-        ).strip()
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", N_DEV)
-    except Exception:
-        pass  # backend already up (pytest): use what it has
-    return jax
+    """CPU XLA with >=2 virtual devices for the spmd program (inside
+    pytest the conftest already forced 8, so an initialized backend
+    with enough devices is left alone)."""
+    from tools._force_cpu import force_cpu_devices
+    return force_cpu_devices(N_DEV)
 
 
 def build_ernie(args, config):

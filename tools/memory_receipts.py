@@ -30,6 +30,12 @@ backend-independent shape arithmetic. Headroom 15% absorbs the rest.
 Usage: python tools/memory_receipts.py [v5e8|v5e8_chunked|v4_32|all]
 (prints one JSON line per leg; rc=1 if any leg exceeds its budget or
 the chunked-vs-baseline temp delta inverts).
+
+Since ISSUE 14 this tool is a shim over the memory-anatomy plane
+(`paddle_tpu.observability.memory`): the per-leg sizes come from
+`memory_analysis_dict`, which also supplies the peak fallback on
+runtimes without `peak_memory_in_bytes`. Per-scope attribution and
+baseline gating live in `tools/memory_anatomy.py`.
 """
 from __future__ import annotations
 
@@ -45,15 +51,19 @@ HEADROOM = 0.85
 
 
 def _force_cpu(n):
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
-    assert len(jax.devices()) >= n
+    # each leg runs in a fresh subprocess precisely so this is still
+    # pre-backend-init; strict: a silently wrong mesh voids the receipt
+    from tools._force_cpu import force_cpu_devices
+    force_cpu_devices(n, strict=True)
 
 
 def _stats(lowered):
-    """Per-device sizes from XLA buffer assignment.
+    """Per-device sizes from XLA buffer assignment — a shim over the
+    memory plane (`observability.memory.memory_analysis_dict`), legacy
+    JSON keys preserved so MEMORY_RECEIPTS.json regenerates
+    byte-compatible modulo new fields. The plane also carries the
+    `peak_bytes` fallback for runtimes whose CompiledMemoryStats has
+    no `peak_memory_in_bytes` (this tool used to crash there).
 
     `argument` (params + optimizer moments + AMP masters + data shard)
     and `output` (their updated twins; donation aliases them onto the
@@ -66,15 +76,22 @@ def _stats(lowered):
     StableHLO level, and round-1 proved on hardware: the same
     ERNIE-base batch-48 config this tool lowers RAN in the chip's
     16 GiB at 0.33 MFU). It is reported, not budget-checked."""
-    c = lowered.compile()
-    ma = c.memory_analysis()
+    from paddle_tpu.observability.memory import memory_analysis_dict
+    ma = memory_analysis_dict(lowered.compile())
+    # the budget check's peak: state residency, never the CPU-bound
+    # temp (the fallback reconstruction FOLDS temp in — strip it back
+    # out so old and new runtimes budget the same quantity)
+    peak = (ma["peak_bytes"] if ma["peak_is_exact"]
+            else max(ma["argument_bytes"],
+                     ma["argument_bytes"] + ma["output_bytes"]
+                     - ma["alias_bytes"]))
     return {
-        "argument_gib": ma.argument_size_in_bytes / GIB,
-        "output_gib": ma.output_size_in_bytes / GIB,
-        "cpu_temp_gib": ma.temp_size_in_bytes / GIB,
-        "peak_gib": ma.peak_memory_in_bytes / GIB,
-        "state_residency_gib": max(
-            ma.peak_memory_in_bytes, ma.argument_size_in_bytes) / GIB,
+        "argument_gib": ma["argument_bytes"] / GIB,
+        "output_gib": ma["output_bytes"] / GIB,
+        "cpu_temp_gib": ma["temp_bytes"] / GIB,
+        "peak_gib": peak / GIB,
+        "peak_is_exact": ma["peak_is_exact"],
+        "state_residency_gib": max(peak, ma["argument_bytes"]) / GIB,
     }
 
 

@@ -26,6 +26,15 @@ Modes:
               print the share table as ONE JSON line — the
               zero-to-attribution receipt (scope shares sum to ~1.0,
               sentinel stays at zero).
+  --memory    memory-anatomy bridge (the HBM twin of --anatomy): build
+              the CPU-smoke ERNIE TrainStep, attribute its ONE
+              executable's buffer assignment by scope
+              (observability.memory — temp-byte shares sum to ~1.0,
+              peak-live-bytes reported), publish memory.* gauges +
+              the live occupancy sample (device memory_stats or
+              host RSS), and print ONE JSON line — the
+              zero-to-memory-anatomy receipt (sentinel stays at zero:
+              attribution never touches the train executable).
   --serving   request-anatomy bridge (the serving twin of --anatomy):
               stand up a tiny ServingFleet with metrics + request
               tracing on, replay a deterministic open-loop trace, and
@@ -286,6 +295,76 @@ def run_anatomy(args):
     return 0 if summary["ok"] else 1
 
 
+def run_memory(args):
+    """Memory-anatomy bridge: one tiny ERNIE TrainStep, the per-scope
+    byte share table of its single executable + the live occupancy
+    sample. Self-checks the acceptance surface (shares sum to 1,
+    unattributed bounded, peak > arguments > 0, zero recompiles) so a
+    drive-by refactor that breaks the buffer attribution fails loudly
+    here."""
+    global jax, np
+    if jax is None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu import jax_compat  # noqa: F401 (shims first)
+        import jax as _jax
+        import numpy as _np
+        jax, np = _jax, _np
+    from paddle_tpu.observability import exporters, memory, metrics
+    from tools.step_anatomy import build_step
+
+    metrics.enable()
+    step, ids, lbl, shape = build_step(False)
+    float(step(ids, lbl).item())  # compile (sentinel baselines here)
+    res = memory.train_step_memory(step, (ids,), (lbl,),
+                                   publish_gauges=True)
+    live = memory.sample()
+    if args.prom:
+        exporters.write_prometheus(args.prom)
+    if args.jsonl:
+        exporters.JsonlExporter(args.jsonl).write(extra={
+            "phase": "memory"})
+    shares = {k: round(v["share"], 4) for k, v in res["scopes"].items()}
+    ma = res["memory"]
+    summary = {
+        "ok": True,
+        "shape": shape,
+        "temp_shares": shares,
+        "share_sum": round(sum(shares.values()), 4),
+        "unattributed_share": round(res["unattributed_share"], 4),
+        "peak_bytes": ma["peak_bytes"],
+        "argument_bytes": ma["argument_bytes"],
+        "temp_bytes": ma["temp_bytes"],
+        "peak_is_exact": ma["peak_is_exact"],
+        "host_rss_bytes": (live or {}).get("host_rss_bytes"),
+        "devices_reporting": len((live or {}).get("devices", [])),
+        "train_recompiles": step.recompile_sentinel.fired,
+        "train_executables": int(step._step_fn._cache_size()),
+        "prometheus": args.prom, "jsonl": args.jsonl,
+    }
+    problems = []
+    if abs(summary["share_sum"] - 1.0) > 0.02:
+        problems.append(f"shares sum to {summary['share_sum']}, not 1")
+    if summary["unattributed_share"] >= 0.25:
+        problems.append(
+            f"unattributed {summary['unattributed_share']} >= 0.25 — "
+            "scope metadata is not reaching the buffer attribution")
+    if not (summary["peak_bytes"] >= summary["argument_bytes"] > 0):
+        problems.append("peak/argument bytes not positive-ordered")
+    if not summary["host_rss_bytes"]:
+        problems.append("no live-tier sample (host RSS missing)")
+    if summary["train_recompiles"] != 0 or \
+            summary["train_executables"] != 1:
+        problems.append(
+            f"attribution must never touch the train executable: "
+            f"{summary['train_recompiles']} recompiles, "
+            f"{summary['train_executables']} executables (want 0/1)")
+    if problems:
+        summary["ok"] = False
+        summary["problems"] = problems
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
 def run_serving(args):
     """Request-anatomy bridge: one tiny fleet, one deterministic
     trace, the per-request attribution + burn gauges + breach verdict
@@ -428,6 +507,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--demo", action="store_true")
     ap.add_argument("--anatomy", action="store_true")
+    ap.add_argument("--memory", action="store_true")
     ap.add_argument("--serving", action="store_true")
     ap.add_argument("--force-recompile", action="store_true")
     ap.add_argument("--doctor", default=None, metavar="DIR",
@@ -443,6 +523,8 @@ def main(argv=None):
         return run_doctor(args)
     if args.serving:
         return run_serving(args)
+    if args.memory:
+        return run_memory(args)
     if args.anatomy:
         return run_anatomy(args)
     if args.demo:

@@ -22,6 +22,10 @@ JSON black box; this tool reads all of them and reports:
                step to name the diverging chip; when no vote decides
                (dp=2, or the fault never reached a probe), the rank
                whose PRE-SYNC grad/param stats spiked first is named
+  oom          `oom` breadcrumbs from the memory plane's dispatch
+               sentries: which rank's which program exhausted HBM,
+               requested vs free bytes, the top static scope and the
+               remediation hint (post-mortem receipt alongside)
   recompile storms   recompile events (the sentinel's shape/dtype
                diffs ride along) above a storm threshold
   hangs        watchdog.stall events with the no-progress age and the
@@ -63,7 +67,7 @@ LIVE_STEP_AGE_S = 10.0
 # is exactly what the NUMERIC verdict needs
 _EVIDENCE_KINDS = ("watchdog.stall", "recompile", "sentry.anomaly",
                    "sentry.fingerprint", "sentry.mismatch",
-                   "sentry.fault_capture")
+                   "sentry.fault_capture", "oom")
 # serving-fleet lifecycle breadcrumbs (serving/fleet.py records them
 # into the same flight-recorder ring) surfaced from merged dumps so a
 # crash dump covers serving incidents like training ones
@@ -331,6 +335,29 @@ def _numeric(dumps: List[dict]) -> Optional[dict]:
     return out
 
 
+def _ooms(dumps: List[dict]) -> List[dict]:
+    """`oom` breadcrumbs from the memory plane's dispatch sentries
+    (observability.memory.handle_dispatch_oom), oldest-first: program,
+    requested vs free bytes, the top static scope, the remediation
+    hint — each one also has a post-mortem receipt JSON next to the
+    flight dumps."""
+    out = []
+    for d in dumps:
+        for e in d.get("events", []):
+            if e.get("k") != "oom":
+                continue
+            out.append({
+                "rank": d["rank"], "t": e.get("t", 0),
+                "program": e.get("program"),
+                "requested_bytes": e.get("requested_bytes"),
+                "free_bytes": e.get("free_bytes"),
+                "top_scope": e.get("top_scope"),
+                "hint": e.get("hint"),
+                "step": e.get("step"),
+            })
+    return sorted(out, key=lambda e: e.get("t", 0))
+
+
 def _goodput(dumps: List[dict]) -> Optional[dict]:
     reps = [d.get("goodput") for d in dumps if d.get("goodput")]
     reps = [r for r in reps if r.get("elapsed_seconds", 0) > 0]
@@ -369,6 +396,7 @@ def diagnose(dumps: List[dict]) -> dict:
         "ranks": [d["rank"] for d in dumps],
         "reasons": sorted({d.get("reason", "?") for d in dumps}),
         "divergence": _divergence(dumps),
+        "oom": _ooms(dumps),
         "numeric": _numeric(dumps),
         "stragglers": _stragglers(dumps),
         "recompile_storm": _recompile_storm(dumps),
@@ -382,8 +410,11 @@ def verdict(diag: dict) -> dict:
     """Collapse a diagnosis into ONE actionable verdict — the record
     the elastic supervisor (distributed/elastic.py) consumes to decide
     evict/shrink/respawn. Priority order mirrors diagnostic confidence:
-    a seq divergence is proof a specific rank skipped a collective; a
-    hang names the rank that stopped stepping; a NUMERIC finding names
+    a seq divergence is proof a specific rank skipped a collective; an
+    OOM breadcrumb is proof a specific rank's program exhausted HBM
+    (above hang — the survivors' stalls are the symptom of the dead
+    rank's collective); a hang names the rank that stopped stepping; a
+    NUMERIC finding names
     the chip whose arithmetic diverged (fingerprint minority vote, or
     the first pre-sync stat spike) — above straggler, because silent
     corruption trains into the weights while a straggler merely costs
@@ -399,6 +430,19 @@ def verdict(diag: dict) -> dict:
                              "op": div.get("op"),
                              "seq": div.get("mismatched_seq"),
                              "lagging_ranks": div.get("diverging_ranks")}}
+    ooms = diag.get("oom") or []
+    if ooms:
+        # above HANG: when one rank dies of RESOURCE_EXHAUSTED the
+        # survivors hang on its collective — the OOM is the cause,
+        # their stalls the symptom. The FIRST oom is the origin.
+        o = ooms[0]
+        return {"kind": "oom", "rank": o["rank"], "source": "doctor",
+                "evidence": {"program": o.get("program"),
+                             "requested_bytes": o.get("requested_bytes"),
+                             "free_bytes": o.get("free_bytes"),
+                             "top_scope": o.get("top_scope"),
+                             "hint": o.get("hint"),
+                             "count": len(ooms)}}
     hangs = diag.get("hangs") or []
     if hangs:
         # several ranks usually hang TOGETHER (everyone blocked on the
@@ -557,6 +601,18 @@ def format_report(diag: dict) -> str:
             f"  (snapshot skew? {s['op']}@{s['axis'] or '<eager>'} "
             f"counts {s['counts']} — lagging rank(s) were live at "
             "dump time; re-dump a quiesced pod to confirm)")
+    for o in (diag.get("oom") or [])[:4]:
+        req = o.get("requested_bytes")
+        free = o.get("free_bytes")
+        sizes = ([f"requested {req / 1e6:.1f} MB"] if req else []) \
+            + ([f"{free / 1e6:.1f} MB free"] if free else [])
+        lines.append(
+            f"OOM: rank {o['rank']} program {o.get('program')} "
+            "exhausted memory"
+            + (f" ({', '.join(sizes)})" if sizes else "")
+            + (f"; top scope {o['top_scope']}" if o.get("top_scope")
+               else "")
+            + (f" — hint: {o['hint']}" if o.get("hint") else ""))
     num = diag.get("numeric")
     if num and num.get("diverging_rank") is not None:
         if num.get("source") == "fingerprint":
@@ -684,6 +740,7 @@ def main(argv=None) -> int:
     num = diag.get("numeric")
     bad = bool((div and div.get("diverging_rank") is not None)
                or (num and num.get("diverging_rank") is not None)
+               or diag.get("oom")
                or diag["stragglers"]
                or diag["recompile_storm"] or diag["hangs"])
     return 1 if bad else 0
