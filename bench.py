@@ -579,6 +579,8 @@ def main():
     # report (and, via emit_report + goodput.publish, the
     # Prometheus/JSONL exports and fleet rollups)
     goodput_stats = None
+    pulse_stats = None
+    _pulse_ts = None
     try:
         from paddle_tpu.observability import (flight_recorder as _fr,
                                               goodput as _goodput,
@@ -594,6 +596,47 @@ def main():
     except Exception as e:  # pragma: no cover — bench must survive
         _fr = _goodput = None
         errors["goodput_arm"] = f"{type(e).__name__}: {e}"
+    try:
+        # fleet pulse over the train legs: a daemon sampler snapshots
+        # the registry into time-series rings (PD_PULSE_CADENCE
+        # seconds), and PD_PULSE_PORT (optional; 0 = ephemeral) stands
+        # up the live localhost /metrics endpoint so an operator can
+        # scrape a RUNNING bench instead of waiting for the exit
+        # artifact. PD_PULSE=0 opts out entirely.
+        if os.environ.get("PD_PULSE", "1") != "0":
+            from paddle_tpu.observability import timeseries as _pulse_ts
+            # deliberately NOT metrics.enable(): the sampler only
+            # READS the registry, so arming it costs the headline
+            # nothing — the rings carry the always-on series
+            # (recompiles, compile-cache, goodput at publish).
+            # PD_PULSE_METRICS=1 flips the full gate for a richer
+            # pulse, accepting that the eager-overhead microbench
+            # then measures counter cost too (loses cross-round
+            # comparability for that one series).
+            if os.environ.get("PD_PULSE_METRICS") == "1":
+                from paddle_tpu.observability import metrics as _metrics
+                _metrics.enable()
+            _pulse_ts.enable(
+                cadence_s=float(os.environ.get("PD_PULSE_CADENCE",
+                                               "0.25")),
+                thread=True)
+            port_env = os.environ.get("PD_PULSE_PORT")
+            if port_env is not None:
+                from paddle_tpu.observability import pulse_server
+                srv = pulse_server.serve(port=int(port_env))
+                print(f"# pulse server: {srv.url}/metrics",
+                      file=sys.stderr)
+    except Exception as e:  # pragma: no cover — bench must survive
+        # the sampler may already be running (enable() succeeded, the
+        # server bind failed): stop it, or it samples through every
+        # timed leg with nobody left to disable it
+        try:
+            if _pulse_ts is not None:
+                _pulse_ts.disable()
+        except Exception:
+            pass
+        _pulse_ts = None
+        errors["pulse_arm"] = f"{type(e).__name__}: {e}"
     anatomy_stats = None
     memory_stats = None
     try:
@@ -609,6 +652,17 @@ def main():
             _fr.disable()
         except Exception as e:  # pragma: no cover
             errors["goodput"] = f"{type(e).__name__}: {e}"
+    if _pulse_ts is not None:
+        try:
+            _pulse_ts.sample(force=True)  # final point: post-publish
+            pulse_stats = {
+                "samples": _pulse_ts.sample_count(),
+                "series": len(_pulse_ts.keys()),
+                "cadence_s": _pulse_ts.cadence(),
+            }
+            _pulse_ts.disable()
+        except Exception as e:  # pragma: no cover
+            errors["pulse"] = f"{type(e).__name__}: {e}"
     # secondary benches never sink the primary metric; failures are
     # reported in extras["errors"]
     images_per_sec = -1.0
@@ -715,6 +769,7 @@ def main():
             "decode_dtype": decode_dtype,
             "attention_path": attn_path,
             **({"goodput": goodput_stats} if goodput_stats else {}),
+            **({"pulse": pulse_stats} if pulse_stats else {}),
             **({"anatomy": anatomy_stats} if anatomy_stats else {}),
             **({"memory": memory_stats} if memory_stats else {}),
             **({"serving": serving_stats} if serving_stats else {}),
@@ -734,6 +789,28 @@ def main():
     except Exception as e:  # pragma: no cover — the artifact survives
         report.setdefault("extras", {}).setdefault(
             "errors", {})["obs_export"] = f"{type(e).__name__}: {e}"
+    # cross-run perf ledger: PD_PERF_LEDGER=path appends this run as
+    # one JSONL record (program/config-fingerprinted) so the trend and
+    # the regression gate see it — tools/perf_ledger.py --check
+    ledger_path = os.environ.get("PD_PERF_LEDGER")
+    if ledger_path:
+        try:
+            from paddle_tpu.analysis import perf_ledger as _pl
+            # unique fallback run id: identical ids would break the
+            # ledger's dedup/naming premise when CI appends repeatedly
+            rec = _pl.record_from_report(
+                report, source="bench",
+                run=(os.environ.get("PD_PERF_RUN_ID")
+                     or f"bench-{int(time.time())}"),
+                ts=round(time.time(), 3))
+            # reaching this append means the bench completed: rc=0
+            # keeps the record comparable with the driver-wrapper
+            # artifacts the committed baseline was anchored on
+            rec["metrics"].setdefault("rc", 0.0)
+            _pl.append_record(ledger_path, rec)
+        except Exception as e:  # pragma: no cover
+            print(f"# perf_ledger append failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
     print(json.dumps(report))
 
 

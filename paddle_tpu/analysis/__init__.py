@@ -22,6 +22,10 @@ from .hlo_rules import LAUNCH_RULES
 from .memory_baseline import (check_memory_baseline,
                               load_memory_baseline, peaks_of,
                               write_memory_baseline)
+from .perf_ledger import (check_record, load_ledger,
+                          load_ledger_baseline, record_from_artifact,
+                          record_from_report, render_trend,
+                          write_ledger_baseline)
 from .schedule import (assign_seqs, capture_collective_schedule,
                        schedule_of, verify_collective_schedules)
 from .source_lint import ALLOWLIST, lint_package, lint_source
@@ -36,4 +40,7 @@ __all__ = [
     "verify_collective_schedules", "lint_package", "lint_source",
     "peaks_of", "load_memory_baseline", "write_memory_baseline",
     "check_memory_baseline",
+    "record_from_report", "record_from_artifact", "load_ledger",
+    "load_ledger_baseline", "write_ledger_baseline", "check_record",
+    "render_trend",
 ]

@@ -1,0 +1,440 @@
+"""Cross-run perf ledger: CI-gate performance the way graph_lint gates
+new findings and memory_baseline gates peak growth.
+
+Five rounds of checked-in ``BENCH_r0*.json``/``MULTICHIP_r0*.json``
+receipts fed no trend and no gate — a PR that halved sustained
+tokens/s or doubled p99 TTFT shipped as long as the suite stayed
+green. TVM's lesson (PAPERS.md) is that measurement-driven
+optimization only works on trustworthy LONGITUDINAL data; this module
+is that data's home:
+
+- the LEDGER is an append-only JSONL file: one record per
+  ``emit_report``-shaped receipt (bench.py, tools/serving_bench.py,
+  the multichip probe), carrying every numeric leaf of the report
+  flattened to dotted keys, keyed by a PROGRAM/CONFIG FINGERPRINT
+  (metric name + platform + model size + devices) so a CPU smoke
+  never diffs against a TPU window and an ERNIE-base run never diffs
+  against ERNIE-large;
+- the BASELINE generalizes ``memory_baseline`` from one quantity
+  (peak bytes, lower-better) to EVERY gateable receipt metric, each
+  with a DIRECTION and TOLERANCE:
+    higher-better  tokens/s, images/s, goodput productive fraction,
+                   MFU — regress when cur < base × (1 − tol)
+    lower-better   p99 TTFT, wire bytes, step ms — regress when
+                   cur > base × (1 + tol)
+    exact-better   compile/recompile/executable counts, rc — any
+                   drift regresses (these are CONTRACTS, not
+                   measurements: one extra executable is a retrace
+                   bug regardless of magnitude)
+  improvement never gates; re-anchor deliberately with
+  ``--write-baseline`` (captures improvements, same flow as
+  memory_anatomy);
+- findings ride the shared ``findings.py`` machinery: rule
+  ``perf_ledger``, location ``<fingerprint>:<metric>``, message
+  naming the METRIC, the RUN and the DELTA — the CI log tells the
+  author what regressed without opening an artifact.
+
+Direction/tolerance resolution: ``spec_for(key)`` matches the key
+against ``SPECS`` (ordered, first match wins, ``fnmatch`` patterns);
+keys with no spec are LEDGERED but never GATED (context, not
+contract). The baseline stores the resolved direction+tolerance per
+metric so ``--check`` on a triage host is self-contained.
+
+This module imports no jax — ingest/check/trend all run from JSON
+artifacts anywhere (the memory_baseline discipline).
+"""
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+from .findings import Finding
+
+__all__ = [
+    "RULE", "DEFAULT_TOLERANCE", "SPECS", "spec_for",
+    "flatten_numeric", "fingerprint_of", "record_from_report",
+    "record_from_artifact", "append_record", "load_ledger",
+    "latest_by_fingerprint", "check_record", "write_ledger_baseline",
+    "load_ledger_baseline", "trend", "render_trend",
+]
+
+RULE = "perf_ledger"
+DEFAULT_TOLERANCE = 0.25
+
+# (pattern, direction, tolerance-override). First match wins; None
+# tolerance inherits the baseline default. Exact specs carry no
+# tolerance by definition. Patterns are fnmatch over the dotted key.
+SPECS = (
+    # contracts first — counts where ANY drift is a bug
+    ("*recompile*", "exact", None),
+    ("*compiles", "exact", None),
+    ("*executables", "exact", None),
+    ("*buckets", "exact", None),
+    # rc is not an ordinal measurement: 0 is the only good value.
+    # lower-better @ tolerance 0 means an rc=1 baseline (a round whose
+    # receipt parse failed) lets a LATER rc=0 run pass — "exact" would
+    # gate the recovery as a regression
+    ("rc", "lower", 0.0),
+    # throughput (higher is better). Tolerances sized to the observed
+    # sandbox round-to-round variance (CHANGES.md records ±25-30% CPU
+    # swings on identical code); hardware rounds are steadier and an
+    # operator can tighten with --tolerance
+    ("*tokens_per_sec*", "higher", 0.35),
+    ("*tokens_per_s", "higher", 0.35),
+    ("*images_per_sec*", "higher", 0.35),
+    ("*rows_per_sec*", "higher", 0.35),
+    ("*examples_per_sec*", "higher", 0.35),
+    ("value", "higher", 0.35),          # the headline metric line
+    ("*mfu", "higher", 0.35),
+    ("*goodput.productive_fraction", "higher", None),
+    ("*speedup*", "higher", 0.35),
+    ("*overlap_fraction", "higher", None),
+    # latency / traffic (lower is better). Tail percentiles of
+    # sub-ms CPU timers are the noisiest series the receipts carry
+    # (r05: decode p99 18× its p50) — wide bars, still catching a
+    # real order-of-magnitude regression
+    ("*ttft_ms.p99", "lower", 0.75),
+    ("*ttft_ms.p50", "lower", 0.50),
+    ("*_ms.p99", "lower", 0.75),
+    ("*_ms.p50", "lower", 0.50),
+    ("*_ms_p99", "lower", 0.75),
+    ("*_ms_p50", "lower", 0.50),
+    ("*wire_bytes*", "lower", None),
+    ("*overhead_us", "lower", 0.50),
+    ("*peak_bytes", "lower", None),
+)
+
+
+def spec_for(key: str) -> Optional[dict]:
+    """Direction/tolerance spec for a metric key, or None when the key
+    is context-only (ledgered, never gated)."""
+    for pat, direction, tol in SPECS:
+        if fnmatch.fnmatch(key, pat):
+            out = {"direction": direction}
+            if tol is not None:
+                out["tolerance"] = float(tol)
+            return out
+    return None
+
+
+# -- records -------------------------------------------------------------------
+
+def flatten_numeric(doc: Any, parent: str = "") -> Dict[str, float]:
+    """Numeric leaves only, dotted keys (bools excluded — `ok` flags
+    are not measurements; `rc` style ints are)."""
+    out: Dict[str, float] = {}
+    if isinstance(doc, Mapping):
+        for k, v in doc.items():
+            key = f"{parent}.{k}" if parent else str(k)
+            out.update(flatten_numeric(v, key))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[parent] = float(doc)
+    return out
+
+
+_FP_FIELDS = ("metric", "unit", "kind")
+_FP_EXTRAS = ("platform", "model_params", "n_devices", "replicas")
+
+
+def fingerprint_of(report: Mapping) -> str:
+    """Program/config identity: runs compare only within the same
+    fingerprint. Built from the metric NAME (bench already encodes
+    model size + platform class in it), unit, platform, model size and
+    device count — message/value drift can't bust it (the findings.py
+    fingerprint lesson)."""
+    extras = report.get("extras") or {}
+    ident = {f: report.get(f) for f in _FP_FIELDS
+             if report.get(f) is not None}
+    for f in _FP_EXTRAS:
+        v = report.get(f, extras.get(f))
+        if v is not None:
+            ident[f] = v
+    blob = json.dumps(ident, sort_keys=True)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def record_from_report(report: Mapping, source: str = "bench",
+                       run: Optional[str] = None,
+                       round_n: Optional[int] = None,
+                       ts: Optional[float] = None) -> dict:
+    """One ledger record from an emit_report-shaped receipt dict."""
+    label = str(report.get("metric") or report.get("kind") or source)
+    return {
+        "version": 1,
+        "run": run or (f"{source}-r{round_n:02d}"
+                       if round_n is not None else source),
+        "source": source,
+        "round": round_n,
+        "ts": ts,
+        "fingerprint": fingerprint_of(report),
+        "label": label,
+        "metrics": flatten_numeric(report),
+    }
+
+
+def record_from_artifact(doc: Mapping, source: str,
+                         run: Optional[str] = None,
+                         ts: Optional[float] = None,
+                         round_n: Optional[int] = None
+                         ) -> Optional[dict]:
+    """Ledger record from a checked-in artifact in any of the shapes
+    the repo accumulates:
+
+    - driver wrapper ``{"n", "rc", "parsed": {report}}`` (BENCH_r0*):
+      the parsed report is the record, the wrapper's round/rc ride
+      along (a round whose parse FAILED still ledgers rc — the
+      failure is part of the trajectory);
+    - multichip probe ``{"n_devices", "rc", "ok", ...}``
+      (MULTICHIP_r0*): rc/n_devices under a 'multichip' fingerprint;
+    - a raw emit_report dict (``{"metric", "value", ...}``).
+    Returns None for artifacts with nothing numeric to ledger.
+    ``round_n`` is the caller's fallback (e.g. parsed from the
+    filename) for artifacts without an embedded round — a round-less
+    record orders by ts alone, and mtime is NOT stable across
+    checkouts, so the gate could pick the wrong 'latest' run."""
+    if isinstance(doc.get("n"), int):
+        round_n = doc["n"]
+    if "parsed" in doc or "tail" in doc and "cmd" in doc:
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("metric"):
+            rec = record_from_report(parsed, source=source, run=run,
+                                     round_n=round_n, ts=ts)
+            if isinstance(doc.get("rc"), int):
+                rec["metrics"]["rc"] = float(doc["rc"])
+            return rec
+        if isinstance(doc.get("rc"), int):
+            # a failed round: rc is the only signal, but a trajectory
+            # with a hole labeled "rc=1" beats a silent gap
+            rep = {"metric": f"{source}_rc_only", "unit": "rc",
+                   "rc": doc["rc"]}
+            return record_from_report(rep, source=source, run=run,
+                                      round_n=round_n, ts=ts)
+        return None
+    if "n_devices" in doc:
+        rep = {"kind": "multichip", "n_devices": doc.get("n_devices"),
+               "rc": doc.get("rc")}
+        return record_from_report(rep, source=source, run=run,
+                                  round_n=round_n, ts=ts)
+    if doc.get("metric") or doc.get("kind"):
+        return record_from_report(doc, source=source, run=run,
+                                  round_n=round_n, ts=ts)
+    return None
+
+
+def append_record(path: str, record: dict) -> dict:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return record
+
+
+def load_ledger(path: str) -> List[dict]:
+    if not path or not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _order_key(rec: dict):
+    return (rec.get("round") if rec.get("round") is not None else 1e9,
+            rec.get("ts") or 0.0)
+
+
+def latest_by_fingerprint(records: List[dict]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for rec in sorted(records, key=_order_key):
+        out[rec["fingerprint"]] = rec
+    return out
+
+
+# -- baseline + gate -----------------------------------------------------------
+
+def load_ledger_baseline(path: str) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_ledger_baseline(records: List[dict], path: str,
+                          tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Anchor on the NEWEST record per fingerprint, storing only
+    gateable metrics (spec_for != None) with their resolved direction
+    and tolerance — the file is the reviewable waiver, a PR diff shows
+    exactly which bars moved."""
+    fps = {}
+    for fp, rec in sorted(latest_by_fingerprint(records).items()):
+        mets = {}
+        for key, val in sorted(rec.get("metrics", {}).items()):
+            spec = spec_for(key)
+            if spec is None:
+                continue
+            if val < 0:
+                # bench's "-1" convention marks a skipped/failed leg
+                # (and e.g. overlap_fraction -1 = no data) — a
+                # placeholder is not an anchor
+                continue
+            entry = {"value": val, "direction": spec["direction"]}
+            if spec["direction"] != "exact":
+                entry["tolerance"] = spec.get("tolerance", tolerance)
+            mets[key] = entry
+        fps[fp] = {"label": rec.get("label"), "run": rec.get("run"),
+                   "metrics": mets}
+    data = {"version": 1, "tolerance": float(tolerance),
+            "fingerprints": fps}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def check_record(record: dict, baseline: dict,
+                 tolerance: Optional[float] = None) -> List[Finding]:
+    """The gate. Error findings name metric + run + delta; a run whose
+    fingerprint has no baseline entry is a warning (same waiver flow
+    as graph_lint/memory_anatomy: --write-baseline then check in the
+    diff); a baselined metric MISSING from the run is a warning too —
+    a silently dropped receipt field is a telemetry regression."""
+    fp = record.get("fingerprint", "")
+    run = record.get("run", "?")
+    base = (baseline.get("fingerprints") or {}).get(fp)
+    findings: List[Finding] = []
+    if base is None:
+        findings.append(Finding(
+            rule=RULE, severity="warning", program=run,
+            location=f"{fp}:no_baseline",
+            message=(f"run {run} ({record.get('label')}) has no perf "
+                     "baseline for its config fingerprint — run "
+                     "tools/perf_ledger.py --write-baseline to pin "
+                     "it")))
+        return findings
+    default_tol = (baseline.get("tolerance", DEFAULT_TOLERANCE)
+                   if tolerance is None else float(tolerance))
+    cur_metrics = record.get("metrics", {})
+    for key, spec in sorted(base.get("metrics", {}).items()):
+        cur = cur_metrics.get(key)
+        base_v = spec["value"]
+        direction = spec["direction"]
+        if cur is None:
+            findings.append(Finding(
+                rule=RULE, severity="warning", program=run,
+                location=f"{fp}:{key}",
+                message=(f"metric {key} is baselined but missing from "
+                         f"run {run} — a dropped receipt field hides "
+                         "future regressions; re-anchor if the "
+                         "receipt schema changed deliberately")))
+            continue
+        tol = (spec.get("tolerance", default_tol)
+               if tolerance is None else float(tolerance))
+        if cur < 0 or base_v < 0:
+            # "-1" sentinels mean the leg was skipped or failed (a
+            # PD_BENCH_ONLY-trimmed run, a no-data gauge): name it,
+            # never diff it — a placeholder is not a measurement
+            findings.append(Finding(
+                rule=RULE, severity="warning", program=run,
+                location=f"{fp}:{key}",
+                message=(f"{key} = {cur:g} in run {run} is a "
+                         "skipped/no-data sentinel — leg not gated "
+                         "(run the full bench for a gateable "
+                         "receipt)")))
+            continue
+        bad = None
+        if direction == "exact":
+            if cur != base_v:
+                bad = (f"{key} = {cur:g}, baseline {base_v:g} "
+                       "(exact-better contract: any drift regresses)")
+        elif direction == "higher":
+            if base_v > 0 and cur < base_v * (1.0 - tol):
+                bad = (f"{key} = {cur:g} fell "
+                       f"{(1.0 - cur / base_v) * 100:.1f}% below "
+                       f"baseline {base_v:g} "
+                       f"(tolerance {tol * 100:.0f}%)")
+        elif direction == "lower":
+            if cur > base_v * (1.0 + tol) and (base_v > 0 or cur > 0):
+                grew = ((cur / base_v - 1.0) * 100
+                        if base_v > 0 else float("inf"))
+                bad = (f"{key} = {cur:g} grew {grew:.1f}% over "
+                       f"baseline {base_v:g} "
+                       f"(tolerance {tol * 100:.0f}%)")
+        if bad:
+            findings.append(Finding(
+                rule=RULE, severity="error", program=run,
+                location=f"{fp}:{key}",
+                message=(f"perf regression in run {run} "
+                         f"({record.get('label')}): {bad} — fix the "
+                         "regression or re-anchor deliberately with "
+                         "--write-baseline")))
+    return findings
+
+
+# -- trend ---------------------------------------------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: List[float]) -> str:
+    vs = [v for v in values if v is not None]
+    if not vs:
+        return ""
+    lo, hi = min(vs), max(vs)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK[3])
+        else:
+            out.append(_SPARK[min(7, int((v - lo) / span * 7.999))])
+    return "".join(out)
+
+
+def trend(records: List[dict], metric: Optional[str] = None
+          ) -> Dict[str, dict]:
+    """Per-fingerprint trajectory: runs in round/ts order with the
+    requested metric (default: the headline ``value``, falling back
+    to ``rc`` for receipt-less rounds)."""
+    groups: Dict[str, dict] = {}
+    for rec in sorted(records, key=_order_key):
+        fp = rec["fingerprint"]
+        g = groups.setdefault(fp, {"label": rec.get("label"),
+                                   "runs": []})
+        mets = rec.get("metrics", {})
+        if metric is not None:
+            val = mets.get(metric)
+        else:
+            val = mets.get("value", mets.get("rc"))
+        g["runs"].append({"run": rec.get("run"),
+                          "round": rec.get("round"),
+                          "ts": rec.get("ts"), "value": val})
+    return groups
+
+
+def render_trend(records: List[dict], metric: Optional[str] = None
+                 ) -> str:
+    """Human trajectory table, one block per fingerprint, with a
+    sparkline over the runs — ``perf_ledger --trend``'s output."""
+    groups = trend(records, metric=metric)
+    lines = []
+    what = metric or "value"
+    for fp, g in sorted(groups.items(),
+                        key=lambda kv: -len(kv[1]["runs"])):
+        vals = [r["value"] for r in g["runs"]]
+        lines.append(f"{g['label']}  [{fp}]  metric={what}  "
+                     f"runs={len(g['runs'])}  {_spark(vals)}")
+        for r in g["runs"]:
+            v = "-" if r["value"] is None else f"{r['value']:g}"
+            lines.append(f"  {r['run']:<16} {v}")
+    return "\n".join(lines)

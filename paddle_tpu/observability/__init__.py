@@ -51,6 +51,18 @@ TPU-pod training job needs on top of raw counters:
                    dispatch boundaries (always-on memory.oom_total,
                    `oom` breadcrumbs, post-mortem receipts with
                    remediation hints)
+  timeseries       fleet pulse: background sampler (daemon thread or
+                   per-tick calls, throttled to a cadence) snapshotting
+                   the registry into per-key fixed-size rings of
+                   (ts, value), with derived streams (counter rates,
+                   trailing-window gauge stats, histogram p50/p99
+                   deltas) and window queries
+  pulse_server     the live operator surface: a localhost-only stdlib
+                   HTTP server answering /metrics (the SAME
+                   to_prometheus renderer as the file export),
+                   /healthz (watchdog/goodput/sentry verdict),
+                   /snapshot (JSON) and /series (pulse-ring windows) —
+                   jax-free so it answers while the pod hangs
   sentry           numeric integrity: in-graph per-scope grad/param
                    stats + every-K param-bit fingerprints riding the
                    one step program, a rolling z-score monitor
@@ -74,8 +86,10 @@ from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import memory  # noqa: F401
+from . import pulse_server  # noqa: F401
 from . import reqtrace  # noqa: F401
 from . import sentry  # noqa: F401
+from . import timeseries  # noqa: F401
 from . import mfu  # noqa: F401
 from . import sentinel  # noqa: F401
 from . import watchdog  # noqa: F401
@@ -89,7 +103,7 @@ from .watchdog import HangWatchdog  # noqa: F401
 __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
     "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
-    "memory", "reqtrace", "sentry",
+    "memory", "reqtrace", "sentry", "timeseries", "pulse_server",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",

@@ -24,9 +24,9 @@ from typing import Any, Dict, Optional
 
 from . import metrics
 
-__all__ = ["to_prometheus", "write_prometheus", "JsonlExporter",
-           "chrome_trace_events", "emit_report", "flatten_report",
-           "unflatten_report"]
+__all__ = ["to_prometheus", "write_prometheus", "validate_exposition",
+           "JsonlExporter", "chrome_trace_events", "emit_report",
+           "flatten_report", "unflatten_report"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -36,11 +36,21 @@ def _prom_name(name: str, prefix: str = "paddle_tpu") -> str:
     return f"{prefix}_{base}" if prefix else base
 
 
+def _escape_label_value(v) -> str:
+    # Prometheus exposition: backslash, double-quote and newline must
+    # be escaped inside label values (strict parsers reject the raw
+    # forms — an un-escaped '"' truncates the value and corrupts every
+    # line after it)
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{_NAME_RE.sub("_", k)}="{v}"'
-                     for k, v in labels)
+    inner = ",".join(
+        f'{_NAME_RE.sub("_", k)}="{_escape_label_value(v)}"'
+        for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -48,12 +58,68 @@ def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+def _split_label_pairs(rest: str):
+    """Split the registry's `k=v,k2=v2` label rendering on UNESCAPED
+    commas, unescaping as we scan (full_name escapes ',' and '\\' in
+    values — a naive split(',') broke every value carrying a comma,
+    e.g. an HLO op path or a shape tuple)."""
+    parts, buf = [], []
+    i, n = 0, len(rest)
+    while i < n:
+        ch = rest[i]
+        if ch == "\\" and i + 1 < n:
+            buf.append(rest[i + 1])
+            i += 2
+            continue
+        if ch == ",":
+            parts.append("".join(buf))
+            buf = []
+            i += 1
+            continue
+        buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
 def _split_key(full_name: str):
     if "{" in full_name:
         name, rest = full_name.split("{", 1)
-        pairs = [p.split("=", 1) for p in rest.rstrip("}").split(",")]
-        return name, [(k, v) for k, v in pairs]
+        # exactly ONE closing brace belongs to the rendering —
+        # rstrip("}") would also eat braces that END a value (an HLO
+        # layout like 'f32[2,4]{1,0}')
+        if rest.endswith("}"):
+            rest = rest[:-1]
+        # keys are identifiers, so '=' in a VALUE is unambiguous: only
+        # the first '=' of each pair separates
+        pairs = [p.split("=", 1) for p in _split_label_pairs(rest)]
+        return name, [(p[0], p[1] if len(p) > 1 else "")
+                      for p in pairs]
     return full_name, []
+
+
+_EXPOSITION_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*",?)*\})?'
+    r' [-+]?([0-9.eE+-]+|nan|inf)$')
+
+
+def validate_exposition(text: str) -> int:
+    """Strict-enough Prometheus text-format check: every line is a
+    comment or ``name[{labels}] value`` with balanced, escaped labels.
+    Returns the number of sample lines; raises ValueError on the
+    first malformed line. ONE copy of the validity notion — the
+    pulse-server scrape receipt (obs_report --pulse) and the tier-1
+    tests both enforce exactly this."""
+    n = 0
+    for i, line in enumerate(text.splitlines()):
+        if not line or line.startswith("#"):
+            continue
+        if not _EXPOSITION_SAMPLE_RE.match(line):
+            raise ValueError(
+                f"malformed exposition line {i}: {line!r}")
+        n += 1
+    return n
 
 
 def to_prometheus(snap: Optional[Dict[str, dict]] = None,

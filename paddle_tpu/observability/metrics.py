@@ -96,7 +96,13 @@ class _Instrument:
     def full_name(self) -> str:
         if not self.labels:
             return self.name
-        lbl = ",".join(f"{k}={v}" for k, v in self.labels)
+        # label VALUES may contain the rendering's own separators (an
+        # HLO op path with commas); escape them so exporters._split_key
+        # can split unambiguously. Keys are python identifiers (kwarg
+        # names) and '=' only separates at the FIRST occurrence per
+        # pair, so ',' and '\' are the only characters needing escape.
+        esc = lambda v: v.replace("\\", "\\\\").replace(",", "\\,")
+        lbl = ",".join(f"{k}={esc(v)}" for k, v in self.labels)
         return f"{self.name}{{{lbl}}}"
 
     def _on(self) -> bool:

@@ -84,6 +84,7 @@ from ..observability import flight_recorder as _fr
 from ..observability import memory as _mem
 from ..observability import metrics as _obs
 from ..observability import reqtrace as _rt
+from ..observability import timeseries as _ts
 from .engine import ServingConfig, ServingEngine
 from .scheduler import BucketLadder, Request
 
@@ -963,6 +964,10 @@ class ServingFleet:
 
     def _publish(self, now: float):
         if not _obs._enabled:
+            # the pulse plane rides the fleet tick even when the gauge
+            # refresh is off (frozen values are still a truthful flat
+            # series; disabled sample() is one bool read)
+            _ts.sample()
             return
         # paged-cache occupancy, sampled EVERY fleet tick (the memory
         # plane's metric-gap fix: the page invariants used to be
@@ -1001,6 +1006,10 @@ class ServingFleet:
                        window=f"{w:g}s").set(round(r, 4))
         _obs.gauge("serving.slo.burn_alert").set(
             1 if self._burn.alert(now) else 0)
+        # pulse sample AFTER the gauge refresh so the rings carry THIS
+        # tick's values (throttled to the sampler cadence internally;
+        # the fleet needs no daemon thread of its own)
+        _ts.sample()
 
     # -- receipts / rollup ----------------------------------------------------
     def _emit(self, action: str, verdict: dict, ranks: Sequence[int],

@@ -99,6 +99,37 @@ def main():
             HeartbeatWorker
         hb = HeartbeatWorker(endpoint, rank, interval=None)  # pulse-only
 
+    # fleet pulse: PD_PULSE=1 arms the time-series sampler, and
+    # PD_PULSE_PORT additionally serves the live localhost endpoint
+    # (/metrics, /healthz with this worker's watchdog as the stall
+    # source) — a wedged worker still answers "what was it doing"
+    # because both planes are jax-free daemon threads. Each rank gets
+    # its own ephemeral port; the chosen port is announced on stderr.
+    if os.environ.get("PD_PULSE") == "1":
+        from paddle_tpu.observability import metrics as obs_metrics
+        from paddle_tpu.observability import timeseries
+        obs_metrics.enable()
+        timeseries.enable(
+            cadence_s=float(os.environ.get("PD_PULSE_CADENCE", "0.5")),
+            thread=True)
+        port_env = os.environ.get("PD_PULSE_PORT")
+        if port_env is not None:
+            from paddle_tpu.observability import pulse_server
+            # a FIXED port is offset per rank (every rank of a local
+            # gang shares the host); 0 stays 0 = ephemeral. A bind
+            # failure (port in use) must never kill a training worker
+            # — telemetry is best-effort, same as bench's arming
+            base = int(port_env)
+            try:
+                srv = pulse_server.serve(
+                    port=base + rank if base else 0,
+                    watchdog=watchdog)
+                print(f"# rank {rank} pulse server: {srv.url}",
+                      file=sys.stderr, flush=True)
+            except OSError as e:
+                print(f"# rank {rank} pulse server failed: {e}",
+                      file=sys.stderr, flush=True)
+
     if args.sharded_ckpt:
         run_sharded(args, rank, world, slot, incarnation, hb)
     else:
@@ -242,6 +273,12 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
             fingerprint_every=args.sentry_probe_every,
             min_clean_for_healthy=args.sentry_probe_every + 1,
             fatal_nonfinite=True))
+        # a live pulse server (PD_PULSE) folds the sentry's health
+        # stamp into /healthz — the numeric verdict rides the same
+        # endpoint as the stall clock
+        from paddle_tpu.observability import pulse_server
+        if pulse_server.get_server() is not None:
+            pulse_server.get_server().sentry_monitor = sen
 
     ckpt = os.path.join(args.ckpt_dir, f"slot{slot}")
     cursor = dckpt.DataShardCursor(dataset_size=n, global_batch=gb)

@@ -115,3 +115,30 @@ def test_serving_bridge_receipt(tmp_path):
     assert any(e.get("ph") == "M"
                and "serving replica" in e["args"]["name"]
                for e in tr["traceEvents"])
+
+
+def test_pulse_bridge_receipt():
+    """--pulse: THE live scrape-parity acceptance receipt — during a
+    running fleet leg a mid-run HTTP /metrics pull parses as valid
+    Prometheus text; the post-run pull is byte-identical to
+    to_prometheus(metrics.snapshot()); /healthz answers ok with a
+    nonzero sample count; /series returns >=2 ring points; and the
+    committed perf ledger renders >=5 historical rounds."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         "--pulse"],
+        capture_output=True, text=True, timeout=300,
+        env={**_ENV, "PD_SRV_REQUESTS": "6"}, cwd=ROOT)
+    assert p.returncode == 0, (p.stdout + "\n" + p.stderr)[-2000:]
+    s = json.loads(p.stdout.strip().splitlines()[-1])
+    assert s["ok"], s
+    assert s["mid_run_scrapes"], s
+    for sc in s["mid_run_scrapes"]:
+        assert sc["status"] == 200 and sc["lines"] > 0, s
+    assert s["scrape_parity"] is True, s
+    assert s["healthz"]["status"] == 200
+    assert s["healthz"]["verdict"] == "ok"
+    assert s["pulse_samples"] > 0
+    assert s["series_points"] >= 2
+    assert s["unknown_series_status"] == 404
+    assert s["trend_rounds"] >= 5

@@ -361,3 +361,59 @@ def test_summary_reports_truncation_flag():
         assert len([k for k in rep if k.startswith("span_")]) == 600
     finally:
         prof.stop_profiler(profile_path=None)
+
+
+# -- Prometheus exposition hardening (PR 15 satellite) -----------------------
+
+def test_prom_label_values_escaped():
+    """Exposition bug regression: '"', '\\' and newline in a label
+    value must render ESCAPED — the raw forms truncate the value and
+    corrupt every line after it for a strict scraper."""
+    with metrics.enabled_scope(True):
+        metrics.gauge("esc.g", path='a"b\\c\nd').set(1.0)
+    text = exporters.to_prometheus(metrics.snapshot())
+    line = next(l for l in text.splitlines()
+                if l.startswith("paddle_tpu_esc_g"))
+    assert 'path="a\\"b\\\\c\\nd"' in line
+    # no raw newline leaked into the middle of a sample line
+    assert all(l.startswith(("#", "paddle_tpu_")) or not l
+               for l in text.splitlines())
+
+
+def test_split_key_label_values_with_comma_and_equals():
+    """_split_key regression: label VALUES containing ',' or '=' (an
+    HLO op path, a shape tuple) must round-trip through the registry's
+    full_name rendering — the naive split(',')/split('=') broke both."""
+    labels = {"op": "dot(a=1, b=2)", "shape": "f32[2,4]",
+              "note": "k=v,x=y"}
+    with metrics.enabled_scope(True):
+        metrics.gauge("rt.g", **labels).set(7.0)
+    (full,) = [k for k in metrics.snapshot() if k.startswith("rt.g")]
+    name, parsed = exporters._split_key(full)
+    assert name == "rt.g"
+    assert dict(parsed) == labels
+    # and the rendered exposition line carries every pair
+    text = exporters.to_prometheus(metrics.snapshot())
+    line = next(l for l in text.splitlines()
+                if l.startswith("paddle_tpu_rt_g{"))
+    for k, v in labels.items():
+        assert f'{k}="{v}"' in line
+
+
+def test_split_key_plain_and_single_label_unchanged():
+    assert exporters._split_key("a.b") == ("a.b", [])
+    assert exporters._split_key("a.b{op=matmul}") == (
+        "a.b", [("op", "matmul")])
+
+
+def test_split_key_value_ending_in_brace():
+    """rstrip('}') regression: a label value ENDING in '}' (an HLO
+    layout like 'f32[2,4]{1,0}') must keep its final brace — only the
+    rendering's own closing brace is stripped."""
+    labels = {"shape": "f32[2,4]{1,0}"}
+    with metrics.enabled_scope(True):
+        metrics.gauge("brace.g", **labels).set(1.0)
+    (full,) = [k for k in metrics.snapshot() if k.startswith("brace.g")]
+    name, parsed = exporters._split_key(full)
+    assert name == "brace.g"
+    assert dict(parsed) == labels

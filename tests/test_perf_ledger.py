@@ -1,0 +1,323 @@
+"""Cross-run perf-ledger receipts: record building (numeric flatten +
+config fingerprints), direction/tolerance resolution, the regression
+gate (rc 0 clean -> rc 1 on an injected regression, finding names
+metric + run + delta), baseline round-trip, and the committed
+historical ledger (backfilled from BENCH_r01-r05 + MULTICHIP_r0*)
+rendering a >=5-round trend. Everything here is jax-free."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import perf_ledger as pl
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+LEDGER = os.path.join(ROOT, "tools", "perf_ledger.jsonl")
+BASELINE = os.path.join(ROOT, "tools", "perf_baseline.json")
+
+
+def _report(value=1000.0, p99=50.0, recompiles=0, platform="cpu"):
+    return {
+        "metric": "unit_tokens_per_sec", "value": value,
+        "unit": "tokens/s", "vs_baseline": 1.0,
+        "extras": {
+            "platform": platform,
+            "model_params": 1234,
+            "serving": {"continuous": {"tokens_per_sec": value * 2,
+                                       "recompile_events": recompiles},
+                        "ttft_ms": {"p50": 10.0, "p99": p99}},
+            "comm": {"wire_bytes": 1e6},
+            "note": "non-numeric leaves are not ledgered",
+            "ok": True,
+        },
+    }
+
+
+# -- records ------------------------------------------------------------------
+
+def test_flatten_numeric_leaves_only():
+    flat = pl.flatten_numeric(_report())
+    assert flat["value"] == 1000.0
+    assert flat["extras.serving.ttft_ms.p99"] == 50.0
+    assert flat["extras.comm.wire_bytes"] == 1e6
+    assert "extras.note" not in flat
+    assert "extras.ok" not in flat            # bools are not metrics
+
+
+def test_fingerprint_stable_and_config_sensitive():
+    a = pl.fingerprint_of(_report(value=1.0))
+    b = pl.fingerprint_of(_report(value=999.0, p99=1.0))
+    assert a == b                 # values never move the fingerprint
+    assert pl.fingerprint_of(_report(platform="tpu")) != a
+    changed = _report()
+    changed["metric"] = "other_metric"
+    assert pl.fingerprint_of(changed) != a
+
+
+def test_record_from_artifact_shapes(tmp_path):
+    # driver wrapper with parsed report (the BENCH_r0* shape)
+    rec = pl.record_from_artifact(
+        {"n": 3, "cmd": "x", "rc": 0, "tail": "...",
+         "parsed": _report()}, source="bench")
+    assert rec["run"] == "bench-r03" and rec["metrics"]["rc"] == 0.0
+    assert rec["metrics"]["value"] == 1000.0
+    # a failed round still ledgers its rc (trajectory hole stays loud)
+    rec2 = pl.record_from_artifact(
+        {"n": 2, "cmd": "x", "rc": 1, "tail": "boom", "parsed": None},
+        source="bench")
+    assert rec2["metrics"] == {"rc": 1.0}
+    # multichip probe shape
+    rec3 = pl.record_from_artifact(
+        {"n_devices": 8, "rc": 0, "ok": True}, source="multichip",
+        run="multichip-r09")
+    assert rec3["label"] == "multichip"
+    assert rec3["metrics"]["n_devices"] == 8.0
+    # nothing numeric -> None
+    assert pl.record_from_artifact({"tail": "x", "cmd": "y"},
+                                   source="bench") is None
+
+
+def test_ledger_append_load_roundtrip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    r1 = pl.record_from_report(_report(), round_n=1)
+    r2 = pl.record_from_report(_report(value=1100.0), round_n=2)
+    pl.append_record(path, r1)
+    pl.append_record(path, r2)
+    recs = pl.load_ledger(path)
+    assert [r["run"] for r in recs] == ["bench-r01", "bench-r02"]
+    latest = pl.latest_by_fingerprint(recs)
+    assert list(latest.values())[0]["run"] == "bench-r02"
+
+
+# -- direction / tolerance specs ----------------------------------------------
+
+def test_spec_directions():
+    assert pl.spec_for("value")["direction"] == "higher"
+    assert pl.spec_for(
+        "extras.serving.continuous.tokens_per_sec")["direction"] \
+        == "higher"
+    assert pl.spec_for("extras.serving.ttft_ms.p99")["direction"] \
+        == "lower"
+    assert pl.spec_for("extras.comm.wire_bytes")["direction"] == "lower"
+    assert pl.spec_for(
+        "extras.serving.continuous.recompile_events")["direction"] \
+        == "exact"
+    assert pl.spec_for("extras.dynamic_shape_compiles")["direction"] \
+        == "exact"
+    assert pl.spec_for("rc") == {"direction": "lower",
+                                 "tolerance": 0.0}
+    assert pl.spec_for("extras.model_params") is None   # context-only
+
+
+# -- the gate -----------------------------------------------------------------
+
+def _baselined(tmp_path, **kw):
+    rec = pl.record_from_report(_report(**kw), round_n=1)
+    base_path = str(tmp_path / "base.json")
+    pl.write_ledger_baseline([rec], base_path)
+    return rec, pl.load_ledger_baseline(base_path)
+
+
+def test_gate_clean_and_within_tolerance(tmp_path):
+    rec, base = _baselined(tmp_path)
+    assert pl.check_record(rec, base) == []
+    ok = pl.record_from_report(_report(value=900.0, p99=60.0),
+                               round_n=2)       # −10% / +20%: inside
+    assert [f for f in pl.check_record(ok, base)
+            if f.severity == "error"] == []
+
+
+def test_gate_higher_better_drop_trips(tmp_path):
+    rec, base = _baselined(tmp_path)
+    bad = pl.record_from_report(_report(value=400.0), round_n=2,
+                                run="bench-r02")
+    errs = [f for f in pl.check_record(bad, base)
+            if f.severity == "error"]
+    assert any("value" in f.location and "bench-r02" in f.message
+               and "60.0%" in f.message for f in errs)
+
+
+def test_gate_lower_better_growth_trips(tmp_path):
+    rec, base = _baselined(tmp_path)
+    bad = pl.record_from_report(_report(p99=200.0), round_n=2)
+    errs = [f for f in pl.check_record(bad, base)
+            if f.severity == "error"]
+    assert any("ttft_ms.p99" in f.location for f in errs)
+    # improvement never gates
+    good = pl.record_from_report(_report(p99=1.0), round_n=3)
+    assert [f for f in pl.check_record(good, base)
+            if f.severity == "error"] == []
+
+
+def test_gate_exact_contract_trips_on_any_drift(tmp_path):
+    rec, base = _baselined(tmp_path, recompiles=0)
+    bad = pl.record_from_report(_report(recompiles=1), round_n=2)
+    errs = [f for f in pl.check_record(bad, base)
+            if f.severity == "error"]
+    assert any("recompile_events" in f.location
+               and "exact-better" in f.message for f in errs)
+
+
+def test_gate_unknown_fingerprint_and_missing_metric_warn(tmp_path):
+    rec, base = _baselined(tmp_path)
+    other = pl.record_from_report(_report(platform="tpu"), round_n=2)
+    fs = pl.check_record(other, base)
+    assert [f.severity for f in fs] == ["warning"]
+    assert "no_baseline" in fs[0].location
+    # a baselined metric vanishing from the receipt is a loud warning
+    gone = pl.record_from_report(_report(), round_n=3)
+    del gone["metrics"]["extras.serving.ttft_ms.p99"]
+    fs2 = pl.check_record(gone, base)
+    assert any(f.severity == "warning"
+               and "ttft_ms.p99" in f.location for f in fs2)
+
+
+# -- trend --------------------------------------------------------------------
+
+def test_trend_orders_runs_and_sparkline(tmp_path):
+    recs = [pl.record_from_report(_report(value=v), round_n=i + 1)
+            for i, v in enumerate((100.0, 150.0, 120.0))]
+    groups = pl.trend(recs)
+    (g,) = groups.values()
+    assert [r["value"] for r in g["runs"]] == [100.0, 150.0, 120.0]
+    out = pl.render_trend(recs)
+    assert "bench-r01" in out and "runs=3" in out
+
+
+# -- committed history + CLI --------------------------------------------------
+
+def test_committed_ledger_renders_five_rounds():
+    """The backfill satellite's acceptance: day-one trend shows the
+    real historical trajectory from the checked-in artifacts."""
+    recs = pl.load_ledger(LEDGER)
+    assert len(recs) >= 10
+    groups = pl.trend(recs)
+    assert max(len(g["runs"]) for g in groups.values()) >= 5
+    out = pl.render_trend(recs)
+    for r in ("r01", "r02", "r03", "r04", "r05"):
+        assert r in out
+
+
+def test_committed_baseline_gates_committed_ledger_clean():
+    base = pl.load_ledger_baseline(BASELINE)
+    assert base.get("fingerprints")
+    for rec in pl.latest_by_fingerprint(pl.load_ledger(LEDGER)).values():
+        errs = [f for f in pl.check_record(rec, base)
+                if f.severity == "error"]
+        assert errs == [], [f.summary() for f in errs]
+
+
+def _cli(*argv, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "perf_ledger.py"),
+         *argv], capture_output=True, text=True, timeout=120, cwd=cwd)
+
+
+def test_cli_check_rc0_clean_rc1_injected_regression():
+    """THE acceptance drill: --check exits 0 on the committed state
+    and 1 naming the regressed metric on an inflated run (the ledger
+    and baseline files are never touched by --inflate)."""
+    p = _cli("--check")
+    assert p.returncode == 0, p.stdout + p.stderr
+    receipt = json.loads(p.stdout.strip().splitlines()[-1]
+                         .split("perf_ledger:", 1)[1])
+    assert receipt["ok"] is True and receipt["rounds"] >= 5
+
+    before = open(LEDGER).read()
+    p2 = _cli("--check", "--inflate", "value:0.5")
+    assert p2.returncode == 1
+    assert "perf regression" in p2.stdout
+    assert "value" in p2.stdout and "fell" in p2.stdout
+    assert open(LEDGER).read() == before       # drill never persists
+
+
+def test_cli_ingest_write_baseline_check_cycle(tmp_path):
+    ledger = str(tmp_path / "l.jsonl")
+    base = str(tmp_path / "b.json")
+    receipt = str(tmp_path / "run.json")
+    with open(receipt, "w") as f:
+        json.dump(_report(value=1000.0), f)
+    p = _cli("--ledger", ledger, "--baseline", base,
+             "--ingest", receipt, "--write-baseline", "--check")
+    assert p.returncode == 0, p.stdout + p.stderr
+    # re-ingesting the same artifact is a no-op (idempotent run ids)
+    p2 = _cli("--ledger", ledger, "--baseline", base,
+              "--ingest", receipt)
+    assert "already ledgered" in p2.stdout
+    assert len(pl.load_ledger(ledger)) == 1
+    # a regressed NEW receipt gates rc 1 against the anchored baseline
+    with open(receipt, "w") as f:
+        json.dump(_report(value=100.0), f)
+    bad = str(tmp_path / "run2.json")
+    os.rename(receipt, bad)
+    p3 = _cli("--ledger", ledger, "--baseline", base, "--check", bad)
+    assert p3.returncode == 1
+    assert "value" in p3.stdout and "below baseline" in p3.stdout
+
+
+def test_gate_skipped_leg_sentinels_warn_not_error(tmp_path):
+    """bench marks a skipped/failed leg with -1: a PD_BENCH_ONLY-
+    trimmed run must not gate those placeholders as regressions, and
+    a -1 anchored into a baseline must never happen."""
+    rep = _report()
+    rep["extras"]["resnet50_images_per_sec"] = 16.2
+    rec = pl.record_from_report(rep, round_n=1)
+    base_path = str(tmp_path / "b.json")
+    pl.write_ledger_baseline([rec], base_path)
+    base = pl.load_ledger_baseline(base_path)
+    trimmed = _report()
+    trimmed["extras"]["resnet50_images_per_sec"] = -1.0
+    got = pl.check_record(pl.record_from_report(trimmed, round_n=2),
+                          base)
+    hits = [f for f in got if "resnet50" in f.location]
+    assert hits and all(f.severity == "warning" for f in hits)
+    assert "sentinel" in hits[0].message
+    # and a sentinel never becomes an anchor
+    pl.write_ledger_baseline(
+        [pl.record_from_report(trimmed, round_n=3)], base_path)
+    base2 = pl.load_ledger_baseline(base_path)
+    (fp_entry,) = base2["fingerprints"].values()
+    assert "extras.resnet50_images_per_sec" not in fp_entry["metrics"]
+
+
+def test_gate_rc_recovery_passes_failure_trips(tmp_path):
+    """rc is zero-better, not exact: a round that RECOVERS (baseline
+    rc=1 from a failed parse, new run rc=0) must pass; a round that
+    starts failing (baseline 0, new 1) must trip."""
+    failed = pl.record_from_artifact(
+        {"n": 1, "cmd": "x", "rc": 1, "tail": "boom", "parsed": None},
+        source="bench")
+    base_path = str(tmp_path / "b.json")
+    pl.write_ledger_baseline([failed], base_path)
+    base = pl.load_ledger_baseline(base_path)
+    recovered = pl.record_from_artifact(
+        {"n": 2, "cmd": "x", "rc": 0, "tail": "boom", "parsed": None},
+        source="bench")
+    assert [f for f in pl.check_record(recovered, base)
+            if f.severity == "error"] == []
+    # and the inverse: a newly failing run against a clean baseline
+    pl.write_ledger_baseline([recovered], base_path)
+    fs = pl.check_record(failed, pl.load_ledger_baseline(base_path))
+    assert any(f.severity == "error" and ":rc" in f.location
+               for f in fs)
+
+
+def test_cli_runs_without_jax_or_paddle(tmp_path):
+    """The triage-host contract: the CLI must gate/trend with jax AND
+    the paddle_tpu package unimportable (it loads the analysis module
+    by file path through tpu_doctor's shim loader)."""
+    code = (
+        "import sys, runpy\n"
+        "sys.modules['jax'] = None\n"
+        "sys.modules['paddle_tpu'] = None\n"
+        "sys.argv = ['perf_ledger', '--check']\n"
+        "runpy.run_path(%r, run_name='__main__')\n"
+        % os.path.join(ROOT, "tools", "perf_ledger.py"))
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=ROOT)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert '"ok": true' in p.stdout

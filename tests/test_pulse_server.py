@@ -1,0 +1,262 @@
+"""Pulse-server receipts: live /metrics scrape parity with
+to_prometheus (one renderer — the ISSUE's cannot-drift contract),
+valid exposition text under concurrent mutation, the localhost-only
+bind, /healthz verdicts (ok / stalled / numeric), /snapshot and
+/series ring contents, and 404 routing."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import exporters, metrics, pulse_server
+from paddle_tpu.observability import timeseries as ts
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    metrics.clear()
+    metrics.disable()
+    ts.disable()
+    ts.reset()
+    yield
+    ts.disable()
+    ts.reset()
+    metrics.clear()
+    metrics.disable()
+
+
+@pytest.fixture()
+def server():
+    srv = pulse_server.PulseServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(f"{srv.url}{path}", timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _assert_valid_exposition(text):
+    # ONE copy of the validity notion: the same validator the --pulse
+    # receipt runs (raises ValueError on the first malformed line)
+    return exporters.validate_exposition(text)
+
+
+def _seed_registry():
+    with metrics.enabled_scope(True):
+        metrics.counter("srv.t.c", op="matmul").add(3)
+        metrics.gauge("srv.t.depth").set(7)
+        metrics.histogram("srv.t.lat").observe_many([1.0, 2.0, 9.0])
+        # adversarial label value: quotes/backslash/comma must survive
+        # the exposition render (the PR 15 escaping fix)
+        metrics.gauge("srv.t.esc", path='a"b\\c,d').set(1)
+
+
+# -- /metrics -----------------------------------------------------------------
+
+def test_metrics_scrape_parity_with_to_prometheus(server):
+    """THE one-renderer contract: the HTTP body equals
+    to_prometheus(metrics.snapshot()) byte for byte (modulo the
+    scrape's own always-on odometer, excluded from both sides)."""
+    _seed_registry()
+    code, body = _get(server, "/metrics")
+    assert code == 200
+    local = exporters.to_prometheus(metrics.snapshot())
+    drop = lambda t: [l for l in t.splitlines()
+                      if "pulse_scrapes_total" not in l]
+    assert drop(body) == drop(local)
+    assert _assert_valid_exposition(body) > 0
+    assert "paddle_tpu_srv_t_c" in body
+
+
+def test_metrics_scrape_valid_under_live_mutation(server):
+    """Scrapes DURING a running leg must still parse: a writer thread
+    hammers the registry while we pull repeatedly."""
+    _seed_registry()
+    stop = threading.Event()
+
+    def hammer():
+        c = metrics.counter("srv.t.c", op="matmul")
+        g = metrics.gauge("srv.t.depth")
+        with metrics.enabled_scope(True):
+            i = 0
+            while not stop.is_set():
+                c.add(1)
+                g.set(i % 13)
+                i += 1
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        values = []
+        for _ in range(5):
+            code, body = _get(server, "/metrics")
+            assert code == 200
+            _assert_valid_exposition(body)
+            line = next(l for l in body.splitlines()
+                        if l.startswith("paddle_tpu_srv_t_c"))
+            values.append(float(line.rsplit(" ", 1)[1]))
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert values == sorted(values)    # counter stays monotonic
+
+
+def test_scrape_counts_on_always_on_odometer(server):
+    assert not metrics.enabled()
+    _get(server, "/metrics")
+    _get(server, "/metrics")
+    assert metrics.counter("pulse.scrapes_total").value() == 2
+
+
+# -- bind policy --------------------------------------------------------------
+
+def test_binds_loopback_ephemeral_port(server):
+    host, port = server.address[0], server.port
+    assert host == "127.0.0.1"
+    assert port > 0
+    srv2 = pulse_server.PulseServer(port=0).start()
+    try:
+        assert srv2.port != port       # each gets its own ephemeral
+    finally:
+        srv2.stop()
+
+
+def test_rejects_non_loopback_host():
+    with pytest.raises(ValueError, match="loopback"):
+        pulse_server.PulseServer(host="0.0.0.0")
+    with pytest.raises(ValueError, match="loopback"):
+        pulse_server.PulseServer(host="10.0.0.5")
+
+
+# -- /healthz -----------------------------------------------------------------
+
+class _FakeWatchdog:
+    def __init__(self, timeout_s):
+        self._t = timeout_s
+        self.stall_count = 0
+
+    def timeout(self):
+        return self._t
+
+
+class _FakeSentry:
+    def __init__(self, loss_finite=True):
+        self._lf = loss_finite
+
+    def health_stamp(self):
+        return {"healthy": self._lf, "loss_finite": self._lf,
+                "clean_window": 5}
+
+
+def test_healthz_ok_and_shape(server):
+    code, body = _get(server, "/healthz")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["ok"] is True and doc["verdict"] == "ok"
+    assert "progress" in doc and "goodput" in doc
+    assert doc["pulse"]["enabled"] is False
+
+
+def test_healthz_stalled_verdict():
+    from paddle_tpu.observability import flight_recorder as fr
+    fr.enable()
+    try:
+        tok = fr.step_begin("t", 0)
+        fr.step_end("t", 0, tok)
+        import time as _time
+        _time.sleep(0.05)
+        # a watchdog whose clock already expired: age > timeout
+        doc = pulse_server.health_doc(watchdog=_FakeWatchdog(0.01))
+        assert doc["verdict"] == "stalled" and doc["ok"] is False
+        srv = pulse_server.PulseServer(
+            port=0, watchdog=_FakeWatchdog(0.01)).start()
+        try:
+            code, body = _get(srv, "/healthz")
+            assert code == 503
+            assert json.loads(body)["verdict"] == "stalled"
+        finally:
+            srv.stop()
+    finally:
+        fr.disable()
+        fr.reset()
+
+
+def test_healthz_numeric_verdict():
+    doc = pulse_server.health_doc(
+        sentry_monitor=_FakeSentry(loss_finite=False))
+    assert doc["verdict"] == "numeric" and doc["ok"] is False
+    srv = pulse_server.PulseServer(
+        port=0, sentry_monitor=_FakeSentry(loss_finite=False)).start()
+    try:
+        code, body = _get(srv, "/healthz")
+        assert code == 503
+        assert json.loads(body)["sentry"]["loss_finite"] is False
+    finally:
+        srv.stop()
+
+
+# -- /snapshot and /series ----------------------------------------------------
+
+def test_snapshot_matches_registry(server):
+    _seed_registry()
+    code, body = _get(server, "/snapshot")
+    assert code == 200
+    doc = json.loads(body)
+    local = metrics.snapshot()
+    # json round-trip loses tuple-vs-list only; compare via dumps
+    assert json.loads(json.dumps(local)) == doc["metrics"]
+
+
+def test_series_returns_ring_contents(server):
+    ts.enable(cadence_s=0.0)
+    with metrics.enabled_scope(True):
+        g = metrics.gauge("srv.t.depth")
+        for now, v in ((10.0, 1), (11.0, 2), (12.0, 3)):
+            g.set(v)
+            ts.sample(now=now, force=True)
+    code, body = _get(server, "/series?key=srv.t.depth")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["points"] == [[10.0, 1.0], [11.0, 2.0], [12.0, 3.0]]
+    # trailing window narrows it
+    code, body = _get(server, "/series?key=srv.t.depth&window=1.5")
+    assert [p[1] for p in json.loads(body)["points"]] == [2.0, 3.0]
+
+
+def test_series_unknown_key_404(server):
+    code, body = _get(server, "/series?key=no.such.key")
+    assert code == 404
+    assert "unknown series" in json.loads(body)["error"]
+
+
+def test_unknown_route_404(server):
+    code, body = _get(server, "/nope")
+    assert code == 404
+    assert "/metrics" in json.loads(body)["routes"]
+
+
+def test_serve_singleton_reuses_and_updates_sources():
+    pulse_server.shutdown()
+    try:
+        a = pulse_server.serve(port=0)
+        b = pulse_server.serve(port=0,
+                               sentry_monitor=_FakeSentry(False))
+        assert a is b
+        code, body = _get(a, "/healthz")
+        assert code == 503             # the late-registered sentry bites
+    finally:
+        pulse_server.shutdown()
+        assert pulse_server.get_server() is None
+
+
+def test_series_bad_window_is_400_not_500(server):
+    code, body = _get(server, "/series?key=k&window=abc")
+    assert code == 400
+    assert "window" in json.loads(body)["error"]

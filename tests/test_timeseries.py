@@ -1,0 +1,217 @@
+"""Fleet-pulse sampler receipts: ring wrap/reset, the <1 µs
+disabled-path guard (the flight-recorder cost bar — sample() is wired
+into the ServingFleet tick permanently), cadence throttling, derived
+streams (counter rates, trailing-window gauge stats, histogram p50/p99
+deltas) and the daemon thread lifecycle."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability import timeseries as ts
+
+
+@pytest.fixture(autouse=True)
+def _isolated_pulse():
+    metrics.clear()
+    metrics.disable()
+    ts.disable()
+    ts.reset()
+    yield
+    ts.disable()
+    ts.reset()
+    metrics.clear()
+    metrics.disable()
+
+
+# -- ring ---------------------------------------------------------------------
+
+def test_ring_wraps_bounded_and_ordered():
+    r = ts.Ring(capacity=8)
+    for i in range(20):
+        r.append(float(i), float(i * 10))
+    assert len(r) == 8
+    assert r.total == 20
+    pts = r.points()
+    assert [p[0] for p in pts] == [float(i) for i in range(12, 20)]
+    assert [p[1] for p in pts] == [float(i * 10) for i in range(12, 20)]
+
+
+def test_ring_window_trailing():
+    r = ts.Ring(capacity=16)
+    for i in range(10):
+        r.append(100.0 + i, float(i))
+    w = r.window(3.0, now=109.0)   # ts >= 106
+    assert [p[0] for p in w] == [106.0, 107.0, 108.0, 109.0]
+    assert r.window(None) == r.points()
+
+
+def test_reset_clears_rings_and_counters():
+    ts.enable(cadence_s=0.0)
+    with metrics.enabled_scope(True):
+        metrics.gauge("pulse.t.g").set(1.0)
+    ts.sample(force=True)
+    assert ts.keys() and ts.sample_count() == 1
+    ts.reset()
+    assert ts.keys() == [] and ts.sample_count() == 0
+    assert ts.series("pulse.t.g") is None
+
+
+# -- cost discipline ----------------------------------------------------------
+
+def test_disabled_sample_under_one_microsecond():
+    """CI guard (the flight-recorder harness verbatim): sample() sits
+    in ServingFleet._publish on EVERY tick; disabled it must stay
+    under ~1 µs median (one module-bool read + call overhead)."""
+    assert not ts.enabled()
+    n = 10000
+    medians = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ts.sample()
+        medians.append((time.perf_counter() - t0) / n)
+    med = sorted(medians)[len(medians) // 2]
+    assert med < 1e-6, f"disabled sample() costs {med * 1e9:.0f}ns"
+    assert ts.keys() == []         # and recorded nothing
+
+
+def test_throttle_honors_cadence_force_bypasses():
+    ts.enable(cadence_s=10.0)      # nothing should pass the throttle
+    with metrics.enabled_scope(True):
+        metrics.gauge("pulse.t.g").set(1.0)
+    assert ts.sample(now=1000.0) is not None       # first always lands
+    assert ts.sample(now=1000.5) is None           # inside cadence
+    assert ts.sample(now=1009.9) is None
+    assert ts.sample(now=1009.9, force=True) is not None
+    assert ts.sample(now=1010.1) is None           # throttle re-anchored
+    assert ts.sample(now=1020.0) is not None
+    pts = ts.series("pulse.t.g")
+    assert [p[0] for p in pts] == [1000.0, 1009.9, 1020.0]
+
+
+# -- derived streams ----------------------------------------------------------
+
+def test_counter_rate_over_window():
+    ts.enable(cadence_s=0.0)
+    c = metrics.counter("pulse.t.c")
+    with metrics.enabled_scope(True):
+        for i, now in enumerate((100.0, 101.0, 102.0, 103.0)):
+            c.add(50)
+            ts.sample(now=now, force=True)
+    # 150 counts over 3 s between first and last point
+    assert ts.rate("pulse.t.c") == pytest.approx(50.0)
+    # trailing 1.5 s window: points at 102 and 103 -> 50/s
+    assert ts.rate("pulse.t.c", window=1.5,
+                   now=103.0) == pytest.approx(50.0)
+    assert ts.rate("pulse.t.c", window=0.1, now=103.0) is None
+
+
+def test_rate_clamped_on_registry_reset():
+    ts.enable(cadence_s=0.0)
+    c = metrics.counter("pulse.t.c")
+    with metrics.enabled_scope(True):
+        c.add(100)
+        ts.sample(now=10.0, force=True)
+        c.reset()                 # mid-window reset must not go negative
+        ts.sample(now=11.0, force=True)
+    assert ts.rate("pulse.t.c") == 0.0
+
+
+def test_gauge_stats_window():
+    ts.enable(cadence_s=0.0)
+    g = metrics.gauge("pulse.t.depth")
+    with metrics.enabled_scope(True):
+        for now, v in ((1.0, 4), (2.0, 8), (3.0, 6)):
+            g.set(v)
+            ts.sample(now=now, force=True)
+    st = ts.gauge_stats("pulse.t.depth")
+    assert st == {"n": 3, "min": 4.0, "max": 8.0, "mean": 6.0,
+                  "last": 6.0}
+    st2 = ts.gauge_stats("pulse.t.depth", window=1.0, now=3.0)
+    assert st2["n"] == 2 and st2["min"] == 6.0
+
+
+def test_histogram_substreams_and_delta():
+    ts.enable(cadence_s=0.0)
+    h = metrics.histogram("pulse.t.lat")
+    with metrics.enabled_scope(True):
+        h.observe_many([10, 10, 10])
+        ts.sample(now=1.0, force=True)
+        h.observe_many([50, 50, 50, 50, 50, 50])
+        ts.sample(now=2.0, force=True)
+    assert ts.series("pulse.t.lat:count")
+    d = ts.hist_delta("pulse.t.lat")
+    assert d["count"] == 9 and d["count_delta"] == 6
+    assert d["p50"] == 50.0 and d["p50_delta"] == 40.0
+    assert d["p99"] == 50.0
+
+
+def test_non_numeric_gauges_skipped():
+    ts.enable(cadence_s=0.0)
+    with metrics.enabled_scope(True):
+        metrics.gauge("pulse.t.str").set("not-a-number")
+        metrics.gauge("pulse.t.num").set(2)
+    ts.sample(force=True)
+    assert ts.series("pulse.t.str") is None
+    assert len(ts.series("pulse.t.num")) == 1
+
+
+def test_samples_total_odometer_always_on():
+    """The sampler's own odometer is _always=True (cold path, one bump
+    per cadence) so a scraper can prove the pulse is running even with
+    the hot-path gate down."""
+    ts.enable(cadence_s=0.0)
+    assert not metrics.enabled()
+    ts.sample(force=True)
+    ts.sample(force=True)
+    assert metrics.counter("pulse.samples_total").value() == 2
+
+
+# -- daemon thread ------------------------------------------------------------
+
+def test_daemon_thread_samples_and_stops():
+    with metrics.enabled_scope(True):
+        metrics.gauge("pulse.t.live").set(1.0)
+        ts.enable(cadence_s=0.02, thread=True)
+        deadline = time.time() + 5.0
+        while ts.sample_count() < 3 and time.time() < deadline:
+            time.sleep(0.01)
+    assert ts.sample_count() >= 3
+    assert len(ts.series("pulse.t.live")) >= 3
+    ts.disable()
+    n = ts.sample_count()
+    time.sleep(0.1)
+    assert ts.sample_count() == n      # thread is really stopped
+    assert not ts.enabled()
+
+
+def test_dump_json_safe():
+    ts.enable(cadence_s=0.0)
+    with metrics.enabled_scope(True):
+        metrics.gauge("pulse.t.g").set(3.0)
+    ts.sample(now=5.0, force=True)
+    d = ts.dump()
+    assert d["pulse.t.g"] == [[5.0, 3.0]]
+    import json
+    json.dumps(d)                      # round-trips
+
+
+def test_reenable_with_new_capacity_resizes_existing_rings():
+    ts.enable(cadence_s=0.0, capacity=4)
+    with metrics.enabled_scope(True):
+        g = metrics.gauge("pulse.t.g")
+        for i in range(6):
+            g.set(i)
+            ts.sample(now=float(i), force=True)
+    assert len(ts.series("pulse.t.g")) == 4     # old cap evicted
+    ts.enable(cadence_s=0.0, capacity=8)        # re-arm, bigger window
+    assert len(ts.series("pulse.t.g")) == 4     # newest points kept
+    with metrics.enabled_scope(True):
+        for i in range(6, 12):
+            g.set(i)
+            ts.sample(now=float(i), force=True)
+    pts = ts.series("pulse.t.g")
+    assert len(pts) == 8                        # new capacity applies
+    assert [p[1] for p in pts] == [float(i) for i in range(4, 12)]

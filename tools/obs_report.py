@@ -44,6 +44,15 @@ Modes:
               ~1.0, dominant named) and the serving breach verdict —
               the zero-to-request-anatomy receipt. Shapes env-tunable
               (PD_SRV_REQUESTS/REPLICAS/RATE/HIDDEN/LAYERS).
+  --pulse     fleet-pulse receipt (the live-telemetry acceptance
+              surface): arm the time-series sampler + the localhost
+              pulse server over a RUNNING ServingFleet leg, scrape
+              /metrics MID-RUN (must parse as valid Prometheus text),
+              prove post-run scrape parity (the HTTP body is byte-
+              identical to to_prometheus(metrics.snapshot()) modulo
+              the scrape's own odometer), check /healthz + /series
+              ring contents, and render the committed perf ledger's
+              cross-run trend (≥5 rounds). Shapes via PD_SRV_*.
   default     aggregate + export whatever the current process's
               registry holds (for embedding in training scripts).
 
@@ -479,6 +488,183 @@ def run_serving(args):
     return 0 if summary["ok"] else 1
 
 
+def run_pulse(args):
+    """Fleet-pulse receipt: arm the time-series sampler and the live
+    localhost /metrics endpoint over a RUNNING ServingFleet leg, then
+    self-check the acceptance surface — a mid-run HTTP scrape parses
+    as valid Prometheus text, the post-run scrape is BYTE-IDENTICAL to
+    ``to_prometheus(metrics.snapshot())`` (one renderer: the pull and
+    the file export cannot drift), /healthz answers ok, /series
+    returns ring contents for a serving gauge, and the committed perf
+    ledger renders a multi-round trend."""
+    global jax, np
+    if jax is None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu import jax_compat  # noqa: F401 (shims first)
+        import jax as _jax
+        import numpy as _np
+        jax, np = _jax, _np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import (exporters, metrics,
+                                          pulse_server, timeseries)
+    from paddle_tpu.serving import (FleetConfig, ServingConfig,
+                                    ServingFleet)
+    from paddle_tpu.serving.loadgen import replay_fleet, synthetic_trace
+
+    n_req = int(os.environ.get("PD_SRV_REQUESTS", 8))
+    replicas = int(os.environ.get("PD_SRV_REPLICAS", 2))
+    rate = float(os.environ.get("PD_SRV_RATE", 300.0))
+    hidden = int(os.environ.get("PD_SRV_HIDDEN", 32))
+    layers = int(os.environ.get("PD_SRV_LAYERS", 2))
+
+    metrics.enable()
+    timeseries.reset()
+    # tick-driven cadence: the fleet samples at every _publish, the
+    # throttle keeps it at ~20 Hz
+    timeseries.enable(cadence_s=0.05, thread=False)
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=hidden, num_layers=layers,
+        num_heads=4, max_seq_len=64, dropout=0.0,
+        use_flash_attention=False))
+    model.eval()
+    cfg = ServingConfig(max_slots=4, max_admit=2, block_size=4,
+                        n_blocks=48, prefill_buckets=(24,),
+                        max_total_tokens=24, decode_chunk=2,
+                        dtype=None)
+    fleet = ServingFleet(model, cfg, fleet=FleetConfig(
+        replicas=replicas, min_replicas=1, max_replicas=replicas,
+        autoscale=False))
+    trace = synthetic_trace(
+        n_req, vocab_size=97, seed=0, rate_rps=rate,
+        prompt_len_choices=(2, 4, 6, 9), new_token_choices=(3, 4, 6))
+
+    srv = pulse_server.PulseServer(port=0).start()
+    mid_scrapes = []
+
+    # non-200 must land in the receipt's problems list, never a
+    # traceback (urllib RAISES on 4xx/5xx — a stalled-verdict 503 or
+    # an unsampled-series 404 is a finding, not a crash)
+    def get(path: str):
+        return get_status(srv, path)
+
+    def on_tick(tick, _fleet):
+        # the LIVE half of the receipt: scrape while the leg runs. A
+        # malformed body is a FINDING (lines=-1 fails the self-check
+        # below), never a crash that eats the receipt
+        if tick in (3, 9):
+            code, body = get("/metrics")
+            try:
+                lines = (exporters.validate_exposition(body)
+                         if code == 200 else -1)
+            except ValueError:
+                lines = -1
+            mid_scrapes.append((tick, code, lines))
+
+    problems = []
+    try:
+        stats, _finished, _shed = replay_fleet(fleet, trace,
+                                               on_tick=on_tick)
+        timeseries.sample(force=True)   # final post-drain point
+
+        # scrape-vs-export parity: the run is drained, nothing
+        # mutates the registry between the pull and the snapshot
+        _code, scrape_body = get("/metrics")
+        local_body = exporters.to_prometheus(metrics.snapshot())
+        # the scrape itself bumped pulse.scrapes_total — compare
+        # modulo that one self-counting line
+        drop = lambda t: "\n".join(
+            l for l in t.splitlines()
+            if "pulse_scrapes_total" not in l)
+        parity = drop(scrape_body) == drop(local_body)
+        scrape_lines = exporters.validate_exposition(scrape_body)
+
+        hcode, hbody = get("/healthz")
+        health = json.loads(hbody)
+        scode, sbody = get("/snapshot")
+        snap_doc = json.loads(sbody) if scode == 200 else {}
+
+        series_key = "serving.fleet.queue_depth"
+        qcode, qbody = get(f"/series?key={series_key}&window=600")
+        series_doc = json.loads(qbody) if qcode == 200 else {}
+        n_points = len(series_doc.get("points", []))
+        bad_code, _ = get(f"/series?key=no.such.key")
+    finally:
+        srv.stop()
+        timeseries.disable()
+        metrics.disable()
+
+    # trend leg: the committed cross-run ledger must render history
+    ledger_path = os.environ.get(
+        "PD_PERF_LEDGER",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "perf_ledger.jsonl"))
+    from paddle_tpu.analysis import perf_ledger as pl
+    records = pl.load_ledger(ledger_path)
+    groups = pl.trend(records)
+    trend_rounds = max((len(g["runs"]) for g in groups.values()),
+                      default=0)
+
+    summary = {
+        "ok": True,
+        "requests": stats.get("requests", 0),
+        "mid_run_scrapes": [{"tick": t, "status": c, "lines": n}
+                            for t, c, n in mid_scrapes],
+        "scrape_parity": parity,
+        "scrape_lines": scrape_lines,
+        "healthz": {"status": hcode,
+                    "verdict": health.get("verdict")},
+        "snapshot_metrics": len(snap_doc.get("metrics", {})),
+        "series_key": series_key,
+        "series_points": n_points,
+        "unknown_series_status": bad_code,
+        "pulse_samples": (health.get("pulse") or {}).get("samples"),
+        "ledger_records": len(records),
+        "trend_rounds": trend_rounds,
+    }
+    if stats.get("requests", 0) != n_req:
+        problems.append(
+            f"finished {stats.get('requests', 0)}/{n_req} requests")
+    if not mid_scrapes:
+        problems.append("no mid-run scrape happened (leg too short?)")
+    if any(c != 200 or n <= 0 for _, c, n in mid_scrapes):
+        problems.append(f"mid-run scrape failed: {mid_scrapes}")
+    if not parity:
+        problems.append("/metrics body != to_prometheus(snapshot()) — "
+                        "the one-renderer contract broke")
+    if hcode != 200 or health.get("verdict") != "ok":
+        problems.append(f"healthz {hcode}: {health.get('verdict')}")
+    if not (health.get("pulse") or {}).get("samples"):
+        problems.append("sampler recorded zero samples during the leg")
+    if n_points < 2:
+        problems.append(f"series {series_key}: {n_points} point(s) — "
+                        "the per-tick sampling is not reaching rings")
+    if bad_code != 404:
+        problems.append(f"unknown series key returned {bad_code}")
+    if trend_rounds < 5:
+        problems.append(f"trend renders {trend_rounds} rounds (<5) "
+                        f"from {ledger_path}")
+    if problems:
+        summary["ok"] = False
+        summary["problems"] = problems
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+def get_status(srv, path: str):
+    """GET that tolerates non-200 (urllib raises on 404)."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(f"{srv.url}{path}",
+                                    timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
 def run_export(args):
     """Non-demo mode: export whatever the registry holds right now."""
     _jax_setup()
@@ -509,6 +695,7 @@ def main(argv=None):
     ap.add_argument("--anatomy", action="store_true")
     ap.add_argument("--memory", action="store_true")
     ap.add_argument("--serving", action="store_true")
+    ap.add_argument("--pulse", action="store_true")
     ap.add_argument("--force-recompile", action="store_true")
     ap.add_argument("--doctor", default=None, metavar="DIR",
                     help="diagnose flight-recorder dumps in DIR "
@@ -521,6 +708,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.doctor:
         return run_doctor(args)
+    if args.pulse:
+        return run_pulse(args)
     if args.serving:
         return run_serving(args)
     if args.memory:

@@ -680,6 +680,33 @@ def format_report(diag: dict) -> str:
     return "\n".join(lines)
 
 
+def _load_perf_ledger():
+    """analysis.perf_ledger WITHOUT importing the paddle_tpu package:
+    the doctor stays stdlib-only so triage works while jax is wedged
+    or absent, and perf_ledger/findings are themselves jax-free files
+    — load them by path into a shim package (the repo-relative
+    fallback idiom elastic.collect_diagnosis uses)."""
+    if "paddle_tpu.analysis.perf_ledger" in sys.modules:
+        return sys.modules["paddle_tpu.analysis.perf_ledger"]
+    import importlib.util
+    import types
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "analysis")
+    shim = "_pd_analysis_shim"
+    if f"{shim}.perf_ledger" in sys.modules:
+        return sys.modules[f"{shim}.perf_ledger"]
+    pkg = types.ModuleType(shim)
+    pkg.__path__ = [base]
+    sys.modules.setdefault(shim, pkg)
+    for name in ("findings", "perf_ledger"):   # dependency order
+        spec = importlib.util.spec_from_file_location(
+            f"{shim}.{name}", os.path.join(base, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[f"{shim}.perf_ledger"]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("dumps", nargs="*", help="flight-recorder JSONs")
@@ -696,7 +723,54 @@ def main(argv=None) -> int:
                          "serving_chaos_drill output with a "
                          "tail_attribution section) and print the "
                          "breach verdict")
+    ap.add_argument("--ledger", default=None, metavar="LEDGER.jsonl",
+                    help="perf-trend triage: render the cross-run "
+                         "trajectory from a perf ledger and gate the "
+                         "newest run per config against the committed "
+                         "baseline (exit 1 names metric + run + "
+                         "delta) — jax-free, runs on a triage host")
+    ap.add_argument("--ledger-baseline", default=None,
+                    help="baseline for --ledger (default "
+                         "tools/perf_baseline.json)")
     args = ap.parse_args(argv)
+    if args.ledger:
+        # one operator surface: the 3am "is this pod broken" tool also
+        # answers "has this config gotten slower across rounds"
+        pl = _load_perf_ledger()
+        records = pl.load_ledger(args.ledger)
+        if not records:
+            print(f"tpu_doctor: no ledger records in {args.ledger}",
+                  file=sys.stderr)
+            return 2
+        base_path = args.ledger_baseline or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "perf_baseline.json")
+        baseline = pl.load_ledger_baseline(base_path)
+        findings = []
+        for rec in pl.latest_by_fingerprint(records).values():
+            findings.extend(pl.check_record(rec, baseline))
+        groups = pl.trend(records)
+        doc = {
+            "records": len(records),
+            "fingerprints": len(groups),
+            "rounds": max((len(g["runs"])
+                           for g in groups.values()), default=0),
+            "regressions": [f.summary() for f in findings
+                            if f.severity == "error"],
+            "warnings": [f.summary() for f in findings
+                         if f.severity == "warning"],
+        }
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            print(pl.render_trend(records))
+            for f in findings:
+                print(f.summary())
+            print("perf_trend:", json.dumps(
+                {k: doc[k] for k in ("records", "fingerprints",
+                                     "rounds")}
+                | {"regressions": len(doc["regressions"])}))
+        return 1 if doc["regressions"] else 0
     if args.serving:
         with open(args.serving) as f:
             doc = json.load(f)
