@@ -90,6 +90,22 @@ def _gpt_params(model):
     }
 
 
+def _mm(x, bp, name):
+    """One block matmul through either the float weight
+    (``<name>_w``: the training/bf16 serving path, unchanged HLO) or
+    the serving int8 snapshot (a ``{"q8", "s"}`` leaf from
+    quant/int8_serving — per-channel PTQ codes + dequant scales riding
+    the params pytree as traced arguments). The branch is a trace-time
+    isinstance on the pytree structure, so the float path compiles to
+    exactly the ``x @ w`` it always was — the f32 greedy parity
+    contract is untouched."""
+    w = bp[name + "_w"]
+    if isinstance(w, dict):
+        from ..quant.int8_serving import int8_matmul
+        return int8_matmul(x, w["q8"], w["s"])
+    return x @ w
+
+
 def _attend(q, kc, vc, n_valid, scale):
     """q [B,N,1,hd] over cache kc/vc [B,N,T,hd], masked to n_valid
     (scalar, or [B] for ragged per-row prompt lengths)."""
@@ -118,7 +134,7 @@ def _step_hidden(params, eps, n_heads, x, caches, pos):
     for bp, (kc, vc) in zip(params["blocks"], caches):
         b = x.shape[0]
         xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-        qkv = (xn @ bp["qkv_w"] + bp["qkv_b"]).reshape(
+        qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
             b, 1, 3, n_heads, hd)
         q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])
         k = jnp.einsum("bsnh->bnsh", qkv[:, :, 1])
@@ -135,11 +151,11 @@ def _step_hidden(params, eps, n_heads, x, caches, pos):
                                                      axis=2)
         ctx = _attend(q, kc, vc, pos + 1, scale)
         ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
-        x = x + ctx @ bp["proj_w"] + bp["proj_b"]
+        x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
         ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
-        ff = jax.nn.gelu(ff @ bp["fc1_w"] + bp["fc1_b"],
+        ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
                          approximate=False)
-        x = x + ff @ bp["fc2_w"] + bp["fc2_b"]
+        x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
         new_caches.append((kc, vc))
     return x, new_caches
 
@@ -165,7 +181,7 @@ def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
     caches = []
     for bp in params["blocks"]:
         xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-        qkv = (xn @ bp["qkv_w"] + bp["qkv_b"]).reshape(
+        qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
             b, s, 3, n_heads, hd)
         q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])
         k = jnp.einsum("bsnh->bnsh", qkv[:, :, 1])
@@ -176,11 +192,11 @@ def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
             x.dtype)
         ctx = jnp.einsum("bnqk,bnkh->bnqh", p, v)
         ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, s, -1)
-        x = x + ctx @ bp["proj_w"] + bp["proj_b"]
+        x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
         ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
-        ff = jax.nn.gelu(ff @ bp["fc1_w"] + bp["fc1_b"],
+        ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
                          approximate=False)
-        x = x + ff @ bp["fc2_w"] + bp["fc2_b"]
+        x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
         kc = jnp.zeros((b, n_heads, total_len, hd), k.dtype)
         vc = jnp.zeros((b, n_heads, total_len, hd), v.dtype)
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k, 0, axis=2)

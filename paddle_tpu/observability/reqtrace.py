@@ -62,9 +62,13 @@ _enabled = False            # the one-bool hot-path gate
 _DEFAULT_CAPACITY = 8192
 
 # the disjoint latency components attribution decomposes into;
-# "other" is the closure (wall time no span claimed)
-COMPONENTS: Tuple[str, ...] = ("queue", "admission", "prefill",
-                               "decode", "requeue", "swap_flip")
+# "other" is the closure (wall time no span claimed). "draft" is the
+# speculative proposer's dispatch slice and "prefix_match" the radix
+# admission slice — named so slow_decode/queue attribution can't
+# silently absorb the raw-speed levers' own cost.
+COMPONENTS: Tuple[str, ...] = ("queue", "admission", "prefix_match",
+                               "prefill", "draft", "decode", "requeue",
+                               "swap_flip")
 _TERMINAL_MARKS = ("retire", "shed", "drop")
 
 
@@ -329,7 +333,9 @@ def explain_tail(evts: Optional[List[dict]] = None,
 _CNAME = {
     "queue": "thread_state_runnable",
     "admission": "thread_state_iowait",
+    "prefix_match": "rail_load",
     "prefill": "thread_state_running",
+    "draft": "rail_idle",
     "decode": "good",
     "requeue": "terrible",
     "swap_flip": "bad",

@@ -28,6 +28,11 @@ the WORKFLOW on top —
   the reference's stateful in-graph scale vars: a functional graph
   prefers recomputing max|x| (one reduction, fused by XLA) over
   threading mutable scale state through the program.
+- int8_serving: the TRUE-int8 decode path for the serving engine —
+  PTQ per-channel weight scales as pytree leaves (traced, never
+  baked), dynamic per-row activation quant, int8×int8→int32
+  dot_general, and the logits-drift accuracy receipt
+  (``ServingConfig(quant="int8")`` / ``QuantConfig(int8_compute=True)``).
 """
 from __future__ import annotations
 
@@ -55,7 +60,9 @@ __all__ = [
     "QuantizationFreezePass",
     "QuantedLinear", "QuantedConv2D", "FrozenQuantLinear",
     "FrozenQuantConv2D",
+    "int8_serving",
 ]
+from . import int8_serving  # noqa: E402  (jax-light: numpy + lazy jax)
 
 _DEFAULT_TYPES = (nn.Linear, nn.Conv2D)
 

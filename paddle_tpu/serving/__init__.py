@@ -10,14 +10,20 @@ beat dynamic shapes):
 
   paged_cache  fixed pool of [n_blocks, block_size, n_heads, hd] KV
                pages per layer + host block tables; eviction = a host
-               list splice
-  programs     TWO compiled programs (bucketed prefill, paged decode
-               step) with donated pools; steady state runs exactly
-               ladder-size executables, RecompileSentinel-pinned
+               list splice; page refcounts + a radix prefix index give
+               copy-on-write prompt sharing (prefix_sharing=True)
+  programs     THREE compiled programs (bucketed prefill, paged decode
+               step, and the mid-stream chunk forward that serves both
+               speculative verify and shared-prefix suffix prefill)
+               with donated pools; steady state runs exactly the
+               engine's expected_executables, RecompileSentinel-pinned
   scheduler    FIFO continuous batching: admit/retire at token
                boundaries, whole-lifetime page reservation
   engine       ServingEngine: bf16 decode default, f32 parity mode
-               bit-for-bit vs models/generation.py greedy
+               bit-for-bit vs models/generation.py greedy; raw-speed
+               levers — quant="int8" PTQ decode, speculative_k draft/
+               verify (accepted tokens bit-identical to greedy), and
+               radix/COW prefix page sharing
   loadgen      open-loop trace replay + SLO stats (tools/serving_bench)
   fleet        ServingFleet: the SLO-aware self-healing control loop —
                supervisor-driven autoscale, exact requeue of a dead
@@ -40,7 +46,8 @@ feeds `decide_scale(burn_alert=)`, and
 tpu_doctor.serving_breach_verdict names a breach's cause from the
 trace alone.
 """
-from .engine import ServingConfig, ServingEngine
+from .engine import ServingConfig, ServingEngine, \
+    build_serving_snapshot
 from .fleet import (FleetConfig, FleetRequest, PRIORITY_CLASSES,
                     Replica, ServingFleet, ServingSLO)
 from .paged_cache import PagedKVCache
@@ -50,4 +57,4 @@ from . import loadgen
 __all__ = ["ServingConfig", "ServingEngine", "PagedKVCache",
            "BucketLadder", "FifoScheduler", "Request", "loadgen",
            "ServingFleet", "ServingSLO", "FleetConfig", "FleetRequest",
-           "Replica", "PRIORITY_CLASSES"]
+           "Replica", "PRIORITY_CLASSES", "build_serving_snapshot"]

@@ -22,9 +22,25 @@ free pages, admitted/retired/evicted totals, TTFT + per-step
 histograms); ``serving_recompiles_total`` is always-on via the
 RecompileSentinel. ``serving.retired_total`` counts FINISHED requests;
 ``serving.evicted_total`` counts requests pulled off the engine for
-requeue (``evict_requests``) — the old conflation of the two survives
-one release as the labeled alias
-``serving.evicted_total{deprecated=retired_alias}``.
+requeue (``evict_requests`` / fleet requeue) — nothing else.
+
+Three raw-speed levers compose on top of the baseline loop, every one
+off by default and each receipted end to end (tools/serving_bench.py):
+
+- ``quant="int8"``: the build-time weight snapshot becomes per-channel
+  PTQ int8 codes + f32 scales (quant/int8_serving) and every block
+  matmul runs int8×int8→int32 on the MXU double-rate path; the f32
+  parity mode stays the accuracy reference.
+- ``speculative_k=k`` (+ a draft model): the draft proposes k greedy
+  tokens in ONE scan dispatch, the target scores anchor+k proposals in
+  ONE chunk dispatch, and the host keeps the longest agreeing prefix —
+  every accepted token is bit-identical to non-speculative greedy
+  (each emitted token IS a target argmax over a correct-by-induction
+  cache prefix), so speculation changes latency, never output.
+- ``prefix_sharing=True``: admission matches the longest radix-indexed
+  prompt prefix, points the block table at the shared pages
+  (refcounted, copy-on-write), and prefills ONLY the unshared suffix
+  through the same chunk program.
 
 The fleet surface (``serving/fleet.py``): ``swap_weights()`` flips
 the weight snapshot at a token boundary without draining or
@@ -49,11 +65,25 @@ from ..observability import metrics as _obs
 from ..observability import reqtrace as _rt
 from ..observability.sentinel import RecompileSentinel
 from .paged_cache import PagedKVCache
-from .programs import (jit_with_donated_pools, make_decode_fn,
-                       make_prefill_fn)
+from .programs import (jit_with_donated_pools, make_chunk_fn,
+                       make_decode_fn, make_prefill_fn)
 from .scheduler import BucketLadder, FifoScheduler, Request
 
-__all__ = ["ServingConfig", "ServingEngine"]
+__all__ = ["ServingConfig", "ServingEngine", "build_serving_snapshot"]
+
+
+def build_serving_snapshot(params, cfg) -> dict:
+    """Raw generation params -> this config's serving snapshot: the
+    float cast first, then (``quant="int8"``) the four block matmul
+    weights become ``{"q8", "s"}`` PTQ leaves. The ONE builder engine
+    build, ``swap_weights(cast=True)`` and the fleet's standby staging
+    all share — a snapshot built anywhere else risks a treedef
+    mismatch that would reject every hot swap."""
+    snap = _cast_params(params, cfg.dtype)
+    if cfg.quant == "int8":
+        from ..quant.int8_serving import quantize_params
+        snap = quantize_params(snap, cfg.quant_config)
+    return snap
 
 
 @dataclass
@@ -75,8 +105,34 @@ class ServingConfig:
     top_p: Optional[float] = None
     eos_token_id: Optional[int] = None # default; per-request override
     seed: int = 0
+    # -- raw-speed levers (all off by default) -------------------------------
+    quant: Optional[object] = None     # "int8" | QuantConfig(int8_compute)
+    speculative_k: int = 0             # draft proposals per boundary
+    prefix_sharing: bool = False       # radix/COW shared prompt pages
 
     def __post_init__(self):
+        self.quant_config = None
+        if self.quant is not None and not isinstance(self.quant, str):
+            # QuantConfig threading: the quant module's config object
+            # opts into serving int8 via int8_compute
+            if not getattr(self.quant, "int8_compute", False):
+                raise ValueError(
+                    "serving quant takes a QuantConfig with "
+                    "int8_compute=True (or the string 'int8')")
+            self.quant_config = self.quant
+            self.quant = "int8"
+        if self.quant not in (None, "int8"):
+            raise ValueError(
+                f"quant={self.quant!r}: only 'int8' (bf16/f32 are the "
+                "dtype= cast, not a quant mode)")
+        if self.speculative_k < 0:
+            raise ValueError(
+                f"speculative_k={self.speculative_k} must be >= 0")
+        if self.speculative_k and self.temperature != 0.0:
+            raise ValueError(
+                "speculative decoding requires greedy (temperature=0):"
+                " acceptance keeps the longest prefix agreeing with "
+                "the target argmax")
         if self.decode_buckets is None:
             self.decode_buckets = (self.max_slots,)
         self.prefill_buckets = tuple(sorted(self.prefill_buckets))
@@ -101,9 +157,14 @@ class ServingConfig:
 
 
 class ServingEngine:
-    """Continuous-batching serving over one GPTForCausalLM."""
+    """Continuous-batching serving over one GPTForCausalLM.
 
-    def __init__(self, model, config: Optional[ServingConfig] = None):
+    ``draft_model`` (required iff ``config.speculative_k >= 1``): the
+    small proposer — any GPTForCausalLM over the same vocab; its own
+    paged cache tracks the target position-for-position."""
+
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 draft_model=None):
         import jax
         self.config = cfg = config or ServingConfig()
         mcfg = model.gpt.config
@@ -111,10 +172,11 @@ class ServingEngine:
             raise ValueError(
                 f"max_total_tokens={cfg.max_total_tokens} exceeds the "
                 f"model's max_seq_len={mcfg.max_seq_len}")
-        # weight snapshot, cast ONCE at engine build; new weights land
-        # only through swap_weights() at a token boundary (same
-        # treedef/avals — the ladder never recompiles)
-        self.params = _cast_params(_gpt_params(model), cfg.dtype)
+        # weight snapshot, cast (and PTQ-quantized under quant="int8")
+        # ONCE at engine build; new weights land only through
+        # swap_weights() at a token boundary (same treedef/avals — the
+        # ladder never recompiles)
+        self.params = build_serving_snapshot(_gpt_params(model), cfg)
         self.n_heads = int(mcfg.num_heads)
         self.eps = float(mcfg.layer_norm_eps)
         self.vocab_size = int(mcfg.vocab_size)
@@ -123,7 +185,8 @@ class ServingEngine:
         self.cache = PagedKVCache(
             n_layers=int(mcfg.num_layers), n_blocks=cfg.n_blocks,
             block_size=cfg.block_size, n_heads=self.n_heads,
-            head_dim=hd, dtype=pool_dtype)
+            head_dim=hd, dtype=pool_dtype,
+            prefix_sharing=cfg.prefix_sharing)
         self.ladder = BucketLadder(cfg.prefill_buckets,
                                    cfg.decode_buckets, cfg.block_size)
         self.sched = FifoScheduler(cfg.max_slots, cfg.max_admit)
@@ -135,6 +198,52 @@ class ServingEngine:
             n_steps=int(cfg.decode_chunk)))
         self._prefill = jit_with_donated_pools(make_prefill_fn(
             self.eps, self.n_heads, cfg.block_size, *sampling))
+        # the chunk program serves BOTH new levers (speculative verify
+        # at [slots, k+1], shared-prefix suffix prefill at [admit,
+        # bucket]) — one jit, shape-bucketed executables
+        self._spec_k = int(cfg.speculative_k)
+        self._chunk = None
+        if cfg.prefix_sharing or self._spec_k:
+            self._chunk = jit_with_donated_pools(make_chunk_fn(
+                self.eps, self.n_heads, cfg.block_size, *sampling))
+        self.draft_cache = None
+        self.draft_params = None
+        self._draft_prefill = self._draft_decode = None
+        if self._spec_k:
+            if draft_model is None:
+                raise ValueError(
+                    "speculative_k >= 1 needs a draft_model — the "
+                    "draft proposes, the target verifies")
+            dcfg = draft_model.gpt.config
+            if int(dcfg.vocab_size) != self.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{mcfg.vocab_size}: proposals would not be "
+                    "comparable token ids")
+            if cfg.max_total_tokens > dcfg.max_seq_len:
+                raise ValueError(
+                    f"max_total_tokens={cfg.max_total_tokens} exceeds "
+                    f"the draft's max_seq_len={dcfg.max_seq_len}")
+            self._draft_heads = int(dcfg.num_heads)
+            self._draft_eps = float(dcfg.layer_norm_eps)
+            # draft keeps the plain float cast (no int8): it is small
+            # by construction, and its only job is proposal quality
+            self.draft_params = _cast_params(_gpt_params(draft_model),
+                                             cfg.dtype)
+            self.draft_cache = PagedKVCache(
+                n_layers=int(dcfg.num_layers), n_blocks=cfg.n_blocks,
+                block_size=cfg.block_size, n_heads=self._draft_heads,
+                head_dim=int(dcfg.hidden_size) // self._draft_heads,
+                dtype=pool_dtype)
+            greedy = (0.0, None, None)   # proposals are always argmax
+            self._draft_prefill = jit_with_donated_pools(
+                make_prefill_fn(self._draft_eps, self._draft_heads,
+                                cfg.block_size, *greedy))
+            # ONE scan dispatch proposes all k tokens
+            self._draft_decode = jit_with_donated_pools(
+                make_decode_fn(self._draft_eps, self._draft_heads,
+                               cfg.block_size, *greedy,
+                               n_steps=self._spec_k))
         self.sentinel = RecompileSentinel("serving")
         self._key = jax.random.key(int(cfg.seed))
         self._step_no = 0
@@ -147,12 +256,40 @@ class ServingEngine:
 
     # -- compile-count contract ----------------------------------------------
     def executable_count(self) -> int:
-        return int(self._prefill._cache_size()
-                   + self._decode._cache_size())
+        n = self._prefill._cache_size() + self._decode._cache_size()
+        if self._chunk is not None:
+            n += self._chunk._cache_size()
+        if self._draft_prefill is not None:
+            n += (self._draft_prefill._cache_size()
+                  + self._draft_decode._cache_size())
+        n += self.cache.copy_executables()
+        return int(n)
 
     @property
     def expected_executables(self) -> int:
-        return self.ladder.size
+        """The steady-state compile budget the sentinel pins. Feature
+        legs swap programs rather than stack them (sharing replaces
+        the dense prefill with chunk suffix prefills; speculation
+        replaces the plain decode with draft-propose + chunk-verify),
+        and chunk executables dedupe by SHAPE — a verify width that
+        collides with a suffix bucket is one executable."""
+        cfg = self.config
+        n = 0
+        chunk_shapes = set()
+        if cfg.prefix_sharing:
+            for s in self.ladder.prefill:
+                chunk_shapes.add((self.sched.max_admit, s))
+            n += 1                       # the COW page-copy program
+        else:
+            n += len(self.ladder.prefill)
+        if self._spec_k:
+            for b in self.ladder.decode:
+                chunk_shapes.add((b, self._spec_k + 1))
+            n += len(self.ladder.prefill)   # draft prompt prefill
+            n += len(self.ladder.decode)    # draft k-proposal scan
+        else:
+            n += len(self.ladder.decode)
+        return n + len(chunk_shapes)
 
     # -- request intake ------------------------------------------------------
     def submit(self, ids, max_new_tokens: int, rid=None,
@@ -199,23 +336,57 @@ class ServingEngine:
         its compiles at startup; steady state then runs a fixed
         executable set and the sentinel flags any growth."""
         import jax
-        W = self.config.table_width
+        cfg = self.config
+        W = cfg.table_width
+        a = self.sched.max_admit
         key = jax.random.key(0)
         # prime the per-boundary key derivation as well: the first
         # step()'s fold_in chain otherwise traces+compiles mid-traffic
         # — ~100 ms the request traces pin on the first admit batch
         jax.random.fold_in(jax.random.fold_in(self._key, 1), 0)
-        for s in self.ladder.prefill:
-            a = self.sched.max_admit
-            self.cache.pools, _ = self._prefill(
-                self.cache.pools, np.zeros((a, W), np.int32),
-                np.zeros((a, s), np.int32), np.ones((a,), np.int32),
-                self.params, key)
-        for b in self.ladder.decode:
-            self.cache.pools, _ = self._decode(
-                self.cache.pools, np.zeros((b, W), np.int32),
-                np.zeros((b,), np.int32), np.zeros((b,), np.int32),
-                self.params, key)
+        if cfg.prefix_sharing:
+            # sharing serves EVERY admission through the chunk program
+            # (starts=0 on a full miss IS a dense prefill, junk routed
+            # to scratch instead of page-scattered); plus the COW copy
+            for s in self.ladder.prefill:
+                self.cache.pools, _, _ = self._chunk(
+                    self.cache.pools, np.zeros((a, W), np.int32),
+                    np.zeros((a, s), np.int32),
+                    np.zeros((a,), np.int32), np.ones((a,), np.int32),
+                    self.params, key)
+            self.cache.warm_copy()
+        else:
+            for s in self.ladder.prefill:
+                self.cache.pools, _ = self._prefill(
+                    self.cache.pools, np.zeros((a, W), np.int32),
+                    np.zeros((a, s), np.int32),
+                    np.ones((a,), np.int32), self.params, key)
+        if self._spec_k:
+            # speculation replaces the plain decode with the draft's
+            # prefill + k-proposal scan and the target's [b, k+1]
+            # chunk verify, per decode bucket
+            for b in self.ladder.decode:
+                self.cache.pools, _, _ = self._chunk(
+                    self.cache.pools, np.zeros((b, W), np.int32),
+                    np.zeros((b, self._spec_k + 1), np.int32),
+                    np.zeros((b,), np.int32), np.ones((b,), np.int32),
+                    self.params, key)
+            for s in self.ladder.prefill:
+                self.draft_cache.pools, _ = self._draft_prefill(
+                    self.draft_cache.pools, np.zeros((a, W), np.int32),
+                    np.zeros((a, s), np.int32),
+                    np.ones((a,), np.int32), self.draft_params, key)
+            for b in self.ladder.decode:
+                self.draft_cache.pools, _ = self._draft_decode(
+                    self.draft_cache.pools, np.zeros((b, W), np.int32),
+                    np.zeros((b,), np.int32), np.zeros((b,), np.int32),
+                    self.draft_params, key)
+        else:
+            for b in self.ladder.decode:
+                self.cache.pools, _ = self._decode(
+                    self.cache.pools, np.zeros((b, W), np.int32),
+                    np.zeros((b,), np.int32), np.zeros((b,), np.int32),
+                    self.params, key)
         self.sentinel.observe(self.executable_count(),
                               expected=self.expected_executables,
                               signature=self._shape_signature(None, None))
@@ -232,6 +403,8 @@ class ServingEngine:
         finished = self.sched.retire_finished()
         for r in finished:
             self.cache.free(r.rid)
+            if self.draft_cache is not None:
+                self.draft_cache.free(r.rid)
             r.done_ts = time.perf_counter()
         if _rt._enabled:
             for r in finished:
@@ -240,14 +413,10 @@ class ServingEngine:
                          replica=self.trace_replica)
         if rec and finished:
             _obs.counter("serving.retired_total").add(len(finished))
-            # DEPRECATED alias (kept one release): serving.evicted_total
-            # used to (mis)count retirements. The labeled series keeps
-            # old dashboards readable while the PLAIN name now counts
-            # only real evictions (evict_requests / fleet requeue).
-            _obs.counter("serving.evicted_total",
-                         deprecated="retired_alias").add(len(finished))
 
-        batch = self.sched.take_admissible(self.cache)
+        batch = self.sched.take_admissible(
+            self.cache,
+            () if self.draft_cache is None else (self.draft_cache,))
         self._step_no += 1
         # one fresh key per boundary, then DISTINCT subkeys for the
         # two programs: prefill's _pick consumes its key directly while
@@ -257,33 +426,91 @@ class ServingEngine:
         pf_key = jax.random.fold_in(key, 0)
         dec_key = jax.random.fold_in(key, 1)
         prefill_sig = decode_sig = None
+        chunk_sigs: List[Tuple[int, int]] = []
         if batch:
             t0 = time.perf_counter()
             a = self.sched.max_admit
-            s = self.ladder.pick_prefill(
-                max(r.prompt_len for r in batch))
-            ids = np.zeros((a, s), np.int32)
-            lens = np.ones((a,), np.int32)
             rids: List[object] = []
-            for i, r in enumerate(batch):
-                self.cache.alloc(r.rid, r.total_tokens)
-                ids[i, :r.prompt_len] = r.ids
-                lens[i] = r.prompt_len
-                rids.append(r.rid)
+            if cfg.prefix_sharing:
+                # radix admission: longest indexed prompt prefix rides
+                # shared pages (refcount++), fresh pages cover the rest
+                for r in batch:
+                    _, r.shared_tokens = self.cache.alloc_shared(
+                        r.rid, r.total_tokens, r.ids)
+                    rids.append(r.rid)
+            else:
+                for r in batch:
+                    self.cache.alloc(r.rid, r.total_tokens)
+                    rids.append(r.rid)
+            t_match = time.perf_counter()
             rids += [None] * (a - len(batch))
-            tables = self.cache.table_array(rids, cfg.table_width)
-            try:
-                self.cache.pools, tok = self._prefill(
-                    self.cache.pools, tables, ids, lens, self.params,
-                    pf_key)
-            except Exception as e:
-                # OOM sentry (zero cost on the success path): a
-                # RESOURCE_EXHAUSTED here leaves the breadcrumb +
-                # post-mortem receipt before the engine dies
-                _mem.handle_dispatch_oom(
-                    "serving_prefill", e, bucket=s, width=a,
-                    replica=self.trace_replica, step=self._step_no)
-                raise
+            if self.draft_cache is not None:
+                # the draft mirrors the target position-for-position;
+                # its cache never shares, so it prefills the FULL
+                # prompt regardless of the target's prefix hits
+                for r in batch:
+                    self.draft_cache.alloc(r.rid, r.total_tokens)
+                sd = self.ladder.pick_prefill(
+                    max(r.prompt_len for r in batch))
+                d_ids = np.zeros((a, sd), np.int32)
+                d_lens = np.ones((a,), np.int32)
+                for i, r in enumerate(batch):
+                    d_ids[i, :r.prompt_len] = r.ids
+                    d_lens[i] = r.prompt_len
+                self.draft_cache.pools, _ = self._draft_prefill(
+                    self.draft_cache.pools,
+                    self.draft_cache.table_array(rids, cfg.table_width),
+                    d_ids, d_lens, self.draft_params, pf_key)
+            if cfg.prefix_sharing:
+                # suffix prefill through the chunk program: each row
+                # forwards ONLY its unshared tail, starting at its
+                # shared-token offset and attending the shared pages
+                # through the same table gather decode uses (a full
+                # miss is starts=0 — a dense prefill with junk routed
+                # to scratch instead of page-scattered)
+                s = self.ladder.pick_prefill(
+                    max(r.prompt_len - r.shared_tokens for r in batch))
+                ids = np.zeros((a, s), np.int32)
+                lens = np.ones((a,), np.int32)
+                starts = np.zeros((a,), np.int32)
+                for i, r in enumerate(batch):
+                    sfx = r.ids[r.shared_tokens:]
+                    ids[i, :sfx.size] = sfx
+                    lens[i] = sfx.size
+                    starts[i] = r.shared_tokens
+                tables = self.cache.table_array(rids, cfg.table_width)
+                try:
+                    self.cache.pools, _, tok = self._chunk(
+                        self.cache.pools, tables, ids, starts, lens,
+                        self.params, pf_key)
+                except Exception as e:
+                    _mem.handle_dispatch_oom(
+                        "serving_prefill", e, bucket=s, width=a,
+                        replica=self.trace_replica, step=self._step_no)
+                    raise
+                chunk_sigs.append((a, s))
+            else:
+                s = self.ladder.pick_prefill(
+                    max(r.prompt_len for r in batch))
+                ids = np.zeros((a, s), np.int32)
+                lens = np.ones((a,), np.int32)
+                for i, r in enumerate(batch):
+                    ids[i, :r.prompt_len] = r.ids
+                    lens[i] = r.prompt_len
+                tables = self.cache.table_array(rids, cfg.table_width)
+                try:
+                    self.cache.pools, tok = self._prefill(
+                        self.cache.pools, tables, ids, lens,
+                        self.params, pf_key)
+                except Exception as e:
+                    # OOM sentry (zero cost on the success path): a
+                    # RESOURCE_EXHAUSTED here leaves the breadcrumb +
+                    # post-mortem receipt before the engine dies
+                    _mem.handle_dispatch_oom(
+                        "serving_prefill", e, bucket=s, width=a,
+                        replica=self.trace_replica, step=self._step_no)
+                    raise
+                prefill_sig = (a, s)
             tok = np.asarray(tok)
             now = time.perf_counter()
             for i, r in enumerate(batch):
@@ -291,13 +518,27 @@ class ServingEngine:
                 r.first_token_ts = now
                 r.pos = r.prompt_len
                 r.accept(int(tok[i]))
-            prefill_sig = (a, s)
+            if cfg.prefix_sharing:
+                # adopt this prompt's full-chunk pages into the radix
+                # index AFTER the prefill landed their K/V — the NEXT
+                # request with this prefix shares them
+                for r in batch:
+                    self.cache.register_prefix(r.rid, r.ids)
             if _rt._enabled:
                 tick = (self._step_no if self.trace_tick is None
                         else self.trace_tick)
                 for r in batch:
-                    _rt.record_span(r.rid, "prefill", t0, now,
-                                    bucket=s, width=a,
+                    if r.shared_tokens:
+                        # the radix-match + shared-alloc slice of
+                        # admission, so tail attribution sees sharing
+                        # cost (and benefit) by name
+                        _rt.record_span(
+                            r.rid, "prefix_match", t0, t_match,
+                            shared_tokens=r.shared_tokens,
+                            replica=self.trace_replica, tick=tick)
+                    _rt.record_span(r.rid, "prefill",
+                                    t_match if r.shared_tokens else t0,
+                                    now, bucket=s, width=a,
                                     replica=self.trace_replica,
                                     tick=tick)
             if rec:
@@ -308,9 +549,112 @@ class ServingEngine:
                     if r.arrival is not None:
                         _obs.histogram("serving.ttft_ms").observe(
                             (now - r.arrival) * 1e3)
+                if cfg.prefix_sharing:
+                    hits = sum(1 for r in batch if r.shared_tokens)
+                    if hits:
+                        _obs.counter("serving.prefix_hits_total").add(
+                            hits)
+                        _obs.counter(
+                            "serving.prefix_shared_pages_total").add(
+                            sum(r.shared_tokens // cfg.block_size
+                                for r in batch))
 
         active = self.sched.active()
-        if active:
+        if active and self._spec_k:
+            # speculative boundary: draft proposes k tokens in one
+            # scan dispatch, target scores anchor + proposals in one
+            # chunk dispatch, host keeps the longest agreeing prefix.
+            # Every emitted token is a TARGET argmax over a cache
+            # prefix that held only accepted tokens — bit-identical to
+            # sequential greedy by induction; speculation can only
+            # change how many such tokens land per boundary.
+            k = self._spec_k
+            t0 = time.perf_counter()
+            b = self.ladder.pick_decode(len(active))
+            toks = np.zeros((b,), np.int32)
+            positions = np.zeros((b,), np.int32)
+            rids = []
+            for i, r in enumerate(active):
+                toks[i] = r.out[-1]
+                positions[i] = r.pos
+                rids.append(r.rid)
+            rids += [None] * (b - len(active))
+            try:
+                self.draft_cache.pools, props = self._draft_decode(
+                    self.draft_cache.pools,
+                    self.draft_cache.table_array(rids,
+                                                 cfg.table_width),
+                    toks, positions, self.draft_params, dec_key)
+            except Exception as e:
+                _mem.handle_dispatch_oom(
+                    "serving_draft", e, bucket=b,
+                    replica=self.trace_replica, step=self._step_no)
+                raise
+            props = np.asarray(props)             # [k, B]
+            t_draft = time.perf_counter()
+            ids = np.zeros((b, k + 1), np.int32)
+            lens = np.ones((b,), np.int32)
+            for i, r in enumerate(active):
+                # emission cap: proposals past the budget are junk the
+                # chunk program routes to scratch (lens masks them)
+                cap = min(k, r.max_new_tokens - len(r.out))
+                ids[i, 0] = r.out[-1]
+                ids[i, 1:] = props[:, i]
+                lens[i] = cap + 1
+            tables = self.cache.table_array(rids, cfg.table_width)
+            try:
+                self.cache.pools, all_tok, _ = self._chunk(
+                    self.cache.pools, tables, ids, positions, lens,
+                    self.params, dec_key)
+            except Exception as e:
+                _mem.handle_dispatch_oom(
+                    "serving_verify", e, bucket=b,
+                    replica=self.trace_replica, step=self._step_no)
+                raise
+            all_tok = np.asarray(all_tok)         # [B, k+1]
+            proposed = accepted = 0
+            for i, r in enumerate(active):
+                cap = int(lens[i]) - 1
+                proposed += cap
+                n = 0
+                while n < cap:
+                    tokv = int(all_tok[i, n])     # target argmax
+                    r.pos += 1
+                    r.accept(tokv)
+                    n += 1
+                    if r.done or n >= cap:
+                        break
+                    if int(props[n - 1, i]) != tokv:
+                        break   # draft diverged: later scores are
+                        #         junk-conditioned, stop here
+                accepted += n
+            chunk_sigs.append((b, k + 1))
+            decode_sig = (b,)
+            if _rt._enabled:
+                t1 = time.perf_counter()
+                tick = (self._step_no if self.trace_tick is None
+                        else self.trace_tick)
+                for r in active:
+                    _rt.record_span(r.rid, "draft", t0, t_draft,
+                                    bucket=b, k=k,
+                                    replica=self.trace_replica,
+                                    tick=tick)
+                    _rt.record_span(r.rid, "decode", t_draft, t1,
+                                    bucket=b, chunk=k + 1,
+                                    replica=self.trace_replica,
+                                    tick=tick)
+            if rec:
+                dt = (time.perf_counter() - t0) * 1e3
+                _obs.histogram("serving.decode_step_ms").observe(dt)
+                _obs.counter("serving.tokens_total").add(accepted)
+                _obs.counter("serving.spec_proposed_total").add(
+                    proposed)
+                _obs.counter("serving.spec_accepted_total").add(
+                    accepted)
+                if proposed:
+                    _obs.gauge("serving.spec_acceptance_rate").set(
+                        accepted / proposed)
+        elif active:
             t0 = time.perf_counter()
             b = self.ladder.pick_decode(len(active))
             toks = np.zeros((b,), np.int32)
@@ -361,13 +705,17 @@ class ServingEngine:
                 self.executable_count(),
                 expected=self.expected_executables,
                 signature=self._shape_signature(prefill_sig,
-                                                decode_sig))
+                                                decode_sig,
+                                                chunk_sigs))
         if rec:
             _obs.gauge("serving.queue_depth").set(self.sched.queue_depth)
             _obs.gauge("serving.active_slots").set(
                 len(self.sched.active()))
             _obs.gauge("serving.pages_free").set(self.cache.n_free)
             _obs.gauge("serving.pages_live").set(self.cache.n_live)
+            if cfg.prefix_sharing:
+                _obs.gauge("serving.pages_shared").set(
+                    self.cache.n_shared)
         return finished
 
     # -- fleet surface: eviction + hot weight swap ---------------------------
@@ -387,6 +735,8 @@ class ServingEngine:
         running = list(self.sched.running.values())
         for r in running:
             self.cache.free(r.rid)
+            if self.draft_cache is not None:
+                self.draft_cache.free(r.rid)
         self.sched.running.clear()
         queued = list(self.sched.queue)
         self.sched.queue.clear()
@@ -410,11 +760,14 @@ class ServingEngine:
         signature: the compiled ladder stays byte-for-byte valid and
         the RecompileSentinel stays pinned (zero recompiles by
         construction). ``cast=True`` runs the standby through the
-        engine's serving cast first (pass ``cast=False`` for a pool
-        already cast once and shared across replicas)."""
+        engine's FULL snapshot build — serving cast plus the int8 PTQ
+        under quant="int8", so the treedef matches — (pass
+        ``cast=False`` for a snapshot already built once via
+        build_serving_snapshot and shared across replicas)."""
         import jax
         import jax.numpy as jnp
-        new = _cast_params(params, self.config.dtype) if cast else params
+        new = (build_serving_snapshot(params, self.config) if cast
+               else params)
         old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
         new_leaves, new_def = jax.tree_util.tree_flatten(new)
         if old_def != new_def:
@@ -444,7 +797,7 @@ class ServingEngine:
             _obs.counter("serving.weight_swaps_total").add(1)
         return self
 
-    def _shape_signature(self, prefill_sig, decode_sig):
+    def _shape_signature(self, prefill_sig, decode_sig, chunk_sigs=()):
         """Sentinel signature: the bucket shapes this step dispatched
         (a violation's diff then names the drifting bucket)."""
         sig = []
@@ -452,6 +805,8 @@ class ServingEngine:
             sig.append(("prefill", tuple(prefill_sig), "bucket"))
         if decode_sig is not None:
             sig.append(("decode", tuple(decode_sig), "bucket"))
+        for cs in chunk_sigs:
+            sig.append(("chunk", tuple(cs), "bucket"))
         return tuple(sig)
 
     # -- convenience drains --------------------------------------------------

@@ -78,7 +78,8 @@ import numpy as np
 
 from ..distributed import chaos as _chaos
 from ..distributed import elastic as _elastic
-from ..models.generation import _cast_params, _gpt_params
+from ..models.generation import _gpt_params
+from .engine import build_serving_snapshot
 from ..observability import fleet as _obs_fleet
 from ..observability import flight_recorder as _fr
 from ..observability import memory as _mem
@@ -263,8 +264,10 @@ class ServingFleet:
 
     def __init__(self, model, config: Optional[ServingConfig] = None,
                  slo: Optional[ServingSLO] = None,
-                 fleet: Optional[FleetConfig] = None):
+                 fleet: Optional[FleetConfig] = None,
+                 draft_model=None):
         self._model = model
+        self._draft_model = draft_model
         self.config = cfg = config or ServingConfig()
         self.slo = slo or ServingSLO()
         self.fleet = fc = fleet or FleetConfig()
@@ -322,7 +325,8 @@ class ServingFleet:
 
     # -- spawn / weights ------------------------------------------------------
     def _spawn(self, slot: int, incarnation: int = 0) -> Replica:
-        eng = ServingEngine(self._model, self.config)
+        eng = ServingEngine(self._model, self.config,
+                            draft_model=self._draft_model)
         if self.fleet.warmup_on_spawn:
             eng.warmup()
         if self._standby is not None or self._standby_version:
@@ -343,7 +347,8 @@ class ServingFleet:
         # live replica survived the episode to read it from.
         if self._current_params is not None:
             return self._current_params
-        return _cast_params(_gpt_params(self._model), self.config.dtype)
+        return build_serving_snapshot(_gpt_params(self._model),
+                                      self.config)
 
     def swap_weights(self, source=None, checkpoint_path: Optional[str]
                      = None, verify: bool = True) -> bool:
@@ -365,7 +370,10 @@ class ServingFleet:
             # itself has no "params" key, so unwrapping is unambiguous
             source = source["params"]
         raw = _gpt_params(source) if hasattr(source, "gpt") else source
-        standby = _cast_params(raw, self.config.dtype)
+        # the engines' snapshot builder (cast + int8 PTQ under
+        # quant="int8") — any other transform would stage a standby
+        # whose treedef every engine rejects
+        standby = build_serving_snapshot(raw, self.config)
         # compatibility is validated at STAGE time, synchronously: a
         # wrong-model standby must raise HERE at the caller, not blow
         # up the control loop ticks later inside _flip_one
@@ -1039,8 +1047,12 @@ class ServingFleet:
                    if r.engine is not None)
 
     def expected_executables(self) -> int:
-        return self._ladder.size * sum(
-            1 for r in self._replicas.values() if r.engine is not None)
+        # per-engine sum, not ladder.size * live: the raw-speed levers
+        # (speculative draft programs, chunk shapes, the COW copy)
+        # change each engine's steady-state budget
+        return sum(r.engine.expected_executables
+                   for r in self._replicas.values()
+                   if r.engine is not None)
 
     def aggregate(self, timeout_s: Optional[float] = None
                   ) -> Dict[str, dict]:

@@ -50,15 +50,26 @@ def synthetic_trace(n_requests: int, vocab_size: int, seed: int = 0,
                         4, 6, 8, 12, 16, 24, 40),
                     new_token_choices: Sequence[int] = (
                         4, 8, 12, 16, 24, 32),
-                    class_mix: Optional[Dict[str, float]] = None
-                    ) -> List[TraceItem]:
+                    class_mix: Optional[Dict[str, float]] = None,
+                    shared_prefix_len: int = 0,
+                    shared_frac: float = 0.0) -> List[TraceItem]:
     """Deterministic mixed-length Poisson-ish arrivals: exponential
     inter-arrival times at ``rate_rps``, prompt/new lengths drawn
     uniformly from the choice sets. Same seed -> same trace, so the
     engine and the static baseline replay identical traffic.
     ``class_mix`` ({class: weight}) tags each request with a priority
-    class for fleet replays (default: all "interactive")."""
+    class for fleet replays (default: all "interactive").
+
+    Shared-prefix mode (the radix/COW sharing receipt): with
+    ``shared_prefix_len > 0``, a ``shared_frac`` fraction of requests
+    prepend ONE trace-wide common prefix of that length to their own
+    drawn tail — the system-prompt traffic shape. Total prompt length
+    for a shared request is ``shared_prefix_len + tail``; size the
+    prefill buckets accordingly."""
     rng = np.random.RandomState(seed)
+    shared_ids = (rng.randint(0, vocab_size,
+                              (int(shared_prefix_len),)).astype(np.int32)
+                  if shared_prefix_len > 0 else None)
     classes, weights = None, None
     if class_mix:
         classes = list(class_mix)
@@ -71,6 +82,8 @@ def synthetic_trace(n_requests: int, vocab_size: int, seed: int = 0,
         L = int(rng.choice(list(prompt_len_choices)))
         N = int(rng.choice(list(new_token_choices)))
         ids = rng.randint(0, vocab_size, (L,)).astype(np.int32)
+        if shared_ids is not None and rng.rand() < float(shared_frac):
+            ids = np.concatenate([shared_ids, ids])
         cls = (str(rng.choice(classes, p=weights)) if classes
                else "interactive")
         out.append(TraceItem(arrival_s=t, ids=ids, max_new_tokens=N,
@@ -141,6 +154,7 @@ def replay_continuous(engine, trace: List[TraceItem]) -> Dict:
     next_i = 0
     records: List[_Record] = []
     by_rid: Dict[object, TraceItem] = {}
+    peak_pages_live = 0
     while next_i < len(pending) or engine.has_work():
         now = time.perf_counter() - t0
         while (next_i < len(pending)
@@ -155,6 +169,11 @@ def replay_continuous(engine, trace: List[TraceItem]) -> Dict:
                 records.append(_Record(
                     arrival=r.arrival, first_token=r.first_token_ts,
                     done=r.done_ts, n_tokens=len(r.out)))
+            # host-side int read: the "freed pages raise capacity"
+            # receipt — a shared-prefix replay must peak LOWER than
+            # the same trace unshared (shared pages counted once)
+            peak_pages_live = max(peak_pages_live,
+                                  engine.cache.n_live)
         elif next_i < len(pending):
             # idle with the next arrival known and no other wake
             # source: sleep the whole gap, don't busy-poll it away
@@ -163,6 +182,7 @@ def replay_continuous(engine, trace: List[TraceItem]) -> Dict:
     stats["executables"] = engine.executable_count()
     stats["expected_executables"] = engine.expected_executables
     stats["recompile_events"] = engine.sentinel.fired
+    stats["peak_pages_live"] = peak_pages_live
     return stats
 
 

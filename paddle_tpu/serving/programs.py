@@ -35,10 +35,11 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..models.generation import _attend, _ln, _pick, _prefill
+from ..models.generation import _attend, _ln, _mm, _pick, _prefill
 from ..observability.anatomy import scope as _scope
 
-__all__ = ["make_decode_fn", "make_prefill_fn", "jit_with_donated_pools"]
+__all__ = ["make_decode_fn", "make_prefill_fn", "make_chunk_fn",
+           "jit_with_donated_pools"]
 
 
 def _gathered(pool, tables, n_heads, hd):
@@ -92,7 +93,7 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
         for bp, (kp, vp) in zip(params["blocks"], pools):
             with _scope("attn"):
                 xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-                qkv = (xn @ bp["qkv_w"] + bp["qkv_b"]).reshape(
+                qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
                     b, 1, 3, n_heads, hd)
                 q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])  # [B,nh,1,hd]
                 k_tok = qkv[:, 0, 1]                     # [B,nh,hd]
@@ -103,12 +104,12 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
                 vc = _gathered(vp, tables, n_heads, hd)
                 ctx = _attend(q, kc, vc, positions + 1, scale)
                 ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
-                x = x + ctx @ bp["proj_w"] + bp["proj_b"]
+                x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
             with _scope("mlp"):
                 ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
-                ff = jax.nn.gelu(ff @ bp["fc1_w"] + bp["fc1_b"],
+                ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
                                  approximate=False)
-                x = x + ff @ bp["fc2_w"] + bp["fc2_b"]
+                x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
             new_pools.append((kp, vp))
         with _scope("lm_head"):
             h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
@@ -176,6 +177,98 @@ def make_prefill_fn(eps: float, n_heads: int, block_size: int,
             logits = h_last[:, 0] @ params["wte"].T
             tok = _pick(logits, key, temperature, top_k, top_p)
         return tuple(new_pools), tok
+
+    return run
+
+
+def make_chunk_fn(eps: float, n_heads: int, block_size: int,
+                  temperature: float, top_k, top_p):
+    """Mid-stream multi-token forward over the PAGED cache — the one
+    program behind both new raw-speed levers:
+
+    - **speculative verify**: the target model scores a draft's k
+      proposals plus the anchor token in ONE dispatch (shape
+      ``[slots, k+1]``) and returns every position's greedy argmax, so
+      the host can keep the longest agreeing prefix;
+    - **shared-prefix suffix prefill**: an admitted request whose
+      prompt head already lives in shared pages forwards ONLY the
+      unshared tail (shape ``[admit, suffix_bucket]``), its queries
+      attending the shared pages through the same table gather decode
+      uses.
+
+    run(pools, tables, toks, starts, lens, params, key)
+        -> (pools', all_tok [B, S], picked [B])
+
+    toks [B, S] right-padded token window; starts [B] the absolute
+    logical position of toks[:, 0] (== tokens already in the cache);
+    lens [B] valid counts (1..S). Position q of row i lands its K/V at
+    logical ``starts[i] + q`` — pages for positions past lens route to
+    SCRATCH (clamped-column writes past a row's table would land in
+    its last real page, which under prefix sharing may even be
+    borrowed; the valid-mask makes junk structurally harmless instead
+    of accidentally so). Per-query causal masking (`key_pos <=
+    query_pos`) keeps every query's softmax support exactly the
+    decode-step support, which is what lets the verify argmaxes be
+    bit-identical to sequential decode in f32.
+
+    all_tok is each position's greedy argmax (the verify receipt);
+    picked is the sampled/argmax token at each row's LAST valid
+    position (the next token a non-speculative boundary would emit).
+    """
+
+    def run(pools, tables, toks, starts, lens, params, key):
+        b, s = toks.shape
+        hd = params["wte"].shape[1] // n_heads
+        scale = 1.0 / math.sqrt(hd)
+        offs = jnp.arange(s, dtype=jnp.int32)
+        positions = starts[:, None] + offs[None, :]        # [B, S]
+        valid = offs[None, :] < lens[:, None]              # [B, S]
+        with _scope("embed"):
+            wpe = params["wpe"]
+            pos_emb = wpe[jnp.clip(positions, 0, wpe.shape[0] - 1)]
+            x = params["wte"][toks] + pos_emb              # [B, S, H]
+        bi = jnp.arange(b)[:, None]                        # [B, 1]
+        w = tables.shape[1]
+        col = jnp.clip(positions // block_size, 0, w - 1)
+        blk = jnp.where(valid, tables[bi, col], 0)         # [B, S]
+        off = positions % block_size
+        new_pools = []
+        for bp, (kp, vp) in zip(params["blocks"], pools):
+            with _scope("attn"):
+                xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
+                qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
+                    b, s, 3, n_heads, hd)
+                q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])  # [B,nh,S,hd]
+                kp = kp.at[blk, off].set(qkv[:, :, 1])
+                vp = vp.at[blk, off].set(qkv[:, :, 2])
+                kc = _gathered(kp, tables, n_heads, hd)
+                vc = _gathered(vp, tables, n_heads, hd)
+                att = jnp.einsum("bnqh,bnkh->bnqk", q, kc) * scale
+                kpos = jnp.arange(kc.shape[2])
+                mask = (kpos[None, None, None, :]
+                        <= positions[:, None, :, None])
+                att = jnp.where(mask, att, -1e30)
+                p = jax.nn.softmax(att.astype(jnp.float32),
+                                   axis=-1).astype(x.dtype)
+                ctx = jnp.einsum("bnqk,bnkh->bnqh", p, vc)
+                ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, s, -1)
+                x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
+            with _scope("mlp"):
+                ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
+                ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
+                                 approximate=False)
+                x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
+            new_pools.append((kp, vp))
+        with _scope("lm_head"):
+            h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+            logits = h @ params["wte"].T                   # [B, S, V]
+            all_tok = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+            idx = (lens - 1).astype(jnp.int32)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]  # [B, V]
+            picked = _pick(last, key, temperature, top_k, top_p)
+        return tuple(new_pools), all_tok, picked
 
     return run
 

@@ -432,7 +432,7 @@ def test_bench_restores_tracing_gate_on_error(monkeypatch):
 
     calls = {"n": 0}
 
-    def boom(model, args, trace):
+    def boom(model, args, trace, **kw):
         calls["n"] += 1
         if calls["n"] == 2:      # the TRACED leg
             raise RuntimeError("wedged")

@@ -217,6 +217,7 @@ class TestSchedulerUnits:
     def test_fifo_head_blocks(self):
         class FakeCache:
             n_free = 4
+            available_pages = 4
             def blocks_for(self, n):
                 return n
         s = FifoScheduler(max_slots=8, max_admit=8)
@@ -312,10 +313,11 @@ class TestGraphLintDonation:
 
 class TestRetiredEvictedCounters:
     def test_retire_counts_retired_not_evicted(self, model):
-        """The satellite fix: finishing a request increments
-        serving.retired_total; the PLAIN serving.evicted_total stays
-        zero until a real eviction. The old conflation survives one
-        release as the labeled deprecated alias."""
+        """Regression pin post-alias-retirement: finishing a request
+        increments serving.retired_total and NOTHING else — the plain
+        serving.evicted_total stays zero until a real eviction, and
+        the PR 11 ``{deprecated=retired_alias}`` shim is gone (a
+        labeled alias series must not even be created)."""
         from paddle_tpu.observability import metrics
         eng = ServingEngine(model, f32_config())
         rng = np.random.RandomState(11)
@@ -328,7 +330,7 @@ class TestRetiredEvictedCounters:
             assert evicted is None or evicted.value() == 0
             alias = metrics.get("serving.evicted_total",
                                 deprecated="retired_alias")
-            assert alias is not None and alias.value() == 1
+            assert alias is None
 
     def test_evict_requests_counts_and_frees(self, model):
         from paddle_tpu.observability import metrics

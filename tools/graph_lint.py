@@ -152,12 +152,47 @@ def build_serving(args, config):
                         config=config, schedule=[])
 
 
+def build_serving_int8(args, config):
+    """True-int8 decode audit target (ISSUE 16): the SAME chunked
+    decode program served with quant="int8". Two rules are
+    load-bearing here: the per-channel scale tables and int8 code
+    planes ride the params pytree as TRACED arguments, so the
+    baked-constant rule must find no >=1MiB weight constants folded
+    into the graph; and pool donation must survive the int8 graph
+    (input_output_alias on every K/V page pool)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import ProgramAudit
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128, dropout=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_slots=8, max_admit=4, block_size=8, n_blocks=64,
+        prefill_buckets=(32, 64), decode_chunk=4,
+        max_total_tokens=96, dtype=None, quant="int8"))
+    W = eng.config.table_width
+    lowered = eng._decode.lower(
+        eng.cache.pools, np.zeros((8, W), np.int32),
+        np.zeros((8,), np.int32), np.zeros((8,), np.int32),
+        eng.params, jax.random.key(0))
+    return ProgramAudit("serving_decode_int8", lowered=lowered,
+                        config=config, schedule=[])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--program", choices=("ernie", "spmd", "serving",
-                                          "all", "none"),
+                                          "serving_int8", "all",
+                                          "none"),
                     default="all",
                     help="which programs to lower and audit "
                          "(none: --source only)")
@@ -194,10 +229,12 @@ def main(argv=None) -> int:
     findings = []
     programs = []
     schedules = {}
-    want = ("ernie", "spmd", "serving") if args.program == "all" else \
+    want = ("ernie", "spmd", "serving", "serving_int8") \
+        if args.program == "all" else \
         () if args.program == "none" else (args.program,)
     builders = {"ernie": build_ernie, "spmd": build_spmd,
-                "serving": build_serving}
+                "serving": build_serving,
+                "serving_int8": build_serving_int8}
     for name in want:
         audit = builders[name](args, config)
         programs.append(audit.name)

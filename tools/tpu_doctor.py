@@ -498,8 +498,9 @@ def verdict(diag: dict) -> dict:
 def _dominant_cause(tail: dict) -> dict:
     comp = tail.get("dominant_overall")
     cause = {"queue": "queue_overload", "admission": "queue_overload",
-             "prefill": "slow_prefill", "decode": "slow_decode",
-             "requeue": "replica_kill",
+             "prefix_match": "slow_prefill",
+             "prefill": "slow_prefill", "draft": "slow_decode",
+             "decode": "slow_decode", "requeue": "replica_kill",
              "swap_flip": "swap_flip"}.get(comp, "unattributed")
     return {"cause": cause, "replica": None, "component": comp}
 
