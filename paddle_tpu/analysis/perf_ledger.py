@@ -213,13 +213,16 @@ def record_from_artifact(doc: Mapping, source: str,
             return record_from_report(rep, source=source, run=run,
                                       round_n=round_n, ts=ts)
         return None
+    if doc.get("metric") or doc.get("kind"):
+        # emit_report receipts first: n_devices is a fingerprint
+        # FIELD on these (planner_bench carries it at top level), not
+        # the multichip-probe discriminator
+        return record_from_report(doc, source=source, run=run,
+                                  round_n=round_n, ts=ts)
     if "n_devices" in doc:
         rep = {"kind": "multichip", "n_devices": doc.get("n_devices"),
                "rc": doc.get("rc")}
         return record_from_report(rep, source=source, run=run,
-                                  round_n=round_n, ts=ts)
-    if doc.get("metric") or doc.get("kind"):
-        return record_from_report(doc, source=source, run=run,
                                   round_n=round_n, ts=ts)
     return None
 

@@ -10,7 +10,7 @@ from .collective import (ReduceOp, Group, all_gather, all_reduce, alltoall,
                          new_group, p2p_shift, recv, reduce, reduce_scatter,
                          scatter, send, wait)  # noqa: F401
 from .comm import (CommConfig, GradSynchronizer,  # noqa: F401
-                   planned_all_reduce)
+                   ParamSynchronizer, planned_all_reduce)
 from .env import (build_mesh, ensure_mesh, get_mesh, set_mesh, get_rank,
                   get_world_size, axis_context, current_axis_name,
                   DATA_AXIS, TENSOR_AXIS, PIPE_AXIS, SEQUENCE_AXIS,
@@ -39,7 +39,9 @@ from .ring import (RingAttention, ring_flash_attention,
                    ulysses_attention)  # noqa: F401
 from .shard_map_util import shard_parallel, sp_shard_map  # noqa: F401
 from .sharding import (NamedSharding, PartitionSpec, ShardingPlan,
-                       shard_tensor)  # noqa: F401
+                       MeshPlan, ModelDims, LayoutCost,
+                       candidate_layouts, choose_layout,
+                       estimate_layout, shard_tensor)  # noqa: F401
 
 
 def get_world_size_compat():

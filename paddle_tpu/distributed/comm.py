@@ -56,9 +56,10 @@ from ..ops.registry import run_op
 from .collective import Group, _mirror_into, _record
 from .env import DATA_AXIS, current_axis_name
 
-__all__ = ["CommConfig", "GradSynchronizer", "planned_all_reduce",
-           "choose_algorithm", "build_buckets", "flatten_bucket",
-           "unflatten_bucket", "purge_residual_state"]
+__all__ = ["CommConfig", "GradSynchronizer", "ParamSynchronizer",
+           "planned_all_reduce", "choose_algorithm", "build_buckets",
+           "flatten_bucket", "unflatten_bucket",
+           "purge_residual_state"]
 
 _MiB = 1 << 20
 _COMPRESS = ("f32", "bf16", "int8_ef")
@@ -629,3 +630,168 @@ class GradSynchronizer:
         def fn(grads, state, params):
             return self(grads, state)
         return self.init_state, fn
+
+
+class ParamSynchronizer:
+    """FSDP building block: bucketed param all-gather / grad
+    reduce-scatter on the 'fsdp' axis.
+
+    DeepSpeed-style flat partitioning: params flatten into the SAME
+    size-targeted fused buckets as GradSynchronizer, each bucket's flat
+    vector is padded to a multiple of the fsdp world and chunked
+    contiguously, rank i owning chunk i. ``shard`` keeps only the local
+    chunk (the per-chip memory win), ``gather`` reassembles full params
+    with one tiled all-gather per bucket (cast through the bf16 wire
+    tier when configured), and ``scatter_grads`` turns full grads back
+    into owned chunks — psum_scatter for the exact/bf16 tiers, and for
+    int8_ef the existing quantized all-gather-sum (_allreduce_flat)
+    with its error-feedback residual, slicing out the local chunk.
+
+    Traceable like GradSynchronizer: inside shard_map over the fsdp
+    axis all three are real collectives with comm.* receipts; with no
+    live axis (world 1) every method is the identity, so the eager /
+    single-chip path stays bit-for-bit.
+
+    The whole-graph planner executable does NOT call this — there the
+    compiler places the all-gathers from MeshPlan's NamedShardings;
+    this is the explicit-manual surface (DataParallel fsdp mode, the
+    elastic re-sync drill) and the receipt-bearing reference the
+    planner's cost model is calibrated against.
+    """
+
+    def __init__(self, config: Optional[CommConfig] = None,
+                 axes: Sequence[str] = ("fsdp",)):
+        self.config = config or CommConfig()
+        self._axes = tuple(axes)
+        self._buckets: Optional[List[BucketSpec]] = None
+        self._bucket_key = None
+
+    def buckets_for(self, tree: Dict[str, Any]) -> List[BucketSpec]:
+        key = tuple((name,) + _leaf_meta(tree[name])
+                    for name in sorted(tree))
+        if self._buckets is None or key != self._bucket_key:
+            self._buckets = build_buckets(tree, self.config.bucket_bytes)
+            self._bucket_key = key
+        return self._buckets
+
+    def _live(self) -> Tuple[str, ...]:
+        return _live(self._axes)
+
+    def _world(self, live) -> int:
+        n = 1
+        for ax in live:
+            n *= lax.axis_size(ax)
+        return n
+
+    @staticmethod
+    def _chunk_len(n: int, world: int) -> int:
+        return -(-n // world)  # ceil: flat is zero-padded to world*len
+
+    def shard(self, params: Dict[str, Any]):
+        """Full params -> {bucket_key: local flat chunk}. Identity-ish
+        with no live axis: the single chunk IS the whole bucket."""
+        live = self._live()
+        specs = self.buckets_for(params)
+        out = {}
+        for spec in specs:
+            flat = flatten_bucket(params, spec)
+            if not live:
+                out[spec.residual_key] = flat
+                continue
+            world = self._world(live)
+            c = self._chunk_len(spec.num_elements, world)
+            flat = jnp.pad(flat, (0, c * world - spec.num_elements))
+            idx = lax.axis_index(live[0])
+            for ax in live[1:]:
+                idx = idx * lax.axis_size(ax) + lax.axis_index(ax)
+            out[spec.residual_key] = lax.dynamic_slice_in_dim(
+                flat, idx * c, c, axis=0)
+        return out
+
+    def gather(self, chunks: Dict[str, Any],
+               like: Dict[str, Any]) -> Dict[str, Any]:
+        """Owned chunks -> full params (one all-gather per bucket).
+        ``like`` supplies the bucket layout (shape metadata only)."""
+        live = self._live()
+        specs = self.buckets_for(like)
+        cfg = self.config
+        out = {}
+        for spec in specs:
+            flat = chunks[spec.residual_key]
+            if live:
+                compress = cfg.compress if (
+                    cfg.compress == "bf16" and jnp.issubdtype(
+                        spec.dtype, jnp.floating)) else "f32"
+                wire = _wire_bytes("flat", compress, spec.num_elements,
+                                   np.dtype(spec.dtype).itemsize,
+                                   cfg.int8_block)
+                done = _record_fused("all_gather", compress, live, wire,
+                                     elements=spec.num_elements)
+                with _scope("param_gather"):
+                    y = flat.astype(jnp.bfloat16) \
+                        if compress == "bf16" else flat
+                    for ax in reversed(live):
+                        y = lax.all_gather(y, ax, axis=0, tiled=True)
+                    flat = lax.slice_in_dim(
+                        y, 0, spec.num_elements, axis=0).astype(
+                            spec.dtype)
+                done and done()
+            out.update(unflatten_bucket(flat, spec))
+        return out
+
+    def scatter_grads(self, grads: Dict[str, Any], state=None):
+        """Full grads -> (owned chunks, state). Exact/bf16 tiers ride
+        psum_scatter; int8_ef reuses the quantized all-gather-sum with
+        its error-feedback residual, then slices the local chunk."""
+        state = dict(state or {})
+        live = self._live()
+        specs = self.buckets_for(grads)
+        cfg = self.config
+        if _obs._enabled:
+            _obs.counter("comm.fused_buckets").add(len(specs))
+        out = {}
+        for spec in specs:
+            compress = cfg.compress if jnp.issubdtype(
+                spec.dtype, jnp.floating) else "f32"
+            flat = flatten_bucket(grads, spec)
+            if not live:
+                out[spec.residual_key] = flat
+                continue
+            world = self._world(live)
+            c = self._chunk_len(spec.num_elements, world)
+            flat = jnp.pad(flat, (0, c * world - spec.num_elements))
+            idx = lax.axis_index(live[0])
+            for ax in live[1:]:
+                idx = idx * lax.axis_size(ax) + lax.axis_index(ax)
+            wire = _wire_bytes("rs_ag" if compress != "int8_ef"
+                               else "flat", compress, spec.num_elements,
+                               np.dtype(spec.dtype).itemsize,
+                               cfg.int8_block)
+            done = _record_fused("reduce_scatter", compress, live, wire,
+                                 elements=spec.num_elements)
+            with _scope("grad_sync"):
+                if compress == "int8_ef":
+                    rkey = spec.residual_key
+                    res = state.get(rkey)
+                    if res is None:
+                        res = jnp.zeros((spec.num_elements,),
+                                        jnp.float32)
+                    summed, new_res = _allreduce_flat(
+                        flat[:spec.num_elements], live, "flat",
+                        compress, res, cfg.int8_block)
+                    state[rkey] = new_res
+                    summed = jnp.pad(
+                        summed, (0, c * world - spec.num_elements))
+                    chunk = lax.dynamic_slice_in_dim(
+                        summed, idx * c, c, axis=0)
+                else:
+                    y = flat.astype(jnp.bfloat16) \
+                        if compress == "bf16" else flat
+                    for ax in live:
+                        y = lax.psum_scatter(y, ax,
+                                             scatter_dimension=0,
+                                             tiled=True)
+                    chunk = y.astype(spec.dtype)
+            done and done()
+            out[spec.residual_key] = chunk
+        return out, state

@@ -56,7 +56,8 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
-                               "pp_degree": 1, "sep_degree": 1}
+                               "pp_degree": 1, "sep_degree": 1,
+                               "fsdp_degree": 1}
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.lamb = False
@@ -112,12 +113,15 @@ class DistributedStrategy:
         pp = max(int(h.get("pp_degree", 1)), 1) if (
             self.pipeline or h.get("pp_degree", 1) > 1) else 1
         sp = max(int(h.get("sep_degree", 1)), 1)
+        fsdp = max(int(h.get("fsdp_degree", 1)), 1)
         dp = h.get("dp_degree", -1)
         if dp in (-1, 0, None):
-            dp = max(n_devices // (mp * pp * sp), 1)
+            dp = max(n_devices // (mp * pp * sp * fsdp), 1)
         shape = {}
-        if dp > 1 or (mp == pp == sp == 1):
+        if dp > 1 or (mp == pp == sp == fsdp == 1):
             shape[DATA_AXIS] = dp
+        if fsdp > 1:
+            shape["fsdp"] = fsdp
         if mp > 1:
             shape[TENSOR_AXIS] = mp
         if pp > 1:
@@ -125,6 +129,17 @@ class DistributedStrategy:
         if sp > 1:
             shape[SEQUENCE_AXIS] = sp
         return shape
+
+    def mesh_plan(self, n_devices: int, rules=None):
+        """The strategy's degrees as ONE MeshPlan declaration — the
+        planner entry for fleet consumers (Fleet.build_mesh_plan adds
+        the layout='auto' cost-model path on top)."""
+        from ..sharding import MeshPlan
+        shape = self.mesh_shape(n_devices)
+        return MeshPlan(dp=shape.get(DATA_AXIS, 1),
+                        fsdp=shape.get("fsdp", 1),
+                        tp=shape.get(TENSOR_AXIS, 1),
+                        pp=shape.get(PIPE_AXIS, 1), rules=rules)
 
     def __repr__(self):
         on = [k for k in ("amp", "recompute", "sharding", "pipeline",
@@ -251,8 +266,35 @@ class Fleet:
         from ..parallel import DataParallel
         return DataParallel(model)
 
+    def build_mesh_plan(self, strategy=None, rules=None, dims=None,
+                        hbm_bytes_per_chip=None, layout=None,
+                        num_micro=4):
+        """The unified planner entry: one MeshPlan from the strategy's
+        hybrid degrees, or — layout='auto' with ModelDims + an HBM
+        budget — from the cost model (bytes moved per collective × wire
+        tier vs per-chip HBM; sharding.choose_layout)."""
+        import jax
+        from ..sharding import MeshPlan
+        strategy = strategy or self.strategy or DistributedStrategy()
+        n = len(jax.devices())
+        if layout == "auto":
+            if dims is None or hbm_bytes_per_chip is None:
+                raise ValueError(
+                    "layout='auto' needs dims= (ModelDims) and "
+                    "hbm_bytes_per_chip= — the cost model scores "
+                    "layouts against the model's bytes and the chip's "
+                    "memory")
+            compress = "none"
+            if strategy.comm_opt:
+                compress = strategy.comm_opt_configs.get(
+                    "compress", "none")
+            return MeshPlan.auto(n, dims, hbm_bytes_per_chip,
+                                 rules=rules, compress=compress,
+                                 num_micro=num_micro)
+        return strategy.mesh_plan(n, rules=rules)
+
     def build_pipeline(self, stages, loss_fn, optimizer, strategy=None,
-                       schedule="spmd_1f1b", exec_mode=None):
+                       schedule="spmd_1f1b", exec_mode=None, plan=None):
         """Pipeline-engine factory off the fleet strategy.
         pipeline_configs['accumulate_steps'] is the MICROBATCH COUNT
         (reference PipelineConfig semantics: the global batch is
@@ -289,6 +331,17 @@ class Fleet:
         v = int(cfgs.get("virtual_pipeline_degree", 1))
         inner = optimizer.inner_opt if isinstance(
             optimizer, DistributedOptimizer) else optimizer
+        if plan is not None:
+            # planner path: the MeshPlan owns the mesh and every spec;
+            # dp×fsdp×tp×pp rides the ONE-executable engine
+            if schedule not in ("1f1b", "fthenb"):
+                raise ValueError(
+                    "plan= drives PipelineParallel's one-executable "
+                    "engine; pick schedule='1f1b' or 'fthenb'")
+            return PipelineParallel(
+                stages, loss_fn, inner, num_micro=micro,
+                mesh=plan.mesh, schedule=schedule,
+                exec_mode="spmd_1f1b", plan=plan)
         if schedule == "spmd_1f1b":
             return SpmdPipelineParallel(
                 stages, loss_fn, inner, num_micro=micro,
@@ -303,7 +356,9 @@ class Fleet:
         zero = 0
         if strategy.sharding:
             zero = int(strategy.sharding_configs.get("stage", 1))
-        return ShardingPlan(self.mesh, zero_stage=zero)
+        fsdp = "fsdp" if (self.mesh is not None
+                          and "fsdp" in self.mesh.axis_names) else None
+        return ShardingPlan(self.mesh, zero_stage=zero, fsdp_axis=fsdp)
 
     def build_train_step(self, layer, loss_fn, optimizer, strategy=None):
         """The strategy compiler (strategy_compiler.py:171 analogue): pick
@@ -321,7 +376,10 @@ class Fleet:
         compiler.compile(spec, strategy, self)
         self._last_applied = list(spec.applied)
         # single source of truth for the zero stage: the compiled spec
-        plan = ShardingPlan(self.mesh, zero_stage=spec.zero_stage)
+        plan = ShardingPlan(
+            self.mesh, zero_stage=spec.zero_stage,
+            fsdp_axis=("fsdp" if "fsdp" in self.mesh.axis_names
+                       else None))
         return build_from_spec(spec, mesh=self.mesh, sharding_plan=plan)
 
     def state_dict(self):

@@ -203,6 +203,14 @@ def _elastic_supervise(args, world) -> int:
     # replayed, not skipped (a slot that merely respawns replays its
     # own lost tail itself — no rollback needed for it)
     gone_slots = {"v": ""}
+    # slots growing BACK into the gang: their checkpoints are STALE
+    # (frozen at the eviction cut while the survivors kept training),
+    # so the regrown incarnation must ADOPT the survivors' current
+    # params + cursor instead of resuming its own tail — workers run
+    # the planner-spec'd resync phase (broadcast for replicated
+    # params, all-gather for fsdp-sharded ones, over the fleet KV)
+    # when their slot is named here
+    regrown_slots = {"v": ""}
     # bumped on every gang bounce and shared by the whole gang: workers
     # namespace their KV step-gate keys with it, so stale gate values
     # from a previous incarnation can never satisfy (and so void) the
@@ -228,6 +236,7 @@ def _elastic_supervise(args, world) -> int:
                            PD_SLOT_ID=str(lr),
                            PD_GANG_EPOCH=str(gang_epoch["v"]),
                            PD_GONE_SLOTS=gone_slots["v"],
+                           PD_REGROWN_SLOTS=regrown_slots["v"],
                            PD_ROLLBACK_HEALTHY=rollback_healthy["v"]))
 
     def bounce_gang(monitor):
@@ -298,7 +307,13 @@ def _elastic_supervise(args, world) -> int:
                           f"{grow.ranks}: {grow.reason}",
                           file=sys.stderr)
                     wb = len(policy.active) - len(grow.ranks)
+                    # only THIS bounce runs the resync phase: once the
+                    # regrown slot has adopted the survivors' state,
+                    # later bounces resume it like any other slot
+                    regrown_slots["v"] = ",".join(str(r)
+                                                  for r in grow.ranks)
                     monitor = bounce_gang(monitor)
+                    regrown_slots["v"] = ""
                     elastic.emit_receipt(
                         episode=grow.episode, verdict=grow.verdict,
                         action="grow", ranks=grow.ranks,

@@ -85,13 +85,31 @@ class DataParallel(Layer):
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None, comm_config=None):
+                 group=None, comm_config=None, plan=None):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
-        mesh = ensure_mesh()
+        # MeshPlan: one layout declaration drives placement — params
+        # land on plan.param_spec (fsdp shards them; XLA then places
+        # the param all-gathers / grad reduce-scatters), inputs ride
+        # plan.data_spec's (dp, fsdp) batch axes. plan=None keeps the
+        # classic dp-only behavior bit-for-bit.
+        self._plan = plan
+        mesh = plan.mesh if plan is not None else ensure_mesh()
         self._dp_sharding = None
-        if DATA_AXIS in mesh.axis_names and \
+        self._data_axes = (DATA_AXIS,)
+        if plan is not None:
+            axes = tuple(a for a in ("dp", "fsdp")
+                         if plan.sizes[a] > 1)
+            if axes:
+                self._dp_sharding = mesh
+                self._data_axes = axes
+            for name, t in layers.state_dict().items():
+                if isinstance(t, Tensor) and t._data.ndim > 0:
+                    t._data = jax.device_put(
+                        t._data, NamedSharding(
+                            mesh, plan.param_spec(name, t)))
+        elif DATA_AXIS in mesh.axis_names and \
                 mesh.shape[DATA_AXIS] > 1:
             self._dp_sharding = mesh
         # comm-optimized explicit grad sync (distributed.comm): a
@@ -114,7 +132,9 @@ class DataParallel(Layer):
             placed = []
             for t in inputs:
                 if isinstance(t, Tensor) and t._data.ndim > 0:
-                    spec = P(*([DATA_AXIS] + [None] * (t._data.ndim - 1)))
+                    batch = self._data_axes if len(self._data_axes) > 1 \
+                        else self._data_axes[0]
+                    spec = P(*([batch] + [None] * (t._data.ndim - 1)))
                     arr = jax.device_put(
                         t._data, NamedSharding(self._dp_sharding, spec))
                     nt = Tensor(arr, stop_gradient=t.stop_gradient)

@@ -514,7 +514,7 @@ class PipelineParallel:
                  optimizer, num_micro: int = 1, mesh: Optional[Mesh] = None,
                  pp_axis: str = "pp", schedule: str = "1f1b",
                  param_spec_fn=None, virtual_pipeline_degree: int = 1,
-                 exec_mode: str = "dispatch", sentry=None):
+                 exec_mode: str = "dispatch", sentry=None, plan=None):
         assert len(stages) >= 1
         if exec_mode not in ("dispatch", "spmd_1f1b"):
             raise ValueError(
@@ -524,6 +524,20 @@ class PipelineParallel:
                 "microbatch forward/backward, grad accumulation, loss "
                 "scaling, optimizer update — as ONE jitted shard_map "
                 "program with donated state)")
+        if plan is not None and exec_mode != "spmd_1f1b":
+            raise ValueError(
+                "plan= (MeshPlan) drives the one-executable spmd_1f1b "
+                "engine; the dispatch engine places per-stage programs "
+                "itself — drop plan= or set exec_mode='spmd_1f1b'")
+        # MeshPlan: dp×fsdp×tp×pp layouts. The manual shard_map ring
+        # cannot host tp/fsdp operands (a partially-manual ppermute is
+        # rejected by the partitioner), so a plan switches the engine to
+        # the whole-graph GSPMD form: same 1F1B tick tables, vectorized
+        # over the stage dim, ring hops as jnp.roll (XLA lowers them to
+        # collective-permute), every other collective placed by the
+        # compiler from the plan's NamedShardings. plan=None keeps the
+        # manual engine bit-for-bit.
+        self.plan = plan
         self.exec_mode = exec_mode
         self.num_micro = int(num_micro)
         self.schedule_policy = schedule
@@ -636,11 +650,19 @@ class PipelineParallel:
             raise ValueError(
                 f"exec_mode='spmd_1f1b' supports schedule '1f1b' or "
                 f"'fthenb', got {schedule!r}")
+        if mesh is None and self.plan is not None:
+            mesh = self.plan.mesh
         mesh = mesh if mesh is not None else get_mesh()
         if mesh is None or pp_axis not in mesh.axis_names:
             raise ValueError(
                 f"exec_mode='spmd_1f1b' needs a mesh with a "
                 f"'{pp_axis}' axis")
+        if self.plan is not None and \
+                self.plan.sizes.get("pp", 1) != int(mesh.shape[pp_axis]):
+            raise ValueError(
+                f"plan pp={self.plan.sizes.get('pp', 1)} vs mesh "
+                f"{pp_axis}={int(mesh.shape[pp_axis])}: one layout "
+                "declaration drives both — rebuild the MeshPlan")
         S = int(mesh.shape[pp_axis])
         if len(stages) != S:
             raise ValueError(
@@ -684,6 +706,16 @@ class PipelineParallel:
         self._tables, self._ring, self._ring_b = _spmd_tick_tables(
             self._sched, S, self.num_micro)
         spec_p = NamedSharding(mesh, P(pp_axis))
+        # per-param stacked shardings: the planner derives trailing-dim
+        # specs (tp row/col splits, fsdp) on top of the leading 'pp'
+        # stage dim; without a plan every param rides the uniform P(pp)
+        if self.plan is not None:
+            self._stacked_shardings = {
+                k: NamedSharding(mesh,
+                                 self.plan.stacked_param_spec(k, ref[k]))
+                for k in ref}
+        else:
+            self._stacked_shardings = {k: spec_p for k in ref}
 
         def stacked(k):
             # per-shard materialization: never builds the unsharded
@@ -697,7 +729,8 @@ class PipelineParallel:
                 arr = np.stack([np.asarray(sds[j][k]._data)
                                 for j in range(lo, hi)])
                 return arr[(slice(None),) + tuple(index[1:])]
-            return jax.make_array_from_callback(shape, spec_p, cb)
+            return jax.make_array_from_callback(
+                shape, self._stacked_shardings[k], cb)
 
         self.params = {k: stacked(k) for k in ref}
         # EVERY leaf is committed to the mesh up front (0-d state like
@@ -707,11 +740,18 @@ class PipelineParallel:
         # exactly-one-train-executable contract (and, via different
         # fusion, bit-for-bit parity with the dispatch mode)
         spec_r = NamedSharding(mesh, P())
-        self.opt_state = jax.tree_util.tree_map(
-            lambda a: (jax.device_put(a, spec_p)
-                       if np.ndim(a) > 0
-                       else jax.device_put(jnp.asarray(a), spec_r)),
-            optimizer.init_state_tree(self.params))
+
+        def place_state(k, leaf):
+            if np.ndim(leaf) == 0:
+                return jax.device_put(jnp.asarray(leaf), spec_r)
+            sh = self._stacked_shardings[k] \
+                if tuple(leaf.shape) == tuple(self.params[k].shape) \
+                else spec_p
+            return jax.device_put(leaf, sh)
+
+        self.opt_state = {
+            k: {n: place_state(k, v) for n, v in st.items()}
+            for k, st in optimizer.init_state_tree(self.params).items()}
         self._pure = functionalize(stages[0].forward, stages[0])
         self._spmd_steps: Dict[bool, Any] = {}  # use_scaler -> jit step
         self._spmd_eval = None
@@ -740,7 +780,10 @@ class PipelineParallel:
             return out
         return block
 
-    def _build_spmd_step(self, use_scaler: bool):
+    def _manual_core(self):
+        """The manual shard_map 1F1B ring: every rank runs ONE stage's
+        program, activations hop via lax.ppermute. The planner-free
+        engine (pp, optionally ×dp) — bit-for-bit stable."""
         from jax import shard_map
         from .env import axis_context
 
@@ -749,7 +792,6 @@ class PipelineParallel:
         R, Rb = self._ring, self._ring_b
         tables = self._tables
         loss_fn = self.loss_fn
-        opt = self.optimizer
         dp = "dp" if "dp" in mesh.axis_names else None
         data_spec = P(None, dp)
 
@@ -907,12 +949,184 @@ class PipelineParallel:
             return losses, jax.tree_util.tree_map(
                 lambda a: a[None], gacc)
 
-        smapped = shard_map(
+        return shard_map(
             spmd, mesh=mesh,
             in_specs=({k: P(axis) for k in self.params}, P(), P(),
                       data_spec, data_spec),
             out_specs=(P(), {k: P(axis) for k in self.params}),
             check_vma=False)
+
+    def _planner_core(self):
+        """The whole-graph GSPMD 1F1B: the SAME tick tables, vectorized
+        over the stage dim (jax.vmap + masks instead of lax.cond), ring
+        hops as jnp.roll over the pp-sharded stage dim — XLA lowers the
+        rolls to collective-permute and places every dp/fsdp/tp
+        collective from the MeshPlan's NamedShardings. This is how a
+        dp×fsdp×tp×pp layout becomes ONE executable: a partially-manual
+        shard_map cannot carry a ppermute next to auto axes (the
+        partitioner rejects mixed manual subgroups), so the planner
+        engine hands the WHOLE program to the partitioner instead.
+
+        Same semantics as _manual_core with one uniform twist: the last
+        stage's F computes loss + dLoss/dy only (not joint param grads)
+        and parks dy in its ring slot; EVERY stage then remats at B via
+        jax.vjp from the saved input — pipeline.one_f_one_b_schedule's
+        form, which vectorizes where the joint F-time grad does not.
+        Grad totals are identical (regression-pinned vs the composed
+        wrappers)."""
+        mesh, axis = self.mesh, self.pp_axis
+        S, M = self._n_stages, self.num_micro
+        R, Rb = self._ring, self._ring_b
+        tables = self._tables
+        loss_fn = self.loss_fn
+        plan = self.plan
+        pure = self._pure
+        wsc = jax.lax.with_sharding_constraint
+
+        def nd_mask(flag, ndim):
+            return (flag == 1).reshape((S,) + (1,) * (ndim - 1))
+
+        def core(stacked, key, scale, x, labels):
+            sid = jnp.arange(S)
+
+            def blk(p_row, s, m, xm):
+                k = jax.random.fold_in(jax.random.fold_in(key, s), m)
+                out, _ = pure(p_row, k, xm)
+                return out
+
+            x0 = jax.tree_util.tree_leaves(x)[0]
+            act = jax.eval_shape(
+                lambda p: blk({k: v[0] for k, v in p.items()}, 0, 0,
+                              x0[0]), stacked)
+            if (act.shape, act.dtype) != (x0.shape[1:], x0.dtype):
+                raise ValueError(
+                    "spmd_1f1b stages must map aval->same aval (ring "
+                    f"pipeline); got {x0.shape[1:]}/{x0.dtype} -> "
+                    f"{act.shape}/{act.dtype}; use exec_mode='dispatch'")
+            nda = len(act.shape) + 1  # stage-stacked activation ndim
+            stk_spec = NamedSharding(
+                mesh, plan.stacked_activation_spec(nda))
+            buf_spec = NamedSharding(
+                mesh, P(*((plan.stacked_activation_spec(nda)[0], None)
+                          + tuple(plan.activation_spec(
+                              len(act.shape))))))
+            vblk = jax.vmap(blk, in_axes=(0, 0, 0, 0))
+
+            def store(buf, arr, flag, slot, ring):
+                # buf [S, ring, ...] <- arr [S, ...] where flag==1
+                def one(b, a, f, s):
+                    upd = lax.dynamic_update_index_in_dim(
+                        b, a, s % ring, 0)
+                    return jnp.where(f == 1, upd, b)
+                return jax.vmap(one)(buf, arr, flag, slot)
+
+            def pick(buf, slot, ring):
+                return jax.vmap(
+                    lambda b, s: lax.dynamic_index_in_dim(
+                        b, s % ring, 0, keepdims=False))(buf, slot)
+
+            first = (sid == 0).reshape((S,) + (1,) * len(act.shape))
+
+            def tick(carry, xs):
+                act_in, dy_in, actbuf, dybuf, gacc, losses = carry
+                fa, fm, ba, bm, rfs, rfm, rbs, rbm = xs
+
+                # 1) store last tick's ring arrivals
+                actbuf = store(actbuf, act_in, rfs, rfm, R)
+                dybuf = store(dybuf, dy_in, rbs, rbm, Rb)
+
+                # 2) forward on every stage row (masked): stage 0 eats
+                # its microbatch, others their ring slot; inputs are
+                # saved for the remat backward
+                x_sel = jax.vmap(
+                    lambda m: lax.dynamic_index_in_dim(
+                        x, m % M, 0, keepdims=False))(fm)
+                inp = jnp.where(first, x_sel, pick(actbuf, fm, R))
+                inp = wsc(inp, stk_spec)
+                actbuf = store(actbuf, inp, fa, fm, R)
+                y = wsc(vblk(stacked, sid, fm, inp), stk_spec)
+
+                # last stage: loss + dLoss/dy at F (objective scaled,
+                # reported unscaled), dy parked in its own dy ring slot
+                m_last = fm[S - 1]
+                lbl = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, m_last % M, 0, keepdims=False), labels)
+
+                def floss(yy):
+                    val = loss_fn(_wrap_tree(yy), *_wrap_tree(lbl))
+                    l = val._data.astype(jnp.float32)
+                    return l * scale, l
+                (_, l), dy_last = jax.value_and_grad(
+                    floss, has_aux=True)(y[S - 1])
+                on = fa[S - 1] == 1
+                losses = jnp.where(
+                    on, lax.dynamic_update_index_in_dim(
+                        losses, l, m_last % M, 0), losses)
+                row = dybuf[S - 1]
+                row = jnp.where(
+                    on, lax.dynamic_update_index_in_dim(
+                        row, dy_last, m_last % Rb, 0), row)
+                dybuf = lax.dynamic_update_index_in_dim(
+                    dybuf, row, S - 1, 0)
+
+                # 3) backward on every stage row (masked): remat from
+                # the saved input, accumulate param grads, emit the
+                # input grad for the ring
+                dy_sel = pick(dybuf, bm, Rb)
+                xs_sel = pick(actbuf, bm, R)
+
+                def fb(p_row, s, m, xsv, dy):
+                    _, vjp = jax.vjp(
+                        lambda pp_, xx: blk(pp_, s, m, xx), p_row, xsv)
+                    return vjp(dy)
+                gp, gx = jax.vmap(fb, in_axes=(0, 0, 0, 0, 0))(
+                    stacked, sid, bm, xs_sel, dy_sel)
+                gacc = jax.tree_util.tree_map(
+                    lambda G, g: G + jnp.where(
+                        nd_mask(ba, g.ndim), g, 0), gacc, gp)
+
+                # 4) ring hops: stage dim is pp-sharded, so the rolls
+                # ARE the collective-permutes ("pp_ring" in anatomy)
+                y_send = jnp.where(nd_mask(fa, y.ndim), y, 0)
+                gx_send = jnp.where(nd_mask(ba, gx.ndim), gx, 0)
+                with _scope("pp_ring"):
+                    act_in = wsc(jnp.roll(y_send, 1, axis=0), stk_spec)
+                    dy_in = wsc(jnp.roll(gx_send, -1, axis=0),
+                                stk_spec)
+                return (act_in, dy_in, actbuf, dybuf, gacc,
+                        losses), None
+
+            zeros_stk = wsc(jnp.zeros((S,) + act.shape, act.dtype),
+                            stk_spec)
+            carry0 = (
+                zeros_stk, zeros_stk,
+                wsc(jnp.zeros((S, R) + act.shape, act.dtype), buf_spec),
+                wsc(jnp.zeros((S, Rb) + act.shape, act.dtype),
+                    buf_spec),
+                jax.tree_util.tree_map(jnp.zeros_like, stacked),
+                jnp.zeros((M,), jnp.float32))
+            (_, _, _, _, gacc, losses), _ = lax.scan(
+                tick, carry0, tables)
+            return losses, gacc
+
+        def smapped(stacked, key, scale, x, labels):
+            # data lands sharded over the plan's data axes before the
+            # scan slices microbatches (batch dim is dim 1 of [M,b,..])
+            def put(a):
+                micro = jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                return wsc(a, NamedSharding(
+                    mesh, P(None, *plan.data_spec(micro))))
+            return core(stacked, key, scale,
+                        jax.tree_util.tree_map(put, x),
+                        jax.tree_util.tree_map(put, labels))
+        return smapped
+
+    def _build_spmd_step(self, use_scaler: bool):
+        M = self.num_micro
+        opt = self.optimizer
+        smapped = self._planner_core() if self.plan is not None \
+            else self._manual_core()
 
         def step(stacked, opt_state, key, lr, scale, x, labels):
             losses, grads = smapped(stacked, key, scale, x, labels)
@@ -1125,6 +1339,56 @@ class PipelineParallel:
             scaler._update(bool(np.asarray(found_inf)))
         return Tensor(loss)
 
+    def _build_planner_eval(self):
+        """Whole-graph gpipe-style eval for the planner engine: forward
+        ticks vectorized over the stage dim, ring as jnp.roll — same
+        form as _planner_core minus the backward. Donates nothing."""
+        mesh = self.mesh
+        S, M = self._n_stages, self.num_micro
+        plan = self.plan
+        pure = self._pure
+        wsc = jax.lax.with_sharding_constraint
+
+        def ev(stacked, key, x):
+            sid = jnp.arange(S)
+
+            def blk(p_row, s, m, xm):
+                k = jax.random.fold_in(jax.random.fold_in(key, s), m)
+                out, _ = pure(p_row, k, xm)
+                return out
+            vblk = jax.vmap(blk, in_axes=(0, 0, 0, 0))
+            x0 = x[0]
+            nda = len(x0.shape) + 1
+            stk_spec = NamedSharding(
+                mesh, plan.stacked_activation_spec(nda))
+            first = (sid == 0).reshape((S,) + (1,) * len(x0.shape))
+
+            def tick(carry, t):
+                act_in, outs = carry
+                mb = t - sid                       # [S]
+                active = (mb >= 0) & (mb < M)
+                mbc = jnp.clip(mb, 0, M - 1)
+                x_sel = jax.vmap(
+                    lambda m: lax.dynamic_index_in_dim(
+                        x, m, 0, keepdims=False))(mbc)
+                inp = jnp.where(first, x_sel, act_in)
+                y = wsc(vblk(stacked, sid, mbc, inp), stk_spec)
+                on_last = active[S - 1]
+                outs = jnp.where(
+                    on_last, lax.dynamic_update_index_in_dim(
+                        outs, y[S - 1], mbc[S - 1], 0), outs)
+                with _scope("pp_ring"):
+                    act_in = wsc(jnp.roll(y, 1, axis=0), stk_spec)
+                return (act_in, outs), None
+
+            carry0 = (wsc(jnp.zeros((S,) + x0.shape, x0.dtype),
+                          stk_spec), jnp.zeros_like(x))
+            (_, outs), _ = lax.scan(tick, carry0,
+                                    jnp.arange(M + S - 1))
+            return outs
+        return jax.jit(ev)  # donates NOTHING: eval must not
+        #                     invalidate train state
+
     def _build_spmd_eval(self):
         from jax import shard_map
         from .env import axis_context
@@ -1187,7 +1451,8 @@ class PipelineParallel:
             raise ValueError("spmd_1f1b eval takes one input array")
         x = self._spmd_micro(_unwrap_tree(inputs[0]))
         if self._spmd_eval is None:
-            self._spmd_eval = self._build_spmd_eval()
+            self._spmd_eval = self._build_planner_eval() \
+                if self.plan is not None else self._build_spmd_eval()
         out = self._spmd_eval(self.params, next_key(), x)
         self.last_dispatch_count = 1
         return Tensor(out.reshape((-1,) + out.shape[2:]))

@@ -1,4 +1,4 @@
-"""ShardingPlan: parameter/state/data placement over the mesh.
+"""ShardingPlan + MeshPlan: one layout declaration for the whole mesh.
 
 TPU-native replacement for the reference's graph-surgery parallelism:
 - DP          ≡ batch sharded over 'dp', params replicated; XLA emits the
@@ -7,16 +7,32 @@ TPU-native replacement for the reference's graph-surgery parallelism:
 - ZeRO 1/2/3  ≡ optimizer state / grads / params sharded over 'dp'
                (sharding_optimizer.py:33 — broadcast/reduce become
                compiler-placed all-gather/reduce-scatter)
+- FSDP        ≡ params sharded over 'fsdp' (a second data axis); the
+               compiler places the param all-gathers / grad
+               reduce-scatters, and the explicit eager path
+               (comm.ParamSynchronizer) reuses the fused buckets +
+               bf16/int8-EF wire tiers
 - TP          ≡ layer-annotated PartitionSpecs over 'tp'
                (collective.py:566 paddle.distributed.split)
 - SP/CP       ≡ sequence dim sharded over 'sp' (ring attention)
+- PP          ≡ stage params stacked on a leading dim sharded over 'pp'
 
-The plan computes NamedShardings for every leaf of TrainStep's pytrees.
+ShardingPlan computes NamedShardings for every leaf of TrainStep's
+pytrees. MeshPlan sits one level above: declare the logical axes
+(data/fsdp/tp/pp) ONCE and the planner derives every param /
+activation / optimizer-state spec for ERNIE-class models (embedding
+tables over fsdp×tp, attention/FFN projections row/col-sharded per
+their layer annotations, norms replicated), plus a GC3/TVM-flavored
+cost model (bytes moved per collective × wire tier vs per-chip HBM)
+that selects the layout from mesh shape + model dims when the caller
+passes ``layout="auto"``.
 """
 from __future__ import annotations
 
+import dataclasses
+import math
 import re
-from typing import Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -24,9 +40,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework import Tensor
 
-__all__ = ["ShardingPlan", "PartitionSpec", "shard_tensor", "NamedSharding"]
+__all__ = ["ShardingPlan", "PartitionSpec", "shard_tensor",
+           "NamedSharding", "MeshPlan", "ModelDims", "LayoutCost",
+           "candidate_layouts", "estimate_layout", "choose_layout",
+           "LOGICAL_AXES"]
 
 PartitionSpec = P
+
+#: the planner's logical axis taxonomy, outermost to innermost:
+#: 'pp' (stage ring), 'dp' (pure replication), 'fsdp' (data axis that
+#: ALSO shards params/grads/opt state), 'tp' (operator sharding —
+#: innermost so the heaviest collectives ride the fastest links)
+LOGICAL_AXES = ("dp", "fsdp", "tp", "pp")
 
 
 def _spec_for_param(name: str, tensor, rules):
@@ -68,11 +93,16 @@ class ShardingPlan:
 
     def __init__(self, mesh: Mesh, rules: Dict[str, P] = None,
                  zero_stage: int = 0, dp_axis="dp", data_axes=("dp",),
-                 batch_dim: int = 0):
+                 batch_dim: int = 0, fsdp_axis: Optional[str] = None):
         self.mesh = mesh
         self.rules = rules or {}
         self.zero_stage = zero_stage
         self.dp_axis = dp_axis if dp_axis in mesh.axis_names else None
+        self.fsdp_axis = fsdp_axis if (fsdp_axis and
+                                       fsdp_axis in mesh.axis_names) \
+            else None
+        if self.fsdp_axis and self.fsdp_axis not in data_axes:
+            data_axes = tuple(data_axes) + (self.fsdp_axis,)
         self.data_axes = tuple(a for a in data_axes
                                if a in mesh.axis_names)
         self.batch_dim = batch_dim
@@ -106,6 +136,9 @@ class ShardingPlan:
         # sanitize BEFORE the ZeRO-3 axis addition: a stale 'tp' label on
         # a dp-only mesh must not block _add_axis from dp-sharding the dim
         spec = self._sanitize(_spec_for_param(name, tensor, self.rules))
+        if self.fsdp_axis:
+            spec = _add_axis(spec, tensor, self.fsdp_axis,
+                             int(self.mesh.shape[self.fsdp_axis]))
         if self.zero_stage >= 3 and self.dp_axis:
             spec = _add_axis(spec, tensor, self.dp_axis, self._dp_size())
         return spec
@@ -202,3 +235,416 @@ def shard_tensor(tensor, mesh=None, placements=None, spec: P = None):
         tensor.sharding_spec = spec
         return tensor
     return Tensor(placed)
+
+
+# ---------------------------------------------------------------------------
+# MeshPlan: the unified planner. One layout declaration -> every spec.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ModelDims:
+    """The handful of numbers the cost model needs about a model.
+
+    Everything is in *elements* except dtype_bytes. ``opt_slots`` counts
+    f32 optimizer moments per param (Adam = 2). ``largest_layer_params``
+    bounds the transient full-layer all-gather FSDP materializes — when
+    0 we approximate with n_params / n_layers.
+    """
+    n_params: int
+    hidden: int
+    n_layers: int
+    vocab: int = 0
+    seq: int = 128
+    batch: int = 8
+    dtype_bytes: int = 4
+    opt_slots: int = 2
+    largest_layer_params: int = 0
+
+    @property
+    def layer_params(self) -> int:
+        if self.largest_layer_params:
+            return self.largest_layer_params
+        return max(self.n_params // max(self.n_layers, 1), 1)
+
+    @classmethod
+    def from_state_dict(cls, state, hidden: int, n_layers: int,
+                        seq: int = 128, batch: int = 8,
+                        dtype_bytes: int = 4, opt_slots: int = 2):
+        sizes = [int(np.prod(getattr(v, "shape", ()) or (1,)))
+                 for v in state.values()]
+        return cls(n_params=int(sum(sizes)), hidden=hidden,
+                   n_layers=n_layers, seq=seq, batch=batch,
+                   dtype_bytes=dtype_bytes, opt_slots=opt_slots,
+                   largest_layer_params=int(max(sizes) if sizes else 0))
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutCost:
+    """One candidate layout scored by the cost model (all byte units)."""
+    sizes: Dict[str, int]
+    hbm_per_chip: float      # params+grads+opt shards + gather ws + acts
+    wire_per_chip: float     # collective bytes moved per step per chip
+    bubble_penalty: float    # pp idle time expressed in byte-equivalents
+    feasible: bool
+
+    @property
+    def cost(self) -> float:
+        return self.wire_per_chip + self.bubble_penalty
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"sizes": dict(self.sizes),
+                "hbm_per_chip": round(self.hbm_per_chip),
+                "wire_per_chip": round(self.wire_per_chip),
+                "bubble_penalty": round(self.bubble_penalty),
+                "feasible": self.feasible,
+                "cost": round(self.cost)}
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int, int]]:
+    """All (dp, fsdp, tp, pp) with dp*fsdp*tp*pp == n."""
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        m = n // dp
+        for fsdp in range(1, m + 1):
+            if m % fsdp:
+                continue
+            k = m // fsdp
+            for tp in range(1, k + 1):
+                if k % tp:
+                    continue
+                out.append((dp, fsdp, tp, k // tp))
+    return out
+
+
+def candidate_layouts(n_devices: int,
+                      max_tp: int = 8,
+                      max_pp: int = 8) -> List[Dict[str, int]]:
+    """Enumerate logical-axis factorizations of the device count.
+
+    tp/pp are capped: tp beyond a node's fast links and pp beyond the
+    model's layer count are never profitable, and the caps keep the
+    search space trivial (GC3-style: layouts are enumerable programs).
+    """
+    cands = []
+    for dp, fsdp, tp, pp in _factorizations(n_devices):
+        if tp > max_tp or pp > max_pp:
+            continue
+        cands.append({"dp": dp, "fsdp": fsdp, "tp": tp, "pp": pp})
+    return cands
+
+
+#: matmul FLOPs a chip retires per byte of interconnect bandwidth —
+#: the exchange rate that converts pipeline-bubble idle time into
+#: wire-byte equivalents (v4-ish: ~275 TF/s vs ~2.4 TB/s ICI ≈ O(100))
+_FLOPS_PER_WIRE_BYTE = 128.0
+
+
+def _wire_tier(compress: str) -> float:
+    """Bytes-on-the-wire per f32 element for a grad wire tier, reusing
+    comm.py's accounting so the model and the runtime never disagree."""
+    from .comm import _wire_bytes
+    n = 1 << 20
+    return _wire_bytes("flat", compress, n, 4, 256) / float(4 * n)
+
+
+def estimate_layout(sizes: Dict[str, int], dims: ModelDims,
+                    hbm_bytes_per_chip: float,
+                    compress: str = "none",
+                    num_micro: int = 4) -> LayoutCost:
+    """Score one layout: per-chip HBM residency vs bytes moved per step.
+
+    HBM (per chip):
+      params + grads            n_params·B / (fsdp·tp·pp)
+      optimizer moments (f32)   opt_slots·n_params·4 / (fsdp·tp·pp)
+      FSDP gather workspace     layer_params·B / tp     (transient full
+                                layer while it computes; 0 when fsdp==1)
+      activations               batch/(dp·fsdp) · seq · hidden · B
+                                · 2·layers/pp           (fwd + saved)
+
+    Wire (per chip per step), grad tiers via comm._wire_bytes:
+      dp   ring all-reduce      2·(dp-1)/dp · grad_shard · tier
+      fsdp ag(params)×2 + rs    [2 + tier]·(fsdp-1)/fsdp · P·B/(tp·pp)
+      tp   4 act all-reduces/层 4·layers/pp · 2·(tp-1)/tp · b·s·h·B
+      pp   ring fwd+bwd         2 · batch/(dp·fsdp) · s·h·B
+
+    The pp bubble ((pp-1)/(m+pp-1)) is charged as idle byte-equivalents
+    of the per-chip compute traffic, so pipeline only wins when it buys
+    fit — the TVM lesson: model the *whole* step, not one collective.
+    """
+    dp, fsdp, tp, pp = (sizes.get(a, 1) for a in LOGICAL_AXES)
+    B = dims.dtype_bytes
+    n_dev = dp * fsdp * tp * pp
+    model_shard = dims.n_params * B / (fsdp * tp * pp)
+    opt_shard = dims.opt_slots * dims.n_params * 4 / (fsdp * tp * pp)
+    gather_ws = (dims.layer_params * B / tp) if fsdp > 1 else 0.0
+    local_batch = dims.batch / (dp * fsdp)
+    layers_local = math.ceil(dims.n_layers / pp)
+    acts = local_batch * dims.seq * dims.hidden * B * 2 * layers_local
+    hbm = 2 * model_shard + opt_shard + gather_ws + acts
+
+    tier = _wire_tier(compress)
+    act_bytes = local_batch * dims.seq * dims.hidden * B
+    wire = 0.0
+    if dp > 1:
+        wire += 2 * (dp - 1) / dp * model_shard * tier
+    if fsdp > 1:
+        full_on_tp_pp = dims.n_params * B / (tp * pp)
+        wire += (2 + tier) * (fsdp - 1) / fsdp * full_on_tp_pp
+    if tp > 1:
+        wire += 4 * layers_local * 2 * (tp - 1) / tp * act_bytes
+    if pp > 1:
+        wire += 2 * act_bytes
+
+    # the bubble is charged in wire-byte equivalents: fwd+bwd is
+    # ~6·n_params FLOPs per token, and a TPU core retires roughly
+    # _FLOPS_PER_WIRE_BYTE matmul FLOPs in the time one byte crosses
+    # the interconnect — so idle compute converts to "bytes not moved"
+    bubble = (pp - 1) / (num_micro + pp - 1) if pp > 1 else 0.0
+    flops = 6.0 * dims.n_params * dims.batch * dims.seq
+    compute_equiv = flops / _FLOPS_PER_WIRE_BYTE / n_dev
+    penalty = bubble / max(1.0 - bubble, 1e-6) * compute_equiv
+
+    return LayoutCost(sizes={a: sizes.get(a, 1) for a in LOGICAL_AXES},
+                      hbm_per_chip=hbm, wire_per_chip=wire,
+                      bubble_penalty=penalty,
+                      feasible=hbm <= hbm_bytes_per_chip)
+
+
+def choose_layout(n_devices: int, dims: ModelDims,
+                  hbm_bytes_per_chip: float, compress: str = "none",
+                  num_micro: int = 4, max_tp: int = 8, max_pp: int = 8
+                  ) -> Tuple[Dict[str, int], List[LayoutCost]]:
+    """Pick the cheapest feasible layout; raise with the full report if
+    nothing fits (a layout that cannot fit must fail at plan time, not
+    as a dispatch OOM — memory_anatomy proves it, this predicts it)."""
+    reports = [estimate_layout(c, dims, hbm_bytes_per_chip,
+                               compress=compress, num_micro=num_micro)
+               for c in candidate_layouts(n_devices, max_tp=max_tp,
+                                          max_pp=max_pp)]
+    feasible = [r for r in reports if r.feasible]
+    if not feasible:
+        tight = min(reports, key=lambda r: r.hbm_per_chip)
+        raise ValueError(
+            "no layout of %d devices fits %d bytes/chip; closest %s "
+            "needs %d" % (n_devices, int(hbm_bytes_per_chip),
+                          tight.sizes, int(tight.hbm_per_chip)))
+    # deterministic tie-break: prefer fewer pipeline stages, then less
+    # tp, then less fsdp — the simplest layout that is also cheapest
+    best = min(feasible, key=lambda r: (r.cost, r.sizes["pp"],
+                                        r.sizes["tp"], r.sizes["fsdp"]))
+    return dict(best.sizes), reports
+
+
+_EMBED_RE = re.compile(r"(embed|mlm_head\.decoder)", re.I)
+
+
+class MeshPlan:
+    """One layout declaration → every PartitionSpec in the program.
+
+    >>> plan = MeshPlan(dp=2, tp=2, pp=2)
+    >>> mesh = plan.build_mesh()
+    >>> plan.param_spec("blk.qkv.weight", t)     # row/col from annotation
+    >>> plan.data_spec(batch)                    # batch over (dp, fsdp)
+    >>> plan.stacked_param_spec("qkv.weight", t) # P('pp', *param spec)
+
+    Axis semantics (LOGICAL_AXES): 'dp' replicates params and shards the
+    batch; 'fsdp' shards the batch AND params/grads/opt state (ZeRO-3
+    over a dedicated axis, so dp×fsdp hierarchies stay expressible);
+    'tp' follows the layer annotations (qkv col-, out row-sharded,
+    embeddings fsdp×tp on the vocab dim); 'pp' shards the stacked stage
+    dim of the whole-graph pipeline executable. Norm scales/biases carry
+    no annotation and stay replicated unless fsdp evenly divides them.
+    """
+
+    def __init__(self, dp: int = 1, fsdp: int = 1, tp: int = 1,
+                 pp: int = 1, *, rules: Dict[str, P] = None,
+                 batch_dim: int = 0, compress: str = "none"):
+        sizes = {"dp": int(dp), "fsdp": int(fsdp), "tp": int(tp),
+                 "pp": int(pp)}
+        for a, s in sizes.items():
+            if s < 1:
+                raise ValueError("axis %r size must be >= 1, got %d"
+                                 % (a, s))
+        self.sizes = sizes
+        self.rules = dict(rules or {})
+        self.batch_dim = batch_dim
+        self.compress = compress
+        self._mesh: Optional[Mesh] = None
+        self.report: List[LayoutCost] = []
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def auto(cls, n_devices: int, dims: ModelDims,
+             hbm_bytes_per_chip: float, *, rules: Dict[str, P] = None,
+             compress: str = "none", num_micro: int = 4,
+             max_tp: int = 8, max_pp: int = 8) -> "MeshPlan":
+        """layout="auto": cost-model search over the factorizations of
+        the device count; the losing candidates ride along in .report
+        so receipts can show WHY this layout won."""
+        sizes, reports = choose_layout(
+            n_devices, dims, hbm_bytes_per_chip, compress=compress,
+            num_micro=num_micro, max_tp=max_tp, max_pp=max_pp)
+        plan = cls(rules=rules, compress=compress, **sizes)
+        plan.report = reports
+        return plan
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for s in self.sizes.values():
+            n *= s
+        return n
+
+    def axis_names(self) -> Tuple[str, ...]:
+        """Mesh axes, outermost first: pp, dp, fsdp, tp (size-1 axes are
+        dropped — absent from the mesh means absent from every spec)."""
+        order = ("pp", "dp", "fsdp", "tp")
+        return tuple(a for a in order if self.sizes[a] > 1)
+
+    def mesh_shape(self) -> Dict[str, int]:
+        return {a: self.sizes[a] for a in self.axis_names()}
+
+    def build_mesh(self, devices=None) -> Mesh:
+        from .env import build_mesh
+        shape = self.mesh_shape() or {"dp": 1}
+        devices = devices if devices is not None \
+            else jax.devices()[:self.n_devices]
+        self._mesh = build_mesh(shape, devices=devices)
+        return self._mesh
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self.build_mesh()
+        return self._mesh
+
+    def _axis(self, a: str) -> Optional[str]:
+        return a if self.sizes[a] > 1 else None
+
+    # -- spec derivation ----------------------------------------------------
+    def _sanitize(self, spec: P) -> P:
+        """Drop spec axes absent from this layout (a model annotated
+        for tp degrades to replicated on a dp-only plan). Pure layout
+        math against the declared axis names — no device mesh needed,
+        so spec derivation works on hosts that don't hold the gang's
+        devices (a regrown elastic slot computing its resync plan)."""
+        names = set(self.axis_names())
+
+        def keep(p):
+            if p is None:
+                return None
+            if isinstance(p, (tuple, list)):
+                kept = tuple(a for a in p if a in names)
+                return kept if kept else None
+            return p if p in names else None
+        return P(*[keep(p) for p in spec])
+
+    def param_spec(self, name: str, tensor) -> P:
+        """annotation → rules → P(), then fsdp on the largest free dim.
+
+        Embedding tables are the special case the ISSUE calls out: a
+        vocab dim already tp-sharded gains fsdp on the SAME dim
+        (('fsdp','tp') product) so the table, the model's largest
+        tensor, shards over both axes instead of falling back to the
+        hidden dim."""
+        spec = self._sanitize(_spec_for_param(name, tensor, self.rules))
+        fsdp = self._axis("fsdp")
+        if fsdp is None:
+            return spec
+        shape = tensor._data.shape if isinstance(tensor, Tensor) else \
+            tuple(getattr(tensor, "shape", ()))
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        if (_EMBED_RE.search(name) and len(shape) == 2
+                and parts and parts[0] is not None
+                and parts[0] == self._axis("tp")
+                and shape[0] % (self.sizes["fsdp"] * self.sizes["tp"])
+                == 0):
+            parts[0] = (fsdp, parts[0])
+            return P(*parts)
+        return _add_axis(P(*parts), tensor, fsdp, self.sizes["fsdp"])
+
+    def state_spec(self, name: str, tensor) -> P:
+        """Optimizer moments mirror the param layout exactly — FSDP's
+        memory win is the whole point of the fsdp axis."""
+        return self.param_spec(name, tensor)
+
+    def data_spec(self, array) -> P:
+        nd = len(array.shape) if hasattr(array, "shape") \
+            else np.ndim(array)
+        if nd == 0:
+            return P()
+        data_axes = tuple(a for a in ("dp", "fsdp") if self.sizes[a] > 1)
+        if not data_axes:
+            return P()
+        parts = [None] * nd
+        parts[self.batch_dim] = (data_axes if len(data_axes) > 1
+                                 else data_axes[0])
+        return P(*parts)
+
+    def activation_spec(self, ndim: int, batch_dim: int = 0) -> P:
+        """Per-microbatch activation spec inside the step body."""
+        parts = [None] * ndim
+        data_axes = tuple(a for a in ("dp", "fsdp") if self.sizes[a] > 1)
+        if data_axes and ndim > batch_dim:
+            parts[batch_dim] = (data_axes if len(data_axes) > 1
+                                else data_axes[0])
+        return P(*parts)
+
+    def stacked_param_spec(self, name: str, tensor) -> P:
+        """Spec for a stage-stacked [S, ...] param in the pipeline
+        executable: leading dim over 'pp', trailing dims per
+        param_spec."""
+        base = self.param_spec(name, tensor)
+        return P(self._axis("pp"), *base)
+
+    def stacked_activation_spec(self, ndim: int) -> P:
+        """[S, batch, ...] ring buffers: stage dim over pp, batch over
+        the data axes."""
+        inner = self.activation_spec(ndim - 1, batch_dim=0)
+        return P(self._axis("pp"), *inner)
+
+    # -- integration surfaces ----------------------------------------------
+    def _sharding_plan_cache(self) -> "ShardingPlan":
+        cached = getattr(self, "_splan", None)
+        if cached is None or cached.mesh is not self.mesh:
+            cached = ShardingPlan(
+                self.mesh, rules=self.rules, dp_axis="dp",
+                data_axes=tuple(a for a in ("dp", "fsdp")
+                                if self.sizes[a] > 1),
+                batch_dim=self.batch_dim,
+                fsdp_axis=self._axis("fsdp"))
+            object.__setattr__(self, "_splan", cached)
+        return cached
+
+    def sharding_plan(self) -> "ShardingPlan":
+        """A ShardingPlan view over this plan's mesh, for TrainStep /
+        fleet consumers that speak the older interface."""
+        return self._sharding_plan_cache()
+
+    def resync_assignments(self, named_params) -> Dict[str, str]:
+        """Per-param re-sync collective for a regrown elastic slot:
+        params replicated across the data axes arrive by 'broadcast'
+        (any survivor owns the bytes); params sharded over fsdp need an
+        'all_gather' so the stale slot reassembles every shard."""
+        out = {}
+        fsdp = self._axis("fsdp")
+        for name, t in named_params.items():
+            spec = self.param_spec(name, t)
+            flat = []
+            for p in spec:
+                if isinstance(p, (tuple, list)):
+                    flat.extend(p)
+                elif p is not None:
+                    flat.append(p)
+            out[name] = "all_gather" if (fsdp and fsdp in flat) \
+                else "broadcast"
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        d = {"sizes": dict(self.sizes), "axes": list(self.axis_names()),
+             "n_devices": self.n_devices, "compress": self.compress}
+        if self.report:
+            d["report"] = [r.as_dict() for r in self.report]
+        return d

@@ -237,6 +237,71 @@ def _step_barrier(kv, rank, world, step, hb=None, poll=0.05):
         time.sleep(poll)
 
 
+def _resync_regrown(kv, rank, world, slot, w, cursor, start, hb=None,
+                    timeout=10.0, poll=0.05):
+    """Regrown-slot param re-sync (closes PR 8's grow scope cut): a
+    slot growing back into the gang holds a checkpoint frozen at the
+    eviction cut while the survivors kept training, so resuming its
+    own tail would replay steps the gang already committed. The
+    planner's layout declaration picks the wire op per param
+    (MeshPlan.resync_assignments: replicated -> broadcast from one
+    survivor, fsdp-sharded -> all-gather of survivor shards). This CPU
+    drill's params are dp-replicated, so the broadcast leg runs here —
+    survivors publish their post-load state over the fleet KV and the
+    regrown slot adopts params + cursor + step from the freshest one
+    (the all-gather leg is pinned by tests/test_mesh_planner.py).
+    Best-effort with a deadline: on a KV outage the regrown slot falls
+    back to deterministic replay of its own tail."""
+    regrown = {int(s) for s in
+               os.environ.get("PD_REGROWN_SLOTS", "").split(",")
+               if s.strip()}
+    if kv is None or world <= 1 or not regrown:
+        return cursor, start, None
+    from paddle_tpu.distributed.sharding import MeshPlan
+    plan = MeshPlan(dp=world)
+    assign = plan.resync_assignments({"w": w})
+    epoch = os.environ.get("PD_GANG_EPOCH", "0")
+    if slot not in regrown:
+        # survivor: publish the adoptable state — the full param per
+        # its 'broadcast' assignment (an fsdp layout would publish the
+        # local shard per 'all_gather')
+        try:
+            kv.put(f"resync/{epoch}/{rank}", json.dumps(
+                {"step": start - 1, "cursor": cursor.state_dict(),
+                 "w": np.asarray(w._data).tolist()}))
+        except Exception:
+            pass
+        return cursor, start, None
+    best = None
+    deadline = time.time() + timeout
+    while time.time() < deadline and best is None:
+        for r in range(world):
+            if r == rank:
+                continue
+            try:
+                v = kv.get(f"resync/{epoch}/{r}")
+            except Exception:
+                return cursor, start, None  # KV outage: replay own tail
+            if v is not None:
+                doc = json.loads(v)
+                if best is None or doc["step"] > best["step"]:
+                    best = doc
+        if best is None:
+            if hb is not None:
+                hb.pulse()
+            time.sleep(poll)
+    if best is None or best["step"] + 1 < start:
+        return cursor, start, None  # no fresher survivor state
+    w.set_value(np.asarray(best["w"], np.float32))
+    cursor = dckpt.DataShardCursor.from_state(best["cursor"])
+    fr.record("elastic.resync", step=int(best["step"]),
+              slot=int(slot), assign=dict(assign))
+    print(f"# slot {slot} resynced to survivor step {best['step']} "
+          f"({assign})", file=sys.stderr, flush=True)
+    return cursor, best["step"] + 1, {"adopted_step": int(best["step"]),
+                                      "assign": dict(assign)}
+
+
 def run_sharded(args, rank, world, slot, incarnation, hb):
     """Elastic mode: one GLOBAL dataset sharded by the cursor, async
     sharded checkpoints keyed on the stable slot id. The gang size may
@@ -320,6 +385,9 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
         from paddle_tpu.distributed.fleet.utils.http_server import \
             KVClient
         kv = KVClient(endpoint, timeout=2.0)
+
+    cursor, start, resynced = _resync_regrown(kv, rank, world, slot,
+                                              w, cursor, start, hb=hb)
 
     exlog = os.path.join(args.out_dir, f"examples_slot{slot}.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
@@ -413,7 +481,7 @@ def run_sharded(args, rank, world, slot, incarnation, hb):
     dckpt.wait_pending()
     _write_out(args, slot, rank, w=np.asarray(w._data).tolist(),
                incarnation=incarnation, steps_done=args.steps,
-               world=world, losses=losses)
+               world=world, losses=losses, resynced=resynced)
 
 
 def _exchange_fingerprints(kv, rank, world, step, fp, hb=None,

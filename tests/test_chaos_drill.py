@@ -223,6 +223,72 @@ class TestShrinkDrillFast:
             assert got == want, (step, sorted(got))
 
 
+class TestGrowDrillFast:
+    """Tier-1 grow drill (closes PR 8's scope cut): kill rank 1,
+    supervisor evicts it and shrinks to dp=1, then --grow_after grows
+    it back — the regrown slot's checkpoint is frozen at the eviction
+    cut, so it must ADOPT the survivor's params + cursor through the
+    planner-spec'd resync phase (MeshPlan.resync_assignments over the
+    fleet KV) instead of replaying its own stale tail. The drill's
+    teeth: post-grow param EQUALITY across slots, plus the resync
+    receipt proving adoption actually ran (~12 s)."""
+
+    def test_kill_shrink_grow_resync(self, tmp_path):
+        import numpy as np
+        # grow_after is small so the grow lands while the survivor
+        # still has steps left (the completion race is the one to
+        # avoid); how far the survivor got at dp=1 by then is timing
+        # noise, so the assertions below pin the deterministic facts:
+        # the resync phase RAN, used the planner's assignment, adopted
+        # state no older than the eviction cut, and left the slots
+        # bit-identical at the end
+        r, out, recs = _launch_elastic(
+            tmp_path,
+            chaos_env={"PD_CHAOS_MODE": "kill", "PD_CHAOS_STEP": "4",
+                       "PD_CHAOS_RANK": "1"},
+            extra=("--elastic_shrink", "--grow_after", "1"),
+            steps=8, worker_extra=("--step-time", "0.15"))
+        assert r.returncode == 0, r.stderr[-3000:]
+        actions = [x["action"] for x in recs]
+        assert "evict_shrink" in actions, actions
+        grow = [x for x in recs if x["action"] == "grow"]
+        assert grow, actions
+        assert grow[0]["ranks"] == [1]
+        assert grow[0]["world_after"] == 2
+        # both slots finished the job at the regrown world
+        docs = {}
+        for s in (0, 1):
+            with open(os.path.join(out, f"rank{s}.json")) as f:
+                docs[s] = json.load(f)
+            assert docs[s]["steps_done"] == 8
+            assert docs[s]["world"] == 2
+        # the regrown slot adopted the survivor's state over the KV,
+        # per the planner's per-param assignment (dp-replicated w ->
+        # broadcast); the survivor never resyncs
+        assert docs[0]["resynced"] is None
+        resync = docs[1]["resynced"]
+        assert resync is not None, \
+            "regrown slot replayed its stale tail instead of resyncing"
+        assert resync["assign"] == {"w": "broadcast"}
+        # the survivor rolled back to the eviction cut (rank 1's last
+        # commit, step 3) and only moved forward from there — whatever
+        # it published is >= that cut
+        assert resync["adopted_step"] >= 3
+        # post-grow param equality: the adopted params plus identical
+        # deterministic updates leave every slot bit-identical
+        assert np.array_equal(np.asarray(docs[0]["w"]),
+                              np.asarray(docs[1]["w"])), \
+            (docs[0]["w"], docs[1]["w"])
+        # and still no example skipped or repeated across the
+        # shrink + grow transitions
+        per_step = _examples_audit(out)
+        assert set(per_step) == set(range(8))
+        for step in range(8):
+            got = {i for rec in per_step[step] for i in rec["ids"]}
+            want = {(step * 8 + j) % 64 for j in range(8)}
+            assert got == want, (step, sorted(got))
+
+
 @pytest.mark.slow  # ~2 min: control + chaos runs sized so one
 #   recovery costs < 10% of the job (the ISSUE's goodput >= 0.9 bar);
 #   tier-1 siblings: TestShrinkDrillFast + the chaos-hook units above

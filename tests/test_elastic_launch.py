@@ -96,6 +96,9 @@ def test_rank_policy_restarts_only_dead_rank(tmp_path, control):
                                    control[rank]["w"], rtol=0, atol=0)
 
 
+@pytest.mark.slow  # ~7 s: tier-1 rebalance (PR 17); sibling
+# test_max_restarts_exhaustion_fails_loudly keeps the budget-abort
+# launcher path in tier-1
 def test_crash_loop_guard_backoff_and_window_budget(tmp_path):
     # a worker that dies at import/step-0 EVERY incarnation must not
     # burn a big lifetime budget in seconds: the restarts-per-window

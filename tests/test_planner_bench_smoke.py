@@ -1,0 +1,59 @@
+"""Tier-1 planner-bench smoke: the `planner_step_time` ledger leg.
+
+Runs tools/planner_bench.py in a subprocess with small shapes and
+fails if
+  - the one-executable contract breaks (train_executables != 1 or
+    dispatches_per_step != 1 on the planner dp×tp×pp engine), or
+  - the receipt stops being perf_ledger-ingestable under its OWN
+    fingerprint: a top-level n_devices used to misroute emit_report
+    receipts into the multichip-probe branch, silently relabeling the
+    planner leg — the record must come back labeled planner_step_time.
+
+Structural asserts only: CPU step-time numbers are gated by
+tools/perf_ledger.py --check against the committed baseline, not
+here.
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PD_PLANNER_BENCH_DEVICES": "8",
+    "PD_PLANNER_BENCH_MICRO": "2",
+    "PD_PLANNER_BENCH_WIDTH": "64",
+    "PD_PLANNER_BENCH_BATCH": "16",
+    "PD_PLANNER_BENCH_STEPS": "2",
+}
+# the parent test process pins a different virtual device count; the
+# bench subprocess must pick its own
+_ENV.pop("XLA_FLAGS", None)
+
+
+def test_planner_bench_receipt_contracts():
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "planner_bench.py")],
+        capture_output=True, text=True, timeout=300, env=_ENV,
+        cwd=ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+
+    assert out["metric"] == "planner_step_time"
+    assert out["value"] > 0
+    ex = out["extras"]
+    assert ex["train_executables"] == 1
+    assert ex["dispatches_per_step"] == 1
+    assert ex["speedup_vs_composed"] > 0
+    assert ex["layout"]["pp"] == 2
+
+    # the receipt must ledger under its own label, not multichip
+    from paddle_tpu.analysis import perf_ledger as pl
+    rec = pl.record_from_artifact(out, source="bench", run="smoke")
+    assert rec is not None and rec["label"] == "planner_step_time"
+    assert rec["metrics"]["extras.train_executables"] == 1.0

@@ -81,6 +81,10 @@ def test_scanned_eager_backward_matches_unrolled():
                                        atol=1e-6, err_msg=f"{n}[{i}]")
 
 
+@pytest.mark.slow  # ~8 s: tier-1 rebalance (PR 17); siblings
+# test_gpt_scan_layers_parity_and_training (full-model scanned TrainStep
+# parity AND training) and test_scanned_eager_backward_matches_unrolled
+# keep both halves of this contract in tier-1
 def test_scanned_train_step_matches_unrolled():
     from paddle_tpu.static import TrainStep
     losses = {}

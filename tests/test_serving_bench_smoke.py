@@ -61,6 +61,10 @@ class TestServingBenchSmoke:
         assert tail["cohort"] and x["tail_components_sum_ok"]
         assert x["breach_verdict"]["cause"]
 
+    @pytest.mark.slow  # ~13 s: tier-1 rebalance (PR 17); the compile
+    # contract + replicated rollup + raw-speed plumbing smokes stay,
+    # and test_serving_raw_speed's TestTailTaxonomy keeps the tail
+    # component-sum contract in tier-1
     def test_tail_attribution_and_tracing_penalty(self):
         """The acceptance bars: p99-cohort latency components sum to
         1.0 ± 0.02 with a dominant component named, and the measured

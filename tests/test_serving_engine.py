@@ -96,6 +96,9 @@ class TestDecodeParity:
 
 
 class TestBucketedPrefill:
+    @pytest.mark.slow  # ~8 s: tier-1 rebalance (PR 17); sibling
+    # test_mixed_lengths_share_one_admit_prefill keeps the bucketed
+    # ragged-admit contract in tier-1
     def test_five_length_mix_pins_executable_count(self, model):
         """The ragged-prompt batching fix: 5 DISTINCT prompt lengths
         admit through shared bucketed prefill programs — executable

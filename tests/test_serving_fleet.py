@@ -169,6 +169,9 @@ class TestStallEviction:
 
 
 class TestPartialRollup:
+    @pytest.mark.slow  # ~9 s: tier-1 rebalance (PR 17); sibling
+    # test_unresponsive_snapshot_times_out_not_hangs keeps the
+    # partial-rollup skip path in tier-1 at a third of the cost
     def test_one_dead_of_three_skips_and_flags(self, model, tmp_path):
         """The satellite bar: a dead replica must not hang or fail the
         fleet rollup — skip-and-flag."""

@@ -20,6 +20,9 @@ Programs (all three by default; shapes env-free, flag-tunable):
            --layers 2 for the full-size audit
   spmd     the spmd_1f1b one-program pipeline engine (2 stages), with
            its ring-ppermute collective schedule captured at trace time
+  planner  the MeshPlan-driven dp×tp×pp ONE-executable train step
+           (whole-graph GSPMD 1F1B); must lint clean by construction —
+           baseline: tools/planner_lint_baseline.json
   serving  the continuous-batching decode-step program
            (paddle_tpu.serving) — its donated KV page pools MUST alias
            in input_output_alias (a dropped donation doubles serving
@@ -46,12 +49,12 @@ sys.path.insert(0, REPO)
 N_DEV = int(os.environ.get("PD_LINT_DEVICES", 2))
 
 
-def _force_cpu_devices():
+def _force_cpu_devices(n=None):
     """CPU XLA with >=2 virtual devices for the spmd program (inside
     pytest the conftest already forced 8, so an initialized backend
     with enough devices is left alone)."""
     from tools._force_cpu import force_cpu_devices
-    return force_cpu_devices(N_DEV)
+    return force_cpu_devices(N_DEV if n is None else n)
 
 
 def build_ernie(args, config):
@@ -117,6 +120,57 @@ def build_spmd(args, config):
         lowered = eng.aot_lower_train(x, y)
     return ProgramAudit("spmd_1f1b", lowered=lowered, config=config,
                         schedule=list(sched))
+
+
+def build_planner(args, config):
+    """Unified-planner audit target: the dp×tp×pp ONE-executable train
+    step built from a MeshPlan (whole-graph GSPMD 1F1B). Every
+    planner-produced program must lint clean BY CONSTRUCTION — the
+    implicit-replication rule is the planner's CI guardrail (a spec
+    derivation bug shows up as a >=1 MiB all-gather materialization
+    here before it ever burns HBM on a pod), and the donation rule
+    proves the donated stacked params/opt-state alias."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.analysis import ProgramAudit
+    from paddle_tpu.distributed.sharding import MeshPlan
+
+    n = jax.device_count()
+    tp = 2 if n >= 8 else 1
+    dp = 2 if n >= 4 * tp else 1
+    width, M, batch = args.width, 2, 8
+    paddle.seed(0)
+
+    class _Stage(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(width, width)
+            # col-parallel annotation: the planner derives the rest
+            self.lin.weight.sharding_spec = P(None, "tp")
+            self.lin.bias.sharding_spec = P("tp")
+
+        def forward(self, xx):
+            return paddle.tanh(self.lin(xx))
+
+    plan = MeshPlan(dp=dp, tp=tp, pp=2)
+    mesh = plan.build_mesh()
+    eng = dist.PipelineParallel(
+        [_Stage() for _ in range(2)],
+        lambda o, y: ((o - y) ** 2).mean(),
+        paddle.optimizer.SGD(learning_rate=1e-3),
+        num_micro=M, mesh=mesh, exec_mode="spmd_1f1b", plan=plan)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+    lowered = eng.aot_lower_train(x, y)
+    # no trace-time schedule: the whole-graph form has no explicit
+    # collectives — the partitioner places them (that's the point)
+    return ProgramAudit("planner", lowered=lowered, config=config,
+                        schedule=[])
 
 
 def build_serving(args, config):
@@ -190,9 +244,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--program", choices=("ernie", "spmd", "serving",
-                                          "serving_int8", "all",
-                                          "none"),
+    ap.add_argument("--program", choices=("ernie", "spmd", "planner",
+                                          "serving", "serving_int8",
+                                          "all", "none"),
                     default="all",
                     help="which programs to lower and audit "
                          "(none: --source only)")
@@ -218,7 +272,11 @@ def main(argv=None) -> int:
                     help="spmd stage width")
     args = ap.parse_args(argv)
 
-    _force_cpu_devices()
+    want = ("ernie", "spmd", "planner", "serving", "serving_int8") \
+        if args.program == "all" else \
+        () if args.program == "none" else (args.program,)
+    # the planner target wants a dp×tp×pp mesh — 8 virtual devices
+    _force_cpu_devices(max(N_DEV, 8) if "planner" in want else None)
     from paddle_tpu.analysis import (
         GraphLintConfig, exit_code, format_findings, lint_package,
         load_baseline, new_findings, run_rules, write_baseline)
@@ -229,10 +287,8 @@ def main(argv=None) -> int:
     findings = []
     programs = []
     schedules = {}
-    want = ("ernie", "spmd", "serving", "serving_int8") \
-        if args.program == "all" else \
-        () if args.program == "none" else (args.program,)
     builders = {"ernie": build_ernie, "spmd": build_spmd,
+                "planner": build_planner,
                 "serving": build_serving,
                 "serving_int8": build_serving_int8}
     for name in want:

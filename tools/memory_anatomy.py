@@ -13,6 +13,9 @@ findings.
 Programs (all by default; shapes flag-tunable, tiny CPU smoke sizes):
   train      the ERNIE TrainStep (AMP O1 bf16) — its ONE executable
   spmd       the spmd_1f1b one-program pipeline engine (2 stages)
+  planner    the MeshPlan ONE-executable train step, one program per
+             layout (dp×tp×pp and fsdp×pp) — per-layout peaks gate
+             spec-derivation regressions
   serving    the continuous-batching prefill + chunked-decode programs
              at the largest ladder buckets (donated page pools)
 
@@ -49,11 +52,11 @@ N_DEV = int(os.environ.get("PD_MEMANAT_DEVICES", 2))
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "memory_baseline.json")
 
 
-def _force_cpu_devices():
+def _force_cpu_devices(n=None):
     """CPU XLA with >=2 virtual devices for the spmd program (inside
     pytest the conftest already forced 8)."""
     from tools._force_cpu import force_cpu_devices
-    return force_cpu_devices(N_DEV)
+    return force_cpu_devices(N_DEV if n is None else n)
 
 
 def build_train(args):
@@ -111,6 +114,56 @@ def build_spmd(args):
     return [("spmd_1f1b", eng.aot_lower_train(x, y))]
 
 
+def build_planner(args):
+    """The MeshPlan-driven ONE-executable train step, one program PER
+    LAYOUT: the same 2-stage model compiled under dp×tp×pp and under
+    fsdp×pp. Per-layout peaks are the planner's memory contract — a
+    spec-derivation regression (a param silently replicated where the
+    plan says sharded) grows exactly one layout's peak, and the gate
+    names it."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.sharding import MeshPlan
+
+    n = jax.device_count()
+    layouts = [("planner_dp2_tp2_pp2",
+                dict(dp=2 if n >= 8 else 1, tp=2 if n >= 4 else 1,
+                     pp=2)),
+               ("planner_fsdp2_pp2",
+                dict(fsdp=2 if n >= 4 else 1, pp=2))]
+    width, M, batch = args.width, 2, 8
+    out = []
+    for name, sizes in layouts:
+        paddle.seed(0)
+
+        class _Stage(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(width, width)
+                self.lin.weight.sharding_spec = P(None, "tp")
+                self.lin.bias.sharding_spec = P("tp")
+
+            def forward(self, xx):
+                return paddle.tanh(self.lin(xx))
+
+        plan = MeshPlan(**sizes)
+        eng = dist.PipelineParallel(
+            [_Stage() for _ in range(2)],
+            lambda o, y: ((o - y) ** 2).mean(),
+            paddle.optimizer.SGD(learning_rate=1e-3),
+            num_micro=M, mesh=plan.build_mesh(),
+            exec_mode="spmd_1f1b", plan=plan)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+        out.append((name, eng.aot_lower_train(x, y)))
+    return out
+
+
 def build_serving(args):
     """The serving prefill + chunked-decode programs at the largest
     ladder buckets (donated page pools — the pools ARE serving HBM)."""
@@ -147,12 +200,13 @@ def build_serving(args):
 def compute(args) -> dict:
     """Lower + attribute every requested program. Returns
     program -> attribute_compiled_memory result."""
-    _force_cpu_devices()
+    builders = {"train": build_train, "spmd": build_spmd,
+                "planner": build_planner, "serving": build_serving}
+    want = [p.strip() for p in args.programs.split(",") if p.strip()]
+    # the planner layouts want a dp×tp×pp mesh — 8 virtual devices
+    _force_cpu_devices(max(N_DEV, 8) if "planner" in want else None)
     from paddle_tpu.observability import memory as mem
 
-    builders = {"train": build_train, "spmd": build_spmd,
-                "serving": build_serving}
-    want = [p.strip() for p in args.programs.split(",") if p.strip()]
     unknown = [p for p in want if p not in builders]
     if unknown:
         raise SystemExit(f"unknown program(s) {unknown}; "
@@ -171,9 +225,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--programs", default="train,spmd,serving",
+    ap.add_argument("--programs", default="train,spmd,planner,serving",
                     help="comma-separated flagship set "
-                         "(train,spmd,serving)")
+                         "(train,spmd,planner,serving)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--check", action="store_true",
                     help="gate peaks against the baseline (exit 1 on "
