@@ -59,20 +59,42 @@ __all__ = [
     "record_from_artifact", "append_record", "load_ledger",
     "latest_by_fingerprint", "check_record", "write_ledger_baseline",
     "load_ledger_baseline", "trend", "render_trend",
+    "check_calibration", "CALIBRATION_LABEL",
 ]
 
 RULE = "perf_ledger"
 DEFAULT_TOLERANCE = 0.25
 
-# (pattern, direction, tolerance-override). First match wins; None
-# tolerance inherits the baseline default. Exact specs carry no
-# tolerance by definition. Patterns are fnmatch over the dotted key.
+# (pattern, direction, tolerance-override[, abs-tolerance]). First
+# match wins; None tolerance inherits the baseline default. Exact
+# specs carry no tolerance by definition. Patterns are fnmatch over
+# the dotted key. A 4th element (PR 18) switches the bound from
+# relative to ABSOLUTE: prediction errors live in [0, 1) where a
+# relative bar is meaningless near a perfect (≈0) baseline — a 0.002
+# error tripling to 0.006 is not drift, an error growing by +0.10
+# absolute is.
 SPECS = (
     # contracts first — counts where ANY drift is a bug
     ("*recompile*", "exact", None),
     ("*compiles", "exact", None),
     ("*executables", "exact", None),
     ("*buckets", "exact", None),
+    # cost-model truth plane contracts: the calibration identity must
+    # keep matching (0 = stale table → analytic fallback: a drift
+    # event, not a quiet degradation) and every audit plane must keep
+    # joining (a dropped join would otherwise shrink the error SUM and
+    # read as an improvement)
+    ("*calibration.match", "exact", None),
+    ("*calibration.used_calibrated", "exact", None),
+    ("*metrics_joined", "exact", None),
+    # prediction errors gate with ABSOLUTE tolerance, lower-better.
+    # step-time error is wall-clock noisy on shared CPU (±30%
+    # sandbox swings feed straight into |pred-meas|); hbm/wire join
+    # deterministic planes so their bars are tight. Listed BEFORE the
+    # traffic group: *wire_bytes* would otherwise shadow
+    # prediction_error.wire_bytes with a relative bar.
+    ("*prediction_error.step_time", "lower", None, 0.50),
+    ("*prediction_error*", "lower", None, 0.10),
     # rc is not an ordinal measurement: 0 is the only good value.
     # lower-better @ tolerance 0 means an rc=1 baseline (a round whose
     # receipt parse failed) lets a LATER rc=0 run pass — "exact" would
@@ -111,11 +133,14 @@ SPECS = (
 def spec_for(key: str) -> Optional[dict]:
     """Direction/tolerance spec for a metric key, or None when the key
     is context-only (ledgered, never gated)."""
-    for pat, direction, tol in SPECS:
+    for spec in SPECS:
+        pat, direction, tol = spec[0], spec[1], spec[2]
         if fnmatch.fnmatch(key, pat):
             out = {"direction": direction}
             if tol is not None:
                 out["tolerance"] = float(tol)
+            if len(spec) > 3 and spec[3] is not None:
+                out["abs_tolerance"] = float(spec[3])
             return out
     return None
 
@@ -289,7 +314,11 @@ def write_ledger_baseline(records: List[dict], path: str,
                 continue
             entry = {"value": val, "direction": spec["direction"]}
             if spec["direction"] != "exact":
-                entry["tolerance"] = spec.get("tolerance", tolerance)
+                if "abs_tolerance" in spec:
+                    entry["abs_tolerance"] = spec["abs_tolerance"]
+                else:
+                    entry["tolerance"] = spec.get("tolerance",
+                                                  tolerance)
             mets[key] = entry
         fps[fp] = {"label": rec.get("label"), "run": rec.get("run"),
                    "metrics": mets}
@@ -353,19 +382,34 @@ def check_record(record: dict, baseline: dict,
                          "(run the full bench for a gateable "
                          "receipt)")))
             continue
+        # PR 18: absolute-tolerance bounds for metrics that live in a
+        # fixed range (prediction errors in [0,1)) where a relative
+        # bar collapses to zero width at a perfect baseline
+        abs_tol = spec.get("abs_tolerance")
         bad = None
         if direction == "exact":
             if cur != base_v:
                 bad = (f"{key} = {cur:g}, baseline {base_v:g} "
                        "(exact-better contract: any drift regresses)")
         elif direction == "higher":
-            if base_v > 0 and cur < base_v * (1.0 - tol):
+            if abs_tol is not None:
+                if cur < base_v - abs_tol:
+                    bad = (f"{key} = {cur:g} fell {base_v - cur:g} "
+                           f"below baseline {base_v:g} "
+                           f"(abs tolerance {abs_tol:g})")
+            elif base_v > 0 and cur < base_v * (1.0 - tol):
                 bad = (f"{key} = {cur:g} fell "
                        f"{(1.0 - cur / base_v) * 100:.1f}% below "
                        f"baseline {base_v:g} "
                        f"(tolerance {tol * 100:.0f}%)")
         elif direction == "lower":
-            if cur > base_v * (1.0 + tol) and (base_v > 0 or cur > 0):
+            if abs_tol is not None:
+                if cur > base_v + abs_tol:
+                    bad = (f"{key} = {cur:g} grew {cur - base_v:g} "
+                           f"over baseline {base_v:g} "
+                           f"(abs tolerance {abs_tol:g})")
+            elif cur > base_v * (1.0 + tol) and (base_v > 0
+                                                 or cur > 0):
                 grew = ((cur / base_v - 1.0) * 100
                         if base_v > 0 else float("inf"))
                 bad = (f"{key} = {cur:g} grew {grew:.1f}% over "
@@ -379,6 +423,67 @@ def check_record(record: dict, baseline: dict,
                          f"({record.get('label')}): {bad} — fix the "
                          "regression or re-anchor deliberately with "
                          "--write-baseline")))
+    return findings
+
+
+CALIBRATION_LABEL = "planner_prediction_error"
+
+
+def check_calibration(records: List[dict],
+                      table: Optional[Mapping]) -> List[Finding]:
+    """Calibration-table staleness check for ``perf_ledger --check``
+    (jax-free: it reads the committed table JSON and the ledger, never
+    the live backend — the plan-time loud path is
+    observability.calibration.load_for).
+
+    Cross-checks the newest planner-audit record against the committed
+    table: an audit that ran on analytic fallback
+    (``extras.calibration.match`` = 0) or against a table committed
+    for a different device count means the committed constants no
+    longer describe the fleet — warn with the regeneration command.
+    The hard gate on match is the exact-better baseline spec; these
+    findings carry the WHY.
+    """
+    findings: List[Finding] = []
+    cal_recs = [r for r in records
+                if r.get("label") == CALIBRATION_LABEL]
+    if not cal_recs:
+        return findings
+    newest = sorted(cal_recs, key=_order_key)[-1]
+    run = newest.get("run", "?")
+    mets = newest.get("metrics", {})
+    if table is None:
+        findings.append(Finding(
+            rule=RULE, severity="warning", program=run,
+            location="calibration:missing_table",
+            message=("planner audit records exist but no "
+                     "cost_calibration.json is committed — plans rank "
+                     "on analytic constants; generate one with "
+                     "tools/planner_calibrate.py --write")))
+        return findings
+    match = mets.get("extras.calibration.match")
+    if match is not None and match < 1:
+        findings.append(Finding(
+            rule=RULE, severity="warning", program=run,
+            location="calibration:stale_table",
+            message=(f"newest planner audit ({run}) ran on ANALYTIC "
+                     "fallback: the committed table "
+                     f"({table.get('topology')!r}) did not match the "
+                     "live (device_kind, topology) — regenerate with "
+                     "tools/planner_calibrate.py --write on the "
+                     "target fleet")))
+    n_dev = mets.get("n_devices")
+    if n_dev is not None and table.get("n_devices") is not None \
+            and int(table["n_devices"]) != int(n_dev):
+        findings.append(Finding(
+            rule=RULE, severity="warning", program=run,
+            location="calibration:n_devices_mismatch",
+            message=(f"committed table is for "
+                     f"{table['n_devices']} devices but the newest "
+                     f"planner audit ({run}) ran on {int(n_dev)} — "
+                     "per-axis bandwidth constants do not transfer "
+                     "across mesh sizes; regenerate with "
+                     "tools/planner_calibrate.py --write")))
     return findings
 
 

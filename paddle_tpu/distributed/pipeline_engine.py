@@ -1315,6 +1315,16 @@ class PipelineParallel:
         self._step_count += 1
         self.last_dispatch_count = 1
         self.last_tick_ms = []  # ticks are in-graph: nothing to time
+        # PR 18 plan audit: the first live step stamps the plan's
+        # falsifiable prediction (step-time/HBM/wire in absolute units)
+        # so the audit loop can join measured values onto it. Never
+        # allowed to break training — prediction is observability.
+        if self.plan is not None and \
+                getattr(self.plan, "receipt", None) is None:
+            try:
+                self._stamp_plan_receipt(x)
+            except Exception:
+                pass
         if _rec:
             # step/dispatch/bubble telemetry
             _obs.histogram("pipeline.step_ms").observe(
@@ -1338,6 +1348,24 @@ class PipelineParallel:
             # ONE host bool per step, read after the step is dispatched
             scaler._update(bool(np.asarray(found_inf)))
         return Tensor(loss)
+
+    def _stamp_plan_receipt(self, x):
+        """Attach the MeshPlan's PlanReceipt using the LIVE workload
+        shape: batch/seq read off the micro-batched ring input, model
+        dims from the plan (auto() remembers them) or inferred from the
+        stacked params. ``plan.receipt`` then carries the predicted
+        step-time / HBM-peak / wire-bytes the audit plane verifies."""
+        import dataclasses as _dc
+        from .sharding import ModelDims
+        batch = int(x.shape[0]) * int(x.shape[1])
+        seq = int(x.shape[2]) if getattr(x, "ndim", 2) >= 4 else 1
+        if self.plan.dims is not None:
+            dims = _dc.replace(self.plan.dims, batch=batch, seq=seq)
+        else:
+            leaves = {f"p{i}": v for i, v in enumerate(
+                jax.tree_util.tree_leaves(self.params))}
+            dims = ModelDims.infer(leaves, batch=batch, seq=seq)
+        self.plan.predict(dims, num_micro=self.num_micro)
 
     def _build_planner_eval(self):
         """Whole-graph gpipe-style eval for the planner engine: forward

@@ -277,19 +277,63 @@ class ModelDims:
                    dtype_bytes=dtype_bytes, opt_slots=opt_slots,
                    largest_layer_params=int(max(sizes) if sizes else 0))
 
+    @classmethod
+    def infer(cls, state, batch: int = 8, seq: int = 128,
+              n_layers: Optional[int] = None, opt_slots: int = 2):
+        """Best-effort dims from a bare state dict (no architecture
+        metadata): hidden = the widest trailing dim of any matrix,
+        n_layers = the matrix count unless given. Good enough for the
+        plan-audit receipt a planner engine stamps on itself — the
+        audit measures how wrong it is."""
+        shapes = [tuple(getattr(v, "shape", ()) or (1,))
+                  for v in state.values()]
+        sizes = [int(np.prod(s)) for s in shapes]
+        mats = [s for s in shapes if len(s) >= 2]
+        hidden = max((s[-1] for s in mats), default=1)
+        return cls(n_params=int(sum(sizes)), hidden=int(hidden),
+                   n_layers=int(n_layers if n_layers is not None
+                                else max(len(mats), 1)),
+                   seq=seq, batch=batch, opt_slots=opt_slots,
+                   largest_layer_params=int(max(sizes) if sizes
+                                            else 0))
+
 
 @dataclasses.dataclass(frozen=True)
 class LayoutCost:
-    """One candidate layout scored by the cost model (all byte units)."""
+    """One candidate layout scored by the cost model.
+
+    Byte units score relative rank (``cost``); since PR 18 every
+    candidate ALSO carries two absolute step-time estimates —
+    ``analytic_step_time_s`` from nominal spec-sheet constants and
+    ``calibrated_step_time_s`` from the committed calibration table
+    (None when no table matched) — plus ``used``, naming which one
+    ranked this candidate. ``wire_by_axis`` decomposes the wire bytes
+    per logical axis with collective-call counts, the shape the
+    calibration's latency+bandwidth model consumes.
+    """
     sizes: Dict[str, int]
     hbm_per_chip: float      # params+grads+opt shards + gather ws + acts
     wire_per_chip: float     # collective bytes moved per step per chip
     bubble_penalty: float    # pp idle time expressed in byte-equivalents
     feasible: bool
+    wire_by_axis: Dict[str, Dict[str, float]] = \
+        dataclasses.field(default_factory=dict)
+    analytic_step_time_s: float = 0.0
+    calibrated_step_time_s: Optional[float] = None
+    used: str = "analytic"   # which estimate ranked this candidate
 
     @property
     def cost(self) -> float:
         return self.wire_per_chip + self.bubble_penalty
+
+    @property
+    def step_time_s(self) -> float:
+        """THE absolute prediction: calibrated when a table ranked the
+        candidate, analytic otherwise."""
+        if self.used == "calibrated" and \
+                self.calibrated_step_time_s is not None:
+            return self.calibrated_step_time_s
+        return self.analytic_step_time_s
 
     def as_dict(self) -> Dict[str, Any]:
         return {"sizes": dict(self.sizes),
@@ -297,7 +341,12 @@ class LayoutCost:
                 "wire_per_chip": round(self.wire_per_chip),
                 "bubble_penalty": round(self.bubble_penalty),
                 "feasible": self.feasible,
-                "cost": round(self.cost)}
+                "cost": round(self.cost),
+                "wire_by_axis": {a: dict(r) for a, r in
+                                 self.wire_by_axis.items()},
+                "analytic_step_time_s": self.analytic_step_time_s,
+                "calibrated_step_time_s": self.calibrated_step_time_s,
+                "used": self.used}
 
 
 def _factorizations(n: int) -> List[Tuple[int, int, int, int]]:
@@ -352,7 +401,8 @@ def _wire_tier(compress: str) -> float:
 def estimate_layout(sizes: Dict[str, int], dims: ModelDims,
                     hbm_bytes_per_chip: float,
                     compress: str = "none",
-                    num_micro: int = 4) -> LayoutCost:
+                    num_micro: int = 4,
+                    calibration=None) -> LayoutCost:
     """Score one layout: per-chip HBM residency vs bytes moved per step.
 
     HBM (per chip):
@@ -387,15 +437,28 @@ def estimate_layout(sizes: Dict[str, int], dims: ModelDims,
     tier = _wire_tier(compress)
     act_bytes = local_batch * dims.seq * dims.hidden * B
     wire = 0.0
+    # per-axis decomposition with collective-call counts: the byte
+    # factors above, plus how many collectives carry them per step —
+    # the latency term of the calibrated model charges per call
+    wire_by_axis: Dict[str, Dict[str, float]] = {}
     if dp > 1:
-        wire += 2 * (dp - 1) / dp * model_shard * tier
+        b = 2 * (dp - 1) / dp * model_shard * tier
+        wire += b
+        wire_by_axis["dp"] = {"bytes": b, "calls": 1}   # fused ring AR
     if fsdp > 1:
         full_on_tp_pp = dims.n_params * B / (tp * pp)
-        wire += (2 + tier) * (fsdp - 1) / fsdp * full_on_tp_pp
+        b = (2 + tier) * (fsdp - 1) / fsdp * full_on_tp_pp
+        wire += b
+        wire_by_axis["fsdp"] = {"bytes": b, "calls": 3}  # ag+ag+rs
     if tp > 1:
-        wire += 4 * layers_local * 2 * (tp - 1) / tp * act_bytes
+        b = 4 * layers_local * 2 * (tp - 1) / tp * act_bytes
+        wire += b
+        wire_by_axis["tp"] = {"bytes": b, "calls": 4 * layers_local}
     if pp > 1:
-        wire += 2 * act_bytes
+        b = 2 * act_bytes
+        wire += b
+        wire_by_axis["pp"] = {"bytes": b,
+                              "calls": 2 * max(num_micro, 1)}
 
     # the bubble is charged in wire-byte equivalents: fwd+bwd is
     # ~6·n_params FLOPs per token, and a TPU core retires roughly
@@ -406,21 +469,49 @@ def estimate_layout(sizes: Dict[str, int], dims: ModelDims,
     compute_equiv = flops / _FLOPS_PER_WIRE_BYTE / n_dev
     penalty = bubble / max(1.0 - bubble, 1e-6) * compute_equiv
 
+    # absolute estimates ride every candidate: analytic always, the
+    # calibrated one when a table matched — receipts show BOTH so a
+    # mis-ranked layout is auditable in seconds, not byte-equivalents
+    from ..observability import calibration as _calibration
+    analytic_t = _calibration.predict_step_time_s(
+        sizes, dims, wire_by_axis, None, num_micro=num_micro,
+        compress=compress)["total_s"]
+    calibrated_t = None
+    used = "analytic"
+    if calibration is not None:
+        calibrated_t = _calibration.predict_step_time_s(
+            sizes, dims, wire_by_axis, calibration,
+            num_micro=num_micro, compress=compress)["total_s"]
+        used = "calibrated"
+
     return LayoutCost(sizes={a: sizes.get(a, 1) for a in LOGICAL_AXES},
                       hbm_per_chip=hbm, wire_per_chip=wire,
                       bubble_penalty=penalty,
-                      feasible=hbm <= hbm_bytes_per_chip)
+                      feasible=hbm <= hbm_bytes_per_chip,
+                      wire_by_axis=wire_by_axis,
+                      analytic_step_time_s=analytic_t,
+                      calibrated_step_time_s=calibrated_t,
+                      used=used)
 
 
 def choose_layout(n_devices: int, dims: ModelDims,
                   hbm_bytes_per_chip: float, compress: str = "none",
-                  num_micro: int = 4, max_tp: int = 8, max_pp: int = 8
+                  num_micro: int = 4, max_tp: int = 8, max_pp: int = 8,
+                  calibration=None
                   ) -> Tuple[Dict[str, int], List[LayoutCost]]:
     """Pick the cheapest feasible layout; raise with the full report if
     nothing fits (a layout that cannot fit must fail at plan time, not
-    as a dispatch OOM — memory_anatomy proves it, this predicts it)."""
+    as a dispatch OOM — memory_anatomy proves it, this predicts it).
+
+    With a matching ``observability.calibration.Calibration`` the rank
+    key is the calibrated ABSOLUTE step time (measured FLOP/s + per-axis
+    bandwidth/latency on THIS device); without one it is the analytic
+    byte cost, exactly as before PR 18. Feasibility is byte math either
+    way — calibration never un-fits a layout.
+    """
     reports = [estimate_layout(c, dims, hbm_bytes_per_chip,
-                               compress=compress, num_micro=num_micro)
+                               compress=compress, num_micro=num_micro,
+                               calibration=calibration)
                for c in candidate_layouts(n_devices, max_tp=max_tp,
                                           max_pp=max_pp)]
     feasible = [r for r in reports if r.feasible]
@@ -432,8 +523,15 @@ def choose_layout(n_devices: int, dims: ModelDims,
                           tight.sizes, int(tight.hbm_per_chip)))
     # deterministic tie-break: prefer fewer pipeline stages, then less
     # tp, then less fsdp — the simplest layout that is also cheapest
-    best = min(feasible, key=lambda r: (r.cost, r.sizes["pp"],
-                                        r.sizes["tp"], r.sizes["fsdp"]))
+    if calibration is not None:
+        best = min(feasible,
+                   key=lambda r: (r.calibrated_step_time_s,
+                                  r.sizes["pp"], r.sizes["tp"],
+                                  r.sizes["fsdp"]))
+    else:
+        best = min(feasible, key=lambda r: (r.cost, r.sizes["pp"],
+                                            r.sizes["tp"],
+                                            r.sizes["fsdp"]))
     return dict(best.sizes), reports
 
 
@@ -473,21 +571,47 @@ class MeshPlan:
         self.compress = compress
         self._mesh: Optional[Mesh] = None
         self.report: List[LayoutCost] = []
+        #: the Calibration that ranked this plan (None = analytic) and
+        #: the dims it was planned for — both feed .predict()
+        self.calibration = None
+        self.dims: Optional[ModelDims] = None
+        #: the falsifiable prediction the planner engine stamps after
+        #: its first live step joins the measured planes
+        self.receipt = None
 
     # -- construction -------------------------------------------------------
     @classmethod
     def auto(cls, n_devices: int, dims: ModelDims,
              hbm_bytes_per_chip: float, *, rules: Dict[str, P] = None,
              compress: str = "none", num_micro: int = 4,
-             max_tp: int = 8, max_pp: int = 8) -> "MeshPlan":
+             max_tp: int = 8, max_pp: int = 8,
+             calibration="auto") -> "MeshPlan":
         """layout="auto": cost-model search over the factorizations of
         the device count; the losing candidates ride along in .report
-        so receipts can show WHY this layout won."""
+        so receipts can show WHY this layout won.
+
+        ``calibration="auto"`` (default) loads the committed
+        ``tools/cost_calibration.json`` when it matches the live
+        (device_kind, topology) — a mismatch warns loudly and falls
+        back to analytic constants (see observability.calibration).
+        Pass None to force analytic ranking, or a Calibration to pin
+        one.
+        """
+        calib = calibration
+        if calib == "auto":
+            from ..observability import calibration as _calibration
+            try:
+                calib = _calibration.load_for(n_devices=n_devices)
+            except Exception:
+                calib = None
         sizes, reports = choose_layout(
             n_devices, dims, hbm_bytes_per_chip, compress=compress,
-            num_micro=num_micro, max_tp=max_tp, max_pp=max_pp)
+            num_micro=num_micro, max_tp=max_tp, max_pp=max_pp,
+            calibration=calib)
         plan = cls(rules=rules, compress=compress, **sizes)
         plan.report = reports
+        plan.calibration = calib
+        plan.dims = dims
         return plan
 
     @property
@@ -642,9 +766,70 @@ class MeshPlan:
                 else "broadcast"
         return out
 
+    def predict(self, dims: Optional[ModelDims] = None, *,
+                num_micro: int = 4, calibration="inherit",
+                hbm_bytes_per_chip: float = float("inf")):
+        """Score THIS plan's layout and return the PlanReceipt — the
+        falsifiable prediction (step-time / HBM-peak / wire-bytes, in
+        absolute units) the audit loop later joins measured values
+        onto. Works for manual plans too: auto() remembers its dims,
+        manual plans pass them (or a state dict via ModelDims.infer).
+
+        ``calibration="inherit"`` uses whatever ranked the plan;
+        "auto" re-resolves the committed table; None forces analytic.
+        """
+        from ..observability import calibration as _calibration
+        dims = dims if dims is not None else self.dims
+        if dims is None:
+            raise ValueError(
+                "MeshPlan.predict needs ModelDims — auto() plans carry "
+                "them; manual plans must pass dims= (see "
+                "ModelDims.infer)")
+        calib = calibration
+        if calib == "inherit":
+            calib = self.calibration
+        elif calib == "auto":
+            try:
+                calib = _calibration.load_for(n_devices=self.n_devices)
+            except Exception:
+                calib = None
+        cost = estimate_layout(self.sizes, dims, hbm_bytes_per_chip,
+                               compress=self.compress,
+                               num_micro=num_micro, calibration=calib)
+        if calib is not None:
+            kind, topo = calib.device_kind, calib.topology
+        else:
+            ident = _calibration.device_identity()
+            kind = ident["device_kind"]
+            topo = _calibration.topology_fingerprint(
+                kind, ident["n_devices"])
+        receipt = _calibration.PlanReceipt(
+            sizes=dict(self.sizes),
+            predicted_step_time_s=cost.step_time_s,
+            predicted_hbm_bytes=cost.hbm_per_chip,
+            predicted_wire_bytes=cost.wire_per_chip,
+            analytic_step_time_s=cost.analytic_step_time_s,
+            calibrated_step_time_s=cost.calibrated_step_time_s,
+            used=cost.used,
+            device_kind=kind,
+            topology=topo,
+            calibration_match=calib is not None,
+            breakdown={"wire_by_axis": {a: dict(r) for a, r in
+                                        cost.wire_by_axis.items()},
+                       "bubble_penalty": round(cost.bubble_penalty),
+                       "num_micro": num_micro})
+        self.receipt = receipt
+        self.dims = dims
+        return receipt
+
     def describe(self) -> Dict[str, Any]:
         d = {"sizes": dict(self.sizes), "axes": list(self.axis_names()),
              "n_devices": self.n_devices, "compress": self.compress}
         if self.report:
             d["report"] = [r.as_dict() for r in self.report]
+        if self.calibration is not None:
+            d["calibration"] = {"topology": self.calibration.topology,
+                                "synthetic": self.calibration.synthetic}
+        if self.receipt is not None:
+            d["receipt"] = self.receipt.as_dict()
         return d

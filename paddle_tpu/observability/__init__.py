@@ -63,6 +63,19 @@ TPU-pod training job needs on top of raw counters:
                    /healthz (watchdog/goodput/sentry verdict),
                    /snapshot (JSON) and /series (pulse-ring windows) —
                    jax-free so it answers while the pod hangs
+  calibration      cost-model truth plane: micro-bench probes filling
+                   the committed tools/cost_calibration.json (achieved
+                   matmul FLOP/s per shape bucket, per-axis collective
+                   bandwidth/latency per payload tier and wire dtype,
+                   HBM copy bandwidth — synthetic/deterministic on CPU,
+                   measured on accelerators), absolute step-time
+                   prediction for MeshPlan candidates, the PlanReceipt
+                   every planner executable carries, and the audit loop
+                   joining measured step-time / HBM-peak / wire-bytes
+                   onto it (always-on planner.prediction_error{metric=}
+                   gauges, planner_prediction_error ledger receipts,
+                   loud planner.calibration_stale_total on identity
+                   mismatch)
   sentry           numeric integrity: in-graph per-scope grad/param
                    stats + every-K param-bit fingerprints riding the
                    one step program, a rolling z-score monitor
@@ -80,6 +93,7 @@ maps to the reference's monitor.h / timeline.py machinery.
 """
 from . import metrics  # noqa: F401
 from . import anatomy  # noqa: F401
+from . import calibration  # noqa: F401
 from . import exporters  # noqa: F401
 from . import xprof  # noqa: F401
 from . import fleet  # noqa: F401
@@ -104,6 +118,7 @@ __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
     "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
     "memory", "reqtrace", "sentry", "timeseries", "pulse_server",
+    "calibration",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",

@@ -1,0 +1,337 @@
+"""Cost-model truth plane (observability.calibration): the committed
+synthetic table is bit-reproducible, the accessors do nearest-bucket
+math, absolute-unit predictions stay finite on degenerate layouts, the
+measured-vs-predicted audit joins without div-by-zero and publishes
+its ALWAYS-ON gauges, staleness is loud, and MeshPlan.predict stamps a
+ledger-ready PlanReceipt. All in-process (the conftest's 8 virtual CPU
+devices serve the MeshPlan legs)."""
+import json
+import os
+import warnings
+
+import pytest
+
+from paddle_tpu.observability import calibration as cal
+from paddle_tpu.observability import metrics
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+COMMITTED = os.path.join(ROOT, "tools", "cost_calibration.json")
+
+
+# -- the table ----------------------------------------------------------------
+
+def test_synthetic_table_bit_identical_and_matches_committed():
+    """THE determinism acceptance: two CPU probe runs produce the SAME
+    bytes, and the committed artifact is exactly what a rebuild
+    produces (drifted synthetic formulas would silently invalidate the
+    committed constants)."""
+    a = cal.build_table(device_kind="cpu", n_devices=8)
+    b = cal.build_table(device_kind="cpu", n_devices=8)
+    dump = lambda t: json.dumps(t, sort_keys=True)  # noqa: E731
+    assert dump(a) == dump(b)
+    with open(COMMITTED) as f:
+        committed = json.load(f)
+    assert dump(a) == dump(committed), (
+        "tools/cost_calibration.json no longer matches build_table's "
+        "synthetic CPU output — regenerate with "
+        "tools/planner_calibrate.py --write")
+
+
+def test_table_schema():
+    t = cal.build_table(device_kind="cpu", n_devices=8)
+    assert t["version"] == cal.SCHEMA_VERSION
+    assert t["synthetic"] is True
+    assert t["topology"] == "cpu-8dev"
+    assert set(t["matmul_flops_per_s"]) == {
+        f"log2_mnk_{b:02d}" for b in cal.MATMUL_BUCKETS}
+    assert set(t["collective"]) == set(cal._AXES)
+    for axis_row in t["collective"].values():
+        assert set(axis_row) == {f"t{p:02d}" for p in cal.PAYLOAD_TIERS}
+        for tier_row in axis_row.values():
+            assert set(tier_row) == set(cal.WIRE_DTYPES)
+            for r in tier_row.values():
+                assert r["bandwidth_bytes_per_s"] > 0
+                assert r["latency_s"] > 0
+    assert t["hbm_copy_bytes_per_s"] > 0
+    # compressed wire dtypes move fewer bytes per element
+    row = t["collective"]["tp"]["t12"]
+    assert row["bf16"]["wire_bytes_per_elt"] \
+        < row["f32"]["wire_bytes_per_elt"]
+    assert row["int8_ef"]["wire_bytes_per_elt"] \
+        < row["bf16"]["wire_bytes_per_elt"]
+
+
+def test_calibration_accessors():
+    c = cal.Calibration(cal.build_table(device_kind="cpu",
+                                        n_devices=8))
+    assert c.matches("cpu", 8) and not c.matches("cpu", 4)
+    assert not c.matches("tpu v4", 8)
+    # bucket lookups clamp to the probed range (tiny and huge matmuls
+    # never KeyError) and bigger matmuls achieve better FLOP/s
+    tiny = c.matmul_flops(2, 2, 2)
+    huge = c.matmul_flops(2**14, 2**14, 2**14)
+    assert 0 < tiny < huge
+    assert huge == c.matmul_flops(2**20, 2**20, 2**20)  # clamped
+    # collective time = bandwidth term + per-call latency term
+    one = c.collective_s("tp", 1 << 14, calls=1)
+    four = c.collective_s("tp", 1 << 14, calls=4)
+    assert 0 < one < four            # latency charges per call
+    assert c.collective_s("tp", 0) == 0.0
+    assert c.collective_s("tp", 1 << 14, calls=0) == 0.0
+    # an axis missing from the table falls back to analytic constants
+    assert c.collective_s("nonsense_axis", 1 << 14) > 0
+    assert c.hbm_bytes_per_s > 0
+
+
+def test_relative_error_symmetric_zero_safe_none_propagating():
+    assert cal.relative_error(100.0, 50.0) == \
+        cal.relative_error(50.0, 100.0) == 0.5
+    assert cal.relative_error(0.0, 0.0) == 0.0      # zero-comm layout
+    assert cal.relative_error(None, 1.0) is None    # join failure
+    assert cal.relative_error(1.0, None) is None
+    err = cal.relative_error(0.0, 10.0)
+    assert err == 1.0                               # bounded
+
+
+# -- absolute-unit prediction -------------------------------------------------
+
+def _dims(**kw):
+    from paddle_tpu.distributed.sharding import ModelDims
+    base = dict(n_params=10_000_000, hidden=512, n_layers=8, seq=128,
+                batch=8)
+    base.update(kw)
+    return ModelDims(**base)
+
+
+def test_predict_step_time_finite_on_degenerate_layouts():
+    import math
+    c = cal.Calibration(cal.build_table(device_kind="cpu",
+                                        n_devices=8))
+    cases = [
+        ({"dp": 1, "fsdp": 1, "tp": 1, "pp": 1}, {}),   # single device
+        ({"dp": 1, "fsdp": 1, "tp": 8, "pp": 1},        # tp > heads
+         {"tp": {"bytes": 1 << 20, "calls": 16}}),
+        ({"dp": 8, "fsdp": 1, "tp": 1, "pp": 1},        # pp collapse
+         {"dp": {"bytes": 1 << 22, "calls": 1}}),
+        ({"dp": 1, "fsdp": 1, "tp": 1, "pp": 8},        # deep pipe
+         {"pp": {"bytes": 1 << 16, "calls": 8}}),
+    ]
+    for sizes, wire in cases:
+        for calib in (None, c):
+            est = cal.predict_step_time_s(sizes, _dims(), wire,
+                                          calib=calib)
+            for k in ("compute_s", "comm_s", "bubble_s", "total_s"):
+                assert math.isfinite(est[k]) and est[k] >= 0, \
+                    (sizes, calib is None, k, est)
+    # no pipeline -> no bubble; no wire -> no comm
+    est = cal.predict_step_time_s({"dp": 8}, _dims(), {}, calib=c)
+    assert est["bubble_s"] == 0.0 and est["comm_s"] == 0.0
+    assert est["total_s"] == est["compute_s"] > 0
+
+
+# -- the audit loop -----------------------------------------------------------
+
+def _receipt(**kw):
+    base = dict(
+        sizes={"dp": 1, "fsdp": 1, "tp": 1, "pp": 1},
+        predicted_step_time_s=1e-3, predicted_hbm_bytes=1e4,
+        predicted_wire_bytes=0.0, analytic_step_time_s=1e-3,
+        calibrated_step_time_s=None, used="analytic",
+        device_kind="cpu", topology="cpu-1dev",
+        calibration_match=False)
+    base.update(kw)
+    return cal.PlanReceipt(**base)
+
+
+def test_zero_comm_audit_no_div_by_zero():
+    """Single-device plan: zero predicted AND measured wire must join
+    as a PERFECT wire prediction (0.0 error), not crash or drop."""
+    res = cal.audit(_receipt(), {"step_time_s": 1e-3,
+                                 "hbm_bytes": 1e4,
+                                 "wire_bytes": 0.0}, publish=False)
+    assert res["metrics_joined"] == 3
+    assert res["prediction_error"] == {"step_time": 0.0,
+                                       "hbm_peak": 0.0,
+                                       "wire_bytes": 0.0}
+    # total error 0: shares defined (all 0.0), no ZeroDivisionError
+    assert set(res["error_share"]) == {"step_time", "hbm_peak",
+                                       "wire_bytes"}
+    assert all(v == 0.0 for v in res["error_share"].values())
+
+
+def test_audit_join_failure_is_not_a_perfect_prediction():
+    res = cal.audit(_receipt(), {"step_time_s": 2e-3,
+                                 "wire_bytes": None}, publish=False)
+    assert res["metrics_joined"] == 1
+    assert res["prediction_error"]["step_time"] == 0.5
+    assert res["prediction_error"]["hbm_peak"] is None
+    assert res["prediction_error"]["wire_bytes"] is None
+    assert res["worst"] == "step_time"
+    assert res["error_share"] == {"step_time": 1.0}
+
+
+def test_audit_gauges_are_always_on():
+    """The prediction-error plane publishes even with the metrics gate
+    DOWN — a mis-planning cost model must be visible on a quiet
+    fleet."""
+    metrics.disable()
+    cal.audit(_receipt(), {"step_time_s": 2e-3, "hbm_bytes": 2e4,
+                           "wire_bytes": 0.0})
+    snap = metrics.snapshot()
+    for m in ("step_time", "hbm_peak", "wire_bytes"):
+        key = "planner.prediction_error{metric=%s}" % m
+        assert key in snap, sorted(
+            k for k in snap if k.startswith("planner."))
+    assert snap["planner.prediction_error{metric=step_time}"][
+        "value"] == 0.5
+    assert "planner.measured{metric=hbm_peak}" in snap
+    assert "planner.predicted{metric=wire_bytes}" in snap
+
+
+def test_audit_report_is_ledger_ready(tmp_path):
+    jsonl = str(tmp_path / "audit.jsonl")
+    rep = cal.audit_report(
+        _receipt(used="calibrated", calibration_match=True,
+                 calibrated_step_time_s=1.1e-3),
+        {"step_time_s": 2e-3, "hbm_bytes": 1.5e4, "wire_bytes": 0.0},
+        platform="cpu", n_devices=1, jsonl_path=jsonl, publish=False)
+    assert rep["metric"] == "planner_prediction_error"
+    assert rep["value"] == 3                      # planes joined
+    ex = rep["extras"]
+    assert ex["metrics_joined"] == 3              # exact-better twin
+    assert ex["calibration"] == {"match": 1, "topology": "cpu-1dev",
+                                 "used_calibrated": 1}
+    assert ex["worst"] in ex["prediction_error"]
+    assert abs(sum(ex["error_share"].values()) - 1.0) < 0.01
+    # ledger round-trip under its OWN fingerprint, with the exact and
+    # absolute-tolerance gate keys present
+    from paddle_tpu.analysis import perf_ledger as pl
+    rec = pl.record_from_artifact(rep, source="bench", run="t")
+    assert rec["label"] == "planner_prediction_error"
+    assert rec["metrics"]["extras.calibration.match"] == 1.0
+    assert rec["metrics"]["extras.metrics_joined"] == 3.0
+    assert "extras.prediction_error.step_time" in rec["metrics"]
+    # and the JSONL series landed
+    assert os.path.exists(jsonl)
+
+
+# -- staleness ----------------------------------------------------------------
+
+def test_load_for_match_and_loud_staleness(tmp_path):
+    path = str(tmp_path / "cal.json")
+    cal.save_table(cal.build_table(device_kind="cpu", n_devices=8),
+                   path)
+    c = cal.load_for(device_kind="cpu", n_devices=8, path=path)
+    assert c is not None and c.topology == "cpu-8dev"
+
+    metrics.disable()
+    before = metrics.snapshot().get(
+        "planner.calibration_stale_total", {}).get("value", 0.0)
+    with pytest.warns(UserWarning, match="STALE"):
+        got = cal.load_for(device_kind="tpu v4", n_devices=8,
+                           path=path)
+    assert got is None                # analytic fallback, never silent
+    after = metrics.snapshot()["planner.calibration_stale_total"][
+        "value"]
+    assert after == before + 1        # always-on counter bumped
+    # no table at all: quiet None (nothing to be stale against)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert cal.load_for(device_kind="cpu", n_devices=8,
+                            path=str(tmp_path / "missing.json")) is None
+
+
+def test_planner_calibrate_cli_write_and_check(tmp_path):
+    """The generator CLI round-trip: --write emits a table for its
+    pinned mesh, --check passes against it and exits 1 (naming both
+    topologies) when the live mesh stops matching."""
+    import subprocess
+    import sys
+    path = str(tmp_path / "cal.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PD_COST_CALIBRATION": path, "PD_CALIBRATE_DEVICES": "8"}
+    env.pop("XLA_FLAGS", None)
+    cli = os.path.join(ROOT, "tools", "planner_calibrate.py")
+    p = subprocess.run([sys.executable, cli, "--write"],
+                       capture_output=True, text=True, timeout=180,
+                       env=env, cwd=ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    wrote = json.loads(p.stdout)["calibration_written"]
+    assert wrote["topology"] == "cpu-8dev" and wrote["synthetic"]
+    p2 = subprocess.run([sys.executable, cli, "--check"],
+                        capture_output=True, text=True, timeout=180,
+                        env=env, cwd=ROOT)
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+    # a 4-device process against the 8-device table: stale, rc 1
+    p3 = subprocess.run([sys.executable, cli, "--check"],
+                        capture_output=True, text=True, timeout=180,
+                        env={**env, "PD_CALIBRATE_DEVICES": "4"},
+                        cwd=ROOT)
+    assert p3.returncode == 1
+    chk = json.loads(p3.stdout)["calibration_check"]
+    assert chk["problems"] and "stale" in chk["problems"][0]
+    assert chk["live"] == "cpu-4dev" and chk["table"] == "cpu-8dev"
+
+
+# -- MeshPlan integration -----------------------------------------------------
+
+def test_mesh_plan_predict_stamps_receipt(tmp_path):
+    from paddle_tpu.distributed.sharding import MeshPlan, ModelDims
+    path = str(tmp_path / "cal.json")
+    cal.save_table(cal.build_table(device_kind="cpu", n_devices=8),
+                   path)
+    calib = cal.load_for(device_kind="cpu", n_devices=8, path=path)
+
+    plan = MeshPlan(dp=2, tp=2, pp=2)
+    with pytest.raises(ValueError, match="ModelDims"):
+        plan.predict()                # manual plan without dims
+    r = plan.predict(_dims(), calibration=calib)
+    assert r.used == "calibrated" and r.calibration_match
+    assert r.calibrated_step_time_s is not None
+    assert r.analytic_step_time_s > 0
+    assert r.predicted_step_time_s == r.calibrated_step_time_s
+    assert r.predicted_hbm_bytes > 0 and r.predicted_wire_bytes > 0
+    assert r.sizes == {"dp": 2, "fsdp": 1, "tp": 2, "pp": 2}
+    assert plan.receipt is r          # stamped on the plan
+    d = r.as_dict()
+    assert d["used"] == "calibrated" and d["breakdown"]
+
+    # calibration=None forces the analytic path — BOTH estimates in
+    # the same absolute units is the whole point of the truth plane
+    r2 = plan.predict(_dims(), calibration=None)
+    assert r2.used == "analytic"
+    assert r2.predicted_step_time_s == r2.analytic_step_time_s
+
+
+def test_auto_plan_carries_dims_and_calibration(tmp_path):
+    from paddle_tpu.distributed.sharding import MeshPlan
+    path = str(tmp_path / "cal.json")
+    cal.save_table(cal.build_table(device_kind="cpu", n_devices=8),
+                   path)
+    old = os.environ.get("PD_COST_CALIBRATION")
+    os.environ["PD_COST_CALIBRATION"] = path
+    try:
+        plan = MeshPlan.auto(8, _dims(), hbm_bytes_per_chip=2**34)
+    finally:
+        if old is None:
+            os.environ.pop("PD_COST_CALIBRATION", None)
+        else:
+            os.environ["PD_COST_CALIBRATION"] = old
+    assert plan.dims is not None      # auto() remembers its dims
+    r = plan.predict()                # inherits plan.calibration
+    assert r.used == "calibrated"
+    desc = plan.describe()
+    assert desc["calibration"]["topology"] == "cpu-8dev"
+    assert desc["receipt"]["used"] == "calibrated"
+
+
+def test_model_dims_infer_from_state_dict():
+    import numpy as np
+    from paddle_tpu.distributed.sharding import ModelDims
+    state = {"w1": np.zeros((64, 128)), "b1": np.zeros((128,)),
+             "w2": np.zeros((128, 128)), "b2": np.zeros((128,))}
+    d = ModelDims.infer(state, batch=4, seq=16)
+    assert d.hidden == 128 and d.n_layers == 2
+    assert d.n_params == 64 * 128 + 128 + 128 * 128 + 128
+    assert d.batch == 4 and d.seq == 16

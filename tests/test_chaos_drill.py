@@ -271,9 +271,13 @@ class TestGrowDrillFast:
             "regrown slot replayed its stale tail instead of resyncing"
         assert resync["assign"] == {"w": "broadcast"}
         # the survivor rolled back to the eviction cut (rank 1's last
-        # commit, step 3) and only moved forward from there — whatever
-        # it published is >= that cut
-        assert resync["adopted_step"] >= 3
+        # DURABLE commit) and only moved forward from there — whatever
+        # it published is >= that cut. The kill at step 4 races rank
+        # 1's async step-3 save (save_sharded async_write=True), so
+        # the cut is 3 when that write landed and 2 when the SIGKILL
+        # beat it — both are correct evictions; the step-2 commit had
+        # a full step-time to land and bounds the cut below
+        assert resync["adopted_step"] >= 2
         # post-grow param equality: the adopted params plus identical
         # deterministic updates leave every slot bit-identical
         assert np.array_equal(np.asarray(docs[0]["w"]),

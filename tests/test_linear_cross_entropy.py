@@ -94,6 +94,9 @@ def test_no_logits_buffer_in_ernie_train_step():
     assert f"tensor<{n_tok}x{min(256, cfg.vocab_size)}x" in txt
 
 
+@pytest.mark.slow  # ~8 s: tier-1 rebalance (PR 18); the param'd
+# test_parity_vs_dense + bf16-accumulation + no-logits-buffer tests
+# keep the chunked-CE contracts
 def test_gpt_chunked_lm_loss_parity():
     """GPT path: chunked_ce TrainStep losses == dense lm_loss path."""
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM

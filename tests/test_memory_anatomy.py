@@ -312,6 +312,36 @@ class TestMemoryBaselineRule:
             assert prog["peak_bytes"] > 0
 
 
+def test_planner_predicted_hbm_joined_in_receipt():
+    """PR 18 satellite: the planner layouts' tables carry the plan
+    cost model's predicted HBM/chip NEXT TO the measured
+    buffer-assignment peak, and the receipt ledgers the join (same
+    symmetric-error definition as the plan-audit plane). Subprocess:
+    the planner programs pin their own 8-device mesh."""
+    import subprocess
+    import sys
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "memory_anatomy.py"),
+         "--programs", "planner"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert p.returncode == 0, (p.stdout + "\n" + p.stderr)[-2000:]
+    assert "predicted HBM/chip (plan cost model):" in p.stdout
+    summary = json.loads(
+        p.stdout.strip().splitlines()[-1].split("memory_anatomy:",
+                                                1)[1])
+    joined = summary["planner_predicted_hbm"]
+    assert set(joined) == {"planner_dp2_tp2_pp2",
+                           "planner_fsdp2_pp2"}, summary
+    for name, row in joined.items():
+        assert row["predicted_bytes"] > 0, (name, row)
+        assert row["measured_bytes"] == summary["peak_bytes"][name]
+        assert 0.0 <= row["error"] < 1.0, (name, row)
+
+
 # ---------------------------------------------------------------------------
 # the OOM sentry + doctor verdict
 # ---------------------------------------------------------------------------

@@ -122,6 +122,69 @@ class TestCostModel:
                                    for r in plan.report)
         assert "report" in plan.describe()
 
+    def test_candidate_report_carries_both_absolute_estimates(self):
+        """PR 18: every candidate names its analytic step-time in
+        absolute seconds, decomposes wire per logical axis with call
+        counts (the shape the calibration latency+bandwidth model
+        consumes), and — when a calibration table is supplied — ALSO
+        the calibrated estimate plus which one ranked it."""
+        from paddle_tpu.observability import calibration as cal
+        dims = ModelDims(n_params=10_000_000, hidden=1024, n_layers=4,
+                         batch=64, seq=128)
+        sizes = {"dp": 2, "fsdp": 1, "tp": 2, "pp": 2}
+        plain = estimate_layout(sizes, dims, 16 * GiB)
+        assert plain.analytic_step_time_s > 0
+        assert plain.calibrated_step_time_s is None
+        assert plain.used == "analytic"
+        assert plain.step_time_s == plain.analytic_step_time_s
+        for axis in ("dp", "tp", "pp"):
+            row = plain.wire_by_axis[axis]
+            assert row["bytes"] > 0 and row["calls"] >= 1, axis
+
+        calib = cal.Calibration(cal.build_table(device_kind="cpu",
+                                                n_devices=8))
+        scored = estimate_layout(sizes, dims, 16 * GiB,
+                                 calibration=calib)
+        assert scored.used == "calibrated"
+        assert scored.calibrated_step_time_s > 0
+        assert scored.analytic_step_time_s \
+            == plain.analytic_step_time_s     # both always reported
+        assert scored.step_time_s == scored.calibrated_step_time_s
+        d = scored.as_dict()
+        assert d["used"] == "calibrated"
+        assert d["calibrated_step_time_s"] > 0
+        # feasibility is byte math — the ruler never changes it
+        assert scored.feasible == plain.feasible
+        assert scored.hbm_per_chip == plain.hbm_per_chip
+
+    def test_calibrated_ranking_preserves_feasibility(self):
+        """choose_layout under a calibration table still returns a
+        feasible factorization of the device count — the table only
+        re-ranks, never admits an infeasible layout."""
+        from paddle_tpu.observability import calibration as cal
+        calib = cal.Calibration(cal.build_table(device_kind="cpu",
+                                                n_devices=8))
+        dims = ModelDims(n_params=10_000_000, hidden=1024, n_layers=4,
+                         batch=64, seq=128)
+        sizes, report = choose_layout(8, dims, 16 * GiB,
+                                      calibration=calib)
+        n = 1
+        for v in sizes.values():
+            n *= v
+        assert n == 8
+        best = next(r for r in report if r.sizes == sizes)
+        assert best.feasible and best.used == "calibrated"
+        # the winner minimizes the calibrated ruler among feasible
+        feasible = [r for r in report if r.feasible]
+        assert best.calibrated_step_time_s == min(
+            r.calibrated_step_time_s for r in feasible)
+        # infeasible stays infeasible with the table supplied
+        big = ModelDims(n_params=4_000_000_000, hidden=8192,
+                        n_layers=8, batch=16, seq=512)
+        with pytest.raises(ValueError, match="closest"):
+            choose_layout(8, big, hbm_bytes_per_chip=1 * GiB,
+                          calibration=calib)
+
 
 # ---------------------------------------------------------------------------
 # spec derivation (mesh-free: no devices touched)
@@ -354,6 +417,33 @@ class TestPlannerEngineParity:
         out = eng.eval_batch(paddle.to_tensor(parity["xh"]))
         assert np.asarray(out._data).shape == (M * MB, H)
         assert np.all(np.isfinite(np.asarray(out._data)))
+
+    def test_planner_leg_carries_a_stamped_plan_receipt(self, parity):
+        # The first live train_batch self-stamps the plan's falsifiable
+        # prediction — every planner-built executable (the ERNIE legs
+        # ride this same engine path) carries it with no opt-in, so the
+        # plan-audit loop always has something to join measured values
+        # onto.
+        eng = parity["peng"]
+        r = eng.plan.receipt
+        assert r is not None
+        assert r.sizes == {"dp": 2, "fsdp": 1, "tp": 2, "pp": S}
+        for v in (r.predicted_step_time_s, r.predicted_hbm_bytes,
+                  r.predicted_wire_bytes):
+            assert np.isfinite(v) and v > 0
+        # stamped from the LIVE workload shape: micro-ring input is
+        # (M, MB, H) → batch = M*MB
+        assert eng.plan.dims.batch == M * MB
+        assert r.used in ("analytic", "calibrated")
+        # the receipt is join-ready: audit against the prediction
+        # itself yields zero error on all three planes
+        from paddle_tpu.observability import calibration as cal
+        audit = cal.audit(r, {"step_time_s": r.predicted_step_time_s,
+                              "hbm_bytes": r.predicted_hbm_bytes,
+                              "wire_bytes": r.predicted_wire_bytes})
+        assert audit["metrics_joined"] == 3
+        assert all(e == 0.0
+                   for e in audit["prediction_error"].values())
 
 
 # ---------------------------------------------------------------------------

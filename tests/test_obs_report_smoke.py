@@ -117,6 +117,55 @@ def test_serving_bridge_receipt(tmp_path):
                for e in tr["traceEvents"])
 
 
+def test_plan_audit_bridge_receipt(tmp_path):
+    """--plan-audit: the zero-to-receipt drive of the cost-model truth
+    plane (PR 18) — live sentinel-guarded steps, all three measured
+    planes joined onto the PlanReceipt, error shares summing to ~1
+    with the worst-mispredicted component named, the always-on
+    prediction-error gauges on the pulse rings, and a ledgerable
+    planner_prediction_error receipt on the JSONL stream."""
+    jsonl = tmp_path / "audit.jsonl"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         "--plan-audit", "--jsonl", str(jsonl)],
+        capture_output=True, text=True, timeout=300,
+        env={**_ENV, "PD_OBS_DEMO_DEVICES": "8",
+             "PD_OBS_DEMO_STEPS": "2"}, cwd=ROOT)
+    assert p.returncode == 0, (p.stdout + "\n" + p.stderr)[-2000:]
+    s = json.loads(p.stdout.strip().splitlines()[-1])
+    assert s["ok"], s
+    assert s["audit"]["metric"] == "planner_prediction_error"
+    assert s["audit"]["value"] == 3               # all planes joined
+    errs = s["prediction_error"]
+    assert set(errs) == {"step_time", "hbm_peak", "wire_bytes"}
+    assert all(0.0 <= v <= 1.0 for v in errs.values()), errs
+    assert abs(sum(s["error_share"].values()) - 1.0) <= 0.02
+    assert s["worst"] in errs
+    # the committed table matches the 8-device smoke: the prediction
+    # must have ranked on it, and both absolute estimates must ride
+    assert s["used"] == "calibrated" and s["calibration_match"]
+    ex = s["audit"]["extras"]
+    assert ex["analytic_step_time_s"] > 0
+    assert ex["calibrated_step_time_s"] > 0
+    # measured wire came from the compiled HLO's collective inventory
+    # (compiler-placed collectives never hit the comm counters)
+    assert s["hlo_collective_calls"] > 0
+    assert s["measured"]["wire_bytes"] > 0
+    # sentinel guards: observation never touched the train executable
+    assert s["train_executables"] == 1
+    assert s["train_recompiles"] == 0
+    # always-on gauges landed on the pulse rings
+    assert len(s["pulse_ring_keys"]) == 3
+    assert s["pulse_ring_points"] >= 3
+    # the JSONL stream carries the same receipt, ledger-ready
+    rec = json.loads(jsonl.read_text().splitlines()[-1])
+    from paddle_tpu.analysis import perf_ledger as pl
+    led = pl.record_from_artifact(s["audit"], source="bench", run="t")
+    assert led["label"] == "planner_prediction_error"
+    assert led["metrics"]["extras.calibration.match"] == 1.0
+    assert rec["metrics"], rec
+
+
 def test_pulse_bridge_receipt():
     """--pulse: THE live scrape-parity acceptance receipt — during a
     running fleet leg a mid-run HTTP /metrics pull parses as valid

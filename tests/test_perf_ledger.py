@@ -305,6 +305,128 @@ def test_gate_rc_recovery_passes_failure_trips(tmp_path):
                for f in fs)
 
 
+# -- cost-model truth plane (PR 18) -------------------------------------------
+
+def _audit_report(step=0.9, hbm=0.5, wire=0.3, joined=3, match=1,
+                  n_devices=8):
+    """A planner_prediction_error receipt the shape
+    observability.calibration.audit_report emits."""
+    return {
+        "metric": "planner_prediction_error", "unit": "count",
+        "value": joined, "platform": "cpu", "n_devices": n_devices,
+        "extras": {
+            "metrics_joined": joined,
+            "prediction_error": {"step_time": step, "hbm_peak": hbm,
+                                 "wire_bytes": wire},
+            "error_share": {"step_time": 0.5, "hbm_peak": 0.3,
+                            "wire_bytes": 0.2},
+            "calibration": {"match": match, "used_calibrated": match},
+        },
+    }
+
+
+def test_spec_absolute_tolerance_resolution():
+    """Prediction errors live in [0,1): they gate on ABSOLUTE bars
+    (a relative bar collapses at a ≈0 baseline), and the
+    *wire_bytes* traffic glob must NOT shadow
+    prediction_error.wire_bytes with a relative one."""
+    s = pl.spec_for("extras.prediction_error.step_time")
+    assert s["direction"] == "lower" and s["abs_tolerance"] == 0.50
+    for k in ("extras.prediction_error.hbm_peak",
+              "extras.prediction_error.wire_bytes"):
+        s = pl.spec_for(k)
+        assert s["direction"] == "lower", k
+        assert s["abs_tolerance"] == 0.10, k
+        assert "tolerance" not in s, k
+    # the plain traffic glob still gates relative
+    assert "abs_tolerance" not in pl.spec_for("extras.comm.wire_bytes")
+    # join-completeness and table identity are exact contracts
+    assert pl.spec_for("extras.metrics_joined")["direction"] == "exact"
+    assert pl.spec_for("extras.calibration.match")["direction"] \
+        == "exact"
+
+
+def test_gate_absolute_tolerance_bounds(tmp_path):
+    rec = pl.record_from_report(_audit_report(), round_n=1)
+    base_path = str(tmp_path / "b.json")
+    pl.write_ledger_baseline([rec], base_path)
+    base = pl.load_ledger_baseline(base_path)
+    (entry,) = base["fingerprints"].values()
+    anchored = entry["metrics"]["extras.prediction_error.hbm_peak"]
+    assert anchored == {"value": 0.5, "direction": "lower",
+                        "abs_tolerance": 0.10}
+
+    # drift INSIDE the absolute bar passes (0.5 -> 0.58: +0.08)
+    ok = pl.record_from_report(_audit_report(hbm=0.58), round_n=2)
+    assert [f for f in pl.check_record(ok, base)
+            if f.severity == "error"] == []
+    # beyond it trips, naming the absolute delta
+    bad = pl.record_from_report(_audit_report(hbm=0.65), round_n=3)
+    errs = [f for f in pl.check_record(bad, base)
+            if f.severity == "error"]
+    assert any("prediction_error.hbm_peak" in f.location
+               and "abs tolerance" in f.message for f in errs)
+    # step_time rides the wide wall-clock bar: +0.4 absolute passes
+    noisy = pl.record_from_report(_audit_report(step=1.3), round_n=4)
+    assert [f for f in pl.check_record(noisy, base)
+            if f.severity == "error"] == []
+    # improvement never gates
+    good = pl.record_from_report(
+        _audit_report(step=0.1, hbm=0.01, wire=0.0), round_n=5)
+    assert [f for f in pl.check_record(good, base)
+            if f.severity == "error"] == []
+
+
+def test_gate_dropped_join_and_stale_table_trip_exact(tmp_path):
+    """A dropped measurement join shrinks the error set — it must gate
+    as a contract break, never read as an improvement; likewise a
+    calibrated->analytic fallback flip."""
+    rec = pl.record_from_report(_audit_report(), round_n=1)
+    base_path = str(tmp_path / "b.json")
+    pl.write_ledger_baseline([rec], base_path)
+    base = pl.load_ledger_baseline(base_path)
+    dropped = pl.record_from_report(_audit_report(joined=2),
+                                    round_n=2)
+    errs = [f for f in pl.check_record(dropped, base)
+            if f.severity == "error"]
+    assert any("metrics_joined" in f.location
+               and "exact-better" in f.message for f in errs)
+    stale = pl.record_from_report(_audit_report(match=0), round_n=3)
+    errs2 = [f for f in pl.check_record(stale, base)
+             if f.severity == "error"]
+    assert any("calibration.match" in f.location for f in errs2)
+
+
+def test_check_calibration_staleness_warnings():
+    table = {"n_devices": 8, "topology": "cpu-8dev",
+             "device_kind": "cpu"}
+    recs = [pl.record_from_report(_audit_report(), round_n=1)]
+    # healthy: matching table, matching audit -> silent
+    assert pl.check_calibration(recs, table) == []
+    # no planner audits ledgered -> nothing to say either way
+    assert pl.check_calibration([], None) == []
+    # audits exist but no table committed -> loud, names the generator
+    (f,) = pl.check_calibration(recs, None)
+    assert f.severity == "warning"
+    assert "missing_table" in f.location
+    assert "planner_calibrate.py --write" in f.message
+    # newest audit fell back to analytic -> stale_table
+    stale_recs = recs + [pl.record_from_report(
+        _audit_report(match=0), round_n=2)]
+    fs = pl.check_calibration(stale_recs, table)
+    assert any("stale_table" in f.location for f in fs)
+    assert all(f.severity == "warning" for f in fs)
+    # table committed for a different mesh size -> n_devices_mismatch
+    fs2 = pl.check_calibration(recs, dict(table, n_devices=16))
+    assert any("n_devices_mismatch" in f.location for f in fs2)
+    # staleness is ordered by round: an OLD analytic audit followed by
+    # a calibrated one is healthy
+    healed = [pl.record_from_report(_audit_report(match=0),
+                                    round_n=1),
+              pl.record_from_report(_audit_report(), round_n=2)]
+    assert pl.check_calibration(healed, table) == []
+
+
 def test_cli_runs_without_jax_or_paddle(tmp_path):
     """The triage-host contract: the CLI must gate/trend with jax AND
     the paddle_tpu package unimportable (it loads the analysis module

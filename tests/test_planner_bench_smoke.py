@@ -1,13 +1,19 @@
-"""Tier-1 planner-bench smoke: the `planner_step_time` ledger leg.
+"""Tier-1 planner-bench smoke: the `planner_step_time` ledger leg
+plus its PR-18 sibling, the `planner_step_time_calibrated` receipt.
 
-Runs tools/planner_bench.py in a subprocess with small shapes and
-fails if
+Runs tools/planner_bench.py --calibration ONCE per module (subprocess,
+small shapes) and fails if
   - the one-executable contract breaks (train_executables != 1 or
-    dispatches_per_step != 1 on the planner dp×tp×pp engine), or
+    dispatches_per_step != 1 on the planner dp×tp×pp engine),
   - the receipt stops being perf_ledger-ingestable under its OWN
     fingerprint: a top-level n_devices used to misroute emit_report
     receipts into the multichip-probe branch, silently relabeling the
-    planner leg — the record must come back labeled planner_step_time.
+    planner leg — the record must come back labeled planner_step_time,
+  - the calibrated pick scores WORSE than the analytic pick on the
+    calibrated ruler. That ordering is true by construction when the
+    committed table loads (the calibrated pick minimizes that ruler),
+    so a violation means tools/cost_calibration.json went stale for
+    this topology — a staleness regression, not a modeling one.
 
 Structural asserts only: CPU step-time numbers are gated by
 tools/perf_ledger.py --check against the committed baseline, not
@@ -17,6 +23,8 @@ import json
 import os
 import subprocess
 import sys
+
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
@@ -35,16 +43,28 @@ _ENV = {
 _ENV.pop("XLA_FLAGS", None)
 
 
-def test_planner_bench_receipt_contracts():
+@pytest.fixture(scope="module")
+def bench_receipts():
+    """ONE subprocess run serves every test: the measured receipt line
+    and the --calibration receipt line it appends."""
     p = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools",
-                                      "planner_bench.py")],
+                                      "planner_bench.py"),
+         "--calibration"],
         capture_output=True, text=True, timeout=300, env=_ENV,
         cwd=ROOT)
     assert p.returncode == 0, p.stderr[-2000:]
-    out = json.loads(p.stdout.strip().splitlines()[-1])
+    lines = [json.loads(ln) for ln in p.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    by_metric = {doc["metric"]: doc for doc in lines}
+    assert set(by_metric) >= {"planner_step_time",
+                              "planner_step_time_calibrated"}, \
+        sorted(by_metric)
+    return by_metric
 
-    assert out["metric"] == "planner_step_time"
+
+def test_planner_bench_receipt_contracts(bench_receipts):
+    out = bench_receipts["planner_step_time"]
     assert out["value"] > 0
     ex = out["extras"]
     assert ex["train_executables"] == 1
@@ -57,3 +77,30 @@ def test_planner_bench_receipt_contracts():
     rec = pl.record_from_artifact(out, source="bench", run="smoke")
     assert rec is not None and rec["label"] == "planner_step_time"
     assert rec["metrics"]["extras.train_executables"] == 1.0
+
+
+def test_calibrated_pick_never_worse_than_analytic(bench_receipts):
+    out = bench_receipts["planner_step_time_calibrated"]
+    ex = out["extras"]
+    # the committed table must match this (cpu, 8-device) smoke
+    assert ex["calibration"]["match"] == 1, (
+        "tools/cost_calibration.json is stale for cpu-8dev — "
+        "regenerate with tools/planner_calibrate.py --write")
+    assert ex["calibration"]["n_devices"] == out["n_devices"]
+    # both picks scored on the SAME (calibrated) ruler: the calibrated
+    # pick minimizes that ruler, so it can never score worse
+    assert ex["calibrated_pick_ms"] <= ex["analytic_pick_ms"] + 1e-9
+    assert out["value"] == ex["calibrated_pick_ms"]
+    for pick in (ex["analytic_pick"], ex["calibrated_pick"]):
+        assert set(pick) == {"dp", "fsdp", "tp", "pp"}
+        n = 1
+        for v in pick.values():
+            n *= v
+        assert n == out["n_devices"]
+
+    # its own ledger fingerprint, side-by-side with the measured leg
+    from paddle_tpu.analysis import perf_ledger as pl
+    rec = pl.record_from_artifact(out, source="bench", run="smoke-cal")
+    assert rec is not None
+    assert rec["label"] == "planner_step_time_calibrated"
+    assert rec["metrics"]["extras.calibration.match"] == 1.0

@@ -182,6 +182,9 @@ class TestLifecycle:
 
 
 class TestBf16Default:
+    @pytest.mark.slow  # ~8 s: tier-1 rebalance (PR 18); sibling
+    # test_bf16_pools_and_params keeps the bf16-default contract and
+    # TestDecodeParity keeps the determinism pin
     def test_default_dtype_is_bf16_and_deterministic(self, model):
         cfg = ServingConfig(max_slots=4, max_admit=2, block_size=4,
                             n_blocks=32, prefill_buckets=(8, 16),

@@ -111,6 +111,9 @@ class TestExactRequeue:
         assert ep["extras"]["requeued"] >= 1
         assert os.path.exists(ep["path"])
 
+    @pytest.mark.slow  # ~6 s: tier-1 rebalance (PR 18); sibling
+    # test_kill_mid_decode_replays_bit_identical keeps the
+    # exact-requeue contract
     def test_queued_requests_on_dead_replica_requeue_too(self, model,
                                                          tmp_path):
         """Requests dispatched to a replica's local queue (not yet

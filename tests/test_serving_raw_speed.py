@@ -117,6 +117,9 @@ class TestInt8:
         with pytest.raises(ValueError, match="quant"):
             f32_config(quant="bf16")
 
+    @pytest.mark.slow  # ~7 s: tier-1 rebalance (PR 18); sibling
+    # test_logits_drift_receipt_bounds keeps the int8 end-to-end leg
+    # and the unit quant tests keep the roundtrip/treedef contracts
     def test_int8_engine_serves_with_pinned_executables(self, model):
         eng = ServingEngine(model, f32_config(quant="int8")).warmup()
         rng = np.random.RandomState(5)
@@ -190,6 +193,9 @@ class TestSpeculative:
         eng.draft_cache.check_invariants()
         assert eng.draft_cache.n_free == eng.draft_cache.n_blocks - 1
 
+    @pytest.mark.slow  # ~7 s: tier-1 rebalance (PR 18); sibling
+    # test_bit_identical_to_greedy keeps the speculative-decode
+    # acceptance contract
     def test_draft_equals_target_accepts_everything(self, model):
         from paddle_tpu.observability import metrics
         eng = ServingEngine(model, f32_config(speculative_k=3),
@@ -406,6 +412,9 @@ class TestEngineSharing:
         # plain: two full 5-page allocations
         assert peak["shared"] < peak["plain"]
 
+    @pytest.mark.slow  # ~6 s: tier-1 rebalance (PR 18); siblings
+    # test_shared_prefix_parity_and_pages_fall +
+    # test_sharing_holds_fewer_fresh_pages keep the sharing contract
     def test_speculative_plus_sharing_compose(self, model, draft):
         eng = ServingEngine(
             model, f32_config(speculative_k=2, prefix_sharing=True),

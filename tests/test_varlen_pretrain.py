@@ -11,9 +11,9 @@ from paddle_tpu.models import ErnieConfig, ErnieForPretraining
 from paddle_tpu.static import TrainStep
 
 
-def _cfg(use_flash):
+def _cfg(use_flash, layers=2):
     return ErnieConfig(vocab_size=512, hidden_size=64,
-                       num_hidden_layers=2, num_attention_heads=2,
+                       num_hidden_layers=layers, num_attention_heads=2,
                        intermediate_size=128,
                        max_position_embeddings=32,
                        hidden_dropout_prob=0.0,
@@ -32,9 +32,9 @@ def _ragged_batch(rng, n=4, P=24):
     return ids, labels, lens
 
 
-def _build(use_flash, seed=5):
+def _build(use_flash, seed=5, layers=2):
     paddle.seed(seed)
-    m = ErnieForPretraining(_cfg(use_flash))
+    m = ErnieForPretraining(_cfg(use_flash, layers))
     opt = paddle.optimizer.AdamW(learning_rate=1e-3,
                                  parameters=m.parameters())
     step = TrainStep(
@@ -49,8 +49,12 @@ def test_varlen_trainstep_matches_masked_sdpa():
     mask = (np.arange(ids.shape[1])[None, :]
             < lens[:, None]).astype(np.int32)
 
-    _, step_flash = _build(True)
-    _, step_sdpa = _build(False)
+    # one layer: the flash-vs-SDPA parity contract is per-attention-op
+    # and this test compiles TWO TrainSteps — it was riding the 15 s
+    # tier-1 bar at 2 layers; the slow sibling below keeps the 2-layer
+    # varlen config exercised
+    _, step_flash = _build(True, layers=1)
+    _, step_sdpa = _build(False, layers=1)
     x = paddle.to_tensor(ids)
     y = paddle.to_tensor(labels)
     tl = paddle.to_tensor(lens)

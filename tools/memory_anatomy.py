@@ -51,6 +51,12 @@ sys.path.insert(0, REPO)
 N_DEV = int(os.environ.get("PD_MEMANAT_DEVICES", 2))
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "memory_baseline.json")
 
+#: per-layout predicted HBM (bytes/chip) from the plan's cost model,
+#: filled by build_planner — printed next to each measured peak and
+#: carried (with the delta) in the final receipt. PR 18's plan-audit
+#: join for the memory plane.
+PLANNER_PREDICTED = {}
+
 
 def _force_cpu_devices(n=None):
     """CPU XLA with >=2 virtual devices for the spmd program (inside
@@ -161,6 +167,19 @@ def build_planner(args):
         x = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
         y = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
         out.append((name, eng.aot_lower_train(x, y)))
+        # the plan's own HBM prediction for this layout (PR 18): the
+        # cost model's candidate-report number in bytes/chip, joined
+        # against the measured buffer-assignment peak below. SGD has
+        # no moment slots; the 2-layer stack is 2 "layers" of width².
+        try:
+            from paddle_tpu.distributed.sharding import ModelDims
+            dims = ModelDims(
+                n_params=2 * (width * width + width), hidden=width,
+                n_layers=2, seq=1, batch=batch, opt_slots=0)
+            receipt = plan.predict(dims, num_micro=M)
+            PLANNER_PREDICTED[name] = int(receipt.predicted_hbm_bytes)
+        except Exception:
+            pass  # prediction is observability: never sinks the table
     return out
 
 
@@ -217,6 +236,13 @@ def compute(args) -> dict:
             res = mem.program_memory(name, lowered,
                                      publish_gauges=args.publish)
             print(mem.format_table(res, title=name), flush=True)
+            pred = PLANNER_PREDICTED.get(name)
+            if pred is not None:
+                meas = int(res["memory"]["peak_bytes"])
+                err = abs(pred - meas) / max(pred, meas, 1)
+                print(f"  predicted HBM/chip (plan cost model): "
+                      f"{pred:,}  measured peak: {meas:,}  "
+                      f"error: {err:.1%}", flush=True)
             results[name] = res
     return results
 
@@ -316,6 +342,17 @@ def main(argv=None) -> int:
     summary = {
         "programs": sorted(checked),
         "peak_bytes": {p: checked[p]["peak_bytes"] for p in checked},
+        # measured-vs-predicted join for the planner layouts (PR 18):
+        # symmetric relative error, same definition as the plan-audit
+        # plane, so the receipt and the gauges agree
+        "planner_predicted_hbm": {
+            p: {"predicted_bytes": pred,
+                "measured_bytes": int(checked[p]["peak_bytes"]),
+                "error": round(
+                    abs(pred - checked[p]["peak_bytes"])
+                    / max(pred, checked[p]["peak_bytes"], 1), 4)}
+            for p, pred in sorted(PLANNER_PREDICTED.items())
+            if p in checked},
         "findings": len(findings),
         "regressions": sum(1 for f in findings
                            if f.severity == "error"),

@@ -44,6 +44,17 @@ Modes:
               ~1.0, dominant named) and the serving breach verdict —
               the zero-to-request-anatomy receipt. Shapes env-tunable
               (PD_SRV_REQUESTS/REPLICAS/RATE/HIDDEN/LAYERS).
+  --plan-audit   cost-model truth-plane bridge (PR 18): build the
+              standard planner leg (2-stage model under a dp×tp×pp
+              MeshPlan), run sentinel-guarded live steps, join the
+              measured planes onto the plan's PlanReceipt — step clock
+              p50 vs predicted step time, buffer-assignment peak vs
+              predicted HBM, compiled-HLO collective bytes + comm
+              counter delta vs predicted wire — publish the always-on
+              planner.prediction_error{metric=} gauges onto the pulse
+              rings, and print ONE JSON line with the error-shares
+              table, the worst-mispredicted component, and the
+              planner_prediction_error ledger receipt.
   --pulse     fleet-pulse receipt (the live-telemetry acceptance
               surface): arm the time-series sampler + the localhost
               pulse server over a RUNNING ServingFleet leg, scrape
@@ -653,6 +664,178 @@ def run_pulse(args):
     return 0 if summary["ok"] else 1
 
 
+def run_plan_audit(args):
+    """Plan-audit bridge (PR 18): zero-to-receipt drive of the
+    cost-model truth plane. Builds the standard planner leg (2-stage
+    model under a dp×tp×pp MeshPlan), runs live sentinel-guarded
+    steps, joins the measured planes onto the plan's PlanReceipt —
+    step time from the step clock, HBM peak from the memory plane's
+    buffer assignment, wire bytes from the compiled HLO's collective
+    inventory (compiler-placed collectives never reach the comm
+    counters) plus the comm counter delta over the live steps — and
+    publishes the always-on planner.prediction_error{metric=} gauges,
+    the error-shares table naming the worst-mispredicted component,
+    and the planner_prediction_error ledger receipt. Self-checks: all
+    three planes joined, shares sum to 1, gauges landed on the pulse
+    rings, zero recompiles, calibrated prediction used whenever the
+    committed table matches this topology."""
+    global jax, np, N_DEV
+    if jax is None and "PD_OBS_DEMO_DEVICES" not in os.environ:
+        N_DEV = 8   # the dp2×tp2×pp2 planner leg wants a full mesh
+    _jax_setup()
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed.sharding import MeshPlan, ModelDims
+    from paddle_tpu.observability import (calibration as cal,
+                                          exporters, memory as mem,
+                                          metrics, timeseries)
+
+    n = jax.device_count()
+    dp = 2 if n >= 8 else 1
+    tp = 2 if n >= 4 else 1
+    pp = min(2, n)
+    M = int(os.environ.get("PD_OBS_DEMO_MICRO", 2))
+    width = int(os.environ.get("PD_OBS_DEMO_WIDTH", 32))
+    batch = int(os.environ.get("PD_OBS_DEMO_BATCH", 16))
+    steps = int(os.environ.get("PD_OBS_DEMO_STEPS", 3))
+
+    metrics.enable()
+    timeseries.reset()
+    timeseries.enable(cadence_s=0.05, thread=False)
+
+    class _Stage(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(width, width)
+            self.lin.weight.sharding_spec = P(None, "tp")
+            self.lin.bias.sharding_spec = P("tp")
+
+        def forward(self, xx):
+            return paddle.tanh(self.lin(xx))
+
+    paddle.seed(0)
+    plan = MeshPlan(dp=dp, tp=tp, pp=pp)
+    eng = dist.PipelineParallel(
+        [_Stage() for _ in range(2)],
+        lambda o, y: ((o - y) ** 2).mean(),
+        paddle.optimizer.SGD(learning_rate=1e-3),
+        num_micro=M, mesh=plan.build_mesh(),
+        exec_mode="spmd_1f1b", plan=plan)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(batch, width).astype(np.float32))
+
+    eng.train_batch(x, y)   # compile (sentinel baselines here)
+    counters_before = _wire_counter_total(metrics.snapshot())
+    clock = profiler.StepClock()
+    for _ in range(steps):
+        with clock.step():
+            loss = eng.train_batch(x, y)
+            float(loss.item())   # device-complete inside the bracket
+    counter_wire = _wire_counter_total(metrics.snapshot()) \
+        - counters_before
+
+    # the prediction: the plan's own receipt, re-scored against the
+    # committed calibration table (SGD: no moment slots; the 2-layer
+    # stack is 2 "layers" of width² — same dims memory_anatomy uses)
+    dims = ModelDims(n_params=2 * (width * width + width),
+                     hidden=width, n_layers=2, seq=1, batch=batch,
+                     opt_slots=0)
+    receipt = plan.predict(dims, num_micro=M, calibration="auto")
+
+    # the measured planes. HBM: buffer-assignment peak of the SAME
+    # lowered executable. Wire: compiled-HLO collective inventory
+    # (per-shard shapes ≈ per-chip bytes) + the comm counter delta —
+    # the two sides see disjoint traffic (compiler-placed vs explicit)
+    lowered = eng.aot_lower_train(x, y)
+    mem_res = mem.program_memory("plan_audit", lowered)
+    hlo_wire = cal.compiled_collective_bytes(lowered=lowered)
+    measured = {
+        "step_time_s": clock.step_ms(50) / 1e3,
+        "hbm_bytes": float(mem_res["memory"]["peak_bytes"]),
+        "wire_bytes": hlo_wire["total_bytes"] + counter_wire,
+    }
+    report = cal.audit_report(receipt, measured,
+                              platform="cpu", n_devices=n,
+                              jsonl_path=args.jsonl)
+    timeseries.sample(force=True)
+    ring_keys = timeseries.keys(prefix="planner.prediction_error")
+    ring_points = sum(
+        len(timeseries.series(k)) for k in ring_keys)
+    if args.prom:
+        exporters.write_prometheus(args.prom)
+    timeseries.disable()
+    metrics.disable()
+
+    extras = report.get("extras", {})
+    errors = extras.get("prediction_error", {})
+    shares = extras.get("error_share", {})
+    table = cal.load_table()
+    table_matches = bool(
+        table and cal.Calibration(table).matches("cpu", n))
+    summary = {
+        "ok": True,
+        "layout": dict(plan.sizes),
+        "audit": report,
+        "predicted": extras.get("predicted"),
+        "measured": extras.get("measured"),
+        "prediction_error": errors,
+        "error_share": shares,
+        "worst": extras.get("worst"),
+        "used": receipt.used,
+        "calibration_match": receipt.calibration_match,
+        "hlo_collective_calls": hlo_wire["calls"],
+        "counter_wire_bytes": counter_wire,
+        "pulse_ring_keys": ring_keys,
+        "pulse_ring_points": ring_points,
+        "train_executables": eng.compile_count,
+        "train_recompiles": eng.recompile_sentinel.fired,
+        "prometheus": args.prom, "jsonl": args.jsonl,
+    }
+    problems = []
+    if report.get("value") != 3 or len(errors) != 3:
+        problems.append(
+            f"joined {report.get('value')}/3 planes "
+            f"(errors: {sorted(errors)}) — a dropped join hides "
+            "future drift")
+    if shares and abs(sum(shares.values()) - 1.0) > 0.02 \
+            and sum(errors.values()) > 0:
+        problems.append(f"error shares sum to {sum(shares.values())}")
+    if errors and not extras.get("worst"):
+        problems.append("no worst-mispredicted component named")
+    if ring_points < 1:
+        problems.append("planner.prediction_error gauges never "
+                        "reached the pulse rings")
+    if eng.recompile_sentinel.fired != 0 or eng.compile_count != 1:
+        problems.append(
+            f"audit must never touch the train executable: "
+            f"{eng.recompile_sentinel.fired} recompiles, "
+            f"{eng.compile_count} executables (want 0/1)")
+    if table_matches and receipt.used != "calibrated":
+        problems.append(
+            "committed calibration table matches this topology but "
+            "the prediction ran analytic — load_for is broken")
+    if problems:
+        summary["ok"] = False
+        summary["problems"] = problems
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+def _wire_counter_total(snap) -> float:
+    """Bytes the EXPLICIT comm paths counted: comm.wire_bytes (the
+    compressed on-wire series) plus collective.bytes (trace-time
+    recorded collectives). The planner executable's collectives are
+    compiler-placed — invisible here, measured from the HLO instead."""
+    return float(sum(
+        v.get("value", 0.0) for k, v in snap.items()
+        if k.startswith("comm.wire_bytes")
+        or k.startswith("collective.bytes")))
+
+
 def get_status(srv, path: str):
     """GET that tolerates non-200 (urllib raises on 404)."""
     import urllib.error
@@ -696,6 +879,10 @@ def main(argv=None):
     ap.add_argument("--memory", action="store_true")
     ap.add_argument("--serving", action="store_true")
     ap.add_argument("--pulse", action="store_true")
+    ap.add_argument("--plan-audit", action="store_true",
+                    dest="plan_audit",
+                    help="measured-vs-predicted plan audit receipt "
+                         "(cost-model truth plane)")
     ap.add_argument("--force-recompile", action="store_true")
     ap.add_argument("--doctor", default=None, metavar="DIR",
                     help="diagnose flight-recorder dumps in DIR "
@@ -708,6 +895,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.doctor:
         return run_doctor(args)
+    if args.plan_audit:
+        return run_plan_audit(args)
     if args.pulse:
         return run_pulse(args)
     if args.serving:

@@ -239,6 +239,21 @@ def main(argv=None) -> int:
             checked_runs.append(r.get("run"))
             findings.extend(pl.check_record(r, baseline,
                                             tolerance=args.tolerance))
+        # calibration-table staleness rides every --check: a planner
+        # audit that fell back to analytic constants (or a table
+        # committed for a different mesh) is named loudly here, the
+        # same place the exact-better calibration.match gate trips
+        cal_table = None
+        cal_path = os.environ.get(
+            "PD_COST_CALIBRATION",
+            os.path.join(REPO, "tools", "cost_calibration.json"))
+        if os.path.exists(cal_path):
+            try:
+                with open(cal_path) as fh:
+                    cal_table = json.load(fh)
+            except ValueError:
+                cal_table = None
+        findings.extend(pl.check_calibration(records, cal_table))
         for f in findings:
             print(f.summary(), flush=True)
         rc = 1 if any(f.severity == "error" for f in findings) else 0

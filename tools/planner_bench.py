@@ -24,6 +24,17 @@ Shapes are env-tunable so the tier-1 smoke stays cheap:
 PD_PLANNER_BENCH_DEVICES, PD_PLANNER_BENCH_MICRO,
 PD_PLANNER_BENCH_WIDTH, PD_PLANNER_BENCH_BATCH,
 PD_PLANNER_BENCH_STEPS.
+
+``--calibration`` (PR 18) appends a SECOND receipt line — metric
+``planner_step_time_calibrated``, its own ledger fingerprint riding
+side-by-side with the measured one — comparing the layout the ANALYTIC
+cost model picks against the layout the calibrated table picks for the
+bench model, both scored on the calibrated ruler (absolute ms from the
+committed tools/cost_calibration.json). The smoke pins that the
+calibrated pick is never worse than the analytic pick on that ruler —
+true by construction when the table matches (the calibrated pick
+minimizes it), so a violation means the table didn't load: a staleness
+regression, not a modeling one.
 """
 import json
 import os
@@ -147,6 +158,69 @@ def main():
     except Exception as e:  # pragma: no cover — the artifact survives
         out["obs_export_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))
+
+    if "--calibration" in sys.argv:
+        print(json.dumps(calibration_receipt(width, batch, M)))
+
+
+def calibration_receipt(width: int, batch: int, num_micro: int):
+    """Analytic pick vs calibrated pick for the bench model, BOTH
+    scored in absolute calibrated ms — the second ledger line
+    --calibration appends."""
+    from paddle_tpu.distributed.sharding import (ModelDims,
+                                                 choose_layout,
+                                                 estimate_layout)
+    from paddle_tpu.observability import calibration as cal
+
+    pp_stages = 2
+    n_params = pp_stages * (width * width + width)
+    dims = ModelDims(n_params=n_params, hidden=width,
+                     n_layers=pp_stages, seq=1, batch=batch)
+    hbm = float(2 ** 34)  # everything fits: ranking, not feasibility
+    calib = cal.load_for(n_devices=jax.device_count())
+
+    analytic_sizes, _ = choose_layout(jax.device_count(), dims, hbm,
+                                      num_micro=num_micro)
+    calib_sizes, _ = choose_layout(jax.device_count(), dims, hbm,
+                                   num_micro=num_micro,
+                                   calibration=calib)
+
+    def on_ruler(sizes):
+        # score on the calibrated ruler when the table matched,
+        # analytic otherwise (then both picks coincide by definition)
+        cost = estimate_layout(sizes, dims, hbm, num_micro=num_micro,
+                               calibration=calib)
+        return cost.calibrated_step_time_s if calib is not None \
+            else cost.analytic_step_time_s
+
+    analytic_pick_s = on_ruler(analytic_sizes)
+    calib_pick_s = on_ruler(calib_sizes)
+    out = {
+        "metric": "planner_step_time_calibrated",
+        "unit": "ms",
+        "value": round(calib_pick_s * 1e3, 6),
+        "platform": "cpu",
+        "n_devices": jax.device_count(),
+        "extras": {
+            "analytic_pick": dict(analytic_sizes),
+            "calibrated_pick": dict(calib_sizes),
+            "analytic_pick_ms": round(analytic_pick_s * 1e3, 6),
+            "calibrated_pick_ms": round(calib_pick_s * 1e3, 6),
+            "calibration": {
+                "match": 1 if calib is not None else 0,
+                "n_devices": calib.n_devices if calib else -1,
+            },
+            "model_params": dims.n_params,
+        },
+    }
+    try:
+        from paddle_tpu.observability import exporters as obs_exporters
+        out = obs_exporters.emit_report(
+            out, jsonl_path=os.environ.get("PD_OBS_JSONL"),
+            prefix="bench.planner_calibrated")
+    except Exception as e:  # pragma: no cover
+        out["obs_export_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 if __name__ == "__main__":

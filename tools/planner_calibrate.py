@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Generate / inspect the planner's cost-calibration table.
+
+Usage:
+    python tools/planner_calibrate.py            # print table (stdout)
+    python tools/planner_calibrate.py --write    # write committed table
+    python tools/planner_calibrate.py --check    # verify committed table
+                                                 #   matches live identity
+    python tools/planner_calibrate.py --measure  # force real timing even
+                                                 #   on cpu (NOT committed:
+                                                 #   non-deterministic)
+
+The committed ``tools/cost_calibration.json`` is keyed by
+(device_kind, topology fingerprint). On CPU the probes are synthetic
+closed-form (bit-identical across runs — CI pins this); on
+accelerators the same harness times real matmuls / collectives / HBM
+copies. ``--check`` exits 1 on a stale table, mirroring the loud
+fallback ``observability.calibration.load_for`` performs at plan time.
+
+Env: PD_COST_CALIBRATION overrides the table path,
+PD_CALIBRATE_DEVICES pins a virtual CPU device count (default 8, the
+repo's standard test mesh).
+"""
+import json
+import os
+import sys
+
+
+def _setup_devices():
+    if "PD_CALIBRATE_DEVICES" in os.environ or not os.environ.get(
+            "XLA_FLAGS"):
+        n = int(os.environ.get("PD_CALIBRATE_DEVICES", "8"))
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "").replace(
+                "--xla_force_host_platform_device_count=", "--_was=")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    write = "--write" in argv
+    check = "--check" in argv
+    measure = "--measure" in argv
+    _setup_devices()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.observability import calibration as cal
+
+    if check:
+        ident = cal.device_identity()
+        table = cal.load_table()
+        problems = []
+        if table is None:
+            problems.append(f"no table at {cal.default_table_path()}")
+        else:
+            calib = cal.Calibration(table)
+            if not calib.matches(ident["device_kind"],
+                                 ident["n_devices"]):
+                problems.append(
+                    "stale: table %r vs live %r" % (
+                        calib.topology, cal.topology_fingerprint(
+                            ident["device_kind"], ident["n_devices"])))
+        print(json.dumps({"calibration_check": {
+            "path": cal.default_table_path(),
+            "live": cal.topology_fingerprint(ident["device_kind"],
+                                             ident["n_devices"]),
+            "table": (table or {}).get("topology"),
+            "problems": problems}}))
+        return 1 if problems else 0
+
+    table = cal.build_table(synthetic=False if measure else None)
+    if write:
+        path = cal.save_table(table)
+        print(json.dumps({"calibration_written": {
+            "path": path, "topology": table["topology"],
+            "synthetic": table["synthetic"]}}))
+        return 0
+    json.dump(table, sys.stdout, indent=1, sort_keys=True)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
