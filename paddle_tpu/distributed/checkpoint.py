@@ -45,6 +45,7 @@ import jax
 import numpy as np
 
 from ..framework import Tensor
+from ..observability import decisions as _dec
 from ..observability import flight_recorder as _fr
 from ..observability import metrics as _obs
 from .. import serialization
@@ -601,6 +602,53 @@ def candidate_healthy(topo: Optional[dict]) -> bool:
     return bool(((topo or {}).get("health") or {}).get("healthy"))
 
 
+def rollback_plan(candidates: List[dict], step: int,
+                  best_effort: bool = True,
+                  require_healthy: bool = False) -> List[dict]:
+    """The PURE rollback walk: the exact ordered attempt list
+    ``load_at_or_before`` executes, derived from the candidate
+    metadata alone — no filesystem, no clock. Each candidate is
+    ``{"name", "step" (int or None), "healthy" (bool)}`` in the
+    newest-first order ``_load_candidates`` yields. Returns attempt
+    entries ``{"cand", "step", "tag"}`` where tag is ``walk`` (an
+    in-cut restore attempt), ``skip_unhealthy`` (certified pass walked
+    past an uncertified candidate), or ``gap`` (best-effort landing on
+    a too-new candidate, data loss recorded loudly).
+
+    This is the decision ledger's replay surface for the certified
+    rollback: ``tools/incident_replay.py`` feeds a dumped record's
+    candidate evidence back through here and asserts the recorded plan
+    bit-identically — any refactor of the walk order fails in CI, not
+    on a burning pod."""
+    attempts: List[dict] = []
+    too_new: List[dict] = []
+    passes = ["certified", "any"] if require_healthy else ["any"]
+    for pass_name in passes:
+        for c in candidates:
+            s = c.get("step")
+            if s is None:
+                continue
+            if int(s) > int(step):
+                if pass_name == passes[0]:
+                    too_new.append(c)
+                continue
+            if pass_name == "certified" and not c.get("healthy"):
+                attempts.append({"cand": c["name"], "step": int(s),
+                                 "tag": "skip_unhealthy"})
+                continue
+            attempts.append({"cand": c["name"], "step": int(s),
+                             "tag": "walk"})
+    if best_effort:
+        gap = list(reversed(too_new))
+        if require_healthy:
+            gap = ([c for c in gap if c.get("healthy")]
+                   + [c for c in gap if not c.get("healthy")])
+        for c in gap:
+            attempts.append({"cand": c["name"], "step": int(c["step"]),
+                             "tag": "gap"})
+    return attempts
+
+
 def load_at_or_before(path: str, step: int,
                       target: Optional[dict] = None,
                       best_effort: bool = True,
@@ -640,6 +688,48 @@ def load_at_or_before(path: str, step: int,
     failed: set = set()  # candidates that already failed a restore —
     #                      retrying in a later pass would double-count
     #                      corruptions and waste a full restore
+
+    # the ledger's evidence snapshot: every candidate's (step, health)
+    # as the walk will see them, in walk order — each skipped or
+    # decertified candidate IS evidence for the rollback decision
+    cand_meta: List[dict] = []
+    if _dec.enabled():
+        for _c in _load_candidates(path, is_dir=ocp is not None):
+            _t = _candidate_topology(_c)
+            cand_meta.append({
+                "name": os.path.basename(str(_c).rstrip("/")),
+                "step": (int(_t["step"]) if _t is not None
+                         and _t.get("step") is not None else None),
+                "healthy": candidate_healthy(_t)})
+
+    def _ledger_rollback(cand, topo, tag):
+        if not _dec.enabled():
+            return None
+        plan = rollback_plan(cand_meta, step,
+                             best_effort=best_effort,
+                             require_healthy=require_healthy)
+        certified = candidate_healthy(topo)
+        return _dec.record(
+            "checkpoint.rollback", "rollback",
+            rule=("certified consistent-cut walk" if require_healthy
+                  else "consistent-cut walk"),
+            evidence={
+                "inputs": {
+                    "step": int(step),
+                    "best_effort": bool(best_effort),
+                    "require_healthy": bool(require_healthy),
+                    "candidates": cand_meta,
+                    "failed": sorted(
+                        os.path.basename(str(c).rstrip("/"))
+                        for c in failed)},
+                "decision": {
+                    "action": "rollback",
+                    "chosen": os.path.basename(str(cand).rstrip("/")),
+                    "chosen_step": int(topo["step"]),
+                    "tag": tag, "certified": certified,
+                    "plan": plan}},
+            signals={"restored": 0, "healthy": 0},
+            post_signals={"restored": 1, "healthy": int(certified)})
 
     def _try_restore(cand):
         nonlocal last_err
@@ -682,6 +772,10 @@ def load_at_or_before(path: str, step: int,
             if out is None:
                 continue
             _note_uncertified(cand, topo)
+            did = _ledger_rollback(cand, topo, tag="walk")
+            if did is not None:
+                topo = dict(topo)
+                topo["rollback_decision_id"] = did
             return out, topo
     if best_effort:
         # oldest too-new candidate first (smallest gap); under
@@ -706,6 +800,10 @@ def load_at_or_before(path: str, step: int,
                        wanted_step=int(step),
                        got_step=int(topo["step"]))
             _note_uncertified(cand, topo)
+            did = _ledger_rollback(cand, topo, tag="gap")
+            if did is not None:
+                topo = dict(topo)
+                topo["rollback_decision_id"] = did
             return out, topo
     raise RuntimeError(
         f"no checkpoint at or before step {step} under {path} — the "

@@ -53,6 +53,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..observability import decisions as _dec
 from ..observability import metrics as _obs
 
 __all__ = ["Decision", "SupervisorPolicy", "effective_verdict",
@@ -86,6 +87,16 @@ class Decision:
     reason: str = ""
     episode: int = 0
     verdict: dict = field(default_factory=lambda: dict(NONE_VERDICT))
+    decision_id: Optional[str] = None   # ledger id (decisions.record);
+                                        # call sites stamp it into their
+                                        # remediation/scale receipts
+
+    def as_dict(self) -> dict:
+        """The replay-comparison surface: everything the decision IS,
+        minus the ledger id (assigned at record time, not decided)."""
+        return {"action": self.action, "ranks": list(self.ranks),
+                "delay_s": self.delay_s, "reason": self.reason,
+                "episode": self.episode, "verdict": dict(self.verdict)}
 
 
 def translate_verdict_rank(verdict: Optional[dict],
@@ -184,6 +195,65 @@ class SupervisorPolicy:
         self._consecutive = 0
         self._last_respawn: Optional[float] = None
         self._last_scale: Optional[float] = None
+        self._grow_deferred = False   # dedup: one grow_deferred record
+                                      # per exhausted-budget episode
+
+    # -- replayable state ----------------------------------------------------
+    def state_snapshot(self) -> dict:
+        """JSON-safe snapshot of config + mutable state. Every ledger
+        record carries the snapshot the decision READ, so
+        tools/incident_replay.py can rebuild this exact policy
+        (``from_snapshot``) and re-run the decision bit-identically."""
+        return {
+            "world": self.world, "max_restarts": self.max_restarts,
+            "policy": self.policy,
+            "backoff_base": self.backoff_base,
+            "backoff_factor": self.backoff_factor,
+            "backoff_max": self.backoff_max,
+            "restart_window_s": self.restart_window_s,
+            "restart_budget": self.restart_budget,
+            "allow_shrink": self.allow_shrink,
+            "min_world": self.min_world,
+            "grow_after_s": self.grow_after_s,
+            "heal_after_s": self.heal_after_s,
+            "scale_cooldown_s": self.scale_cooldown_s,
+            "active": list(self.active),
+            "evicted": {str(r): float(ts)
+                        for r, ts in self.evicted.items()},
+            "episode": self.episode, "restarts": self.restarts,
+            "respawn_ts": list(self._respawn_ts),
+            "consecutive": self._consecutive,
+            "last_respawn": self._last_respawn,
+            "last_scale": self._last_scale,
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "SupervisorPolicy":
+        """Rebuild a policy from ``state_snapshot()`` output (JSON
+        round-trip safe — evicted keys come back as strings)."""
+        p = cls(world=snap["world"],
+                max_restarts=snap["max_restarts"],
+                policy=snap["policy"],
+                backoff_base=snap["backoff_base"],
+                backoff_factor=snap["backoff_factor"],
+                backoff_max=snap["backoff_max"],
+                restart_window_s=snap["restart_window_s"],
+                restart_budget=snap["restart_budget"],
+                allow_shrink=snap["allow_shrink"],
+                min_world=snap["min_world"],
+                grow_after_s=snap["grow_after_s"],
+                heal_after_s=snap["heal_after_s"],
+                scale_cooldown_s=snap["scale_cooldown_s"])
+        p.active = [int(r) for r in snap["active"]]
+        p.evicted = {int(r): float(ts)
+                     for r, ts in snap["evicted"].items()}
+        p.episode = int(snap["episode"])
+        p.restarts = int(snap["restarts"])
+        p._respawn_ts = [float(t) for t in snap["respawn_ts"]]
+        p._consecutive = int(snap["consecutive"])
+        p._last_respawn = snap["last_respawn"]
+        p._last_scale = snap["last_scale"]
+        return p
 
     # -- observations --------------------------------------------------------
     def note_progress(self, now: Optional[float] = None):
@@ -218,27 +288,51 @@ class SupervisorPolicy:
 
     def decide(self, failures: Sequence[Tuple[int, str]],
                doctor_verdict: Optional[dict] = None,
-               now: Optional[float] = None) -> Decision:
+               now: Optional[float] = None,
+               evidence_ts: Optional[float] = None) -> Decision:
         """One failure episode → one Decision. `failures` are
-        (global_rank, why) pairs from the supervisor's own detection."""
+        (global_rank, why) pairs from the supervisor's own detection.
+        Replay-determinism contract: every branch reads only (self,
+        arguments) — no wall clock (`now` is injected), no ambient
+        state. `evidence_ts` is ledger metadata (when the doctor
+        evidence was observed; tpu_doctor's staleness check), never a
+        decision input."""
         now = time.monotonic() if now is None else now
+        state = self.state_snapshot()
+        inputs = {"failures": [[int(r), str(w)] for r, w in failures],
+                  "doctor_verdict": (dict(doctor_verdict)
+                                     if doctor_verdict else None),
+                  "now": now}
         self.episode += 1
+
+        def _led(d: Decision) -> Decision:
+            d.decision_id = _dec.record(
+                "supervisor.remediate", d.action,
+                rule=d.reason or d.action,
+                evidence={"inputs": inputs, "state": state,
+                          "decision": d.as_dict()},
+                signals={"failures": len(failures),
+                         "episode": self.episode},
+                settle_s=self.heal_after_s, clock=now,
+                evidence_ts=evidence_ts)
+            return d
+
         v = effective_verdict(failures, doctor_verdict)
         # crash-loop guards run BEFORE any respawn so a worker dying at
         # import cannot burn the budget in seconds
         if self.restarts + 1 > self.max_restarts:
-            return Decision(
+            return _led(Decision(
                 "abort", reason=f"max_restarts={self.max_restarts}",
-                episode=self.episode, verdict=v)
+                episode=self.episode, verdict=v))
         if self.restart_budget:
             recent = [t for t in self._respawn_ts
                       if now - t <= self.restart_window_s]
             if len(recent) + 1 > self.restart_budget:
-                return Decision(
+                return _led(Decision(
                     "abort",
                     reason=(f"restart budget {self.restart_budget}/"
                             f"{self.restart_window_s:g}s"),
-                    episode=self.episode, verdict=v)
+                    episode=self.episode, verdict=v))
         delay = self.backoff_delay()
         self._consecutive += 1
         # eviction: verdict names a rank precisely, shrink is allowed,
@@ -249,24 +343,34 @@ class SupervisorPolicy:
             rank = int(v["rank"])
             self.active.remove(rank)
             self.evicted[rank] = now
-            return Decision("evict_shrink", ranks=[rank], delay_s=delay,
-                            reason=f"evict rank {rank} ({v['kind']})",
-                            episode=self.episode, verdict=v)
+            return _led(Decision(
+                "evict_shrink", ranks=[rank], delay_s=delay,
+                reason=f"evict rank {rank} ({v['kind']})",
+                episode=self.episode, verdict=v))
         if self.policy == "rank":
             ranks = sorted({int(r) for r, _ in failures}) or list(
                 self.active)
-            return Decision("respawn_rank", ranks=ranks, delay_s=delay,
-                            reason="rank restart", episode=self.episode,
-                            verdict=v)
-        return Decision("respawn_gang", ranks=list(self.active),
-                        delay_s=delay, reason="gang restart",
-                        episode=self.episode, verdict=v)
+            return _led(Decision(
+                "respawn_rank", ranks=ranks, delay_s=delay,
+                reason="rank restart", episode=self.episode,
+                verdict=v))
+        return _led(Decision(
+            "respawn_gang", ranks=list(self.active),
+            delay_s=delay, reason="gang restart",
+            episode=self.episode, verdict=v))
 
     def maybe_grow(self, now: Optional[float] = None) -> Optional[Decision]:
         """Grow back to full size once a replacement slot is available
         — here, once the evicted rank's cooldown (`grow_after_s`)
         passed, modeling a preempted host coming back. Disabled when
-        grow_after_s == 0."""
+        grow_after_s == 0.
+
+        A grow is a SPAWN: it spends the same restarts-per-window
+        budget a scale_up does (``record_scale_spawn`` per restored
+        slot — the window bounds spawning, whatever triggered it) and
+        DEFERS while the budget is exhausted instead of bypassing the
+        flap guard, leaving a ``grow_deferred`` ledger record so the
+        non-action is auditable too."""
         if not self.grow_after_s or not self.evicted:
             return None
         now = time.monotonic() if now is None else now
@@ -274,15 +378,41 @@ class SupervisorPolicy:
                        if now - ts >= self.grow_after_s)
         if not ready:
             return None
+        state = self.state_snapshot()
+        inputs = {"now": now, "ready": list(ready)}
+        if self.restart_budget:
+            recent = [t for t in self._respawn_ts
+                      if now - t <= self.restart_window_s]
+            if len(recent) + len(ready) > self.restart_budget:
+                if not self._grow_deferred:
+                    self._grow_deferred = True
+                    _dec.record(
+                        "supervisor.grow", "grow_deferred",
+                        rule=(f"restart budget {self.restart_budget}/"
+                              f"{self.restart_window_s:g}s exhausted: "
+                              f"grow of {ready} deferred"),
+                        evidence={"inputs": inputs, "state": state,
+                                  "decision": None},
+                        clock=now)
+                return None
+        self._grow_deferred = False
         for r in ready:
             del self.evicted[r]
             self.active.append(r)
+            self.record_scale_spawn(now=now)
         self.active.sort()
         self.episode += 1
-        return Decision("grow", ranks=ready, delay_s=0.0,
-                        reason=f"replacement for rank(s) {ready}",
-                        episode=self.episode,
-                        verdict=dict(NONE_VERDICT))
+        d = Decision("grow", ranks=ready, delay_s=0.0,
+                     reason=f"replacement for rank(s) {ready}",
+                     episode=self.episode,
+                     verdict=dict(NONE_VERDICT))
+        d.decision_id = _dec.record(
+            "supervisor.grow", "grow", rule=d.reason,
+            evidence={"inputs": inputs, "state": state,
+                      "decision": d.as_dict()},
+            signals={"failures": 0, "episode": self.episode},
+            settle_s=self.heal_after_s, clock=now)
+        return d
 
     # -- serving mode --------------------------------------------------------
     def decide_scale(self, slo, queued: int, p99_ttft_ms: float,
@@ -334,6 +464,7 @@ class SupervisorPolicy:
                            - set(self.evicted))
             if not spare:
                 return None  # every spare slot is an eviction cooldown
+            state = self.state_snapshot()
             slot = spare[0]
             self.active.append(slot)
             self.active.sort()
@@ -347,7 +478,7 @@ class SupervisorPolicy:
                       "window (burn rate > 1)")
             kind = ("slo_breach" if breach
                     else "overload" if hot else "budget_burn")
-            return Decision(
+            return self._ledger_scale(Decision(
                 "scale_up", ranks=[slot], episode=self.episode,
                 reason=reason,
                 verdict={"kind": kind,
@@ -355,15 +486,17 @@ class SupervisorPolicy:
                          "evidence": {"queued": int(queued),
                                       "p99_ttft_ms": float(p99_ttft_ms),
                                       "burn_alert": burn,
-                                      "live": live}})
+                                      "live": live}}),
+                state, slo, queued, p99_ttft_ms, burn, now)
         if (not hot and not breach and not burn and p99_ttft_ms >= 0
                 and live > self.min_world
                 and queued <= int(slo.queue_low) * live):
+            state = self.state_snapshot()
             slot = max(self.active)
             self.active.remove(slot)
             self._last_scale = now
             self.episode += 1
-            return Decision(
+            return self._ledger_scale(Decision(
                 "scale_down", ranks=[slot], episode=self.episode,
                 reason=(f"idle: queued {queued} <= {slo.queue_low}"
                         f"/replica x {live}, p99 {p99_ttft_ms:.0f}ms"),
@@ -371,8 +504,35 @@ class SupervisorPolicy:
                          "source": "serving_policy",
                          "evidence": {"queued": int(queued),
                                       "p99_ttft_ms": float(p99_ttft_ms),
-                                      "live": live}})
+                                      "live": live}}),
+                state, slo, queued, p99_ttft_ms, burn, now)
         return None
+
+    def _ledger_scale(self, d: Decision, state: dict, slo,
+                      queued: int, p99_ttft_ms: float, burn: bool,
+                      now: float) -> Decision:
+        """Record one serving-scale decision: evidence = the exact
+        signals + pre-mutation state decide_scale read; the joiner
+        re-reads queue/p99 from the fleet's per-tick ``observe`` once
+        the (shared-cooldown-sized) settle window passes — the next
+        legal scale instant is exactly when "did it help" is asked."""
+        d.decision_id = _dec.record(
+            "supervisor.scale", d.action, rule=d.reason,
+            evidence={
+                "inputs": {
+                    "slo": {"p99_ttft_ms": float(
+                                getattr(slo, "p99_ttft_ms", 0.0) or 0.0),
+                            "queue_high": int(slo.queue_high),
+                            "queue_low": int(
+                                getattr(slo, "queue_low", 0))},
+                    "queued": int(queued),
+                    "p99_ttft_ms": float(p99_ttft_ms),
+                    "burn_alert": bool(burn), "now": now},
+                "state": state, "decision": d.as_dict()},
+            signals={"queued": int(queued),
+                     "p99_ttft_ms": float(p99_ttft_ms)},
+            settle_s=self.scale_cooldown_s, clock=now)
+        return d
 
 
 # -- doctor bridge ------------------------------------------------------------
@@ -411,7 +571,7 @@ def collect_diagnosis(dump_dir: str,
                  if os.path.getmtime(p) >= since_ts]
     out = {"dumps": len(paths), "diagnosis": None,
            "verdict": dict(NONE_VERDICT), "resume_step": None,
-           "goodput": None}
+           "goodput": None, "evidence_ts": None}
     if not paths or doctor is None:
         return out
     try:
@@ -421,6 +581,14 @@ def collect_diagnosis(dump_dir: str,
         return out  # an unreadable dump must not kill the supervisor
     out["diagnosis"] = diag
     out["verdict"] = doctor.verdict(diag)
+    # when the verdict's evidence was OBSERVED (newest contributing
+    # dump): the ledger's staleness check compares this against the
+    # incarnation boundary — acting on a previous incarnation's dumps
+    # is the PR 8(i) failure class
+    ts_seen = [d.get("ts") for d in dumps
+               if isinstance(d.get("ts"), (int, float))]
+    if ts_seen:
+        out["evidence_ts"] = float(max(ts_seen))
     steps = [(d.get("progress") or {}).get("steps") for d in dumps]
     steps = [s for s in steps if s is not None]  # step 0 is a step
     if steps:
@@ -444,6 +612,7 @@ def emit_receipt(episode: int, verdict: dict, action: str,
                  goodput_delta: Optional[float] = None,
                  delay_s: float = 0.0, reason: str = "",
                  extras: Optional[dict] = None,
+                 decision_id: Optional[str] = None,
                  out_dir: Optional[str] = None) -> dict:
     """Write one structured remediation receipt and mirror it into the
     always-on ``elastic.*`` registry series (counters stay visible with
@@ -463,6 +632,11 @@ def emit_receipt(episode: int, verdict: dict, action: str,
         "backoff_s": round(float(delay_s), 3),
         "reason": reason,
     }
+    if decision_id:
+        # the receipt ↔ ledger join key: every autonomous action's
+        # receipt names the DecisionRecord that drove it (the chaos
+        # drills assert this and a joined outcome)
+        doc["decision_id"] = decision_id
     if extras:
         # free-form evidence the action's subsystem wants on the paper
         # trail (dump dir, requeue counts, per-class TTFT, ...)

@@ -148,6 +148,7 @@ def _elastic_supervise(args, world) -> int:
     from .fleet.utils import KVServer
     from .fleet.utils.heartbeat import HeartbeatMonitor
     from . import elastic
+    from ..observability import decisions as _ledger
 
     if args.nnodes > 1:
         # a launcher-private KV can't see remote ranks, and a gang
@@ -249,6 +250,10 @@ def _elastic_supervise(args, world) -> int:
         completed.clear()
         gang_epoch["v"] += 1
         since_ts["v"] = time.time()  # close this episode's dump window
+        # incarnation boundary for the ledger: a decision made after
+        # this instant on evidence observed before it is acted-on-
+        # stale-evidence (tpu_doctor flags those)
+        _ledger.note_bounce()
         for lr in policy.active:
             incarnation[lr] += 1
             procs[lr] = spawn_slot(lr)
@@ -277,6 +282,13 @@ def _elastic_supervise(args, world) -> int:
         while True:
             time.sleep(0.25)
             policy.note_progress()
+            # steady-state post-signals for the outcome joiner: a
+            # healthy poll is the evidence a remediation/grow worked
+            # (failures back to zero); pending records join once their
+            # settle window expires
+            _ledger.observe("supervisor.remediate", {"failures": 0})
+            _ledger.observe("supervisor.grow", {"failures": 0})
+            _ledger.join_outcomes()
             failed = []
             for lr, p in list(procs.items()):
                 if lr in completed or lr not in policy.active:
@@ -321,6 +333,7 @@ def _elastic_supervise(args, world) -> int:
                         world_after=len(policy.active),
                         reason=grow.reason,
                         extras={"dump_dir": dump_dir},
+                        decision_id=grow.decision_id,
                         out_dir=receipts)
                 continue
 
@@ -343,7 +356,8 @@ def _elastic_supervise(args, world) -> int:
             # stable slots — translate before any slot comparison
             verdict = elastic.translate_verdict_rank(
                 bundle["verdict"], ranks_now)
-            decision = policy.decide(failed, verdict)
+            decision = policy.decide(
+                failed, verdict, evidence_ts=bundle.get("evidence_ts"))
             if decision.action == "abort":
                 print(f"[elastic] rank(s) {[f[0] for f in failed]} "
                       f"failed and {decision.reason} "
@@ -359,6 +373,7 @@ def _elastic_supervise(args, world) -> int:
                     goodput=bundle["goodput"],
                     reason=decision.reason,
                     extras={"dump_dir": dump_dir},
+                    decision_id=decision.decision_id,
                     out_dir=receipts)
                 monitor.close()
                 return 1
@@ -437,11 +452,21 @@ def _elastic_supervise(args, world) -> int:
                 # the receipt an operator reads at 3am should name
                 # where the black boxes that drove the verdict live
                 extras={"dump_dir": dump_dir},
+                decision_id=decision.decision_id,
                 out_dir=receipts)
             if receipt.get("path"):
                 print(f"[elastic] remediation receipt: "
                       f"{receipt['path']}", file=sys.stderr)
     finally:
+        # close the ledger's books whatever path exits: pending
+        # decisions join against the last post-decision observation
+        # (or stamp `unjoined` honestly), and the decisions dump lands
+        # next to the remediation receipts for the drills / doctor
+        try:
+            _ledger.join_outcomes(force=True)
+            _ledger.dump(reason="supervisor_exit", out_dir=receipts)
+        except Exception:
+            pass
         # a supervisor crash (KeyboardInterrupt, EMFILE, ...) must not
         # orphan training processes holding the chips
         for p in procs.values():

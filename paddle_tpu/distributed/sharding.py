@@ -612,7 +612,57 @@ class MeshPlan:
         plan.report = reports
         plan.calibration = calib
         plan.dims = dims
+        cls._ledger_layout(n_devices, dims, hbm_bytes_per_chip,
+                           compress, num_micro, max_tp, max_pp,
+                           calib, sizes, reports)
         return plan
+
+    @staticmethod
+    def _ledger_layout(n_devices, dims, hbm_bytes_per_chip, compress,
+                       num_micro, max_tp, max_pp, calib, sizes,
+                       reports):
+        """Ledger the layout pick: the losing candidates + the ranking
+        ruler ARE the evidence (incident_replay re-runs choose_layout
+        from them and asserts the same winner); the outcome joins
+        against PR 18's measured-vs-predicted audit — a pick whose
+        calibrated prediction missed by >20% stamps `worse`."""
+        from ..observability import decisions as _dec
+        if not _dec.enabled():
+            return
+        from ..observability import metrics as _obs
+
+        def _probe():
+            g = _obs.get("planner.prediction_error",
+                         metric="step_time")
+            if g is None:
+                return None
+            return {"prediction_error": abs(float(g.value()))}
+
+        def _judge(pre, post):
+            err = post.get("prediction_error")
+            if err is None:
+                return "neutral"
+            return "improved" if abs(err) <= 0.2 else "worse"
+
+        _dec.record(
+            "planner.layout", "layout",
+            rule=("calibrated step-time ranking" if calib is not None
+                  else "analytic byte-cost ranking"),
+            evidence={
+                "inputs": {
+                    "n_devices": int(n_devices),
+                    "dims": dataclasses.asdict(dims),
+                    "hbm_bytes_per_chip": float(hbm_bytes_per_chip),
+                    "compress": compress,
+                    "num_micro": int(num_micro),
+                    "max_tp": int(max_tp), "max_pp": int(max_pp),
+                    "calibration": (dict(calib.table)
+                                    if calib is not None else None)},
+                "decision": {
+                    "action": "layout", "sizes": dict(sizes),
+                    "candidates": [r.as_dict() for r in reports]}},
+            signals={"prediction_error": 0.0},
+            settle_s=600.0, probe=_probe, judge=_judge)
 
     @property
     def n_devices(self) -> int:

@@ -76,6 +76,15 @@ TPU-pod training job needs on top of raw counters:
                    gauges, planner_prediction_error ledger receipts,
                    loud planner.calibration_stale_total on identity
                    mismatch)
+  decisions        control-plane decision ledger: one DecisionRecord
+                   (actor, action, rule, evidence snapshot) per
+                   autonomous action — supervisor evict/grow, serving
+                   scale/shed/swap, certified rollback, layout pick —
+                   with an outcome joiner stamping improved/neutral/
+                   worse/unjoined after a settle window, always-on
+                   decision.* series, atomic decisions_*.json dumps,
+                   and deterministic replay via
+                   tools/incident_replay.py
   sentry           numeric integrity: in-graph per-scope grad/param
                    stats + every-K param-bit fingerprints riding the
                    one step program, a rolling z-score monitor
@@ -94,6 +103,7 @@ maps to the reference's monitor.h / timeline.py machinery.
 from . import metrics  # noqa: F401
 from . import anatomy  # noqa: F401
 from . import calibration  # noqa: F401
+from . import decisions  # noqa: F401
 from . import exporters  # noqa: F401
 from . import xprof  # noqa: F401
 from . import fleet  # noqa: F401
@@ -118,7 +128,7 @@ __all__ = [
     "metrics", "exporters", "fleet", "mfu", "sentinel",
     "flight_recorder", "watchdog", "goodput", "anatomy", "xprof",
     "memory", "reqtrace", "sentry", "timeseries", "pulse_server",
-    "calibration",
+    "calibration", "decisions",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "enabled_scope", "snapshot", "reset", "scope",
     "ThroughputMeter", "chip_peak_flops", "step_flops",

@@ -80,6 +80,7 @@ from ..distributed import chaos as _chaos
 from ..distributed import elastic as _elastic
 from ..models.generation import _gpt_params
 from .engine import build_serving_snapshot
+from ..observability import decisions as _dec
 from ..observability import fleet as _obs_fleet
 from ..observability import flight_recorder as _fr
 from ..observability import memory as _mem
@@ -309,6 +310,7 @@ class ServingFleet:
         self._current_params = None         # latest COMPLETED deploy
         self._standby_version = 0
         self._flip_pending: List[int] = []
+        self._swap_evidence = None          # staged swap's ledger evidence
         self._swap_sabotage = False         # armed by corrupt_swap chaos
         self._retired_recompiles = 0        # sentinel fires of dead engines
         self._retired_executables = 0
@@ -385,10 +387,30 @@ class ServingFleet:
             import jax.numpy as jnp
             standby = dict(standby)
             standby["wte"] = jnp.full_like(standby["wte"], jnp.nan)
-        if verify and not self._verify_standby(standby):
+        standby_ok = (not verify) or self._verify_standby(standby)
+        # the swap decision's evidence: exactly what the pure rule read
+        # (verify flag + verification verdict + target version);
+        # incident_replay re-derives the action from these inputs alone
+        swap_evidence = {
+            "inputs": {"verify": bool(verify),
+                       "standby_ok": bool(standby_ok),
+                       "version": self._standby_version + 1},
+            "decision": {"action": ("weight_swap" if standby_ok
+                                    else "swap_aborted")},
+        }
+        if not standby_ok:
             self.swaps_aborted += 1
             if _obs._enabled:
                 _obs.counter("serving.swap_aborted_total").add(1)
+            # aborting a corrupt standby keeps the old weights serving:
+            # joined `neutral` (no movement), never `unjoined` — the
+            # outcome IS known the instant the abort fires
+            did = _dec.record(
+                "fleet.swap", "swap_aborted",
+                rule="standby failed verification",
+                evidence=swap_evidence,
+                signals={"completed": 0},
+                post_signals={"completed": 0})
             self._emit(
                 action="swap_aborted",
                 verdict={"kind": "corrupt_standby", "rank": None,
@@ -396,10 +418,12 @@ class ServingFleet:
                          "evidence": {"version":
                                       self._standby_version + 1}},
                 ranks=[], reason="standby weights failed verification "
-                "(non-finite floats); old snapshot keeps serving")
+                "(non-finite floats); old snapshot keeps serving",
+                decision_id=did)
             return False
         self._standby = standby
         self._standby_version += 1
+        self._swap_evidence = swap_evidence
         self._flip_pending = [r.slot for r in self._replicas.values()
                               if r.alive]
         return True
@@ -469,6 +493,19 @@ class ServingFleet:
             self._current_params = self._standby
             if _obs._enabled:
                 _obs.counter("serving.fleet.weight_swaps_total").add(1)
+            # the decision record lands at COMMIT (evidence was
+            # snapshotted at stage time): every replica flipped, so the
+            # outcome joins immediately as `improved` (0 -> 1 complete)
+            did = _dec.record(
+                "fleet.swap", "weight_swap",
+                rule="standby verified; flip per-replica at token "
+                     "boundaries",
+                evidence=(self._swap_evidence
+                          or {"inputs": {"version":
+                                         self._standby_version}}),
+                signals={"completed": 0},
+                post_signals={"completed": 1})
+            self._swap_evidence = None
             self._emit(
                 action="weight_swap",
                 verdict={"kind": "deploy", "rank": None,
@@ -477,7 +514,8 @@ class ServingFleet:
                 ranks=sorted(r.slot for r in self._replicas.values()
                              if r.alive),
                 reason=f"hot swap v{self._standby_version} complete "
-                       "(flipped per-replica at token boundaries)")
+                       "(flipped per-replica at token boundaries)",
+                decision_id=did)
             self._standby = None
 
     # -- request intake -------------------------------------------------------
@@ -529,6 +567,23 @@ class ServingFleet:
                 _obs.counter("serving.fleet.shed_total", cls=cls).add(1)
             if _rt._enabled:
                 _rt.mark(fr.rid, "shed", cls=cls)
+            # ledger: the shed rule is pure (class + queue depth vs
+            # watermark) so the evidence alone replays the action; the
+            # outcome joins against the queue depth _publish observes
+            # after the settle window — a drained queue means the shed
+            # protected the SLO (improved)
+            _dec.record(
+                "fleet.shed", "shed",
+                rule="lowest class beyond shed_queue_depth",
+                evidence={"inputs": {
+                    "cls": cls,
+                    "queue_len": len(self._queues[cls]),
+                    "shed_queue_depth": int(self.slo.shed_queue_depth),
+                    "lowest_class": fc.classes[-1],
+                    "shed_enabled": bool(fc.shed)},
+                    "decision": {"action": "shed"}},
+                signals={"queued": self.queue_depth},
+                settle_s=0.05)
             return fr
         if _rt._enabled:
             # the request's arrival on the TRACE clock — queue wait
@@ -701,7 +756,8 @@ class ServingFleet:
             episode=decision.episode, world_before=world_before,
             extras={"requeued": requeued,
                     "queue_depth": self.queue_depth,
-                    "fleet_tick": self._tick})
+                    "fleet_tick": self._tick},
+            decision_id=decision.decision_id)
 
     def _incarnation(self, slot: int) -> int:
         rep = self._replicas.get(slot)
@@ -822,7 +878,8 @@ class ServingFleet:
                    reason=d.reason, episode=d.episode,
                    extras={"queue_depth": self.queue_depth,
                            "p99_ttft_ms": p99,
-                           "fleet_tick": self._tick})
+                           "fleet_tick": self._tick},
+                   decision_id=d.decision_id)
 
     def _maybe_grow(self):
         if self._aborted:
@@ -830,14 +887,17 @@ class ServingFleet:
         d = self.policy.maybe_grow()
         if d is None:
             return
+        # maybe_grow itself books the spawns against the restart
+        # window (the budget-bypass fix) — recording them again here
+        # would double-charge the budget
         for slot in d.ranks:
             self._replicas[slot] = self._spawn(
                 slot, self._incarnation(slot) + 1)
-            self.policy.record_scale_spawn()
         _fr.record("fleet.scale", action="grow", ranks=list(d.ranks),
                    tick=self._tick)
         self._emit(action="grow", verdict=d.verdict, ranks=d.ranks,
-                   reason=d.reason, episode=d.episode)
+                   reason=d.reason, episode=d.episode,
+                   decision_id=d.decision_id)
 
     def _dispatch(self):
         """Feed highest-priority queued requests to the least-loaded
@@ -971,6 +1031,19 @@ class ServingFleet:
                 g.reset()
 
     def _publish(self, now: float):
+        # post-signals for the outcome joiner, fed EVERY tick whether
+        # or not the gauge refresh is on: the ledger's verdicts must
+        # not depend on the metrics gate (decision.* series are
+        # always-on for the same reason)
+        if _dec.enabled():
+            queued = self.queue_depth
+            p99 = self._rolling_p99()
+            _dec.observe("supervisor.scale",
+                         {"queued": queued, "p99_ttft_ms": p99})
+            _dec.observe("supervisor.remediate", {"failures": 0})
+            _dec.observe("supervisor.grow", {"failures": 0})
+            _dec.observe("fleet.shed", {"queued": queued})
+            _dec.join_outcomes()
         if not _obs._enabled:
             # the pulse plane rides the fleet tick even when the gauge
             # refresh is off (frozen values are still a truthful flat
@@ -1024,7 +1097,8 @@ class ServingFleet:
               reason: str = "", delay_s: float = 0.0,
               episode: Optional[int] = None,
               world_before: Optional[int] = None,
-              extras: Optional[dict] = None):
+              extras: Optional[dict] = None,
+              decision_id: Optional[str] = None):
         live = self.live_replicas()
         doc = _elastic.emit_receipt(
             episode=self.policy.episode if episode is None else episode,
@@ -1032,7 +1106,8 @@ class ServingFleet:
             world_before=(len(live) if world_before is None
                           else int(world_before)),
             world_after=len(live), delay_s=delay_s, reason=reason,
-            extras=extras, out_dir=self.fleet.receipts_dir)
+            extras=extras, decision_id=decision_id,
+            out_dir=self.fleet.receipts_dir)
         self.episodes.append(doc)
         return doc
 
@@ -1099,6 +1174,11 @@ class ServingFleet:
 
     def summary(self) -> dict:
         """One receipt-shaped dict for benches/drills."""
+        # close the ledger's books: anything still inside its settle
+        # window joins against the freshest post-decision observation
+        # (or stamps `unjoined` honestly) so the episode rollup below
+        # carries final outcomes, not race results
+        _dec.join_outcomes(force=True)
         per_cls = {}
         for cls in self.fleet.classes:
             ttfts = [w[1] for w in self._window if w[2] == cls]
@@ -1109,14 +1189,22 @@ class ServingFleet:
                 "p99_ttft_ms": (round(float(np.percentile(ttfts, 99)),
                                       3) if ttfts else -1.0),
             }
+        episodes = []
+        for e in self.episodes:
+            ent = {"action": e["action"],
+                   "verdict": e["verdict"].get("kind"),
+                   "ranks": e["ranks"], "reason": e["reason"]}
+            did = e.get("decision_id")
+            if did is not None:
+                rec = _dec.get(did)
+                ent["decision_id"] = did
+                ent["outcome"] = (rec.outcome if rec is not None
+                                  else None)
+            episodes.append(ent)
         return {
             "ticks": self._tick,
             "live_replicas": self.live_replicas(),
-            "episodes": [
-                {"action": e["action"],
-                 "verdict": e["verdict"].get("kind"),
-                 "ranks": e["ranks"], "reason": e["reason"]}
-                for e in self.episodes],
+            "episodes": episodes,
             "requeued_total": self.requeued_total,
             "shed_total": self.shed_total,
             "weight_swaps": self.swaps_total,

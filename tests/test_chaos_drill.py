@@ -133,11 +133,17 @@ class TestDrillCli:
         class A:
             mode, rank = "kill", 1
 
+        ledger = [{"records": [
+            {"decision_id": "d0-1-0", "actor": "supervisor.remediate",
+             "action": "evict_shrink", "outcome": "improved"}]}]
         good = {"receipts": [
             {"action": "evict_shrink", "ranks": [1], "episode": 1,
+             "decision_id": "d0-1-0",
              "verdict": {"kind": "crash", "rank": 1,
-                         "source": "supervisor"}}]}
-        assert chaos_drill.check_receipt(A, good)["ok"]
+                         "source": "supervisor"}}],
+            "ledger": ledger}
+        got = chaos_drill.check_receipt(A, good)
+        assert got["ok"] and got["outcome"] == "improved"
         wrong_rank = {"receipts": [
             {"action": "respawn_gang", "ranks": [0],
              "verdict": {"kind": "crash", "rank": 0}}]}
@@ -146,6 +152,18 @@ class TestDrillCli:
             {"action": "respawn_gang", "ranks": [1],
              "verdict": {"kind": "hang", "rank": 1}}]}
         assert not chaos_drill.check_receipt(A, wrong_kind)["ok"]
+        # an action without a JOINED ledger record is unaudited:
+        # missing decision_id, id absent from the dump, and an
+        # unjoined outcome all fail the receipt
+        no_id = {"receipts": list(good["receipts"]), "ledger": ledger}
+        no_id["receipts"] = [dict(no_id["receipts"][0])]
+        del no_id["receipts"][0]["decision_id"]
+        assert not chaos_drill.check_receipt(A, no_id)["ok"]
+        missing = dict(good, ledger=[{"records": []}])
+        assert not chaos_drill.check_receipt(A, missing)["ok"]
+        unjoined = dict(good, ledger=[{"records": [
+            dict(ledger[0]["records"][0], outcome="unjoined")]}])
+        assert not chaos_drill.check_receipt(A, unjoined)["ok"]
 
 
 def _launch_elastic(tmp_path, *, chaos_env=None, extra=(), steps=10,

@@ -1,0 +1,401 @@
+"""Control-plane decision ledger (the PR 19 tentpole) — tier-1 drills
+for paddle_tpu/observability/decisions.py and its tool surface.
+
+- ledger semantics: record/get/records, bounded ring, disabled path
+  under the flight recorder's <1 µs bar, dump/glob under the
+  $PD_FR_DIR contract
+- the outcome joiner's edge cases (the satellite's acceptance list):
+  settle expiry with NO post-signal stamps `unjoined`, NEVER `neutral`;
+  a second same-actor decision inside the settle window joins the
+  first against the second's PRE-action signals only; push (observe),
+  pull (probe), and immediate (post_signals) join paths
+- always-on registry series: decision.total{actor,action} counters and
+  decision.outcome{verdict=} gauges, with BYTE parity between the
+  Prometheus file export and a live pulse-server scrape
+- incident replay: the committed chaos-drill fixture
+  (tests/fixtures/incident_ledger.json) re-runs every decision from
+  its evidence and must reproduce the recorded actions bit-identically
+- tpu_doctor staleness cross-check: decisions made after a bounce on
+  evidence observed before it are flagged
+- ops_timeline: decisions + flight events merge into one sorted
+  chronology; chrome-trace rendering keeps one lane per plane
+"""
+import json
+import os
+import time
+
+import pytest
+
+from paddle_tpu.observability import decisions as dec
+from paddle_tpu.observability import exporters, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                       "incident_ledger.json")
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    """Clean ledger + registry per test, private dump dir."""
+    monkeypatch.setenv("PD_FR_DIR", str(tmp_path / "fr"))
+    metrics.clear()
+    metrics.disable()
+    dec.reset()
+    yield
+    dec.reset()
+    metrics.clear()
+    metrics.disable()
+
+
+# -- ledger semantics ---------------------------------------------------------
+
+class TestLedger:
+    def test_record_returns_id_and_is_queryable(self):
+        did = dec.record("supervisor.remediate", "evict_shrink",
+                         rule="divergence names rank 1",
+                         evidence={"inputs": {"failures": [[1, "rc=1"]]}})
+        assert did and did.startswith("d")
+        rec = dec.get(did)
+        assert rec is not None
+        assert rec.actor == "supervisor.remediate"
+        assert rec.action == "evict_shrink"
+        assert rec.outcome == "unjoined" and rec.joined_ts is None
+        assert dec.records("supervisor.remediate")[0].decision_id == did
+
+    def test_disabled_records_nothing_and_returns_none(self):
+        dec.disable()
+        assert dec.record("a", "b", rule="r", evidence={}) is None
+        assert dec.records() == []
+        assert dec.pending_count() == 0
+
+    def test_disabled_record_under_one_microsecond(self):
+        """Same CI harness as the flight recorder / metrics gates: one
+        disabled record() is a function call plus a module-bool read."""
+        dec.disable()
+        n = 10000
+        medians = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                dec.record("perf.guard", "noop", rule="r", evidence={})
+            medians.append((time.perf_counter() - t0) / n)
+        med = sorted(medians)[len(medians) // 2]
+        assert med < 1e-6, f"disabled record() costs {med * 1e9:.0f}ns"
+        assert dec.records() == []
+
+    def test_ring_is_bounded(self):
+        for i in range(dec._CAPACITY + 10):
+            dec.record("a", "act", rule="r", evidence={"i": i},
+                       post_signals={})
+        assert len(dec.records()) == dec._CAPACITY
+        assert dec.records()[0].evidence["i"] == 10  # oldest evicted
+
+    def test_dump_and_glob_contract(self, tmp_path):
+        did = dec.record("fleet.shed", "shed", rule="r",
+                         evidence={"inputs": {"queue_len": 9}})
+        doc = dec.dump(reason="unit test!", out_dir=str(tmp_path))
+        assert doc["path"] and os.path.exists(doc["path"])
+        base = os.path.basename(doc["path"])
+        assert base.startswith("decisions_unit_test_")   # sanitized
+        assert f"pid{os.getpid()}" in base
+        assert dec.glob_dumps(str(tmp_path)) == [doc["path"]]
+        with open(doc["path"]) as f:
+            on_disk = json.load(f)
+        assert on_disk["records"][0]["decision_id"] == did
+        assert on_disk["pending"] == [did]      # settle not yet closed
+        assert on_disk["incarnation_ts"] == dec.incarnation_ts()
+        assert set(on_disk["outcomes"]) == set(dec.OUTCOMES)
+
+    def test_dump_works_even_when_disabled(self, tmp_path):
+        dec.record("a", "act", rule="r", evidence={})
+        dec.disable()
+        doc = dec.dump(reason="wedged", out_dir=str(tmp_path))
+        assert doc["path"] and len(doc["records"]) == 1
+        assert doc["enabled"] is False
+
+
+# -- the outcome joiner -------------------------------------------------------
+
+class TestJoiner:
+    def test_settle_expiry_without_post_signal_is_unjoined_never_neutral(self):
+        """THE taxonomy edge: "we don't know" (no post-signal arrived
+        before the settle window expired) is a different fact from
+        "nothing changed" — the joiner must stamp `unjoined`."""
+        dec.record("supervisor.scale", "scale_up", rule="r",
+                   evidence={}, signals={"queued": 40}, settle_s=5.0,
+                   clock=100.0)
+        assert dec.join_outcomes(now=104.0) == 0    # window still open
+        assert dec.join_outcomes(now=106.0) == 1    # expired, no signal
+        rec = dec.records()[0]
+        assert rec.outcome == "unjoined"
+        assert rec.outcome_evidence == {"pre": {"queued": 40},
+                                        "post": None}
+
+    def test_observation_older_than_decision_never_joins_it(self):
+        dec.observe("supervisor.scale", {"queued": 10}, clock=90.0)
+        dec.record("supervisor.scale", "scale_up", rule="r",
+                   evidence={}, signals={"queued": 40}, settle_s=5.0,
+                   clock=100.0)
+        dec.join_outcomes(now=106.0)
+        # the pre-decision observation is stale state, not an outcome
+        assert dec.records()[0].outcome == "unjoined"
+
+    def test_push_join_improved_and_worse(self):
+        dec.record("supervisor.scale", "scale_up", rule="r",
+                   evidence={}, signals={"queued": 40,
+                                         "p99_ttft_ms": 900.0},
+                   settle_s=5.0, clock=100.0)
+        dec.observe("supervisor.scale", {"queued": 4,
+                                         "p99_ttft_ms": 200.0},
+                    clock=103.0)
+        dec.join_outcomes(now=106.0)
+        assert dec.records()[0].outcome == "improved"
+        dec.record("supervisor.scale", "scale_down", rule="r",
+                   evidence={}, signals={"queued": 4}, settle_s=5.0,
+                   clock=110.0)
+        dec.observe("supervisor.scale", {"queued": 50}, clock=112.0)
+        dec.join_outcomes(now=116.0)
+        assert dec.records()[1].outcome == "worse"
+
+    def test_second_decision_joins_first_against_pre_action_signals(self):
+        """A second same-actor decision inside the settle window closes
+        the first against the SECOND'S pre-action snapshot — the first
+        outcome must never be judged on state the second action already
+        changed (here: the queue the second scale_up will drain)."""
+        first = dec.record("supervisor.scale", "scale_up", rule="r",
+                           evidence={}, signals={"queued": 40},
+                           settle_s=60.0, clock=100.0)
+        # later observation EXISTS but is post-second-action state; the
+        # force-join must use the second decision's own signals instead
+        second = dec.record("supervisor.scale", "scale_up", rule="r",
+                            evidence={}, signals={"queued": 20},
+                            settle_s=60.0, clock=110.0)
+        rec1 = dec.get(first)
+        assert rec1.outcome == "improved"           # 40 -> 20
+        assert rec1.outcome_evidence["post"] == {"queued": 20}
+        # the second stays pending on its own window
+        assert dec.get(second).outcome == "unjoined"
+        assert dec.pending_count() == 1
+
+    def test_immediate_join_via_post_signals(self):
+        did = dec.record("checkpoint.rollback", "rollback", rule="r",
+                         evidence={}, signals={"restored": 0},
+                         post_signals={"restored": 1})
+        rec = dec.get(did)
+        assert rec.outcome == "improved" and rec.joined_ts is not None
+        assert dec.pending_count() == 0
+
+    def test_probe_pull_join(self):
+        dec.record("planner.layout", "layout", rule="r", evidence={},
+                   signals={"prediction_error": 0.0}, settle_s=5.0,
+                   clock=100.0,
+                   probe=lambda: {"prediction_error": 0.5})
+        dec.join_outcomes(now=106.0)
+        assert dec.records()[0].outcome == "worse"   # error grew
+
+    def test_custom_judge_wins_and_bad_verdict_is_unjoined(self):
+        dec.record("a", "act", rule="r", evidence={}, signals={},
+                   post_signals={}, judge=lambda pre, post: "improved")
+        dec.record("a", "act2", rule="r", evidence={}, signals={},
+                   post_signals={}, judge=lambda pre, post: "banana")
+        assert [r.outcome for r in dec.records()] == ["improved",
+                                                      "unjoined"]
+
+    def test_judge_signals_band_sentinels_and_directions(self):
+        # inside the ±5% band: no vote -> neutral
+        assert dec.judge_signals({"queued": 100}, {"queued": 97}) \
+            == "neutral"
+        # -1.0 p99 is "no data yet", never a measurement
+        assert dec.judge_signals({"p99_ttft_ms": -1.0},
+                                 {"p99_ttft_ms": 500.0}) == "neutral"
+        # keys without direction metadata are evidence, not votes
+        assert dec.judge_signals({"live": 2}, {"live": 3}) == "neutral"
+        assert dec.judge_signals({"failures": 3}, {"failures": 0}) \
+            == "improved"
+        assert dec.judge_signals({"goodput": 0.9}, {"goodput": 0.5}) \
+            == "worse"
+
+    def test_force_join_closes_the_books(self):
+        dec.record("a", "act", rule="r", evidence={}, signals={},
+                   settle_s=1e9, clock=0.0)
+        assert dec.pending_count() == 1
+        assert dec.join_outcomes(force=True) == 1
+        assert dec.pending_count() == 0
+        assert dec.records()[0].outcome == "unjoined"
+
+
+# -- always-on series + exporter parity ---------------------------------------
+
+class TestSeries:
+    def test_counters_and_gauges_ride_the_registry_when_gate_down(self):
+        assert not metrics.enabled()    # decision series are always-on
+        dec.record("fleet.shed", "shed", rule="r", evidence={},
+                   signals={"queued": 10}, post_signals={"queued": 2})
+        snap = metrics.snapshot()
+        assert snap["decision.total{action=shed,actor=fleet.shed}"][
+            "value"] == 1
+        # ALL taxonomy members are published every time (stable
+        # exposition), not just the verdicts that occurred
+        for v in dec.OUTCOMES:
+            assert f"decision.outcome{{verdict={v}}}" in snap
+        assert snap["decision.outcome{verdict=improved}"]["value"] == 1
+        assert dec.outcome_counts()["improved"] == 1
+
+    def test_prometheus_file_and_pulse_scrape_byte_parity(self, tmp_path):
+        """One renderer for the file export and the live scrape: the
+        decision series must come out BYTE-identical from both."""
+        from urllib.request import urlopen
+        from paddle_tpu.observability import pulse_server
+        dec.record("supervisor.scale", "scale_up", rule="r",
+                   evidence={}, signals={"queued": 40},
+                   post_signals={"queued": 4})
+        dec.record("fleet.swap", "swap_aborted", rule="r",
+                   evidence={}, signals={"completed": 0},
+                   post_signals={"completed": 0})
+        path = str(tmp_path / "metrics.prom")
+        exporters.write_prometheus(path)
+        with open(path) as f:
+            file_lines = [ln for ln in f.read().splitlines()
+                          if "decision_" in ln]
+        srv = pulse_server.PulseServer(port=0).start()
+        try:
+            body = urlopen(f"{srv.url}/metrics",
+                           timeout=10).read().decode()
+        finally:
+            srv.stop()
+        scrape_lines = [ln for ln in body.splitlines()
+                        if "decision_" in ln]
+        assert file_lines == scrape_lines
+        assert any(ln.startswith(
+            'paddle_tpu_decision_total{action="scale_up",'
+            'actor="supervisor.scale"} 1') for ln in file_lines)
+        assert any(ln.startswith(
+            'paddle_tpu_decision_outcome{verdict="unjoined"} 0')
+            for ln in file_lines)
+        for ln in file_lines:
+            exporters.validate_exposition(ln)
+
+
+# -- incident replay ----------------------------------------------------------
+
+class TestIncidentReplay:
+    def test_committed_fixture_replays_bit_identically(self):
+        """The acceptance drill: every decision in the committed
+        chaos fixture re-runs from its recorded evidence through the
+        SAME decision logic and reproduces the recorded action."""
+        from tools import incident_replay
+        assert os.path.exists(FIXTURE), \
+            "regenerate with: python tools/incident_replay.py " \
+            "--make-fixture"
+        with open(FIXTURE) as f:
+            doc = json.load(f)
+        out = incident_replay.replay_doc(doc)
+        assert out["ok"], json.dumps(out["mismatches"], indent=2)
+        assert out["checked"] >= 10 and out["skipped"] == 0
+        # the fixture covers every wired actor class
+        actors = {r["actor"] for r in doc["records"]}
+        assert actors == {"supervisor.remediate", "supervisor.grow",
+                          "supervisor.scale", "fleet.shed",
+                          "fleet.swap", "checkpoint.rollback",
+                          "planner.layout"}
+
+    def test_tampered_evidence_is_caught(self):
+        from tools import incident_replay
+        with open(FIXTURE) as f:
+            doc = json.load(f)
+        rec = next(r for r in doc["records"]
+                   if r["action"] == "scale_up")
+        # flip the recorded action: replay must flag the divergence
+        rec["evidence"]["decision"]["action"] = "scale_down"
+        out = incident_replay.replay_doc(doc)
+        assert not out["ok"] and len(out["mismatches"]) == 1
+        assert out["mismatches"][0]["decision_id"] == \
+            rec["decision_id"]
+
+    def test_replay_never_writes_to_the_ledger(self):
+        from tools import incident_replay
+        with open(FIXTURE) as f:
+            doc = json.load(f)
+        before = len(dec.records())
+        incident_replay.replay_doc(doc)
+        assert len(dec.records()) == before
+        assert dec.enabled()       # gate restored after the replay
+
+
+# -- tpu_doctor staleness cross-check -----------------------------------------
+
+class TestDoctorStaleness:
+    def _doc(self, recs, inc=500.0):
+        return {"rank": 0, "incarnation_ts": inc, "records": recs}
+
+    def test_flags_post_bounce_decision_on_pre_bounce_evidence(self):
+        from tools.tpu_doctor import stale_decisions
+        recs = [
+            # acted after the bounce on evidence observed before it
+            {"decision_id": "d0-1-0", "actor": "supervisor.remediate",
+             "action": "evict_shrink", "ts": 510.0,
+             "evidence_ts": 480.0, "outcome": "unjoined"},
+            # fresh evidence: fine
+            {"decision_id": "d0-1-1", "actor": "supervisor.remediate",
+             "action": "evict_shrink", "ts": 520.0,
+             "evidence_ts": 515.0, "outcome": "improved"},
+            # decided BEFORE the bounce: the old incarnation's call
+            {"decision_id": "d0-1-2", "actor": "fleet.shed",
+             "action": "shed", "ts": 499.0, "evidence_ts": 400.0},
+            # no evidence timestamp recorded: nothing to cross-check
+            {"decision_id": "d0-1-3", "actor": "fleet.swap",
+             "action": "weight_swap", "ts": 530.0,
+             "evidence_ts": None},
+        ]
+        flagged = stale_decisions([self._doc(recs)])
+        assert [f["decision_id"] for f in flagged] == ["d0-1-0"]
+        assert flagged[0]["evidence_age_s"] == 20.0
+
+    def test_doc_without_incarnation_ts_is_skipped(self):
+        from tools.tpu_doctor import stale_decisions
+        assert stale_decisions([{"records": [
+            {"ts": 510.0, "evidence_ts": 480.0}]}]) == []
+
+
+# -- ops_timeline -------------------------------------------------------------
+
+class TestOpsTimeline:
+    def test_merge_sorts_planes_on_one_clock(self, tmp_path):
+        from tools import ops_timeline
+        did = dec.record("supervisor.remediate", "evict_shrink",
+                         rule="r", evidence={}, signals={"failures": 1},
+                         post_signals={"failures": 0})
+        ddoc = dec.dump(reason="t", out_dir=str(tmp_path))
+        fdoc = {"rank": 0, "events": [
+            {"t": ddoc["records"][0]["ts"] - 1.0, "k": "rank_exit",
+             "i": 0},
+            {"t": ddoc["records"][0]["ts"] + 60.0, "k": "step", "i": 1},
+        ]}
+        with open(tmp_path / "flight_x_rank0_pid1.json", "w") as f:
+            json.dump(fdoc, f)
+        evts = ops_timeline.timeline_for_dir(str(tmp_path))
+        assert [e["ts"] for e in evts] == sorted(e["ts"] for e in evts)
+        kinds = [e["kind"] for e in evts]
+        # failure -> decision -> outcome -> recovery, in causal order
+        assert kinds[0] == "rank_exit"
+        assert kinds[1] == "supervisor.remediate:evict_shrink"
+        assert kinds[2].startswith("outcome:")
+        assert kinds[-1] == "step"
+        dec_evt = evts[1]
+        assert dec_evt["decision_id"] == did
+        trace = ops_timeline.to_chrome_trace(evts)
+        names = {t["args"]["name"] for t in trace["traceEvents"]
+                 if t["ph"] == "M"}
+        assert {"decision", "flight"} <= names
+        assert all(t["ts"] >= 0 for t in trace["traceEvents"]
+                   if t["ph"] != "M")
+
+
+# -- bounce bookkeeping -------------------------------------------------------
+
+class TestBounce:
+    def test_note_bounce_moves_the_incarnation_clock(self):
+        dec.note_bounce(123.0)
+        assert dec.incarnation_ts() == 123.0
+        dec.note_bounce()
+        assert dec.incarnation_ts() > 123.0

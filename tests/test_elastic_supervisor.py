@@ -224,6 +224,51 @@ class TestGrow:
         p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
         assert p.maybe_grow(now=1e9) is None
 
+    def test_grow_defers_while_restart_window_budget_exhausted(self):
+        """The PR 19 budget fix: maybe_grow used to BYPASS the
+        restarts-per-window flap guard — a flapping host on grow
+        cooldown could spawn forever while decide() was already
+        refusing respawns. A blocked grow must defer (state untouched)
+        and leave a grow_deferred ledger record, once per episode."""
+        from paddle_tpu.observability import decisions as dec
+        dec.reset()
+        p = _policy(allow_shrink=True, grow_after_s=30.0,
+                    max_restarts=100, restart_budget=2,
+                    restart_window_s=60.0)
+        p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)  # evict 1
+        p.record_scale_spawn(now=10.0)
+        p.record_scale_spawn(now=11.0)       # window budget now full
+        assert p.maybe_grow(now=40.0) is None     # deferred, not spawned
+        assert 1 in p.evicted and p.active == [0, 2, 3]
+        grows = dec.records("supervisor.grow")
+        assert [r.action for r in grows] == ["grow_deferred"]
+        assert "restart budget 2" in grows[0].rule
+        # dedup: polling again while still blocked does not spam
+        assert p.maybe_grow(now=41.0) is None
+        assert len(dec.records("supervisor.grow")) == 1
+        dec.reset()
+
+    def test_grow_proceeds_and_spends_budget_once_window_slides(self):
+        from paddle_tpu.observability import decisions as dec
+        dec.reset()
+        p = _policy(allow_shrink=True, grow_after_s=30.0,
+                    max_restarts=100, restart_budget=2,
+                    restart_window_s=60.0)
+        p.decide([(1, "exit rc=1")], DIVERGENCE, now=0.0)
+        p.record_scale_spawn(now=10.0)
+        p.record_scale_spawn(now=11.0)
+        assert p.maybe_grow(now=40.0) is None
+        g = p.maybe_grow(now=100.0)          # old spawns left the window
+        assert g is not None and g.action == "grow" and g.ranks == [1]
+        assert p.active == [0, 1, 2, 3] and not p.evicted
+        # the grow itself SPENT the window budget (one spawn recorded)
+        assert [t for t in p._respawn_ts if 100.0 - t <= 60.0] \
+            == [100.0]
+        # and the deferral flag cleared: the ledger holds defer + grow
+        acts = [r.action for r in dec.records("supervisor.grow")]
+        assert acts == ["grow_deferred", "grow"]
+        dec.reset()
+
 
 class TestReceipts:
     def test_receipt_written_and_counters_always_on(self, tmp_path):

@@ -129,35 +129,57 @@ def _run_once(args, tag: str, chaos_mode: str, workdir: str) -> dict:
     for f in sorted(glob.glob(os.path.join(receipts, "receipt_*.json"))):
         with open(f) as fh:
             recs.append(json.load(fh))
+    # the supervisor dumps its decision ledger into the same receipts
+    # dir on exit (launch.py reason="supervisor_exit") — the drill
+    # cross-checks every remediation receipt against it
+    ledger = []
+    for f in sorted(glob.glob(os.path.join(receipts,
+                                           "decisions_*.json"))):
+        with open(f) as fh:
+            ledger.append(json.load(fh))
     steps_reached = max((d.get("steps_done", 0) for d in outs.values()),
                        default=0)
     return {"rc": r.returncode, "wall_s": round(wall, 3),
             "steps_reached": steps_reached,
             "goodput_steps_per_s": round(steps_reached / wall, 4),
-            "outs": outs, "receipts": recs,
+            "outs": outs, "receipts": recs, "ledger": ledger,
             "stderr_tail": r.stderr[-2000:]}
 
 
 def check_receipt(args, chaos: dict) -> dict:
     """Does a remediation receipt name the faulted rank and a verdict
-    that plausibly drove the action?"""
+    that plausibly drove the action — AND does the action carry a
+    decision-ledger id whose outcome was measured (joined, not
+    ``unjoined``)? An action without a joined ledger record is
+    unaudited: the fleet moved, but nothing proves the move helped."""
     want_kinds = EXPECT_VERDICTS[args.mode]
+    by_id = {r.get("decision_id"): r
+             for doc in chaos.get("ledger", [])
+             for r in doc.get("records", [])}
     for rec in chaos["receipts"]:
         v = rec.get("verdict") or {}
         if v.get("kind") in want_kinds and v.get("rank") == args.rank \
                 and args.rank in (rec.get("ranks") or []):
-            return {"ok": True, "episode": rec.get("episode"),
+            did = rec.get("decision_id")
+            lrec = by_id.get(did) if did else None
+            outcome = (lrec or {}).get("outcome")
+            ledger_ok = bool(lrec) and outcome not in (None, "unjoined")
+            return {"ok": ledger_ok, "episode": rec.get("episode"),
                     "action": rec.get("action"),
                     "verdict": {"kind": v.get("kind"),
                                 "rank": v.get("rank"),
                                 "source": v.get("source")},
+                    "decision_id": did, "outcome": outcome,
+                    "ledger_joined": ledger_ok,
                     "resume_step": rec.get("resume_step"),
                     "backoff_s": rec.get("backoff_s")}
     return {"ok": False,
             "receipts_seen": [
                 {"action": r.get("action"),
                  "verdict": (r.get("verdict") or {}).get("kind"),
-                 "ranks": r.get("ranks")} for r in chaos["receipts"]]}
+                 "ranks": r.get("ranks"),
+                 "decision_id": r.get("decision_id")}
+                for r in chaos["receipts"]]}
 
 
 def _trajectory_match(control: dict, chaos: dict) -> dict:

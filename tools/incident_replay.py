@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""incident_replay: deterministic replay of the decision ledger — the
+control-plane twin of tools/replay_triage.py.
+
+Every autonomous actor in this repo (elastic SupervisorPolicy
+decide/maybe_grow/decide_scale, the serving fleet's shed and
+hot-swap, the certified checkpoint rollback walk, MeshPlan.auto's
+layout pick) is a PURE function of the evidence its DecisionRecord
+snapshots: no wall-clock reads (they take ``now``), no RNG, no
+ambient state outside the recorded inputs. This tool cashes that
+contract in: it feeds each dumped record's evidence back through the
+SAME decision logic and asserts the action comes out bit-identical —
+GC3's verify-control-logic-as-artifact discipline, so a refactor that
+silently changes remediation behavior fails in CI, not on a burning
+pod at 3am.
+
+Per actor, the replay surface:
+
+  supervisor.remediate   SupervisorPolicy.from_snapshot(state)
+                         .decide(failures, verdict, now) ==
+                         evidence["decision"] (Decision.as_dict)
+  supervisor.grow        .maybe_grow(now) — `grow` must reproduce the
+                         Decision; `grow_deferred` must reproduce None
+                         (the budget veto)
+  supervisor.scale       .decide_scale(slo, queued, p99, now,
+                         burn_alert) against the duck SLO rebuilt
+                         from evidence
+  fleet.shed             the admission watermark rule re-derived from
+                         (cls, queue_len, shed_queue_depth)
+  fleet.swap             verify ∧ standby_ok → weight_swap | abort
+  checkpoint.rollback    checkpoint.rollback_plan(candidates, step)
+                         must reproduce the recorded attempt plan AND
+                         the chosen candidate (first non-failed
+                         restore attempt in plan order)
+  planner.layout         sharding.choose_layout over the recorded
+                         (dims, hbm, calibration table) must
+                         reproduce the winning sizes and every
+                         candidate's scored report
+
+The ledger is DISABLED around every replay (a replay must never
+record). ``--make-fixture`` regenerates the committed chaos-drill
+fixture ``tests/fixtures/incident_ledger.json`` — a canned incident
+timeline (crash→evict, budget abort, deferred+granted grow, scale
+up/down, shed, corrupt+clean swap, certified rollback with a
+decertified skip, an 8-chip layout pick) replayed bit-identically by
+tests/test_decisions.py in tier-1.
+
+Usage:
+  python tools/incident_replay.py DIR_OR_DUMP.json   # replay, exit 1
+                                                     # on any mismatch
+  python tools/incident_replay.py --make-fixture     # regenerate the
+                                                     # committed fixture
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FIXTURE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures",
+    "incident_ledger.json")
+
+
+# -- per-actor replay dispatch ------------------------------------------------
+
+class _DuckSLO:
+    def __init__(self, d: dict):
+        self.p99_ttft_ms = float(d.get("p99_ttft_ms", 0.0))
+        self.queue_high = int(d.get("queue_high", 0))
+        self.queue_low = int(d.get("queue_low", 0))
+
+
+def _replay_supervisor_remediate(rec: dict) -> Optional[str]:
+    from paddle_tpu.distributed import elastic
+    ev = rec["evidence"]
+    pol = elastic.SupervisorPolicy.from_snapshot(ev["state"])
+    failures = [(int(r), str(w)) for r, w in ev["inputs"]["failures"]]
+    d = pol.decide(failures, ev["inputs"]["doctor_verdict"],
+                   now=ev["inputs"]["now"])
+    if d.as_dict() != ev["decision"]:
+        return f"decide() diverged: {d.as_dict()} != {ev['decision']}"
+    return None
+
+
+def _replay_supervisor_grow(rec: dict) -> Optional[str]:
+    from paddle_tpu.distributed import elastic
+    ev = rec["evidence"]
+    pol = elastic.SupervisorPolicy.from_snapshot(ev["state"])
+    d = pol.maybe_grow(now=ev["inputs"]["now"])
+    if rec["action"] == "grow_deferred":
+        if d is not None:
+            return ("maybe_grow() granted a grow the ledger recorded "
+                    f"as budget-deferred: {d.as_dict()}")
+        return None
+    if d is None:
+        return "maybe_grow() returned None for a recorded grow"
+    if d.as_dict() != ev["decision"]:
+        return f"maybe_grow() diverged: {d.as_dict()} != {ev['decision']}"
+    return None
+
+
+def _replay_supervisor_scale(rec: dict) -> Optional[str]:
+    from paddle_tpu.distributed import elastic
+    ev = rec["evidence"]
+    pol = elastic.SupervisorPolicy.from_snapshot(ev["state"])
+    inp = ev["inputs"]
+    d = pol.decide_scale(_DuckSLO(inp["slo"]), inp["queued"],
+                         inp["p99_ttft_ms"], now=inp["now"],
+                         burn_alert=inp["burn_alert"])
+    if d is None:
+        return "decide_scale() returned None for a recorded scale"
+    if d.as_dict() != ev["decision"]:
+        return (f"decide_scale() diverged: {d.as_dict()} != "
+                f"{ev['decision']}")
+    return None
+
+
+def _replay_fleet_shed(rec: dict) -> Optional[str]:
+    inp = rec["evidence"]["inputs"]
+    shed = (bool(inp["shed_enabled"])
+            and inp["cls"] == inp["lowest_class"]
+            and int(inp["queue_len"]) >= int(inp["shed_queue_depth"]))
+    want = rec["evidence"]["decision"]["action"] == "shed"
+    if shed != want:
+        return (f"shed rule diverged: evidence says shed={want}, "
+                f"recomputed {shed} from {inp}")
+    return None
+
+
+def _replay_fleet_swap(rec: dict) -> Optional[str]:
+    inp = rec["evidence"]["inputs"]
+    action = ("weight_swap"
+              if (not inp.get("verify", True)) or inp["standby_ok"]
+              else "swap_aborted")
+    want = rec["evidence"]["decision"]["action"]
+    if action != want:
+        return f"swap rule diverged: recomputed {action}, recorded {want}"
+    return None
+
+
+def _replay_checkpoint_rollback(rec: dict) -> Optional[str]:
+    from paddle_tpu.distributed import checkpoint as ckpt
+    ev = rec["evidence"]
+    inp = ev["inputs"]
+    plan = ckpt.rollback_plan(inp["candidates"], inp["step"],
+                              best_effort=inp["best_effort"],
+                              require_healthy=inp["require_healthy"])
+    if plan != ev["decision"]["plan"]:
+        return (f"rollback_plan diverged: {plan} != "
+                f"{ev['decision']['plan']}")
+    failed = set(inp.get("failed") or [])
+    chosen = None
+    for att in plan:
+        if att["tag"] == "skip_unhealthy" or att["cand"] in failed:
+            continue
+        chosen = att
+        break
+    if chosen is None:
+        return "replayed walk found no restorable candidate"
+    if (chosen["cand"] != ev["decision"]["chosen"]
+            or chosen["tag"] != ev["decision"]["tag"]):
+        return (f"rollback landing diverged: replay chose "
+                f"{chosen}, recorded {ev['decision']['chosen']}"
+                f"/{ev['decision']['tag']}")
+    return None
+
+
+def _replay_planner_layout(rec: dict) -> Optional[str]:
+    from paddle_tpu.distributed import sharding
+    ev = rec["evidence"]
+    inp = ev["inputs"]
+    calib = None
+    if inp.get("calibration") is not None:
+        from paddle_tpu.observability.calibration import Calibration
+        calib = Calibration(inp["calibration"])
+    sizes, reports = sharding.choose_layout(
+        inp["n_devices"], sharding.ModelDims(**inp["dims"]),
+        inp["hbm_bytes_per_chip"], compress=inp["compress"],
+        num_micro=inp["num_micro"], max_tp=inp["max_tp"],
+        max_pp=inp["max_pp"], calibration=calib)
+    if sizes != ev["decision"]["sizes"]:
+        return (f"choose_layout winner diverged: {sizes} != "
+                f"{ev['decision']['sizes']}")
+    cands = [r.as_dict() for r in reports]
+    # JSON round-trip the recomputed reports so float/int identity is
+    # compared on the same encoding the fixture committed
+    cands = json.loads(json.dumps(cands))
+    want = json.loads(json.dumps(ev["decision"]["candidates"]))
+    if cands != want:
+        return "candidate cost reports diverged from the recorded ruler"
+    return None
+
+
+_DISPATCH = {
+    "supervisor.remediate": _replay_supervisor_remediate,
+    "supervisor.grow": _replay_supervisor_grow,
+    "supervisor.scale": _replay_supervisor_scale,
+    "fleet.shed": _replay_fleet_shed,
+    "fleet.swap": _replay_fleet_swap,
+    "checkpoint.rollback": _replay_checkpoint_rollback,
+    "planner.layout": _replay_planner_layout,
+}
+
+
+# -- driver -------------------------------------------------------------------
+
+def replay_record(rec: dict) -> Dict[str, Any]:
+    """Replay ONE record dict (DecisionRecord.as_dict shape). Returns
+    {decision_id, actor, action, status: ok|mismatch|skipped, why}."""
+    out = {"decision_id": rec.get("decision_id"),
+           "actor": rec.get("actor"), "action": rec.get("action"),
+           "status": "ok", "why": None}
+    fn = _DISPATCH.get(rec.get("actor"))
+    if fn is None:
+        out["status"] = "skipped"
+        out["why"] = f"no replay dispatch for actor {rec.get('actor')!r}"
+        return out
+    from paddle_tpu.observability import decisions as dec
+    was = dec.enabled()
+    dec.disable()      # a replay must never record
+    try:
+        why = fn(rec)
+    except Exception as e:  # a replay crash IS a determinism failure
+        why = f"replay raised {type(e).__name__}: {e}"
+    finally:
+        dec.enable(was)
+    if why is not None:
+        out["status"] = "mismatch"
+        out["why"] = why
+    return out
+
+
+def replay_doc(doc: dict) -> Dict[str, Any]:
+    """Replay every record of one decisions dump doc."""
+    results = [replay_record(r) for r in doc.get("records", [])]
+    mismatches = [r for r in results if r["status"] == "mismatch"]
+    return {
+        "records": len(results),
+        "checked": sum(1 for r in results if r["status"] != "skipped"),
+        "skipped": sum(1 for r in results if r["status"] == "skipped"),
+        "mismatches": mismatches,
+        "ok": not mismatches,
+        "results": results,
+    }
+
+
+def replay_path(path: str) -> Dict[str, Any]:
+    """Replay one dump file or every decisions_*.json under a dir."""
+    from paddle_tpu.observability import decisions as dec
+    if os.path.isdir(path):
+        paths = dec.glob_dumps(path)
+    else:
+        paths = [path]
+    per = {}
+    ok = True
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        r = replay_doc(doc)
+        r.pop("results")
+        per[os.path.basename(p)] = r
+        ok = ok and r["ok"]
+    return {"ok": ok, "dumps": len(paths), "per_dump": per}
+
+
+# -- the committed fixture ----------------------------------------------------
+
+def make_fixture(path: str = FIXTURE) -> dict:
+    """Record one canned incident timeline into a decisions dump — the
+    chaos-drill shapes, deterministically, with injected clocks: a
+    crash-evict under allow_shrink, a budget abort, a budget-deferred
+    then granted grow, a p99-breach scale_up and an idle scale_down, a
+    shed, a corrupt-standby abort + a clean hot swap, a certified
+    rollback that walks past a decertified candidate, and an 8-chip
+    layout pick. Committed so tier-1 replays TODAY's remediation
+    behavior against tomorrow's refactors."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed import elastic, sharding
+    from paddle_tpu.observability import decisions as dec
+
+    dec.reset()
+    dec.note_bounce(0.0)   # fixture clocks are synthetic; keep the
+    #                        staleness plane quiet for replay tests
+
+    # 1) crash → evict_shrink (allow_shrink, doctor names rank 2)
+    pol = elastic.SupervisorPolicy(world=4, allow_shrink=True,
+                                   backoff_base=1.0, heal_after_s=5.0)
+    pol.decide([(2, "process exited 137")],
+               {"kind": "crash", "rank": 2, "source": "doctor",
+                "evidence": {"why": "exit 137"}}, now=100.0,
+               evidence_ts=99.0)
+
+    # 2) exhausted lifetime budget → abort
+    pol2 = elastic.SupervisorPolicy(world=2, max_restarts=1,
+                                    backoff_base=1.0)
+    pol2.record_respawn(now=10.0)
+    pol2.decide([(0, "process exited 1")], None, now=20.0)
+
+    # 3) grow deferred by the restarts-per-window budget, then granted
+    #    once the window slides (the maybe_grow budget-bypass fix)
+    pol3 = elastic.SupervisorPolicy(world=2, allow_shrink=True,
+                                    grow_after_s=5.0,
+                                    restart_window_s=60.0,
+                                    restart_budget=1, backoff_base=1.0)
+    pol3.decide([(1, "preempted")], None, now=100.0)   # evict_shrink
+    pol3.record_respawn(now=100.0)                     # budget spent
+    pol3.maybe_grow(now=110.0)                         # -> deferred
+    pol3.maybe_grow(now=170.0)                         # window slid -> grow
+
+    # 4) serving scale: p99 breach up, then idle down
+    slo = _DuckSLO({"p99_ttft_ms": 500.0, "queue_high": 4,
+                    "queue_low": 1})
+    pol4 = elastic.SupervisorPolicy(world=4, initial_world=2,
+                                    scale_cooldown_s=5.0,
+                                    backoff_base=1.0)
+    pol4.decide_scale(slo, queued=3, p99_ttft_ms=900.0, now=50.0)
+    pol4.decide_scale(slo, queued=1, p99_ttft_ms=80.0, now=60.0)
+
+    # 5) shed + 6) swap (the fleet's pure rules, fleet record shapes)
+    dec.record("fleet.shed", "shed",
+               rule="lowest class beyond shed_queue_depth",
+               evidence={"inputs": {"cls": "batch", "queue_len": 64,
+                                    "shed_queue_depth": 64,
+                                    "lowest_class": "batch",
+                                    "shed_enabled": True},
+                         "decision": {"action": "shed"}},
+               signals={"queued": 80}, settle_s=0.0, clock=200.0)
+    dec.record("fleet.swap", "swap_aborted",
+               rule="standby failed verification",
+               evidence={"inputs": {"verify": True, "standby_ok": False,
+                                    "version": 1},
+                         "decision": {"action": "swap_aborted"}},
+               signals={"completed": 0}, post_signals={"completed": 0},
+               clock=210.0)
+    dec.record("fleet.swap", "weight_swap",
+               rule="standby verified; flip per-replica at token "
+                    "boundaries",
+               evidence={"inputs": {"verify": True, "standby_ok": True,
+                                    "version": 1},
+                         "decision": {"action": "weight_swap"}},
+               signals={"completed": 0}, post_signals={"completed": 1},
+               clock=220.0)
+
+    # 7) certified rollback: newest candidate decertified, walk past it
+    cands = [{"name": "model.pdckpt", "step": 30, "healthy": False},
+             {"name": "model.pdckpt.old", "step": 20, "healthy": True},
+             {"name": "model.pdckpt.old2", "step": 10, "healthy": True}]
+    plan = ckpt.rollback_plan(cands, 25, best_effort=True,
+                              require_healthy=True)
+    chosen = next(a for a in plan if a["tag"] != "skip_unhealthy")
+    dec.record("checkpoint.rollback", "rollback",
+               rule="certified consistent-cut walk",
+               evidence={"inputs": {"step": 25, "best_effort": True,
+                                    "require_healthy": True,
+                                    "candidates": cands, "failed": []},
+                         "decision": {"action": "rollback",
+                                      "chosen": chosen["cand"],
+                                      "chosen_step": chosen["step"],
+                                      "tag": chosen["tag"],
+                                      "certified": True, "plan": plan}},
+               signals={"restored": 0, "healthy": 0},
+               post_signals={"restored": 1, "healthy": 1}, clock=230.0)
+
+    # 8) layout pick over 8 synthetic chips (analytic ruler: the
+    #    fixture must not depend on the committed calibration table)
+    dims = sharding.ModelDims(n_params=124_000_000, hidden=768,
+                              n_layers=12, seq=1024, batch=8,
+                              opt_slots=2,
+                              largest_layer_params=38_597_376)
+    sharding.MeshPlan.auto(8, dims, 16e9, calibration=None)
+
+    dec.join_outcomes(force=True)
+    doc = dec.dump(path=path, reason="chaos_fixture")
+    dec.reset()
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="?", default=None,
+                    help="decisions dump file or directory of "
+                         "decisions_*.json (default: the committed "
+                         "fixture)")
+    ap.add_argument("--make-fixture", action="store_true",
+                    help=f"regenerate {FIXTURE}")
+    args = ap.parse_args(argv)
+    if args.make_fixture:
+        doc = make_fixture()
+        print(json.dumps({"fixture": doc.get("path"),
+                          "records": len(doc["records"])}))
+        return 0
+    target = args.target or FIXTURE
+    out = replay_path(target)
+    print("incident_replay: " + json.dumps(
+        {k: v for k, v in out.items()}, default=str))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -825,6 +825,187 @@ def run_plan_audit(args):
     return 0 if summary["ok"] else 1
 
 
+def run_decisions(args):
+    """Decision-ledger bridge: the zero-to-receipt drive of the
+    control plane. Runs a canned incident end-to-end IN PROCESS — a
+    crash evicted under allow_shrink, a budget-deferred then granted
+    grow, a p99-breach scale_up, a shed, a hot swap, a certified
+    rollback walk, an 8-chip layout pick — pushing the post-decision
+    observations each actor would publish, so every record JOINS a
+    measured outcome. Then cashes all three ledger contracts: replay
+    (tools/incident_replay re-derives every action bit-identically
+    from the dumped evidence), timeline (tools/ops_timeline merges
+    decisions + flight events chronologically), and export (the
+    always-on decision.total / decision.outcome series land in the
+    Prometheus text dump). Prints ONE JSON line; ok=false on any gap."""
+    import socket as _socket  # noqa: F401  (parity with other modes)
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed import elastic, sharding
+    from paddle_tpu.observability import (decisions as dec, exporters,
+                                          flight_recorder as fr,
+                                          metrics)
+    from tools import incident_replay, ops_timeline
+
+    outdir = args.out
+    os.makedirs(outdir, exist_ok=True)
+    dec.reset()
+    fr.enable()
+    metrics.enable()
+
+    class _SLO:
+        p99_ttft_ms, queue_high, queue_low = 500.0, 4, 1
+
+    # 1) remediate: doctor-confirmed crash -> evict_shrink; the
+    #    healthy poll 6 s later is the joiner's proof it healed
+    pol = elastic.SupervisorPolicy(world=4, allow_shrink=True,
+                                   heal_after_s=5.0, backoff_base=1.0,
+                                   grow_after_s=30.0,
+                                   restart_window_s=60.0,
+                                   restart_budget=2)
+    fr.record("elastic.failure", rank=2, why="process exited 137")
+    pol.decide([(2, "process exited 137")],
+               {"kind": "crash", "rank": 2, "source": "doctor",
+                "evidence": {"why": "exit 137"}},
+               now=100.0, evidence_ts=99.5)
+    dec.observe("supervisor.remediate", {"failures": 0}, clock=106.0)
+    dec.join_outcomes(now=106.0)
+
+    # 2) grow: vetoed while the restarts-per-window budget is spent
+    #    (grow_deferred), granted once the window slides
+    pol.record_scale_spawn(now=120.0)
+    pol.record_scale_spawn(now=121.0)
+    deferred_ok = pol.maybe_grow(now=135.0) is None
+    grow = pol.maybe_grow(now=190.0)
+    dec.observe("supervisor.grow", {"failures": 0}, clock=196.0)
+    dec.join_outcomes(now=196.0)
+
+    # 3) serving scale_up on a p99 breach; the queue drains
+    spol = elastic.SupervisorPolicy(world=4, initial_world=2,
+                                    scale_cooldown_s=5.0,
+                                    backoff_base=1.0)
+    spol.decide_scale(_SLO(), queued=40, p99_ttft_ms=900.0, now=200.0)
+    dec.observe("supervisor.scale",
+                {"queued": 4, "p99_ttft_ms": 300.0}, clock=206.0)
+    dec.join_outcomes(now=206.0)
+
+    # 4) shed + hot swap (the fleet's record shapes; the swap knows
+    #    its outcome at commit time)
+    dec.record("fleet.shed", "shed",
+               rule="lowest class beyond shed_queue_depth",
+               evidence={"inputs": {"cls": "batch", "queue_len": 64,
+                                    "shed_queue_depth": 64,
+                                    "lowest_class": "batch",
+                                    "shed_enabled": True},
+                         "decision": {"action": "shed"}},
+               signals={"queued": 80}, settle_s=0.05, clock=210.0)
+    dec.observe("fleet.shed", {"queued": 10}, clock=211.0)
+    dec.join_outcomes(now=211.0)
+    dec.record("fleet.swap", "weight_swap",
+               rule="standby verified; flip per-replica at token "
+                    "boundaries",
+               evidence={"inputs": {"verify": True, "standby_ok": True,
+                                    "version": 1},
+                         "decision": {"action": "weight_swap"}},
+               signals={"completed": 0}, post_signals={"completed": 1},
+               clock=220.0)
+
+    # 5) certified rollback walking past a decertified candidate
+    cands = [{"name": "model.pdckpt", "step": 30, "healthy": False},
+             {"name": "model.pdckpt.old", "step": 20, "healthy": True}]
+    plan = ckpt.rollback_plan(cands, 25, best_effort=True,
+                              require_healthy=True)
+    chosen = next(a for a in plan if a["tag"] != "skip_unhealthy")
+    dec.record("checkpoint.rollback", "rollback",
+               rule="certified consistent-cut walk",
+               evidence={"inputs": {"step": 25, "best_effort": True,
+                                    "require_healthy": True,
+                                    "candidates": cands, "failed": []},
+                         "decision": {"action": "rollback",
+                                      "chosen": chosen["cand"],
+                                      "chosen_step": chosen["step"],
+                                      "tag": chosen["tag"],
+                                      "certified": True, "plan": plan}},
+               signals={"restored": 0, "healthy": 0},
+               post_signals={"restored": 1, "healthy": 1}, clock=230.0)
+
+    # 6) layout pick; PR 18's audit gauge is the probe its joiner reads
+    dims = sharding.ModelDims(n_params=124_000_000, hidden=768,
+                              n_layers=12, seq=1024, batch=8,
+                              opt_slots=2,
+                              largest_layer_params=38_597_376)
+    mesh_plan = sharding.MeshPlan.auto(8, dims, 16e9, calibration=None)
+    metrics.gauge("planner.prediction_error", _always=True,
+                  metric="step_time").set(0.07)
+    dec.join_outcomes(force=True)
+
+    # the paper trail: dump, replay, timeline, export
+    doc = dec.dump(reason="obs_report", out_dir=outdir)
+    fr.dump(path=os.path.join(
+        outdir, "flight_obs_report_rank0_pid%d.json" % os.getpid()),
+        reason="obs_report", stacks=False)
+    replay = incident_replay.replay_doc(doc)
+    replay.pop("results", None)
+    events = ops_timeline.timeline_for_dir(outdir)
+    trace_path = args.trace or os.path.join(outdir,
+                                            "ops_timeline.json")
+    with open(trace_path, "w") as f:
+        json.dump(ops_timeline.to_chrome_trace(events), f)
+    prom_path = args.prom or os.path.join(outdir, "metrics.prom")
+    exporters.write_prometheus(prom_path)
+    with open(prom_path) as f:
+        prom_decision_lines = [
+            ln for ln in f.read().splitlines()
+            if "decision_" in ln and not ln.startswith("#")]
+    metrics.disable()
+    fr.disable()
+
+    actors = sorted({r.actor for r in dec.records()})
+    outcomes = dec.outcome_counts()
+    summary = {
+        "ok": True,
+        "records": len(dec.records()),
+        "actors": actors,
+        "outcomes": outcomes,
+        "layout": dict(mesh_plan.sizes),
+        "replay": replay,
+        "timeline_events": len(events),
+        "chrome_trace": trace_path,
+        "decisions_dump": doc.get("path"),
+        "prom_decision_series": len(prom_decision_lines),
+        "prometheus": prom_path,
+    }
+    problems = []
+    want_actors = ["checkpoint.rollback", "fleet.shed", "fleet.swap",
+                   "planner.layout", "supervisor.grow",
+                   "supervisor.remediate", "supervisor.scale"]
+    if actors != want_actors:
+        problems.append(f"actor classes missing: expected "
+                        f"{want_actors}, got {actors}")
+    if not deferred_ok or grow is None:
+        problems.append("grow budget gate broken: deferred="
+                        f"{deferred_ok}, granted={grow is not None}")
+    if not replay["ok"]:
+        problems.append(f"incident replay diverged: "
+                        f"{replay['mismatches']}")
+    if outcomes.get("unjoined", 0) != 0:
+        problems.append(f"{outcomes['unjoined']} decisions never "
+                        "joined an outcome despite post-signals")
+    if outcomes.get("improved", 0) < 5:
+        problems.append(f"expected >=5 improved outcomes, got "
+                        f"{outcomes.get('improved', 0)}")
+    if len(events) < 2 * len(dec.records()):
+        problems.append(f"timeline carries {len(events)} events for "
+                        f"{len(dec.records())} joined decisions")
+    if len(prom_decision_lines) < 5:
+        problems.append("decision.* series missing from the "
+                        "Prometheus export")
+    if problems:
+        summary["ok"] = False
+        summary["problems"] = problems
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
 def _wire_counter_total(snap) -> float:
     """Bytes the EXPLICIT comm paths counted: comm.wire_bytes (the
     compressed on-wire series) plus collective.bytes (trace-time
@@ -883,6 +1064,10 @@ def main(argv=None):
                     dest="plan_audit",
                     help="measured-vs-predicted plan audit receipt "
                          "(cost-model truth plane)")
+    ap.add_argument("--decisions", action="store_true",
+                    help="decision-ledger receipt: canned incident -> "
+                         "joined outcomes -> bit-identical replay -> "
+                         "ops timeline -> exported decision.* series")
     ap.add_argument("--force-recompile", action="store_true")
     ap.add_argument("--doctor", default=None, metavar="DIR",
                     help="diagnose flight-recorder dumps in DIR "
@@ -895,6 +1080,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.doctor:
         return run_doctor(args)
+    if args.decisions:
+        return run_decisions(args)
     if args.plan_audit:
         return run_plan_audit(args)
     if args.pulse:

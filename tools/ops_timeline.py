@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""ops_timeline: one chronological view of what the pod SAW, DECIDED,
+and GOT.
+
+The forensics planes each dump their own artifact — flight-recorder
+rings (``flight_*.json``: collective enter/exit, step/checkpoint/
+evict breadcrumbs), the decision ledger (``decisions_*.json``: every
+autonomous action with its evidence and joined outcome), reqtrace
+request spans, and the pulse sampler's time-series rings. Answering
+"why did the fleet do X at 03:12, and did it help" means eyeballing
+four files on four clocks. This tool merges them into ONE
+chronological stream:
+
+  decision   a DecisionRecord firing (actor, action, rule) — and a
+             second entry at ``joined_ts`` carrying the outcome, so
+             cause and measured effect both land on the timeline
+  flight     every flight-recorder event (kind + fields)
+  reqtrace   request spans/marks (in-process only — the trace clock is
+             perf_counter, so callers pass ``trace_offset`` =
+             ``time.time() - time.perf_counter()`` captured in the
+             SAME process; file-based merges skip this lane)
+  series     pulse-ring samples for selected keys (queue depth, p99,
+             decision outcomes...), so the signal the decision read is
+             visible right next to the decision
+
+Output: JSONL (one ``{"ts", "source", "kind", ...}`` per line,
+sorted) or a chrome-trace (``chrome://tracing`` / Perfetto) where
+each source is a lane and decisions are instant events whose args
+carry rule + evidence summary + outcome.
+
+Usage:
+  python tools/ops_timeline.py DIR                 # JSONL to stdout
+  python tools/ops_timeline.py DIR --chrome out.json
+  python tools/ops_timeline.py DIR --jsonl out.jsonl --limit 200
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# -- loaders ------------------------------------------------------------------
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def load_decision_docs(dump_dir: str) -> List[dict]:
+    return [d for d in (_read_json(p) for p in sorted(glob.glob(
+        os.path.join(dump_dir, "decisions_*.json")))) if d]
+
+
+def load_flight_docs(dump_dir: str) -> List[dict]:
+    return [d for d in (_read_json(p) for p in sorted(glob.glob(
+        os.path.join(dump_dir, "flight_*.json")))) if d]
+
+
+# -- normalization ------------------------------------------------------------
+
+def decision_events(docs: List[dict]) -> List[dict]:
+    """Two timeline entries per record: the decision at ``ts`` and —
+    when the joiner closed it — the outcome at ``joined_ts``."""
+    out = []
+    for doc in docs:
+        rank = doc.get("rank", 0)
+        for rec in doc.get("records", []):
+            out.append({
+                "ts": rec["ts"], "source": "decision",
+                "kind": f"{rec['actor']}:{rec['action']}",
+                "rank": rank,
+                "decision_id": rec["decision_id"],
+                "rule": rec.get("rule"),
+                "outcome": rec.get("outcome"),
+                "evidence_ts": rec.get("evidence_ts"),
+            })
+            if rec.get("joined_ts") is not None:
+                out.append({
+                    "ts": rec["joined_ts"], "source": "decision",
+                    "kind": f"outcome:{rec.get('outcome')}",
+                    "rank": rank,
+                    "decision_id": rec["decision_id"],
+                    "actor": rec["actor"], "action": rec["action"],
+                    "outcome_evidence": rec.get("outcome_evidence"),
+                })
+    return out
+
+
+def flight_events(docs: List[dict]) -> List[dict]:
+    out = []
+    for doc in docs:
+        rank = doc.get("rank", 0)
+        for e in doc.get("events", []):
+            ev = {k: v for k, v in e.items()
+                  if k not in ("t", "k", "i")}
+            ev.update({"ts": e.get("t"), "source": "flight",
+                       "kind": e.get("k"), "rank": rank})
+            if ev["ts"] is not None:
+                out.append(ev)
+    return out
+
+
+def reqtrace_events(evts: List[dict],
+                    trace_offset: float) -> List[dict]:
+    """Reqtrace rides perf_counter; ``trace_offset`` rebases it onto
+    the wall clock (``time.time() - time.perf_counter()`` captured in
+    the emitting process)."""
+    out = []
+    for e in evts:
+        kind = e.get("comp") or e.get("mark") or "?"
+        ev = {k: v for k, v in e.items()
+              if k not in ("t", "t0", "t1", "i")}
+        ev.update({"source": "reqtrace", "kind": kind})
+        if e.get("t0") is not None:          # span: start + duration
+            ev["ts"] = e["t0"] + trace_offset
+            ev["dur_s"] = (e.get("t1", e["t0"]) - e["t0"])
+        elif e.get("t") is not None:         # mark: instant
+            ev["ts"] = e["t"] + trace_offset
+        else:
+            continue
+        out.append(ev)
+    return out
+
+
+def series_events(keys: Optional[List[str]] = None) -> List[dict]:
+    """Pulse-ring samples for ``keys`` (prefix match per key) from the
+    in-process timeseries plane."""
+    from paddle_tpu.observability import timeseries as _ts
+    out = []
+    for want in (keys or []):
+        for key in _ts.keys(prefix=want):
+            for ts, v in (_ts.series(key) or []):
+                out.append({"ts": ts, "source": "series", "kind": key,
+                            "value": v})
+    return out
+
+
+def merge_timeline(decision_docs: Optional[List[dict]] = None,
+                   flight_docs: Optional[List[dict]] = None,
+                   reqtrace_evts: Optional[List[dict]] = None,
+                   trace_offset: float = 0.0,
+                   series_keys: Optional[List[str]] = None
+                   ) -> List[dict]:
+    """The merge: every plane normalized to {ts, source, kind, ...}
+    and sorted on the shared wall clock (stable — same-instant events
+    keep plane order: decisions, flight, reqtrace, series)."""
+    events: List[dict] = []
+    events += decision_events(decision_docs or [])
+    events += flight_events(flight_docs or [])
+    if reqtrace_evts:
+        events += reqtrace_events(reqtrace_evts, trace_offset)
+    if series_keys:
+        events += series_events(series_keys)
+    events.sort(key=lambda e: e["ts"])
+    return events
+
+
+# -- renderers ----------------------------------------------------------------
+
+_LANES = {"decision": 1, "flight": 2, "reqtrace": 3, "series": 4}
+
+
+def to_chrome_trace(events: List[dict]) -> Dict[str, Any]:
+    """Instant events on one lane (tid) per source; spans (dur_s) as
+    complete events. Epoch-rebased so Perfetto's µs axis starts at 0."""
+    if not events:
+        return {"traceEvents": []}
+    t0 = min(e["ts"] for e in events)
+    tes = []
+    for e in events:
+        args = {k: v for k, v in e.items()
+                if k not in ("ts", "source", "kind", "dur_s")}
+        te = {"name": e["kind"], "pid": 0,
+              "tid": _LANES.get(e["source"], 9),
+              "ts": (e["ts"] - t0) * 1e6, "args": args}
+        if e.get("dur_s") is not None:
+            te.update({"ph": "X", "dur": e["dur_s"] * 1e6})
+        else:
+            te.update({"ph": "i", "s": "t"})
+        tes.append(te)
+    meta = [{"ph": "M", "pid": 0, "tid": tid, "name": "thread_name",
+             "args": {"name": src}} for src, tid in _LANES.items()]
+    return {"traceEvents": meta + tes,
+            "displayTimeUnit": "ms",
+            "otherData": {"epoch_ts": t0}}
+
+
+def timeline_for_dir(dump_dir: str,
+                     series_keys: Optional[List[str]] = None
+                     ) -> List[dict]:
+    return merge_timeline(load_decision_docs(dump_dir),
+                          load_flight_docs(dump_dir),
+                          series_keys=series_keys)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="directory holding decisions_*.json / "
+                                "flight_*.json dumps")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="write a chrome-trace JSON here")
+    ap.add_argument("--jsonl", metavar="OUT",
+                    help="write JSONL here instead of stdout")
+    ap.add_argument("--series", action="append", default=[],
+                    help="include in-process pulse-ring keys matching "
+                         "this prefix (repeatable)")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="print at most N newest events (0 = all)")
+    args = ap.parse_args(argv)
+    events = timeline_for_dir(args.dir, series_keys=args.series)
+    shown = events[-args.limit:] if args.limit else events
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(to_chrome_trace(events), f)
+        print(json.dumps({"chrome_trace": args.chrome,
+                          "events": len(events)}))
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        print(json.dumps({"jsonl": args.jsonl, "events": len(events)}))
+    if not args.chrome and not args.jsonl:
+        for e in shown:
+            print(json.dumps(e, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -158,6 +158,31 @@ def p99_recovery(finished, fault_ts, bound_ms, window=8):
     return max(0.0, recovered_at - fault_ts)
 
 
+# every autonomous fleet move in this family must ship its ledger
+# audit: a decision_id in the episode receipt AND a JOINED outcome
+# (anything still "unjoined" means the fleet acted and nobody measured
+# whether it helped — the drill fails the receipt)
+AUDITED_ACTIONS = ("evict_shrink", "respawn_rank", "scale_up",
+                   "scale_down", "grow", "weight_swap", "swap_aborted")
+
+
+def _ledger_audit(episodes, require=1):
+    """Cross-check fleet episode receipts against the decision ledger:
+    every AUDITED action must carry a decision_id whose outcome joined
+    (require = minimum number of audited episodes expected)."""
+    audited = [e for e in episodes
+               if e.get("action") in AUDITED_ACTIONS]
+    unaudited = [
+        {"action": e.get("action"), "episode": e.get("episode"),
+         "decision_id": e.get("decision_id"),
+         "outcome": e.get("outcome")}
+        for e in audited
+        if not e.get("decision_id")
+        or e.get("outcome") in (None, "unjoined")]
+    return {"ok": len(audited) >= require and not unaudited,
+            "audited": len(audited), "unaudited": unaudited}
+
+
 def run_fault_drill(args, mode):
     """kill / stall: one replica faulted mid-load."""
     from paddle_tpu.observability import reqtrace
@@ -205,6 +230,7 @@ def run_fault_drill(args, mode):
                     if e["action"] in ("evict_shrink", "respawn_rank")]
     receipt_names_replica = any(
         args.chaos_replica in e["ranks"] for e in remediations)
+    ledger_audited = _ledger_audit(summ["episodes"])
     dropped = args.requests - stats.get("requests", 0) - stats["shed"]
     expected_verdict = "crash" if mode == "kill" else "hang"
     expected_cause = ("replica_kill" if mode == "kill"
@@ -225,7 +251,8 @@ def run_fault_drill(args, mode):
           and summ["recompile_events"] == 0
           and 0.0 <= rec_s <= args.recovery_bound_s
           and trace_verdict_ok
-          and tail_sums_ok)
+          and tail_sums_ok
+          and ledger_audited["ok"])
     return {
         "metric": f"serving_chaos_{mode}",
         "value": stats.get("requests", 0),
@@ -243,6 +270,7 @@ def run_fault_drill(args, mode):
             "breach_verdict": breach,
             "trace_verdict_ok": trace_verdict_ok,
             "tail_components_sum_ok": tail_sums_ok,
+            "ledger_audited": ledger_audited,
             "receipt_ok": ok,
         },
     }
@@ -316,13 +344,17 @@ def run_swap_drill(args):
                     for fr, o in zip(finished, outs))
     summ = stats["fleet"]
     dropped = args.requests - stats.get("requests", 0) - stats["shed"]
+    # BOTH swap halves must be in the ledger: the completed flip and
+    # the sabotaged abort each carry a joined decision record
+    ledger_audited = _ledger_audit(summ["episodes"], require=2)
     ok = (dropped == 0
           and swap_state["clean"] is True
           and swap_state["sabotaged"] is False
           and summ["weight_swaps"] == 1
           and summ["weight_swaps_aborted"] == 1
           and summ["recompile_events"] == 0
-          and identical)
+          and identical
+          and ledger_audited["ok"])
     return {
         "metric": "serving_chaos_swap",
         "value": summ["weight_swaps"],
@@ -336,6 +368,7 @@ def run_swap_drill(args):
             "zero_recompiles": summ["recompile_events"] == 0,
             # the flip pauses are visible per request in the trace
             "swap_flip_spans": tail["swap_flips"],
+            "ledger_audited": ledger_audited,
             "receipt_ok": ok,
         },
     }
@@ -378,12 +411,16 @@ def run_overload_drill(args):
     degraded = (stats["shed"] > 0
                 or (lo["p99"] > 0 and hi["p99"] > 0
                     and lo["p99"] >= 2.0 * hi["p99"]))
+    # autoscale off => no audited episodes expected (require=0 keeps
+    # the check vacuous); any scale/evict that DID fire must be joined
+    ledger_audited = _ledger_audit(summ["episodes"], require=0)
     ok = (dropped == 0
           and hi_done == n_hi
           and 0 < hi["p99"] <= args.slo_p99_ms
           and batch_shed
           and degraded
-          and summ["recompile_events"] == 0)
+          and summ["recompile_events"] == 0
+          and ledger_audited["ok"])
     return {
         "metric": "serving_chaos_overload",
         "value": hi["p99"],
@@ -404,6 +441,7 @@ def run_overload_drill(args):
             "breach_verdict": breach,
             "tail_dominant": tail["dominant_overall"],
             "slo_burn": summ.get("slo_burn"),
+            "ledger_audited": ledger_audited,
             "receipt_ok": ok,
         },
     }

@@ -406,6 +406,38 @@ def diagnose(dumps: List[dict]) -> dict:
     }
 
 
+def stale_decisions(decision_docs: List[dict]) -> List[dict]:
+    """Cross-check the decision ledger against the bounce clock: flag
+    every record the CURRENT incarnation acted on (``rec.ts`` at or
+    after the dump's ``incarnation_ts``) whose evidence was gathered
+    BEFORE the bounce that spawned this incarnation
+    (``evidence_ts < incarnation_ts``). That is the acted-on-stale-
+    evidence failure class: the supervisor evicted/scaled on a
+    diagnosis describing the pod that no longer exists. Pure function
+    over decisions_*.json docs — safe on a triage host."""
+    out = []
+    for doc in decision_docs:
+        inc = doc.get("incarnation_ts")
+        if inc is None:
+            continue
+        for rec in doc.get("records", []):
+            ets = rec.get("evidence_ts")
+            if (ets is not None and rec.get("ts") is not None
+                    and rec["ts"] >= inc and ets < inc):
+                out.append({
+                    "decision_id": rec.get("decision_id"),
+                    "actor": rec.get("actor"),
+                    "action": rec.get("action"),
+                    "rank": doc.get("rank", 0),
+                    "ts": rec["ts"],
+                    "evidence_ts": ets,
+                    "incarnation_ts": inc,
+                    "evidence_age_s": round(inc - ets, 3),
+                    "outcome": rec.get("outcome"),
+                })
+    return out
+
+
 def verdict(diag: dict) -> dict:
     """Collapse a diagnosis into ONE actionable verdict — the record
     the elastic supervisor (distributed/elastic.py) consumes to decide
@@ -667,6 +699,13 @@ def format_report(diag: dict) -> str:
                    if e.get('requeued') is not None else "")
                 + (f"action={e.get('action')}"
                    if e.get('action') else ""))
+    for s in diag.get("stale_decisions", []):
+        lines.append(
+            f"STALE EVIDENCE: {s['actor']}:{s['action']} "
+            f"({s['decision_id']}) fired at {s['ts']:.3f} in the "
+            f"current incarnation, but its evidence predates the "
+            f"bounce by {s['evidence_age_s']}s — the action targeted "
+            "a pod that no longer exists (re-diagnose, then re-decide)")
     gp = diag.get("goodput")
     if gp:
         lines.append(
@@ -794,15 +833,35 @@ def main(argv=None) -> int:
                                    summary=summ)
         print(json.dumps(v))
         return 1 if v["cause"] not in ("none", "unattributed") else 0
-    paths = list(args.dumps)
+    given = list(args.dumps)
+    # decision-ledger dumps ride the same CLI surface: positionally by
+    # their decisions_* basename, or scooped up next to flight_*.json
+    # under --dir
+    dec_paths = [p for p in given
+                 if os.path.basename(p).startswith("decisions_")]
+    paths = [p for p in given if p not in dec_paths]
     if args.dir:
         paths += sorted(glob.glob(os.path.join(args.dir,
                                                "flight_*.json")))
-    if not paths:
+        dec_paths += sorted(glob.glob(os.path.join(args.dir,
+                                                   "decisions_*.json")))
+    if not paths and not dec_paths:
         print("tpu_doctor: no dumps given (pass files or --dir)",
               file=sys.stderr)
         return 2
-    diag = diagnose(load_dumps(paths))
+    dec_docs = []
+    for p in dec_paths:
+        try:
+            with open(p) as f:
+                dec_docs.append(json.load(f))
+        except (OSError, ValueError):
+            pass
+    diag = diagnose(load_dumps(paths)) if paths else {
+        "hosts": 0, "ranks": [], "reasons": [], "divergence": None,
+        "oom": [], "numeric": None, "stragglers": [],
+        "recompile_storm": None, "hangs": [], "goodput": None,
+        "serving_incidents": []}
+    diag["stale_decisions"] = stale_decisions(dec_docs)
     if args.verdict:
         print(json.dumps(verdict(diag)))
     elif args.json:
@@ -817,7 +876,8 @@ def main(argv=None) -> int:
                or (num and num.get("diverging_rank") is not None)
                or diag.get("oom")
                or diag["stragglers"]
-               or diag["recompile_storm"] or diag["hangs"])
+               or diag["recompile_storm"] or diag["hangs"]
+               or diag.get("stale_decisions"))
     return 1 if bad else 0
 
 
