@@ -883,3 +883,78 @@ class MeshPlan:
         if self.receipt is not None:
             d["receipt"] = self.receipt.as_dict()
         return d
+
+
+# ---------------------------------------------------------------------------
+# serving spec derivation (tensor-parallel serving engine)
+# ---------------------------------------------------------------------------
+# The serving snapshot is NOT a training pytree: the embedding table is
+# the lm_head (logits = h @ wte.T) and must stay REPLICATED for the
+# greedy-parity contract (the training flavor's _EMBED_RE fsdp x tp
+# vocab sharding would force an all-gather of logits per token).
+# Megatron layout over the one 'tp' axis: qkv/fc1 column-parallel
+# (out dim sharded), proj/fc2 row-parallel (in dim sharded, partial
+# contraction all-reduced before the bias), norms + biases of
+# row-parallel layers + embeddings replicated.
+
+#: per-leaf tp specs, keyed by the serving-snapshot block leaf name
+SERVING_TP_RULES = {
+    "qkv_w": P(None, "tp"), "qkv_b": P("tp"),
+    "proj_w": P("tp", None), "proj_b": P(),
+    "fc1_w": P(None, "tp"), "fc1_b": P("tp"),
+    "fc2_w": P("tp", None), "fc2_b": P(),
+}
+
+#: the paged K/V page pools [n_blocks, block_size, n_heads, hd] shard
+#: over the heads axis — each chip holds exactly 1/tp of every page
+SERVING_POOL_SPEC = P(None, None, "tp", None)
+
+
+def permute_qkv_heads(arr, n_heads):
+    """Reorder a fused-qkv weight's output columns (or the bias) from
+    (3, n_heads, hd) to (n_heads, 3, hd) so that a CONTIGUOUS tp shard
+    of the last dim carries whole heads with their q, k and v. The
+    permutation moves values without touching them — each output
+    column's dot product is bitwise the tp=1 column — and it commutes
+    with per-column int8 PTQ (codes and scales permute together when
+    applied to the float weight first). Shapes are preserved, so the
+    swap-validation treedef/shape contract is unchanged."""
+    out = arr.shape[-1]
+    hd = out // (3 * n_heads)
+    x = arr.reshape(arr.shape[:-1] + (3, n_heads, hd))
+    x = jax.numpy.swapaxes(x, -3, -2)
+    return x.reshape(arr.shape)
+
+
+def serving_param_specs(params):
+    """PartitionSpec pytree matching a serving snapshot (float or int8
+    ``{"q8","s"}`` leaves): block weights per SERVING_TP_RULES,
+    everything else (wte/wpe/lnf/ln1/ln2) replicated. int8 leaves
+    follow the parent weight: q8 mirrors the float weight's 2-D spec;
+    the per-output-column scale vector s shards over 'tp' exactly when
+    the out dim does (qkv/fc1), else replicates."""
+    def spec_for(path, leaf):
+        names = [str(getattr(k, "key", getattr(k, "name", k)))
+                 for k in path]
+        if names and names[-1] in ("q8", "s") and len(names) >= 2:
+            base = SERVING_TP_RULES.get(names[-2], P(None, None))
+            if names[-1] == "q8":
+                return base
+            return P("tp") if (len(base) > 1 and base[1] == "tp") \
+                else P()
+        return SERVING_TP_RULES.get(names[-1] if names else "", P())
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def serving_param_shardings(mesh: Mesh, params):
+    """NamedSharding pytree for device_put'ing a serving snapshot onto
+    a tp mesh (the one placement swap_weights must reproduce — a leaf
+    re-placed differently is a new jit cache key, i.e. a recompile)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), serving_param_specs(params),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+__all__ += ["SERVING_TP_RULES", "SERVING_POOL_SPEC",
+            "permute_qkv_heads", "serving_param_specs",
+            "serving_param_shardings"]

@@ -175,16 +175,21 @@ def create_predictor(config: Config) -> Predictor:
 
 
 def create_serving_engine(model, serving_config=None, warmup=True,
-                          **config_kw):
+                          plan=None, **config_kw):
     """The serving twin of create_predictor: build a warmed
     continuous-batching ServingEngine over a live GPTForCausalLM.
     Keyword overrides construct a paddle_tpu.serving.ServingConfig
     (e.g. ``max_slots=16, dtype=None``); ``warmup=False`` skips the
-    ladder compile (tests that only inspect structure)."""
+    ladder compile (tests that only inspect structure).
+
+    ``plan=MeshPlan(tp=N)`` builds the tensor-parallel engine: ONE
+    shard_map program set over the tp axis with the paged K/V pools
+    sharded over heads — tp must divide the model's head count
+    (validated at config time, the error names both dims)."""
     from ..serving import ServingConfig, ServingEngine
-    if serving_config is not None and config_kw:
+    if serving_config is not None and (config_kw or plan is not None):
         raise ValueError(
             "pass either serving_config or keyword overrides, not both")
-    cfg = serving_config or ServingConfig(**config_kw)
+    cfg = serving_config or ServingConfig(plan=plan, **config_kw)
     eng = ServingEngine(model, cfg)
     return eng.warmup() if warmup else eng

@@ -160,7 +160,8 @@ def _step_hidden(params, eps, n_heads, x, caches, pos):
     return x, new_caches
 
 
-def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
+def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None,
+             qkv_heads_major=False, tp_reduce=None, head_dim=None):
     """Full forward over the prompt, returning per-layer caches sized to
     total_len and the last hidden state. Uses the same big-matmul form
     as training (the MXU-efficient path) — only decode is token-wise.
@@ -168,9 +169,18 @@ def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
     prompt_lens [B] (ragged, right-padded prompts): keys beyond each
     row's true length are masked; their junk cache slots are
     progressively OVERWRITTEN by the decode loop's per-row scatter, so
-    they are never attended to."""
+    they are never attended to.
+
+    qkv_heads_major / tp_reduce: the tensor-parallel hooks. Inside a
+    tp shard_map the qkv columns are laid out (heads, 3, hd) — so each
+    chip's contiguous shard carries WHOLE heads with their q,k,v —
+    and the proj/fc2 partial contractions need an all-reduce before
+    the bias. Both default off; the tp=1 graph is byte-for-byte the
+    one this function always built (the parity contract). head_dim
+    must be given explicitly under tp (n_heads is then the LOCAL head
+    count while the replicated hidden stays global)."""
     b, s = ids.shape
-    hd = params["wte"].shape[1] // n_heads
+    hd = head_dim or params["wte"].shape[1] // n_heads
     scale = 1.0 / math.sqrt(hd)
     x = params["wte"][ids] + params["wpe"][jnp.arange(s)][None]
     cm = jnp.tril(jnp.ones((s, s), bool))
@@ -181,8 +191,12 @@ def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
     caches = []
     for bp in params["blocks"]:
         xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-        qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
-            b, s, 3, n_heads, hd)
+        qkv = _mm(xn, bp, "qkv") + bp["qkv_b"]
+        if qkv_heads_major:
+            qkv = jnp.einsum("bsnch->bscnh", qkv.reshape(
+                b, s, n_heads, 3, hd))
+        else:
+            qkv = qkv.reshape(b, s, 3, n_heads, hd)
         q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])
         k = jnp.einsum("bsnh->bnsh", qkv[:, :, 1])
         v = jnp.einsum("bsnh->bnsh", qkv[:, :, 2])
@@ -192,11 +206,17 @@ def _prefill(params, eps, n_heads, ids, total_len, prompt_lens=None):
             x.dtype)
         ctx = jnp.einsum("bnqk,bnkh->bnqh", p, v)
         ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, s, -1)
-        x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
+        proj = _mm(ctx, bp, "proj")
+        if tp_reduce is not None:
+            proj = tp_reduce(proj)
+        x = x + proj + bp["proj_b"]
         ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
         ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
                          approximate=False)
-        x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
+        f2 = _mm(ff, bp, "fc2")
+        if tp_reduce is not None:
+            f2 = tp_reduce(f2)
+        x = x + f2 + bp["fc2_b"]
         kc = jnp.zeros((b, n_heads, total_len, hd), k.dtype)
         vc = jnp.zeros((b, n_heads, total_len, hd), v.dtype)
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k, 0, axis=2)

@@ -62,7 +62,14 @@ def quantize_params(params, qcfg=None):
     weights become int8+scale leaves; everything else (embeddings,
     norms, biases, already-cast floats) passes through untouched. The
     tree STRUCTURE changes — swap_weights re-runs this same transform
-    so a standby pool always lands with the matching treedef."""
+    so a standby pool always lands with the matching treedef.
+
+    Order matters under a tp plan: the snapshot build permutes the
+    fused-qkv columns head-major BEFORE calling this (quantization is
+    per-COLUMN, so permuting float columns permutes codes and scales
+    identically — the {"q8","s"} leaves then shard by the float
+    parent's SERVING_TP_RULES spec: codes like the weight, scales
+    like its output columns)."""
     bits = int(getattr(qcfg, "weight_bits", 8) or 8)
     out = dict(params)
     out["blocks"] = [

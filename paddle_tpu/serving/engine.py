@@ -65,24 +65,52 @@ from ..observability import metrics as _obs
 from ..observability import reqtrace as _rt
 from ..observability.sentinel import RecompileSentinel
 from .paged_cache import PagedKVCache
-from .programs import (jit_with_donated_pools, make_chunk_fn,
+from .programs import (jit_tp_with_donated_pools,
+                       jit_with_donated_pools, make_chunk_fn,
                        make_decode_fn, make_prefill_fn)
 from .scheduler import BucketLadder, FifoScheduler, Request
 
 __all__ = ["ServingConfig", "ServingEngine", "build_serving_snapshot"]
 
 
-def build_serving_snapshot(params, cfg) -> dict:
+def build_serving_snapshot(params, cfg, n_heads: Optional[int] = None
+                           ) -> dict:
     """Raw generation params -> this config's serving snapshot: the
     float cast first, then (``quant="int8"``) the four block matmul
     weights become ``{"q8", "s"}`` PTQ leaves. The ONE builder engine
     build, ``swap_weights(cast=True)`` and the fleet's standby staging
     all share — a snapshot built anywhere else risks a treedef
-    mismatch that would reject every hot swap."""
+    mismatch that would reject every hot swap.
+
+    Under a tensor-parallel plan (``cfg.plan`` with tp>1, which needs
+    ``n_heads``) two more stages run IN ORDER: the fused-qkv columns
+    permute to heads-major BEFORE quantization (so int8 codes + scales
+    permute with their float columns, bitwise), and the finished
+    snapshot device_puts onto the plan's mesh with the derived
+    Megatron specs — qkv/fc1 column-parallel, proj/fc2 row-parallel,
+    embeddings/norms replicated. Shapes and treedef are unchanged, so
+    the swap-validation contract is dtype/shape-identical to tp=1."""
     snap = _cast_params(params, cfg.dtype)
+    tp = cfg.tp
+    if tp > 1:
+        if n_heads is None:
+            raise ValueError(
+                "build_serving_snapshot needs n_heads under a tp plan "
+                "(the qkv head-major column permutation is per-head)")
+        from ..distributed.sharding import permute_qkv_heads
+        snap = dict(snap)
+        snap["blocks"] = [dict(bp) for bp in snap["blocks"]]
+        for bp in snap["blocks"]:
+            bp["qkv_w"] = permute_qkv_heads(bp["qkv_w"], n_heads)
+            bp["qkv_b"] = permute_qkv_heads(bp["qkv_b"], n_heads)
     if cfg.quant == "int8":
         from ..quant.int8_serving import quantize_params
         snap = quantize_params(snap, cfg.quant_config)
+    if tp > 1:
+        import jax
+        from ..distributed.sharding import serving_param_shardings
+        snap = jax.device_put(
+            snap, serving_param_shardings(cfg.plan.mesh, snap))
     return snap
 
 
@@ -109,8 +137,46 @@ class ServingConfig:
     quant: Optional[object] = None     # "int8" | QuantConfig(int8_compute)
     speculative_k: int = 0             # draft proposals per boundary
     prefix_sharing: bool = False       # radix/COW shared prompt pages
+    # -- tensor parallelism --------------------------------------------------
+    plan: Optional[object] = None      # MeshPlan(tp=N): shard_map serving
+    tp_wire: str = "f32"               # tp all-reduce wire tier (comm.py)
+
+    @property
+    def tp(self) -> int:
+        """Tensor-parallel degree (1 without a plan)."""
+        return int(self.plan.sizes["tp"]) if self.plan is not None \
+            else 1
 
     def __post_init__(self):
+        if self.plan is not None:
+            sizes = getattr(self.plan, "sizes", None)
+            if not isinstance(sizes, dict) or "tp" not in sizes:
+                raise ValueError(
+                    "plan= takes a distributed.MeshPlan (e.g. "
+                    "MeshPlan(tp=2))")
+            off_axes = {a: s for a, s in sizes.items()
+                        if a != "tp" and s > 1}
+            if off_axes:
+                raise ValueError(
+                    f"serving plans shard over 'tp' only; drop "
+                    f"{off_axes} (replica parallelism is the fleet's "
+                    "job, not the engine's)")
+        if self.tp > 1:
+            if self.speculative_k:
+                raise ValueError(
+                    "speculative_k is not supported under a tp plan "
+                    "yet: the draft engine would need its own sharded "
+                    "cache + programs. Drop speculative_k or the plan.")
+            if self.prefix_sharing:
+                raise ValueError(
+                    "prefix_sharing is not supported under a tp plan "
+                    "yet: the COW page-copy program is not tp-sharded."
+                    " Drop prefix_sharing or the plan.")
+            if self.tp_wire not in ("f32", "bf16"):
+                raise ValueError(
+                    f"tp_wire={self.tp_wire!r}: the tp all-reduce wire "
+                    "tier is 'f32' (exact, the parity default) or "
+                    "'bf16' (half wire bytes)")
         self.quant_config = None
         if self.quant is not None and not isinstance(self.quant, str):
             # QuantConfig threading: the quant module's config object
@@ -172,32 +238,80 @@ class ServingEngine:
             raise ValueError(
                 f"max_total_tokens={cfg.max_total_tokens} exceeds the "
                 f"model's max_seq_len={mcfg.max_seq_len}")
-        # weight snapshot, cast (and PTQ-quantized under quant="int8")
-        # ONCE at engine build; new weights land only through
-        # swap_weights() at a token boundary (same treedef/avals — the
-        # ladder never recompiles)
-        self.params = build_serving_snapshot(_gpt_params(model), cfg)
         self.n_heads = int(mcfg.num_heads)
+        self.tp = int(cfg.tp)
+        if self.tp > 1 and self.n_heads % self.tp:
+            raise ValueError(
+                f"plan tp={self.tp} must divide n_heads="
+                f"{self.n_heads}: the paged pools shard their heads "
+                f"axis ([n_blocks, block_size, n_heads={self.n_heads},"
+                f" head_dim]) and the qkv/proj weights shard per head "
+                f"— {self.n_heads} % {self.tp} != 0 leaves a ragged "
+                "shard no chip can own")
+        # weight snapshot, cast (and PTQ-quantized under quant="int8",
+        # qkv-permuted + mesh-sharded under a tp plan) ONCE at engine
+        # build; new weights land only through swap_weights() at a
+        # token boundary (same treedef/avals — the ladder never
+        # recompiles)
+        self.params = build_serving_snapshot(_gpt_params(model), cfg,
+                                             n_heads=self.n_heads)
         self.eps = float(mcfg.layer_norm_eps)
         self.vocab_size = int(mcfg.vocab_size)
         hd = int(mcfg.hidden_size) // self.n_heads
         pool_dtype = cfg.dtype or "float32"
+        pool_sharding = None
+        if self.tp > 1:
+            from jax.sharding import NamedSharding
+            from ..distributed.sharding import SERVING_POOL_SPEC
+            pool_sharding = NamedSharding(cfg.plan.mesh,
+                                          SERVING_POOL_SPEC)
         self.cache = PagedKVCache(
             n_layers=int(mcfg.num_layers), n_blocks=cfg.n_blocks,
             block_size=cfg.block_size, n_heads=self.n_heads,
             head_dim=hd, dtype=pool_dtype,
-            prefix_sharing=cfg.prefix_sharing)
+            prefix_sharing=cfg.prefix_sharing,
+            pool_sharding=pool_sharding, tp=self.tp)
         self.ladder = BucketLadder(cfg.prefill_buckets,
                                    cfg.decode_buckets, cfg.block_size)
         self.sched = FifoScheduler(cfg.max_slots, cfg.max_admit)
         sampling = (float(cfg.temperature),
                     None if cfg.top_k is None else int(cfg.top_k),
                     None if cfg.top_p is None else float(cfg.top_p))
-        self._decode = jit_with_donated_pools(make_decode_fn(
-            self.eps, self.n_heads, cfg.block_size, *sampling,
-            n_steps=int(cfg.decode_chunk)))
-        self._prefill = jit_with_donated_pools(make_prefill_fn(
-            self.eps, self.n_heads, cfg.block_size, *sampling))
+        if self.tp > 1:
+            # tp programs: the SAME bodies, shard_mapped over 'tp'.
+            # Each chip runs n_heads/tp heads in the permuted
+            # heads-major qkv layout and all-reduces the proj/fc2
+            # partial contractions through the planned collectives
+            # (tp_wire picks the wire tier; f32 is exact).
+            from ..distributed.comm import (CommConfig,
+                                            planned_all_reduce)
+            from ..distributed.sharding import serving_param_specs
+            comm_cfg = CommConfig(compress=cfg.tp_wire)
+
+            def tp_reduce(t):
+                return planned_all_reduce(t, config=comm_cfg,
+                                          axes=("tp",))
+
+            mesh = cfg.plan.mesh
+            pspecs = serving_param_specs(self.params)
+            nh_local = self.n_heads // self.tp
+            tp_kw = dict(qkv_heads_major=True, tp_reduce=tp_reduce,
+                         head_dim=hd)
+            self._decode = jit_tp_with_donated_pools(
+                make_decode_fn(self.eps, nh_local, cfg.block_size,
+                               *sampling,
+                               n_steps=int(cfg.decode_chunk), **tp_kw),
+                mesh, pspecs, n_plain=3, n_out=2)
+            self._prefill = jit_tp_with_donated_pools(
+                make_prefill_fn(self.eps, nh_local, cfg.block_size,
+                                *sampling, **tp_kw),
+                mesh, pspecs, n_plain=3, n_out=2)
+        else:
+            self._decode = jit_with_donated_pools(make_decode_fn(
+                self.eps, self.n_heads, cfg.block_size, *sampling,
+                n_steps=int(cfg.decode_chunk)))
+            self._prefill = jit_with_donated_pools(make_prefill_fn(
+                self.eps, self.n_heads, cfg.block_size, *sampling))
         # the chunk program serves BOTH new levers (speculative verify
         # at [slots, k+1], shared-prefix suffix prefill at [admit,
         # bucket]) — one jit, shape-bucketed executables
@@ -766,7 +880,8 @@ class ServingEngine:
         build_serving_snapshot and shared across replicas)."""
         import jax
         import jax.numpy as jnp
-        new = (build_serving_snapshot(params, self.config) if cast
+        new = (build_serving_snapshot(params, self.config,
+                                      n_heads=self.n_heads) if cast
                else params)
         old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
         new_leaves, new_def = jax.tree_util.tree_flatten(new)
@@ -789,10 +904,20 @@ class ServingEngine:
         # its device (and a raw numpy leaf is host-side), so flipping
         # either in directly would RETRACE the whole ladder on the
         # first post-flip dispatch. The host round-trip yields fresh
-        # uncommitted arrays that hit the existing executables.
-        import numpy as _np
-        self.params = jax.tree_util.tree_map(
-            lambda a: jnp.asarray(_np.asarray(a)), new)
+        # uncommitted arrays that hit the existing executables. Under
+        # a tp plan the inverse holds: build-time params are COMMITTED
+        # to the plan's mesh with the derived specs, so the one
+        # placement that hits the compiled ladder is that same
+        # device_put — a host round-trip would un-shard and retrace.
+        if self.tp > 1:
+            from ..distributed.sharding import serving_param_shardings
+            self.params = jax.device_put(
+                new, serving_param_shardings(self.config.plan.mesh,
+                                             new))
+        else:
+            import numpy as _np
+            self.params = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(_np.asarray(a)), new)
         if _obs._enabled:
             _obs.counter("serving.weight_swaps_total").add(1)
         return self

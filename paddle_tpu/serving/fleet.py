@@ -349,8 +349,9 @@ class ServingFleet:
         # live replica survived the episode to read it from.
         if self._current_params is not None:
             return self._current_params
-        return build_serving_snapshot(_gpt_params(self._model),
-                                      self.config)
+        return build_serving_snapshot(
+            _gpt_params(self._model), self.config,
+            n_heads=int(self._model.gpt.config.num_heads))
 
     def swap_weights(self, source=None, checkpoint_path: Optional[str]
                      = None, verify: bool = True) -> bool:
@@ -373,9 +374,12 @@ class ServingFleet:
             source = source["params"]
         raw = _gpt_params(source) if hasattr(source, "gpt") else source
         # the engines' snapshot builder (cast + int8 PTQ under
-        # quant="int8") — any other transform would stage a standby
-        # whose treedef every engine rejects
-        standby = build_serving_snapshot(raw, self.config)
+        # quant="int8", plus the qkv head-major permutation + sharded
+        # placement under a tp plan) — any other transform would stage
+        # a standby whose treedef every engine rejects
+        standby = build_serving_snapshot(
+            raw, self.config,
+            n_heads=int(self._model.gpt.config.num_heads))
         # compatibility is validated at STAGE time, synchronously: a
         # wrong-model standby must raise HERE at the caller, not blow
         # up the control loop ticks later inside _flip_one

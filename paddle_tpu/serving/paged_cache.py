@@ -180,7 +180,8 @@ class PagedKVCache:
 
     def __init__(self, n_layers: int, n_blocks: int, block_size: int,
                  n_heads: int, head_dim: int, dtype="float32",
-                 prefix_sharing: bool = False):
+                 prefix_sharing: bool = False, pool_sharding=None,
+                 tp: int = 1):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks={n_blocks}: need at least 1 allocatable "
@@ -194,11 +195,25 @@ class PagedKVCache:
         self.n_heads = int(n_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype)
+        # tensor parallelism: n_heads stays the GLOBAL head count —
+        # every host-side structure (tables, free list, refcounts,
+        # radix index, sizing math) is tp-invariant; only the device
+        # pools shard, each chip holding heads/tp of every page
+        # (pool_sharding = NamedSharding over the plan's 'tp' axis)
+        self.tp = int(tp)
+        self.pool_sharding = pool_sharding
         shape = (self.n_blocks, self.block_size, self.n_heads,
                  self.head_dim)
-        self.pools = tuple(
-            (jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
-            for _ in range(self.n_layers))
+
+        def _pool():
+            z = jnp.zeros(shape, self.dtype)
+            if pool_sharding is not None:
+                import jax
+                z = jax.device_put(z, pool_sharding)
+            return z
+
+        self.pools = tuple((_pool(), _pool())
+                           for _ in range(self.n_layers))
         # LIFO free list: hot reuse keeps the working set of pages
         # small (freshly-freed pages go to the next admission)
         self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
@@ -275,6 +290,7 @@ class PagedKVCache:
             "pool_bytes": 2 * self.n_layers * self.n_blocks
             * page_bytes,
         }
+        out["pool_bytes_per_chip"] = out["pool_bytes"] // self.tp
         if self.prefix_sharing:
             out.update({
                 "pages_shared": self.n_shared,

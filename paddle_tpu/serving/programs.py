@@ -27,6 +27,14 @@ prefill computes past a row's true length) is either routed to scratch
 by table padding or progressively overwritten by the decode scatter —
 and never attended, because every attention masks to the row's live
 prefix.
+
+Tensor parallelism (``ServingConfig(plan=MeshPlan(tp=N))``) reuses
+these exact bodies inside a ``shard_map`` over the 'tp' axis: the
+makers' ``qkv_heads_major``/``tp_reduce``/``head_dim`` hooks switch the
+qkv column layout to heads-major (whole heads per contiguous shard)
+and all-reduce the proj/fc2 partial contractions before their biases —
+with both hooks off, the tp=1 graph is byte-for-byte the one these
+makers always built, which is what keeps the parity contract intact.
 """
 from __future__ import annotations
 
@@ -34,12 +42,13 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..models.generation import _attend, _ln, _mm, _pick, _prefill
 from ..observability.anatomy import scope as _scope
 
 __all__ = ["make_decode_fn", "make_prefill_fn", "make_chunk_fn",
-           "jit_with_donated_pools"]
+           "jit_with_donated_pools", "jit_tp_with_donated_pools"]
 
 
 def _gathered(pool, tables, n_heads, hd):
@@ -54,7 +63,8 @@ def _gathered(pool, tables, n_heads, hd):
 
 def make_decode_fn(eps: float, n_heads: int, block_size: int,
                    temperature: float, top_k, top_p,
-                   n_steps: int = 1):
+                   n_steps: int = 1, qkv_heads_major: bool = False,
+                   tp_reduce=None, head_dim=None):
     """``n_steps`` token boundaries for every running slot, fused into
     one dispatch (lax.scan over the single-token body).
 
@@ -81,7 +91,7 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
         # memory plane attributes the paged cache's scatter/gather and
         # the per-layer matmuls row-for-row with the train taxonomy
         b = toks.shape[0]
-        hd = params["wte"].shape[1] // n_heads
+        hd = head_dim or params["wte"].shape[1] // n_heads
         scale = 1.0 / math.sqrt(hd)
         with _scope("embed"):
             x = (params["wte"][toks]
@@ -93,8 +103,12 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
         for bp, (kp, vp) in zip(params["blocks"], pools):
             with _scope("attn"):
                 xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-                qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
-                    b, 1, 3, n_heads, hd)
+                qkv = _mm(xn, bp, "qkv") + bp["qkv_b"]
+                if qkv_heads_major:
+                    qkv = jnp.einsum("bsnch->bscnh", qkv.reshape(
+                        b, 1, n_heads, 3, hd))
+                else:
+                    qkv = qkv.reshape(b, 1, 3, n_heads, hd)
                 q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])  # [B,nh,1,hd]
                 k_tok = qkv[:, 0, 1]                     # [B,nh,hd]
                 v_tok = qkv[:, 0, 2]
@@ -104,12 +118,18 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
                 vc = _gathered(vp, tables, n_heads, hd)
                 ctx = _attend(q, kc, vc, positions + 1, scale)
                 ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, 1, -1)
-                x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
+                proj = _mm(ctx, bp, "proj")
+                if tp_reduce is not None:
+                    proj = tp_reduce(proj)
+                x = x + proj + bp["proj_b"]
             with _scope("mlp"):
                 ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
                 ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
                                  approximate=False)
-                x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
+                f2 = _mm(ff, bp, "fc2")
+                if tp_reduce is not None:
+                    f2 = tp_reduce(f2)
+                x = x + f2 + bp["fc2_b"]
             new_pools.append((kp, vp))
         with _scope("lm_head"):
             h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
@@ -132,7 +152,9 @@ def make_decode_fn(eps: float, n_heads: int, block_size: int,
 
 
 def make_prefill_fn(eps: float, n_heads: int, block_size: int,
-                    temperature: float, top_k, top_p):
+                    temperature: float, top_k, top_p,
+                    qkv_heads_major: bool = False, tp_reduce=None,
+                    head_dim=None):
     """Bucketed admission prefill: the whole admit batch — MIXED true
     lengths — shares ONE executable per (admit width, bucket len).
 
@@ -159,7 +181,10 @@ def make_prefill_fn(eps: float, n_heads: int, block_size: int,
             # helper — its own layers carry no finer scopes, so the
             # whole forward attributes to attn (the dominant term)
             x, caches = _prefill(params, eps, n_heads, ids, s,
-                                 prompt_lens=prompt_lens)
+                                 prompt_lens=prompt_lens,
+                                 qkv_heads_major=qkv_heads_major,
+                                 tp_reduce=tp_reduce,
+                                 head_dim=head_dim)
             new_pools = []
             for (kp, vp), (kc, vc) in zip(pools, caches):
                 # [A, nh, S, hd] -> page chunks [A, nblk, bs, nh, hd]
@@ -182,7 +207,9 @@ def make_prefill_fn(eps: float, n_heads: int, block_size: int,
 
 
 def make_chunk_fn(eps: float, n_heads: int, block_size: int,
-                  temperature: float, top_k, top_p):
+                  temperature: float, top_k, top_p,
+                  qkv_heads_major: bool = False, tp_reduce=None,
+                  head_dim=None):
     """Mid-stream multi-token forward over the PAGED cache — the one
     program behind both new raw-speed levers:
 
@@ -218,7 +245,7 @@ def make_chunk_fn(eps: float, n_heads: int, block_size: int,
 
     def run(pools, tables, toks, starts, lens, params, key):
         b, s = toks.shape
-        hd = params["wte"].shape[1] // n_heads
+        hd = head_dim or params["wte"].shape[1] // n_heads
         scale = 1.0 / math.sqrt(hd)
         offs = jnp.arange(s, dtype=jnp.int32)
         positions = starts[:, None] + offs[None, :]        # [B, S]
@@ -236,8 +263,12 @@ def make_chunk_fn(eps: float, n_heads: int, block_size: int,
         for bp, (kp, vp) in zip(params["blocks"], pools):
             with _scope("attn"):
                 xn = _ln(x, bp["ln1_w"], bp["ln1_b"], eps)
-                qkv = (_mm(xn, bp, "qkv") + bp["qkv_b"]).reshape(
-                    b, s, 3, n_heads, hd)
+                qkv = _mm(xn, bp, "qkv") + bp["qkv_b"]
+                if qkv_heads_major:
+                    qkv = jnp.einsum("bsnch->bscnh", qkv.reshape(
+                        b, s, n_heads, 3, hd))
+                else:
+                    qkv = qkv.reshape(b, s, 3, n_heads, hd)
                 q = jnp.einsum("bsnh->bnsh", qkv[:, :, 0])  # [B,nh,S,hd]
                 kp = kp.at[blk, off].set(qkv[:, :, 1])
                 vp = vp.at[blk, off].set(qkv[:, :, 2])
@@ -252,12 +283,18 @@ def make_chunk_fn(eps: float, n_heads: int, block_size: int,
                                    axis=-1).astype(x.dtype)
                 ctx = jnp.einsum("bnqk,bnkh->bnqh", p, vc)
                 ctx = jnp.einsum("bnsh->bsnh", ctx).reshape(b, s, -1)
-                x = x + _mm(ctx, bp, "proj") + bp["proj_b"]
+                proj = _mm(ctx, bp, "proj")
+                if tp_reduce is not None:
+                    proj = tp_reduce(proj)
+                x = x + proj + bp["proj_b"]
             with _scope("mlp"):
                 ff = _ln(x, bp["ln2_w"], bp["ln2_b"], eps)
                 ff = jax.nn.gelu(_mm(ff, bp, "fc1") + bp["fc1_b"],
                                  approximate=False)
-                x = x + _mm(ff, bp, "fc2") + bp["fc2_b"]
+                f2 = _mm(ff, bp, "fc2")
+                if tp_reduce is not None:
+                    f2 = tp_reduce(f2)
+                x = x + f2 + bp["fc2_b"]
             new_pools.append((kp, vp))
         with _scope("lm_head"):
             h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
@@ -279,3 +316,31 @@ def jit_with_donated_pools(fn):
     cache): `_cache_size()` then counts exactly this engine's
     executables, which is what the RecompileSentinel contract needs."""
     return jax.jit(fn, donate_argnums=(0,))
+
+
+def jit_tp_with_donated_pools(fn, mesh, params_specs, n_plain: int,
+                              n_out: int):
+    """The tp twin of jit_with_donated_pools: the program body runs as
+    a ``shard_map`` over the mesh's 'tp' axis, then jits with the SAME
+    donation policy — pools stay arg 0 and donated, so the per-chip
+    page shards update in place and ``_cache_size()`` keeps counting
+    this engine's executables.
+
+    Argument contract (all three serving programs share it):
+    ``fn(pools, <n_plain host arrays>, params, key)``. Pools shard
+    over heads per SERVING_POOL_SPEC; the host arrays (tables /
+    positions / token windows) and the key replicate — the host block
+    tables are the SAME numpy arrays a tp=1 engine dispatches, which
+    is why admission/eviction/COW logic is untouched by tp. Outputs:
+    pools first (sharded), then ``n_out - 1`` replicated token arrays
+    (identical on every chip by construction — every divergent value
+    is all-reduced before it reaches the sampler)."""
+    from jax import shard_map
+    from ..distributed.sharding import SERVING_POOL_SPEC
+    sm = shard_map(
+        fn, mesh=mesh,
+        in_specs=(SERVING_POOL_SPEC,) + (P(),) * n_plain
+        + (params_specs, P()),
+        out_specs=(SERVING_POOL_SPEC,) + (P(),) * (n_out - 1),
+        check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))

@@ -19,6 +19,15 @@ prompts pad to the smallest prefill bucket that fits the LONGEST
 prompt in the admit batch, decode runs at the smallest slot-count
 bucket covering the active set. Executable count is therefore bounded
 by ladder size, not by the length mix of the traffic.
+
+Tensor parallelism changes NOTHING here — that is a load-bearing
+contract, not an accident. The scheduler's decisions are over
+requests, slots, pages and positions, never heads, and under a
+``ServingConfig(plan=MeshPlan(tp=N))`` engine the block tables and
+every queue stay host-replicated while only the device pools shard
+over heads. ONE host decision stream drives all tp chips; anything
+added here that branches on a per-chip quantity would fork that
+stream and break the shard_map programs' replicated-operand contract.
 """
 from __future__ import annotations
 

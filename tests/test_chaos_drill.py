@@ -241,8 +241,11 @@ class TestShrinkDrillFast:
             assert got == want, (step, sorted(got))
 
 
+@pytest.mark.slow  # 13.5 s, the heaviest chaos subprocess drill:
+#                    TestShrinkDrillFast keeps the kill->evict->resume
+#                    e2e in tier-1, the unit classes keep the policy
 class TestGrowDrillFast:
-    """Tier-1 grow drill (closes PR 8's scope cut): kill rank 1,
+    """Grow drill (closes PR 8's scope cut): kill rank 1,
     supervisor evicts it and shrinks to dp=1, then --grow_after grows
     it back — the regrown slot's checkpoint is frozen at the eviction
     cut, so it must ADOPT the survivor's params + cursor through the

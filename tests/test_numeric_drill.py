@@ -284,8 +284,11 @@ def _launch_numeric(tmp_path, *, chaos_env, nproc=2, steps=18,
     return r, out, recs
 
 
+@pytest.mark.slow  # 9.5 s subprocess drill; TestDoctorNumericVerdict
+#                    + TestSupervisorQuarantine + TestReplayTriage
+#                    keep the verdict->quarantine->triage policy fast
 class TestNumericDrillFast:
-    """Tier-1 acceptance smoke (~9 s): flip_bit on rank 1 of a dp=2
+    """Acceptance smoke (~9 s): flip_bit on rank 1 of a dp=2
     elastic run -> NUMERIC verdict names the rank, supervisor
     quarantine-evicts it, survivor resumes from a health-stamped
     checkpoint, and the fault capture triages as transient SDC."""

@@ -9,6 +9,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 
@@ -166,6 +168,8 @@ def test_plan_audit_bridge_receipt(tmp_path):
     assert rec["metrics"], rec
 
 
+@pytest.mark.slow  # 8.3 s; test_pulse_server's 14 tests + the three
+#                    bridges above keep pulse + obs_report in tier-1
 def test_pulse_bridge_receipt():
     """--pulse: THE live scrape-parity acceptance receipt — during a
     running fleet leg a mid-run HTTP /metrics pull parses as valid

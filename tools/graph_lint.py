@@ -27,6 +27,10 @@ Programs (all three by default; shapes env-free, flag-tunable):
            (paddle_tpu.serving) — its donated KV page pools MUST alias
            in input_output_alias (a dropped donation doubles serving
            HBM every token); baseline: tools/serving_lint_baseline.json
+  serving_tp  the tp=2 shard_map decode step with head-sharded page
+           pools — implicit-replication is the headline (NO >=1 MiB
+           all-gather of cache or weights) and the sharded pools must
+           still alias; baseline: tools/serving_tp_lint_baseline.json
 
 Baselines: --baseline FILE gates on NEW findings only;
 --write-baseline re-anchors (the tier1_budget rebalance flow). Always
@@ -240,12 +244,49 @@ def build_serving_int8(args, config):
                         config=config, schedule=[])
 
 
+def build_serving_tp(args, config):
+    """Tensor-parallel decode audit target (ISSUE 20): the tp=2
+    shard_map decode step with the paged K/V pools sharded over heads.
+    The implicit-replication rule is the headline — each page pool is
+    sized to 1 MiB f32 GLOBAL, so a spec-derivation bug that gathers a
+    pool (or un-shards the weights) onto every chip materializes a
+    >=1 MiB all-gather and fails the lint before it doubles per-chip
+    HBM on a pod. The donation rule proves the sharded pools still
+    alias (jit(shard_map) keeps input_output_alias)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import ProgramAudit
+    from paddle_tpu.distributed.sharding import MeshPlan
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128, dropout=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_slots=8, max_admit=4, block_size=8, n_blocks=512,
+        prefill_buckets=(32, 64), decode_chunk=4,
+        max_total_tokens=96, dtype=None, plan=MeshPlan(tp=2)))
+    W = eng.config.table_width
+    lowered = eng._decode.lower(
+        eng.cache.pools, np.zeros((8, W), np.int32),
+        np.zeros((8,), np.int32), np.zeros((8,), np.int32),
+        eng.params, jax.random.key(0))
+    return ProgramAudit("serving_tp_decode", lowered=lowered,
+                        config=config, schedule=[])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--program", choices=("ernie", "spmd", "planner",
                                           "serving", "serving_int8",
+                                          "serving_tp",
                                           "all", "none"),
                     default="all",
                     help="which programs to lower and audit "
@@ -272,10 +313,12 @@ def main(argv=None) -> int:
                     help="spmd stage width")
     args = ap.parse_args(argv)
 
-    want = ("ernie", "spmd", "planner", "serving", "serving_int8") \
+    want = ("ernie", "spmd", "planner", "serving", "serving_int8",
+            "serving_tp") \
         if args.program == "all" else \
         () if args.program == "none" else (args.program,)
-    # the planner target wants a dp×tp×pp mesh — 8 virtual devices
+    # the planner target wants a dp×tp×pp mesh — 8 virtual devices;
+    # serving_tp needs >=2 (N_DEV's floor already covers it)
     _force_cpu_devices(max(N_DEV, 8) if "planner" in want else None)
     from paddle_tpu.analysis import (
         GraphLintConfig, exit_code, format_findings, lint_package,
@@ -290,7 +333,8 @@ def main(argv=None) -> int:
     builders = {"ernie": build_ernie, "spmd": build_spmd,
                 "planner": build_planner,
                 "serving": build_serving,
-                "serving_int8": build_serving_int8}
+                "serving_int8": build_serving_int8,
+                "serving_tp": build_serving_tp}
     for name in want:
         audit = builders[name](args, config)
         programs.append(audit.name)

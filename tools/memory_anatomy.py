@@ -18,6 +18,9 @@ Programs (all by default; shapes flag-tunable, tiny CPU smoke sizes):
              spec-derivation regressions
   serving    the continuous-batching prefill + chunked-decode programs
              at the largest ladder buckets (donated page pools)
+  serving_tp the tp=2 tensor-parallel twins at the SAME shapes —
+             per-chip rows proving pool+weight bytes ≈ 1/tp (+ε for
+             the tp all-reduce scratch)
 
 Baselines (tools/memory_baseline.json by default):
   --check            exit 1 when a program's peak exceeds its baseline
@@ -216,13 +219,53 @@ def build_serving(args):
     return [("serving_prefill", prefill), ("serving_decode", decode)]
 
 
+def build_serving_tp(args):
+    """The tp=2 tensor-parallel serving programs at the SAME shapes as
+    the serving group — XLA's buffer assignment is per chip, so these
+    rows against their tp=1 twins are the 1/tp receipt: per-chip pool
+    + sharded-weight bytes halve (replicated tables/embeddings and the
+    tp all-reduce scratch are the +ε)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.sharding import MeshPlan
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=args.srv_hidden,
+                    num_layers=2, num_heads=4, max_seq_len=128,
+                    dropout=0.0, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_slots=4, max_admit=2, block_size=8, n_blocks=32,
+        prefill_buckets=(32,), decode_chunk=2,
+        max_total_tokens=64, dtype=None, plan=MeshPlan(tp=2)))
+    W = eng.config.table_width
+    a, s, b = eng.sched.max_admit, 32, eng.config.max_slots
+    key = jax.random.key(0)
+    prefill = eng._prefill.lower(
+        eng.cache.pools, np.zeros((a, W), np.int32),
+        np.zeros((a, s), np.int32), np.ones((a,), np.int32),
+        eng.params, key)
+    decode = eng._decode.lower(
+        eng.cache.pools, np.zeros((b, W), np.int32),
+        np.zeros((b,), np.int32), np.zeros((b,), np.int32),
+        eng.params, key)
+    return [("serving_prefill_tp2", prefill),
+            ("serving_decode_tp2", decode)]
+
+
 def compute(args) -> dict:
     """Lower + attribute every requested program. Returns
     program -> attribute_compiled_memory result."""
     builders = {"train": build_train, "spmd": build_spmd,
-                "planner": build_planner, "serving": build_serving}
+                "planner": build_planner, "serving": build_serving,
+                "serving_tp": build_serving_tp}
     want = [p.strip() for p in args.programs.split(",") if p.strip()]
-    # the planner layouts want a dp×tp×pp mesh — 8 virtual devices
+    # the planner layouts want a dp×tp×pp mesh — 8 virtual devices;
+    # serving_tp needs >=2 (N_DEV's floor already covers it)
     _force_cpu_devices(max(N_DEV, 8) if "planner" in want else None)
     from paddle_tpu.observability import memory as mem
 
@@ -251,7 +294,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--programs", default="train,spmd,planner,serving",
+    ap.add_argument("--programs",
+                    default="train,spmd,planner,serving,serving_tp",
                     help="comma-separated flagship set "
                          "(train,spmd,planner,serving)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
